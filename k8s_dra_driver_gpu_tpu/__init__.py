@@ -1,0 +1,33 @@
+"""TPU-native Kubernetes DRA driver framework.
+
+A brand-new framework with the capabilities of NVIDIA's k8s-dra-driver-gpu
+(reference surveyed in /root/repo/SURVEY.md), designed TPU-first:
+
+- ``tpulib``: C++/ctypes device layer enumerating TPU chips, ICI topology,
+  and sub-slice partitions (replaces the reference's NVML cgo layer,
+  reference cmd/gpu-kubelet-plugin/nvlib.go).
+- ``api``: the ``resource.tpu.dra/v1beta1`` API group -- opaque device
+  configs with Normalize/Validate and strict/non-strict decoders, plus the
+  ComputeDomain / ComputeDomainClique CR types (reference
+  api/nvidia.com/resource/v1beta1/).
+- ``kubeletplugin``: the per-node ``tpu.dra.dev`` DRA driver -- chip
+  enumeration -> ResourceSlice publication, two-phase checkpointed
+  Prepare/Unprepare, CDI injection of /dev/accel* + libtpu + TPU_* env
+  (reference cmd/gpu-kubelet-plugin/).
+- ``computedomain``: controller + kubelet plugin + per-node daemon that
+  gang-prepare multi-host ICI slices and bootstrap the JAX coordination
+  service (reference cmd/compute-domain-{controller,kubelet-plugin,daemon}/).
+- ``pkg``: shared infra -- feature gates, flock, workqueue, metrics,
+  boot-id, minimal k8s REST client, DRA gRPC plumbing (reference pkg/).
+- ``models`` / ``ops`` / ``parallel`` / ``train``: the TPU workload stack
+  (JAX Llama-3, sharded training step, ring attention, collectives) that
+  runs on slices prepared by this driver -- the reference exercises its
+  fabric with external NCCL jobs; we ship the JAX analog in-tree.
+"""
+
+__version__ = "0.1.0"
+
+DRIVER_NAME = "tpu.dra.dev"
+COMPUTE_DOMAIN_DRIVER_NAME = "compute-domain.tpu.dra.dev"
+API_GROUP = "resource.tpu.dra"
+API_VERSION = "v1beta1"

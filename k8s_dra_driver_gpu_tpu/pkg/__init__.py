@@ -1,0 +1,1 @@
+"""Shared infrastructure packages (reference: pkg/ and internal/)."""

@@ -1,0 +1,27 @@
+"""Node boot-ID reader, used for checkpoint invalidation across reboots.
+
+Reference: pkg/bootid/bootid.go (reads /proc/sys/kernel/random/boot_id;
+mutable path seam for tests, bootid.go:14; consumed by the checkpoint
+layer to invalidate prepared-claim state after a node reboot,
+cmd/gpu-kubelet-plugin/checkpointv.go:74-81).
+"""
+
+from __future__ import annotations
+
+# Test seam: tests may reassign this to a temp file (mirrors the
+# reference's mutable ``bootIDPath`` package variable).
+BOOT_ID_PATH = "/proc/sys/kernel/random/boot_id"
+
+
+def read_boot_id(path: str | None = None) -> str:
+    """Return the node's boot ID, or "" if unreadable.
+
+    An empty boot ID disables reboot-based checkpoint invalidation rather
+    than failing startup (same degradation the reference chooses).
+    """
+    p = path or BOOT_ID_PATH
+    try:
+        with open(p, "r", encoding="utf-8") as f:
+            return f.read().strip()
+    except OSError:
+        return ""

@@ -1,0 +1,175 @@
+"""Versioned k8s-style feature gates with cross-gate validation.
+
+Reference: pkg/featuregates/featuregates.go (gates TimeSlicingSettings,
+MPSSupport, IMEXDaemonsWithDNSNames, PassthroughSupport,
+NVMLDeviceHealthCheck, DynamicMIG, ComputeDomainCliques,
+CrashOnNVLinkFabricErrors, DeviceMetadata at :44-67; dependency /
+mutual-exclusion validation ValidateFeatureGates() :222-248;
+emulation-version pinning :26-40).
+
+TPU mapping: DynamicMIG -> DynamicSubSlice (ICI sub-slice carve-outs),
+MPSSupport -> MultiTenancySupport (co-tenant chip sharing),
+IMEXDaemonsWithDNSNames -> DomainDaemonsWithDNSNames (stable DNS names for
+the JAX coordination service), NVMLDeviceHealthCheck -> ChipHealthCheck,
+CrashOnNVLinkFabricErrors -> CrashOnICIFabricErrors.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class Stage(str, Enum):
+    ALPHA = "ALPHA"
+    BETA = "BETA"
+    GA = "GA"
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    name: str
+    default: bool
+    stage: Stage
+    # Gates that must be enabled for this gate to be enabled.
+    requires: tuple[str, ...] = ()
+    # Gates that must NOT be enabled together with this gate.
+    conflicts_with: tuple[str, ...] = ()
+    # Introduced-at emulation version (major, minor); a gate is unknown
+    # below its introduction version.
+    since: tuple[int, int] = (0, 1)
+
+
+# -- Gate names ---------------------------------------------------------------
+
+TIME_SLICING_SETTINGS = "TimeSlicingSettings"
+MULTI_TENANCY_SUPPORT = "MultiTenancySupport"
+DOMAIN_DAEMONS_WITH_DNS_NAMES = "DomainDaemonsWithDNSNames"
+PASSTHROUGH_SUPPORT = "PassthroughSupport"
+CHIP_HEALTH_CHECK = "ChipHealthCheck"
+DYNAMIC_SUB_SLICE = "DynamicSubSlice"
+COMPUTE_DOMAIN_CLIQUES = "ComputeDomainCliques"
+CRASH_ON_ICI_FABRIC_ERRORS = "CrashOnICIFabricErrors"
+DEVICE_METADATA = "DeviceMetadata"
+
+KNOWN_FEATURES: dict[str, FeatureSpec] = {
+    s.name: s
+    for s in [
+        FeatureSpec(TIME_SLICING_SETTINGS, default=False, stage=Stage.ALPHA),
+        FeatureSpec(
+            MULTI_TENANCY_SUPPORT,
+            default=False,
+            stage=Stage.ALPHA,
+            # Co-tenancy reuses the time-slicing policy plumbing; mirrors the
+            # reference's MPSSupport/TimeSlicingSettings relationship.
+            requires=(TIME_SLICING_SETTINGS,),
+        ),
+        FeatureSpec(DOMAIN_DAEMONS_WITH_DNS_NAMES, default=True, stage=Stage.BETA),
+        FeatureSpec(
+            PASSTHROUGH_SUPPORT,
+            default=False,
+            stage=Stage.ALPHA,
+            # A chip handed to vfio passthrough cannot be dynamically
+            # re-partitioned by this driver at the same time.
+            conflicts_with=(DYNAMIC_SUB_SLICE,),
+        ),
+        FeatureSpec(CHIP_HEALTH_CHECK, default=True, stage=Stage.BETA),
+        FeatureSpec(DYNAMIC_SUB_SLICE, default=False, stage=Stage.ALPHA),
+        FeatureSpec(COMPUTE_DOMAIN_CLIQUES, default=True, stage=Stage.BETA),
+        FeatureSpec(CRASH_ON_ICI_FABRIC_ERRORS, default=True, stage=Stage.BETA),
+        FeatureSpec(DEVICE_METADATA, default=False, stage=Stage.ALPHA),
+    ]
+}
+
+# The emulation version tracks the vendored k8s minor the driver targets
+# (reference pins to the vendored k8s minor, featuregates.go:26-40).
+EMULATION_VERSION = (1, 34)
+
+
+class FeatureGateError(ValueError):
+    pass
+
+
+@dataclass
+class FeatureGates:
+    """Immutable-after-parse set of enabled gates."""
+
+    enabled: dict[str, bool] = field(default_factory=dict)
+    emulation_version: tuple[int, int] = EMULATION_VERSION
+
+    def is_enabled(self, name: str) -> bool:
+        if name not in KNOWN_FEATURES:
+            raise FeatureGateError(f"unknown feature gate {name!r}")
+        # A gate is unknown (and therefore off) below its introduction
+        # version, including via its default.
+        if KNOWN_FEATURES[name].since > self.emulation_version:
+            return False
+        if name in self.enabled:
+            return self.enabled[name]
+        return KNOWN_FEATURES[name].default
+
+    def validate(self) -> None:
+        """Cross-gate dependency / mutual-exclusion validation.
+
+        Reference: ValidateFeatureGates(), featuregates.go:222-248.
+        """
+        for name in self.enabled:
+            if name not in KNOWN_FEATURES:
+                raise FeatureGateError(f"unknown feature gate {name!r}")
+            if KNOWN_FEATURES[name].since > self.emulation_version:
+                raise FeatureGateError(
+                    f"feature gate {name!r} is not available at emulation "
+                    f"version {self.emulation_version}"
+                )
+        for name, spec in KNOWN_FEATURES.items():
+            if not self.is_enabled(name):
+                continue
+            for dep in spec.requires:
+                if not self.is_enabled(dep):
+                    raise FeatureGateError(
+                        f"feature gate {name} requires {dep} to be enabled"
+                    )
+            for other in spec.conflicts_with:
+                if self.is_enabled(other):
+                    raise FeatureGateError(
+                        f"feature gates {name} and {other} are mutually exclusive"
+                    )
+
+    @classmethod
+    def parse(cls, spec: str, emulation_version: tuple[int, int] | None = None) -> "FeatureGates":
+        """Parse "Gate1=true,Gate2=false" (k8s-style) and validate.
+
+        Empty string yields all-defaults. Reference: pkg/flags
+        FeatureGateConfig with env mirror FEATURE_GATES.
+        """
+        enabled: dict[str, bool] = {}
+        for item in filter(None, (p.strip() for p in spec.split(","))):
+            if "=" not in item:
+                raise FeatureGateError(
+                    f"invalid feature gate spec {item!r}: expected Name=bool"
+                )
+            name, _, val = item.partition("=")
+            name, val = name.strip(), val.strip().lower()
+            if val not in ("true", "false"):
+                raise FeatureGateError(
+                    f"invalid value {val!r} for feature gate {name!r}"
+                )
+            enabled[name] = val == "true"
+        fg = cls(enabled=enabled, emulation_version=emulation_version or EMULATION_VERSION)
+        fg.validate()
+        return fg
+
+    @classmethod
+    def from_env(
+        cls,
+        env_var: str = "FEATURE_GATES",
+        emulation_version: tuple[int, int] | None = None,
+    ) -> "FeatureGates":
+        return cls.parse(
+            os.environ.get(env_var, ""), emulation_version=emulation_version
+        )
+
+
+def default_gates() -> FeatureGates:
+    return FeatureGates()

@@ -1,0 +1,122 @@
+"""Polling flock(2) wrapper with timeout and cancellation.
+
+Reference: pkg/flock/flock.go (release-on-fd-close crash safety; used for
+the node-global prepare/unprepare mutex and the checkpoint
+read-modify-write lock, for multi-process safety across plugin upgrades).
+
+Design notes (TPU build): same semantics -- a named lock file, acquired
+with LOCK_EX | LOCK_NB in a poll loop so acquisition honors a timeout and
+an optional cancel event. The lock is released either explicitly or by the
+kernel when the fd closes (process crash safety).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import threading
+import time
+
+
+class FlockTimeoutError(TimeoutError):
+    """Raised when the lock cannot be acquired within the timeout."""
+
+
+class Flock:
+    """A file-based advisory lock.
+
+    Usage:
+        lock = Flock("/var/run/tpu-dra/pu.lock")
+        with lock.acquire(timeout=10.0):
+            ...
+    """
+
+    def __init__(self, path: str):
+        self._path = path
+        self._fd: int | None = None
+        # Serializes acquire/release within this process; flock(2) itself
+        # only excludes other processes' fds.
+        self._thread_lock = threading.Lock()
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def acquire(
+        self,
+        timeout: float = 10.0,
+        poll_interval: float = 0.01,
+        cancel: threading.Event | None = None,
+    ) -> "_FlockGuard":
+        """Acquire the lock, polling until ``timeout`` seconds elapse.
+
+        Raises FlockTimeoutError on timeout and InterruptedError if
+        ``cancel`` is set while waiting.
+        """
+        deadline = time.monotonic() + timeout
+        # Honor timeout/cancel for intra-process contention too (the thread
+        # lock is non-reentrant: re-acquiring from the holding thread times
+        # out rather than deadlocking forever).
+        while not self._thread_lock.acquire(timeout=poll_interval):
+            if cancel is not None and cancel.is_set():
+                raise InterruptedError(
+                    f"lock acquisition on {self._path} canceled"
+                )
+            if time.monotonic() >= deadline:
+                raise FlockTimeoutError(
+                    f"timed out after {timeout}s acquiring {self._path}"
+                )
+        try:
+            os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+            fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+        except BaseException:
+            self._thread_lock.release()
+            raise
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self._fd = fd
+                return _FlockGuard(self)
+            except BlockingIOError:
+                if cancel is not None and cancel.is_set():
+                    os.close(fd)
+                    self._thread_lock.release()
+                    raise InterruptedError(
+                        f"lock acquisition on {self._path} canceled"
+                    ) from None
+                if time.monotonic() >= deadline:
+                    os.close(fd)
+                    self._thread_lock.release()
+                    raise FlockTimeoutError(
+                        f"timed out after {timeout}s acquiring {self._path}"
+                    ) from None
+                time.sleep(poll_interval)
+            except BaseException:
+                os.close(fd)
+                self._thread_lock.release()
+                raise
+
+    def release(self) -> None:
+        if self._fd is None:
+            return
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+        finally:
+            os.close(self._fd)
+            self._fd = None
+            self._thread_lock.release()
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+
+class _FlockGuard:
+    def __init__(self, lock: Flock):
+        self._lock = lock
+
+    def __enter__(self) -> Flock:
+        return self._lock
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()

@@ -1,0 +1,126 @@
+"""Prometheus metrics for the DRA drivers.
+
+Reference: pkg/metrics (DRA request duration histograms, in-flight and
+error counters, prepared-devices gauge -- dra_requests.go:27-151; the
+ComputeDomain cluster-status gauge -- computedomain_cluster.go; HTTP
+exposition server -- prometheus_httpserver.go).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)
+
+
+class DRARequestMetrics:
+    """Per-operation DRA request metrics (reference dra_requests.go)."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.duration = Histogram(
+            "tpu_dra_request_duration_seconds",
+            "Duration of DRA plugin requests by operation.",
+            ["operation"],
+            buckets=_BUCKETS,
+            registry=self.registry,
+        )
+        self.in_flight = Gauge(
+            "tpu_dra_requests_in_flight",
+            "Number of DRA plugin requests currently being served.",
+            ["operation"],
+            registry=self.registry,
+        )
+        self.errors = Counter(
+            "tpu_dra_request_errors_total",
+            "Total DRA plugin request errors by operation.",
+            ["operation"],
+            registry=self.registry,
+        )
+        self.prepared_devices = Gauge(
+            "tpu_dra_prepared_devices",
+            "Number of devices currently prepared for claims.",
+            registry=self.registry,
+        )
+
+    @contextmanager
+    def observe(self, operation: str):
+        self.in_flight.labels(operation).inc()
+        start = time.monotonic()
+        try:
+            yield
+        except BaseException:
+            self.errors.labels(operation).inc()
+            raise
+        finally:
+            self.duration.labels(operation).observe(time.monotonic() - start)
+            self.in_flight.labels(operation).dec()
+
+
+class ComputeDomainMetrics:
+    """Cluster-level ComputeDomain status gauge (computedomain_cluster.go)."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.status = Gauge(
+            "tpu_compute_domain_status",
+            "ComputeDomain readiness (1=Ready, 0=NotReady) by domain.",
+            ["namespace", "name"],
+            registry=self.registry,
+        )
+        self.nodes = Gauge(
+            "tpu_compute_domain_nodes",
+            "Number of nodes registered in a ComputeDomain.",
+            ["namespace", "name"],
+            registry=self.registry,
+        )
+
+
+class MetricsServer:
+    """Tiny HTTP exposition server (reference prometheus_httpserver.go)."""
+
+    def __init__(self, registry: CollectorRegistry, host: str = "127.0.0.1", port: int = 0):
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path.rstrip("/") not in ("", "/metrics".rstrip("/")):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = generate_latest(reg)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request logging
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="metrics-http", daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,237 @@
+"""Rate-limited retrying work queue with per-item callbacks.
+
+Reference: pkg/workqueue/workqueue.go (wrapper over the k8s typed
+rate-limited workqueue; limiter presets at :40-58 -- prepare/unprepare
+250ms->3s exponential plus a global 5 rps / burst 10 bucket; compute-domain
+daemon 5ms->6s exponential with 50% jitter, jitterlimiter.go; controller
+default) and the compute-domain plugin's retry engine
+(cmd/compute-domain-kubelet-plugin/driver.go:40-233: bounded retries via
+ErrorRetryMaxTimeout, permanentError short-circuit).
+
+Design notes (TPU build): a small threaded queue. Items are hashable keys
+with an attached callback; failures re-enqueue with exponential backoff
+until the limiter's max delay; ``PermanentError`` short-circuits retries.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
+
+
+class PermanentError(Exception):
+    """Wraps an error that must not be retried.
+
+    Reference: the CD plugin's permanentError (driver.go:56-60).
+    """
+
+    def __init__(self, cause: BaseException | str):
+        super().__init__(str(cause))
+        self.cause = cause if isinstance(cause, BaseException) else None
+
+
+@dataclass(frozen=True)
+class RateLimiter:
+    """Per-item exponential backoff with optional jitter and global rps cap."""
+
+    base_delay: float = 0.25
+    max_delay: float = 3.0
+    jitter: float = 0.0  # fraction of delay added uniformly at random
+    global_rps: float | None = None
+    global_burst: int = 1
+    # Total elapsed-time budget for retrying one item; None = unbounded.
+    # Reference: ErrorRetryMaxTimeout=45s (CD plugin driver.go:40-52).
+    retry_timeout: float | None = None
+
+    def delay_for(self, failures: int) -> float:
+        d = min(self.base_delay * (2 ** max(failures - 1, 0)), self.max_delay)
+        if self.jitter:
+            d += d * self.jitter * random.random()
+        return d
+
+
+# Presets mirroring the reference's limiter catalog (workqueue.go:40-58).
+PREP_UNPREP_LIMITER = RateLimiter(
+    base_delay=0.25, max_delay=3.0, global_rps=5.0, global_burst=10,
+    retry_timeout=45.0,
+)
+DOMAIN_DAEMON_LIMITER = RateLimiter(base_delay=0.005, max_delay=6.0, jitter=0.5)
+CONTROLLER_DEFAULT_LIMITER = RateLimiter(base_delay=0.005, max_delay=1.0)
+
+
+@dataclass(order=True)
+class _Scheduled:
+    when: float
+    seq: int
+    key: Any = field(compare=False)
+    fn: Callable[[Any], None] = field(compare=False)
+
+
+class WorkQueue:
+    """A retrying queue. ``enqueue(key, fn)`` runs ``fn(key)`` on a worker;
+    exceptions re-enqueue with backoff; PermanentError drops the item.
+
+    ``serialize=False`` allows multiple workers (reference CD plugin uses
+    Serialize(false) because channel-Prepares are codependent with the
+    daemon's Prepare, driver.go:89-96).
+    """
+
+    def __init__(
+        self,
+        limiter: RateLimiter = CONTROLLER_DEFAULT_LIMITER,
+        workers: int = 1,
+        name: str = "workqueue",
+        on_drop: Callable[[Any, BaseException], None] | None = None,
+    ):
+        self._limiter = limiter
+        self._name = name
+        self._on_drop = on_drop
+        self._heap: list[_Scheduled] = []
+        self._failures: dict[Any, int] = {}
+        self._first_failure: dict[Any, float] = {}
+        self._pending: set[Any] = set()  # keys queued or running (dedupe)
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._shutdown = False
+        self._tokens = float(limiter.global_burst)
+        self._last_refill = time.monotonic()
+        self._threads = [
+            threading.Thread(target=self._run, name=f"{name}-{i}", daemon=True)
+            for i in range(max(workers, 1))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- public API -----------------------------------------------------------
+
+    def enqueue(self, key: Any, fn: Callable[[Any], None]) -> None:
+        """Schedule fn(key) to run now. Deduplicates by key while queued."""
+        with self._cv:
+            if self._shutdown or key in self._pending:
+                return
+            self._pending.add(key)
+            self._push(key, fn, delay=0.0)
+
+    def forget(self, key: Any) -> None:
+        """Reset the failure count for key (after a success elsewhere)."""
+        with self._cv:
+            self._failures.pop(key, None)
+            self._first_failure.pop(key, None)
+
+    def len(self) -> int:
+        with self._cv:
+            return len(self._heap)
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+        if wait:
+            for t in self._threads:
+                t.join(timeout=5.0)
+
+    def wait_idle(self, timeout: float = 10.0) -> bool:
+        """Block until no items are queued or running (test helper)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._pending and not self._heap:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    # -- internals ------------------------------------------------------------
+
+    def _push(self, key: Any, fn: Callable[[Any], None], delay: float) -> None:
+        self._seq += 1
+        heapq.heappush(
+            self._heap, _Scheduled(time.monotonic() + delay, self._seq, key, fn)
+        )
+        self._cv.notify()
+
+    def _take_token(self) -> float:
+        """Global token bucket (reference: 5 rps / burst 10 on prep queues).
+
+        Returns seconds to wait before running (0 if a token was available).
+        """
+        if self._limiter.global_rps is None:
+            return 0.0
+        now = time.monotonic()
+        self._tokens = min(
+            self._limiter.global_burst,
+            self._tokens + (now - self._last_refill) * self._limiter.global_rps,
+        )
+        self._last_refill = now
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return 0.0
+        return (1.0 - self._tokens) / self._limiter.global_rps
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._shutdown and (
+                    not self._heap or self._heap[0].when > time.monotonic()
+                ):
+                    timeout = None
+                    if self._heap:
+                        timeout = max(self._heap[0].when - time.monotonic(), 0)
+                    self._cv.wait(timeout=timeout)
+                if self._shutdown:
+                    return
+                wait = self._take_token()
+                if wait > 0:
+                    item = heapq.heappop(self._heap)
+                    item.when = time.monotonic() + wait
+                    heapq.heappush(self._heap, item)
+                    continue
+                item = heapq.heappop(self._heap)
+            try:
+                item.fn(item.key)
+            except PermanentError as e:
+                self._drop(item.key, e)
+            except BaseException as e:  # noqa: BLE001 - retry loop boundary
+                now = time.monotonic()
+                with self._cv:
+                    first = self._first_failure.setdefault(item.key, now)
+                    exhausted = (
+                        self._limiter.retry_timeout is not None
+                        and now - first >= self._limiter.retry_timeout
+                    )
+                    if not exhausted:
+                        n = self._failures.get(item.key, 0) + 1
+                        self._failures[item.key] = n
+                        self._push(item.key, item.fn, self._limiter.delay_for(n))
+                if exhausted:
+                    logger.warning(
+                        "%s: retry budget (%.1fs) exhausted for %r",
+                        self._name, self._limiter.retry_timeout, item.key,
+                    )
+                    self._drop(item.key, e)
+                else:
+                    logger.warning(
+                        "%s: %r failed (attempt %d), retrying: %s",
+                        self._name, item.key, n, e,
+                    )
+            else:
+                with self._cv:
+                    self._failures.pop(item.key, None)
+                    self._first_failure.pop(item.key, None)
+                    self._pending.discard(item.key)
+
+    def _drop(self, key: Any, err: BaseException) -> None:
+        with self._cv:
+            self._failures.pop(key, None)
+            self._first_failure.pop(key, None)
+            self._pending.discard(key)
+        if self._on_drop:
+            self._on_drop(key, err)
+        else:
+            logger.error("%s: dropping %r: %s", self._name, key, err)

@@ -1,0 +1,27 @@
+"""Test harness configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (the reference tests
+multi-node flows on CPU-only kind clusters with a mock NVML; we test
+multi-chip sharding on a forced-host-platform device mesh, SURVEY.md §4).
+"""
+
+import os
+import sys
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_root(tmp_path):
+    """A scratch dir standing in for the plugin's state root."""
+    return str(tmp_path)

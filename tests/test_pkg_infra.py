@@ -1,0 +1,247 @@
+"""Tests for shared infra: flock, bootid, featuregates, workqueue, metrics.
+
+Modeled on the reference's pkg-level unit tests (pkg/featuregates/
+featuregates_test.go, pkg/workqueue/workqueue_test.go,
+pkg/bootid/bootid_test.go, pkg/metrics/dra_requests_test.go).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg import bootid
+from k8s_dra_driver_gpu_tpu.pkg.featuregates import (
+    CHIP_HEALTH_CHECK,
+    DYNAMIC_SUB_SLICE,
+    MULTI_TENANCY_SUPPORT,
+    PASSTHROUGH_SUPPORT,
+    TIME_SLICING_SETTINGS,
+    FeatureGateError,
+    FeatureGates,
+)
+from k8s_dra_driver_gpu_tpu.pkg.flock import Flock, FlockTimeoutError
+from k8s_dra_driver_gpu_tpu.pkg.metrics import DRARequestMetrics, MetricsServer
+from k8s_dra_driver_gpu_tpu.pkg.workqueue import (
+    PermanentError,
+    RateLimiter,
+    WorkQueue,
+)
+
+
+class TestFlock:
+    def test_acquire_release(self, tmp_root):
+        lock = Flock(os.path.join(tmp_root, "pu.lock"))
+        with lock.acquire(timeout=1.0):
+            assert lock.held
+        assert not lock.held
+
+    def test_cross_process_exclusion(self, tmp_root):
+        path = os.path.join(tmp_root, "pu.lock")
+        lock = Flock(path)
+        child = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "import fcntl,sys,time; f=open(sys.argv[1],'w');"
+                "fcntl.flock(f,fcntl.LOCK_EX); print('locked',flush=True);"
+                "time.sleep(5)",
+                path,
+            ],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            assert child.stdout.readline().strip() == "locked"
+            with pytest.raises(FlockTimeoutError):
+                lock.acquire(timeout=0.2)
+        finally:
+            child.kill()
+            child.wait()
+        # Kernel released the lock when the child died (crash safety).
+        with lock.acquire(timeout=2.0):
+            assert lock.held
+
+    def test_cancel(self, tmp_root):
+        path = os.path.join(tmp_root, "pu.lock")
+        holder = Flock(path)
+        guard = holder.acquire(timeout=1.0)
+        other = Flock(path)
+        cancel = threading.Event()
+        cancel.set()
+        with pytest.raises(InterruptedError):
+            other.acquire(timeout=5.0, cancel=cancel)
+        guard.__exit__(None, None, None)
+
+
+class TestBootID:
+    def test_read_from_seam(self, tmp_root):
+        p = os.path.join(tmp_root, "boot_id")
+        with open(p, "w") as f:
+            f.write("abc-123\n")
+        assert bootid.read_boot_id(p) == "abc-123"
+
+    def test_missing_file_degrades_to_empty(self, tmp_root):
+        assert bootid.read_boot_id(os.path.join(tmp_root, "nope")) == ""
+
+
+class TestFeatureGates:
+    def test_defaults(self):
+        fg = FeatureGates()
+        assert fg.is_enabled(CHIP_HEALTH_CHECK)
+        assert not fg.is_enabled(DYNAMIC_SUB_SLICE)
+
+    def test_parse_roundtrip(self):
+        fg = FeatureGates.parse("DynamicSubSlice=true,ChipHealthCheck=false")
+        assert fg.is_enabled(DYNAMIC_SUB_SLICE)
+        assert not fg.is_enabled(CHIP_HEALTH_CHECK)
+
+    def test_unknown_gate(self):
+        with pytest.raises(FeatureGateError):
+            FeatureGates.parse("NoSuchGate=true")
+
+    def test_bad_value(self):
+        with pytest.raises(FeatureGateError):
+            FeatureGates.parse("DynamicSubSlice=yes")
+
+    def test_dependency_validation(self):
+        # MultiTenancySupport requires TimeSlicingSettings.
+        with pytest.raises(FeatureGateError):
+            FeatureGates.parse(f"{MULTI_TENANCY_SUPPORT}=true")
+        fg = FeatureGates.parse(
+            f"{MULTI_TENANCY_SUPPORT}=true,{TIME_SLICING_SETTINGS}=true"
+        )
+        assert fg.is_enabled(MULTI_TENANCY_SUPPORT)
+
+    def test_mutual_exclusion(self):
+        with pytest.raises(FeatureGateError):
+            FeatureGates.parse(
+                f"{PASSTHROUGH_SUPPORT}=true,{DYNAMIC_SUB_SLICE}=true"
+            )
+
+    def test_emulation_version_gate(self):
+        with pytest.raises(FeatureGateError):
+            FeatureGates.parse("DynamicSubSlice=true", emulation_version=(0, 0))
+
+    def test_emulation_version_disables_defaults(self):
+        # A default-on gate introduced after the emulation version is off.
+        fg = FeatureGates(emulation_version=(0, 0))
+        assert not fg.is_enabled(CHIP_HEALTH_CHECK)
+
+
+class TestWorkQueue:
+    def test_success_runs_once(self):
+        q = WorkQueue()
+        ran = []
+        q.enqueue("a", lambda k: ran.append(k))
+        assert q.wait_idle(5.0)
+        assert ran == ["a"]
+        q.shutdown()
+
+    def test_retry_until_success(self):
+        q = WorkQueue(limiter=RateLimiter(base_delay=0.005, max_delay=0.01))
+        attempts = []
+
+        def flaky(key):
+            attempts.append(key)
+            if len(attempts) < 3:
+                raise RuntimeError("transient")
+
+        q.enqueue("x", flaky)
+        assert q.wait_idle(5.0)
+        assert len(attempts) == 3
+        q.shutdown()
+
+    def test_permanent_error_drops(self):
+        drops = []
+        q = WorkQueue(on_drop=lambda k, e: drops.append((k, str(e))))
+        attempts = []
+
+        def fatal(key):
+            attempts.append(key)
+            raise PermanentError("namespace mismatch")
+
+        q.enqueue("x", fatal)
+        assert q.wait_idle(5.0)
+        assert len(attempts) == 1
+        assert drops == [("x", "namespace mismatch")]
+        q.shutdown()
+
+    def test_retry_budget_exhaustion_drops(self):
+        # Reference: ErrorRetryMaxTimeout bounds per-item retrying
+        # (compute-domain plugin driver.go:40-52).
+        drops = []
+        q = WorkQueue(
+            limiter=RateLimiter(
+                base_delay=0.01, max_delay=0.02, retry_timeout=0.1
+            ),
+            on_drop=lambda k, e: drops.append(k),
+        )
+        q.enqueue("x", lambda k: (_ for _ in ()).throw(RuntimeError("always")))
+        assert q.wait_idle(5.0)
+        assert drops == ["x"]
+        # The key is released for future enqueues after the drop.
+        ran = []
+        q.enqueue("x", lambda k: ran.append(k))
+        assert q.wait_idle(5.0)
+        assert ran == ["x"]
+        q.shutdown()
+
+    def test_flock_same_instance_contention_times_out(self, tmp_root):
+        lock = Flock(os.path.join(tmp_root, "pu.lock"))
+        guard = lock.acquire(timeout=1.0)
+        done = []
+
+        def contend():
+            try:
+                lock.acquire(timeout=0.2)
+            except FlockTimeoutError:
+                done.append("timeout")
+
+        t = threading.Thread(target=contend)
+        t.start()
+        t.join(timeout=5.0)
+        assert done == ["timeout"]
+        guard.__exit__(None, None, None)
+
+    def test_dedupe_while_queued(self):
+        q = WorkQueue(limiter=RateLimiter(base_delay=0.2, max_delay=0.2))
+        ran = []
+        block = threading.Event()
+
+        def slow(key):
+            block.wait(2.0)
+            ran.append(key)
+
+        q.enqueue("k", slow)
+        time.sleep(0.05)
+        q.enqueue("k", slow)  # deduped: still pending
+        block.set()
+        assert q.wait_idle(5.0)
+        assert ran == ["k"]
+        q.shutdown()
+
+
+class TestMetrics:
+    def test_observe_and_expose(self):
+        m = DRARequestMetrics()
+        with m.observe("prepare"):
+            pass
+        with pytest.raises(ValueError):
+            with m.observe("prepare"):
+                raise ValueError("boom")
+        srv = MetricsServer(m.registry)
+        srv.start()
+        try:
+            import urllib.request
+
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics"
+            ).read().decode()
+            assert 'tpu_dra_request_errors_total{operation="prepare"} 1.0' in body
+            assert "tpu_dra_request_duration_seconds_bucket" in body
+        finally:
+            srv.stop()
