@@ -95,7 +95,8 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - http.server API
-                if self.path.rstrip("/") not in ("", "/metrics".rstrip("/")):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path not in ("", "/metrics"):
                     self.send_response(404)
                     self.end_headers()
                     return

@@ -51,7 +51,10 @@ class RateLimiter:
     retry_timeout: float | None = None
 
     def delay_for(self, failures: int) -> float:
-        d = min(self.base_delay * (2 ** max(failures - 1, 0)), self.max_delay)
+        # Cap the exponent so a persistently failing item can't grow an
+        # unbounded integer before the clamp.
+        exp = min(max(failures - 1, 0), 62)
+        d = min(self.base_delay * (2 ** exp), self.max_delay)
         if self.jitter:
             d += d * self.jitter * random.random()
         return d

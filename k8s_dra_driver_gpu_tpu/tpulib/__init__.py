@@ -1,0 +1,30 @@
+"""tpulib: the TPU device layer (NVML-analog).
+
+Reference: cmd/gpu-kubelet-plugin/nvlib.go (deviceLib over cgo/NVML).
+Here the native core is in-tree C++ (native/tpuinfo.cc) exposed through a
+C API and loaded via ctypes; a pure-Python backend implements the same
+contract for environments without the built library, and a parity test
+keeps the two honest.
+"""
+
+from .binding import (
+    HealthEvent,
+    NativeTpuLib,
+    PyTpuLib,
+    SubSliceProfile,
+    TpuChip,
+    TpuHostInfo,
+    TpuLibError,
+    load,
+)
+
+__all__ = [
+    "HealthEvent",
+    "NativeTpuLib",
+    "PyTpuLib",
+    "SubSliceProfile",
+    "TpuChip",
+    "TpuHostInfo",
+    "TpuLibError",
+    "load",
+]

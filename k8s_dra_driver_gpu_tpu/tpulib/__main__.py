@@ -1,0 +1,26 @@
+"""CLI: print this host's TPU enumeration as JSON.
+
+Usage:
+    TPULIB_MOCK_TOPOLOGY=v5p-16 python -m k8s_dra_driver_gpu_tpu.tpulib
+"""
+
+import dataclasses
+import json
+
+from .binding import EnumerateOptions, load
+
+
+def main() -> None:
+    lib = load()
+    opts = EnumerateOptions.from_env()
+    host = lib.enumerate(opts)
+    doc = dataclasses.asdict(host)
+    doc["backend"] = lib.name
+    doc["profiles"] = [
+        dataclasses.asdict(p) for p in lib.subslice_profiles(opts)
+    ]
+    print(json.dumps(doc, indent=2))
+
+
+if __name__ == "__main__":
+    main()

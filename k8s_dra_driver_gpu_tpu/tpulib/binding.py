@@ -1,0 +1,479 @@
+"""ctypes binding for libtpuinfo.so plus a pure-Python fallback backend.
+
+Reference analog: the cgo layer in cmd/gpu-kubelet-plugin/nvlib.go that
+dlopens libnvidia-ml.so.1 at a configurable driver root (root.go:28-63).
+Here the native library is our own in-tree C++ (native/tpuinfo.cc); the
+Python fallback mirrors its mock/devfs behavior so the rest of the stack
+is backend-agnostic (and the mock path mirrors the reference's mock-NVML
+strategy, hack/ci/mock-nvml/).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import re
+import subprocess
+from dataclasses import dataclass, field
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libtpuinfo.so")
+
+# Env seams (mirrors mock-NVML env switches like ALT_PROC_DEVICES_PATH,
+# internal/common/nvcaps.go:30-75).
+ENV_MOCK_TOPOLOGY = "TPULIB_MOCK_TOPOLOGY"
+ENV_MOCK_WORKER_ID = "TPULIB_MOCK_WORKER_ID"
+ENV_MOCK_HEALTH_EVENTS = "TPULIB_MOCK_HEALTH_EVENTS"
+
+
+class TpuLibError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class TpuChip:
+    index: int
+    uuid: str
+    devpath: str
+    ici_coords: tuple[int, int, int]
+    numa_node: int
+    pci_bdf: str
+    healthy: bool = True
+
+
+@dataclass(frozen=True)
+class TpuHostInfo:
+    platform: str  # v4|v5e|v5p|v6e
+    accelerator_type: str  # e.g. "v5p-16" ("" when undetectable)
+    topology: str  # chip-grid dims of the full slice, e.g. "2x2x2"
+    num_slice_chips: int
+    num_hosts: int
+    worker_id: int
+    chips_per_host: int
+    cores_per_chip: int
+    hbm_bytes_per_chip: int
+    chips: tuple[TpuChip, ...]
+    source: str  # mock|devfs|none
+
+    @property
+    def topology_dims(self) -> tuple[int, ...]:
+        return tuple(int(d) for d in self.topology.split("x"))
+
+
+@dataclass(frozen=True)
+class SubSliceProfile:
+    """A valid carve-out of one host's chips (MIG-profile analog)."""
+
+    name: str  # "1c" (single TensorCore) or chip-grid dims e.g. "2x1x1"
+    chips: int  # 0 for core-level profiles
+    cores: int
+    hbm_bytes: int
+    placements: tuple[int, ...]  # core index for "Nc", start chip otherwise
+
+    @property
+    def is_core_level(self) -> bool:
+        return self.chips == 0
+
+
+@dataclass(frozen=True)
+class HealthEvent:
+    chip: int
+    kind: str
+    fatal: bool
+
+
+@dataclass(frozen=True)
+class EnumerateOptions:
+    mock_topology: str | None = None
+    worker_id: int | None = None
+    dev_root: str | None = None
+    sys_root: str | None = None
+    health_events: str | None = None
+
+    @classmethod
+    def from_env(cls) -> "EnumerateOptions":
+        wid = os.environ.get(ENV_MOCK_WORKER_ID)
+        return cls(
+            mock_topology=os.environ.get(ENV_MOCK_TOPOLOGY),
+            worker_id=int(wid) if wid else None,
+            health_events=os.environ.get(ENV_MOCK_HEALTH_EVENTS),
+        )
+
+    def encode(self) -> str:
+        parts = []
+        if self.mock_topology:
+            parts.append(f"mock_topology={self.mock_topology}")
+        if self.worker_id is not None:
+            parts.append(f"worker_id={self.worker_id}")
+        if self.dev_root:
+            parts.append(f"dev_root={self.dev_root}")
+        if self.sys_root:
+            parts.append(f"sys_root={self.sys_root}")
+        if self.health_events:
+            parts.append(f"health_events={self.health_events}")
+        return ";".join(parts)
+
+
+def _host_from_json(doc: dict) -> TpuHostInfo:
+    return TpuHostInfo(
+        platform=doc["platform"],
+        accelerator_type=doc["accelerator_type"],
+        topology=doc["topology"],
+        num_slice_chips=doc["num_slice_chips"],
+        num_hosts=doc["num_hosts"],
+        worker_id=doc["worker_id"],
+        chips_per_host=doc["chips_per_host"],
+        cores_per_chip=doc["cores_per_chip"],
+        hbm_bytes_per_chip=doc["hbm_bytes_per_chip"],
+        chips=tuple(
+            TpuChip(
+                index=c["index"],
+                uuid=c["uuid"],
+                devpath=c["devpath"],
+                ici_coords=tuple(c["ici_coords"]),
+                numa_node=c["numa_node"],
+                pci_bdf=c["pci_bdf"],
+                healthy=c["healthy"],
+            )
+            for c in doc["chips"]
+        ),
+        source=doc["source"],
+    )
+
+
+class NativeTpuLib:
+    """Backend over the in-tree C++ library."""
+
+    def __init__(self, so_path: str = _SO_PATH):
+        if not os.path.exists(so_path):
+            raise TpuLibError(f"{so_path} not built")
+        self._lib = ctypes.CDLL(so_path)
+        self._lib.tpuinfo_version.restype = ctypes.c_char_p
+        for fn in ("tpuinfo_enumerate", "tpuinfo_subslice_profiles",
+                   "tpuinfo_health"):
+            getattr(self._lib, fn).restype = ctypes.c_void_p
+            getattr(self._lib, fn).argtypes = [ctypes.c_char_p]
+        self._lib.tpuinfo_free.argtypes = [ctypes.c_void_p]
+
+    @property
+    def name(self) -> str:
+        return "native"
+
+    def version(self) -> str:
+        return self._lib.tpuinfo_version().decode()
+
+    def _call(self, fn_name: str, opts: EnumerateOptions) -> dict:
+        ptr = getattr(self._lib, fn_name)(opts.encode().encode())
+        if not ptr:
+            raise TpuLibError(f"{fn_name} returned NULL")
+        try:
+            return json.loads(ctypes.string_at(ptr).decode())
+        finally:
+            self._lib.tpuinfo_free(ptr)
+
+    def enumerate(self, opts: EnumerateOptions | None = None) -> TpuHostInfo:
+        return _host_from_json(
+            self._call("tpuinfo_enumerate", opts or EnumerateOptions.from_env())
+        )
+
+    def subslice_profiles(
+        self, opts: EnumerateOptions | None = None
+    ) -> tuple[SubSliceProfile, ...]:
+        doc = self._call(
+            "tpuinfo_subslice_profiles", opts or EnumerateOptions.from_env()
+        )
+        return tuple(
+            SubSliceProfile(
+                name=p["name"],
+                chips=p["chips"],
+                cores=p["cores"],
+                hbm_bytes=p["hbm_bytes"],
+                placements=tuple(p["placements"]),
+            )
+            for p in doc["profiles"]
+        )
+
+    def health(self, opts: EnumerateOptions | None = None) -> tuple[HealthEvent, ...]:
+        doc = self._call("tpuinfo_health", opts or EnumerateOptions.from_env())
+        return tuple(
+            HealthEvent(chip=e["chip"], kind=e["kind"], fatal=e["fatal"])
+            for e in doc["events"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python backend (same contract; used when the .so is unavailable and
+# as the parity oracle in tests)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _Gen:
+    name: str
+    dims: int
+    chips_per_host: int
+    cores_per_chip: int
+    hbm_bytes: int
+    type_counts_cores: bool
+
+
+_GENERATIONS = {
+    g.name: g
+    for g in [
+        _Gen("v4", 3, 4, 2, 32 << 30, True),
+        _Gen("v5e", 2, 4, 1, 16 << 30, False),
+        _Gen("v5p", 3, 4, 2, 95 << 30, True),
+        _Gen("v6e", 2, 4, 1, 32 << 30, False),
+    ]
+}
+
+_SHAPES_3D = {1: (1, 1, 1), 2: (1, 1, 2), 4: (2, 2, 1), 8: (2, 2, 2),
+              16: (2, 2, 4), 32: (2, 4, 4), 64: (4, 4, 4), 128: (4, 4, 8),
+              256: (4, 8, 8), 512: (8, 8, 8)}
+_SHAPES_2D = {1: (1, 1, 1), 2: (1, 2, 1), 4: (2, 2, 1), 8: (2, 4, 1),
+              16: (4, 4, 1), 32: (4, 8, 1), 64: (8, 8, 1), 128: (8, 16, 1),
+              256: (16, 16, 1)}
+
+_FATAL_KINDS = {"hbm_uncorrectable", "chip_lost", "ici_link_down"}
+
+
+def _atoi(s: str) -> int:
+    """C atoi semantics (the native backend parses with atoi): leading
+    integer prefix, 0 when there is none."""
+    m = re.match(r"\s*[+-]?\d+", s)
+    return int(m.group()) if m else 0
+
+
+def _parse_type(t: str) -> tuple[_Gen, int] | None:
+    m = re.fullmatch(r"(v\d+\w*)-(\d+)", t)
+    if not m or m.group(1) not in _GENERATIONS:
+        return None
+    g = _GENERATIONS[m.group(1)]
+    n = int(m.group(2))
+    chips = n // g.cores_per_chip if g.type_counts_cores else n
+    return (g, chips) if chips > 0 else None
+
+
+def _slice_shape(g: _Gen, chips: int) -> tuple[int, int, int]:
+    tbl = _SHAPES_3D if g.dims == 3 else _SHAPES_2D
+    return tbl.get(chips, (1, chips, 1))
+
+
+def _host_shape(g: _Gen) -> tuple[int, int, int]:
+    return {8: (2, 4, 1), 4: (2, 2, 1), 2: (1, 2, 1)}.get(
+        g.chips_per_host, (1, 1, 1)
+    )
+
+
+def _chip_coords(slice_s, host_s, worker: int, local: int) -> tuple[int, int, int]:
+    bx = max(slice_s[0] // host_s[0], 1)
+    by = max(slice_s[1] // host_s[1], 1)
+    wx, wy, wz = worker % bx, (worker // bx) % by, worker // (bx * by)
+    lx = local % host_s[0]
+    ly = (local // host_s[0]) % host_s[1]
+    lz = local // (host_s[0] * host_s[1])
+    return (wx * host_s[0] + lx, wy * host_s[1] + ly, wz * host_s[2] + lz)
+
+
+def _shape_str(s: tuple[int, int, int], dims: int) -> str:
+    return f"{s[0]}x{s[1]}" if dims == 2 else f"{s[0]}x{s[1]}x{s[2]}"
+
+
+class PyTpuLib:
+    """Pure-Python backend implementing the tpuinfo contract."""
+
+    @property
+    def name(self) -> str:
+        return "python"
+
+    def version(self) -> str:
+        return "0.1.0"
+
+    def enumerate(self, opts: EnumerateOptions | None = None) -> TpuHostInfo:
+        opts = opts or EnumerateOptions.from_env()
+        if opts.mock_topology:
+            return self._mock(opts)
+        return self._devfs(opts)
+
+    def _mock(self, opts: EnumerateOptions) -> TpuHostInfo:
+        parsed = _parse_type(opts.mock_topology or "")
+        if parsed is None:
+            g, chips, acc = _GENERATIONS["v5e"], 4, "v5e-4"
+        else:
+            (g, chips), acc = parsed, opts.mock_topology
+        slice_s = _slice_shape(g, chips)
+        host_s = _host_shape(g)
+        per_host = min(chips, g.chips_per_host)
+        num_hosts = -(-chips // g.chips_per_host)
+        worker = opts.worker_id or 0
+        chip_list = []
+        for i in range(per_host):
+            chip_list.append(
+                TpuChip(
+                    index=i,
+                    uuid=f"tpu-{acc}-w{worker}-c{i}",
+                    devpath=f"/dev/accel{i}",
+                    ici_coords=_chip_coords(slice_s, host_s, worker, i),
+                    numa_node=0 if i < per_host // 2 else (1 if per_host > 1 else 0),
+                    pci_bdf=f"0000:00:{4 + i:02x}.0",
+                )
+            )
+        return TpuHostInfo(
+            platform=g.name,
+            accelerator_type=acc,
+            topology=_shape_str(slice_s, g.dims),
+            num_slice_chips=slice_s[0] * slice_s[1] * slice_s[2],
+            num_hosts=num_hosts,
+            worker_id=worker,
+            chips_per_host=g.chips_per_host,
+            cores_per_chip=g.cores_per_chip,
+            hbm_bytes_per_chip=g.hbm_bytes,
+            chips=tuple(chip_list),
+            source="mock",
+        )
+
+    def _devfs(self, opts: EnumerateOptions) -> TpuHostInfo:
+        dev_root = opts.dev_root or "/dev"
+        sys_root = opts.sys_root or "/sys"
+        type_env = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        parsed = _parse_type(type_env)
+        if parsed is None:
+            g, slice_chips, acc = _GENERATIONS["v5e"], 0, ""
+        else:
+            (g, slice_chips), acc = parsed, type_env
+        indices = sorted(
+            int(m.group(1))
+            for name in (os.listdir(dev_root) if os.path.isdir(dev_root) else [])
+            if (m := re.fullmatch(r"accel(\d+)", name))
+        )
+        source = "devfs" if indices else "none"
+        if slice_chips == 0:
+            slice_chips = len(indices) or 1
+        slice_s = _slice_shape(g, slice_chips)
+        host_s = _host_shape(g)
+        worker = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+        chip_list = []
+        for idx in indices:
+            sysdev = f"{sys_root}/class/accel/accel{idx}/device"
+            numa_node = -1
+            try:
+                with open(f"{sysdev}/numa_node") as f:
+                    numa_node = int(f.read().strip() or -1)
+            except (OSError, ValueError):
+                pass
+            pci_bdf = ""
+            try:
+                pci_bdf = os.path.basename(os.readlink(sysdev))
+            except OSError:
+                pass
+            chip_list.append(
+                TpuChip(
+                    index=idx,
+                    uuid=f"tpu-{g.name}-w{worker}-c{idx}",
+                    devpath=f"{dev_root}/accel{idx}",
+                    ici_coords=_chip_coords(slice_s, host_s, worker, idx),
+                    numa_node=numa_node,
+                    pci_bdf=pci_bdf,
+                )
+            )
+        return TpuHostInfo(
+            platform=g.name,
+            accelerator_type=acc,
+            topology=_shape_str(slice_s, g.dims),
+            num_slice_chips=slice_s[0] * slice_s[1] * slice_s[2],
+            num_hosts=-(-slice_chips // g.chips_per_host),
+            worker_id=worker,
+            chips_per_host=g.chips_per_host,
+            cores_per_chip=g.cores_per_chip,
+            hbm_bytes_per_chip=g.hbm_bytes,
+            chips=tuple(chip_list),
+            source=source,
+        )
+
+    def subslice_profiles(
+        self, opts: EnumerateOptions | None = None
+    ) -> tuple[SubSliceProfile, ...]:
+        opts = opts or EnumerateOptions.from_env()
+        t = opts.mock_topology or os.environ.get("TPU_ACCELERATOR_TYPE", "v5e-4")
+        parsed = _parse_type(t)
+        g, chips = parsed if parsed else (_GENERATIONS["v5e"], 4)
+        host_s = _host_shape(g)
+        per_host = min(chips, g.chips_per_host)
+        if per_host < host_s[0] * host_s[1] * host_s[2]:
+            host_s = _slice_shape(g, per_host)
+        profiles = []
+        if g.cores_per_chip > 1:
+            profiles.append(
+                SubSliceProfile(
+                    name="1c",
+                    chips=0,
+                    cores=1,
+                    hbm_bytes=g.hbm_bytes // g.cores_per_chip,
+                    placements=tuple(range(per_host * g.cores_per_chip)),
+                )
+            )
+        w = 1
+        while w <= host_s[0]:
+            h = 1
+            while h <= host_s[1]:
+                if w * h <= per_host:
+                    placements = tuple(
+                        y * host_s[0] + x
+                        for y in range(0, host_s[1] - h + 1, h)
+                        for x in range(0, host_s[0] - w + 1, w)
+                    )
+                    profiles.append(
+                        SubSliceProfile(
+                            name=_shape_str((w, h, 1), g.dims),
+                            chips=w * h,
+                            cores=w * h * g.cores_per_chip,
+                            hbm_bytes=w * h * g.hbm_bytes,
+                            placements=placements,
+                        )
+                    )
+                h *= 2
+            w *= 2
+        return tuple(profiles)
+
+    def health(self, opts: EnumerateOptions | None = None) -> tuple[HealthEvent, ...]:
+        opts = opts or EnumerateOptions.from_env()
+        events = []
+        for item in filter(None, (opts.health_events or "").split("|")):
+            chip, kind = -1, "unknown"
+            for f in item.split(","):
+                if "=" not in f:
+                    continue
+                k, _, v = f.partition("=")
+                if k == "chip":
+                    chip = _atoi(v)
+                elif k == "kind":
+                    kind = v
+            events.append(
+                HealthEvent(chip=chip, kind=kind, fatal=kind in _FATAL_KINDS)
+            )
+        return tuple(events)
+
+
+def load(prefer_native: bool = True, build_if_missing: bool = True):
+    """Load the device library: native if built (building it on demand
+    when a toolchain is present), else the Python backend.
+
+    Mirrors the reference's runtime driver-root library location
+    (root.go:28-63): the library is found relative to this package.
+    """
+    if prefer_native:
+        if not os.path.exists(_SO_PATH) and build_if_missing:
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                pass
+        try:
+            return NativeTpuLib()
+        except (TpuLibError, OSError):
+            pass
+    return PyTpuLib()

@@ -1,0 +1,501 @@
+// tpuinfo: TPU device enumeration library.
+//
+// TPU-native replacement for the reference's NVML layer (reference:
+// cmd/gpu-kubelet-plugin/nvlib.go). See tpuinfo.h for the C API contract.
+//
+// Two backends behind one interface, selected per call:
+//   - mock: built-in slice profiles (v4/v5e/v5p/v6e), mirroring the
+//     reference's mock-NVML test strategy (hack/ci/mock-nvml/) so the whole
+//     claim->prepare->CDI pipeline runs on CPU-only hosts.
+//   - devfs: probe /dev/accel* + sysfs on a real TPU VM.
+
+#include "tpuinfo.h"
+
+#include <dirent.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr const char* kVersion = "0.1.0";
+
+// ---------------------------------------------------------------------------
+// Options: "key=value;key=value"
+// ---------------------------------------------------------------------------
+
+std::map<std::string, std::string> ParseOpts(const char* opts) {
+  std::map<std::string, std::string> out;
+  if (opts == nullptr) return out;
+  std::stringstream ss(opts);
+  std::string item;
+  while (std::getline(ss, item, ';')) {
+    auto eq = item.find('=');
+    if (eq == std::string::npos) continue;
+    out[item.substr(0, eq)] = item.substr(eq + 1);
+  }
+  return out;
+}
+
+std::string Opt(const std::map<std::string, std::string>& o, const char* k,
+                const std::string& dflt = "") {
+  auto it = o.find(k);
+  return it == o.end() ? dflt : it->second;
+}
+
+// ---------------------------------------------------------------------------
+// Generation + topology database
+// ---------------------------------------------------------------------------
+
+struct Generation {
+  const char* name;
+  int dims;            // 2 (mesh) or 3 (torus)
+  int chips_per_host;  // chips managed by one CPU host
+  int cores_per_chip;  // TensorCores per chip (2 = megacore-capable)
+  long long hbm_bytes; // per chip
+  // Accelerator-type suffix counts cores (v4/v5p) or chips (v5e/v6e).
+  bool type_counts_cores;
+};
+
+const Generation kGenerations[] = {
+    {"v4", 3, 4, 2, 32LL << 30, true},
+    {"v5e", 2, 4, 1, 16LL << 30, false},
+    {"v5p", 3, 4, 2, 95LL << 30, true},
+    {"v6e", 2, 4, 1, 32LL << 30, false},
+};
+
+const Generation* FindGeneration(const std::string& name) {
+  for (const auto& g : kGenerations) {
+    if (name == g.name) return &g;
+  }
+  return nullptr;
+}
+
+struct Shape {
+  int x = 1, y = 1, z = 1;
+  int count() const { return x * y * z; }
+  std::string str(int dims) const {
+    char buf[48];
+    if (dims == 2) {
+      std::snprintf(buf, sizeof(buf), "%dx%d", x, y);
+    } else {
+      std::snprintf(buf, sizeof(buf), "%dx%dx%d", x, y, z);
+    }
+    return buf;
+  }
+};
+
+// Standard slice shapes per chip count (chips, not cores).
+// 3D torus shapes follow v4/v5p slice geometry; 2D mesh shapes follow
+// v5e/v6e pod geometry.
+Shape SliceShape(const Generation& g, int chips) {
+  static const std::map<int, Shape> k3d = {
+      {1, {1, 1, 1}},  {2, {1, 1, 2}},   {4, {2, 2, 1}},   {8, {2, 2, 2}},
+      {16, {2, 2, 4}}, {32, {2, 4, 4}},  {64, {4, 4, 4}},  {128, {4, 4, 8}},
+      {256, {4, 8, 8}}, {512, {8, 8, 8}},
+  };
+  static const std::map<int, Shape> k2d = {
+      {1, {1, 1, 1}},  {2, {1, 2, 1}},  {4, {2, 2, 1}},   {8, {2, 4, 1}},
+      {16, {4, 4, 1}}, {32, {4, 8, 1}}, {64, {8, 8, 1}},  {128, {8, 16, 1}},
+      {256, {16, 16, 1}},
+  };
+  const auto& tbl = g.dims == 3 ? k3d : k2d;
+  auto it = tbl.find(chips);
+  if (it != tbl.end()) return it->second;
+  // Fallback: flat line along y (keeps enumeration well-defined for
+  // non-standard mock sizes).
+  Shape s;
+  s.y = chips;
+  return s;
+}
+
+// The chip block one host owns within the slice grid.
+Shape HostShape(const Generation& g) {
+  if (g.chips_per_host == 8) return {2, 4, 1};
+  if (g.chips_per_host == 4) return {2, 2, 1};
+  if (g.chips_per_host == 2) return {1, 2, 1};
+  return {1, 1, 1};
+}
+
+// Parse "v5p-16" / "v5e-4" into (generation, chips).
+bool ParseAcceleratorType(const std::string& t, const Generation** gen,
+                          int* chips) {
+  auto dash = t.find('-');
+  if (dash == std::string::npos) return false;
+  const Generation* g = FindGeneration(t.substr(0, dash));
+  if (g == nullptr) return false;
+  int n = std::atoi(t.c_str() + dash + 1);
+  if (n <= 0) return false;
+  *gen = g;
+  *chips = g->type_counts_cores ? n / g->cores_per_chip : n;
+  return *chips > 0;
+}
+
+// ---------------------------------------------------------------------------
+// Minimal JSON emission
+// ---------------------------------------------------------------------------
+
+class Json {
+ public:
+  Json& raw(const std::string& s) {
+    out_ += s;
+    return *this;
+  }
+  Json& str(const std::string& s) {
+    out_ += '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': out_ += "\\\""; break;
+        case '\\': out_ += "\\\\"; break;
+        case '\n': out_ += "\\n"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out_ += buf;
+          } else {
+            out_ += c;
+          }
+      }
+    }
+    out_ += '"';
+    return *this;
+  }
+  Json& num(long long v) {
+    out_ += std::to_string(v);
+    return *this;
+  }
+  Json& boolean(bool b) {
+    out_ += b ? "true" : "false";
+    return *this;
+  }
+  char* release() {
+    char* p = static_cast<char*>(std::malloc(out_.size() + 1));
+    std::memcpy(p, out_.c_str(), out_.size() + 1);
+    return p;
+  }
+
+ private:
+  std::string out_;
+};
+
+// ---------------------------------------------------------------------------
+// Chip model
+// ---------------------------------------------------------------------------
+
+struct Chip {
+  int index = 0;
+  std::string uuid;
+  std::string devpath;
+  int coords[3] = {0, 0, 0};
+  int numa_node = -1;
+  std::string pci_bdf;
+  bool healthy = true;
+};
+
+struct HostInfo {
+  const Generation* gen = nullptr;
+  std::string accelerator_type;
+  Shape slice;
+  int num_hosts = 1;
+  int worker_id = 0;
+  std::vector<Chip> chips;
+  std::string source;
+};
+
+// ICI coordinates of local chip `local` on worker `worker`: hosts tile the
+// slice grid in row-major host-block order (x fastest), chips tile the
+// host block the same way.
+void ChipCoords(const Shape& slice, const Shape& host, int worker, int local,
+                int out[3]) {
+  int bx = slice.x / host.x, by = slice.y / host.y;
+  if (bx < 1) bx = 1;
+  if (by < 1) by = 1;
+  int wx = worker % bx;
+  int wy = (worker / bx) % by;
+  int wz = worker / (bx * by);
+  int lx = local % host.x;
+  int ly = (local / host.x) % host.y;
+  int lz = local / (host.x * host.y);
+  out[0] = wx * host.x + lx;
+  out[1] = wy * host.y + ly;
+  out[2] = wz * host.z + lz;
+}
+
+HostInfo MockEnumerate(const std::map<std::string, std::string>& opts) {
+  HostInfo h;
+  h.source = "mock";
+  std::string type = Opt(opts, "mock_topology", "v5e-4");
+  int chips = 0;
+  if (!ParseAcceleratorType(type, &h.gen, &chips)) {
+    h.gen = FindGeneration("v5e");
+    chips = 4;
+    type = "v5e-4";  // fall back wholesale so derived UUIDs match too
+  }
+  h.accelerator_type = type;
+  h.slice = SliceShape(*h.gen, chips);
+  Shape host = HostShape(*h.gen);
+  int per_host = std::min(chips, h.gen->chips_per_host);
+  h.num_hosts = (chips + h.gen->chips_per_host - 1) / h.gen->chips_per_host;
+  h.worker_id = std::atoi(Opt(opts, "worker_id", "0").c_str());
+  for (int i = 0; i < per_host; i++) {
+    Chip c;
+    c.index = i;
+    char buf[128];
+    std::snprintf(buf, sizeof(buf), "tpu-%s-w%d-c%d", type.c_str(),
+                  h.worker_id, i);
+    c.uuid = buf;
+    std::snprintf(buf, sizeof(buf), "/dev/accel%d", i);
+    c.devpath = buf;
+    std::snprintf(buf, sizeof(buf), "0000:00:%02x.0", 4 + i);
+    c.pci_bdf = buf;
+    c.numa_node = i < per_host / 2 ? 0 : (per_host > 1 ? 1 : 0);
+    ChipCoords(h.slice, host, h.worker_id, i, c.coords);
+    h.chips.push_back(c);
+  }
+  return h;
+}
+
+std::string ReadFileTrim(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) return "";
+  std::string s;
+  std::getline(f, s);
+  while (!s.empty() && (s.back() == '\n' || s.back() == '\r')) s.pop_back();
+  return s;
+}
+
+HostInfo DevfsEnumerate(const std::map<std::string, std::string>& opts) {
+  HostInfo h;
+  const std::string dev_root = Opt(opts, "dev_root", "/dev");
+  const std::string sys_root = Opt(opts, "sys_root", "/sys");
+
+  // Generation from the environment the TPU runtime publishes on GKE/GCE
+  // TPU VMs; fall back to v5e when undetectable.
+  const char* type_env = std::getenv("TPU_ACCELERATOR_TYPE");
+  int slice_chips = 0;
+  if (type_env == nullptr ||
+      !ParseAcceleratorType(type_env, &h.gen, &slice_chips)) {
+    h.gen = FindGeneration("v5e");
+    h.accelerator_type = "";
+  } else {
+    h.accelerator_type = type_env;
+  }
+
+  DIR* d = opendir(dev_root.c_str());
+  std::vector<int> indices;
+  if (d != nullptr) {
+    while (dirent* e = readdir(d)) {
+      // Strict "accel<digits>" match: reject trailing junk and negatives
+      // (keeps enumeration identical to the Python backend's fullmatch).
+      int idx;
+      char extra;
+      if (std::sscanf(e->d_name, "accel%d%c", &idx, &extra) == 1 && idx >= 0 &&
+          std::isdigit(static_cast<unsigned char>(e->d_name[5]))) {
+        indices.push_back(idx);
+      }
+    }
+    closedir(d);
+  }
+  std::sort(indices.begin(), indices.end());
+  h.source = indices.empty() ? "none" : "devfs";
+  if (slice_chips == 0) slice_chips = static_cast<int>(indices.size());
+  if (slice_chips == 0) slice_chips = 1;
+  h.slice = SliceShape(*h.gen, slice_chips);
+  h.num_hosts =
+      (slice_chips + h.gen->chips_per_host - 1) / h.gen->chips_per_host;
+  const char* wid = std::getenv("TPU_WORKER_ID");
+  h.worker_id = wid != nullptr ? std::atoi(wid) : 0;
+  Shape host = HostShape(*h.gen);
+  for (int idx : indices) {
+    Chip c;
+    c.index = idx;
+    c.devpath = dev_root + "/accel" + std::to_string(idx);
+    std::string sysdev =
+        sys_root + "/class/accel/accel" + std::to_string(idx) + "/device";
+    std::string numa = ReadFileTrim(sysdev + "/numa_node");
+    c.numa_node = numa.empty() ? -1 : std::atoi(numa.c_str());
+    // The device symlink's basename is the PCI BDF on real systems.
+    char linkbuf[256];
+    ssize_t n = readlink(sysdev.c_str(), linkbuf, sizeof(linkbuf) - 1);
+    if (n > 0) {
+      linkbuf[n] = '\0';
+      std::string link(linkbuf);
+      auto slash = link.rfind('/');
+      c.pci_bdf = slash == std::string::npos ? link : link.substr(slash + 1);
+    }
+    c.uuid = "tpu-" + std::string(h.gen->name) + "-w" +
+             std::to_string(h.worker_id) + "-c" + std::to_string(idx);
+    ChipCoords(h.slice, host, h.worker_id, idx, c.coords);
+    h.chips.push_back(c);
+  }
+  return h;
+}
+
+void EmitHost(Json& j, const HostInfo& h) {
+  j.raw("{");
+  j.str("platform").raw(":").str(h.gen->name).raw(",");
+  j.str("accelerator_type").raw(":").str(h.accelerator_type).raw(",");
+  j.str("topology").raw(":").str(h.slice.str(h.gen->dims)).raw(",");
+  j.str("num_slice_chips").raw(":").num(h.slice.count()).raw(",");
+  j.str("num_hosts").raw(":").num(h.num_hosts).raw(",");
+  j.str("worker_id").raw(":").num(h.worker_id).raw(",");
+  j.str("chips_per_host").raw(":").num(h.gen->chips_per_host).raw(",");
+  j.str("cores_per_chip").raw(":").num(h.gen->cores_per_chip).raw(",");
+  j.str("hbm_bytes_per_chip").raw(":").num(h.gen->hbm_bytes).raw(",");
+  j.str("chips").raw(":[");
+  for (size_t i = 0; i < h.chips.size(); i++) {
+    const Chip& c = h.chips[i];
+    if (i) j.raw(",");
+    j.raw("{");
+    j.str("index").raw(":").num(c.index).raw(",");
+    j.str("uuid").raw(":").str(c.uuid).raw(",");
+    j.str("devpath").raw(":").str(c.devpath).raw(",");
+    j.str("ici_coords").raw(":[").num(c.coords[0]).raw(",").num(c.coords[1])
+        .raw(",").num(c.coords[2]).raw("],");
+    j.str("numa_node").raw(":").num(c.numa_node).raw(",");
+    j.str("pci_bdf").raw(":").str(c.pci_bdf).raw(",");
+    j.str("healthy").raw(":").boolean(c.healthy);
+    j.raw("}");
+  }
+  j.raw("],");
+  j.str("source").raw(":").str(h.source);
+  j.raw("}");
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* tpuinfo_version(void) { return kVersion; }
+
+char* tpuinfo_enumerate(const char* opts) {
+  auto o = ParseOpts(opts);
+  HostInfo h = o.count("mock_topology") ? MockEnumerate(o) : DevfsEnumerate(o);
+  Json j;
+  EmitHost(j, h);
+  return j.release();
+}
+
+char* tpuinfo_subslice_profiles(const char* opts) {
+  auto o = ParseOpts(opts);
+  const Generation* gen = nullptr;
+  int chips = 0;
+  std::string type = Opt(o, "mock_topology");
+  if (type.empty()) {
+    const char* env = std::getenv("TPU_ACCELERATOR_TYPE");
+    type = env != nullptr ? env : "v5e-4";
+  }
+  if (!ParseAcceleratorType(type, &gen, &chips)) {
+    gen = FindGeneration("v5e");
+    chips = 4;
+  }
+  Shape host = HostShape(*gen);
+  int per_host = std::min(chips, gen->chips_per_host);
+  // Host may own fewer chips than a full block (e.g. v5e-1).
+  if (per_host < host.count()) {
+    host = SliceShape(*gen, per_host);
+  }
+
+  Json j;
+  j.raw("{").str("profiles").raw(":[");
+  bool first = true;
+
+  // Half-chip (single TensorCore) profile for megacore-capable chips:
+  // the finest-grained carve-out, the analog of the smallest MIG profile.
+  if (gen->cores_per_chip > 1) {
+    j.raw("{");
+    j.str("name").raw(":").str("1c").raw(",");
+    j.str("chips").raw(":").num(0).raw(",");
+    j.str("cores").raw(":").num(1).raw(",");
+    j.str("hbm_bytes").raw(":").num(gen->hbm_bytes / gen->cores_per_chip)
+        .raw(",");
+    j.str("placements").raw(":[");
+    for (int i = 0; i < per_host * gen->cores_per_chip; i++) {
+      if (i) j.raw(",");
+      j.num(i);
+    }
+    j.raw("]}");
+    first = false;
+  }
+
+  // Aligned sub-rectangle (power-of-two) chip blocks within the host grid,
+  // the analog of MIG profile x placement enumeration.
+  for (int w = 1; w <= host.x; w *= 2) {
+    for (int hgt = 1; hgt <= host.y; hgt *= 2) {
+      if (w * hgt > per_host) continue;
+      Shape prof{w, hgt, 1};
+      if (!first) j.raw(",");
+      first = false;
+      j.raw("{");
+      j.str("name").raw(":").str(prof.str(gen->dims)).raw(",");
+      j.str("chips").raw(":").num(prof.count()).raw(",");
+      j.str("cores").raw(":").num(prof.count() * gen->cores_per_chip).raw(",");
+      j.str("hbm_bytes").raw(":").num(prof.count() * gen->hbm_bytes).raw(",");
+      j.str("placements").raw(":[");
+      bool p0 = true;
+      for (int y = 0; y + hgt <= host.y; y += hgt) {
+        for (int x = 0; x + w <= host.x; x += w) {
+          if (!p0) j.raw(",");
+          p0 = false;
+          j.num(y * host.x + x);
+        }
+      }
+      j.raw("]}");
+    }
+  }
+  j.raw("]}");
+  return j.release();
+}
+
+char* tpuinfo_health(const char* opts) {
+  auto o = ParseOpts(opts);
+  Json j;
+  j.raw("{").str("events").raw(":[");
+  std::string events = Opt(o, "health_events");
+  if (!events.empty()) {
+    std::stringstream ss(events);
+    std::string item;
+    bool first = true;
+    while (std::getline(ss, item, '|')) {
+      if (item.empty()) continue;
+      int chip = -1;
+      std::string kind = "unknown";
+      std::stringstream fs(item);
+      std::string field;
+      while (std::getline(fs, field, ',')) {
+        auto eq = field.find('=');
+        if (eq == std::string::npos) continue;
+        std::string k = field.substr(0, eq), v = field.substr(eq + 1);
+        if (k == "chip") chip = std::atoi(v.c_str());
+        if (k == "kind") kind = v;
+      }
+      if (!first) j.raw(",");
+      first = false;
+      bool fatal = kind == "hbm_uncorrectable" || kind == "chip_lost" ||
+                   kind == "ici_link_down";
+      j.raw("{").str("chip").raw(":").num(chip).raw(",")
+          .str("kind").raw(":").str(kind).raw(",")
+          .str("fatal").raw(":").boolean(fatal).raw("}");
+    }
+  }
+  // Real-host path: no standardized health sysfs exists for TPU accel
+  // devices today; health beyond enumeration presence is reported by the
+  // runtime (libtpu) inside workloads. The node plugin treats missing
+  // devfs entries as chip_lost at enumeration time instead.
+  j.raw("]}");
+  return j.release();
+}
+
+void tpuinfo_free(char* p) { std::free(p); }
+
+}  // extern "C"

@@ -1,0 +1,86 @@
+/* tpuinfo: TPU device enumeration library (C API).
+ *
+ * This is the TPU-native replacement for the reference driver's NVML
+ * dependency (reference: cmd/gpu-kubelet-plugin/nvlib.go loads
+ * libnvidia-ml.so.1 via cgo). Instead of GPU UUID/MIG queries it reports
+ * TPU chips with ICI coordinates, slice topology, HBM capacity and
+ * TensorCore counts, and enumerates valid sub-slice carve-out profiles
+ * (the MIG-profile analog).
+ *
+ * All functions returning char* return a malloc'd NUL-terminated JSON
+ * document the caller must release with tpuinfo_free(). Options are
+ * passed as a "key=value;key=value" string; recognized keys:
+ *   mock_topology   e.g. "v5p-16" - use a built-in mock profile instead
+ *                   of probing the host (mirrors the reference's mock
+ *                   NVML, hack/ci/mock-nvml/).
+ *   worker_id       which host of a multi-host slice this is (default 0).
+ *   dev_root        device directory to probe (default "/dev").
+ *   sys_root        sysfs root to probe (default "/sys").
+ *   health_events   injected mock health events, format
+ *                   "chip=1,kind=hbm_uncorrectable;chip=2,kind=ici_link_down".
+ */
+
+#ifndef TPUINFO_H_
+#define TPUINFO_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Library version, "major.minor.patch". Static string; do not free. */
+const char* tpuinfo_version(void);
+
+/* Enumerate the chips visible on this host.
+ *
+ * JSON shape:
+ * {
+ *   "platform": "v5p",            // generation: v4|v5e|v5p|v6e
+ *   "accelerator_type": "v5p-16", // slice name if known, else ""
+ *   "topology": "2x2x2",          // chip-grid dims of the full slice
+ *   "num_slice_chips": 8,         // chips in the full slice
+ *   "num_hosts": 2,
+ *   "worker_id": 0,
+ *   "chips_per_host": 4,
+ *   "cores_per_chip": 2,
+ *   "hbm_bytes_per_chip": 102005473280,
+ *   "chips": [
+ *     {"index":0, "uuid":"tpu-v5p-16-w0-c0", "devpath":"/dev/accel0",
+ *      "ici_coords":[0,0,0], "numa_node":0, "pci_bdf":"0000:00:04.0",
+ *      "healthy": true}
+ *   ],
+ *   "source": "mock"              // mock|devfs|none
+ * }
+ */
+char* tpuinfo_enumerate(const char* opts);
+
+/* Enumerate valid sub-slice carve-out profiles for one host's chips
+ * (the MIG GI/CI-profile analog; reference nvlib.go
+ * inspectMigProfilesAndPlacements).
+ *
+ * JSON shape:
+ * {
+ *   "profiles": [
+ *     {"name":"1c", "chips":0, "cores":1, "placements":[0,1,...,7],
+ *      "hbm_bytes": 51002736640},   // half-chip (single TensorCore)
+ *     {"name":"1x1", "chips":1, "cores":2, "placements":[0,1,2,3], ...},
+ *     {"name":"2x1", "chips":2, "cores":4, "placements":[0,2], ...},
+ *     {"name":"2x2", "chips":4, "cores":8, "placements":[0], ...}
+ *   ]
+ * }
+ * Placement units: for core profiles ("Nc") the placement is a core
+ * index; for chip profiles the placement is the starting chip index of a
+ * contiguous aligned block in the host's chip grid.
+ */
+char* tpuinfo_subslice_profiles(const char* opts);
+
+/* Read per-chip health. JSON: {"events":[{"chip":1,"kind":"...",
+ * "fatal":true}]} - empty events list when healthy. */
+char* tpuinfo_health(const char* opts);
+
+void tpuinfo_free(char* p);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUINFO_H_ */

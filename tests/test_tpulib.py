@@ -1,0 +1,197 @@
+"""Tests for the tpulib device layer (native C++ + Python backends).
+
+The parity class is the TPU analog of the reference's mock-NVML fidelity
+requirement (SURVEY.md §4.4): both backends must agree exactly so tests
+exercising either are equivalent.
+"""
+
+import dataclasses
+import os
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+    EnumerateOptions,
+    NativeTpuLib,
+    PyTpuLib,
+    TpuLibError,
+    load,
+)
+
+NATIVE_AVAILABLE = True
+try:
+    NativeTpuLib()
+except (TpuLibError, OSError):
+    NATIVE_AVAILABLE = False
+
+BACKENDS = [PyTpuLib()] + ([NativeTpuLib()] if NATIVE_AVAILABLE else [])
+
+
+@pytest.fixture(params=[b.name for b in BACKENDS])
+def lib(request):
+    return {b.name: b for b in BACKENDS}[request.param]
+
+
+class TestEnumerate:
+    def test_v5e4_single_host(self, lib):
+        h = lib.enumerate(EnumerateOptions(mock_topology="v5e-4"))
+        assert h.platform == "v5e"
+        assert h.topology == "2x2"
+        assert h.num_hosts == 1
+        assert h.cores_per_chip == 1
+        assert len(h.chips) == 4
+        assert [c.ici_coords for c in h.chips] == [
+            (0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0)
+        ]
+        assert h.chips[0].devpath == "/dev/accel0"
+        assert h.source == "mock"
+
+    def test_v5p16_multi_host_coords(self, lib):
+        # v5p-16 = 16 TensorCores = 8 chips = 2x2x2, 2 hosts of 4.
+        h0 = lib.enumerate(EnumerateOptions(mock_topology="v5p-16", worker_id=0))
+        h1 = lib.enumerate(EnumerateOptions(mock_topology="v5p-16", worker_id=1))
+        assert h0.topology == "2x2x2"
+        assert h0.num_slice_chips == 8
+        assert h0.num_hosts == 2
+        # Worker 1's block sits at z=1.
+        assert [c.ici_coords for c in h1.chips] == [
+            (0, 0, 1), (1, 0, 1), (0, 1, 1), (1, 1, 1)
+        ]
+        # All 8 chip coords across hosts are unique and fill the grid.
+        coords = {c.ici_coords for c in h0.chips} | {c.ici_coords for c in h1.chips}
+        assert len(coords) == 8
+
+    def test_v5p32_is_16_chips(self, lib):
+        # v5p type suffix counts cores: v5p-32 = 16 chips = 2x2x4, 4 hosts.
+        h = lib.enumerate(EnumerateOptions(mock_topology="v5p-32"))
+        assert h.num_slice_chips == 16
+        assert h.topology == "2x2x4"
+        assert h.num_hosts == 4
+
+    def test_devfs_fake_tree(self, lib, tmp_path):
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        for i in range(4):
+            (dev / f"accel{i}").touch()
+        sys = tmp_path / "sys"
+        for i in range(4):
+            d = sys / "class" / "accel" / f"accel{i}"
+            d.mkdir(parents=True)
+            (d / "device").mkdir()
+            (d / "device" / "numa_node").write_text("0\n")
+        h = lib.enumerate(
+            EnumerateOptions(dev_root=str(dev), sys_root=str(sys))
+        )
+        assert h.source == "devfs"
+        assert len(h.chips) == 4
+        assert h.chips[2].devpath == str(dev / "accel2")
+        assert h.chips[0].numa_node == 0
+
+    def test_devfs_empty(self, lib, tmp_path):
+        h = lib.enumerate(EnumerateOptions(dev_root=str(tmp_path)))
+        assert h.source == "none"
+        assert h.chips == ()
+
+
+class TestSubSliceProfiles:
+    def test_v5p_profiles(self, lib):
+        profs = {p.name: p for p in lib.subslice_profiles(
+            EnumerateOptions(mock_topology="v5p-8"))}
+        # Megacore chips expose a single-TensorCore profile.
+        assert profs["1c"].cores == 1
+        assert profs["1c"].placements == tuple(range(8))
+        assert profs["1x1x1"].chips == 1
+        assert profs["1x1x1"].placements == (0, 1, 2, 3)
+        assert profs["2x1x1"].placements == (0, 2)
+        assert profs["1x2x1"].placements == (0, 1)
+        assert profs["2x2x1"].placements == (0,)
+
+    def test_v5e_profiles_no_core_level(self, lib):
+        profs = {p.name: p for p in lib.subslice_profiles(
+            EnumerateOptions(mock_topology="v5e-4"))}
+        assert "1c" not in profs
+        assert profs["1x1"].chips == 1
+        assert profs["2x2"].chips == 4
+        assert profs["1x1"].hbm_bytes == 16 << 30
+
+
+class TestHealth:
+    def test_mock_events(self, lib):
+        evs = lib.health(EnumerateOptions(
+            health_events="chip=1,kind=hbm_uncorrectable|chip=2,kind=thermal"))
+        assert len(evs) == 2
+        assert evs[0].fatal and evs[0].chip == 1
+        assert not evs[1].fatal and evs[1].kind == "thermal"
+
+    def test_no_events(self, lib):
+        assert lib.health(EnumerateOptions()) == ()
+
+
+@pytest.mark.skipif(not NATIVE_AVAILABLE, reason="libtpuinfo.so not built")
+class TestBackendParity:
+    """Native C++ and Python backends must agree field-for-field."""
+
+    CASES = [
+        EnumerateOptions(mock_topology="v5e-4"),
+        EnumerateOptions(mock_topology="v5e-8"),
+        EnumerateOptions(mock_topology="v5p-8"),
+        EnumerateOptions(mock_topology="v5p-16", worker_id=1),
+        EnumerateOptions(mock_topology="v5p-32", worker_id=3),
+        EnumerateOptions(mock_topology="v4-16"),
+        EnumerateOptions(mock_topology="v6e-8"),
+        # Unknown type falls back to v5e-4 wholesale on both backends.
+        EnumerateOptions(mock_topology="v99-4"),
+    ]
+
+    def test_enumerate_parity(self):
+        native, py = NativeTpuLib(), PyTpuLib()
+        for opts in self.CASES:
+            a = dataclasses.asdict(native.enumerate(opts))
+            b = dataclasses.asdict(py.enumerate(opts))
+            assert a == b, f"enumerate mismatch for {opts}"
+
+    def test_profiles_parity(self):
+        native, py = NativeTpuLib(), PyTpuLib()
+        for opts in self.CASES:
+            a = [dataclasses.asdict(p) for p in native.subslice_profiles(opts)]
+            b = [dataclasses.asdict(p) for p in py.subslice_profiles(opts)]
+            assert a == b, f"profiles mismatch for {opts}"
+
+    def test_health_parity(self):
+        native, py = NativeTpuLib(), PyTpuLib()
+        for events in [
+            "chip=0,kind=ici_link_down|chip=3,kind=thermal",
+            # Malformed inputs must degrade identically: empty segments,
+            # missing '=', non-numeric chip.
+            "chip=1,kind=thermal||chip=2,kind=thermal",
+            "chip|kind=thermal",
+            "chip=x,kind=thermal",
+        ]:
+            opts = EnumerateOptions(health_events=events)
+            assert native.health(opts) == py.health(opts), events
+
+    def test_devfs_junk_entries_parity(self, tmp_path):
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        for name in ["accel0", "accel1", "accel-1", "accel0tmp", "accel", "accel 2"]:
+            (dev / name).touch()
+        native, py = NativeTpuLib(), PyTpuLib()
+        opts = EnumerateOptions(dev_root=str(dev), sys_root=str(tmp_path))
+        a = dataclasses.asdict(native.enumerate(opts))
+        b = dataclasses.asdict(py.enumerate(opts))
+        assert a == b
+        assert [c["index"] for c in a["chips"]] == [0, 1]
+
+
+class TestLoad:
+    def test_load_returns_backend(self):
+        lib = load()
+        h = lib.enumerate(EnumerateOptions(mock_topology="v5e-4"))
+        assert h.num_slice_chips == 4
+
+    def test_env_seam(self, monkeypatch):
+        monkeypatch.setenv("TPULIB_MOCK_TOPOLOGY", "v5p-16")
+        monkeypatch.setenv("TPULIB_MOCK_WORKER_ID", "1")
+        opts = EnumerateOptions.from_env()
+        assert opts.mock_topology == "v5p-16"
+        assert opts.worker_id == 1
