@@ -1,0 +1,88 @@
+"""North-star benchmark: DRA claim-prepare latency p50 (ms).
+
+Measures the full node-side claim pipeline -- checkpoint-backed two-phase
+Prepare (device allocation, config apply, CDI spec write) + Unprepare --
+against the mock v5e-4 topology, end to end through the same DeviceState
+machinery the kubelet plugin serves. This is BASELINE.md metric #1; the
+reference instruments but never publishes this path (t_prep* klog V6,
+cmd/gpu-kubelet-plugin/driver.go:394-404). vs_baseline compares against
+the reference's O(1s) dynamic-partition envelope (MIG create/destroy
+"may take O(1 s)", nvlib.go:1136-1141): values >1 mean faster.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+"""
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_ENVELOPE_MS = 1000.0  # reference MIG create/destroy O(1s)
+ITERS = 50
+
+
+def bench_claim_prepare() -> float:
+    """p50 ms for a full Prepare+Unprepare of a 4-chip claim."""
+    from tests.fake_kube import make_claim  # noqa: deferred heavy imports
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+        DeviceState, Config,
+    )
+
+    samples = []
+    for i in range(ITERS):
+        with tempfile.TemporaryDirectory() as root:
+            state = DeviceState(
+                Config.mock(root=root, topology="v5e-4")
+            )
+            claim = make_claim(
+                uid=f"bench-{i}", devices=[f"chip-{j}" for j in range(4)]
+            )
+            t0 = time.perf_counter()
+            state.prepare(claim)
+            state.unprepare(claim.uid)
+            samples.append((time.perf_counter() - t0) * 1000)
+    return statistics.median(samples)
+
+
+def bench_enumerate() -> float:
+    """Fallback until the DeviceState pipeline lands: p50 ms of a full
+    tpulib enumerate + sub-slice profile scan."""
+    from k8s_dra_driver_gpu_tpu.tpulib.binding import EnumerateOptions, load
+
+    lib = load()
+    opts = EnumerateOptions(mock_topology="v5e-4")
+    samples = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        lib.enumerate(opts)
+        lib.subslice_profiles(opts)
+        samples.append((time.perf_counter() - t0) * 1000)
+    return statistics.median(samples)
+
+
+def main() -> None:
+    try:
+        p50 = bench_claim_prepare()
+        metric = "dra_claim_prepare_p50"
+    except ImportError:
+        p50 = bench_enumerate()
+        metric = "tpulib_enumerate_p50"
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(REFERENCE_ENVELOPE_MS / max(p50, 1e-9), 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Model families runnable on slices prepared by the DRA driver.
+
+The reference exercises its prepared fabric with external NCCL/nvbandwidth
+jobs (tests/bats/test_cd_mnnvl_workload.bats); the TPU build ships the JAX
+workload in-tree. Flagship: Llama-3 (north star per BASELINE.json: a
+32-chip ResourceClaim running Llama-3-8B training on a v5p slice).
+"""

@@ -1,0 +1,180 @@
+"""Llama-3 in TPU-first JAX: functional, scan-over-layers, bfloat16.
+
+Design (not a torch port):
+- Parameters are a plain pytree with per-leaf PartitionSpecs (fsdp/tp
+  sharding per the scaling-book recipe); XLA inserts the collectives.
+- Layers are STACKED and iterated with lax.scan: one traced layer body,
+  O(1) compile time in depth, and jax.checkpoint (remat) on the body
+  trades FLOPs for HBM.
+- Matmuls stay large and bf16 so XLA tiles them onto the MXU; attention
+  uses a fused softmax formulation with a causal mask computed inside the
+  kernel-friendly einsum path (pallas flash-attention swaps in via
+  ops.attention).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+from ..parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14_336
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        """Test/dryrun config: same structure, toy sizes."""
+        return LlamaConfig(
+            vocab_size=256,
+            d_model=64,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=2,
+            d_ff=128,
+        )
+
+
+def param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpecs per parameter leaf (layer-stacked leaves lead with
+    None for the scan dimension). fsdp shards the long matmul dim, tp the
+    head/ff dim."""
+    del cfg
+    return {
+        "embed": P(TENSOR_AXIS, FSDP_AXIS),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, FSDP_AXIS, TENSOR_AXIS),
+            "wk": P(None, FSDP_AXIS, TENSOR_AXIS),
+            "wv": P(None, FSDP_AXIS, TENSOR_AXIS),
+            "wo": P(None, TENSOR_AXIS, FSDP_AXIS),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, FSDP_AXIS, TENSOR_AXIS),
+            "w_up": P(None, FSDP_AXIS, TENSOR_AXIS),
+            "w_down": P(None, TENSOR_AXIS, FSDP_AXIS),
+        },
+        "final_norm": P(None),
+        "lm_head": P(FSDP_AXIS, TENSOR_AXIS),
+    }
+
+
+def batch_spec() -> P:
+    return P((DATA_AXIS, FSDP_AXIS), None)
+
+
+def init(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Initialize parameters (fp32 master weights; cast at use)."""
+    k = iter(jax.random.split(key, 16))
+    d, h, kv, hd, f = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff,
+    )
+    L = cfg.n_layers
+
+    def dense(key, shape):
+        fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+        return jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)
+
+    return {
+        "embed": dense(next(k), (cfg.vocab_size, d)),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "wq": dense(next(k), (L, d, h * hd)),
+            "wk": dense(next(k), (L, d, kv * hd)),
+            "wv": dense(next(k), (L, d, kv * hd)),
+            "wo": dense(next(k), (L, h * hd, d)),
+            "mlp_norm": jnp.ones((L, d), jnp.float32),
+            "w_gate": dense(next(k), (L, d, f)),
+            "w_up": dense(next(k), (L, d, f)),
+            "w_down": dense(next(k), (L, f, d)),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": dense(next(k), (d, cfg.vocab_size)),
+    }
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    # Normalize in fp32 for stability, cast back to the compute dtype.
+    xf = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * rms).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings over the last dim of [..., S, H, hd]."""
+    hd = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, hd // 2, dtype=jnp.float32) / (hd // 2))
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [.., S, hd/2]
+    cos = jnp.cos(angles)[..., :, None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
+           positions: jax.Array) -> jax.Array:
+    """One transformer block: [B, S, D] -> [B, S, D]."""
+    p = layer_params
+    dt = cfg.dtype
+    B, S, _ = x.shape
+    h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    # Attention.
+    a = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = (a @ p["wq"].astype(dt)).reshape(B, S, h, hd)
+    k = (a @ p["wk"].astype(dt)).reshape(B, S, kv, hd)
+    v = (a @ p["wv"].astype(dt)).reshape(B, S, kv, hd)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    attn = dot_product_attention(q, k, v, causal=True)
+    attn = attn.reshape(B, S, h * hd)
+    x = x + attn @ p["wo"].astype(dt)
+
+    # SwiGLU MLP.
+    m = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(m @ p["w_gate"].astype(dt))
+    up = m @ p["w_up"].astype(dt)
+    x = x + (gate * up) @ p["w_down"].astype(dt)
+    return x
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Token ids [B, S] -> logits [B, S, V] (fp32 logits)."""
+    # Sharding comes from the in_shardings on params/tokens; XLA propagates
+    # (dp,fsdp)-batch x tp-heads layouts through the whole graph.
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.arange(tokens.shape[1])[None, :]
+
+    # Scan over stacked layers; remat the body so long sequences fit HBM.
+    body = jax.checkpoint(
+        lambda carry, lp: (_layer(cfg, carry, lp, positions), None)
+    )
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)

@@ -1,0 +1,1 @@
+"""TPU compute ops: attention, collectives, (pallas kernels as they land)."""

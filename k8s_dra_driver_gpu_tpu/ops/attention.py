@@ -1,0 +1,39 @@
+"""Attention ops, GQA-aware, causal, MXU-friendly.
+
+The einsum formulation below is the portable baseline XLA fuses well on
+TPU; a pallas flash-attention kernel is the drop-in upgrade path behind
+the same signature.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, S, H, hd]
+    k: jax.Array,  # [B, S, K, hd]
+    v: jax.Array,  # [B, S, K, hd]
+    causal: bool = True,
+) -> jax.Array:
+    """GQA attention: q-heads H grouped over kv-heads K (H % K == 0).
+
+    Softmax runs in fp32; the two matmuls stay in the input dtype so they
+    hit the MXU in bf16.
+    """
+    B, S, H, hd = q.shape
+    K = k.shape[2]
+    group = H // K
+    qg = q.reshape(B, S, K, group, hd)
+
+    scores = jnp.einsum("bqkgh,bskh->bkgqs", qg, k) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)
+    ).astype(q.dtype)
+    scores = scores.astype(jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    weights = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", weights, v)
+    return out.reshape(B, S, H, hd)

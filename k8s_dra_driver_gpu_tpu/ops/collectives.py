@@ -1,0 +1,66 @@
+"""Collective microbenchmark ops over a device mesh.
+
+The reference proves its prepared fabric with external nvbandwidth/NCCL
+jobs asserting bandwidth output (tests/bats/test_cd_mnnvl_workload.bats);
+this module is the in-tree JAX analog: an all-reduce (psum) benchmark over
+the ComputeDomain's ICI mesh, reporting achieved GB/s.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def allreduce_fn(mesh: Mesh, axis: str):
+    """A jitted psum over ``axis`` of ``mesh`` for [N] fp32 buffers."""
+
+    @partial(
+        jax.jit,
+        in_shardings=NamedSharding(mesh, P()),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+    def _psum(x):
+        return jax.shard_map(
+            lambda v: jax.lax.psum(v, axis),
+            mesh=mesh,
+            in_specs=P(),
+            out_specs=P(),
+        )(x)
+
+    return _psum
+
+
+def bench_allreduce(
+    mesh: Mesh,
+    axis: str,
+    nbytes: int = 64 << 20,
+    iters: int = 10,
+) -> dict:
+    """Time all-reduce of an nbytes fp32 buffer; returns achieved GB/s.
+
+    Algorithmic bytes moved per device for a ring all-reduce of size S
+    over n participants: 2*S*(n-1)/n.
+    """
+    n = mesh.shape[axis]
+    x = jnp.ones((nbytes // 4,), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P()))
+    fn = allreduce_fn(mesh, axis)
+    fn(x).block_until_ready()  # compile + warm up
+    start = time.perf_counter()
+    for _ in range(iters):
+        x = fn(x)
+    x.block_until_ready()
+    elapsed = time.perf_counter() - start
+    algo_bytes = 2 * nbytes * (n - 1) / max(n, 1)
+    return {
+        "participants": n,
+        "bytes": nbytes,
+        "iters": iters,
+        "seconds": elapsed,
+        "gbps": (algo_bytes * iters / elapsed) / 1e9 if elapsed > 0 else 0.0,
+    }

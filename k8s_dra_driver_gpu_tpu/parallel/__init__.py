@@ -1,0 +1,8 @@
+"""Parallelism layer: device meshes, sharding rules, sequence parallelism.
+
+The reference driver orchestrates fabric domains but ships no collective
+code (SURVEY.md §2.9); its fabric is exercised by external NCCL jobs. The
+TPU build ships the workload side in-tree: meshes built from the same ICI
+topologies tpulib enumerates, SPMD sharding rules, and ring attention for
+long sequences -- all via jax.sharding + shard_map over XLA collectives.
+"""

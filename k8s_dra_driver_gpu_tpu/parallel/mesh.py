@@ -1,0 +1,99 @@
+"""Device-mesh construction from ICI slice topologies.
+
+Bridges the driver side and the workload side: tpulib enumerates a slice
+topology like "2x2x4"; this module turns the same topology into a
+jax.sharding.Mesh whose axes ride ICI. Axis sizing follows the
+scaling-book recipe: put the fastest-varying (most-communicating) axis
+("tp") innermost so its collectives stay on-chip-adjacent ICI links, data
+parallelism outermost.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical logical axis names used across the workload stack.
+DATA_AXIS = "dp"
+FSDP_AXIS = "fsdp"
+TENSOR_AXIS = "tp"
+SEQUENCE_AXIS = "sp"
+EXPERT_AXIS = "ep"
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A factorization of the device count over logical axes."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+    def axis_names(self) -> tuple[str, ...]:
+        return (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, SEQUENCE_AXIS)
+
+    def shape(self) -> tuple[int, ...]:
+        return (self.dp, self.fsdp, self.tp, self.sp)
+
+
+def _factor(n: int, max_tp: int) -> MeshPlan:
+    """Default factorization: tp = largest power of two <= max_tp dividing
+    n (tensor parallelism wants the tightest ICI neighborhood), fsdp takes
+    the next factor up to 8, dp absorbs the rest."""
+    tp = 1
+    while tp * 2 <= max_tp and n % (tp * 2) == 0:
+        tp *= 2
+    rem = n // tp
+    fsdp = 1
+    while fsdp * 2 <= 8 and rem % (fsdp * 2) == 0:
+        fsdp *= 2
+    dp = rem // fsdp
+    return MeshPlan(dp=dp, fsdp=fsdp, tp=tp)
+
+
+def plan_for(n_devices: int, tp: int | None = None, sp: int = 1) -> MeshPlan:
+    """Pick a MeshPlan for n_devices, honoring an explicit tp if given."""
+    if tp is None:
+        plan = _factor(n_devices // sp, max_tp=4)
+        return MeshPlan(dp=plan.dp, fsdp=plan.fsdp, tp=plan.tp, sp=sp)
+    if n_devices % (tp * sp):
+        raise ValueError(f"{n_devices} devices not divisible by tp={tp}*sp={sp}")
+    plan = _factor(n_devices // (tp * sp), max_tp=1)
+    return MeshPlan(dp=plan.dp * plan.fsdp, fsdp=1, tp=tp, sp=sp)
+
+
+def build_mesh(
+    plan: MeshPlan | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all) shaped by ``plan``.
+
+    Device order is row-major over the plan shape; on real TPU slices
+    jax.devices() is already ICI-topology-ordered, so the innermost mesh
+    axis lands on ICI-adjacent chips.
+    """
+    devs = devices if devices is not None else jax.devices()
+    if plan is None:
+        plan = plan_for(len(devs))
+    if plan.size != len(devs):
+        raise ValueError(
+            f"mesh plan {plan.shape()} needs {plan.size} devices, have {len(devs)}"
+        )
+    arr = np.asarray(devs).reshape(plan.shape())
+    return Mesh(arr, plan.axis_names())
+
+
+def mesh_from_topology(topology: str, tp: int | None = None) -> Mesh:
+    """Build a mesh for an ICI topology string ("2x2x4") as enumerated by
+    tpulib / published in ResourceSlice attributes."""
+    n = math.prod(int(d) for d in topology.split("x"))
+    return build_mesh(plan_for(n, tp=tp), devices=jax.devices()[:n])

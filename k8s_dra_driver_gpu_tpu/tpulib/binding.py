@@ -96,7 +96,7 @@ class EnumerateOptions:
         wid = os.environ.get(ENV_MOCK_WORKER_ID)
         return cls(
             mock_topology=os.environ.get(ENV_MOCK_TOPOLOGY),
-            worker_id=int(wid) if wid else None,
+            worker_id=_atoi(wid) if wid else None,
             health_events=os.environ.get(ENV_MOCK_HEALTH_EVENTS),
         )
 
@@ -304,6 +304,9 @@ class PyTpuLib:
         slice_s = _slice_shape(g, chips)
         host_s = _host_shape(g)
         per_host = min(chips, g.chips_per_host)
+        if per_host < host_s[0] * host_s[1] * host_s[2]:
+            # A partial host covers the (smaller) slice grid itself.
+            host_s = _slice_shape(g, per_host)
         num_hosts = -(-chips // g.chips_per_host)
         worker = opts.worker_id or 0
         chip_list = []
@@ -351,7 +354,9 @@ class PyTpuLib:
             slice_chips = len(indices) or 1
         slice_s = _slice_shape(g, slice_chips)
         host_s = _host_shape(g)
-        worker = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+        if len(indices) < host_s[0] * host_s[1] * host_s[2] and indices:
+            host_s = _slice_shape(g, len(indices))
+        worker = _atoi(os.environ.get("TPU_WORKER_ID", "0") or "0")
         chip_list = []
         for idx in indices:
             sysdev = f"{sys_root}/class/accel/accel{idx}/device"
@@ -416,21 +421,25 @@ class PyTpuLib:
         while w <= host_s[0]:
             h = 1
             while h <= host_s[1]:
-                if w * h <= per_host:
-                    placements = tuple(
-                        y * host_s[0] + x
-                        for y in range(0, host_s[1] - h + 1, h)
-                        for x in range(0, host_s[0] - w + 1, w)
-                    )
-                    profiles.append(
-                        SubSliceProfile(
-                            name=_shape_str((w, h, 1), g.dims),
-                            chips=w * h,
-                            cores=w * h * g.cores_per_chip,
-                            hbm_bytes=w * h * g.hbm_bytes,
-                            placements=placements,
+                d = 1
+                while d <= host_s[2]:
+                    if w * h * d <= per_host:
+                        placements = tuple(
+                            (z * host_s[1] + y) * host_s[0] + x
+                            for z in range(0, host_s[2] - d + 1, d)
+                            for y in range(0, host_s[1] - h + 1, h)
+                            for x in range(0, host_s[0] - w + 1, w)
                         )
-                    )
+                        profiles.append(
+                            SubSliceProfile(
+                                name=_shape_str((w, h, d), g.dims),
+                                chips=w * h * d,
+                                cores=w * h * d * g.cores_per_chip,
+                                hbm_bytes=w * h * d * g.hbm_bytes,
+                                placements=placements,
+                            )
+                        )
+                    d *= 2
                 h *= 2
             w *= 2
         return tuple(profiles)

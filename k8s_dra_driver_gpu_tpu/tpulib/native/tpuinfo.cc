@@ -126,13 +126,17 @@ Shape HostShape(const Generation& g) {
   return {1, 1, 1};
 }
 
-// Parse "v5p-16" / "v5e-4" into (generation, chips).
+// Parse "v5p-16" / "v5e-4" into (generation, chips). Strict: the suffix
+// must be all digits (parity with the Python backend's fullmatch).
 bool ParseAcceleratorType(const std::string& t, const Generation** gen,
                           int* chips) {
   auto dash = t.find('-');
-  if (dash == std::string::npos) return false;
+  if (dash == std::string::npos || dash + 1 >= t.size()) return false;
   const Generation* g = FindGeneration(t.substr(0, dash));
   if (g == nullptr) return false;
+  for (size_t i = dash + 1; i < t.size(); i++) {
+    if (!std::isdigit(static_cast<unsigned char>(t[i]))) return false;
+  }
   int n = std::atoi(t.c_str() + dash + 1);
   if (n <= 0) return false;
   *gen = g;
@@ -245,6 +249,9 @@ HostInfo MockEnumerate(const std::map<std::string, std::string>& opts) {
   h.slice = SliceShape(*h.gen, chips);
   Shape host = HostShape(*h.gen);
   int per_host = std::min(chips, h.gen->chips_per_host);
+  // A host owning fewer chips than a full block covers the (smaller)
+  // slice grid itself; keep coords inside that grid.
+  if (per_host < host.count()) host = SliceShape(*h.gen, per_host);
   h.num_hosts = (chips + h.gen->chips_per_host - 1) / h.gen->chips_per_host;
   h.worker_id = std::atoi(Opt(opts, "worker_id", "0").c_str());
   for (int i = 0; i < per_host; i++) {
@@ -316,6 +323,9 @@ HostInfo DevfsEnumerate(const std::map<std::string, std::string>& opts) {
   const char* wid = std::getenv("TPU_WORKER_ID");
   h.worker_id = wid != nullptr ? std::atoi(wid) : 0;
   Shape host = HostShape(*h.gen);
+  if (!indices.empty() && static_cast<int>(indices.size()) < host.count()) {
+    host = SliceShape(*h.gen, static_cast<int>(indices.size()));
+  }
   for (int idx : indices) {
     Chip c;
     c.index = idx;
@@ -428,29 +438,36 @@ char* tpuinfo_subslice_profiles(const char* opts) {
     first = false;
   }
 
-  // Aligned sub-rectangle (power-of-two) chip blocks within the host grid,
-  // the analog of MIG profile x placement enumeration.
+  // Aligned sub-block (power-of-two) chip carve-outs within the host
+  // grid, over all three dims (z matters for 2-chip 3D hosts), the
+  // analog of MIG profile x placement enumeration.
   for (int w = 1; w <= host.x; w *= 2) {
     for (int hgt = 1; hgt <= host.y; hgt *= 2) {
-      if (w * hgt > per_host) continue;
-      Shape prof{w, hgt, 1};
-      if (!first) j.raw(",");
-      first = false;
-      j.raw("{");
-      j.str("name").raw(":").str(prof.str(gen->dims)).raw(",");
-      j.str("chips").raw(":").num(prof.count()).raw(",");
-      j.str("cores").raw(":").num(prof.count() * gen->cores_per_chip).raw(",");
-      j.str("hbm_bytes").raw(":").num(prof.count() * gen->hbm_bytes).raw(",");
-      j.str("placements").raw(":[");
-      bool p0 = true;
-      for (int y = 0; y + hgt <= host.y; y += hgt) {
-        for (int x = 0; x + w <= host.x; x += w) {
-          if (!p0) j.raw(",");
-          p0 = false;
-          j.num(y * host.x + x);
+      for (int dep = 1; dep <= host.z; dep *= 2) {
+        if (w * hgt * dep > per_host) continue;
+        Shape prof{w, hgt, dep};
+        if (!first) j.raw(",");
+        first = false;
+        j.raw("{");
+        j.str("name").raw(":").str(prof.str(gen->dims)).raw(",");
+        j.str("chips").raw(":").num(prof.count()).raw(",");
+        j.str("cores").raw(":").num(prof.count() * gen->cores_per_chip)
+            .raw(",");
+        j.str("hbm_bytes").raw(":").num(prof.count() * gen->hbm_bytes)
+            .raw(",");
+        j.str("placements").raw(":[");
+        bool p0 = true;
+        for (int z = 0; z + dep <= host.z; z += dep) {
+          for (int y = 0; y + hgt <= host.y; y += hgt) {
+            for (int x = 0; x + w <= host.x; x += w) {
+              if (!p0) j.raw(",");
+              p0 = false;
+              j.num((z * host.y + y) * host.x + x);
+            }
+          }
         }
+        j.raw("]}");
       }
-      j.raw("]}");
     }
   }
   j.raw("]}");

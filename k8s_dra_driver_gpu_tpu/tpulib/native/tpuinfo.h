@@ -16,8 +16,9 @@
  *   worker_id       which host of a multi-host slice this is (default 0).
  *   dev_root        device directory to probe (default "/dev").
  *   sys_root        sysfs root to probe (default "/sys").
- *   health_events   injected mock health events, format
- *                   "chip=1,kind=hbm_uncorrectable;chip=2,kind=ici_link_down".
+ *   health_events   injected mock health events, '|'-separated (';' is
+ *                   the options separator), format
+ *                   "chip=1,kind=hbm_uncorrectable|chip=2,kind=ici_link_down".
  */
 
 #ifndef TPUINFO_H_

@@ -1,0 +1,1 @@
+"""Training loop: sharded train step over a ComputeDomain's mesh."""

@@ -8,8 +8,12 @@ multi-chip sharding on a forced-host-platform device mesh, SURVEY.md §4).
 import os
 import sys
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the cpu platform even when the ambient environment selects a TPU
+# backend (this image registers an 'axon' PJRT plugin from sitecustomize,
+# and pytest plugins import jax before conftest runs). Backend selection
+# happens at first use, so config.update still wins here as long as no
+# computation ran yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,6 +21,10 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,139 @@
+"""Tests for the JAX workload stack: llama, mesh, train step, collectives.
+
+Runs on the virtual 8-device CPU mesh (conftest.py), mirroring how the
+reference tests multi-node flows without hardware (SURVEY.md §4.4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_gpu_tpu.models import llama
+from k8s_dra_driver_gpu_tpu.ops.attention import dot_product_attention
+from k8s_dra_driver_gpu_tpu.ops.collectives import bench_allreduce
+from k8s_dra_driver_gpu_tpu.parallel.mesh import (
+    MeshPlan,
+    build_mesh,
+    mesh_from_topology,
+    plan_for,
+)
+from k8s_dra_driver_gpu_tpu.train.train import make_sharded_train
+
+
+class TestMesh:
+    def test_plan_factorization(self):
+        p = plan_for(8)
+        assert p.size == 8
+        assert p.tp == 4  # tp takes the innermost power of two up to 4
+        p = plan_for(32)
+        assert p.size == 32
+
+    def test_build_mesh(self):
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=4, sp=1))
+        assert mesh.shape["dp"] == 2
+        assert mesh.shape["tp"] == 4
+
+    def test_mesh_from_topology(self):
+        mesh = mesh_from_topology("2x2x2")
+        assert int(np.prod(list(mesh.shape.values()))) == 8
+
+    def test_plan_explicit_tp(self):
+        p = plan_for(8, tp=2, sp=2)
+        assert p.tp == 2 and p.sp == 2 and p.size == 8
+
+    def test_plan_indivisible(self):
+        with pytest.raises(ValueError):
+            plan_for(8, tp=3)
+
+
+class TestAttention:
+    def test_causal_masking(self):
+        # Future tokens must not influence earlier outputs.
+        key = jax.random.PRNGKey(0)
+        q, k, v = (
+            jax.random.normal(key, (1, 8, 4, 16), jnp.float32) for _ in range(3)
+        )
+        out1 = dot_product_attention(q, k, v, causal=True)
+        k2 = k.at[:, -1].set(99.0)
+        v2 = v.at[:, -1].set(99.0)
+        out2 = dot_product_attention(q, k2, v2, causal=True)
+        np.testing.assert_allclose(out1[:, :-1], out2[:, :-1], atol=1e-5)
+        assert not np.allclose(out1[:, -1], out2[:, -1])
+
+    def test_gqa_matches_mha_when_equal_heads(self):
+        key = jax.random.PRNGKey(1)
+        q = jax.random.normal(key, (2, 6, 4, 8))
+        k = jax.random.normal(key, (2, 6, 4, 8))
+        v = jax.random.normal(key, (2, 6, 4, 8))
+        out = dot_product_attention(q, k, v, causal=False)
+        # Reference einsum per-head computation.
+        scores = jnp.einsum("bqhd,bshd->bhqs", q, k) / np.sqrt(8)
+        w = jax.nn.softmax(scores, axis=-1)
+        ref = jnp.einsum("bhqs,bshd->bqhd", w, v)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+class TestLlama:
+    def test_forward_shapes(self):
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = jax.jit(lambda p, t: llama.forward(p, t, cfg))(params, tokens)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_causality_end_to_end(self):
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        t1 = jnp.zeros((1, 8), jnp.int32)
+        t2 = t1.at[0, -1].set(5)
+        l1 = llama.forward(params, t1, cfg)
+        l2 = llama.forward(params, t2, cfg)
+        np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], atol=2e-2)
+
+
+class TestShardedTraining:
+    def test_one_step_8dev(self):
+        from k8s_dra_driver_gpu_tpu.parallel.mesh import plan_for
+
+        mesh = build_mesh(plan_for(8))
+        cfg = llama.LlamaConfig.tiny()
+        init_fn, step_fn, batch_shard, place = make_sharded_train(mesh, cfg)
+        state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                               cfg.vocab_size, jnp.int32),
+            batch_shard,
+        )
+        state, loss0 = step_fn(state, tokens)
+        for _ in range(5):
+            state, loss = step_fn(state, tokens)
+        # Loss decreases on a repeated batch (the step actually trains).
+        assert float(loss) < float(loss0)
+        assert int(state.step) == 6
+        # Params are really sharded: a tp-sharded leaf spans devices.
+        wq = state.params["layers"]["wq"]
+        assert len(wq.sharding.device_set) > 1
+
+    def test_graft_entry(self):
+        import __graft_entry__ as g
+
+        fn, args = g.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape[0] == 2
+
+    def test_graft_dryrun(self, capsys):
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8)
+        assert "loss=" in capsys.readouterr().out
+
+
+class TestCollectives:
+    def test_allreduce_bench_runs(self):
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=8, sp=1))
+        res = bench_allreduce(mesh, "tp", nbytes=1 << 20, iters=2)
+        assert res["participants"] == 8
+        assert res["gbps"] > 0

@@ -106,6 +106,16 @@ class TestSubSliceProfiles:
         assert profs["1x2x1"].placements == (0, 1)
         assert profs["2x2x1"].placements == (0,)
 
+    def test_two_chip_3d_host_covers_z(self, lib):
+        # v5p-4 = 2 chips in a 1x1x2 grid: the z-axis carve-outs must
+        # exist and enumeration coords must stay inside the slice grid.
+        profs = {p.name: p for p in lib.subslice_profiles(
+            EnumerateOptions(mock_topology="v5p-4"))}
+        assert profs["1x1x1"].placements == (0, 1)
+        assert profs["1x1x2"].placements == (0,)
+        h = lib.enumerate(EnumerateOptions(mock_topology="v5p-4"))
+        assert [c.ici_coords for c in h.chips] == [(0, 0, 0), (0, 0, 1)]
+
     def test_v5e_profiles_no_core_level(self, lib):
         profs = {p.name: p for p in lib.subslice_profiles(
             EnumerateOptions(mock_topology="v5e-4"))}
@@ -141,6 +151,10 @@ class TestBackendParity:
         EnumerateOptions(mock_topology="v6e-8"),
         # Unknown type falls back to v5e-4 wholesale on both backends.
         EnumerateOptions(mock_topology="v99-4"),
+        # Trailing junk in the suffix is rejected identically.
+        EnumerateOptions(mock_topology="v5p-16x"),
+        # Partial 3D host (z-extent carve-outs).
+        EnumerateOptions(mock_topology="v5p-4"),
     ]
 
     def test_enumerate_parity(self):
