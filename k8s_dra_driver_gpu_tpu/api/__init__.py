@@ -1,0 +1,52 @@
+"""The ``resource.tpu.dra/v1beta1`` API group.
+
+Reference: api/nvidia.com/resource/v1beta1/ (opaque-config types with
+Normalize()/Validate(), strict + non-strict decoders at api.go:41-98, and
+the ComputeDomain/ComputeDomainClique CRDs).
+"""
+
+from .configs import (
+    AllocationMode,
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+    MultiTenancyConfig,
+    PassthroughConfig,
+    Sharing,
+    SubSliceConfig,
+    TimeSlicingConfig,
+    TimeSlicingInterval,
+    TpuConfig,
+    ValidationError,
+)
+from .computedomain import (
+    ComputeDomain,
+    ComputeDomainClique,
+    ComputeDomainNode,
+    ComputeDomainStatusValue,
+)
+from .decode import DecodeError, decode_config, nonstrict_decode, strict_decode
+
+API_VERSION = "resource.tpu.dra/v1beta1"
+
+__all__ = [
+    "API_VERSION",
+    "AllocationMode",
+    "ComputeDomain",
+    "ComputeDomainChannelConfig",
+    "ComputeDomainClique",
+    "ComputeDomainDaemonConfig",
+    "ComputeDomainNode",
+    "ComputeDomainStatusValue",
+    "DecodeError",
+    "MultiTenancyConfig",
+    "PassthroughConfig",
+    "Sharing",
+    "SubSliceConfig",
+    "TimeSlicingConfig",
+    "TimeSlicingInterval",
+    "TpuConfig",
+    "ValidationError",
+    "decode_config",
+    "nonstrict_decode",
+    "strict_decode",
+]

@@ -1,0 +1,172 @@
+"""ComputeDomain / ComputeDomainClique CR types.
+
+Reference: api/nvidia.com/resource/v1beta1/computedomain.go:38-143 and
+computedomainclique.go:29-71. A ComputeDomain gang-prepares a contiguous
+multi-host ICI slice; its status aggregates per-node daemon readiness. A
+ComputeDomainClique carries per-ICI-domain daemon membership (one clique
+per tightly-coupled slice; cross-clique traffic rides DCN).
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+
+
+class ComputeDomainStatusValue:
+    READY = "Ready"
+    NOT_READY = "NotReady"
+
+
+@dataclass
+class ComputeDomainChannel:
+    resource_claim_template_name: str = ""
+    allocation_mode: str = "Single"
+
+
+@dataclass
+class ComputeDomainNode:
+    """Per-node rendezvous record (computedomain.go status.nodes)."""
+
+    name: str = ""
+    ip_address: str = ""
+    clique_id: str = ""
+    index: int = -1  # stable worker index within the clique
+    status: str = ComputeDomainStatusValue.NOT_READY
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ComputeDomainNode":
+        return cls(
+            name=d.get("name", ""),
+            ip_address=d.get("ipAddress", ""),
+            clique_id=d.get("cliqueID", ""),
+            index=d.get("index", -1),
+            status=d.get("status", ComputeDomainStatusValue.NOT_READY),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "ipAddress": self.ip_address,
+            "cliqueID": self.clique_id,
+            "index": self.index,
+            "status": self.status,
+        }
+
+
+@dataclass
+class ComputeDomain:
+    """The ComputeDomain CR (namespaced)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    # Spec.
+    num_nodes: int = 0
+    channel_resource_claim_template: str = ""
+    channel_allocation_mode: str = "Single"
+    # Desired ICI slice topology, e.g. "2x2x4" (TPU-native addition: the
+    # reference sizes domains by numNodes only; on TPU the slice shape is
+    # the unit of gang scheduling).
+    topology: str = ""
+    # Status.
+    status: str = ComputeDomainStatusValue.NOT_READY
+    nodes: list[ComputeDomainNode] = field(default_factory=list)
+    # Metadata bookkeeping.
+    finalizers: list[str] = field(default_factory=list)
+    generation: int = 0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ComputeDomain":
+        meta = d.get("metadata", {})
+        spec = d.get("spec", {})
+        status = d.get("status", {})
+        channel = spec.get("channel") or {}
+        rct = channel.get("resourceClaimTemplate") or {}
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            uid=meta.get("uid", ""),
+            num_nodes=spec.get("numNodes", 0),
+            channel_resource_claim_template=rct.get("name", ""),
+            channel_allocation_mode=channel.get("allocationMode", "Single"),
+            topology=spec.get("topology", ""),
+            status=status.get("status", ComputeDomainStatusValue.NOT_READY),
+            nodes=[
+                ComputeDomainNode.from_dict(n) for n in status.get("nodes", [])
+            ],
+            finalizers=list(meta.get("finalizers", [])),
+            generation=meta.get("generation", 0),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": "resource.tpu.dra/v1beta1",
+            "kind": "ComputeDomain",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "uid": self.uid,
+                "finalizers": self.finalizers,
+                "generation": self.generation,
+            },
+            "spec": {
+                "numNodes": self.num_nodes,
+                "topology": self.topology,
+                "channel": {
+                    "resourceClaimTemplate": {
+                        "name": self.channel_resource_claim_template
+                    },
+                    "allocationMode": self.channel_allocation_mode,
+                },
+            },
+            "status": {
+                "status": self.status,
+                "nodes": [n.to_dict() for n in self.nodes],
+            },
+        }
+
+
+@dataclass
+class ComputeDomainClique:
+    """Per-ICI-clique daemon membership CR, named "<cdUID>.<cliqueID>"
+    (computedomainclique.go:29-71; written by daemons, read by the
+    controller and by workload bootstrap)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    compute_domain_uid: str = ""
+    clique_id: str = ""
+    daemons: list[ComputeDomainNode] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ComputeDomainClique":
+        meta = d.get("metadata", {})
+        spec = d.get("spec", {})
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            uid=meta.get("uid", ""),
+            compute_domain_uid=spec.get("computeDomainUID", ""),
+            clique_id=spec.get("cliqueID", ""),
+            daemons=[
+                ComputeDomainNode.from_dict(n)
+                for n in d.get("status", {}).get("daemons", [])
+            ],
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": "resource.tpu.dra/v1beta1",
+            "kind": "ComputeDomainClique",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "uid": self.uid,
+            },
+            "spec": {
+                "computeDomainUID": self.compute_domain_uid,
+                "cliqueID": self.clique_id,
+            },
+            "status": {"daemons": [n.to_dict() for n in self.daemons]},
+        }

@@ -1,0 +1,256 @@
+"""Opaque device-config types with Normalize/Validate.
+
+Reference: api/nvidia.com/resource/v1beta1/{gpuconfig.go:29,
+migconfig.go:28, vfiodeviceconfig.go:29, computedomainconfig.go:28-86,
+sharing.go} -- every config implements Interface{Normalize,Validate}
+(api.go:41-44).
+
+TPU mapping: GpuConfig -> TpuConfig (whole-chip claims), MigDeviceConfig
+-> SubSliceConfig (sub-slice carve-out claims), VfioDeviceConfig ->
+PassthroughConfig, MPS -> MultiTenancy (co-tenant chip sharing with
+per-client HBM limits).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class ValidationError(ValueError):
+    pass
+
+
+class TimeSlicingInterval(str, Enum):
+    DEFAULT = "Default"
+    SHORT = "Short"
+    MEDIUM = "Medium"
+    LONG = "Long"
+
+
+class AllocationMode(str, Enum):
+    SINGLE = "Single"
+    ALL = "All"
+
+
+_HBM_RE = re.compile(r"^(\d+)(Gi|Mi)?$")
+
+
+def _parse_hbm(limit: str) -> int:
+    """Parse an HBM limit like "8Gi"/"512Mi"/"1024" (bytes) to bytes."""
+    m = _HBM_RE.match(limit)
+    if not m:
+        raise ValidationError(f"invalid HBM limit {limit!r}")
+    n = int(m.group(1))
+    unit = m.group(2)
+    if unit == "Gi":
+        return n << 30
+    if unit == "Mi":
+        return n << 20
+    return n
+
+
+@dataclass
+class TimeSlicingConfig:
+    """Temporal sharing: chip time-slice interval.
+
+    Reference: sharing.go:33-39 (TimeSlicingSettings Default/Short/
+    Medium/Long).
+    """
+
+    interval: str = TimeSlicingInterval.DEFAULT.value
+
+    def normalize(self) -> None:
+        if not self.interval:
+            self.interval = TimeSlicingInterval.DEFAULT.value
+
+    def validate(self) -> None:
+        values = [i.value for i in TimeSlicingInterval]
+        if self.interval not in values:
+            raise ValidationError(
+                f"unknown time-slicing interval {self.interval!r}; "
+                f"must be one of {values}"
+            )
+
+
+@dataclass
+class MultiTenancyConfig:
+    """Spatial co-tenancy on one chip (MPS analog): bounded client count
+    with per-client HBM limits, normalized per device.
+
+    Reference: sharing.go:190-220 (MPS activeThreadPercentage + pinned
+    device-memory limits with per-device override normalization).
+    """
+
+    max_clients: int | None = None
+    # Default HBM limit applied to every client; per-device overrides win.
+    hbm_limit: str | None = None
+    per_device_hbm_limits: dict[str, str] = field(default_factory=dict)
+
+    def normalize(self) -> None:
+        # Fold the default limit into an explicit per-device map entry
+        # ("*" wildcard), mirroring the reference's normalization of the
+        # default memory limit into per-device entries.
+        if self.hbm_limit and "*" not in self.per_device_hbm_limits:
+            self.per_device_hbm_limits["*"] = self.hbm_limit
+
+    def validate(self) -> None:
+        if self.max_clients is not None and self.max_clients < 1:
+            raise ValidationError("maxClients must be >= 1")
+        for dev, lim in self.per_device_hbm_limits.items():
+            _parse_hbm(lim)  # raises on malformed
+            if dev != "*" and not dev:
+                raise ValidationError("empty device key in hbm limits")
+
+    def hbm_limit_bytes_for(self, device: str) -> int | None:
+        lim = self.per_device_hbm_limits.get(
+            device, self.per_device_hbm_limits.get("*")
+        )
+        return _parse_hbm(lim) if lim else None
+
+
+@dataclass
+class Sharing:
+    """Sharing strategy union (exactly one member set after validate).
+
+    Reference: sharing.go Sharing{strategy, timeSlicingConfig, mpsConfig}.
+    """
+
+    strategy: str = "TimeSlicing"  # TimeSlicing | MultiTenancy
+    time_slicing: TimeSlicingConfig | None = None
+    multi_tenancy: MultiTenancyConfig | None = None
+
+    def normalize(self) -> None:
+        if self.strategy == "TimeSlicing" and self.time_slicing is None:
+            self.time_slicing = TimeSlicingConfig()
+        if self.time_slicing:
+            self.time_slicing.normalize()
+        if self.multi_tenancy:
+            self.multi_tenancy.normalize()
+
+    def validate(self) -> None:
+        if self.strategy == "TimeSlicing":
+            if self.multi_tenancy is not None:
+                raise ValidationError(
+                    "multiTenancy config set with TimeSlicing strategy"
+                )
+            if self.time_slicing:
+                self.time_slicing.validate()
+        elif self.strategy == "MultiTenancy":
+            if self.time_slicing is not None:
+                raise ValidationError(
+                    "timeSlicing config set with MultiTenancy strategy"
+                )
+            if self.multi_tenancy is None:
+                raise ValidationError("multiTenancy config missing")
+            self.multi_tenancy.validate()
+        else:
+            raise ValidationError(f"unknown sharing strategy {self.strategy!r}")
+
+    @property
+    def is_time_slicing(self) -> bool:
+        return self.strategy == "TimeSlicing"
+
+    @property
+    def is_multi_tenancy(self) -> bool:
+        return self.strategy == "MultiTenancy"
+
+
+@dataclass
+class TpuConfig:
+    """Config for whole-chip claims (GpuConfig analog, gpuconfig.go:29)."""
+
+    KIND = "TpuConfig"
+
+    sharing: Sharing | None = None
+
+    def normalize(self) -> None:
+        if self.sharing is None:
+            self.sharing = Sharing()
+        self.sharing.normalize()
+
+    def validate(self) -> None:
+        if self.sharing:
+            self.sharing.validate()
+
+
+@dataclass
+class SubSliceConfig:
+    """Config for sub-slice carve-out claims (MigDeviceConfig analog,
+    migconfig.go:28)."""
+
+    KIND = "SubSliceConfig"
+
+    sharing: Sharing | None = None
+
+    def normalize(self) -> None:
+        if self.sharing is None:
+            self.sharing = Sharing()
+        self.sharing.normalize()
+
+    def validate(self) -> None:
+        if self.sharing:
+            self.sharing.validate()
+
+
+@dataclass
+class PassthroughConfig:
+    """Config for vfio passthrough claims (VfioDeviceConfig analog,
+    vfiodeviceconfig.go:29)."""
+
+    KIND = "PassthroughConfig"
+
+    # "legacy" (/dev/vfio/<group>) or "iommufd" (/dev/vfio/devices/*).
+    iommu_mode: str = "legacy"
+
+    def normalize(self) -> None:
+        if not self.iommu_mode:
+            self.iommu_mode = "legacy"
+
+    def validate(self) -> None:
+        if self.iommu_mode not in ("legacy", "iommufd"):
+            raise ValidationError(
+                f"unknown iommu mode {self.iommu_mode!r}"
+            )
+
+
+@dataclass
+class ComputeDomainChannelConfig:
+    """Workload-side ComputeDomain claim config
+    (computedomainconfig.go:28-56)."""
+
+    KIND = "ComputeDomainChannelConfig"
+
+    domain_id: str = ""
+    allocation_mode: str = AllocationMode.SINGLE.value
+
+    def normalize(self) -> None:
+        if not self.allocation_mode:
+            self.allocation_mode = AllocationMode.SINGLE.value
+
+    def validate(self) -> None:
+        if not self.domain_id:
+            raise ValidationError("domainID must be set")
+        modes = [m.value for m in AllocationMode]
+        if self.allocation_mode not in modes:
+            raise ValidationError(
+                f"unknown allocationMode {self.allocation_mode!r}"
+            )
+
+
+@dataclass
+class ComputeDomainDaemonConfig:
+    """Daemon-side ComputeDomain claim config
+    (computedomainconfig.go:58-86)."""
+
+    KIND = "ComputeDomainDaemonConfig"
+
+    domain_id: str = ""
+
+    def normalize(self) -> None:
+        pass
+
+    def validate(self) -> None:
+        if not self.domain_id:
+            raise ValidationError("domainID must be set")

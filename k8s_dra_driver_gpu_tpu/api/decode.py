@@ -1,0 +1,145 @@
+"""Strict and non-strict decoders for opaque device configs.
+
+Reference: api.go:46-57 -- the StrictDecoder rejects unknown fields (used
+on *user input*: claim parameters, webhook admission), the
+NonstrictDecoder tolerates them (used on *checkpoint data*, where a newer
+schema may have written fields an older binary doesn't know).
+"""
+
+from __future__ import annotations
+
+from dataclasses import fields as dc_fields
+from typing import Any, Type
+
+from . import configs
+from .configs import (
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+    MultiTenancyConfig,
+    PassthroughConfig,
+    Sharing,
+    SubSliceConfig,
+    TimeSlicingConfig,
+    TpuConfig,
+)
+
+API_VERSION = "resource.tpu.dra/v1beta1"
+
+
+class DecodeError(ValueError):
+    pass
+
+
+_KINDS: dict[str, Type] = {
+    c.KIND: c
+    for c in (
+        TpuConfig,
+        SubSliceConfig,
+        PassthroughConfig,
+        ComputeDomainChannelConfig,
+        ComputeDomainDaemonConfig,
+    )
+}
+
+# JSON field name -> dataclass attribute per type.
+_FIELD_MAPS: dict[Type, dict[str, str]] = {
+    TpuConfig: {"sharing": "sharing"},
+    SubSliceConfig: {"sharing": "sharing"},
+    PassthroughConfig: {"iommuMode": "iommu_mode"},
+    ComputeDomainChannelConfig: {
+        "domainID": "domain_id",
+        "allocationMode": "allocation_mode",
+    },
+    ComputeDomainDaemonConfig: {"domainID": "domain_id"},
+    Sharing: {
+        "strategy": "strategy",
+        "timeSlicing": "time_slicing",
+        "multiTenancy": "multi_tenancy",
+    },
+    TimeSlicingConfig: {"interval": "interval"},
+    MultiTenancyConfig: {
+        "maxClients": "max_clients",
+        "hbmLimit": "hbm_limit",
+        "perDeviceHbmLimits": "per_device_hbm_limits",
+    },
+}
+
+_NESTED: dict[tuple[Type, str], Type] = {
+    (TpuConfig, "sharing"): Sharing,
+    (SubSliceConfig, "sharing"): Sharing,
+    (Sharing, "time_slicing"): TimeSlicingConfig,
+    (Sharing, "multi_tenancy"): MultiTenancyConfig,
+}
+
+
+def _decode_into(cls: Type, data: dict, strict: bool, path: str) -> Any:
+    if not isinstance(data, dict):
+        raise DecodeError(f"{path}: expected object, got {type(data).__name__}")
+    fmap = _FIELD_MAPS[cls]
+    kwargs: dict[str, Any] = {}
+    for json_key, value in data.items():
+        if json_key not in fmap:
+            if strict:
+                raise DecodeError(f"{path}: unknown field {json_key!r}")
+            continue
+        attr = fmap[json_key]
+        nested = _NESTED.get((cls, attr))
+        if nested is not None and value is not None:
+            value = _decode_into(nested, value, strict, f"{path}.{json_key}")
+        kwargs[attr] = value
+    try:
+        return cls(**kwargs)
+    except TypeError as e:
+        raise DecodeError(f"{path}: {e}") from e
+
+
+def decode_config(parameters: dict, strict: bool = True) -> Any:
+    """Decode an opaque-config ``parameters`` object (with apiVersion and
+    kind) into its typed config. Does NOT normalize/validate -- callers
+    run that explicitly (reference runs Normalize+Validate at both
+    admission and prepare time)."""
+    if not isinstance(parameters, dict):
+        raise DecodeError("opaque parameters must be an object")
+    api_version = parameters.get("apiVersion", "")
+    if api_version != API_VERSION:
+        raise DecodeError(
+            f"unsupported apiVersion {api_version!r} (want {API_VERSION})"
+        )
+    kind = parameters.get("kind", "")
+    cls = _KINDS.get(kind)
+    if cls is None:
+        raise DecodeError(f"unknown config kind {kind!r}")
+    body = {
+        k: v for k, v in parameters.items() if k not in ("apiVersion", "kind")
+    }
+    return _decode_into(cls, body, strict, kind)
+
+
+def strict_decode(parameters: dict) -> Any:
+    """User-input decoder: unknown fields are errors (api.go:46-50)."""
+    return decode_config(parameters, strict=True)
+
+
+def nonstrict_decode(parameters: dict) -> Any:
+    """Checkpoint-data decoder: unknown fields ignored (api.go:52-57)."""
+    return decode_config(parameters, strict=False)
+
+
+def encode_config(cfg: Any) -> dict:
+    """Typed config -> opaque parameters dict (inverse of decode)."""
+    cls = type(cfg)
+    fmap = _FIELD_MAPS[cls]
+    out: dict[str, Any] = {"apiVersion": API_VERSION}
+    if hasattr(cls, "KIND"):
+        out["kind"] = cls.KIND
+    rev = {attr: json_key for json_key, attr in fmap.items()}
+    for f in dc_fields(cfg):
+        value = getattr(cfg, f.name)
+        if value is None:
+            continue
+        if (cls, f.name) in _NESTED:
+            inner = encode_config(value)
+            inner.pop("apiVersion", None)
+            value = inner
+        out[rev[f.name]] = value
+    return out

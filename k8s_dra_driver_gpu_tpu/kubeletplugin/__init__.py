@@ -1,0 +1,11 @@
+"""The per-node ``tpu.dra.dev`` DRA kubelet plugin.
+
+Reference: cmd/gpu-kubelet-plugin/ (8318 LoC Go). Enumerates TPU chips
+via tpulib, publishes ResourceSlices, serves NodePrepareResources /
+NodeUnprepareResources with two-phase checkpointing, and injects devices
+into containers via CDI specs.
+"""
+
+DRIVER_NAME = "tpu.dra.dev"
+CDI_VENDOR = "k8s.tpu.dra.dev"
+CDI_CLASS = "claim"

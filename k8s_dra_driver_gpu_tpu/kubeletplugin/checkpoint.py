@@ -1,0 +1,262 @@
+"""Versioned, checksummed, crash-safe claim checkpointing.
+
+Reference: cmd/gpu-kubelet-plugin/{checkpoint.go,checkpointv.go} --
+versioned on-disk JSON with V1+V2 dual checksums for seamless up/downgrade
+(checkpoint.go:26-66), omitempty-hardened device marshalling (issue 1080,
+checkpointv.go:29-57), claim-state enum (:59-66), NodeBootID invalidation
+on reboot (:74-81), corruption diagnosis via on-disk vs re-marshaled diff
+(device_state.go:618-646), and a flock guarding read-modify-write across
+processes (device_state.go:648-676).
+
+Schema versions:
+  v1: {claims: {uid: {state, devices}}}                 (legacy carry)
+  v2: v1 + nodeBootID + per-claim namespace/name for API-server
+      validation by the stale-claim GC.
+"""
+
+from __future__ import annotations
+
+import difflib
+import json
+import logging
+import os
+import zlib
+from dataclasses import dataclass, field
+from enum import Enum
+
+from ..pkg import bootid
+from ..pkg.flock import Flock
+
+logger = logging.getLogger(__name__)
+
+LATEST_VERSION = "v2"
+
+
+class ClaimState(str, Enum):
+    PREPARE_STARTED = "PrepareStarted"
+    PREPARE_COMPLETED = "PrepareCompleted"
+
+
+@dataclass
+class CheckpointedDevice:
+    """One prepared device record. All fields serialize omitempty-style:
+    absent keys decode to defaults (the reference hardened this after
+    issue 1080 -- a schema change that dropped empty fields corrupted
+    checksums across up/downgrade)."""
+
+    canonical_name: str = ""
+    kind: str = ""  # DeviceKind value
+    cdi_device_ids: list[str] = field(default_factory=list)
+    # Dynamic sub-slice live identity (None for static devices).
+    live: dict | None = None
+
+    def to_dict(self) -> dict:
+        d: dict = {}
+        if self.canonical_name:
+            d["canonicalName"] = self.canonical_name
+        if self.kind:
+            d["kind"] = self.kind
+        if self.cdi_device_ids:
+            d["cdiDeviceIDs"] = self.cdi_device_ids
+        if self.live is not None:
+            d["live"] = self.live
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CheckpointedDevice":
+        return cls(
+            canonical_name=d.get("canonicalName", ""),
+            kind=d.get("kind", ""),
+            cdi_device_ids=list(d.get("cdiDeviceIDs", [])),
+            live=d.get("live"),
+        )
+
+
+@dataclass
+class CheckpointedClaim:
+    uid: str = ""
+    namespace: str = ""
+    name: str = ""
+    state: str = ClaimState.PREPARE_STARTED.value
+    devices: list[CheckpointedDevice] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d: dict = {"uid": self.uid, "state": self.state}
+        if self.namespace:
+            d["namespace"] = self.namespace
+        if self.name:
+            d["name"] = self.name
+        if self.devices:
+            d["devices"] = [x.to_dict() for x in self.devices]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CheckpointedClaim":
+        return cls(
+            uid=d.get("uid", ""),
+            namespace=d.get("namespace", ""),
+            name=d.get("name", ""),
+            state=d.get("state", ClaimState.PREPARE_STARTED.value),
+            devices=[
+                CheckpointedDevice.from_dict(x) for x in d.get("devices", [])
+            ],
+        )
+
+
+def _checksum(payload: dict) -> int:
+    """Deterministic checksum over the canonical JSON encoding."""
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return zlib.crc32(blob.encode())
+
+
+@dataclass
+class Checkpoint:
+    """The in-memory checkpoint document."""
+
+    node_boot_id: str = ""
+    claims: dict[str, CheckpointedClaim] = field(default_factory=dict)
+
+    # -- serialization --------------------------------------------------------
+
+    def _payload_v2(self) -> dict:
+        return {
+            "nodeBootID": self.node_boot_id,
+            "claims": {uid: c.to_dict() for uid, c in self.claims.items()},
+        }
+
+    def _payload_v1(self) -> dict:
+        # v1 lacked boot-id and namespace/name.
+        return {
+            "claims": {
+                uid: {
+                    "uid": c.uid,
+                    "state": c.state,
+                    **(
+                        {"devices": [x.to_dict() for x in c.devices]}
+                        if c.devices
+                        else {}
+                    ),
+                }
+                for uid, c in self.claims.items()
+            }
+        }
+
+    def to_dict(self) -> dict:
+        """Dual-checksum envelope: a vN reader verifies checksum[vN] over
+        its own projection of the payload, so up/downgrades never see a
+        'corrupt' file (checkpoint.go:53-66)."""
+        return {
+            "version": LATEST_VERSION,
+            "data": self._payload_v2(),
+            "checksums": {
+                "v1": _checksum(self._payload_v1()),
+                "v2": _checksum(self._payload_v2()),
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Checkpoint":
+        version = d.get("version", "v1")
+        data = d.get("data", {})
+        cp = cls(
+            node_boot_id=data.get("nodeBootID", ""),
+            claims={
+                uid: CheckpointedClaim.from_dict(c)
+                for uid, c in data.get("claims", {}).items()
+            },
+        )
+        checks = d.get("checksums", {})
+        want = checks.get("v2" if version == "v2" else "v1")
+        if want is not None:
+            have = _checksum(
+                cp._payload_v2() if version == "v2" else cp._payload_v1()
+            )
+            if have != want:
+                raise CheckpointCorruptError(_diagnose(d, cp, version))
+        return cp
+
+
+class CheckpointCorruptError(RuntimeError):
+    pass
+
+
+def _diagnose(on_disk: dict, cp: Checkpoint, version: str) -> str:
+    """Unified diff of on-disk vs re-marshaled payload
+    (device_state.go:618-646)."""
+    a = json.dumps(on_disk.get("data", {}), sort_keys=True, indent=1)
+    b = json.dumps(
+        cp._payload_v2() if version == "v2" else cp._payload_v1(),
+        sort_keys=True,
+        indent=1,
+    )
+    diff = "\n".join(
+        difflib.unified_diff(
+            a.splitlines(), b.splitlines(), "on-disk", "re-marshaled", lineterm=""
+        )
+    )
+    return f"checkpoint checksum mismatch ({version}); diff:\n{diff}"
+
+
+class CheckpointManager:
+    """Flock-guarded read-modify-write of checkpoint.json.
+
+    On startup: if the recorded boot ID differs from the node's current
+    one, the checkpoint is invalidated wholesale (a reboot destroyed all
+    device state; checkpointv.go:74-81, device_state.go:190-215).
+    """
+
+    FILENAME = "checkpoint.json"
+
+    def __init__(self, root: str, boot_id: str | None = None):
+        os.makedirs(root, exist_ok=True)
+        self._path = os.path.join(root, self.FILENAME)
+        self._lock = Flock(os.path.join(root, "checkpoint.lock"))
+        self._boot_id = (
+            boot_id if boot_id is not None else bootid.read_boot_id()
+        )
+        self.invalidated_on_boot = False
+        with self._lock.acquire(timeout=10.0):
+            cp = self._read()
+            if cp.node_boot_id and self._boot_id and cp.node_boot_id != self._boot_id:
+                logger.warning(
+                    "node boot ID changed (%s -> %s): invalidating checkpoint "
+                    "with %d claim(s)",
+                    cp.node_boot_id, self._boot_id, len(cp.claims),
+                )
+                cp = Checkpoint(node_boot_id=self._boot_id)
+                self._write(cp)
+                self.invalidated_on_boot = True
+            elif not cp.node_boot_id:
+                cp.node_boot_id = self._boot_id
+                self._write(cp)
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def _read(self) -> Checkpoint:
+        if not os.path.exists(self._path):
+            return Checkpoint(node_boot_id="")
+        with open(self._path, "r", encoding="utf-8") as f:
+            return Checkpoint.from_dict(json.load(f))
+
+    def _write(self, cp: Checkpoint) -> None:
+        cp.node_boot_id = cp.node_boot_id or self._boot_id
+        tmp = self._path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(cp.to_dict(), f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+
+    def get(self) -> Checkpoint:
+        with self._lock.acquire(timeout=10.0):
+            return self._read()
+
+    def update(self, fn) -> Checkpoint:
+        """Atomic read-modify-write: fn(checkpoint) mutates in place."""
+        with self._lock.acquire(timeout=10.0):
+            cp = self._read()
+            fn(cp)
+            self._write(cp)
+            return cp

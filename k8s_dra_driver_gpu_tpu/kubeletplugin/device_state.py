@@ -1,0 +1,512 @@
+"""DeviceState: the node-side claim state machine.
+
+Reference: cmd/gpu-kubelet-plugin/device_state.go (1328 LoC) -- idempotent
+two-phase Prepare (PrepareStarted -> PrepareCompleted, :229-334), rollback
+of partially prepared claims (:536), overlapping-allocation guard (:1212),
+config precedence (class < claim, later wins; :1138), config dispatch to
+sharing/sub-slice appliers (:1010), startup reconciliation of unknown
+dynamic carve-outs (:388).
+
+TPU specifics: a dynamic sub-slice "create" realizes the carve-out in the
+node's live-sub-slice registry (the hardware-truth analog of the NVML MIG
+walk -- TPU carve-outs are bounds handed to the runtime at container
+start, so the registry is what crash recovery reconciles against) and
+hands out a UUID; whole chips and core-level splits inject /dev/accel*
+device nodes plus the TPU_* env contract via CDI.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import uuid as uuidlib
+from dataclasses import dataclass, field
+
+from ..api import configs as api_configs
+from ..api.decode import nonstrict_decode, strict_decode
+from ..pkg.featuregates import (
+    DYNAMIC_SUB_SLICE,
+    MULTI_TENANCY_SUPPORT,
+    TIME_SLICING_SETTINGS,
+    FeatureGates,
+)
+from ..pkg.flock import Flock
+from ..tpulib.binding import EnumerateOptions, TpuHostInfo, load as load_tpulib
+from .cdi import CDIHandler, ContainerEdits
+from .checkpoint import (
+    CheckpointedClaim,
+    CheckpointedDevice,
+    CheckpointManager,
+    ClaimState,
+)
+from .claim import ResourceClaim
+from .deviceinfo import (
+    AllocatableDevice,
+    ChipInfo,
+    DeviceKind,
+    SubSliceInfo,
+)
+from .sharing import MultiTenancyManager, TimeSlicingManager
+from .subslice import SubSliceLiveTuple, SubSliceSpecTuple, enumerate_subslice_devices
+
+logger = logging.getLogger(__name__)
+
+
+class PrepareError(RuntimeError):
+    pass
+
+
+@dataclass
+class Config:
+    """Node plugin configuration."""
+
+    root: str  # state root: checkpoint, CDI specs, policy files
+    tpulib_opts: EnumerateOptions = field(default_factory=EnumerateOptions)
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+    cdi_root: str | None = None
+    boot_id: str | None = None
+
+    @classmethod
+    def mock(
+        cls,
+        root: str,
+        topology: str = "v5e-4",
+        worker_id: int = 0,
+        gates: str = "DynamicSubSlice=true,TimeSlicingSettings=true,"
+        "MultiTenancySupport=true",
+    ) -> "Config":
+        return cls(
+            root=root,
+            tpulib_opts=EnumerateOptions(
+                mock_topology=topology, worker_id=worker_id
+            ),
+            feature_gates=FeatureGates.parse(gates),
+            cdi_root=os.path.join(root, "cdi"),
+        )
+
+
+class SubSliceRegistry:
+    """Node-local registry of live dynamic carve-outs (hardware truth for
+    crash reconciliation; the analog of walking NVML for stray MIG
+    devices, nvlib.go:420)."""
+
+    def __init__(self, root: str):
+        self._path = os.path.join(root, "subslices.json")
+
+    def list(self) -> dict[str, dict]:
+        try:
+            with open(self._path, encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return {}
+
+    def _write(self, entries: dict[str, dict]) -> None:
+        tmp = self._path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(entries, f, indent=1)
+        os.replace(tmp, self._path)
+
+    def create(self, live: SubSliceLiveTuple) -> None:
+        entries = self.list()
+        entries[live.uuid] = live.to_dict()
+        self._write(entries)
+
+    def destroy(self, uuid: str) -> None:
+        entries = self.list()
+        if entries.pop(uuid, None) is not None:
+            self._write(entries)
+
+
+class DeviceState:
+    """Prepare/Unprepare engine over this host's allocatable devices."""
+
+    def __init__(self, config: Config):
+        self._config = config
+        os.makedirs(config.root, exist_ok=True)
+        self._lock = threading.Lock()
+        # Node-global prepare/unprepare flock: excludes other plugin
+        # processes across upgrades (reference driver.go:46-47).
+        self.pu_lock = Flock(os.path.join(config.root, "pu.lock"))
+
+        self._tpulib = load_tpulib()
+        self.host: TpuHostInfo = self._tpulib.enumerate(config.tpulib_opts)
+        self._profiles = self._tpulib.subslice_profiles(config.tpulib_opts)
+
+        self.allocatable = self._enumerate_allocatable()
+        self._checkpoint = CheckpointManager(config.root, boot_id=config.boot_id)
+        self._registry = SubSliceRegistry(config.root)
+        self._cdi = CDIHandler(
+            cdi_root=config.cdi_root or os.path.join(config.root, "cdi")
+        )
+        self._timeslicing = TimeSlicingManager(config.root)
+        self._tenancy = MultiTenancyManager(config.root)
+
+        self.destroy_unknown_subslices()
+
+    # -- enumeration ----------------------------------------------------------
+
+    def _enumerate_allocatable(self) -> dict[str, AllocatableDevice]:
+        out: dict[str, AllocatableDevice] = {}
+        for chip in self.host.chips:
+            info = ChipInfo(chip=chip, host=self.host)
+            out[info.canonical_name] = AllocatableDevice(
+                kind=DeviceKind.CHIP, chip=info
+            )
+        if self._config.feature_gates.is_enabled(DYNAMIC_SUB_SLICE):
+            for spec in enumerate_subslice_devices(self.host, self._profiles):
+                # Full-host carve-outs duplicate the chip set; still
+                # published (schedulers pick by shape), reference
+                # publishes the full-GPU MIG profile too.
+                info = SubSliceInfo(spec=spec, host=self.host, dynamic=True)
+                out[info.canonical_name] = AllocatableDevice(
+                    kind=DeviceKind.SUBSLICE_DYNAMIC, subslice=info
+                )
+        return out
+
+    # -- crash reconciliation -------------------------------------------------
+
+    def destroy_unknown_subslices(self) -> int:
+        """Tear down live carve-outs not referenced by any checkpointed
+        claim (checkpoint is source of truth; device_state.go:388)."""
+        cp = self._checkpoint.get()
+        referenced = {
+            dev.live["uuid"]
+            for c in cp.claims.values()
+            for dev in c.devices
+            if dev.live
+        }
+        destroyed = 0
+        for uid in list(self._registry.list()):
+            if uid not in referenced:
+                self._registry.destroy(uid)
+                destroyed += 1
+        if destroyed:
+            logger.warning("destroyed %d unknown sub-slice(s)", destroyed)
+        return destroyed
+
+    # -- prepare --------------------------------------------------------------
+
+    def prepare(self, claim: ResourceClaim) -> list[str]:
+        """Idempotent two-phase prepare; returns CDI device IDs.
+
+        Holds the node-global flock for the whole operation so a second
+        plugin process (upgrade handover) can't interleave its own
+        prepare/unprepare between our overlap validation and checkpoint
+        writes (reference driver.go:381, pulock.Acquire with 10s timeout).
+        """
+        with self.pu_lock.acquire(timeout=10.0), self._lock:
+            cp = self._checkpoint.get()
+            existing = cp.claims.get(claim.uid)
+            if existing and existing.state == ClaimState.PREPARE_COMPLETED.value:
+                return [
+                    i for d in existing.devices for i in d.cdi_device_ids
+                ]
+            if existing and existing.state == ClaimState.PREPARE_STARTED.value:
+                # A previous Prepare died mid-flight: roll back its
+                # partial state, then retry fresh (device_state.go:277).
+                self._rollback(existing)
+
+            self._validate_no_overlap(cp, claim)
+
+            self._checkpoint.update(
+                lambda c: c.claims.__setitem__(
+                    claim.uid,
+                    CheckpointedClaim(
+                        uid=claim.uid,
+                        namespace=claim.namespace,
+                        name=claim.name,
+                        state=ClaimState.PREPARE_STARTED.value,
+                    ),
+                )
+            )
+
+            try:
+                prepared = self._prepare_devices(claim)
+            except BaseException:
+                # _prepare_devices rolled back its own partial device
+                # state; drop the PrepareStarted checkpoint entry.
+                self._checkpoint.update(
+                    lambda c: c.claims.pop(claim.uid, None)
+                )
+                raise
+
+            def complete(c):
+                c.claims[claim.uid] = CheckpointedClaim(
+                    uid=claim.uid,
+                    namespace=claim.namespace,
+                    name=claim.name,
+                    state=ClaimState.PREPARE_COMPLETED.value,
+                    devices=prepared,
+                )
+
+            self._checkpoint.update(complete)
+            return [i for d in prepared for i in d.cdi_device_ids]
+
+    def _validate_no_overlap(self, cp, claim: ResourceClaim) -> None:
+        """Reject preparing a device whose chips/cores another claim holds
+        (guards scheduler races; device_state.go:1212-1249)."""
+        held: dict[int, str] = {}  # core index -> claim uid
+        for other in cp.claims.values():
+            if other.uid == claim.uid:
+                continue
+            for dev in other.devices:
+                for core in self._cores_of(dev.canonical_name):
+                    held[core] = other.uid
+        # Claims in PrepareStarted with no devices yet can't conflict.
+        for result in claim.results:
+            for core in self._cores_of(result.device):
+                if core in held:
+                    raise PrepareError(
+                        f"device {result.device} overlaps with prepared "
+                        f"claim {held[core]}"
+                    )
+
+    def _cores_of(self, canonical_name: str) -> tuple[int, ...]:
+        dev = self.allocatable.get(canonical_name)
+        if dev is None:
+            return ()
+        if dev.kind == DeviceKind.CHIP:
+            idx = dev.chip.chip.index
+            return tuple(
+                idx * self.host.cores_per_chip + k
+                for k in range(self.host.cores_per_chip)
+            )
+        if dev.subslice is not None:
+            return dev.subslice.spec.core_indices(self.host)
+        return ()
+
+    def _resolve_configs(self, claim: ResourceClaim):
+        """Per-request effective config: class-sourced first, claim-sourced
+        later, later wins (GetOpaqueDeviceConfigs precedence :1138; a
+        default TpuConfig is injected when nothing matches :698-724)."""
+        per_request: dict[str, object] = {}
+        ordered = [c for c in claim.configs if c.source == "FromClass"] + [
+            c for c in claim.configs if c.source != "FromClass"
+        ]
+        for result in claim.results:
+            cfg_obj = None
+            for oc in ordered:
+                if not oc.applies_to(result.request):
+                    continue
+                cfg_obj = strict_decode(oc.parameters)
+            if cfg_obj is None:
+                dev = self.allocatable.get(result.device)
+                if dev is not None and dev.kind in (
+                    DeviceKind.SUBSLICE_DYNAMIC,
+                    DeviceKind.SUBSLICE_STATIC,
+                ):
+                    cfg_obj = api_configs.SubSliceConfig()
+                else:
+                    cfg_obj = api_configs.TpuConfig()
+            cfg_obj.normalize()
+            cfg_obj.validate()
+            per_request[result.request] = cfg_obj
+        return per_request
+
+    def _prepare_devices(self, claim: ResourceClaim) -> list[CheckpointedDevice]:
+        """All-or-nothing: any failure rolls back the partial device state
+        created by this attempt (carve-outs, sharing state, CDI spec)
+        before re-raising (unpreparePartiallyPrepairedClaim analog,
+        device_state.go:536)."""
+        created_live: list[str] = []
+        touched_chips: set[int] = set()
+        try:
+            return self._prepare_devices_inner(
+                claim, created_live, touched_chips
+            )
+        except BaseException:
+            for live_uuid in created_live:
+                self._registry.destroy(live_uuid)
+            self._timeslicing.release(claim.uid, sorted(touched_chips))
+            self._tenancy.stop(claim.uid)
+            self._cdi.delete_claim_spec_file(claim.uid)
+            raise
+
+    def _prepare_devices_inner(
+        self,
+        claim: ResourceClaim,
+        created_live: list[str],
+        touched_chips: set[int],
+    ) -> list[CheckpointedDevice]:
+        cfgs = self._resolve_configs(claim)
+        prepared: list[CheckpointedDevice] = []
+        device_edits: dict[str, ContainerEdits] = {}
+        claim_chips: set[int] = set()
+        # request -> (chips, device names) for one sharing application per
+        # config group (the reference merges MPS edits per group,
+        # cdi.go:181-307).
+        groups: dict[str, tuple[set[int], list[str]]] = {}
+
+        for result in claim.results:
+            dev = self.allocatable.get(result.device)
+            if dev is None:
+                raise PrepareError(f"unknown device {result.device!r}")
+            cfg = cfgs[result.request]
+            self._check_config_kind(dev, cfg)
+
+            edits = ContainerEdits()
+            live = None
+            if dev.kind == DeviceKind.CHIP:
+                chip_idxs: tuple[int, ...] = (dev.chip.chip.index,)
+                edits.device_nodes.append(dev.chip.chip.devpath)
+            else:
+                ss = dev.subslice
+                chip_idxs = (
+                    ss.spec.chip_indices(self.host)
+                    if not ss.spec.is_core_level
+                    else (ss.spec.parent_chip,)
+                )
+                for ci in chip_idxs:
+                    edits.device_nodes.append(self._devpath(ci))
+                if ss.spec.is_core_level:
+                    edits.env.append(
+                        f"TPU_CORE_BOUNDS={ss.spec.placement}"
+                    )
+                    edits.env.append("TPU_MEGACORE=disabled")
+                else:
+                    edits.env.append(
+                        f"TPU_CHIPS_PER_HOST_BOUNDS={ss.spec.profile.replace('x', ',')}"
+                    )
+                if dev.kind == DeviceKind.SUBSLICE_DYNAMIC:
+                    live_t = SubSliceLiveTuple(
+                        spec=ss.spec, uuid=f"tpu-ss-{uuidlib.uuid4()}"
+                    )
+                    # HOT path analog of createMigDevice (nvlib.go:926).
+                    self._registry.create(live_t)
+                    created_live.append(live_t.uuid)
+                    live = live_t.to_dict()
+
+            claim_chips.update(chip_idxs)
+            grp = groups.setdefault(result.request, (set(), []))
+            grp[0].update(chip_idxs)
+            grp[1].append(result.device)
+
+            device_edits[result.device] = edits
+            prepared.append(
+                CheckpointedDevice(
+                    canonical_name=result.device,
+                    kind=dev.kind.value,
+                    cdi_device_ids=[],
+                    live=live,
+                )
+            )
+
+        # One sharing application per request group over its full chip and
+        # device set.
+        sharing_edits = ContainerEdits()
+        for request, (chips, names) in groups.items():
+            sharing = getattr(cfgs[request], "sharing", None)
+            if sharing is None:
+                continue
+            touched_chips |= chips
+            sharing_edits = sharing_edits.merge(
+                self._apply_sharing(
+                    claim, request, sharing, sorted(chips), names
+                )
+            )
+
+        common = self._cdi.common_edits(self.host)
+        common.env.append(
+            "TPU_VISIBLE_DEVICES=" + ",".join(str(i) for i in sorted(claim_chips))
+        )
+        common = common.merge(sharing_edits)
+        cdi_ids = self._cdi.create_claim_spec_file(
+            claim.uid, device_edits, common
+        )
+        by_name = dict(zip(sorted(device_edits), cdi_ids))
+        for dev in prepared:
+            dev.cdi_device_ids = [by_name[dev.canonical_name]]
+        return prepared
+
+    def _check_config_kind(self, dev: AllocatableDevice, cfg) -> None:
+        if dev.kind == DeviceKind.CHIP and not isinstance(
+            cfg, api_configs.TpuConfig
+        ):
+            raise PrepareError(
+                f"config kind {type(cfg).__name__} cannot apply to a chip"
+            )
+        if dev.kind in (DeviceKind.SUBSLICE_DYNAMIC, DeviceKind.SUBSLICE_STATIC) \
+                and not isinstance(cfg, api_configs.SubSliceConfig):
+            raise PrepareError(
+                f"config kind {type(cfg).__name__} cannot apply to a sub-slice"
+            )
+
+    def _apply_sharing(
+        self,
+        claim: ResourceClaim,
+        request: str,
+        sharing: api_configs.Sharing,
+        chip_indices: list[int],
+        device_names: list[str],
+    ) -> ContainerEdits:
+        gates = self._config.feature_gates
+        if sharing.is_time_slicing:
+            if sharing.time_slicing.interval != "Default" and not gates.is_enabled(
+                TIME_SLICING_SETTINGS
+            ):
+                raise PrepareError(
+                    "TimeSlicingSettings feature gate disabled"
+                )
+            return self._timeslicing.set_time_slice(
+                claim.uid, chip_indices, sharing.time_slicing
+            )
+        if sharing.is_multi_tenancy:
+            if not gates.is_enabled(MULTI_TENANCY_SUPPORT):
+                raise PrepareError("MultiTenancySupport feature gate disabled")
+            return self._tenancy.start(
+                claim.uid, request, chip_indices, sharing.multi_tenancy,
+                device_names,
+            )
+        return ContainerEdits()
+
+    def _devpath(self, chip_index: int) -> str:
+        for chip in self.host.chips:
+            if chip.index == chip_index:
+                return chip.devpath
+        return f"/dev/accel{chip_index}"
+
+    # -- unprepare ------------------------------------------------------------
+
+    def unprepare(self, claim_uid: str) -> None:
+        """Idempotent unprepare + cleanup (device_state.go:426)."""
+        with self.pu_lock.acquire(timeout=10.0), self._lock:
+            cp = self._checkpoint.get()
+            existing = cp.claims.get(claim_uid)
+            if existing is None:
+                return  # noop: never prepared or already unprepared
+            self._rollback(existing)
+
+    def _rollback(self, checkpointed: CheckpointedClaim) -> None:
+        """Tear down whatever a claim holds: dynamic carve-outs, sharing
+        state, CDI spec, checkpoint entry (unprepareDevices :898 +
+        unpreparePartiallyPrepairedClaim :536)."""
+        chip_indices: set[int] = set()
+        for dev in checkpointed.devices:
+            if dev.live:
+                self._registry.destroy(dev.live["uuid"])
+            chip_indices.update(
+                c // self.host.cores_per_chip
+                for c in self._cores_of(dev.canonical_name)
+            )
+        # Holder-counted release: a chip shared with another claim (via
+        # disjoint core-level carve-outs) keeps its policy file.
+        self._timeslicing.release(checkpointed.uid, sorted(chip_indices))
+        self._tenancy.stop(checkpointed.uid)
+        self._cdi.delete_claim_spec_file(checkpointed.uid)
+        self._checkpoint.update(
+            lambda c: c.claims.pop(checkpointed.uid, None)
+        )
+
+    # -- introspection --------------------------------------------------------
+
+    def prepared_claims(self) -> dict[str, CheckpointedClaim]:
+        return self._checkpoint.get().claims
+
+    def prepared_device_count(self) -> int:
+        return sum(
+            len(c.devices)
+            for c in self._checkpoint.get().claims.values()
+            if c.state == ClaimState.PREPARE_COMPLETED.value
+        )

@@ -1,0 +1,178 @@
+"""Device model: typed info per allocatable device -> DRA Device dicts.
+
+Reference: cmd/gpu-kubelet-plugin/deviceinfo.go (GpuInfo/MigDeviceInfo/
+VfioDeviceInfo -> resourceapi.Device with attributes at :152-199) and
+allocatable.go (AllocatableDevice tagged union :48, PerGPUAllocatable-
+Devices :43, taint bookkeeping :319-328).
+
+Attributes published per device (CEL-selectable by schedulers):
+  uuid, platform, acceleratorType, topology (full-slice grid),
+  iciX/iciY/iciZ (chip coords), numaNode, pciBdf, workerId, numHosts,
+  profile/placement for sub-slices. Capacities: hbmBytes, tensorCores.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+
+from ..tpulib.binding import TpuChip, TpuHostInfo
+from .subslice import SubSliceSpecTuple, chip_name
+
+
+class DeviceKind(str, Enum):
+    CHIP = "chip"
+    SUBSLICE_STATIC = "subslice-static"
+    SUBSLICE_DYNAMIC = "subslice-dynamic"
+    PASSTHROUGH = "passthrough"
+
+
+@dataclass(frozen=True)
+class ChipInfo:
+    chip: TpuChip
+    host: TpuHostInfo
+
+    @property
+    def canonical_name(self) -> str:
+        return chip_name(self.chip.index)
+
+    def attributes(self) -> dict:
+        x, y, z = self.chip.ici_coords
+        return {
+            "uuid": self.chip.uuid,
+            "platform": self.host.platform,
+            "acceleratorType": self.host.accelerator_type,
+            "topology": self.host.topology,
+            "iciX": x,
+            "iciY": y,
+            "iciZ": z,
+            "numaNode": self.chip.numa_node,
+            "pciBdf": self.chip.pci_bdf,
+            "workerId": self.host.worker_id,
+            "numHosts": self.host.num_hosts,
+            "coresPerChip": self.host.cores_per_chip,
+        }
+
+    def capacities(self) -> dict:
+        return {
+            "hbmBytes": self.host.hbm_bytes_per_chip,
+            "tensorCores": self.host.cores_per_chip,
+        }
+
+
+@dataclass(frozen=True)
+class SubSliceInfo:
+    spec: SubSliceSpecTuple
+    host: TpuHostInfo
+    dynamic: bool  # True: created at Prepare; False: pre-carved static
+
+    @property
+    def canonical_name(self) -> str:
+        return self.spec.canonical_name()
+
+    @property
+    def chips(self) -> int:
+        return 0 if self.spec.is_core_level else len(
+            self.spec.chip_indices(self.host)
+        )
+
+    @property
+    def cores(self) -> int:
+        return len(self.spec.core_indices(self.host))
+
+    @property
+    def hbm_bytes(self) -> int:
+        per_core = self.host.hbm_bytes_per_chip // self.host.cores_per_chip
+        return per_core * self.cores
+
+    def attributes(self) -> dict:
+        return {
+            "platform": self.host.platform,
+            "acceleratorType": self.host.accelerator_type,
+            "topology": self.host.topology,
+            "profile": self.spec.profile,
+            "placement": self.spec.placement,
+            "parentChip": (
+                self.spec.parent_chip if self.spec.is_core_level else -1
+            ),
+            "workerId": self.host.worker_id,
+            "dynamic": self.dynamic,
+        }
+
+    def capacities(self) -> dict:
+        return {"hbmBytes": self.hbm_bytes, "tensorCores": self.cores}
+
+
+@dataclass(frozen=True)
+class PassthroughInfo:
+    """A chip surfaced for vfio passthrough (VfioDeviceInfo analog)."""
+
+    chip: TpuChip
+    host: TpuHostInfo
+    iommu_group: int = -1
+
+    @property
+    def canonical_name(self) -> str:
+        return f"{chip_name(self.chip.index)}-passthrough"
+
+    def attributes(self) -> dict:
+        return {
+            "uuid": self.chip.uuid,
+            "platform": self.host.platform,
+            "pciBdf": self.chip.pci_bdf,
+            "iommuGroup": self.iommu_group,
+            "passthrough": True,
+        }
+
+    def capacities(self) -> dict:
+        return {"hbmBytes": self.host.hbm_bytes_per_chip}
+
+
+@dataclass
+class AllocatableDevice:
+    """Tagged union over everything this node can allocate
+    (allocatable.go:48)."""
+
+    kind: DeviceKind
+    chip: ChipInfo | None = None
+    subslice: SubSliceInfo | None = None
+    passthrough: PassthroughInfo | None = None
+    # DRA device taints currently applied (health events -> taints).
+    taints: list[dict] = field(default_factory=list)
+
+    @property
+    def canonical_name(self) -> str:
+        return self._info.canonical_name
+
+    @property
+    def _info(self):
+        return self.chip or self.subslice or self.passthrough
+
+    def to_dra_device(self) -> dict:
+        """-> a resource.k8s.io Device entry for a ResourceSlice."""
+        info = self._info
+        attrs = {}
+        for key, val in info.attributes().items():
+            if isinstance(val, bool):
+                attrs[key] = {"bool": val}
+            elif isinstance(val, int):
+                attrs[key] = {"int": val}
+            else:
+                attrs[key] = {"string": str(val)}
+        caps = {
+            key: {"value": str(val)} for key, val in info.capacities().items()
+        }
+        dev: dict = {
+            "name": self.canonical_name,
+            "attributes": attrs,
+            "capacity": caps,
+        }
+        if self.taints:
+            dev["taints"] = list(self.taints)
+        return dev
+
+
+# chip index -> {canonical name -> AllocatableDevice}; mirrors
+# PerGPUAllocatableDevices (allocatable.go:43). Host-scoped (multi-chip)
+# sub-slices key under their lowest chip index.
+PerChipAllocatableDevices = dict[int, dict[str, AllocatableDevice]]

@@ -1,0 +1,154 @@
+"""Sharing managers: time-slicing and multi-tenant co-tenancy.
+
+Reference: cmd/gpu-kubelet-plugin/sharing.go -- TimeSlicingManager sets
+the per-GPU compute timeslice via nvidia-smi (:135); MpsManager runs a
+per-claim MPS control-daemon Deployment and points workloads at its pipe
+dir via CDI edits (:214-379).
+
+TPU translation: there is no per-chip preemption ioctl exposed by libtpu;
+temporal sharing on TPU is cooperative multi-process scheduling, which
+the runtime activates from environment + a shared coordination directory.
+So:
+- TimeSlicingManager records the chip's policy in a node-local policy
+  file (the admin surface an actual scheduler daemon consumes) and emits
+  the env contract for workloads.
+- MultiTenancyManager provisions a per-claim tenancy directory (shm-like
+  rendezvous the co-tenant processes share, the MPS-pipe-dir analog),
+  enforces max-client/HBM limits via env, and cleans up on unprepare.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+from ..api.configs import MultiTenancyConfig, TimeSlicingConfig
+from .cdi import ContainerEdits
+
+# Interval name -> microseconds budget per tenant timeslice.
+_INTERVALS_US = {
+    "Default": 5000,
+    "Short": 1000,
+    "Medium": 5000,
+    "Long": 20000,
+}
+
+
+class TimeSlicingManager:
+    """Per-chip temporal-sharing policy (TimeSlicingManager analog).
+
+    Policies are holder-counted: a chip can be shared by several claims
+    (disjoint core-level carve-outs), so the policy file persists until
+    the last holding claim releases it.
+    """
+
+    def __init__(self, policy_root: str):
+        self._root = os.path.join(policy_root, "timeslice")
+        os.makedirs(self._root, exist_ok=True)
+
+    def _path(self, chip_index: int) -> str:
+        return os.path.join(self._root, f"chip-{chip_index}.json")
+
+    def _load(self, chip_index: int) -> dict | None:
+        try:
+            with open(self._path(chip_index), encoding="utf-8") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def set_time_slice(
+        self, claim_uid: str, chip_indices: list[int], cfg: TimeSlicingConfig
+    ) -> ContainerEdits:
+        interval_us = _INTERVALS_US[cfg.interval]
+        for idx in chip_indices:
+            doc = self._load(idx) or {"holders": {}}
+            doc["interval"] = cfg.interval  # last setter wins
+            doc["intervalUs"] = interval_us
+            doc.setdefault("holders", {})[claim_uid] = cfg.interval
+            with open(self._path(idx), "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+        return ContainerEdits(
+            env=[
+                f"TPU_TIMESLICE_INTERVAL_US={interval_us}",
+                "TPU_PROCESS_SHARING=cooperative",
+            ]
+        )
+
+    def release(self, claim_uid: str, chip_indices: list[int]) -> None:
+        """Drop this claim's hold; the policy file disappears only when no
+        other claim still shares the chip."""
+        for idx in chip_indices:
+            doc = self._load(idx)
+            if doc is None:
+                continue
+            doc.get("holders", {}).pop(claim_uid, None)
+            if doc.get("holders"):
+                with open(self._path(idx), "w", encoding="utf-8") as f:
+                    json.dump(doc, f)
+            else:
+                try:
+                    os.unlink(self._path(idx))
+                except FileNotFoundError:
+                    pass
+
+    def current(self, chip_index: int) -> dict | None:
+        return self._load(chip_index)
+
+
+class MultiTenancyManager:
+    """Per-claim co-tenancy rendezvous (MpsManager/MpsControlDaemon
+    analog, sharing.go:214-379)."""
+
+    def __init__(self, tenancy_root: str):
+        self._root = os.path.join(tenancy_root, "tenancy")
+        os.makedirs(self._root, exist_ok=True)
+
+    def _dir(self, claim_uid: str, request: str | None = None) -> str:
+        d = os.path.join(self._root, claim_uid)
+        return os.path.join(d, request) if request else d
+
+    def start(
+        self,
+        claim_uid: str,
+        request: str,
+        chip_indices: list[int],
+        cfg: MultiTenancyConfig,
+        device_names: list[str],
+    ) -> ContainerEdits:
+        """Provision the per-request tenancy dir + emit workload env/mount
+        edits. One call per request group covers all its devices."""
+        d = self._dir(claim_uid, request)
+        os.makedirs(d, exist_ok=True)
+        manifest = {
+            "chips": chip_indices,
+            "maxClients": cfg.max_clients,
+            "hbmLimits": {
+                name: cfg.hbm_limit_bytes_for(name) for name in device_names
+            },
+        }
+        with open(os.path.join(d, "tenancy.json"), "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+        env = [
+            "TPU_MULTI_TENANT=1",
+            f"TPU_TENANCY_DIR=/var/run/tpu-tenancy/{claim_uid}/{request}",
+        ]
+        if cfg.max_clients is not None:
+            env.append(f"TPU_MAX_TENANTS={cfg.max_clients}")
+        limits = [
+            str(v) for v in manifest["hbmLimits"].values() if v is not None
+        ]
+        if limits:
+            # Uniform per-group limit contract; per-device granularity
+            # rides the manifest mount.
+            env.append(f"TPU_HBM_LIMIT_BYTES={min(map(int, limits))}")
+        return ContainerEdits(
+            env=env,
+            mounts=[(d, f"/var/run/tpu-tenancy/{claim_uid}/{request}")],
+        )
+
+    def stop(self, claim_uid: str) -> None:
+        shutil.rmtree(self._dir(claim_uid), ignore_errors=True)
+
+    def active(self, claim_uid: str) -> bool:
+        return os.path.isdir(self._dir(claim_uid))

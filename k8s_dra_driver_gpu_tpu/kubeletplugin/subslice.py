@@ -1,0 +1,179 @@
+"""Sub-slice naming: spec tuples vs live tuples, canonical names.
+
+Reference: cmd/gpu-kubelet-plugin/mig.go -- MigSpecTuple (abstract:
+parent/placement/profile, :37) vs MigLiveTuple (concrete GIID/CIID/UUID,
+:68), canonical-name regex parsers (:189,:236).
+
+TPU canonical names:
+    chip-<index>                          a whole chip
+    chip-<index>-ss-<profile>-<placement> a sub-slice carve-out, e.g.
+                                          chip-0-ss-1c-1 (TensorCore 1 of
+                                          chip 0) or host-level block
+                                          ss-<profile>-<placement> for
+                                          multi-chip carve-outs, e.g.
+                                          ss-2x1x1-2 (chips 2,3).
+
+Chip-level profiles ("1c") nest under their parent chip; multi-chip
+profiles are host-scoped (a carve-out spans chips, so no single parent).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from ..tpulib.binding import SubSliceProfile, TpuHostInfo
+
+_CHIP_RE = re.compile(r"^chip-(\d+)$")
+_CHIP_SS_RE = re.compile(r"^chip-(\d+)-ss-([a-z0-9]+)-(\d+)$")
+_HOST_SS_RE = re.compile(r"^ss-(\d+x\d+(?:x\d+)?)-(\d+)$")
+
+
+def chip_name(index: int) -> str:
+    return f"chip-{index}"
+
+
+def parse_chip_name(name: str) -> int | None:
+    m = _CHIP_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+@dataclass(frozen=True)
+class SubSliceSpecTuple:
+    """Abstract identity of a carve-out: profile + placement (+ parent
+    chip for core-level profiles). Mirrors MigSpecTuple (mig.go:37)."""
+
+    profile: str  # "1c" or a chip-grid shape like "2x1x1"
+    placement: int  # core index (core-level) or start chip index
+    parent_chip: int | None = None  # set for core-level profiles only
+
+    @property
+    def is_core_level(self) -> bool:
+        return self.parent_chip is not None
+
+    def canonical_name(self) -> str:
+        if self.is_core_level:
+            return f"chip-{self.parent_chip}-ss-{self.profile}-{self.placement}"
+        return f"ss-{self.profile}-{self.placement}"
+
+    @classmethod
+    def from_canonical_name(cls, name: str) -> "SubSliceSpecTuple | None":
+        m = _CHIP_SS_RE.match(name)
+        if m:
+            return cls(
+                profile=m.group(2),
+                placement=int(m.group(3)),
+                parent_chip=int(m.group(1)),
+            )
+        m = _HOST_SS_RE.match(name)
+        if m:
+            return cls(profile=m.group(1), placement=int(m.group(2)))
+        return None
+
+    def chip_indices(self, host: TpuHostInfo) -> tuple[int, ...]:
+        """Which chips this carve-out occupies."""
+        if self.is_core_level:
+            return (self.parent_chip,)
+        dims = [int(d) for d in self.profile.split("x")]
+        while len(dims) < 3:
+            dims.append(1)
+        w, h, d = dims
+        hx, hy, _ = _host_grid(host)
+        sx = self.placement % hx
+        sy = (self.placement // hx) % hy
+        sz = self.placement // (hx * hy)
+        return tuple(
+            ((sz + dz) * hy + (sy + dy)) * hx + (sx + dx)
+            for dz in range(d)
+            for dy in range(h)
+            for dx in range(w)
+        )
+
+    def core_indices(self, host: TpuHostInfo) -> tuple[int, ...]:
+        """Which cores (host-global core index) this carve-out occupies."""
+        if self.is_core_level:
+            return (self.parent_chip * host.cores_per_chip + self.placement
+                    % host.cores_per_chip,)
+        return tuple(
+            c * host.cores_per_chip + k
+            for c in self.chip_indices(host)
+            for k in range(host.cores_per_chip)
+        )
+
+
+@dataclass(frozen=True)
+class SubSliceLiveTuple:
+    """A realized carve-out (what the runtime actually allocated).
+
+    Mirrors MigLiveTuple (mig.go:68): spec + the concrete identity the
+    device layer handed back (uuid; on TPU there is no GI/CI handle --
+    the carve-out is realized by bounds env/devices at container start).
+    """
+
+    spec: SubSliceSpecTuple
+    uuid: str
+
+    def to_dict(self) -> dict:
+        return {
+            "profile": self.spec.profile,
+            "placement": self.spec.placement,
+            "parentChip": self.spec.parent_chip,
+            "uuid": self.uuid,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SubSliceLiveTuple":
+        return cls(
+            spec=SubSliceSpecTuple(
+                profile=d["profile"],
+                placement=d["placement"],
+                parent_chip=d.get("parentChip"),
+            ),
+            uuid=d["uuid"],
+        )
+
+
+def _host_grid(host: TpuHostInfo) -> tuple[int, int, int]:
+    """The local chip grid of this host (reduced when the host owns fewer
+    chips than a full block), matching tpulib's placement indexing.
+
+    Delegates to the tpulib backend's own grid helpers so placement
+    decode here can never diverge from tpulib's encode."""
+    from ..tpulib.binding import (  # noqa: PLC0415 - avoid import cycle
+        _GENERATIONS,
+        _host_shape,
+        _slice_shape,
+    )
+
+    n = len(host.chips) or host.chips_per_host
+    gen = _GENERATIONS.get(host.platform)
+    if gen is None:
+        return (1, n, 1)
+    grid = _host_shape(gen)
+    if n < grid[0] * grid[1] * grid[2]:
+        grid = _slice_shape(gen, n)
+    return grid
+
+
+def enumerate_subslice_devices(
+    host: TpuHostInfo, profiles: tuple[SubSliceProfile, ...]
+) -> list[SubSliceSpecTuple]:
+    """All possible carve-outs on this host (profile x placement),
+    mirroring inspectMigProfilesAndPlacements (nvlib.go:1202)."""
+    out: list[SubSliceSpecTuple] = []
+    for prof in profiles:
+        if prof.is_core_level:
+            for placement in prof.placements:
+                chip = placement // host.cores_per_chip
+                core = placement % host.cores_per_chip
+                out.append(
+                    SubSliceSpecTuple(
+                        profile=prof.name, placement=core, parent_chip=chip
+                    )
+                )
+        else:
+            for placement in prof.placements:
+                out.append(
+                    SubSliceSpecTuple(profile=prof.name, placement=placement)
+                )
+    return out

@@ -39,10 +39,13 @@ class MeshPlan:
         return self.dp * self.fsdp * self.tp * self.sp
 
     def axis_names(self) -> tuple[str, ...]:
-        return (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, SEQUENCE_AXIS)
+        # tp is the innermost (fastest-varying) axis so tensor-parallel
+        # collectives -- the most communication-intensive -- land on
+        # ICI-adjacent chips; sp sits just outside it.
+        return (DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
 
     def shape(self) -> tuple[int, ...]:
-        return (self.dp, self.fsdp, self.tp, self.sp)
+        return (self.dp, self.fsdp, self.sp, self.tp)
 
 
 def _factor(n: int, max_tp: int) -> MeshPlan:

@@ -358,7 +358,7 @@ class PyTpuLib:
             host_s = _slice_shape(g, len(indices))
         worker = _atoi(os.environ.get("TPU_WORKER_ID", "0") or "0")
         chip_list = []
-        for idx in indices:
+        for pos, idx in enumerate(indices):
             sysdev = f"{sys_root}/class/accel/accel{idx}/device"
             numa_node = -1
             try:
@@ -376,7 +376,10 @@ class PyTpuLib:
                     index=idx,
                     uuid=f"tpu-{g.name}-w{worker}-c{idx}",
                     devpath=f"{dev_root}/accel{idx}",
-                    ici_coords=_chip_coords(slice_s, host_s, worker, idx),
+                    # Position in the sorted device list, not the raw accel
+                    # index: sparse indices (failed chip) must still map
+                    # inside the (possibly reduced) host grid.
+                    ici_coords=_chip_coords(slice_s, host_s, worker, pos),
                     numa_node=numa_node,
                     pci_bdf=pci_bdf,
                 )

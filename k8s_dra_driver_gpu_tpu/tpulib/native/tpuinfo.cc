@@ -326,7 +326,8 @@ HostInfo DevfsEnumerate(const std::map<std::string, std::string>& opts) {
   if (!indices.empty() && static_cast<int>(indices.size()) < host.count()) {
     host = SliceShape(*h.gen, static_cast<int>(indices.size()));
   }
-  for (int idx : indices) {
+  for (size_t pos = 0; pos < indices.size(); pos++) {
+    int idx = indices[pos];
     Chip c;
     c.index = idx;
     c.devpath = dev_root + "/accel" + std::to_string(idx);
@@ -345,7 +346,9 @@ HostInfo DevfsEnumerate(const std::map<std::string, std::string>& opts) {
     }
     c.uuid = "tpu-" + std::string(h.gen->name) + "-w" +
              std::to_string(h.worker_id) + "-c" + std::to_string(idx);
-    ChipCoords(h.slice, host, h.worker_id, idx, c.coords);
+    // Position in the sorted device list, not the raw accel index:
+    // sparse indices (failed chip) must still map inside the grid.
+    ChipCoords(h.slice, host, h.worker_id, static_cast<int>(pos), c.coords);
     h.chips.push_back(c);
   }
   return h;

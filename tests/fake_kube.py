@@ -1,0 +1,62 @@
+"""Test helpers: fabricate k8s objects (claims, etc.) as plain dicts."""
+
+from __future__ import annotations
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin import DRIVER_NAME
+from k8s_dra_driver_gpu_tpu.kubeletplugin.claim import ResourceClaim
+
+
+def make_claim_dict(
+    uid: str,
+    devices: list[str],
+    namespace: str = "default",
+    name: str | None = None,
+    configs: list[dict] | None = None,
+    request: str = "tpu",
+    driver: str = DRIVER_NAME,
+) -> dict:
+    """A resource.k8s.io/v1 ResourceClaim with an allocation for
+    ``devices`` (canonical names) and optional opaque config entries:
+    each config: {"parameters": {...}, "requests": [...], "source": ...}.
+    """
+    return {
+        "metadata": {"uid": uid, "namespace": namespace, "name": name or uid},
+        "status": {
+            "allocation": {
+                "devices": {
+                    "results": [
+                        {
+                            "request": request,
+                            "driver": driver,
+                            "pool": "node",
+                            "device": d,
+                        }
+                        for d in devices
+                    ],
+                    "config": [
+                        {
+                            "opaque": {
+                                "driver": driver,
+                                "parameters": c["parameters"],
+                            },
+                            "requests": c.get("requests", []),
+                            "source": c.get("source", "FromClaim"),
+                        }
+                        for c in (configs or [])
+                    ],
+                }
+            }
+        },
+    }
+
+
+def make_claim(uid: str, devices: list[str], **kw) -> ResourceClaim:
+    return ResourceClaim.from_dict(make_claim_dict(uid, devices, **kw))
+
+
+def opaque(kind: str, **fields) -> dict:
+    return {
+        "apiVersion": "resource.tpu.dra/v1beta1",
+        "kind": kind,
+        **fields,
+    }

@@ -1,0 +1,212 @@
+"""API group tests: config normalize/validate matrix, decoders, CR types.
+
+Modeled on the reference's api tests (api/.../sharing_test.go MPS
+memory-limit normalization; cmd/webhook/main_test.go decode matrix).
+"""
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.api import (
+    AllocationMode,
+    ComputeDomain,
+    ComputeDomainChannelConfig,
+    ComputeDomainClique,
+    ComputeDomainDaemonConfig,
+    ComputeDomainNode,
+    DecodeError,
+    MultiTenancyConfig,
+    PassthroughConfig,
+    Sharing,
+    SubSliceConfig,
+    TimeSlicingConfig,
+    TpuConfig,
+    ValidationError,
+    nonstrict_decode,
+    strict_decode,
+)
+from k8s_dra_driver_gpu_tpu.api.decode import encode_config
+
+
+def params(kind: str, **fields) -> dict:
+    return {"apiVersion": "resource.tpu.dra/v1beta1", "kind": kind, **fields}
+
+
+class TestSharing:
+    def test_default_normalizes_to_time_slicing(self):
+        s = Sharing()
+        s.normalize()
+        s.validate()
+        assert s.is_time_slicing
+        assert s.time_slicing.interval == "Default"
+
+    def test_bad_interval(self):
+        s = Sharing(time_slicing=TimeSlicingConfig(interval="Turbo"))
+        s.normalize()
+        with pytest.raises(ValidationError):
+            s.validate()
+
+    def test_strategy_member_mismatch(self):
+        s = Sharing(strategy="TimeSlicing",
+                    multi_tenancy=MultiTenancyConfig())
+        with pytest.raises(ValidationError):
+            s.validate()
+        s = Sharing(strategy="MultiTenancy",
+                    time_slicing=TimeSlicingConfig())
+        with pytest.raises(ValidationError):
+            s.validate()
+
+    def test_multi_tenancy_requires_config(self):
+        s = Sharing(strategy="MultiTenancy")
+        with pytest.raises(ValidationError):
+            s.validate()
+
+
+class TestMultiTenancy:
+    def test_hbm_limit_normalization(self):
+        # The default limit folds into the per-device map (reference
+        # sharing.go:190-220 normalization).
+        mt = MultiTenancyConfig(hbm_limit="8Gi",
+                                per_device_hbm_limits={"chip-1": "4Gi"})
+        mt.normalize()
+        mt.validate()
+        assert mt.hbm_limit_bytes_for("chip-1") == 4 << 30
+        assert mt.hbm_limit_bytes_for("chip-0") == 8 << 30
+
+    def test_explicit_wildcard_wins_over_default(self):
+        mt = MultiTenancyConfig(hbm_limit="8Gi",
+                                per_device_hbm_limits={"*": "2Gi"})
+        mt.normalize()
+        assert mt.hbm_limit_bytes_for("chip-0") == 2 << 30
+
+    def test_invalid_limits(self):
+        for bad in ("8G", "-4Gi", "lots"):
+            mt = MultiTenancyConfig(hbm_limit=bad)
+            mt.normalize()
+            with pytest.raises(ValidationError):
+                mt.validate()
+        # Empty string means unset, not invalid.
+        mt = MultiTenancyConfig(hbm_limit="")
+        mt.normalize()
+        mt.validate()
+
+    def test_max_clients(self):
+        mt = MultiTenancyConfig(max_clients=0)
+        with pytest.raises(ValidationError):
+            mt.validate()
+
+    def test_no_limit_returns_none(self):
+        mt = MultiTenancyConfig()
+        mt.normalize()
+        assert mt.hbm_limit_bytes_for("chip-0") is None
+
+
+class TestConfigs:
+    def test_tpu_config_default(self):
+        c = TpuConfig()
+        c.normalize()
+        c.validate()
+        assert c.sharing.is_time_slicing
+
+    def test_passthrough_modes(self):
+        c = PassthroughConfig(iommu_mode="iommufd")
+        c.normalize()
+        c.validate()
+        c = PassthroughConfig(iommu_mode="weird")
+        with pytest.raises(ValidationError):
+            c.validate()
+
+    def test_channel_config(self):
+        c = ComputeDomainChannelConfig(domain_id="abc")
+        c.normalize()
+        c.validate()
+        assert c.allocation_mode == AllocationMode.SINGLE.value
+        with pytest.raises(ValidationError):
+            ComputeDomainChannelConfig(domain_id="").validate()
+        bad = ComputeDomainChannelConfig(domain_id="abc",
+                                         allocation_mode="Some")
+        with pytest.raises(ValidationError):
+            bad.validate()
+
+    def test_daemon_config(self):
+        with pytest.raises(ValidationError):
+            ComputeDomainDaemonConfig().validate()
+
+
+class TestDecoders:
+    def test_roundtrip_tpu_config(self):
+        p = params("TpuConfig", sharing={
+            "strategy": "MultiTenancy",
+            "multiTenancy": {"maxClients": 4, "hbmLimit": "8Gi"},
+        })
+        cfg = strict_decode(p)
+        assert isinstance(cfg, TpuConfig)
+        assert cfg.sharing.multi_tenancy.max_clients == 4
+        cfg.normalize()
+        cfg.validate()
+        enc = encode_config(cfg)
+        assert enc["kind"] == "TpuConfig"
+        cfg2 = strict_decode(enc)
+        assert cfg2.sharing.multi_tenancy.max_clients == 4
+
+    def test_strict_rejects_unknown_fields(self):
+        p = params("TpuConfig", sharing={"strategy": "TimeSlicing"},
+                   bogus=True)
+        with pytest.raises(DecodeError):
+            strict_decode(p)
+        # Nested unknown field too.
+        p = params("TpuConfig",
+                   sharing={"strategy": "TimeSlicing", "zzz": 1})
+        with pytest.raises(DecodeError):
+            strict_decode(p)
+
+    def test_nonstrict_tolerates_unknown_fields(self):
+        p = params("SubSliceConfig", sharing={"strategy": "TimeSlicing"},
+                   futureField={"a": 1})
+        cfg = nonstrict_decode(p)
+        assert isinstance(cfg, SubSliceConfig)
+
+    def test_wrong_api_version(self):
+        with pytest.raises(DecodeError):
+            strict_decode({"apiVersion": "v1", "kind": "TpuConfig"})
+
+    def test_unknown_kind(self):
+        with pytest.raises(DecodeError):
+            strict_decode(params("GpuConfig"))
+
+    def test_channel_decode(self):
+        cfg = strict_decode(params(
+            "ComputeDomainChannelConfig",
+            domainID="uid-1", allocationMode="All"))
+        assert cfg.domain_id == "uid-1"
+        assert cfg.allocation_mode == "All"
+
+    def test_type_error_surfaces_as_decode_error(self):
+        with pytest.raises(DecodeError):
+            strict_decode(params("TpuConfig", sharing=[1, 2]))
+
+
+class TestComputeDomainCR:
+    def test_roundtrip(self):
+        cd = ComputeDomain(
+            name="cd1", namespace="team-a", uid="u-1", num_nodes=4,
+            topology="2x2x4",
+            channel_resource_claim_template="cd1-channel",
+            nodes=[ComputeDomainNode(name="n0", ip_address="10.0.0.1",
+                                     clique_id="0", index=0,
+                                     status="Ready")],
+        )
+        d = cd.to_dict()
+        cd2 = ComputeDomain.from_dict(d)
+        assert cd2 == cd
+
+    def test_clique_roundtrip(self):
+        cq = ComputeDomainClique(
+            name="u-1.0", compute_domain_uid="u-1", clique_id="0",
+            daemons=[ComputeDomainNode(name="n0", index=0)],
+        )
+        assert ComputeDomainClique.from_dict(cq.to_dict()) == cq
+
+    def test_from_empty_dict(self):
+        cd = ComputeDomain.from_dict({})
+        assert cd.status == "NotReady"
+        assert cd.nodes == []

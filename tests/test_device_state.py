@@ -1,0 +1,333 @@
+"""DeviceState prepare/unprepare state-machine tests.
+
+Modeled on the reference's device_state_test.go (569 LoC driving the
+Prepare/Unprepare state machine without NVML or kubelet) -- here with
+the mock tpulib backend and a tmpdir state root.
+"""
+
+import json
+import os
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import ClaimState
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+    Config,
+    DeviceState,
+    PrepareError,
+)
+from tests.fake_kube import make_claim, opaque
+
+
+@pytest.fixture()
+def state(tmp_root):
+    return DeviceState(Config.mock(root=tmp_root, topology="v5e-4"))
+
+
+@pytest.fixture()
+def v5p_state(tmp_root):
+    return DeviceState(Config.mock(root=tmp_root, topology="v5p-8"))
+
+
+class TestEnumeration:
+    def test_chips_published(self, state):
+        names = set(state.allocatable)
+        assert {"chip-0", "chip-1", "chip-2", "chip-3"} <= names
+
+    def test_dynamic_subslices_published(self, v5p_state):
+        names = set(v5p_state.allocatable)
+        # Core-level carve-outs on megacore chips + chip-block carve-outs.
+        assert "chip-0-ss-1c-0" in names
+        assert "chip-0-ss-1c-1" in names
+        assert "ss-2x1x1-0" in names
+        assert "ss-2x2x1-0" in names
+
+    def test_dra_device_shape(self, state):
+        dev = state.allocatable["chip-0"].to_dra_device()
+        assert dev["name"] == "chip-0"
+        assert dev["attributes"]["platform"] == {"string": "v5e"}
+        assert dev["attributes"]["iciX"] == {"int": 0}
+        assert dev["capacity"]["hbmBytes"] == {"value": str(16 << 30)}
+
+
+class TestPrepare:
+    def test_prepare_whole_host(self, state):
+        claim = make_claim("c1", ["chip-0", "chip-1", "chip-2", "chip-3"])
+        ids = state.prepare(claim)
+        assert len(ids) == 4
+        assert all(i.startswith("k8s.tpu.dra.dev/claim=") for i in ids)
+        spec = state._cdi.read_spec("c1")
+        env = spec["containerEdits"]["env"]
+        assert "TPU_VISIBLE_DEVICES=0,1,2,3" in env
+        assert "TPU_SKIP_MDS_QUERY=1" in env
+        cp = state.prepared_claims()
+        assert cp["c1"].state == ClaimState.PREPARE_COMPLETED.value
+
+    def test_prepare_idempotent(self, state):
+        claim = make_claim("c1", ["chip-0"])
+        ids1 = state.prepare(claim)
+        ids2 = state.prepare(claim)
+        assert ids1 == ids2
+
+    def test_unknown_device_rejected(self, state):
+        with pytest.raises(PrepareError):
+            state.prepare(make_claim("c1", ["chip-9"]))
+        # Failed prepare leaves no checkpoint residue.
+        assert "c1" not in state.prepared_claims()
+
+    def test_overlap_rejected(self, state):
+        state.prepare(make_claim("c1", ["chip-0"]))
+        with pytest.raises(PrepareError):
+            state.prepare(make_claim("c2", ["chip-0"]))
+        # Other chips still preparable.
+        state.prepare(make_claim("c3", ["chip-1"]))
+
+    def test_subslice_overlap_with_chip_rejected(self, v5p_state):
+        v5p_state.prepare(make_claim("c1", ["ss-2x1x1-0"]))  # chips 0,1
+        with pytest.raises(PrepareError):
+            v5p_state.prepare(make_claim("c2", ["chip-0"]))
+        v5p_state.prepare(make_claim("c3", ["chip-2"]))
+
+    def test_core_level_subslices_disjoint(self, v5p_state):
+        # Two TensorCore halves of the same chip can serve two claims.
+        v5p_state.prepare(make_claim("c1", ["chip-0-ss-1c-0"]))
+        v5p_state.prepare(make_claim("c2", ["chip-0-ss-1c-1"]))
+        with pytest.raises(PrepareError):
+            v5p_state.prepare(make_claim("c3", ["chip-0-ss-1c-0"]))
+        with pytest.raises(PrepareError):
+            v5p_state.prepare(make_claim("c4", ["chip-0"]))
+
+    def test_dynamic_subslice_lifecycle(self, v5p_state):
+        claim = make_claim("c1", ["ss-2x1x1-0"])
+        v5p_state.prepare(claim)
+        reg = v5p_state._registry.list()
+        assert len(reg) == 1
+        live = next(iter(reg.values()))
+        assert live["profile"] == "2x1x1"
+        v5p_state.unprepare("c1")
+        assert v5p_state._registry.list() == {}
+
+    def test_subslice_env_contract(self, v5p_state):
+        v5p_state.prepare(make_claim("c1", ["chip-1-ss-1c-1"]))
+        spec = v5p_state._cdi.read_spec("c1")
+        dev_env = spec["devices"][0]["containerEdits"]["env"]
+        assert "TPU_CORE_BOUNDS=1" in dev_env
+        assert "TPU_MEGACORE=disabled" in dev_env
+
+    def test_sharing_timeslicing_config(self, state):
+        cfgs = [{
+            "parameters": opaque("TpuConfig", sharing={
+                "strategy": "TimeSlicing",
+                "timeSlicing": {"interval": "Short"},
+            }),
+        }]
+        state.prepare(make_claim("c1", ["chip-0"], configs=cfgs))
+        assert state._timeslicing.current(0)["interval"] == "Short"
+        spec = state._cdi.read_spec("c1")
+        assert "TPU_TIMESLICE_INTERVAL_US=1000" in spec["containerEdits"]["env"]
+        state.unprepare("c1")
+        assert state._timeslicing.current(0) is None
+
+    def test_timeslice_survives_cotenant_unprepare(self, v5p_state):
+        # Two claims share chip-0 via disjoint TensorCore halves; the
+        # chip policy must outlive the first unprepare.
+        ts = {"parameters": opaque("SubSliceConfig", sharing={
+            "strategy": "TimeSlicing", "timeSlicing": {"interval": "Short"},
+        })}
+        v5p_state.prepare(make_claim("c1", ["chip-0-ss-1c-0"], configs=[ts]))
+        v5p_state.prepare(make_claim("c2", ["chip-0-ss-1c-1"], configs=[ts]))
+        v5p_state.unprepare("c1")
+        assert v5p_state._timeslicing.current(0)["interval"] == "Short"
+        v5p_state.unprepare("c2")
+        assert v5p_state._timeslicing.current(0) is None
+
+    def test_multi_tenancy_manifest_covers_all_devices(self, state):
+        cfgs = [{
+            "parameters": opaque("TpuConfig", sharing={
+                "strategy": "MultiTenancy",
+                "multiTenancy": {"hbmLimit": "4Gi"},
+            }),
+        }]
+        state.prepare(make_claim("c1", ["chip-0", "chip-1"], configs=cfgs))
+        import json as _json
+        d = state._tenancy._dir("c1", "tpu")
+        with open(f"{d}/tenancy.json") as f:
+            manifest = _json.load(f)
+        assert manifest["chips"] == [0, 1]
+        assert set(manifest["hbmLimits"]) == {"chip-0", "chip-1"}
+        # The tenancy mount appears exactly once in the claim spec.
+        spec = state._cdi.read_spec("c1")
+        mounts = spec["containerEdits"].get("mounts", [])
+        assert len(mounts) == 1
+
+    def test_sharing_multi_tenancy(self, state):
+        cfgs = [{
+            "parameters": opaque("TpuConfig", sharing={
+                "strategy": "MultiTenancy",
+                "multiTenancy": {"maxClients": 2, "hbmLimit": "4Gi"},
+            }),
+        }]
+        state.prepare(make_claim("c1", ["chip-0"], configs=cfgs))
+        assert state._tenancy.active("c1")
+        spec = state._cdi.read_spec("c1")
+        env = spec["containerEdits"]["env"]
+        assert "TPU_MULTI_TENANT=1" in env
+        assert "TPU_MAX_TENANTS=2" in env
+        assert f"TPU_HBM_LIMIT_BYTES={4 << 30}" in env
+        state.unprepare("c1")
+        assert not state._tenancy.active("c1")
+
+    def test_config_precedence_claim_over_class(self, state):
+        cfgs = [
+            {
+                "parameters": opaque("TpuConfig", sharing={
+                    "strategy": "TimeSlicing",
+                    "timeSlicing": {"interval": "Long"},
+                }),
+                "source": "FromClass",
+            },
+            {
+                "parameters": opaque("TpuConfig", sharing={
+                    "strategy": "TimeSlicing",
+                    "timeSlicing": {"interval": "Short"},
+                }),
+                "source": "FromClaim",
+            },
+        ]
+        state.prepare(make_claim("c1", ["chip-0"], configs=cfgs))
+        assert state._timeslicing.current(0)["interval"] == "Short"
+
+    def test_config_kind_mismatch(self, v5p_state):
+        cfgs = [{"parameters": opaque("SubSliceConfig")}]
+        with pytest.raises(PrepareError):
+            v5p_state.prepare(make_claim("c1", ["chip-0"], configs=cfgs))
+
+    def test_gate_disabled_rejects_timeslice_setting(self, tmp_root):
+        st = DeviceState(
+            Config.mock(root=os.path.join(tmp_root, "x"), gates="")
+        )
+        cfgs = [{
+            "parameters": opaque("TpuConfig", sharing={
+                "strategy": "TimeSlicing",
+                "timeSlicing": {"interval": "Short"},
+            }),
+        }]
+        with pytest.raises(PrepareError):
+            st.prepare(make_claim("c1", ["chip-0"], configs=cfgs))
+
+
+class TestUnprepare:
+    def test_unprepare_noop_when_missing(self, state):
+        state.unprepare("never-prepared")
+
+    def test_unprepare_removes_cdi_and_checkpoint(self, state):
+        claim = make_claim("c1", ["chip-0"])
+        state.prepare(claim)
+        assert state._cdi.spec_exists("c1")
+        state.unprepare("c1")
+        assert not state._cdi.spec_exists("c1")
+        assert "c1" not in state.prepared_claims()
+        # Chip free again.
+        state.prepare(make_claim("c2", ["chip-0"]))
+
+
+class TestCrashRecovery:
+    def test_stale_prepare_started_rolled_back_on_retry(self, tmp_root):
+        state = DeviceState(Config.mock(root=tmp_root, topology="v5p-8"))
+        claim = make_claim("c1", ["ss-2x1x1-0"])
+        # Simulate a crash mid-prepare: PrepareStarted in the checkpoint,
+        # a live carve-out in the registry, no PrepareCompleted.
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import (
+            CheckpointedClaim,
+        )
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.subslice import (
+            SubSliceLiveTuple, SubSliceSpecTuple,
+        )
+        live = SubSliceLiveTuple(
+            spec=SubSliceSpecTuple(profile="2x1x1", placement=0),
+            uuid="tpu-ss-stale",
+        )
+        state._registry.create(live)
+        state._checkpoint.update(
+            lambda c: c.claims.__setitem__(
+                "c1",
+                CheckpointedClaim(uid="c1", state="PrepareStarted"),
+            )
+        )
+        # Retry: rolls back, then succeeds.
+        ids = state.prepare(claim)
+        assert len(ids) == 1
+        assert state.prepared_claims()["c1"].state == "PrepareCompleted"
+
+    def test_startup_reconciliation_destroys_unknown(self, tmp_root):
+        state = DeviceState(Config.mock(root=tmp_root, topology="v5p-8"))
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.subslice import (
+            SubSliceLiveTuple, SubSliceSpecTuple,
+        )
+        state._registry.create(SubSliceLiveTuple(
+            spec=SubSliceSpecTuple(profile="1c", placement=0, parent_chip=0),
+            uuid="tpu-ss-orphan",
+        ))
+        # A fresh DeviceState over the same root reconciles.
+        state2 = DeviceState(Config.mock(root=tmp_root, topology="v5p-8"))
+        assert state2._registry.list() == {}
+
+    def test_boot_id_invalidation(self, tmp_root):
+        cfg = Config.mock(root=tmp_root)
+        cfg.boot_id = "boot-1"
+        state = DeviceState(cfg)
+        state.prepare(make_claim("c1", ["chip-0"]))
+        assert "c1" in state.prepared_claims()
+        # Same root, new boot ID: checkpoint invalidated wholesale.
+        cfg2 = Config.mock(root=tmp_root)
+        cfg2.boot_id = "boot-2"
+        state2 = DeviceState(cfg2)
+        assert state2.prepared_claims() == {}
+        assert state2._checkpoint.invalidated_on_boot
+
+    def test_prepare_failure_mid_flight_rolls_back(self, v5p_state, monkeypatch):
+        # Fail CDI spec write after the carve-out was created.
+        def boom(*a, **kw):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(v5p_state._cdi, "create_claim_spec_file", boom)
+        with pytest.raises(OSError):
+            v5p_state.prepare(make_claim("c1", ["ss-2x1x1-0"]))
+        assert v5p_state._registry.list() == {}
+        assert "c1" not in v5p_state.prepared_claims()
+
+    def test_checkpoint_survives_restart(self, tmp_root):
+        cfg = Config.mock(root=tmp_root)
+        state = DeviceState(cfg)
+        ids = state.prepare(make_claim("c1", ["chip-0", "chip-1"]))
+        state2 = DeviceState(Config.mock(root=tmp_root))
+        assert state2.prepare(make_claim("c1", ["chip-0", "chip-1"])) == ids
+
+
+class TestCheckpointFile:
+    def test_corruption_detected(self, tmp_root):
+        state = DeviceState(Config.mock(root=tmp_root))
+        state.prepare(make_claim("c1", ["chip-0"]))
+        path = state._checkpoint.path
+        with open(path) as f:
+            doc = json.load(f)
+        doc["data"]["claims"]["c1"]["state"] = "Tampered"
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import (
+            CheckpointCorruptError,
+        )
+        with pytest.raises(CheckpointCorruptError):
+            state._checkpoint.get()
+
+    def test_v1_reader_accepts_v2_file(self, tmp_root):
+        # Downgrade path: a v1 reader verifies the v1 checksum over its
+        # projection of a v2 file (checkpoint.go:53-66).
+        state = DeviceState(Config.mock(root=tmp_root))
+        state.prepare(make_claim("c1", ["chip-0"]))
+        with open(state._checkpoint.path) as f:
+            doc = json.load(f)
+        doc["version"] = "v1"  # what an old binary would consider itself
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import Checkpoint
+        cp = Checkpoint.from_dict(doc)
+        assert "c1" in cp.claims

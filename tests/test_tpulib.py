@@ -87,6 +87,20 @@ class TestEnumerate:
         assert h.chips[2].devpath == str(dev / "accel2")
         assert h.chips[0].numa_node == 0
 
+    def test_devfs_sparse_indices_stay_in_grid(self, lib, tmp_path):
+        # accel1 missing (failed chip): remaining chips map by position,
+        # inside the reduced grid.
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        (dev / "accel0").touch()
+        (dev / "accel2").touch()
+        h = lib.enumerate(
+            EnumerateOptions(dev_root=str(dev), sys_root=str(tmp_path))
+        )
+        dims = h.topology_dims + (1,) * (3 - len(h.topology_dims))
+        for c in h.chips:
+            assert all(0 <= c.ici_coords[i] < dims[i] for i in range(3)), c
+
     def test_devfs_empty(self, lib, tmp_path):
         h = lib.enumerate(EnumerateOptions(dev_root=str(tmp_path)))
         assert h.source == "none"
