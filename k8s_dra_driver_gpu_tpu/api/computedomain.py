@@ -9,7 +9,7 @@ per tightly-coupled slice; cross-clique traffic rides DCN).
 
 from __future__ import annotations
 
-from dataclasses import asdict, dataclass, field
+from dataclasses import dataclass, field
 
 
 class ComputeDomainStatusValue:

@@ -34,7 +34,9 @@ DEFAULT_LIBTPU_PATH = "/usr/lib/libtpu.so"
 class ContainerEdits:
     env: list[str] = field(default_factory=list)
     device_nodes: list[str] = field(default_factory=list)
-    mounts: list[tuple[str, str]] = field(default_factory=list)  # host, ctr
+    # (hostPath, containerPath, read_only). Library mounts are ro; shared
+    # rendezvous dirs (tenancy) must stay writable.
+    mounts: list[tuple[str, str, bool]] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         out: dict = {}
@@ -47,9 +49,10 @@ class ContainerEdits:
                 {
                     "hostPath": h,
                     "containerPath": c,
-                    "options": ["ro", "nosuid", "nodev", "bind"],
+                    "options": (["ro"] if ro else ["rw"])
+                    + ["nosuid", "nodev", "bind"],
                 }
-                for h, c in self.mounts
+                for h, c, ro in self.mounts
             ]
         return out
 
@@ -93,7 +96,7 @@ class CDIHandler:
             ],
         )
         if os.path.exists(self._libtpu):
-            edits.mounts.append((self._libtpu, DEFAULT_LIBTPU_PATH))
+            edits.mounts.append((self._libtpu, DEFAULT_LIBTPU_PATH, True))
         return edits
 
     def create_claim_spec_file(

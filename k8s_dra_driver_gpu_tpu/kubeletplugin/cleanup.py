@@ -1,0 +1,85 @@
+"""Stale-claim GC: unprepare checkpointed claims the API server forgot.
+
+Reference: cmd/gpu-kubelet-plugin/cleanup.go -- CheckpointCleanupManager:
+every 10 minutes (:35) list checkpointed claims stuck in PrepareStarted
+(or whose ResourceClaim no longer exists), validate against the API
+server by namespace/name + UID (cheap Get, not List; :149-190), and
+unprepare the stale ones; single-slot queue (:233).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..pkg.kubeclient import NotFoundError
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_INTERVAL_S = 600.0  # reference: every 10 min
+
+
+class CheckpointCleanupManager:
+    def __init__(
+        self,
+        device_state,
+        kube_client,
+        interval: float = DEFAULT_INTERVAL_S,
+    ):
+        self._state = device_state
+        self._kube = kube_client
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="checkpoint-cleanup", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    def cleanup_once(self) -> list[str]:
+        """Returns the claim UIDs unprepared this pass."""
+        removed = []
+        for uid, claim in list(self._state.prepared_claims().items()):
+            if not self._is_stale(uid, claim):
+                continue
+            logger.warning(
+                "unpreparing stale claim %s (%s/%s)",
+                uid, claim.namespace, claim.name,
+            )
+            try:
+                self._state.unprepare(uid)
+                removed.append(uid)
+            except Exception:  # noqa: BLE001 - GC must survive
+                logger.exception("stale-claim unprepare failed for %s", uid)
+        return removed
+
+    def _is_stale(self, uid: str, claim) -> bool:
+        """A claim is stale when its API object is gone or has a
+        different UID (deleted + recreated under the same name)."""
+        if not claim.namespace or not claim.name:
+            # No identity recorded (crashed before v2 fields landed):
+            # only PrepareStarted leftovers are safe to reap.
+            return claim.state == "PrepareStarted"
+        try:
+            obj = self._kube.get(
+                "resource.k8s.io", "v1", "resourceclaims",
+                claim.name, namespace=claim.namespace,
+            )
+        except NotFoundError:
+            return True
+        except Exception:  # noqa: BLE001 - apiserver unavailable: keep
+            logger.exception("claim staleness check failed for %s", uid)
+            return False
+        return obj.get("metadata", {}).get("uid") != uid
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.cleanup_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("checkpoint cleanup pass failed")

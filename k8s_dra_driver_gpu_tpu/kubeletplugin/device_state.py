@@ -25,7 +25,7 @@ import uuid as uuidlib
 from dataclasses import dataclass, field
 
 from ..api import configs as api_configs
-from ..api.decode import nonstrict_decode, strict_decode
+from ..api.decode import strict_decode
 from ..pkg.featuregates import (
     DYNAMIC_SUB_SLICE,
     MULTI_TENANCY_SUPPORT,
@@ -49,7 +49,7 @@ from .deviceinfo import (
     SubSliceInfo,
 )
 from .sharing import MultiTenancyManager, TimeSlicingManager
-from .subslice import SubSliceLiveTuple, SubSliceSpecTuple, enumerate_subslice_devices
+from .subslice import SubSliceLiveTuple, enumerate_subslice_devices
 
 logger = logging.getLogger(__name__)
 
@@ -103,9 +103,13 @@ class SubSliceRegistry:
             return {}
 
     def _write(self, entries: dict[str, dict]) -> None:
+        # fsync: this registry is the crash-reconciliation source of
+        # truth, so it gets the same durability as the checkpoint.
         tmp = self._path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(entries, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self._path)
 
     def create(self, live: SubSliceLiveTuple) -> None:
@@ -133,6 +137,11 @@ class DeviceState:
         self._tpulib = load_tpulib()
         self.host: TpuHostInfo = self._tpulib.enumerate(config.tpulib_opts)
         self._profiles = self._tpulib.subslice_profiles(config.tpulib_opts)
+        # Grid position of each physical chip (position == index on a
+        # healthy host; they diverge when a chip is missing).
+        self._pos_by_index = {
+            chip.index: pos for pos, chip in enumerate(self.host.chips)
+        }
 
         self.allocatable = self._enumerate_allocatable()
         self._checkpoint = CheckpointManager(config.root, boot_id=config.boot_id)
@@ -143,6 +152,12 @@ class DeviceState:
         self._timeslicing = TimeSlicingManager(config.root)
         self._tenancy = MultiTenancyManager(config.root)
 
+        if self._checkpoint.invalidated_on_boot:
+            # A reboot destroyed all device state: the claim records are
+            # gone, so the per-claim side state under the same persistent
+            # root (sharing policies, tenancy dirs, CDI specs, live
+            # carve-outs) must go with them or holder entries leak.
+            self._cleanup_all_side_state()
         self.destroy_unknown_subslices()
 
     # -- enumeration ----------------------------------------------------------
@@ -154,7 +169,17 @@ class DeviceState:
             out[info.canonical_name] = AllocatableDevice(
                 kind=DeviceKind.CHIP, chip=info
             )
-        if self._config.feature_gates.is_enabled(DYNAMIC_SUB_SLICE):
+        expected = min(self.host.num_slice_chips, self.host.chips_per_host)
+        degraded = len(self.host.chips) < expected
+        if degraded:
+            # A host missing chips keeps publishing the survivors as
+            # whole chips (taints mark the gap) but offers no carve-outs:
+            # the placement grid can't be trusted against a hole.
+            logger.warning(
+                "degraded host (%d/%d chips): not publishing sub-slices",
+                len(self.host.chips), expected,
+            )
+        if self._config.feature_gates.is_enabled(DYNAMIC_SUB_SLICE) and not degraded:
             for spec in enumerate_subslice_devices(self.host, self._profiles):
                 # Full-host carve-outs duplicate the chip set; still
                 # published (schedulers pick by shape), reference
@@ -164,6 +189,27 @@ class DeviceState:
                     kind=DeviceKind.SUBSLICE_DYNAMIC, subslice=info
                 )
         return out
+
+    def _cleanup_all_side_state(self) -> None:
+        import shutil  # noqa: PLC0415
+
+        for sub in ("timeslice", "tenancy"):
+            shutil.rmtree(os.path.join(self._config.root, sub),
+                          ignore_errors=True)
+        os.makedirs(os.path.join(self._config.root, "timeslice"), exist_ok=True)
+        os.makedirs(os.path.join(self._config.root, "tenancy"), exist_ok=True)
+        cdi_root = self._config.cdi_root or os.path.join(self._config.root, "cdi")
+        if os.path.isdir(cdi_root):
+            for name in os.listdir(cdi_root):
+                if name.startswith("k8s.tpu.dra.dev-claim_"):
+                    try:
+                        os.unlink(os.path.join(cdi_root, name))
+                    except OSError:
+                        pass
+        # Live carve-outs all belonged to pre-reboot claims.
+        for live_uuid in list(self._registry.list()):
+            self._registry.destroy(live_uuid)
+        logger.warning("boot-ID change: cleared all per-claim side state")
 
     # -- crash reconciliation -------------------------------------------------
 
@@ -264,35 +310,57 @@ class DeviceState:
                     )
 
     def _cores_of(self, canonical_name: str) -> tuple[int, ...]:
+        """Position-based core set of a device (for overlap math).
+
+        Uses grid POSITIONS, not raw accel indices, so whole-chip and
+        carve-out claims account against the same coordinate system even
+        when device indices are sparse."""
         dev = self.allocatable.get(canonical_name)
         if dev is None:
             return ()
         if dev.kind == DeviceKind.CHIP:
-            idx = dev.chip.chip.index
+            pos = self._pos_by_index[dev.chip.chip.index]
             return tuple(
-                idx * self.host.cores_per_chip + k
+                pos * self.host.cores_per_chip + k
                 for k in range(self.host.cores_per_chip)
             )
         if dev.subslice is not None:
             return dev.subslice.spec.core_indices(self.host)
         return ()
 
+    def _chips_at(self, positions: tuple[int, ...]):
+        """Physical chips backing grid positions (PrepareError when a
+        position has no live chip)."""
+        chips = []
+        for pos in positions:
+            if pos >= len(self.host.chips):
+                raise PrepareError(
+                    f"grid position {pos} has no backing chip on this host"
+                )
+            chips.append(self.host.chips[pos])
+        return chips
+
     def _resolve_configs(self, claim: ResourceClaim):
         """Per-request effective config: class-sourced first, claim-sourced
         later, later wins (GetOpaqueDeviceConfigs precedence :1138; a
-        default TpuConfig is injected when nothing matches :698-724)."""
-        per_request: dict[str, object] = {}
+        default TpuConfig/SubSliceConfig is injected when nothing matches
+        :698-724). Resolved once per unique request."""
         ordered = [c for c in claim.configs if c.source == "FromClass"] + [
             c for c in claim.configs if c.source != "FromClass"
         ]
+        first_device: dict[str, str] = {}
         for result in claim.results:
-            cfg_obj = None
+            first_device.setdefault(result.request, result.device)
+        per_request: dict[str, object] = {}
+        for request, device in first_device.items():
+            winner = None
             for oc in ordered:
-                if not oc.applies_to(result.request):
-                    continue
-                cfg_obj = strict_decode(oc.parameters)
-            if cfg_obj is None:
-                dev = self.allocatable.get(result.device)
+                if oc.applies_to(request):
+                    winner = oc
+            if winner is not None:
+                cfg_obj = strict_decode(winner.parameters)
+            else:
+                dev = self.allocatable.get(device)
                 if dev is not None and dev.kind in (
                     DeviceKind.SUBSLICE_DYNAMIC,
                     DeviceKind.SUBSLICE_STATIC,
@@ -302,7 +370,7 @@ class DeviceState:
                     cfg_obj = api_configs.TpuConfig()
             cfg_obj.normalize()
             cfg_obj.validate()
-            per_request[result.request] = cfg_obj
+            per_request[request] = cfg_obj
         return per_request
 
     def _prepare_devices(self, claim: ResourceClaim) -> list[CheckpointedDevice]:
@@ -349,17 +417,18 @@ class DeviceState:
             edits = ContainerEdits()
             live = None
             if dev.kind == DeviceKind.CHIP:
-                chip_idxs: tuple[int, ...] = (dev.chip.chip.index,)
+                physical = [dev.chip.chip]
                 edits.device_nodes.append(dev.chip.chip.devpath)
             else:
                 ss = dev.subslice
-                chip_idxs = (
-                    ss.spec.chip_indices(self.host)
+                positions = (
+                    ss.spec.chip_positions(self.host)
                     if not ss.spec.is_core_level
                     else (ss.spec.parent_chip,)
                 )
-                for ci in chip_idxs:
-                    edits.device_nodes.append(self._devpath(ci))
+                physical = self._chips_at(positions)
+                for chip in physical:
+                    edits.device_nodes.append(chip.devpath)
                 if ss.spec.is_core_level:
                     edits.env.append(
                         f"TPU_CORE_BOUNDS={ss.spec.placement}"
@@ -378,9 +447,10 @@ class DeviceState:
                     created_live.append(live_t.uuid)
                     live = live_t.to_dict()
 
-            claim_chips.update(chip_idxs)
+            physical_idxs = [c.index for c in physical]
+            claim_chips.update(physical_idxs)
             grp = groups.setdefault(result.request, (set(), []))
-            grp[0].update(chip_idxs)
+            grp[0].update(physical_idxs)
             grp[1].append(result.device)
 
             device_edits[result.device] = edits
@@ -461,12 +531,6 @@ class DeviceState:
             )
         return ContainerEdits()
 
-    def _devpath(self, chip_index: int) -> str:
-        for chip in self.host.chips:
-            if chip.index == chip_index:
-                return chip.devpath
-        return f"/dev/accel{chip_index}"
-
     # -- unprepare ------------------------------------------------------------
 
     def unprepare(self, claim_uid: str) -> None:
@@ -486,10 +550,11 @@ class DeviceState:
         for dev in checkpointed.devices:
             if dev.live:
                 self._registry.destroy(dev.live["uuid"])
-            chip_indices.update(
-                c // self.host.cores_per_chip
-                for c in self._cores_of(dev.canonical_name)
-            )
+            for core in self._cores_of(dev.canonical_name):
+                pos = core // self.host.cores_per_chip
+                if pos < len(self.host.chips):
+                    # Sharing state is keyed by physical chip index.
+                    chip_indices.add(self.host.chips[pos].index)
         # Holder-counted release: a chip shared with another claim (via
         # disjoint core-level carve-outs) keeps its policy file.
         self._timeslicing.release(checkpointed.uid, sorted(chip_indices))

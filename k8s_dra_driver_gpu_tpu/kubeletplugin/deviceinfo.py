@@ -73,7 +73,7 @@ class SubSliceInfo:
     @property
     def chips(self) -> int:
         return 0 if self.spec.is_core_level else len(
-            self.spec.chip_indices(self.host)
+            self.spec.chip_positions(self.host)
         )
 
     @property

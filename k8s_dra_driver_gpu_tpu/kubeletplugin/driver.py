@@ -1,0 +1,228 @@
+"""Driver: DRA callbacks + ResourceSlice publication + health wiring.
+
+Reference: cmd/gpu-kubelet-plugin/driver.go -- NewDriver (:70),
+PrepareResourceClaims loop (:337), nodePrepareResource (:373) under the
+node-global flock, ResourceSlice publication in legacy/combined/split
+modes with server-version sniffing (:190, :574), health events ->
+DeviceTaints -> republish (:496-566).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from . import DRIVER_NAME
+from ..pkg.kubeclient import NotFoundError
+from ..pkg.metrics import DRARequestMetrics
+from .claim import ResourceClaim
+from .cleanup import CheckpointCleanupManager
+from .device_state import Config, DeviceState
+from .deviceinfo import DeviceKind
+from .health import ChipHealthMonitor, DeviceTaint
+from .partitions import consumed_counters, shared_counter_sets
+
+logger = logging.getLogger(__name__)
+
+RESOURCE_GROUP = "resource.k8s.io"
+RESOURCE_VERSION = "v1"
+
+
+class Driver:
+    """The per-node driver. Talks to the API server through any object
+    with the KubeClient surface (FakeKubeClient in tests)."""
+
+    def __init__(
+        self,
+        config: Config,
+        kube_client,
+        node_name: str,
+        metrics: DRARequestMetrics | None = None,
+        enable_health_monitor: bool = True,
+        split_slices: bool | None = None,
+    ):
+        self.state = DeviceState(config)
+        self.kube = kube_client
+        self.node_name = node_name
+        self.metrics = metrics or DRARequestMetrics()
+        self._taints: dict[str, list[dict]] = {}
+        # KEP-4815 split mode needs a server >= 1.35 (reference sniffs the
+        # server version, driver.go:574).
+        if split_slices is None:
+            split_slices = self._server_supports_split()
+        self.split_slices = split_slices
+
+        self.cleanup = CheckpointCleanupManager(self.state, kube_client)
+        self.health_monitor = None
+        if enable_health_monitor:
+            self.health_monitor = ChipHealthMonitor(
+                self.state._tpulib,
+                config.tpulib_opts,
+                self._on_health_taints,
+            )
+
+    def start(self) -> None:
+        self.cleanup.start()
+        if self.health_monitor:
+            self.health_monitor.start()
+        self.publish_resources()
+
+    def stop(self) -> None:
+        self.cleanup.stop()
+        if self.health_monitor:
+            self.health_monitor.stop()
+
+    def _server_supports_split(self) -> bool:
+        try:
+            v = self.kube.server_version()
+            return (int(v.get("major", "0")), int(v.get("minor", "0").rstrip("+"))) >= (1, 35)
+        except Exception:  # noqa: BLE001
+            return False
+
+    # -- DRA callbacks --------------------------------------------------------
+
+    def prepare_resource_claims(self, claim_refs: list) -> dict:
+        """claim_refs: protobuf Claims or dicts with uid/namespace/name.
+        Returns uid -> (devices, error) for the gRPC layer."""
+        out = {}
+        for ref in claim_refs:
+            uid = getattr(ref, "uid", None) or ref.get("uid")
+            try:
+                with self.metrics.observe("NodePrepareResources"):
+                    out[uid] = (self._prepare_one(ref), "")
+            except Exception as e:  # noqa: BLE001 - wire boundary
+                logger.exception("prepare failed for claim %s", uid)
+                out[uid] = ([], str(e))
+        self.metrics.prepared_devices.set(self.state.prepared_device_count())
+        return out
+
+    def _prepare_one(self, ref) -> list[dict]:
+        uid = getattr(ref, "uid", None) or ref.get("uid")
+        namespace = getattr(ref, "namespace", None) or ref.get("namespace")
+        name = getattr(ref, "name", None) or ref.get("name")
+        t0 = time.monotonic()
+        obj = self.kube.get(
+            RESOURCE_GROUP, RESOURCE_VERSION, "resourceclaims",
+            name, namespace=namespace,
+        )
+        if obj.get("metadata", {}).get("uid") != uid:
+            raise NotFoundError(f"claim {namespace}/{name} UID mismatch")
+        claim = ResourceClaim.from_dict(obj)
+        self.state.prepare(claim)
+        # Group CDI ids by request for the kubelet response.
+        cp = self.state.prepared_claims()[uid]
+        by_request: dict[str, list] = {}
+        req_of = {r.device: r.request for r in claim.results}
+        for dev in cp.devices:
+            by_request.setdefault(req_of.get(dev.canonical_name, ""), []).append(dev)
+        devices = []
+        for request, devs in by_request.items():
+            for dev in devs:
+                devices.append(
+                    {
+                        "request_names": [request] if request else [],
+                        "pool_name": self.node_name,
+                        "device_name": dev.canonical_name,
+                        "cdi_device_ids": dev.cdi_device_ids,
+                    }
+                )
+        logger.info(
+            "prepared claim %s (%d devices) in %.1fms",
+            uid, len(devices), (time.monotonic() - t0) * 1e3,
+        )
+        return devices
+
+    def unprepare_resource_claims(self, claim_refs: list) -> dict:
+        out = {}
+        for ref in claim_refs:
+            uid = getattr(ref, "uid", None) or ref.get("uid")
+            try:
+                with self.metrics.observe("NodeUnprepareResources"):
+                    self.state.unprepare(uid)
+                out[uid] = ""
+            except Exception as e:  # noqa: BLE001 - wire boundary
+                logger.exception("unprepare failed for claim %s", uid)
+                out[uid] = str(e)
+        self.metrics.prepared_devices.set(self.state.prepared_device_count())
+        return out
+
+    # -- ResourceSlice publication -------------------------------------------
+
+    def generate_resource_slices(self) -> list[dict]:
+        """Build the node's ResourceSlices.
+
+        Combined mode: one slice with all devices + shared counters.
+        Split mode (KEP-4815, server >= 1.35): chips slice + per-partition
+        slice, mirroring generateSplitResourceSlices (driver.go:190).
+        """
+        host = self.state.host
+        devices = []
+        partition_devices = []
+        for name, dev in sorted(self.state.allocatable.items()):
+            entry = dev.to_dra_device()
+            taints = self._taints.get(name)
+            if taints:
+                entry["taints"] = taints
+            entry["consumesCounters"] = consumed_counters(dev, host)
+            if dev.kind == DeviceKind.CHIP:
+                devices.append(entry)
+            else:
+                partition_devices.append(entry)
+
+        def slice_obj(suffix: str, devs: list[dict]) -> dict:
+            return {
+                "apiVersion": f"{RESOURCE_GROUP}/{RESOURCE_VERSION}",
+                "kind": "ResourceSlice",
+                "metadata": {"name": f"{self.node_name}-{DRIVER_NAME}{suffix}"},
+                "spec": {
+                    "driver": DRIVER_NAME,
+                    "nodeName": self.node_name,
+                    "pool": {
+                        "name": self.node_name,
+                        "resourceSliceCount": 2 if self.split_slices else 1,
+                        "generation": 1,
+                    },
+                    "sharedCounters": shared_counter_sets(host),
+                    "perDeviceNodeSelection": False,
+                    "devices": devs,
+                },
+            }
+
+        if self.split_slices and partition_devices:
+            return [
+                slice_obj("-chips", devices),
+                slice_obj("-partitions", partition_devices),
+            ]
+        return [slice_obj("", devices + partition_devices)]
+
+    def publish_resources(self) -> None:
+        for obj in self.generate_resource_slices():
+            name = obj["metadata"]["name"]
+            try:
+                existing = self.kube.get(
+                    RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", name
+                )
+                obj["spec"]["pool"]["generation"] = (
+                    existing["spec"]["pool"]["generation"] + 1
+                )
+                self.kube.update(
+                    RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices",
+                    name, obj,
+                )
+            except NotFoundError:
+                self.kube.create(
+                    RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", obj
+                )
+
+    # -- health ---------------------------------------------------------------
+
+    def _on_health_taints(self, taints: list[DeviceTaint]) -> None:
+        """Reconcile device taints and republish (driver.go:496-566)."""
+        new: dict[str, list[dict]] = {}
+        for t in taints:
+            new.setdefault(t.device, []).append(t.to_dict())
+        self._taints = new
+        try:
+            self.publish_resources()
+        except Exception:  # noqa: BLE001 - known reference gap: no retry
+            logger.exception("republish after health event failed")

@@ -1,0 +1,118 @@
+"""Chip health monitoring: tpulib health events -> DRA device taints.
+
+Reference: cmd/gpu-kubelet-plugin/device_health.go -- NVML event-set
+monitor mapping XID/GPU-lost events to devices (:101), a skip-list of
+benign events plus user-supplied ignores (:394-443), events becoming
+DeviceTaints (keys gpu.nvidia.com/xid|gpu-lost, :36-40) consumed by the
+driver to taint + republish ResourceSlices (driver.go:496-566).
+
+TPU translation: tpulib health kinds (hbm_uncorrectable, ici_link_down,
+chip_lost, thermal, ...) map to taints under tpu.dra.dev/. Non-fatal
+kinds produce Effect=None taints (observability without eviction),
+mirroring the reference's Option-A schema.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+from ..tpulib.binding import EnumerateOptions, HealthEvent
+from .subslice import chip_name
+
+logger = logging.getLogger(__name__)
+
+TAINT_KEY_PREFIX = "tpu.dra.dev"
+
+# Benign kinds never surfaced as NoSchedule/NoExecute (skip-list analog,
+# device_health.go:394-443).
+DEFAULT_IGNORED_KINDS = frozenset({"thermal_notice", "clock_throttle"})
+
+POLL_INTERVAL_S = 5.0  # reference polls NVML events with 5000ms waits
+
+
+@dataclass(frozen=True)
+class DeviceTaint:
+    device: str  # canonical device name
+    key: str
+    value: str
+    effect: str  # NoSchedule | NoExecute | None ("" = observe only)
+
+    def to_dict(self) -> dict:
+        d = {"key": self.key, "value": self.value}
+        if self.effect:
+            d["effect"] = self.effect
+        return d
+
+
+def health_event_to_taints(
+    event: HealthEvent,
+    ignored_kinds: frozenset[str] = DEFAULT_IGNORED_KINDS,
+) -> list[DeviceTaint]:
+    """Map one health event to taints on the affected chip."""
+    if event.kind in ignored_kinds:
+        return []
+    effect = "NoExecute" if event.fatal else ""
+    return [
+        DeviceTaint(
+            device=chip_name(event.chip),
+            key=f"{TAINT_KEY_PREFIX}/{event.kind}",
+            value="true",
+            effect=effect,
+        )
+    ]
+
+
+class ChipHealthMonitor:
+    """Polls tpulib health and pushes taint updates to a callback.
+
+    The callback receives the full current taint list (per poll), so the
+    consumer can reconcile (add + clear) rather than accumulate.
+    """
+
+    def __init__(
+        self,
+        tpulib,
+        opts: EnumerateOptions,
+        on_taints: Callable[[list[DeviceTaint]], None],
+        ignored_kinds: frozenset[str] = DEFAULT_IGNORED_KINDS,
+        additional_ignored: tuple[str, ...] = (),
+        poll_interval: float = POLL_INTERVAL_S,
+    ):
+        self._tpulib = tpulib
+        self._opts = opts
+        self._on_taints = on_taints
+        self._ignored = frozenset(ignored_kinds) | frozenset(additional_ignored)
+        self._interval = poll_interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="chip-health", daemon=True
+        )
+        self._last: list[DeviceTaint] | None = None
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=self._interval + 1)
+
+    def poll_once(self) -> list[DeviceTaint]:
+        events = self._tpulib.health(self._opts)
+        taints: list[DeviceTaint] = []
+        for ev in events:
+            taints.extend(health_event_to_taints(ev, self._ignored))
+        return taints
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                taints = self.poll_once()
+            except Exception:  # noqa: BLE001 - monitor must survive
+                logger.exception("health poll failed")
+                continue
+            if taints != self._last:
+                self._last = taints
+                self._on_taints(taints)

@@ -1,0 +1,143 @@
+"""tpu-kubelet-plugin entry point.
+
+Reference: cmd/gpu-kubelet-plugin/main.go -- urfave/cli app with env-var
+mirrors for every flag (:80), metrics server (:269-276), plugin start
+(:240). Flags mirror the reference's surface where meaningful on TPU.
+
+Run (mock mode, no cluster):
+    python -m k8s_dra_driver_gpu_tpu.kubeletplugin.main \
+        --mock-topology v5e-4 --state-root /tmp/tpu-dra --standalone
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+
+from .. import __version__
+from ..pkg.featuregates import FeatureGates
+from ..pkg.kubeclient import FakeKubeClient, KubeClient
+from ..pkg.metrics import DRARequestMetrics, MetricsServer
+from ..pkg.dra.service import PluginServer
+from ..tpulib.binding import EnumerateOptions
+from . import DRIVER_NAME
+from .device_state import Config
+from .driver import Driver
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-kubelet-plugin",
+        description="TPU DRA kubelet plugin (driver %s)" % DRIVER_NAME,
+    )
+    env = os.environ.get
+    p.add_argument("--node-name", default=env("NODE_NAME", ""),
+                   help="node this plugin serves [NODE_NAME]")
+    p.add_argument("--state-root",
+                   default=env("STATE_ROOT", "/var/lib/tpu-dra"),
+                   help="checkpoint/policy state root [STATE_ROOT]")
+    p.add_argument("--cdi-root", default=env("CDI_ROOT", "/var/run/cdi"),
+                   help="CDI spec dir [CDI_ROOT]")
+    p.add_argument("--plugin-dir",
+                   default=env("PLUGIN_DIR",
+                               "/var/lib/kubelet/plugins/tpu.dra.dev"),
+                   help="DRA plugin socket dir [PLUGIN_DIR]")
+    p.add_argument("--registry-dir",
+                   default=env("REGISTRY_DIR",
+                               "/var/lib/kubelet/plugins_registry"),
+                   help="kubelet plugin-registry socket dir [REGISTRY_DIR]")
+    p.add_argument("--metrics-port", type=int,
+                   default=int(env("METRICS_PORT", "0")),
+                   help="Prometheus port (0=disabled) [METRICS_PORT]")
+    p.add_argument("--feature-gates", default=env("FEATURE_GATES", ""),
+                   help="Gate1=true,Gate2=false [FEATURE_GATES]")
+    p.add_argument("--mock-topology", default=env("TPULIB_MOCK_TOPOLOGY"),
+                   help="use mock tpulib with this topology "
+                        "[TPULIB_MOCK_TOPOLOGY]")
+    p.add_argument("--mock-worker-id", type=int,
+                   default=int(env("TPULIB_MOCK_WORKER_ID", "0")),
+                   help="mock worker id [TPULIB_MOCK_WORKER_ID]")
+    p.add_argument("--additional-health-kinds-to-ignore", default="",
+                   help="comma-separated health kinds never tainted")
+    p.add_argument("--standalone", action="store_true",
+                   help="no API server: in-memory kube client (dev/mock)")
+    p.add_argument("--kube-api", default=env("KUBE_API", ""),
+                   help="API server URL override [KUBE_API]")
+    p.add_argument("--version", action="version", version=__version__)
+    return p
+
+
+def run(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    logger.info("tpu-kubelet-plugin %s starting (driver %s)",
+                __version__, DRIVER_NAME)
+    # Structured startup-config dump (reference pkg/flags/utils.go).
+    for key, val in sorted(vars(args).items()):
+        logger.info("config %s=%r", key, val)
+
+    gates = FeatureGates.parse(args.feature_gates)
+    config = Config(
+        root=args.state_root,
+        cdi_root=args.cdi_root,
+        feature_gates=gates,
+        tpulib_opts=EnumerateOptions(
+            mock_topology=args.mock_topology,
+            worker_id=args.mock_worker_id if args.mock_topology else None,
+        ),
+    )
+    node_name = args.node_name or os.uname().nodename
+
+    kube = FakeKubeClient() if args.standalone else KubeClient(
+        host=args.kube_api or None
+    )
+    metrics = DRARequestMetrics()
+    driver = Driver(config, kube, node_name, metrics=metrics)
+
+    server = PluginServer(
+        DRIVER_NAME,
+        plugin_dir=args.plugin_dir,
+        registry_dir=args.registry_dir,
+        prepare_fn=driver.prepare_resource_claims,
+        unprepare_fn=driver.unprepare_resource_claims,
+    )
+
+    metrics_server = None
+    if args.metrics_port > 0:
+        metrics_server = MetricsServer(
+            metrics.registry, host="0.0.0.0", port=args.metrics_port
+        )
+        metrics_server.start()
+
+    driver.start()
+    server.start()
+    logger.info(
+        "serving DRA on %s (registry %s); %d allocatable device(s)",
+        server.plugin_socket, server.registry_socket,
+        len(driver.state.allocatable),
+    )
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    finally:
+        server.stop()
+        driver.stop()
+        if metrics_server:
+            metrics_server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

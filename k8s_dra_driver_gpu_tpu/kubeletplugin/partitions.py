@@ -1,0 +1,57 @@
+"""KEP-4815 partitionable devices: shared counters over chips/cores/HBM.
+
+Reference: cmd/gpu-kubelet-plugin/partitions.go -- per-GPU SharedCounters
+(memory slices + per-capacity counters) with PartGetDevice/
+PartSharedCounterSets/PartCapacities (:300-326); consumed by the
+KEP-4815 "split"/"combined" ResourceSlice modes (driver.go:190).
+
+TPU model: one counter set per host ("host-counters") tracking
+per-TensorCore occupancy (the finest allocation grain) plus HBM bytes.
+Every chip and every sub-slice carve-out consumes its core counters, so
+the scheduler can never over-commit a core between a whole-chip claim
+and a carve-out claim.
+"""
+
+from __future__ import annotations
+
+from ..tpulib.binding import TpuHostInfo
+from .deviceinfo import AllocatableDevice, DeviceKind
+
+COUNTER_SET = "host-counters"
+
+
+def shared_counter_sets(host: TpuHostInfo) -> list[dict]:
+    """The counter sets block for a ResourceSlice (sharedCounters)."""
+    counters: dict[str, dict] = {}
+    for chip in host.chips:
+        for core in range(host.cores_per_chip):
+            counters[f"core-{chip.index}-{core}"] = {"value": "1"}
+        counters[f"hbm-{chip.index}"] = {
+            "value": str(host.hbm_bytes_per_chip)
+        }
+    return [{"name": COUNTER_SET, "counters": counters}]
+
+
+def consumed_counters(
+    dev: AllocatableDevice, host: TpuHostInfo
+) -> list[dict]:
+    """The consumesCounters block for one device."""
+    per_core_hbm = host.hbm_bytes_per_chip // host.cores_per_chip
+    if dev.kind == DeviceKind.CHIP:
+        idx = dev.chip.chip.index
+        cores = [(idx, k) for k in range(host.cores_per_chip)]
+    elif dev.subslice is not None:
+        cores = [
+            (c // host.cores_per_chip, c % host.cores_per_chip)
+            for c in dev.subslice.spec.core_indices(host)
+        ]
+    else:
+        return []
+    counters: dict[str, dict] = {}
+    hbm_per_chip: dict[int, int] = {}
+    for chip_idx, core_idx in cores:
+        counters[f"core-{chip_idx}-{core_idx}"] = {"value": "1"}
+        hbm_per_chip[chip_idx] = hbm_per_chip.get(chip_idx, 0) + per_core_hbm
+    for chip_idx, hbm in hbm_per_chip.items():
+        counters[f"hbm-{chip_idx}"] = {"value": str(hbm)}
+    return [{"counterSet": COUNTER_SET, "counters": counters}]

@@ -144,7 +144,8 @@ class MultiTenancyManager:
             env.append(f"TPU_HBM_LIMIT_BYTES={min(map(int, limits))}")
         return ContainerEdits(
             env=env,
-            mounts=[(d, f"/var/run/tpu-tenancy/{claim_uid}/{request}")],
+            # Writable: co-tenant processes create rendezvous files here.
+            mounts=[(d, f"/var/run/tpu-tenancy/{claim_uid}/{request}", False)],
         )
 
     def stop(self, claim_uid: str) -> None:

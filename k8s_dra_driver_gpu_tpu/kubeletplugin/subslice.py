@@ -70,8 +70,13 @@ class SubSliceSpecTuple:
             return cls(profile=m.group(1), placement=int(m.group(2)))
         return None
 
-    def chip_indices(self, host: TpuHostInfo) -> tuple[int, ...]:
-        """Which chips this carve-out occupies."""
+    def chip_positions(self, host: TpuHostInfo) -> tuple[int, ...]:
+        """Which GRID POSITIONS this carve-out occupies.
+
+        Positions index host.chips (tpulib orders chips by position and
+        assigns coords positionally), NOT raw accel indices -- on a host
+        with a failed chip the two diverge. Callers map a position p to
+        the physical chip via host.chips[p]."""
         if self.is_core_level:
             return (self.parent_chip,)
         dims = [int(d) for d in self.profile.split("x")]
@@ -90,13 +95,14 @@ class SubSliceSpecTuple:
         )
 
     def core_indices(self, host: TpuHostInfo) -> tuple[int, ...]:
-        """Which cores (host-global core index) this carve-out occupies."""
+        """Which cores (host-global, position-based core index) this
+        carve-out occupies."""
         if self.is_core_level:
             return (self.parent_chip * host.cores_per_chip + self.placement
                     % host.cores_per_chip,)
         return tuple(
             c * host.cores_per_chip + k
-            for c in self.chip_indices(host)
+            for c in self.chip_positions(host)
             for k in range(host.cores_per_chip)
         )
 
@@ -137,15 +143,18 @@ def _host_grid(host: TpuHostInfo) -> tuple[int, int, int]:
     """The local chip grid of this host (reduced when the host owns fewer
     chips than a full block), matching tpulib's placement indexing.
 
-    Delegates to the tpulib backend's own grid helpers so placement
-    decode here can never diverge from tpulib's encode."""
+    Delegates to the tpulib backend's own grid helpers, and derives the
+    grid from the TOPOLOGY (num_slice_chips / chips_per_host) rather than
+    the live chip count, exactly as tpulib's subslice_profiles encodes
+    placements -- a degraded host (failed chip) keeps the full grid and
+    the missing positions simply have no backing chip."""
     from ..tpulib.binding import (  # noqa: PLC0415 - avoid import cycle
         _GENERATIONS,
         _host_shape,
         _slice_shape,
     )
 
-    n = len(host.chips) or host.chips_per_host
+    n = min(host.num_slice_chips, host.chips_per_host) or 1
     gen = _GENERATIONS.get(host.platform)
     if gen is None:
         return (1, n, 1)

@@ -1,0 +1,8 @@
+"""DRA gRPC plumbing: plugin service + kubelet registration.
+
+Reference: the kubeletplugin helper the reference drives
+(driver.go:141, kubeletplugin.Start) -- two unix-socket gRPC services:
+the DRAPlugin service (NodePrepareResources/NodeUnprepareResources) and
+the pluginregistration Registration service the kubelet's plugin watcher
+dials.
+"""

@@ -1,0 +1,1 @@
+"""protoc-generated messages for the DRA + registration services."""

@@ -1,0 +1,230 @@
+"""gRPC servers for the DRA plugin + registration services.
+
+Runs over unix sockets (kubelet's plugin watcher convention):
+  <plugin-dir>/<driver>.sock            DRAPlugin service
+  <registry-dir>/<driver>-reg.sock      Registration service
+
+Service handlers are wired with grpc generic handlers over the
+protoc-generated messages (no grpcio-tools in this runtime).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from concurrent import futures
+from typing import Callable
+
+import grpc
+
+from .proto import dra_plugin_pb2 as drapb
+from .proto import plugin_registration_pb2 as regpb
+
+logger = logging.getLogger(__name__)
+
+DRA_SERVICE = "v1beta1.DRAPlugin"
+REGISTRATION_SERVICE = "pluginregistration.Registration"
+SUPPORTED_VERSIONS = ["v1beta1"]
+
+
+class DRAPluginServicer:
+    """Adapts prepare/unprepare callbacks to the wire API.
+
+    prepare_fn(claims: list[Claim]) -> dict uid -> (devices, error) where
+    devices is a list of dicts {request_names, pool_name, device_name,
+    cdi_device_ids}.
+    """
+
+    def __init__(
+        self,
+        prepare_fn: Callable[[list], dict],
+        unprepare_fn: Callable[[list], dict],
+    ):
+        self._prepare = prepare_fn
+        self._unprepare = unprepare_fn
+
+    def NodePrepareResources(self, request, context):  # noqa: N802
+        results = self._prepare(list(request.claims))
+        resp = drapb.NodePrepareResourcesResponse()
+        for uid, (devices, error) in results.items():
+            r = drapb.NodePrepareResourceResponse()
+            if error:
+                r.error = error
+            for d in devices:
+                dev = r.devices.add()
+                dev.request_names.extend(d.get("request_names", []))
+                dev.pool_name = d.get("pool_name", "")
+                dev.device_name = d.get("device_name", "")
+                dev.cdi_device_ids.extend(d.get("cdi_device_ids", []))
+            resp.claims[uid].CopyFrom(r)
+        return resp
+
+    def NodeUnprepareResources(self, request, context):  # noqa: N802
+        results = self._unprepare(list(request.claims))
+        resp = drapb.NodeUnprepareResourcesResponse()
+        for uid, error in results.items():
+            r = drapb.NodeUnprepareResourceResponse()
+            if error:
+                r.error = error
+            resp.claims[uid].CopyFrom(r)
+        return resp
+
+    def handler(self) -> grpc.GenericRpcHandler:
+        return grpc.method_handlers_generic_handler(
+            DRA_SERVICE,
+            {
+                "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
+                    self.NodePrepareResources,
+                    request_deserializer=(
+                        drapb.NodePrepareResourcesRequest.FromString
+                    ),
+                    response_serializer=(
+                        drapb.NodePrepareResourcesResponse.SerializeToString
+                    ),
+                ),
+                "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
+                    self.NodeUnprepareResources,
+                    request_deserializer=(
+                        drapb.NodeUnprepareResourcesRequest.FromString
+                    ),
+                    response_serializer=(
+                        drapb.NodeUnprepareResourcesResponse.SerializeToString
+                    ),
+                ),
+            },
+        )
+
+
+class RegistrationServicer:
+    """Answers the kubelet plugin watcher (pluginregistration.v1)."""
+
+    def __init__(self, driver_name: str, endpoint: str):
+        self._driver = driver_name
+        self._endpoint = endpoint
+        self.registered = False
+        self.registration_error = ""
+
+    def GetInfo(self, request, context):  # noqa: N802
+        info = regpb.PluginInfo()
+        info.type = "DRAPlugin"
+        info.name = self._driver
+        info.endpoint = self._endpoint
+        info.supported_versions.extend(SUPPORTED_VERSIONS)
+        return info
+
+    def NotifyRegistrationStatus(self, request, context):  # noqa: N802
+        self.registered = request.plugin_registered
+        self.registration_error = request.error
+        if not request.plugin_registered:
+            logger.error("kubelet registration failed: %s", request.error)
+        return regpb.RegistrationStatusResponse()
+
+    def handler(self) -> grpc.GenericRpcHandler:
+        return grpc.method_handlers_generic_handler(
+            REGISTRATION_SERVICE,
+            {
+                "GetInfo": grpc.unary_unary_rpc_method_handler(
+                    self.GetInfo,
+                    request_deserializer=regpb.InfoRequest.FromString,
+                    response_serializer=regpb.PluginInfo.SerializeToString,
+                ),
+                "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
+                    self.NotifyRegistrationStatus,
+                    request_deserializer=regpb.RegistrationStatus.FromString,
+                    response_serializer=(
+                        regpb.RegistrationStatusResponse.SerializeToString
+                    ),
+                ),
+            },
+        )
+
+
+class PluginServer:
+    """Hosts both services on their unix sockets."""
+
+    def __init__(
+        self,
+        driver_name: str,
+        plugin_dir: str,
+        registry_dir: str,
+        prepare_fn,
+        unprepare_fn,
+    ):
+        os.makedirs(plugin_dir, exist_ok=True)
+        os.makedirs(registry_dir, exist_ok=True)
+        self.plugin_socket = os.path.join(plugin_dir, f"{driver_name}.sock")
+        self.registry_socket = os.path.join(
+            registry_dir, f"{driver_name}-reg.sock"
+        )
+        for sock in (self.plugin_socket, self.registry_socket):
+            if os.path.exists(sock):
+                os.unlink(sock)
+
+        self.dra = DRAPluginServicer(prepare_fn, unprepare_fn)
+        self.registration = RegistrationServicer(
+            driver_name, self.plugin_socket
+        )
+
+        self._plugin_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=4)
+        )
+        self._plugin_server.add_generic_rpc_handlers((self.dra.handler(),))
+        self._plugin_server.add_insecure_port(f"unix://{self.plugin_socket}")
+
+        self._registry_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=2)
+        )
+        self._registry_server.add_generic_rpc_handlers(
+            (self.registration.handler(),)
+        )
+        self._registry_server.add_insecure_port(
+            f"unix://{self.registry_socket}"
+        )
+
+    def start(self) -> None:
+        self._plugin_server.start()
+        self._registry_server.start()
+
+    def stop(self, grace: float = 2.0) -> None:
+        self._plugin_server.stop(grace)
+        self._registry_server.stop(grace)
+        for sock in (self.plugin_socket, self.registry_socket):
+            try:
+                os.unlink(sock)
+            except FileNotFoundError:
+                pass
+
+
+def dra_client_stubs(socket_path: str):
+    """A raw client for tests / healthchecks: returns (channel, call_fns)."""
+    channel = grpc.insecure_channel(f"unix://{socket_path}")
+    prepare = channel.unary_unary(
+        f"/{DRA_SERVICE}/NodePrepareResources",
+        request_serializer=drapb.NodePrepareResourcesRequest.SerializeToString,
+        response_deserializer=drapb.NodePrepareResourcesResponse.FromString,
+    )
+    unprepare = channel.unary_unary(
+        f"/{DRA_SERVICE}/NodeUnprepareResources",
+        request_serializer=(
+            drapb.NodeUnprepareResourcesRequest.SerializeToString
+        ),
+        response_deserializer=(
+            drapb.NodeUnprepareResourcesResponse.FromString
+        ),
+    )
+    return channel, prepare, unprepare
+
+
+def registration_client_stubs(socket_path: str):
+    channel = grpc.insecure_channel(f"unix://{socket_path}")
+    get_info = channel.unary_unary(
+        f"/{REGISTRATION_SERVICE}/GetInfo",
+        request_serializer=regpb.InfoRequest.SerializeToString,
+        response_deserializer=regpb.PluginInfo.FromString,
+    )
+    notify = channel.unary_unary(
+        f"/{REGISTRATION_SERVICE}/NotifyRegistrationStatus",
+        request_serializer=regpb.RegistrationStatus.SerializeToString,
+        response_deserializer=regpb.RegistrationStatusResponse.FromString,
+    )
+    return channel, get_info, notify

@@ -1,0 +1,286 @@
+"""Minimal Kubernetes REST client + in-memory fake.
+
+Reference: pkg/flags/kubeclient.go builds ClientSets{Core, Nvidia,
+Resource} from kubeconfig/in-cluster config. This runtime has no official
+client dependency, so this is a small typed wrapper over the REST API:
+CRUD on arbitrary group/version/resource paths, JSON-merge patch, and a
+bounded watch. The FakeKubeClient implements the same surface in memory
+for unit tests (the analog of the reference's generated fake clientset,
+pkg/nvidia.com/clientset/versioned/fake/).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+
+
+class NotFoundError(KubeError):
+    def __init__(self, message: str = "not found"):
+        super().__init__(404, message)
+
+
+class ConflictError(KubeError):
+    def __init__(self, message: str = "conflict"):
+        super().__init__(409, message)
+
+
+def _resource_path(
+    group: str, version: str, resource: str, namespace: str | None, name: str | None
+) -> str:
+    base = f"/api/{version}" if not group else f"/apis/{group}/{version}"
+    if namespace:
+        base += f"/namespaces/{namespace}"
+    base += f"/{resource}"
+    if name:
+        base += f"/{name}"
+    return base
+
+
+class KubeClient:
+    """REST client over the API server (in-cluster or kubeconfig host)."""
+
+    def __init__(
+        self,
+        host: str | None = None,
+        token: str | None = None,
+        ca_cert: str | None = None,
+        insecure: bool = False,
+    ):
+        if host is None:
+            h = os.environ.get("KUBERNETES_SERVICE_HOST")
+            p = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not h:
+                raise KubeError(0, "no API server host configured")
+            host = f"https://{h}:{p}"
+        self._host = host.rstrip("/")
+        if token is None:
+            token_path = os.path.join(SERVICE_ACCOUNT_DIR, "token")
+            if os.path.exists(token_path):
+                with open(token_path) as f:
+                    token = f.read().strip()
+        self._token = token
+        ctx: ssl.SSLContext | None = None
+        if self._host.startswith("https"):
+            ctx = ssl.create_default_context()
+            ca = ca_cert or os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+            if os.path.exists(ca):
+                ctx.load_verify_locations(ca)
+            if insecure:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+        self._ssl = ctx
+
+    def _request(
+        self, method: str, path: str, body: dict | None = None,
+        content_type: str = "application/json", timeout: float = 30.0,
+    ) -> dict:
+        url = self._host + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout, context=self._ssl
+            ) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            msg = e.read().decode(errors="replace")
+            if e.code == 404:
+                raise NotFoundError(msg) from e
+            if e.code == 409:
+                raise ConflictError(msg) from e
+            raise KubeError(e.code, msg) from e
+
+    # -- typed surface --------------------------------------------------------
+
+    def get(self, group, version, resource, name, namespace=None) -> dict:
+        return self._request(
+            "GET", _resource_path(group, version, resource, namespace, name)
+        )
+
+    def list(self, group, version, resource, namespace=None,
+             label_selector: str | None = None) -> list[dict]:
+        path = _resource_path(group, version, resource, namespace, None)
+        if label_selector:
+            path += f"?labelSelector={urllib.request.quote(label_selector)}"
+        return self._request("GET", path).get("items", [])
+
+    def create(self, group, version, resource, obj, namespace=None) -> dict:
+        return self._request(
+            "POST", _resource_path(group, version, resource, namespace, None),
+            body=obj,
+        )
+
+    def update(self, group, version, resource, name, obj, namespace=None) -> dict:
+        return self._request(
+            "PUT", _resource_path(group, version, resource, namespace, name),
+            body=obj,
+        )
+
+    def patch(self, group, version, resource, name, patch, namespace=None) -> dict:
+        return self._request(
+            "PATCH", _resource_path(group, version, resource, namespace, name),
+            body=patch, content_type="application/merge-patch+json",
+        )
+
+    def delete(self, group, version, resource, name, namespace=None) -> None:
+        try:
+            self._request(
+                "DELETE",
+                _resource_path(group, version, resource, namespace, name),
+            )
+        except NotFoundError:
+            pass
+
+    def server_version(self) -> dict:
+        return self._request("GET", "/version")
+
+
+@dataclass
+class _WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    obj: dict
+
+
+class FakeKubeClient:
+    """In-memory KubeClient with the same surface + watch hooks."""
+
+    def __init__(self):
+        # (group, resource, namespace or "", name) -> obj
+        self._store: dict[tuple, dict] = {}
+        self._lock = threading.Lock()
+        self._watchers: list[Callable[[str, dict], None]] = []
+        self._uid = 0
+        self.version = {"major": "1", "minor": "34"}
+
+    # -- helpers --------------------------------------------------------------
+
+    def _key(self, group, resource, namespace, name):
+        return (group, resource, namespace or "", name)
+
+    def _notify(self, event_type: str, obj: dict) -> None:
+        for w in list(self._watchers):
+            w(event_type, obj)
+
+    def add_watcher(self, fn: Callable[[str, dict], None]) -> None:
+        self._watchers.append(fn)
+
+    def objects(self, group=None, resource=None) -> list[dict]:
+        with self._lock:
+            return [
+                v for (g, r, _, _), v in self._store.items()
+                if (group is None or g == group)
+                and (resource is None or r == resource)
+            ]
+
+    # -- surface --------------------------------------------------------------
+
+    def get(self, group, version, resource, name, namespace=None) -> dict:
+        with self._lock:
+            obj = self._store.get(self._key(group, resource, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{resource}/{name}")
+            return json.loads(json.dumps(obj))
+
+    def list(self, group, version, resource, namespace=None,
+             label_selector: str | None = None) -> list[dict]:
+        sel = {}
+        if label_selector:
+            for part in label_selector.split(","):
+                k, _, v = part.partition("=")
+                sel[k] = v
+        with self._lock:
+            out = []
+            for (g, r, ns, _), obj in self._store.items():
+                if g != group or r != resource:
+                    continue
+                if namespace and ns != namespace:
+                    continue
+                labels = obj.get("metadata", {}).get("labels", {})
+                if all(labels.get(k) == v for k, v in sel.items()):
+                    out.append(json.loads(json.dumps(obj)))
+            return out
+
+    def create(self, group, version, resource, obj, namespace=None) -> dict:
+        name = obj.get("metadata", {}).get("name", "")
+        key = self._key(group, resource, namespace, name)
+        with self._lock:
+            if key in self._store:
+                raise ConflictError(f"{resource}/{name} exists")
+            obj = json.loads(json.dumps(obj))
+            meta = obj.setdefault("metadata", {})
+            if namespace:
+                meta.setdefault("namespace", namespace)
+            if not meta.get("uid"):
+                self._uid += 1
+                meta["uid"] = f"uid-{self._uid}"
+            meta["resourceVersion"] = "1"
+            self._store[key] = obj
+        self._notify("ADDED", obj)
+        return json.loads(json.dumps(obj))
+
+    def update(self, group, version, resource, name, obj, namespace=None) -> dict:
+        key = self._key(group, resource, namespace, name)
+        with self._lock:
+            if key not in self._store:
+                raise NotFoundError(f"{resource}/{name}")
+            old = self._store[key]
+            obj = json.loads(json.dumps(obj))
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("uid", old.get("metadata", {}).get("uid"))
+            rv = int(old.get("metadata", {}).get("resourceVersion", "1"))
+            meta["resourceVersion"] = str(rv + 1)
+            self._store[key] = obj
+        self._notify("MODIFIED", obj)
+        return json.loads(json.dumps(obj))
+
+    def patch(self, group, version, resource, name, patch, namespace=None) -> dict:
+        def merge(dst, src):
+            for k, v in src.items():
+                if v is None:
+                    dst.pop(k, None)
+                elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    merge(dst[k], v)
+                else:
+                    dst[k] = v
+        key = self._key(group, resource, namespace, name)
+        with self._lock:
+            if key not in self._store:
+                raise NotFoundError(f"{resource}/{name}")
+            obj = self._store[key]
+            merge(obj, json.loads(json.dumps(patch)))
+            rv = int(obj.get("metadata", {}).get("resourceVersion", "1"))
+            obj["metadata"]["resourceVersion"] = str(rv + 1)
+            out = json.loads(json.dumps(obj))
+        self._notify("MODIFIED", out)
+        return out
+
+    def delete(self, group, version, resource, name, namespace=None) -> None:
+        key = self._key(group, resource, namespace, name)
+        with self._lock:
+            obj = self._store.pop(key, None)
+        if obj is not None:
+            self._notify("DELETED", obj)
+
+    def server_version(self) -> dict:
+        return self.version

@@ -160,6 +160,19 @@ class TestPrepare:
         mounts = spec["containerEdits"].get("mounts", [])
         assert len(mounts) == 1
 
+    def test_tenancy_mount_is_writable(self, state):
+        cfgs = [{
+            "parameters": opaque("TpuConfig", sharing={
+                "strategy": "MultiTenancy",
+                "multiTenancy": {"maxClients": 2},
+            }),
+        }]
+        state.prepare(make_claim("c1", ["chip-0"], configs=cfgs))
+        spec = state._cdi.read_spec("c1")
+        mount = spec["containerEdits"]["mounts"][0]
+        assert "rw" in mount["options"]
+        assert "ro" not in mount["options"]
+
     def test_sharing_multi_tenancy(self, state):
         cfgs = [{
             "parameters": opaque("TpuConfig", sharing={

@@ -1,0 +1,240 @@
+"""Driver + gRPC end-to-end tests: a fake kubelet dials the plugin's unix
+sockets, claims flow through the API-server (fake) lookup into
+DeviceState, ResourceSlices land in the (fake) API server.
+
+Reference analog: the kubeletplugin helper integration the reference
+gets from upstream, plus driver.go's publication/taint logic.
+"""
+
+import os
+import time
+
+import grpc
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import Config
+from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+from k8s_dra_driver_gpu_tpu.pkg.dra.proto import dra_plugin_pb2 as drapb
+from k8s_dra_driver_gpu_tpu.pkg.dra.proto import plugin_registration_pb2 as regpb
+from k8s_dra_driver_gpu_tpu.pkg.dra.service import (
+    PluginServer,
+    dra_client_stubs,
+    registration_client_stubs,
+)
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from tests.fake_kube import make_claim_dict
+
+
+@pytest.fixture()
+def kube():
+    return FakeKubeClient()
+
+
+@pytest.fixture()
+def driver(tmp_root, kube):
+    d = Driver(
+        Config.mock(root=tmp_root, topology="v5e-4"),
+        kube,
+        node_name="node-a",
+        enable_health_monitor=False,
+    )
+    d.publish_resources()
+    return d
+
+
+def put_claim(kube, uid, devices, **kw):
+    obj = make_claim_dict(uid, devices, **kw)
+    kube.create("resource.k8s.io", "v1", "resourceclaims", obj,
+                namespace=obj["metadata"]["namespace"])
+    return obj
+
+
+class TestResourceSlices:
+    def test_combined_slice_published(self, driver, kube):
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        assert len(slices) == 1
+        spec = slices[0]["spec"]
+        assert spec["driver"] == "tpu.dra.dev"
+        assert spec["nodeName"] == "node-a"
+        names = [d["name"] for d in spec["devices"]]
+        assert "chip-0" in names
+        # Sub-slice carve-outs publish alongside chips in combined mode.
+        assert any(n.startswith("ss-") or "-ss-" in n for n in names)
+        # Shared counters guard core-level overcommit.
+        counters = spec["sharedCounters"][0]["counters"]
+        assert "core-0-0" in counters
+        assert "hbm-0" in counters
+        chip0 = next(d for d in spec["devices"] if d["name"] == "chip-0")
+        assert chip0["consumesCounters"][0]["counters"]["core-0-0"] == {
+            "value": "1"
+        }
+
+    def test_split_slices_on_new_server(self, tmp_root, kube):
+        kube.version = {"major": "1", "minor": "35"}
+        d = Driver(
+            Config.mock(root=os.path.join(tmp_root, "s"), topology="v5e-4"),
+            kube, node_name="node-b", enable_health_monitor=False,
+        )
+        d.publish_resources()
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        assert len(slices) == 2
+        names = {s["metadata"]["name"] for s in slices}
+        assert any("chips" in n for n in names)
+        assert any("partitions" in n for n in names)
+
+    def test_republish_bumps_generation(self, driver, kube):
+        driver.publish_resources()
+        s = kube.list("resource.k8s.io", "v1", "resourceslices")[0]
+        assert s["spec"]["pool"]["generation"] == 2
+
+
+class TestPrepareFlow:
+    def test_prepare_via_api_lookup(self, driver, kube):
+        put_claim(kube, "u1", ["chip-0", "chip-1"], namespace="team-a")
+        out = driver.prepare_resource_claims(
+            [{"uid": "u1", "namespace": "team-a", "name": "u1"}]
+        )
+        devices, err = out["u1"]
+        assert err == ""
+        assert {d["device_name"] for d in devices} == {"chip-0", "chip-1"}
+        assert all(d["pool_name"] == "node-a" for d in devices)
+        assert all(d["cdi_device_ids"] for d in devices)
+
+    def test_uid_mismatch_rejected(self, driver, kube):
+        put_claim(kube, "u1", ["chip-0"])
+        out = driver.prepare_resource_claims(
+            [{"uid": "other-uid", "namespace": "default", "name": "u1"}]
+        )
+        devices, err = out["other-uid"]
+        assert devices == [] and "UID mismatch" in err
+
+    def test_unprepare(self, driver, kube):
+        put_claim(kube, "u1", ["chip-0"])
+        driver.prepare_resource_claims(
+            [{"uid": "u1", "namespace": "default", "name": "u1"}]
+        )
+        out = driver.unprepare_resource_claims([{"uid": "u1"}])
+        assert out == {"u1": ""}
+        assert driver.state.prepared_claims() == {}
+
+
+class TestHealthTaints:
+    def test_taints_republish(self, tmp_root, kube):
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import EnumerateOptions
+
+        cfg = Config.mock(root=tmp_root, topology="v5e-4")
+        d = Driver(cfg, kube, node_name="node-a", enable_health_monitor=False)
+        d.publish_resources()
+        # Simulate a fatal event on chip 1 through the monitor mapping.
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.health import (
+            ChipHealthMonitor,
+        )
+        mon = ChipHealthMonitor(
+            d.state._tpulib,
+            EnumerateOptions(
+                mock_topology="v5e-4",
+                health_events="chip=1,kind=ici_link_down|chip=2,kind=thermal",
+            ),
+            d._on_health_taints,
+        )
+        taints = mon.poll_once()
+        d._on_health_taints(taints)
+        s = kube.list("resource.k8s.io", "v1", "resourceslices")[0]
+        devs = {x["name"]: x for x in s["spec"]["devices"]}
+        assert devs["chip-1"]["taints"][0]["key"] == "tpu.dra.dev/ici_link_down"
+        assert devs["chip-1"]["taints"][0]["effect"] == "NoExecute"
+        # Non-fatal: observe-only taint (no effect key).
+        assert "effect" not in devs["chip-2"]["taints"][0]
+        assert "taints" not in devs["chip-0"]
+
+    def test_ignored_kinds(self):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.health import (
+            health_event_to_taints,
+        )
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import HealthEvent
+
+        assert health_event_to_taints(
+            HealthEvent(chip=0, kind="thermal_notice", fatal=False)
+        ) == []
+
+
+class TestCleanup:
+    def test_stale_claim_reaped(self, driver, kube):
+        put_claim(kube, "u1", ["chip-0"])
+        driver.prepare_resource_claims(
+            [{"uid": "u1", "namespace": "default", "name": "u1"}]
+        )
+        # Claim deleted from the API server behind our back.
+        kube.delete("resource.k8s.io", "v1", "resourceclaims", "u1",
+                    namespace="default")
+        removed = driver.cleanup.cleanup_once()
+        assert removed == ["u1"]
+        assert driver.state.prepared_claims() == {}
+
+    def test_live_claim_kept(self, driver, kube):
+        put_claim(kube, "u1", ["chip-0"])
+        driver.prepare_resource_claims(
+            [{"uid": "u1", "namespace": "default", "name": "u1"}]
+        )
+        assert driver.cleanup.cleanup_once() == []
+        assert "u1" in driver.state.prepared_claims()
+
+    def test_recreated_claim_uid_mismatch_reaped(self, driver, kube):
+        put_claim(kube, "u1", ["chip-0"])
+        driver.prepare_resource_claims(
+            [{"uid": "u1", "namespace": "default", "name": "u1"}]
+        )
+        kube.delete("resource.k8s.io", "v1", "resourceclaims", "u1",
+                    namespace="default")
+        put_claim(kube, "u1-reborn", ["chip-1"], name="u1")
+        assert driver.cleanup.cleanup_once() == ["u1"]
+
+
+class TestGRPCEndToEnd:
+    def test_kubelet_dialog(self, tmp_root, kube):
+        driver = Driver(
+            Config.mock(root=os.path.join(tmp_root, "st"), topology="v5e-4"),
+            kube, node_name="node-a", enable_health_monitor=False,
+        )
+        put_claim(kube, "u1", ["chip-0"], namespace="ns1")
+        server = PluginServer(
+            "tpu.dra.dev",
+            plugin_dir=os.path.join(tmp_root, "plugin"),
+            registry_dir=os.path.join(tmp_root, "registry"),
+            prepare_fn=driver.prepare_resource_claims,
+            unprepare_fn=driver.unprepare_resource_claims,
+        )
+        server.start()
+        try:
+            # Kubelet leg 1: registration handshake.
+            ch, get_info, notify = registration_client_stubs(
+                server.registry_socket
+            )
+            info = get_info(regpb.InfoRequest(), timeout=5)
+            assert info.type == "DRAPlugin"
+            assert info.name == "tpu.dra.dev"
+            assert info.endpoint == server.plugin_socket
+            notify(regpb.RegistrationStatus(plugin_registered=True), timeout=5)
+            assert server.registration.registered
+            ch.close()
+
+            # Kubelet leg 2: prepare/unprepare over the plugin socket.
+            ch2, prepare, unprepare = dra_client_stubs(server.plugin_socket)
+            req = drapb.NodePrepareResourcesRequest()
+            c = req.claims.add()
+            c.uid, c.namespace, c.name = "u1", "ns1", "u1"
+            resp = prepare(req, timeout=10)
+            assert resp.claims["u1"].error == ""
+            assert resp.claims["u1"].devices[0].device_name == "chip-0"
+            assert resp.claims["u1"].devices[0].cdi_device_ids[0].startswith(
+                "k8s.tpu.dra.dev/claim="
+            )
+            # Unknown claim: error in-band, not a transport failure.
+            req2 = drapb.NodeUnprepareResourcesRequest()
+            c2 = req2.claims.add()
+            c2.uid = "u1"
+            resp2 = unprepare(req2, timeout=10)
+            assert resp2.claims["u1"].error == ""
+            ch2.close()
+        finally:
+            server.stop()
