@@ -1,0 +1,52 @@
+"""ComputeDomain stack: gang-prepared multi-host ICI slices.
+
+Reference: the compute-domain.nvidia.com three-binary stack
+(cmd/compute-domain-{controller,kubelet-plugin,daemon}/, SURVEY.md
+§2.2-2.4, §3.3). A ComputeDomain CR names a contiguous multi-host ICI
+slice; the controller materializes a per-CD DaemonSet + workload
+ResourceClaimTemplate; node plugins gate workload Prepare on domain
+readiness and inject slice-membership env; per-node daemons rendezvous
+through ComputeDomainClique CRs and bootstrap the JAX coordination
+service (coordinator = the stable DNS name of clique index 0) -- the
+TPU-native replacement for IMEX daemon supervision.
+"""
+
+COMPUTE_DOMAIN_DRIVER_NAME = "compute-domain.tpu.dra.dev"
+CHANNEL_DEVICE_CLASS = "compute-domain-default-channel.tpu.dra.dev"
+DAEMON_DEVICE_CLASS = "compute-domain-daemon.tpu.dra.dev"
+NODE_LABEL = "resource.tpu.dra/computeDomain"
+CLIQUE_POD_LABEL = "resource.tpu.dra/cliqueId"
+FINALIZER = "resource.tpu.dra/computedomain-finalizer"
+DOMAIN_DAEMON_PORT = 7077  # JAX coordination service port
+API_GROUP = "resource.tpu.dra"
+API_VERSION = "v1beta1"
+
+# Stable daemon DNS name pattern, index-addressable (the reference uses
+# compute-domain-daemon-%04d, dnsnames.go:36-37).
+DAEMON_DNS_PATTERN = "compute-domain-daemon-{index:04d}"
+
+
+def daemon_dns_name(index: int, cd_uid: str = "") -> str:
+    base = DAEMON_DNS_PATTERN.format(index=index)
+    return f"{base}.{cd_uid}" if cd_uid else base
+
+
+def expected_workers(cd_spec: dict) -> int:
+    """How many hosts a ComputeDomain spans: explicit numNodes, else
+    derived from the slice topology and chips-per-host (overridable via
+    spec.chipsPerHost for 8-chip-host generations).
+
+    Single source of truth for the controller's readiness threshold and
+    the daemons' COMPUTE_DOMAIN_NUM_WORKERS -- these MUST agree or the
+    domain can never go Ready.
+    """
+    import math  # noqa: PLC0415
+
+    if cd_spec.get("numNodes"):
+        return cd_spec["numNodes"]
+    topology = cd_spec.get("topology", "")
+    if topology:
+        chips = math.prod(int(d) for d in topology.split("x"))
+        per_host = cd_spec.get("chipsPerHost", 4)
+        return max(1, math.ceil(chips / per_host))
+    return 1

@@ -1,0 +1,1 @@
+"""ComputeDomain controller (reference cmd/compute-domain-controller/)."""

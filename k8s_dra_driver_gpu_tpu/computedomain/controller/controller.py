@@ -1,0 +1,248 @@
+"""The ComputeDomain reconciler.
+
+Reference: cmd/compute-domain-controller/computedomain.go -- on
+add/update: add finalizer, create per-CD DaemonSet + workload RCT, update
+global status (onAddOrUpdate :298-377); on delete: teardown cascade
+RCT -> DaemonSet -> node labels -> finalizer; global status Ready iff
+enough nodes and all Ready (calculateGlobalStatus :257). Status sync
+groups cliques + daemon pods per CD (cdstatus.go:135-242). Orphan GC for
+DaemonSets/RCTs whose CD is gone (cleanup.go, generics CleanupManager).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ...api.computedomain import ComputeDomainStatusValue
+from ...pkg.kubeclient import ConflictError, NotFoundError
+from ...pkg.workqueue import CONTROLLER_DEFAULT_LIMITER, WorkQueue
+from .. import API_GROUP, API_VERSION, FINALIZER, NODE_LABEL, expected_workers
+from .objects import (
+    build_daemon_daemonset,
+    build_daemon_rct,
+    build_workload_rct,
+    daemon_rct_name,
+    daemonset_name,
+)
+
+logger = logging.getLogger(__name__)
+
+CD_RESOURCE = "computedomains"
+CLIQUE_RESOURCE = "computedomaincliques"
+
+
+class ComputeDomainController:
+    def __init__(self, kube, driver_namespace: str = "tpu-dra-driver"):
+        self.kube = kube
+        self.ns = driver_namespace
+        self.queue = WorkQueue(
+            limiter=CONTROLLER_DEFAULT_LIMITER, name="cd-controller"
+        )
+        self._stop = threading.Event()
+        self._resync_thread = threading.Thread(
+            target=self._resync_loop, name="cd-resync", daemon=True
+        )
+        if hasattr(kube, "add_watcher"):
+            kube.add_watcher(self._on_event)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, resync_interval: float = 30.0) -> None:
+        self._resync_interval = resync_interval
+        self.sync_all()
+        self._resync_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self._resync_interval):
+            try:
+                self.sync_all()
+            except Exception:  # noqa: BLE001
+                logger.exception("resync failed")
+
+    def _on_event(self, event_type: str, obj: dict) -> None:
+        kind = obj.get("kind", "")
+        if kind == "ComputeDomain":
+            key = (obj["metadata"].get("namespace", "default"),
+                   obj["metadata"]["name"])
+            self.queue.enqueue(key, self._reconcile_key)
+        elif kind in ("ComputeDomainClique", "Pod"):
+            # Status inputs changed: resync every domain that matches.
+            for cd in self._list_cds():
+                key = (cd["metadata"].get("namespace", "default"),
+                       cd["metadata"]["name"])
+                self.queue.enqueue(key, self._reconcile_key)
+
+    def sync_all(self) -> None:
+        for cd in self._list_cds():
+            key = (cd["metadata"].get("namespace", "default"),
+                   cd["metadata"]["name"])
+            self.queue.enqueue(key, self._reconcile_key)
+        self.cleanup_orphans()
+
+    def _list_cds(self) -> list[dict]:
+        try:
+            return self.kube.list(API_GROUP, API_VERSION, CD_RESOURCE)
+        except Exception:  # noqa: BLE001
+            logger.exception("listing ComputeDomains failed")
+            return []
+
+    def _reconcile_key(self, key) -> None:
+        namespace, name = key
+        try:
+            cd = self.kube.get(API_GROUP, API_VERSION, CD_RESOURCE, name,
+                               namespace=namespace)
+        except NotFoundError:
+            return
+        self.reconcile(cd)
+
+    # -- reconcile ------------------------------------------------------------
+
+    def reconcile(self, cd: dict) -> None:
+        meta = cd["metadata"]
+        if meta.get("deletionTimestamp"):
+            self._teardown(cd)
+            return
+        if FINALIZER not in meta.get("finalizers", []):
+            meta.setdefault("finalizers", []).append(FINALIZER)
+            cd = self.kube.update(
+                API_GROUP, API_VERSION, CD_RESOURCE, meta["name"], cd,
+                namespace=meta.get("namespace", "default"),
+            )
+        self._ensure(build_daemon_rct(cd, self.ns), "resourceclaimtemplates",
+                     "resource.k8s.io", "v1", self.ns)
+        self._ensure(build_daemon_daemonset(cd, self.ns), "daemonsets",
+                     "apps", "v1", self.ns)
+        workload_rct = build_workload_rct(cd)
+        if workload_rct["metadata"]["name"]:
+            self._ensure(workload_rct, "resourceclaimtemplates",
+                         "resource.k8s.io", "v1",
+                         workload_rct["metadata"]["namespace"])
+        self.update_global_status(cd)
+
+    def _ensure(self, obj, resource, group, version, namespace) -> None:
+        try:
+            self.kube.create(group, version, resource, obj,
+                             namespace=namespace)
+        except ConflictError:
+            pass  # already exists; spec is immutable per CD generation
+
+    # -- status ---------------------------------------------------------------
+
+    def _expected_nodes(self, cd: dict) -> int:
+        return expected_workers(cd.get("spec", {}))
+
+    def update_global_status(self, cd: dict) -> None:
+        """Aggregate clique daemons into CD.status (cdstatus.go:135-242 +
+        calculateGlobalStatus computedomain.go:257)."""
+        uid = cd["metadata"]["uid"]
+        nodes: list[dict] = []
+        for clique in self.kube.list(API_GROUP, API_VERSION, CLIQUE_RESOURCE):
+            if clique.get("spec", {}).get("computeDomainUID") != uid:
+                continue
+            nodes.extend(clique.get("status", {}).get("daemons", []))
+        expected = self._expected_nodes(cd)
+        ready = (
+            len(nodes) >= expected
+            and all(
+                n.get("status") == ComputeDomainStatusValue.READY
+                for n in nodes
+            )
+            and expected > 0
+        )
+        status = {
+            "status": (
+                ComputeDomainStatusValue.READY
+                if ready
+                else ComputeDomainStatusValue.NOT_READY
+            ),
+            "nodes": sorted(nodes, key=lambda n: n.get("index", -1)),
+        }
+        if cd.get("status") == status:
+            return
+        try:
+            self.kube.patch(
+                API_GROUP, API_VERSION, CD_RESOURCE,
+                cd["metadata"]["name"], {"status": status},
+                namespace=cd["metadata"].get("namespace", "default"),
+            )
+        except NotFoundError:
+            pass
+
+    # -- teardown + orphan GC ---------------------------------------------------
+
+    def _teardown(self, cd: dict) -> None:
+        """Deletion cascade: workload RCT -> daemon RCT -> DaemonSet ->
+        cliques -> finalizer (onAddOrUpdate delete path :298-361)."""
+        meta = cd["metadata"]
+        uid = meta["uid"]
+        channel = (cd.get("spec", {}).get("channel") or {})
+        rct = (channel.get("resourceClaimTemplate") or {}).get("name")
+        if rct:
+            self.kube.delete("resource.k8s.io", "v1",
+                             "resourceclaimtemplates", rct,
+                             namespace=meta.get("namespace", "default"))
+        self.kube.delete("resource.k8s.io", "v1", "resourceclaimtemplates",
+                         daemon_rct_name(meta["name"]), namespace=self.ns)
+        self.kube.delete("apps", "v1", "daemonsets", daemonset_name(uid),
+                         namespace=self.ns)
+        for clique in self.kube.list(API_GROUP, API_VERSION, CLIQUE_RESOURCE):
+            if clique.get("spec", {}).get("computeDomainUID") == uid:
+                self.kube.delete(
+                    API_GROUP, API_VERSION, CLIQUE_RESOURCE,
+                    clique["metadata"]["name"],
+                    namespace=clique["metadata"].get("namespace"),
+                )
+        self._remove_node_labels(uid)
+        finalizers = [f for f in meta.get("finalizers", []) if f != FINALIZER]
+        try:
+            self.kube.patch(
+                API_GROUP, API_VERSION, CD_RESOURCE, meta["name"],
+                {"metadata": {"finalizers": finalizers or None}},
+                namespace=meta.get("namespace", "default"),
+            )
+        except NotFoundError:
+            pass
+
+    def _remove_node_labels(self, cd_uid: str) -> None:
+        """node.go RemoveComputeDomainLabels analog."""
+        try:
+            nodes = self.kube.list("", "v1", "nodes",
+                                   label_selector=f"{NODE_LABEL}={cd_uid}")
+        except Exception:  # noqa: BLE001
+            return
+        for node in nodes:
+            self.kube.patch(
+                "", "v1", "nodes", node["metadata"]["name"],
+                {"metadata": {"labels": {NODE_LABEL: None}}},
+            )
+
+    def cleanup_orphans(self) -> None:
+        """Periodic orphan GC: DaemonSets/RCTs labeled for a CD that no
+        longer exists (cleanup.go CleanupManager[T])."""
+        live_uids = {
+            cd["metadata"]["uid"] for cd in self._list_cds()
+        }
+        for group, version, resource, ns in (
+            ("apps", "v1", "daemonsets", self.ns),
+            ("resource.k8s.io", "v1", "resourceclaimtemplates", None),
+        ):
+            try:
+                objs = self.kube.list(group, version, resource, namespace=ns)
+            except Exception:  # noqa: BLE001
+                continue
+            for obj in objs:
+                uid = obj.get("metadata", {}).get("labels", {}).get(NODE_LABEL)
+                if uid and uid not in live_uids:
+                    logger.warning(
+                        "GC orphan %s/%s (CD %s gone)",
+                        resource, obj["metadata"]["name"], uid,
+                    )
+                    self.kube.delete(
+                        group, version, resource, obj["metadata"]["name"],
+                        namespace=obj["metadata"].get("namespace"),
+                    )

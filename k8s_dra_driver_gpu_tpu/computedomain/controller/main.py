@@ -1,0 +1,80 @@
+"""compute-domain-controller entry with leader election.
+
+Reference: cmd/compute-domain-controller/main.go -- flags including
+max-nodes-per-domain (:56-59), Lease-based leader election with
+release-on-cancel (runWithLeaderElection :277-377), metrics + pprof mux
+(:379).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from ...pkg.kubeclient import FakeKubeClient, KubeClient
+from ...pkg.leaderelection import LeaderElector
+from ...pkg.metrics import ComputeDomainMetrics, MetricsServer
+from .controller import ComputeDomainController
+
+logger = logging.getLogger(__name__)
+
+
+def run(argv: list[str] | None = None) -> int:
+    env = os.environ.get
+    p = argparse.ArgumentParser(prog="compute-domain-controller")
+    p.add_argument("--namespace", default=env("DRIVER_NAMESPACE",
+                                              "tpu-dra-driver"))
+    p.add_argument("--max-nodes-per-domain", type=int,
+                   default=int(env("MAX_NODES_PER_DOMAIN", "64")),
+                   help="largest gang a single domain may span "
+                        "(reference caps IMEX domains at 18)")
+    p.add_argument("--metrics-port", type=int,
+                   default=int(env("METRICS_PORT", "0")))
+    p.add_argument("--leader-election", action="store_true",
+                   default=env("LEADER_ELECTION", "") == "true")
+    p.add_argument("--lease-name", default="tpu-dra-cd-controller")
+    p.add_argument("--identity", default=env("POD_NAME", os.uname().nodename))
+    p.add_argument("--standalone", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    kube = FakeKubeClient() if args.standalone else KubeClient()
+    metrics = ComputeDomainMetrics()
+    metrics_server = None
+    if args.metrics_port > 0:
+        metrics_server = MetricsServer(metrics.registry, host="0.0.0.0",
+                                       port=args.metrics_port)
+        metrics_server.start()
+
+    controller = ComputeDomainController(kube, args.namespace)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    def lead():
+        controller.start()
+        stop.wait()
+        controller.stop()
+
+    if args.leader_election:
+        elector = LeaderElector(
+            kube, lease_name=args.lease_name, namespace=args.namespace,
+            identity=args.identity,
+        )
+        elector.run(lead, stop)
+    else:
+        lead()
+    if metrics_server:
+        metrics_server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

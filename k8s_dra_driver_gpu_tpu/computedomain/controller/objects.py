@@ -1,0 +1,215 @@
+"""Builders for the k8s objects the controller materializes per CD.
+
+Reference: templates/compute-domain-daemon.tmpl.yaml rendered by
+daemonset.go:190-254, and the two ResourceClaimTemplate flavors
+(resourceclaimtemplate.go:304-398).
+"""
+
+from __future__ import annotations
+
+from .. import (
+    API_GROUP,
+    API_VERSION,
+    CHANNEL_DEVICE_CLASS,
+    CLIQUE_POD_LABEL,
+    DAEMON_DEVICE_CLASS,
+    DOMAIN_DAEMON_PORT,
+    NODE_LABEL,
+)
+
+DAEMON_IMAGE = "ghcr.io/tpu-dra-driver/compute-domain-daemon:latest"
+
+
+def daemonset_name(cd_uid: str) -> str:
+    return f"computedomain-daemon-{cd_uid}"
+
+
+def daemon_rct_name(cd_name: str) -> str:
+    return f"{cd_name}-daemon-claim"
+
+
+def build_daemon_daemonset(cd: dict, namespace: str) -> dict:
+    """The per-CD DaemonSet. Its nodeSelector matches the CD node label
+    that the kubelet plugin sets during a workload-channel Prepare --
+    that label is the rendezvous that makes daemons appear exactly on
+    nodes running this domain's workload (computedomain.go:312-364)."""
+    uid = cd["metadata"]["uid"]
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {
+            "name": daemonset_name(uid),
+            "namespace": namespace,
+            "labels": {NODE_LABEL: uid},
+            "ownerReferences": [_owner_ref(cd)],
+        },
+        "spec": {
+            "selector": {"matchLabels": {NODE_LABEL: uid}},
+            "template": {
+                "metadata": {"labels": {NODE_LABEL: uid}},
+                "spec": {
+                    "nodeSelector": {NODE_LABEL: uid},
+                    "containers": [
+                        {
+                            "name": "compute-domain-daemon",
+                            "image": DAEMON_IMAGE,
+                            "command": [
+                                "python", "-m",
+                                "k8s_dra_driver_gpu_tpu.computedomain.daemon.main",
+                                "run",
+                            ],
+                            # Downward-API identity: the daemon registers
+                            # its real pod IP/name in the Clique CR.
+                            "env": [
+                                {"name": "POD_IP", "valueFrom": {"fieldRef": {
+                                    "fieldPath": "status.podIP"}}},
+                                {"name": "POD_NAME", "valueFrom": {"fieldRef": {
+                                    "fieldPath": "metadata.name"}}},
+                                {"name": "NODE_NAME", "valueFrom": {"fieldRef": {
+                                    "fieldPath": "spec.nodeName"}}},
+                                {"name": "DRIVER_NAMESPACE", "valueFrom": {
+                                    "fieldRef": {
+                                        "fieldPath": "metadata.namespace"}}},
+                            ],
+                            "ports": [
+                                {"containerPort": DOMAIN_DAEMON_PORT,
+                                 "name": "coordinator"}
+                            ],
+                            "startupProbe": _probe("startup"),
+                            "readinessProbe": _probe("readiness"),
+                            "livenessProbe": _probe("liveness"),
+                            "resources": {
+                                "claims": [{"name": "daemon-claim"}]
+                            },
+                        }
+                    ],
+                    "resourceClaims": [
+                        {
+                            "name": "daemon-claim",
+                            "resourceClaimTemplateName": daemon_rct_name(
+                                cd["metadata"]["name"]
+                            ),
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def _probe(kind: str) -> dict:
+    """Probe budgets mirror the reference daemon
+    (compute-domain-daemon.tmpl.yaml:74-100: startup 1s x 1200,
+    readiness every 10s, liveness 60s x 20)."""
+    exec_check = {
+        "exec": {
+            "command": [
+                "python", "-m",
+                "k8s_dra_driver_gpu_tpu.computedomain.daemon.main",
+                "check",
+            ]
+        }
+    }
+    if kind == "startup":
+        return {**exec_check, "periodSeconds": 1, "failureThreshold": 1200}
+    if kind == "readiness":
+        return {**exec_check, "periodSeconds": 10, "failureThreshold": 1}
+    return {**exec_check, "periodSeconds": 60, "failureThreshold": 20}
+
+
+def build_daemon_rct(cd: dict, namespace: str) -> dict:
+    """Daemon ResourceClaimTemplate (deviceClass daemon, opaque
+    ComputeDomainDaemonConfig{domainID})."""
+    uid = cd["metadata"]["uid"]
+    return {
+        "apiVersion": "resource.k8s.io/v1",
+        "kind": "ResourceClaimTemplate",
+        "metadata": {
+            "name": daemon_rct_name(cd["metadata"]["name"]),
+            "namespace": namespace,
+            "labels": {NODE_LABEL: uid},
+            "ownerReferences": [_owner_ref(cd)],
+        },
+        "spec": {
+            "spec": {
+                "devices": {
+                    "requests": [
+                        {
+                            "name": "daemon",
+                            "deviceClassName": DAEMON_DEVICE_CLASS,
+                        }
+                    ],
+                    "config": [
+                        {
+                            "requests": ["daemon"],
+                            "opaque": {
+                                "driver": "compute-domain.tpu.dra.dev",
+                                "parameters": {
+                                    "apiVersion": f"{API_GROUP}/{API_VERSION}",
+                                    "kind": "ComputeDomainDaemonConfig",
+                                    "domainID": uid,
+                                },
+                            },
+                        }
+                    ],
+                }
+            }
+        },
+    }
+
+
+def build_workload_rct(cd: dict) -> dict:
+    """Workload-channel ResourceClaimTemplate, created in the USER'S
+    namespace (resourceclaimtemplate.go:364-398)."""
+    uid = cd["metadata"]["uid"]
+    spec = cd.get("spec", {})
+    channel = spec.get("channel") or {}
+    rct_name = (channel.get("resourceClaimTemplate") or {}).get("name", "")
+    return {
+        "apiVersion": "resource.k8s.io/v1",
+        "kind": "ResourceClaimTemplate",
+        "metadata": {
+            "name": rct_name,
+            "namespace": cd["metadata"].get("namespace", "default"),
+            "labels": {NODE_LABEL: uid},
+            "ownerReferences": [_owner_ref(cd)],
+        },
+        "spec": {
+            "spec": {
+                "devices": {
+                    "requests": [
+                        {
+                            "name": "channel",
+                            "deviceClassName": CHANNEL_DEVICE_CLASS,
+                        }
+                    ],
+                    "config": [
+                        {
+                            "requests": ["channel"],
+                            "opaque": {
+                                "driver": "compute-domain.tpu.dra.dev",
+                                "parameters": {
+                                    "apiVersion": f"{API_GROUP}/{API_VERSION}",
+                                    "kind": "ComputeDomainChannelConfig",
+                                    "domainID": uid,
+                                    "allocationMode": channel.get(
+                                        "allocationMode", "Single"
+                                    ),
+                                },
+                            },
+                        }
+                    ],
+                }
+            }
+        },
+    }
+
+
+def _owner_ref(cd: dict) -> dict:
+    return {
+        "apiVersion": f"{API_GROUP}/{API_VERSION}",
+        "kind": "ComputeDomain",
+        "name": cd["metadata"]["name"],
+        "uid": cd["metadata"]["uid"],
+        "controller": True,
+    }

@@ -1,0 +1,1 @@
+"""Per-node ComputeDomain daemon (reference cmd/compute-domain-daemon/)."""

@@ -1,0 +1,135 @@
+"""Clique registration: the daemon's rendezvous through the API server.
+
+Reference: cmd/compute-domain-daemon/cdclique.go -- each daemon writes
+its {nodeName, IP, cliqueID, index, status} into a ComputeDomainClique CR
+named "<cdUID>.<cliqueID>"; the index is the first free slot (:350),
+conflict-retried; readiness flips the entry's status (:429). On TPU a
+clique is one ICI-connected slice: every host of the slice shares the
+clique (cross-clique traffic is DCN).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from ...pkg.kubeclient import ConflictError, NotFoundError
+from .. import API_GROUP, API_VERSION
+
+logger = logging.getLogger(__name__)
+
+CLIQUE_RESOURCE = "computedomaincliques"
+
+
+def clique_name(cd_uid: str, clique_id: str) -> str:
+    return f"{cd_uid}.{clique_id}"
+
+
+class CliqueRegistrar:
+    def __init__(
+        self,
+        kube,
+        cd_uid: str,
+        clique_id: str,
+        node_name: str,
+        ip_address: str,
+        namespace: str = "tpu-dra-driver",
+    ):
+        self.kube = kube
+        self.cd_uid = cd_uid
+        self.clique_id = clique_id
+        self.node_name = node_name
+        self.ip_address = ip_address
+        self.namespace = namespace
+        self.index: int | None = None
+
+    @property
+    def name(self) -> str:
+        return clique_name(self.cd_uid, self.clique_id)
+
+    def _get_or_create(self) -> dict:
+        try:
+            return self.kube.get(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
+                                 self.name, namespace=self.namespace)
+        except NotFoundError:
+            obj = {
+                "apiVersion": f"{API_GROUP}/{API_VERSION}",
+                "kind": "ComputeDomainClique",
+                "metadata": {"name": self.name, "namespace": self.namespace},
+                "spec": {
+                    "computeDomainUID": self.cd_uid,
+                    "cliqueID": self.clique_id,
+                },
+                "status": {"daemons": []},
+            }
+            try:
+                return self.kube.create(API_GROUP, API_VERSION,
+                                        CLIQUE_RESOURCE, obj,
+                                        namespace=self.namespace)
+            except ConflictError:
+                return self.kube.get(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
+                                     self.name, namespace=self.namespace)
+
+    def register(self, status: str = "NotReady", retries: int = 10) -> int:
+        """Write our entry; index = existing or first free slot
+        (cdclique.go:350), retried on write conflicts."""
+        for attempt in range(retries):
+            obj = self._get_or_create()
+            daemons = obj.setdefault("status", {}).setdefault("daemons", [])
+            mine = next(
+                (d for d in daemons if d.get("name") == self.node_name), None
+            )
+            if mine is None:
+                used = {d.get("index") for d in daemons}
+                index = next(i for i in range(len(daemons) + 1)
+                             if i not in used)
+                daemons.append({
+                    "name": self.node_name,
+                    "ipAddress": self.ip_address,
+                    "cliqueID": self.clique_id,
+                    "index": index,
+                    "status": status,
+                })
+            else:
+                mine["ipAddress"] = self.ip_address
+                mine["status"] = status
+                index = mine["index"]
+            try:
+                self.kube.update(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
+                                 self.name, obj, namespace=self.namespace)
+                self.index = index
+                return index
+            except ConflictError:
+                logger.info("clique write conflict (attempt %d)", attempt + 1)
+                time.sleep(0.05 * (attempt + 1))
+        raise RuntimeError(f"could not register in clique {self.name}")
+
+    def set_status(self, status: str) -> None:
+        self.register(status=status)
+
+    def members(self) -> list[dict]:
+        try:
+            obj = self.kube.get(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
+                                self.name, namespace=self.namespace)
+        except NotFoundError:
+            return []
+        return sorted(
+            obj.get("status", {}).get("daemons", []),
+            key=lambda d: d.get("index", -1),
+        )
+
+    def deregister(self) -> None:
+        try:
+            obj = self.kube.get(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
+                                self.name, namespace=self.namespace)
+        except NotFoundError:
+            return
+        daemons = obj.get("status", {}).get("daemons", [])
+        obj["status"]["daemons"] = [
+            d for d in daemons if d.get("name") != self.node_name
+        ]
+        try:
+            self.kube.update(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
+                             self.name, obj, namespace=self.namespace)
+        except (ConflictError, NotFoundError):
+            pass

@@ -1,0 +1,57 @@
+"""Stable index -> DNS name mapping + hosts-file rewriting.
+
+Reference: cmd/compute-domain-daemon/dnsnames.go -- stable
+compute-domain-daemon-%04d names per clique index; peer IP changes only
+rewrite /etc/hosts and nudge the daemon (no restart), so a node
+replacement never disrupts the rest of the gang (main.go:390-431).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .. import daemon_dns_name
+
+HOSTS_MARKER_BEGIN = "# BEGIN tpu-compute-domain\n"
+HOSTS_MARKER_END = "# END tpu-compute-domain\n"
+
+
+def dns_name_mappings(nodes: list[dict]) -> dict[str, str]:
+    """index-stable DNS name -> IP for every known daemon."""
+    out = {}
+    for n in nodes:
+        index = n.get("index", -1)
+        ip = n.get("ipAddress", "")
+        if index >= 0 and ip:
+            out[daemon_dns_name(index)] = ip
+    return out
+
+
+def update_hosts_file(path: str, mappings: dict[str, str]) -> bool:
+    """Idempotently rewrite the managed block; returns True on change."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            content = f.read()
+    except FileNotFoundError:
+        content = ""
+    begin = content.find(HOSTS_MARKER_BEGIN)
+    end = content.find(HOSTS_MARKER_END)
+    if begin != -1 and end != -1:
+        head = content[:begin]
+        tail = content[end + len(HOSTS_MARKER_END):]
+    else:
+        head, tail = content, ""
+        if head and not head.endswith("\n"):
+            head += "\n"
+    block = HOSTS_MARKER_BEGIN
+    for name in sorted(mappings):
+        block += f"{mappings[name]}\t{name}\n"
+    block += HOSTS_MARKER_END
+    new_content = head + block + tail
+    if new_content == content:
+        return False
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(new_content)
+    os.replace(tmp, path)
+    return True

@@ -1,0 +1,229 @@
+"""compute-domain-daemon entry: `run` and `check` subcommands.
+
+Reference: cmd/compute-domain-daemon/main.go -- identity via CDI-injected
+env (:44-51), pod clique label (:536), config render (:461), three
+concurrent loops: controller (clique registration), update loop (peer
+changes -> hosts rewrite + SIGUSR1, DNS-names mode :390-431), process
+watchdog (:333). `check` = probe shelling to `nvidia-imex-ctl -q`
+expecting READY (:435-459); here it queries the coordination service.
+
+The daemon's workload-facing output is the BOOTSTRAP FILE
+(<state>/bootstrap.json): coordinator address (index-0 stable DNS name),
+this host's worker id, and worker hostnames -- exactly what
+jax.distributed.initialize needs on every pod of the gang.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+
+from ...pkg.kubeclient import FakeKubeClient, KubeClient
+from .. import DOMAIN_DAEMON_PORT, daemon_dns_name
+from .clique import CliqueRegistrar
+from .dnsnames import dns_name_mappings, update_hosts_file
+from .process import ProcessManager
+from .rendezvous import query
+
+logger = logging.getLogger(__name__)
+
+POLL_INTERVAL_S = 2.0
+
+
+class DaemonConfig:
+    """Identity + paths, from the env the CD plugin injected."""
+
+    def __init__(self, env=os.environ):
+        self.cd_uid = env.get("COMPUTE_DOMAIN_UUID", "")
+        self.cd_name = env.get("COMPUTE_DOMAIN_NAME", "")
+        self.cd_namespace = env.get("COMPUTE_DOMAIN_NAMESPACE", "default")
+        self.clique_id = env.get("CLIQUE_ID", "0")
+        self.node_name = env.get("NODE_NAME", os.uname().nodename)
+        self.pod_ip = env.get("POD_IP", "127.0.0.1")
+        self.pod_name = env.get("POD_NAME", "")
+        self.num_workers = int(env.get("COMPUTE_DOMAIN_NUM_WORKERS", "1"))
+        self.state_dir = env.get("DOMAIN_STATE_DIR", "/var/run/tpu-domain")
+        self.hosts_file = env.get("HOSTS_FILE", "/etc/hosts")
+        self.port = int(env.get("COORDINATION_PORT", str(DOMAIN_DAEMON_PORT)))
+        self.driver_namespace = env.get("DRIVER_NAMESPACE", "tpu-dra-driver")
+        self.standalone = env.get("CD_DAEMON_STANDALONE", "") == "1"
+
+
+class Daemon:
+    def __init__(self, config: DaemonConfig, kube=None):
+        self.cfg = config
+        self.kube = kube or (
+            FakeKubeClient() if config.standalone else KubeClient()
+        )
+        os.makedirs(config.state_dir, exist_ok=True)
+        self.members_file = os.path.join(config.state_dir, "members.json")
+        self.bootstrap_file = os.path.join(config.state_dir, "bootstrap.json")
+        self.registrar = CliqueRegistrar(
+            self.kube,
+            cd_uid=config.cd_uid,
+            clique_id=config.clique_id,
+            node_name=config.node_name,
+            ip_address=config.pod_ip,
+            namespace=config.driver_namespace,
+        )
+        self._write_members([])  # exists before the child starts
+        # The child must resolve this package regardless of how the
+        # daemon itself was launched.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        child_env = dict(os.environ)
+        child_env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + child_env.get("PYTHONPATH", "")
+        ).rstrip(os.pathsep)
+        self.process = ProcessManager([
+            sys.executable, "-m",
+            "k8s_dra_driver_gpu_tpu.computedomain.daemon.rendezvous",
+            "--members-file", self.members_file,
+            "--port", str(config.port),
+        ], env=child_env)
+        self._stop = threading.Event()
+        self._last_members: list[dict] | None = None
+
+    # -- membership/bootstrap files --------------------------------------------
+
+    def _write_members(self, members: list[dict]) -> None:
+        doc = {
+            "computeDomain": self.cfg.cd_uid,
+            "cliqueID": self.cfg.clique_id,
+            "numWorkers": self.cfg.num_workers,
+            "workers": members,
+        }
+        tmp = self.members_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, self.members_file)
+
+    def _write_bootstrap(self, members: list[dict], my_index: int) -> None:
+        """The JAX bootstrap contract consumed by workload pods."""
+        coordinator = f"{daemon_dns_name(0)}:{self.cfg.port}"
+        doc = {
+            "coordinatorAddress": coordinator,
+            "numProcesses": self.cfg.num_workers,
+            "processId": my_index,
+            "workerHostnames": [
+                daemon_dns_name(m.get("index", -1)) for m in members
+            ],
+        }
+        tmp = self.bootstrap_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, self.bootstrap_file)
+
+    # -- pod label ---------------------------------------------------------------
+
+    def _label_own_pod(self) -> None:
+        if not self.cfg.pod_name:
+            return
+        from .. import CLIQUE_POD_LABEL  # noqa: PLC0415
+
+        try:
+            self.kube.patch(
+                "", "v1", "pods", self.cfg.pod_name,
+                {"metadata": {"labels": {
+                    CLIQUE_POD_LABEL: self.cfg.clique_id}}},
+                namespace=self.cfg.driver_namespace,
+            )
+        except Exception:  # noqa: BLE001 - label is advisory
+            logger.exception("labeling own pod failed")
+
+    # -- main loops ---------------------------------------------------------------
+
+    def sync_once(self) -> None:
+        """One pass of the update loop: clique members -> members file +
+        hosts + bootstrap; SIGUSR1 the child on change (DNS-names mode:
+        no restart, no workload disruption)."""
+        members = self.registrar.members()
+        if members == self._last_members:
+            return
+        self._last_members = members
+        self._write_members(members)
+        if self.registrar.index is not None:
+            self._write_bootstrap(members, self.registrar.index)
+        try:
+            update_hosts_file(self.cfg.hosts_file, dns_name_mappings(members))
+        except OSError:
+            logger.exception("hosts file update failed")
+        self.process.ensure_started()
+        # Nudge a RUNNING service only: a SIGUSR1 during interpreter
+        # startup (before the handler is registered) would kill the
+        # child. A freshly started child reads the members file itself.
+        try:
+            query("127.0.0.1", self.cfg.port, "STATUS", timeout=1.0)
+        except OSError:
+            logger.info("coordination service not answering yet; no nudge")
+        else:
+            self.process.signal(signal.SIGUSR1)
+        logger.info("membership: %d/%d worker(s)",
+                    len(members), self.cfg.num_workers)
+
+    def run(self) -> int:
+        logger.info(
+            "compute-domain-daemon starting: cd=%s clique=%s node=%s",
+            self.cfg.cd_uid, self.cfg.clique_id, self.cfg.node_name,
+        )
+        self._label_own_pod()
+        index = self.registrar.register(status="NotReady")
+        logger.info("registered as worker index %d", index)
+
+        self.process.ensure_started()
+        self.process.start_watchdog()
+
+        signal.signal(signal.SIGTERM, lambda *a: self._stop.set())
+        signal.signal(signal.SIGINT, lambda *a: self._stop.set())
+
+        ready_reported = False
+        while not self._stop.wait(POLL_INTERVAL_S):
+            try:
+                self.sync_once()
+                if self.process.alive() and not ready_reported:
+                    self.registrar.set_status("Ready")
+                    ready_reported = True
+                    self._last_members = None  # re-sync with own Ready
+                elif not self.process.alive() and ready_reported:
+                    self.registrar.set_status("NotReady")
+                    ready_reported = False
+            except Exception:  # noqa: BLE001 - daemon must survive
+                logger.exception("sync failed")
+        self.registrar.deregister()
+        self.process.stop()
+        return 0
+
+
+def check(config: DaemonConfig) -> int:
+    """Probe: the coordination service must answer READY
+    (reference `compute-domain-daemon check`, main.go:435-459)."""
+    try:
+        answer = query("127.0.0.1", config.port, "STATUS")
+    except OSError as e:
+        print(f"NOT_READY ({e})")
+        return 1
+    print(answer)
+    return 0 if answer == "READY" else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="compute-domain-daemon")
+    p.add_argument("command", choices=["run", "check"])
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    config = DaemonConfig()
+    if args.command == "check":
+        return check(config)
+    return Daemon(config).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

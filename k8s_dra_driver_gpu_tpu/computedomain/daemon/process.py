@@ -1,0 +1,111 @@
+"""Child-process supervisor for the coordination service.
+
+Reference: cmd/compute-domain-daemon/process.go -- ProcessManager with
+Restart/EnsureStarted/Signal/stop (SIGTERM -> 5s -> SIGKILL) and a
+Watchdog goroutine auto-restarting on unexpected exit with 1s backoff
+(:169-203). The supervised child there is nvidia-imex; here it is the
+TPU coordination-service stub (rendezvous.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import subprocess
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+TERM_GRACE_S = 5.0
+RESTART_BACKOFF_S = 1.0
+
+
+class ProcessManager:
+    def __init__(self, argv: list[str], env: dict | None = None):
+        self._argv = argv
+        self._env = env
+        self._proc: subprocess.Popen | None = None
+        self._lock = threading.Lock()
+        self._expected_exit = False
+        self._watchdog_stop = threading.Event()
+        self._watchdog_thread: threading.Thread | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def ensure_started(self) -> None:
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                return
+            self._start_locked()
+
+    def restart(self) -> None:
+        with self._lock:
+            self._stop_locked()
+            self._start_locked()
+
+    def signal(self, sig: int = signal.SIGUSR1) -> None:
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                self._proc.send_signal(sig)
+
+    def stop(self) -> None:
+        self._watchdog_stop.set()
+        with self._lock:
+            self._expected_exit = True
+            self._stop_locked()
+        if self._watchdog_thread:
+            self._watchdog_thread.join(timeout=RESTART_BACKOFF_S + 1)
+
+    def alive(self) -> bool:
+        with self._lock:
+            return self._proc is not None and self._proc.poll() is None
+
+    @property
+    def pid(self) -> int | None:
+        with self._lock:
+            return self._proc.pid if self._proc else None
+
+    # -- internals ------------------------------------------------------------
+
+    def _start_locked(self) -> None:
+        self._expected_exit = False
+        self._proc = subprocess.Popen(self._argv, env=self._env)
+        logger.info("started %s (pid %d)", self._argv[0], self._proc.pid)
+
+    def _stop_locked(self) -> None:
+        proc = self._proc
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=TERM_GRACE_S)
+        except subprocess.TimeoutExpired:
+            logger.warning("child %d ignored SIGTERM; killing", proc.pid)
+            proc.kill()
+            proc.wait()
+
+    # -- watchdog ---------------------------------------------------------------
+
+    def start_watchdog(self) -> None:
+        self._watchdog_thread = threading.Thread(
+            target=self._watchdog, name="process-watchdog", daemon=True
+        )
+        self._watchdog_thread.start()
+
+    def _watchdog(self) -> None:
+        while not self._watchdog_stop.wait(RESTART_BACKOFF_S):
+            with self._lock:
+                dead = (
+                    self._proc is not None
+                    and self._proc.poll() is not None
+                    and not self._expected_exit
+                )
+            if dead:
+                logger.warning(
+                    "coordination service exited unexpectedly; restarting"
+                )
+                time.sleep(RESTART_BACKOFF_S)
+                with self._lock:
+                    if not self._expected_exit:
+                        self._start_locked()

@@ -1,0 +1,127 @@
+"""The coordination service the daemon supervises.
+
+This is the nvidia-imex analog for TPU: ICI itself needs no userland
+memory-export daemon, but multi-host JAX needs (a) a rendezvous that
+hands every worker the coordinator address + its worker id, and (b) peer
+liveness the gang can gate on. This small TCP service provides both:
+
+  STATUS\n  -> READY\n | NOT_READY\n   (quorum state; probes use this,
+               the analog of `nvidia-imex-ctl -q` == READY)
+  MEMBERS\n -> one-line JSON of the current membership (workers, ips,
+               coordinator address, worker count)
+
+Membership lives in a JSON file the daemon rewrites on peer changes;
+SIGUSR1 reloads it without dropping connections (the reference's
+DNS-names mode uses SIGUSR1 on nvidia-imex for non-disruptive updates,
+main.go:390-431). Quorum: READY once all expected workers appear
+(IMEX_WAIT_FOR_QUORUM analog).
+
+Run as a child process:
+    python -m ...daemon.rendezvous --members-file F --port N
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import socketserver
+import sys
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+class MembershipState:
+    def __init__(self, members_file: str):
+        self._file = members_file
+        self._lock = threading.Lock()
+        self._doc: dict = {}
+        self.reload()
+
+    def reload(self) -> None:
+        try:
+            with open(self._file, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            doc = {}
+        with self._lock:
+            self._doc = doc
+        logger.info(
+            "membership reloaded: %d/%s workers",
+            len(doc.get("workers", [])), doc.get("numWorkers", "?"),
+        )
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._doc)
+
+    def ready(self) -> bool:
+        doc = self.snapshot()
+        expected = doc.get("numWorkers", 0)
+        workers = doc.get("workers", [])
+        return (
+            expected > 0
+            and len(workers) >= expected
+            and all(w.get("status") == "Ready" for w in workers)
+        )
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        state: MembershipState = self.server.state  # type: ignore[attr-defined]
+        line = self.rfile.readline().decode(errors="replace").strip().upper()
+        if line == "STATUS":
+            self.wfile.write(b"READY\n" if state.ready() else b"NOT_READY\n")
+        elif line == "MEMBERS":
+            self.wfile.write(
+                (json.dumps(state.snapshot()) + "\n").encode()
+            )
+        else:
+            self.wfile.write(b"ERR unknown command\n")
+
+
+class CoordinationService(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str, port: int, state: MembershipState):
+        super().__init__((host, port), _Handler)
+        self.state = state
+
+
+def query(host: str, port: int, command: str, timeout: float = 3.0) -> str:
+    """Client helper (used by `check` probes and tests)."""
+    import socket
+
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(command.encode() + b"\n")
+        data = s.makefile().readline()
+    return data.strip()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="coordination-service")
+    p.add_argument("--members-file", required=True)
+    p.add_argument("--port", type=int, default=7077)
+    p.add_argument("--host", default="0.0.0.0")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    state = MembershipState(args.members_file)
+    signal.signal(signal.SIGUSR1, lambda *a: state.reload())
+    server = CoordinationService(args.host, args.port, state)
+    # shutdown() must not run on the serving (main) thread -- it blocks
+    # until serve_forever exits, which would deadlock inside the handler.
+    signal.signal(
+        signal.SIGTERM,
+        lambda *a: threading.Thread(target=server.shutdown).start(),
+    )
+    logger.info("coordination service on %s:%d", args.host, args.port)
+    server.serve_forever(poll_interval=0.2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

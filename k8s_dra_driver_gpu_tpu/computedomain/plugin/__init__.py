@@ -1,0 +1,1 @@
+"""ComputeDomain kubelet plugin (reference cmd/compute-domain-kubelet-plugin/)."""

@@ -1,0 +1,316 @@
+"""CD plugin DeviceState: channel + daemon claim preparation.
+
+Reference: cmd/compute-domain-kubelet-plugin/device_state.go --
+allocatables are IMEX channels + one daemon device (nvlib.go:167-194);
+applyComputeDomainChannelConfig (:544): double-alloc guard, namespace
+spoof guard (PermanentError, :577 + computedomain.go:296), node label
+add (the DaemonSet trigger), BLOCK until CD Ready, then CDI-inject the
+channel; applyComputeDomainDaemonConfig (:594): per-domain config dir +
+daemon identity injection.
+
+TPU translation: a "channel" is slice-membership -- the workload gets
+the JAX bootstrap contract (coordinator address, process id, worker
+hostnames via the daemon's bootstrap file) instead of an
+/dev/nvidia-caps-imex-channels device node. The daemon device carries
+the domain identity env the compute-domain-daemon needs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+from ...api.configs import (
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+)
+from ...api.decode import strict_decode
+from ...kubeletplugin.cdi import CDIHandler, ContainerEdits
+from ...kubeletplugin.checkpoint import (
+    CheckpointedClaim,
+    CheckpointedDevice,
+    CheckpointManager,
+    ClaimState,
+)
+from ...kubeletplugin.claim import ResourceClaim
+from ...pkg.kubeclient import NotFoundError
+from ...pkg.workqueue import PermanentError
+from .. import (
+    API_GROUP,
+    API_VERSION,
+    NODE_LABEL,
+    daemon_dns_name,
+    expected_workers,
+)
+
+logger = logging.getLogger(__name__)
+
+MAX_CHANNELS = 128
+DAEMON_DEVICE = "daemon"
+DOMAIN_STATE_ROOT = "/var/run/tpu-domain"
+
+
+class RetryableError(RuntimeError):
+    """Prepare must be retried (e.g. CD not Ready yet)."""
+
+
+class CDDeviceState:
+    def __init__(
+        self,
+        root: str,
+        kube,
+        node_name: str,
+        clique_id: str = "0",
+        cdi_root: str | None = None,
+        driver_namespace: str = "tpu-dra-driver",
+        boot_id: str | None = None,
+    ):
+        os.makedirs(root, exist_ok=True)
+        self.root = root
+        self.kube = kube
+        self.node_name = node_name
+        self.clique_id = clique_id
+        self.ns = driver_namespace
+        self._lock = threading.Lock()
+        self._checkpoint = CheckpointManager(root, boot_id=boot_id)
+        self._cdi = CDIHandler(cdi_root=cdi_root or os.path.join(root, "cdi"))
+
+    # -- allocatable devices ----------------------------------------------------
+
+    def allocatable_devices(self) -> list[dict]:
+        """channel-0..N + the daemon device (nvlib.go:167-194)."""
+        devices = [
+            {
+                "name": DAEMON_DEVICE,
+                "attributes": {"type": {"string": "daemon"}},
+                "capacity": {},
+            }
+        ]
+        for i in range(MAX_CHANNELS):
+            devices.append(
+                {
+                    "name": f"channel-{i}",
+                    "attributes": {
+                        "type": {"string": "channel"},
+                        "channel": {"int": i},
+                    },
+                    "capacity": {},
+                }
+            )
+        return devices
+
+    # -- prepare ------------------------------------------------------------------
+
+    def prepare(self, claim: ResourceClaim) -> list[str]:
+        with self._lock:
+            cp = self._checkpoint.get()
+            existing = cp.claims.get(claim.uid)
+            if existing and existing.state == ClaimState.PREPARE_COMPLETED.value:
+                return [i for d in existing.devices for i in d.cdi_device_ids]
+
+            cfg = self._decode_config(claim)
+            if isinstance(cfg, ComputeDomainChannelConfig):
+                edits, devices = self._prepare_channel(claim, cfg)
+            elif isinstance(cfg, ComputeDomainDaemonConfig):
+                edits, devices = self._prepare_daemon(claim, cfg)
+            else:
+                raise PermanentError(
+                    f"config kind {type(cfg).__name__} not valid for "
+                    "compute-domain claims"
+                )
+
+            device_edits = {d: ContainerEdits() for d in devices}
+            cdi_ids = self._cdi.create_claim_spec_file(
+                claim.uid, device_edits, edits
+            )
+
+            def complete(c):
+                c.claims[claim.uid] = CheckpointedClaim(
+                    uid=claim.uid,
+                    namespace=claim.namespace,
+                    name=claim.name,
+                    state=ClaimState.PREPARE_COMPLETED.value,
+                    devices=[
+                        CheckpointedDevice(
+                            canonical_name=name, kind="cd",
+                            cdi_device_ids=[cid],
+                        )
+                        for name, cid in zip(sorted(devices), cdi_ids)
+                    ],
+                )
+
+            self._checkpoint.update(complete)
+            return cdi_ids
+
+    def _decode_config(self, claim: ResourceClaim):
+        for oc in claim.configs:
+            try:
+                cfg = strict_decode(oc.parameters)
+            except Exception as e:
+                raise PermanentError(e) from e
+            cfg.normalize()
+            cfg.validate()
+            return cfg
+        raise PermanentError("compute-domain claim carries no opaque config")
+
+    def _get_cd(self, domain_id: str) -> dict:
+        for cd in self.kube.list(API_GROUP, API_VERSION, "computedomains"):
+            if cd["metadata"].get("uid") == domain_id:
+                return cd
+        raise RetryableError(f"ComputeDomain {domain_id} not found (yet)")
+
+    def _prepare_channel(
+        self, claim: ResourceClaim, cfg: ComputeDomainChannelConfig
+    ):
+        cd = self._get_cd(cfg.domain_id)
+        # Cross-namespace spoof guard: a claim may only join a CD living
+        # in its own namespace (device_state.go:577, PermanentError).
+        if cd["metadata"].get("namespace", "default") != claim.namespace:
+            raise PermanentError(
+                f"ComputeDomain {cd['metadata']['name']} namespace "
+                f"{cd['metadata'].get('namespace')!r} does not match claim "
+                f"namespace {claim.namespace!r}"
+            )
+        self._assert_channel_not_allocated(claim)
+        self._add_node_label(cfg.domain_id)
+        node = self._assert_cd_ready(cd)  # raises RetryableError until ready
+
+        channels = [r.device for r in claim.results]
+        port = int(os.environ.get("COORDINATION_PORT", "7077"))
+        # Coordinator by IP: workload pods have no resolver entry for the
+        # daemon DNS names (those live in the daemons' own /etc/hosts), so
+        # hand out the index-0 daemon's registered pod IP directly; the
+        # full name<->IP map rides the mounted members.json for consumers
+        # that want stable names.
+        nodes = cd.get("status", {}).get("nodes", [])
+        node0 = next((n for n in nodes if n.get("index") == 0), None)
+        coordinator_host = (
+            node0.get("ipAddress") if node0 and node0.get("ipAddress")
+            else daemon_dns_name(0)
+        )
+        edits = ContainerEdits(
+            env=[
+                f"COMPUTE_DOMAIN_UUID={cfg.domain_id}",
+                f"TPU_COORDINATOR_ADDRESS={coordinator_host}:{port}",
+                f"TPU_PROCESS_ID={node.get('index', 0)}",
+                f"TPU_NUM_PROCESSES={len(self._ready_nodes(cd))}",
+                "TPU_DOMAIN_CHANNELS="
+                + ("all" if cfg.allocation_mode == "All"
+                   else ",".join(sorted(channels))),
+            ],
+            # The daemon's bootstrap/membership files for this domain,
+            # read-only. Host source must match what _prepare_daemon
+            # mounts INTO the daemon (same per-domain dir).
+            mounts=[(
+                os.path.join(self.root, "domains", cfg.domain_id),
+                DOMAIN_STATE_ROOT, True,
+            )],
+        )
+        return edits, channels
+
+    def _ready_nodes(self, cd: dict) -> list[dict]:
+        return [
+            n for n in cd.get("status", {}).get("nodes", [])
+            if n.get("status") == "Ready"
+        ]
+
+    def _assert_cd_ready(self, cd: dict) -> dict:
+        """Our node must be registered and the domain Ready
+        (AssertComputeDomainReady, computedomain.go:238-295)."""
+        status = cd.get("status", {})
+        node = next(
+            (n for n in status.get("nodes", [])
+             if n.get("name") == self.node_name),
+            None,
+        )
+        if status.get("status") != "Ready" or node is None:
+            raise RetryableError(
+                f"ComputeDomain {cd['metadata']['name']} not ready on "
+                f"{self.node_name} (status={status.get('status')})"
+            )
+        return node
+
+    def _assert_channel_not_allocated(self, claim: ResourceClaim) -> None:
+        """Checkpoint-backed double-alloc guard (device_state.go:729)."""
+        cp = self._checkpoint.get()
+        wanted = {r.device for r in claim.results}
+        for other in cp.claims.values():
+            if other.uid == claim.uid:
+                continue
+            held = {d.canonical_name for d in other.devices}
+            both = wanted & held
+            if both:
+                raise PermanentError(
+                    f"channel(s) {sorted(both)} already allocated to "
+                    f"claim {other.uid}"
+                )
+
+    def _add_node_label(self, cd_uid: str) -> None:
+        """Label this node so the per-CD DaemonSet schedules here
+        (computedomain.go:312-364) -- THE rendezvous step."""
+        try:
+            self.kube.patch(
+                "", "v1", "nodes", self.node_name,
+                {"metadata": {"labels": {NODE_LABEL: cd_uid}}},
+            )
+        except NotFoundError:
+            # Node objects may not exist in bare test environments.
+            logger.warning("node %s not found for labeling", self.node_name)
+
+    def _prepare_daemon(
+        self, claim: ResourceClaim, cfg: ComputeDomainDaemonConfig
+    ):
+        cd = self._get_cd(cfg.domain_id)
+        domain_dir = os.path.join(self.root, "domains", cfg.domain_id)
+        os.makedirs(domain_dir, exist_ok=True)
+        expected = self._expected_workers(cd)
+        edits = ContainerEdits(
+            env=[
+                f"COMPUTE_DOMAIN_UUID={cfg.domain_id}",
+                f"COMPUTE_DOMAIN_NAME={cd['metadata']['name']}",
+                f"COMPUTE_DOMAIN_NAMESPACE={cd['metadata'].get('namespace', 'default')}",
+                f"CLIQUE_ID={self.clique_id}",
+                f"NODE_NAME={self.node_name}",
+                f"COMPUTE_DOMAIN_NUM_WORKERS={expected}",
+                f"DOMAIN_STATE_DIR={DOMAIN_STATE_ROOT}",
+            ],
+            mounts=[(domain_dir, DOMAIN_STATE_ROOT, False)],
+        )
+        return edits, [DAEMON_DEVICE]
+
+    def _expected_workers(self, cd: dict) -> int:
+        return expected_workers(cd.get("spec", {}))
+
+    # -- unprepare ------------------------------------------------------------------
+
+    def unprepare(self, claim_uid: str) -> None:
+        with self._lock:
+            cp = self._checkpoint.get()
+            if claim_uid not in cp.claims:
+                return
+            self._cdi.delete_claim_spec_file(claim_uid)
+            self._checkpoint.update(
+                lambda c: c.claims.pop(claim_uid, None)
+            )
+            # Last CHANNEL claim gone: drop the node label so the daemon
+            # pod drains (computedomain.go:312-364 removal path). The
+            # daemon's own claim must not keep the label alive -- the
+            # daemon only exists because of the label.
+            remaining = self._checkpoint.get().claims.values()
+            any_channels = any(
+                d.canonical_name.startswith("channel-")
+                for c in remaining
+                for d in c.devices
+            )
+            if not any_channels:
+                try:
+                    self.kube.patch(
+                        "", "v1", "nodes", self.node_name,
+                        {"metadata": {"labels": {NODE_LABEL: None}}},
+                    )
+                except NotFoundError:
+                    pass
+
+    def prepared_claims(self):
+        return self._checkpoint.get().claims

@@ -1,0 +1,137 @@
+"""CD plugin driver: the codependent-Prepare retry engine.
+
+Reference: cmd/compute-domain-kubelet-plugin/driver.go:40-233 -- unlike
+the TPU/GPU plugin, every claim runs through a retry loop bounded by
+ErrorRetryMaxTimeout=45s with exponential backoff, because Prepare is
+*codependent*: a workload-channel Prepare can only succeed after the CD
+daemon on this node is Ready, which itself requires another (daemon)
+Prepare that is triggered BY the first Prepare's node-label side effect.
+permanentError short-circuits (:56-60); work is not serialized (:89-96).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from ...kubeletplugin.claim import ResourceClaim
+from ...pkg.kubeclient import NotFoundError
+from ...pkg.metrics import DRARequestMetrics
+from ...pkg.sliceutil import publish_resource_slices
+from ...pkg.workqueue import PermanentError, RateLimiter
+from .. import COMPUTE_DOMAIN_DRIVER_NAME
+from .device_state import CDDeviceState, RetryableError
+
+logger = logging.getLogger(__name__)
+
+ERROR_RETRY_MAX_TIMEOUT_S = 45.0
+RETRY_LIMITER = RateLimiter(base_delay=0.25, max_delay=3.0, jitter=0.2)
+
+
+class CDDriver:
+    def __init__(
+        self,
+        state: CDDeviceState,
+        kube,
+        node_name: str,
+        metrics: DRARequestMetrics | None = None,
+        retry_timeout: float = ERROR_RETRY_MAX_TIMEOUT_S,
+    ):
+        self.state = state
+        self.kube = kube
+        self.node_name = node_name
+        self.metrics = metrics or DRARequestMetrics()
+        self.retry_timeout = retry_timeout
+
+    def _fetch_claim(self, ref) -> ResourceClaim:
+        uid = getattr(ref, "uid", None) or ref.get("uid")
+        namespace = getattr(ref, "namespace", None) or ref.get("namespace")
+        name = getattr(ref, "name", None) or ref.get("name")
+        obj = self.kube.get(
+            "resource.k8s.io", "v1", "resourceclaims", name,
+            namespace=namespace,
+        )
+        if obj.get("metadata", {}).get("uid") != uid:
+            raise PermanentError(f"claim {namespace}/{name} UID mismatch")
+        return ResourceClaim.from_dict(obj, driver=COMPUTE_DOMAIN_DRIVER_NAME)
+
+    def prepare_resource_claims(self, claim_refs: list) -> dict:
+        out = {}
+        for ref in claim_refs:
+            uid = getattr(ref, "uid", None) or ref.get("uid")
+            try:
+                with self.metrics.observe("NodePrepareResources"):
+                    out[uid] = (self._prepare_with_retry(ref), "")
+            except Exception as e:  # noqa: BLE001 - wire boundary
+                logger.warning("prepare failed for %s: %s", uid, e)
+                out[uid] = ([], str(e))
+        return out
+
+    def _prepare_with_retry(self, ref) -> list[dict]:
+        """Bounded retry loop (the reference's per-call retry engine with
+        ErrorRetryMaxTimeout; driver.go:165-233)."""
+        deadline = time.monotonic() + self.retry_timeout
+        failures = 0
+        while True:
+            try:
+                claim = self._fetch_claim(ref)
+                cdi_ids = self.state.prepare(claim)
+                return [
+                    {
+                        "request_names": [r.request],
+                        "pool_name": self.node_name,
+                        "device_name": r.device,
+                        "cdi_device_ids": cdi_ids,
+                    }
+                    for r in claim.results
+                ]
+            except PermanentError:
+                raise
+            except (RetryableError, NotFoundError, OSError) as e:
+                failures += 1
+                delay = RETRY_LIMITER.delay_for(failures)
+                if time.monotonic() + delay >= deadline:
+                    raise TimeoutError(
+                        f"prepare retry budget ({self.retry_timeout}s) "
+                        f"exhausted: {e}"
+                    ) from e
+                logger.info("prepare retry %d in %.2fs: %s",
+                            failures, delay, e)
+                time.sleep(delay)
+
+    def unprepare_resource_claims(self, claim_refs: list) -> dict:
+        out = {}
+        for ref in claim_refs:
+            uid = getattr(ref, "uid", None) or ref.get("uid")
+            try:
+                with self.metrics.observe("NodeUnprepareResources"):
+                    self.state.unprepare(uid)
+                out[uid] = ""
+            except Exception as e:  # noqa: BLE001 - wire boundary
+                logger.exception("unprepare failed for %s", uid)
+                out[uid] = str(e)
+        return out
+
+    # -- ResourceSlice publication ------------------------------------------------
+
+    def generate_resource_slices(self) -> list[dict]:
+        return [{
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceSlice",
+            "metadata": {
+                "name": f"{self.node_name}-{COMPUTE_DOMAIN_DRIVER_NAME}",
+            },
+            "spec": {
+                "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                "nodeName": self.node_name,
+                "pool": {
+                    "name": self.node_name,
+                    "resourceSliceCount": 1,
+                    "generation": 1,
+                },
+                "devices": self.state.allocatable_devices(),
+            },
+        }]
+
+    def publish_resources(self) -> None:
+        publish_resource_slices(self.kube, self.generate_resource_slices())

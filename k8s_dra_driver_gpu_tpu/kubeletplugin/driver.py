@@ -15,6 +15,7 @@ import time
 from . import DRIVER_NAME
 from ..pkg.kubeclient import NotFoundError
 from ..pkg.metrics import DRARequestMetrics
+from ..pkg.sliceutil import publish_resource_slices
 from .claim import ResourceClaim
 from .cleanup import CheckpointCleanupManager
 from .device_state import Config, DeviceState
@@ -196,23 +197,7 @@ class Driver:
         return [slice_obj("", devices + partition_devices)]
 
     def publish_resources(self) -> None:
-        for obj in self.generate_resource_slices():
-            name = obj["metadata"]["name"]
-            try:
-                existing = self.kube.get(
-                    RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", name
-                )
-                obj["spec"]["pool"]["generation"] = (
-                    existing["spec"]["pool"]["generation"] + 1
-                )
-                self.kube.update(
-                    RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices",
-                    name, obj,
-                )
-            except NotFoundError:
-                self.kube.create(
-                    RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", obj
-                )
+        publish_resource_slices(self.kube, self.generate_resource_slices())
 
     # -- health ---------------------------------------------------------------
 

@@ -1,0 +1,159 @@
+"""Lease-based leader election for controller HA.
+
+Reference: cmd/compute-domain-controller/main.go:277-377 -- k8s Lease
+(coordination.k8s.io/v1) leader election with ReleaseOnCancel, 30s lease
+/ 10s renew / 2s retry (upstream defaults).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from datetime import datetime, timezone
+
+from .kubeclient import ConflictError, NotFoundError
+
+logger = logging.getLogger(__name__)
+
+LEASE_DURATION_S = 30
+RENEW_PERIOD_S = 10
+RETRY_PERIOD_S = 2
+
+
+def _now() -> str:
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse(ts: str) -> float:
+    try:
+        return datetime.strptime(
+            ts, "%Y-%m-%dT%H:%M:%S.%fZ"
+        ).replace(tzinfo=timezone.utc).timestamp()
+    except (ValueError, TypeError):
+        return 0.0
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        kube,
+        lease_name: str,
+        namespace: str,
+        identity: str,
+        lease_duration: float = LEASE_DURATION_S,
+        renew_period: float = RENEW_PERIOD_S,
+        retry_period: float = RETRY_PERIOD_S,
+    ):
+        self.kube = kube
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self.is_leader = False
+
+    # -- lease CRUD -------------------------------------------------------------
+
+    def _lease_obj(self) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.lease_name,
+                         "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_duration),
+                "acquireTime": _now(),
+                "renewTime": _now(),
+            },
+        }
+
+    def try_acquire_or_renew(self) -> bool:
+        """Never raises: any API failure reads as 'did not get the lease',
+        so a transient apiserver error makes the leader step down rather
+        than split-brain (the renew loop treats False as lost)."""
+        try:
+            return self._try_acquire_or_renew()
+        except Exception:  # noqa: BLE001 - lease RPC boundary
+            logger.exception("lease operation failed")
+            return False
+
+    def _try_acquire_or_renew(self) -> bool:
+        try:
+            lease = self.kube.get("coordination.k8s.io", "v1", "leases",
+                                  self.lease_name, namespace=self.namespace)
+        except NotFoundError:
+            try:
+                self.kube.create("coordination.k8s.io", "v1", "leases",
+                                 self._lease_obj(), namespace=self.namespace)
+                return True
+            except ConflictError:
+                return False
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity", "")
+        renew = _parse(spec.get("renewTime", ""))
+        expired = time.time() - renew > self.lease_duration
+        # An empty holder means the previous leader released on cancel.
+        if holder and holder != self.identity and not expired:
+            return False
+        spec["holderIdentity"] = self.identity
+        spec["renewTime"] = _now()
+        if holder != self.identity:
+            spec["acquireTime"] = _now()
+        try:
+            self.kube.update("coordination.k8s.io", "v1", "leases",
+                             self.lease_name, lease,
+                             namespace=self.namespace)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    def release(self) -> None:
+        """ReleaseOnCancel: zero the holder so a peer takes over fast."""
+        try:
+            lease = self.kube.get("coordination.k8s.io", "v1", "leases",
+                                  self.lease_name, namespace=self.namespace)
+        except NotFoundError:
+            return
+        if lease.get("spec", {}).get("holderIdentity") != self.identity:
+            return
+        lease["spec"]["holderIdentity"] = ""
+        try:
+            self.kube.update("coordination.k8s.io", "v1", "leases",
+                             self.lease_name, lease,
+                             namespace=self.namespace)
+        except (ConflictError, NotFoundError):
+            pass
+
+    # -- loop ---------------------------------------------------------------------
+
+    def run(self, lead_fn, stop: threading.Event) -> None:
+        """Block until stop; call lead_fn() (blocking) while leading."""
+        while not stop.is_set():
+            if self.try_acquire_or_renew():
+                self.is_leader = True
+                logger.info("%s acquired lease %s", self.identity,
+                            self.lease_name)
+                renew_stop = threading.Event()
+
+                def renew_loop():
+                    while not renew_stop.wait(self.renew_period):
+                        if not self.try_acquire_or_renew():
+                            logger.warning("lost lease %s", self.lease_name)
+                            self.is_leader = False
+                            stop.set()
+                            return
+
+                t = threading.Thread(target=renew_loop, daemon=True)
+                t.start()
+                try:
+                    lead_fn()
+                finally:
+                    renew_stop.set()
+                    t.join(timeout=2)
+                    self.release()
+                    self.is_leader = False
+                return
+            stop.wait(self.retry_period)

@@ -1,0 +1,33 @@
+"""Shared ResourceSlice publication (used by both DRA drivers).
+
+Reference: the kubeletplugin helper's PublishResources
+(gpu driver.go:455, CD plugin equivalent).
+"""
+
+from __future__ import annotations
+
+from .kubeclient import NotFoundError
+
+RESOURCE_GROUP = "resource.k8s.io"
+RESOURCE_VERSION = "v1"
+
+
+def publish_resource_slices(kube, slices: list[dict]) -> None:
+    """Create-or-update each slice, bumping the pool generation on
+    update so schedulers see a fresh pool snapshot."""
+    for obj in slices:
+        name = obj["metadata"]["name"]
+        try:
+            existing = kube.get(
+                RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", name
+            )
+            obj["spec"]["pool"]["generation"] = (
+                existing["spec"]["pool"]["generation"] + 1
+            )
+            kube.update(
+                RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", name, obj
+            )
+        except NotFoundError:
+            kube.create(
+                RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", obj
+            )

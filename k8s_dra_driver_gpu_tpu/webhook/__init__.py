@@ -1,0 +1,1 @@
+"""Validating admission webhook (reference cmd/webhook/)."""

@@ -1,0 +1,157 @@
+"""Admission webhook: strict-validate opaque configs at admission time.
+
+Reference: cmd/webhook/main.go -- TLS HTTP server exposing
+/validate-resource-claim-parameters (:100); extracts ResourceClaim(
+Template)s from an AdmissionReview across resource.k8s.io v1/v1beta1/
+v1beta2 (resource.go:33-150), strict-decodes any driver-owned opaque
+config and runs Normalize()+Validate(). Optional -- the same strict
+decoding re-runs at Prepare time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import ssl
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..api.decode import DecodeError, strict_decode
+from ..api.configs import ValidationError
+
+logger = logging.getLogger(__name__)
+
+VALIDATE_PATH = "/validate-resource-claim-parameters"
+OUR_DRIVERS = ("tpu.dra.dev", "compute-domain.tpu.dra.dev")
+SUPPORTED_VERSIONS = ("v1", "v1beta1", "v1beta2")
+
+
+def extract_device_configs(obj: dict) -> list[dict]:
+    """Opaque parameter objects owned by our drivers, from a
+    ResourceClaim or ResourceClaimTemplate (resource.go:82-150)."""
+    kind = obj.get("kind", "")
+    if kind == "ResourceClaimTemplate":
+        spec = obj.get("spec", {}).get("spec", {})
+    else:
+        spec = obj.get("spec", {})
+    out = []
+    for entry in spec.get("devices", {}).get("config", []):
+        opaque = entry.get("opaque") or {}
+        if opaque.get("driver") in OUR_DRIVERS:
+            out.append(opaque.get("parameters", {}))
+    return out
+
+
+def validate_admission_review(review: dict) -> dict:
+    """AdmissionReview in -> AdmissionReview out with allowed verdict."""
+    request = review.get("request") or {}
+    uid = request.get("uid", "")
+    response: dict = {"uid": uid, "allowed": True}
+
+    obj = request.get("object") or {}
+    api_version = obj.get("apiVersion", "")
+    group_version = api_version.rsplit("/", 1)[-1] if api_version else ""
+    if (
+        obj.get("kind") in ("ResourceClaim", "ResourceClaimTemplate")
+        and group_version in SUPPORTED_VERSIONS
+    ):
+        for params in extract_device_configs(obj):
+            try:
+                cfg = strict_decode(params)
+                cfg.normalize()
+                cfg.validate()
+            except (DecodeError, ValidationError) as e:
+                response["allowed"] = False
+                response["status"] = {
+                    "message": f"invalid device config: {e}",
+                    "code": 422,
+                }
+                break
+    return {
+        "apiVersion": review.get(
+            "apiVersion", "admission.k8s.io/v1"
+        ),
+        "kind": "AdmissionReview",
+        "response": response,
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_POST(self):  # noqa: N802 - http.server API
+        if self.path.split("?", 1)[0] != VALIDATE_PATH:
+            self.send_response(404)
+            self.end_headers()
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        try:
+            review = json.loads(self.rfile.read(length))
+            out = validate_admission_review(review)
+        except (json.JSONDecodeError, AttributeError) as e:
+            self.send_response(400)
+            self.end_headers()
+            self.wfile.write(str(e).encode())
+            return
+        body = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+class WebhookServer:
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        tls_cert: str | None = None,
+        tls_key: str | None = None,
+    ):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        if tls_cert and tls_key:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True
+            )
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="webhook", daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-dra-webhook")
+    p.add_argument("--port", type=int, default=8443)
+    p.add_argument("--tls-cert")
+    p.add_argument("--tls-key")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = WebhookServer(port=args.port, tls_cert=args.tls_cert,
+                           tls_key=args.tls_key)
+    server.start()
+    logger.info("webhook serving on :%d%s", server.port, VALIDATE_PATH)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,378 @@
+"""ComputeDomain stack tests: the full §3.3 gang choreography in one
+process -- controller, two node plugins, two daemons with REAL
+coordination-service child processes, all rendezvousing through a shared
+FakeKubeClient.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.computedomain import (
+    API_GROUP,
+    API_VERSION,
+    NODE_LABEL,
+    daemon_dns_name,
+)
+from k8s_dra_driver_gpu_tpu.computedomain.controller.controller import (
+    ComputeDomainController,
+)
+from k8s_dra_driver_gpu_tpu.computedomain.daemon.clique import CliqueRegistrar
+from k8s_dra_driver_gpu_tpu.computedomain.daemon.dnsnames import (
+    dns_name_mappings,
+    update_hosts_file,
+)
+from k8s_dra_driver_gpu_tpu.computedomain.daemon.main import (
+    Daemon,
+    DaemonConfig,
+)
+from k8s_dra_driver_gpu_tpu.computedomain.daemon.rendezvous import query
+from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state import (
+    CDDeviceState,
+)
+from k8s_dra_driver_gpu_tpu.computedomain.plugin.driver import CDDriver
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.workqueue import PermanentError
+from tests.fake_kube import make_claim_dict
+
+
+def make_cd(kube, name="cd1", namespace="team-a", topology="2x2x2") -> dict:
+    cd = {
+        "apiVersion": f"{API_GROUP}/{API_VERSION}",
+        "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "topology": topology,
+            "channel": {
+                "resourceClaimTemplate": {"name": f"{name}-channel"},
+                "allocationMode": "Single",
+            },
+        },
+    }
+    return kube.create(API_GROUP, API_VERSION, "computedomains", cd,
+                       namespace=namespace)
+
+
+def put_channel_claim(kube, uid, cd_uid, namespace="team-a", device="channel-0"):
+    obj = make_claim_dict(
+        uid, [device], namespace=namespace, request="channel",
+        driver="compute-domain.tpu.dra.dev",
+        configs=[{
+            "parameters": {
+                "apiVersion": "resource.tpu.dra/v1beta1",
+                "kind": "ComputeDomainChannelConfig",
+                "domainID": cd_uid,
+                "allocationMode": "Single",
+            },
+            "requests": ["channel"],
+        }],
+    )
+    kube.create("resource.k8s.io", "v1", "resourceclaims", obj,
+                namespace=namespace)
+    return obj
+
+
+@pytest.fixture()
+def kube():
+    k = FakeKubeClient()
+    for node in ("node-0", "node-1"):
+        k.create("", "v1", "nodes",
+                 {"kind": "Node", "metadata": {"name": node}})
+    return k
+
+
+@pytest.fixture()
+def controller(kube):
+    c = ComputeDomainController(kube)
+    yield c
+    c.queue.shutdown(wait=False)
+
+
+class TestController:
+    def test_reconcile_materializes_objects(self, kube, controller):
+        cd = make_cd(kube)
+        controller.reconcile(cd)
+        uid = cd["metadata"]["uid"]
+        ds = kube.get("apps", "v1", "daemonsets",
+                      f"computedomain-daemon-{uid}",
+                      namespace="tpu-dra-driver")
+        assert ds["spec"]["template"]["spec"]["nodeSelector"] == {
+            NODE_LABEL: uid
+        }
+        # Workload RCT in the user's namespace.
+        rct = kube.get("resource.k8s.io", "v1", "resourceclaimtemplates",
+                       "cd1-channel", namespace="team-a")
+        params = rct["spec"]["spec"]["devices"]["config"][0]["opaque"][
+            "parameters"]
+        assert params["domainID"] == uid
+        # Finalizer added.
+        cd2 = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                       namespace="team-a")
+        assert cd2["metadata"]["finalizers"]
+
+    def test_status_aggregation(self, kube, controller):
+        cd = make_cd(kube, topology="2x2x2")  # 8 chips -> 2 hosts
+        controller.reconcile(cd)
+        uid = cd["metadata"]["uid"]
+        # One daemon Ready: still NotReady overall.
+        r0 = CliqueRegistrar(kube, uid, "0", "node-0", "10.0.0.1")
+        r0.register(status="Ready")
+        controller.update_global_status(
+            kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                     namespace="team-a"))
+        cd2 = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                       namespace="team-a")
+        assert cd2["status"]["status"] == "NotReady"
+        # Second daemon Ready: domain Ready.
+        r1 = CliqueRegistrar(kube, uid, "0", "node-1", "10.0.0.2")
+        r1.register(status="Ready")
+        controller.update_global_status(cd2)
+        cd3 = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                       namespace="team-a")
+        assert cd3["status"]["status"] == "Ready"
+        assert [n["index"] for n in cd3["status"]["nodes"]] == [0, 1]
+
+    def test_teardown_cascade(self, kube, controller):
+        cd = make_cd(kube)
+        controller.reconcile(cd)
+        uid = cd["metadata"]["uid"]
+        cd = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                      namespace="team-a")
+        cd["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+        kube.update(API_GROUP, API_VERSION, "computedomains", "cd1", cd,
+                    namespace="team-a")
+        controller.reconcile(cd)
+        assert kube.list("apps", "v1", "daemonsets") == []
+        assert kube.list("resource.k8s.io", "v1",
+                         "resourceclaimtemplates") == []
+        cd2 = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                       namespace="team-a")
+        assert not cd2["metadata"].get("finalizers")
+
+    def test_orphan_gc(self, kube, controller):
+        cd = make_cd(kube)
+        controller.reconcile(cd)
+        kube.delete(API_GROUP, API_VERSION, "computedomains", "cd1",
+                    namespace="team-a")
+        controller.cleanup_orphans()
+        assert kube.list("apps", "v1", "daemonsets") == []
+
+
+class TestCliqueRegistrar:
+    def test_first_free_index(self, kube):
+        r0 = CliqueRegistrar(kube, "u1", "0", "node-0", "10.0.0.1")
+        r1 = CliqueRegistrar(kube, "u1", "0", "node-1", "10.0.0.2")
+        assert r0.register() == 0
+        assert r1.register() == 1
+        # Re-register keeps the index (stable identity).
+        assert r0.register(status="Ready") == 0
+        # Deregister node-0; a new node takes slot 0.
+        r0.deregister()
+        r2 = CliqueRegistrar(kube, "u1", "0", "node-2", "10.0.0.3")
+        assert r2.register() == 0
+
+    def test_members_sorted_by_index(self, kube):
+        r0 = CliqueRegistrar(kube, "u1", "0", "node-0", "10.0.0.1")
+        r1 = CliqueRegistrar(kube, "u1", "0", "node-1", "10.0.0.2")
+        r1_idx = r1.register()
+        r0.register()
+        members = r0.members()
+        assert [m["index"] for m in members] == [0, 1]
+
+
+class TestDNSNames:
+    def test_hosts_file_rewrite(self, tmp_path):
+        hosts = tmp_path / "hosts"
+        hosts.write_text("127.0.0.1 localhost\n")
+        nodes = [
+            {"index": 0, "ipAddress": "10.0.0.1"},
+            {"index": 1, "ipAddress": "10.0.0.2"},
+        ]
+        changed = update_hosts_file(str(hosts), dns_name_mappings(nodes))
+        assert changed
+        content = hosts.read_text()
+        assert "127.0.0.1 localhost" in content
+        assert f"10.0.0.1\t{daemon_dns_name(0)}" in content
+        # Idempotent.
+        assert not update_hosts_file(str(hosts), dns_name_mappings(nodes))
+        # Peer change rewrites only the managed block.
+        nodes[1]["ipAddress"] = "10.0.0.9"
+        assert update_hosts_file(str(hosts), dns_name_mappings(nodes))
+        assert "10.0.0.9" in hosts.read_text()
+        assert hosts.read_text().count("BEGIN tpu-compute-domain") == 1
+
+
+def wait_for_service(port, timeout=20.0):
+    """Interpreter startup on this 1-core box takes ~2s; poll."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            return query("127.0.0.1", port, "STATUS")
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"coordination service on :{port} never came up")
+
+
+def make_daemon(kube, tmp_path, cd_uid, node, ip, port, num_workers=2):
+    env = {
+        "COMPUTE_DOMAIN_UUID": cd_uid,
+        "COMPUTE_DOMAIN_NAME": "cd1",
+        "COMPUTE_DOMAIN_NAMESPACE": "team-a",
+        "CLIQUE_ID": "0",
+        "NODE_NAME": node,
+        "POD_IP": ip,
+        "COMPUTE_DOMAIN_NUM_WORKERS": str(num_workers),
+        "DOMAIN_STATE_DIR": str(tmp_path / node),
+        "HOSTS_FILE": str(tmp_path / node / "hosts"),
+        "COORDINATION_PORT": str(port),
+    }
+    cfg = DaemonConfig(env=env)
+    return Daemon(cfg, kube=kube)
+
+
+class TestGangFlow:
+    """The end-to-end §3.3 choreography with real child processes."""
+
+    def test_full_gang_prepare(self, kube, controller, tmp_path):
+        cd = make_cd(kube, topology="2x2x2")  # 2 hosts
+        uid = cd["metadata"]["uid"]
+        controller.reconcile(cd)
+
+        # Workload channel claims land on both nodes BEFORE daemons run:
+        # prepare must be retryable-failing, and must label the nodes.
+        put_channel_claim(kube, "w0", uid)
+        st0 = CDDeviceState(str(tmp_path / "st0"), kube, "node-0")
+        drv0 = CDDriver(st0, kube, "node-0", retry_timeout=0.3)
+        out = drv0.prepare_resource_claims(
+            [{"uid": "w0", "namespace": "team-a", "name": "w0"}]
+        )
+        assert "retry budget" in out["w0"][1]
+        node0 = kube.get("", "v1", "nodes", "node-0")
+        assert node0["metadata"]["labels"][NODE_LABEL] == uid
+
+        # Daemons come up (the DaemonSet would schedule them now).
+        d0 = make_daemon(kube, tmp_path, uid, "node-0", "127.0.0.1", 17071)
+        d1 = make_daemon(kube, tmp_path, uid, "node-1", "127.0.0.1", 17072)
+        try:
+            assert d0.registrar.register() == 0
+            assert d1.registrar.register() == 1
+            d0.process.ensure_started()
+            d1.process.ensure_started()
+            wait_for_service(17071)
+            wait_for_service(17072)
+            d0.sync_once()
+            d1.sync_once()
+            d0.registrar.set_status("Ready")
+            d1.registrar.set_status("Ready")
+            d0._last_members = None
+            d1._last_members = None
+            d0.sync_once()
+            d1.sync_once()
+
+            # Coordination service answers READY once quorum is met.
+            assert query("127.0.0.1", 17071, "STATUS") == "READY"
+            members = json.loads(query("127.0.0.1", 17071, "MEMBERS"))
+            assert members["numWorkers"] == 2
+            assert len(members["workers"]) == 2
+
+            # Controller aggregates Ready.
+            controller.update_global_status(
+                kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                         namespace="team-a"))
+            cd2 = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                           namespace="team-a")
+            assert cd2["status"]["status"] == "Ready"
+
+            # Channel prepare now succeeds and injects the JAX bootstrap.
+            drv0.retry_timeout = 5.0
+            out = drv0.prepare_resource_claims(
+                [{"uid": "w0", "namespace": "team-a", "name": "w0"}]
+            )
+            devices, err = out["w0"]
+            assert err == ""
+            spec = st0._cdi.read_spec("w0")
+            env = spec["containerEdits"]["env"]
+            # Coordinator by registered pod IP (workloads can't resolve
+            # the daemon DNS names).
+            assert "TPU_COORDINATOR_ADDRESS=127.0.0.1:7077" in env
+            assert "TPU_PROCESS_ID=0" in env
+            assert "TPU_NUM_PROCESSES=2" in env
+            # Channel mount points at the per-domain state dir the daemon
+            # writes into.
+            mount = spec["containerEdits"]["mounts"][0]
+            assert mount["hostPath"].endswith(f"domains/{uid}")
+
+            # Bootstrap file carries the jax.distributed contract.
+            with open(d1.bootstrap_file) as f:
+                boot = json.load(f)
+            assert boot["processId"] == 1
+            assert boot["numProcesses"] == 2
+            assert boot["coordinatorAddress"].startswith(daemon_dns_name(0))
+        finally:
+            d0.process.stop()
+            d1.process.stop()
+
+    def test_namespace_spoof_guard(self, kube, controller, tmp_path):
+        cd = make_cd(kube, namespace="team-a")
+        uid = cd["metadata"]["uid"]
+        # Claim in a DIFFERENT namespace referencing team-a's domain.
+        put_channel_claim(kube, "evil", uid, namespace="team-b")
+        st = CDDeviceState(str(tmp_path / "st"), kube, "node-0")
+        drv = CDDriver(st, kube, "node-0", retry_timeout=2.0)
+        out = drv.prepare_resource_claims(
+            [{"uid": "evil", "namespace": "team-b", "name": "evil"}]
+        )
+        assert "does not match claim namespace" in out["evil"][1]
+
+    def test_channel_double_alloc_guard(self, kube, tmp_path):
+        cd = make_cd(kube)
+        uid = cd["metadata"]["uid"]
+        st = CDDeviceState(str(tmp_path / "st"), kube, "node-0")
+        # Mark the domain ready for node-0 directly.
+        kube.patch(API_GROUP, API_VERSION, "computedomains", "cd1",
+                   {"status": {"status": "Ready", "nodes": [
+                       {"name": "node-0", "index": 0, "status": "Ready"},
+                   ]}}, namespace="team-a")
+        put_channel_claim(kube, "c1", uid)
+        put_channel_claim(kube, "c2", uid)
+        drv = CDDriver(st, kube, "node-0", retry_timeout=2.0)
+        out1 = drv.prepare_resource_claims(
+            [{"uid": "c1", "namespace": "team-a", "name": "c1"}])
+        assert out1["c1"][1] == ""
+        out2 = drv.prepare_resource_claims(
+            [{"uid": "c2", "namespace": "team-a", "name": "c2"}])
+        assert "already allocated" in out2["c2"][1]
+        # Unprepare frees the channel and (last claim) the node label.
+        drv.unprepare_resource_claims([{"uid": "c1"}])
+        out3 = drv.prepare_resource_claims(
+            [{"uid": "c2", "namespace": "team-a", "name": "c2"}])
+        assert out3["c2"][1] == ""
+
+    def test_daemon_claim_injects_identity(self, kube, tmp_path):
+        cd = make_cd(kube, topology="2x2x2")
+        uid = cd["metadata"]["uid"]
+        obj = make_claim_dict(
+            "d0", ["daemon"], namespace="tpu-dra-driver", request="daemon",
+            driver="compute-domain.tpu.dra.dev",
+            configs=[{
+                "parameters": {
+                    "apiVersion": "resource.tpu.dra/v1beta1",
+                    "kind": "ComputeDomainDaemonConfig",
+                    "domainID": uid,
+                },
+            }],
+        )
+        kube.create("resource.k8s.io", "v1", "resourceclaims", obj,
+                    namespace="tpu-dra-driver")
+        st = CDDeviceState(str(tmp_path / "st"), kube, "node-0",
+                           clique_id="slice-a")
+        drv = CDDriver(st, kube, "node-0", retry_timeout=2.0)
+        out = drv.prepare_resource_claims(
+            [{"uid": "d0", "namespace": "tpu-dra-driver", "name": "d0"}])
+        assert out["d0"][1] == ""
+        env = st._cdi.read_spec("d0")["containerEdits"]["env"]
+        assert f"COMPUTE_DOMAIN_UUID={uid}" in env
+        assert "CLIQUE_ID=slice-a" in env
+        assert "COMPUTE_DOMAIN_NUM_WORKERS=2" in env

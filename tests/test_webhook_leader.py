@@ -1,0 +1,173 @@
+"""Webhook admission matrix + leader-election tests.
+
+Reference analogs: cmd/webhook/main_test.go (523 LoC AdmissionReview
+encode/decode/validate matrix) and the controller's leader election.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.leaderelection import LeaderElector
+from k8s_dra_driver_gpu_tpu.webhook.main import (
+    VALIDATE_PATH,
+    WebhookServer,
+    validate_admission_review,
+)
+
+
+def review(obj, uid="r1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": uid, "object": obj},
+    }
+
+
+def claim_with_config(params, kind="ResourceClaim", api="resource.k8s.io/v1"):
+    spec = {
+        "devices": {
+            "requests": [{"name": "tpu"}],
+            "config": [{
+                "opaque": {"driver": "tpu.dra.dev", "parameters": params},
+            }],
+        }
+    }
+    if kind == "ResourceClaimTemplate":
+        return {"apiVersion": api, "kind": kind, "spec": {"spec": spec}}
+    return {"apiVersion": api, "kind": kind, "spec": spec}
+
+
+GOOD = {
+    "apiVersion": "resource.tpu.dra/v1beta1",
+    "kind": "TpuConfig",
+    "sharing": {"strategy": "TimeSlicing",
+                "timeSlicing": {"interval": "Short"}},
+}
+BAD_FIELD = {**GOOD, "bogus": 1}
+BAD_VALUE = {
+    "apiVersion": "resource.tpu.dra/v1beta1",
+    "kind": "TpuConfig",
+    "sharing": {"strategy": "TimeSlicing",
+                "timeSlicing": {"interval": "Turbo"}},
+}
+
+
+class TestValidation:
+    def test_valid_config_allowed(self):
+        out = validate_admission_review(review(claim_with_config(GOOD)))
+        assert out["response"]["allowed"]
+
+    def test_unknown_field_rejected(self):
+        out = validate_admission_review(review(claim_with_config(BAD_FIELD)))
+        assert not out["response"]["allowed"]
+        assert "unknown field" in out["response"]["status"]["message"]
+
+    def test_invalid_value_rejected(self):
+        out = validate_admission_review(review(claim_with_config(BAD_VALUE)))
+        assert not out["response"]["allowed"]
+
+    def test_template_nested_spec(self):
+        out = validate_admission_review(
+            review(claim_with_config(BAD_VALUE, kind="ResourceClaimTemplate"))
+        )
+        assert not out["response"]["allowed"]
+
+    def test_other_driver_ignored(self):
+        obj = claim_with_config(GOOD)
+        obj["spec"]["devices"]["config"][0]["opaque"]["driver"] = "other.dev"
+        obj["spec"]["devices"]["config"][0]["opaque"]["parameters"] = {
+            "kind": "Whatever"
+        }
+        out = validate_admission_review(review(obj))
+        assert out["response"]["allowed"]
+
+    def test_beta_versions_checked(self):
+        for api in ("resource.k8s.io/v1beta1", "resource.k8s.io/v1beta2"):
+            out = validate_admission_review(
+                review(claim_with_config(BAD_VALUE, api=api))
+            )
+            assert not out["response"]["allowed"], api
+
+    def test_non_claim_kind_allowed(self):
+        out = validate_admission_review(
+            review({"apiVersion": "v1", "kind": "Pod"})
+        )
+        assert out["response"]["allowed"]
+
+    def test_uid_echoed(self):
+        out = validate_admission_review(review(claim_with_config(GOOD),
+                                               uid="xyz"))
+        assert out["response"]["uid"] == "xyz"
+
+
+class TestWebhookHTTP:
+    def test_end_to_end(self):
+        server = WebhookServer(host="127.0.0.1", port=0)
+        server.start()
+        try:
+            body = json.dumps(review(claim_with_config(BAD_FIELD))).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}{VALIDATE_PATH}",
+                data=body, headers={"Content-Type": "application/json"},
+            )
+            out = json.loads(urllib.request.urlopen(req).read())
+            assert not out["response"]["allowed"]
+            # Wrong path 404s.
+            req2 = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/nope", data=b"{}"
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req2)
+            assert e.value.code == 404
+        finally:
+            server.stop()
+
+
+class TestLeaderElection:
+    def test_single_leader(self, ):
+        kube = FakeKubeClient()
+        a = LeaderElector(kube, "lease1", "ns", "pod-a")
+        b = LeaderElector(kube, "lease1", "ns", "pod-b")
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        # a renews fine.
+        assert a.try_acquire_or_renew()
+
+    def test_takeover_after_release(self):
+        kube = FakeKubeClient()
+        a = LeaderElector(kube, "lease1", "ns", "pod-a")
+        b = LeaderElector(kube, "lease1", "ns", "pod-b")
+        assert a.try_acquire_or_renew()
+        a.release()
+        assert b.try_acquire_or_renew()
+
+    def test_takeover_after_expiry(self):
+        kube = FakeKubeClient()
+        a = LeaderElector(kube, "lease1", "ns", "pod-a",
+                          lease_duration=0.05)
+        b = LeaderElector(kube, "lease1", "ns", "pod-b",
+                          lease_duration=0.05)
+        assert a.try_acquire_or_renew()
+        import time
+        time.sleep(0.1)
+        assert b.try_acquire_or_renew()
+
+    def test_run_calls_lead_and_releases(self):
+        kube = FakeKubeClient()
+        a = LeaderElector(kube, "lease1", "ns", "pod-a")
+        stop = threading.Event()
+        led = []
+
+        def lead():
+            led.append(True)
+            stop.set()
+
+        a.run(lead, stop)
+        assert led == [True]
+        lease = kube.get("coordination.k8s.io", "v1", "leases", "lease1",
+                         namespace="ns")
+        assert lease["spec"]["holderIdentity"] == ""
