@@ -1,0 +1,111 @@
+"""compute-domain-kubelet-plugin entry point.
+
+Reference: cmd/compute-domain-kubelet-plugin/main.go (same flag pattern
+as the chip plugin; driver name compute-domain.tpu.dra.dev).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+
+from ... import __version__
+from ...pkg.debug import start_debug_signal_handlers
+from ...pkg.dra.service import PluginServer
+from ...pkg.healthcheck import HealthcheckServer
+from ...pkg.kubeclient import FakeKubeClient, KubeClient
+from ...pkg.metrics import DRARequestMetrics, MetricsServer
+from .. import COMPUTE_DOMAIN_DRIVER_NAME
+from .device_state import CDDeviceState
+from .driver import CDDriver
+
+logger = logging.getLogger(__name__)
+
+
+def run(argv: list[str] | None = None) -> int:
+    env = os.environ.get
+    p = argparse.ArgumentParser(prog="compute-domain-kubelet-plugin")
+    p.add_argument("--node-name", default=env("NODE_NAME", ""))
+    p.add_argument("--state-root",
+                   default=env("STATE_ROOT", "/var/lib/tpu-dra/cd"))
+    p.add_argument("--cdi-root", default=env("CDI_ROOT", "/var/run/cdi"))
+    p.add_argument("--plugin-dir",
+                   default=env("PLUGIN_DIR",
+                               "/var/lib/kubelet/plugins/"
+                               "compute-domain.tpu.dra.dev"))
+    p.add_argument("--registry-dir",
+                   default=env("REGISTRY_DIR",
+                               "/var/lib/kubelet/plugins_registry"))
+    p.add_argument("--clique-id", default=env("TPU_SLICE_ID", "0"),
+                   help="identity of the ICI slice this host belongs to")
+    p.add_argument("--driver-namespace",
+                   default=env("DRIVER_NAMESPACE", "tpu-dra-driver"))
+    p.add_argument("--metrics-port", type=int,
+                   default=int(env("METRICS_PORT", "0")))
+    p.add_argument("--healthcheck-port", type=int,
+                   default=int(env("HEALTHCHECK_PORT", "0")))
+    p.add_argument("--standalone", action="store_true")
+    p.add_argument("--version", action="version", version=__version__)
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    start_debug_signal_handlers()
+    for key, val in sorted(vars(args).items()):
+        logger.info("config %s=%r", key, val)
+
+    node_name = args.node_name or os.uname().nodename
+    kube = FakeKubeClient() if args.standalone else KubeClient()
+    state = CDDeviceState(
+        root=args.state_root,
+        kube=kube,
+        node_name=node_name,
+        clique_id=args.clique_id,
+        cdi_root=args.cdi_root,
+        driver_namespace=args.driver_namespace,
+    )
+    metrics = DRARequestMetrics()
+    driver = CDDriver(state, kube, node_name, metrics=metrics)
+    driver.publish_resources()
+
+    server = PluginServer(
+        COMPUTE_DOMAIN_DRIVER_NAME,
+        plugin_dir=args.plugin_dir,
+        registry_dir=args.registry_dir,
+        prepare_fn=driver.prepare_resource_claims,
+        unprepare_fn=driver.unprepare_resource_claims,
+    )
+    server.start()
+
+    extras = []
+    if args.metrics_port > 0:
+        m = MetricsServer(metrics.registry, host="0.0.0.0",
+                          port=args.metrics_port)
+        m.start()
+        extras.append(m)
+    if args.healthcheck_port > 0:
+        h = HealthcheckServer(server.plugin_socket, server.registry_socket,
+                              host="0.0.0.0", port=args.healthcheck_port)
+        h.start()
+        extras.append(h)
+
+    logger.info("serving CD DRA on %s", server.plugin_socket)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    finally:
+        server.stop()
+        for e in extras:
+            e.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -29,6 +29,7 @@ from ..api.decode import strict_decode
 from ..pkg.featuregates import (
     DYNAMIC_SUB_SLICE,
     MULTI_TENANCY_SUPPORT,
+    PASSTHROUGH_SUPPORT,
     TIME_SLICING_SETTINGS,
     FeatureGates,
 )
@@ -46,8 +47,10 @@ from .deviceinfo import (
     AllocatableDevice,
     ChipInfo,
     DeviceKind,
+    PassthroughInfo,
     SubSliceInfo,
 )
+from .vfio import VfioPciManager
 from .sharing import MultiTenancyManager, TimeSlicingManager
 from .subslice import SubSliceLiveTuple, enumerate_subslice_devices
 
@@ -143,6 +146,10 @@ class DeviceState:
             chip.index: pos for pos, chip in enumerate(self.host.chips)
         }
 
+        self._vfio = VfioPciManager(
+            sys_root=config.tpulib_opts.sys_root or "/sys",
+            dev_root=config.tpulib_opts.dev_root or "/dev",
+        )
         self.allocatable = self._enumerate_allocatable()
         self._checkpoint = CheckpointManager(config.root, boot_id=config.boot_id)
         self._registry = SubSliceRegistry(config.root)
@@ -179,6 +186,15 @@ class DeviceState:
                 "degraded host (%d/%d chips): not publishing sub-slices",
                 len(self.host.chips), expected,
             )
+        if self._config.feature_gates.is_enabled(PASSTHROUGH_SUPPORT):
+            for chip in self.host.chips:
+                info = PassthroughInfo(
+                    chip=chip, host=self.host,
+                    iommu_group=self._vfio.iommu_group(chip.pci_bdf),
+                )
+                out[info.canonical_name] = AllocatableDevice(
+                    kind=DeviceKind.PASSTHROUGH, passthrough=info
+                )
         if self._config.feature_gates.is_enabled(DYNAMIC_SUB_SLICE) and not degraded:
             for spec in enumerate_subslice_devices(self.host, self._profiles):
                 # Full-host carve-outs duplicate the chip set; still
@@ -221,7 +237,7 @@ class DeviceState:
             dev.live["uuid"]
             for c in cp.claims.values()
             for dev in c.devices
-            if dev.live
+            if dev.live and "uuid" in dev.live  # vfio lives carry no uuid
         }
         destroyed = 0
         for uid in list(self._registry.list()):
@@ -318,8 +334,9 @@ class DeviceState:
         dev = self.allocatable.get(canonical_name)
         if dev is None:
             return ()
-        if dev.kind == DeviceKind.CHIP:
-            pos = self._pos_by_index[dev.chip.chip.index]
+        if dev.kind == DeviceKind.CHIP or dev.kind == DeviceKind.PASSTHROUGH:
+            chip = (dev.chip or dev.passthrough).chip
+            pos = self._pos_by_index[chip.index]
             return tuple(
                 pos * self.host.cores_per_chip + k
                 for k in range(self.host.cores_per_chip)
@@ -366,6 +383,8 @@ class DeviceState:
                     DeviceKind.SUBSLICE_STATIC,
                 ):
                     cfg_obj = api_configs.SubSliceConfig()
+                elif dev is not None and dev.kind == DeviceKind.PASSTHROUGH:
+                    cfg_obj = api_configs.PassthroughConfig()
                 else:
                     cfg_obj = api_configs.TpuConfig()
             cfg_obj.normalize()
@@ -379,14 +398,17 @@ class DeviceState:
         before re-raising (unpreparePartiallyPrepairedClaim analog,
         device_state.go:536)."""
         created_live: list[str] = []
+        configured_vfio: list[str] = []
         touched_chips: set[int] = set()
         try:
             return self._prepare_devices_inner(
-                claim, created_live, touched_chips
+                claim, created_live, configured_vfio, touched_chips
             )
         except BaseException:
             for live_uuid in created_live:
                 self._registry.destroy(live_uuid)
+            for bdf in configured_vfio:
+                self._vfio.unconfigure(bdf)
             self._timeslicing.release(claim.uid, sorted(touched_chips))
             self._tenancy.stop(claim.uid)
             self._cdi.delete_claim_spec_file(claim.uid)
@@ -396,6 +418,7 @@ class DeviceState:
         self,
         claim: ResourceClaim,
         created_live: list[str],
+        configured_vfio: list[str],
         touched_chips: set[int],
     ) -> list[CheckpointedDevice]:
         cfgs = self._resolve_configs(claim)
@@ -419,6 +442,17 @@ class DeviceState:
             if dev.kind == DeviceKind.CHIP:
                 physical = [dev.chip.chip]
                 edits.device_nodes.append(dev.chip.chip.devpath)
+            elif dev.kind == DeviceKind.PASSTHROUGH:
+                chip = dev.passthrough.chip
+                physical = [chip]
+                # Kernel boundary: rebind to vfio-pci (vfio-device.go:145).
+                # Record BEFORE configuring: a failure mid-rebind must
+                # still be rolled back (unconfigure is idempotent).
+                configured_vfio.append(chip.pci_bdf)
+                edits = edits.merge(
+                    self._vfio.configure(chip.pci_bdf, cfg)
+                )
+                live = {"pciBdf": chip.pci_bdf, "vfio": True}
             else:
                 ss = dev.subslice
                 positions = (
@@ -502,6 +536,13 @@ class DeviceState:
             raise PrepareError(
                 f"config kind {type(cfg).__name__} cannot apply to a sub-slice"
             )
+        if dev.kind == DeviceKind.PASSTHROUGH and not isinstance(
+            cfg, api_configs.PassthroughConfig
+        ):
+            raise PrepareError(
+                f"config kind {type(cfg).__name__} cannot apply to a "
+                "passthrough device"
+            )
 
     def _apply_sharing(
         self,
@@ -548,7 +589,11 @@ class DeviceState:
         unpreparePartiallyPrepairedClaim :536)."""
         chip_indices: set[int] = set()
         for dev in checkpointed.devices:
-            if dev.live:
+            if dev.live and dev.live.get("vfio"):
+                # Kernel boundary: return the function to the native
+                # driver (vfio-device.go:189).
+                self._vfio.unconfigure(dev.live["pciBdf"])
+            elif dev.live:
                 self._registry.destroy(dev.live["uuid"])
             for core in self._cores_of(dev.canonical_name):
                 pos = core // self.host.cores_per_chip

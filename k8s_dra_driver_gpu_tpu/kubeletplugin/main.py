@@ -18,6 +18,7 @@ import signal
 import sys
 
 from .. import __version__
+from ..pkg.debug import start_debug_signal_handlers
 from ..pkg.featuregates import FeatureGates
 from ..pkg.kubeclient import FakeKubeClient, KubeClient
 from ..pkg.metrics import DRARequestMetrics, MetricsServer
@@ -80,6 +81,7 @@ def run(argv: list[str] | None = None) -> int:
     )
     logger.info("tpu-kubelet-plugin %s starting (driver %s)",
                 __version__, DRIVER_NAME)
+    start_debug_signal_handlers()
     # Structured startup-config dump (reference pkg/flags/utils.go).
     for key, val in sorted(vars(args).items()):
         logger.info("config %s=%r", key, val)

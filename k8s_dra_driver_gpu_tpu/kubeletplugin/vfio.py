@@ -1,0 +1,112 @@
+"""VFIO passthrough: hand a whole TPU chip to a guest/userspace driver.
+
+Reference: cmd/gpu-kubelet-plugin/vfio-device.go -- VfioPciManager.
+Configure (:145): wait device free, unbind from the native driver, bind
+to vfio-pci via driver_override sysfs writes; Unconfigure (:189) reverses
+and rediscovers. vfio-cdi.go exposes /dev/vfio/<group> (legacy) or
+/dev/vfio/devices/* (iommufd).
+
+TPU translation: same sysfs mechanics against the TPU PCI function. All
+paths are rooted at a configurable sys_root/dev_root so the whole flow
+runs against a fake sysfs tree in tests (and mock mode).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from ..api.configs import PassthroughConfig
+from .cdi import ContainerEdits
+
+logger = logging.getLogger(__name__)
+
+VFIO_DRIVER = "vfio-pci"
+NATIVE_DRIVER = "tpu"  # the in-kernel accel driver to rebind on release
+
+
+class VfioPciManager:
+    def __init__(self, sys_root: str = "/sys", dev_root: str = "/dev"):
+        self._sys = sys_root
+        self._dev = dev_root
+
+    # -- sysfs paths ------------------------------------------------------------
+
+    def _device_dir(self, pci_bdf: str) -> str:
+        return os.path.join(self._sys, "bus", "pci", "devices", pci_bdf)
+
+    def _driver_override(self, pci_bdf: str) -> str:
+        return os.path.join(self._device_dir(pci_bdf), "driver_override")
+
+    def _current_driver(self, pci_bdf: str) -> str | None:
+        link = os.path.join(self._device_dir(pci_bdf), "driver")
+        try:
+            return os.path.basename(os.readlink(link))
+        except OSError:
+            return None
+
+    def iommu_group(self, pci_bdf: str) -> int:
+        link = os.path.join(self._device_dir(pci_bdf), "iommu_group")
+        try:
+            return int(os.path.basename(os.readlink(link)))
+        except (OSError, ValueError):
+            return -1
+
+    # -- bind/unbind --------------------------------------------------------------
+
+    def _write(self, path: str, value: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(value)
+
+    def _unbind(self, pci_bdf: str, driver: str) -> None:
+        unbind = os.path.join(self._sys, "bus", "pci", "drivers", driver,
+                              "unbind")
+        try:
+            self._write(unbind, pci_bdf)
+        except OSError as e:
+            logger.warning("unbind %s from %s: %s", pci_bdf, driver, e)
+
+    def _bind(self, pci_bdf: str, driver: str) -> None:
+        bind = os.path.join(self._sys, "bus", "pci", "drivers", driver,
+                            "bind")
+        self._write(bind, pci_bdf)
+
+    def configure(self, pci_bdf: str, cfg: PassthroughConfig) -> ContainerEdits:
+        """Rebind the function to vfio-pci and emit the CDI edits
+        (Configure analog, vfio-device.go:145)."""
+        group_pre = self.iommu_group(pci_bdf)
+        if group_pre < 0:
+            raise RuntimeError(
+                f"device {pci_bdf} has no iommu group (IOMMU disabled?); "
+                "refusing passthrough"
+            )
+        current = self._current_driver(pci_bdf)
+        if current != VFIO_DRIVER:
+            if current:
+                self._unbind(pci_bdf, current)
+            self._write(self._driver_override(pci_bdf), VFIO_DRIVER)
+            self._bind(pci_bdf, VFIO_DRIVER)
+        group = self.iommu_group(pci_bdf)
+        if cfg.iommu_mode == "iommufd":
+            dev_node = os.path.join(self._dev, "vfio", "devices",
+                                    f"vfio{group}")
+        else:
+            dev_node = os.path.join(self._dev, "vfio", str(group))
+        return ContainerEdits(
+            env=[f"TPU_VFIO_GROUP={group}",
+                 f"TPU_VFIO_MODE={cfg.iommu_mode}"],
+            device_nodes=[os.path.join(self._dev, "vfio", "vfio"), dev_node],
+        )
+
+    def unconfigure(self, pci_bdf: str) -> None:
+        """Return the function to the native driver (Unconfigure :189)."""
+        if self._current_driver(pci_bdf) == VFIO_DRIVER:
+            self._unbind(pci_bdf, VFIO_DRIVER)
+        try:
+            self._write(self._driver_override(pci_bdf), "\n")
+        except OSError:
+            pass
+        try:
+            self._bind(pci_bdf, NATIVE_DRIVER)
+        except OSError as e:
+            logger.warning("rebind %s to %s: %s", pci_bdf, NATIVE_DRIVER, e)

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import dot_product_attention
+from ..ops.attention import attention
 from ..parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
 
 
@@ -36,6 +36,8 @@ class LlamaConfig:
     rope_theta: float = 500_000.0
     norm_eps: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
+    # "auto": pallas flash attention on TPU, einsum elsewhere.
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -151,7 +153,7 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
     v = (a @ p["wv"].astype(dt)).reshape(B, S, kv, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    attn = dot_product_attention(q, k, v, causal=True)
+    attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
     attn = attn.reshape(B, S, h * hd)
     x = x + attn @ p["wo"].astype(dt)
 

@@ -1,1 +1,9 @@
-"""TPU compute ops: attention, collectives, (pallas kernels as they land)."""
+"""TPU compute ops: attention, collectives, pallas kernels."""
+
+TPU_BACKENDS = ("tpu", "axon")
+
+
+def is_tpu_backend() -> bool:
+    import jax  # noqa: PLC0415
+
+    return jax.default_backend() in TPU_BACKENDS

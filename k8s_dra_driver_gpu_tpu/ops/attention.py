@@ -11,6 +11,28 @@ import jax
 import jax.numpy as jnp
 
 
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatch: pallas flash attention on TPU, einsum elsewhere.
+
+    impl: "auto" | "flash" | "einsum".
+    """
+    if impl == "auto":
+        from . import is_tpu_backend  # noqa: PLC0415
+
+        impl = "flash" if is_tpu_backend() else "einsum"
+    if impl == "flash":
+        from .flash_attention import flash_attention  # noqa: PLC0415
+
+        return flash_attention(q, k, v, causal=causal)
+    return dot_product_attention(q, k, v, causal=causal)
+
+
 def dot_product_attention(
     q: jax.Array,  # [B, S, H, hd]
     k: jax.Array,  # [B, S, K, hd]

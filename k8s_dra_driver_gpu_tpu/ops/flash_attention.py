@@ -1,0 +1,150 @@
+"""Pallas flash attention for TPU: blocked online-softmax, causal, GQA.
+
+The MXU-friendly formulation: q blocks of (block_q, head_dim) stream
+against the full K/V of their (batch, kv-head) pair held in VMEM; the
+softmax runs online (running max + normalizer) in fp32 scratch while the
+two matmuls stay in the input dtype. Causal masking skips whole k-blocks
+past the diagonal. GQA is expressed in the BlockSpec index maps (q-head
+h reads kv-head h // group) -- no materialized KV repetition.
+
+Falls back to interpret mode off-TPU so the same code path runs in CPU
+tests (mirroring the mock-backend strategy of the driver side).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  sm_scale: float, kv_len: int):
+    """One (batch*head, q-block) program instance.
+
+    q_ref: [1, block_q, hd]; k_ref/v_ref: [1, S_padded, hd] (padded to a
+    block_k multiple; kv_len is the true length); o_ref like q_ref.
+    """
+    _, block_q, hd = q_ref.shape
+    seq_len = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+
+    def body(ki, carry):
+        o_acc, m_prev, l_prev = carry
+        k_start = ki * block_k
+        k = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        # Padding keys never contribute.
+        valid = k_pos < kv_len
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        o_new = o_acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return o_new, m_new, l_new
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        # Blocks strictly past the diagonal contribute nothing.
+        num_k_blocks = jnp.minimum(
+            num_k_blocks, pl.cdiv(q_start + block_q, block_k)
+        )
+
+    o_acc = jnp.zeros((block_q, hd), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    o_acc, _, l = jax.lax.fori_loop(0, num_k_blocks, body, (o_acc, m0, l0))
+    o_ref[0] = (o_acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,  # [B, S, H, hd]
+    k: jax.Array,  # [B, S, K, hd]
+    v: jax.Array,  # [B, S, K, hd]
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+) -> jax.Array:
+    from . import is_tpu_backend  # noqa: PLC0415
+
+    B, S, H, hd = q.shape
+    K = k.shape[2]
+    group = H // K
+    if interpret is None:
+        interpret = not is_tpu_backend()
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+
+    # Pad the kv sequence to a block_k multiple: a clamped pl.ds read on
+    # a partial last block would re-read (and double-count) real keys
+    # under wrong position labels. Padding keys are masked by kv_len.
+    S_pad = -(-S // block_k) * block_k
+
+    # [B, H|K, S, hd] layout so the grid walks (batch*head, q-block).
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, hd)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * K, S, hd)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * K, S, hd)
+    if S_pad != S:
+        pad = ((0, 0), (0, S_pad - S), (0, 0))
+        kt = jnp.pad(kt, pad)
+        vt = jnp.pad(vt, pad)
+
+    grid = (B * H, pl.cdiv(S, block_q))
+
+    def q_index(bh, qi):
+        return (bh, qi, 0)
+
+    def kv_index(bh, qi):
+        # GQA: q-head bh maps onto kv-head (bh % H) // group.
+        b = bh // H
+        h = bh % H
+        return (b * K + h // group, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            block_k=block_k,
+            causal=causal,
+            sm_scale=1.0 / (hd ** 0.5),
+            kv_len=S,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), q_index),
+            pl.BlockSpec((1, S_pad, hd), kv_index),
+            pl.BlockSpec((1, S_pad, hd), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hd), q_index),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)

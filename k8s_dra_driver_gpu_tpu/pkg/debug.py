@@ -1,0 +1,35 @@
+"""Debug signal handlers: thread-stack dumps on SIGUSR1/SIGUSR2.
+
+Reference: internal/common/util.go:29-34 -- goroutine-stack dumps to
+/tmp/goroutine-stacks.dump on SIGUSR1/2, used to diagnose wedged
+prepare/unprepare flows in the field.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import signal
+import sys
+import threading
+import traceback
+
+DUMP_PATH = "/tmp/thread-stacks.dump"
+
+
+def dump_thread_stacks(path: str = DUMP_PATH) -> None:
+    frames = sys._current_frames()
+    with open(path, "w", encoding="utf-8") as f:
+        for thread in threading.enumerate():
+            f.write(f"--- {thread.name} (ident {thread.ident}, "
+                    f"daemon={thread.daemon}) ---\n")
+            frame = frames.get(thread.ident)
+            if frame is not None:
+                f.write("".join(traceback.format_stack(frame)))
+            f.write("\n")
+
+
+def start_debug_signal_handlers(path: str = DUMP_PATH) -> None:
+    """Install SIGUSR1/SIGUSR2 stack dumpers + SIGABRT faulthandler."""
+    signal.signal(signal.SIGUSR1, lambda *a: dump_thread_stacks(path))
+    signal.signal(signal.SIGUSR2, lambda *a: dump_thread_stacks(path))
+    faulthandler.enable()

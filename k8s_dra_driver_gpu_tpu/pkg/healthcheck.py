@@ -1,0 +1,82 @@
+"""Plugin self-healthcheck: probe our own kubelet-facing sockets.
+
+Reference: cmd/gpu-kubelet-plugin/health.go:51-130 -- a healthcheck
+service that dials the plugin's own registration + DRA unix sockets and
+reports healthy only when both answer; exposed for container probes.
+Here it is a tiny HTTP endpoint (GET /healthz -> 200 ok / 503).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import grpc
+
+from .dra.proto import plugin_registration_pb2 as regpb
+from .dra.service import registration_client_stubs
+
+
+def probe_sockets(plugin_socket: str, registry_socket: str,
+                  timeout: float = 3.0) -> tuple[bool, str]:
+    """Dial both sockets like the kubelet would."""
+    ch = None
+    try:
+        ch, get_info, _ = registration_client_stubs(registry_socket)
+        info = get_info(regpb.InfoRequest(), timeout=timeout)
+        if info.type != "DRAPlugin":
+            return False, f"unexpected plugin type {info.type!r}"
+    except grpc.RpcError as e:
+        return False, f"registration socket: {e.code().name}"
+    finally:
+        if ch is not None:
+            ch.close()
+    ch = None
+    try:
+        # The DRA socket must at least accept a connection.
+        ch = grpc.insecure_channel(f"unix://{plugin_socket}")
+        grpc.channel_ready_future(ch).result(timeout=timeout)
+    except (grpc.RpcError, grpc.FutureTimeoutError):
+        return False, "DRA socket not ready"
+    finally:
+        if ch is not None:
+            ch.close()
+    return True, "ok"
+
+
+class HealthcheckServer:
+    def __init__(self, plugin_socket: str, registry_socket: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        plugin_sock, registry_sock = plugin_socket, registry_socket
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.split("?", 1)[0].rstrip("/") != "/healthz":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                ok, msg = probe_sockets(plugin_sock, registry_sock)
+                body = msg.encode()
+                self.send_response(200 if ok else 503)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="healthcheck", daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

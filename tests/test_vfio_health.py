@@ -1,0 +1,164 @@
+"""VFIO passthrough, healthcheck server, and debug-dump tests."""
+
+import os
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+    Config,
+    DeviceState,
+    PrepareError,
+)
+from k8s_dra_driver_gpu_tpu.kubeletplugin.vfio import VfioPciManager
+from k8s_dra_driver_gpu_tpu.pkg.debug import dump_thread_stacks
+from k8s_dra_driver_gpu_tpu.tpulib.binding import EnumerateOptions
+from k8s_dra_driver_gpu_tpu.pkg.featuregates import FeatureGates
+from tests.fake_kube import make_claim, opaque
+
+
+def fake_pci_tree(tmp_path, bdfs, native="tpu"):
+    """A sysfs skeleton with bind/unbind/driver_override files."""
+    sys_root = tmp_path / "sys"
+    for drv in (native, "vfio-pci"):
+        d = sys_root / "bus" / "pci" / "drivers" / drv
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "bind").write_text("")
+        (d / "unbind").write_text("")
+    for i, bdf in enumerate(bdfs):
+        dev = sys_root / "bus" / "pci" / "devices" / bdf
+        dev.mkdir(parents=True)
+        (dev / "driver_override").write_text("")
+        # iommu_group + current driver as symlinks.
+        group_dir = sys_root / "kernel" / "iommu_groups" / str(10 + i)
+        group_dir.mkdir(parents=True)
+        (dev / "iommu_group").symlink_to(group_dir)
+        (dev / "driver").symlink_to(
+            sys_root / "bus" / "pci" / "drivers" / native)
+    return str(sys_root)
+
+
+class TestVfioManager:
+    def test_configure_unconfigure(self, tmp_path):
+        sys_root = fake_pci_tree(tmp_path, ["0000:00:04.0"])
+        mgr = VfioPciManager(sys_root=sys_root, dev_root=str(tmp_path / "dev"))
+        from k8s_dra_driver_gpu_tpu.api.configs import PassthroughConfig
+
+        edits = mgr.configure("0000:00:04.0", PassthroughConfig())
+        assert "TPU_VFIO_GROUP=10" in edits.env
+        assert any(p.endswith("vfio/10") for p in edits.device_nodes)
+        # driver_override was set to vfio-pci.
+        override = (tmp_path / "sys" / "bus" / "pci" / "devices" /
+                    "0000:00:04.0" / "driver_override")
+        assert override.read_text() == "vfio-pci"
+        mgr.unconfigure("0000:00:04.0")
+        assert override.read_text().strip() == ""
+
+    def test_iommufd_mode(self, tmp_path):
+        sys_root = fake_pci_tree(tmp_path, ["0000:00:04.0"])
+        mgr = VfioPciManager(sys_root=sys_root, dev_root="/dev")
+        from k8s_dra_driver_gpu_tpu.api.configs import PassthroughConfig
+
+        edits = mgr.configure("0000:00:04.0",
+                              PassthroughConfig(iommu_mode="iommufd"))
+        assert any("vfio/devices/vfio10" in p for p in edits.device_nodes)
+
+
+class TestPassthroughPrepare:
+    @pytest.fixture()
+    def pt_state(self, tmp_path):
+        cfg = Config(
+            root=str(tmp_path / "state"),
+            tpulib_opts=EnumerateOptions(mock_topology="v5e-4"),
+            feature_gates=FeatureGates.parse("PassthroughSupport=true"),
+            cdi_root=str(tmp_path / "cdi"),
+        )
+        # Point the vfio manager at a fake sysfs for the mock BDFs.
+        state = DeviceState(cfg)
+        sys_root = fake_pci_tree(
+            tmp_path, [c.pci_bdf for c in state.host.chips]
+        )
+        state._vfio = VfioPciManager(sys_root=sys_root,
+                                     dev_root=str(tmp_path / "dev"))
+        state.allocatable = state._enumerate_allocatable()
+        return state
+
+    def test_passthrough_devices_published(self, pt_state):
+        assert "chip-0-passthrough" in pt_state.allocatable
+
+    def test_passthrough_claim_lifecycle(self, pt_state):
+        cfgs = [{"parameters": opaque("PassthroughConfig")}]
+        ids = pt_state.prepare(
+            make_claim("c1", ["chip-0-passthrough"], configs=cfgs))
+        assert len(ids) == 1
+        spec = pt_state._cdi.read_spec("c1")
+        env = spec["devices"][0]["containerEdits"]["env"]
+        assert any(e.startswith("TPU_VFIO_GROUP=") for e in env)
+        # Passthrough chip conflicts with a whole-chip claim.
+        with pytest.raises(PrepareError):
+            pt_state.prepare(make_claim("c2", ["chip-0"]))
+        pt_state.unprepare("c1")
+        pt_state.prepare(make_claim("c2", ["chip-0"]))
+
+    def test_restart_with_vfio_claim_survives(self, tmp_path, pt_state):
+        # Reconciliation on restart must not trip over vfio live records
+        # (they carry no carve-out uuid).
+        cfgs = [{"parameters": opaque("PassthroughConfig")}]
+        pt_state.prepare(make_claim("c1", ["chip-0-passthrough"], configs=cfgs))
+        assert pt_state.destroy_unknown_subslices() == 0
+
+    def test_no_iommu_group_rejected(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.api.configs import PassthroughConfig
+
+        sys_root = tmp_path / "sys"
+        dev = sys_root / "bus" / "pci" / "devices" / "0000:00:09.0"
+        dev.mkdir(parents=True)
+        (dev / "driver_override").write_text("")
+        mgr = VfioPciManager(sys_root=str(sys_root), dev_root="/dev")
+        with pytest.raises(RuntimeError, match="no iommu group"):
+            mgr.configure("0000:00:09.0", PassthroughConfig())
+
+    def test_wrong_config_kind(self, pt_state):
+        cfgs = [{"parameters": opaque("TpuConfig")}]
+        with pytest.raises(PrepareError):
+            pt_state.prepare(
+                make_claim("c1", ["chip-0-passthrough"], configs=cfgs))
+
+
+class TestHealthcheck:
+    def test_healthz(self, tmp_path):
+        import urllib.request, urllib.error
+        from k8s_dra_driver_gpu_tpu.pkg.dra.service import PluginServer
+        from k8s_dra_driver_gpu_tpu.pkg.healthcheck import HealthcheckServer
+
+        server = PluginServer(
+            "tpu.dra.dev",
+            plugin_dir=str(tmp_path / "p"),
+            registry_dir=str(tmp_path / "r"),
+            prepare_fn=lambda claims: {},
+            unprepare_fn=lambda claims: {},
+        )
+        server.start()
+        hc = HealthcheckServer(server.plugin_socket, server.registry_socket)
+        hc.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{hc.port}/healthz", timeout=10
+            )
+            assert body.status == 200
+            # Kill the gRPC server: healthz flips to 503.
+            server.stop()
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{hc.port}/healthz", timeout=10)
+            assert e.value.code == 503
+        finally:
+            hc.stop()
+
+
+class TestDebugDump:
+    def test_dump_thread_stacks(self, tmp_path):
+        path = str(tmp_path / "stacks.dump")
+        dump_thread_stacks(path)
+        content = open(path).read()
+        assert "MainThread" in content
+        assert "test_dump_thread_stacks" in content
