@@ -9,11 +9,11 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import signal
+
 import sys
 
 from ... import __version__
-from ...pkg.debug import start_debug_signal_handlers
+from ...pkg.debug import start_debug_signal_handlers, wait_for_termination
 from ...pkg.dra.service import PluginServer
 from ...pkg.healthcheck import HealthcheckServer
 from ...pkg.kubeclient import FakeKubeClient, KubeClient
@@ -94,12 +94,8 @@ def run(argv: list[str] | None = None) -> int:
         extras.append(h)
 
     logger.info("serving CD DRA on %s", server.plugin_socket)
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     try:
-        while not stop:
-            signal.pause()
+        wait_for_termination()
     finally:
         server.stop()
         for e in extras:

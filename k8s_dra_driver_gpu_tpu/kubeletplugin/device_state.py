@@ -50,7 +50,7 @@ from .deviceinfo import (
     PassthroughInfo,
     SubSliceInfo,
 )
-from .vfio import VfioPciManager
+from .vfio import VfioPciManager, VfioRegistry
 from .sharing import MultiTenancyManager, TimeSlicingManager
 from .subslice import SubSliceLiveTuple, enumerate_subslice_devices
 
@@ -149,6 +149,7 @@ class DeviceState:
         self._vfio = VfioPciManager(
             sys_root=config.tpulib_opts.sys_root or "/sys",
             dev_root=config.tpulib_opts.dev_root or "/dev",
+            registry=VfioRegistry(config.root),
         )
         self.allocatable = self._enumerate_allocatable()
         self._checkpoint = CheckpointManager(config.root, boot_id=config.boot_id)
@@ -188,9 +189,17 @@ class DeviceState:
             )
         if self._config.feature_gates.is_enabled(PASSTHROUGH_SUPPORT):
             for chip in self.host.chips:
+                group = self._vfio.iommu_group(chip.pci_bdf)
+                if group < 0:
+                    # No IOMMU group: the device could never be prepared
+                    # for passthrough, so don't let a scheduler pick it.
+                    logger.warning(
+                        "chip %s has no iommu group: not publishing a "
+                        "passthrough device", chip.pci_bdf,
+                    )
+                    continue
                 info = PassthroughInfo(
-                    chip=chip, host=self.host,
-                    iommu_group=self._vfio.iommu_group(chip.pci_bdf),
+                    chip=chip, host=self.host, iommu_group=group,
                 )
                 out[info.canonical_name] = AllocatableDevice(
                     kind=DeviceKind.PASSTHROUGH, passthrough=info
@@ -230,8 +239,9 @@ class DeviceState:
     # -- crash reconciliation -------------------------------------------------
 
     def destroy_unknown_subslices(self) -> int:
-        """Tear down live carve-outs not referenced by any checkpointed
-        claim (checkpoint is source of truth; device_state.go:388)."""
+        """Tear down live carve-outs AND orphaned vfio rebinds not
+        referenced by any checkpointed claim (checkpoint is source of
+        truth; device_state.go:388)."""
         cp = self._checkpoint.get()
         referenced = {
             dev.live["uuid"]
@@ -244,8 +254,25 @@ class DeviceState:
             if uid not in referenced:
                 self._registry.destroy(uid)
                 destroyed += 1
+        # Orphaned passthrough rebinds: a crash between configure() and
+        # the completed checkpoint leaves the chip on vfio-pci with no
+        # claim record; the vfio registry lets us rebind it back.
+        claimed_bdfs = {
+            dev.live["pciBdf"]
+            for c in cp.claims.values()
+            for dev in c.devices
+            if dev.live and dev.live.get("vfio")
+        }
+        if self._vfio.registry is not None:
+            for bdf in list(self._vfio.registry.list()):
+                if bdf not in claimed_bdfs:
+                    logger.warning("unbinding orphaned vfio rebind of %s", bdf)
+                    self._vfio.unconfigure(bdf)
+                    destroyed += 1
         if destroyed:
-            logger.warning("destroyed %d unknown sub-slice(s)", destroyed)
+            logger.warning(
+                "reconciled %d unknown sub-slice(s)/rebind(s)", destroyed
+            )
         return destroyed
 
     # -- prepare --------------------------------------------------------------

@@ -14,11 +14,11 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import signal
+
 import sys
 
 from .. import __version__
-from ..pkg.debug import start_debug_signal_handlers
+from ..pkg.debug import start_debug_signal_handlers, wait_for_termination
 from ..pkg.featuregates import FeatureGates
 from ..pkg.kubeclient import FakeKubeClient, KubeClient
 from ..pkg.metrics import DRARequestMetrics, MetricsServer
@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int,
                    default=int(env("METRICS_PORT", "0")),
                    help="Prometheus port (0=disabled) [METRICS_PORT]")
+    p.add_argument("--healthcheck-port", type=int,
+                   default=int(env("HEALTHCHECK_PORT", "0")),
+                   help="/healthz port probing own sockets (0=disabled) "
+                        "[HEALTHCHECK_PORT]")
     p.add_argument("--feature-gates", default=env("FEATURE_GATES", ""),
                    help="Gate1=true,Gate2=false [FEATURE_GATES]")
     p.add_argument("--mock-topology", default=env("TPULIB_MOCK_TOPOLOGY"),
@@ -112,32 +116,38 @@ def run(argv: list[str] | None = None) -> int:
         unprepare_fn=driver.unprepare_resource_claims,
     )
 
-    metrics_server = None
+    extras = []
     if args.metrics_port > 0:
-        metrics_server = MetricsServer(
+        m = MetricsServer(
             metrics.registry, host="0.0.0.0", port=args.metrics_port
         )
-        metrics_server.start()
+        m.start()
+        extras.append(m)
 
     driver.start()
     server.start()
+    if args.healthcheck_port > 0:
+        from ..pkg.healthcheck import HealthcheckServer  # noqa: PLC0415
+
+        h = HealthcheckServer(
+            server.plugin_socket, server.registry_socket,
+            host="0.0.0.0", port=args.healthcheck_port,
+        )
+        h.start()
+        extras.append(h)
     logger.info(
         "serving DRA on %s (registry %s); %d allocatable device(s)",
         server.plugin_socket, server.registry_socket,
         len(driver.state.allocatable),
     )
 
-    stop = []
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     try:
-        while not stop:
-            signal.pause()
+        wait_for_termination()
     finally:
         server.stop()
         driver.stop()
-        if metrics_server:
-            metrics_server.stop()
+        for e in extras:
+            e.stop()
     return 0
 
 

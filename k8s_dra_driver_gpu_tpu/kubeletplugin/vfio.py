@@ -25,10 +25,55 @@ VFIO_DRIVER = "vfio-pci"
 NATIVE_DRIVER = "tpu"  # the in-kernel accel driver to rebind on release
 
 
+class VfioRegistry:
+    """Crash-persistent record of functions we rebound to vfio-pci (and
+    their original drivers), written BEFORE the rebind so startup
+    reconciliation can always undo an orphaned rebind -- the same role
+    the SubSliceRegistry plays for dynamic carve-outs."""
+
+    def __init__(self, root: str):
+        os.makedirs(root, exist_ok=True)
+        self._path = os.path.join(root, "vfio.json")
+
+    def list(self) -> dict[str, dict]:
+        import json  # noqa: PLC0415
+
+        try:
+            with open(self._path, encoding="utf-8") as f:
+                return json.load(f)
+        except (FileNotFoundError, ValueError):
+            return {}
+
+    def _write(self, entries: dict[str, dict]) -> None:
+        import json  # noqa: PLC0415
+
+        tmp = self._path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(entries, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+
+    def add(self, pci_bdf: str, native_driver: str | None) -> None:
+        entries = self.list()
+        entries[pci_bdf] = {"nativeDriver": native_driver or ""}
+        self._write(entries)
+
+    def remove(self, pci_bdf: str) -> None:
+        entries = self.list()
+        if entries.pop(pci_bdf, None) is not None:
+            self._write(entries)
+
+    def native_driver(self, pci_bdf: str) -> str | None:
+        return self.list().get(pci_bdf, {}).get("nativeDriver") or None
+
+
 class VfioPciManager:
-    def __init__(self, sys_root: str = "/sys", dev_root: str = "/dev"):
+    def __init__(self, sys_root: str = "/sys", dev_root: str = "/dev",
+                 registry: VfioRegistry | None = None):
         self._sys = sys_root
         self._dev = dev_root
+        self.registry = registry
 
     # -- sysfs paths ------------------------------------------------------------
 
@@ -82,6 +127,10 @@ class VfioPciManager:
             )
         current = self._current_driver(pci_bdf)
         if current != VFIO_DRIVER:
+            # Record the rebind (and the driver to restore) BEFORE
+            # touching sysfs: a crash mid-rebind must be reconcilable.
+            if self.registry is not None:
+                self.registry.add(pci_bdf, current)
             if current:
                 self._unbind(pci_bdf, current)
             self._write(self._driver_override(pci_bdf), VFIO_DRIVER)
@@ -99,7 +148,12 @@ class VfioPciManager:
         )
 
     def unconfigure(self, pci_bdf: str) -> None:
-        """Return the function to the native driver (Unconfigure :189)."""
+        """Return the function to its recorded native driver
+        (Unconfigure :189)."""
+        native = None
+        if self.registry is not None:
+            native = self.registry.native_driver(pci_bdf)
+        native = native or NATIVE_DRIVER
         if self._current_driver(pci_bdf) == VFIO_DRIVER:
             self._unbind(pci_bdf, VFIO_DRIVER)
         try:
@@ -107,6 +161,8 @@ class VfioPciManager:
         except OSError:
             pass
         try:
-            self._bind(pci_bdf, NATIVE_DRIVER)
+            self._bind(pci_bdf, native)
         except OSError as e:
-            logger.warning("rebind %s to %s: %s", pci_bdf, NATIVE_DRIVER, e)
+            logger.warning("rebind %s to %s: %s", pci_bdf, native, e)
+        if self.registry is not None:
+            self.registry.remove(pci_bdf)

@@ -33,3 +33,16 @@ def start_debug_signal_handlers(path: str = DUMP_PATH) -> None:
     signal.signal(signal.SIGUSR1, lambda *a: dump_thread_stacks(path))
     signal.signal(signal.SIGUSR2, lambda *a: dump_thread_stacks(path))
     faulthandler.enable()
+
+
+def wait_for_termination() -> None:
+    """Block until SIGTERM/SIGINT, race-free.
+
+    signal.pause() in a check-then-pause loop loses a signal delivered
+    between the check and the pause; an Event set from the handler is
+    immune (the kubelet's SIGKILL-after-grace would otherwise hit us).
+    """
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()

@@ -8,13 +8,11 @@ Here it is a tiny HTTP endpoint (GET /healthz -> 200 ok / 503).
 
 from __future__ import annotations
 
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
 import grpc
 
 from .dra.proto import plugin_registration_pb2 as regpb
 from .dra.service import registration_client_stubs
+from .httpserver import SimpleHTTPEndpoint
 
 
 def probe_sockets(plugin_socket: str, registry_socket: str,
@@ -44,39 +42,12 @@ def probe_sockets(plugin_socket: str, registry_socket: str,
     return True, "ok"
 
 
-class HealthcheckServer:
+class HealthcheckServer(SimpleHTTPEndpoint):
     def __init__(self, plugin_socket: str, registry_socket: str,
                  host: str = "127.0.0.1", port: int = 0):
-        plugin_sock, registry_sock = plugin_socket, registry_socket
+        def handler():
+            ok, msg = probe_sockets(plugin_socket, registry_socket)
+            return (200 if ok else 503, "text/plain", msg.encode())
 
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802
-                if self.path.split("?", 1)[0].rstrip("/") != "/healthz":
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                ok, msg = probe_sockets(plugin_sock, registry_sock)
-                body = msg.encode()
-                self.send_response(200 if ok else 503)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):
-                pass
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="healthcheck", daemon=True
-        )
-
-    @property
-    def port(self) -> int:
-        return self._server.server_address[1]
-
-    def start(self) -> None:
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+        super().__init__("/healthz", handler, host=host, port=port,
+                         thread_name="healthcheck")

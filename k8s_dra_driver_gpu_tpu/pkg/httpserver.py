@@ -1,0 +1,51 @@
+"""Shared single-endpoint HTTP server (metrics, healthz, ...)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+# handler() -> (status_code, content_type, body_bytes)
+EndpointFn = Callable[[], tuple[int, str, bytes]]
+
+
+class SimpleHTTPEndpoint:
+    """Serves GET <path> from ``fn``; anything else 404s."""
+
+    def __init__(self, path: str, fn: EndpointFn, host: str = "127.0.0.1",
+                 port: int = 0, thread_name: str = "http-endpoint"):
+        endpoint_path = path.rstrip("/")
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                got = self.path.split("?", 1)[0].rstrip("/")
+                if got not in ("", endpoint_path):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                status, ctype, body = fn()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=thread_name, daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -8,10 +8,8 @@ exposition server -- prometheus_httpserver.go).
 
 from __future__ import annotations
 
-import threading
 import time
 from contextlib import contextmanager
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from prometheus_client import (
     CollectorRegistry,
@@ -20,6 +18,8 @@ from prometheus_client import (
     Histogram,
     generate_latest,
 )
+
+from .httpserver import SimpleHTTPEndpoint
 
 _BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)
 
@@ -87,41 +87,14 @@ class ComputeDomainMetrics:
         )
 
 
-class MetricsServer:
-    """Tiny HTTP exposition server (reference prometheus_httpserver.go)."""
+class MetricsServer(SimpleHTTPEndpoint):
+    """Prometheus exposition server (reference prometheus_httpserver.go)."""
 
-    def __init__(self, registry: CollectorRegistry, host: str = "127.0.0.1", port: int = 0):
-        reg = registry
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 - http.server API
-                path = self.path.split("?", 1)[0].rstrip("/")
-                if path not in ("", "/metrics"):
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                body = generate_latest(reg)
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):  # silence per-request logging
-                pass
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="metrics-http", daemon=True
+    def __init__(self, registry: CollectorRegistry, host: str = "127.0.0.1",
+                 port: int = 0):
+        super().__init__(
+            "/metrics",
+            lambda: (200, "text/plain; version=0.0.4",
+                     generate_latest(registry)),
+            host=host, port=port, thread_name="metrics-http",
         )
-
-    @property
-    def port(self) -> int:
-        return self._server.server_address[1]
-
-    def start(self) -> None:
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()

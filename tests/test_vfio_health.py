@@ -66,21 +66,25 @@ class TestVfioManager:
 class TestPassthroughPrepare:
     @pytest.fixture()
     def pt_state(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import PyTpuLib
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.vfio import VfioRegistry
+
+        bdfs = [
+            c.pci_bdf
+            for c in PyTpuLib().enumerate(
+                EnumerateOptions(mock_topology="v5e-4")).chips
+        ]
+        sys_root = fake_pci_tree(tmp_path, bdfs)
         cfg = Config(
             root=str(tmp_path / "state"),
-            tpulib_opts=EnumerateOptions(mock_topology="v5e-4"),
+            tpulib_opts=EnumerateOptions(
+                mock_topology="v5e-4", sys_root=sys_root,
+                dev_root=str(tmp_path / "dev"),
+            ),
             feature_gates=FeatureGates.parse("PassthroughSupport=true"),
             cdi_root=str(tmp_path / "cdi"),
         )
-        # Point the vfio manager at a fake sysfs for the mock BDFs.
-        state = DeviceState(cfg)
-        sys_root = fake_pci_tree(
-            tmp_path, [c.pci_bdf for c in state.host.chips]
-        )
-        state._vfio = VfioPciManager(sys_root=sys_root,
-                                     dev_root=str(tmp_path / "dev"))
-        state.allocatable = state._enumerate_allocatable()
-        return state
+        return DeviceState(cfg)
 
     def test_passthrough_devices_published(self, pt_state):
         assert "chip-0-passthrough" in pt_state.allocatable
@@ -105,6 +109,55 @@ class TestPassthroughPrepare:
         cfgs = [{"parameters": opaque("PassthroughConfig")}]
         pt_state.prepare(make_claim("c1", ["chip-0-passthrough"], configs=cfgs))
         assert pt_state.destroy_unknown_subslices() == 0
+
+    def test_crash_orphaned_rebind_reconciled(self, tmp_path, pt_state):
+        # Simulate a crash between configure() and PrepareCompleted: the
+        # vfio registry has an entry, the checkpoint does not.
+        chip = pt_state.host.chips[0]
+        from k8s_dra_driver_gpu_tpu.api.configs import PassthroughConfig
+
+        pt_state._vfio.configure(chip.pci_bdf, PassthroughConfig())
+        assert chip.pci_bdf in pt_state._vfio.registry.list()
+        # Restart over the same root: the orphan is unbound and the
+        # original driver restored.
+        cfg2 = Config(
+            root=pt_state._config.root,
+            tpulib_opts=pt_state._config.tpulib_opts,
+            feature_gates=pt_state._config.feature_gates,
+            cdi_root=pt_state._config.cdi_root,
+        )
+        state2 = DeviceState(cfg2)
+        assert state2._vfio.registry.list() == {}
+        override = os.path.join(
+            pt_state._config.tpulib_opts.sys_root, "bus", "pci", "devices",
+            chip.pci_bdf, "driver_override")
+        assert open(override).read().strip() == ""
+
+    def test_no_iommu_group_not_published(self, tmp_path):
+        # A chip without an iommu group must not appear as a
+        # passthrough device at all.
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import PyTpuLib
+
+        bdfs = [
+            c.pci_bdf
+            for c in PyTpuLib().enumerate(
+                EnumerateOptions(mock_topology="v5e-4")).chips
+        ]
+        sys_root = fake_pci_tree(tmp_path, bdfs[:2])  # only 2 have groups
+        for bdf in bdfs[2:]:
+            d = tmp_path / "sys" / "bus" / "pci" / "devices" / bdf
+            d.mkdir(parents=True)
+            (d / "driver_override").write_text("")
+        cfg = Config(
+            root=str(tmp_path / "state"),
+            tpulib_opts=EnumerateOptions(
+                mock_topology="v5e-4", sys_root=sys_root),
+            feature_gates=FeatureGates.parse("PassthroughSupport=true"),
+            cdi_root=str(tmp_path / "cdi"),
+        )
+        state = DeviceState(cfg)
+        pt = [n for n in state.allocatable if n.endswith("passthrough")]
+        assert len(pt) == 2
 
     def test_no_iommu_group_rejected(self, tmp_path):
         from k8s_dra_driver_gpu_tpu.api.configs import PassthroughConfig
