@@ -33,9 +33,11 @@ CLIQUE_RESOURCE = "computedomaincliques"
 
 
 class ComputeDomainController:
-    def __init__(self, kube, driver_namespace: str = "tpu-dra-driver"):
+    def __init__(self, kube, driver_namespace: str = "tpu-dra-driver",
+                 metrics=None):
         self.kube = kube
         self.ns = driver_namespace
+        self.metrics = metrics  # ComputeDomainMetrics or None
         self.queue = WorkQueue(
             limiter=CONTROLLER_DEFAULT_LIMITER, name="cd-controller"
         )
@@ -162,6 +164,11 @@ class ComputeDomainController:
             ),
             "nodes": sorted(nodes, key=lambda n: n.get("index", -1)),
         }
+        if self.metrics is not None:
+            ns = cd["metadata"].get("namespace", "default")
+            name = cd["metadata"]["name"]
+            self.metrics.status.labels(ns, name).set(1 if ready else 0)
+            self.metrics.nodes.labels(ns, name).set(len(nodes))
         if cd.get("status") == status:
             return
         try:
@@ -198,6 +205,13 @@ class ComputeDomainController:
                     namespace=clique["metadata"].get("namespace"),
                 )
         self._remove_node_labels(uid)
+        if self.metrics is not None:
+            ns = meta.get("namespace", "default")
+            for gauge in (self.metrics.status, self.metrics.nodes):
+                try:
+                    gauge.remove(ns, meta["name"])
+                except KeyError:
+                    pass  # never reported
         finalizers = [f for f in meta.get("finalizers", []) if f != FINALIZER]
         try:
             self.kube.patch(

@@ -53,7 +53,8 @@ def run(argv: list[str] | None = None) -> int:
                                        port=args.metrics_port)
         metrics_server.start()
 
-    controller = ComputeDomainController(kube, args.namespace)
+    controller = ComputeDomainController(kube, args.namespace,
+                                         metrics=metrics)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())

@@ -21,6 +21,7 @@ import json
 import logging
 import os
 import threading
+import time
 import uuid as uuidlib
 from dataclasses import dataclass, field
 
@@ -34,6 +35,7 @@ from ..pkg.featuregates import (
     FeatureGates,
 )
 from ..pkg.flock import Flock
+from ..pkg.timing import SegmentTimer
 from ..tpulib.binding import EnumerateOptions, TpuHostInfo, load as load_tpulib
 from .cdi import CDIHandler, ContainerEdits
 from .checkpoint import (
@@ -284,54 +286,74 @@ class DeviceState:
         plugin process (upgrade handover) can't interleave its own
         prepare/unprepare between our overlap validation and checkpoint
         writes (reference driver.go:381, pulock.Acquire with 10s timeout).
+
+        Per-segment wall times are logged at debug level (the t_prep_*
+        instrumentation, reference driver.go:394-404).
         """
-        with self.pu_lock.acquire(timeout=10.0), self._lock:
-            cp = self._checkpoint.get()
-            existing = cp.claims.get(claim.uid)
-            if existing and existing.state == ClaimState.PREPARE_COMPLETED.value:
-                return [
-                    i for d in existing.devices for i in d.cdi_device_ids
-                ]
-            if existing and existing.state == ClaimState.PREPARE_STARTED.value:
-                # A previous Prepare died mid-flight: roll back its
-                # partial state, then retry fresh (device_state.go:277).
-                self._rollback(existing)
+        timer = SegmentTimer("prepare", claim.uid)
+        try:
+            t0 = time.monotonic()
+            # Keep acquisition inside the with-statement: pulling the
+            # guard out would open an async-exception window where the
+            # non-reentrant flock leaks held.
+            with self.pu_lock.acquire(timeout=10.0), self._lock:
+                timer.segments["prep_lock_acq"] = time.monotonic() - t0
+                with timer.segment("prep_get_checkpoint"):
+                    cp = self._checkpoint.get()
+                existing = cp.claims.get(claim.uid)
+                if (existing
+                        and existing.state == ClaimState.PREPARE_COMPLETED.value):
+                    return [
+                        i for d in existing.devices for i in d.cdi_device_ids
+                    ]
+                if (existing
+                        and existing.state == ClaimState.PREPARE_STARTED.value):
+                    # A previous Prepare died mid-flight: roll back its
+                    # partial state, then retry fresh (device_state.go:277).
+                    with timer.segment("prep_rollback_stale"):
+                        self._rollback(existing)
 
-            self._validate_no_overlap(cp, claim)
+                self._validate_no_overlap(cp, claim)
 
-            self._checkpoint.update(
-                lambda c: c.claims.__setitem__(
-                    claim.uid,
-                    CheckpointedClaim(
+                with timer.segment("checkpoint_write_started"):
+                    self._checkpoint.update(
+                        lambda c: c.claims.__setitem__(
+                            claim.uid,
+                            CheckpointedClaim(
+                                uid=claim.uid,
+                                namespace=claim.namespace,
+                                name=claim.name,
+                                state=ClaimState.PREPARE_STARTED.value,
+                            ),
+                        )
+                    )
+
+                try:
+                    with timer.segment("prep_devices"):
+                        prepared = self._prepare_devices(claim, timer)
+                except BaseException:
+                    # _prepare_devices rolled back its own partial device
+                    # state; drop the PrepareStarted checkpoint entry.
+                    self._checkpoint.update(
+                        lambda c: c.claims.pop(claim.uid, None)
+                    )
+                    raise
+
+                def complete(c):
+                    c.claims[claim.uid] = CheckpointedClaim(
                         uid=claim.uid,
                         namespace=claim.namespace,
                         name=claim.name,
-                        state=ClaimState.PREPARE_STARTED.value,
-                    ),
-                )
-            )
+                        state=ClaimState.PREPARE_COMPLETED.value,
+                        devices=prepared,
+                    )
 
-            try:
-                prepared = self._prepare_devices(claim)
-            except BaseException:
-                # _prepare_devices rolled back its own partial device
-                # state; drop the PrepareStarted checkpoint entry.
-                self._checkpoint.update(
-                    lambda c: c.claims.pop(claim.uid, None)
-                )
-                raise
-
-            def complete(c):
-                c.claims[claim.uid] = CheckpointedClaim(
-                    uid=claim.uid,
-                    namespace=claim.namespace,
-                    name=claim.name,
-                    state=ClaimState.PREPARE_COMPLETED.value,
-                    devices=prepared,
-                )
-
-            self._checkpoint.update(complete)
-            return [i for d in prepared for i in d.cdi_device_ids]
+                with timer.segment("checkpoint_write_completed"):
+                    self._checkpoint.update(complete)
+                return [i for d in prepared for i in d.cdi_device_ids]
+        finally:
+            # Failed/slow/idempotent prepares need the breakdown most.
+            timer.done()
 
     def _validate_no_overlap(self, cp, claim: ResourceClaim) -> None:
         """Reject preparing a device whose chips/cores another claim holds
@@ -419,7 +441,9 @@ class DeviceState:
             per_request[request] = cfg_obj
         return per_request
 
-    def _prepare_devices(self, claim: ResourceClaim) -> list[CheckpointedDevice]:
+    def _prepare_devices(
+        self, claim: ResourceClaim, timer: SegmentTimer
+    ) -> list[CheckpointedDevice]:
         """All-or-nothing: any failure rolls back the partial device state
         created by this attempt (carve-outs, sharing state, CDI spec)
         before re-raising (unpreparePartiallyPrepairedClaim analog,
@@ -429,7 +453,7 @@ class DeviceState:
         touched_chips: set[int] = set()
         try:
             return self._prepare_devices_inner(
-                claim, created_live, configured_vfio, touched_chips
+                claim, created_live, configured_vfio, touched_chips, timer
             )
         except BaseException:
             for live_uuid in created_live:
@@ -447,6 +471,7 @@ class DeviceState:
         created_live: list[str],
         configured_vfio: list[str],
         touched_chips: set[int],
+        timer: SegmentTimer,
     ) -> list[CheckpointedDevice]:
         cfgs = self._resolve_configs(claim)
         prepared: list[CheckpointedDevice] = []
@@ -504,7 +529,8 @@ class DeviceState:
                         spec=ss.spec, uuid=f"tpu-ss-{uuidlib.uuid4()}"
                     )
                     # HOT path analog of createMigDevice (nvlib.go:926).
-                    self._registry.create(live_t)
+                    with timer.segment("prep_create_subslice"):
+                        self._registry.create(live_t)
                     created_live.append(live_t.uuid)
                     live = live_t.to_dict()
 
@@ -543,9 +569,10 @@ class DeviceState:
             "TPU_VISIBLE_DEVICES=" + ",".join(str(i) for i in sorted(claim_chips))
         )
         common = common.merge(sharing_edits)
-        cdi_ids = self._cdi.create_claim_spec_file(
-            claim.uid, device_edits, common
-        )
+        with timer.segment("gen_write_cdi_spec"):
+            cdi_ids = self._cdi.create_claim_spec_file(
+                claim.uid, device_edits, common
+            )
         by_name = dict(zip(sorted(device_edits), cdi_ids))
         for dev in prepared:
             dev.cdi_device_ids = [by_name[dev.canonical_name]]

@@ -1,0 +1,49 @@
+"""Per-segment wall-time instrumentation for the prepare path.
+
+Reference: the t_prep_* klog V6/V7 segments (cmd/gpu-kubelet-plugin/
+driver.go:394-404, device_state.go:229-334, nvlib.go:860-930,
+cdi.go:306) -- fine-grained timings of lock acquisition, checkpoint
+reads/writes, device creation, and CDI spec writes, logged per claim so
+field latency regressions are attributable to a segment.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+
+logger = logging.getLogger(__name__)
+
+
+class SegmentTimer:
+    """Collects named wall-time segments for one operation."""
+
+    def __init__(self, operation: str, key: str = ""):
+        self.operation = operation
+        self.key = key
+        self.segments: dict[str, float] = {}
+        self._start = time.monotonic()
+
+    @contextmanager
+    def segment(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.segments[name] = self.segments.get(name, 0.0) + (
+                time.monotonic() - t0
+            )
+
+    def done(self) -> float:
+        """Log the segment breakdown; returns total seconds."""
+        total = time.monotonic() - self._start
+        parts = " ".join(
+            f"t_{name}={dt * 1e3:.2f}ms"
+            for name, dt in sorted(self.segments.items())
+        )
+        logger.debug(
+            "%s %s total=%.2fms %s",
+            self.operation, self.key, total * 1e3, parts,
+        )
+        return total

@@ -1,0 +1,51 @@
+"""Training checkpoint/resume via orbax.
+
+The reference's "checkpoint/resume" is driver-state only (SURVEY.md §5);
+the workload side of this framework adds model/optimizer checkpointing
+so a gang-scheduled training job survives slice preemption: save on a
+cadence, restore on restart, sharding-preserving (orbax restores each
+leaf with its original NamedSharding when a mesh is supplied).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import orbax.checkpoint as ocp
+
+from .train import TrainState
+
+
+class TrainCheckpointer:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._mngr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: TrainState, wait: bool = True) -> None:
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, state_like: TrainState, step: int | None = None) -> TrainState:
+        """Restore into the structure/shardings of ``state_like`` (an
+        abstract or concrete TrainState from make_sharded_train)."""
+        step = step if step is not None else self._mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint to restore")
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, state_like
+        )
+        return self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract)
+        )
+
+    def close(self) -> None:
+        self._mngr.close()

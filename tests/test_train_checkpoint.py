@@ -1,0 +1,108 @@
+"""Train checkpoint/resume + fault-injection tests.
+
+Fault injection mirrors the reference's bats robustness suite
+(test_gpu_robustness.bats / test_cd_failover.bats): kill things and
+assert recovery.
+"""
+
+import os
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from k8s_dra_driver_gpu_tpu.models import llama
+from k8s_dra_driver_gpu_tpu.parallel.mesh import build_mesh, plan_for
+from k8s_dra_driver_gpu_tpu.train.checkpoint import TrainCheckpointer
+from k8s_dra_driver_gpu_tpu.train.train import make_sharded_train
+
+
+class TestTrainCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        mesh = build_mesh(plan_for(8))
+        cfg = llama.LlamaConfig.tiny()
+        init_fn, step_fn, batch_shard, place = make_sharded_train(mesh, cfg)
+        state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
+        tokens = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                               cfg.vocab_size),
+            batch_shard,
+        )
+        state, _ = step_fn(state, tokens)
+        state, _ = step_fn(state, tokens)
+
+        ckpt = TrainCheckpointer(str(tmp_path / "ckpt"))
+        ckpt.save(int(state.step), state)
+        assert ckpt.latest_step() == 2
+
+        # A "restarted job": fresh state, restore into its shardings.
+        state2 = init_fn(place(llama.init(jax.random.PRNGKey(9), cfg)))
+        restored = ckpt.restore(state2)
+        assert int(restored.step) == 2
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(restored.params["embed"])),
+            np.asarray(jax.device_get(state.params["embed"])),
+        )
+        # Restored state trains on.
+        restored, loss = step_fn(restored, tokens)
+        assert np.isfinite(float(loss))
+        # Shardings preserved.
+        wq = restored.params["layers"]["wq"]
+        assert len(wq.sharding.device_set) > 1
+        ckpt.close()
+
+    def test_restore_without_checkpoint_raises(self, tmp_path):
+        ckpt = TrainCheckpointer(str(tmp_path / "empty"))
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(None)
+        ckpt.close()
+
+
+class TestWatchdogFaultInjection:
+    def test_coordination_service_restarted_after_kill(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.computedomain.daemon.main import (
+            Daemon, DaemonConfig,
+        )
+        from k8s_dra_driver_gpu_tpu.computedomain.daemon.rendezvous import query
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+
+        env = {
+            "COMPUTE_DOMAIN_UUID": "u1", "CLIQUE_ID": "0",
+            "NODE_NAME": "n0", "POD_IP": "127.0.0.1",
+            "COMPUTE_DOMAIN_NUM_WORKERS": "1",
+            "DOMAIN_STATE_DIR": str(tmp_path / "n0"),
+            "HOSTS_FILE": str(tmp_path / "hosts"),
+            "COORDINATION_PORT": "17091",
+        }
+        d = Daemon(DaemonConfig(env=env), kube=FakeKubeClient())
+        d.registrar.register(status="Ready")
+        d.process.ensure_started()
+        d.process.start_watchdog()
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    query("127.0.0.1", 17091, "STATUS")
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            pid1 = d.process.pid
+            # Fault injection: SIGKILL the coordination service.
+            os.kill(pid1, signal.SIGKILL)
+            # Watchdog restarts it with a fresh pid within its backoff.
+            deadline = time.monotonic() + 30
+            recovered = False
+            while time.monotonic() < deadline:
+                if d.process.alive() and d.process.pid != pid1:
+                    try:
+                        query("127.0.0.1", 17091, "STATUS")
+                        recovered = True
+                        break
+                    except OSError:
+                        pass
+                time.sleep(0.3)
+            assert recovered, "watchdog never restarted the service"
+        finally:
+            d.process.stop()
