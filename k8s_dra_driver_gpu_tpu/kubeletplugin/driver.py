@@ -61,6 +61,22 @@ class Driver:
                 config.tpulib_opts,
                 self._on_health_taints,
             )
+        else:
+            # Health monitoring off: mark every chip observably
+            # unmonitored (reference taints gpu.nvidia.com/unmonitored
+            # with Effect=None, device_health.go:36-40).
+            from .health import TAINT_KEY_PREFIX  # noqa: PLC0415
+
+            self._taints = {
+                name: [DeviceTaint(
+                    device=name,
+                    key=f"{TAINT_KEY_PREFIX}/unmonitored",
+                    value="true",
+                    effect="",
+                ).to_dict()]
+                for name, dev in self.state.allocatable.items()
+                if dev.kind == DeviceKind.CHIP
+            }
 
     def start(self) -> None:
         self.cleanup.start()

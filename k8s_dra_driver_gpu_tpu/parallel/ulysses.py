@@ -1,0 +1,83 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The complement to ring attention for long sequences: instead of rotating
+K/V chunks, two all-to-alls re-shard activations between
+sequence-sharded and head-sharded layouts around the attention core --
+each device then computes FULL-sequence attention for a subset of heads.
+Communication volume is O(S*D/n) per all-to-all (independent of step
+count), which beats the ring when heads divide evenly and the sequence
+fits per-device HBM after the swap; the ring wins at extreme sequence
+lengths. Both ride the same sp axis ICI neighborhood.
+
+Layout contract (inside shard_map over axis "sp", n = axis size):
+  in:  q/k/v [B, S/n, H, hd]  (sequence-sharded)
+  mid: q/k/v [B, S, H/n, hd]  (head-sharded, after all-to-all)
+  out:       [B, S/n, H, hd]  (sequence-sharded, after the inverse)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import dot_product_attention
+
+
+def _seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, S/n, H, hd] -> [B, S, H/n, hd] via all_to_all over heads."""
+    # Split the head dim across devices, gather the sequence dim.
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def _heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, S, H/n, hd] -> [B, S/n, H, hd] (inverse all_to_all)."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, S/n, H, hd] inside shard_map
+    k: jax.Array,  # [B, S/n, K, hd]
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    n = jax.lax.psum(1, axis_name)
+    H = q.shape[2]
+    K = k.shape[2]
+    if H % n or K % n:
+        raise ValueError(
+            f"Ulysses needs heads divisible by the sp size: H={H} K={K} n={n}"
+        )
+    qh = _seq_to_heads(q, axis_name)
+    kh = _seq_to_heads(k, axis_name)
+    vh = _seq_to_heads(v, axis_name)
+    out = dot_product_attention(qh, kh, vh, causal=causal)
+    return _heads_to_seq(out, axis_name)
+
+
+def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
+                           causal: bool = True):
+    """jitted [B, S, H, hd] attention with S sharded over ``axis_name``
+    (same surface as make_ring_attention)."""
+    spec = P(None, axis_name, None, None)
+
+    @jax.jit
+    def fn(q, k, v):
+        return jax.shard_map(
+            partial(ulysses_attention, axis_name=axis_name, causal=causal),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )(q, k, v)
+
+    def place(x):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return fn, place

@@ -38,12 +38,13 @@ class SegmentTimer:
     def done(self) -> float:
         """Log the segment breakdown; returns total seconds."""
         total = time.monotonic() - self._start
-        parts = " ".join(
-            f"t_{name}={dt * 1e3:.2f}ms"
-            for name, dt in sorted(self.segments.items())
-        )
-        logger.debug(
-            "%s %s total=%.2fms %s",
-            self.operation, self.key, total * 1e3, parts,
-        )
+        if logger.isEnabledFor(logging.DEBUG):
+            parts = " ".join(
+                f"t_{name}={dt * 1e3:.2f}ms"
+                for name, dt in sorted(self.segments.items())
+            )
+            logger.debug(
+                "%s %s total=%.2fms %s",
+                self.operation, self.key, total * 1e3, parts,
+            )
         return total

@@ -60,3 +60,20 @@ def opaque(kind: str, **fields) -> dict:
         "kind": kind,
         **fields,
     }
+
+
+def wait_for_service(port: int, timeout: float = 30.0,
+                     host: str = "127.0.0.1") -> str:
+    """Poll a coordination service until it answers STATUS (interpreter
+    startup on 1-core CI boxes takes seconds)."""
+    import time
+
+    from k8s_dra_driver_gpu_tpu.computedomain.daemon.rendezvous import query
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            return query(host, port, "STATUS")
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"coordination service on :{port} never came up")

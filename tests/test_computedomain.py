@@ -204,15 +204,7 @@ class TestDNSNames:
         assert hosts.read_text().count("BEGIN tpu-compute-domain") == 1
 
 
-def wait_for_service(port, timeout=20.0):
-    """Interpreter startup on this 1-core box takes ~2s; poll."""
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            return query("127.0.0.1", port, "STATUS")
-        except OSError:
-            time.sleep(0.2)
-    raise TimeoutError(f"coordination service on :{port} never came up")
+from tests.fake_kube import wait_for_service  # noqa: E402
 
 
 def make_daemon(kube, tmp_path, cd_uid, node, ip, port, num_workers=2):

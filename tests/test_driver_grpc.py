@@ -147,6 +147,22 @@ class TestHealthTaints:
         assert "effect" not in devs["chip-2"]["taints"][0]
         assert "taints" not in devs["chip-0"]
 
+    def test_unmonitored_taint_when_health_disabled(self, tmp_root, kube):
+        # Reference taints gpu.nvidia.com/unmonitored (Effect=None) when
+        # the health monitor is off.
+        d = Driver(
+            Config.mock(root=os.path.join(tmp_root, "um"), topology="v5e-4"),
+            kube, node_name="node-um", enable_health_monitor=False,
+        )
+        d.publish_resources()
+        s = next(x for x in kube.list("resource.k8s.io", "v1",
+                                      "resourceslices")
+                 if x["spec"]["nodeName"] == "node-um")
+        devs = {x["name"]: x for x in s["spec"]["devices"]}
+        taint = devs["chip-0"]["taints"][0]
+        assert taint["key"] == "tpu.dra.dev/unmonitored"
+        assert "effect" not in taint  # observe-only
+
     def test_ignored_kinds(self):
         from k8s_dra_driver_gpu_tpu.kubeletplugin.health import (
             health_event_to_taints,

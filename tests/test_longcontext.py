@@ -53,6 +53,34 @@ class TestFlashAttention:
                                    atol=2e-5, rtol=2e-5)
 
 
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference_8way(self, causal):
+        from k8s_dra_driver_gpu_tpu.parallel.ulysses import (
+            make_ulysses_attention,
+        )
+
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=8))
+        # H and K both divisible by 8.
+        q, k, v = rand_qkv(jax.random.PRNGKey(7), B=1, S=128, H=8, K=8)
+        fn, place = make_ulysses_attention(mesh, "sp", causal=causal)
+        out = fn(place(q), place(k), place(v))
+        ref = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_indivisible_heads_rejected(self):
+        from k8s_dra_driver_gpu_tpu.parallel.ulysses import (
+            make_ulysses_attention,
+        )
+
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=8))
+        q, k, v = rand_qkv(jax.random.PRNGKey(8), B=1, S=128, H=4, K=2)
+        fn, place = make_ulysses_attention(mesh, "sp")
+        with pytest.raises(ValueError, match="divisible"):
+            fn(place(q), place(k), place(v))
+
+
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [True, False])
     def test_matches_reference_8way(self, causal):

@@ -76,18 +76,14 @@ class TestWatchdogFaultInjection:
             "HOSTS_FILE": str(tmp_path / "hosts"),
             "COORDINATION_PORT": "17091",
         }
+        from tests.fake_kube import wait_for_service
+
         d = Daemon(DaemonConfig(env=env), kube=FakeKubeClient())
         d.registrar.register(status="Ready")
         d.process.ensure_started()
         d.process.start_watchdog()
         try:
-            deadline = time.monotonic() + 30
-            while time.monotonic() < deadline:
-                try:
-                    query("127.0.0.1", 17091, "STATUS")
-                    break
-                except OSError:
-                    time.sleep(0.2)
+            wait_for_service(17091)
             pid1 = d.process.pid
             # Fault injection: SIGKILL the coordination service.
             os.kill(pid1, signal.SIGKILL)
