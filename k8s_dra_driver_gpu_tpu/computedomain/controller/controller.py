@@ -143,10 +143,20 @@ class ComputeDomainController:
         calculateGlobalStatus computedomain.go:257)."""
         uid = cd["metadata"]["uid"]
         nodes: list[dict] = []
+        any_clique = False
         for clique in self.kube.list(API_GROUP, API_VERSION, CLIQUE_RESOURCE):
             if clique.get("spec", {}).get("computeDomainUID") != uid:
                 continue
+            any_clique = True
             nodes.extend(clique.get("status", {}).get("daemons", []))
+        # Legacy mode is recognized by the ABSENCE of clique CRs (the
+        # daemons write status.nodes directly, cdstatus.go:223-293). A
+        # clique that exists but drained to zero daemons must NOT fall
+        # back, or a fully-deregistered domain would stay Ready on its
+        # own stale node list.
+        legacy = not any_clique
+        if legacy:
+            nodes = list(cd.get("status", {}).get("nodes", []))
         expected = self._expected_nodes(cd)
         ready = (
             len(nodes) >= expected
@@ -156,25 +166,34 @@ class ComputeDomainController:
             )
             and expected > 0
         )
-        status = {
-            "status": (
-                ComputeDomainStatusValue.READY
-                if ready
-                else ComputeDomainStatusValue.NOT_READY
-            ),
-            "nodes": sorted(nodes, key=lambda n: n.get("index", -1)),
-        }
+        verdict = (
+            ComputeDomainStatusValue.READY
+            if ready
+            else ComputeDomainStatusValue.NOT_READY
+        )
+        if legacy:
+            # Daemons own status.nodes in legacy mode; rewriting the full
+            # list from our read snapshot would race their registrations
+            # (lost update). Patch only the verdict.
+            status_patch: dict = {"status": verdict}
+            changed = cd.get("status", {}).get("status") != verdict
+        else:
+            status_patch = {
+                "status": verdict,
+                "nodes": sorted(nodes, key=lambda n: n.get("index", -1)),
+            }
+            changed = cd.get("status") != status_patch
         if self.metrics is not None:
             ns = cd["metadata"].get("namespace", "default")
             name = cd["metadata"]["name"]
             self.metrics.status.labels(ns, name).set(1 if ready else 0)
             self.metrics.nodes.labels(ns, name).set(len(nodes))
-        if cd.get("status") == status:
+        if not changed:
             return
         try:
             self.kube.patch(
                 API_GROUP, API_VERSION, CD_RESOURCE,
-                cd["metadata"]["name"], {"status": status},
+                cd["metadata"]["name"], {"status": status_patch},
                 namespace=cd["metadata"].get("namespace", "default"),
             )
         except NotFoundError:

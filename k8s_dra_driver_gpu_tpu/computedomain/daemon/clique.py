@@ -6,6 +6,11 @@ named "<cdUID>.<cliqueID>"; the index is the first free slot (:350),
 conflict-retried; readiness flips the entry's status (:429). On TPU a
 clique is one ICI-connected slice: every host of the slice shares the
 clique (cross-clique traffic is DCN).
+
+Legacy mode (ComputeDomainCliques gate off) writes the same record shape
+directly into ComputeDomain.status.nodes (cdstatus.go:223-293). Both
+registrars share the slot-allocation/upsert algorithm; they differ only
+in which object holds the entry list.
 """
 
 from __future__ import annotations
@@ -25,7 +30,95 @@ def clique_name(cd_uid: str, clique_id: str) -> str:
     return f"{cd_uid}.{clique_id}"
 
 
-class CliqueRegistrar:
+class _EntryRegistrar:
+    """First-free-slot registration of {name, ip, cliqueID, index,
+    status} records in some list owned by a k8s object. Subclasses
+    provide fetch/persist and the list accessor."""
+
+    clique_id: str
+    node_name: str
+    ip_address: str
+
+    def __init__(self):
+        self.index: int | None = None
+
+    # -- subclass hooks ---------------------------------------------------------
+
+    def _fetch(self) -> dict:
+        raise NotImplementedError
+
+    def _persist(self, obj: dict) -> None:
+        raise NotImplementedError
+
+    def _entries(self, obj: dict) -> list[dict]:
+        raise NotImplementedError
+
+    # -- shared algorithm -------------------------------------------------------
+
+    def register(self, status: str = "NotReady", retries: int = 10) -> int:
+        """Upsert our entry; index = existing or first free slot
+        (cdclique.go:350), retried on write conflicts."""
+        for attempt in range(retries):
+            obj = self._fetch()
+            entries = self._entries(obj)
+            mine = next(
+                (e for e in entries if e.get("name") == self.node_name), None
+            )
+            if mine is None:
+                used = {e.get("index") for e in entries}
+                index = next(i for i in range(len(entries) + 1)
+                             if i not in used)
+                entries.append({
+                    "name": self.node_name,
+                    "ipAddress": self.ip_address,
+                    "cliqueID": self.clique_id,
+                    "index": index,
+                    "status": status,
+                })
+            else:
+                mine["ipAddress"] = self.ip_address
+                mine["status"] = status
+                index = mine["index"]
+            try:
+                self._persist(obj)
+                self.index = index
+                return index
+            except ConflictError:
+                logger.info("registrar write conflict (attempt %d)",
+                            attempt + 1)
+                time.sleep(0.05 * (attempt + 1))
+        raise RuntimeError(
+            f"could not register {self.node_name} after {retries} attempts"
+        )
+
+    def set_status(self, status: str) -> None:
+        self.register(status=status)
+
+    def members(self) -> list[dict]:
+        try:
+            obj = self._fetch()
+        except NotFoundError:
+            return []
+        return sorted(self._entries(obj), key=lambda e: e.get("index", -1))
+
+    def deregister(self) -> None:
+        try:
+            obj = self._fetch()
+        except NotFoundError:
+            return
+        entries = self._entries(obj)
+        entries[:] = [
+            e for e in entries if e.get("name") != self.node_name
+        ]
+        try:
+            self._persist(obj)
+        except (ConflictError, NotFoundError):
+            pass
+
+
+class CliqueRegistrar(_EntryRegistrar):
+    """Entries live in ComputeDomainClique.status.daemons."""
+
     def __init__(
         self,
         kube,
@@ -35,19 +128,19 @@ class CliqueRegistrar:
         ip_address: str,
         namespace: str = "tpu-dra-driver",
     ):
+        super().__init__()
         self.kube = kube
         self.cd_uid = cd_uid
         self.clique_id = clique_id
         self.node_name = node_name
         self.ip_address = ip_address
         self.namespace = namespace
-        self.index: int | None = None
 
     @property
     def name(self) -> str:
         return clique_name(self.cd_uid, self.clique_id)
 
-    def _get_or_create(self) -> dict:
+    def _fetch(self) -> dict:
         try:
             return self.kube.get(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
                                  self.name, namespace=self.namespace)
@@ -70,66 +163,35 @@ class CliqueRegistrar:
                 return self.kube.get(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
                                      self.name, namespace=self.namespace)
 
-    def register(self, status: str = "NotReady", retries: int = 10) -> int:
-        """Write our entry; index = existing or first free slot
-        (cdclique.go:350), retried on write conflicts."""
-        for attempt in range(retries):
-            obj = self._get_or_create()
-            daemons = obj.setdefault("status", {}).setdefault("daemons", [])
-            mine = next(
-                (d for d in daemons if d.get("name") == self.node_name), None
-            )
-            if mine is None:
-                used = {d.get("index") for d in daemons}
-                index = next(i for i in range(len(daemons) + 1)
-                             if i not in used)
-                daemons.append({
-                    "name": self.node_name,
-                    "ipAddress": self.ip_address,
-                    "cliqueID": self.clique_id,
-                    "index": index,
-                    "status": status,
-                })
-            else:
-                mine["ipAddress"] = self.ip_address
-                mine["status"] = status
-                index = mine["index"]
-            try:
-                self.kube.update(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
-                                 self.name, obj, namespace=self.namespace)
-                self.index = index
-                return index
-            except ConflictError:
-                logger.info("clique write conflict (attempt %d)", attempt + 1)
-                time.sleep(0.05 * (attempt + 1))
-        raise RuntimeError(f"could not register in clique {self.name}")
+    def _persist(self, obj: dict) -> None:
+        self.kube.update(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
+                         self.name, obj, namespace=self.namespace)
 
-    def set_status(self, status: str) -> None:
-        self.register(status=status)
+    def _entries(self, obj: dict) -> list[dict]:
+        return obj.setdefault("status", {}).setdefault("daemons", [])
 
-    def members(self) -> list[dict]:
-        try:
-            obj = self.kube.get(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
-                                self.name, namespace=self.namespace)
-        except NotFoundError:
-            return []
-        return sorted(
-            obj.get("status", {}).get("daemons", []),
-            key=lambda d: d.get("index", -1),
-        )
 
-    def deregister(self) -> None:
-        try:
-            obj = self.kube.get(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
-                                self.name, namespace=self.namespace)
-        except NotFoundError:
-            return
-        daemons = obj.get("status", {}).get("daemons", [])
-        obj["status"]["daemons"] = [
-            d for d in daemons if d.get("name") != self.node_name
-        ]
-        try:
-            self.kube.update(API_GROUP, API_VERSION, CLIQUE_RESOURCE,
-                             self.name, obj, namespace=self.namespace)
-        except (ConflictError, NotFoundError):
-            pass
+class LegacyStatusRegistrar(_EntryRegistrar):
+    """Legacy mode: entries live in ComputeDomain.status.nodes."""
+
+    def __init__(self, kube, cd_uid: str, cd_name: str, cd_namespace: str,
+                 clique_id: str, node_name: str, ip_address: str):
+        super().__init__()
+        self.kube = kube
+        self.cd_name = cd_name
+        self.cd_namespace = cd_namespace
+        self.clique_id = clique_id
+        self.node_name = node_name
+        self.ip_address = ip_address
+        del cd_uid  # identity is (name, namespace) for direct status writes
+
+    def _fetch(self) -> dict:
+        return self.kube.get(API_GROUP, API_VERSION, "computedomains",
+                             self.cd_name, namespace=self.cd_namespace)
+
+    def _persist(self, obj: dict) -> None:
+        self.kube.update(API_GROUP, API_VERSION, "computedomains",
+                         self.cd_name, obj, namespace=self.cd_namespace)
+
+    def _entries(self, obj: dict) -> list[dict]:
+        return obj.setdefault("status", {}).setdefault("nodes", [])

@@ -52,6 +52,8 @@ class DaemonConfig:
         self.port = int(env.get("COORDINATION_PORT", str(DOMAIN_DAEMON_PORT)))
         self.driver_namespace = env.get("DRIVER_NAMESPACE", "tpu-dra-driver")
         self.standalone = env.get("CD_DAEMON_STANDALONE", "") == "1"
+        # ComputeDomainCliques feature gate (default on, like upstream).
+        self.use_cliques = env.get("COMPUTE_DOMAIN_CLIQUES", "true") != "false"
 
 
 class Daemon:
@@ -63,14 +65,28 @@ class Daemon:
         os.makedirs(config.state_dir, exist_ok=True)
         self.members_file = os.path.join(config.state_dir, "members.json")
         self.bootstrap_file = os.path.join(config.state_dir, "bootstrap.json")
-        self.registrar = CliqueRegistrar(
-            self.kube,
-            cd_uid=config.cd_uid,
-            clique_id=config.clique_id,
-            node_name=config.node_name,
-            ip_address=config.pod_ip,
-            namespace=config.driver_namespace,
-        )
+        if config.use_cliques:
+            self.registrar = CliqueRegistrar(
+                self.kube,
+                cd_uid=config.cd_uid,
+                clique_id=config.clique_id,
+                node_name=config.node_name,
+                ip_address=config.pod_ip,
+                namespace=config.driver_namespace,
+            )
+        else:
+            # Legacy direct-status mode (ComputeDomainCliques gate off).
+            from .clique import LegacyStatusRegistrar  # noqa: PLC0415
+
+            self.registrar = LegacyStatusRegistrar(
+                self.kube,
+                cd_uid=config.cd_uid,
+                cd_name=config.cd_name,
+                cd_namespace=config.cd_namespace,
+                clique_id=config.clique_id,
+                node_name=config.node_name,
+                ip_address=config.pod_ip,
+            )
         self._write_members([])  # exists before the child starts
         # The child must resolve this package regardless of how the
         # daemon itself was launched.

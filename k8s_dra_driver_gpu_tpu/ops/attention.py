@@ -25,7 +25,13 @@ def attention(
     if impl == "auto":
         from . import is_tpu_backend  # noqa: PLC0415
 
-        impl = "flash" if is_tpu_backend() else "einsum"
+        # The pallas kernel wants MXU/VPU-aligned head dims (lane = 128);
+        # small-head models (tests, toy configs) take the einsum path.
+        impl = (
+            "flash"
+            if is_tpu_backend() and q.shape[-1] % 128 == 0
+            else "einsum"
+        )
     if impl == "flash":
         from .flash_attention import flash_attention  # noqa: PLC0415
 

@@ -95,6 +95,39 @@ def build_mesh(
     return Mesh(arr, plan.axis_names())
 
 
+DCN_AXIS = "dcn"
+
+
+def build_multislice_mesh(
+    num_slices: int,
+    plan: MeshPlan | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Multislice: a leading DCN axis over ICI slices.
+
+    Cross-slice traffic rides the data-center network, so only gradient
+    data-parallelism belongs on the "dcn" axis; tp/fsdp/sp stay inside a
+    slice (each slice's devices form a contiguous block). On real
+    multislice jobs jax.devices() groups by slice already; the CPU mesh
+    simulates that by block-partitioning.
+    """
+    devs = devices if devices is not None else jax.devices()
+    if len(devs) % num_slices:
+        raise ValueError(
+            f"{len(devs)} devices not divisible by {num_slices} slices"
+        )
+    per_slice = len(devs) // num_slices
+    if plan is None:
+        plan = plan_for(per_slice)
+    if plan.size != per_slice:
+        raise ValueError(
+            f"plan {plan.shape()} needs {plan.size} devices/slice, "
+            f"have {per_slice}"
+        )
+    arr = np.asarray(devs).reshape((num_slices,) + plan.shape())
+    return Mesh(arr, (DCN_AXIS,) + plan.axis_names())
+
+
 def mesh_from_topology(topology: str, tp: int | None = None) -> Mesh:
     """Build a mesh for an ICI topology string ("2x2x4") as enumerated by
     tpulib / published in ResourceSlice attributes."""

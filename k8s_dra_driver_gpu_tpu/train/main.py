@@ -1,0 +1,129 @@
+"""Training launcher: consumes the env contract the DRA driver injects.
+
+This is the workload side of the whole pipeline: a pod whose claim was
+prepared by tpu.dra.dev (+ a ComputeDomain channel for multi-host) runs
+
+    python -m k8s_dra_driver_gpu_tpu.train.main --model tiny --steps 100
+
+and the launcher wires everything from the injected environment:
+  TPU_COORDINATOR_ADDRESS / TPU_PROCESS_ID / TPU_NUM_PROCESSES
+      -> jax.distributed.initialize (multi-host gangs; absent = single
+         process)
+  TPU_TOPOLOGY / TPU_VISIBLE_DEVICES -> mesh planning
+  CHECKPOINT_DIR -> orbax save/restore (resume after preemption)
+
+North star (BASELINE.json): a 32-chip ResourceClaim runs Llama-3-8B
+training on a v5p slice with no GPU in the loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+
+logger = logging.getLogger(__name__)
+
+
+def initialize_distributed(env=os.environ) -> None:
+    """jax.distributed from the ComputeDomain channel env, if present."""
+    import jax
+
+    coordinator = env.get("TPU_COORDINATOR_ADDRESS", "")
+    if not coordinator:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(env.get("TPU_NUM_PROCESSES", "1")),
+        process_id=int(env.get("TPU_PROCESS_ID", "0")),
+    )
+    logger.info(
+        "joined gang: process %s/%s via %s",
+        env.get("TPU_PROCESS_ID"), env.get("TPU_NUM_PROCESSES"), coordinator,
+    )
+
+
+def run(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-train")
+    p.add_argument("--model", choices=["tiny", "llama3-8b"], default="tiny")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--checkpoint-dir",
+                   default=os.environ.get("CHECKPOINT_DIR", ""))
+    p.add_argument("--checkpoint-every", type=int, default=100)
+    p.add_argument("--tp", type=int, default=None,
+                   help="tensor-parallel size (default: planned)")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    initialize_distributed()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import llama
+    from ..parallel.mesh import build_mesh, plan_for
+    from .train import make_sharded_train
+
+    devices = jax.devices()
+    logger.info("devices: %d x %s", len(devices), devices[0].platform)
+    mesh = build_mesh(plan_for(len(devices), tp=args.tp), devices=devices)
+    logger.info("mesh: %s", dict(zip(mesh.axis_names, mesh.devices.shape)))
+
+    cfg = (llama.LlamaConfig.tiny() if args.model == "tiny"
+           else llama.LlamaConfig.llama3_8b())
+    init_fn, step_fn, batch_shard, place = make_sharded_train(mesh, cfg)
+    state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
+
+    ckpt = None
+    if args.checkpoint_dir:
+        from .checkpoint import TrainCheckpointer  # noqa: PLC0415
+
+        ckpt = TrainCheckpointer(args.checkpoint_dir)
+        if ckpt.latest_step() is not None:
+            state = ckpt.restore(state)
+            logger.info("resumed from step %d", int(state.step))
+
+    # Synthetic next-token data keyed by step (a real loader drops in
+    # here; the reference ships no data path at all).
+    def batch_for(step: int):
+        return jax.device_put(
+            jax.random.randint(
+                jax.random.PRNGKey(step), (args.batch_size, args.seq_len + 1),
+                0, cfg.vocab_size, jnp.int32,
+            ),
+            batch_shard,
+        )
+
+    start_step = int(state.step)
+    t0 = time.perf_counter()
+    tokens_per_step = args.batch_size * args.seq_len
+    for step in range(start_step, args.steps):
+        state, loss = step_fn(state, batch_for(step))
+        if step == start_step:
+            jax.block_until_ready(loss)  # exclude compile from timing
+            t0 = time.perf_counter()
+        if (step + 1) % 10 == 0 or step + 1 == args.steps:
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            done = step - start_step
+            tps = tokens_per_step * done / dt if dt > 0 and done else 0.0
+            logger.info("step %d loss %.4f (%.0f tok/s)",
+                        step + 1, float(loss), tps)
+        if ckpt and (step + 1) % args.checkpoint_every == 0:
+            ckpt.save(step + 1, state)
+    if ckpt:
+        ckpt.save(int(state.step), state)
+        ckpt.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(run())

@@ -5,8 +5,6 @@ FakeKubeClient.
 """
 
 import json
-import os
-import time
 
 import pytest
 
@@ -34,7 +32,6 @@ from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state import (
 )
 from k8s_dra_driver_gpu_tpu.computedomain.plugin.driver import CDDriver
 from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
-from k8s_dra_driver_gpu_tpu.pkg.workqueue import PermanentError
 from tests.fake_kube import make_claim_dict
 
 
@@ -180,6 +177,49 @@ class TestCliqueRegistrar:
         r0.register()
         members = r0.members()
         assert [m["index"] for m in members] == [0, 1]
+
+
+class TestLegacyStatusMode:
+    def test_direct_status_registration(self, kube, controller):
+        from k8s_dra_driver_gpu_tpu.computedomain.daemon.clique import (
+            LegacyStatusRegistrar,
+        )
+
+        cd = make_cd(kube, topology="2x2x2")
+        uid = cd["metadata"]["uid"]
+        r0 = LegacyStatusRegistrar(kube, uid, "cd1", "team-a", "0",
+                                   "node-0", "10.0.0.1")
+        r1 = LegacyStatusRegistrar(kube, uid, "cd1", "team-a", "0",
+                                   "node-1", "10.0.0.2")
+        assert r0.register(status="Ready") == 0
+        assert r1.register(status="Ready") == 1
+        # Controller aggregates from status.nodes when no cliques exist.
+        controller.update_global_status(
+            kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                     namespace="team-a"))
+        cd2 = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                       namespace="team-a")
+        assert cd2["status"]["status"] == "Ready"
+        r0.deregister()
+        assert [n["name"] for n in r1.members()] == ["node-1"]
+
+    def test_daemon_env_selects_legacy(self, kube, tmp_path):
+        from k8s_dra_driver_gpu_tpu.computedomain.daemon.clique import (
+            LegacyStatusRegistrar,
+        )
+
+        cd = make_cd(kube)
+        env = {
+            "COMPUTE_DOMAIN_UUID": cd["metadata"]["uid"],
+            "COMPUTE_DOMAIN_NAME": "cd1",
+            "COMPUTE_DOMAIN_NAMESPACE": "team-a",
+            "COMPUTE_DOMAIN_CLIQUES": "false",
+            "NODE_NAME": "node-0", "POD_IP": "10.0.0.1",
+            "DOMAIN_STATE_DIR": str(tmp_path / "st"),
+            "HOSTS_FILE": str(tmp_path / "hosts"),
+        }
+        d = Daemon(DaemonConfig(env=env), kube=kube)
+        assert isinstance(d.registrar, LegacyStatusRegistrar)
 
 
 class TestDNSNames:
