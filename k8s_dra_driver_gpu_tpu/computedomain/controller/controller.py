@@ -45,8 +45,24 @@ class ComputeDomainController:
         self._resync_thread = threading.Thread(
             target=self._resync_loop, name="cd-resync", daemon=True
         )
+        # Event path: push watchers from the in-memory fake, or streamed
+        # HTTP watches from a real client; periodic resync backstops both.
         if hasattr(kube, "add_watcher"):
             kube.add_watcher(self._on_event)
+        elif hasattr(kube, "watch"):
+            # Per-resource callbacks: the event must carry which resource
+            # it came from (streamed objects may omit kind).
+            import functools  # noqa: PLC0415
+
+            for resource, kind in (
+                (CD_RESOURCE, "ComputeDomain"),
+                (CLIQUE_RESOURCE, "ComputeDomainClique"),
+            ):
+                kube.watch(
+                    API_GROUP, API_VERSION, resource,
+                    functools.partial(self._on_watch_event, kind),
+                    stop=self._stop,
+                )
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -66,6 +82,16 @@ class ComputeDomainController:
             except Exception:  # noqa: BLE001
                 logger.exception("resync failed")
 
+    _ALL_KEY = ("*", "*")  # sentinel: reconcile every domain
+
+    def _on_watch_event(self, kind: str, event_type: str, obj: dict) -> None:
+        """Streamed-watch events may omit kind; the watch registration
+        tells us which resource they came from."""
+        if not obj.get("kind"):
+            obj = dict(obj)
+            obj["kind"] = kind
+        self._on_event(event_type, obj)
+
     def _on_event(self, event_type: str, obj: dict) -> None:
         kind = obj.get("kind", "")
         if kind == "ComputeDomain":
@@ -73,11 +99,10 @@ class ComputeDomainController:
                    obj["metadata"]["name"])
             self.queue.enqueue(key, self._reconcile_key)
         elif kind in ("ComputeDomainClique", "Pod"):
-            # Status inputs changed: resync every domain that matches.
-            for cd in self._list_cds():
-                key = (cd["metadata"].get("namespace", "default"),
-                       cd["metadata"]["name"])
-                self.queue.enqueue(key, self._reconcile_key)
+            # Status inputs changed: one deduplicated reconcile-all item
+            # (a registration storm collapses into a single queue entry;
+            # the list happens on a worker, never the watch thread).
+            self.queue.enqueue(self._ALL_KEY, self._reconcile_key)
 
     def sync_all(self) -> None:
         for cd in self._list_cds():
@@ -94,6 +119,10 @@ class ComputeDomainController:
             return []
 
     def _reconcile_key(self, key) -> None:
+        if key == self._ALL_KEY:
+            for cd in self._list_cds():
+                self.reconcile(cd)
+            return
         namespace, name = key
         try:
             cd = self.kube.get(API_GROUP, API_VERSION, CD_RESOURCE, name,

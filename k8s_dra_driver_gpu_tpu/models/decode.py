@@ -1,0 +1,181 @@
+"""Autoregressive decoding with a KV cache (the serving path).
+
+TPU-first decisions:
+- The cache is a pair of [L, B, max_len, K, hd] stacked tensors so the
+  per-step layer loop is one lax.scan (same O(1)-compile trick as the
+  training forward).
+- The decode step is fully static-shaped: position is a traced scalar,
+  cache updates are dynamic_update_slice, attention masks by position --
+  no Python control flow under jit, so a whole generate() loop compiles
+  once via lax.scan.
+- Sampling: greedy or temperature, PRNG threaded through the scan.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .llama import LlamaConfig, rms_norm, rope
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, max_len, K, hd]
+    v: jax.Array  # [L, B, max_len, K, hd]
+    length: jax.Array  # [] int32: filled positions
+
+    @classmethod
+    def empty(cls, cfg: LlamaConfig, batch: int, max_len: int) -> "KVCache":
+        shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+        return cls(
+            k=jnp.zeros(shape, cfg.dtype),
+            v=jnp.zeros(shape, cfg.dtype),
+            length=jnp.zeros((), jnp.int32),
+        )
+
+
+def _project_qkv(cfg: LlamaConfig, x, lp, positions):
+    B, S, _ = x.shape
+    a = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (a @ lp["wq"].astype(cfg.dtype)).reshape(
+        B, S, cfg.n_heads, cfg.head_dim)
+    k = (a @ lp["wk"].astype(cfg.dtype)).reshape(
+        B, S, cfg.n_kv_heads, cfg.head_dim)
+    v = (a @ lp["wv"].astype(cfg.dtype)).reshape(
+        B, S, cfg.n_kv_heads, cfg.head_dim)
+    return rope(q, positions, cfg.rope_theta), \
+        rope(k, positions, cfg.rope_theta), v
+
+
+def _mlp(cfg: LlamaConfig, x, lp):
+    m = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(m @ lp["w_gate"].astype(cfg.dtype))
+    up = m @ lp["w_up"].astype(cfg.dtype)
+    return (gate * up) @ lp["w_down"].astype(cfg.dtype)
+
+
+def _attend_cached(cfg: LlamaConfig, q, ck, cv, valid_len):
+    """q [B,S,H,hd] vs cache ck/cv [B,max_len,K,hd]; positions >=
+    valid_len are masked."""
+    B, S, H, hd = q.shape
+    K = ck.shape[2]
+    group = H // K
+    qg = q.reshape(B, S, K, group, hd)
+    s = jnp.einsum("bqkgh,bskh->bkgqs", qg, ck) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)).astype(q.dtype)
+    s = s.astype(jnp.float32)
+    max_len = ck.shape[1]
+    mask = jnp.arange(max_len)[None, :] < valid_len  # [1, max_len]
+    s = jnp.where(mask[None, None, None], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", w, cv)
+    return out.reshape(B, S, H, hd)
+
+
+def prefill(
+    params: dict, tokens: jax.Array, cfg: LlamaConfig, max_len: int
+) -> tuple[jax.Array, KVCache]:
+    """Process the prompt; returns (logits for the LAST position [B, V],
+    a cache filled up to tokens.shape[1])."""
+    B, S = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.arange(S)[None, :]
+
+    def body(carry, lp):
+        h = carry
+        q, k, v = _project_qkv(cfg, h, lp, positions)
+        ck = jnp.zeros((B, max_len, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
+        cv = jnp.zeros_like(ck)
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, 0, 0))
+        # Causal attention within the prompt: same dispatcher as the
+        # training forward (pallas flash on TPU when shapes allow).
+        from ..ops.attention import attention  # noqa: PLC0415
+
+        attn = attention(q, k, v, causal=True, impl=cfg.attn_impl).reshape(
+            B, S, cfg.n_heads * cfg.head_dim)
+        h = h + attn @ lp["wo"].astype(cfg.dtype)
+        h = h + _mlp(cfg, h, lp)
+        return h, (ck, cv)
+
+    x, (cks, cvs) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x[:, -1:], params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    cache = KVCache(k=cks, v=cvs, length=jnp.asarray(S, jnp.int32))
+    return logits[:, 0], cache
+
+
+def decode_step(
+    params: dict, cache: KVCache, token: jax.Array, cfg: LlamaConfig
+) -> tuple[jax.Array, KVCache]:
+    """One token [B] in -> next-token logits [B, V] + updated cache."""
+    B = token.shape[0]
+    pos = cache.length
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B,1,D]
+    positions = jnp.full((B, 1), pos, jnp.int32)
+
+    def body(carry, layer_in):
+        h = carry
+        lp, ck, cv = layer_in
+        q, k, v = _project_qkv(cfg, h, lp, positions)
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+        attn = _attend_cached(cfg, q, ck, cv, pos + 1)
+        attn = attn.reshape(B, 1, cfg.n_heads * cfg.head_dim)
+        h = h + attn @ lp["wo"].astype(cfg.dtype)
+        h = h + _mlp(cfg, h, lp)
+        return h, (ck, cv)
+
+    x, (cks, cvs) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits[:, 0], KVCache(k=cks, v=cvs, length=pos + 1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "max_len", "temperature"),
+)
+def generate(
+    params: dict,
+    prompt: jax.Array,  # [B, S] token ids
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    max_len: int,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled generation; returns [B,
+    max_new_tokens]."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if prompt.shape[1] + max_new_tokens > max_len:
+        # dynamic_update_slice clamps out-of-range writes -- overflow
+        # would silently corrupt the cache instead of erroring.
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) exceeds max_len ({max_len})"
+        )
+    logits, cache = prefill(params, prompt, cfg, max_len)
+
+    def sample(logits, key):
+        if temperature > 0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def step(carry, _):
+        logits, cache, key = carry
+        key, sub = jax.random.split(key)
+        token = sample(logits, sub).astype(jnp.int32)
+        logits, cache = decode_step(params, cache, token, cfg)
+        return (logits, cache, key), token
+
+    (_, _, _), tokens = jax.lax.scan(
+        step, (logits, cache, key), None, length=max_new_tokens
+    )
+    return tokens.swapaxes(0, 1)  # [B, max_new_tokens]

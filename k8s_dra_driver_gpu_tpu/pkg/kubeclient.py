@@ -12,6 +12,7 @@ pkg/nvidia.com/clientset/versioned/fake/).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import ssl
 import threading
@@ -19,6 +20,8 @@ import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator
+
+logger = logging.getLogger(__name__)
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -154,6 +157,82 @@ class KubeClient:
 
     def server_version(self) -> dict:
         return self._request("GET", "/version")
+
+    # -- watch ----------------------------------------------------------------
+
+    def watch(
+        self,
+        group: str,
+        version: str,
+        resource: str,
+        on_event: Callable[[str, dict], None],
+        namespace: str | None = None,
+        stop: threading.Event | None = None,
+        reconnect_delay: float = 2.0,
+    ) -> threading.Thread:
+        """Streamed watch (chunked JSON lines, `?watch=true`), with
+        resourceVersion bookmarking and automatic reconnect. Events are
+        delivered as on_event(type, object) -- the same surface as
+        FakeKubeClient watchers. Returns the (daemon) watch thread."""
+        stop = stop or threading.Event()
+
+        def run():
+            resource_version = ""
+            while not stop.is_set():
+                path = _resource_path(group, version, resource, namespace,
+                                      None)
+                query = "?watch=true&allowWatchBookmarks=true"
+                if resource_version:
+                    query += f"&resourceVersion={resource_version}"
+                url = self._host + path + query
+                req = urllib.request.Request(url)
+                req.add_header("Accept", "application/json")
+                if self._token:
+                    req.add_header("Authorization", f"Bearer {self._token}")
+                try:
+                    with urllib.request.urlopen(
+                        req, timeout=300, context=self._ssl
+                    ) as resp:
+                        for raw in resp:
+                            if stop.is_set():
+                                return
+                            line = raw.strip()
+                            if not line:
+                                continue
+                            try:
+                                ev = json.loads(line)
+                            except json.JSONDecodeError:
+                                continue
+                            obj = ev.get("object", {})
+                            rv = obj.get("metadata", {}).get(
+                                "resourceVersion")
+                            if rv:
+                                resource_version = rv
+                            ev_type = ev.get("type", "")
+                            if ev_type == "BOOKMARK":
+                                continue
+                            if ev_type == "ERROR":
+                                resource_version = ""  # relist from now
+                                break
+                            if not ev_type or not obj.get("metadata"):
+                                continue  # not a usable watch event
+                            try:
+                                on_event(ev_type, obj)
+                            except Exception:  # noqa: BLE001
+                                # A callback bug must not kill the watch.
+                                logger.exception(
+                                    "watch callback failed for %s %s",
+                                    ev_type, resource,
+                                )
+                except (urllib.error.URLError, OSError, TimeoutError):
+                    pass
+                stop.wait(reconnect_delay)
+
+        thread = threading.Thread(
+            target=run, name=f"watch-{resource}", daemon=True
+        )
+        thread.start()
+        return thread
 
 
 @dataclass

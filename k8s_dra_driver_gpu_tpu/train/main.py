@@ -55,6 +55,10 @@ def run(argv: list[str] | None = None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=100)
     p.add_argument("--tp", type=int, default=None,
                    help="tensor-parallel size (default: planned)")
+    p.add_argument("--profile-dir",
+                   default=os.environ.get("PROFILE_DIR", ""),
+                   help="capture a jax.profiler trace (XLA/TPU timeline) "
+                        "of steps 2..4 into this dir")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -103,8 +107,17 @@ def run(argv: list[str] | None = None) -> int:
     start_step = int(state.step)
     t0 = time.perf_counter()
     tokens_per_step = args.batch_size * args.seq_len
+    tracing = False
     for step in range(start_step, args.steps):
+        if args.profile_dir and step == start_step + 1 and not tracing:
+            jax.profiler.start_trace(args.profile_dir)
+            tracing = True
         state, loss = step_fn(state, batch_for(step))
+        if tracing and step >= start_step + 3:
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
+            tracing = False
+            logger.info("profile trace written to %s", args.profile_dir)
         if step == start_step:
             jax.block_until_ready(loss)  # exclude compile from timing
             t0 = time.perf_counter()
@@ -117,6 +130,11 @@ def run(argv: list[str] | None = None) -> int:
                         step + 1, float(loss), tps)
         if ckpt and (step + 1) % args.checkpoint_every == 0:
             ckpt.save(step + 1, state)
+    if tracing:
+        # Short runs: close the trace before exit so it's usable.
+        jax.block_until_ready(state.step)
+        jax.profiler.stop_trace()
+        logger.info("profile trace written to %s", args.profile_dir)
     if ckpt:
         ckpt.save(int(state.step), state)
         ckpt.close()
