@@ -94,6 +94,52 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool | None = None,
 ) -> jax.Array:
+    """Differentiable: the forward runs the pallas kernel; the backward
+    recomputes attention with the einsum formulation and takes its VJP
+    (flash-style recompute-in-backward -- no S x S residuals saved)."""
+    return _flash_attention_vjp(q, k, v, causal, block_q, block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_vjp(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_attention_fwd_impl(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_attention_fwd_impl(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, g):
+    del block_q, block_k, interpret
+    q, k, v = residuals
+    from .attention import dot_product_attention  # noqa: PLC0415
+
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: dot_product_attention(q_, k_, v_, causal=causal),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_attention_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _flash_attention_fwd_impl(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool | None,
+) -> jax.Array:
     from . import is_tpu_backend  # noqa: PLC0415
 
     B, S, H, hd = q.shape
