@@ -117,15 +117,72 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, g):
-    del block_q, block_k, interpret
+    del block_k, interpret
     q, k, v = residuals
-    from .attention import dot_product_attention  # noqa: PLC0415
+    return _chunked_attention_bwd(q, k, v, g, causal=causal,
+                                  block_q=block_q)
 
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: dot_product_attention(q_, k_, v_, causal=causal),
-        q, k, v,
+
+def _chunked_attention_bwd(q, k, v, g, *, causal: bool, block_q: int):
+    """Flash-style backward: recompute attention one q-chunk at a time
+    (lax.scan), so peak transient memory is O(block_q * S) per layer --
+    never the full S x S score tensor.
+
+    Standard softmax-attention gradients:
+      P = softmax(S'),  S' = scale * Q K^T
+      dV = P^T dO
+      dP = dO V^T
+      dS' = P * (dP - rowsum(dP * P))
+      dQ = scale * dS' K,   dK = scale * dS'^T Q
+    """
+    B, S, H, hd = q.shape
+    K = k.shape[2]
+    group = H // K
+    scale = 1.0 / (hd ** 0.5)
+    C = min(block_q, S)
+    n_chunks = -(-S // C)
+    S_pad = n_chunks * C
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    if S_pad != S:
+        pad = ((0, 0), (0, S_pad - S), (0, 0), (0, 0))
+        qf, gf = jnp.pad(qf, pad), jnp.pad(gf, pad)
+
+    # [n_chunks, B, C, H, hd] chunked views of q and dO.
+    qc_all = qf.reshape(B, n_chunks, C, H, hd).swapaxes(0, 1)
+    gc_all = gf.reshape(B, n_chunks, C, H, hd).swapaxes(0, 1)
+    k_pos = jnp.arange(S)
+
+    def chunk(carry, inputs):
+        dk_acc, dv_acc = carry
+        ci, qc, gc = inputs  # qc/gc: [B, C, H, hd]
+        q_pos = ci * C + jnp.arange(C)
+        qg = qc.reshape(B, C, K, group, hd)
+        gg = gc.reshape(B, C, K, group, hd)
+        s = jnp.einsum("bqkgh,bskh->bkgqs", qg, kf) * scale
+        valid = (q_pos[:, None] < S) & (
+            (q_pos[:, None] >= k_pos[None, :]) if causal
+            else jnp.ones((C, S), bool)
+        )
+        s = jnp.where(valid[None, None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        dv_acc = dv_acc + jnp.einsum("bkgqs,bqkgh->bskh", p, gg)
+        dp = jnp.einsum("bqkgh,bskh->bkgqs", gg, vf)
+        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+        dq_c = jnp.einsum("bkgqs,bskh->bqkgh", ds, kf) * scale
+        dk_acc = dk_acc + jnp.einsum("bkgqs,bqkgh->bskh", ds, qg) * scale
+        return (dk_acc, dv_acc), dq_c.reshape(B, C, H, hd)
+
+    (dk, dv), dq_chunks = jax.lax.scan(
+        chunk,
+        (jnp.zeros_like(kf), jnp.zeros_like(vf)),
+        (jnp.arange(n_chunks), qc_all, gc_all),
     )
-    return vjp(g)
+    dq = dq_chunks.swapaxes(0, 1).reshape(B, S_pad, H, hd)[:, :S]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash_attention_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

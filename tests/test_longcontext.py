@@ -74,6 +74,25 @@ class TestFlashAttentionGrad:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4, rtol=5e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gradients_non_divisible_seq(self, causal):
+        # Chunked backward with a padded tail (S=50, block 32).
+        q, k, v = rand_qkv(jax.random.PRNGKey(6), B=1, S=50, H=4, K=2)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=causal, block_q=32, block_k=32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                dot_product_attention(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4)
+
     def test_trainable_in_llama(self):
         # A full train-step grad through the flash path (forced impl).
         import dataclasses
