@@ -41,6 +41,7 @@ class Driver:
         metrics: DRARequestMetrics | None = None,
         enable_health_monitor: bool = True,
         split_slices: bool | None = None,
+        additional_ignored_health_kinds: tuple[str, ...] = (),
     ):
         self.state = DeviceState(config)
         self.kube = kube_client
@@ -60,6 +61,7 @@ class Driver:
                 self.state._tpulib,
                 config.tpulib_opts,
                 self._on_health_taints,
+                additional_ignored=additional_ignored_health_kinds,
             )
         else:
             # Health monitoring off: mark every chip observably

@@ -67,8 +67,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mock-worker-id", type=int,
                    default=int(env("TPULIB_MOCK_WORKER_ID", "0")),
                    help="mock worker id [TPULIB_MOCK_WORKER_ID]")
-    p.add_argument("--additional-health-kinds-to-ignore", default="",
-                   help="comma-separated health kinds never tainted")
+    p.add_argument("--additional-health-kinds-to-ignore",
+                   default=env("ADDITIONAL_HEALTH_KINDS_TO_IGNORE", ""),
+                   help="comma-separated health kinds never tainted "
+                        "[ADDITIONAL_HEALTH_KINDS_TO_IGNORE] (reference: "
+                        "additional-xids-to-ignore)")
+    p.add_argument("-v", "--verbosity", type=int,
+                   default=int(env("V", "4")),
+                   help="log verbosity: 0 errors, 4 info, 6+ debug "
+                        "incl. t_prep_* segments [V]")
     p.add_argument("--standalone", action="store_true",
                    help="no API server: in-memory kube client (dev/mock)")
     p.add_argument("--kube-api", default=env("KUBE_API", ""),
@@ -79,8 +86,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    level = (logging.ERROR if args.verbosity <= 0
+             else logging.WARNING if args.verbosity < 4
+             else logging.INFO if args.verbosity < 6
+             else logging.DEBUG)
     logging.basicConfig(
-        level=logging.INFO,
+        level=level,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     logger.info("tpu-kubelet-plugin %s starting (driver %s)",
@@ -106,7 +117,13 @@ def run(argv: list[str] | None = None) -> int:
         host=args.kube_api or None
     )
     metrics = DRARequestMetrics()
-    driver = Driver(config, kube, node_name, metrics=metrics)
+    ignored = tuple(
+        k.strip()
+        for k in args.additional_health_kinds_to_ignore.split(",")
+        if k.strip()
+    )
+    driver = Driver(config, kube, node_name, metrics=metrics,
+                    additional_ignored_health_kinds=ignored)
 
     server = PluginServer(
         DRIVER_NAME,

@@ -1,0 +1,129 @@
+"""Mixture-of-Experts FFN with expert parallelism over a mesh axis.
+
+Rounds out the parallelism surface (dp/fsdp/tp/sp + EP): experts are
+sharded over an axis; tokens route top-k and travel to their experts via
+the all-to-all-free "dense dispatch" formulation -- every device computes
+its local experts over ALL tokens it holds, with a capacity-free
+weighted combine. TPU-first choices:
+
+- Router + combine run in fp32 (softmax stability); expert matmuls in
+  the model dtype on the MXU.
+- Dispatch is einsum-based (one_hot combine weights), which XLA turns
+  into dense matmuls -- no gather/scatter with dynamic shapes, so the
+  whole layer jits with static shapes. For very large expert counts an
+  all_to_all dispatch (Ulysses-style) drops in behind the same
+  signature.
+- Under shard_map the expert dimension is sharded over ``axis_name``;
+  psum over the axis completes the combine.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_moe(key, d_model: int, d_ff: int, n_experts: int, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / (d_model ** 0.5)
+    scale_out = 1.0 / (d_ff ** 0.5)
+    return {
+        "router": jax.random.normal(k1, (d_model, n_experts),
+                                    jnp.float32) * scale_in,
+        "w_in": jax.random.normal(k2, (n_experts, d_model, d_ff),
+                                  jnp.float32) * scale_in,
+        "w_out": jax.random.normal(k3, (n_experts, d_ff, d_model),
+                                   jnp.float32) * scale_out,
+    }
+
+
+def moe_param_specs(axis_name: str = "ep") -> dict:
+    return {
+        "router": P(None, None),
+        "w_in": P(axis_name, None, None),
+        "w_out": P(axis_name, None, None),
+    }
+
+
+def moe_ffn(params: dict, x: jax.Array, top_k: int = 2,
+            dtype=jnp.bfloat16,
+            expert_offset: jax.Array | int = 0) -> tuple[jax.Array, jax.Array]:
+    """Dense-dispatch MoE: x [B, S, D] -> (out, aux).
+
+    Routing is over the GLOBAL expert count (the replicated router);
+    ``params['w_in']/['w_out']`` may hold only a local expert shard, with
+    ``expert_offset`` giving its position -- the combine weights are
+    sliced to the local block, so summing shard outputs (psum over the
+    ep axis) completes the full mixture.
+
+    aux is the load-balancing loss (mean expert load * mean router prob,
+    scaled by n_experts -- the standard switch-transformer auxiliary);
+    it is computed from the replicated router, so it is identical on
+    every shard (do NOT psum it).
+    """
+    E_total = params["router"].shape[1]
+    E_local = params["w_in"].shape[0]
+    logits = x.astype(jnp.float32) @ params["router"]  # [B,S,E_total]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_idx = jax.lax.top_k(probs, top_k)  # [B,S,k]
+    top_mask = jax.nn.one_hot(top_idx, E_total, dtype=jnp.float32)
+    # Renormalized combine weights as a dense [B,S,E_total] mask.
+    combine = jnp.sum(
+        top_mask
+        * (top_p / jnp.sum(top_p, -1, keepdims=True))[..., None],
+        axis=2,
+    )
+    combine_local = jax.lax.dynamic_slice_in_dim(
+        combine, expert_offset, E_local, axis=2
+    )
+    xd = x.astype(dtype)
+    h = jnp.einsum("bsd,edf->besf", xd, params["w_in"].astype(dtype))
+    h = jax.nn.silu(h)
+    y = jnp.einsum("besf,efd->besd", h, params["w_out"].astype(dtype))
+    out = jnp.einsum("besd,bse->bsd", y.astype(jnp.float32), combine_local)
+
+    load = jnp.mean(
+        jnp.sum(top_mask, axis=2), axis=(0, 1)
+    )  # fraction of tokens per expert (x top_k)
+    importance = jnp.mean(probs, axis=(0, 1))
+    aux = E_total * jnp.sum(load * importance) / top_k
+    return out.astype(x.dtype), aux
+
+
+def make_sharded_moe(mesh: Mesh, axis_name: str, top_k: int = 2,
+                     dtype=jnp.bfloat16):
+    """Expert-parallel MoE: experts sharded over ``axis_name``; each
+    device runs its expert shard over all tokens, psum combines."""
+
+    def local_fn(params, x):
+        e_local = params["w_in"].shape[0]
+        offset = jax.lax.axis_index(axis_name) * e_local
+        out, aux = moe_ffn(params, x, top_k=top_k, dtype=dtype,
+                           expert_offset=offset)
+        # Partial mixture over the local expert shard -> full combine.
+        # aux is shard-invariant (replicated router), so no psum.
+        return jax.lax.psum(out, axis_name), aux
+
+    specs = moe_param_specs(axis_name)
+    x_spec = P()  # tokens replicated over the ep axis
+
+    @jax.jit
+    def fn(params, x):
+        return jax.shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(specs, x_spec),
+            out_specs=(x_spec, P()),
+        )(params, x)
+
+    def place(params):
+        return jax.device_put(
+            params,
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda s: isinstance(s, P),
+            ),
+        )
+
+    return fn, place

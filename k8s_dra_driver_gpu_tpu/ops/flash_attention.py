@@ -95,8 +95,9 @@ def flash_attention(
     interpret: bool | None = None,
 ) -> jax.Array:
     """Differentiable: the forward runs the pallas kernel; the backward
-    recomputes attention with the einsum formulation and takes its VJP
-    (flash-style recompute-in-backward -- no S x S residuals saved)."""
+    recomputes attention one q-chunk at a time under lax.scan
+    (_chunked_attention_bwd) -- O(block_q * S) transient memory, never
+    the full S x S score tensor, and no residuals beyond (q, k, v)."""
     return _flash_attention_vjp(q, k, v, causal, block_q, block_k, interpret)
 
 
