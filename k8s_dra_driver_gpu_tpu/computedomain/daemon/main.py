@@ -52,8 +52,19 @@ class DaemonConfig:
         self.port = int(env.get("COORDINATION_PORT", str(DOMAIN_DAEMON_PORT)))
         self.driver_namespace = env.get("DRIVER_NAMESPACE", "tpu-dra-driver")
         self.standalone = env.get("CD_DAEMON_STANDALONE", "") == "1"
-        # ComputeDomainCliques feature gate (default on, like upstream).
-        self.use_cliques = env.get("COMPUTE_DOMAIN_CLIQUES", "true") != "false"
+        # Both mode switches ride the k8s-style FEATURE_GATES mechanism
+        # (pkg/featuregates): ComputeDomainCliques picks the registrar,
+        # DomainDaemonsWithDNSNames picks hosts-rewrite+SIGUSR1 vs the
+        # legacy restart-on-peer-change loop (reference main.go:347-431).
+        from ...pkg.featuregates import (  # noqa: PLC0415
+            COMPUTE_DOMAIN_CLIQUES,
+            DOMAIN_DAEMONS_WITH_DNS_NAMES,
+            FeatureGates,
+        )
+
+        gates = FeatureGates.parse(env.get("FEATURE_GATES", ""))
+        self.use_cliques = gates.is_enabled(COMPUTE_DOMAIN_CLIQUES)
+        self.dns_names = gates.is_enabled(DOMAIN_DAEMONS_WITH_DNS_NAMES)
 
 
 class Daemon:
@@ -169,6 +180,12 @@ class Daemon:
             update_hosts_file(self.cfg.hosts_file, dns_name_mappings(members))
         except OSError:
             logger.exception("hosts file update failed")
+        if not self.cfg.dns_names and self.process.alive():
+            # Legacy IP mode: membership changes restart the service
+            # (disruptive, like the reference's nodes.cfg rewrite +
+            # IMEX restart; DNS mode below avoids it).
+            self.process.restart()
+            return
         self.process.ensure_started()
         # Nudge a RUNNING service only: a SIGUSR1 during interpreter
         # startup (before the handler is registered) would kill the

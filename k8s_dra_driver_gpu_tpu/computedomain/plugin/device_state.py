@@ -314,3 +314,38 @@ class CDDeviceState:
 
     def prepared_claims(self):
         return self._checkpoint.get().claims
+
+    # -- stale per-domain dir GC -------------------------------------------------
+
+    def cleanup_stale_domain_dirs(self) -> list[str]:
+        """Remove domains/<uid> state dirs whose ComputeDomain no longer
+        exists (reference computedomain.go:384 periodic cleanup)."""
+        import shutil  # noqa: PLC0415
+
+        domains_root = os.path.join(self.root, "domains")
+        if not os.path.isdir(domains_root):
+            return []
+        # Order matters (TOCTOU): snapshot the dirs FIRST, then the live
+        # set. A dir can only be created for an already-existing CD, so
+        # any dir observed here either has its CD in the (later) live
+        # snapshot or is genuinely stale. The reverse order could delete
+        # the state dir of a domain created between the two reads.
+        dirs = os.listdir(domains_root)
+        live = {
+            cd["metadata"].get("uid")
+            for cd in self.kube.list(API_GROUP, API_VERSION, "computedomains")
+        }
+        removed = []
+        for uid in dirs:
+            if uid in live:
+                continue
+            path = os.path.join(domains_root, uid)
+            try:
+                shutil.rmtree(path)
+            except OSError:
+                logger.exception("removing stale domain dir %s failed", path)
+                continue
+            removed.append(uid)
+        if removed:
+            logger.warning("removed stale domain dir(s): %s", removed)
+        return removed

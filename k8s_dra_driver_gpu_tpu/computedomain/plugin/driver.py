@@ -28,6 +28,9 @@ ERROR_RETRY_MAX_TIMEOUT_S = 45.0
 RETRY_LIMITER = RateLimiter(base_delay=0.25, max_delay=3.0, jitter=0.2)
 
 
+STALE_DIR_GC_INTERVAL_S = 600.0
+
+
 class CDDriver:
     def __init__(
         self,
@@ -42,6 +45,27 @@ class CDDriver:
         self.node_name = node_name
         self.metrics = metrics or DRARequestMetrics()
         self.retry_timeout = retry_timeout
+        self._gc_stop = None
+
+    def start_background(self) -> None:
+        """Periodic stale-domain-dir GC (computedomain.go:384)."""
+        import threading  # noqa: PLC0415
+
+        self._gc_stop = threading.Event()
+
+        def loop():
+            while not self._gc_stop.wait(STALE_DIR_GC_INTERVAL_S):
+                try:
+                    self.state.cleanup_stale_domain_dirs()
+                except Exception:  # noqa: BLE001
+                    logger.exception("stale domain dir GC failed")
+
+        threading.Thread(target=loop, name="cd-domain-gc",
+                         daemon=True).start()
+
+    def stop_background(self) -> None:
+        if self._gc_stop is not None:
+            self._gc_stop.set()
 
     def _fetch_claim(self, ref) -> ResourceClaim:
         uid = getattr(ref, "uid", None) or ref.get("uid")

@@ -71,6 +71,7 @@ def run(argv: list[str] | None = None) -> int:
     metrics = DRARequestMetrics()
     driver = CDDriver(state, kube, node_name, metrics=metrics)
     driver.publish_resources()
+    driver.start_background()
 
     server = PluginServer(
         COMPUTE_DOMAIN_DRIVER_NAME,
@@ -98,6 +99,7 @@ def run(argv: list[str] | None = None) -> int:
         wait_for_termination()
     finally:
         server.stop()
+        driver.stop_background()
         for e in extras:
             e.stop()
     return 0

@@ -213,7 +213,7 @@ class TestLegacyStatusMode:
             "COMPUTE_DOMAIN_UUID": cd["metadata"]["uid"],
             "COMPUTE_DOMAIN_NAME": "cd1",
             "COMPUTE_DOMAIN_NAMESPACE": "team-a",
-            "COMPUTE_DOMAIN_CLIQUES": "false",
+            "FEATURE_GATES": "ComputeDomainCliques=false",
             "NODE_NAME": "node-0", "POD_IP": "10.0.0.1",
             "DOMAIN_STATE_DIR": str(tmp_path / "st"),
             "HOSTS_FILE": str(tmp_path / "hosts"),
@@ -381,6 +381,44 @@ class TestGangFlow:
         out3 = drv.prepare_resource_claims(
             [{"uid": "c2", "namespace": "team-a", "name": "c2"}])
         assert out3["c2"][1] == ""
+
+    def test_stale_domain_dir_gc(self, kube, tmp_path):
+        cd = make_cd(kube)
+        uid = cd["metadata"]["uid"]
+        st = CDDeviceState(str(tmp_path / "st"), kube, "node-0")
+        import os
+        os.makedirs(os.path.join(st.root, "domains", uid))
+        os.makedirs(os.path.join(st.root, "domains", "ghost-uid"))
+        removed = st.cleanup_stale_domain_dirs()
+        assert removed == ["ghost-uid"]
+        assert os.path.isdir(os.path.join(st.root, "domains", uid))
+
+    def test_legacy_ip_mode_restarts_on_member_change(self, kube, tmp_path):
+        cd = make_cd(kube)
+        uid = cd["metadata"]["uid"]
+        env = {
+            "COMPUTE_DOMAIN_UUID": uid, "CLIQUE_ID": "0",
+            "NODE_NAME": "n0", "POD_IP": "127.0.0.1",
+            "COMPUTE_DOMAIN_NUM_WORKERS": "2",
+            "DOMAIN_STATE_DIR": str(tmp_path / "n0"),
+            "HOSTS_FILE": str(tmp_path / "hosts"),
+            "COORDINATION_PORT": "17093",
+            "FEATURE_GATES": "DomainDaemonsWithDNSNames=false",
+        }
+        d = Daemon(DaemonConfig(env=env), kube=kube)
+        assert not d.cfg.dns_names
+        d.registrar.register()
+        try:
+            d.process.ensure_started()
+            from tests.fake_kube import wait_for_service
+            wait_for_service(17093)
+            pid1 = d.process.pid
+            # Membership change in IP mode restarts the child.
+            CliqueRegistrar(kube, uid, "0", "n1", "10.0.0.2").register()
+            d.sync_once()
+            assert d.process.pid != pid1
+        finally:
+            d.process.stop()
 
     def test_daemon_claim_injects_identity(self, kube, tmp_path):
         cd = make_cd(kube, topology="2x2x2")
