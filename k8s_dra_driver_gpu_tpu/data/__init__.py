@@ -1,0 +1,1 @@
+"""Data loading for gang-scheduled training jobs."""

@@ -303,9 +303,25 @@ class DeviceState:
                 existing = cp.claims.get(claim.uid)
                 if (existing
                         and existing.state == ClaimState.PREPARE_COMPLETED.value):
-                    return [
-                        i for d in existing.devices for i in d.cdi_device_ids
-                    ]
+                    # Idempotent return ONLY if the (un-fsync'd,
+                    # regenerable) CDI spec actually survived; a
+                    # crash-truncated spec falls through to a full
+                    # re-prepare.
+                    try:
+                        spec_ok = self._cdi.read_spec(claim.uid) is not None
+                    except ValueError:
+                        spec_ok = False  # corrupt JSON
+                    if spec_ok:
+                        return [
+                            i for d in existing.devices
+                            for i in d.cdi_device_ids
+                        ]
+                    logger.warning(
+                        "claim %s completed but CDI spec missing/corrupt; "
+                        "re-preparing", claim.uid,
+                    )
+                    with timer.segment("prep_rollback_stale"):
+                        self._rollback(existing)
                 if (existing
                         and existing.state == ClaimState.PREPARE_STARTED.value):
                     # A previous Prepare died mid-flight: roll back its

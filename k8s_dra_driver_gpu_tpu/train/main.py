@@ -55,6 +55,14 @@ def run(argv: list[str] | None = None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=100)
     p.add_argument("--tp", type=int, default=None,
                    help="tensor-parallel size (default: planned)")
+    p.add_argument("--data-file", default=os.environ.get("DATA_FILE", ""),
+                   help="flat binary token file; synthetic data when "
+                        "unset [DATA_FILE]")
+    p.add_argument("--data-dtype", default=os.environ.get(
+                       "DATA_DTYPE", "uint16"),
+                   choices=["uint16", "uint32", "int32"],
+                   help="token file dtype (llama3 vocab 128k needs "
+                        "uint32) [DATA_DTYPE]")
     p.add_argument("--profile-dir",
                    default=os.environ.get("PROFILE_DIR", ""),
                    help="capture a jax.profiler trace (XLA/TPU timeline) "
@@ -93,16 +101,45 @@ def run(argv: list[str] | None = None) -> int:
             state = ckpt.restore(state)
             logger.info("resumed from step %d", int(state.step))
 
-    # Synthetic next-token data keyed by step (a real loader drops in
-    # here; the reference ships no data path at all).
-    def batch_for(step: int):
-        return jax.device_put(
-            jax.random.randint(
-                jax.random.PRNGKey(step), (args.batch_size, args.seq_len + 1),
-                0, cfg.vocab_size, jnp.int32,
-            ),
-            batch_shard,
-        )
+    if args.data_file:
+        # Host-sharded deterministic loading keyed by the injected gang
+        # env; batch(step) is pure, so checkpoint resume replays exactly.
+        from ..data.loader import ShardedBatchIterator, TokenDataset  # noqa: PLC0415
+
+        num_shards = int(os.environ.get("TPU_NUM_PROCESSES", "1"))
+        ds = TokenDataset(args.data_file, args.seq_len,
+                          dtype=args.data_dtype)
+        it = ShardedBatchIterator(ds, global_batch=args.batch_size * num_shards)
+        # Out-of-vocab ids anywhere in the file would silently NaN the
+        # loss (out-of-bounds embedding gather); one full memmap scan at
+        # startup fails loudly instead (wrong --data-dtype shows up here
+        # too for files tokenized with a larger vocab).
+        file_max = int(ds._tokens.max())
+        if file_max >= cfg.vocab_size:
+            raise SystemExit(
+                f"--data-file contains token id {file_max} >= model "
+                f"vocab {cfg.vocab_size}; retokenize, fix --data-dtype, "
+                "or pick the right --model"
+            )
+
+        def batch_for(step: int):
+            # Each process supplies ONLY its local shard; device_put's
+            # same-on-all-hosts semantics would drop 1-1/N of every
+            # shard on multi-host gangs.
+            return jax.make_array_from_process_local_data(
+                batch_shard, it.batch(step)
+            )
+    else:
+        # Synthetic next-token data keyed by step.
+        def batch_for(step: int):
+            return jax.device_put(
+                jax.random.randint(
+                    jax.random.PRNGKey(step),
+                    (args.batch_size, args.seq_len + 1),
+                    0, cfg.vocab_size, jnp.int32,
+                ),
+                batch_shard,
+            )
 
     start_step = int(state.step)
     t0 = time.perf_counter()

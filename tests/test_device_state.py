@@ -309,6 +309,24 @@ class TestCrashRecovery:
         assert v5p_state._registry.list() == {}
         assert "c1" not in v5p_state.prepared_claims()
 
+    def test_completed_claim_with_lost_cdi_spec_reprepares(self, tmp_root):
+        # A crash after the fsync'd checkpoint but before the
+        # (intentionally un-fsync'd) CDI spec hit disk: the idempotent
+        # path must re-prepare, not hand out IDs for a missing spec.
+        state = DeviceState(Config.mock(root=tmp_root))
+        ids = state.prepare(make_claim("c1", ["chip-0"]))
+        import os as _os
+        _os.unlink(state._cdi._spec_path("c1"))
+        ids2 = state.prepare(make_claim("c1", ["chip-0"]))
+        assert ids2 == ids
+        assert state._cdi.spec_exists("c1")
+        # Truncated (corrupt) spec likewise.
+        with open(state._cdi._spec_path("c1"), "w") as f:
+            f.write("{trunc")
+        ids3 = state.prepare(make_claim("c1", ["chip-0"]))
+        assert ids3 == ids
+        assert state._cdi.read_spec("c1") is not None
+
     def test_checkpoint_survives_restart(self, tmp_root):
         cfg = Config.mock(root=tmp_root)
         state = DeviceState(cfg)
