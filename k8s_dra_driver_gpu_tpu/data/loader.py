@@ -26,8 +26,20 @@ TOKEN_DTYPES = {"uint16": np.uint16, "uint32": np.uint32, "int32": np.int32}
 
 
 def write_token_file(path: str, tokens, dtype: str = "uint16") -> None:
-    """Helper for tests/preprocessing: dump a 1-D token array."""
-    np.asarray(tokens, dtype=TOKEN_DTYPES[dtype]).tofile(path)
+    """Helper for tests/preprocessing: dump a 1-D token array.
+
+    Validates range before casting: a silent wrap (e.g. llama3 ids
+    >= 65536 into uint16) would produce VALID-looking garbage tokens
+    no downstream vocab check could catch."""
+    arr = np.asarray(tokens)
+    info = np.iinfo(TOKEN_DTYPES[dtype])
+    lo, hi = int(arr.min()), int(arr.max())
+    if lo < info.min or hi > info.max:
+        raise ValueError(
+            f"token ids [{lo}, {hi}] don't fit dtype {dtype} "
+            f"[{info.min}, {info.max}]"
+        )
+    arr.astype(TOKEN_DTYPES[dtype]).tofile(path)
 
 
 class TokenDataset:
@@ -49,6 +61,29 @@ class TokenDataset:
         """-> [seq_len + 1] tokens (inputs + next-token targets)."""
         start = index * self.seq_len
         return np.asarray(self._tokens[start:start + self.seq_len + 1])
+
+    def max_token(self) -> int:
+        """Largest token id in the file, cached in a sidecar keyed by
+        (size, mtime) so preemption-resume doesn't rescan a huge file."""
+        import json  # noqa: PLC0415
+
+        st = os.stat(self.path)
+        key = [st.st_size, int(st.st_mtime)]
+        sidecar = self.path + ".max.json"
+        try:
+            with open(sidecar, encoding="utf-8") as f:
+                doc = json.load(f)
+            if doc.get("key") == key:
+                return int(doc["max"])
+        except (OSError, ValueError, KeyError):
+            pass
+        value = int(self._tokens.max())
+        try:
+            with open(sidecar, "w", encoding="utf-8") as f:
+                json.dump({"key": key, "max": value}, f)
+        except OSError:
+            pass  # cache is best-effort
+        return value
 
 
 def _permute(index: np.ndarray, n: int, seed: int) -> np.ndarray:
@@ -91,8 +126,8 @@ class ShardedBatchIterator:
         if not 0 <= shard_id < num_shards:
             raise ValueError(f"shard_id {shard_id} not in [0, {num_shards})")
         if dataset.num_sequences < global_batch:
-            # The modulo fold-back below would silently hand different
-            # shards identical samples, breaking disjointness.
+            # A permutation over fewer slots than one global batch could
+            # not keep the shards' rows disjoint.
             raise ValueError(
                 f"dataset has {dataset.num_sequences} sequences < one "
                 f"global batch of {global_batch}"
@@ -110,10 +145,12 @@ class ShardedBatchIterator:
         pos = step % self.steps_per_epoch
         row0 = pos * self.global_batch + self.shard_id * self.local_batch
         slots = np.arange(row0, row0 + self.local_batch)
-        # Re-permute every epoch with a distinct seed.
-        slots = _permute(slots, self.steps_per_epoch * self.global_batch,
-                         self.seed + epoch)
-        slots = slots % self.ds.num_sequences
+        # Permute over the WHOLE dataset (not just the consumed prefix):
+        # each epoch's distinct affine map rotates which tail sequences
+        # fall off the drop-last edge, so every sample is eventually
+        # seen. Injectivity over [0, num_sequences) keeps shards
+        # disjoint within a step.
+        slots = _permute(slots, self.ds.num_sequences, self.seed + epoch)
         return np.stack(
             [self.ds.sequence(int(s)) for s in slots]
         ).astype(np.int32)

@@ -316,6 +316,25 @@ class DeviceState:
                             i for d in existing.devices
                             for i in d.cdi_device_ids
                         ]
+                    # Regenerating via rollback+re-prepare is only safe
+                    # when it can't disturb state a RUNNING workload may
+                    # hold: vfio rebinds and tenancy rendezvous dirs
+                    # must not be torn down under a live pod.
+                    disruptive = any(
+                        d.live and d.live.get("vfio")
+                        for d in existing.devices
+                    ) or self._tenancy.active(claim.uid)
+                    if disruptive:
+                        logger.error(
+                            "claim %s completed but CDI spec missing/"
+                            "corrupt; NOT re-preparing (live vfio/"
+                            "tenancy state) -- unprepare to recover",
+                            claim.uid,
+                        )
+                        return [
+                            i for d in existing.devices
+                            for i in d.cdi_device_ids
+                        ]
                     logger.warning(
                         "claim %s completed but CDI spec missing/corrupt; "
                         "re-preparing", claim.uid,

@@ -101,20 +101,25 @@ def run(argv: list[str] | None = None) -> int:
             state = ckpt.restore(state)
             logger.info("resumed from step %d", int(state.step))
 
+    # --batch-size is PER PROCESS in both modes; the global batch is
+    # batch_size * TPU_NUM_PROCESSES, so synthetic-vs-real comparisons
+    # use identical compiled shapes and throughput accounting.
+    num_shards = int(os.environ.get("TPU_NUM_PROCESSES", "1"))
+    shard_id = int(os.environ.get("TPU_PROCESS_ID", "0"))
+    global_batch = args.batch_size * num_shards
     if args.data_file:
         # Host-sharded deterministic loading keyed by the injected gang
         # env; batch(step) is pure, so checkpoint resume replays exactly.
         from ..data.loader import ShardedBatchIterator, TokenDataset  # noqa: PLC0415
 
-        num_shards = int(os.environ.get("TPU_NUM_PROCESSES", "1"))
         ds = TokenDataset(args.data_file, args.seq_len,
                           dtype=args.data_dtype)
-        it = ShardedBatchIterator(ds, global_batch=args.batch_size * num_shards)
+        it = ShardedBatchIterator(ds, global_batch=global_batch)
         # Out-of-vocab ids anywhere in the file would silently NaN the
-        # loss (out-of-bounds embedding gather); one full memmap scan at
-        # startup fails loudly instead (wrong --data-dtype shows up here
-        # too for files tokenized with a larger vocab).
-        file_max = int(ds._tokens.max())
+        # loss (out-of-bounds embedding gather); fail loudly instead.
+        # The scan result is sidecar-cached so preemption resumes don't
+        # re-read huge files.
+        file_max = ds.max_token()
         if file_max >= cfg.vocab_size:
             raise SystemExit(
                 f"--data-file contains token id {file_max} >= model "
@@ -122,28 +127,33 @@ def run(argv: list[str] | None = None) -> int:
                 "or pick the right --model"
             )
 
-        def batch_for(step: int):
-            # Each process supplies ONLY its local shard; device_put's
-            # same-on-all-hosts semantics would drop 1-1/N of every
-            # shard on multi-host gangs.
-            return jax.make_array_from_process_local_data(
-                batch_shard, it.batch(step)
-            )
+        def local_batch(step: int):
+            return it.batch(step)
     else:
-        # Synthetic next-token data keyed by step.
-        def batch_for(step: int):
-            return jax.device_put(
-                jax.random.randint(
-                    jax.random.PRNGKey(step),
-                    (args.batch_size, args.seq_len + 1),
-                    0, cfg.vocab_size, jnp.int32,
-                ),
-                batch_shard,
-            )
+        # Synthetic next-token data: each process draws ITS shard's
+        # slice (keyed by step and shard) so global semantics match the
+        # data path exactly.
+        def local_batch(step: int):
+            import numpy as _np  # noqa: PLC0415
+
+            rng = _np.random.RandomState(step * 65521 + shard_id)
+            return rng.randint(
+                0, cfg.vocab_size,
+                (args.batch_size, args.seq_len + 1),
+            ).astype(_np.int32)
+
+    def batch_for(step: int):
+        # Each process supplies ONLY its local shard; device_put's
+        # same-on-all-hosts semantics would drop 1-1/N of every shard
+        # on multi-host gangs.
+        return jax.make_array_from_process_local_data(
+            batch_shard, local_batch(step)
+        )
 
     start_step = int(state.step)
     t0 = time.perf_counter()
-    tokens_per_step = args.batch_size * args.seq_len
+    # Global tokens per step (all gang members), matching both modes.
+    tokens_per_step = global_batch * args.seq_len
     tracing = False
     for step in range(start_step, args.steps):
         if args.profile_dir and step == start_step + 1 and not tracing:

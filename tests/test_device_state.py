@@ -315,8 +315,7 @@ class TestCrashRecovery:
         # path must re-prepare, not hand out IDs for a missing spec.
         state = DeviceState(Config.mock(root=tmp_root))
         ids = state.prepare(make_claim("c1", ["chip-0"]))
-        import os as _os
-        _os.unlink(state._cdi._spec_path("c1"))
+        os.unlink(state._cdi._spec_path("c1"))
         ids2 = state.prepare(make_claim("c1", ["chip-0"]))
         assert ids2 == ids
         assert state._cdi.spec_exists("c1")
