@@ -1,0 +1,33 @@
+# Developer entry points (reference: Makefile `make test` / `make bats`).
+
+PYTHON ?= python
+
+.PHONY: all native test test-fast bench lint clean
+
+all: native test
+
+native:
+	$(MAKE) -C k8s_dra_driver_gpu_tpu/tpulib/native
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+# The non-JAX suites (~15s); JAX compile-heavy suites excluded.
+test-fast: native
+	$(PYTHON) -m pytest tests/ -q \
+	    --ignore=tests/test_model_stack.py \
+	    --ignore=tests/test_longcontext.py \
+	    --ignore=tests/test_train_checkpoint.py \
+	    --ignore=tests/test_launcher.py \
+	    --ignore=tests/test_decode.py \
+	    --ignore=tests/test_moe.py
+
+bench: native
+	$(PYTHON) bench.py
+
+lint:
+	ruff check --select E9,F k8s_dra_driver_gpu_tpu/ tests/ bench.py __graft_entry__.py
+
+clean:
+	$(MAKE) -C k8s_dra_driver_gpu_tpu/tpulib/native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
