@@ -12,7 +12,9 @@ native:
 test: native
 	$(PYTHON) -m pytest tests/ -q
 
-# The non-JAX suites (~15s); JAX compile-heavy suites excluded.
+# The quick suites (~20s): excludes the compile-heavy JAX suites.
+# (jax is still imported by conftest; this trims compile time, not
+# the dependency. Keep the list in sync with jax-importing tests.)
 test-fast: native
 	$(PYTHON) -m pytest tests/ -q \
 	    --ignore=tests/test_model_stack.py \

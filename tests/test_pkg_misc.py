@@ -1,0 +1,69 @@
+"""Coverage for the small pkg helpers: timing, sliceutil, httpserver."""
+
+import logging
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg.httpserver import SimpleHTTPEndpoint
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.sliceutil import publish_resource_slices
+from k8s_dra_driver_gpu_tpu.pkg.timing import SegmentTimer
+
+
+class TestSegmentTimer:
+    def test_segments_accumulate_and_log(self, caplog):
+        caplog.set_level(logging.DEBUG,
+                         logger="k8s_dra_driver_gpu_tpu.pkg.timing")
+        t = SegmentTimer("prepare", "claim-1")
+        with t.segment("a"):
+            pass
+        with t.segment("a"):
+            pass
+        with t.segment("b"):
+            pass
+        total = t.done()
+        assert total >= 0
+        assert set(t.segments) == {"a", "b"}
+        msg = caplog.records[-1].getMessage()
+        assert "prepare claim-1" in msg and "t_a=" in msg and "t_b=" in msg
+
+    def test_segment_records_on_exception(self):
+        t = SegmentTimer("op")
+        with pytest.raises(RuntimeError):
+            with t.segment("x"):
+                raise RuntimeError("boom")
+        assert "x" in t.segments
+
+
+class TestSliceUtil:
+    def _slice(self, name, gen=1):
+        return {
+            "metadata": {"name": name},
+            "spec": {"pool": {"name": "n", "generation": gen,
+                              "resourceSliceCount": 1},
+                     "devices": []},
+        }
+
+    def test_create_then_update_bumps_generation(self):
+        kube = FakeKubeClient()
+        publish_resource_slices(kube, [self._slice("s1")])
+        publish_resource_slices(kube, [self._slice("s1")])
+        obj = kube.get("resource.k8s.io", "v1", "resourceslices", "s1")
+        assert obj["spec"]["pool"]["generation"] == 2
+
+
+class TestSimpleHTTPEndpoint:
+    def test_serves_and_404s(self):
+        ep = SimpleHTTPEndpoint("/thing", lambda: (200, "text/plain", b"ok"))
+        ep.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{ep.port}/thing?q=1")
+            assert body.read() == b"ok"
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"http://127.0.0.1:{ep.port}/other")
+            assert e.value.code == 404
+        finally:
+            ep.stop()
