@@ -40,7 +40,7 @@ class Driver:
         node_name: str,
         metrics: DRARequestMetrics | None = None,
         enable_health_monitor: bool = True,
-        split_slices: bool | None = None,
+        publication_mode: str | None = None,
         additional_ignored_health_kinds: tuple[str, ...] = (),
     ):
         self.state = DeviceState(config)
@@ -48,11 +48,19 @@ class Driver:
         self.node_name = node_name
         self.metrics = metrics or DRARequestMetrics()
         self._taints: dict[str, list[dict]] = {}
-        # KEP-4815 split mode needs a server >= 1.35 (reference sniffs the
-        # server version, driver.go:574).
-        if split_slices is None:
-            split_slices = self._server_supports_split()
-        self.split_slices = split_slices
+        # Publication modes mirror the reference's three
+        # (driver.go:190,574): "legacy" (pre-partitionable-devices
+        # servers: one slice, whole chips only), "combined" (one slice,
+        # chips + partitions + shared counters), "split" (KEP-4815
+        # two-slice layout, needs a server >= 1.35 -- sniffed when not
+        # forced).
+        if publication_mode is None:
+            publication_mode = (
+                "split" if self._server_supports_split() else "combined"
+            )
+        if publication_mode not in ("legacy", "combined", "split"):
+            raise ValueError(f"unknown publication mode {publication_mode!r}")
+        self.publication_mode = publication_mode
 
         self.cleanup = CheckpointCleanupManager(self.state, kube_client)
         self.health_monitor = None
@@ -170,49 +178,66 @@ class Driver:
     def generate_resource_slices(self) -> list[dict]:
         """Build the node's ResourceSlices.
 
+        Legacy mode: one slice of whole chips only -- no shared counters
+        or partition devices, for servers predating KEP-4815 semantics.
         Combined mode: one slice with all devices + shared counters.
         Split mode (KEP-4815, server >= 1.35): chips slice + per-partition
         slice, mirroring generateSplitResourceSlices (driver.go:190).
+        resourceSliceCount is derived from the slices actually built, so
+        a pool is never published incomplete (e.g. split mode with no
+        partition devices publishes one slice with count 1).
         """
         host = self.state.host
+        legacy = self.publication_mode == "legacy"
         devices = []
         partition_devices = []
         for name, dev in sorted(self.state.allocatable.items()):
+            if legacy and dev.kind != DeviceKind.CHIP:
+                # Partition capacity can't be expressed without shared
+                # counters; legacy servers see whole chips only.
+                continue
             entry = dev.to_dra_device()
             taints = self._taints.get(name)
             if taints:
                 entry["taints"] = taints
-            entry["consumesCounters"] = consumed_counters(dev, host)
+            if not legacy:
+                entry["consumesCounters"] = consumed_counters(dev, host)
             if dev.kind == DeviceKind.CHIP:
                 devices.append(entry)
             else:
                 partition_devices.append(entry)
 
         def slice_obj(suffix: str, devs: list[dict]) -> dict:
+            spec = {
+                "driver": DRIVER_NAME,
+                "nodeName": self.node_name,
+                "pool": {
+                    "name": self.node_name,
+                    "resourceSliceCount": 1,  # fixed up below
+                    "generation": 1,
+                },
+                "perDeviceNodeSelection": False,
+                "devices": devs,
+            }
+            if not legacy:
+                spec["sharedCounters"] = shared_counter_sets(host)
             return {
                 "apiVersion": f"{RESOURCE_GROUP}/{RESOURCE_VERSION}",
                 "kind": "ResourceSlice",
                 "metadata": {"name": f"{self.node_name}-{DRIVER_NAME}{suffix}"},
-                "spec": {
-                    "driver": DRIVER_NAME,
-                    "nodeName": self.node_name,
-                    "pool": {
-                        "name": self.node_name,
-                        "resourceSliceCount": 2 if self.split_slices else 1,
-                        "generation": 1,
-                    },
-                    "sharedCounters": shared_counter_sets(host),
-                    "perDeviceNodeSelection": False,
-                    "devices": devs,
-                },
+                "spec": spec,
             }
 
-        if self.split_slices and partition_devices:
-            return [
+        if self.publication_mode == "split" and partition_devices:
+            slices = [
                 slice_obj("-chips", devices),
                 slice_obj("-partitions", partition_devices),
             ]
-        return [slice_obj("", devices + partition_devices)]
+        else:
+            slices = [slice_obj("", devices + partition_devices)]
+        for s in slices:
+            s["spec"]["pool"]["resourceSliceCount"] = len(slices)
+        return slices
 
     def publish_resources(self) -> None:
         publish_resource_slices(self.kube, self.generate_resource_slices())

@@ -67,6 +67,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mock-worker-id", type=int,
                    default=int(env("TPULIB_MOCK_WORKER_ID", "0")),
                    help="mock worker id [TPULIB_MOCK_WORKER_ID]")
+    p.add_argument("--publication-mode",
+                   choices=["auto", "legacy", "combined", "split"],
+                   default=env("PUBLICATION_MODE", "auto"),
+                   help="ResourceSlice publication mode; auto sniffs the "
+                        "server version (reference driver.go:190,574) "
+                        "[PUBLICATION_MODE]")
     p.add_argument("--additional-health-kinds-to-ignore",
                    default=env("ADDITIONAL_HEALTH_KINDS_TO_IGNORE", ""),
                    help="comma-separated health kinds never tainted "
@@ -123,6 +129,8 @@ def run(argv: list[str] | None = None) -> int:
         if k.strip()
     )
     driver = Driver(config, kube, node_name, metrics=metrics,
+                    publication_mode=(None if args.publication_mode == "auto"
+                                      else args.publication_mode),
                     additional_ignored_health_kinds=ignored)
 
     server = PluginServer(

@@ -122,10 +122,18 @@ class KubeClient:
         )
 
     def list(self, group, version, resource, namespace=None,
-             label_selector: str | None = None) -> list[dict]:
+             label_selector: str | None = None,
+             field_selector: str | None = None) -> list[dict]:
         path = _resource_path(group, version, resource, namespace, None)
+        query = []
         if label_selector:
-            path += f"?labelSelector={urllib.request.quote(label_selector)}"
+            query.append(
+                f"labelSelector={urllib.request.quote(label_selector)}")
+        if field_selector:
+            query.append(
+                f"fieldSelector={urllib.request.quote(field_selector)}")
+        if query:
+            path += "?" + "&".join(query)
         return self._request("GET", path).get("items", [])
 
     def create(self, group, version, resource, obj, namespace=None) -> dict:
@@ -173,7 +181,12 @@ class KubeClient:
         """Streamed watch (chunked JSON lines, `?watch=true`), with
         resourceVersion bookmarking and automatic reconnect. Events are
         delivered as on_event(type, object) -- the same surface as
-        FakeKubeClient watchers. Returns the (daemon) watch thread."""
+        FakeKubeClient watchers. Returns the (daemon) watch thread.
+
+        After a 410 Gone (resourceVersion aged out of the watch cache)
+        the stream resumes from "now" without replaying the gap, so
+        consumers MUST pair the watch with a periodic relist/resync to
+        converge on anything missed (informer-style)."""
         stop = stop or threading.Event()
 
         def run():
@@ -224,6 +237,14 @@ class KubeClient:
                                     "watch callback failed for %s %s",
                                     ev_type, resource,
                                 )
+                except urllib.error.HTTPError as e:
+                    if e.code == 410:
+                        # Expired resourceVersion at watch establishment
+                        # (long disconnect): drop the bookmark and
+                        # re-watch from "now" instead of redialing with
+                        # the stale version forever. Events from the gap
+                        # are NOT replayed -- see the docstring.
+                        resource_version = ""
                 except (urllib.error.URLError, OSError, TimeoutError):
                     pass
                 stop.wait(reconnect_delay)
@@ -282,12 +303,27 @@ class FakeKubeClient:
             return json.loads(json.dumps(obj))
 
     def list(self, group, version, resource, namespace=None,
-             label_selector: str | None = None) -> list[dict]:
+             label_selector: str | None = None,
+             field_selector: str | None = None) -> list[dict]:
         sel = {}
         if label_selector:
             for part in label_selector.split(","):
                 k, _, v = part.partition("=")
                 sel[k] = v
+        fields = {}
+        if field_selector:
+            for part in field_selector.split(","):
+                k, _, v = part.partition("=")
+                fields[k] = v
+
+        def field_val(obj, dotted):
+            cur = obj
+            for seg in dotted.split("."):
+                if not isinstance(cur, dict):
+                    return None
+                cur = cur.get(seg)
+            return cur
+
         with self._lock:
             out = []
             for (g, r, ns, _), obj in self._store.items():
@@ -296,7 +332,9 @@ class FakeKubeClient:
                 if namespace and ns != namespace:
                     continue
                 labels = obj.get("metadata", {}).get("labels", {})
-                if all(labels.get(k) == v for k, v in sel.items()):
+                if not all(labels.get(k) == v for k, v in sel.items()):
+                    continue
+                if all(field_val(obj, k) == v for k, v in fields.items()):
                     out.append(json.loads(json.dumps(obj)))
             return out
 

@@ -25,15 +25,6 @@ def _now() -> str:
     return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
 
 
-def _parse(ts: str) -> float:
-    try:
-        return datetime.strptime(
-            ts, "%Y-%m-%dT%H:%M:%S.%fZ"
-        ).replace(tzinfo=timezone.utc).timestamp()
-    except (ValueError, TypeError):
-        return 0.0
-
-
 class LeaderElector:
     def __init__(
         self,
@@ -53,6 +44,12 @@ class LeaderElector:
         self.renew_period = renew_period
         self.retry_period = retry_period
         self.is_leader = False
+        # Lease expiry is judged from when THIS process last observed the
+        # lease record change (client-go semantics), never by comparing
+        # the remote renewTime against the local wall clock -- clock skew
+        # between replicas must not open a dual-leader window.
+        self._observed_record: tuple[str, str] | None = None
+        self._observed_at: float = 0.0
 
     # -- lease CRUD -------------------------------------------------------------
 
@@ -93,8 +90,13 @@ class LeaderElector:
                 return False
         spec = lease.get("spec", {})
         holder = spec.get("holderIdentity", "")
-        renew = _parse(spec.get("renewTime", ""))
-        expired = time.time() - renew > self.lease_duration
+        record = (holder, spec.get("renewTime", ""))
+        now = time.monotonic()
+        if record != self._observed_record:
+            # Fresh activity: restart the local expiry clock.
+            self._observed_record = record
+            self._observed_at = now
+        expired = now - self._observed_at > self.lease_duration
         # An empty holder means the previous leader released on cancel.
         if holder and holder != self.identity and not expired:
             return False

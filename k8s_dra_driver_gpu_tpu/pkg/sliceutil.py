@@ -1,7 +1,11 @@
 """Shared ResourceSlice publication (used by both DRA drivers).
 
 Reference: the kubeletplugin helper's PublishResources
-(gpu driver.go:455, CD plugin equivalent).
+(gpu driver.go:455, CD plugin equivalent). Like the upstream helper, one
+publish pass stamps a single shared pool generation on every slice of
+the pool and deletes slices of this driver/node that are no longer in
+the desired set (e.g. after a combined->split mode transition), so no
+stale slice can shadow the pool at a higher generation.
 """
 
 from __future__ import annotations
@@ -12,22 +16,60 @@ RESOURCE_GROUP = "resource.k8s.io"
 RESOURCE_VERSION = "v1"
 
 
+def _existing_pool_slices(kube, driver: str, node_name: str) -> list[dict]:
+    # ResourceSlice supports spec.driver/spec.nodeName field selectors;
+    # scope the list server-side so an N-node rollout doesn't make every
+    # node fetch the whole cluster's slices. Client-side filter retained
+    # as a belt for clients that ignore the selector.
+    items = kube.list(
+        RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices",
+        field_selector=f"spec.driver={driver},spec.nodeName={node_name}",
+    )
+    return [
+        s for s in items
+        if s.get("spec", {}).get("driver") == driver
+        and s.get("spec", {}).get("nodeName") == node_name
+    ]
+
+
 def publish_resource_slices(kube, slices: list[dict]) -> None:
-    """Create-or-update each slice, bumping the pool generation on
-    update so schedulers see a fresh pool snapshot."""
+    """Publish the desired slice set for one (driver, node) pool.
+
+    All slices must belong to the same driver/node. The whole set gets
+    one pool generation (max existing + 1); stale slices of that pool
+    are deleted. An empty set is a no-op (the pool identity would be
+    unknown): a driver with zero devices still publishes one slice with
+    an empty device list rather than an empty set, which is what both
+    in-tree drivers do.
+    """
+    if not slices:
+        return
+    driver = slices[0]["spec"]["driver"]
+    node_name = slices[0]["spec"]["nodeName"]
+    existing = _existing_pool_slices(kube, driver, node_name)
+    existing_by_name = {s["metadata"]["name"]: s for s in existing}
+    generation = 1 + max(
+        (s["spec"].get("pool", {}).get("generation", 0) for s in existing),
+        default=0,
+    )
+    desired_names = set()
     for obj in slices:
         name = obj["metadata"]["name"]
-        try:
-            existing = kube.get(
-                RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", name
-            )
-            obj["spec"]["pool"]["generation"] = (
-                existing["spec"]["pool"]["generation"] + 1
-            )
-            kube.update(
-                RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", name, obj
-            )
-        except NotFoundError:
+        desired_names.add(name)
+        obj["spec"]["pool"]["generation"] = generation
+        if name in existing_by_name:
+            try:
+                kube.update(
+                    RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", name, obj
+                )
+            except NotFoundError:
+                kube.create(
+                    RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", obj
+                )
+        else:
             kube.create(
                 RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", obj
             )
+    for name in existing_by_name:
+        if name not in desired_names:
+            kube.delete(RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", name)

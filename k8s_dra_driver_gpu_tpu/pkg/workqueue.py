@@ -74,7 +74,6 @@ class _Scheduled:
     when: float
     seq: int
     key: Any = field(compare=False)
-    fn: Callable[[Any], None] = field(compare=False)
 
 
 class WorkQueue:
@@ -100,6 +99,15 @@ class WorkQueue:
         self._failures: dict[Any, int] = {}
         self._first_failure: dict[Any, float] = {}
         self._pending: set[Any] = set()  # keys queued or running (dedupe)
+        self._running: set[Any] = set()  # keys currently in a callback
+        # Latest callback per pending key: an enqueue for a queued key
+        # (including one waiting out a retry backoff) swaps in the fresh
+        # callback; the heap holds keys only.
+        self._fn: dict[Any, Callable[[Any], None]] = {}
+        # Keys re-enqueued while running: processed again after the
+        # in-flight callback returns (k8s workqueue "dirty" semantics),
+        # so a watch event racing a reconcile is never silently dropped.
+        self._dirty: set[Any] = set()
         self._cv = threading.Condition()
         self._seq = 0
         self._shutdown = False
@@ -115,12 +123,21 @@ class WorkQueue:
     # -- public API -----------------------------------------------------------
 
     def enqueue(self, key: Any, fn: Callable[[Any], None]) -> None:
-        """Schedule fn(key) to run now. Deduplicates by key while queued."""
+        """Schedule fn(key) to run now. Deduplicates by key while queued
+        (the fresh fn replaces the queued one); an enqueue for a key
+        whose callback is mid-flight marks it dirty and re-runs it (with
+        the new fn) after the callback returns."""
         with self._cv:
-            if self._shutdown or key in self._pending:
+            if self._shutdown:
                 return
+            self._fn[key] = fn
+            if key in self._running:
+                self._dirty.add(key)
+                return
+            if key in self._pending:
+                return  # already queued; it will run with the fresh fn
             self._pending.add(key)
-            self._push(key, fn, delay=0.0)
+            self._push(key, delay=0.0)
 
     def forget(self, key: Any) -> None:
         """Reset the failure count for key (after a success elsewhere)."""
@@ -152,10 +169,10 @@ class WorkQueue:
 
     # -- internals ------------------------------------------------------------
 
-    def _push(self, key: Any, fn: Callable[[Any], None], delay: float) -> None:
+    def _push(self, key: Any, delay: float) -> None:
         self._seq += 1
         heapq.heappush(
-            self._heap, _Scheduled(time.monotonic() + delay, self._seq, key, fn)
+            self._heap, _Scheduled(time.monotonic() + delay, self._seq, key)
         )
         self._cv.notify()
 
@@ -196,8 +213,11 @@ class WorkQueue:
                     heapq.heappush(self._heap, item)
                     continue
                 item = heapq.heappop(self._heap)
+                self._running.add(item.key)
+                fn = self._fn.get(item.key)
             try:
-                item.fn(item.key)
+                if fn is not None:
+                    fn(item.key)
             except PermanentError as e:
                 self._drop(item.key, e)
             except BaseException as e:  # noqa: BLE001 - retry loop boundary
@@ -211,7 +231,12 @@ class WorkQueue:
                     if not exhausted:
                         n = self._failures.get(item.key, 0) + 1
                         self._failures[item.key] = n
-                        self._push(item.key, item.fn, self._limiter.delay_for(n))
+                        self._running.discard(item.key)
+                        # A retry is scheduled; it looks the callback up
+                        # at run time, so a fresh fn enqueued mid-flight
+                        # (or mid-backoff) is picked up automatically.
+                        self._dirty.discard(item.key)
+                        self._push(item.key, self._limiter.delay_for(n))
                 if exhausted:
                     logger.warning(
                         "%s: retry budget (%.1fs) exhausted for %r",
@@ -227,13 +252,26 @@ class WorkQueue:
                 with self._cv:
                     self._failures.pop(item.key, None)
                     self._first_failure.pop(item.key, None)
-                    self._pending.discard(item.key)
+                    self._running.discard(item.key)
+                    self._retire_or_requeue_locked(item.key)
+
+    def _retire_or_requeue_locked(self, key: Any) -> None:
+        """Re-push a dirty key, else retire it from pending. Caller holds
+        the lock."""
+        if key in self._dirty and not self._shutdown:
+            self._dirty.discard(key)
+            self._push(key, delay=0.0)  # key stays in _pending
+        else:
+            self._dirty.discard(key)
+            self._pending.discard(key)
+            self._fn.pop(key, None)
 
     def _drop(self, key: Any, err: BaseException) -> None:
         with self._cv:
             self._failures.pop(key, None)
             self._first_failure.pop(key, None)
-            self._pending.discard(key)
+            self._running.discard(key)
+            self._retire_or_requeue_locked(key)
         if self._on_drop:
             self._on_drop(key, err)
         else:

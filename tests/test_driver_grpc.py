@@ -87,6 +87,79 @@ class TestResourceSlices:
         s = kube.list("resource.k8s.io", "v1", "resourceslices")[0]
         assert s["spec"]["pool"]["generation"] == 2
 
+    def test_split_mode_without_partitions_publishes_complete_pool(
+        self, tmp_root, kube
+    ):
+        # Default gates (no DynamicSubSlice/Passthrough): split mode has
+        # no partition devices, so exactly ONE slice must be published
+        # and resourceSliceCount must say 1 -- schedulers ignore pools
+        # whose slice count doesn't match what's visible.
+        kube.version = {"major": "1", "minor": "35"}
+        d = Driver(
+            Config.mock(root=os.path.join(tmp_root, "np"), topology="v5e-4",
+                        gates=""),
+            kube, node_name="node-c", enable_health_monitor=False,
+        )
+        assert d.publication_mode == "split"
+        d.publish_resources()
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        assert len(slices) == 1
+        assert slices[0]["spec"]["pool"]["resourceSliceCount"] == 1
+
+    def test_split_slice_counts_and_shared_generation(self, tmp_root, kube):
+        kube.version = {"major": "1", "minor": "35"}
+        d = Driver(
+            Config.mock(root=os.path.join(tmp_root, "sg"), topology="v5e-4"),
+            kube, node_name="node-b", enable_health_monitor=False,
+        )
+        d.publish_resources()
+        d.publish_resources()
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        assert len(slices) == 2
+        assert all(s["spec"]["pool"]["resourceSliceCount"] == 2
+                   for s in slices)
+        gens = {s["spec"]["pool"]["generation"] for s in slices}
+        assert len(gens) == 1  # one shared pool generation per publish
+
+    def test_mode_transition_deletes_stale_combined_slice(
+        self, tmp_root, kube
+    ):
+        root = os.path.join(tmp_root, "tr")
+        d1 = Driver(
+            Config.mock(root=root, topology="v5e-4"),
+            kube, node_name="node-b", enable_health_monitor=False,
+            publication_mode="combined",
+        )
+        d1.publish_resources()
+        d1.publish_resources()  # combined slice reaches generation 2
+        d2 = Driver(
+            Config.mock(root=root, topology="v5e-4"),
+            kube, node_name="node-b", enable_health_monitor=False,
+            publication_mode="split",
+        )
+        d2.publish_resources()
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        names = {s["metadata"]["name"] for s in slices}
+        assert len(slices) == 2
+        assert all("chips" in n or "partitions" in n for n in names)
+        # The new slices outrank the deleted combined slice's generation.
+        assert all(s["spec"]["pool"]["generation"] == 3 for s in slices)
+
+    def test_legacy_mode_publishes_whole_chips_only(self, tmp_root, kube):
+        d = Driver(
+            Config.mock(root=os.path.join(tmp_root, "lg"), topology="v5e-4"),
+            kube, node_name="node-d", enable_health_monitor=False,
+            publication_mode="legacy",
+        )
+        d.publish_resources()
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        assert len(slices) == 1
+        spec = slices[0]["spec"]
+        assert "sharedCounters" not in spec
+        names = [dev["name"] for dev in spec["devices"]]
+        assert names and all(n.startswith("chip-") for n in names)
+        assert all("consumesCounters" not in dev for dev in spec["devices"])
+
 
 class TestPrepareFlow:
     def test_prepare_via_api_lookup(self, driver, kube):

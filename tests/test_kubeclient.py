@@ -24,6 +24,8 @@ class ApiServerStub(ThreadingHTTPServer):
         self.store = {}
         self.watch_events: list[dict] = []
         self.watch_connections = 0
+        self.gone_on_rv = False  # reply 410 to watches with resourceVersion
+        self.gone_replies = 0
         self.requests: list[tuple[str, str, str]] = []  # method, path, auth
         stub = self
 
@@ -44,6 +46,10 @@ class ApiServerStub(ThreadingHTTPServer):
                 )
                 if "watch=true" in self.path:
                     stub.watch_connections += 1
+                    if stub.gone_on_rv and "resourceVersion=" in self.path:
+                        stub.gone_replies += 1
+                        self._reply(410, {"message": "Expired: too old"})
+                        return
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Transfer-Encoding", "chunked")
@@ -161,3 +167,28 @@ class TestKubeClientWatch:
         assert stub.watch_connections >= 2
         watch_paths = [p for m, p, _ in stub.requests if "watch=true" in p]
         assert any("resourceVersion=1" in p for p in watch_paths)
+
+    def test_watch_410_resets_resource_version(self, stub):
+        # An HTTP-level 410 Gone at watch establishment (expired
+        # resourceVersion after a long disconnect) must reset the
+        # bookmark instead of redialing with the stale version forever.
+        stub.watch_events = [
+            {"type": "ADDED", "object": {
+                "metadata": {"name": "x", "resourceVersion": "1"}}},
+        ]
+        stub.gone_on_rv = True
+        client = KubeClient(host=stub.url)
+        stop = threading.Event()
+        client.watch(
+            "resource.tpu.dra", "v1beta1", "computedomains",
+            lambda t, o: None, stop=stop, reconnect_delay=0.05,
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and stub.watch_connections < 3:
+            time.sleep(0.05)
+        stop.set()
+        assert stub.gone_replies >= 1
+        # After the 410 the client redialed WITHOUT a resourceVersion.
+        watch_paths = [p for m, p, _ in stub.requests if "watch=true" in p]
+        post_gone = [p for p in watch_paths[1:] if "resourceVersion=" not in p]
+        assert post_gone

@@ -207,18 +207,83 @@ class TestWorkQueue:
         assert done == ["timeout"]
         guard.__exit__(None, None, None)
 
-    def test_dedupe_while_queued(self):
-        q = WorkQueue(limiter=RateLimiter(base_delay=0.2, max_delay=0.2))
-        ran = []
+    def test_enqueue_while_running_marks_dirty_and_reruns(self):
+        # k8s workqueue semantics: an event arriving while the same key
+        # is mid-reconcile re-runs the callback after it returns, rather
+        # than being silently dropped until the periodic resync.
+        q = WorkQueue()
+        started = threading.Event()
         block = threading.Event()
+        ran = []
 
         def slow(key):
+            ran.append("slow")
+            started.set()
             block.wait(2.0)
-            ran.append(key)
 
         q.enqueue("k", slow)
-        time.sleep(0.05)
-        q.enqueue("k", slow)  # deduped: still pending
+        assert started.wait(2.0)
+        q.enqueue("k", lambda k: ran.append("fresh"))  # arrives mid-flight
+        block.set()
+        assert q.wait_idle(5.0)
+        assert ran == ["slow", "fresh"]
+        q.shutdown()
+
+    def test_enqueue_during_retry_backoff_swaps_in_fresh_fn(self):
+        # A key waiting out a retry backoff is queued, not running; an
+        # enqueue in that window must not be silently dropped -- the
+        # scheduled retry runs the freshest callback.
+        q = WorkQueue(limiter=RateLimiter(base_delay=0.3, max_delay=0.3))
+        ran = []
+        failed = threading.Event()
+
+        def failing(key):
+            failed.set()
+            raise RuntimeError("transient")
+
+        q.enqueue("k", failing)
+        assert failed.wait(2.0)
+        time.sleep(0.05)  # let the worker schedule the backoff retry
+        q.enqueue("k", lambda k: ran.append("fresh"))
+        assert q.wait_idle(5.0)
+        assert ran == ["fresh"]
+        q.shutdown()
+
+    def test_dirty_key_reruns_after_permanent_drop(self):
+        q = WorkQueue(on_drop=lambda k, e: None)
+        started = threading.Event()
+        block = threading.Event()
+        ran = []
+
+        def fatal(key):
+            started.set()
+            block.wait(2.0)
+            raise PermanentError("boom")
+
+        q.enqueue("k", fatal)
+        assert started.wait(2.0)
+        q.enqueue("k", lambda k: ran.append("fresh"))
+        block.set()
+        assert q.wait_idle(5.0)
+        assert ran == ["fresh"]
+        q.shutdown()
+
+    def test_dedupe_while_queued(self):
+        # Single worker: occupy it with "blocker" so "k" stays *queued*
+        # (not running); duplicate enqueues for a queued key collapse.
+        q = WorkQueue()
+        ran = []
+        started = threading.Event()
+        block = threading.Event()
+
+        def blocker(key):
+            started.set()
+            block.wait(2.0)
+
+        q.enqueue("blocker", blocker)
+        assert started.wait(2.0)
+        q.enqueue("k", lambda k: ran.append(k))
+        q.enqueue("k", lambda k: ran.append(k))  # deduped: still queued
         block.set()
         assert q.wait_idle(5.0)
         assert ran == ["k"]

@@ -38,10 +38,11 @@ class TestSegmentTimer:
 
 
 class TestSliceUtil:
-    def _slice(self, name, gen=1):
+    def _slice(self, name, gen=1, driver="tpu.dra.dev", node="n"):
         return {
             "metadata": {"name": name},
-            "spec": {"pool": {"name": "n", "generation": gen,
+            "spec": {"driver": driver, "nodeName": node,
+                     "pool": {"name": node, "generation": gen,
                               "resourceSliceCount": 1},
                      "devices": []},
         }
@@ -52,6 +53,28 @@ class TestSliceUtil:
         publish_resource_slices(kube, [self._slice("s1")])
         obj = kube.get("resource.k8s.io", "v1", "resourceslices", "s1")
         assert obj["spec"]["pool"]["generation"] == 2
+
+    def test_one_shared_generation_and_stale_deletion(self):
+        kube = FakeKubeClient()
+        publish_resource_slices(kube, [self._slice("s1")])
+        publish_resource_slices(kube, [self._slice("s1")])
+        # New desired set {s2, s3}: both get generation 3 (> s1's 2) and
+        # the stale s1 is deleted so it can't shadow the pool.
+        publish_resource_slices(kube, [self._slice("s2"), self._slice("s3")])
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        assert {s["metadata"]["name"] for s in slices} == {"s2", "s3"}
+        assert all(s["spec"]["pool"]["generation"] == 3 for s in slices)
+
+    def test_other_driver_and_node_pools_untouched(self):
+        kube = FakeKubeClient()
+        publish_resource_slices(kube, [self._slice("other", driver="cd.dra")])
+        publish_resource_slices(kube, [self._slice("peer", node="n2")])
+        publish_resource_slices(kube, [self._slice("mine")])
+        names = {s["metadata"]["name"]
+                 for s in kube.list("resource.k8s.io", "v1", "resourceslices")}
+        assert names == {"other", "peer", "mine"}
+        mine = kube.get("resource.k8s.io", "v1", "resourceslices", "mine")
+        assert mine["spec"]["pool"]["generation"] == 1
 
 
 class TestSimpleHTTPEndpoint:

@@ -153,8 +153,34 @@ class TestLeaderElection:
                           lease_duration=0.05)
         assert a.try_acquire_or_renew()
         import time
+        # The first observation only starts b's local expiry clock
+        # (client-go measures expiry from locally observed transitions).
+        assert not b.try_acquire_or_renew()
         time.sleep(0.1)
         assert b.try_acquire_or_renew()
+
+    def test_clock_skew_does_not_allow_seizure(self):
+        # A live leader whose wall clock differs from the challenger's
+        # must keep the lease: expiry is judged by locally observed
+        # renewTime *transitions*, never by remote-vs-local wall time.
+        import time
+        kube = FakeKubeClient()
+        a = LeaderElector(kube, "lease1", "ns", "pod-a",
+                          lease_duration=0.08)
+        b = LeaderElector(kube, "lease1", "ns", "pod-b",
+                          lease_duration=0.08)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        # Simulate a leader with a skewed clock: renewTime is ancient,
+        # but the record keeps changing (active renewals).
+        for i in range(3):
+            time.sleep(0.04)
+            lease = kube.get("coordination.k8s.io", "v1", "leases",
+                             "lease1", namespace="ns")
+            lease["spec"]["renewTime"] = f"1999-01-01T00:00:0{i}.000000Z"
+            kube.update("coordination.k8s.io", "v1", "leases", "lease1",
+                        lease, namespace="ns")
+            assert not b.try_acquire_or_renew()
 
     def test_run_calls_lead_and_releases(self):
         kube = FakeKubeClient()
