@@ -22,9 +22,10 @@ import os
 import signal
 import sys
 import threading
+import time
 
 from ...pkg.kubeclient import FakeKubeClient, KubeClient
-from .. import DOMAIN_DAEMON_PORT, daemon_dns_name
+from .. import API_GROUP, API_VERSION, DOMAIN_DAEMON_PORT, daemon_dns_name
 from .clique import CliqueRegistrar
 from .dnsnames import dns_name_mappings, update_hosts_file
 from .process import ProcessManager
@@ -32,7 +33,13 @@ from .rendezvous import query
 
 logger = logging.getLogger(__name__)
 
-POLL_INTERVAL_S = 2.0
+# Peer updates arrive via the registrar object's watch (informer); the
+# resync interval is only the fallback cadence covering watch gaps
+# (reference: informer-driven, cdclique.go, + periodic resync). The
+# liveness interval bounds how fast a dead coordination child flips the
+# daemon NotReady -- child death produces no watch event.
+RESYNC_INTERVAL_S = 15.0
+LIVENESS_INTERVAL_S = 2.0
 
 
 class DaemonConfig:
@@ -114,7 +121,28 @@ class Daemon:
             "--port", str(config.port),
         ], env=child_env)
         self._stop = threading.Event()
+        self._kick = threading.Event()
         self._last_members: list[dict] | None = None
+        # Watch-driven peer propagation: an informer over the registrar's
+        # backing resource kicks the sync loop the moment a peer
+        # registers/flips status, instead of a fixed-cadence poll.
+        from ...pkg.informer import Informer  # noqa: PLC0415
+
+        if config.use_cliques:
+            self._informer = Informer(
+                self.kube, API_GROUP, API_VERSION, "computedomaincliques",
+                kind="ComputeDomainClique",
+                namespace=config.driver_namespace,
+                resync_period=RESYNC_INTERVAL_S,
+            )
+        else:
+            self._informer = Informer(
+                self.kube, API_GROUP, API_VERSION, "computedomains",
+                kind="ComputeDomain",
+                namespace=config.cd_namespace,
+                resync_period=RESYNC_INTERVAL_S,
+            )
+        self._informer.add_change_hook(self._kick.set)
 
     # -- membership/bootstrap files --------------------------------------------
 
@@ -210,14 +238,31 @@ class Daemon:
 
         self.process.ensure_started()
         self.process.start_watchdog()
+        self._informer.start()
 
-        signal.signal(signal.SIGTERM, lambda *a: self._stop.set())
-        signal.signal(signal.SIGINT, lambda *a: self._stop.set())
+        def terminate(*_):
+            self._stop.set()
+            self._kick.set()  # unblock the wait immediately
+
+        signal.signal(signal.SIGTERM, terminate)
+        signal.signal(signal.SIGINT, terminate)
 
         ready_reported = False
-        while not self._stop.wait(POLL_INTERVAL_S):
+        last_sync = 0.0
+        while not self._stop.is_set():
+            # Wake on watch events. The short timeout only drives the
+            # child-liveness Ready/NotReady flips (no informer event
+            # fires when the local child dies); membership syncs happen
+            # on kicks plus a RESYNC_INTERVAL_S fallback relist.
+            kicked = self._kick.wait(LIVENESS_INTERVAL_S)
+            self._kick.clear()
+            if self._stop.is_set():
+                break
+            now = time.monotonic()
             try:
-                self.sync_once()
+                if kicked or now - last_sync >= RESYNC_INTERVAL_S:
+                    last_sync = now
+                    self.sync_once()
                 if self.process.alive() and not ready_reported:
                     self.registrar.set_status("Ready")
                     ready_reported = True
@@ -227,6 +272,8 @@ class Daemon:
                     ready_reported = False
             except Exception:  # noqa: BLE001 - daemon must survive
                 logger.exception("sync failed")
+                last_sync = 0.0  # retry the sync on the next liveness tick
+        self._informer.stop()
         self.registrar.deregister()
         self.process.stop()
         return 0

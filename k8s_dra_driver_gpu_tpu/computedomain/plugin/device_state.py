@@ -65,6 +65,7 @@ class CDDeviceState:
         cdi_root: str | None = None,
         driver_namespace: str = "tpu-dra-driver",
         boot_id: str | None = None,
+        use_informer: bool = True,
     ):
         os.makedirs(root, exist_ok=True)
         self.root = root
@@ -75,6 +76,24 @@ class CDDeviceState:
         self._lock = threading.Lock()
         self._checkpoint = CheckpointManager(root, boot_id=boot_id)
         self._cdi = CDIHandler(cdi_root=cdi_root or os.path.join(root, "cdi"))
+        # ComputeDomains are read through an informer cache: Prepare sits
+        # in a retry loop for up to 45s, and a full list() per attempt
+        # hammers the API server at scale (reference uses informers,
+        # computedomain.go:118-127). The cache is uid-indexed, O(1) per
+        # lookup; a periodic relist reconciles watch gaps.
+        self._cd_informer = None
+        if use_informer:
+            from ...pkg.informer import Informer  # noqa: PLC0415
+
+            self._cd_informer = Informer(
+                kube, API_GROUP, API_VERSION, "computedomains",
+                kind="ComputeDomain",
+            ).start()
+
+    def stop(self) -> None:
+        """Stop background machinery (the CD informer's watch/resync)."""
+        if self._cd_informer is not None:
+            self._cd_informer.stop()
 
     # -- allocatable devices ----------------------------------------------------
 
@@ -155,9 +174,15 @@ class CDDeviceState:
         raise PermanentError("compute-domain claim carries no opaque config")
 
     def _get_cd(self, domain_id: str) -> dict:
-        for cd in self.kube.list(API_GROUP, API_VERSION, "computedomains"):
-            if cd["metadata"].get("uid") == domain_id:
+        if self._cd_informer is not None:
+            cd = self._cd_informer.get_by_uid(domain_id)
+            if cd is not None:
                 return cd
+        else:
+            for cd in self.kube.list(API_GROUP, API_VERSION,
+                                     "computedomains"):
+                if cd["metadata"].get("uid") == domain_id:
+                    return cd
         raise RetryableError(f"ComputeDomain {domain_id} not found (yet)")
 
     def _prepare_channel(

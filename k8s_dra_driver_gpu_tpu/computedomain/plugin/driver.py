@@ -66,6 +66,7 @@ class CDDriver:
     def stop_background(self) -> None:
         if self._gc_stop is not None:
             self._gc_stop.set()
+        self.state.stop()
 
     def _fetch_claim(self, ref) -> ResourceClaim:
         uid = getattr(ref, "uid", None) or ref.get("uid")

@@ -39,7 +39,8 @@ class CheckpointCleanupManager:
 
     def stop(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=2.0)
+        if self._thread.ident is not None:  # join only a started thread
+            self._thread.join(timeout=2.0)
 
     def cleanup_once(self) -> list[str]:
         """Returns the claim UIDs unprepared this pass."""

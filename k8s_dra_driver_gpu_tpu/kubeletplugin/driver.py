@@ -65,9 +65,25 @@ class Driver:
         self.cleanup = CheckpointCleanupManager(self.state, kube_client)
         self.health_monitor = None
         if enable_health_monitor:
+            # The startup enumeration is the health baseline: a chip seen
+            # here whose devfs entry later vanishes is chip_lost, and its
+            # sysfs AER counters are polled (device_health.go:215-328
+            # analog). Mock mode ignores expected_chips and uses injected
+            # events only.
+            import dataclasses  # noqa: PLC0415
+
+            baseline = sorted(
+                d.chip.chip.index
+                for d in self.state.allocatable.values()
+                if d.kind == DeviceKind.CHIP
+            )
+            monitor_opts = dataclasses.replace(
+                config.tpulib_opts,
+                expected_chips=",".join(str(i) for i in baseline),
+            )
             self.health_monitor = ChipHealthMonitor(
                 self.state._tpulib,
-                config.tpulib_opts,
+                monitor_opts,
                 self._on_health_taints,
                 additional_ignored=additional_ignored_health_kinds,
             )

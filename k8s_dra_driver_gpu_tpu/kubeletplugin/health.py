@@ -97,7 +97,8 @@ class ChipHealthMonitor:
 
     def stop(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=self._interval + 1)
+        if self._thread.ident is not None:  # join only a started thread
+            self._thread.join(timeout=self._interval + 1)
 
     def poll_once(self) -> list[DeviceTaint]:
         events = self._tpulib.health(self._opts)

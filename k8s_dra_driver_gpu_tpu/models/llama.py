@@ -139,8 +139,13 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
-           positions: jax.Array) -> jax.Array:
-    """One transformer block: [B, S, D] -> [B, S, D]."""
+           positions: jax.Array, attn_fn=None) -> jax.Array:
+    """One transformer block: [B, S, D] -> [B, S, D].
+
+    ``attn_fn(q, k, v)`` overrides the attention core -- the seam the
+    sequence-parallel trainer uses to swap in ring/Ulysses attention
+    (which communicate over the sp axis inside shard_map).
+    """
     p = layer_params
     dt = cfg.dtype
     B, S, _ = x.shape
@@ -153,7 +158,10 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
     v = (a @ p["wv"].astype(dt)).reshape(B, S, kv, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    if attn_fn is not None:
+        attn = attn_fn(q, k, v)
+    else:
+        attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
     attn = attn.reshape(B, S, h * hd)
     x = x + attn @ p["wo"].astype(dt)
 
@@ -165,16 +173,23 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
     return x
 
 
-def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    """Token ids [B, S] -> logits [B, S, V] (fp32 logits)."""
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+            attn_fn=None, positions: jax.Array | None = None) -> jax.Array:
+    """Token ids [B, S] -> logits [B, S, V] (fp32 logits).
+
+    ``positions`` overrides the rope positions ([1, S] or [B, S]) -- a
+    sequence-parallel caller passes each shard's GLOBAL offsets so rope
+    stays consistent across the sp ring.
+    """
     # Sharding comes from the in_shardings on params/tokens; XLA propagates
     # (dp,fsdp)-batch x tp-heads layouts through the whole graph.
     x = params["embed"].astype(cfg.dtype)[tokens]
-    positions = jnp.arange(tokens.shape[1])[None, :]
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])[None, :]
 
     # Scan over stacked layers; remat the body so long sequences fit HBM.
     body = jax.checkpoint(
-        lambda carry, lp: (_layer(cfg, carry, lp, positions), None)
+        lambda carry, lp: (_layer(cfg, carry, lp, positions, attn_fn), None)
     )
     x, _ = jax.lax.scan(body, x, params["layers"])
 

@@ -42,9 +42,15 @@ def _chunk_attention(q, k, v, q_offset, k_offset, causal):
         k_pos = k_offset + jnp.arange(Sk)[None, :]
         mask = q_pos >= k_pos  # [Sq, Sk]
         s = jnp.where(mask[None, None, None], s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)  # [B,K,g,Sq,1]
-    # Fully masked rows: keep exp() finite.
-    p = jnp.exp(s - jax.lax.stop_gradient(jnp.maximum(m, NEG_INF / 2)))
+    # The m/l stats are scaling factors that cancel exactly in the final
+    # o/l ratio, so they carry NO gradient -- stop_gradient them fully.
+    # (Stopping m only inside exp(s - m) while _merge differentiates its
+    # alphas through the raw m leaves a spurious non-canceling term that
+    # corrupts dq/dk.)
+    m = jax.lax.stop_gradient(
+        jnp.maximum(jnp.max(s, axis=-1, keepdims=True), NEG_INF / 2)
+    )  # [B,K,g,Sq,1]; the maximum() keeps exp() finite on masked rows
+    p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     o = jnp.einsum("bkgqs,bskh->bkgqh", p, v.astype(jnp.float32))
     # -> [B, Sq, H, ...]

@@ -1,0 +1,178 @@
+"""List+watch cache with a uid index -- the client-go informer analog.
+
+Reference: the CD kubelet plugin and controller consume CRs through
+informers with local caches (cmd/compute-domain-kubelet-plugin/
+computedomain.go:118-137, cmd/compute-domain-daemon/cdclique.go) instead
+of re-listing per operation. This is the same shape over the in-tree
+KubeClient: an initial list primes the cache, a streamed watch applies
+incremental updates, and a periodic relist reconciles anything a watch
+gap missed (required: the watch does not replay events lost across a
+410, see KubeClient.watch).
+
+Works against both clients:
+- KubeClient: real `?watch=true` stream + timer-driven relist.
+- FakeKubeClient: its global watch hook; events for other resources are
+  filtered by `kind`, and each matching event triggers a relist (the
+  fake store is tiny, and relisting sidesteps incremental bookkeeping
+  differences between patch/update notification shapes).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+
+class Informer:
+    def __init__(
+        self,
+        kube,
+        group: str,
+        version: str,
+        resource: str,
+        kind: str,
+        namespace: str | None = None,
+        resync_period: float = 30.0,
+    ):
+        self.kube = kube
+        self.group = group
+        self.version = version
+        self.resource = resource
+        self.kind = kind
+        self.namespace = namespace
+        self.resync_period = resync_period
+        self._lock = threading.Lock()
+        self._cache: dict[tuple[str, str], dict] = {}  # (ns, name) -> obj
+        self._by_uid: dict[str, tuple[str, str]] = {}
+        self._hooks: list[Callable[[], None]] = []
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+        self._started = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "Informer":
+        if self._started:
+            return self
+        self._started = True
+        try:
+            self.relist()
+        except Exception:  # noqa: BLE001 - transient API failure at boot
+            # Tolerated: the watch + resync loop converge once the API
+            # server answers; consumers see an empty cache until then
+            # (RetryableError semantics), never a crashed constructor.
+            logger.exception("initial informer list failed; will resync")
+        if hasattr(self.kube, "add_watcher"):  # FakeKubeClient
+            self.kube.add_watcher(self._on_fake_event)
+        else:
+            self.kube.watch(
+                self.group, self.version, self.resource,
+                self._on_watch_event,
+                namespace=self.namespace, stop=self._stop,
+            )
+            t = threading.Thread(
+                target=self._resync_loop,
+                name=f"informer-resync-{self.resource}", daemon=True,
+            )
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # -- event plumbing -------------------------------------------------------
+
+    def add_change_hook(self, fn: Callable[[], None]) -> None:
+        """fn() fires after any cache change (coalesced, no payload --
+        consumers re-read the cache, informer-handler style)."""
+        self._hooks.append(fn)
+
+    def _fire(self) -> None:
+        for fn in list(self._hooks):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - consumer bug must not kill us
+                logger.exception("informer change hook failed")
+
+    def _key(self, obj: dict) -> tuple[str, str]:
+        md = obj.get("metadata", {})
+        return (md.get("namespace", ""), md.get("name", ""))
+
+    def _on_watch_event(self, ev_type: str, obj: dict) -> None:
+        changed = False
+        with self._lock:
+            key = self._key(obj)
+            uid = obj.get("metadata", {}).get("uid", "")
+            if ev_type == "DELETED":
+                changed = self._cache.pop(key, None) is not None
+                if uid:
+                    self._by_uid.pop(uid, None)
+            else:
+                old = self._cache.get(key)
+                changed = old != obj
+                self._cache[key] = obj
+                if uid:
+                    self._by_uid[uid] = key
+        if changed:
+            self._fire()
+
+    def _on_fake_event(self, ev_type: str, obj: dict) -> None:
+        if self._stop.is_set():
+            return  # FakeKubeClient has no watcher-removal path
+        # Objects in the fake store usually carry their kind; ones that
+        # don't (bare test fixtures) relist conservatively.
+        if obj.get("kind") not in (self.kind, None):
+            return
+        self.relist()
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync_period):
+            try:
+                self.relist()
+            except Exception:  # noqa: BLE001 - transient API failures
+                logger.exception("informer relist failed")
+
+    def relist(self) -> None:
+        items = self.kube.list(
+            self.group, self.version, self.resource,
+            namespace=self.namespace,
+        )
+        with self._lock:
+            old = self._cache
+            self._cache = {self._key(o): o for o in items}
+            self._by_uid = {
+                o["metadata"]["uid"]: self._key(o)
+                for o in items
+                if o.get("metadata", {}).get("uid")
+            }
+            changed = old != self._cache
+        self._synced.set()
+        if changed:
+            self._fire()
+
+    # -- cache reads ----------------------------------------------------------
+
+    def get_by_uid(self, uid: str) -> dict | None:
+        with self._lock:
+            key = self._by_uid.get(uid)
+            obj = self._cache.get(key) if key else None
+            # A delete+recreate under the same (ns, name) during a watch
+            # gap leaves the old uid pointing at the new object until the
+            # next resync -- never serve an object whose uid differs.
+            if obj is not None and obj.get("metadata", {}).get("uid") != uid:
+                return None
+            return obj
+
+    def get(self, name: str, namespace: str = "") -> dict | None:
+        with self._lock:
+            return self._cache.get((namespace, name))
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return list(self._cache.values())

@@ -90,6 +90,9 @@ class EnumerateOptions:
     dev_root: str | None = None
     sys_root: str | None = None
     health_events: str | None = None
+    # Comma-separated chip indices from the startup enumeration: the
+    # baseline for devfs health (enumeration-diff chip_lost + AER poll).
+    expected_chips: str | None = None
 
     @classmethod
     def from_env(cls) -> "EnumerateOptions":
@@ -112,6 +115,8 @@ class EnumerateOptions:
             parts.append(f"sys_root={self.sys_root}")
         if self.health_events:
             parts.append(f"health_events={self.health_events}")
+        if self.expected_chips:
+            parts.append(f"expected_chips={self.expected_chips}")
         return ";".join(parts)
 
 
@@ -234,7 +239,31 @@ _SHAPES_2D = {1: (1, 1, 1), 2: (1, 2, 1), 4: (2, 2, 1), 8: (2, 4, 1),
               16: (4, 4, 1), 32: (4, 8, 1), 64: (8, 8, 1), 128: (8, 16, 1),
               256: (16, 16, 1)}
 
-_FATAL_KINDS = {"hbm_uncorrectable", "chip_lost", "ici_link_down"}
+_FATAL_KINDS = {"hbm_uncorrectable", "chip_lost", "ici_link_down",
+                "pcie_aer_fatal"}
+
+
+def _read_aer_count(path: str) -> int:
+    """Sum of counts in a sysfs AER attribute ("<errname> <count>" per
+    line); a TOTAL_ERR_* line is authoritative. -1 = attribute absent."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return -1
+    # Token-pair stream parse, matching the native backend's
+    # `f >> name >> count` loop (stops at the first non-numeric count).
+    tokens = text.split()
+    total = 0
+    for i in range(0, len(tokens) - 1, 2):
+        try:
+            count = int(tokens[i + 1])
+        except ValueError:
+            break
+        if tokens[i].startswith("TOTAL"):
+            return count
+        total += count
+    return total
 
 
 def _atoi(s: str) -> int:
@@ -463,6 +492,24 @@ class PyTpuLib:
             events.append(
                 HealthEvent(chip=chip, kind=kind, fatal=kind in _FATAL_KINDS)
             )
+        # Real-host sources (devfs mode only; see tpuinfo.cc): baseline
+        # enumeration-diff -> chip_lost, plus PCIe AER counters.
+        if opts.expected_chips and not opts.mock_topology:
+            dev_root = opts.dev_root or "/dev"
+            sys_root = opts.sys_root or "/sys"
+            for tok in filter(None, opts.expected_chips.split(",")):
+                idx = _atoi(tok)
+                if not os.path.exists(f"{dev_root}/accel{idx}"):
+                    events.append(
+                        HealthEvent(chip=idx, kind="chip_lost", fatal=True))
+                    continue
+                sysdev = f"{sys_root}/class/accel/accel{idx}/device"
+                if _read_aer_count(f"{sysdev}/aer_dev_fatal") > 0:
+                    events.append(HealthEvent(
+                        chip=idx, kind="pcie_aer_fatal", fatal=True))
+                if _read_aer_count(f"{sysdev}/aer_dev_nonfatal") > 0:
+                    events.append(HealthEvent(
+                        chip=idx, kind="pcie_aer_nonfatal", fatal=False))
         return tuple(events)
 
 

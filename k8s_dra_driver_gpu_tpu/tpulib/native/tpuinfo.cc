@@ -12,6 +12,7 @@
 #include "tpuinfo.h"
 
 #include <dirent.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -477,15 +478,48 @@ char* tpuinfo_subslice_profiles(const char* opts) {
   return j.release();
 }
 
+namespace {
+
+bool IsFatalKind(const std::string& kind) {
+  return kind == "hbm_uncorrectable" || kind == "chip_lost" ||
+         kind == "ici_link_down" || kind == "pcie_aer_fatal";
+}
+
+void EmitEvent(Json& j, bool& first, int chip, const std::string& kind) {
+  if (!first) j.raw(",");
+  first = false;
+  j.raw("{").str("chip").raw(":").num(chip).raw(",")
+      .str("kind").raw(":").str(kind).raw(",")
+      .str("fatal").raw(":").boolean(IsFatalKind(kind)).raw("}");
+}
+
+// Sum of error counts in a sysfs AER attribute ("<errname> <count>" per
+// line). A TOTAL_ERR_* line, when present, is authoritative (summing the
+// per-kind lines too would double-count).
+long long ReadAerCount(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) return -1;  // attribute absent: source not available
+  long long sum = 0;
+  std::string name;
+  long long count;
+  while (f >> name >> count) {
+    if (name.rfind("TOTAL", 0) == 0) return count;
+    sum += count;
+  }
+  return sum;
+}
+
+}  // namespace
+
 char* tpuinfo_health(const char* opts) {
   auto o = ParseOpts(opts);
   Json j;
   j.raw("{").str("events").raw(":[");
+  bool first = true;
   std::string events = Opt(o, "health_events");
   if (!events.empty()) {
     std::stringstream ss(events);
     std::string item;
-    bool first = true;
     while (std::getline(ss, item, '|')) {
       if (item.empty()) continue;
       int chip = -1;
@@ -499,19 +533,39 @@ char* tpuinfo_health(const char* opts) {
         if (k == "chip") chip = std::atoi(v.c_str());
         if (k == "kind") kind = v;
       }
-      if (!first) j.raw(",");
-      first = false;
-      bool fatal = kind == "hbm_uncorrectable" || kind == "chip_lost" ||
-                   kind == "ici_link_down";
-      j.raw("{").str("chip").raw(":").num(chip).raw(",")
-          .str("kind").raw(":").str(kind).raw(",")
-          .str("fatal").raw(":").boolean(fatal).raw("}");
+      EmitEvent(j, first, chip, kind);
     }
   }
-  // Real-host path: no standardized health sysfs exists for TPU accel
-  // devices today; health beyond enumeration presence is reported by the
-  // runtime (libtpu) inside workloads. The node plugin treats missing
-  // devfs entries as chip_lost at enumeration time instead.
+  // Real-host sources (devfs mode only: the caller supplies the chip
+  // baseline from its startup enumeration via expected_chips). TPU accel
+  // devices expose no NVML-style event fd, so health is:
+  //   1. enumeration diff -- a baseline chip whose /dev/accelN vanished
+  //      is chip_lost (the GPU-lost analog, device_health.go:281-328);
+  //   2. PCIe AER counters from the chip's sysfs device node --
+  //      aer_dev_fatal / aer_dev_nonfatal (the XID analog).
+  std::string expected = Opt(o, "expected_chips");
+  if (!expected.empty() && o.count("mock_topology") == 0) {
+    const std::string dev_root = Opt(o, "dev_root", "/dev");
+    const std::string sys_root = Opt(o, "sys_root", "/sys");
+    std::stringstream es(expected);
+    std::string tok;
+    while (std::getline(es, tok, ',')) {
+      if (tok.empty()) continue;
+      int idx = std::atoi(tok.c_str());
+      std::string devpath = dev_root + "/accel" + std::to_string(idx);
+      struct stat st;
+      if (stat(devpath.c_str(), &st) != 0) {
+        EmitEvent(j, first, idx, "chip_lost");
+        continue;
+      }
+      std::string sysdev =
+          sys_root + "/class/accel/accel" + std::to_string(idx) + "/device";
+      long long fatal = ReadAerCount(sysdev + "/aer_dev_fatal");
+      if (fatal > 0) EmitEvent(j, first, idx, "pcie_aer_fatal");
+      long long nonfatal = ReadAerCount(sysdev + "/aer_dev_nonfatal");
+      if (nonfatal > 0) EmitEvent(j, first, idx, "pcie_aer_nonfatal");
+    }
+  }
   j.raw("]}");
   return j.release();
 }

@@ -1,0 +1,112 @@
+"""Sequence-parallel (long-context) Llama training step.
+
+TPU-first manual-SPMD: the whole train step runs inside shard_map over a
+(dp, sp) mesh. The sequence dimension is sharded over "sp"; attention is
+ring attention (K/V chunks rotating over ICI neighbors via ppermute) or
+Ulysses (two all-to-alls re-sharding seq<->heads), both from
+``parallel/``. Everything else (norms, MLPs, rope with GLOBAL position
+offsets) is local to the shard; gradients are pmean-ed over (dp, sp), so
+the update is identical on every device and parameters stay replicated.
+
+This is the analog of the reference's long-context surface (SURVEY §5:
+the reference has none in-tree; its workloads bring their own). The
+graft gate (dryrun_multichip) runs one step of this on the virtual mesh
+so a regression in the sp sharding contract fails the driver check.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from ..parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+from ..parallel.ring_attention import ring_attention
+from ..parallel.ulysses import ulysses_attention
+from .train import TrainState, make_optimizer
+
+ATTN_IMPLS = {
+    "ring": ring_attention,
+    "ulysses": ulysses_attention,
+}
+
+
+def make_sp_train(
+    mesh: Mesh,
+    cfg: llama.LlamaConfig,
+    attn: str = "ring",
+    optimizer: optax.GradientTransformation | None = None,
+    dp_axis: str = DATA_AXIS,
+    sp_axis: str = SEQUENCE_AXIS,
+):
+    """Returns (init_fn, step_fn, batch_sharding, place_params).
+
+    Tokens are [B, n_sp * S_local + 1] (the +1 supplies the next-token
+    target for the last local position of the final shard): sharded over
+    ``dp_axis`` on batch, replicated over ``sp_axis`` -- each device
+    slices its own sequence chunk by axis index, so no host-side seq
+    splitting is needed. Parameters are replicated; sp communication
+    happens inside the attention core only.
+    """
+    if attn not in ATTN_IMPLS:
+        raise ValueError(f"attn must be one of {sorted(ATTN_IMPLS)}")
+    attn_core = partial(ATTN_IMPLS[attn], axis_name=sp_axis, causal=True)
+    optimizer = optimizer or make_optimizer()
+    n_sp = mesh.shape[sp_axis]
+
+    token_spec = P(dp_axis, None)
+    batch_shard = NamedSharding(mesh, token_spec)
+    repl = NamedSharding(mesh, P())
+
+    def local_loss(params, tokens):
+        """Loss of the local (batch-shard, seq-shard) block."""
+        sp_i = jax.lax.axis_index(sp_axis)
+        s_local = (tokens.shape[1] - 1) // n_sp
+        inputs = jax.lax.dynamic_slice_in_dim(
+            tokens, sp_i * s_local, s_local, axis=1)
+        targets = jax.lax.dynamic_slice_in_dim(
+            tokens, sp_i * s_local + 1, s_local, axis=1)
+        positions = sp_i * s_local + jnp.arange(s_local)[None, :]
+        logits = llama.forward(
+            params, inputs, cfg, attn_fn=attn_core, positions=positions)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets)
+        return losses.mean()
+
+    def local_step(state: TrainState, tokens):
+        loss, grads = jax.value_and_grad(local_loss)(state.params, tokens)
+        # Equal shard sizes: the mean of local grads IS the grad of the
+        # global mean loss. After pmean the update is device-invariant.
+        grads = jax.lax.pmean(grads, (dp_axis, sp_axis))
+        loss = jax.lax.pmean(loss, (dp_axis, sp_axis))
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    @jax.jit
+    def init_fn(params):
+        return TrainState(
+            params=params,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state, tokens):
+        return jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), token_spec),
+            out_specs=(P(), P()),
+            check_vma=False,  # replicated-update invariance argued above
+        )(state, tokens)
+
+    def place_params(params):
+        return jax.device_put(params, repl)
+
+    return init_fn, step_fn, batch_shard, place_params

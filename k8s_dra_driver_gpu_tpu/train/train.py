@@ -48,11 +48,18 @@ def train_step(state: TrainState, tokens, *, cfg, optimizer):
     return TrainState(params, opt_state, state.step + 1), loss
 
 
-def make_sharded_train(mesh: Mesh, cfg: llama.LlamaConfig, optimizer=None):
+def make_sharded_train(mesh: Mesh, cfg: llama.LlamaConfig, optimizer=None,
+                       batch_axes: tuple[str, ...] | None = None):
     """Returns (init_fn, step_fn, batch_sharding) jitted over ``mesh``.
 
     init_fn(params_on_host) -> TrainState placed/sharded on the mesh.
     step_fn(state, tokens) -> (state, loss), donated input state.
+
+    ``batch_axes`` overrides the mesh axes the batch dim shards over --
+    a multislice mesh passes ("dcn", "dp", "fsdp") so pure gradient data
+    parallelism (and only it) crosses the data-center network while
+    params stay replicated across slices; XLA then inserts the
+    cross-slice gradient all-reduce on DCN and everything else on ICI.
     """
     optimizer = optimizer or make_optimizer()
     specs = llama.param_specs(cfg)
@@ -60,7 +67,9 @@ def make_sharded_train(mesh: Mesh, cfg: llama.LlamaConfig, optimizer=None):
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P),
     )
-    batch_shard = NamedSharding(mesh, llama.batch_spec())
+    batch_spec = (P(batch_axes, None) if batch_axes is not None
+                  else llama.batch_spec())
+    batch_shard = NamedSharding(mesh, batch_spec)
 
     @partial(jax.jit, in_shardings=(param_shard,))
     def init_fn(params):

@@ -192,6 +192,49 @@ class TestPrepareFlow:
 
 
 class TestHealthTaints:
+    def test_real_devfs_chip_lost_taints_and_republish(
+        self, tmp_path, kube
+    ):
+        # End-to-end real-source path: enumerate a devfs tree, then make
+        # a chip's devfs entry vanish -- the monitor (primed with the
+        # startup baseline) must emit chip_lost and the republished
+        # slice must carry the NoExecute taint.
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import EnumerateOptions
+
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        sys_root = tmp_path / "sys"
+        for i in range(4):
+            (dev / f"accel{i}").touch()
+            (sys_root / "class" / "accel" / f"accel{i}"
+             / "device").mkdir(parents=True)
+        from k8s_dra_driver_gpu_tpu.pkg.featuregates import FeatureGates
+
+        cfg = Config(
+            root=str(tmp_path / "state"),
+            tpulib_opts=EnumerateOptions(
+                dev_root=str(dev), sys_root=str(sys_root)),
+            feature_gates=FeatureGates(),
+            cdi_root=str(tmp_path / "cdi"),
+        )
+        d = Driver(cfg, kube, node_name="node-a",
+                   enable_health_monitor=True)
+        assert d.health_monitor._opts.expected_chips == "0,1,2,3"
+        d.publish_resources()
+        assert d.health_monitor.poll_once() == []
+
+        (dev / "accel1").unlink()
+        taints = d.health_monitor.poll_once()
+        d._on_health_taints(taints)
+        s = kube.list("resource.k8s.io", "v1", "resourceslices")[0]
+        chip1 = next(dev_ for dev_ in s["spec"]["devices"]
+                     if dev_["name"] == "chip-1")
+        assert chip1["taints"] == [{
+            "key": "tpu.dra.dev/chip_lost", "value": "true",
+            "effect": "NoExecute",
+        }]
+        d.stop()
+
     def test_taints_republish(self, tmp_root, kube):
         from k8s_dra_driver_gpu_tpu.tpulib.binding import EnumerateOptions
 

@@ -1,0 +1,119 @@
+"""Informer cache tests: list+watch priming, uid index, change hooks,
+and the CD plugin's cache-backed _get_cd.
+"""
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.computedomain import API_GROUP, API_VERSION
+from k8s_dra_driver_gpu_tpu.pkg.informer import Informer
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+
+
+def make_cd(kube, name, uid=None, namespace="default"):
+    return kube.create(API_GROUP, API_VERSION, "computedomains", {
+        "apiVersion": f"{API_GROUP}/{API_VERSION}",
+        "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": namespace,
+                     **({"uid": uid} if uid else {})},
+        "spec": {"numNodes": 2},
+    }, namespace=namespace)
+
+
+class TestInformer:
+    def test_primes_and_indexes_by_uid(self):
+        kube = FakeKubeClient()
+        cd = make_cd(kube, "cd1", uid="u-cd1")
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()
+        assert inf.wait_for_sync(5.0)
+        assert inf.get_by_uid("u-cd1")["metadata"]["name"] == "cd1"
+        assert inf.get("cd1", "default")["metadata"]["uid"] == "u-cd1"
+        assert len(inf.list()) == 1
+        del cd
+
+    def test_tracks_creates_updates_deletes(self):
+        kube = FakeKubeClient()
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()
+        changes = []
+        inf.add_change_hook(lambda: changes.append(1))
+        make_cd(kube, "cd1", uid="u1")
+        assert inf.get_by_uid("u1") is not None
+        kube.patch(API_GROUP, API_VERSION, "computedomains", "cd1",
+                   {"status": {"status": "Ready"}}, namespace="default")
+        assert inf.get_by_uid("u1")["status"]["status"] == "Ready"
+        kube.delete(API_GROUP, API_VERSION, "computedomains", "cd1",
+                    namespace="default")
+        assert inf.get_by_uid("u1") is None
+        assert changes  # hooks fired on changes
+
+    def test_uid_mismatch_after_recreate_not_served(self):
+        # Delete+recreate under the same (ns, name) during a watch gap:
+        # the stale uid must never resolve to the new object.
+        kube = FakeKubeClient()
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()
+        make_cd(kube, "cd1", uid="u-old")
+        # Simulate the gap: poison the uid index as a missed DELETE would.
+        with inf._lock:
+            inf._by_uid["u-old"] = ("default", "cd1")
+            inf._cache[("default", "cd1")]["metadata"]["uid"] = "u-new"
+        assert inf.get_by_uid("u-old") is None
+
+    def test_stopped_informer_ignores_fake_events(self):
+        kube = FakeKubeClient()
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()
+        inf.stop()
+        make_cd(kube, "cd1", uid="u1")
+        assert inf.get_by_uid("u1") is None  # no relist after stop
+
+    def test_start_survives_initial_list_failure(self):
+        class FlakyKube(FakeKubeClient):
+            def __init__(self):
+                super().__init__()
+                self.fail_next_list = True
+
+            def list(self, *a, **kw):
+                if self.fail_next_list:
+                    self.fail_next_list = False
+                    raise RuntimeError("apiserver unreachable")
+                return super().list(*a, **kw)
+
+        kube = FlakyKube()
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()  # must not raise
+        make_cd(kube, "cd1", uid="u1")  # event-driven relist recovers
+        assert inf.get_by_uid("u1") is not None
+
+    def test_ignores_other_kinds(self):
+        kube = FakeKubeClient()
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()
+        kube.create(API_GROUP, API_VERSION, "computedomaincliques", {
+            "apiVersion": f"{API_GROUP}/{API_VERSION}",
+            "kind": "ComputeDomainClique",
+            "metadata": {"name": "u1.0", "namespace": "ns"},
+            "status": {"daemons": []},
+        }, namespace="ns")
+        assert inf.list() == []
+
+
+class TestCDPluginInformerPath:
+    def test_get_cd_via_cache_and_retryable_miss(self, tmp_root):
+        from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state import (
+            CDDeviceState,
+            RetryableError,
+        )
+
+        kube = FakeKubeClient()
+        state = CDDeviceState(tmp_root, kube, node_name="n1",
+                              use_informer=True)
+        with pytest.raises(RetryableError):
+            state._get_cd("u-missing")
+        make_cd(kube, "cd1", uid="u-cd1")
+        assert state._get_cd("u-cd1")["metadata"]["name"] == "cd1"
+        kube.delete(API_GROUP, API_VERSION, "computedomains", "cd1",
+                    namespace="default")
+        with pytest.raises(RetryableError):
+            state._get_cd("u-cd1")

@@ -151,6 +151,28 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gradients_match_reference_8way(self, causal):
+        # Regression: the m/l softmax stats must be fully stop-gradiented;
+        # differentiating _merge's alphas through a raw m corrupted dq/dk
+        # while leaving the forward (and dv) exact.
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=8))
+        q, k, v = rand_qkv(jax.random.PRNGKey(5), B=1, S=64, H=4, K=2)
+        w = jax.random.normal(jax.random.PRNGKey(6), q.shape)
+        fn, place = make_ring_attention(mesh, "sp", causal=causal)
+
+        g_ring = jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v) * w), argnums=(0, 1, 2)
+        )(place(q), place(k), place(v))
+        g_ref = jax.grad(
+            lambda q, k, v: jnp.sum(
+                dot_product_attention(q, k, v, causal=causal) * w),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
     def test_long_sequence_sharded(self):
         # Each device sees only S/8 of the sequence.
         mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=8))

@@ -1,0 +1,74 @@
+"""Sequence-parallel trainer correctness: one sp_train step over the
+virtual 8-device mesh must match the single-device training step (same
+loss, same updated params) for both ring and Ulysses attention cores.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from k8s_dra_driver_gpu_tpu.models import llama
+from k8s_dra_driver_gpu_tpu.parallel.mesh import MeshPlan, build_mesh
+from k8s_dra_driver_gpu_tpu.train.sp_train import make_sp_train
+from k8s_dra_driver_gpu_tpu.train.train import TrainState, loss_fn
+
+
+def tiny_tokens(key, B=2, S=32):
+    cfg = llama.LlamaConfig.tiny()
+    return jax.random.randint(key, (B, S + 1), 0, cfg.vocab_size, jnp.int32)
+
+
+def single_device_step(params, tokens, cfg, lr=0.1):
+    """Baseline: full-sequence loss + plain SGD update on one device."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+    params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return params, loss
+
+
+class TestSpTrain:
+    @pytest.mark.parametrize("attn,sp", [("ring", 8), ("ring", 4),
+                                         ("ulysses", 2)])
+    def test_matches_single_device(self, attn, sp):
+        cfg = llama.LlamaConfig.tiny()
+        dp = 8 // sp
+        mesh = build_mesh(MeshPlan(dp=dp, sp=sp))
+        lr = 0.1
+        init_fn, step_fn, batch_shard, place = make_sp_train(
+            mesh, cfg, attn=attn, optimizer=optax.sgd(lr))
+
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        tokens = tiny_tokens(jax.random.PRNGKey(1), B=dp * 2, S=sp * 8)
+
+        state = init_fn(place(params))
+        state, loss = step_fn(state, jax.device_put(tokens, batch_shard))
+
+        ref_params, ref_loss = single_device_step(params, tokens, cfg, lr=lr)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=2e-4, atol=2e-4)
+        for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                        jax.tree_util.tree_leaves(ref_params)):
+            # bf16 forward: dp-split reduction order perturbs grads at
+            # the ~1e-3 level; anything structural shows up far larger.
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=1.5e-3)
+
+    def test_step_counter_and_replication(self):
+        cfg = llama.LlamaConfig.tiny()
+        mesh = build_mesh(MeshPlan(dp=2, sp=4))
+        init_fn, step_fn, batch_shard, place = make_sp_train(
+            mesh, cfg, optimizer=optax.sgd(0.1))
+        state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
+        tokens = jax.device_put(
+            tiny_tokens(jax.random.PRNGKey(1), B=4, S=32), batch_shard)
+        state, _ = step_fn(state, tokens)
+        state, loss = step_fn(state, tokens)
+        assert int(state.step) == 2
+        assert jnp.isfinite(loss)
+
+    def test_rejects_unknown_attn(self):
+        cfg = llama.LlamaConfig.tiny()
+        mesh = build_mesh(MeshPlan(dp=2, sp=4))
+        with pytest.raises(ValueError, match="attn"):
+            make_sp_train(mesh, cfg, attn="flash")

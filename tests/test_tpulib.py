@@ -150,6 +150,54 @@ class TestHealth:
     def test_no_events(self, lib):
         assert lib.health(EnumerateOptions()) == ()
 
+    def _real_tree(self, tmp_path, chips=4):
+        dev = tmp_path / "dev"
+        dev.mkdir(exist_ok=True)
+        sys = tmp_path / "sys"
+        for i in range(chips):
+            (dev / f"accel{i}").touch()
+            d = sys / "class" / "accel" / f"accel{i}" / "device"
+            d.mkdir(parents=True, exist_ok=True)
+        return dev, sys
+
+    def test_devfs_healthy_baseline_no_events(self, lib, tmp_path):
+        dev, sys = self._real_tree(tmp_path)
+        evs = lib.health(EnumerateOptions(
+            dev_root=str(dev), sys_root=str(sys), expected_chips="0,1,2,3"))
+        assert evs == ()
+
+    def test_devfs_enumeration_diff_chip_lost(self, lib, tmp_path):
+        # The GPU-lost analog (device_health.go:281-328): a baseline chip
+        # whose devfs entry vanished is fatal chip_lost.
+        dev, sys = self._real_tree(tmp_path)
+        (dev / "accel2").unlink()
+        evs = lib.health(EnumerateOptions(
+            dev_root=str(dev), sys_root=str(sys), expected_chips="0,1,2,3"))
+        assert [(e.chip, e.kind, e.fatal) for e in evs] == [
+            (2, "chip_lost", True)]
+
+    def test_devfs_aer_counters(self, lib, tmp_path):
+        dev, sys = self._real_tree(tmp_path)
+        base = sys / "class" / "accel"
+        (base / "accel1" / "device" / "aer_dev_fatal").write_text(
+            "Undefined 0\nTOTAL_ERR_FATAL 2\n")
+        (base / "accel3" / "device" / "aer_dev_nonfatal").write_text(
+            "RxErr 1\nBadTLP 0\n")
+        evs = lib.health(EnumerateOptions(
+            dev_root=str(dev), sys_root=str(sys), expected_chips="0,1,2,3"))
+        assert [(e.chip, e.kind, e.fatal) for e in evs] == [
+            (1, "pcie_aer_fatal", True),
+            (3, "pcie_aer_nonfatal", False),
+        ]
+
+    def test_mock_mode_ignores_expected_chips(self, lib, tmp_path):
+        # Mock mode must not consult devfs: no /dev/accel* exists on a
+        # dev box, and that must not read as every chip lost.
+        evs = lib.health(EnumerateOptions(
+            mock_topology="v5e-4", dev_root=str(tmp_path),
+            expected_chips="0,1,2,3"))
+        assert evs == ()
+
 
 @pytest.mark.skipif(not NATIVE_AVAILABLE, reason="libtpuinfo.so not built")
 class TestBackendParity:
@@ -197,6 +245,22 @@ class TestBackendParity:
         ]:
             opts = EnumerateOptions(health_events=events)
             assert native.health(opts) == py.health(opts), events
+
+    def test_devfs_health_parity(self, tmp_path):
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        sys = tmp_path / "sys"
+        for i in [0, 1, 3]:  # accel2 lost
+            (dev / f"accel{i}").touch()
+            d = sys / "class" / "accel" / f"accel{i}" / "device"
+            d.mkdir(parents=True)
+        (sys / "class" / "accel" / "accel1" / "device"
+         / "aer_dev_fatal").write_text("BadTLP 1\nRxErr 2\n")
+        opts = EnumerateOptions(dev_root=str(dev), sys_root=str(sys),
+                                expected_chips="0,1,2,3")
+        native, py = NativeTpuLib(), PyTpuLib()
+        assert native.health(opts) == py.health(opts)
+        assert any(e.kind == "chip_lost" for e in py.health(opts))
 
     def test_devfs_junk_entries_parity(self, tmp_path):
         dev = tmp_path / "dev"
