@@ -10,7 +10,19 @@ the reference's O(1s) dynamic-partition envelope (MIG create/destroy
 "may take O(1 s)", nvlib.go:1136-1141): values >1 mean faster.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N,
+   "extras": {...}}
+
+extras carries the secondary metrics:
+  - stress_p50_ms / stress_p99_ms: prepare+unprepare latency under
+    concurrent claim churn (4 workers x 25 iters against ONE DeviceState,
+    contending the node-global flock -- the regime where the reference
+    hits its 10s lock timeouts, nvlib.go:1136-1141).
+  - model_step_ms / tokens_per_s / mfu_est / chip: single-chip training
+    step on REAL TPU hardware (absent when no TPU is attached). Each
+    timed step consumes distinct token batches so the tunnel's
+    identical-execution elision (docs/benchmarks.md) cannot skip work;
+    mfu_est = 6*N*tokens / step_time / peak_flops(chip).
 """
 
 import json
@@ -24,6 +36,22 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REFERENCE_ENVELOPE_MS = 1000.0  # reference MIG create/destroy O(1s)
 ITERS = 50
+# One worker per chip: the DRA scheduler never double-allocates a
+# device, so workers churn DISJOINT claims; contention is on the node
+# flock + checkpoint, the path the reference's stress suite hammers.
+STRESS_WORKERS = 4
+STRESS_ITERS = 25
+
+# Dense bf16 peak FLOP/s per chip by generation (public spec sheets).
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5": 459e12,  # v5p
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6lite": 918e12,
+}
 
 
 def bench_claim_prepare() -> float:
@@ -49,29 +77,165 @@ def bench_claim_prepare() -> float:
     return statistics.median(samples)
 
 
-def bench_enumerate() -> float:
-    """Fallback until the DeviceState pipeline lands: p50 ms of a full
-    tpulib enumerate + sub-slice profile scan."""
-    from k8s_dra_driver_gpu_tpu.tpulib.binding import EnumerateOptions, load
+def bench_claim_churn() -> dict:
+    """Concurrent churn: workers hammering ONE DeviceState with
+    disjoint single-chip claims (prepare+unprepare loops). The node
+    flock + state lock serialize them -- this measures the latency a
+    claim sees while the node is busy with other claims."""
+    import concurrent.futures
 
-    lib = load()
-    opts = EnumerateOptions(mock_topology="v5e-4")
-    samples = []
-    for _ in range(ITERS):
+    from tests.fake_kube import make_claim
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+        DeviceState, Config,
+    )
+
+    with tempfile.TemporaryDirectory() as root:
+        state = DeviceState(Config.mock(root=root, topology="v5e-4"))
+        samples: list[float] = []
+
+        def worker(wid: int) -> list[float]:
+            chip = f"chip-{wid % 4}"
+            out = []
+            for i in range(STRESS_ITERS):
+                claim = make_claim(uid=f"w{wid}-{i}", devices=[chip])
+                t0 = time.perf_counter()
+                state.prepare(claim)
+                state.unprepare(claim.uid)
+                out.append((time.perf_counter() - t0) * 1000)
+            return out
+
+        with concurrent.futures.ThreadPoolExecutor(STRESS_WORKERS) as ex:
+            for result in ex.map(worker, range(STRESS_WORKERS)):
+                samples.extend(result)
+    samples.sort()
+    return {
+        "stress_p50_ms": round(samples[len(samples) // 2], 3),
+        "stress_p99_ms": round(samples[int(len(samples) * 0.99) - 1], 3),
+    }
+
+
+def bench_model_step() -> dict | None:
+    """Single-chip training-step perf on real TPU; None off-hardware."""
+    try:
+        import jax
+        import jax.numpy as jnp
+    except ImportError:
+        return None
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError:
+        return None
+    if dev.platform != "tpu":
+        return None
+
+    from functools import partial
+
+    from k8s_dra_driver_gpu_tpu.models import llama
+    from k8s_dra_driver_gpu_tpu.train.train import (
+        make_optimizer,
+        train_step,
+        TrainState,
+    )
+
+    B, S = 8, 1024
+    cfg = llama.LlamaConfig(
+        vocab_size=32_768, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=8, d_ff=4096,
+    )
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    optimizer = make_optimizer()
+    state = TrainState(params=params, opt_state=optimizer.init(params),
+                       step=jnp.zeros((), jnp.int32))
+    step = jax.jit(partial(train_step, cfg=cfg, optimizer=optimizer),
+                   donate_argnums=(0,))
+    # Distinct batches, materialized up front: the timed loop must do
+    # real per-step work (the tunnel elides repeated identical execs).
+    n_steps = 5
+    batches = [
+        jax.device_put(jax.random.randint(
+            jax.random.PRNGKey(100 + i), (B, S + 1), 0, cfg.vocab_size,
+            jnp.int32,
+        ))
+        for i in range(n_steps + 2)
+    ]
+    jax.block_until_ready(batches)
+    state, loss = step(state, batches[-1])  # compile + warm
+    jax.block_until_ready(loss)
+
+    kind = dev.device_kind.lower().replace("tpu", "").replace(" ", "")
+    peak = next((v for k, v in PEAK_FLOPS.items() if kind.startswith(k)),
+                197e12)
+    flops = 6.0 * n_params * B * S  # fwd+bwd dense-matmul estimate
+
+    def timed(sync_each: bool) -> float:
+        nonlocal state
         t0 = time.perf_counter()
-        lib.enumerate(opts)
-        lib.subslice_profiles(opts)
-        samples.append((time.perf_counter() - t0) * 1000)
-    return statistics.median(samples)
+        for i in range(n_steps):
+            state, loss = step(state, batches[i])
+            if sync_each:
+                float(loss)  # device round-trip forces real completion
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0) / n_steps
+
+    dt = timed(sync_each=False)
+    synced = False
+    if flops / dt / peak > 0.9:
+        # Physically impossible: the tunnel elided the async chain.
+        # Re-measure with a per-step scalar fetch (pessimistic by one
+        # round-trip per step, but real; docs/benchmarks.md caveat).
+        # One synced step first drains the elided burst's backlog, then
+        # the median per-step time is taken.
+        state, loss = step(state, batches[n_steps + 1])
+        float(loss)
+        durations = []
+        for i in range(n_steps):
+            t0 = time.perf_counter()
+            state, loss = step(state, batches[i])
+            float(loss)
+            durations.append(time.perf_counter() - t0)
+        dt = statistics.median(durations)
+        synced = True
+    return {
+        "model_step_ms": round(dt * 1000, 2),
+        "tokens_per_s": round(B * S / dt),
+        "mfu_est": round(flops / dt / peak, 4),
+        "chip": dev.device_kind,
+        "model_params_m": round(n_params / 1e6, 1),
+        "synced_per_step": synced,
+    }
 
 
 def main() -> None:
+    extras: dict = {}
     try:
         p50 = bench_claim_prepare()
         metric = "dra_claim_prepare_p50"
     except ImportError:
-        p50 = bench_enumerate()
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+            EnumerateOptions, load,
+        )
+
+        lib = load()
+        opts = EnumerateOptions(mock_topology="v5e-4")
+        samples = []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            lib.enumerate(opts)
+            lib.subslice_profiles(opts)
+            samples.append((time.perf_counter() - t0) * 1000)
+        p50 = statistics.median(samples)
         metric = "tpulib_enumerate_p50"
+    try:
+        extras.update(bench_claim_churn())
+    except Exception:  # noqa: BLE001 - secondary metric must not kill bench
+        pass
+    try:
+        model = bench_model_step()
+        if model:
+            extras.update(model)
+    except Exception:  # noqa: BLE001 - secondary metric must not kill bench
+        pass
     print(
         json.dumps(
             {
@@ -79,6 +243,7 @@ def main() -> None:
                 "value": round(p50, 3),
                 "unit": "ms",
                 "vs_baseline": round(REFERENCE_ENVELOPE_MS / max(p50, 1e-9), 2),
+                "extras": extras,
             }
         )
     )

@@ -23,7 +23,7 @@ from .controller import ComputeDomainController
 logger = logging.getLogger(__name__)
 
 
-def run(argv: list[str] | None = None) -> int:
+def build_parser() -> argparse.ArgumentParser:
     env = os.environ.get
     p = argparse.ArgumentParser(prog="compute-domain-controller")
     p.add_argument("--namespace", default=env("DRIVER_NAMESPACE",
@@ -39,7 +39,11 @@ def run(argv: list[str] | None = None) -> int:
     p.add_argument("--lease-name", default="tpu-dra-cd-controller")
     p.add_argument("--identity", default=env("POD_NAME", os.uname().nodename))
     p.add_argument("--standalone", action="store_true")
-    args = p.parse_args(argv)
+    return p
+
+
+def run(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",

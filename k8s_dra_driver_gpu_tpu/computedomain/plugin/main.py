@@ -25,7 +25,7 @@ from .driver import CDDriver
 logger = logging.getLogger(__name__)
 
 
-def run(argv: list[str] | None = None) -> int:
+def build_parser() -> argparse.ArgumentParser:
     env = os.environ.get
     p = argparse.ArgumentParser(prog="compute-domain-kubelet-plugin")
     p.add_argument("--node-name", default=env("NODE_NAME", ""))
@@ -49,7 +49,11 @@ def run(argv: list[str] | None = None) -> int:
                    default=int(env("HEALTHCHECK_PORT", "0")))
     p.add_argument("--standalone", action="store_true")
     p.add_argument("--version", action="version", version=__version__)
-    args = p.parse_args(argv)
+    return p
+
+
+def run(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",

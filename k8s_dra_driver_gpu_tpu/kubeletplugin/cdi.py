@@ -37,6 +37,10 @@ class ContainerEdits:
     # (hostPath, containerPath, read_only). Library mounts are ro; shared
     # rendezvous dirs (tenancy) must stay writable.
     mounts: list[tuple[str, str, bool]] = field(default_factory=list)
+    # OCI hooks the runtime executes on the host (nvidia-cdi-hook analog,
+    # gpu main.go:293): (hookName, path, args). The tenancy preflight
+    # rides a createContainer hook so a DENIED admission fails the start.
+    hooks: list[tuple[str, str, list[str]]] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         out: dict = {}
@@ -54,6 +58,15 @@ class ContainerEdits:
                 }
                 for h, c, ro in self.mounts
             ]
+        if self.hooks:
+            # timeout: the runtime kills a hung hook (wedged agent) so a
+            # pod never sits in ContainerCreating forever; for
+            # createContainer that reads as fail-closed.
+            out["hooks"] = [
+                {"hookName": name, "path": path, "args": args,
+                 "timeout": 10}
+                for name, path, args in self.hooks
+            ]
         return out
 
     def merge(self, other: "ContainerEdits") -> "ContainerEdits":
@@ -61,6 +74,7 @@ class ContainerEdits:
             env=self.env + other.env,
             device_nodes=self.device_nodes + other.device_nodes,
             mounts=self.mounts + other.mounts,
+            hooks=self.hooks + other.hooks,
         )
 
 

@@ -72,6 +72,10 @@ class Config:
     feature_gates: FeatureGates = field(default_factory=FeatureGates)
     cdi_root: str | None = None
     boot_id: str | None = None
+    # Run supervised per-claim tenancy agents (MPS-control-daemon analog).
+    # Production default; mock configs default it off so unit tests don't
+    # pay a child-process spawn per tenancy Prepare.
+    tenancy_agents: bool = True
 
     @classmethod
     def mock(
@@ -81,6 +85,7 @@ class Config:
         worker_id: int = 0,
         gates: str = "DynamicSubSlice=true,TimeSlicingSettings=true,"
         "MultiTenancySupport=true",
+        tenancy_agents: bool = False,
     ) -> "Config":
         return cls(
             root=root,
@@ -89,6 +94,7 @@ class Config:
             ),
             feature_gates=FeatureGates.parse(gates),
             cdi_root=os.path.join(root, "cdi"),
+            tenancy_agents=tenancy_agents,
         )
 
 
@@ -160,7 +166,11 @@ class DeviceState:
             cdi_root=config.cdi_root or os.path.join(config.root, "cdi")
         )
         self._timeslicing = TimeSlicingManager(config.root)
-        self._tenancy = MultiTenancyManager(config.root)
+        self._tenancy = MultiTenancyManager(
+            config.root,
+            hbm_capacity_bytes=self.host.hbm_bytes_per_chip,
+            spawn_agents=config.tenancy_agents,
+        )
 
         if self._checkpoint.invalidated_on_boot:
             # A reboot destroyed all device state: the claim records are
@@ -169,6 +179,16 @@ class DeviceState:
             # carve-outs) must go with them or holder entries leak.
             self._cleanup_all_side_state()
         self.destroy_unknown_subslices()
+        # Re-own tenancy state for claims that survived the restart
+        # (respawn their enforcement agents; drop orphan dirs).
+        self._tenancy.reconcile({
+            uid for uid, c in self._checkpoint.get().claims.items()
+            if c.state == ClaimState.PREPARE_COMPLETED.value
+        })
+
+    def stop(self) -> None:
+        """Stop background machinery (supervised tenancy agents)."""
+        self._tenancy.shutdown()
 
     # -- enumeration ----------------------------------------------------------
 

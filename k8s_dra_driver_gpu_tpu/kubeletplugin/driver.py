@@ -114,6 +114,9 @@ class Driver:
         self.cleanup.stop()
         if self.health_monitor:
             self.health_monitor.stop()
+        # Tenancy agents die with the plugin; prepared claims re-own
+        # their dirs (and respawn agents) on the next start.
+        self.state.stop()
 
     def _server_supports_split(self) -> bool:
         try:

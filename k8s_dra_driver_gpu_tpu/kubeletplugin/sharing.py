@@ -20,11 +20,17 @@ So:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
+import sys
+import time
 
 from ..api.configs import MultiTenancyConfig, TimeSlicingConfig
+from ..pkg.fsutil import write_json_atomic
 from .cdi import ContainerEdits
+
+logger = logging.getLogger(__name__)
 
 # Interval name -> microseconds budget per tenant timeslice.
 _INTERVALS_US = {
@@ -96,12 +102,36 @@ class TimeSlicingManager:
         return self._load(chip_index)
 
 
-class MultiTenancyManager:
-    """Per-claim co-tenancy rendezvous (MpsManager/MpsControlDaemon
-    analog, sharing.go:214-379)."""
+class TenancyAgentError(RuntimeError):
+    """The per-claim tenancy agent failed to become ready."""
 
-    def __init__(self, tenancy_root: str):
+
+class MultiTenancyManager:
+    """Per-claim co-tenancy enforcement (MpsManager/MpsControlDaemon
+    analog, sharing.go:214-379).
+
+    With ``spawn_agents`` on (the production default), each tenancy
+    request gets a supervised agent process that OWNS the rendezvous dir
+    and admits tenants against the claim's max-client / HBM budgets
+    (tenancy_agent.py); Prepare blocks until the agent answers READY
+    (AssertReady analog, sharing.go:322), and the claim's CDI spec
+    injects a createContainer preflight hook so a DENIED admission fails
+    the container start (tenancy_preflight.py). With it off (unit-test
+    mode), only the env/mount contract is emitted.
+    """
+
+    def __init__(
+        self,
+        tenancy_root: str,
+        hbm_capacity_bytes: int | None = None,
+        spawn_agents: bool = False,
+        ready_timeout: float = 10.0,
+    ):
         self._root = os.path.join(tenancy_root, "tenancy")
+        self._capacity = hbm_capacity_bytes
+        self._spawn = spawn_agents
+        self._ready_timeout = ready_timeout
+        self._agents: dict[str, "object"] = {}  # dir -> ProcessManager
         os.makedirs(self._root, exist_ok=True)
 
     def _dir(self, claim_uid: str, request: str | None = None) -> str:
@@ -116,19 +146,24 @@ class MultiTenancyManager:
         cfg: MultiTenancyConfig,
         device_names: list[str],
     ) -> ContainerEdits:
-        """Provision the per-request tenancy dir + emit workload env/mount
-        edits. One call per request group covers all its devices."""
+        """Provision the per-request tenancy dir, start+await its agent,
+        and emit workload env/mount/hook edits. One call per request
+        group covers all its devices."""
         d = self._dir(claim_uid, request)
         os.makedirs(d, exist_ok=True)
         manifest = {
             "chips": chip_indices,
             "maxClients": cfg.max_clients,
+            # PER-CHIP budget: every tenant of the group runs on every
+            # chip of the group, so its per-chip demand applies to each
+            # chip and admission must fit tenants within ONE chip's HBM
+            # (multiplying by chip count would over-admit by that factor).
+            "hbmCapacityBytes": self._capacity,
             "hbmLimits": {
                 name: cfg.hbm_limit_bytes_for(name) for name in device_names
             },
         }
-        with open(os.path.join(d, "tenancy.json"), "w", encoding="utf-8") as f:
-            json.dump(manifest, f)
+        write_json_atomic(os.path.join(d, "tenancy.json"), manifest)
         env = [
             "TPU_MULTI_TENANT=1",
             f"TPU_TENANCY_DIR=/var/run/tpu-tenancy/{claim_uid}/{request}",
@@ -138,18 +173,171 @@ class MultiTenancyManager:
         limits = [
             str(v) for v in manifest["hbmLimits"].values() if v is not None
         ]
+        tenant_hbm = min(map(int, limits)) if limits else 0
         if limits:
             # Uniform per-group limit contract; per-device granularity
             # rides the manifest mount.
-            env.append(f"TPU_HBM_LIMIT_BYTES={min(map(int, limits))}")
-        return ContainerEdits(
+            env.append(f"TPU_HBM_LIMIT_BYTES={tenant_hbm}")
+        edits = ContainerEdits(
             env=env,
             # Writable: co-tenant processes create rendezvous files here.
             mounts=[(d, f"/var/run/tpu-tenancy/{claim_uid}/{request}", False)],
         )
+        if self._spawn:
+            d = self._short_dir(d)  # keep agent.sock inside sun_path
+            self._ensure_agent(d)
+            hook_path = self._hook_binary()
+            base = [hook_path, "--dir", d]
+            # OCI hook args include argv[0]. createContainer admits the
+            # tenant (DENIED -> container start fails); poststop releases
+            # its slot so a restarted container (fresh OCI id) never
+            # leaks admissions.
+            edits.hooks.append((
+                "createContainer", hook_path,
+                base + ["--hbm-bytes", str(tenant_hbm)],
+            ))
+            edits.hooks.append(("poststop", hook_path, base + ["--release"]))
+        return edits
+
+    def _hook_binary(self) -> str:
+        """Host path of the preflight hook. The native static binary is
+        copied into <root>/bin (a hostPath the runtime can exec -- the
+        nvidia-cdi-hook copy pattern, gpu main.go:293); without it (dev
+        checkouts) fall back to a wrapper script around this python."""
+        bin_dir = os.path.join(os.path.dirname(self._root), "bin")
+        os.makedirs(bin_dir, exist_ok=True)
+        target = os.path.join(bin_dir, "tpu-tenancy-preflight")
+        native = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tpulib", "native", "tenancy_preflight",
+        )
+        if os.path.exists(native):
+            if (not os.path.exists(target)
+                    or os.path.getmtime(target) < os.path.getmtime(native)):
+                shutil.copy2(native, target + ".tmp")
+                os.replace(target + ".tmp", target)
+            return target
+        # Dev fallback: exec this interpreter with the package on path.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        script = (
+            "#!/bin/sh\n"
+            f'PYTHONPATH="{pkg_root}:$PYTHONPATH" exec "{sys.executable}" '
+            "-m k8s_dra_driver_gpu_tpu.kubeletplugin.tenancy_preflight "
+            '"$@"\n'
+        )
+        with open(target + ".tmp", "w", encoding="utf-8") as f:
+            f.write(script)
+        os.chmod(target + ".tmp", 0o755)
+        os.replace(target + ".tmp", target)
+        return target
+
+    # -- agent supervision ------------------------------------------------------
+
+    def _short_dir(self, d: str) -> str:
+        """AF_UNIX sun_path caps at ~108 bytes; a long (legal) DRA
+        request name can push <root>/tenancy/<uid>/<request>/agent.sock
+        past it. Bind/connect through a short stable symlink instead
+        (the kernel resolves it; the length limit applies only to the
+        given string)."""
+        import hashlib  # noqa: PLC0415
+
+        sdir = os.path.join(self._root, ".s")
+        os.makedirs(sdir, exist_ok=True)
+        short = os.path.join(
+            sdir, hashlib.md5(d.encode()).hexdigest()[:12])
+        if os.path.realpath(short) != os.path.realpath(d):
+            tmp = short + ".tmp"
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+            os.symlink(d, tmp)
+            os.replace(tmp, short)
+        return short
+
+    def _ensure_agent(self, d: str) -> None:
+        """Start (or reuse) the agent owning dir ``d`` and block until it
+        answers READY (AssertReady analog, sharing.go:322)."""
+        from ..computedomain.daemon.process import (  # noqa: PLC0415
+            ProcessManager,
+        )
+        from .tenancy_agent import query  # noqa: PLC0415
+
+        pm = self._agents.get(d)
+        if pm is None or not pm.alive():
+            pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            child_env = dict(os.environ)
+            child_env["PYTHONPATH"] = (
+                pkg_root + os.pathsep + child_env.get("PYTHONPATH", "")
+            ).rstrip(os.pathsep)
+            pm = ProcessManager([
+                sys.executable, "-m",
+                "k8s_dra_driver_gpu_tpu.kubeletplugin.tenancy_agent",
+                "--dir", d,
+            ], env=child_env)
+            pm.ensure_started()
+            pm.start_watchdog()
+            self._agents[d] = pm
+        deadline = time.monotonic() + self._ready_timeout
+        while time.monotonic() < deadline:
+            try:
+                if query(d, "STATUS", timeout=1.0) == "READY":
+                    return
+            except OSError:
+                pass
+            time.sleep(0.1)
+        raise TenancyAgentError(
+            f"tenancy agent for {d} not ready after {self._ready_timeout}s"
+        )
+
+    def reconcile(self, active_claim_uids: set[str]) -> None:
+        """Plugin restart: re-own the tenancy dirs of still-prepared
+        claims (respawn their agents) and drop orphans."""
+        if not os.path.isdir(self._root):
+            return
+        for uid in os.listdir(self._root):
+            if uid not in active_claim_uids:
+                shutil.rmtree(os.path.join(self._root, uid),
+                              ignore_errors=True)
+                continue
+            if self._spawn:
+                claim_dir = os.path.join(self._root, uid)
+                for request in os.listdir(claim_dir):
+                    d = os.path.join(claim_dir, request)
+                    if not os.path.isfile(os.path.join(d, "tenancy.json")):
+                        continue
+                    try:
+                        self._ensure_agent(self._short_dir(d))
+                    except TenancyAgentError:
+                        # Claim-level failure: one unrecoverable tenancy
+                        # dir must not crash-loop the whole node plugin.
+                        # The claim's own retried Prepare (or unprepare)
+                        # deals with it.
+                        logger.exception(
+                            "could not re-own tenancy agent for %s", d)
 
     def stop(self, claim_uid: str) -> None:
+        claim_dir = os.path.realpath(self._dir(claim_uid))
+        for d, pm in list(self._agents.items()):
+            real = os.path.realpath(d)  # agents are keyed by short path
+            if real.startswith(claim_dir + os.sep) or real == claim_dir:
+                pm.stop()
+                del self._agents[d]
+                if os.path.islink(d):
+                    try:
+                        os.unlink(d)
+                    except OSError:
+                        pass
         shutil.rmtree(self._dir(claim_uid), ignore_errors=True)
+
+    def shutdown(self) -> None:
+        """Stop every supervised agent (plugin shutdown; dirs stay --
+        prepared claims survive plugin restarts via reconcile())."""
+        for pm in self._agents.values():
+            pm.stop()
+        self._agents.clear()
 
     def active(self, claim_uid: str) -> bool:
         return os.path.isdir(self._dir(claim_uid))

@@ -1,0 +1,226 @@
+"""Per-claim tenancy control agent: the MPS-control-daemon analog.
+
+Reference: cmd/gpu-kubelet-plugin/sharing.go:214-379 -- the reference
+runs an actual MPS control daemon per MultiTenancy claim (Deployment,
+tmpfs shm, EXCLUSIVE_PROCESS, readiness asserted before Prepare
+returns). TPU has no MPS daemon, but the enforcement role is the same:
+a supervised per-claim agent OWNS the tenancy rendezvous dir and is the
+single admission point for co-tenants -- a tenant that would exceed the
+claim's max-client count or the chips' HBM capacity is DENIED, which
+(via the CDI-injected preflight hook, tenancy_preflight.py) fails the
+container start.
+
+Protocol (unix socket `agent.sock` inside the tenancy dir, one
+newline-terminated request per connection, mirrors rendezvous.py):
+
+  STATUS                          -> "READY"
+  REGISTER <client> <hbm_bytes>   -> "OK <granted>" | "DENIED <reason>"
+  RELEASE <client>                -> "OK released"
+  MEMBERS                         -> JSON {clients: {id: hbm}, ...}
+
+Admissions are persisted to clients.json (atomic replace) so an agent
+restart -- the plugin supervises it with the same watchdog pattern as
+the CD coordination service -- keeps enforcing prior grants.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import socket
+import socketserver
+import sys
+import threading
+
+logger = logging.getLogger(__name__)
+
+SOCKET_NAME = "agent.sock"
+MANIFEST_NAME = "tenancy.json"
+CLIENTS_NAME = "clients.json"
+# Tombstone dir: a poststop hook that cannot reach the agent (e.g. the
+# plugin was mid-restart) records the released client id here; the
+# agent applies tombstones at startup and before each admission, so a
+# lost RELEASE can never leak an admission slot permanently.
+RELEASED_DIR = "released.d"
+
+
+class TenancyState:
+    """Manifest-driven admission control with persisted grants."""
+
+    def __init__(self, tenancy_dir: str):
+        self.dir = tenancy_dir
+        self._lock = threading.Lock()
+        self.manifest: dict = {}
+        self.clients: dict[str, int] = {}
+        self.reload()
+        self._load_clients()
+        self._apply_tombstones_locked()
+
+    def reload(self) -> None:
+        with open(os.path.join(self.dir, MANIFEST_NAME),
+                  encoding="utf-8") as f:
+            self.manifest = json.load(f)
+
+    def _load_clients(self) -> None:
+        try:
+            with open(os.path.join(self.dir, CLIENTS_NAME),
+                      encoding="utf-8") as f:
+                self.clients = {
+                    k: int(v) for k, v in json.load(f).items()
+                }
+        except (OSError, ValueError):
+            self.clients = {}
+
+    def _save_clients(self) -> None:
+        from ..pkg.fsutil import write_json_atomic  # noqa: PLC0415
+
+        write_json_atomic(os.path.join(self.dir, CLIENTS_NAME), self.clients)
+
+    def _apply_tombstones_locked(self) -> None:
+        """Release clients recorded in released.d by hooks that could
+        not reach a live agent. Caller need not hold the lock at init;
+        register() calls this under its lock."""
+        rd = os.path.join(self.dir, RELEASED_DIR)
+        try:
+            names = os.listdir(rd)
+        except FileNotFoundError:
+            return
+        changed = False
+        for name in names:
+            if self.clients.pop(name, None) is not None:
+                changed = True
+            try:
+                os.unlink(os.path.join(rd, name))
+            except OSError:
+                pass
+        if changed:
+            self._save_clients()
+
+    # -- admission ------------------------------------------------------------
+
+    def register(self, client: str, hbm_bytes: int) -> tuple[bool, str]:
+        with self._lock:
+            self._apply_tombstones_locked()
+            max_clients = self.manifest.get("maxClients")
+            capacity = self.manifest.get("hbmCapacityBytes")
+            others = {k: v for k, v in self.clients.items() if k != client}
+            if max_clients is not None and len(others) + 1 > int(max_clients):
+                return False, f"max clients ({max_clients}) reached"
+            if capacity is not None and hbm_bytes + sum(others.values()) > int(
+                capacity
+            ):
+                return (
+                    False,
+                    f"HBM budget exceeded: {hbm_bytes} requested, "
+                    f"{int(capacity) - sum(others.values())} available",
+                )
+            self.clients[client] = hbm_bytes
+            self._save_clients()
+            return True, str(hbm_bytes)
+
+    def release(self, client: str) -> None:
+        with self._lock:
+            if self.clients.pop(client, None) is not None:
+                self._save_clients()
+
+    def members(self) -> dict:
+        with self._lock:
+            return {
+                "clients": dict(self.clients),
+                "maxClients": self.manifest.get("maxClients"),
+                "hbmCapacityBytes": self.manifest.get("hbmCapacityBytes"),
+            }
+
+
+def _handle_line(state: TenancyState, line: str) -> str:
+    parts = line.strip().split()
+    if not parts:
+        return "ERROR empty request"
+    cmd = parts[0].upper()
+    if cmd == "STATUS":
+        return "READY"
+    if cmd == "MEMBERS":
+        return json.dumps(state.members())
+    if cmd == "REGISTER":
+        if len(parts) < 3:
+            return "ERROR usage: REGISTER <client> <hbm_bytes>"
+        if "/" in parts[1] or parts[1] in (".", ".."):
+            return "ERROR invalid client id"
+        try:
+            hbm = int(parts[2])
+        except ValueError:
+            return "ERROR hbm_bytes must be an integer"
+        ok, detail = state.register(parts[1], hbm)
+        return f"OK {detail}" if ok else f"DENIED {detail}"
+    if cmd == "RELEASE":
+        if len(parts) < 2:
+            return "ERROR usage: RELEASE <client>"
+        state.release(parts[1])
+        return "OK released"
+    return f"ERROR unknown command {cmd}"
+
+
+def serve(tenancy_dir: str) -> int:
+    state = TenancyState(tenancy_dir)
+    sock_path = os.path.join(tenancy_dir, SOCKET_NAME)
+    try:
+        os.unlink(sock_path)
+    except FileNotFoundError:
+        pass
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            line = self.rfile.readline().decode(errors="replace")
+            self.wfile.write((_handle_line(state, line) + "\n").encode())
+
+    class Server(socketserver.ThreadingUnixStreamServer):
+        daemon_threads = True
+
+    server = Server(sock_path, Handler)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGUSR1, lambda *a: state.reload())
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    logger.info("tenancy agent serving on %s", sock_path)
+    stop.wait()
+    server.shutdown()
+    server.server_close()
+    return 0
+
+
+def query(tenancy_dir: str, request: str, timeout: float = 2.0) -> str:
+    """Client helper (plugin readiness checks + preflight hook)."""
+    sock_path = os.path.join(tenancy_dir, SOCKET_NAME)
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(timeout)
+        s.connect(sock_path)
+        s.sendall((request + "\n").encode())
+        chunks = []
+        while True:
+            b = s.recv(4096)
+            if not b:
+                break
+            chunks.append(b)
+            if b.endswith(b"\n"):
+                break
+    return b"".join(chunks).decode().strip()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-tenancy-agent")
+    p.add_argument("--dir", required=True, help="tenancy dir (owns it)")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    return serve(args.dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""Tenancy preflight: CDI createContainer hook enforcing admission.
+
+Reference: the reference's MPS enforcement happens because workloads can
+only reach the GPU through the MPS control daemon's pipe directory
+(sharing.go:379). On TPU the enforcement point is container start: the
+claim's CDI spec injects this program as a createContainer hook
+(nvidia-cdi-hook analog, gpu main.go:293); the container runtime runs it
+on the HOST with the OCI container state on stdin. It registers the
+tenant with the claim's tenancy agent -- a tenant that would exceed the
+claim's max-client count or HBM capacity gets DENIED, the hook exits
+nonzero, and the runtime refuses to start the container.
+
+Exit 0 = admitted. Exit 1 = denied or agent unreachable (fail closed:
+an unreachable agent must not admit unlimited tenants).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .tenancy_agent import query
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-tenancy-preflight")
+    p.add_argument("--dir", required=True, dest="tenancy_dir",
+                   help="host path of the claim's tenancy dir")
+    p.add_argument("--hbm-bytes", type=int, default=0,
+                   help="this tenant's per-chip HBM budget")
+    p.add_argument("--client-id", default="",
+                   help="override client identity (default: OCI state id)")
+    p.add_argument("--release", action="store_true",
+                   help="poststop: free this tenant's admission slot")
+    args = p.parse_args(argv)
+
+    client = args.client_id
+    if not client:
+        # OCI hooks receive the container state JSON on stdin.
+        try:
+            state = json.load(sys.stdin)
+            client = state.get("id", "")
+        except (ValueError, OSError):
+            client = ""
+    if not client:
+        print("tenancy-preflight: no client identity", file=sys.stderr)
+        return 0 if args.release else 1
+
+    if "/" in client or client in (".", ".."):
+        print("tenancy-preflight: invalid client id", file=sys.stderr)
+        return 0 if args.release else 1
+
+    request = (f"RELEASE {client}" if args.release
+               else f"REGISTER {client} {args.hbm_bytes}")
+    try:
+        answer = query(args.tenancy_dir, request)
+    except OSError as e:
+        print(f"tenancy-preflight: agent unreachable: {e}", file=sys.stderr)
+        if args.release:
+            # Leave a tombstone so the slot is reclaimed when the agent
+            # is back (it applies released.d before each admission).
+            from .tenancy_agent import RELEASED_DIR  # noqa: PLC0415
+
+            try:
+                rd = os.path.join(args.tenancy_dir, RELEASED_DIR)
+                os.makedirs(rd, exist_ok=True)
+                with open(os.path.join(rd, client), "w"):
+                    pass
+            except OSError:
+                pass
+            return 0  # never block container teardown
+        return 1  # fail closed on admission
+    if args.release or answer.startswith("OK"):
+        return 0
+    print(f"tenancy-preflight: {answer}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,318 @@
+"""Minimal Helm-chart renderer for the in-tree chart.
+
+Two jobs:
+1. Render-test the chart in CI without a helm binary (the reference
+   relies on `helm lint`/`helm template` in its pipelines; this repo's
+   environment has no helm, so the tests use this renderer to prove the
+   manifests parse and that every flag/env the templates set is
+   accepted by the real binaries).
+2. Poor-man's `helm template` for operators:
+       python -m k8s_dra_driver_gpu_tpu.pkg.chartrender \
+           deployments/helm/tpu-dra-driver [--set a.b=c ...]
+
+Supports exactly the template dialect the chart uses: `.Values.*` /
+`.Chart.*` lookups, `|` pipelines (quote, default X, toYaml, nindent N,
+b64enc), `if`/`with`/`end` blocks with `{{-`/`-}}` whitespace control,
+and `fail "msg"` (the validation.yaml analog of the reference chart).
+Anything else raises -- better a loud render-test failure than silently
+wrong manifests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import os
+import re
+import sys
+
+import yaml
+
+
+class ChartRenderError(ValueError):
+    pass
+
+
+class ChartValidationError(ChartRenderError):
+    """A template called fail (values rejected by validation rules)."""
+
+
+_TAG = re.compile(r"(\{\{-?.*?-?\}\})", re.DOTALL)
+
+
+def _lookup(path: str, ctx: dict):
+    """Resolve `.Values.a.b` / `.Chart.X` / `.` against the context."""
+    if path == ".":
+        return ctx["."]
+    cur = ctx
+    for seg in path.lstrip(".").split("."):
+        if isinstance(cur, dict):
+            cur = cur.get(seg)
+        else:
+            return None
+        if cur is None:
+            return None
+    return cur
+
+
+def _to_yaml(value) -> str:
+    return yaml.safe_dump(value, default_flow_style=False).rstrip("\n")
+
+
+def _eval_atom(atom: str, ctx: dict):
+    atom = atom.strip()
+    if atom.startswith('"') and atom.endswith('"'):
+        return atom[1:-1]
+    if atom.startswith("."):
+        return _lookup(atom, ctx)
+    if re.fullmatch(r"-?\d+", atom):
+        return int(atom)
+    raise ChartRenderError(f"unsupported expression atom: {atom!r}")
+
+
+_FILTER_NAMES = {"quote", "default", "toYaml", "nindent", "b64enc"}
+
+
+def _eval_expr(expr: str, ctx: dict):
+    """Evaluate a pipeline: atom | filter [arg] | ... The first stage may
+    also be function-style (`toYaml .`), normalized to `.` | toYaml."""
+    stages = [s.strip() for s in expr.split("|")]
+    head = stages[0].split(None, 1)
+    if head[0] in _FILTER_NAMES and len(head) > 1:
+        stages = [head[1], head[0]] + stages[1:]
+    value = _eval_atom(stages[0], ctx)
+    for stage in stages[1:]:
+        parts = stage.split(None, 1)
+        name, arg = parts[0], (parts[1] if len(parts) > 1 else None)
+        if name == "quote":
+            value = '"%s"' % str(value if value is not None else "")
+        elif name == "default":
+            fallback = _eval_atom(arg, ctx)
+            if value in (None, "", 0, False):
+                value = fallback
+        elif name == "toYaml":
+            value = _to_yaml(value)
+        elif name == "nindent":
+            n = int(arg)
+            pad = " " * n
+            value = "\n" + "\n".join(
+                pad + line if line else line
+                for line in str(value).split("\n")
+            )
+        elif name == "b64enc":
+            value = base64.b64encode(str(value).encode()).decode()
+        else:
+            raise ChartRenderError(f"unsupported filter: {name!r}")
+    return value
+
+
+def _truthy(value) -> bool:
+    return bool(value)
+
+
+class _Node:
+    def __init__(self, kind: str, arg: str = ""):
+        self.kind = kind  # root | text | expr | if | with
+        self.arg = arg
+        self.children: list[_Node] = []
+        self.else_children: list[_Node] = []
+        self._in_else = False
+
+    def sink(self) -> list["_Node"]:
+        return self.else_children if self._in_else else self.children
+
+
+def _parse(text: str) -> _Node:
+    """Split into text/tag tokens (with whitespace control applied) and
+    build the block tree."""
+    tokens = _TAG.split(text)
+    # Apply {{- / -}} trimming to neighboring text tokens.
+    for i, tok in enumerate(tokens):
+        if not tok.startswith("{{"):
+            continue
+        if tok.startswith("{{-") and i > 0:
+            tokens[i - 1] = tokens[i - 1].rstrip(" \t")
+            if tokens[i - 1].endswith("\n"):
+                tokens[i - 1] = tokens[i - 1][:-1]
+        if tok.endswith("-}}") and i + 1 < len(tokens):
+            tokens[i + 1] = tokens[i + 1].lstrip(" \t\n")
+
+    root = _Node("root")
+    stack = [root]
+    for tok in tokens:
+        if not tok.startswith("{{"):
+            if tok:
+                node = _Node("text", tok)
+                stack[-1].sink().append(node)
+            continue
+        body = tok.strip("{}").strip("-").strip()
+        if body.startswith("if "):
+            node = _Node("if", body[3:].strip())
+            stack[-1].sink().append(node)
+            stack.append(node)
+        elif body.startswith("with "):
+            node = _Node("with", body[5:].strip())
+            stack[-1].sink().append(node)
+            stack.append(node)
+        elif body == "else":
+            if len(stack) == 1 or stack[-1].kind != "if":
+                raise ChartRenderError("{{ else }} outside an if block")
+            stack[-1]._in_else = True
+        elif body == "end":
+            if len(stack) == 1:
+                raise ChartRenderError("unbalanced {{ end }}")
+            stack.pop()
+        elif body.startswith("/*"):
+            continue  # comment
+        else:
+            stack[-1].sink().append(_Node("expr", body))
+    if len(stack) != 1:
+        raise ChartRenderError("unclosed {{ if/with }} block")
+    return root
+
+
+def _render_node(node: _Node, ctx: dict, out: list[str]) -> None:
+    for child in node.children:
+        if child.kind == "text":
+            out.append(child.arg)
+        elif child.kind == "expr":
+            body = child.arg
+            if body.startswith("fail "):
+                raise ChartValidationError(_eval_atom(body[5:], ctx))
+            value = _eval_expr(body, ctx)
+            out.append("" if value is None else str(value))
+        elif child.kind == "if":
+            if _truthy(_eval_expr(child.arg, ctx)):
+                _render_node(child, ctx, out)
+            else:
+                branch = _Node("root")
+                branch.children = child.else_children
+                _render_node(branch, ctx, out)
+        elif child.kind == "with":
+            value = _eval_expr(child.arg, ctx)
+            if _truthy(value):
+                sub = dict(ctx)
+                sub["."] = value
+                _render_node(child, sub, out)
+
+
+def render_template(text: str, ctx: dict) -> str:
+    out: list[str] = []
+    _render_node(_parse(text), ctx, out)
+    return "".join(out)
+
+
+def _deep_merge(base: dict, overlay: dict) -> dict:
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _validate_values(chart_dir: str, values: dict) -> None:
+    """Enforce values.schema.json (helm validates it natively; this
+    renderer mirrors that so render tests catch bad values too)."""
+    schema_path = os.path.join(chart_dir, "values.schema.json")
+    if not os.path.exists(schema_path):
+        return
+    import json  # noqa: PLC0415
+
+    with open(schema_path, encoding="utf-8") as f:
+        schema = json.load(f)
+    try:
+        import jsonschema  # noqa: PLC0415
+    except ImportError:  # pragma: no cover - jsonschema is baked in here
+        return
+    try:
+        jsonschema.validate(values, schema)
+    except jsonschema.ValidationError as e:
+        raise ChartValidationError(
+            f"values rejected by values.schema.json: {e.message}"
+        ) from e
+
+
+def render_chart(
+    chart_dir: str, overrides: dict | None = None
+) -> dict[str, str]:
+    """Render every template; returns {relative template path: text}.
+    CRDs (helm installs them verbatim) are included under crds/."""
+    with open(os.path.join(chart_dir, "Chart.yaml"), encoding="utf-8") as f:
+        chart = yaml.safe_load(f)
+    with open(os.path.join(chart_dir, "values.yaml"), encoding="utf-8") as f:
+        values = yaml.safe_load(f)
+    if overrides:
+        values = _deep_merge(values, overrides)
+    _validate_values(chart_dir, values)
+    ctx = {
+        "Values": values,
+        "Chart": {
+            "Name": chart.get("name"),
+            "Version": chart.get("version"),
+            "AppVersion": chart.get("appVersion"),
+        },
+        ".": None,
+    }
+    out: dict[str, str] = {}
+    tdir = os.path.join(chart_dir, "templates")
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith((".yaml", ".yml")):
+            continue
+        with open(os.path.join(tdir, name), encoding="utf-8") as f:
+            out[f"templates/{name}"] = render_template(f.read(), ctx)
+    cdir = os.path.join(chart_dir, "crds")
+    if os.path.isdir(cdir):
+        for name in sorted(os.listdir(cdir)):
+            with open(os.path.join(cdir, name), encoding="utf-8") as f:
+                out[f"crds/{name}"] = f.read()
+    return out
+
+
+def manifests(rendered: dict[str, str]) -> list[dict]:
+    """Parse rendered output into manifest dicts (skips empty docs)."""
+    docs = []
+    for _, text in sorted(rendered.items()):
+        for doc in yaml.safe_load_all(text):
+            if doc:
+                docs.append(doc)
+    return docs
+
+
+def _parse_set(expr: str) -> dict:
+    key, _, val = expr.partition("=")
+    out: dict = {}
+    cur = out
+    parts = key.split(".")
+    for p in parts[:-1]:
+        cur[p] = {}
+        cur = cur[p]
+    parsed: object = val
+    if val in ("true", "false"):
+        parsed = val == "true"
+    elif re.fullmatch(r"-?\d+", val):
+        parsed = int(val)
+    cur[parts[-1]] = parsed
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="chartrender",
+                                description="render the in-tree helm chart")
+    p.add_argument("chart_dir")
+    p.add_argument("--set", action="append", default=[],
+                   help="value override a.b.c=x (repeatable)")
+    args = p.parse_args(argv)
+    overrides: dict = {}
+    for expr in args.set:
+        overrides = _deep_merge(overrides, _parse_set(expr))
+    for name, text in render_chart(args.chart_dir, overrides).items():
+        body = text.strip()
+        if body:
+            print(f"---\n# Source: {name}\n{body}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""Shared filesystem helpers."""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def write_json_atomic(path: str, obj, fsync: bool = False) -> None:
+    """tmp-write + rename so a crash mid-write never leaves truncated
+    JSON behind (the checkpoint/registry persistence pattern)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -1,0 +1,137 @@
+// Tenancy preflight: CDI createContainer/poststop OCI hook (native).
+//
+// The container runtime executes CDI hooks on the HOST, so this must be
+// a self-contained binary with no interpreter dependency -- the analog
+// of nvidia-cdi-hook, which the reference copies into the plugin dir on
+// the host at startup (gpu main.go:293). The kubelet plugin copies this
+// binary into <state-root>/bin/ (a hostPath) and the claim's CDI spec
+// points its hooks here.
+//
+// createContainer: REGISTER <id> <hbm> with the claim's tenancy agent;
+// a DENIED reply (over max-clients / over HBM budget) exits 1 and the
+// runtime refuses to start the container. poststop: RELEASE <id> so a
+// restarted container (new OCI id) does not leak its admission slot.
+// The container id comes from the OCI state JSON on stdin.
+//
+// Build: static-linked (see Makefile) so it runs on minimal host images
+// (COS) that ship neither python nor a matching libstdc++.
+
+#include <fcntl.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace {
+
+// Minimal extraction of "id":"..." from the OCI state JSON on stdin.
+std::string StateId() {
+  std::string input;
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(STDIN_FILENO, buf, sizeof(buf))) > 0) {
+    input.append(buf, static_cast<size_t>(n));
+    if (input.size() > 1 << 20) break;  // state blobs are small
+  }
+  size_t key = input.find("\"id\"");
+  if (key == std::string::npos) return "";
+  size_t colon = input.find(':', key);
+  if (colon == std::string::npos) return "";
+  size_t open = input.find('"', colon);
+  if (open == std::string::npos) return "";
+  size_t close = input.find('"', open + 1);
+  if (close == std::string::npos) return "";
+  return input.substr(open + 1, close - open - 1);
+}
+
+int Query(const std::string& sock_path, const std::string& request,
+          std::string* reply) {
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  // A wedged-but-listening agent must not hang container creation:
+  // bound every socket op (connect honors SO_SNDTIMEO on Linux). The
+  // CDI hook entry also carries its own runtime-enforced timeout.
+  timeval tv{5, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  sockaddr_un addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  if (sock_path.size() >= sizeof(addr.sun_path)) {
+    close(fd);
+    return -1;
+  }
+  std::strncpy(addr.sun_path, sock_path.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  std::string line = request + "\n";
+  if (write(fd, line.c_str(), line.size()) < 0) {
+    close(fd);
+    return -1;
+  }
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) {
+    reply->append(buf, static_cast<size_t>(n));
+    if (!reply->empty() && reply->back() == '\n') break;
+  }
+  close(fd);
+  while (!reply->empty() &&
+         (reply->back() == '\n' || reply->back() == '\r')) {
+    reply->pop_back();
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string dir, hbm = "0", client;
+  bool release = false;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    if (a == "--dir" && i + 1 < argc) dir = argv[++i];
+    else if (a == "--hbm-bytes" && i + 1 < argc) hbm = argv[++i];
+    else if (a == "--client-id" && i + 1 < argc) client = argv[++i];
+    else if (a == "--release") release = true;
+  }
+  if (dir.empty()) {
+    std::fprintf(stderr, "tenancy-preflight: --dir required\n");
+    return 1;
+  }
+  if (client.empty()) client = StateId();
+  if (client.empty() || client.find('/') != std::string::npos ||
+      client == "." || client == "..") {
+    std::fprintf(stderr, "tenancy-preflight: no usable client identity\n");
+    // poststop must not fail the runtime's teardown path.
+    return release ? 0 : 1;
+  }
+  std::string request = release ? "RELEASE " + client
+                                : "REGISTER " + client + " " + hbm;
+  std::string reply;
+  if (Query(dir + "/agent.sock", request, &reply) != 0) {
+    std::fprintf(stderr, "tenancy-preflight: agent unreachable at %s\n",
+                 dir.c_str());
+    if (release) {
+      // Tombstone: the agent reclaims this slot from released.d before
+      // its next admission, so a lost RELEASE never leaks permanently.
+      std::string rd = dir + "/released.d";
+      mkdir(rd.c_str(), 0755);
+      int tfd = open((rd + "/" + client).c_str(),
+                     O_WRONLY | O_CREAT | O_TRUNC, 0644);
+      if (tfd >= 0) close(tfd);
+      return 0;  // never block container teardown
+    }
+    return 1;  // fail closed on admission
+  }
+  if (release || reply.rfind("OK", 0) == 0) return 0;
+  std::fprintf(stderr, "tenancy-preflight: %s\n", reply.c_str());
+  return 1;
+}

@@ -135,12 +135,16 @@ class WebhookServer:
         self._server.server_close()
 
 
-def main(argv: list[str] | None = None) -> int:
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpu-dra-webhook")
     p.add_argument("--port", type=int, default=8443)
     p.add_argument("--tls-cert")
     p.add_argument("--tls-key")
-    args = p.parse_args(argv)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     server = WebhookServer(port=args.port, tls_cert=args.tls_cert,
                            tls_key=args.tls_key)

@@ -1,0 +1,136 @@
+"""Hardware-gated smoke tests: the stack against REAL TPU state.
+
+Reference role: tests/bats/test_gpu_basic.bats (real enumeration +
+claim + workload on actual hardware) -- these skip cleanly off-hardware.
+
+Two independent gates:
+- /dev/accel* present  -> real devfs enumeration + claim Prepare + the
+  health baseline on the real device tree.
+- a TPU visible to JAX (this bench env reaches one chip through a
+  tunnel even without local /dev/accel*) -> a claim's injected TPU_*
+  env contract is handed to a REAL subprocess JAX step that must see
+  the chip and compute on it.
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HAVE_DEVFS = os.path.exists("/dev/accel0")
+
+
+@functools.cache
+def tpu_platform_available() -> bool:
+    """Probe for a JAX-visible TPU in a subprocess (the test process
+    itself is pinned to CPU by conftest)."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+    except subprocess.SubprocessError:
+        return False
+    return out.stdout.strip() == "tpu"
+
+
+@pytest.mark.skipif(not HAVE_DEVFS, reason="no /dev/accel* on this host")
+class TestRealDevfs:
+    def test_enumerates_real_chips(self):
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+            EnumerateOptions, load,
+        )
+
+        host = load().enumerate(EnumerateOptions())
+        assert host.source == "devfs"
+        assert host.chips
+        for chip in host.chips:
+            assert os.path.exists(chip.devpath)
+
+    def test_prepare_real_chip_claim(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+            Config, DeviceState,
+        )
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import EnumerateOptions
+        from tests.fake_kube import make_claim
+
+        state = DeviceState(Config(
+            root=str(tmp_path / "root"),
+            tpulib_opts=EnumerateOptions(),  # the real tree
+            cdi_root=str(tmp_path / "cdi"),
+            tenancy_agents=False,
+        ))
+        name = next(iter(sorted(state.allocatable)))
+        state.prepare(make_claim("rhw-1", [name]))
+        spec = state._cdi.read_spec("rhw-1")
+        assert spec["devices"]
+        state.unprepare("rhw-1")
+
+    def test_health_baseline_clean(self):
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+            EnumerateOptions, load,
+        )
+
+        lib = load()
+        host = lib.enumerate(EnumerateOptions())
+        expected = ",".join(str(c.index) for c in host.chips)
+        events = lib.health(EnumerateOptions(expected_chips=expected))
+        # A healthy host shows no chip_lost for currently-present chips.
+        assert not [e for e in events if e.kind == "chip_lost"]
+
+
+class TestRealChipWorkload:
+    def test_jax_step_under_injected_claim_env(self, tmp_path):
+        """Prepare a 1-chip claim, launch a real JAX computation in a
+        subprocess under the claim's injected env, assert it sees the
+        TPU and computes on it (the bats real-workload analog)."""
+        if not tpu_platform_available():
+            pytest.skip("no JAX-visible TPU")
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+            Config, DeviceState,
+        )
+        from tests.fake_kube import make_claim
+
+        state = DeviceState(Config.mock(root=str(tmp_path / "root"),
+                                        topology="v5e-1"))
+        state.prepare(make_claim("rhw-jax", ["chip-0"]))
+        spec = state._cdi.read_spec("rhw-jax")
+        claim_env: dict[str, str] = {}
+        for dev in spec["devices"]:
+            for e in dev["containerEdits"].get("env", []):
+                k, _, v = e.partition("=")
+                claim_env[k] = v
+        for e in spec.get("containerEdits", {}).get("env", []):
+            k, _, v = e.partition("=")
+            claim_env.setdefault(k, v)
+        assert claim_env.get("TPU_VISIBLE_DEVICES") == "0"
+
+        env = {k: v for k, v in os.environ.items()
+               if k != "JAX_PLATFORMS"}
+        env.update(claim_env)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        probe = (
+            "import jax, jax.numpy as jnp, json;"
+            "d = jax.devices();"
+            "x = jnp.ones((256, 256), jnp.bfloat16);"
+            "y = (x @ x).sum();"
+            "print(json.dumps({'platform': d[0].platform,"
+            " 'n': len(d), 'y': float(y)}))"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        doc = json.loads(out.stdout.strip().split("\n")[-1])
+        assert doc["platform"] == "tpu"
+        assert doc["n"] >= 1
+        assert doc["y"] == 256.0 * 256 * 256
+        state.unprepare("rhw-jax")

@@ -1,0 +1,286 @@
+"""MultiTenancy enforcement tests: the per-claim agent admits tenants
+against max-client and HBM budgets; the CDI preflight hook fails (exit
+nonzero -> container start refused) for an over-budget tenant; grants
+survive agent restarts; prepared claims re-own agents on plugin restart.
+
+Reference role: cmd/gpu-kubelet-plugin/sharing.go:214-379 (MPS control
+daemon Deployment + AssertReady + workload redirection).
+"""
+
+import json
+import os
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+    Config,
+    DeviceState,
+)
+from k8s_dra_driver_gpu_tpu.kubeletplugin.sharing import MultiTenancyManager
+from k8s_dra_driver_gpu_tpu.kubeletplugin.tenancy_agent import (
+    TenancyState,
+    _handle_line,
+)
+from k8s_dra_driver_gpu_tpu.kubeletplugin.tenancy_preflight import (
+    main as preflight_main,
+)
+from tests.fake_kube import make_claim, opaque
+
+GI = 1 << 30
+PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "k8s_dra_driver_gpu_tpu", "kubeletplugin")
+
+
+def write_manifest(d, max_clients=2, capacity=4 * GI):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "tenancy.json"), "w") as f:
+        json.dump({
+            "chips": [0],
+            "maxClients": max_clients,
+            "hbmCapacityBytes": capacity,
+            "hbmLimits": {"chip-0": 2 * GI},
+        }, f)
+
+
+class TestAdmissionLogic:
+    def test_admits_within_budget(self, tmp_path):
+        write_manifest(tmp_path)
+        st = TenancyState(str(tmp_path))
+        assert _handle_line(st, "REGISTER a 1073741824").startswith("OK")
+        assert _handle_line(st, "REGISTER b 1073741824").startswith("OK")
+
+    def test_denies_over_max_clients(self, tmp_path):
+        write_manifest(tmp_path, max_clients=1)
+        st = TenancyState(str(tmp_path))
+        assert _handle_line(st, "REGISTER a 1").startswith("OK")
+        out = _handle_line(st, "REGISTER b 1")
+        assert out.startswith("DENIED") and "max clients" in out
+
+    def test_denies_over_hbm_capacity(self, tmp_path):
+        write_manifest(tmp_path, capacity=3 * GI)
+        st = TenancyState(str(tmp_path))
+        assert _handle_line(st, f"REGISTER a {2 * GI}").startswith("OK")
+        out = _handle_line(st, f"REGISTER b {2 * GI}")
+        assert out.startswith("DENIED") and "HBM budget" in out
+
+    def test_release_frees_budget(self, tmp_path):
+        write_manifest(tmp_path, capacity=2 * GI, max_clients=None)
+        st = TenancyState(str(tmp_path))
+        assert _handle_line(st, f"REGISTER a {2 * GI}").startswith("OK")
+        assert _handle_line(st, f"REGISTER b {GI}").startswith("DENIED")
+        assert _handle_line(st, "RELEASE a") == "OK released"
+        assert _handle_line(st, f"REGISTER b {GI}").startswith("OK")
+
+    def test_reregister_same_client_is_update_not_double_count(self, tmp_path):
+        write_manifest(tmp_path, capacity=2 * GI, max_clients=1)
+        st = TenancyState(str(tmp_path))
+        assert _handle_line(st, f"REGISTER a {GI}").startswith("OK")
+        assert _handle_line(st, f"REGISTER a {2 * GI}").startswith("OK")
+
+    def test_grants_survive_agent_restart(self, tmp_path):
+        write_manifest(tmp_path, max_clients=1)
+        st = TenancyState(str(tmp_path))
+        assert _handle_line(st, "REGISTER a 1").startswith("OK")
+        st2 = TenancyState(str(tmp_path))  # fresh agent, same dir
+        assert _handle_line(st2, "REGISTER b 1").startswith("DENIED")
+
+    def test_tombstone_reclaims_lost_release(self, tmp_path):
+        # A poststop that couldn't reach the agent leaves released.d/<id>;
+        # the agent applies it before the next admission, so the dead
+        # container's slot is reclaimed instead of leaking forever.
+        write_manifest(tmp_path, max_clients=1)
+        st = TenancyState(str(tmp_path))
+        assert _handle_line(st, "REGISTER dead 1").startswith("OK")
+        rd = tmp_path / "released.d"
+        rd.mkdir()
+        (rd / "dead").touch()
+        assert _handle_line(st, "REGISTER alive 1").startswith("OK")
+        assert not (rd / "dead").exists()
+
+    def test_preflight_writes_tombstone_when_agent_unreachable(
+        self, tmp_path
+    ):
+        assert preflight_main(["--dir", str(tmp_path), "--release",
+                               "--client-id", "ctr-x"]) == 0
+        assert (tmp_path / "released.d" / "ctr-x").exists()
+
+    def test_register_rejects_path_traversal_ids(self, tmp_path):
+        write_manifest(tmp_path)
+        st = TenancyState(str(tmp_path))
+        assert _handle_line(st, "REGISTER ../evil 1").startswith("ERROR")
+
+    def test_status_and_members(self, tmp_path):
+        write_manifest(tmp_path)
+        st = TenancyState(str(tmp_path))
+        assert _handle_line(st, "STATUS") == "READY"
+        _handle_line(st, "REGISTER a 5")
+        doc = json.loads(_handle_line(st, "MEMBERS"))
+        assert doc["clients"] == {"a": 5}
+
+
+class TestEndToEndEnforcement:
+    """Real agent process + real preflight, through DeviceState.prepare."""
+
+    @pytest.fixture()
+    def state(self, tmp_path):
+        s = DeviceState(Config.mock(root=str(tmp_path / "root"),
+                                    tenancy_agents=True))
+        yield s
+        s.stop()
+
+    def _prepare_tenancy_claim(self, state, uid="c1", max_clients=2,
+                               hbm_limit="8Gi"):
+        cfgs = [{
+            "parameters": opaque("TpuConfig", sharing={
+                "strategy": "MultiTenancy",
+                "multiTenancy": {
+                    "maxClients": max_clients,
+                    "hbmLimit": hbm_limit,
+                },
+            }),
+        }]
+        state.prepare(make_claim(uid, ["chip-0"], configs=cfgs))
+
+    def test_prepare_spawns_ready_agent_and_injects_hook(self, state):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.tenancy_agent import query
+
+        self._prepare_tenancy_claim(state)
+        d = state._tenancy._dir("c1", "tpu")
+        assert query(d, "STATUS") == "READY"
+        spec = state._cdi.read_spec("c1")
+        hooks = spec["containerEdits"].get("hooks", [])
+        assert hooks and hooks[0]["hookName"] == "createContainer"
+        assert "--dir" in hooks[0]["args"]
+
+    def test_second_over_budget_tenant_rejected(self, state, capsys):
+        # v5e chip: 16 GiB HBM. Two tenants at 8Gi fit; a third tenant
+        # (or one asking beyond the remainder) must be DENIED and the
+        # preflight hook must exit nonzero = container start refused.
+        self._prepare_tenancy_claim(state, hbm_limit="8Gi")
+        d = state._tenancy._dir("c1", "tpu")
+        assert preflight_main(["--dir", d, "--hbm-bytes",
+                               str(8 * GI), "--client-id", "pod-a"]) == 0
+        assert preflight_main(["--dir", d, "--hbm-bytes",
+                               str(8 * GI), "--client-id", "pod-b"]) == 0
+        rc = preflight_main(["--dir", d, "--hbm-bytes",
+                             str(8 * GI), "--client-id", "pod-c"])
+        assert rc == 1
+        assert "DENIED" in capsys.readouterr().err
+
+    def test_poststop_release_frees_restarted_containers_slot(self, state):
+        # kubelet restarts a crashed container under a NEW OCI id; the
+        # poststop hook must free the old id or the pod wedges on the
+        # max-client check forever.
+        self._prepare_tenancy_claim(state, max_clients=1, hbm_limit="8Gi")
+        d = state._tenancy._dir("c1", "tpu")
+        assert preflight_main(["--dir", d, "--hbm-bytes", "1",
+                               "--client-id", "ctr-old"]) == 0
+        assert preflight_main(["--dir", d, "--hbm-bytes", "1",
+                               "--client-id", "ctr-new"]) == 1
+        assert preflight_main(["--dir", d, "--release",
+                               "--client-id", "ctr-old"]) == 0
+        assert preflight_main(["--dir", d, "--hbm-bytes", "1",
+                               "--client-id", "ctr-new"]) == 0
+
+    def test_cdi_spec_carries_create_and_poststop_hooks(self, state):
+        self._prepare_tenancy_claim(state)
+        spec = state._cdi.read_spec("c1")
+        hooks = {h["hookName"]: h for h in
+                 spec["containerEdits"].get("hooks", [])}
+        assert set(hooks) == {"createContainer", "poststop"}
+        # OCI hook args include argv[0] == path.
+        for h in hooks.values():
+            assert h["args"][0] == h["path"]
+        assert "--release" in hooks["poststop"]["args"]
+        # The hook binary lives under the state root (a hostPath the
+        # runtime can exec) and is executable.
+        assert os.access(hooks["createContainer"]["path"], os.X_OK)
+
+    def test_hbm_budget_is_per_chip_for_multichip_groups(self, tmp_path):
+        # Admission must fit tenants within ONE chip's HBM: every tenant
+        # runs on every chip of the group, so a 2-chip group does NOT
+        # double the budget.
+        s = DeviceState(Config.mock(root=str(tmp_path / "root"),
+                                    tenancy_agents=True))
+        try:
+            cfgs = [{
+                "parameters": opaque("TpuConfig", sharing={
+                    "strategy": "MultiTenancy",
+                    "multiTenancy": {"hbmLimit": "12Gi"},
+                }),
+            }]
+            s.prepare(make_claim("c1", ["chip-0", "chip-1"], configs=cfgs))
+            d = s._tenancy._dir("c1", "tpu")
+            assert preflight_main(["--dir", d, "--hbm-bytes",
+                                   str(12 * GI), "--client-id", "a"]) == 0
+            # 12Gi committed of a 16Gi (per-chip) budget: no second 12Gi.
+            assert preflight_main(["--dir", d, "--hbm-bytes",
+                                   str(12 * GI), "--client-id", "b"]) == 1
+        finally:
+            s.stop()
+
+    def test_preflight_fails_closed_without_agent(self, tmp_path):
+        rc = preflight_main(["--dir", str(tmp_path),
+                             "--hbm-bytes", "1", "--client-id", "x"])
+        assert rc == 1
+        # ...but a release during teardown never blocks the runtime.
+        assert preflight_main(["--dir", str(tmp_path), "--release",
+                               "--client-id", "x"]) == 0
+
+    def test_native_preflight_binary_parity(self, state):
+        # The static C++ hook binary (the one real runtimes exec) must
+        # enforce identically to the python module.
+        native = os.path.join(os.path.dirname(PKG_DIR), "tpulib",
+                              "native", "tenancy_preflight")
+        if not os.path.exists(native):
+            pytest.skip("native preflight not built")
+        import subprocess
+
+        self._prepare_tenancy_claim(state, max_clients=1)
+        d = state._tenancy._dir("c1", "tpu")
+
+        def run_native(*args):
+            return subprocess.run(
+                [native, "--dir", d, *args],
+                capture_output=True, stdin=subprocess.DEVNULL,
+            ).returncode
+
+        assert run_native("--hbm-bytes", "1", "--client-id", "n-a") == 0
+        assert run_native("--hbm-bytes", "1", "--client-id", "n-b") == 1
+        assert run_native("--release", "--client-id", "n-a") == 0
+        assert run_native("--hbm-bytes", "1", "--client-id", "n-b") == 0
+
+    def test_unprepare_stops_agent_and_removes_dir(self, state):
+        self._prepare_tenancy_claim(state)
+        d = state._tenancy._dir("c1", "tpu")
+        state.unprepare("c1")
+        assert not os.path.isdir(d)
+        assert not state._tenancy._agents
+
+    def test_plugin_restart_reowns_agent(self, tmp_path):
+        root = str(tmp_path / "root")
+        s1 = DeviceState(Config.mock(root=root, tenancy_agents=True))
+        self._prepare_tenancy_claim(s1)
+        s1.stop()  # plugin shutdown kills the agent...
+        s2 = DeviceState(Config.mock(root=root, tenancy_agents=True))
+        try:
+            from k8s_dra_driver_gpu_tpu.kubeletplugin.tenancy_agent import (
+                query,
+            )
+
+            d = s2._tenancy._dir("c1", "tpu")
+            assert query(d, "STATUS") == "READY"  # ...restart re-owns it
+        finally:
+            s2.stop()
+
+    def test_orphan_tenancy_dir_dropped_on_restart(self, tmp_path):
+        root = str(tmp_path / "root")
+        s1 = DeviceState(Config.mock(root=root, tenancy_agents=True))
+        orphan = os.path.join(root, "tenancy", "ghost-claim")
+        os.makedirs(orphan)
+        s1.stop()
+        s2 = DeviceState(Config.mock(root=root, tenancy_agents=True))
+        try:
+            assert not os.path.isdir(orphan)
+        finally:
+            s2.stop()

@@ -127,6 +127,69 @@ class TestWebhookHTTP:
             server.stop()
 
 
+class TestCertBootstrap:
+    """Webhook TLS bootstrap (webhook/certbootstrap.py): self-signed
+    cert -> Secret + ValidatingWebhookConfiguration caBundle patch."""
+
+    def _webhook_config(self, kube):
+        kube.create("admissionregistration.k8s.io", "v1",
+                    "validatingwebhookconfigurations", {
+                        "apiVersion": "admissionregistration.k8s.io/v1",
+                        "kind": "ValidatingWebhookConfiguration",
+                        "metadata": {"name": "tpu-dra-webhook"},
+                        "webhooks": [{"name": "validate.tpu.dra.dev",
+                                      "clientConfig": {}}],
+                    })
+
+    def test_generates_secret_and_patches_bundle(self):
+        import base64
+
+        from k8s_dra_driver_gpu_tpu.webhook.certbootstrap import run
+
+        kube = FakeKubeClient()
+        self._webhook_config(kube)
+        assert run(kube, "tpu-dra-webhook", "ns1",
+                   "tpu-dra-webhook-tls", "tpu-dra-webhook") == 0
+        secret = kube.get("", "v1", "secrets", "tpu-dra-webhook-tls",
+                          namespace="ns1")
+        cert = base64.b64decode(secret["data"]["tls.crt"])
+        assert b"BEGIN CERTIFICATE" in cert
+        assert b"BEGIN PRIVATE KEY" in base64.b64decode(
+            secret["data"]["tls.key"])
+        whc = kube.get("admissionregistration.k8s.io", "v1",
+                       "validatingwebhookconfigurations",
+                       "tpu-dra-webhook")
+        bundle = whc["webhooks"][0]["clientConfig"]["caBundle"]
+        assert base64.b64decode(bundle) == cert
+
+    def test_idempotent_keeps_existing_secret(self):
+        from k8s_dra_driver_gpu_tpu.webhook.certbootstrap import run
+
+        kube = FakeKubeClient()
+        self._webhook_config(kube)
+        run(kube, "svc", "ns1", "tls-secret", "tpu-dra-webhook")
+        first = kube.get("", "v1", "secrets", "tls-secret",
+                         namespace="ns1")["data"]["tls.crt"]
+        run(kube, "svc", "ns1", "tls-secret", "tpu-dra-webhook")
+        second = kube.get("", "v1", "secrets", "tls-secret",
+                          namespace="ns1")["data"]["tls.crt"]
+        assert first == second  # no cert churn on re-run
+
+    def test_cert_has_service_sans(self):
+        from k8s_dra_driver_gpu_tpu.webhook.certbootstrap import (
+            generate_self_signed,
+        )
+
+        cert_pem, _ = generate_self_signed("tpu-dra-webhook", "ns1")
+        import subprocess
+        out = subprocess.run(
+            ["openssl", "x509", "-noout", "-text"],
+            input=cert_pem, capture_output=True, check=True,
+        ).stdout.decode()
+        assert "tpu-dra-webhook.ns1.svc" in out
+        assert "tpu-dra-webhook.ns1.svc.cluster.local" in out
+
+
 class TestLeaderElection:
     def test_single_leader(self, ):
         kube = FakeKubeClient()
