@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Create a GKE cluster with a TPU node pool for the DRA driver.
+# Requires: gcloud auth + project configured.
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+ZONE="${ZONE:-us-east5-a}"
+# v5p host machine with 4 chips; topology spans hosts (2x2x2 = 2 hosts).
+MACHINE_TYPE="${MACHINE_TYPE:-ct5p-hightpu-4t}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x2x2}"
+NUM_NODES="${NUM_NODES:-2}"
+
+gcloud container clusters create "${CLUSTER_NAME}" \
+    --zone "${ZONE}" \
+    --cluster-version "1.35" \
+    --machine-type e2-standard-4 \
+    --num-nodes 1 \
+    --no-enable-autoupgrade
+
+# DRA needs the beta API enabled on GKE; TPU pools carry the
+# cloud.google.com/gke-tpu-accelerator label + google.com/tpu taint the
+# chart's DaemonSet selects/tolerates by default.
+gcloud container node-pools create tpu-pool \
+    --cluster "${CLUSTER_NAME}" \
+    --zone "${ZONE}" \
+    --machine-type "${MACHINE_TYPE}" \
+    --tpu-topology "${TPU_TOPOLOGY}" \
+    --num-nodes "${NUM_NODES}" \
+    --no-enable-autoupgrade
+
+gcloud container clusters get-credentials "${CLUSTER_NAME}" --zone "${ZONE}"
+echo "cluster ready; next: ./install-dra-driver-tpu.sh IMAGE=<registry>/tpu-dra-driver:TAG"

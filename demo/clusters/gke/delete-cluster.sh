@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+set -euo pipefail
+gcloud container clusters delete "${CLUSTER_NAME:-tpu-dra}" \
+    --zone "${ZONE:-us-east5-a}" --quiet

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Install the chart on a GKE cluster with TPU node pools.
+set -euo pipefail
+
+IMAGE="${IMAGE:?set IMAGE=<registry>/tpu-dra-driver:TAG}"
+REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
+
+helm upgrade --install tpu-dra-driver \
+    "${REPO_ROOT}/deployments/helm/tpu-dra-driver" \
+    --namespace tpu-dra-driver --create-namespace \
+    --set image.repository="${IMAGE%:*}" \
+    --set image.tag="${IMAGE##*:}" \
+    "$@"
+
+kubectl -n tpu-dra-driver rollout status ds/tpu-dra-kubelet-plugin --timeout=300s
+kubectl get resourceslices

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Build the driver image and side-load it into the kind cluster.
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+IMAGE="${IMAGE:-tpu-dra-driver:dev}"
+REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
+
+docker build -f "${REPO_ROOT}/deployments/container/Dockerfile" \
+    -t "${IMAGE}" "${REPO_ROOT}"
+kind load docker-image --name "${CLUSTER_NAME}" "${IMAGE}"
+echo "loaded ${IMAGE} into kind/${CLUSTER_NAME}"

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Bring up a kind cluster ready for the TPU DRA driver in MOCK mode
+# (no TPUs needed -- the device library fakes a topology end to end;
+# the reference's mock-NVML kind pipeline analog, hack/ci/mock-nvml/).
+set -euo pipefail
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+K8S_IMAGE="${K8S_IMAGE:-kindest/node:v1.35.0}"
+
+cat <<EOF | kind create cluster --name "${CLUSTER_NAME}" --image "${K8S_IMAGE}" --config -
+kind: Cluster
+apiVersion: kind.x-k8s.io/v1alpha4
+featureGates:
+  DynamicResourceAllocation: true
+containerdConfigPatches:
+  # CDI must be enabled so the runtime honors the driver's specs.
+  - |-
+    [plugins."io.containerd.grpc.v1.cri"]
+      enable_cdi = true
+nodes:
+  - role: control-plane
+  - role: worker
+  - role: worker
+EOF
+
+echo "cluster ${CLUSTER_NAME} up; next:"
+echo "  ./build-image.sh && ./install-dra-driver-tpu.sh"

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Install the chart in mock-topology mode on a kind cluster.
+set -euo pipefail
+
+IMAGE="${IMAGE:-tpu-dra-driver:dev}"
+MOCK_TOPOLOGY="${MOCK_TOPOLOGY:-v5e-4}"
+REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
+
+helm upgrade --install tpu-dra-driver \
+    "${REPO_ROOT}/deployments/helm/tpu-dra-driver" \
+    --namespace tpu-dra-driver --create-namespace \
+    --set image.repository="${IMAGE%:*}" \
+    --set image.tag="${IMAGE##*:}" \
+    --set image.pullPolicy=Never \
+    --set kubeletPlugin.mockTopology="${MOCK_TOPOLOGY}" \
+    --set kubeletPlugin.nodeSelector=null \
+    --set kubeletPlugin.tolerations=null \
+    "$@"
+
+kubectl -n tpu-dra-driver rollout status ds/tpu-dra-kubelet-plugin --timeout=180s
+kubectl get resourceslices

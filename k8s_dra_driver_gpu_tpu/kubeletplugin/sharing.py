@@ -150,7 +150,13 @@ class MultiTenancyManager:
         and emit workload env/mount/hook edits. One call per request
         group covers all its devices."""
         d = self._dir(claim_uid, request)
-        os.makedirs(d, exist_ok=True)
+        # Only shared/ is bind-mounted (rw) into tenant containers --
+        # the agent socket, grant state, and tombstones stay OUTSIDE the
+        # mount, or a tenant could RELEASE a sibling's reservation and
+        # defeat admission control (the protocol is unauthenticated; the
+        # enforcement boundary is host-only reachability).
+        shared = os.path.join(d, "shared")
+        os.makedirs(shared, exist_ok=True)
         manifest = {
             "chips": chip_indices,
             "maxClients": cfg.max_clients,
@@ -164,6 +170,9 @@ class MultiTenancyManager:
             },
         }
         write_json_atomic(os.path.join(d, "tenancy.json"), manifest)
+        # Informational copy for tenants (the enforced one stays
+        # host-side with the agent).
+        write_json_atomic(os.path.join(shared, "tenancy.json"), manifest)
         env = [
             "TPU_MULTI_TENANT=1",
             f"TPU_TENANCY_DIR=/var/run/tpu-tenancy/{claim_uid}/{request}",
@@ -181,7 +190,9 @@ class MultiTenancyManager:
         edits = ContainerEdits(
             env=env,
             # Writable: co-tenant processes create rendezvous files here.
-            mounts=[(d, f"/var/run/tpu-tenancy/{claim_uid}/{request}", False)],
+            # Only shared/ -- see the control/data split above.
+            mounts=[(shared,
+                     f"/var/run/tpu-tenancy/{claim_uid}/{request}", False)],
         )
         if self._spawn:
             d = self._short_dir(d)  # keep agent.sock inside sun_path
@@ -242,7 +253,9 @@ class MultiTenancyManager:
         given string)."""
         import hashlib  # noqa: PLC0415
 
-        sdir = os.path.join(self._root, ".s")
+        # Sibling of the tenancy root: reconcile() sweeps the tenancy
+        # root's entries as claim uids and must never eat this dir.
+        sdir = os.path.join(os.path.dirname(self._root), "tenancy-sock")
         os.makedirs(sdir, exist_ok=True)
         short = os.path.join(
             sdir, hashlib.md5(d.encode()).hexdigest()[:12])
@@ -317,6 +330,17 @@ class MultiTenancyManager:
                         # deals with it.
                         logger.exception(
                             "could not re-own tenancy agent for %s", d)
+        # AFTER the orphan sweep (which may have just orphaned some):
+        # drop dangling agent-socket symlinks.
+        sdir = os.path.join(os.path.dirname(self._root), "tenancy-sock")
+        if os.path.isdir(sdir):
+            for name in os.listdir(sdir):
+                link = os.path.join(sdir, name)
+                if os.path.islink(link) and not os.path.exists(link):
+                    try:
+                        os.unlink(link)
+                    except OSError:
+                        pass
 
     def stop(self, claim_uid: str) -> None:
         claim_dir = os.path.realpath(self._dir(claim_uid))

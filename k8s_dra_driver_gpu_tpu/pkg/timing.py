@@ -10,10 +10,20 @@ field latency regressions are attributable to a segment.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from contextlib import contextmanager
 
 logger = logging.getLogger(__name__)
+
+# Fault-injection seams (robustness tests; the bats-suite kill-9 sweep
+# analog, reference test_gpu_robustness.bats). Both act at the START of
+# the named segment and only when the env var is set:
+#   TPU_DRA_CRASH_AT_SEGMENT=<name>  -> os._exit(86)  (SIGKILL analog)
+#   TPU_DRA_STALL_AT_SEGMENT=<name> [TPU_DRA_STALL_SECONDS=N] -> sleep
+ENV_CRASH_AT = "TPU_DRA_CRASH_AT_SEGMENT"
+ENV_STALL_AT = "TPU_DRA_STALL_AT_SEGMENT"
+ENV_STALL_SECONDS = "TPU_DRA_STALL_SECONDS"
 
 
 class SegmentTimer:
@@ -27,6 +37,11 @@ class SegmentTimer:
 
     @contextmanager
     def segment(self, name: str):
+        if os.environ.get(ENV_CRASH_AT) == name:
+            logger.warning("fault injection: crashing at segment %s", name)
+            os._exit(86)
+        if os.environ.get(ENV_STALL_AT) == name:
+            time.sleep(float(os.environ.get(ENV_STALL_SECONDS, "5")))
         t0 = time.monotonic()
         try:
             yield

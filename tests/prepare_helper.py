@@ -1,0 +1,41 @@
+"""Subprocess helper for robustness system tests: run one
+prepare/unprepare against a DeviceState root, with fault injection via
+the TPU_DRA_{CRASH,STALL}_AT_SEGMENT env seams (pkg/timing.py).
+
+    python -m tests.prepare_helper <root> <uid> <device>|AUTO_SUBSLICE \
+        [prepare|unprepare|cycle]
+
+Exit 0 on success; the injected crash path exits 86 from inside the
+segment. AUTO_SUBSLICE resolves to the first dynamic sub-slice device
+(so the carve-out create path is inside the crash window).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (  # noqa: E402
+    Config,
+    DeviceState,
+)
+from tests.fake_kube import make_claim  # noqa: E402
+
+
+def main() -> int:
+    root, uid, device = sys.argv[1], sys.argv[2], sys.argv[3]
+    action = sys.argv[4] if len(sys.argv) > 4 else "prepare"
+    state = DeviceState(Config.mock(root=root, topology="v5e-4"))
+    if device == "AUTO_SUBSLICE":
+        device = next(n for n in sorted(state.allocatable)
+                      if n.startswith("ss-") or "-ss-" in n)
+    if action in ("prepare", "cycle"):
+        state.prepare(make_claim(uid, [device]))
+    if action in ("unprepare", "cycle"):
+        state.unprepare(uid)
+    print(f"ok {action} {uid} {device}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

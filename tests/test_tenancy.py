@@ -250,6 +250,38 @@ class TestEndToEndEnforcement:
         assert run_native("--release", "--client-id", "n-a") == 0
         assert run_native("--hbm-bytes", "1", "--client-id", "n-b") == 0
 
+    def test_control_plane_not_inside_tenant_mount(self, state):
+        # The rw mount tenants get must expose ONLY the shared
+        # rendezvous subdir: socket/grants/tombstones outside it, or a
+        # tenant could RELEASE a sibling and defeat admission control.
+        self._prepare_tenancy_claim(state)
+        d = state._tenancy._dir("c1", "tpu")
+        spec = state._cdi.read_spec("c1")
+        mounts = spec["containerEdits"]["mounts"]
+        assert len(mounts) == 1
+        assert mounts[0]["hostPath"] == os.path.join(d, "shared")
+        shared = os.listdir(os.path.join(d, "shared"))
+        assert "agent.sock" not in shared
+        assert "clients.json" not in shared
+        assert "tenancy.json" in shared  # informational copy
+
+    def test_hook_short_path_survives_plugin_restart(self, tmp_path):
+        # The CDI hooks of an already-prepared claim point at the short
+        # symlink; a plugin restart (reconcile) must keep it working.
+        root = str(tmp_path / "root")
+        s1 = DeviceState(Config.mock(root=root, tenancy_agents=True))
+        self._prepare_tenancy_claim(s1)
+        spec = s1._cdi.read_spec("c1")
+        hook = spec["containerEdits"]["hooks"][0]
+        short = hook["args"][hook["args"].index("--dir") + 1]
+        s1.stop()
+        s2 = DeviceState(Config.mock(root=root, tenancy_agents=True))
+        try:
+            assert preflight_main(["--dir", short, "--hbm-bytes", "1",
+                                   "--client-id", "after-restart"]) == 0
+        finally:
+            s2.stop()
+
     def test_unprepare_stops_agent_and_removes_dir(self, state):
         self._prepare_tenancy_claim(state)
         d = state._tenancy._dir("c1", "tpu")
