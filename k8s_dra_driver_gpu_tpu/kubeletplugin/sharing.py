@@ -128,6 +128,9 @@ class MultiTenancyManager:
         ready_timeout: float = 10.0,
     ):
         self._root = os.path.join(tenancy_root, "tenancy")
+        # Sibling of the tenancy root: reconcile() sweeps the tenancy
+        # root's entries as claim uids and must never eat this dir.
+        self._sock_dir = os.path.join(tenancy_root, "tenancy-sock")
         self._capacity = hbm_capacity_bytes
         self._spawn = spawn_agents
         self._ready_timeout = ready_timeout
@@ -253,12 +256,9 @@ class MultiTenancyManager:
         given string)."""
         import hashlib  # noqa: PLC0415
 
-        # Sibling of the tenancy root: reconcile() sweeps the tenancy
-        # root's entries as claim uids and must never eat this dir.
-        sdir = os.path.join(os.path.dirname(self._root), "tenancy-sock")
-        os.makedirs(sdir, exist_ok=True)
+        os.makedirs(self._sock_dir, exist_ok=True)
         short = os.path.join(
-            sdir, hashlib.md5(d.encode()).hexdigest()[:12])
+            self._sock_dir, hashlib.md5(d.encode()).hexdigest()[:12])
         if os.path.realpath(short) != os.path.realpath(d):
             tmp = short + ".tmp"
             try:
@@ -332,10 +332,9 @@ class MultiTenancyManager:
                             "could not re-own tenancy agent for %s", d)
         # AFTER the orphan sweep (which may have just orphaned some):
         # drop dangling agent-socket symlinks.
-        sdir = os.path.join(os.path.dirname(self._root), "tenancy-sock")
-        if os.path.isdir(sdir):
-            for name in os.listdir(sdir):
-                link = os.path.join(sdir, name)
+        if os.path.isdir(self._sock_dir):
+            for name in os.listdir(self._sock_dir):
+                link = os.path.join(self._sock_dir, name)
                 if os.path.islink(link) and not os.path.exists(link):
                     try:
                         os.unlink(link)

@@ -93,6 +93,18 @@ class TestRender:
         assert "cert-manager.io/inject-ca-from" in whc["metadata"][
             "annotations"]
 
+    def test_extended_resource_mapping(self):
+        docs = manifests(render_chart(
+            CHART, {"extendedResources": {"enabled": True}}))
+        chip_class = next(d for d in docs if d["kind"] == "DeviceClass"
+                          and d["metadata"]["name"] == "tpu.dra.dev")
+        assert chip_class["spec"]["extendedResourceName"] == "google.com/tpu"
+        # Default off: would clash with the GKE TPU device plugin.
+        docs = manifests(render_chart(CHART))
+        chip_class = next(d for d in docs if d["kind"] == "DeviceClass"
+                          and d["metadata"]["name"] == "tpu.dra.dev")
+        assert "extendedResourceName" not in chip_class["spec"]
+
     def test_mock_topology_env_injected(self):
         docs = manifests(render_chart(
             CHART, {"kubeletPlugin": {"mockTopology": "v5p-16"}}))

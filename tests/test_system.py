@@ -87,6 +87,69 @@ class TestDaemonBinary:
         assert out.returncode == 1
         assert "NOT_READY" in out.stdout
 
+    def test_coordination_child_failover(self, tmp_path):
+        """Full-process failover (reference test_cd_failover role): run
+        the daemon binary, SIGKILL its coordination-service child, and
+        assert the watchdog restores READY without daemon restart."""
+        port = "17191"
+        env = {
+            **ENV,
+            "CD_DAEMON_STANDALONE": "1",
+            "COMPUTE_DOMAIN_UUID": "u-failover",
+            "CLIQUE_ID": "0",
+            "NODE_NAME": "n0",
+            "POD_IP": "127.0.0.1",
+            "COMPUTE_DOMAIN_NUM_WORKERS": "1",
+            "DOMAIN_STATE_DIR": str(tmp_path / "state"),
+            "HOSTS_FILE": str(tmp_path / "hosts"),
+            "COORDINATION_PORT": port,
+        }
+        daemon = subprocess.Popen(
+            [sys.executable, "-m",
+             "k8s_dra_driver_gpu_tpu.computedomain.daemon.main", "run"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+
+        def check_ready():
+            out = subprocess.run(
+                [sys.executable, "-m",
+                 "k8s_dra_driver_gpu_tpu.computedomain.daemon.main",
+                 "check"],
+                env=env, cwd=REPO, capture_output=True, text=True,
+                timeout=30,
+            )
+            return out.returncode == 0
+
+        def child_pid():
+            out = subprocess.run(
+                ["pgrep", "-f",
+                 f"daemon.rendezvous --members-file "
+                 f"{tmp_path / 'state' / 'members.json'}"],
+                capture_output=True, text=True,
+            )
+            pids = [int(p) for p in out.stdout.split()]
+            return pids[0] if pids else None
+
+        try:
+            assert wait_for(check_ready, timeout=60), "never READY"
+            pid1 = child_pid()
+            assert pid1, "coordination child not found"
+            os.kill(pid1, signal.SIGKILL)
+            # Watchdog restarts the child (new pid) and READY returns.
+            assert wait_for(
+                lambda: (child_pid() not in (None, pid1)) and check_ready(),
+                timeout=60,
+            ), "watchdog never restored READY"
+            assert daemon.poll() is None  # daemon itself never died
+        finally:
+            daemon.terminate()
+            try:
+                daemon.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                daemon.kill()
+                daemon.wait()
+
 
 class TestBench:
     def test_bench_prints_one_json_line(self):
