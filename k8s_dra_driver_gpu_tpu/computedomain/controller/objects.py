@@ -136,7 +136,12 @@ def build_daemon_rct(cd: dict, namespace: str) -> dict:
                     "requests": [
                         {
                             "name": "daemon",
-                            "deviceClassName": DAEMON_DEVICE_CLASS,
+                            # resource.k8s.io/v1 nests the request spec
+                            # under "exactly" (the flat form died with
+                            # v1beta1).
+                            "exactly": {
+                                "deviceClassName": DAEMON_DEVICE_CLASS,
+                            },
                         }
                     ],
                     "config": [
@@ -180,7 +185,9 @@ def build_workload_rct(cd: dict) -> dict:
                     "requests": [
                         {
                             "name": "channel",
-                            "deviceClassName": CHANNEL_DEVICE_CLASS,
+                            "exactly": {
+                                "deviceClassName": CHANNEL_DEVICE_CLASS,
+                            },
                         }
                     ],
                     "config": [

@@ -246,7 +246,10 @@ class CheckpointManager:
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(cp.to_dict(), f, indent=1)
             f.flush()
-            os.fsync(f.fileno())
+            # fdatasync: the data must be durable before the rename; the
+            # tmp file's metadata (mtime) need not be -- saves one
+            # journal commit per write on the 2x-per-Prepare hot path.
+            os.fdatasync(f.fileno())
         os.replace(tmp, self._path)
 
     def get(self) -> Checkpoint:

@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import ssl
 import threading
 import urllib.error
@@ -62,6 +63,9 @@ class KubeClient:
         host: str | None = None,
         token: str | None = None,
         ca_cert: str | None = None,
+        ca_data: str | None = None,
+        client_cert: str | None = None,
+        client_key: str | None = None,
         insecure: bool = False,
     ):
         if host is None:
@@ -80,13 +84,95 @@ class KubeClient:
         ctx: ssl.SSLContext | None = None
         if self._host.startswith("https"):
             ctx = ssl.create_default_context()
-            ca = ca_cert or os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
-            if os.path.exists(ca):
-                ctx.load_verify_locations(ca)
+            if ca_data:
+                ctx.load_verify_locations(cadata=ca_data)
+            else:
+                ca = ca_cert or os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+                if os.path.exists(ca):
+                    ctx.load_verify_locations(ca)
+            if client_cert:
+                ctx.load_cert_chain(client_cert, keyfile=client_key)
             if insecure:
                 ctx.check_hostname = False
                 ctx.verify_mode = ssl.CERT_NONE
         self._ssl = ctx
+
+    @classmethod
+    def from_kubeconfig(
+        cls, path: str | None = None, context: str | None = None
+    ) -> "KubeClient":
+        """Build a client from a kubeconfig (token or client-cert auth;
+        the e2e tier's entry point -- the rest of the stack runs
+        in-cluster with service-account credentials)."""
+        import base64  # noqa: PLC0415
+        import tempfile  # noqa: PLC0415
+
+        import yaml  # noqa: PLC0415
+
+        path = path or os.environ.get(
+            "KUBECONFIG", os.path.expanduser("~/.kube/config"))
+        with open(path, encoding="utf-8") as f:
+            doc = yaml.safe_load(f)
+        ctx_name = context or doc.get("current-context", "")
+
+        def pick(section: str, name: str, inner: str) -> dict:
+            match = next((e[inner] for e in doc.get(section, [])
+                          if e.get("name") == name), None)
+            if match is None:
+                raise KubeError(
+                    0, f"kubeconfig {path}: no {inner} named {name!r} "
+                       f"in {section} (current-context unset?)")
+            return match
+
+        ctx = pick("contexts", ctx_name, "context")
+        cluster = pick("clusters", ctx["cluster"], "cluster")
+        user = pick("users", ctx["user"], "user")
+
+        def materialize(data_key: str, file_key: str) -> str | None:
+            if user.get(data_key):
+                import atexit  # noqa: PLC0415
+
+                fd, tmp_path = tempfile.mkstemp(suffix=".pem")
+                os.fchmod(fd, 0o600)  # decoded private-key material
+                with os.fdopen(fd, "wb") as tf:
+                    tf.write(base64.b64decode(user[data_key]))
+                atexit.register(
+                    lambda p=tmp_path: os.path.exists(p) and os.unlink(p))
+                return tmp_path
+            return user.get(file_key)
+
+        ca_data = None
+        if cluster.get("certificate-authority-data"):
+            ca_data = base64.b64decode(
+                cluster["certificate-authority-data"]).decode()
+        return cls(
+            host=cluster["server"],
+            token=user.get("token", ""),
+            ca_cert=cluster.get("certificate-authority"),
+            ca_data=ca_data,
+            client_cert=materialize("client-certificate-data",
+                                    "client-certificate"),
+            client_key=materialize("client-key-data", "client-key"),
+            insecure=bool(cluster.get("insecure-skip-tls-verify")),
+        )
+
+    def read_raw(self, path: str, timeout: float = 30.0) -> str:
+        """GET returning the raw body (pod logs are not JSON). Same
+        auth/error mapping as the JSON surface."""
+        req = urllib.request.Request(self._host + path, method="GET")
+        req.add_header("Accept", "*/*")
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout, context=self._ssl
+            ) as resp:
+                return resp.read().decode(errors="replace")
+        except urllib.error.HTTPError as e:
+            msg = e.read().decode(errors="replace")
+            if e.code == 404:
+                raise NotFoundError(msg) from e
+            raise KubeError(e.code, msg) from e
 
     def _request(
         self, method: str, path: str, body: dict | None = None,
@@ -401,3 +487,15 @@ class FakeKubeClient:
 
     def server_version(self) -> dict:
         return self.version
+
+    def read_raw(self, path: str, timeout: float = 30.0) -> str:
+        """Raw-body read for the fake: pod-log style paths resolve to a
+        `fake/log` annotation on the object; anything else is 404."""
+        m = re.match(
+            r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/log$", path)
+        if m:
+            obj = self.get("", "v1", "pods", m.group(2),
+                           namespace=m.group(1))
+            return obj.get("metadata", {}).get(
+                "annotations", {}).get("fake/log", "")
+        raise NotFoundError(path)

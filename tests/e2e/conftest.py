@@ -1,0 +1,65 @@
+"""Real-cluster e2e tier (reference: test/e2e/ Ginkgo suite).
+
+Skipped unless TPU_DRA_E2E=1 AND a kubeconfig is reachable -- this
+tier is invasive against the current kubectl context (like the
+reference's bats suite). Run:
+
+    TPU_DRA_E2E=1 KUBECONFIG=~/.kube/config \
+        python -m pytest tests/e2e/ -q
+
+The suite adapts to whatever the driver published: it reads the
+ResourceSlice in a session fixture (platform/topology/HBM) and drives
+its CEL assertions from that, mirroring the reference's BeforeSuite
+hardware detection.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+E2E = os.environ.get("TPU_DRA_E2E") == "1"
+
+
+def pytest_runtest_setup(item):
+    if not E2E:
+        pytest.skip("e2e tier: set TPU_DRA_E2E=1 with a live kubeconfig")
+
+
+@pytest.fixture(scope="session")
+def kube():
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
+
+    return KubeClient.from_kubeconfig()
+
+
+@pytest.fixture(scope="session")
+def chip_slice(kube):
+    """The driver's published chip ResourceSlice (install check +
+    hardware detection for the CEL tests)."""
+    slices = [
+        s for s in kube.list("resource.k8s.io", "v1", "resourceslices")
+        if s["spec"].get("driver") == "tpu.dra.dev"
+        and any("iciX" in d.get("attributes", {})
+                for d in s["spec"].get("devices", []))
+    ]
+    assert slices, "tpu.dra.dev published no chip ResourceSlice -- is " \
+                   "the driver installed?"
+    return slices[0]
+
+
+@pytest.fixture()
+def namespace(kube, request):
+    """A throwaway namespace per test, torn down afterwards."""
+    import uuid
+
+    name = f"tpu-e2e-{uuid.uuid4().hex[:8]}"
+    kube.create("", "v1", "namespaces", {
+        "apiVersion": "v1", "kind": "Namespace",
+        "metadata": {"name": name},
+    })
+    yield name
+    kube.delete("", "v1", "namespaces", name)

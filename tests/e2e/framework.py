@@ -1,0 +1,95 @@
+"""e2e helpers: typed create/wait/log over the in-tree KubeClient
+(the reference's framework/{client,gpu,manifests,wait}.go analog)."""
+
+from __future__ import annotations
+
+import time
+
+# kind -> (group, version, plural)
+GVR = {
+    "Namespace": ("", "v1", "namespaces"),
+    "Pod": ("", "v1", "pods"),
+    "Job": ("batch", "v1", "jobs"),
+    "ResourceClaim": ("resource.k8s.io", "v1", "resourceclaims"),
+    "ResourceClaimTemplate": ("resource.k8s.io", "v1",
+                              "resourceclaimtemplates"),
+    "DeviceClass": ("resource.k8s.io", "v1", "deviceclasses"),
+    "ComputeDomain": ("resource.tpu.dra", "v1beta1", "computedomains"),
+}
+
+
+def wait_for(predicate, timeout=180.0, interval=2.0, desc="condition"):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = predicate()
+        if last:
+            return last
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc} (last={last!r})")
+
+
+def apply(kube, doc: dict, namespace: str | None = None):
+    group, version, plural = GVR[doc["kind"]]
+    ns = namespace or doc["metadata"].get("namespace")
+    return kube.create(group, version, plural, doc, namespace=ns)
+
+
+def pod_phase(kube, name: str, namespace: str) -> str:
+    try:
+        pod = kube.get("", "v1", "pods", name, namespace=namespace)
+    except Exception:  # noqa: BLE001
+        return ""
+    return pod.get("status", {}).get("phase", "")
+
+
+def pod_log(kube, name: str, namespace: str) -> str:
+    return kube.read_raw(f"/api/v1/namespaces/{namespace}/pods/{name}/log")
+
+
+def chip_pod(namespace: str, name: str, claim_source: dict,
+             command: list[str] | None = None) -> dict:
+    """A pod consuming one TPU claim and printing its env contract."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [{
+                "name": "probe",
+                "image": "python:3.12-slim",
+                "command": command or [
+                    "python", "-c",
+                    "import os, json; print(json.dumps({k: v for k, v in "
+                    "os.environ.items() if k.startswith('TPU_')}))",
+                ],
+                "resources": {"claims": [{"name": "tpu"}]},
+            }],
+            "resourceClaims": [{"name": "tpu", **claim_source}],
+            "tolerations": [{
+                "key": "google.com/tpu",
+                "operator": "Exists",
+                "effect": "NoSchedule",
+            }],
+        },
+    }
+
+
+def claim_template(namespace: str, name: str,
+                   device_class: str = "tpu.dra.dev",
+                   cel: str | None = None, count: int = 1) -> dict:
+    # resource.k8s.io/v1 nests the request spec under "exactly".
+    exactly: dict = {"deviceClassName": device_class}
+    if count != 1:
+        exactly["count"] = count
+    if cel:
+        exactly["selectors"] = [{"cel": {"expression": cel}}]
+    return {
+        "apiVersion": "resource.k8s.io/v1",
+        "kind": "ResourceClaimTemplate",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"spec": {"devices": {"requests": [
+            {"name": "tpu", "exactly": exactly},
+        ]}}},
+    }

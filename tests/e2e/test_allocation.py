@@ -1,0 +1,99 @@
+"""Six e2e scenarios mirroring the reference suite (test/e2e/
+gpu_allocation_test.go): install sanity, CEL selectors driven by the
+detected hardware, sharing, and the unsatisfiable negative case."""
+
+import json
+
+from tests.e2e.framework import (
+    apply,
+    chip_pod,
+    claim_template,
+    pod_log,
+    pod_phase,
+    wait_for,
+)
+
+
+class TestInstall:
+    def test_driver_publishes_chip_slice(self, chip_slice):
+        devices = chip_slice["spec"]["devices"]
+        assert devices
+        attrs = devices[0]["attributes"]
+        for key in ("platform", "iciX", "uuid"):
+            assert key in attrs
+        assert "hbmBytes" in devices[0].get("capacity", {})
+
+
+class TestAllocation:
+    def test_single_chip_pod_runs_with_env_contract(self, kube, namespace):
+        apply(kube, claim_template(namespace, "one-chip"))
+        apply(kube, chip_pod(namespace, "probe", {
+            "resourceClaimTemplateName": "one-chip"}))
+        wait_for(lambda: pod_phase(kube, "probe", namespace) == "Succeeded",
+                 desc="probe pod success")
+        env = json.loads(pod_log(kube, "probe", namespace).strip())
+        assert "TPU_VISIBLE_DEVICES" in env
+        assert env.get("TPU_SKIP_MDS_QUERY") == "1"
+
+    def test_cel_platform_selector_matches(self, kube, namespace,
+                                           chip_slice):
+        platform = chip_slice["spec"]["devices"][0]["attributes"][
+            "platform"]["string"]
+        apply(kube, claim_template(
+            namespace, "by-platform",
+            cel=f'device.attributes["tpu.dra.dev"].platform == '
+                f'"{platform}"'))
+        apply(kube, chip_pod(namespace, "plat", {
+            "resourceClaimTemplateName": "by-platform"}))
+        wait_for(lambda: pod_phase(kube, "plat", namespace) == "Succeeded",
+                 desc="platform-matched pod")
+
+    def test_cel_hbm_capacity_selector(self, kube, namespace, chip_slice):
+        hbm = int(chip_slice["spec"]["devices"][0]["capacity"]["hbmBytes"][
+            "value"])
+        # 90% threshold of the detected HBM, like the reference memory
+        # test.
+        apply(kube, claim_template(
+            namespace, "by-hbm",
+            cel=f'device.capacity["tpu.dra.dev"].hbmBytes.compareTo('
+                f'quantity("{int(hbm * 0.9)}")) >= 0'))
+        apply(kube, chip_pod(namespace, "hbm", {
+            "resourceClaimTemplateName": "by-hbm"}))
+        wait_for(lambda: pod_phase(kube, "hbm", namespace) == "Succeeded",
+                 desc="hbm-matched pod")
+
+    def test_shared_claim_two_pods(self, kube, namespace):
+        apply(kube, {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "shared", "namespace": namespace},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu",
+                 "exactly": {"deviceClassName": "tpu.dra.dev"}},
+            ]}},
+        })
+        for name in ("sharer-a", "sharer-b"):
+            apply(kube, chip_pod(namespace, name, {
+                "resourceClaimName": "shared"}))
+        wait_for(
+            lambda: all(
+                pod_phase(kube, n, namespace) == "Succeeded"
+                for n in ("sharer-a", "sharer-b")
+            ),
+            desc="both sharers succeed",
+        )
+
+    def test_unsatisfiable_selector_stays_pending(self, kube, namespace):
+        apply(kube, claim_template(
+            namespace, "never",
+            cel='device.attributes["tpu.dra.dev"].platform == "v99x"'))
+        apply(kube, chip_pod(namespace, "stuck", {
+            "resourceClaimTemplateName": "never"}))
+        import time
+
+        time.sleep(30)
+        assert pod_phase(kube, "stuck", namespace) in ("Pending", "")
+        claims = kube.list("resource.k8s.io", "v1", "resourceclaims",
+                           namespace=namespace)
+        assert all(not c.get("status", {}).get("allocation")
+                   for c in claims)

@@ -22,6 +22,7 @@ class ApiServerStub(ThreadingHTTPServer):
 
     def __init__(self):
         self.store = {}
+        self.raw: dict[str, str] = {}  # path -> text/plain body
         self.watch_events: list[dict] = []
         self.watch_connections = 0
         self.gone_on_rv = False  # reply 410 to watches with resourceVersion
@@ -61,6 +62,14 @@ class ApiServerStub(ThreadingHTTPServer):
                         )
                         self.wfile.flush()
                     self.wfile.write(b"0\r\n\r\n")
+                    return
+                if self.path in stub.raw:
+                    body = stub.raw[self.path].encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 if self.path == "/version":
                     self._reply(200, {"major": "1", "minor": "34"})
@@ -117,6 +126,49 @@ class TestKubeClientREST:
         monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
         with pytest.raises(KubeError):
             KubeClient()
+
+
+class TestKubeconfig:
+    def test_from_kubeconfig_token_auth(self, stub, tmp_path):
+        import yaml
+
+        cfg = {
+            "current-context": "e2e",
+            "contexts": [{"name": "e2e",
+                          "context": {"cluster": "c1", "user": "u1"}}],
+            "clusters": [{"name": "c1", "cluster": {"server": stub.url}}],
+            "users": [{"name": "u1", "user": {"token": "e2e-token"}}],
+        }
+        path = tmp_path / "kubeconfig"
+        path.write_text(yaml.safe_dump(cfg))
+        client = KubeClient.from_kubeconfig(str(path))
+        assert client.server_version()["major"] == "1"
+        # The bearer token from the kubeconfig rode the request.
+        assert any(a == "Bearer e2e-token" for _, _, a in stub.requests)
+
+    def test_read_raw_returns_plain_text(self, stub):
+        stub.raw["/api/v1/namespaces/ns/pods/p/log"] = "line1\nline2\n"
+        client = KubeClient(host=stub.url)
+        body = client.read_raw("/api/v1/namespaces/ns/pods/p/log")
+        assert body == "line1\nline2\n"
+
+    def test_read_raw_404_maps_to_not_found(self, stub):
+        client = KubeClient(host=stub.url)
+        with pytest.raises(NotFoundError):
+            client.read_raw("/api/v1/namespaces/ns/pods/gone/log")
+
+    def test_fake_read_raw_same_surface(self):
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+
+        kube = FakeKubeClient()
+        kube.create("", "v1", "pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "ns",
+                         "annotations": {"fake/log": "hello"}},
+        }, namespace="ns")
+        assert kube.read_raw("/api/v1/namespaces/ns/pods/p/log") == "hello"
+        with pytest.raises(NotFoundError):
+            kube.read_raw("/api/v1/namespaces/ns/pods/gone/log")
 
 
 class TestKubeClientWatch:
