@@ -1,0 +1,283 @@
+"""MoE-Llama: the Llama architecture with a mixture-of-experts FFN.
+
+Second model family of the workload stack (dense Llama + this): the
+attention/norm/rope stack is shared with models/llama.py; every layer's
+SwiGLU FFN is replaced by the dense-dispatch MoE layer (models/moe.py)
+with a replicated router and expert weights shardable over an "ep"
+mesh axis. The training step is manual-SPMD over a (dp, ep) mesh, the
+same shape as the sequence-parallel trainer (train/sp_train.py):
+
+- tokens are dp-sharded, ep-replicated; each device computes the FULL
+  model with its LOCAL expert shard and a psum over "ep" completes
+  every layer's mixture;
+- gradients: expert-shard leaves are pmean'd over dp only (each ep
+  shard owns its experts); replicated leaves over (dp, ep) -- so the
+  optimizer update is identical wherever the parameter is replicated.
+
+TPU-first: routing/combine in fp32, expert matmuls in bf16 on the MXU,
+dense one-hot dispatch (static shapes; XLA lowers it to matmuls), remat
+over the layer scan.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, EXPERT_AXIS
+from ..train.train import TrainState, make_optimizer
+from . import llama
+from .moe import moe_ffn
+
+
+@dataclass(frozen=True)
+class LlamaMoEConfig:
+    vocab_size: int = 32_768
+    d_model: int = 1024
+    n_layers: int = 8
+    n_heads: int = 16
+    n_kv_heads: int = 8
+    d_ff: int = 2048  # per expert
+    n_experts: int = 8
+    top_k: int = 2
+    aux_coef: float = 0.01  # load-balancing loss weight
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny() -> "LlamaMoEConfig":
+        return LlamaMoEConfig(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=96, n_experts=4, top_k=2,
+        )
+
+    def as_llama(self) -> llama.LlamaConfig:
+        """The dense view used by the shared attention stack."""
+        return llama.LlamaConfig(
+            vocab_size=self.vocab_size, d_model=self.d_model,
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads, d_ff=self.d_ff,
+            rope_theta=self.rope_theta, norm_eps=self.norm_eps,
+            dtype=self.dtype, attn_impl=self.attn_impl,
+        )
+
+
+def init(key: jax.Array, cfg: LlamaMoEConfig) -> dict:
+    k = iter(jax.random.split(key, 16))
+    d, h, kv, hd = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    f, E, L = cfg.d_ff, cfg.n_experts, cfg.n_layers
+
+    def dense(key, shape):
+        fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+        return jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)
+
+    return {
+        "embed": dense(next(k), (cfg.vocab_size, d)),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "wq": dense(next(k), (L, d, h * hd)),
+            "wk": dense(next(k), (L, d, kv * hd)),
+            "wv": dense(next(k), (L, d, kv * hd)),
+            "wo": dense(next(k), (L, h * hd, d)),
+            "mlp_norm": jnp.ones((L, d), jnp.float32),
+            "router": dense(next(k), (L, d, E)),
+            "w_in": dense(next(k), (L, E, d, f)),
+            "w_out": dense(next(k), (L, E, f, d)),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "lm_head": dense(next(k), (d, cfg.vocab_size)),
+    }
+
+
+def param_specs(cfg: LlamaMoEConfig, ep_axis: str = EXPERT_AXIS) -> dict:
+    """Expert leaves shard their E dim over the ep axis; the rest are
+    replicated (the dp x ep trainer's layout)."""
+    return {
+        "embed": P(),
+        "layers": {
+            "attn_norm": P(), "wq": P(), "wk": P(), "wv": P(), "wo": P(),
+            "mlp_norm": P(),
+            "router": P(),
+            "w_in": P(None, ep_axis, None, None),
+            "w_out": P(None, ep_axis, None, None),
+        },
+        "final_norm": P(),
+        "lm_head": P(),
+    }
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaMoEConfig,
+    expert_offset: jax.Array | int = 0,
+    attn_fn=None,
+    positions: jax.Array | None = None,
+    ep_axis: str = EXPERT_AXIS,
+) -> tuple[jax.Array, jax.Array]:
+    """Token ids [B, S] -> (logits [B, S, V] fp32, aux scalar).
+
+    With expert-sharded weights, ``expert_offset`` marks the local
+    block; each layer's mixture is then PARTIAL and the caller psums it
+    over the ep axis (combine_fn hook below handles it in-layer so the
+    residual stream stays correct)."""
+    lcfg = cfg.as_llama()
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1])[None, :]
+
+    inside_shard_map = not isinstance(expert_offset, int)
+
+    def body(carry, lp):
+        x, aux_sum = carry
+        # Attention half: reuse the dense-llama block internals by
+        # calling its layer with a zeroed FFN? No -- the FFN is fused in
+        # llama._layer; re-derive the two halves here with the shared
+        # primitives instead.
+        a = llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        B, S, _ = x.shape
+        q = (a @ lp["wq"].astype(cfg.dtype)).reshape(
+            B, S, cfg.n_heads, cfg.head_dim)
+        kk = (a @ lp["wk"].astype(cfg.dtype)).reshape(
+            B, S, cfg.n_kv_heads, cfg.head_dim)
+        v = (a @ lp["wv"].astype(cfg.dtype)).reshape(
+            B, S, cfg.n_kv_heads, cfg.head_dim)
+        q = llama.rope(q, positions, cfg.rope_theta)
+        kk = llama.rope(kk, positions, cfg.rope_theta)
+        if attn_fn is not None:
+            attn = attn_fn(q, kk, v)
+        else:
+            attn = llama.attention(q, kk, v, causal=True,
+                                   impl=lcfg.attn_impl)
+        x = x + attn.reshape(B, S, -1) @ lp["wo"].astype(cfg.dtype)
+
+        m = llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        moe_params = {"router": lp["router"], "w_in": lp["w_in"],
+                      "w_out": lp["w_out"]}
+        out, aux = moe_ffn(moe_params, m, top_k=cfg.top_k,
+                           dtype=cfg.dtype, expert_offset=expert_offset)
+        if inside_shard_map:
+            # Partial mixture over the local expert block -> complete it
+            # before the residual add.
+            out = jax.lax.psum(out, ep_axis)
+        x = x + out
+        return (x, aux_sum + aux), None
+
+    (x, aux_sum), _ = jax.lax.scan(
+        jax.checkpoint(body), (x, jnp.zeros((), jnp.float32)),
+        params["layers"],
+    )
+    x = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux_sum / cfg.n_layers
+
+
+def loss_fn(params, tokens, cfg: LlamaMoEConfig,
+            expert_offset: jax.Array | int = 0,
+            ep_axis: str = EXPERT_AXIS) -> jax.Array:
+    logits, aux = forward(params, tokens[:, :-1], cfg,
+                          expert_offset=expert_offset, ep_axis=ep_axis)
+    losses = optax.softmax_cross_entropy_with_integer_labels(
+        logits, tokens[:, 1:])
+    return losses.mean() + cfg.aux_coef * aux
+
+
+def make_moe_train(
+    mesh: Mesh,
+    cfg: LlamaMoEConfig,
+    optimizer: optax.GradientTransformation | None = None,
+    dp_axis: str = DATA_AXIS,
+    ep_axis: str = EXPERT_AXIS,
+):
+    """Returns (init_fn, step_fn, batch_sharding, place_params) for a
+    (dp, ep) mesh -- manual-SPMD like train/sp_train.py."""
+    optimizer = optimizer or make_optimizer()
+    specs = param_specs(cfg, ep_axis)
+    token_spec = P(dp_axis, None)
+    batch_shard = NamedSharding(mesh, token_spec)
+
+    def leaf_spec(x) -> P:
+        """Spec for any state leaf (params AND optimizer moments, which
+        mirror the param shapes): in this model only expert tensors
+        (w_in/w_out and their adam moments) are rank-4, so rank alone
+        identifies the ep-sharded leaves."""
+        if getattr(x, "ndim", 0) == 4:
+            return P(None, ep_axis, None, None)
+        return P()
+
+    def is_expert(g) -> bool:
+        return getattr(g, "ndim", 0) == 4
+
+    def local_step(state: TrainState, tokens):
+        e_local = state.params["layers"]["w_in"].shape[1]
+        offset = jax.lax.axis_index(ep_axis) * e_local
+        n_ep = jax.lax.psum(1, ep_axis)
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, tokens, cfg, offset, ep_axis)
+        # Expert shards: every ep rank computes an IDENTICAL local loss
+        # (the in-layer psum replicates the mixture), so AD through that
+        # psum delivers each expert block the SUM of all n_ep identical
+        # cotangents -- scale by 1/n_ep, then average over dp only (each
+        # ep rank owns its experts). Replicated params pmean over both
+        # axes so their update is device-invariant.
+        grads = jax.tree_util.tree_map(
+            lambda g: (jax.lax.pmean(g, (dp_axis,)) / n_ep
+                       if is_expert(g)
+                       else jax.lax.pmean(g, (dp_axis, ep_axis))),
+            grads,
+        )
+        loss = jax.lax.pmean(loss, (dp_axis, ep_axis))
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    @jax.jit
+    def init_fn(params):
+        return TrainState(
+            params=params,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    compiled: dict = {}
+
+    def step_fn(state, tokens):
+        # The optimizer-state pytree structure is optax-internal; build
+        # the spec tree from the live state by leaf rank (cached per
+        # structure) instead of hard-coding optax internals.
+        key = jax.tree_util.tree_structure(state)
+        if key not in compiled:
+            state_specs = jax.tree_util.tree_map(leaf_spec, state)
+            compiled[key] = jax.jit(
+                lambda s, t: jax.shard_map(
+                    local_step,
+                    mesh=mesh,
+                    in_specs=(state_specs, token_spec),
+                    out_specs=(state_specs, P()),
+                    check_vma=False,
+                )(s, t),
+                donate_argnums=(0,),
+            )
+        return compiled[key](state, tokens)
+
+    def place_params(params):
+        return jax.device_put(
+            params,
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P),
+            ),
+        )
+
+    return init_fn, step_fn, batch_shard, place_params

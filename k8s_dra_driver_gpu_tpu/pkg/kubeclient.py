@@ -113,6 +113,15 @@ class KubeClient:
             "KUBECONFIG", os.path.expanduser("~/.kube/config"))
         with open(path, encoding="utf-8") as f:
             doc = yaml.safe_load(f)
+        base_dir = os.path.dirname(os.path.abspath(path))
+
+        def resolve(p: str | None) -> str | None:
+            # kubectl resolves relative cert paths against the
+            # kubeconfig's own directory, not the process CWD.
+            if p and not os.path.isabs(p):
+                return os.path.join(base_dir, p)
+            return p
+
         ctx_name = context or doc.get("current-context", "")
 
         def pick(section: str, name: str, inner: str) -> dict:
@@ -139,7 +148,7 @@ class KubeClient:
                 atexit.register(
                     lambda p=tmp_path: os.path.exists(p) and os.unlink(p))
                 return tmp_path
-            return user.get(file_key)
+            return resolve(user.get(file_key))
 
         ca_data = None
         if cluster.get("certificate-authority-data"):
@@ -148,7 +157,7 @@ class KubeClient:
         return cls(
             host=cluster["server"],
             token=user.get("token", ""),
-            ca_cert=cluster.get("certificate-authority"),
+            ca_cert=resolve(cluster.get("certificate-authority")),
             ca_data=ca_data,
             client_cert=materialize("client-certificate-data",
                                     "client-certificate"),
@@ -157,31 +166,19 @@ class KubeClient:
         )
 
     def read_raw(self, path: str, timeout: float = 30.0) -> str:
-        """GET returning the raw body (pod logs are not JSON). Same
-        auth/error mapping as the JSON surface."""
-        req = urllib.request.Request(self._host + path, method="GET")
-        req.add_header("Accept", "*/*")
-        if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
-        try:
-            with urllib.request.urlopen(
-                req, timeout=timeout, context=self._ssl
-            ) as resp:
-                return resp.read().decode(errors="replace")
-        except urllib.error.HTTPError as e:
-            msg = e.read().decode(errors="replace")
-            if e.code == 404:
-                raise NotFoundError(msg) from e
-            raise KubeError(e.code, msg) from e
+        """GET returning the raw body (pod logs are not JSON). Shares
+        the JSON surface's auth + error mapping."""
+        return self._request("GET", path, timeout=timeout, raw=True)
 
     def _request(
         self, method: str, path: str, body: dict | None = None,
         content_type: str = "application/json", timeout: float = 30.0,
-    ) -> dict:
+        raw: bool = False,
+    ):
         url = self._host + path
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
+        req.add_header("Accept", "*/*" if raw else "application/json")
         if data is not None:
             req.add_header("Content-Type", content_type)
         if self._token:
@@ -191,6 +188,8 @@ class KubeClient:
                 req, timeout=timeout, context=self._ssl
             ) as resp:
                 payload = resp.read()
+                if raw:
+                    return payload.decode(errors="replace")
                 return json.loads(payload) if payload else {}
         except urllib.error.HTTPError as e:
             msg = e.read().decode(errors="replace")

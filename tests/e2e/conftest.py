@@ -22,11 +22,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
 
 E2E = os.environ.get("TPU_DRA_E2E") == "1"
+KUBECONFIG = os.environ.get("KUBECONFIG",
+                            os.path.expanduser("~/.kube/config"))
 
 
 def pytest_runtest_setup(item):
     if not E2E:
         pytest.skip("e2e tier: set TPU_DRA_E2E=1 with a live kubeconfig")
+    if not os.path.exists(KUBECONFIG):
+        pytest.skip(f"e2e tier: no kubeconfig at {KUBECONFIG}")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,135 @@
+"""MoE-Llama model family: single-device correctness, and the (dp, ep)
+expert-parallel training step must match the single-device step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from k8s_dra_driver_gpu_tpu.models import llama_moe
+from k8s_dra_driver_gpu_tpu.parallel.mesh import Mesh, MeshPlan, build_mesh
+
+
+def tiny_tokens(key, B=4, S=16):
+    cfg = llama_moe.LlamaMoEConfig.tiny()
+    return jax.random.randint(key, (B, S + 1), 0, cfg.vocab_size, jnp.int32)
+
+
+def dp_ep_mesh(dp=2, ep=4):
+    import numpy as _np
+
+    devs = _np.asarray(jax.devices()[:dp * ep]).reshape(dp, ep)
+    return Mesh(devs, ("dp", "ep"))
+
+
+class TestForward:
+    def test_shapes_and_aux(self):
+        cfg = llama_moe.LlamaMoEConfig.tiny()
+        params = llama_moe.init(jax.random.PRNGKey(0), cfg)
+        tokens = tiny_tokens(jax.random.PRNGKey(1))[:, :-1]
+        logits, aux = llama_moe.forward(params, tokens, cfg)
+        assert logits.shape == (*tokens.shape, cfg.vocab_size)
+        assert jnp.isfinite(aux) and float(aux) > 0  # load-balance loss
+
+    def test_expert_shards_sum_to_full_mixture(self):
+        # Single-layer invariant the ep psum relies on: computing each
+        # expert block separately (offset slices) and summing must
+        # equal the full-expert mixture. (Whole-network partials do NOT
+        # sum -- the residual stream feeds forward -- so the layer is
+        # the right place to check.)
+        cfg = llama_moe.LlamaMoEConfig.tiny()
+        params = llama_moe.init(jax.random.PRNGKey(0), cfg)
+
+        from k8s_dra_driver_gpu_tpu.models.moe import moe_ffn
+
+        lp = jax.tree_util.tree_map(lambda x: x[0], params["layers"])
+        moe_params = {"router": lp["router"], "w_in": lp["w_in"],
+                      "w_out": lp["w_out"]}
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, cfg.d_model))
+        whole, _ = moe_ffn(moe_params, x, top_k=cfg.top_k)
+        partial_sum = jnp.zeros_like(whole)
+        per_shard = cfg.n_experts // 2
+        for off in range(0, cfg.n_experts, per_shard):
+            shard = dict(
+                moe_params,
+                w_in=moe_params["w_in"][off:off + per_shard],
+                w_out=moe_params["w_out"][off:off + per_shard],
+            )
+            part, _ = moe_ffn(shard, x, top_k=cfg.top_k,
+                              expert_offset=off)
+            partial_sum = partial_sum + part
+        np.testing.assert_allclose(np.asarray(partial_sum),
+                                   np.asarray(whole), atol=2e-2, rtol=2e-2)
+
+
+class TestExpertParallelTrain:
+    @pytest.mark.parametrize("dtype,tol", [
+        # fp32 proves the sharded algorithm is exact; bf16 (the
+        # production dtype) only differs by matmul-order noise (the
+        # dense path einsums all experts at once, shards slice them).
+        (jnp.float32, 1e-5),
+        (jnp.bfloat16, 2e-2),
+    ])
+    def test_matches_single_device(self, dtype, tol):
+        import dataclasses
+
+        cfg = dataclasses.replace(llama_moe.LlamaMoEConfig.tiny(),
+                                  dtype=dtype)
+        mesh = dp_ep_mesh(dp=2, ep=4)
+        lr = 0.1
+        init_fn, step_fn, batch_shard, place = llama_moe.make_moe_train(
+            mesh, cfg, optimizer=optax.sgd(lr))
+        params = llama_moe.init(jax.random.PRNGKey(0), cfg)
+        tokens = tiny_tokens(jax.random.PRNGKey(1), B=4, S=16)
+
+        state = init_fn(place(params))
+        state, loss = step_fn(state, jax.device_put(tokens, batch_shard))
+
+        def ref_loss(p):
+            # The trainer computes the aux (load-balance) loss per
+            # dp-shard and averages -- standard data-parallel semantics
+            # (aux is nonlinear over the batch, so whole-batch aux
+            # differs slightly). Mirror that: average the loss over the
+            # dp groups.
+            return (llama_moe.loss_fn(p, tokens[:2], cfg)
+                    + llama_moe.loss_fn(p, tokens[2:], cfg)) / 2
+
+        ref_val, ref_grads = jax.value_and_grad(ref_loss)(params)
+        ref_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, ref_grads)
+        np.testing.assert_allclose(float(loss), float(ref_val),
+                                   rtol=3e-4, atol=3e-4)
+        for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                        jax.tree_util.tree_leaves(ref_params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=tol, atol=tol)
+
+    def test_expert_moments_stay_sharded(self):
+        cfg = llama_moe.LlamaMoEConfig.tiny()
+        mesh = dp_ep_mesh(dp=2, ep=4)
+        init_fn, step_fn, batch_shard, place = llama_moe.make_moe_train(
+            mesh, cfg)
+        state = init_fn(place(llama_moe.init(jax.random.PRNGKey(0), cfg)))
+        state, loss = step_fn(
+            state,
+            jax.device_put(tiny_tokens(jax.random.PRNGKey(1), B=4, S=16),
+                           batch_shard))
+        assert jnp.isfinite(loss)
+        w_in = state.params["layers"]["w_in"]
+        shard = next(iter(w_in.addressable_shards)).data
+        # E dim (axis 1) is split 4 ways over ep.
+        assert shard.shape[1] == cfg.n_experts // 4
+
+    def test_two_steps_progress(self):
+        cfg = llama_moe.LlamaMoEConfig.tiny()
+        mesh = dp_ep_mesh(dp=2, ep=4)
+        init_fn, step_fn, batch_shard, place = llama_moe.make_moe_train(
+            mesh, cfg)
+        state = init_fn(place(llama_moe.init(jax.random.PRNGKey(0), cfg)))
+        tokens = jax.device_put(
+            tiny_tokens(jax.random.PRNGKey(1), B=4, S=16), batch_shard)
+        state, l1 = step_fn(state, tokens)
+        state, l2 = step_fn(state, tokens)
+        assert int(state.step) == 2
+        assert float(l2) < float(l1)  # same batch: loss must drop
