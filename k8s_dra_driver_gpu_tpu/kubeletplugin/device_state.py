@@ -190,6 +190,9 @@ class DeviceState:
         """Stop background machinery (supervised tenancy agents)."""
         self._tenancy.shutdown()
 
+    def tenancy_agent_count(self) -> int:
+        return self._tenancy.agent_count()
+
     # -- enumeration ----------------------------------------------------------
 
     def _enumerate_allocatable(self) -> dict[str, AllocatableDevice]:

@@ -140,6 +140,7 @@ class Driver:
                 logger.exception("prepare failed for claim %s", uid)
                 out[uid] = ([], str(e))
         self.metrics.prepared_devices.set(self.state.prepared_device_count())
+        self.metrics.tenancy_agents.set(self.state.tenancy_agent_count())
         return out
 
     def _prepare_one(self, ref) -> list[dict]:
@@ -190,6 +191,7 @@ class Driver:
                 logger.exception("unprepare failed for claim %s", uid)
                 out[uid] = str(e)
         self.metrics.prepared_devices.set(self.state.prepared_device_count())
+        self.metrics.tenancy_agents.set(self.state.tenancy_agent_count())
         return out
 
     # -- ResourceSlice publication -------------------------------------------
@@ -269,6 +271,7 @@ class Driver:
         for t in taints:
             new.setdefault(t.device, []).append(t.to_dict())
         self._taints = new
+        self.metrics.set_taints(taints)
         try:
             self.publish_resources()
         except Exception:  # noqa: BLE001 - known reference gap: no retry

@@ -355,6 +355,9 @@ class MultiTenancyManager:
                         pass
         shutil.rmtree(self._dir(claim_uid), ignore_errors=True)
 
+    def agent_count(self) -> int:
+        return len(self._agents)
+
     def shutdown(self) -> None:
         """Stop every supervised agent (plugin shutdown; dirs stay --
         prepared claims survive plugin restarts via reconcile())."""

@@ -138,20 +138,20 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
-           positions: jax.Array, attn_fn=None) -> jax.Array:
-    """One transformer block: [B, S, D] -> [B, S, D].
+def attention_block(cfg, x: jax.Array, p: dict, positions: jax.Array,
+                    attn_fn=None) -> jax.Array:
+    """rms-norm -> q/k/v -> rope -> attention -> wo residual. Shared by
+    the dense and MoE model families (cfg only needs the attention
+    fields: n_heads/n_kv_heads/head_dim/dtype/rope_theta/norm_eps/
+    attn_impl).
 
     ``attn_fn(q, k, v)`` overrides the attention core -- the seam the
     sequence-parallel trainer uses to swap in ring/Ulysses attention
     (which communicate over the sp axis inside shard_map).
     """
-    p = layer_params
     dt = cfg.dtype
     B, S, _ = x.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-
-    # Attention.
     a = rms_norm(x, p["attn_norm"], cfg.norm_eps)
     q = (a @ p["wq"].astype(dt)).reshape(B, S, h, hd)
     k = (a @ p["wk"].astype(dt)).reshape(B, S, kv, hd)
@@ -162,8 +162,15 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
         attn = attn_fn(q, k, v)
     else:
         attn = attention(q, k, v, causal=True, impl=cfg.attn_impl)
-    attn = attn.reshape(B, S, h * hd)
-    x = x + attn @ p["wo"].astype(dt)
+    return x + attn.reshape(B, S, h * hd) @ p["wo"].astype(dt)
+
+
+def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
+           positions: jax.Array, attn_fn=None) -> jax.Array:
+    """One transformer block: [B, S, D] -> [B, S, D]."""
+    p = layer_params
+    dt = cfg.dtype
+    x = attention_block(cfg, x, p, positions, attn_fn)
 
     # SwiGLU MLP.
     m = rms_norm(x, p["mlp_norm"], cfg.norm_eps)

@@ -140,26 +140,8 @@ def forward(
 
     def body(carry, lp):
         x, aux_sum = carry
-        # Attention half: reuse the dense-llama block internals by
-        # calling its layer with a zeroed FFN? No -- the FFN is fused in
-        # llama._layer; re-derive the two halves here with the shared
-        # primitives instead.
-        a = llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        B, S, _ = x.shape
-        q = (a @ lp["wq"].astype(cfg.dtype)).reshape(
-            B, S, cfg.n_heads, cfg.head_dim)
-        kk = (a @ lp["wk"].astype(cfg.dtype)).reshape(
-            B, S, cfg.n_kv_heads, cfg.head_dim)
-        v = (a @ lp["wv"].astype(cfg.dtype)).reshape(
-            B, S, cfg.n_kv_heads, cfg.head_dim)
-        q = llama.rope(q, positions, cfg.rope_theta)
-        kk = llama.rope(kk, positions, cfg.rope_theta)
-        if attn_fn is not None:
-            attn = attn_fn(q, kk, v)
-        else:
-            attn = llama.attention(q, kk, v, causal=True,
-                                   impl=lcfg.attn_impl)
-        x = x + attn.reshape(B, S, -1) @ lp["wo"].astype(cfg.dtype)
+        # Attention half is identical to dense Llama: the shared block.
+        x = llama.attention_block(cfg, x, lp, positions, attn_fn)
 
         m = llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         moe_params = {"router": lp["router"], "w_in": lp["w_in"],
@@ -206,20 +188,34 @@ def make_moe_train(
     token_spec = P(dp_axis, None)
     batch_shard = NamedSharding(mesh, token_spec)
 
+    # Single source of truth for which leaves are expert-sharded: their
+    # exact shapes from the config (optimizer moments mirror them). A
+    # rank heuristic would silently misclassify any future rank-4
+    # non-expert parameter.
+    expert_shapes = {
+        (cfg.n_layers, cfg.n_experts, cfg.d_model, cfg.d_ff),
+        (cfg.n_layers, cfg.n_experts, cfg.d_ff, cfg.d_model),
+    }
+
     def leaf_spec(x) -> P:
-        """Spec for any state leaf (params AND optimizer moments, which
-        mirror the param shapes): in this model only expert tensors
-        (w_in/w_out and their adam moments) are rank-4, so rank alone
-        identifies the ep-sharded leaves."""
-        if getattr(x, "ndim", 0) == 4:
+        """Spec for any GLOBAL state leaf (params AND optimizer
+        moments, which mirror the param shapes)."""
+        if tuple(getattr(x, "shape", ())) in expert_shapes:
             return P(None, ep_axis, None, None)
         return P()
 
-    def is_expert(g) -> bool:
-        return getattr(g, "ndim", 0) == 4
-
     def local_step(state: TrainState, tokens):
         e_local = state.params["layers"]["w_in"].shape[1]
+        # Inside shard_map leaves carry LOCAL shapes: the expert dim is
+        # already split to e_local.
+        local_expert_shapes = {
+            (cfg.n_layers, e_local, cfg.d_model, cfg.d_ff),
+            (cfg.n_layers, e_local, cfg.d_ff, cfg.d_model),
+        }
+
+        def is_expert(g) -> bool:
+            return tuple(getattr(g, "shape", ())) in local_expert_shapes
+
         offset = jax.lax.axis_index(ep_axis) * e_local
         n_ep = jax.lax.psum(1, ep_axis)
         loss, grads = jax.value_and_grad(loss_fn)(

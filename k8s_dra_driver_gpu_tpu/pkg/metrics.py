@@ -53,6 +53,31 @@ class DRARequestMetrics:
             "Number of devices currently prepared for claims.",
             registry=self.registry,
         )
+        self.device_taints = Gauge(
+            "tpu_dra_device_taints",
+            "Current DRA device taints by health kind.",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.tenancy_agents = Gauge(
+            "tpu_dra_tenancy_agents",
+            "Supervised multi-tenancy enforcement agents running.",
+            registry=self.registry,
+        )
+
+    def set_taints(self, taints) -> None:
+        """Reconcile the taint gauge from the full current taint list
+        (clears kinds that no longer apply)."""
+        counts: dict[str, int] = {}
+        for t in taints:
+            kind = t.key.rsplit("/", 1)[-1]
+            counts[kind] = counts.get(kind, 0) + 1
+        seen = getattr(self, "_taint_kinds", set())
+        for kind in seen - set(counts):
+            self.device_taints.labels(kind).set(0)
+        for kind, n in counts.items():
+            self.device_taints.labels(kind).set(n)
+        self._taint_kinds = seen | set(counts)
 
     @contextmanager
     def observe(self, operation: str):

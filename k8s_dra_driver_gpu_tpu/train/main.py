@@ -46,7 +46,8 @@ def initialize_distributed(env=os.environ) -> None:
 
 def run(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tpu-train")
-    p.add_argument("--model", choices=["tiny", "llama3-8b"], default="tiny")
+    p.add_argument("--model", choices=["tiny", "llama3-8b", "moe-tiny"],
+                   default="tiny")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=128)
@@ -84,13 +85,39 @@ def run(argv: list[str] | None = None) -> int:
 
     devices = jax.devices()
     logger.info("devices: %d x %s", len(devices), devices[0].platform)
-    mesh = build_mesh(plan_for(len(devices), tp=args.tp), devices=devices)
-    logger.info("mesh: %s", dict(zip(mesh.axis_names, mesh.devices.shape)))
 
-    cfg = (llama.LlamaConfig.tiny() if args.model == "tiny"
-           else llama.LlamaConfig.llama3_8b())
-    init_fn, step_fn, batch_shard, place = make_sharded_train(mesh, cfg)
-    state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
+    if args.model == "moe-tiny":
+        # Expert-parallel family: a (dp, ep) mesh; ep takes as many
+        # devices as divide both the device count and the expert count.
+        import numpy as np  # noqa: PLC0415
+
+        from ..models import llama_moe  # noqa: PLC0415
+        from jax.sharding import Mesh  # noqa: PLC0415
+
+        cfg = llama_moe.LlamaMoEConfig.tiny()
+        ep = min(len(devices), cfg.n_experts)
+        while ep > 1 and (len(devices) % ep or cfg.n_experts % ep):
+            ep -= 1
+        dp = len(devices) // ep
+        if args.batch_size % dp:
+            p.error(f"--batch-size {args.batch_size} must be divisible "
+                    f"by dp={dp} ({len(devices)} devices / ep={ep})")
+        mesh = Mesh(np.asarray(devices[:dp * ep]).reshape(dp, ep),
+                    ("dp", "ep"))
+        logger.info("mesh: %s", dict(zip(mesh.axis_names,
+                                         mesh.devices.shape)))
+        init_fn, step_fn, batch_shard, place = llama_moe.make_moe_train(
+            mesh, cfg)
+        state = init_fn(place(llama_moe.init(jax.random.PRNGKey(0), cfg)))
+    else:
+        mesh = build_mesh(plan_for(len(devices), tp=args.tp),
+                          devices=devices)
+        logger.info("mesh: %s", dict(zip(mesh.axis_names,
+                                         mesh.devices.shape)))
+        cfg = (llama.LlamaConfig.tiny() if args.model == "tiny"
+               else llama.LlamaConfig.llama3_8b())
+        init_fn, step_fn, batch_shard, place = make_sharded_train(mesh, cfg)
+        state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
 
     ckpt = None
     if args.checkpoint_dir:

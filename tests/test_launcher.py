@@ -21,6 +21,17 @@ class TestLauncher:
                     "--batch-size", "4", "--seq-len", "16"]) == 0
         assert any("loss" in r.message for r in caplog.records)
 
+    def test_moe_tiny_run(self, caplog):
+        import logging
+
+        caplog.set_level(logging.INFO)
+        # The (dp, ep) expert-parallel family through the same launcher;
+        # on the 8-device CPU mesh this lands dp=2 x ep=4.
+        assert run(["--model", "moe-tiny", "--steps", "2",
+                    "--batch-size", "4", "--seq-len", "16"]) == 0
+        assert any("'ep'" in r.message or "ep" in str(r.message)
+                   for r in caplog.records if "mesh" in r.message)
+
     def test_resume_from_checkpoint(self, tmp_path, caplog):
         import logging
 

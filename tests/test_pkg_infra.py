@@ -291,6 +291,26 @@ class TestWorkQueue:
 
 
 class TestMetrics:
+    def test_taint_gauge_reconciles(self):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.health import DeviceTaint
+
+        m = DRARequestMetrics()
+        taint = lambda kind: DeviceTaint(  # noqa: E731
+            device="chip-0", key=f"tpu.dra.dev/{kind}", value="true",
+            effect="NoExecute")
+        m.set_taints([taint("chip_lost"), taint("pcie_aer_fatal"),
+                      taint("chip_lost")])
+
+        def value(kind):
+            return m.registry.get_sample_value(
+                "tpu_dra_device_taints", {"kind": kind})
+
+        assert value("chip_lost") == 2
+        assert value("pcie_aer_fatal") == 1
+        m.set_taints([])  # recovery clears the kinds
+        assert value("chip_lost") == 0
+        assert value("pcie_aer_fatal") == 0
+
     def test_observe_and_expose(self):
         m = DRARequestMetrics()
         with m.observe("prepare"):
