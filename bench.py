@@ -114,21 +114,38 @@ def bench_claim_churn() -> dict:
     }
 
 
-def bench_model_step() -> dict | None:
-    """Single-chip training-step perf on real TPU; None off-hardware."""
+def _tpu_device_or_none():
+    """Shared hardware guard for the on-chip model benchmarks."""
     if os.environ.get("BENCH_SKIP_MODEL"):
         return None
     try:
         import jax
-        import jax.numpy as jnp
     except ImportError:
         return None
     try:
         dev = jax.devices()[0]
     except RuntimeError:
         return None
-    if dev.platform != "tpu":
+    return dev if dev.platform == "tpu" else None
+
+
+def _bench_model_cfg():
+    """The 193M-param bench model, shared by train + decode metrics."""
+    from k8s_dra_driver_gpu_tpu.models import llama
+
+    return llama.LlamaConfig(
+        vocab_size=32_768, d_model=1024, n_layers=8, n_heads=16,
+        n_kv_heads=8, d_ff=4096,
+    )
+
+
+def bench_model_step() -> dict | None:
+    """Single-chip training-step perf on real TPU; None off-hardware."""
+    dev = _tpu_device_or_none()
+    if dev is None:
         return None
+    import jax
+    import jax.numpy as jnp
 
     from functools import partial
 
@@ -140,10 +157,7 @@ def bench_model_step() -> dict | None:
     )
 
     B, S = 8, 1024
-    cfg = llama.LlamaConfig(
-        vocab_size=32_768, d_model=1024, n_layers=8, n_heads=16,
-        n_kv_heads=8, d_ff=4096,
-    )
+    cfg = _bench_model_cfg()
     params = llama.init(jax.random.PRNGKey(0), cfg)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     optimizer = make_optimizer()
@@ -208,6 +222,43 @@ def bench_model_step() -> dict | None:
     }
 
 
+def bench_decode() -> dict | None:
+    """KV-cache decode throughput on real TPU; None off-hardware. The
+    whole generate() loop is one compiled lax.scan; the warm-up call
+    uses the SAME static args + pytree signature (temperature, key
+    structure) as the timed call so the timed region never recompiles,
+    and a different PRNG key defeats the tunnel's identical-execution
+    elision."""
+    dev = _tpu_device_or_none()
+    if dev is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_dra_driver_gpu_tpu.models import llama
+    from k8s_dra_driver_gpu_tpu.models.decode import generate
+
+    cfg = _bench_model_cfg()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    B, prompt_len, new = 8, 128, 128
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, prompt_len),
+                                0, cfg.vocab_size, jnp.int32)
+    warm = generate(params, prompt, cfg, max_new_tokens=new, max_len=512,
+                    temperature=0.7, key=jax.random.PRNGKey(6))
+    jax.block_until_ready(warm)  # pays the compile
+    t0 = time.perf_counter()
+    out = generate(params, prompt, cfg, max_new_tokens=new, max_len=512,
+                   temperature=0.7, key=jax.random.PRNGKey(7))
+    # Fetching the tokens forces real completion through the tunnel.
+    tokens = jax.device_get(out)
+    dt = time.perf_counter() - t0
+    assert tokens.shape == (B, new)
+    return {
+        "decode_tokens_per_s": round(B * new / dt),
+        "decode_step_ms": round(dt / new * 1000, 2),
+    }
+
+
 def main() -> None:
     extras: dict = {}
     try:
@@ -236,6 +287,12 @@ def main() -> None:
         model = bench_model_step()
         if model:
             extras.update(model)
+    except Exception:  # noqa: BLE001 - secondary metric must not kill bench
+        pass
+    try:
+        decode = bench_decode()
+        if decode:
+            extras.update(decode)
     except Exception:  # noqa: BLE001 - secondary metric must not kill bench
         pass
     print(

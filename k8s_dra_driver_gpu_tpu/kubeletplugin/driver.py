@@ -108,6 +108,11 @@ class Driver:
         self.cleanup.start()
         if self.health_monitor:
             self.health_monitor.start()
+        # Restart reconciliation may have respawned tenancy agents and
+        # resumed prepared claims before any RPC arrives -- the gauges
+        # must reflect that, not 0.
+        self.metrics.prepared_devices.set(self.state.prepared_device_count())
+        self.metrics.tenancy_agents.set(self.state.tenancy_agent_count())
         self.publish_resources()
 
     def stop(self) -> None:

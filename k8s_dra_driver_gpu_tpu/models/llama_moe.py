@@ -250,8 +250,9 @@ def make_moe_train(
 
     def step_fn(state, tokens):
         # The optimizer-state pytree structure is optax-internal; build
-        # the spec tree from the live state by leaf rank (cached per
-        # structure) instead of hard-coding optax internals.
+        # the spec tree from the live state by exact expert-tensor
+        # shapes (cached per structure) instead of hard-coding optax
+        # internals.
         key = jax.tree_util.tree_structure(state)
         if key not in compiled:
             state_specs = jax.tree_util.tree_map(leaf_spec, state)

@@ -94,6 +94,9 @@ def run(argv: list[str] | None = None) -> int:
         from ..models import llama_moe  # noqa: PLC0415
         from jax.sharding import Mesh  # noqa: PLC0415
 
+        if args.tp and args.tp != 1:
+            p.error("--tp applies to the dense families only; "
+                    "--model moe-tiny uses a (dp, ep) mesh")
         cfg = llama_moe.LlamaMoEConfig.tiny()
         ep = min(len(devices), cfg.n_experts)
         while ep > 1 and (len(devices) % ep or cfg.n_experts % ep):
