@@ -16,16 +16,29 @@ import traceback
 DUMP_PATH = "/tmp/thread-stacks.dump"
 
 
-def dump_thread_stacks(path: str = DUMP_PATH) -> None:
+def format_thread_stacks() -> str:
     frames = sys._current_frames()
+    out = []
+    for thread in threading.enumerate():
+        out.append(f"--- {thread.name} (ident {thread.ident}, "
+                   f"daemon={thread.daemon}) ---\n")
+        frame = frames.get(thread.ident)
+        if frame is not None:
+            out.append("".join(traceback.format_stack(frame)))
+        out.append("\n")
+    return "".join(out)
+
+
+def dump_thread_stacks(path: str = DUMP_PATH) -> None:
     with open(path, "w", encoding="utf-8") as f:
-        for thread in threading.enumerate():
-            f.write(f"--- {thread.name} (ident {thread.ident}, "
-                    f"daemon={thread.daemon}) ---\n")
-            frame = frames.get(thread.ident)
-            if frame is not None:
-                f.write("".join(traceback.format_stack(frame)))
-            f.write("\n")
+        f.write(format_thread_stacks())
+
+
+def debug_stacks_endpoint() -> tuple[int, str, bytes]:
+    """Live thread stacks as text (the reference mounts net/http/pprof
+    on its diagnostics mux, compute-domain-controller main.go:383-390;
+    this is the in-process analog, also reachable via SIGUSR1)."""
+    return 200, "text/plain", format_thread_stacks().encode()
 
 
 def start_debug_signal_handlers(path: str = DUMP_PATH) -> None:

@@ -1,4 +1,4 @@
-"""Shared single-endpoint HTTP server (metrics, healthz, ...)."""
+"""Shared small HTTP server (metrics, healthz, debug, ...)."""
 
 from __future__ import annotations
 
@@ -11,20 +11,29 @@ EndpointFn = Callable[[], tuple[int, str, bytes]]
 
 
 class SimpleHTTPEndpoint:
-    """Serves GET <path> from ``fn``; anything else 404s."""
+    """Serves GET <path> from ``fn``; ``extra`` adds more path->fn
+    routes on the same listener (e.g. /metrics + /debug/stacks).
+    Anything else 404s."""
 
     def __init__(self, path: str, fn: EndpointFn, host: str = "127.0.0.1",
-                 port: int = 0, thread_name: str = "http-endpoint"):
-        endpoint_path = path.rstrip("/")
+                 port: int = 0, thread_name: str = "http-endpoint",
+                 extra: dict[str, EndpointFn] | None = None):
+        routes = {path.rstrip("/"): fn}
+        routes.update({p.rstrip("/"): f for p, f in (extra or {}).items()})
+        default = path.rstrip("/")
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - http.server API
                 got = self.path.split("?", 1)[0].rstrip("/")
-                if got not in ("", endpoint_path):
+                # Exact route first ("" can be a registered root route);
+                # a bare "/" falls back to the primary endpoint.
+                handler = routes.get(got, routes.get(default)
+                                     if got == "" else None)
+                if handler is None:
                     self.send_response(404)
                     self.end_headers()
                     return
-                status, ctype, body = fn()
+                status, ctype, body = handler()
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))

@@ -19,6 +19,7 @@ from prometheus_client import (
     generate_latest,
 )
 
+from .debug import debug_stacks_endpoint
 from .httpserver import SimpleHTTPEndpoint
 
 _BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)
@@ -113,7 +114,9 @@ class ComputeDomainMetrics:
 
 
 class MetricsServer(SimpleHTTPEndpoint):
-    """Prometheus exposition server (reference prometheus_httpserver.go)."""
+    """Prometheus exposition server (reference prometheus_httpserver.go)
+    + the pprof-analog /debug/stacks route (the reference mounts pprof
+    on the same diagnostics mux, controller main.go:383-390)."""
 
     def __init__(self, registry: CollectorRegistry, host: str = "127.0.0.1",
                  port: int = 0):
@@ -122,4 +125,5 @@ class MetricsServer(SimpleHTTPEndpoint):
             lambda: (200, "text/plain; version=0.0.4",
                      generate_latest(registry)),
             host=host, port=port, thread_name="metrics-http",
+            extra={"/debug/stacks": debug_stacks_endpoint},
         )

@@ -311,6 +311,21 @@ class TestMetrics:
         assert value("chip_lost") == 0
         assert value("pcie_aer_fatal") == 0
 
+    def test_debug_stacks_route(self):
+        m = DRARequestMetrics()
+        srv = MetricsServer(m.registry)
+        srv.start()
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/stacks", timeout=5
+            ) as resp:
+                body = resp.read().decode()
+            assert "MainThread" in body
+        finally:
+            srv.stop()
+
     def test_observe_and_expose(self):
         m = DRARequestMetrics()
         with m.observe("prepare"):
