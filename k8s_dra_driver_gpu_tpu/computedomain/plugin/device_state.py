@@ -35,6 +35,7 @@ from ...kubeletplugin.checkpoint import (
 )
 from ...kubeletplugin.claim import ResourceClaim
 from ...pkg.kubeclient import NotFoundError
+from ...pkg.timing import SegmentTimer
 from ...pkg.workqueue import PermanentError
 from .. import (
     API_GROUP,
@@ -122,17 +123,24 @@ class CDDeviceState:
     # -- prepare ------------------------------------------------------------------
 
     def prepare(self, claim: ResourceClaim) -> list[str]:
+        # Per-segment timings (the reference CD plugin logs the same
+        # t_prep_* breakdown); the segments double as the fault-
+        # injection seams the robustness suite uses.
+        timer = SegmentTimer("cd_prepare", claim.uid)
         with self._lock:
-            cp = self._checkpoint.get()
+            with timer.segment("cd_get_checkpoint"):
+                cp = self._checkpoint.get()
             existing = cp.claims.get(claim.uid)
             if existing and existing.state == ClaimState.PREPARE_COMPLETED.value:
                 return [i for d in existing.devices for i in d.cdi_device_ids]
 
             cfg = self._decode_config(claim)
             if isinstance(cfg, ComputeDomainChannelConfig):
-                edits, devices = self._prepare_channel(claim, cfg)
+                with timer.segment("cd_prepare_channel"):
+                    edits, devices = self._prepare_channel(claim, cfg)
             elif isinstance(cfg, ComputeDomainDaemonConfig):
-                edits, devices = self._prepare_daemon(claim, cfg)
+                with timer.segment("cd_prepare_daemon"):
+                    edits, devices = self._prepare_daemon(claim, cfg)
             else:
                 raise PermanentError(
                     f"config kind {type(cfg).__name__} not valid for "
@@ -140,9 +148,10 @@ class CDDeviceState:
                 )
 
             device_edits = {d: ContainerEdits() for d in devices}
-            cdi_ids = self._cdi.create_claim_spec_file(
-                claim.uid, device_edits, edits
-            )
+            with timer.segment("cd_write_cdi_spec"):
+                cdi_ids = self._cdi.create_claim_spec_file(
+                    claim.uid, device_edits, edits
+                )
 
             def complete(c):
                 c.claims[claim.uid] = CheckpointedClaim(
@@ -159,7 +168,9 @@ class CDDeviceState:
                     ],
                 )
 
-            self._checkpoint.update(complete)
+            with timer.segment("cd_checkpoint_write"):
+                self._checkpoint.update(complete)
+            timer.done()
             return cdi_ids
 
     def _decode_config(self, claim: ResourceClaim):

@@ -85,12 +85,16 @@ def ring_attention(
     B, S, H, hd = q.shape
     q_offset = my * S
 
-    # pvary: the carry must be device-varying over the ring axis from the
+    # The carry must be device-varying over the ring axis from the
     # start (shard_map vma typing), since the loop outputs are.
-    o0 = jax.lax.pvary(jnp.zeros((B, S, H, hd), jnp.float32), (axis_name,))
-    m0 = jax.lax.pvary(
-        jnp.full((B, S, H, 1), NEG_INF, jnp.float32), (axis_name,))
-    l0 = jax.lax.pvary(jnp.zeros((B, S, H, 1), jnp.float32), (axis_name,))
+    def vary(x):
+        if hasattr(jax.lax, "pcast"):  # jax >= the pvary deprecation
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        return jax.lax.pvary(x, (axis_name,))
+
+    o0 = vary(jnp.zeros((B, S, H, hd), jnp.float32))
+    m0 = vary(jnp.full((B, S, H, 1), NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((B, S, H, 1), jnp.float32))
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 

@@ -116,14 +116,29 @@ class ComputeDomainMetrics:
 class MetricsServer(SimpleHTTPEndpoint):
     """Prometheus exposition server (reference prometheus_httpserver.go)
     + the pprof-analog /debug/stacks route (the reference mounts pprof
-    on the same diagnostics mux, controller main.go:383-390)."""
+    on the same diagnostics mux, controller main.go:383-390).
+
+    Stack traces disclose internal state, so like the reference's
+    opt-in --pprof-path the debug route is only served when the
+    listener is loopback-bound or explicitly enabled
+    (TPU_DRA_DEBUG_HTTP=1); production metrics bind 0.0.0.0 and keep
+    it off. SIGUSR1 remains the always-available dump path."""
 
     def __init__(self, registry: CollectorRegistry, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, debug_endpoints: bool | None = None):
+        if debug_endpoints is None:
+            import os  # noqa: PLC0415
+
+            debug_endpoints = (
+                host in ("127.0.0.1", "localhost", "::1")
+                or os.environ.get("TPU_DRA_DEBUG_HTTP") == "1"
+            )
+        extra = {"/debug/stacks": debug_stacks_endpoint} \
+            if debug_endpoints else None
         super().__init__(
             "/metrics",
             lambda: (200, "text/plain; version=0.0.4",
                      generate_latest(registry)),
             host=host, port=port, thread_name="metrics-http",
-            extra={"/debug/stacks": debug_stacks_endpoint},
+            extra=extra,
         )

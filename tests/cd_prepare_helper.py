@@ -1,0 +1,85 @@
+"""Subprocess helper for CD-plugin robustness tests: one channel-claim
+prepare against a CDDeviceState root, with fault injection via the
+TPU_DRA_CRASH_AT_SEGMENT seam. The ComputeDomain CR is seeded Ready in
+a scratch FakeKubeClient persisted per call (each subprocess reseeds).
+
+    python -m tests.cd_prepare_helper <root> <uid> \
+        [prepare|prepare-daemon|unprepare]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state import (  # noqa: E402
+    CDDeviceState,
+)
+from k8s_dra_driver_gpu_tpu.kubeletplugin.claim import ResourceClaim  # noqa: E402
+from tests.fake_kube import make_claim_dict  # noqa: E402
+from k8s_dra_driver_gpu_tpu.computedomain import (  # noqa: E402
+    API_GROUP,
+    API_VERSION,
+)
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient  # noqa: E402
+
+CD_UID = "u-cd-rob"
+
+
+def seed_kube() -> FakeKubeClient:
+    kube = FakeKubeClient()
+    kube.create(API_GROUP, API_VERSION, "computedomains", {
+        "apiVersion": f"{API_GROUP}/{API_VERSION}",
+        "kind": "ComputeDomain",
+        "metadata": {"name": "cd-rob", "namespace": "default",
+                     "uid": CD_UID},
+        "spec": {"numNodes": 1},
+        "status": {"status": "Ready", "nodes": [
+            {"name": "n1", "index": 0, "ipAddress": "10.0.0.1",
+             "status": "Ready"},
+        ]},
+    }, namespace="default")
+    return kube
+
+
+def make_cd_claim(uid: str, kind: str) -> ResourceClaim:
+    if kind == "daemon":
+        device, request = "daemon", "daemon"
+        config_kind = "ComputeDomainDaemonConfig"
+    else:
+        device, request = "channel-0", "channel"
+        config_kind = "ComputeDomainChannelConfig"
+    return ResourceClaim.from_dict(
+        make_claim_dict(
+            uid, [device], request=request,
+            driver="compute-domain.tpu.dra.dev",
+            configs=[{
+                "parameters": {
+                    "apiVersion": f"{API_GROUP}/{API_VERSION}",
+                    "kind": config_kind,
+                    "domainID": CD_UID,
+                },
+                "requests": [request],
+            }],
+        ),
+        driver="compute-domain.tpu.dra.dev",
+    )
+
+
+def main() -> int:
+    root, uid = sys.argv[1], sys.argv[2]
+    action = sys.argv[3] if len(sys.argv) > 3 else "prepare"
+    state = CDDeviceState(root, seed_kube(), node_name="n1",
+                          use_informer=True)
+    if action in ("prepare", "prepare-daemon"):
+        kind = "daemon" if action == "prepare-daemon" else "channel"
+        ids = state.prepare(make_cd_claim(uid, kind))
+        print(f"ok {action} {uid} {ids}")
+    else:
+        state.unprepare(uid)
+        print(f"ok unprepare {uid}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

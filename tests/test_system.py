@@ -228,6 +228,41 @@ class TestKill9RobustnessSweep:
             assert json.loads(reg.read_text() or "{}") in ({}, [])
 
 
+CD_PREPARE_SEGMENTS = [
+    ("cd_get_checkpoint", "prepare"),
+    ("cd_prepare_channel", "prepare"),
+    ("cd_prepare_daemon", "prepare-daemon"),
+    ("cd_write_cdi_spec", "prepare"),
+    ("cd_checkpoint_write", "prepare"),
+]
+
+
+class TestCDKill9Robustness:
+    """SIGKILL at each CD-plugin prepare segment (channel AND daemon
+    claim paths); a fresh process must retry the same claim to
+    completion (the CD half of the reference's robustness coverage,
+    test_cd_*.bats)."""
+
+    @pytest.mark.parametrize("segment,action", CD_PREPARE_SEGMENTS)
+    def test_crash_then_recover(self, tmp_path, segment, action):
+        def run_cd(uid, act, extra_env=None):
+            return subprocess.run(
+                [sys.executable, "-m", "tests.cd_prepare_helper",
+                 str(tmp_path / "root"), uid, act],
+                env={**ENV, **(extra_env or {})}, capture_output=True,
+                text=True, timeout=60, cwd=REPO,
+            )
+
+        crashed = run_cd("cd-rob-1", action, extra_env={
+            "TPU_DRA_CRASH_AT_SEGMENT": segment})
+        assert crashed.returncode == 86, (
+            crashed.stdout + crashed.stderr)
+        retried = run_cd("cd-rob-1", action)
+        assert retried.returncode == 0, retried.stdout + retried.stderr
+        done = run_cd("cd-rob-1", "unprepare")
+        assert done.returncode == 0, done.stdout + done.stderr
+
+
 class TestUpDowngradeHandover:
     """Two plugin processes contending the node-global pu.lock
     mid-claim; the old one is SIGKILLed (upgrade rollout) and the new
