@@ -261,6 +261,17 @@ def bench_decode() -> dict | None:
 
 def main() -> None:
     extras: dict = {}
+    t_start = time.monotonic()
+    # Wall-clock guard: the on-chip extras (compiles over the tunnel)
+    # must never starve the primary metric of its runner budget.
+    try:
+        budget_s = float(os.environ.get("BENCH_TIME_BUDGET", "480"))
+    except ValueError:
+        budget_s = 480.0  # a bad knob must not kill the primary metric
+
+    def budget_left() -> bool:
+        return time.monotonic() - t_start < budget_s
+
     try:
         p50 = bench_claim_prepare()
         metric = "dra_claim_prepare_p50"
@@ -284,15 +295,17 @@ def main() -> None:
     except Exception:  # noqa: BLE001 - secondary metric must not kill bench
         pass
     try:
-        model = bench_model_step()
-        if model:
-            extras.update(model)
+        if budget_left():
+            model = bench_model_step()
+            if model:
+                extras.update(model)
     except Exception:  # noqa: BLE001 - secondary metric must not kill bench
         pass
     try:
-        decode = bench_decode()
-        if decode:
-            extras.update(decode)
+        if budget_left():
+            decode = bench_decode()
+            if decode:
+                extras.update(decode)
     except Exception:  # noqa: BLE001 - secondary metric must not kill bench
         pass
     print(
