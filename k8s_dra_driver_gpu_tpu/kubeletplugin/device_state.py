@@ -54,7 +54,11 @@ from .deviceinfo import (
 )
 from .vfio import VfioPciManager, VfioRegistry
 from .sharing import MultiTenancyManager, TimeSlicingManager
-from .subslice import SubSliceLiveTuple, enumerate_subslice_devices
+from .subslice import (
+    SubSliceLiveTuple,
+    SubSliceSpecTuple,
+    enumerate_subslice_devices,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -76,6 +80,11 @@ class Config:
     # Production default; mock configs default it off so unit tests don't
     # pay a child-process spawn per tenancy Prepare.
     tenancy_agents: bool = True
+    # Admin-pre-carved static sub-slices (the static-MIG analog,
+    # mig-parted style): canonical names like "ss-2x1x1-0" or
+    # "chip-0-ss-1c-1". Published as-is; Prepare does not create (and
+    # Unprepare does not destroy) a carve-out for them.
+    static_subslices: tuple[str, ...] = ()
 
     @classmethod
     def mock(
@@ -229,8 +238,12 @@ class DeviceState:
                 out[info.canonical_name] = AllocatableDevice(
                     kind=DeviceKind.PASSTHROUGH, passthrough=info
                 )
-        if self._config.feature_gates.is_enabled(DYNAMIC_SUB_SLICE) and not degraded:
-            for spec in enumerate_subslice_devices(self.host, self._profiles):
+        all_specs = (
+            enumerate_subslice_devices(self.host, self._profiles)
+            if not degraded else []
+        )
+        if self._config.feature_gates.is_enabled(DYNAMIC_SUB_SLICE):
+            for spec in all_specs:
                 # Full-host carve-outs duplicate the chip set; still
                 # published (schedulers pick by shape), reference
                 # publishes the full-GPU MIG profile too.
@@ -238,6 +251,37 @@ class DeviceState:
                 out[info.canonical_name] = AllocatableDevice(
                     kind=DeviceKind.SUBSLICE_DYNAMIC, subslice=info
                 )
+        if self._config.static_subslices:
+            if degraded:
+                # Like the dynamic path: a host missing chips cannot
+                # trust the placement grid -- keep the surviving whole
+                # chips published and warn, never crash-loop the plugin
+                # over a carve-out it can't honor right now.
+                logger.warning(
+                    "degraded host: not publishing static sub-slices %s",
+                    list(self._config.static_subslices),
+                )
+            else:
+                valid = {s.canonical_name() for s in all_specs}
+                for name in self._config.static_subslices:
+                    if name not in valid:
+                        # A bad NAME is a config error on a healthy
+                        # host: fail startup loudly rather than
+                        # silently publishing less than declared.
+                        raise ValueError(
+                            f"static sub-slice {name!r} is not a valid "
+                            f"carve-out for this host "
+                            f"({self.host.accelerator_type or 'unknown'})"
+                        )
+                    spec = SubSliceSpecTuple.from_canonical_name(name)
+                    info = SubSliceInfo(spec=spec, host=self.host,
+                                        dynamic=False)
+                    # Static wins over the identically-named dynamic
+                    # device: the admin carved it; it must not be torn
+                    # down at Unprepare.
+                    out[info.canonical_name] = AllocatableDevice(
+                        kind=DeviceKind.SUBSLICE_STATIC, subslice=info
+                    )
         return out
 
     def _cleanup_all_side_state(self) -> None:

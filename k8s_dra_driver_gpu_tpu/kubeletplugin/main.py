@@ -73,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ResourceSlice publication mode; auto sniffs the "
                         "server version (reference driver.go:190,574) "
                         "[PUBLICATION_MODE]")
+    p.add_argument("--static-subslices",
+                   default=env("STATIC_SUBSLICES", ""),
+                   help="comma-separated admin-pre-carved sub-slices "
+                        "(static-MIG analog), e.g. "
+                        "'ss-2x1x1-0,chip-0-ss-1c-1' [STATIC_SUBSLICES]")
     p.add_argument("--additional-health-kinds-to-ignore",
                    default=env("ADDITIONAL_HEALTH_KINDS_TO_IGNORE", ""),
                    help="comma-separated health kinds never tainted "
@@ -115,6 +120,9 @@ def run(argv: list[str] | None = None) -> int:
         tpulib_opts=EnumerateOptions(
             mock_topology=args.mock_topology,
             worker_id=args.mock_worker_id if args.mock_topology else None,
+        ),
+        static_subslices=tuple(
+            s.strip() for s in args.static_subslices.split(",") if s.strip()
         ),
     )
     node_name = args.node_name or os.uname().nodename

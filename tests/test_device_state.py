@@ -141,6 +141,73 @@ class TestPrepare:
         v5p_state.unprepare("c2")
         assert v5p_state._timeslicing.current(0) is None
 
+    def test_static_subslice_published_prepared_not_destroyed(
+        self, tmp_path
+    ):
+        # Static-MIG analog: admin-pre-carved sub-slices are published
+        # as static devices; Prepare injects the same bounds env but
+        # creates no live carve-out, and Unprepare tears nothing down.
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            Config.mock(root=str(tmp_path / "root")),
+            # v5e has single-core chips: no "1c" core-level profile;
+            # two chip-level carve-outs exercise the static path.
+            static_subslices=("ss-1x1-0", "ss-2x1-0"),
+        )
+        state = DeviceState(cfg)
+        # Static replaces the same-name dynamic device (DynamicSubSlice
+        # is on in Config.mock, so a dynamic "ss-1x1-0" existed first).
+        dev = state.allocatable["ss-1x1-0"]
+        assert dev.kind.value == "subslice-static"
+        assert not dev.subslice.dynamic
+
+        state.prepare(make_claim("c-static", ["ss-1x1-0"]))
+        cp = state._checkpoint.get().claims["c-static"]
+        assert cp.devices[0].live is None  # nothing to destroy later
+        assert state._registry.list() == {}
+        spec = state._cdi.read_spec("c-static")
+        env = [e for d in spec["devices"]
+               for e in d["containerEdits"].get("env", [])]
+        assert any(e.startswith("TPU_CHIPS_PER_HOST_BOUNDS") for e in env)
+        state.unprepare("c-static")
+        assert "ss-1x1-0" in state.allocatable  # still published
+
+    def test_static_subslice_degraded_host_skips_not_crashes(
+        self, tmp_path, monkeypatch
+    ):
+        # A host missing chips keeps serving (whole chips published,
+        # statics skipped with a warning) -- a runtime chip failure must
+        # never crash-loop the plugin over configured carve-outs.
+        import dataclasses
+
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import EnumerateOptions
+
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        for i in [0, 1, 2]:  # one of 4 chips missing
+            (dev / f"accel{i}").touch()
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-4")
+        cfg = dataclasses.replace(
+            Config.mock(root=str(tmp_path / "root")),
+            tpulib_opts=EnumerateOptions(dev_root=str(dev),
+                                         sys_root=str(tmp_path)),
+            static_subslices=("ss-1x1-0",),
+        )
+        state = DeviceState(cfg)
+        assert "chip-0" in state.allocatable  # survivors still served
+        assert "ss-1x1-0" not in state.allocatable
+
+    def test_static_subslice_invalid_name_fails_startup(self, tmp_path):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            Config.mock(root=str(tmp_path / "root")),
+            static_subslices=("ss-9x9x9-0",),
+        )
+        with pytest.raises(ValueError, match="static sub-slice"):
+            DeviceState(cfg)
+
     def test_multi_tenancy_manifest_covers_all_devices(self, state):
         cfgs = [{
             "parameters": opaque("TpuConfig", sharing={
