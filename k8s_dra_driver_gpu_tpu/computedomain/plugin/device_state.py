@@ -225,12 +225,24 @@ class CDDeviceState:
             node0.get("ipAddress") if node0 and node0.get("ipAddress")
             else daemon_dns_name(0)
         )
+        # Worker addresses by gang index (libtpu's multi-host contract
+        # alongside coordinator/process id). Like the coordinator above,
+        # emit registered pod IPs: workload pods have no resolver entry
+        # for the daemon DNS names (those live in the daemons' own
+        # /etc/hosts; the name<->IP map rides members.json). Ready nodes
+        # only, so the list length always equals TPU_NUM_PROCESSES.
+        ready = self._ready_nodes(cd)
+        hostnames = ",".join(
+            n.get("ipAddress") or daemon_dns_name(n.get("index", 0))
+            for n in sorted(ready, key=lambda n: n.get("index", 0))
+        )
         edits = ContainerEdits(
             env=[
                 f"COMPUTE_DOMAIN_UUID={cfg.domain_id}",
                 f"TPU_COORDINATOR_ADDRESS={coordinator_host}:{port}",
                 f"TPU_PROCESS_ID={node.get('index', 0)}",
-                f"TPU_NUM_PROCESSES={len(self._ready_nodes(cd))}",
+                f"TPU_NUM_PROCESSES={len(ready)}",
+                f"TPU_WORKER_HOSTNAMES={hostnames}",
                 "TPU_DOMAIN_CHANNELS="
                 + ("all" if cfg.allocation_mode == "All"
                    else ",".join(sorted(channels))),

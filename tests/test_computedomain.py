@@ -331,6 +331,9 @@ class TestGangFlow:
             assert "TPU_COORDINATOR_ADDRESS=127.0.0.1:7077" in env
             assert "TPU_PROCESS_ID=0" in env
             assert "TPU_NUM_PROCESSES=2" in env
+            # Worker addresses are registered pod IPs (workloads cannot
+            # resolve the daemon DNS names), one per ready process.
+            assert "TPU_WORKER_HOSTNAMES=127.0.0.1,127.0.0.1" in env
             # Channel mount points at the per-domain state dir the daemon
             # writes into.
             mount = spec["containerEdits"]["mounts"][0]

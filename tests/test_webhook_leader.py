@@ -127,6 +127,41 @@ class TestWebhookHTTP:
             server.stop()
 
 
+class TestWebhookTLS:
+    def test_https_with_bootstrap_cert(self, tmp_path):
+        """Integration of the two TLS halves: the bootstrap-generated
+        cert serves the webhook over HTTPS and a client trusting that
+        cert (as the API server would via the patched caBundle)
+        validates an admission review end to end."""
+        import ssl
+
+        from k8s_dra_driver_gpu_tpu.webhook.certbootstrap import (
+            generate_self_signed,
+        )
+
+        cert_pem, key_pem = generate_self_signed("tpu-dra-webhook", "ns1")
+        cert = tmp_path / "tls.crt"
+        key = tmp_path / "tls.key"
+        cert.write_bytes(cert_pem)
+        key.write_bytes(key_pem)
+        server = WebhookServer(host="127.0.0.1", port=0,
+                               tls_cert=str(cert), tls_key=str(key))
+        server.start()
+        try:
+            ctx = ssl.create_default_context(cadata=cert_pem.decode())
+            ctx.check_hostname = False  # SANs name the k8s service
+            body = json.dumps(review(claim_with_config(BAD_FIELD))).encode()
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{server.port}{VALIDATE_PATH}",
+                data=body, headers={"Content-Type": "application/json"},
+            )
+            out = json.loads(
+                urllib.request.urlopen(req, context=ctx, timeout=10).read())
+            assert not out["response"]["allowed"]
+        finally:
+            server.stop()
+
+
 class TestCertBootstrap:
     """Webhook TLS bootstrap (webhook/certbootstrap.py): self-signed
     cert -> Secret + ValidatingWebhookConfiguration caBundle patch."""
