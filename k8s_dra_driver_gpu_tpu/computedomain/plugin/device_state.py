@@ -225,23 +225,27 @@ class CDDeviceState:
             node0.get("ipAddress") if node0 and node0.get("ipAddress")
             else daemon_dns_name(0)
         )
-        # Worker addresses by gang index (libtpu's multi-host contract
-        # alongside coordinator/process id). Like the coordinator above,
-        # emit registered pod IPs: workload pods have no resolver entry
-        # for the daemon DNS names (those live in the daemons' own
-        # /etc/hosts; the name<->IP map rides members.json). Ready nodes
-        # only, so the list length always equals TPU_NUM_PROCESSES.
-        ready = self._ready_nodes(cd)
+        # Worker addresses POSITIONAL BY PROCESS ID (libtpu's multi-host
+        # contract): entry i must be worker i's address, and the list
+        # length must equal TPU_NUM_PROCESSES, so both derive from the
+        # gang size the spec declares -- never from whichever subset of
+        # nodes happens to be registered/Ready in a cached status (a
+        # gap would shift every later process's mapping). Like the
+        # coordinator above, emit registered pod IPs (workload pods
+        # can't resolve the daemon DNS names); an unregistered slot
+        # falls back to its stable DNS name.
+        expected = self._expected_workers(cd)
+        by_index = {n.get("index"): n for n in nodes}
         hostnames = ",".join(
-            n.get("ipAddress") or daemon_dns_name(n.get("index", 0))
-            for n in sorted(ready, key=lambda n: n.get("index", 0))
+            by_index.get(i, {}).get("ipAddress") or daemon_dns_name(i)
+            for i in range(expected)
         )
         edits = ContainerEdits(
             env=[
                 f"COMPUTE_DOMAIN_UUID={cfg.domain_id}",
                 f"TPU_COORDINATOR_ADDRESS={coordinator_host}:{port}",
                 f"TPU_PROCESS_ID={node.get('index', 0)}",
-                f"TPU_NUM_PROCESSES={len(ready)}",
+                f"TPU_NUM_PROCESSES={expected}",
                 f"TPU_WORKER_HOSTNAMES={hostnames}",
                 "TPU_DOMAIN_CHANNELS="
                 + ("all" if cfg.allocation_mode == "All"
@@ -336,6 +340,11 @@ class CDDeviceState:
         with self._lock:
             cp = self._checkpoint.get()
             if claim_uid not in cp.claims:
+                # Single-phase prepare: a crash between the CDI write
+                # and the (only) checkpoint write leaves a spec file
+                # with no claim record -- delete it here so claim
+                # deletion cleans the orphan (idempotent).
+                self._cdi.delete_claim_spec_file(claim_uid)
                 return
             self._cdi.delete_claim_spec_file(claim_uid)
             self._checkpoint.update(

@@ -417,6 +417,11 @@ class DeviceState:
 
                 self._validate_no_overlap(cp, claim)
 
+                # Resolve + validate configs BEFORE the PrepareStarted
+                # write: a claim with a bad config now fails without
+                # ever touching the checkpoint (no write+rollback pair).
+                cfgs = self._resolve_configs(claim)
+
                 with timer.segment("checkpoint_write_started"):
                     self._checkpoint.update(
                         lambda c: c.claims.__setitem__(
@@ -432,7 +437,7 @@ class DeviceState:
 
                 try:
                     with timer.segment("prep_devices"):
-                        prepared = self._prepare_devices(claim, timer)
+                        prepared = self._prepare_devices(claim, timer, cfgs)
                 except BaseException:
                     # _prepare_devices rolled back its own partial device
                     # state; drop the PrepareStarted checkpoint entry.
@@ -544,7 +549,7 @@ class DeviceState:
         return per_request
 
     def _prepare_devices(
-        self, claim: ResourceClaim, timer: SegmentTimer
+        self, claim: ResourceClaim, timer: SegmentTimer, cfgs=None
     ) -> list[CheckpointedDevice]:
         """All-or-nothing: any failure rolls back the partial device state
         created by this attempt (carve-outs, sharing state, CDI spec)
@@ -555,7 +560,8 @@ class DeviceState:
         touched_chips: set[int] = set()
         try:
             return self._prepare_devices_inner(
-                claim, created_live, configured_vfio, touched_chips, timer
+                claim, created_live, configured_vfio, touched_chips, timer,
+                cfgs,
             )
         except BaseException:
             for live_uuid in created_live:
@@ -574,8 +580,10 @@ class DeviceState:
         configured_vfio: list[str],
         touched_chips: set[int],
         timer: SegmentTimer,
+        cfgs=None,
     ) -> list[CheckpointedDevice]:
-        cfgs = self._resolve_configs(claim)
+        if cfgs is None:
+            cfgs = self._resolve_configs(claim)
         prepared: list[CheckpointedDevice] = []
         device_edits: dict[str, ContainerEdits] = {}
         claim_chips: set[int] = set()
@@ -736,7 +744,12 @@ class DeviceState:
             cp = self._checkpoint.get()
             existing = cp.claims.get(claim_uid)
             if existing is None:
-                return  # noop: never prepared or already unprepared
+                # Never prepared or already unprepared. Defensive spec
+                # delete (idempotent): this plugin's own two-phase flow
+                # can't leave a spec without a checkpoint entry, but an
+                # externally-manipulated/cross-version state root might.
+                self._cdi.delete_claim_spec_file(claim_uid)
+                return
             self._rollback(existing)
 
     def _rollback(self, checkpointed: CheckpointedClaim) -> None:

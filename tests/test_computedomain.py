@@ -385,6 +385,19 @@ class TestGangFlow:
             [{"uid": "c2", "namespace": "team-a", "name": "c2"}])
         assert out3["c2"][1] == ""
 
+    def test_unprepare_cleans_orphan_cdi_spec(self, kube, tmp_path):
+        # Single-phase CD prepare: a crash between the spec write and
+        # the checkpoint write leaves an orphan spec; unprepare for the
+        # never-completed claim must remove it.
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.cdi import ContainerEdits
+
+        st = CDDeviceState(str(tmp_path / "st"), kube, "node-0")
+        st._cdi.create_claim_spec_file("orphan",
+                                       {"channel-0": ContainerEdits()})
+        assert st._cdi.spec_exists("orphan")
+        st.unprepare("orphan")
+        assert not st._cdi.spec_exists("orphan")
+
     def test_stale_domain_dir_gc(self, kube, tmp_path):
         cd = make_cd(kube)
         uid = cd["metadata"]["uid"]

@@ -173,6 +173,31 @@ class TestPrepare:
         state.unprepare("c-static")
         assert "ss-1x1-0" in state.allocatable  # still published
 
+    def test_crash_orphaned_cdi_spec_cleaned_by_unprepare(
+        self, tmp_path
+    ):
+        # A crash can leave a CDI spec with no checkpoint entry (the
+        # spec write precedes the completed write); a fresh instance
+        # re-prepares idempotently, and an unprepare for a
+        # never-completed claim still removes the orphan spec file.
+        root = str(tmp_path / "root")
+        s1 = DeviceState(Config.mock(root=root))
+        # Simulate the crash window: spec written, checkpoint not.
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.cdi import ContainerEdits
+
+        s1._cdi.create_claim_spec_file("c-orphan",
+                                       {"chip-0": ContainerEdits()})
+        assert s1._cdi.spec_exists("c-orphan")
+        s2 = DeviceState(Config.mock(root=root))
+        s2.unprepare("c-orphan")  # kubelet unprepares on claim deletion
+        assert not s2._cdi.spec_exists("c-orphan")
+        # And a retried prepare works from the same half-state.
+        s1._cdi.create_claim_spec_file("c-retry",
+                                       {"chip-0": ContainerEdits()})
+        s2.prepare(make_claim("c-retry", ["chip-0"]))
+        cp = s2._checkpoint.get().claims["c-retry"]
+        assert cp.state == "PrepareCompleted"
+
     def test_static_subslice_degraded_host_skips_not_crashes(
         self, tmp_path, monkeypatch
     ):
