@@ -9,7 +9,9 @@ TPU coordination-service stub (rendezvous.py).
 
 from __future__ import annotations
 
+import ctypes
 import logging
+import os
 import signal
 import subprocess
 import threading
@@ -20,11 +22,37 @@ logger = logging.getLogger(__name__)
 TERM_GRACE_S = 5.0
 RESTART_BACKOFF_S = 1.0
 
+_PR_SET_PDEATHSIG = 1  # linux/prctl.h
+
+# Resolved at import: preexec_fn runs between fork and exec in a
+# multithreaded process, where dlopen/malloc can deadlock on locks some
+# other thread held at fork time -- only the pre-resolved call is safe
+# there.
+try:
+    _LIBC = ctypes.CDLL(None, use_errno=True)
+    _LIBC.prctl  # resolve the symbol now too
+except (OSError, AttributeError):  # non-linux dev hosts
+    _LIBC = None
+
+
+def _child_preexec() -> None:
+    """Runs in the child between fork and exec: own session (the child
+    must not ride the supervisor's process group / controlling tty) plus
+    parent-death signal, so a SIGKILLed supervisor can never leak its
+    children -- the kernel SIGTERMs them the moment the parent thread
+    dies. Respawned supervisors additionally kill stale pids recorded in
+    the pidfile (the PDEATHSIG belt's braces)."""
+    os.setsid()
+    if _LIBC is not None:
+        _LIBC.prctl(_PR_SET_PDEATHSIG, signal.SIGTERM, 0, 0, 0)
+
 
 class ProcessManager:
-    def __init__(self, argv: list[str], env: dict | None = None):
+    def __init__(self, argv: list[str], env: dict | None = None,
+                 pidfile: str | None = None):
         self._argv = argv
         self._env = env
+        self._pidfile = pidfile
         self._proc: subprocess.Popen | None = None
         self._lock = threading.Lock()
         self._expected_exit = False
@@ -70,8 +98,75 @@ class ProcessManager:
 
     def _start_locked(self) -> None:
         self._expected_exit = False
-        self._proc = subprocess.Popen(self._argv, env=self._env)
+        self._kill_stale_locked()
+        self._proc = subprocess.Popen(
+            self._argv, env=self._env, preexec_fn=_child_preexec)
+        if self._pidfile:
+            tmp = self._pidfile + ".tmp"
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write(str(self._proc.pid))
+                os.replace(tmp, self._pidfile)
+            except OSError:
+                logger.warning("could not write pidfile %s", self._pidfile)
         logger.info("started %s (pid %d)", self._argv[0], self._proc.pid)
+
+    def _kill_stale_locked(self) -> None:
+        """A previous supervisor instance's child may survive a missed
+        PDEATHSIG (e.g. the pidfile outlived a host that lost the signal
+        race); kill it before binding its resources again."""
+        if not self._pidfile:
+            return
+        try:
+            with open(self._pidfile, encoding="utf-8") as f:
+                stale = int(f.read().strip())
+        except (OSError, ValueError):
+            return
+        if self._proc is not None and self._proc.pid == stale:
+            return
+        # Guard against pid recycling: only kill a process that is
+        # recognizably ours (argv prefix match via /proc cmdline).
+        try:
+            with open(f"/proc/{stale}/cmdline", "rb") as f:
+                cmdline = f.read().split(b"\0")
+        except OSError:
+            return
+        want = [a.encode() for a in self._argv]
+        if cmdline[: len(want)] != want:
+            return
+        # The stale child must actually be GONE before the replacement
+        # starts (it may still own a socket/dir); escalate to SIGKILL
+        # if it ignores SIGTERM through the grace period.
+        def gone() -> bool:
+            # A pid can linger as a zombie (e.g. this very process
+            # spawned it earlier and never reaped); a zombie holds no
+            # sockets or files, so Z counts as gone.
+            try:
+                with open(f"/proc/{stale}/stat", encoding="ascii",
+                          errors="replace") as f:
+                    return f.read().rsplit(")", 1)[1].split()[0] == "Z"
+            except (OSError, IndexError):
+                return True
+
+        try:
+            os.kill(stale, signal.SIGTERM)
+            logger.warning("terminating stale child pid %d from %s",
+                           stale, self._pidfile)
+        except OSError:
+            return
+        deadline = time.monotonic() + TERM_GRACE_S
+        while time.monotonic() < deadline:
+            if gone():
+                return
+            time.sleep(0.05)
+        try:
+            os.kill(stale, signal.SIGKILL)
+        except OSError:
+            return
+        logger.warning("stale child %d ignored SIGTERM; killed", stale)
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline and not gone():
+            time.sleep(0.05)
 
     def _stop_locked(self) -> None:
         proc = self._proc

@@ -285,11 +285,14 @@ class MultiTenancyManager:
             child_env["PYTHONPATH"] = (
                 pkg_root + os.pathsep + child_env.get("PYTHONPATH", "")
             ).rstrip(os.pathsep)
+            # pidfile + PDEATHSIG (ProcessManager): a SIGKILLed plugin
+            # can't leak agents, and a respawn kills any stale survivor
+            # before the fresh agent rebinds agent.sock.
             pm = ProcessManager([
                 sys.executable, "-m",
                 "k8s_dra_driver_gpu_tpu.kubeletplugin.tenancy_agent",
                 "--dir", d,
-            ], env=child_env)
+            ], env=child_env, pidfile=os.path.join(d, "agent.pid"))
             pm.ensure_started()
             pm.start_watchdog()
             self._agents[d] = pm

@@ -61,15 +61,20 @@ def main(argv: list[str] | None = None) -> int:
         if args.release:
             # Leave a tombstone so the slot is reclaimed when the agent
             # is back (it applies released.d before each admission).
+            # Only while the tenancy dir still exists: a poststop racing
+            # Unprepare must not recreate the removed dir (a real dir
+            # behind the sock symlink would dodge the dangling-symlink
+            # sweep in reconcile() and leak).
             from .tenancy_agent import RELEASED_DIR  # noqa: PLC0415
 
-            try:
-                rd = os.path.join(args.tenancy_dir, RELEASED_DIR)
-                os.makedirs(rd, exist_ok=True)
-                with open(os.path.join(rd, client), "w"):
+            if os.path.isdir(args.tenancy_dir):
+                try:
+                    rd = os.path.join(args.tenancy_dir, RELEASED_DIR)
+                    os.makedirs(rd, exist_ok=True)
+                    with open(os.path.join(rd, client), "w"):
+                        pass
+                except OSError:
                     pass
-            except OSError:
-                pass
             return 0  # never block container teardown
         return 1  # fail closed on admission
     if args.release or answer.startswith("OK"):

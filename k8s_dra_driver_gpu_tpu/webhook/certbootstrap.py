@@ -57,9 +57,13 @@ def generate_self_signed(service: str, namespace: str,
 
 def cert_valid(cert_pem: bytes, service: str, namespace: str,
                min_remaining_s: int = 30 * 24 * 3600) -> bool:
-    """The cert must cover the service DNS name and not expire within
-    ``min_remaining_s`` -- otherwise the bootstrap regenerates it
-    instead of re-trusting a stale Secret forever."""
+    """The cert must carry the service DNS name as a subjectAltName and
+    not expire within ``min_remaining_s`` -- otherwise the bootstrap
+    regenerates it instead of re-trusting a stale Secret forever.
+
+    The SAN extension specifically: API servers ignore the Subject CN,
+    so a CN-only cert (e.g. an externally created Secret) would keep the
+    webhook broken forever if we accepted it."""
     try:
         check = subprocess.run(
             ["openssl", "x509", "-noout", "-checkend",
@@ -68,13 +72,18 @@ def cert_valid(cert_pem: bytes, service: str, namespace: str,
         )
         if check.returncode != 0:
             return False
-        text = subprocess.run(
-            ["openssl", "x509", "-noout", "-text"],
+        san = subprocess.run(
+            ["openssl", "x509", "-noout", "-ext", "subjectAltName"],
             input=cert_pem, capture_output=True, check=True,
         ).stdout.decode()
     except (OSError, subprocess.SubprocessError):
         return False
-    return f"{service}.{namespace}.svc" in text
+    dns_names = {
+        entry.strip()[len("DNS:"):]
+        for entry in san.replace("\n", ",").split(",")
+        if entry.strip().startswith("DNS:")
+    }
+    return f"{service}.{namespace}.svc" in dns_names
 
 
 def ensure_secret(kube, name: str, namespace: str, service: str) -> bytes:

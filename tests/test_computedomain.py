@@ -5,6 +5,11 @@ FakeKubeClient.
 """
 
 import json
+import os
+import signal
+import subprocess
+import sys
+import time
 
 import pytest
 
@@ -462,3 +467,87 @@ class TestGangFlow:
         assert f"COMPUTE_DOMAIN_UUID={uid}" in env
         assert "CLIQUE_ID=slice-a" in env
         assert "COMPUTE_DOMAIN_NUM_WORKERS=2" in env
+
+
+class TestProcessManagerOrphans:
+    """Supervisor death must not leak children (advisor r2): children
+    get PR_SET_PDEATHSIG, and a respawned supervisor kills the stale
+    pid recorded in its pidfile before starting a fresh child."""
+
+    SLEEPER = [sys.executable, "-c", "import time; time.sleep(120)"]
+
+    def test_pidfile_written_and_stale_child_killed(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.computedomain.daemon.process import (
+            ProcessManager,
+        )
+
+        pidfile = str(tmp_path / "agent.pid")
+        a = ProcessManager(self.SLEEPER, pidfile=pidfile)
+        a.ensure_started()
+        pid1 = a.pid
+        with open(pidfile, encoding="utf-8") as f:
+            assert int(f.read()) == pid1
+        # Simulate a crashed supervisor: a new instance over the same
+        # pidfile must terminate the survivor, not leak it.
+        b = ProcessManager(self.SLEEPER, pidfile=pidfile)
+        b.ensure_started()
+        assert b.pid != pid1
+        assert a._proc.wait(timeout=10) is not None  # old child died
+        b.stop()
+
+    def test_stale_kill_respects_cmdline_guard(self, tmp_path):
+        # A recycled pid belonging to some other program must be left
+        # alone even if the pidfile names it.
+        from k8s_dra_driver_gpu_tpu.computedomain.daemon.process import (
+            ProcessManager,
+        )
+
+        bystander = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(30)"])
+        try:
+            pidfile = str(tmp_path / "agent.pid")
+            with open(pidfile, "w", encoding="utf-8") as f:
+                f.write(str(bystander.pid))
+            pm = ProcessManager(
+                [sys.executable, "-c", "import time; time.sleep(1)"],
+                pidfile=pidfile)
+            pm.ensure_started()
+            pm.stop()
+            assert bystander.poll() is None  # untouched
+        finally:
+            bystander.kill()
+            bystander.wait()
+
+    def test_pdeathsig_reaps_child_when_supervisor_sigkilled(self, tmp_path):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sup = subprocess.Popen(
+            [sys.executable, "-c", (
+                "import sys, time\n"
+                "from k8s_dra_driver_gpu_tpu.computedomain.daemon.process "
+                "import ProcessManager\n"
+                "pm = ProcessManager([sys.executable, '-c', "
+                "'import time; time.sleep(120)'])\n"
+                "pm.ensure_started()\n"
+                "print(pm.pid, flush=True)\n"
+                "time.sleep(120)\n"
+            )],
+            stdout=subprocess.PIPE, cwd=root,
+            env={**os.environ, "PYTHONPATH": root},
+        )
+        try:
+            child_pid = int(sup.stdout.readline())
+            os.kill(sup.pid, signal.SIGKILL)
+            sup.wait(timeout=10)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    os.kill(child_pid, 0)
+                except ProcessLookupError:
+                    break  # reaped by PDEATHSIG
+                time.sleep(0.1)
+            else:
+                os.kill(child_pid, signal.SIGKILL)
+                pytest.fail("orphaned child survived supervisor SIGKILL")
+        finally:
+            if sup.poll() is None:
+                sup.kill()

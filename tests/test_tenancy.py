@@ -104,6 +104,17 @@ class TestAdmissionLogic:
                                "--client-id", "ctr-x"]) == 0
         assert (tmp_path / "released.d" / "ctr-x").exists()
 
+    def test_preflight_skips_tombstone_when_dir_gone(self, tmp_path):
+        # poststop racing Unprepare: the tenancy dir (and its sock
+        # symlink) are already removed. The hook must NOT makedirs the
+        # path back into existence -- a real dir would dodge the
+        # dangling-symlink sweep in reconcile() and leak.
+        gone = tmp_path / "sock" / "deadbeef1234"
+        assert preflight_main(["--dir", str(gone), "--release",
+                               "--client-id", "ctr-x"]) == 0
+        assert not gone.exists()
+        assert not (tmp_path / "sock").exists()
+
     def test_register_rejects_path_traversal_ids(self, tmp_path):
         write_manifest(tmp_path)
         st = TenancyState(str(tmp_path))

@@ -5,6 +5,7 @@ encode/decode/validate matrix) and the controller's leader election.
 """
 
 import json
+import os
 import threading
 import urllib.request
 
@@ -223,6 +224,34 @@ class TestCertBootstrap:
         ).stdout.decode()
         assert "tpu-dra-webhook.ns1.svc" in out
         assert "tpu-dra-webhook.ns1.svc.cluster.local" in out
+
+    def test_cert_valid_requires_san_not_just_cn(self):
+        # API servers ignore the Subject CN: a CN-only cert (e.g. an
+        # externally created Secret) must be regenerated, not re-trusted
+        # forever while the webhook stays broken.
+        import subprocess
+        import tempfile
+
+        from k8s_dra_driver_gpu_tpu.webhook.certbootstrap import (
+            cert_valid,
+            generate_self_signed,
+        )
+
+        with tempfile.TemporaryDirectory() as d:
+            crt, key = os.path.join(d, "c.pem"), os.path.join(d, "k.pem")
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-keyout", key, "-out", crt, "-days", "3650", "-nodes",
+                 "-subj", "/CN=svc.ns1.svc"],
+                check=True, capture_output=True,
+            )
+            with open(crt, "rb") as f:
+                cn_only = f.read()
+        assert not cert_valid(cn_only, "svc", "ns1")
+        good, _ = generate_self_signed("svc", "ns1")
+        assert cert_valid(good, "svc", "ns1")
+        # SAN present but for a different service: still invalid.
+        assert not cert_valid(good, "other", "ns1")
 
 
 class TestLeaderElection:
