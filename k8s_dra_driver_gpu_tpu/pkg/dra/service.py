@@ -18,36 +18,54 @@ from typing import Callable
 import grpc
 
 from .proto import dra_plugin_pb2 as drapb
+from .proto import dra_plugin_v1_pb2 as drapbv1
 from .proto import plugin_registration_pb2 as regpb
 
 logger = logging.getLogger(__name__)
 
-DRA_SERVICE = "v1beta1.DRAPlugin"
+DRA_SERVICE_V1 = "v1.DRAPlugin"
+DRA_SERVICE_V1BETA1 = "v1beta1.DRAPlugin"
+DRA_SERVICE = DRA_SERVICE_V1BETA1  # compat alias for older callers
 REGISTRATION_SERVICE = "pluginregistration.Registration"
-SUPPORTED_VERSIONS = ["v1beta1"]
+# Registration advertises SERVICE NAMES, not bare versions -- the
+# kubelet DRA plugin manager matches on e.g. "v1beta1.DRAPlugin"
+# (ref noderegistrar.go:39). v1 first: newest the kubelet supports wins.
+SUPPORTED_SERVICES = [DRA_SERVICE_V1, DRA_SERVICE_V1BETA1]
+
+_SERVICE_PB = {
+    DRA_SERVICE_V1: drapbv1,
+    DRA_SERVICE_V1BETA1: drapb,
+}
 
 
 class DRAPluginServicer:
-    """Adapts prepare/unprepare callbacks to the wire API.
+    """Adapts prepare/unprepare callbacks to the wire API for ONE
+    service version; the plugin socket hosts one instance per version
+    (the reference registers v1 and a v1beta1 wrapper side by side,
+    draplugin.go:792-801).
 
     prepare_fn(claims: list[Claim]) -> dict uid -> (devices, error) where
     devices is a list of dicts {request_names, pool_name, device_name,
-    cdi_device_ids}.
+    cdi_device_ids, share_id?}; share_id only rides the v1 wire (the
+    field does not exist pre-v1).
     """
 
     def __init__(
         self,
         prepare_fn: Callable[[list], dict],
         unprepare_fn: Callable[[list], dict],
+        service: str = DRA_SERVICE_V1BETA1,
     ):
         self._prepare = prepare_fn
         self._unprepare = unprepare_fn
+        self._service = service
+        self._pb = _SERVICE_PB[service]
 
     def NodePrepareResources(self, request, context):  # noqa: N802
         results = self._prepare(list(request.claims))
-        resp = drapb.NodePrepareResourcesResponse()
+        resp = self._pb.NodePrepareResourcesResponse()
         for uid, (devices, error) in results.items():
-            r = drapb.NodePrepareResourceResponse()
+            r = self._pb.NodePrepareResourceResponse()
             if error:
                 r.error = error
             for d in devices:
@@ -56,39 +74,42 @@ class DRAPluginServicer:
                 dev.pool_name = d.get("pool_name", "")
                 dev.device_name = d.get("device_name", "")
                 dev.cdi_device_ids.extend(d.get("cdi_device_ids", []))
+                if d.get("share_id") and self._service == DRA_SERVICE_V1:
+                    dev.share_id = d["share_id"]
             resp.claims[uid].CopyFrom(r)
         return resp
 
     def NodeUnprepareResources(self, request, context):  # noqa: N802
         results = self._unprepare(list(request.claims))
-        resp = drapb.NodeUnprepareResourcesResponse()
+        resp = self._pb.NodeUnprepareResourcesResponse()
         for uid, error in results.items():
-            r = drapb.NodeUnprepareResourceResponse()
+            r = self._pb.NodeUnprepareResourceResponse()
             if error:
                 r.error = error
             resp.claims[uid].CopyFrom(r)
         return resp
 
     def handler(self) -> grpc.GenericRpcHandler:
+        pb = self._pb
         return grpc.method_handlers_generic_handler(
-            DRA_SERVICE,
+            self._service,
             {
                 "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
                     self.NodePrepareResources,
                     request_deserializer=(
-                        drapb.NodePrepareResourcesRequest.FromString
+                        pb.NodePrepareResourcesRequest.FromString
                     ),
                     response_serializer=(
-                        drapb.NodePrepareResourcesResponse.SerializeToString
+                        pb.NodePrepareResourcesResponse.SerializeToString
                     ),
                 ),
                 "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
                     self.NodeUnprepareResources,
                     request_deserializer=(
-                        drapb.NodeUnprepareResourcesRequest.FromString
+                        pb.NodeUnprepareResourcesRequest.FromString
                     ),
                     response_serializer=(
-                        drapb.NodeUnprepareResourcesResponse.SerializeToString
+                        pb.NodeUnprepareResourcesResponse.SerializeToString
                     ),
                 ),
             },
@@ -109,7 +130,7 @@ class RegistrationServicer:
         info.type = "DRAPlugin"
         info.name = self._driver
         info.endpoint = self._endpoint
-        info.supported_versions.extend(SUPPORTED_VERSIONS)
+        info.supported_versions.extend(SUPPORTED_SERVICES)
         return info
 
     def NotifyRegistrationStatus(self, request, context):  # noqa: N802
@@ -160,7 +181,14 @@ class PluginServer:
             if os.path.exists(sock):
                 os.unlink(sock)
 
-        self.dra = DRAPluginServicer(prepare_fn, unprepare_fn)
+        # Both API versions on ONE socket (ref draplugin.go:792-801);
+        # self.dra keeps naming the v1beta1 instance for older callers.
+        self.dra_v1 = DRAPluginServicer(
+            prepare_fn, unprepare_fn, service=DRA_SERVICE_V1
+        )
+        self.dra = DRAPluginServicer(
+            prepare_fn, unprepare_fn, service=DRA_SERVICE_V1BETA1
+        )
         self.registration = RegistrationServicer(
             driver_name, self.plugin_socket
         )
@@ -168,7 +196,9 @@ class PluginServer:
         self._plugin_server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=4)
         )
-        self._plugin_server.add_generic_rpc_handlers((self.dra.handler(),))
+        self._plugin_server.add_generic_rpc_handlers(
+            (self.dra_v1.handler(), self.dra.handler())
+        )
         self._plugin_server.add_insecure_port(f"unix://{self.plugin_socket}")
 
         self._registry_server = grpc.server(
@@ -195,21 +225,24 @@ class PluginServer:
                 pass
 
 
-def dra_client_stubs(socket_path: str):
-    """A raw client for tests / healthchecks: returns (channel, call_fns)."""
+def dra_client_stubs(socket_path: str, service: str = DRA_SERVICE_V1BETA1):
+    """A raw client for tests / healthchecks: returns (channel, call_fns).
+    ``service`` picks the negotiated API version, as a kubelet would
+    from the advertised SUPPORTED_SERVICES."""
+    pb = _SERVICE_PB[service]
     channel = grpc.insecure_channel(f"unix://{socket_path}")
     prepare = channel.unary_unary(
-        f"/{DRA_SERVICE}/NodePrepareResources",
-        request_serializer=drapb.NodePrepareResourcesRequest.SerializeToString,
-        response_deserializer=drapb.NodePrepareResourcesResponse.FromString,
+        f"/{service}/NodePrepareResources",
+        request_serializer=pb.NodePrepareResourcesRequest.SerializeToString,
+        response_deserializer=pb.NodePrepareResourcesResponse.FromString,
     )
     unprepare = channel.unary_unary(
-        f"/{DRA_SERVICE}/NodeUnprepareResources",
+        f"/{service}/NodeUnprepareResources",
         request_serializer=(
-            drapb.NodeUnprepareResourcesRequest.SerializeToString
+            pb.NodeUnprepareResourcesRequest.SerializeToString
         ),
         response_deserializer=(
-            drapb.NodeUnprepareResourcesResponse.FromString
+            pb.NodeUnprepareResourcesResponse.FromString
         ),
     )
     return channel, prepare, unprepare

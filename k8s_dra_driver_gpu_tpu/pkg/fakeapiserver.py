@@ -1,0 +1,326 @@
+"""Fake Kubernetes API server: the REST surface over HTTP.
+
+Reference analog: the mock-NVML CI pipeline proves the reference stack
+against real cluster components on CPU-only runners
+(.github/workflows/mock-nvml-e2e.yaml, hack/ci/mock-nvml/). Without
+container tooling, the nearest executable proof is this process: it
+serves the exact REST subset ``KubeClient`` speaks (CRUD, merge-patch,
+selectors, streamed ``?watch=true``) over real HTTP, backed by the
+in-memory ``FakeKubeClient`` store -- so the REAL driver binaries run
+with their REAL ``KubeClient`` against a live server, exercising URL
+construction, error mapping, and watch framing that a purely in-process
+fake never touches.
+
+Run standalone:
+    python -m k8s_dra_driver_gpu_tpu.pkg.fakeapiserver --port 8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import queue
+import re
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+from .kubeclient import ConflictError, FakeKubeClient, KubeError, NotFoundError
+
+logger = logging.getLogger(__name__)
+
+# /api/v1/... (core) or /apis/<group>/<version>/...; optional namespace
+# segment; then plural; then optional name; then optional subresource.
+_PATH_RE = re.compile(
+    r"^/(?:api/(?P<core_version>[^/]+)|apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
+    r"(?:/namespaces/(?P<namespace>[^/]+))?"
+    r"/(?P<resource>[^/]+)"
+    r"(?:/(?P<name>[^/]+))?"
+    r"(?:/(?P<subresource>[^/]+))?$"
+)
+
+
+def _status_body(code: int, reason: str, message: str) -> bytes:
+    return json.dumps({
+        "kind": "Status", "apiVersion": "v1", "status": "Failure",
+        "message": message, "reason": reason, "code": code,
+    }).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.0 framing: no Content-Length on watch streams means
+    # read-until-close, which is exactly what KubeClient.watch expects.
+    protocol_version = "HTTP/1.0"
+    server_version = "FakeKubeApiserver/1.0"
+
+    @property
+    def store(self) -> FakeKubeClient:
+        return self.server.store  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # noqa: A003 - quiet by default
+        logger.debug("%s %s", self.address_string(), fmt % args)
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _send_json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error(self, exc: Exception) -> None:
+        if isinstance(exc, NotFoundError):
+            code, reason = 404, "NotFound"
+        elif isinstance(exc, ConflictError):
+            code, reason = 409, "AlreadyExists"
+        elif isinstance(exc, KubeError):
+            code, reason = exc.status or 500, "InternalError"
+        else:
+            code, reason = 500, "InternalError"
+        body = _status_body(code, reason, str(exc))
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", "0"))
+        raw = self.rfile.read(length) if length else b""
+        return json.loads(raw) if raw else {}
+
+    def _route(self):
+        """(group, version, namespace, resource, name, subresource,
+        query) or None after responding with 404."""
+        parsed = urlparse(self.path)
+        m = _PATH_RE.match(parsed.path)
+        if not m:
+            self._send_error(NotFoundError(f"unroutable path {parsed.path}"))
+            return None
+        d = m.groupdict()
+        group = d["group"] or ""
+        version = d["core_version"] or d["version"]
+        return (group, version, d["namespace"], d["resource"], d["name"],
+                d["subresource"], parse_qs(parsed.query))
+
+    # -- verbs ----------------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802
+        parsed = urlparse(self.path)
+        if parsed.path == "/version":
+            self._send_json(200, self.store.server_version())
+            return
+        if parsed.path in ("/healthz", "/readyz", "/livez"):
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+            return
+        route = self._route()
+        if route is None:
+            return
+        group, version, namespace, resource, name, sub, query = route
+        try:
+            if sub == "log" and resource == "pods":
+                text = self.store.read_raw(parsed.path)
+                body = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if name is not None:
+                self._send_json(200, self.store.get(
+                    group, version, resource, name, namespace=namespace))
+                return
+            if query.get("watch", ["false"])[0] == "true":
+                self._serve_watch(group, resource, namespace)
+                return
+            items = self.store.list(
+                group, version, resource, namespace=namespace,
+                label_selector=unquote(
+                    query.get("labelSelector", [""])[0]) or None,
+                field_selector=unquote(
+                    query.get("fieldSelector", [""])[0]) or None,
+            )
+            self._send_json(200, {
+                "kind": "List", "apiVersion": "v1",
+                "metadata": {"resourceVersion": "1"},
+                "items": items,
+            })
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            self._send_error(e)
+
+    def do_POST(self):  # noqa: N802
+        route = self._route()
+        if route is None:
+            return
+        group, version, namespace, resource, name, _, _ = route
+        try:
+            if name is not None:
+                raise KubeError(405, "POST with name")
+            obj = self.store.create(
+                group, version, resource, self._read_body(),
+                namespace=namespace)
+            self._send_json(201, obj)
+        except Exception as e:  # noqa: BLE001
+            self._send_error(e)
+
+    def do_PUT(self):  # noqa: N802
+        route = self._route()
+        if route is None:
+            return
+        group, version, namespace, resource, name, _, _ = route
+        try:
+            if name is None:
+                raise KubeError(405, "PUT without name")
+            obj = self.store.update(
+                group, version, resource, name, self._read_body(),
+                namespace=namespace)
+            self._send_json(200, obj)
+        except Exception as e:  # noqa: BLE001
+            self._send_error(e)
+
+    def do_PATCH(self):  # noqa: N802
+        route = self._route()
+        if route is None:
+            return
+        group, version, namespace, resource, name, _, _ = route
+        try:
+            if name is None:
+                raise KubeError(405, "PATCH without name")
+            obj = self.store.patch(
+                group, version, resource, name, self._read_body(),
+                namespace=namespace)
+            self._send_json(200, obj)
+        except Exception as e:  # noqa: BLE001
+            self._send_error(e)
+
+    def do_DELETE(self):  # noqa: N802
+        route = self._route()
+        if route is None:
+            return
+        group, version, namespace, resource, name, _, _ = route
+        try:
+            if name is None:
+                raise KubeError(405, "DELETE without name")
+            # K8s DELETE of a missing object is a 404; KubeClient.delete
+            # swallows it client-side, so surface it faithfully.
+            self.store.get(group, version, resource, name,
+                           namespace=namespace)
+            self.store.delete(group, version, resource, name,
+                              namespace=namespace)
+            self._send_json(200, {"kind": "Status", "status": "Success"})
+        except Exception as e:  # noqa: BLE001
+            self._send_error(e)
+
+    # -- watch ----------------------------------------------------------------
+
+    def _serve_watch(self, group: str, resource: str,
+                     namespace: str | None) -> None:
+        """Stream JSON-lines watch events until the client disconnects.
+        Watches start from "now" (no replay), matching an un-versioned
+        k8s watch; consumers pair this with list (informer-style)."""
+        events: queue.Queue = queue.Queue()
+
+        def on_event(g, r, ns, ev_type, obj):
+            if g != group or r != resource:
+                return
+            if namespace and ns != namespace:
+                return
+            events.put((ev_type, obj))
+
+        self.store.add_resource_watcher(on_event)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            # No Content-Length: HTTP/1.0 read-until-close streaming.
+            self.end_headers()
+            self.wfile.flush()
+            while True:
+                try:
+                    ev_type, obj = events.get(timeout=5.0)
+                    line = json.dumps(
+                        {"type": ev_type, "object": obj}) + "\n"
+                    self.wfile.write(line.encode())
+                except queue.Empty:
+                    # Bookmark keep-alive: proves liveness and flushes
+                    # through proxies; KubeClient skips BOOKMARKs.
+                    self.wfile.write((json.dumps({
+                        "type": "BOOKMARK",
+                        "object": {"metadata": {"resourceVersion": "1"}},
+                    }) + "\n").encode())
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client hung up: normal watch teardown
+        finally:
+            self.store.remove_resource_watcher(on_event)
+
+
+class FakeApiServer:
+    """The fake apiserver as an embeddable object (tests) or CLI."""
+
+    def __init__(self, store: FakeKubeClient | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store or FakeKubeClient()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.store = self.store  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._httpd.server_address[0]}:{self.port}"
+
+    def start(self) -> "FakeApiServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fake-apiserver",
+            daemon=True)
+        self._thread.start()
+        logger.info("fake apiserver on %s", self.url)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-dra-fake-apiserver")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8001)
+    p.add_argument("--seed", default="",
+                   help="JSON file: [{group,version,resource,namespace?,"
+                        "object}, ...] created at startup")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = FakeApiServer(host=args.host, port=args.port)
+    if args.seed:
+        with open(args.seed, encoding="utf-8") as f:
+            for entry in json.load(f):
+                server.store.create(
+                    entry["group"], entry["version"], entry["resource"],
+                    entry["object"], namespace=entry.get("namespace"))
+    server.start()
+    print(f"listening on {server.url}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

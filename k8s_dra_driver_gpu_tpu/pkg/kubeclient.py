@@ -355,6 +355,9 @@ class FakeKubeClient:
         self._store: dict[tuple, dict] = {}
         self._lock = threading.Lock()
         self._watchers: list[Callable[[str, dict], None]] = []
+        # fn(group, resource, namespace, event_type, obj) -- scoped
+        # events for consumers that multiplex resources (fakeapiserver).
+        self._resource_watchers: list[Callable] = []
         self._uid = 0
         self.version = {"major": "1", "minor": "34"}
 
@@ -363,12 +366,25 @@ class FakeKubeClient:
     def _key(self, group, resource, namespace, name):
         return (group, resource, namespace or "", name)
 
-    def _notify(self, event_type: str, obj: dict) -> None:
+    def _notify(self, event_type: str, obj: dict,
+                group: str = "", resource: str = "",
+                namespace: str = "") -> None:
         for w in list(self._watchers):
             w(event_type, obj)
+        for rw in list(self._resource_watchers):
+            rw(group, resource, namespace, event_type, obj)
 
     def add_watcher(self, fn: Callable[[str, dict], None]) -> None:
         self._watchers.append(fn)
+
+    def add_resource_watcher(self, fn: Callable) -> None:
+        self._resource_watchers.append(fn)
+
+    def remove_resource_watcher(self, fn: Callable) -> None:
+        try:
+            self._resource_watchers.remove(fn)
+        except ValueError:
+            pass
 
     def objects(self, group=None, resource=None) -> list[dict]:
         with self._lock:
@@ -438,7 +454,7 @@ class FakeKubeClient:
                 meta["uid"] = f"uid-{self._uid}"
             meta["resourceVersion"] = "1"
             self._store[key] = obj
-        self._notify("ADDED", obj)
+        self._notify("ADDED", obj, group, resource, namespace or "")
         return json.loads(json.dumps(obj))
 
     def update(self, group, version, resource, name, obj, namespace=None) -> dict:
@@ -453,7 +469,7 @@ class FakeKubeClient:
             rv = int(old.get("metadata", {}).get("resourceVersion", "1"))
             meta["resourceVersion"] = str(rv + 1)
             self._store[key] = obj
-        self._notify("MODIFIED", obj)
+        self._notify("MODIFIED", obj, group, resource, namespace or "")
         return json.loads(json.dumps(obj))
 
     def patch(self, group, version, resource, name, patch, namespace=None) -> dict:
@@ -474,7 +490,7 @@ class FakeKubeClient:
             rv = int(obj.get("metadata", {}).get("resourceVersion", "1"))
             obj["metadata"]["resourceVersion"] = str(rv + 1)
             out = json.loads(json.dumps(obj))
-        self._notify("MODIFIED", out)
+        self._notify("MODIFIED", out, group, resource, namespace or "")
         return out
 
     def delete(self, group, version, resource, name, namespace=None) -> None:
@@ -482,7 +498,7 @@ class FakeKubeClient:
         with self._lock:
             obj = self._store.pop(key, None)
         if obj is not None:
-            self._notify("DELETED", obj)
+            self._notify("DELETED", obj, group, resource, namespace or "")
 
     def server_version(self) -> dict:
         return self.version

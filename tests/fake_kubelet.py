@@ -1,0 +1,160 @@
+"""A protocol-faithful fake kubelet (DRA plugin-manager side).
+
+Mirrors the kubelet behaviors the driver depends on, in the order the
+kubelet performs them (reference consumes them through the kubeletplugin
+helper; the kubelet side lives in k8s pkg/kubelet/pluginmanager):
+
+1. Watch the plugin-registry dir for registration sockets.
+2. Dial each socket and call ``Registration.GetInfo``.
+3. Validate the info (type, name, endpoint, version intersection with
+   what this kubelet speaks).
+4. Report the outcome via ``Registration.NotifyRegistrationStatus`` --
+   including the failure report on a bad handshake.
+5. Drive ``NodePrepareResources``/``NodeUnprepareResources`` on the
+   plugin endpoint using the NEGOTIATED service version.
+
+Used by the system tier to make first contact with the real plugin
+binary over the real wire protocol; the kind CI job replaces this with
+an actual kubelet.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+
+import grpc
+
+from k8s_dra_driver_gpu_tpu.pkg.dra.proto import dra_plugin_pb2 as b1pb
+from k8s_dra_driver_gpu_tpu.pkg.dra.proto import dra_plugin_v1_pb2 as v1pb
+from k8s_dra_driver_gpu_tpu.pkg.dra.proto import (
+    plugin_registration_pb2 as regpb,
+)
+from k8s_dra_driver_gpu_tpu.pkg.dra.service import (
+    dra_client_stubs,
+    registration_client_stubs,
+)
+
+# Newest-first, like the kubelet's DRA plugin manager.
+KUBELET_SUPPORTED = ["v1.DRAPlugin", "v1beta1.DRAPlugin"]
+
+_PB = {"v1.DRAPlugin": v1pb, "v1beta1.DRAPlugin": b1pb}
+
+
+class PluginHandle:
+    def __init__(self, name: str, endpoint: str, service: str):
+        self.name = name
+        self.endpoint = endpoint
+        self.service = service  # the negotiated API version
+
+
+class FakeKubelet:
+    def __init__(self, registry_dir: str,
+                 supported: list[str] | None = None):
+        self._registry_dir = registry_dir
+        self._supported = supported or list(KUBELET_SUPPORTED)
+        self.plugins: dict[str, PluginHandle] = {}
+        self.failed: dict[str, str] = {}  # socket path -> error reported
+        self._registered_socks: set[str] = set()
+
+    # -- plugin watcher + registration handshake -----------------------------
+
+    def scan_once(self) -> list[str]:
+        """One pass of the plugin watcher: register every socket found.
+        Returns the plugin names registered in this pass."""
+        new = []
+        for sock in sorted(glob.glob(
+                os.path.join(self._registry_dir, "*.sock"))):
+            if sock in self._registered_socks:
+                continue  # register-once, like the kubelet
+            name = self._register(sock)
+            if name:
+                new.append(name)
+        return new
+
+    def wait_for_plugin(self, name: str, timeout: float = 30.0,
+                        interval: float = 0.2) -> PluginHandle:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.scan_once()
+            if name in self.plugins:
+                return self.plugins[name]
+            time.sleep(interval)
+        raise TimeoutError(
+            f"plugin {name!r} never registered "
+            f"(failed handshakes: {self.failed})")
+
+    def _register(self, sock: str) -> str | None:
+        ch, get_info, notify = registration_client_stubs(sock)
+        try:
+            # A socket can outlive (or predate) its server; the kubelet
+            # plugin watcher retries failed handshakes, so record the
+            # error and let the next scan try again.
+            try:
+                info = get_info(regpb.InfoRequest(), timeout=10)
+            except grpc.RpcError as e:
+                self.failed[sock] = f"GetInfo failed: {e.code()}"
+                return None
+            err = self._validate(info)
+            if err:
+                self.failed[sock] = err
+                notify(regpb.RegistrationStatus(
+                    plugin_registered=False, error=err), timeout=10)
+                return None
+            service = next(v for v in self._supported
+                           if v in info.supported_versions)
+            self.plugins[info.name] = PluginHandle(
+                info.name, info.endpoint, service)
+            notify(regpb.RegistrationStatus(plugin_registered=True),
+                   timeout=10)
+            self._registered_socks.add(sock)
+            self.failed.pop(sock, None)
+            return info.name
+        finally:
+            ch.close()
+
+    def _validate(self, info) -> str:
+        if info.type != "DRAPlugin":
+            return f"unsupported plugin type {info.type!r}"
+        if not info.name:
+            return "plugin reported empty name"
+        if not info.endpoint or not os.path.exists(info.endpoint):
+            return f"plugin endpoint {info.endpoint!r} does not exist"
+        if not any(v in info.supported_versions for v in self._supported):
+            return (
+                f"none of {list(info.supported_versions)} supported; "
+                f"kubelet speaks {self._supported}")
+        return ""
+
+    # -- DRA calls over the negotiated version --------------------------------
+
+    def prepare(self, plugin_name: str, claims: list[dict],
+                timeout: float = 60.0):
+        """claims: [{uid, namespace, name}]. Returns the wire response."""
+        h = self.plugins[plugin_name]
+        pb = _PB[h.service]
+        ch, prepare, _ = dra_client_stubs(h.endpoint, service=h.service)
+        try:
+            req = pb.NodePrepareResourcesRequest()
+            for c in claims:
+                cl = req.claims.add()
+                cl.uid = c["uid"]
+                cl.namespace = c.get("namespace", "default")
+                cl.name = c.get("name", c["uid"])
+            return prepare(req, timeout=timeout)
+        finally:
+            ch.close()
+
+    def unprepare(self, plugin_name: str, uids: list[str],
+                  timeout: float = 60.0):
+        h = self.plugins[plugin_name]
+        pb = _PB[h.service]
+        ch, _, unprepare = dra_client_stubs(h.endpoint, service=h.service)
+        try:
+            req = pb.NodeUnprepareResourcesRequest()
+            for uid in uids:
+                req.claims.add().uid = uid
+            return unprepare(req, timeout=timeout)
+        finally:
+            ch.close()
