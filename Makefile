@@ -2,7 +2,16 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast bench lint clean
+.PHONY: all native test test-fast bench lint clean stamp-version
+
+VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
+
+# Stamp the chart from VERSION (reference: versions.mk consumers).
+stamp-version:
+	sed -i 's/^version: .*/version: $(patsubst v%,%,$(VERSION))/' \
+	    deployments/helm/tpu-dra-driver/Chart.yaml
+	sed -i 's/^appVersion: .*/appVersion: "$(patsubst v%,%,$(VERSION))"/' \
+	    deployments/helm/tpu-dra-driver/Chart.yaml
 
 all: native test
 

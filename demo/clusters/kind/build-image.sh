@@ -3,8 +3,12 @@
 set -euo pipefail
 
 CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
-IMAGE="${IMAGE:-tpu-dra-driver:dev}"
 REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
+# Default tag tracks the repo VERSION (reference: versions.mk). The
+# 'v' prefix is stripped so the tag matches the chart's appVersion
+# (the chart's default image tag).
+VERSION="$(cat "${REPO_ROOT}/VERSION" 2>/dev/null || echo dev)"
+IMAGE="${IMAGE:-tpu-dra-driver:${VERSION#v}}"
 
 docker build -f "${REPO_ROOT}/deployments/container/Dockerfile" \
     -t "${IMAGE}" "${REPO_ROOT}"

@@ -2,9 +2,10 @@
 # Install the chart in mock-topology mode on a kind cluster.
 set -euo pipefail
 
-IMAGE="${IMAGE:-tpu-dra-driver:dev}"
-MOCK_TOPOLOGY="${MOCK_TOPOLOGY:-v5e-4}"
 REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
+VERSION="$(cat "${REPO_ROOT}/VERSION" 2>/dev/null || echo dev)"
+IMAGE="${IMAGE:-tpu-dra-driver:${VERSION#v}}"
+MOCK_TOPOLOGY="${MOCK_TOPOLOGY:-v5e-4}"
 
 helm upgrade --install tpu-dra-driver \
     "${REPO_ROOT}/deployments/helm/tpu-dra-driver" \

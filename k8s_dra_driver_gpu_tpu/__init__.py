@@ -25,7 +25,23 @@ A brand-new framework with the capabilities of NVIDIA's k8s-dra-driver-gpu
   fabric with external NCCL jobs; we ship the JAX analog in-tree.
 """
 
-__version__ = "0.1.0"
+def _read_version() -> str:
+    """Single source of truth: the repo-root VERSION file (reference:
+    /root/reference/VERSION consumed by versions.mk). A distribution
+    shipped without the file (the Dockerfile copies it) reports an
+    explicitly-unknown version rather than a stale literal."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "VERSION")
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read().strip().lstrip("v")
+    except OSError:
+        return "0.0.0+unknown"
+
+
+__version__ = _read_version()
 
 DRIVER_NAME = "tpu.dra.dev"
 COMPUTE_DOMAIN_DRIVER_NAME = "compute-domain.tpu.dra.dev"

@@ -73,13 +73,15 @@ class Driver:
             import dataclasses  # noqa: PLC0415
 
             baseline = sorted(
-                d.chip.chip.index
+                (d.chip.chip.index, d.chip.chip.pci_bdf or "")
                 for d in self.state.allocatable.values()
                 if d.kind == DeviceKind.CHIP
             )
             monitor_opts = dataclasses.replace(
                 config.tpulib_opts,
-                expected_chips=",".join(str(i) for i in baseline),
+                expected_chips=",".join(str(i) for i, _ in baseline),
+                # AER fallback path for class-less hosts (see binding.py)
+                expected_bdfs=",".join(b for _, b in baseline),
             )
             self.health_monitor = ChipHealthMonitor(
                 self.state._tpulib,
@@ -217,10 +219,14 @@ class Driver:
         legacy = self.publication_mode == "legacy"
         devices = []
         partition_devices = []
+        withheld = []
         for name, dev in sorted(self.state.allocatable.items()):
-            if legacy and dev.kind != DeviceKind.CHIP:
+            if legacy and dev.kind not in (DeviceKind.CHIP,
+                                           DeviceKind.PASSTHROUGH):
                 # Partition capacity can't be expressed without shared
-                # counters; legacy servers see whole chips only.
+                # counters; legacy servers see whole chips and whole-chip
+                # passthrough only (passthrough needs no counters).
+                withheld.append(name)
                 continue
             entry = dev.to_dra_device()
             taints = self._taints.get(name)
@@ -232,6 +238,12 @@ class Driver:
                 devices.append(entry)
             else:
                 partition_devices.append(entry)
+        if withheld:
+            logger.warning(
+                "legacy publication mode withholds %d partition device(s) "
+                "(no shared-counter support pre-KEP-4815): %s",
+                len(withheld), ", ".join(withheld),
+            )
 
         def slice_obj(suffix: str, devs: list[dict]) -> dict:
             spec = {

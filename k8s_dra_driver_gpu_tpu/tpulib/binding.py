@@ -93,6 +93,11 @@ class EnumerateOptions:
     # Comma-separated chip indices from the startup enumeration: the
     # baseline for devfs health (enumeration-diff chip_lost + AER poll).
     expected_chips: str | None = None
+    # PCI addresses aligned with expected_chips: the AER fallback path
+    # for hosts where the chip has no /sys/class/accel node (vfio-bound,
+    # GKE TPU-VM) -- counters are then read under
+    # /sys/bus/pci/devices/<bdf>/ instead.
+    expected_bdfs: str | None = None
 
     @classmethod
     def from_env(cls) -> "EnumerateOptions":
@@ -117,6 +122,8 @@ class EnumerateOptions:
             parts.append(f"health_events={self.health_events}")
         if self.expected_chips:
             parts.append(f"expected_chips={self.expected_chips}")
+        if self.expected_bdfs:
+            parts.append(f"expected_bdfs={self.expected_bdfs}")
         return ";".join(parts)
 
 
@@ -497,19 +504,30 @@ class PyTpuLib:
         if opts.expected_chips and not opts.mock_topology:
             dev_root = opts.dev_root or "/dev"
             sys_root = opts.sys_root or "/sys"
-            for tok in filter(None, opts.expected_chips.split(",")):
+            bdfs = (opts.expected_bdfs or "").split(",")
+            for pos, tok in enumerate(
+                    filter(None, opts.expected_chips.split(","))):
                 idx = _atoi(tok)
                 if not os.path.exists(f"{dev_root}/accel{idx}"):
                     events.append(
                         HealthEvent(chip=idx, kind="chip_lost", fatal=True))
                     continue
                 sysdev = f"{sys_root}/class/accel/accel{idx}/device"
-                if _read_aer_count(f"{sysdev}/aer_dev_fatal") > 0:
-                    events.append(HealthEvent(
-                        chip=idx, kind="pcie_aer_fatal", fatal=True))
-                if _read_aer_count(f"{sysdev}/aer_dev_nonfatal") > 0:
-                    events.append(HealthEvent(
-                        chip=idx, kind="pcie_aer_nonfatal", fatal=False))
+                # Fallback by PCI address: vfio-bound or TPU-VM hosts may
+                # expose no accel class node (device_health.go:215-328
+                # keeps multiple event classes in one pipeline).
+                bdf = bdfs[pos].strip() if pos < len(bdfs) else ""
+                pcidev = f"{sys_root}/bus/pci/devices/{bdf}" if bdf else ""
+                for attr, kind, fatal in (
+                    ("aer_dev_fatal", "pcie_aer_fatal", True),
+                    ("aer_dev_nonfatal", "pcie_aer_nonfatal", False),
+                ):
+                    count = _read_aer_count(f"{sysdev}/{attr}")
+                    if count < 0 and pcidev:
+                        count = _read_aer_count(f"{pcidev}/{attr}")
+                    if count > 0:
+                        events.append(
+                            HealthEvent(chip=idx, kind=kind, fatal=fatal))
         return tuple(events)
 
 

@@ -547,10 +547,22 @@ char* tpuinfo_health(const char* opts) {
   if (!expected.empty() && o.count("mock_topology") == 0) {
     const std::string dev_root = Opt(o, "dev_root", "/dev");
     const std::string sys_root = Opt(o, "sys_root", "/sys");
+    // PCI addresses aligned with expected_chips: the AER fallback for
+    // hosts without an accel class node (vfio-bound, TPU-VM) -- the
+    // counters are then read under /sys/bus/pci/devices/<bdf>/
+    // (device_health.go:215-328: several event classes, one pipeline).
+    std::vector<std::string> bdfs;
+    {
+      std::stringstream bs(Opt(o, "expected_bdfs"));
+      std::string b;
+      while (std::getline(bs, b, ',')) bdfs.push_back(b);
+    }
     std::stringstream es(expected);
     std::string tok;
+    size_t pos = 0;
     while (std::getline(es, tok, ',')) {
       if (tok.empty()) continue;
+      const size_t my_pos = pos++;
       int idx = std::atoi(tok.c_str());
       std::string devpath = dev_root + "/accel" + std::to_string(idx);
       struct stat st;
@@ -560,10 +572,20 @@ char* tpuinfo_health(const char* opts) {
       }
       std::string sysdev =
           sys_root + "/class/accel/accel" + std::to_string(idx) + "/device";
-      long long fatal = ReadAerCount(sysdev + "/aer_dev_fatal");
-      if (fatal > 0) EmitEvent(j, first, idx, "pcie_aer_fatal");
-      long long nonfatal = ReadAerCount(sysdev + "/aer_dev_nonfatal");
-      if (nonfatal > 0) EmitEvent(j, first, idx, "pcie_aer_nonfatal");
+      std::string pcidev;
+      if (my_pos < bdfs.size() && !bdfs[my_pos].empty())
+        pcidev = sys_root + "/bus/pci/devices/" + bdfs[my_pos];
+      struct AerAttr { const char* attr; const char* kind; };
+      const AerAttr attrs[] = {
+          {"aer_dev_fatal", "pcie_aer_fatal"},
+          {"aer_dev_nonfatal", "pcie_aer_nonfatal"},
+      };
+      for (const auto& a : attrs) {
+        long long count = ReadAerCount(sysdev + "/" + a.attr);
+        if (count < 0 && !pcidev.empty())
+          count = ReadAerCount(pcidev + "/" + a.attr);
+        if (count > 0) EmitEvent(j, first, idx, a.kind);
+      }
     }
   }
   j.raw("]}");

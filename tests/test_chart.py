@@ -21,8 +21,8 @@ from k8s_dra_driver_gpu_tpu.pkg.chartrender import (
     render_chart,
 )
 
-CHART = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "deployments", "helm", "tpu-dra-driver")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
 PKG = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "k8s_dra_driver_gpu_tpu")
 
@@ -192,3 +192,26 @@ class TestValuesSchema:
             "featureGates": "DynamicSubSlice=true",
             "logVerbosity": 6,
         })
+
+
+class TestVersionStamping:
+    """VERSION is the single source of truth (reference: VERSION +
+    versions.mk); the chart must be stamped from it."""
+
+    def test_chart_matches_version_file(self):
+        import yaml as _yaml
+
+        with open(os.path.join(REPO, "VERSION"), encoding="utf-8") as f:
+            version = f.read().strip().lstrip("v")
+        with open(os.path.join(
+                REPO, "deployments", "helm", "tpu-dra-driver",
+                "Chart.yaml"), encoding="utf-8") as f:
+            chart = _yaml.safe_load(f)
+        assert chart["version"] == version, "run `make stamp-version`"
+        assert chart["appVersion"] == version, "run `make stamp-version`"
+
+    def test_package_version_reads_version_file(self):
+        import k8s_dra_driver_gpu_tpu as pkg
+
+        with open(os.path.join(REPO, "VERSION"), encoding="utf-8") as f:
+            assert pkg.__version__ == f.read().strip().lstrip("v")

@@ -160,6 +160,43 @@ class TestResourceSlices:
         assert names and all(n.startswith("chip-") for n in names)
         assert all("consumesCounters" not in dev for dev in spec["devices"])
 
+    def test_legacy_mode_keeps_passthrough_devices(self, tmp_root, kube):
+        # Whole-chip passthrough needs no shared counters, so pre-1.35
+        # servers must not lose it; only partition devices are withheld.
+        from k8s_dra_driver_gpu_tpu.pkg.featuregates import FeatureGates
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+            EnumerateOptions,
+            PyTpuLib,
+        )
+        from tests.test_vfio_health import fake_pci_tree
+
+        bdfs = [
+            c.pci_bdf
+            for c in PyTpuLib().enumerate(
+                EnumerateOptions(mock_topology="v5e-4")).chips
+        ]
+        import pathlib
+        sys_root = fake_pci_tree(pathlib.Path(tmp_root), bdfs)
+        d = Driver(
+            Config(
+                root=os.path.join(tmp_root, "lp"),
+                tpulib_opts=EnumerateOptions(
+                    mock_topology="v5e-4", sys_root=sys_root,
+                    dev_root=os.path.join(tmp_root, "dev"),
+                ),
+                feature_gates=FeatureGates.parse("PassthroughSupport=true"),
+                cdi_root=os.path.join(tmp_root, "cdi"),
+                tenancy_agents=False,
+            ),
+            kube, node_name="node-e", enable_health_monitor=False,
+            publication_mode="legacy",
+        )
+        d.publish_resources()
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        names = [dev["name"] for s in slices for dev in s["spec"]["devices"]]
+        assert any(n.endswith("-passthrough") for n in names)
+        assert not any("-ss-" in n or n.startswith("ss-") for n in names)
+
 
 class TestPrepareFlow:
     def test_prepare_via_api_lookup(self, driver, kube):
@@ -367,6 +404,69 @@ class TestGRPCEndToEnd:
             c2.uid = "u1"
             resp2 = unprepare(req2, timeout=10)
             assert resp2.claims["u1"].error == ""
+            ch2.close()
+        finally:
+            server.stop()
+
+    def test_version_negotiation_v1_and_v1beta1(self, tmp_root, kube):
+        """A kubelet speaking EITHER advertised service prepares a claim
+        on the same socket (ref draplugin.go:757-801)."""
+        from k8s_dra_driver_gpu_tpu.pkg.dra.proto import (
+            dra_plugin_v1_pb2 as v1pb,
+        )
+        from k8s_dra_driver_gpu_tpu.pkg.dra.service import (
+            DRA_SERVICE_V1,
+            DRA_SERVICE_V1BETA1,
+            SUPPORTED_SERVICES,
+        )
+
+        driver = Driver(
+            Config.mock(root=os.path.join(tmp_root, "st"), topology="v5e-4"),
+            kube, node_name="node-a", enable_health_monitor=False,
+        )
+        put_claim(kube, "u1", ["chip-0"], namespace="ns1")
+        put_claim(kube, "u2", ["chip-1"], namespace="ns1")
+        server = PluginServer(
+            "tpu.dra.dev",
+            plugin_dir=os.path.join(tmp_root, "plugin"),
+            registry_dir=os.path.join(tmp_root, "registry"),
+            prepare_fn=driver.prepare_resource_claims,
+            unprepare_fn=driver.unprepare_resource_claims,
+        )
+        server.start()
+        try:
+            # Registration advertises full service names, v1 preferred.
+            ch, get_info, _ = registration_client_stubs(
+                server.registry_socket)
+            info = get_info(regpb.InfoRequest(), timeout=5)
+            assert list(info.supported_versions) == SUPPORTED_SERVICES
+            assert list(info.supported_versions) == [
+                "v1.DRAPlugin", "v1beta1.DRAPlugin"]
+            ch.close()
+
+            # v1 kubelet.
+            ch1, prepare1, unprepare1 = dra_client_stubs(
+                server.plugin_socket, service=DRA_SERVICE_V1)
+            req = v1pb.NodePrepareResourcesRequest()
+            c = req.claims.add()
+            c.uid, c.namespace, c.name = "u1", "ns1", "u1"
+            resp = prepare1(req, timeout=10)
+            assert resp.claims["u1"].error == ""
+            assert resp.claims["u1"].devices[0].device_name == "chip-0"
+            unreq = v1pb.NodeUnprepareResourcesRequest()
+            unreq.claims.add().uid = "u1"
+            assert unprepare1(unreq, timeout=10).claims["u1"].error == ""
+            ch1.close()
+
+            # v1beta1 kubelet against the SAME socket.
+            ch2, prepare2, _ = dra_client_stubs(
+                server.plugin_socket, service=DRA_SERVICE_V1BETA1)
+            req2 = drapb.NodePrepareResourcesRequest()
+            c2 = req2.claims.add()
+            c2.uid, c2.namespace, c2.name = "u2", "ns1", "u2"
+            resp2 = prepare2(req2, timeout=10)
+            assert resp2.claims["u2"].error == ""
+            assert resp2.claims["u2"].devices[0].device_name == "chip-1"
             ch2.close()
         finally:
             server.stop()

@@ -190,6 +190,38 @@ class TestHealth:
             (3, "pcie_aer_nonfatal", False),
         ]
 
+    def test_aer_pci_address_fallback(self, lib, tmp_path):
+        # vfio-bound / TPU-VM hosts may expose the chip with NO accel
+        # class node; the counters must then come from the PCI device
+        # path (device_health.go:215-328: one pipeline, many sources).
+        dev, sys = self._real_tree(tmp_path)
+        import shutil as _sh
+        _sh.rmtree(sys / "class" / "accel" / "accel1")  # class-less chip
+        pci = sys / "bus" / "pci" / "devices" / "0000:00:05.0"
+        pci.mkdir(parents=True)
+        (pci / "aer_dev_fatal").write_text("TOTAL_ERR_FATAL 1\n")
+        evs = lib.health(EnumerateOptions(
+            dev_root=str(dev), sys_root=str(sys), expected_chips="0,1,2,3",
+            expected_bdfs="0000:00:04.0,0000:00:05.0,0000:00:06.0,"
+                          "0000:00:07.0"))
+        assert [(e.chip, e.kind, e.fatal) for e in evs] == [
+            (1, "pcie_aer_fatal", True)]
+
+    def test_aer_class_path_wins_over_pci_fallback(self, lib, tmp_path):
+        # When the accel class node exists, its (empty) counters are
+        # authoritative; the PCI path is only consulted when the class
+        # attribute is ABSENT.
+        dev, sys = self._real_tree(tmp_path)
+        (sys / "class" / "accel" / "accel0" / "device"
+         / "aer_dev_fatal").write_text("TOTAL_ERR_FATAL 0\n")
+        pci = sys / "bus" / "pci" / "devices" / "0000:00:04.0"
+        pci.mkdir(parents=True)
+        (pci / "aer_dev_fatal").write_text("TOTAL_ERR_FATAL 9\n")
+        evs = lib.health(EnumerateOptions(
+            dev_root=str(dev), sys_root=str(sys), expected_chips="0",
+            expected_bdfs="0000:00:04.0"))
+        assert evs == ()
+
     def test_mock_mode_ignores_expected_chips(self, lib, tmp_path):
         # Mock mode must not consult devfs: no /dev/accel* exists on a
         # dev box, and that must not read as every chip lost.
@@ -261,6 +293,26 @@ class TestBackendParity:
         native, py = NativeTpuLib(), PyTpuLib()
         assert native.health(opts) == py.health(opts)
         assert any(e.kind == "chip_lost" for e in py.health(opts))
+
+    def test_aer_pci_fallback_parity(self, tmp_path):
+        dev = tmp_path / "dev"
+        dev.mkdir()
+        sys = tmp_path / "sys"
+        for i in [0, 1]:
+            (dev / f"accel{i}").touch()
+        # Only chip 0 has a class node; chip 1 is class-less with AER
+        # counters under its PCI address.
+        (sys / "class" / "accel" / "accel0" / "device").mkdir(parents=True)
+        pci = sys / "bus" / "pci" / "devices" / "0000:00:05.0"
+        pci.mkdir(parents=True)
+        (pci / "aer_dev_nonfatal").write_text("RxErr 3\n")
+        opts = EnumerateOptions(
+            dev_root=str(dev), sys_root=str(sys), expected_chips="0,1",
+            expected_bdfs="0000:00:04.0,0000:00:05.0")
+        native, py = NativeTpuLib(), PyTpuLib()
+        assert native.health(opts) == py.health(opts)
+        assert [(e.chip, e.kind) for e in py.health(opts)] == [
+            (1, "pcie_aer_nonfatal")]
 
     def test_devfs_junk_entries_parity(self, tmp_path):
         dev = tmp_path / "dev"
