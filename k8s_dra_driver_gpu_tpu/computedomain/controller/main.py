@@ -15,6 +15,8 @@ import signal
 import sys
 import threading
 
+from ... import __version__
+from ...pkg import logsetup
 from ...pkg.kubeclient import FakeKubeClient, KubeClient
 from ...pkg.leaderelection import LeaderElector
 from ...pkg.metrics import ComputeDomainMetrics, MetricsServer
@@ -38,16 +40,18 @@ def build_parser() -> argparse.ArgumentParser:
                    default=env("LEADER_ELECTION", "") == "true")
     p.add_argument("--lease-name", default="tpu-dra-cd-controller")
     p.add_argument("--identity", default=env("POD_NAME", os.uname().nodename))
+    p.add_argument("-v", "--verbosity", type=int,
+                   default=int(env("V", "4")),
+                   help="log verbosity (see pkg/logsetup.py) [V]")
     p.add_argument("--standalone", action="store_true")
     return p
 
 
 def run(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    logsetup.setup(args.verbosity)
+    logsetup.log_startup(__name__, "compute-domain-controller",
+                         __version__, args)
 
     kube = FakeKubeClient() if args.standalone else KubeClient()
     metrics = ComputeDomainMetrics()

@@ -7,6 +7,8 @@ daemonset.go:190-254, and the two ResourceClaimTemplate flavors
 
 from __future__ import annotations
 
+import os
+
 from .. import (
     API_GROUP,
     API_VERSION,
@@ -70,6 +72,10 @@ def build_daemon_daemonset(cd: dict, namespace: str) -> dict:
                                 {"name": "DRIVER_NAMESPACE", "valueFrom": {
                                     "fieldRef": {
                                         "fieldPath": "metadata.namespace"}}},
+                                # Daemons inherit the controller's own
+                                # verbosity (chart logVerbosity -> V).
+                                {"name": "V",
+                                 "value": os.environ.get("V", "4")},
                             ],
                             "ports": [
                                 {"containerPort": DOMAIN_DAEMON_PORT,

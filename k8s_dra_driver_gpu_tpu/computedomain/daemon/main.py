@@ -292,16 +292,22 @@ def check(config: DaemonConfig) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from ...pkg import logsetup  # noqa: PLC0415
+
     p = argparse.ArgumentParser(prog="compute-domain-daemon")
     p.add_argument("command", choices=["run", "check"])
+    p.add_argument("-v", "--verbosity", type=int,
+                   default=int(os.environ.get("V", "4")),
+                   help="log verbosity (see pkg/logsetup.py) [V]")
     args = p.parse_args(argv)
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    logsetup.setup(args.verbosity)
     config = DaemonConfig()
     if args.command == "check":
         return check(config)
+    from ... import __version__  # noqa: PLC0415
+
+    logsetup.log_startup(__name__, "compute-domain-daemon",
+                         __version__, args)
     return Daemon(config).run()
 
 

@@ -13,6 +13,7 @@ import os
 import sys
 
 from ... import __version__
+from ...pkg import logsetup
 from ...pkg.debug import start_debug_signal_handlers, wait_for_termination
 from ...pkg.dra.service import PluginServer
 from ...pkg.healthcheck import HealthcheckServer
@@ -47,6 +48,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default=int(env("METRICS_PORT", "0")))
     p.add_argument("--healthcheck-port", type=int,
                    default=int(env("HEALTHCHECK_PORT", "0")))
+    p.add_argument("-v", "--verbosity", type=int,
+                   default=int(env("V", "4")),
+                   help="log verbosity (see pkg/logsetup.py) [V]")
     p.add_argument("--standalone", action="store_true")
     p.add_argument("--version", action="version", version=__version__)
     return p
@@ -54,13 +58,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    logsetup.setup(args.verbosity)
     start_debug_signal_handlers()
-    for key, val in sorted(vars(args).items()):
-        logger.info("config %s=%r", key, val)
+    logsetup.log_startup(__name__, "compute-domain-kubelet-plugin",
+                         __version__, args)
 
     node_name = args.node_name or os.uname().nodename
     kube = FakeKubeClient() if args.standalone else KubeClient()

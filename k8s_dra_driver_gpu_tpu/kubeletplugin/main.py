@@ -18,6 +18,7 @@ import os
 import sys
 
 from .. import __version__
+from ..pkg import logsetup
 from ..pkg.debug import start_debug_signal_handlers, wait_for_termination
 from ..pkg.featuregates import FeatureGates
 from ..pkg.kubeclient import FakeKubeClient, KubeClient
@@ -97,20 +98,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
-    level = (logging.ERROR if args.verbosity <= 0
-             else logging.WARNING if args.verbosity < 4
-             else logging.INFO if args.verbosity < 6
-             else logging.DEBUG)
-    logging.basicConfig(
-        level=level,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
-    logger.info("tpu-kubelet-plugin %s starting (driver %s)",
-                __version__, DRIVER_NAME)
+    logsetup.setup(args.verbosity)
     start_debug_signal_handlers()
-    # Structured startup-config dump (reference pkg/flags/utils.go).
-    for key, val in sorted(vars(args).items()):
-        logger.info("config %s=%r", key, val)
+    # Banner + structured startup-config dump: always visible, even at
+    # verbosity 0 (logging contract, pkg/logsetup.py).
+    logsetup.log_startup(__name__, "tpu-kubelet-plugin", __version__, args)
 
     gates = FeatureGates.parse(args.feature_gates)
     config = Config(

@@ -1,0 +1,48 @@
+"""Shared logging contract for every binary.
+
+The contract (docs/install.md, mirroring the reference klog levels the
+bats suite asserts, tests/bats/test_cd_logging.bats):
+
+- startup banner + config dump: ALWAYS visible, even at verbosity 0
+  (the reference asserts config detail in level-0 logs);
+- 0: errors only;
+- 4 (default): claim/domain lifecycle (INFO);
+- 6: per-claim ``t_prep_*`` segment timings and other DEBUG detail;
+- 7: wire dumps.
+"""
+
+from __future__ import annotations
+
+import logging
+
+FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+
+
+def level_for(verbosity: int) -> int:
+    return (logging.ERROR if verbosity <= 0
+            else logging.WARNING if verbosity < 4
+            else logging.INFO if verbosity < 6
+            else logging.DEBUG)
+
+
+def setup(verbosity: int) -> None:
+    logging.basicConfig(level=level_for(verbosity), format=FORMAT)
+
+
+def startup_logger(name: str) -> logging.Logger:
+    """A logger whose INFO records bypass the verbosity gate: records
+    pass their ORIGINATING logger's level, and handlers default to
+    NOTSET, so pinning this child to INFO keeps the startup config
+    visible at verbosity 0."""
+    lg = logging.getLogger(f"{name}.startup")
+    lg.setLevel(logging.INFO)
+    return lg
+
+
+def log_startup(name: str, binary: str, version: str, args) -> None:
+    """Banner + structured config dump (reference pkg/flags/utils.go;
+    asserted at verbosity 0 by the logging-contract tests)."""
+    lg = startup_logger(name)
+    lg.info("%s %s starting", binary, version)
+    for key, val in sorted(vars(args).items()):
+        lg.info("config %s=%r", key, val)

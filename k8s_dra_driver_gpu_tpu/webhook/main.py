@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import ssl
 import sys
 import threading
@@ -140,16 +141,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8443)
     p.add_argument("--tls-cert")
     p.add_argument("--tls-key")
+    p.add_argument("-v", "--verbosity", type=int,
+                   default=int(os.environ.get("V", "4")),
+                   help="log verbosity (see pkg/logsetup.py) [V]")
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
+    from ..pkg import logsetup  # noqa: PLC0415
+
     args = build_parser().parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
+    logsetup.setup(args.verbosity)
     server = WebhookServer(port=args.port, tls_cert=args.tls_cert,
                            tls_key=args.tls_key)
     server.start()
-    logger.info("webhook serving on :%d%s", server.port, VALIDATE_PATH)
+    logsetup.startup_logger(__name__).info(
+        "webhook serving on :%d%s", server.port, VALIDATE_PATH)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

@@ -551,3 +551,88 @@ class TestProcessManagerOrphans:
         finally:
             if sup.poll() is None:
                 sup.kill()
+
+
+class TestFourDaemonFailover:
+    """Scale + failover past the 2-daemon happy path (the reference
+    exercises failover via test_cd_failover.bats fault injections): a
+    4-host domain converges, survives a SIGKILLed coordination child,
+    and re-admits a wholesale-replaced daemon into its old slot."""
+
+    PORTS = (17081, 17082, 17083, 17084)
+
+    def _sync_all(self, daemons):
+        for d in daemons:
+            d._last_members = None
+            d.sync_once()
+
+    def test_gang_of_four_with_failovers(self, kube, controller, tmp_path):
+        for node in ("node-2", "node-3"):
+            kube.create("", "v1", "nodes",
+                        {"kind": "Node", "metadata": {"name": node}})
+        cd = make_cd(kube, topology="4x2x2")  # 16 chips / 4 per host
+        uid = cd["metadata"]["uid"]
+        controller.reconcile(cd)
+
+        daemons = [
+            make_daemon(kube, tmp_path, uid, f"node-{i}", "127.0.0.1",
+                        self.PORTS[i], num_workers=4)
+            for i in range(4)
+        ]
+        try:
+            for i, d in enumerate(daemons):
+                assert d.registrar.register() == i
+                d.process.ensure_started()
+            for port in self.PORTS:
+                wait_for_service(port)
+            self._sync_all(daemons)
+            for d in daemons:
+                d.registrar.set_status("Ready")
+            self._sync_all(daemons)
+            members = json.loads(
+                query("127.0.0.1", self.PORTS[0], "MEMBERS"))
+            assert members["numWorkers"] == 4
+            assert len(members["workers"]) == 4
+            assert query("127.0.0.1", self.PORTS[0], "STATUS") == "READY"
+
+            # Failover 1: SIGKILL daemon 2's coordination child; its
+            # supervisor restarts it and the quorum re-converges.
+            victim = daemons[2]
+            old_pid = victim.process.pid
+            os.kill(old_pid, signal.SIGKILL)
+            deadline = time.monotonic() + 10
+            while victim.process.alive() and time.monotonic() < deadline:
+                time.sleep(0.05)  # SIGKILL delivery is asynchronous
+            victim.process.ensure_started()
+            assert victim.process.pid != old_pid
+            wait_for_service(self.PORTS[2])
+            self._sync_all(daemons)
+            assert query("127.0.0.1", self.PORTS[2], "STATUS") == "READY"
+
+            # Failover 2: daemon 3 is replaced wholesale (pod deleted,
+            # DaemonSet reschedules). The replacement re-claims slot 3.
+            daemons[3].process.stop()
+            replacement = make_daemon(kube, tmp_path, uid, "node-3",
+                                      "127.0.0.1", self.PORTS[3],
+                                      num_workers=4)
+            assert replacement.registrar.register() == 3
+            replacement.process.ensure_started()
+            wait_for_service(self.PORTS[3])
+            replacement.registrar.set_status("Ready")
+            daemons[3] = replacement
+            self._sync_all(daemons)
+            members = json.loads(
+                query("127.0.0.1", self.PORTS[0], "MEMBERS"))
+            assert len(members["workers"]) == 4
+            assert query("127.0.0.1", self.PORTS[3], "STATUS") == "READY"
+
+            # Controller still aggregates Ready after both failovers.
+            controller.update_global_status(
+                kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                         namespace="team-a"))
+            cd2 = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                           namespace="team-a")
+            assert cd2["status"]["status"] == "Ready"
+        finally:
+            for d in daemons:
+                d.process.stop()

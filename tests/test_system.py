@@ -267,6 +267,28 @@ class TestBench:
         doc = json.loads(lines[0])
         assert {"metric", "value", "unit", "vs_baseline"} <= set(doc)
         assert "stress_p50_ms" in doc.get("extras", {})
+        # vs_baseline is like-for-like: the dynamic sub-slice p50 (the
+        # claim class the reference's O(1s) MIG envelope applies to).
+        ss = doc["extras"]["subslice_prepare_p50_ms"]
+        assert abs(doc["vs_baseline"] - 1000.0 / ss) < 1.0
+        # Multi-chip section skips cleanly when single-chip.
+        assert "allreduce_gbps" not in doc["extras"]
+        assert "allreduce_mock_gbps" not in doc["extras"]
+
+    def test_bench_multichip_mock_section(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env={**ENV, "BENCH_SKIP_MODEL": "1",
+                 "BENCH_MULTICHIP_MOCK": "4"},
+            capture_output=True, text=True, timeout=300,
+        )
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout.strip().splitlines()[-1])
+        # The mock proves the section end to end but stays clearly
+        # labeled: a CPU number must never pose as ICI bandwidth.
+        assert doc["extras"]["allreduce_mock_participants"] == 4
+        assert doc["extras"]["allreduce_mock_gbps"] > 0
+        assert "allreduce_gbps" not in doc["extras"]
 
 
 PREPARE_SEGMENTS = [
