@@ -52,6 +52,10 @@ def run(argv: list[str] | None = None) -> int:
     logsetup.setup(args.verbosity)
     logsetup.log_startup(__name__, "compute-domain-controller",
                          __version__, args)
+    # Canonical verbosity channel for anything this process renders
+    # (daemon DaemonSets inherit it, objects.py) -- a -v flag must win
+    # over a stale inherited V.
+    os.environ["V"] = str(args.verbosity)
 
     kube = FakeKubeClient() if args.standalone else KubeClient()
     metrics = ComputeDomainMetrics()

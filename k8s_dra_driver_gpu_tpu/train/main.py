@@ -68,7 +68,14 @@ def run(argv: list[str] | None = None) -> int:
                    default=os.environ.get("PROFILE_DIR", ""),
                    help="capture a jax.profiler trace (XLA/TPU timeline) "
                         "of steps 2..4 into this dir")
+    p.add_argument("--steps-per-call", type=int,
+                   default=int(os.environ.get("STEPS_PER_CALL", "1")),
+                   help="optimizer steps per compiled dispatch "
+                        "(lax.scan pipeline; amortizes host round-trips "
+                        "-- see train.scanned_train_step) [STEPS_PER_CALL]")
     args = p.parse_args(argv)
+    if args.steps_per_call < 1:
+        p.error("--steps-per-call must be >= 1")
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
@@ -97,6 +104,9 @@ def run(argv: list[str] | None = None) -> int:
         if args.tp and args.tp != 1:
             p.error("--tp applies to the dense families only; "
                     "--model moe-tiny uses a (dp, ep) mesh")
+        if args.steps_per_call > 1:
+            p.error("--steps-per-call applies to the dense families "
+                    "only (the MoE trainer is manual-SPMD)")
         cfg = llama_moe.LlamaMoEConfig.tiny()
         ep = min(len(devices), cfg.n_experts)
         while ep > 1 and (len(devices) % ep or cfg.n_experts % ep):
@@ -111,6 +121,7 @@ def run(argv: list[str] | None = None) -> int:
                                          mesh.devices.shape)))
         init_fn, step_fn, batch_shard, place = llama_moe.make_moe_train(
             mesh, cfg)
+        scan_fn = scan_batch_shard = None
         state = init_fn(place(llama_moe.init(jax.random.PRNGKey(0), cfg)))
     else:
         mesh = build_mesh(plan_for(len(devices), tp=args.tp),
@@ -120,6 +131,12 @@ def run(argv: list[str] | None = None) -> int:
         cfg = (llama.LlamaConfig.tiny() if args.model == "tiny"
                else llama.LlamaConfig.llama3_8b())
         init_fn, step_fn, batch_shard, place = make_sharded_train(mesh, cfg)
+        scan_fn = scan_batch_shard = None
+        if args.steps_per_call > 1:
+            from .train import make_scanned_sharded_train  # noqa: PLC0415
+
+            _, scan_fn, scan_batch_shard, _ = make_scanned_sharded_train(
+                mesh, cfg)
         state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
 
     ckpt = None
@@ -185,28 +202,54 @@ def run(argv: list[str] | None = None) -> int:
     # Global tokens per step (all gang members), matching both modes.
     tokens_per_step = global_batch * args.seq_len
     tracing = False
-    for step in range(start_step, args.steps):
-        if args.profile_dir and step == start_step + 1 and not tracing:
+
+    def scan_batch_for(step: int, k: int):
+        import numpy as _np  # noqa: PLC0415
+
+        stacked = _np.stack([local_batch(step + i) for i in range(k)])
+        return jax.make_array_from_process_local_data(
+            scan_batch_shard, stacked)
+
+    step = start_step
+    first_timed = None  # first step boundary after the compile call
+    profiled = False  # the trace runs once, around steps ~2..4
+    while step < args.steps:
+        prev = step
+        if (args.profile_dir and step >= start_step + 1
+                and not tracing and not profiled):
             jax.profiler.start_trace(args.profile_dir)
             tracing = True
-        state, loss = step_fn(state, batch_for(step))
+            profiled = True
+        # Scan path: K full steps per dispatch while they fit; the tail
+        # (and the per-step path) use the unscanned step_fn. Step
+        # semantics are identical -- same batches per step, same order.
+        k = args.steps_per_call
+        if scan_fn is not None and step + k <= args.steps:
+            state, losses = scan_fn(state, scan_batch_for(step, k))
+            loss = losses[-1]
+            step += k
+        else:
+            state, loss = step_fn(state, batch_for(step))
+            step += 1
         if tracing and step >= start_step + 3:
             jax.block_until_ready(loss)
             jax.profiler.stop_trace()
             tracing = False
             logger.info("profile trace written to %s", args.profile_dir)
-        if step == start_step:
+        if first_timed is None:
             jax.block_until_ready(loss)  # exclude compile from timing
             t0 = time.perf_counter()
-        if (step + 1) % 10 == 0 or step + 1 == args.steps:
+            first_timed = step
+        if prev // 10 != step // 10 or step == args.steps:
             jax.block_until_ready(loss)
             dt = time.perf_counter() - t0
-            done = step - start_step
-            tps = tokens_per_step * done / dt if dt > 0 and done else 0.0
+            done = step - first_timed
+            tps = tokens_per_step * done / dt if dt > 0 and done > 0 else 0.0
             logger.info("step %d loss %.4f (%.0f tok/s)",
-                        step + 1, float(loss), tps)
-        if ckpt and (step + 1) % args.checkpoint_every == 0:
-            ckpt.save(step + 1, state)
+                        step, float(loss), tps)
+        if ckpt and (prev // args.checkpoint_every
+                     != step // args.checkpoint_every):
+            ckpt.save(step, state)
     if tracing:
         # Short runs: close the trace before exit so it's usable.
         jax.block_until_ready(state.step)

@@ -48,6 +48,23 @@ def train_step(state: TrainState, tokens, *, cfg, optimizer):
     return TrainState(params, opt_state, state.step + 1), loss
 
 
+def scanned_train_step(state: TrainState, tokens_kbs, *, cfg, optimizer):
+    """K optimizer steps per dispatch: ``tokens_kbs`` is [K, B, S+1] and
+    the K steps run under one ``lax.scan`` inside one compiled call,
+    returning all K losses.
+
+    TPU-first dispatch shape: one XLA program per macro-batch instead of
+    one per step keeps the chip busy between host visits -- on a
+    tunneled/remote chip this is the difference between 0.26 and 0.42+
+    MFU (docs/benchmarks.md), and on local hardware it still removes
+    K-1 dispatch/sync gaps per macro-batch. The loop stays
+    compiler-friendly: scan compiles the body ONCE regardless of K."""
+    def body(st, tokens):
+        return train_step(st, tokens, cfg=cfg, optimizer=optimizer)
+
+    return jax.lax.scan(body, state, tokens_kbs)
+
+
 def make_sharded_train(mesh: Mesh, cfg: llama.LlamaConfig, optimizer=None,
                        batch_axes: tuple[str, ...] | None = None):
     """Returns (init_fn, step_fn, batch_sharding) jitted over ``mesh``.
@@ -88,3 +105,23 @@ def make_sharded_train(mesh: Mesh, cfg: llama.LlamaConfig, optimizer=None,
         return jax.device_put(params, param_shard)
 
     return init_fn, step_fn, batch_shard, place_params
+
+
+def make_scanned_sharded_train(mesh: Mesh, cfg: llama.LlamaConfig,
+                               optimizer=None,
+                               batch_axes: tuple[str, ...] | None = None):
+    """``make_sharded_train`` with K steps per dispatch (see
+    ``scanned_train_step``). step_fn(state, tokens[K, B, S+1]) ->
+    (state, losses[K]); the leading scan dim is unsharded (K is just the
+    input's leading extent), the per-step batch shards exactly as in the
+    unscanned path."""
+    optimizer = optimizer or make_optimizer()
+    init_fn, _, batch_shard, place_params = make_sharded_train(
+        mesh, cfg, optimizer=optimizer, batch_axes=batch_axes)
+    spec = batch_shard.spec
+    scan_batch_shard = NamedSharding(mesh, P(None, *spec))
+    step_fn = jax.jit(
+        partial(scanned_train_step, cfg=cfg, optimizer=optimizer),
+        donate_argnums=(0,),
+    )
+    return init_fn, step_fn, scan_batch_shard, place_params

@@ -148,10 +148,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from .. import __version__  # noqa: PLC0415
     from ..pkg import logsetup  # noqa: PLC0415
 
     args = build_parser().parse_args(argv)
     logsetup.setup(args.verbosity)
+    logsetup.log_startup(__name__, "tpu-dra-webhook", __version__, args)
     server = WebhookServer(port=args.port, tls_cert=args.tls_cert,
                            tls_key=args.tls_key)
     server.start()

@@ -32,6 +32,34 @@ class TestLauncher:
         assert any("'ep'" in r.message or "ep" in str(r.message)
                    for r in caplog.records if "mesh" in r.message)
 
+    def test_steps_per_call_matches_per_step_semantics(self, caplog):
+        import logging
+        import re
+
+        caplog.set_level(logging.INFO)
+
+        def final_loss():
+            msgs = [r.getMessage() for r in caplog.records
+                    if r.getMessage().startswith("step 7 ")]
+            assert msgs, [r.getMessage() for r in caplog.records]
+            return float(re.search(r"loss ([0-9.]+)", msgs[-1]).group(1))
+
+        # 7 steps = 2 scanned dispatches of 3 + 1 per-step tail; the
+        # synthetic data is keyed by step, so step semantics identical
+        # to the unscanned run mean an identical final loss.
+        assert run(["--model", "tiny", "--steps", "7", "--batch-size",
+                    "4", "--seq-len", "16", "--steps-per-call", "3"]) == 0
+        scanned = final_loss()
+        caplog.clear()
+        assert run(["--model", "tiny", "--steps", "7", "--batch-size",
+                    "4", "--seq-len", "16"]) == 0
+        assert abs(scanned - final_loss()) < 2e-3
+
+    def test_steps_per_call_rejected_for_moe(self, capsys):
+        with pytest.raises(SystemExit):
+            run(["--model", "moe-tiny", "--steps", "2",
+                 "--steps-per-call", "2"])
+
     def test_resume_from_checkpoint(self, tmp_path, caplog):
         import logging
 
