@@ -43,6 +43,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-v", "--verbosity", type=int,
                    default=int(env("V", "4")),
                    help="log verbosity (see pkg/logsetup.py) [V]")
+    p.add_argument("--kube-api", default=env("KUBE_API", ""),
+                   help="API server URL override [KUBE_API]")
     p.add_argument("--standalone", action="store_true")
     return p
 
@@ -57,7 +59,8 @@ def run(argv: list[str] | None = None) -> int:
     # over a stale inherited V.
     os.environ["V"] = str(args.verbosity)
 
-    kube = FakeKubeClient() if args.standalone else KubeClient()
+    kube = FakeKubeClient() if args.standalone else KubeClient(
+        host=args.kube_api or None)
     metrics = ComputeDomainMetrics()
     metrics_server = None
     if args.metrics_port > 0:

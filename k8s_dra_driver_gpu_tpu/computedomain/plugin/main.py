@@ -51,6 +51,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-v", "--verbosity", type=int,
                    default=int(env("V", "4")),
                    help="log verbosity (see pkg/logsetup.py) [V]")
+    p.add_argument("--kube-api", default=env("KUBE_API", ""),
+                   help="API server URL override [KUBE_API]")
     p.add_argument("--standalone", action="store_true")
     p.add_argument("--version", action="version", version=__version__)
     return p
@@ -64,7 +66,8 @@ def run(argv: list[str] | None = None) -> int:
                          __version__, args)
 
     node_name = args.node_name or os.uname().nodename
-    kube = FakeKubeClient() if args.standalone else KubeClient()
+    kube = FakeKubeClient() if args.standalone else KubeClient(
+        host=args.kube_api or None)
     state = CDDeviceState(
         root=args.state_root,
         kube=kube,

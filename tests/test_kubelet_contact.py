@@ -206,3 +206,134 @@ class TestApiServerWireParity:
         assert seen.wait(timeout=15), f"watch delivered only {got}"
         assert ("ADDED", "w1") in got and ("DELETED", "w1") in got
         stop.set()
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def spawned_binary(log_path, argv):
+    """Run a driver binary with file-captured logs and guaranteed
+    SIGTERM/kill teardown (the pattern of the `plugin` fixture, shared
+    by the CD first-contact tests)."""
+    log = open(log_path, "w", encoding="utf-8")
+    proc = subprocess.Popen(argv, env=ENV, stdout=log,
+                            stderr=subprocess.STDOUT)
+    try:
+        yield proc
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        log.close()
+
+
+class TestComputeDomainFirstContact:
+    """The CD stack's first contact: controller and CD plugin binaries
+    against the live fake apiserver -- streamed HTTP watches drive the
+    controller's reconcile, and the CD plugin registers with the fake
+    kubelet and publishes its channel slice over HTTP."""
+
+    CD_DRIVER = "compute-domain.tpu.dra.dev"
+
+    def _wait(self, fn, timeout=60, desc=""):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            got = fn()
+            if got:
+                return got
+            time.sleep(0.5)
+        raise AssertionError(f"timed out waiting for {desc}")
+
+    def test_controller_reconciles_over_http_watch(self, apiserver,
+                                                   tmp_path):
+        kube = KubeClient(host=apiserver.url)
+        with spawned_binary(tmp_path / "controller.log", [
+            sys.executable, "-m",
+            "k8s_dra_driver_gpu_tpu.computedomain.controller.main",
+            "--kube-api", apiserver.url,
+            "--namespace", "tpu-dra-driver",
+        ]):
+            # Created AFTER the controller starts: only the streamed
+            # HTTP watch (not the startup resync) can deliver it fast.
+            kube.create("resource.tpu.dra", "v1beta1", "computedomains", {
+                "apiVersion": "resource.tpu.dra/v1beta1",
+                "kind": "ComputeDomain",
+                "metadata": {"name": "cd-http", "namespace": "team-a",
+                             "uid": "cd-http-uid"},
+                "spec": {
+                    "topology": "2x2x2",
+                    "channel": {
+                        "resourceClaimTemplate": {"name": "cd-http-rct"},
+                        "allocationMode": "Single",
+                    },
+                },
+            }, namespace="team-a")
+
+            from k8s_dra_driver_gpu_tpu.computedomain import NODE_LABEL
+
+            ds = self._wait(
+                lambda: kube.list("apps", "v1", "daemonsets",
+                                  namespace="tpu-dra-driver"),
+                desc="daemon DaemonSet")
+            assert any(
+                d["metadata"].get("labels", {}).get(NODE_LABEL)
+                == "cd-http-uid"
+                for d in ds
+            ), [d["metadata"] for d in ds]
+            rcts = self._wait(
+                lambda: kube.list("resource.k8s.io", "v1",
+                                  "resourceclaimtemplates",
+                                  namespace="team-a"),
+                desc="workload RCT in the user namespace")
+            assert any(r["metadata"]["name"] == "cd-http-rct"
+                       for r in rcts)
+            cd = self._wait(
+                lambda: (lambda o: o if o["metadata"].get("finalizers")
+                         else None)(
+                    kube.get("resource.tpu.dra", "v1beta1",
+                             "computedomains", "cd-http",
+                             namespace="team-a")),
+                desc="finalizer on the ComputeDomain")
+            assert cd["metadata"]["finalizers"]
+
+    def test_cd_plugin_registers_and_publishes(self, apiserver, tmp_path):
+        import shutil
+        import tempfile
+
+        kube = KubeClient(host=apiserver.url)
+        # The CD driver's registration socket name is 35 chars; under
+        # pytest's deep tmp_path the full path exceeds AF_UNIX's
+        # ~108-byte sun_path. Short dir for the sockets only (the
+        # production dirs /var/lib/kubelet/... are well inside).
+        sock_root = tempfile.mkdtemp(prefix="cdfc-", dir="/tmp")
+        try:
+            with spawned_binary(tmp_path / "cd-plugin.log", [
+                sys.executable, "-m",
+                "k8s_dra_driver_gpu_tpu.computedomain.plugin.main",
+                "--kube-api", apiserver.url,
+                "--node-name", "node-cd",
+                "--state-root", str(tmp_path / "state"),
+                "--cdi-root", str(tmp_path / "cdi"),
+                "--plugin-dir", os.path.join(sock_root, "plugin"),
+                "--registry-dir", os.path.join(sock_root, "registry"),
+            ]):
+                kubelet = FakeKubelet(os.path.join(sock_root, "registry"))
+                handle = kubelet.wait_for_plugin(self.CD_DRIVER,
+                                                 timeout=60)
+                assert handle.service == "v1.DRAPlugin"
+                slices = self._wait(
+                    lambda: [s for s in kube.list(
+                        "resource.k8s.io", "v1", "resourceslices")
+                        if s["spec"].get("driver") == self.CD_DRIVER],
+                    desc="CD ResourceSlice over HTTP")
+                devices = {d["name"] for s in slices
+                           for d in s["spec"]["devices"]}
+                assert "channel-0" in devices
+                assert any(d.startswith("daemon") for d in devices), devices
+        finally:
+            shutil.rmtree(sock_root, ignore_errors=True)

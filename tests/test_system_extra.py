@@ -115,6 +115,20 @@ class TestLoggingContract:
         assert "t_prep_devices" in text
         assert "t_checkpoint_write" in text
 
+    def test_webhook_startup_config_at_verbosity_0(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from k8s_dra_driver_gpu_tpu.webhook.main import main\n"
+             "import threading, os, signal\n"
+             "threading.Timer(1.0, lambda: os.kill(os.getpid(), "
+             "signal.SIGINT)).start()\n"
+             "main(['--port', '0', '-v', '0'])"],
+            env=ENV, cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        text = out.stdout + out.stderr
+        assert "tpu-dra-webhook" in text and "starting" in text
+        assert "config port=0" in text
+
     def test_cd_controller_startup_config_at_verbosity_0(self, tmp_path):
         out = subprocess.run(
             [sys.executable, "-c",
