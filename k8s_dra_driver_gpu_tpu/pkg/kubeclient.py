@@ -466,8 +466,19 @@ class FakeKubeClient:
             obj = json.loads(json.dumps(obj))
             meta = obj.setdefault("metadata", {})
             meta.setdefault("uid", old.get("metadata", {}).get("uid"))
-            rv = int(old.get("metadata", {}).get("resourceVersion", "1"))
-            meta["resourceVersion"] = str(rv + 1)
+            old_rv = old.get("metadata", {}).get("resourceVersion", "1")
+            # Apiserver optimistic concurrency: an update carrying a
+            # resourceVersion must match the stored one or 409 -- this
+            # is what makes fetch-modify-update retry loops (registrar,
+            # leader election) actually exercise their conflict paths.
+            # An update WITHOUT a resourceVersion is accepted (k8s
+            # last-write semantics for rv-less updates).
+            rv_in = meta.get("resourceVersion")
+            if rv_in and rv_in != old_rv:
+                raise ConflictError(
+                    f"{resource}/{name}: resourceVersion {rv_in} is "
+                    f"stale (current {old_rv})")
+            meta["resourceVersion"] = str(int(old_rv) + 1)
             self._store[key] = obj
         self._notify("MODIFIED", obj, group, resource, namespace or "")
         return json.loads(json.dumps(obj))
@@ -486,7 +497,15 @@ class FakeKubeClient:
             if key not in self._store:
                 raise NotFoundError(f"{resource}/{name}")
             obj = self._store[key]
-            merge(obj, json.loads(json.dumps(patch)))
+            stored_rv = obj.get("metadata", {}).get("resourceVersion", "1")
+            patch = json.loads(json.dumps(patch))
+            # Merge-patch ignores optimistic concurrency (matching the
+            # apiserver for rv-less patches); a stale rv inside the
+            # patch body must not rewind the stored counter update()
+            # now enforces against.
+            patch.get("metadata", {}).pop("resourceVersion", None)
+            merge(obj, patch)
+            obj.setdefault("metadata", {})["resourceVersion"] = stored_rv
             rv = int(obj.get("metadata", {}).get("resourceVersion", "1"))
             obj["metadata"]["resourceVersion"] = str(rv + 1)
             out = json.loads(json.dumps(obj))

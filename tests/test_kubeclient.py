@@ -11,6 +11,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import pytest
 
 from k8s_dra_driver_gpu_tpu.pkg.kubeclient import (
+    ConflictError,
+    FakeKubeClient,
     KubeClient,
     KubeError,
     NotFoundError,
@@ -244,3 +246,50 @@ class TestKubeClientWatch:
         watch_paths = [p for m, p, _ in stub.requests if "watch=true" in p]
         post_gone = [p for p in watch_paths[1:] if "resourceVersion=" not in p]
         assert post_gone
+
+
+class TestOptimisticConcurrency:
+    def test_stale_resource_version_conflicts(self):
+        kube = FakeKubeClient()
+        kube.create("", "v1", "configmaps", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "cm"}, "data": {"k": "0"},
+        }, namespace="ns")
+        first = kube.get("", "v1", "configmaps", "cm", namespace="ns")
+        second = kube.get("", "v1", "configmaps", "cm", namespace="ns")
+        first["data"]["k"] = "1"
+        kube.update("", "v1", "configmaps", "cm", first, namespace="ns")
+        # Writer 2 holds the old resourceVersion: lost-update prevented.
+        second["data"]["k"] = "2"
+        with pytest.raises(ConflictError):
+            kube.update("", "v1", "configmaps", "cm", second,
+                        namespace="ns")
+        assert kube.get("", "v1", "configmaps", "cm",
+                        namespace="ns")["data"]["k"] == "1"
+        # An rv-less update is accepted (k8s semantics).
+        kube.update("", "v1", "configmaps", "cm", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "cm"}, "data": {"k": "3"},
+        }, namespace="ns")
+        assert kube.get("", "v1", "configmaps", "cm",
+                        namespace="ns")["data"]["k"] == "3"
+
+    def test_patch_never_rewinds_resource_version(self):
+        kube = FakeKubeClient()
+        kube.create("", "v1", "configmaps", {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "cm"}, "data": {"k": "0"},
+        }, namespace="ns")
+        stale = kube.get("", "v1", "configmaps", "cm", namespace="ns")
+        for i in range(3):  # advance the stored rv well past the copy
+            kube.patch("", "v1", "configmaps", "cm",
+                       {"data": {"k": str(i)}}, namespace="ns")
+        # Patching with a FULL stale object (rv inside the body) must
+        # not rewind the counter...
+        stale["data"]["k"] = "stale"
+        kube.patch("", "v1", "configmaps", "cm", stale, namespace="ns")
+        fresh = kube.get("", "v1", "configmaps", "cm", namespace="ns")
+        assert int(fresh["metadata"]["resourceVersion"]) >= 5
+        # ...so a holder of the genuinely-latest rv still updates fine.
+        fresh["data"]["k"] = "after"
+        kube.update("", "v1", "configmaps", "cm", fresh, namespace="ns")
