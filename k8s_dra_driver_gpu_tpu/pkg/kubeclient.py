@@ -516,8 +516,17 @@ class FakeKubeClient:
         key = self._key(group, resource, namespace, name)
         with self._lock:
             obj = self._store.pop(key, None)
+            cascade = []
+            if obj is not None and resource == "namespaces" and not namespace:
+                # Namespace deletion GCs every namespaced object in it,
+                # like the real namespace controller (so e2e teardown
+                # frees allocated devices and claims).
+                for k in [k for k in self._store if k[2] == name]:
+                    cascade.append((k, self._store.pop(k)))
         if obj is not None:
             self._notify("DELETED", obj, group, resource, namespace or "")
+        for (g, r, ns, _), victim in cascade:
+            self._notify("DELETED", victim, g, r, ns)
 
     def server_version(self) -> dict:
         return self.version

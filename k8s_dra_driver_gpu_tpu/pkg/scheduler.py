@@ -1,0 +1,542 @@
+"""A DRA-aware scheduler + resourceclaim controller stand-in.
+
+The reference never ships this logic -- it relies on the real
+kube-scheduler's DRA plugin and kube-controller-manager's resourceclaim
+controller (vendored under k8s.io/dynamic-resource-allocation). Our
+first-contact tier has no kubelet or scheduler binaries available, so
+this module implements the two control-plane behaviors the e2e tier
+needs, faithfully enough that the REAL driver binaries cannot tell the
+difference:
+
+1. **Claim generation** (kcm resourceclaim controller): a pod whose
+   ``spec.resourceClaims[]`` entry names a ``resourceClaimTemplateName``
+   gets a generated ResourceClaim (owner-ref'd to the pod) and a
+   ``status.resourceClaimStatuses`` mapping.
+2. **Allocation** (kube-scheduler DRA plugin, structured parameters
+   KEP-4381): for each unallocated claim, walk published
+   ResourceSlices at their newest pool generation, filter devices
+   through DeviceClass + request CEL selectors (pkg/cel.py), skip
+   devices already allocated or tainted NoSchedule/NoExecute (unless
+   tolerated), enforce KEP-4815 shared-counter budgets so partitioned
+   devices can never over-commit their parent, then write
+   ``status.allocation`` (results + config + nodeSelector) and reserve
+   the claim for its consumer pods.
+3. **Binding**: pods whose claims are all allocated get
+   ``spec.nodeName`` patched to the (single) node the allocation pins.
+
+Used by the executable e2e tier (TPU_DRA_E2E=fake) and runnable as a
+standalone control-plane binary:
+
+    python -m k8s_dra_driver_gpu_tpu.pkg.scheduler --kube-api http://...
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+import time
+import uuid
+
+from .cel import CelEvalError, CelProgram, Quantity, compile_expression
+from .kubeclient import ConflictError, KubeError, NotFoundError
+
+logger = logging.getLogger(__name__)
+
+RESOURCE = ("resource.k8s.io", "v1")
+
+
+def _meta(obj):
+    return obj.get("metadata", {})
+
+
+class _CompiledSelectors:
+    """Expression -> CelProgram cache; a selector that fails to compile
+    permanently matches nothing (and is logged once), like a CEL
+    compile error surfaced in the scheduler."""
+
+    def __init__(self):
+        self._cache: dict[str, CelProgram | None] = {}
+
+    def get(self, expression: str) -> CelProgram | None:
+        if expression not in self._cache:
+            try:
+                self._cache[expression] = compile_expression(expression)
+            except Exception as e:  # noqa: BLE001 - compile boundary
+                logger.error("selector does not compile (%s): %s",
+                             e, expression)
+                self._cache[expression] = None
+        return self._cache[expression]
+
+
+class _CounterLedger:
+    """Available KEP-4815 counters per (driver, pool, counterSet),
+    seeded from sharedCounters and debited by consumesCounters."""
+
+    def __init__(self):
+        self._avail: dict[tuple, dict[str, int]] = {}
+
+    def seed(self, driver: str, pool: str, counter_sets: list[dict]):
+        for cs in counter_sets or []:
+            key = (driver, pool, cs.get("name", ""))
+            if key in self._avail:
+                continue
+            self._avail[key] = {
+                name: Quantity.parse(val.get("value", "0")).milli
+                for name, val in (cs.get("counters") or {}).items()
+            }
+
+    def _iter_demand(self, driver, pool, consumes):
+        for block in consumes or []:
+            key = (driver, pool, block.get("counterSet", ""))
+            for name, val in (block.get("counters") or {}).items():
+                yield key, name, Quantity.parse(
+                    val.get("value", "0")).milli
+
+    def fits(self, driver: str, pool: str, consumes: list[dict]) -> bool:
+        for key, name, milli in self._iter_demand(driver, pool, consumes):
+            have = self._avail.get(key, {}).get(name)
+            if have is None or have < milli:
+                return False
+        return True
+
+    def debit(self, driver: str, pool: str, consumes: list[dict]):
+        for key, name, milli in self._iter_demand(driver, pool, consumes):
+            if key in self._avail and name in self._avail[key]:
+                self._avail[key][name] -= milli
+
+
+class _Candidate:
+    __slots__ = ("driver", "pool", "node", "device")
+
+    def __init__(self, driver, pool, node, device):
+        self.driver = driver
+        self.pool = pool
+        self.node = node
+        self.device = device
+
+    @property
+    def name(self):
+        return self.device["name"]
+
+    @property
+    def key(self):
+        return (self.driver, self.pool, self.name)
+
+
+def _tolerates(taint: dict, tolerations: list[dict]) -> bool:
+    for tol in tolerations or []:
+        if tol.get("effect") and tol["effect"] != taint.get("effect"):
+            continue
+        op = tol.get("operator", "Equal")
+        if op == "Exists":
+            if not tol.get("key") or tol["key"] == taint.get("key"):
+                return True
+        elif tol.get("key") == taint.get("key") and \
+                tol.get("value", "") == taint.get("value", ""):
+            return True
+    return False
+
+
+class DraScheduler:
+    """Single-pass-capable scheduler; call sync_once() or run()."""
+
+    def __init__(self, kube, default_node: str | None = None):
+        self.kube = kube
+        self.default_node = default_node
+        self._selectors = _CompiledSelectors()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- claim generation (kcm resourceclaim controller) ----------------------
+
+    def _pods(self) -> list[dict]:
+        try:
+            return self.kube.list("", "v1", "pods")
+        except KubeError:
+            return []
+
+    def _generate_claims(self):
+        for pod in self._pods():
+            refs = pod.get("spec", {}).get("resourceClaims") or []
+            statuses = pod.get("status", {}).get(
+                "resourceClaimStatuses") or []
+            have = {s["name"] for s in statuses}
+            ns = _meta(pod).get("namespace", "default")
+            new_statuses = []
+            for ref in refs:
+                tmpl = ref.get("resourceClaimTemplateName")
+                if not tmpl or ref["name"] in have:
+                    continue
+                try:
+                    template = self.kube.get(
+                        *RESOURCE, "resourceclaimtemplates", tmpl,
+                        namespace=ns)
+                except NotFoundError:
+                    continue  # template not applied yet; retry next pass
+                claim_name = (f"{_meta(pod)['name']}-{ref['name']}-"
+                              f"{uuid.uuid4().hex[:5]}")
+                claim = {
+                    "apiVersion": "resource.k8s.io/v1",
+                    "kind": "ResourceClaim",
+                    "metadata": {
+                        "name": claim_name,
+                        "namespace": ns,
+                        "uid": f"claim-{uuid.uuid4().hex[:12]}",
+                        "annotations": {
+                            "resource.kubernetes.io/pod-claim-name":
+                                ref["name"],
+                        },
+                        "ownerReferences": [{
+                            "apiVersion": "v1", "kind": "Pod",
+                            "name": _meta(pod)["name"],
+                            "uid": _meta(pod).get("uid", ""),
+                            "controller": True,
+                        }],
+                    },
+                    "spec": template.get("spec", {}).get("spec", {}),
+                }
+                try:
+                    self.kube.create(*RESOURCE, "resourceclaims", claim,
+                                     namespace=ns)
+                except ConflictError:
+                    pass
+                new_statuses.append(
+                    {"name": ref["name"], "resourceClaimName": claim_name})
+            if new_statuses:
+                self.kube.patch(
+                    "", "v1", "pods", _meta(pod)["name"],
+                    {"status": {"resourceClaimStatuses":
+                                statuses + new_statuses}},
+                    namespace=ns)
+
+    # -- allocation (kube-scheduler DRA plugin) -------------------------------
+
+    def _snapshot(self):
+        """(candidates, ledger, allocated-device keys) from the newest
+        generation of every published pool."""
+        slices = self.kube.list(*RESOURCE, "resourceslices")
+        newest: dict[tuple, int] = {}
+        for s in slices:
+            spec = s.get("spec", {})
+            pool = spec.get("pool", {})
+            key = (spec.get("driver", ""), pool.get("name", ""))
+            newest[key] = max(newest.get(key, 0), pool.get("generation", 0))
+        candidates: list[_Candidate] = []
+        ledger = _CounterLedger()
+        for s in slices:
+            spec = s.get("spec", {})
+            pool = spec.get("pool", {})
+            driver = spec.get("driver", "")
+            pool_name = pool.get("name", "")
+            if pool.get("generation", 0) != newest[(driver, pool_name)]:
+                continue  # stale generation: invisible to allocation
+            node = spec.get("nodeName") or self.default_node or ""
+            ledger.seed(driver, pool_name, spec.get("sharedCounters"))
+            for dev in spec.get("devices", []):
+                candidates.append(_Candidate(driver, pool_name, node, dev))
+
+        allocated: set[tuple] = set()
+        for claim in self.kube.list(*RESOURCE, "resourceclaims"):
+            alloc = claim.get("status", {}).get("allocation")
+            if not alloc:
+                continue
+            for res in alloc.get("devices", {}).get("results", []):
+                key = (res.get("driver", ""), res.get("pool", ""),
+                       res.get("device", ""))
+                allocated.add(key)
+        by_key = {c.key: c for c in candidates}
+        for key in allocated:
+            cand = by_key.get(key)
+            if cand is not None:
+                ledger.debit(cand.driver, cand.pool,
+                             cand.device.get("consumesCounters"))
+        return candidates, ledger, allocated
+
+    def _device_matches(self, cand: _Candidate, selectors: list[dict],
+                        tolerations: list[dict]) -> bool:
+        for taint in cand.device.get("taints") or []:
+            if taint.get("effect") in ("NoSchedule", "NoExecute") and \
+                    not _tolerates(taint, tolerations):
+                return False
+        for sel in selectors:
+            expr = (sel.get("cel") or {}).get("expression", "")
+            prog = self._selectors.get(expr)
+            if prog is None or not prog.matches_device(
+                    cand.device, cand.driver):
+                return False
+        return True
+
+    def _device_classes(self) -> dict[str, dict]:
+        return {
+            _meta(c)["name"]: c
+            for c in self.kube.list(*RESOURCE, "deviceclasses")
+        }
+
+    def _try_allocate(self, claim, candidates, ledger, allocated,
+                      classes) -> dict | None:
+        """One claim against the snapshot. Returns the allocation or
+        None; mutates ledger/allocated on success."""
+        requests = claim.get("spec", {}).get("devices", {}).get(
+            "requests", [])
+        if not requests:
+            return None
+        # Node-local pools pin the whole claim to one node: try each
+        # candidate node until every request fits (kube-scheduler does
+        # this per-node in Filter).
+        nodes = sorted({c.node for c in candidates})
+        for node in nodes:
+            picks = self._fit_on_node(
+                claim, node, candidates, ledger, allocated, classes)
+            if picks is None:
+                continue
+            results, configs = [], []
+            seen_classes = []
+            for req_name, cand, class_name in picks:
+                results.append({
+                    "request": req_name,
+                    "driver": cand.driver,
+                    "pool": cand.pool,
+                    "device": cand.name,
+                })
+                allocated.add(cand.key)
+                ledger.debit(cand.driver, cand.pool,
+                             cand.device.get("consumesCounters"))
+                if class_name not in seen_classes:
+                    seen_classes.append(class_name)
+            for class_name in seen_classes:
+                for cfg in classes.get(class_name, {}).get(
+                        "spec", {}).get("config", []) or []:
+                    if "opaque" in cfg:
+                        configs.append({
+                            "opaque": cfg["opaque"],
+                            "requests": [],
+                            "source": "FromClass",
+                        })
+            for cfg in claim.get("spec", {}).get("devices", {}).get(
+                    "config", []) or []:
+                if "opaque" in cfg:
+                    configs.append({
+                        "opaque": cfg["opaque"],
+                        "requests": cfg.get("requests", []),
+                        "source": "FromClaim",
+                    })
+            alloc = {
+                "devices": {"results": results, "config": configs},
+                "nodeSelector": {"nodeSelectorTerms": [{
+                    "matchFields": [{
+                        "key": "metadata.name",
+                        "operator": "In",
+                        "values": [node],
+                    }],
+                }]},
+            }
+            return alloc
+        return None
+
+    def _fit_on_node(self, claim, node, candidates, ledger, allocated,
+                     classes):
+        """All requests of one claim against one node; returns
+        [(request, candidate, class_name)] or None. Counter fits are
+        checked against a tentative ledger so multi-device claims can't
+        double-spend."""
+        tentative: list[tuple[str, _Candidate, str]] = []
+        taken: set[tuple] = set()
+        spent = _CounterLedger()
+        spent._avail = {k: dict(v) for k, v in ledger._avail.items()}
+        for req in claim.get("spec", {}).get("devices", {}).get(
+                "requests", []):
+            exactly = req.get("exactly") or req  # v1 nests under exactly
+            class_name = exactly.get("deviceClassName", "")
+            cls = classes.get(class_name)
+            if cls is None:
+                return None
+            selectors = list(cls.get("spec", {}).get("selectors") or [])
+            selectors += list(exactly.get("selectors") or [])
+            tolerations = list(exactly.get("tolerations") or [])
+            mode = exactly.get("allocationMode", "ExactCount")
+            want = int(exactly.get("count", 1)) if mode != "All" else None
+            got = 0
+            for cand in candidates:
+                if cand.node != node or cand.key in allocated or \
+                        cand.key in taken:
+                    continue
+                if not self._device_matches(cand, selectors, tolerations):
+                    continue
+                if not spent.fits(cand.driver, cand.pool,
+                                  cand.device.get("consumesCounters")):
+                    continue
+                spent.debit(cand.driver, cand.pool,
+                            cand.device.get("consumesCounters"))
+                taken.add(cand.key)
+                tentative.append((req.get("name", "r"), cand, class_name))
+                got += 1
+                if want is not None and got >= want:
+                    break
+            if want is not None and got < want:
+                return None
+            if want is None and got == 0:
+                return None  # All-mode with nothing to allocate
+        return tentative
+
+    def _allocate_claims(self):
+        candidates, ledger, allocated = self._snapshot()
+        classes = self._device_classes()
+        for claim in self.kube.list(*RESOURCE, "resourceclaims"):
+            if claim.get("status", {}).get("allocation"):
+                continue
+            if _meta(claim).get("deletionTimestamp"):
+                continue
+            alloc = self._try_allocate(
+                claim, candidates, ledger, allocated, classes)
+            if alloc is None:
+                continue
+            ns = _meta(claim).get("namespace", "default")
+            try:
+                self.kube.patch(
+                    *RESOURCE, "resourceclaims", _meta(claim)["name"],
+                    {"status": {"allocation": alloc}}, namespace=ns)
+            except (NotFoundError, ConflictError):
+                continue
+            logger.info(
+                "allocated claim %s/%s -> %s", ns, _meta(claim)["name"],
+                [r["device"] for r in alloc["devices"]["results"]])
+
+    # -- binding --------------------------------------------------------------
+
+    def _claims_for_pod(self, pod) -> list[tuple[str, dict | None]]:
+        ns = _meta(pod).get("namespace", "default")
+        statuses = {
+            s["name"]: s.get("resourceClaimName")
+            for s in pod.get("status", {}).get("resourceClaimStatuses") or []
+        }
+        out = []
+        for ref in pod.get("spec", {}).get("resourceClaims") or []:
+            claim_name = ref.get("resourceClaimName") or statuses.get(
+                ref["name"])
+            if not claim_name:
+                out.append((ref["name"], None))
+                continue
+            try:
+                out.append((claim_name, self.kube.get(
+                    *RESOURCE, "resourceclaims", claim_name,
+                    namespace=ns)))
+            except NotFoundError:
+                out.append((claim_name, None))
+        return out
+
+    def _reserve(self, claim, pod):
+        ns = _meta(claim).get("namespace", "default")
+        reserved = claim.get("status", {}).get("reservedFor") or []
+        entry = {
+            "resource": "pods",
+            "name": _meta(pod)["name"],
+            "uid": _meta(pod).get("uid", ""),
+        }
+        if entry not in reserved:
+            self.kube.patch(
+                *RESOURCE, "resourceclaims", _meta(claim)["name"],
+                {"status": {"reservedFor": reserved + [entry]}},
+                namespace=ns)
+
+    def _bind_pods(self):
+        for pod in self._pods():
+            if pod.get("spec", {}).get("nodeName"):
+                continue
+            if pod.get("status", {}).get("phase") not in (
+                    None, "", "Pending"):
+                continue
+            nodes = set()
+            ready = True
+            claim_objs = []
+            for _, claim in self._claims_for_pod(pod):
+                if claim is None:
+                    ready = False
+                    break
+                alloc = claim.get("status", {}).get("allocation")
+                if not alloc:
+                    ready = False
+                    break
+                claim_objs.append(claim)
+                for term in alloc.get("nodeSelector", {}).get(
+                        "nodeSelectorTerms", []):
+                    for mf in term.get("matchFields", []):
+                        if mf.get("key") == "metadata.name":
+                            nodes.add(mf["values"][0])
+            if not ready:
+                continue
+            if len(nodes) > 1:
+                # Claims allocated independently landed on different
+                # nodes: binding anywhere would strand a device. The
+                # real scheduler avoids this by filtering per-node
+                # before allocating; surface it instead of mis-binding.
+                logger.warning(
+                    "pod %s/%s claims span nodes %s; not binding",
+                    _meta(pod).get("namespace", "default"),
+                    _meta(pod)["name"], sorted(nodes))
+                continue
+            node = next(iter(nodes)) if nodes else None
+            if node is None:
+                node = self.default_node
+            if node is None:
+                continue
+            ns = _meta(pod).get("namespace", "default")
+            for claim in claim_objs:
+                self._reserve(claim, pod)
+            self.kube.patch("", "v1", "pods", _meta(pod)["name"],
+                            {"spec": {"nodeName": node}}, namespace=ns)
+            logger.info("bound pod %s/%s -> %s", ns,
+                        _meta(pod)["name"], node)
+
+    # -- loop -----------------------------------------------------------------
+
+    def sync_once(self):
+        self._generate_claims()
+        self._allocate_claims()
+        self._bind_pods()
+
+    def run(self, interval: float = 0.25):
+        while not self._stop.is_set():
+            try:
+                self.sync_once()
+            except Exception:  # noqa: BLE001 - control loop
+                logger.exception("scheduler sync failed")
+            self._stop.wait(interval)
+
+    def start(self) -> "DraScheduler":
+        self._thread = threading.Thread(
+            target=self.run, name="dra-scheduler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main(argv: list[str] | None = None) -> int:
+    from .kubeclient import KubeClient
+
+    p = argparse.ArgumentParser(prog="tpu-dra-scheduler")
+    p.add_argument("--kube-api", required=True)
+    p.add_argument("--default-node", default=None)
+    p.add_argument("--interval", type=float, default=0.25)
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    sched = DraScheduler(KubeClient(host=args.kube_api),
+                         default_node=args.default_node)
+    print("scheduler running", flush=True)
+    try:
+        sched.run(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

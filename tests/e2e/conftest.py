@@ -1,11 +1,20 @@
-"""Real-cluster e2e tier (reference: test/e2e/ Ginkgo suite).
+"""e2e tier (reference: test/e2e/ Ginkgo suite) -- two backends:
 
-Skipped unless TPU_DRA_E2E=1 AND a kubeconfig is reachable -- this
-tier is invasive against the current kubectl context (like the
-reference's bats suite). Run:
+**fake-cluster mode (default).** The tier EXECUTES in every test run:
+a live fake apiserver (pkg/fakeapiserver), the REAL kubelet-plugin
+binary as a subprocess, the DRA scheduler + resourceclaim controller
+(pkg/scheduler), and a fake node that prepares claims over the real
+plugin gRPC socket, applies the CDI specs exactly like containerd, and
+runs container commands as real subprocesses (tests/fake_node). Every
+process boundary of a real cluster short of containerd itself is
+crossed for real. This is the in-repo analog of the reference's
+mock-NVML kind pipeline (.github/workflows/mock-nvml-e2e.yaml).
 
-    TPU_DRA_E2E=1 KUBECONFIG=~/.kube/config \
-        python -m pytest tests/e2e/ -q
+**real-cluster mode.** TPU_DRA_E2E=1 with a reachable kubeconfig runs
+the same tests against the current kubectl context (invasive, like the
+reference's bats suite):
+
+    TPU_DRA_E2E=1 KUBECONFIG=~/.kube/config python -m pytest tests/e2e/
 
 The suite adapts to whatever the driver published: it reads the
 ResourceSlice in a session fixture (platform/topology/HBM) and drives
@@ -14,27 +23,130 @@ hardware detection.
 """
 
 import os
+import shutil
+import signal
+import subprocess
 import sys
+import tempfile
 
 import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
 
-E2E = os.environ.get("TPU_DRA_E2E") == "1"
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+MODE = os.environ.get("TPU_DRA_E2E", "fake")
 KUBECONFIG = os.environ.get("KUBECONFIG",
                             os.path.expanduser("~/.kube/config"))
 
 
 def pytest_runtest_setup(item):
-    if not E2E:
-        pytest.skip("e2e tier: set TPU_DRA_E2E=1 with a live kubeconfig")
-    if not os.path.exists(KUBECONFIG):
+    if MODE == "1" and not os.path.exists(KUBECONFIG):
         pytest.skip(f"e2e tier: no kubeconfig at {KUBECONFIG}")
+    if MODE not in ("1", "fake"):
+        pytest.skip("e2e tier disabled (TPU_DRA_E2E=0)")
+
+
+class FakeCluster:
+    """Apiserver + plugin binary + scheduler + node, one session."""
+
+    NODE = "node-e2e"
+
+    def __init__(self):
+        # Anything set up before a constructor failure must be torn
+        # down -- especially the plugin subprocess, which would
+        # otherwise outlive pytest holding its sockets.
+        self.apiserver = None
+        self.plugin = None
+        self.scheduler = None
+        self.node = None
+        self.log = None
+        try:
+            self._start()
+        except BaseException:
+            self.stop()
+            raise
+
+    def _start(self):
+        from k8s_dra_driver_gpu_tpu.pkg.chartrender import (
+            manifests,
+            render_chart,
+        )
+        from k8s_dra_driver_gpu_tpu.pkg.fakeapiserver import FakeApiServer
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
+        from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+        from tests.fake_node import FakeNode
+
+        self.workdir = tempfile.mkdtemp(prefix="tpu-e2e-")
+        self.apiserver = FakeApiServer().start()
+        self.kube = KubeClient(host=self.apiserver.url)
+
+        # The chart's DeviceClasses are the scheduler's matching input;
+        # applying the rendered chart is the "helm install" leg.
+        chart = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
+        for doc in manifests(render_chart(chart)):
+            if doc.get("kind") == "DeviceClass":
+                self.kube.create("resource.k8s.io", "v1", "deviceclasses",
+                                 doc)
+
+        cdi_root = os.path.join(self.workdir, "cdi")
+        registry = os.path.join(self.workdir, "reg")
+        self.log = open(os.path.join(self.workdir, "plugin.log"), "w",
+                        encoding="utf-8")
+        self.plugin = subprocess.Popen(
+            [sys.executable, "-m",
+             "k8s_dra_driver_gpu_tpu.kubeletplugin.main",
+             "--kube-api", self.apiserver.url,
+             "--node-name", self.NODE,
+             "--mock-topology", "v5e-4",
+             "--state-root", os.path.join(self.workdir, "state"),
+             "--cdi-root", cdi_root,
+             "--plugin-dir", os.path.join(self.workdir, "plugin"),
+             "--registry-dir", registry],
+            env={**os.environ, "PYTHONPATH": REPO},
+            stdout=self.log, stderr=subprocess.STDOUT,
+        )
+        self.scheduler = DraScheduler(self.kube,
+                                      default_node=self.NODE).start()
+        self.node = FakeNode(self.NODE, registry, cdi_root,
+                             self.kube).start()
+
+    def stop(self):
+        if self.node:
+            self.node.stop()
+        if self.scheduler:
+            self.scheduler.stop()
+        if self.plugin and self.plugin.poll() is None:
+            self.plugin.send_signal(signal.SIGTERM)
+            try:
+                self.plugin.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.plugin.kill()
+                self.plugin.wait()
+        if self.log:
+            self.log.close()
+        if self.apiserver:
+            self.apiserver.stop()
+        if getattr(self, "workdir", None):
+            shutil.rmtree(self.workdir, ignore_errors=True)
 
 
 @pytest.fixture(scope="session")
-def kube():
+def fake_cluster():
+    if MODE != "fake":
+        yield None
+        return
+    cluster = FakeCluster()
+    yield cluster
+    cluster.stop()
+
+
+@pytest.fixture(scope="session")
+def kube(fake_cluster):
+    if MODE == "fake":
+        return fake_cluster.kube
     from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
 
     return KubeClient.from_kubeconfig()
@@ -44,12 +156,21 @@ def kube():
 def chip_slice(kube):
     """The driver's published chip ResourceSlice (install check +
     hardware detection for the CEL tests)."""
-    slices = [
-        s for s in kube.list("resource.k8s.io", "v1", "resourceslices")
-        if s["spec"].get("driver") == "tpu.dra.dev"
-        and any("iciX" in d.get("attributes", {})
-                for d in s["spec"].get("devices", []))
-    ]
+    import time
+
+    deadline = time.monotonic() + 90
+    slices = []
+    while time.monotonic() < deadline:
+        slices = [
+            s for s in kube.list("resource.k8s.io", "v1",
+                                 "resourceslices")
+            if s["spec"].get("driver") == "tpu.dra.dev"
+            and any("iciX" in d.get("attributes", {})
+                    for d in s["spec"].get("devices", []))
+        ]
+        if slices:
+            break
+        time.sleep(1.0)
     assert slices, "tpu.dra.dev published no chip ResourceSlice -- is " \
                    "the driver installed?"
     return slices[0]

@@ -1,0 +1,271 @@
+"""A fake node: the kubelet pod-sync loop + containerd's CDI injection.
+
+Pairs with tests/fake_kubelet.py (the plugin-manager side) to finish
+the node: pods bound to this node get their DRA claims prepared over
+the REAL plugin gRPC socket, the returned CDI device IDs are resolved
+against the REAL spec files the driver wrote (exactly what containerd's
+CDI interceptor does: parse ``vendor/class=name``, find the spec, apply
+``containerEdits``), and the container command then runs as a REAL
+subprocess with the merged environment -- so the workload observes the
+same env contract a containerized workload would. Logs land where the
+fake apiserver's pod-log endpoint reads them; phases walk
+Pending -> Running -> Succeeded/Failed.
+
+Pod deletion triggers NodeUnprepareResources, mirroring the kubelet's
+claim lifecycle, so devices and prepared state are released.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeError, NotFoundError
+from tests.fake_kubelet import FakeKubelet
+
+
+class _PodRecord:
+    def __init__(self, pod):
+        self.uid = pod["metadata"].get("uid", "")
+        self.namespace = pod["metadata"].get("namespace", "default")
+        self.name = pod["metadata"]["name"]
+        self.prepared: list[tuple[str, str]] = []  # (driver, claim uid)
+        self.done = False
+        self.failed_msg = ""
+
+
+def resolve_cdi_devices(cdi_root: str, device_ids: list[str]) -> dict:
+    """containerd's CDI step: qualified IDs -> merged containerEdits.
+
+    Returns {"env": [...], "deviceNodes": [...], "mounts": [...]}.
+    Raises KeyError when an ID resolves to no spec/device (containerd
+    fails container creation the same way).
+    """
+    specs = []
+    for path in sorted(glob.glob(os.path.join(cdi_root, "**", "*.json"),
+                                 recursive=True)):
+        try:
+            with open(path, encoding="utf-8") as f:
+                specs.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    merged = {"env": [], "deviceNodes": [], "mounts": []}
+
+    def apply(edits: dict):
+        merged["env"] += edits.get("env", [])
+        merged["deviceNodes"] += edits.get("deviceNodes", [])
+        merged["mounts"] += edits.get("mounts", [])
+
+    applied_spec_edits: set[int] = set()
+    for device_id in device_ids:
+        kind, _, name = device_id.partition("=")
+        for i, spec in enumerate(specs):
+            if spec.get("kind") != kind:
+                continue
+            for dev in spec.get("devices", []):
+                if dev.get("name") == name:
+                    apply(dev.get("containerEdits", {}))
+                    # Spec-level edits apply once per spec, however
+                    # many of its devices the container uses (CDI spec
+                    # semantics; containerd dedupes the same way).
+                    if i not in applied_spec_edits:
+                        applied_spec_edits.add(i)
+                        apply(spec.get("containerEdits", {}))
+                    break
+            else:
+                continue
+            break
+        else:
+            raise KeyError(f"unresolvable CDI device {device_id!r}")
+    return merged
+
+
+class FakeNode:
+    def __init__(self, node_name: str, registry_dir: str, cdi_root: str,
+                 kube, poll: float = 0.3):
+        self.node_name = node_name
+        self.cdi_root = cdi_root
+        self.kube = kube
+        self.kubelet = FakeKubelet(registry_dir)
+        self._kubelet_lock = threading.Lock()
+        self.poll = poll
+        self._records: dict[str, _PodRecord] = {}  # pod uid -> record
+        self._running: set[str] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- claim resolution -----------------------------------------------------
+
+    def _pod_claims(self, pod) -> list[dict] | None:
+        """Resolved, allocated ResourceClaim objects, or None if any is
+        missing/unallocated (retry next pass)."""
+        ns = pod["metadata"].get("namespace", "default")
+        statuses = {
+            s["name"]: s.get("resourceClaimName")
+            for s in pod.get("status", {}).get("resourceClaimStatuses") or []
+        }
+        out = []
+        for ref in pod.get("spec", {}).get("resourceClaims") or []:
+            claim_name = ref.get("resourceClaimName") or statuses.get(
+                ref["name"])
+            if not claim_name:
+                return None
+            try:
+                claim = self.kube.get("resource.k8s.io", "v1",
+                                      "resourceclaims", claim_name,
+                                      namespace=ns)
+            except NotFoundError:
+                return None
+            if not claim.get("status", {}).get("allocation"):
+                return None
+            out.append(claim)
+        return out
+
+    # -- pod lifecycle --------------------------------------------------------
+
+    def _set_status(self, rec: _PodRecord, phase: str,
+                    log: str | None = None):
+        patch: dict = {"status": {"phase": phase}}
+        if log is not None:
+            patch["metadata"] = {"annotations": {"fake/log": log}}
+        try:
+            self.kube.patch("", "v1", "pods", rec.name, patch,
+                            namespace=rec.namespace)
+        except (NotFoundError, KubeError):
+            pass  # pod gone mid-run: deletion path unprepares
+
+    def _run_pod(self, pod, claims):
+        rec = self._records[pod["metadata"]["uid"]]
+        try:
+            cdi_ids = []
+            # Prepare per driver, like the kubelet's DRA manager
+            # fanning out one NodePrepareResources per plugin.
+            by_driver: dict[str, list[dict]] = {}
+            for claim in claims:
+                results = claim["status"]["allocation"].get(
+                    "devices", {}).get("results", [])
+                for drv in {res["driver"] for res in results}:
+                    by_driver.setdefault(drv, []).append(claim)
+            for driver, driver_claims in by_driver.items():
+                self._wait_plugin(driver, timeout=30)
+                resp = self.kubelet.prepare(driver, [{
+                    "uid": c["metadata"]["uid"],
+                    "namespace": c["metadata"].get("namespace", "default"),
+                    "name": c["metadata"]["name"],
+                } for c in driver_claims])
+                for c in driver_claims:
+                    uid = c["metadata"]["uid"]
+                    result = resp.claims[uid]
+                    if result.error:
+                        raise RuntimeError(
+                            f"prepare {driver} claim {uid}: {result.error}")
+                    rec.prepared.append((driver, uid))
+                    for dev in result.devices:
+                        cdi_ids.extend(dev.cdi_device_ids)
+
+            edits = resolve_cdi_devices(self.cdi_root, cdi_ids)
+            env = dict(os.environ)
+            for entry in edits["env"]:
+                k, _, v = entry.partition("=")
+                env[k] = v
+            env["FAKE_NODE_DEVICE_NODES"] = json.dumps(
+                edits["deviceNodes"])
+
+            container = pod["spec"]["containers"][0]
+            command = list(container.get("command") or ["true"])
+            if command and command[0] in ("python", "python3"):
+                command[0] = sys.executable
+            self._set_status(rec, "Running")
+            proc = subprocess.run(
+                command, env=env, capture_output=True, text=True,
+                timeout=120,
+            )
+            log = proc.stdout + proc.stderr
+            self._set_status(
+                rec, "Succeeded" if proc.returncode == 0 else "Failed",
+                log=log)
+        except Exception as e:  # noqa: BLE001 - node-agent boundary
+            rec.failed_msg = str(e)
+            self._set_status(rec, "Failed", log=f"fake-node error: {e}")
+        finally:
+            rec.done = True
+
+    def _unprepare(self, rec: _PodRecord):
+        by_driver: dict[str, list[str]] = {}
+        for driver, uid in rec.prepared:
+            by_driver.setdefault(driver, []).append(uid)
+        for driver, uids in by_driver.items():
+            try:
+                self.kubelet.unprepare(driver, sorted(set(uids)))
+            except Exception:  # noqa: BLE001 - plugin may be gone
+                pass
+        rec.prepared.clear()
+
+    def _wait_plugin(self, driver: str, timeout: float = 30.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._kubelet_lock:
+                self.kubelet.scan_once()
+                if driver in self.kubelet.plugins:
+                    return self.kubelet.plugins[driver]
+            time.sleep(0.2)
+        raise TimeoutError(f"plugin {driver!r} never registered")
+
+    # -- loop -----------------------------------------------------------------
+
+    def sync_once(self):
+        with self._kubelet_lock:
+            self.kubelet.scan_once()
+        try:
+            pods = self.kube.list("", "v1", "pods")
+        except KubeError:
+            return
+        seen = set()
+        for pod in pods:
+            uid = pod["metadata"].get("uid", "")
+            seen.add(uid)
+            if pod.get("spec", {}).get("nodeName") != self.node_name:
+                continue
+            if uid in self._records:
+                continue
+            claims = self._pod_claims(pod)
+            if claims is None:
+                continue
+            rec = _PodRecord(pod)
+            self._records[uid] = rec
+            t = threading.Thread(target=self._run_pod, name=f"pod-{uid}",
+                                 args=(pod, claims), daemon=True)
+            t.start()
+        # Deleted pods: unprepare their claims (kubelet claim GC).
+        for uid in [u for u in self._records if u not in seen]:
+            rec = self._records[uid]
+            if rec.done:
+                self._unprepare(rec)
+                del self._records[uid]
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                self.sync_once()
+            except Exception:  # noqa: BLE001 - keep the node alive
+                import traceback
+
+                traceback.print_exc()
+            self._stop.wait(self.poll)
+
+    def start(self) -> "FakeNode":
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"fake-node-{self.node_name}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)

@@ -1,0 +1,271 @@
+"""DraScheduler unit tier: allocation against REAL published slices
+(the driver's own publication path) and the REAL chart DeviceClasses,
+claim generation from templates, binding, counters, and taints."""
+
+import os
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import Config
+from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+from k8s_dra_driver_gpu_tpu.pkg.chartrender import manifests, render_chart
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
+RES = ("resource.k8s.io", "v1")
+
+
+def apply_device_classes(kube):
+    for doc in manifests(render_chart(CHART)):
+        if doc.get("kind") == "DeviceClass":
+            kube.create(*RES, "deviceclasses", doc)
+
+
+@pytest.fixture()
+def kube():
+    k = FakeKubeClient()
+    apply_device_classes(k)
+    return k
+
+
+@pytest.fixture()
+def driver(tmp_path, kube):
+    d = Driver(Config.mock(root=str(tmp_path), topology="v5e-4"), kube,
+               node_name="node-a", enable_health_monitor=False,
+               publication_mode="combined")
+    d.publish_resources()
+    return d
+
+
+@pytest.fixture()
+def sched(kube):
+    return DraScheduler(kube)
+
+
+def make_claim(kube, name, *, device_class="tpu.dra.dev", cel=None,
+               count=1, mode=None, tolerations=None, ns="default"):
+    exactly = {"deviceClassName": device_class}
+    if count != 1:
+        exactly["count"] = count
+    if mode:
+        exactly["allocationMode"] = mode
+    if cel:
+        exactly["selectors"] = [{"cel": {"expression": cel}}]
+    if tolerations:
+        exactly["tolerations"] = tolerations
+    return kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "exactly": exactly}]}},
+    }, namespace=ns)
+
+
+def allocation(kube, name, ns="default"):
+    return kube.get(*RES, "resourceclaims", name, ns).get(
+        "status", {}).get("allocation")
+
+
+class TestAllocation:
+    def test_allocates_chip_and_pins_node(self, driver, kube, sched):
+        make_claim(kube, "c1")
+        sched.sync_once()
+        alloc = allocation(kube, "c1")
+        assert alloc, "claim not allocated"
+        res = alloc["devices"]["results"]
+        assert len(res) == 1
+        assert res[0]["driver"] == "tpu.dra.dev"
+        assert res[0]["device"].startswith("chip-")
+        node = alloc["nodeSelector"]["nodeSelectorTerms"][0][
+            "matchFields"][0]["values"]
+        assert node == ["node-a"]
+
+    def test_device_exclusivity_across_claims(self, driver, kube, sched):
+        for i in range(4):
+            make_claim(kube, f"c{i}")
+        make_claim(kube, "c-overflow")
+        sched.sync_once()
+        devices = []
+        for i in range(4):
+            alloc = allocation(kube, f"c{i}")
+            assert alloc
+            devices.append(alloc["devices"]["results"][0]["device"])
+        assert len(set(devices)) == 4, "same chip allocated twice"
+        # A v5e-4 node has 4 chips; the fifth chip claim must wait.
+        assert allocation(kube, "c-overflow") is None
+
+    def test_request_cel_selector(self, driver, kube, sched):
+        slices = kube.list(*RES, "resourceslices")
+        chip = next(d for s in slices for d in s["spec"]["devices"]
+                    if d["name"] == "chip-0")
+        platform = chip["attributes"]["platform"]["string"]
+        make_claim(kube, "match", cel=(
+            f'device.attributes["tpu.dra.dev"].platform == "{platform}"'))
+        make_claim(kube, "nomatch", cel=(
+            'device.attributes["tpu.dra.dev"].platform == "v99x"'))
+        sched.sync_once()
+        assert allocation(kube, "match")
+        assert allocation(kube, "nomatch") is None
+
+    def test_counters_block_partition_overlap(self, driver, kube, sched):
+        """KEP-4815: whole chips consume every core counter, so once all
+        chips are allocated no sub-slice carve-out can fit."""
+        slices = kube.list(*RES, "resourceslices")
+        partitions = [d["name"] for s in slices
+                      for d in s["spec"]["devices"]
+                      if "profile" in d.get("attributes", {})]
+        assert partitions, "mock topology publishes no carve-outs"
+        make_claim(kube, "all-chips", count=4)
+        make_claim(kube, "carve", device_class="subslice.tpu.dra.dev")
+        sched.sync_once()
+        assert allocation(kube, "all-chips")
+        assert allocation(kube, "carve") is None, \
+            "sub-slice allocated over fully-committed chips"
+        # Free the chips: the carve-out now fits.
+        kube.delete(*RES, "resourceclaims", "all-chips", "default")
+        sched.sync_once()
+        assert allocation(kube, "carve")
+
+    def test_partition_blocks_parent_chip(self, driver, kube, sched):
+        """The reverse direction: a carve-out on chip N makes the whole
+        chip N unallocatable (shared counters both ways)."""
+        make_claim(kube, "carve", device_class="subslice.tpu.dra.dev")
+        sched.sync_once()
+        carve = allocation(kube, "carve")
+        assert carve
+        make_claim(kube, "chips", count=4)
+        sched.sync_once()
+        assert allocation(kube, "chips") is None, \
+            "4 whole chips allocated despite a live carve-out"
+
+    def test_all_mode_takes_every_match(self, driver, kube, sched):
+        make_claim(kube, "everything", mode="All")
+        sched.sync_once()
+        alloc = allocation(kube, "everything")
+        assert alloc
+        assert len(alloc["devices"]["results"]) == 4  # all v5e-4 chips
+
+    def test_taint_noschedule_skips_device(self, tmp_path, kube, sched):
+        d = Driver(Config.mock(root=str(tmp_path), topology="v5e-4"),
+                   kube, node_name="node-a", enable_health_monitor=False,
+                   publication_mode="combined")
+        d._taints["chip-0"] = [{
+            "key": "tpu.dra.dev/chip-lost", "effect": "NoSchedule",
+            "value": "true",
+        }]
+        d.publish_resources()
+        make_claim(kube, "wants-chip0", cel=(
+            'device.attributes["tpu.dra.dev"].uuid != ""'), count=4)
+        sched.sync_once()
+        assert allocation(kube, "wants-chip0") is None  # only 3 usable
+        make_claim(kube, "tolerant", count=4, tolerations=[{
+            "key": "tpu.dra.dev/chip-lost", "operator": "Exists",
+            "effect": "NoSchedule"}])
+        sched.sync_once()
+        assert allocation(kube, "tolerant")
+
+    def test_class_config_propagates(self, driver, kube, sched):
+        kube.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "tuned.tpu.dra.dev"},
+            "spec": {
+                "selectors": [{"cel": {"expression":
+                    'device.driver == "tpu.dra.dev"'}}],
+                "config": [{"opaque": {
+                    "driver": "tpu.dra.dev",
+                    "parameters": {"kind": "TpuConfig",
+                                   "sharing": {"strategy": "TimeSlicing"}},
+                }}],
+            },
+        })
+        make_claim(kube, "tuned", device_class="tuned.tpu.dra.dev")
+        sched.sync_once()
+        alloc = allocation(kube, "tuned")
+        assert alloc
+        cfg = alloc["devices"]["config"]
+        assert cfg and cfg[0]["source"] == "FromClass"
+        assert cfg[0]["opaque"]["parameters"]["kind"] == "TpuConfig"
+
+    def test_stale_pool_generation_invisible(self, driver, kube, sched):
+        # Re-publish bumps the generation; hand-craft a stale slice with
+        # a phantom device at the old generation.
+        driver.publish_resources()
+        kube.create(*RES, "resourceslices", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": "stale-slice"},
+            "spec": {
+                "driver": "tpu.dra.dev", "nodeName": "node-a",
+                "pool": {"name": "node-a", "generation": 1,
+                         "resourceSliceCount": 1},
+                "devices": [{"name": "phantom-chip", "attributes": {
+                    "platform": {"string": "v5e"}}}],
+            },
+        })
+        make_claim(kube, "phantom", cel=(
+            'device.attributes["tpu.dra.dev"].platform == "v5e"'),
+            count=5)
+        sched.sync_once()
+        # Only 4 real chips exist; the phantom at gen 1 must not count.
+        assert allocation(kube, "phantom") is None
+
+
+class TestClaimGenerationAndBinding:
+    def make_pod(self, kube, name, claim_entry, ns="default"):
+        return kube.create("", "v1", "pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "containers": [{"name": "c", "command": ["true"]}],
+                "resourceClaims": [{"name": "tpu", **claim_entry}],
+            },
+        }, namespace=ns)
+
+    def test_template_to_claim_to_binding(self, driver, kube, sched):
+        kube.create(*RES, "resourceclaimtemplates", {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": "tpl", "namespace": "default"},
+            "spec": {"spec": {"devices": {"requests": [
+                {"name": "tpu",
+                 "exactly": {"deviceClassName": "tpu.dra.dev"}}]}}},
+        }, namespace="default")
+        self.make_pod(kube, "worker",
+                      {"resourceClaimTemplateName": "tpl"})
+        sched.sync_once()  # generate claim
+        sched.sync_once()  # allocate + bind
+        pod = kube.get("", "v1", "pods", "worker", "default")
+        statuses = pod["status"]["resourceClaimStatuses"]
+        assert statuses[0]["name"] == "tpu"
+        generated = statuses[0]["resourceClaimName"]
+        claim = kube.get(*RES, "resourceclaims", generated, "default")
+        assert claim["status"]["allocation"]
+        assert claim["metadata"]["ownerReferences"][0]["name"] == "worker"
+        assert pod["spec"]["nodeName"] == "node-a"
+        reserved = claim["status"]["reservedFor"]
+        assert reserved[0]["name"] == "worker"
+
+    def test_shared_claim_two_pods_one_allocation(self, driver, kube,
+                                                  sched):
+        make_claim(kube, "shared")
+        for name in ("a", "b"):
+            self.make_pod(kube, name, {"resourceClaimName": "shared"})
+        sched.sync_once()
+        sched.sync_once()
+        claim = kube.get(*RES, "resourceclaims", "shared", "default")
+        assert len(claim["status"]["allocation"]["devices"]["results"]) == 1
+        names = {r["name"] for r in claim["status"]["reservedFor"]}
+        assert names == {"a", "b"}
+        for name in ("a", "b"):
+            pod = kube.get("", "v1", "pods", name, "default")
+            assert pod["spec"]["nodeName"] == "node-a"
+
+    def test_unsatisfied_pod_stays_unbound(self, driver, kube, sched):
+        make_claim(kube, "never", cel=(
+            'device.attributes["tpu.dra.dev"].platform == "v99x"'))
+        self.make_pod(kube, "stuck", {"resourceClaimName": "never"})
+        for _ in range(3):
+            sched.sync_once()
+        pod = kube.get("", "v1", "pods", "stuck", "default")
+        assert not pod["spec"].get("nodeName")
