@@ -57,6 +57,11 @@ class DaemonConfig:
         self.state_dir = env.get("DOMAIN_STATE_DIR", "/var/run/tpu-domain")
         self.hosts_file = env.get("HOSTS_FILE", "/etc/hosts")
         self.port = int(env.get("COORDINATION_PORT", str(DOMAIN_DAEMON_PORT)))
+        # Bind/probe address for the coordination service. Default: bind
+        # all interfaces, probe loopback (one daemon per host). Set to
+        # the pod IP when several daemons share one network namespace
+        # (the fake-cluster gang e2e runs every "node" on one machine).
+        self.coordination_host = env.get("COORDINATION_HOST", "")
         self.driver_namespace = env.get("DRIVER_NAMESPACE", "tpu-dra-driver")
         self.standalone = env.get("CD_DAEMON_STANDALONE", "") == "1"
         # Both mode switches ride the k8s-style FEATURE_GATES mechanism
@@ -119,6 +124,7 @@ class Daemon:
             "k8s_dra_driver_gpu_tpu.computedomain.daemon.rendezvous",
             "--members-file", self.members_file,
             "--port", str(config.port),
+            "--host", config.coordination_host or "0.0.0.0",
         ], env=child_env)
         self._stop = threading.Event()
         self._kick = threading.Event()
@@ -283,7 +289,8 @@ def check(config: DaemonConfig) -> int:
     """Probe: the coordination service must answer READY
     (reference `compute-domain-daemon check`, main.go:435-459)."""
     try:
-        answer = query("127.0.0.1", config.port, "STATUS")
+        answer = query(config.coordination_host or "127.0.0.1",
+                       config.port, "STATUS")
     except OSError as e:
         print(f"NOT_READY ({e})")
         return 1

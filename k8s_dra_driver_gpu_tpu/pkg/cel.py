@@ -284,15 +284,22 @@ class SemVer:
 
 def _unwrap_attr(value):
     """DRA typed-union attribute value -> CEL scalar; intermediate maps
-    (attributes, capacity, per-driver maps) pass through unchanged."""
-    if isinstance(value, dict) and value and set(value) <= _UNION_KEYS:
-        if "version" in value:
-            return SemVer(value["version"])
-        for key in ("string", "int", "bool"):
-            if key in value:
-                v = value[key]
-                return int(v) if key == "int" else v
-        return Quantity.parse(value["value"])
+    (attributes, capacity, per-driver maps) pass through unchanged.
+
+    A union is exactly one key from the wire schema with a SCALAR
+    payload -- both conditions matter, or a per-driver map containing a
+    single attribute literally named "version"/"string"/... would be
+    misread as a union and collapse the whole map."""
+    if isinstance(value, dict) and len(value) == 1:
+        key, v = next(iter(value.items()))
+        if key in _UNION_KEYS and isinstance(v, (str, int, float, bool)):
+            if key == "version":
+                return SemVer(str(v))
+            if key == "int":
+                return int(v)
+            if key == "value":
+                return Quantity.parse(str(v))
+            return v
     return value
 
 

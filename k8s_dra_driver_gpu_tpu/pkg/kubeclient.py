@@ -69,6 +69,11 @@ class KubeClient:
         insecure: bool = False,
     ):
         if host is None:
+            # KUBE_API: explicit full URL (binaries' --kube-api flag
+            # mirror; lets processes launched as "pods" by the fake
+            # node reach the fake apiserver over plain HTTP).
+            host = os.environ.get("KUBE_API")
+        if host is None:
             h = os.environ.get("KUBERNETES_SERVICE_HOST")
             p = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
             if not h:

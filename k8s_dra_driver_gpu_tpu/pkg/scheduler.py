@@ -50,6 +50,12 @@ def _meta(obj):
     return obj.get("metadata", {})
 
 
+def json_copy(obj):
+    import json
+
+    return json.loads(json.dumps(obj))
+
+
 class _CompiledSelectors:
     """Expression -> CelProgram cache; a selector that fails to compile
     permanently matches nothing (and is logged once), like a CEL
@@ -251,7 +257,7 @@ class DraScheduler:
             if cand is not None:
                 ledger.debit(cand.driver, cand.pool,
                              cand.device.get("consumesCounters"))
-        return candidates, ledger, allocated
+        return candidates, ledger, allocated, by_key
 
     def _device_matches(self, cand: _Candidate, selectors: list[dict],
                         tolerations: list[dict]) -> bool:
@@ -274,17 +280,31 @@ class DraScheduler:
         }
 
     def _try_allocate(self, claim, candidates, ledger, allocated,
-                      classes) -> dict | None:
+                      classes, by_key, pinned_node: str | None = None
+                      ) -> dict | None:
         """One claim against the snapshot. Returns the allocation or
-        None; mutates ledger/allocated on success."""
+        None; mutates ledger/allocated on success. ``pinned_node``
+        restricts placement to the node a consumer pod is already bound
+        to (real DRA allocates during that pod's scheduling, so the
+        choice is inherently per-node)."""
         requests = claim.get("spec", {}).get("devices", {}).get(
             "requests", [])
         if not requests:
             return None
         # Node-local pools pin the whole claim to one node: try each
         # candidate node until every request fits (kube-scheduler does
-        # this per-node in Filter).
-        nodes = sorted({c.node for c in candidates})
+        # this per-node in Filter). Least-allocated node first -- the
+        # spreading a real scheduler gets from per-pod Filter/Score;
+        # without it a multi-node gang would pile onto one node.
+        load: dict[str, int] = {}
+        for key in allocated:
+            cand = by_key.get(key)
+            if cand is not None:
+                load[cand.node] = load.get(cand.node, 0) + 1
+        nodes = sorted({c.node for c in candidates},
+                       key=lambda n: (load.get(n, 0), n))
+        if pinned_node is not None:
+            nodes = [n for n in nodes if n == pinned_node]
         for node in nodes:
             picks = self._fit_on_node(
                 claim, node, candidates, ledger, allocated, classes)
@@ -379,16 +399,41 @@ class DraScheduler:
                 return None  # All-mode with nothing to allocate
         return tentative
 
+    def _claim_pins(self) -> dict[tuple[str, str], str]:
+        """(namespace, claim name) -> node, for claims whose consumer
+        pod is already bound (DaemonSet pods are born bound)."""
+        pins: dict[tuple[str, str], str] = {}
+        for pod in self._pods():
+            node = pod.get("spec", {}).get("nodeName")
+            if not node:
+                continue
+            ns = _meta(pod).get("namespace", "default")
+            statuses = {
+                s["name"]: s.get("resourceClaimName")
+                for s in pod.get("status", {}).get(
+                    "resourceClaimStatuses") or []
+            }
+            for ref in pod.get("spec", {}).get("resourceClaims") or []:
+                claim_name = ref.get("resourceClaimName") or statuses.get(
+                    ref["name"])
+                if claim_name:
+                    pins[(ns, claim_name)] = node
+        return pins
+
     def _allocate_claims(self):
-        candidates, ledger, allocated = self._snapshot()
+        candidates, ledger, allocated, by_key = self._snapshot()
         classes = self._device_classes()
+        pins = self._claim_pins()
         for claim in self.kube.list(*RESOURCE, "resourceclaims"):
             if claim.get("status", {}).get("allocation"):
                 continue
             if _meta(claim).get("deletionTimestamp"):
                 continue
+            pin = pins.get((_meta(claim).get("namespace", "default"),
+                            _meta(claim)["name"]))
             alloc = self._try_allocate(
-                claim, candidates, ledger, allocated, classes)
+                claim, candidates, ledger, allocated, classes, by_key,
+                pinned_node=pin)
             if alloc is None:
                 continue
             ns = _meta(claim).get("namespace", "default")
@@ -488,9 +533,89 @@ class DraScheduler:
             logger.info("bound pod %s/%s -> %s", ns,
                         _meta(pod)["name"], node)
 
+    # -- DaemonSet controller (kcm daemonset controller) ----------------------
+
+    def _sync_daemonsets(self):
+        """One pod per matching node per DaemonSet (the CD controller's
+        per-domain DaemonSet needs this to materialize daemon pods on
+        labeled nodes). Pod name is deterministic per (ds, node) so the
+        pass is idempotent; pods on no-longer-matching nodes drain."""
+        try:
+            daemonsets = self.kube.list("apps", "v1", "daemonsets")
+        except KubeError:
+            return
+        try:
+            nodes = self.kube.list("", "v1", "nodes")
+        except KubeError:
+            nodes = []
+        pods = self._pods()
+        # GC pods whose owning DaemonSet is gone (kcm orphan deletion).
+        live = {(_meta(d).get("namespace", "default"), _meta(d)["name"])
+                for d in daemonsets}
+        for pod in pods:
+            ns = _meta(pod).get("namespace", "default")
+            for o in _meta(pod).get("ownerReferences") or []:
+                if o.get("kind") == "DaemonSet" and \
+                        (ns, o.get("name")) not in live:
+                    try:
+                        self.kube.delete("", "v1", "pods",
+                                         _meta(pod)["name"], namespace=ns)
+                    except NotFoundError:
+                        pass
+        for ds in daemonsets:
+            ns = _meta(ds).get("namespace", "default")
+            ds_name = _meta(ds)["name"]
+            tmpl = ds.get("spec", {}).get("template", {})
+            selector = tmpl.get("spec", {}).get("nodeSelector") or {}
+            want = {
+                _meta(n)["name"] for n in nodes
+                if all((_meta(n).get("labels") or {}).get(k) == v
+                       for k, v in selector.items())
+            }
+            existing: dict[str, dict] = {}
+            for pod in pods:
+                if _meta(pod).get("namespace", "default") != ns:
+                    continue
+                if any(o.get("kind") == "DaemonSet"
+                       and o.get("name") == ds_name
+                       for o in _meta(pod).get("ownerReferences") or []):
+                    existing[pod.get("spec", {}).get("nodeName", "")] = pod
+            for node in sorted(want - set(existing)):
+                pod = {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {
+                        "name": f"{ds_name}-{node}",
+                        "namespace": ns,
+                        "labels": dict(tmpl.get("metadata", {}).get(
+                            "labels") or {}),
+                        "ownerReferences": [{
+                            "apiVersion": "apps/v1", "kind": "DaemonSet",
+                            "name": ds_name,
+                            "uid": _meta(ds).get("uid", ""),
+                            "controller": True,
+                        }],
+                    },
+                    "spec": {**json_copy(tmpl.get("spec", {})),
+                             "nodeName": node},
+                }
+                try:
+                    self.kube.create("", "v1", "pods", pod, namespace=ns)
+                    logger.info("daemonset %s/%s -> pod on %s", ns,
+                                ds_name, node)
+                except ConflictError:
+                    pass
+            for node in sorted(set(existing) - want):
+                pod = existing[node]
+                try:
+                    self.kube.delete("", "v1", "pods",
+                                     _meta(pod)["name"], namespace=ns)
+                except NotFoundError:
+                    pass
+
     # -- loop -----------------------------------------------------------------
 
     def sync_once(self):
+        self._sync_daemonsets()
         self._generate_claims()
         self._allocate_claims()
         self._bind_pods()

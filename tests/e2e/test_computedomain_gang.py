@@ -1,0 +1,348 @@
+"""CD gang e2e: the full ComputeDomain choreography on the fake
+cluster with TWO nodes and REAL binaries end to end.
+
+Reference analog: tests/bats/test_cd_imex_chan_injection.bats +
+test_cd_failover.bats run on a kind cluster -- a ComputeDomain CR, the
+controller's DaemonSet/RCT fan-out, per-node daemons registering into
+clique CRs, workload channel claims blocking until the domain is
+Ready, and the injected env contract inside the workload container.
+
+Processes in this test: fake apiserver (HTTP), CD controller binary,
+2x CD kubelet-plugin binaries (one per fake node, real gRPC sockets),
+2x daemon pods (run by the fake nodes as real subprocesses, spawning
+their coordination-service children), 2x workload pods. The scheduler
+(in-process control plane) materializes DaemonSet pods, generates
+claims from templates, allocates channel devices across BOTH nodes,
+and binds the gang.
+
+Fake-cluster mode only: in real-cluster mode the chip e2e suite plus
+the bats-analog system tier cover the CD flow.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.e2e.conftest import MODE, REPO
+from tests.e2e.framework import wait_for
+
+DRIVER_NS = "tpu-dra-driver"
+CD_DRIVER = "compute-domain.tpu.dra.dev"
+
+pytestmark = pytest.mark.skipif(
+    MODE != "fake",
+    reason="gang e2e drives the fake cluster; real clusters are "
+           "covered by the chip e2e suite + system tier",
+)
+
+
+class GangCluster:
+    """2 fake nodes, 2 CD plugins, controller, scheduler, apiserver."""
+
+    NODES = ("node-gang-0", "node-gang-1")
+
+    def __init__(self):
+        self.procs = []
+        self.logs = []
+        self.nodes = []
+        self.scheduler = None
+        self.apiserver = None
+        try:
+            self._start()
+        except BaseException:
+            self.stop()
+            raise
+
+    def _spawn(self, name, argv, env=None):
+        import tempfile
+
+        log = open(os.path.join(self.workdir, f"{name}.log"), "w",
+                   encoding="utf-8")
+        proc = subprocess.Popen(
+            argv, env={**os.environ, "PYTHONPATH": REPO, **(env or {})},
+            stdout=log, stderr=subprocess.STDOUT)
+        self.procs.append(proc)
+        self.logs.append(log)
+        return proc
+
+    def _start(self):
+        import tempfile
+
+        from k8s_dra_driver_gpu_tpu.pkg.chartrender import (
+            manifests,
+            render_chart,
+        )
+        from k8s_dra_driver_gpu_tpu.pkg.fakeapiserver import FakeApiServer
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
+        from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+        from tests.fake_node import FakeNode
+
+        # Short workdir: AF_UNIX sun_path limits (sockets live here).
+        self.workdir = tempfile.mkdtemp(prefix="gang-", dir="/tmp")
+        self.apiserver = FakeApiServer().start()
+        self.kube = KubeClient(host=self.apiserver.url)
+        chart = os.path.join(REPO, "deployments", "helm",
+                             "tpu-dra-driver")
+        for doc in manifests(render_chart(chart)):
+            if doc.get("kind") == "DeviceClass":
+                self.kube.create("resource.k8s.io", "v1",
+                                 "deviceclasses", doc)
+
+        self._spawn("controller", [
+            sys.executable, "-m",
+            "k8s_dra_driver_gpu_tpu.computedomain.controller.main",
+            "--kube-api", self.apiserver.url,
+            "--namespace", DRIVER_NS,
+        ])
+
+        for i, node in enumerate(self.NODES):
+            ndir = os.path.join(self.workdir, f"n{i}")
+            os.makedirs(ndir)
+            pod_ip = f"127.0.1.{i + 1}"
+            self._spawn(f"cd-plugin-{i}", [
+                sys.executable, "-m",
+                "k8s_dra_driver_gpu_tpu.computedomain.plugin.main",
+                "--kube-api", self.apiserver.url,
+                "--node-name", node,
+                "--state-root", os.path.join(ndir, "state"),
+                "--cdi-root", os.path.join(ndir, "cdi"),
+                "--plugin-dir", os.path.join(ndir, "plugin"),
+                "--registry-dir", os.path.join(ndir, "reg"),
+            ])
+            fn = FakeNode(
+                node, os.path.join(ndir, "reg"),
+                os.path.join(ndir, "cdi"), self.kube,
+                pod_ip=pod_ip,
+                extra_env={
+                    "KUBE_API": self.apiserver.url,
+                    "PYTHONPATH": REPO,
+                    # Every "node" shares this machine: daemons bind
+                    # their pod IP (distinct loopback aliases) and keep
+                    # their hosts rewrites out of /etc/hosts.
+                    "COORDINATION_HOST": pod_ip,
+                    "HOSTS_FILE": os.path.join(ndir, "hosts"),
+                })
+            self.nodes.append(fn)
+            fn.start()
+
+        self.scheduler = DraScheduler(self.kube).start()
+
+    def stop(self):
+        for fn in self.nodes:
+            fn.stop()
+        if self.scheduler:
+            self.scheduler.stop()
+        for proc in self.procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in self.procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        for log in self.logs:
+            log.close()
+        if self.apiserver:
+            self.apiserver.stop()
+
+    def dump_logs(self, tail=4000) -> str:
+        out = []
+        for log in self.logs:
+            try:
+                text = open(log.name, encoding="utf-8").read()
+            except OSError:
+                continue
+            out.append(f"==== {os.path.basename(log.name)} ====\n"
+                       f"{text[-tail:]}")
+        return "\n".join(out)
+
+
+@pytest.fixture(scope="module")
+def gang():
+    cluster = GangCluster()
+    yield cluster
+    cluster.stop()
+
+
+def workload_pod(namespace, name, rct_name):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [{
+                "name": "worker", "image": "python:3.12-slim",
+                "command": [
+                    "python", "-c",
+                    "import os, json; print(json.dumps({k: v for k, v"
+                    " in os.environ.items() if k.startswith('TPU_') or"
+                    " k.startswith('COMPUTE_DOMAIN')}))",
+                ],
+                "resources": {"claims": [{"name": "channel"}]},
+            }],
+            "resourceClaims": [{
+                "name": "channel",
+                "resourceClaimTemplateName": rct_name,
+            }],
+        },
+    }
+
+
+class TestComputeDomainGang:
+    NS = "team-gang"
+    CD = "gang-domain"
+    RCT = "gang-channel-rct"
+
+    def test_two_node_gang_end_to_end(self, gang):
+        kube = gang.kube
+        kube.create("", "v1", "namespaces", {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": self.NS}})
+
+        # Both CD plugins published their channel + daemon slices.
+        def cd_slices():
+            pools = {s["spec"].get("pool", {}).get("name", "")
+                     for s in kube.list("resource.k8s.io", "v1",
+                                        "resourceslices")
+                     if s["spec"].get("driver") == CD_DRIVER}
+            return pools if len(pools) >= 2 else None
+        wait_for(cd_slices, timeout=90,
+                 desc=f"CD slices from both nodes\n{gang.dump_logs()}")
+
+        # The ComputeDomain: 2 nodes, one workload channel RCT.
+        kube.create("resource.tpu.dra", "v1beta1", "computedomains", {
+            "apiVersion": "resource.tpu.dra/v1beta1",
+            "kind": "ComputeDomain",
+            "metadata": {"name": self.CD, "namespace": self.NS,
+                         "uid": "gang-cd-uid"},
+            "spec": {
+                "numNodes": 2,
+                "channel": {
+                    "resourceClaimTemplate": {"name": self.RCT},
+                    "allocationMode": "Single",
+                },
+            },
+        }, namespace=self.NS)
+
+        # Controller fan-out: workload RCT in the user namespace.
+        wait_for(
+            lambda: any(
+                r["metadata"]["name"] == self.RCT
+                for r in kube.list("resource.k8s.io", "v1",
+                                   "resourceclaimtemplates",
+                                   namespace=self.NS)),
+            timeout=60, desc="workload RCT")
+
+        # The gang: two workload pods claiming one channel each.
+        for name in ("worker-0", "worker-1"):
+            kube.create("", "v1", "pods",
+                        workload_pod(self.NS, name, self.RCT),
+                        namespace=self.NS)
+
+        def phase(name):
+            try:
+                pod = kube.get("", "v1", "pods", name,
+                               namespace=self.NS)
+            except Exception:  # noqa: BLE001
+                return ""
+            return pod.get("status", {}).get("phase", "")
+
+        try:
+            wait_for(
+                lambda: (phase("worker-0") == "Succeeded"
+                         and phase("worker-1") == "Succeeded") or None,
+                timeout=240, desc="gang workers succeed")
+        except AssertionError:
+            print(gang.dump_logs())
+            for name in ("worker-0", "worker-1"):
+                try:
+                    print(name, kube.read_raw(
+                        f"/api/v1/namespaces/{self.NS}/pods/{name}/log"))
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+
+        # The domain went Ready with both nodes registered.
+        cd = kube.get("resource.tpu.dra", "v1beta1", "computedomains",
+                      self.CD, namespace=self.NS)
+        assert cd.get("status", {}).get("status") == "Ready"
+        nodes = cd.get("status", {}).get("nodes", [])
+        assert {n.get("name") for n in nodes} == set(
+            GangCluster.NODES)
+
+        # Workload pods landed on DIFFERENT nodes (the gang spread).
+        placed = {
+            kube.get("", "v1", "pods", n, namespace=self.NS)["spec"][
+                "nodeName"]
+            for n in ("worker-0", "worker-1")
+        }
+        assert placed == set(GangCluster.NODES), placed
+
+        # The injected env contract, inside both "containers".
+        envs = {}
+        for name in ("worker-0", "worker-1"):
+            log = kube.read_raw(
+                f"/api/v1/namespaces/{self.NS}/pods/{name}/log")
+            envs[name] = json.loads(log.strip())
+        for env in envs.values():
+            assert env["COMPUTE_DOMAIN_UUID"] == "gang-cd-uid"
+            assert env["TPU_NUM_PROCESSES"] == "2"
+            assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 2
+            host, _, port = env["TPU_COORDINATOR_ADDRESS"].partition(":")
+            assert host and port.isdigit()
+        # Distinct, positional process ids.
+        ids = {env["TPU_PROCESS_ID"] for env in envs.values()}
+        assert ids == {"0", "1"}, ids
+        # Both workers agree on the coordinator (index-0 daemon).
+        assert len({env["TPU_COORDINATOR_ADDRESS"]
+                    for env in envs.values()}) == 1
+
+        # Daemon pods exist on both nodes (DaemonSet materialized) and
+        # are Running.
+        daemon_pods = [
+            p for p in kube.list("", "v1", "pods", namespace=DRIVER_NS)
+            if any(o.get("kind") == "DaemonSet"
+                   for o in p["metadata"].get("ownerReferences") or [])
+        ]
+        assert {p["spec"]["nodeName"] for p in daemon_pods} == set(
+            GangCluster.NODES)
+        assert all(p.get("status", {}).get("phase") == "Running"
+                   for p in daemon_pods), [
+                       p.get("status") for p in daemon_pods]
+
+    def test_teardown_drains_gang(self, gang):
+        """Deleting workloads + CD cascades: claims free, daemon pods
+        drain, node labels drop (the reference teardown cascade)."""
+        from k8s_dra_driver_gpu_tpu.computedomain import NODE_LABEL
+
+        kube = gang.kube
+        kube.delete("", "v1", "namespaces", self.NS)
+        kube.delete("resource.tpu.dra", "v1beta1", "computedomains",
+                    self.CD, namespace=self.NS)
+
+        def drained():
+            daemon_pods = [
+                p for p in kube.list("", "v1", "pods",
+                                     namespace=DRIVER_NS)
+                if any(o.get("kind") == "DaemonSet"
+                       for o in p["metadata"].get(
+                           "ownerReferences") or [])
+            ]
+            labeled = [
+                n for n in kube.list("", "v1", "nodes")
+                if (n["metadata"].get("labels") or {}).get(NODE_LABEL)
+            ]
+            return (not daemon_pods and not labeled) or None
+
+        try:
+            wait_for(drained, timeout=180,
+                     desc="daemon pods + node labels drained")
+        except AssertionError:
+            print(gang.dump_logs())
+            raise

@@ -35,6 +35,7 @@ class _PodRecord:
         self.name = pod["metadata"]["name"]
         self.prepared: list[tuple[str, str]] = []  # (driver, claim uid)
         self.done = False
+        self.deleted = threading.Event()  # pod object gone: tear down
         self.failed_msg = ""
 
 
@@ -86,17 +87,36 @@ def resolve_cdi_devices(cdi_root: str, device_ids: list[str]) -> dict:
 
 class FakeNode:
     def __init__(self, node_name: str, registry_dir: str, cdi_root: str,
-                 kube, poll: float = 0.3):
+                 kube, poll: float = 0.3, pod_ip: str = "127.0.0.1",
+                 extra_env: dict[str, str] | None = None,
+                 labels: dict[str, str] | None = None):
         self.node_name = node_name
         self.cdi_root = cdi_root
         self.kube = kube
         self.kubelet = FakeKubelet(registry_dir)
         self._kubelet_lock = threading.Lock()
         self.poll = poll
+        self.pod_ip = pod_ip
+        # Per-node env for every container (the fake-cluster stand-in
+        # for per-node files/NICs: HOSTS_FILE, COORDINATION_HOST, ...).
+        self.extra_env = dict(extra_env or {})
         self._records: dict[str, _PodRecord] = {}  # pod uid -> record
-        self._running: set[str] = set()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._register_node(labels or {})
+
+    def _register_node(self, labels: dict[str, str]):
+        """Create this node's Node object (kubelet registration): the
+        CD plugin labels it, the DaemonSet pass selects over it."""
+        try:
+            self.kube.create("", "v1", "nodes", {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": self.node_name, "labels": labels},
+                "status": {"addresses": [
+                    {"type": "InternalIP", "address": self.pod_ip}]},
+            })
+        except KubeError:
+            pass  # already registered (restart)
 
     # -- claim resolution -----------------------------------------------------
 
@@ -138,56 +158,170 @@ class FakeNode:
         except (NotFoundError, KubeError):
             pass  # pod gone mid-run: deletion path unprepares
 
+    PREPARE_DEADLINE_S = 180.0  # kubelet retries failed prepares
+    RUN_DEADLINE_S = 300.0  # run-to-completion budget (Never policy)
+
+    def _prepare_claims(self, rec, claims) -> list[str]:
+        """NodePrepareResources per driver with kubelet-style retries
+        (a CD channel prepare legitimately fails until the domain is
+        Ready). Returns the merged CDI device IDs."""
+        import time
+
+        by_driver: dict[str, list[dict]] = {}
+        for claim in claims:
+            results = claim["status"]["allocation"].get(
+                "devices", {}).get("results", [])
+            for drv in {res["driver"] for res in results}:
+                by_driver.setdefault(drv, []).append(claim)
+        cdi_ids: list[str] = []
+        deadline = time.monotonic() + self.PREPARE_DEADLINE_S
+        for driver, driver_claims in by_driver.items():
+            self._wait_plugin(driver, timeout=60)
+            reqs = [{
+                "uid": c["metadata"]["uid"],
+                "namespace": c["metadata"].get("namespace", "default"),
+                "name": c["metadata"]["name"],
+            } for c in driver_claims]
+            while True:
+                resp = self.kubelet.prepare(driver, reqs)
+                errors = {u: r.error for u, r in resp.claims.items()
+                          if r.error}
+                if not errors:
+                    break
+                if time.monotonic() > deadline or rec.deleted.is_set():
+                    raise RuntimeError(
+                        f"prepare {driver}: {errors}")
+                time.sleep(2.0)
+            for c in driver_claims:
+                uid = c["metadata"]["uid"]
+                rec.prepared.append((driver, uid))
+                for dev in resp.claims[uid].devices:
+                    cdi_ids.extend(dev.cdi_device_ids)
+        return cdi_ids
+
+    def _container_env(self, pod, container, edits) -> dict[str, str]:
+        """Merged process env: CDI edits (containerd), declared env with
+        downward-API fieldRefs (kubelet), per-node extra_env, and
+        mount-path translation (host processes see the mount SOURCE)."""
+        env = dict(os.environ)
+        for entry in edits["env"]:
+            k, _, v = entry.partition("=")
+            env[k] = v
+        fields = {
+            "metadata.name": pod["metadata"]["name"],
+            "metadata.namespace": pod["metadata"].get("namespace",
+                                                      "default"),
+            "spec.nodeName": self.node_name,
+            "status.podIP": self.pod_ip,
+        }
+        for entry in container.get("env") or []:
+            if "value" in entry:
+                env[entry["name"]] = str(entry["value"])
+            elif "valueFrom" in entry:
+                path = entry["valueFrom"].get("fieldRef", {}).get(
+                    "fieldPath", "")
+                if path in fields:
+                    env[entry["name"]] = fields[path]
+        env.update(self.extra_env)
+        # Mount translation: without mount namespaces, an env value
+        # pointing at a container mount dest must point at the host
+        # source instead (same files the bind mount would expose).
+        for src, dst, *_ in [tuple(m) if not isinstance(m, dict)
+                             else (m.get("hostPath"),
+                                   m.get("containerPath"))
+                             for m in edits["mounts"]]:
+            if not src or not dst:
+                continue
+            for k, v in env.items():
+                if v == dst:
+                    env[k] = src
+                elif v.startswith(dst + "/"):
+                    env[k] = src + v[len(dst):]
+        env["FAKE_NODE_DEVICE_NODES"] = json.dumps(edits["deviceNodes"])
+        env["POD_IP"] = env.get("POD_IP", self.pod_ip)
+        return env
+
     def _run_pod(self, pod, claims):
+        import time
+
         rec = self._records[pod["metadata"]["uid"]]
         try:
-            cdi_ids = []
-            # Prepare per driver, like the kubelet's DRA manager
-            # fanning out one NodePrepareResources per plugin.
-            by_driver: dict[str, list[dict]] = {}
-            for claim in claims:
-                results = claim["status"]["allocation"].get(
-                    "devices", {}).get("results", [])
-                for drv in {res["driver"] for res in results}:
-                    by_driver.setdefault(drv, []).append(claim)
-            for driver, driver_claims in by_driver.items():
-                self._wait_plugin(driver, timeout=30)
-                resp = self.kubelet.prepare(driver, [{
-                    "uid": c["metadata"]["uid"],
-                    "namespace": c["metadata"].get("namespace", "default"),
-                    "name": c["metadata"]["name"],
-                } for c in driver_claims])
-                for c in driver_claims:
-                    uid = c["metadata"]["uid"]
-                    result = resp.claims[uid]
-                    if result.error:
-                        raise RuntimeError(
-                            f"prepare {driver} claim {uid}: {result.error}")
-                    rec.prepared.append((driver, uid))
-                    for dev in result.devices:
-                        cdi_ids.extend(dev.cdi_device_ids)
-
+            cdi_ids = self._prepare_claims(rec, claims)
             edits = resolve_cdi_devices(self.cdi_root, cdi_ids)
-            env = dict(os.environ)
-            for entry in edits["env"]:
-                k, _, v = entry.partition("=")
-                env[k] = v
-            env["FAKE_NODE_DEVICE_NODES"] = json.dumps(
-                edits["deviceNodes"])
-
             container = pod["spec"]["containers"][0]
+            env = self._container_env(pod, container, edits)
             command = list(container.get("command") or ["true"])
             if command and command[0] in ("python", "python3"):
                 command[0] = sys.executable
+            restart_always = pod["spec"].get(
+                "restartPolicy", "Always") == "Always"
             self._set_status(rec, "Running")
-            proc = subprocess.run(
-                command, env=env, capture_output=True, text=True,
-                timeout=120,
-            )
-            log = proc.stdout + proc.stderr
-            self._set_status(
-                rec, "Succeeded" if proc.returncode == 0 else "Failed",
-                log=log)
+            # Container output goes to a file, not a PIPE: nothing
+            # drains a pipe while the process runs, so a chatty
+            # long-running container would block on a full pipe buffer
+            # (the kubelet writes container logs to files too).
+            import tempfile
+
+            log_fd, log_path = tempfile.mkstemp(prefix="pod-log-")
+            os.close(log_fd)
+
+            def read_log() -> str:
+                try:
+                    with open(log_path, encoding="utf-8",
+                              errors="replace") as f:
+                        f.seek(0, os.SEEK_END)
+                        size = f.tell()
+                        f.seek(max(0, size - (1 << 16)))
+                        return f.read()
+                except OSError:
+                    return ""
+
+            try:
+                while True:
+                    with open(os.devnull) as devnull, \
+                            open(log_path, "a",
+                                 encoding="utf-8") as log_file:
+                        proc = subprocess.Popen(
+                            command, env=env, stdin=devnull,
+                            stdout=log_file, stderr=subprocess.STDOUT,
+                            text=True,
+                        )
+                    deadline = time.monotonic() + self.RUN_DEADLINE_S
+                    while proc.poll() is None:
+                        if rec.deleted.is_set():
+                            proc.terminate()
+                            try:
+                                proc.wait(timeout=10)
+                            except subprocess.TimeoutExpired:
+                                proc.kill()
+                                proc.wait()
+                            return
+                        if not restart_always and \
+                                time.monotonic() > deadline:
+                            proc.kill()
+                            proc.wait()
+                            self._set_status(
+                                rec, "Failed",
+                                log=read_log()
+                                + "\nfake-node: run deadline")
+                            return
+                        time.sleep(0.2)
+                    if restart_always and not rec.deleted.is_set():
+                        # Long-running pod died: kubelet restarts it.
+                        self._set_status(rec, "Running", log=read_log())
+                        time.sleep(1.0)
+                        continue
+                    self._set_status(
+                        rec,
+                        "Succeeded" if proc.returncode == 0
+                        else "Failed",
+                        log=read_log())
+                    return
+            finally:
+                try:
+                    os.unlink(log_path)
+                except OSError:
+                    pass
         except Exception as e:  # noqa: BLE001 - node-agent boundary
             rec.failed_msg = str(e)
             self._set_status(rec, "Failed", log=f"fake-node error: {e}")
@@ -242,9 +376,12 @@ class FakeNode:
             t = threading.Thread(target=self._run_pod, name=f"pod-{uid}",
                                  args=(pod, claims), daemon=True)
             t.start()
-        # Deleted pods: unprepare their claims (kubelet claim GC).
+        # Deleted pods: signal the pod thread (long-running containers
+        # get SIGTERM), then unprepare claims once it wound down
+        # (kubelet claim GC).
         for uid in [u for u in self._records if u not in seen]:
             rec = self._records[uid]
+            rec.deleted.set()
             if rec.done:
                 self._unprepare(rec)
                 del self._records[uid]
