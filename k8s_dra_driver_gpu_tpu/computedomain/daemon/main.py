@@ -225,7 +225,8 @@ class Daemon:
         # startup (before the handler is registered) would kill the
         # child. A freshly started child reads the members file itself.
         try:
-            query("127.0.0.1", self.cfg.port, "STATUS", timeout=1.0)
+            query(self.cfg.coordination_host or "127.0.0.1",
+                  self.cfg.port, "STATUS", timeout=1.0)
         except OSError:
             logger.info("coordination service not answering yet; no nudge")
         else:

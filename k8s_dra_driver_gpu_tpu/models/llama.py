@@ -38,6 +38,13 @@ class LlamaConfig:
     dtype: jnp.dtype = jnp.bfloat16
     # "auto": pallas flash attention on TPU, einsum elsewhere.
     attn_impl: str = "auto"
+    # Rematerialization of the layer body in the backward pass:
+    # "full" recomputes everything (long sequences / big models fit
+    # HBM at ~+2 forward-FLOPs per 6 counted), "dots" saves matmul
+    # outputs and recomputes the cheap elementwise rest, "none" saves
+    # all activations (small models: highest true MFU). Trade per
+    # jax.checkpoint docs; measured on v5e in docs/benchmarks.md.
+    remat: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -194,10 +201,17 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     if positions is None:
         positions = jnp.arange(tokens.shape[1])[None, :]
 
-    # Scan over stacked layers; remat the body so long sequences fit HBM.
-    body = jax.checkpoint(
-        lambda carry, lp: (_layer(cfg, carry, lp, positions, attn_fn), None)
-    )
+    # Scan over stacked layers; remat policy per cfg.remat (full: long
+    # sequences fit HBM; none: small models keep max true MFU).
+    body = lambda carry, lp: (  # noqa: E731
+        _layer(cfg, carry, lp, positions, attn_fn), None)
+    if cfg.remat == "full":
+        body = jax.checkpoint(body)
+    elif cfg.remat == "dots":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_saveable)
+    elif cfg.remat != "none":
+        raise ValueError(f"unknown remat policy {cfg.remat!r}")
     x, _ = jax.lax.scan(body, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

@@ -59,8 +59,6 @@ class GangCluster:
             raise
 
     def _spawn(self, name, argv, env=None):
-        import tempfile
-
         log = open(os.path.join(self.workdir, f"{name}.log"), "w",
                    encoding="utf-8")
         proc = subprocess.Popen(
@@ -150,6 +148,10 @@ class GangCluster:
             log.close()
         if self.apiserver:
             self.apiserver.stop()
+        if getattr(self, "workdir", None):
+            import shutil
+
+            shutil.rmtree(self.workdir, ignore_errors=True)
 
     def dump_logs(self, tail=4000) -> str:
         out = []
@@ -212,8 +214,12 @@ class TestComputeDomainGang:
                                         "resourceslices")
                      if s["spec"].get("driver") == CD_DRIVER}
             return pools if len(pools) >= 2 else None
-        wait_for(cd_slices, timeout=90,
-                 desc=f"CD slices from both nodes\n{gang.dump_logs()}")
+        try:
+            wait_for(cd_slices, timeout=180,
+                     desc="CD slices from both nodes")
+        except AssertionError:
+            print(gang.dump_logs())
+            raise
 
         # The ComputeDomain: 2 nodes, one workload channel RCT.
         kube.create("resource.tpu.dra", "v1beta1", "computedomains", {
@@ -257,7 +263,7 @@ class TestComputeDomainGang:
             wait_for(
                 lambda: (phase("worker-0") == "Succeeded"
                          and phase("worker-1") == "Succeeded") or None,
-                timeout=240, desc="gang workers succeed")
+                timeout=420, desc="gang workers succeed")
         except AssertionError:
             print(gang.dump_logs())
             for name in ("worker-0", "worker-1"):

@@ -158,7 +158,7 @@ class FakeNode:
         except (NotFoundError, KubeError):
             pass  # pod gone mid-run: deletion path unprepares
 
-    PREPARE_DEADLINE_S = 180.0  # kubelet retries failed prepares
+    PREPARE_DEADLINE_S = 300.0  # kubelet retries failed prepares
     RUN_DEADLINE_S = 300.0  # run-to-completion budget (Never policy)
 
     def _prepare_claims(self, rec, claims) -> list[str]:
