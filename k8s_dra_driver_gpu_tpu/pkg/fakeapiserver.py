@@ -164,12 +164,60 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if name is not None:
                 raise KubeError(405, "POST with name")
+            body = self._read_body()
+            self._admit(group, version, resource, namespace, body)
             obj = self.store.create(
-                group, version, resource, self._read_body(),
-                namespace=namespace)
+                group, version, resource, body, namespace=namespace)
             self._send_json(201, obj)
         except Exception as e:  # noqa: BLE001
             self._send_error(e)
+
+    def _admit(self, group, version, resource, namespace, body,
+               operation: str = "CREATE") -> None:
+        """Validating-admission leg: POST an AdmissionReview to the
+        configured webhook (the ValidatingWebhookConfiguration analog)
+        for the resources the chart's webhook registers. Fail policy
+        ``Fail``: an unreachable webhook rejects the write, like the
+        chart's fail-closed configuration."""
+        admission = getattr(self.server, "admission", None)
+        if not admission or resource not in (
+                "resourceclaims", "resourceclaimtemplates"):
+            return
+        import urllib.request
+        import uuid
+
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": uuid.uuid4().hex,
+                "operation": operation,
+                "resource": {"group": group, "version": version,
+                             "resource": resource},
+                "namespace": namespace or "default",
+                "object": body,
+            },
+        }
+        url, ssl_ctx = admission
+        req = urllib.request.Request(
+            url, data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=10,
+                                        context=ssl_ctx) as resp:
+                out = json.loads(resp.read())
+        except OSError as e:
+            raise KubeError(
+                500, f"admission webhook unreachable (failurePolicy="
+                     f"Fail): {e}") from e
+        response = out.get("response") or {}
+        if not response.get("allowed", False):
+            status = response.get("status") or {}
+            raise KubeError(
+                status.get("code", 400),
+                "admission webhook denied the request: "
+                + status.get("message", "denied"))
 
     def do_PUT(self):  # noqa: N802
         route = self._route()
@@ -179,8 +227,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if name is None:
                 raise KubeError(405, "PUT without name")
+            body = self._read_body()
+            # The chart's webhook registers CREATE and UPDATE.
+            self._admit(group, version, resource, namespace, body,
+                        operation="UPDATE")
             obj = self.store.update(
-                group, version, resource, name, self._read_body(),
+                group, version, resource, name, body,
                 namespace=namespace)
             self._send_json(200, obj)
         except Exception as e:  # noqa: BLE001
@@ -270,8 +322,23 @@ class FakeApiServer:
         self.store = store or FakeKubeClient()
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.store = self.store  # type: ignore[attr-defined]
+        self._httpd.admission = None  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self._thread: threading.Thread | None = None
+
+    def set_admission_webhook(self, url: str, ca_cert: str | None = None):
+        """Register a validating webhook for resource claims/templates
+        (ValidatingWebhookConfiguration analog). ``ca_cert`` verifies
+        the webhook's serving cert (the chart's caBundle)."""
+        import ssl as _ssl
+
+        ctx = None
+        if url.startswith("https"):
+            ctx = _ssl.create_default_context()
+            if ca_cert:
+                ctx.load_verify_locations(ca_cert)
+            ctx.check_hostname = False
+        self._httpd.admission = (url, ctx)  # type: ignore[attr-defined]
 
     @property
     def port(self) -> int:

@@ -612,10 +612,65 @@ class DraScheduler:
                 except NotFoundError:
                     pass
 
+    # -- Job controller (kcm job controller, completions=1 subset) ------------
+
+    def _sync_jobs(self):
+        """One pod per Job (the demo specs' workloads are Jobs); pod
+        phase feeds Job status (succeeded/failed + Complete)."""
+        try:
+            jobs = self.kube.list("batch", "v1", "jobs")
+        except KubeError:
+            return
+        for job in jobs:
+            ns = _meta(job).get("namespace", "default")
+            name = _meta(job)["name"]
+            pod_name = f"{name}-0"
+            try:
+                pod = self.kube.get("", "v1", "pods", pod_name,
+                                    namespace=ns)
+            except NotFoundError:
+                status = job.get("status", {})
+                if status.get("succeeded") or status.get("failed"):
+                    continue  # finished Job: never re-run its pod
+                tmpl = job.get("spec", {}).get("template", {})
+                try:
+                    self.kube.create("", "v1", "pods", {
+                        "apiVersion": "v1", "kind": "Pod",
+                        "metadata": {
+                            "name": pod_name, "namespace": ns,
+                            "labels": dict(tmpl.get("metadata", {}).get(
+                                "labels") or {}),
+                            "ownerReferences": [{
+                                "apiVersion": "batch/v1", "kind": "Job",
+                                "name": name,
+                                "uid": _meta(job).get("uid", ""),
+                                "controller": True,
+                            }],
+                        },
+                        "spec": json_copy(tmpl.get("spec", {})),
+                    }, namespace=ns)
+                except ConflictError:
+                    pass
+                continue
+            phase = pod.get("status", {}).get("phase", "")
+            if phase == "Succeeded" and not job.get("status", {}).get(
+                    "succeeded"):
+                self.kube.patch("batch", "v1", "jobs", name, {
+                    "status": {"succeeded": 1, "conditions": [
+                        {"type": "Complete", "status": "True"}]},
+                }, namespace=ns)
+            elif phase == "Failed" and not job.get("status", {}).get(
+                    "failed"):
+                self.kube.patch("batch", "v1", "jobs", name, {
+                    "status": {"failed": 1, "conditions": [
+                        {"type": "Failed", "status": "True"}]},
+                }, namespace=ns)
+
     # -- loop -----------------------------------------------------------------
 
     def sync_once(self):
         self._sync_daemonsets()
+        self._sync_jobs()
         self._generate_claims()
         self._allocate_claims()
         self._bind_pods()

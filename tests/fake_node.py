@@ -54,12 +54,13 @@ def resolve_cdi_devices(cdi_root: str, device_ids: list[str]) -> dict:
                 specs.append(json.load(f))
         except (OSError, ValueError):
             continue
-    merged = {"env": [], "deviceNodes": [], "mounts": []}
+    merged = {"env": [], "deviceNodes": [], "mounts": [], "hooks": []}
 
     def apply(edits: dict):
         merged["env"] += edits.get("env", [])
         merged["deviceNodes"] += edits.get("deviceNodes", [])
         merged["mounts"] += edits.get("mounts", [])
+        merged["hooks"] += edits.get("hooks", [])
 
     applied_spec_edits: set[int] = set()
     for device_id in device_ids:
@@ -120,9 +121,9 @@ class FakeNode:
 
     # -- claim resolution -----------------------------------------------------
 
-    def _pod_claims(self, pod) -> list[dict] | None:
-        """Resolved, allocated ResourceClaim objects, or None if any is
-        missing/unallocated (retry next pass)."""
+    def _pod_claims(self, pod) -> list[tuple[str, dict]] | None:
+        """[(pod claim-entry name, allocated ResourceClaim)], or None
+        if any is missing/unallocated (retry next pass)."""
         ns = pod["metadata"].get("namespace", "default")
         statuses = {
             s["name"]: s.get("resourceClaimName")
@@ -142,7 +143,7 @@ class FakeNode:
                 return None
             if not claim.get("status", {}).get("allocation"):
                 return None
-            out.append(claim)
+            out.append((ref["name"], claim))
         return out
 
     # -- pod lifecycle --------------------------------------------------------
@@ -161,19 +162,20 @@ class FakeNode:
     PREPARE_DEADLINE_S = 300.0  # kubelet retries failed prepares
     RUN_DEADLINE_S = 300.0  # run-to-completion budget (Never policy)
 
-    def _prepare_claims(self, rec, claims) -> list[str]:
+    def _prepare_claims(self, rec, claims) -> dict[str, list[str]]:
         """NodePrepareResources per driver with kubelet-style retries
         (a CD channel prepare legitimately fails until the domain is
-        Ready). Returns the merged CDI device IDs."""
+        Ready). Returns CDI device IDs keyed by pod claim-entry name
+        (containers only receive the devices of claims they name)."""
         import time
 
-        by_driver: dict[str, list[dict]] = {}
-        for claim in claims:
+        by_driver: dict[str, list[tuple[str, dict]]] = {}
+        for entry_name, claim in claims:
             results = claim["status"]["allocation"].get(
                 "devices", {}).get("results", [])
             for drv in {res["driver"] for res in results}:
-                by_driver.setdefault(drv, []).append(claim)
-        cdi_ids: list[str] = []
+                by_driver.setdefault(drv, []).append((entry_name, claim))
+        ids_by_entry: dict[str, list[str]] = {}
         deadline = time.monotonic() + self.PREPARE_DEADLINE_S
         for driver, driver_claims in by_driver.items():
             self._wait_plugin(driver, timeout=60)
@@ -181,7 +183,7 @@ class FakeNode:
                 "uid": c["metadata"]["uid"],
                 "namespace": c["metadata"].get("namespace", "default"),
                 "name": c["metadata"]["name"],
-            } for c in driver_claims]
+            } for _, c in driver_claims]
             while True:
                 resp = self.kubelet.prepare(driver, reqs)
                 errors = {u: r.error for u, r in resp.claims.items()
@@ -192,12 +194,13 @@ class FakeNode:
                     raise RuntimeError(
                         f"prepare {driver}: {errors}")
                 time.sleep(2.0)
-            for c in driver_claims:
+            for entry_name, c in driver_claims:
                 uid = c["metadata"]["uid"]
                 rec.prepared.append((driver, uid))
                 for dev in resp.claims[uid].devices:
-                    cdi_ids.extend(dev.cdi_device_ids)
-        return cdi_ids
+                    ids_by_entry.setdefault(entry_name, []).extend(
+                        dev.cdi_device_ids)
+        return ids_by_entry
 
     def _container_env(self, pod, container, edits) -> dict[str, str]:
         """Merged process env: CDI edits (containerd), declared env with
@@ -241,20 +244,139 @@ class FakeNode:
         env["POD_IP"] = env.get("POD_IP", self.pod_ip)
         return env
 
+    def _run_hooks(self, edits: dict, stage: str,
+                   container_id: str) -> None:
+        """Execute OCI hooks of one stage, as the runtime would: the
+        container state JSON goes to the hook's stdin (OCI runtime
+        spec); a failing createContainer hook fails the container
+        start (fail-closed admission -- the tenancy preflight
+        contract)."""
+        state = json.dumps({
+            "ociVersion": "1.0.2", "id": container_id,
+            "status": "creating" if stage == "createContainer"
+            else "stopped",
+        })
+        for hook in edits.get("hooks", []):
+            if hook.get("hookName") != stage:
+                continue
+            r = subprocess.run(
+                hook.get("args") or [hook["path"]],
+                executable=hook["path"],
+                input=state, capture_output=True, text=True,
+                timeout=hook.get("timeout", 10),
+            )
+            if r.returncode != 0 and stage == "createContainer":
+                raise RuntimeError(
+                    f"createContainer hook {hook['path']} failed "
+                    f"rc={r.returncode}: {r.stdout} {r.stderr}")
+
+    def _run_container(self, pod, container, ids_by_entry, results,
+                       rec: _PodRecord):
+        """One container to completion: CDI resolve, hooks, process.
+        Appends (name, returncode, log-text) to results. Reacts to pod
+        deletion (SIGTERM, like the kubelet killing containers)."""
+        import tempfile
+        import time
+
+        name = container.get("name", "c")
+        try:
+            ids = []
+            for ref in container.get("resources", {}).get(
+                    "claims") or []:
+                ids.extend(ids_by_entry.get(ref["name"], []))
+            edits = resolve_cdi_devices(self.cdi_root, ids)
+            env = self._container_env(pod, container, edits)
+            command = list(container.get("command") or ["true"])
+            if command and command[0] in ("python", "python3"):
+                command[0] = sys.executable
+            cid = f"{pod['metadata'].get('uid', 'pod')}-{name}"
+            self._run_hooks(edits, "createContainer", cid)
+            log_fd, log_path = tempfile.mkstemp(prefix="ctr-log-")
+            os.close(log_fd)
+            try:
+                with open(os.devnull) as devnull, \
+                        open(log_path, "a", encoding="utf-8") as lf:
+                    proc = subprocess.Popen(
+                        command, env=env, stdin=devnull, stdout=lf,
+                        stderr=subprocess.STDOUT, text=True)
+                deadline = time.monotonic() + self.RUN_DEADLINE_S
+                while proc.poll() is None:
+                    if rec.deleted.is_set() or \
+                            time.monotonic() > deadline:
+                        proc.terminate()
+                        try:
+                            proc.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+                            proc.wait()
+                        break
+                    time.sleep(0.2)
+                with open(log_path, encoding="utf-8",
+                          errors="replace") as f:
+                    log = f.read()
+                results.append((name, proc.returncode, log))
+            finally:
+                try:
+                    os.unlink(log_path)
+                except OSError:
+                    pass
+                # poststop failures never fail a finished workload
+                # (runtimes log and continue on poststop errors).
+                try:
+                    self._run_hooks(edits, "poststop", cid)
+                except Exception as e:  # noqa: BLE001
+                    print(f"fake-node: poststop hook error for "
+                          f"{name}: {e}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - container boundary
+            results.append((name, -1, f"fake-node container error: {e}"))
+
     def _run_pod(self, pod, claims):
         import time
 
         rec = self._records[pod["metadata"]["uid"]]
         try:
-            cdi_ids = self._prepare_claims(rec, claims)
-            edits = resolve_cdi_devices(self.cdi_root, cdi_ids)
-            container = pod["spec"]["containers"][0]
+            ids_by_entry = self._prepare_claims(rec, claims)
+            containers = pod["spec"]["containers"]
+            restart_always = pod["spec"].get(
+                "restartPolicy", "Always") == "Always"
+            if not restart_always:
+                # Run-to-completion pod: all containers concurrently,
+                # Succeeded iff every one exits 0 (k8s pod phase rules).
+                self._set_status(rec, "Running")
+                results: list[tuple[str, int, str]] = []
+                threads = [
+                    threading.Thread(
+                        target=self._run_container,
+                        args=(pod, c, ids_by_entry, results, rec))
+                    for c in containers
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=self.RUN_DEADLINE_S + 30)
+                log = "".join(
+                    (f"[{n}] {text}" if len(containers) > 1 else text)
+                    for n, _, text in results)
+                ok = len(results) == len(containers) and all(
+                    rc == 0 for _, rc, _ in results)
+                self._set_status(rec, "Succeeded" if ok else "Failed",
+                                 log=log)
+                return
+            # Long-running (Always) pod: single supervised container.
+            container = containers[0]
+            ids = []
+            for ref in container.get("resources", {}).get(
+                    "claims") or []:
+                ids.extend(ids_by_entry.get(ref["name"], []))
+            if not ids:
+                ids = [i for v in ids_by_entry.values() for i in v]
+            edits = resolve_cdi_devices(self.cdi_root, ids)
             env = self._container_env(pod, container, edits)
             command = list(container.get("command") or ["true"])
             if command and command[0] in ("python", "python3"):
                 command[0] = sys.executable
-            restart_always = pod["spec"].get(
-                "restartPolicy", "Always") == "Always"
+            self._run_hooks(edits, "createContainer",
+                            f"{pod['metadata'].get('uid', 'pod')}-0")
             self._set_status(rec, "Running")
             # Container output goes to a file, not a PIPE: nothing
             # drains a pipe while the process runs, so a chatty
@@ -286,7 +408,6 @@ class FakeNode:
                             stdout=log_file, stderr=subprocess.STDOUT,
                             text=True,
                         )
-                    deadline = time.monotonic() + self.RUN_DEADLINE_S
                     while proc.poll() is None:
                         if rec.deleted.is_set():
                             proc.terminate()
@@ -296,17 +417,8 @@ class FakeNode:
                                 proc.kill()
                                 proc.wait()
                             return
-                        if not restart_always and \
-                                time.monotonic() > deadline:
-                            proc.kill()
-                            proc.wait()
-                            self._set_status(
-                                rec, "Failed",
-                                log=read_log()
-                                + "\nfake-node: run deadline")
-                            return
                         time.sleep(0.2)
-                    if restart_always and not rec.deleted.is_set():
+                    if not rec.deleted.is_set():
                         # Long-running pod died: kubelet restarts it.
                         self._set_status(rec, "Running", log=read_log())
                         time.sleep(1.0)

@@ -1,0 +1,27 @@
+"""Mock workload runtime: the fake-cluster analog of the reference's
+mock libnvidia-ml for WORKLOAD containers.
+
+The mock-NVML kind pipeline makes GPU workloads run on CPU-only nodes
+by swapping the driver library under them
+(hack/ci/mock-nvml/setup-mock-gpu.sh). The TPU analog for JAX
+workloads: when a pod carries the driver-injected TPU env but no real
+chip exists, back JAX with N virtual CPU devices where N comes from
+``TPU_VISIBLE_DEVICES`` -- so a demo spec asserting
+``jax.device_count() == 4`` passes through the claim -> CDI -> env
+chain for real, on any machine.
+
+Activated by the fake node adding this directory to the container's
+PYTHONPATH and setting TPU_MOCK_WORKLOAD=1; inert everywhere else.
+"""
+
+import os
+
+if os.environ.get("TPU_MOCK_WORKLOAD") == "1":
+    chips = [c for c in os.environ.get(
+        "TPU_VISIBLE_DEVICES", "").split(",") if c != ""]
+    if chips:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={len(chips)}"
+        ).strip()
