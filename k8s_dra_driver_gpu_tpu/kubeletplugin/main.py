@@ -24,7 +24,7 @@ from ..pkg.featuregates import FeatureGates
 from ..pkg.kubeclient import FakeKubeClient, KubeClient
 from ..pkg.metrics import DRARequestMetrics, MetricsServer
 from ..pkg.dra.service import PluginServer
-from ..tpulib.binding import EnumerateOptions
+from ..tpulib.binding import ENV_MOCK_HEALTH_EVENTS, EnumerateOptions
 from . import DRIVER_NAME
 from .device_state import Config
 from .driver import Driver
@@ -112,6 +112,10 @@ def run(argv: list[str] | None = None) -> int:
         tpulib_opts=EnumerateOptions(
             mock_topology=args.mock_topology,
             worker_id=args.mock_worker_id if args.mock_topology else None,
+            # Mock health injection (TPULIB_MOCK_HEALTH_EVENTS, incl.
+            # the @control-file form) rides the same opts the health
+            # monitor polls with -- the mock-NVML event-injection seam.
+            health_events=os.environ.get(ENV_MOCK_HEALTH_EVENTS),
         ),
         static_subslices=tuple(
             s.strip() for s in args.static_subslices.split(",") if s.strip()

@@ -486,7 +486,17 @@ class PyTpuLib:
     def health(self, opts: EnumerateOptions | None = None) -> tuple[HealthEvent, ...]:
         opts = opts or EnumerateOptions.from_env()
         events = []
-        for item in filter(None, (opts.health_events or "").split("|")):
+        spec = opts.health_events or ""
+        if spec.startswith("@"):
+            # Control-file form: re-read every poll so a running plugin
+            # can have health events injected/cleared at runtime (the
+            # mock-NVML control-file analog; native backend mirrors).
+            try:
+                with open(spec[1:], encoding="utf-8") as f:
+                    spec = f.read().strip()
+            except OSError:
+                spec = ""
+        for item in filter(None, spec.split("|")):
             chip, kind = -1, "unknown"
             for f in item.split(","):
                 if "=" not in f:

@@ -517,6 +517,21 @@ char* tpuinfo_health(const char* opts) {
   j.raw("{").str("events").raw(":[");
   bool first = true;
   std::string events = Opt(o, "health_events");
+  if (!events.empty() && events[0] == '@') {
+    // Control-file form (@/path): re-read per call so events can be
+    // injected into a running plugin (mock-NVML control-file analog).
+    std::ifstream f(events.substr(1));
+    std::stringstream buf;
+    if (f) buf << f.rdbuf();
+    events = buf.str();
+    // Full strip (both ends, all whitespace) -- must match the Python
+    // backend's str.strip() exactly (backend-parity contract).
+    size_t b = events.find_first_not_of(" \t\r\n\f\v");
+    size_t e = events.find_last_not_of(" \t\r\n\f\v");
+    events = (b == std::string::npos)
+                 ? ""
+                 : events.substr(b, e - b + 1);
+  }
   if (!events.empty()) {
     std::stringstream ss(events);
     std::string item;

@@ -57,7 +57,13 @@ def cluster(tmp_path_factory):
     key_path.write_bytes(key)
 
     log = open(tmp / "webhook.log", "w", encoding="utf-8")
-    port = 18443
+    # Ephemeral port: probe a free one (hardcoding collides across
+    # concurrent runs on one host).
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     proc = subprocess.Popen(
         [sys.executable, "-m", "k8s_dra_driver_gpu_tpu.webhook.main",
          "--port", str(port),

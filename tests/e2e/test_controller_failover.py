@@ -1,0 +1,127 @@
+"""Controller HA e2e: two CD controller replicas with Lease-based
+leader election; the leader is SIGKILLed (crash, no lease release) and
+the standby must take over within the lease window and keep
+reconciling.
+
+Reference analog: tests/bats/test_cd_failover.bats +
+runWithLeaderElection (compute-domain-controller/main.go:277-377).
+The crash path is the interesting one: a SIGTERM'd leader releases its
+lease on cancel, but a crashed leader leaves the lease to EXPIRE --
+the standby's clock-skew-safe expiry check (pkg/leaderelection.py,
+fixed in round 2) is what this exercises end to end.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from tests.e2e.conftest import MODE, REPO
+from tests.e2e.framework import wait_for
+
+pytestmark = pytest.mark.skipif(
+    MODE != "fake",
+    reason="controller failover drives the fake cluster; real "
+           "clusters: tests/bats-analog system tier",
+)
+
+NS = "tpu-dra-driver"
+LEASE = "tpu-dra-cd-controller"
+
+
+def spawn_controller(workdir, url, identity):
+    log = open(os.path.join(workdir, f"{identity}.log"), "w",
+               encoding="utf-8")
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "k8s_dra_driver_gpu_tpu.computedomain.controller.main",
+         "--kube-api", url,
+         "--namespace", NS,
+         "--leader-election",
+         "--identity", identity],
+        env={**os.environ, "PYTHONPATH": REPO},
+        stdout=log, stderr=subprocess.STDOUT)
+    return proc, log
+
+
+def make_cd(kube, name, uid):
+    kube.create("resource.tpu.dra", "v1beta1", "computedomains", {
+        "apiVersion": "resource.tpu.dra/v1beta1",
+        "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": "team-f", "uid": uid},
+        "spec": {
+            "numNodes": 2,
+            "channel": {
+                "resourceClaimTemplate": {"name": f"{name}-rct"},
+                "allocationMode": "Single",
+            },
+        },
+    }, namespace="team-f")
+
+
+def daemonset_names(kube):
+    return {d["metadata"]["name"]
+            for d in kube.list("apps", "v1", "daemonsets", namespace=NS)}
+
+
+class TestControllerFailover:
+    def test_crashed_leader_fails_over(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.pkg.fakeapiserver import FakeApiServer
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
+
+        api = FakeApiServer().start()
+        kube = KubeClient(host=api.url)
+        procs = {}
+        logs = []
+        try:
+            for ident in ("ctrl-0", "ctrl-1"):
+                proc, log = spawn_controller(str(tmp_path), api.url,
+                                             ident)
+                procs[ident] = proc
+                logs.append(log)
+
+            def holder():
+                try:
+                    lease = kube.get("coordination.k8s.io", "v1",
+                                     "leases", LEASE, namespace=NS)
+                except Exception:  # noqa: BLE001
+                    return None
+                return lease.get("spec", {}).get("holderIdentity")
+
+            leader = wait_for(holder, timeout=60, desc="initial leader")
+            assert leader in procs
+
+            # The leader reconciles a CD.
+            make_cd(kube, "cd-a", "cd-a-uid")
+            wait_for(lambda: daemonset_names(kube) or None, timeout=60,
+                     desc="cd-a DaemonSet from the leader")
+
+            # Crash the leader: SIGKILL leaves the lease to expire.
+            procs[leader].kill()
+            procs[leader].wait()
+            survivor = next(i for i in procs if i != leader)
+
+            # Standby acquires after expiry (~30s lease) ...
+            wait_for(lambda: holder() == survivor or None, timeout=120,
+                     desc=f"lease takeover by {survivor}")
+            # ... and reconciliation continues: a CD created AFTER the
+            # crash gets its DaemonSet from the new leader.
+            make_cd(kube, "cd-b", "cd-b-uid")
+            wait_for(
+                lambda: len(daemonset_names(kube)) >= 2 or None,
+                timeout=90, desc="cd-b DaemonSet from the survivor")
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)
+            for proc in procs.values():
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+            for log in logs:
+                log.close()
+            api.stop()

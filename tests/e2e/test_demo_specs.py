@@ -50,6 +50,7 @@ class DemoCluster:
         self.nodes = []
         self.scheduler = None
         self.apiserver = None
+        self.pending_cleanup: list[str] = []  # per-instance, not shared
         try:
             self._start()
         except BaseException:
@@ -149,8 +150,6 @@ class DemoCluster:
             out.append(f"==== {os.path.basename(log.name)} ====\n"
                        f"{text[-tail:]}")
         return "\n".join(out)
-
-    pending_cleanup: list[str] = []
 
     def apply_spec(self, path: str) -> list[dict]:
         from k8s_dra_driver_gpu_tpu.pkg.kubeclient import ConflictError

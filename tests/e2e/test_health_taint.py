@@ -1,0 +1,140 @@
+"""Health e2e: a fatal chip event injected into the RUNNING plugin
+binary flows health -> DeviceTaint -> ResourceSlice republish ->
+scheduler avoidance -> recovery, end to end.
+
+Reference analog: the XID/GPU-lost pipeline (device_health.go ->
+DeviceTaints -> republish, SURVEY §3.5) exercised in CI through the
+mock-NVML event injection. Here the tpulib mock's control file
+(TPULIB_MOCK_HEALTH_EVENTS=@file, re-read every poll by both the
+native and Python backends) plays the mock-NVML role: write an event,
+the live plugin taints and republishes; clear it, capacity returns.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from tests.e2e.conftest import MODE, REPO
+from tests.e2e.framework import wait_for
+
+pytestmark = pytest.mark.skipif(
+    MODE != "fake",
+    reason="health injection drives the fake cluster's plugin binary",
+)
+
+RES = ("resource.k8s.io", "v1")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    from k8s_dra_driver_gpu_tpu.pkg.chartrender import (
+        manifests,
+        render_chart,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.fakeapiserver import FakeApiServer
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+
+    tmp = tmp_path_factory.mktemp("health")
+    ctl = tmp / "health.ctl"
+    api = FakeApiServer().start()
+    kube = KubeClient(host=api.url)
+    chart = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
+    for doc in manifests(render_chart(chart)):
+        if doc.get("kind") == "DeviceClass":
+            kube.create(*RES, "deviceclasses", doc)
+    log = open(tmp / "plugin.log", "w", encoding="utf-8")
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "k8s_dra_driver_gpu_tpu.kubeletplugin.main",
+         "--kube-api", api.url,
+         "--node-name", "node-health",
+         "--mock-topology", "v5e-4",
+         "--state-root", str(tmp / "state"),
+         "--cdi-root", str(tmp / "cdi"),
+         "--plugin-dir", str(tmp / "plugin"),
+         "--registry-dir", str(tmp / "reg")],
+        env={**os.environ, "PYTHONPATH": REPO,
+             "TPULIB_MOCK_HEALTH_EVENTS": f"@{ctl}"},
+        stdout=log, stderr=subprocess.STDOUT)
+    sched = DraScheduler(kube, default_node="node-health").start()
+    yield kube, ctl, sched
+    sched.stop()
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    log.close()
+    api.stop()
+
+
+def chip_taints(kube, chip: str) -> list[dict]:
+    out = []
+    for s in kube.list(*RES, "resourceslices"):
+        if s["spec"].get("driver") != "tpu.dra.dev":
+            continue
+        for d in s["spec"].get("devices", []):
+            if d["name"] == chip:
+                out.extend(d.get("taints") or [])
+    return out
+
+
+def make_claim(kube, name, count):
+    kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"devices": {"requests": [{
+            "name": "tpu",
+            "exactly": {"deviceClassName": "tpu.dra.dev",
+                        "count": count}}]}},
+    }, namespace="default")
+
+
+def allocation(kube, name):
+    return kube.get(*RES, "resourceclaims", name, "default").get(
+        "status", {}).get("allocation")
+
+
+class TestHealthTaintFlow:
+    def test_inject_taint_avoid_recover(self, cluster):
+        kube, ctl, _ = cluster
+        wait_for(lambda: kube.list(*RES, "resourceslices") or None,
+                 timeout=90, desc="initial publication")
+        assert chip_taints(kube, "chip-1") == []
+
+        # Inject a fatal HBM event into the LIVE plugin.
+        ctl.write_text("chip=1,kind=hbm_uncorrectable\n")
+        taints = wait_for(lambda: chip_taints(kube, "chip-1") or None,
+                          timeout=60, desc="taint republished")
+        # Fatal events carry the stronger NoExecute effect.
+        assert any(t.get("effect") in ("NoSchedule", "NoExecute")
+                   for t in taints), taints
+
+        # The scheduler now cannot seat a whole-host claim...
+        make_claim(kube, "whole-host", 4)
+        import time
+
+        time.sleep(3)
+        assert allocation(kube, "whole-host") is None
+        # ...but a 3-chip claim lands on the healthy chips.
+        make_claim(kube, "healthy-three", 3)
+        wait_for(lambda: allocation(kube, "healthy-three"), timeout=30,
+                 desc="3-chip claim on healthy chips")
+        used = {r["device"] for r in allocation(
+            kube, "healthy-three")["devices"]["results"]}
+        assert "chip-1" not in used
+
+        # Recovery: clear the event; the taint drops and the parked
+        # whole-host claim finally allocates.
+        ctl.write_text("")
+        wait_for(lambda: (not chip_taints(kube, "chip-1")) or None,
+                 timeout=60, desc="taint cleared on republish")
+        kube.delete(*RES, "resourceclaims", "healthy-three", "default")
+        wait_for(lambda: allocation(kube, "whole-host"), timeout=30,
+                 desc="whole-host claim after recovery")

@@ -1,0 +1,183 @@
+"""Plugin crash/restart e2e: SIGKILL the chip plugin mid-life and
+prove checkpoint resume through the full cluster stack.
+
+Reference analog: tests/bats/test_gpu_robustness.bats (plugin pod
+kills over live claims) + the checkpoint/resume design
+(device_state.go:83-215). The crashed plugin held a prepared claim;
+after restart it must (1) re-register with the kubelet watcher over
+the same sockets, (2) republish its pool at a higher generation,
+(3) serve NEW prepares without conflicting with the restored claim
+(per-core overlap guard against resumed state, not empty state), and
+(4) honor unprepare of a claim prepared by the PREVIOUS incarnation
+-- all over the real gRPC/HTTP boundaries.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from tests.e2e.conftest import MODE, REPO
+from tests.e2e.framework import wait_for
+
+pytestmark = pytest.mark.skipif(
+    MODE != "fake", reason="drives the fake cluster's plugin binary")
+
+RES = ("resource.k8s.io", "v1")
+NODE = "node-restart"
+
+
+class RestartCluster:
+    def __init__(self, tmp):
+        from k8s_dra_driver_gpu_tpu.pkg.chartrender import (
+            manifests,
+            render_chart,
+        )
+        from k8s_dra_driver_gpu_tpu.pkg.fakeapiserver import FakeApiServer
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
+        from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+        from tests.fake_node import FakeNode
+
+        self.tmp = str(tmp)
+        self.apiserver = FakeApiServer().start()
+        self.kube = KubeClient(host=self.apiserver.url)
+        chart = os.path.join(REPO, "deployments", "helm",
+                             "tpu-dra-driver")
+        for doc in manifests(render_chart(chart)):
+            if doc.get("kind") == "DeviceClass":
+                self.kube.create(*RES, "deviceclasses", doc)
+        self.plugin = None
+        self.log = None
+        self.spawn_plugin()
+        self.scheduler = DraScheduler(self.kube,
+                                      default_node=NODE).start()
+        self.node = FakeNode(NODE, os.path.join(self.tmp, "reg"),
+                             os.path.join(self.tmp, "cdi"),
+                             self.kube).start()
+
+    def spawn_plugin(self):
+        if self.log:
+            self.log.close()
+        self.log = open(os.path.join(self.tmp, "plugin.log"), "a",
+                        encoding="utf-8")
+        self.plugin = subprocess.Popen(
+            [sys.executable, "-m",
+             "k8s_dra_driver_gpu_tpu.kubeletplugin.main",
+             "--kube-api", self.apiserver.url,
+             "--node-name", NODE,
+             "--mock-topology", "v5e-4",
+             "--state-root", os.path.join(self.tmp, "state"),
+             "--cdi-root", os.path.join(self.tmp, "cdi"),
+             "--plugin-dir", os.path.join(self.tmp, "plugin"),
+             "--registry-dir", os.path.join(self.tmp, "reg")],
+            env={**os.environ, "PYTHONPATH": REPO},
+            stdout=self.log, stderr=subprocess.STDOUT)
+
+    def stop(self):
+        self.node.stop()
+        self.scheduler.stop()
+        if self.plugin and self.plugin.poll() is None:
+            self.plugin.send_signal(signal.SIGTERM)
+            try:
+                self.plugin.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.plugin.kill()
+                self.plugin.wait()
+        if self.log:
+            self.log.close()
+        self.apiserver.stop()
+
+    def pool_generation(self):
+        gens = [s["spec"]["pool"]["generation"]
+                for s in self.kube.list(*RES, "resourceslices")
+                if s["spec"].get("driver") == "tpu.dra.dev"]
+        return max(gens) if gens else 0
+
+    def run_probe_pod(self, ns, name, count, timeout=180):
+        self.kube.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": f"{name}-claim", "namespace": ns},
+            "spec": {"devices": {"requests": [{
+                "name": "tpu", "exactly": {
+                    "deviceClassName": "tpu.dra.dev",
+                    "count": count}}]}},
+        }, namespace=ns)
+        self.kube.create("", "v1", "pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "restartPolicy": "Never",
+                "containers": [{
+                    "name": "probe", "image": "python:3.12",
+                    "command": ["python", "-c",
+                                "import os; print(os.environ["
+                                "'TPU_VISIBLE_DEVICES'])"],
+                    "resources": {"claims": [{"name": "tpu"}]},
+                }],
+                "resourceClaims": [{
+                    "name": "tpu",
+                    "resourceClaimName": f"{name}-claim"}],
+            },
+        }, namespace=ns)
+
+        def phase():
+            try:
+                pod = self.kube.get("", "v1", "pods", name,
+                                    namespace=ns)
+            except Exception:  # noqa: BLE001
+                return None
+            p = pod.get("status", {}).get("phase", "")
+            if p == "Failed":
+                raise AssertionError(
+                    "probe pod failed: " + self.kube.read_raw(
+                        f"/api/v1/namespaces/{ns}/pods/{name}/log"))
+            return p == "Succeeded" or None
+        wait_for(phase, timeout=timeout, desc=f"pod {name}")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = RestartCluster(tmp_path_factory.mktemp("restart"))
+    yield c
+    c.stop()
+
+
+class TestPluginRestart:
+    def test_crash_resume_over_live_claim(self, cluster):
+        kube = cluster.kube
+        kube.create("", "v1", "namespaces", {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "t1"}})
+        wait_for(lambda: cluster.pool_generation() or None, timeout=90,
+                 desc="initial publication")
+
+        # A claim prepared by incarnation #1.
+        cluster.run_probe_pod("t1", "pod1", 1)
+        gen_before = cluster.pool_generation()
+
+        # Crash: SIGKILL, no graceful shutdown, checkpoint on disk.
+        cluster.plugin.kill()
+        cluster.plugin.wait()
+        cluster.spawn_plugin()
+
+        # Incarnation #2 republishes at a higher generation.
+        wait_for(lambda: cluster.pool_generation() > gen_before or None,
+                 timeout=90, desc="republish after restart")
+
+        # New prepare against RESUMED state: 3 chips remain free
+        # (pod1's chip is still checkpoint-held); the overlap guard
+        # must allow exactly the other three.
+        cluster.run_probe_pod("t1", "pod2", 3)
+
+        # Unprepare across incarnations: namespace teardown releases
+        # BOTH claims -- one prepared before the crash, one after.
+        kube.delete("", "v1", "namespaces", "t1")
+        kube.create("", "v1", "namespaces", {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "t2"}})
+        # All 4 chips must be preparable again: only true if the
+        # restarted plugin honored the pre-crash claim's unprepare.
+        cluster.run_probe_pod("t2", "pod3", 4)
+        kube.delete("", "v1", "namespaces", "t2")

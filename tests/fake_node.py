@@ -270,6 +270,26 @@ class FakeNode:
                     f"createContainer hook {hook['path']} failed "
                     f"rc={r.returncode}: {r.stdout} {r.stderr}")
 
+    def _container_setup(self, pod, container, ids_by_entry,
+                         all_devices_fallback: bool = False):
+        """Shared container bring-up: claim-scoped CDI resolve, env
+        merge, command rewrite, container id. Used by both the
+        run-to-completion and the supervised (Always) paths so their
+        semantics cannot drift."""
+        ids = []
+        for ref in container.get("resources", {}).get("claims") or []:
+            ids.extend(ids_by_entry.get(ref["name"], []))
+        if not ids and all_devices_fallback:
+            ids = [i for v in ids_by_entry.values() for i in v]
+        edits = resolve_cdi_devices(self.cdi_root, ids)
+        env = self._container_env(pod, container, edits)
+        command = list(container.get("command") or ["true"])
+        if command and command[0] in ("python", "python3"):
+            command[0] = sys.executable
+        cid = (f"{pod['metadata'].get('uid', 'pod')}-"
+               f"{container.get('name', 'c')}")
+        return edits, env, command, cid
+
     def _run_container(self, pod, container, ids_by_entry, results,
                        rec: _PodRecord):
         """One container to completion: CDI resolve, hooks, process.
@@ -280,16 +300,8 @@ class FakeNode:
 
         name = container.get("name", "c")
         try:
-            ids = []
-            for ref in container.get("resources", {}).get(
-                    "claims") or []:
-                ids.extend(ids_by_entry.get(ref["name"], []))
-            edits = resolve_cdi_devices(self.cdi_root, ids)
-            env = self._container_env(pod, container, edits)
-            command = list(container.get("command") or ["true"])
-            if command and command[0] in ("python", "python3"):
-                command[0] = sys.executable
-            cid = f"{pod['metadata'].get('uid', 'pod')}-{name}"
+            edits, env, command, cid = self._container_setup(
+                pod, container, ids_by_entry)
             self._run_hooks(edits, "createContainer", cid)
             log_fd, log_path = tempfile.mkstemp(prefix="ctr-log-")
             os.close(log_fd)
@@ -363,20 +375,13 @@ class FakeNode:
                                  log=log)
                 return
             # Long-running (Always) pod: single supervised container.
+            # (DS daemon pod templates put the claim on the pod but the
+            # container entry may omit resources.claims -- fall back to
+            # all pod devices there, matching older template shapes.)
             container = containers[0]
-            ids = []
-            for ref in container.get("resources", {}).get(
-                    "claims") or []:
-                ids.extend(ids_by_entry.get(ref["name"], []))
-            if not ids:
-                ids = [i for v in ids_by_entry.values() for i in v]
-            edits = resolve_cdi_devices(self.cdi_root, ids)
-            env = self._container_env(pod, container, edits)
-            command = list(container.get("command") or ["true"])
-            if command and command[0] in ("python", "python3"):
-                command[0] = sys.executable
-            self._run_hooks(edits, "createContainer",
-                            f"{pod['metadata'].get('uid', 'pod')}-0")
+            edits, env, command, cid = self._container_setup(
+                pod, container, ids_by_entry, all_devices_fallback=True)
+            self._run_hooks(edits, "createContainer", cid)
             self._set_status(rec, "Running")
             # Container output goes to a file, not a PIPE: nothing
             # drains a pipe while the process runs, so a chatty
@@ -434,6 +439,11 @@ class FakeNode:
                     os.unlink(log_path)
                 except OSError:
                     pass
+                try:
+                    self._run_hooks(edits, "poststop", cid)
+                except Exception as e:  # noqa: BLE001
+                    print(f"fake-node: poststop hook error for "
+                          f"{rec.name}: {e}", file=sys.stderr)
         except Exception as e:  # noqa: BLE001 - node-agent boundary
             rec.failed_msg = str(e)
             self._set_status(rec, "Failed", log=f"fake-node error: {e}")

@@ -278,6 +278,22 @@ class TestBackendParity:
             opts = EnumerateOptions(health_events=events)
             assert native.health(opts) == py.health(opts), events
 
+    def test_health_control_file_parity(self, tmp_path):
+        """@file form: both backends re-read the control file per call
+        (runtime injection seam) and treat a missing file as no
+        events."""
+        native, py = NativeTpuLib(), PyTpuLib()
+        ctl = tmp_path / "health.ctl"
+        opts = EnumerateOptions(health_events=f"@{ctl}")
+        assert native.health(opts) == py.health(opts) == ()
+        # CRLF + leading whitespace: both backends must strip alike.
+        ctl.write_text("\n chip=2,kind=hbm_uncorrectable\r\n")
+        got = py.health(opts)
+        assert got == native.health(opts)
+        assert got[0].chip == 2 and got[0].fatal
+        ctl.write_text("")  # cleared at runtime
+        assert native.health(opts) == py.health(opts) == ()
+
     def test_devfs_health_parity(self, tmp_path):
         dev = tmp_path / "dev"
         dev.mkdir()
