@@ -68,6 +68,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mock-worker-id", type=int,
                    default=int(env("TPULIB_MOCK_WORKER_ID", "0")),
                    help="mock worker id [TPULIB_MOCK_WORKER_ID]")
+    p.add_argument("--sys-root", default=env("SYS_ROOT", ""),
+                   help="sysfs root override (containerized plugins "
+                        "mount the host's /sys here; also the fake-"
+                        "PCI-tree seam for vfio tests) [SYS_ROOT]")
+    p.add_argument("--dev-root", default=env("DEV_ROOT", ""),
+                   help="devfs root override, like --sys-root "
+                        "[DEV_ROOT]")
     p.add_argument("--publication-mode",
                    choices=["auto", "legacy", "combined", "split"],
                    default=env("PUBLICATION_MODE", "auto"),
@@ -112,6 +119,8 @@ def run(argv: list[str] | None = None) -> int:
         tpulib_opts=EnumerateOptions(
             mock_topology=args.mock_topology,
             worker_id=args.mock_worker_id if args.mock_topology else None,
+            sys_root=args.sys_root or None,
+            dev_root=args.dev_root or None,
             # Mock health injection (TPULIB_MOCK_HEALTH_EVENTS, incl.
             # the @control-file form) rides the same opts the health
             # monitor polls with -- the mock-NVML event-injection seam.

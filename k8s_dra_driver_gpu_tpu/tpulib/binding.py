@@ -490,10 +490,12 @@ class PyTpuLib:
         if spec.startswith("@"):
             # Control-file form: re-read every poll so a running plugin
             # can have health events injected/cleared at runtime (the
-            # mock-NVML control-file analog; native backend mirrors).
+            # mock-NVML control-file analog). latin-1 + explicit ASCII
+            # strip = byte-for-byte what the native backend does, so
+            # arbitrary file bytes cannot diverge the two.
             try:
-                with open(spec[1:], encoding="utf-8") as f:
-                    spec = f.read().strip()
+                with open(spec[1:], encoding="latin-1") as f:
+                    spec = f.read().strip(" \t\r\n\f\v")
             except OSError:
                 spec = ""
         for item in filter(None, spec.split("|")):

@@ -1,9 +1,18 @@
 """e2e helpers: typed create/wait/log over the in-tree KubeClient
-(the reference's framework/{client,gpu,manifests,wait}.go analog)."""
+(the reference's framework/{client,gpu,manifests,wait}.go analog),
+plus the shared single-plugin fake-cluster scaffold the per-feature
+e2e modules build on."""
 
 from __future__ import annotations
 
+import os
+import signal
+import subprocess
+import sys
 import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 # kind -> (group, version, plural)
 GVR = {
@@ -16,6 +25,104 @@ GVR = {
     "DeviceClass": ("resource.k8s.io", "v1", "deviceclasses"),
     "ComputeDomain": ("resource.tpu.dra", "v1beta1", "computedomains"),
 }
+
+
+def apply_device_classes(kube) -> None:
+    """helm-install leg: the chart's DeviceClasses into the store."""
+    from k8s_dra_driver_gpu_tpu.pkg.chartrender import (
+        manifests,
+        render_chart,
+    )
+
+    chart = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
+    for doc in manifests(render_chart(chart)):
+        if doc.get("kind") == "DeviceClass":
+            kube.create("resource.k8s.io", "v1", "deviceclasses", doc)
+
+
+def stop_binary(proc, log=None, timeout: float = 15.0) -> None:
+    """SIGTERM -> wait -> SIGKILL teardown for a spawned binary."""
+    if proc is not None and proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    if log is not None:
+        log.close()
+
+
+class PluginCluster:
+    """One chip-plugin-binary fake cluster: apiserver + DeviceClasses +
+    plugin subprocess + scheduler + fake node. Feature e2e modules
+    parameterize via plugin_args/plugin_env/with_node. Construction is
+    failure-safe: a partial start tears itself down."""
+
+    def __init__(self, workdir, node_name: str,
+                 plugin_args: list[str] | None = None,
+                 plugin_env: dict | None = None,
+                 with_node: bool = True):
+        self.workdir = str(workdir)
+        self.node_name = node_name
+        self.apiserver = None
+        self.scheduler = None
+        self.node = None
+        self.plugin = None
+        self.log = None
+        try:
+            self._start(plugin_args or [], plugin_env or {}, with_node)
+        except BaseException:
+            self.stop()
+            raise
+
+    def _start(self, plugin_args, plugin_env, with_node):
+        from k8s_dra_driver_gpu_tpu.pkg.fakeapiserver import FakeApiServer
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
+        from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+        from tests.fake_node import FakeNode
+
+        self.apiserver = FakeApiServer().start()
+        self.kube = KubeClient(host=self.apiserver.url)
+        apply_device_classes(self.kube)
+        self._plugin_env = plugin_env
+        self._plugin_args = plugin_args
+        self.spawn_plugin()
+        self.scheduler = DraScheduler(
+            self.kube, default_node=self.node_name).start()
+        if with_node:
+            self.node = FakeNode(
+                self.node_name, os.path.join(self.workdir, "reg"),
+                os.path.join(self.workdir, "cdi"), self.kube).start()
+
+    def spawn_plugin(self):
+        """(Re)spawn the plugin binary over the same state dirs --
+        restart tests call this after a kill."""
+        if self.log:
+            self.log.close()
+        self.log = open(os.path.join(self.workdir, "plugin.log"), "a",
+                        encoding="utf-8")
+        self.plugin = subprocess.Popen(
+            [sys.executable, "-m",
+             "k8s_dra_driver_gpu_tpu.kubeletplugin.main",
+             "--kube-api", self.apiserver.url,
+             "--node-name", self.node_name,
+             "--state-root", os.path.join(self.workdir, "state"),
+             "--cdi-root", os.path.join(self.workdir, "cdi"),
+             "--plugin-dir", os.path.join(self.workdir, "plugin"),
+             "--registry-dir", os.path.join(self.workdir, "reg"),
+             *self._plugin_args],
+            env={**os.environ, "PYTHONPATH": REPO, **self._plugin_env},
+            stdout=self.log, stderr=subprocess.STDOUT)
+
+    def stop(self):
+        if self.node:
+            self.node.stop()
+        if self.scheduler:
+            self.scheduler.stop()
+        stop_binary(self.plugin, self.log)
+        if self.apiserver:
+            self.apiserver.stop()
 
 
 def wait_for(predicate, timeout=180.0, interval=2.0, desc="condition"):

@@ -10,14 +10,9 @@ native and Python backends) plays the mock-NVML role: write an event,
 the live plugin taints and republishes; clear it, capacity returns.
 """
 
-import os
-import signal
-import subprocess
-import sys
-
 import pytest
 
-from tests.e2e.conftest import MODE, REPO
+from tests.e2e.conftest import MODE
 from tests.e2e.framework import wait_for
 
 pytestmark = pytest.mark.skipif(
@@ -30,48 +25,17 @@ RES = ("resource.k8s.io", "v1")
 
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
-    from k8s_dra_driver_gpu_tpu.pkg.chartrender import (
-        manifests,
-        render_chart,
-    )
-    from k8s_dra_driver_gpu_tpu.pkg.fakeapiserver import FakeApiServer
-    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
-    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+    from tests.e2e.framework import PluginCluster
 
     tmp = tmp_path_factory.mktemp("health")
     ctl = tmp / "health.ctl"
-    api = FakeApiServer().start()
-    kube = KubeClient(host=api.url)
-    chart = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
-    for doc in manifests(render_chart(chart)):
-        if doc.get("kind") == "DeviceClass":
-            kube.create(*RES, "deviceclasses", doc)
-    log = open(tmp / "plugin.log", "w", encoding="utf-8")
-    proc = subprocess.Popen(
-        [sys.executable, "-m",
-         "k8s_dra_driver_gpu_tpu.kubeletplugin.main",
-         "--kube-api", api.url,
-         "--node-name", "node-health",
-         "--mock-topology", "v5e-4",
-         "--state-root", str(tmp / "state"),
-         "--cdi-root", str(tmp / "cdi"),
-         "--plugin-dir", str(tmp / "plugin"),
-         "--registry-dir", str(tmp / "reg")],
-        env={**os.environ, "PYTHONPATH": REPO,
-             "TPULIB_MOCK_HEALTH_EVENTS": f"@{ctl}"},
-        stdout=log, stderr=subprocess.STDOUT)
-    sched = DraScheduler(kube, default_node="node-health").start()
-    yield kube, ctl, sched
-    sched.stop()
-    if proc.poll() is None:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
-    log.close()
-    api.stop()
+    c = PluginCluster(
+        tmp, "node-health",
+        plugin_args=["--mock-topology", "v5e-4"],
+        plugin_env={"TPULIB_MOCK_HEALTH_EVENTS": f"@{ctl}"},
+        with_node=False)
+    yield c.kube, ctl, c.scheduler
+    c.stop()
 
 
 def chip_taints(kube, chip: str) -> list[dict]:

@@ -12,15 +12,10 @@ the same sockets, (2) republish its pool at a higher generation,
 -- all over the real gRPC/HTTP boundaries.
 """
 
-import os
-import signal
-import subprocess
-import sys
-
 import pytest
 
-from tests.e2e.conftest import MODE, REPO
-from tests.e2e.framework import wait_for
+from tests.e2e.conftest import MODE
+from tests.e2e.framework import PluginCluster, wait_for
 
 pytestmark = pytest.mark.skipif(
     MODE != "fake", reason="drives the fake cluster's plugin binary")
@@ -29,65 +24,13 @@ RES = ("resource.k8s.io", "v1")
 NODE = "node-restart"
 
 
-class RestartCluster:
+class RestartCluster(PluginCluster):
+    """PluginCluster + the pool-generation and probe-pod helpers the
+    restart scenario drives."""
+
     def __init__(self, tmp):
-        from k8s_dra_driver_gpu_tpu.pkg.chartrender import (
-            manifests,
-            render_chart,
-        )
-        from k8s_dra_driver_gpu_tpu.pkg.fakeapiserver import FakeApiServer
-        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
-        from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
-        from tests.fake_node import FakeNode
-
-        self.tmp = str(tmp)
-        self.apiserver = FakeApiServer().start()
-        self.kube = KubeClient(host=self.apiserver.url)
-        chart = os.path.join(REPO, "deployments", "helm",
-                             "tpu-dra-driver")
-        for doc in manifests(render_chart(chart)):
-            if doc.get("kind") == "DeviceClass":
-                self.kube.create(*RES, "deviceclasses", doc)
-        self.plugin = None
-        self.log = None
-        self.spawn_plugin()
-        self.scheduler = DraScheduler(self.kube,
-                                      default_node=NODE).start()
-        self.node = FakeNode(NODE, os.path.join(self.tmp, "reg"),
-                             os.path.join(self.tmp, "cdi"),
-                             self.kube).start()
-
-    def spawn_plugin(self):
-        if self.log:
-            self.log.close()
-        self.log = open(os.path.join(self.tmp, "plugin.log"), "a",
-                        encoding="utf-8")
-        self.plugin = subprocess.Popen(
-            [sys.executable, "-m",
-             "k8s_dra_driver_gpu_tpu.kubeletplugin.main",
-             "--kube-api", self.apiserver.url,
-             "--node-name", NODE,
-             "--mock-topology", "v5e-4",
-             "--state-root", os.path.join(self.tmp, "state"),
-             "--cdi-root", os.path.join(self.tmp, "cdi"),
-             "--plugin-dir", os.path.join(self.tmp, "plugin"),
-             "--registry-dir", os.path.join(self.tmp, "reg")],
-            env={**os.environ, "PYTHONPATH": REPO},
-            stdout=self.log, stderr=subprocess.STDOUT)
-
-    def stop(self):
-        self.node.stop()
-        self.scheduler.stop()
-        if self.plugin and self.plugin.poll() is None:
-            self.plugin.send_signal(signal.SIGTERM)
-            try:
-                self.plugin.wait(timeout=15)
-            except subprocess.TimeoutExpired:
-                self.plugin.kill()
-                self.plugin.wait()
-        if self.log:
-            self.log.close()
-        self.apiserver.stop()
+        super().__init__(tmp, NODE,
+                         plugin_args=["--mock-topology", "v5e-4"])
 
     def pool_generation(self):
         gens = [s["spec"]["pool"]["generation"]
