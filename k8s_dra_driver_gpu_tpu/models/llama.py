@@ -187,6 +187,17 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: dict,
     return x
 
 
+def apply_remat(body, remat: str):
+    """Wrap a scan body per the cfg.remat policy (see LlamaConfig.remat)."""
+    if remat == "full":
+        return jax.checkpoint(body)
+    if remat == "dots":
+        return jax.checkpoint(body, policy=jax.checkpoint_policies.dots_saveable)
+    if remat == "none":
+        return body
+    raise ValueError(f"unknown remat policy {remat!r}")
+
+
 def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
             attn_fn=None, positions: jax.Array | None = None) -> jax.Array:
     """Token ids [B, S] -> logits [B, S, V] (fp32 logits).
@@ -205,14 +216,7 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
     # sequences fit HBM; none: small models keep max true MFU).
     body = lambda carry, lp: (  # noqa: E731
         _layer(cfg, carry, lp, positions, attn_fn), None)
-    if cfg.remat == "full":
-        body = jax.checkpoint(body)
-    elif cfg.remat == "dots":
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.dots_saveable)
-    elif cfg.remat != "none":
-        raise ValueError(f"unknown remat policy {cfg.remat!r}")
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, _ = jax.lax.scan(apply_remat(body, cfg.remat), x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)

@@ -23,6 +23,7 @@ FSDP_AXIS = "fsdp"
 TENSOR_AXIS = "tp"
 SEQUENCE_AXIS = "sp"
 EXPERT_AXIS = "ep"
+PIPELINE_AXIS = "pp"
 
 
 @dataclass(frozen=True)
@@ -126,6 +127,32 @@ def build_multislice_mesh(
         )
     arr = np.asarray(devs).reshape((num_slices,) + plan.shape())
     return Mesh(arr, (DCN_AXIS,) + plan.axis_names())
+
+
+def build_pipeline_mesh(
+    pp: int,
+    dp: int | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """A ("pp", "dp") mesh for pipeline-parallel training.
+
+    Pipeline stage-to-stage traffic is point-to-point activations (small
+    vs the dp gradient all-reduce), so "pp" is the OUTERMOST axis: dp
+    replicas of one stage stay ICI-adjacent and the gradient all-reduce
+    rides the tight neighborhood, while the per-tick ppermute tolerates
+    the longer hops. (Scaling-book recipe: give the weakest links to the
+    least bandwidth-hungry axis.)
+    """
+    devs = devices if devices is not None else jax.devices()
+    if dp is None:
+        if len(devs) % pp:
+            raise ValueError(f"{len(devs)} devices not divisible by pp={pp}")
+        dp = len(devs) // pp
+    if pp * dp != len(devs):
+        raise ValueError(
+            f"pp={pp} x dp={dp} needs {pp * dp} devices, have {len(devs)}")
+    arr = np.asarray(devs).reshape((pp, dp))
+    return Mesh(arr, (PIPELINE_AXIS, DATA_AXIS))
 
 
 def mesh_from_topology(topology: str, tp: int | None = None) -> Mesh:

@@ -1,0 +1,189 @@
+"""Pipeline-parallel (GPipe-schedule) Llama training step.
+
+TPU-first collective pipelining, not a stage-per-process port: the whole
+step runs inside one ``shard_map`` over a ("pp", "dp") mesh. The stacked
+layer parameters ([L, ...] leaves) shard their leading dim over "pp", so
+each device holds a contiguous block of L/pp layers; microbatches stream
+through a ``lax.scan`` over M + pp - 1 ticks, and after every tick the
+activations rotate one stage forward with ``lax.ppermute`` on ICI.
+Embedding lives on stage 0 and the LM head + loss on the last stage
+(both leaves are replicated for simplicity; only the owning stage's
+compute touches them, and a psum over "pp" folds their gradients).
+
+Why this shape for TPU/XLA:
+- One jitted SPMD program; the schedule is a compiler-visible ``scan``
+  with static trip count, not host-side stage orchestration.
+- Stage-to-stage transfer is a single ``ppermute`` of the [mb, S, D]
+  activation block per tick -- point-to-point on ICI, overlappable by
+  XLA with the next tick's compute (schedule per the GPipe paper,
+  arXiv:1811.06965).
+- Autodiff runs INSIDE the shard_map: the transpose of ``ppermute`` is
+  the reverse rotation, so backward ticks stream cotangents stage
+  pp-1 -> 0 with the same collective, giving the classic
+  forward-then-backward GPipe schedule with bubble fraction
+  (pp-1)/(M+pp-1). ``cfg.remat`` applies to the stage body, so per-tick
+  activation memory is O(carry), the GPipe rematerialization trade.
+
+Reference parity note: the reference driver has no pipeline engine
+in-tree (SURVEY.md §2.9 -- its workloads bring their own); this module
+is part of the workload-side parallelism surface the TPU framework
+ships so a prepared multi-chip claim can be driven by every major
+parallelism family (dp/fsdp/tp/sp/ep/pp).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from ..parallel.mesh import DATA_AXIS, PIPELINE_AXIS
+from .train import TrainState, make_optimizer
+
+
+def pp_param_specs(cfg: llama.LlamaConfig,
+                   pp_axis: str = PIPELINE_AXIS) -> dict:
+    """PartitionSpecs for pipeline training: stacked layer leaves shard
+    their leading (layer) dim over ``pp_axis``; everything else is
+    replicated."""
+    specs = jax.tree.map(
+        lambda _: P(), llama.param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P))
+    specs["layers"] = jax.tree.map(
+        lambda _: P(pp_axis), specs["layers"],
+        is_leaf=lambda x: isinstance(x, P))
+    return specs
+
+
+def make_pp_train(
+    mesh: Mesh,
+    cfg: llama.LlamaConfig,
+    n_microbatches: int,
+    optimizer: optax.GradientTransformation | None = None,
+    pp_axis: str = PIPELINE_AXIS,
+    dp_axis: str = DATA_AXIS,
+):
+    """Returns (init_fn, step_fn, batch_sharding, place_params).
+
+    Tokens are [M, B, S+1]: M microbatches per optimizer step, batch
+    sharded over ``dp_axis``, replicated over ``pp_axis`` (each stage
+    reads only the slice its role needs: stage 0 the inputs, the last
+    stage the targets). The update equals a plain synchronous step on
+    the concatenated M*B batch -- GPipe is exact data parallelism over
+    microbatches, there is no staleness.
+    """
+    pp = mesh.shape[pp_axis]
+    M = n_microbatches
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    if M < 1:
+        raise ValueError("need at least one microbatch")
+    optimizer = optimizer or make_optimizer()
+    specs = pp_param_specs(cfg, pp_axis)
+    param_shard = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    token_spec = P(None, dp_axis, None)
+    batch_shard = NamedSharding(mesh, token_spec)
+    dt = cfg.dtype
+
+    def stage_fn(layers_local, x, positions):
+        """Apply this stage's L/pp layers ([L/pp, ...] local leaves)."""
+        body = lambda carry, lp: (  # noqa: E731
+            llama._layer(cfg, carry, lp, positions), None)
+        x, _ = jax.lax.scan(llama.apply_remat(body, cfg.remat), x,
+                            layers_local)
+        return x
+
+    def local_loss(params, tokens):
+        """This device's contribution to the global mean loss.
+
+        Only the last stage produces a nonzero value; the caller psums
+        over ``pp_axis`` to recover the full mean (and pmeans over
+        ``dp_axis`` for the batch shards).
+        """
+        idx = jax.lax.axis_index(pp_axis)
+        inputs, targets = tokens[..., :-1], tokens[..., 1:]
+        mb, S = inputs.shape[1], inputs.shape[2]
+        positions = jnp.arange(S)[None, :]
+        x0 = jnp.zeros((mb, S, cfg.d_model), dt)
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def head_loss(x, m):
+            h = llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+            logits = (h @ params["lm_head"].astype(dt)).astype(jnp.float32)
+            tgt = targets[jnp.clip(m, 0, M - 1)]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgt).mean()
+
+        def tick(carry, t):
+            x, loss_sum = carry
+            # Stage 0 ingests microbatch t's embedding (bubble ticks
+            # t >= M re-feed a clipped batch whose output never reaches
+            # a counted loss); later stages keep the rotated-in value.
+            fresh = params["embed"].astype(dt)[inputs[jnp.clip(t, 0, M - 1)]]
+            x = jnp.where(idx == 0, fresh, x)
+            x = stage_fn(params["layers"], x, positions)
+            # Last stage scores microbatch m = t - (pp-1) once it has
+            # traversed all stages. lax.cond skips the V-sized head
+            # matmul at runtime on every other (stage, tick).
+            m = t - (pp - 1)
+            valid = (idx == pp - 1) & (m >= 0) & (m < M)
+            loss_t = jax.lax.cond(
+                valid, head_loss, lambda x, m: jnp.float32(0.0), x, m)
+            x = jax.lax.ppermute(x, pp_axis, fwd_perm)
+            return (x, loss_sum + loss_t), None
+
+        (_, loss_sum), _ = jax.lax.scan(
+            tick, (x0, jnp.float32(0.0)), jnp.arange(M + pp - 1))
+        return loss_sum / M
+
+    def local_value_and_grad(params, tokens):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens)
+        # Stage-owned layer grads: cotangents already arrived via the
+        # reverse ppermute, so they are totals for this stage's layers;
+        # average the dp batch shards only. Replicated leaves (embed,
+        # head, final norm): nonzero only on the owning stage -- psum
+        # over pp makes every copy the true total.
+        grads = jax.lax.pmean(grads, dp_axis)
+        repl = jax.tree.map(
+            lambda g, s: jax.lax.psum(g, pp_axis) if s == P() else g,
+            grads, specs, is_leaf=lambda x: isinstance(x, P))
+        loss = jax.lax.pmean(jax.lax.psum(loss, pp_axis), dp_axis)
+        return loss, repl
+
+    @partial(jax.jit, in_shardings=(param_shard,))
+    def init_fn(params):
+        return TrainState(
+            params=params,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state: TrainState, tokens):
+        # Static at trace time; without this the clipped microbatch
+        # gathers below would silently re-count batches on a mismatch.
+        if tokens.ndim != 3 or tokens.shape[0] != M:
+            raise ValueError(
+                f"tokens must be [M={M}, B, S+1], got {tokens.shape}")
+        loss, grads = jax.shard_map(
+            local_value_and_grad,
+            mesh=mesh,
+            in_specs=(specs, token_spec),
+            out_specs=(P(), specs),
+            check_vma=False,  # replication argued in local_value_and_grad
+        )(state.params, tokens)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    def place_params(params):
+        return jax.device_put(params, param_shard)
+
+    return init_fn, step_fn, batch_shard, place_params

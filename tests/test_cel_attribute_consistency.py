@@ -132,6 +132,16 @@ class TestE2EShapeConsistency:
             COMPUTE_DOMAIN_DRIVER_NAME,
         )
 
+        # DeviceClass names in e2e specs must be classes the chart actually
+        # ships, so a renamed class breaks the e2e tier loudly here.
+        with open(os.path.join(
+                REPO, "deployments", "helm", "tpu-dra-driver",
+                "templates", "deviceclasses.yaml"), encoding="utf-8") as f:
+            chart_classes = set(
+                re.findall(r"name:\s*([a-z0-9.-]*\.dra\.dev)", f.read()))
+        assert DRIVER_NAME in chart_classes
+        allowed = chart_classes | {DRIVER_NAME, COMPUTE_DOMAIN_DRIVER_NAME}
+
         for fname in os.listdir(os.path.join(REPO, "tests", "e2e")):
             if not fname.endswith(".py"):
                 continue
@@ -139,9 +149,7 @@ class TestE2EShapeConsistency:
                       encoding="utf-8") as f:
                 text = f.read()
             for m in re.finditer(r'"([a-z0-9.-]*\.dra\.dev)"', text):
-                assert m.group(1) in (DRIVER_NAME,
-                                      COMPUTE_DOMAIN_DRIVER_NAME), (
-                    fname, m.group(1))
+                assert m.group(1) in allowed, (fname, m.group(1))
 
 
 class TestCELAttributeConsistency:
