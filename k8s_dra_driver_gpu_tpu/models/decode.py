@@ -137,30 +137,25 @@ def decode_step(
     return logits[:, 0], KVCache(k=cks, v=cvs, length=pos + 1)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "max_len", "temperature"),
-)
-def generate(
-    params: dict,
-    prompt: jax.Array,  # [B, S] token ids
-    cfg: LlamaConfig,
-    max_new_tokens: int,
-    max_len: int,
-    temperature: float = 0.0,
-    key: jax.Array | None = None,
-) -> jax.Array:
-    """Greedy (temperature=0) or sampled generation; returns [B,
-    max_new_tokens]."""
-    if key is None:
-        key = jax.random.PRNGKey(0)
-    if prompt.shape[1] + max_new_tokens > max_len:
+def _check_budget(prompt_len: int, max_new_tokens: int, max_len: int):
+    if prompt_len + max_new_tokens > max_len:
         # dynamic_update_slice clamps out-of-range writes -- overflow
         # would silently corrupt the cache instead of erroring.
         raise ValueError(
-            f"prompt ({prompt.shape[1]}) + max_new_tokens "
+            f"prompt ({prompt_len}) + max_new_tokens "
             f"({max_new_tokens}) exceeds max_len ({max_len})"
         )
+
+
+def _generate_impl(
+    params: dict,
+    prompt: jax.Array,  # [B, S] token ids
+    key: jax.Array,
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    max_len: int,
+    temperature: float,
+) -> jax.Array:
     logits, cache = prefill(params, prompt, cfg, max_len)
 
     def sample(logits, key):
@@ -179,3 +174,85 @@ def generate(
         step, (logits, cache, key), None, length=max_new_tokens
     )
     return tokens.swapaxes(0, 1)  # [B, max_new_tokens]
+
+
+_generate_jit = jax.jit(
+    _generate_impl,
+    static_argnames=("cfg", "max_new_tokens", "max_len", "temperature"),
+)
+
+
+def generate(
+    params: dict,
+    prompt: jax.Array,  # [B, S] token ids
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    max_len: int,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled generation; returns [B,
+    max_new_tokens]."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    _check_budget(prompt.shape[1], max_new_tokens, max_len)
+    return _generate_jit(params, prompt, key, cfg, max_new_tokens,
+                         max_len, temperature)
+
+
+def make_sharded_generate(
+    mesh,
+    cfg: LlamaConfig,
+    max_new_tokens: int,
+    max_len: int,
+    temperature: float = 0.0,
+):
+    """Multi-chip serving: generate() jitted over a (dp, fsdp, tp) mesh.
+
+    Returns (generate_fn(params, prompt, key=None) -> [B, new],
+    prompt_sharding, place_params). Parameters shard with the training
+    PartitionSpecs (fsdp over the long matmul dim, tp over heads/ff),
+    the prompt batch over (dp, fsdp); XLA's sharding propagation then
+    lays the KV cache out tp-sharded on the kv-head dim and dp-sharded
+    on batch and inserts the tp all-reduces after wo/w_down -- the same
+    single-program SPMD serving layout a hand-sharded engine would
+    build, with no collective written by hand. Requires
+    cfg.n_kv_heads % tp == 0 (GQA: each tp shard owns whole kv heads).
+
+    Reference parity: the reference driver has no serving path in-tree
+    (SURVEY.md §2.9 -- workloads bring their own); this is the
+    workload-side analog, sized by the ResourceClaim's chip count.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import TENSOR_AXIS
+
+    from .llama import batch_spec, param_specs
+
+    tp = mesh.shape.get(TENSOR_AXIS, 1)
+    if cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}")
+    param_shard = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P))
+    prompt_shard = NamedSharding(mesh, batch_spec())
+    repl = NamedSharding(mesh, P())
+
+    jitted = jax.jit(
+        partial(_generate_impl, cfg=cfg, max_new_tokens=max_new_tokens,
+                max_len=max_len, temperature=temperature),
+        in_shardings=(param_shard, prompt_shard, repl),
+        out_shardings=prompt_shard,
+    )
+
+    def generate_fn(params, prompt, key=None):
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        _check_budget(prompt.shape[1], max_new_tokens, max_len)
+        return jitted(params, prompt, key)
+
+    def place_params(params):
+        return jax.device_put(params, param_shard)
+
+    return generate_fn, prompt_shard, place_params
