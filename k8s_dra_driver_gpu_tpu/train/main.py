@@ -56,6 +56,13 @@ def run(argv: list[str] | None = None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=100)
     p.add_argument("--tp", type=int, default=None,
                    help="tensor-parallel size (default: planned)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stages (GPipe schedule over a "
+                        "(pp, dp) mesh; layers must divide evenly)")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="microbatches per optimizer step in pp mode "
+                        "(default: pp; more microbatches shrink the "
+                        "pipeline bubble)")
     p.add_argument("--data-file", default=os.environ.get("DATA_FILE", ""),
                    help="flat binary token file; synthetic data when "
                         "unset [DATA_FILE]")
@@ -76,6 +83,20 @@ def run(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     if args.steps_per_call < 1:
         p.error("--steps-per-call must be >= 1")
+    if args.pp < 1:
+        p.error("--pp must be >= 1")
+    if args.pp > 1 and args.steps_per_call > 1:
+        p.error("--steps-per-call composes with the auto-sharded trainer "
+                "only; in pp mode the microbatch scan already amortizes "
+                "dispatch (use --microbatches)")
+    if args.pp > 1 and args.tp and args.tp != 1:
+        p.error("--tp and --pp are mutually exclusive (the pp trainer "
+                "runs over a (pp, dp) mesh)")
+    if args.microbatches is not None:
+        if args.pp == 1:
+            p.error("--microbatches requires --pp > 1")
+        if args.microbatches < 1:
+            p.error("--microbatches must be >= 1")
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
@@ -107,6 +128,8 @@ def run(argv: list[str] | None = None) -> int:
         if args.steps_per_call > 1:
             p.error("--steps-per-call applies to the dense families "
                     "only (the MoE trainer is manual-SPMD)")
+        if args.pp > 1:
+            p.error("--pp applies to the dense families only")
         cfg = llama_moe.LlamaMoEConfig.tiny()
         ep = min(len(devices), cfg.n_experts)
         while ep > 1 and (len(devices) % ep or cfg.n_experts % ep):
@@ -122,7 +145,33 @@ def run(argv: list[str] | None = None) -> int:
         init_fn, step_fn, batch_shard, place = llama_moe.make_moe_train(
             mesh, cfg)
         scan_fn = scan_batch_shard = None
+        pp_m = 0
         state = init_fn(place(llama_moe.init(jax.random.PRNGKey(0), cfg)))
+    elif args.pp > 1:
+        from ..parallel.mesh import build_pipeline_mesh  # noqa: PLC0415
+        from .pp_train import make_pp_train  # noqa: PLC0415
+
+        cfg = (llama.LlamaConfig.tiny() if args.model == "tiny"
+               else llama.LlamaConfig.llama3_8b())
+        if len(devices) % args.pp:
+            p.error(f"--pp {args.pp} does not divide "
+                    f"{len(devices)} devices")
+        if cfg.n_layers % args.pp:
+            p.error(f"--pp {args.pp} does not divide "
+                    f"{cfg.n_layers} layers")
+        pp_m = (args.microbatches if args.microbatches is not None
+                else args.pp)
+        dp = len(devices) // args.pp
+        if args.batch_size % dp:
+            p.error(f"--batch-size {args.batch_size} must be divisible "
+                    f"by dp={dp} ({len(devices)} devices / pp={args.pp})")
+        mesh = build_pipeline_mesh(args.pp, devices=devices)
+        logger.info("mesh: %s microbatches=%d",
+                    dict(zip(mesh.axis_names, mesh.devices.shape)), pp_m)
+        init_fn, step_fn, batch_shard, place = make_pp_train(
+            mesh, cfg, n_microbatches=pp_m)
+        scan_fn = scan_batch_shard = None
+        state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
     else:
         mesh = build_mesh(plan_for(len(devices), tp=args.tp),
                           devices=devices)
@@ -132,6 +181,7 @@ def run(argv: list[str] | None = None) -> int:
                else llama.LlamaConfig.llama3_8b())
         init_fn, step_fn, batch_shard, place = make_sharded_train(mesh, cfg)
         scan_fn = scan_batch_shard = None
+        pp_m = 0
         if args.steps_per_call > 1:
             from .train import make_scanned_sharded_train  # noqa: PLC0415
 
@@ -199,8 +249,9 @@ def run(argv: list[str] | None = None) -> int:
 
     start_step = int(state.step)
     t0 = time.perf_counter()
-    # Global tokens per step (all gang members), matching both modes.
-    tokens_per_step = global_batch * args.seq_len
+    # Global tokens per step (all gang members), matching both modes;
+    # a pp optimizer step consumes M microbatches of the global batch.
+    tokens_per_step = global_batch * args.seq_len * (pp_m or 1)
     tracing = False
 
     def scan_batch_for(step: int, k: int):
@@ -209,6 +260,15 @@ def run(argv: list[str] | None = None) -> int:
         stacked = _np.stack([local_batch(step + i) for i in range(k)])
         return jax.make_array_from_process_local_data(
             scan_batch_shard, stacked)
+
+    def pp_batch_for(step: int):
+        # M distinct microbatches per optimizer step, deterministically
+        # keyed so resume replays the same stream.
+        import numpy as _np  # noqa: PLC0415
+
+        stacked = _np.stack(
+            [local_batch(step * pp_m + i) for i in range(pp_m)])
+        return jax.make_array_from_process_local_data(batch_shard, stacked)
 
     step = start_step
     first_timed = None  # first step boundary after the compile call
@@ -224,7 +284,10 @@ def run(argv: list[str] | None = None) -> int:
         # (and the per-step path) use the unscanned step_fn. Step
         # semantics are identical -- same batches per step, same order.
         k = args.steps_per_call
-        if scan_fn is not None and step + k <= args.steps:
+        if pp_m:
+            state, loss = step_fn(state, pp_batch_for(step))
+            step += 1
+        elif scan_fn is not None and step + k <= args.steps:
             state, losses = scan_fn(state, scan_batch_for(step, k))
             loss = losses[-1]
             step += k

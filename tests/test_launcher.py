@@ -102,3 +102,30 @@ class TestMultisliceMesh:
     def test_indivisible_rejected(self):
         with pytest.raises(ValueError):
             build_multislice_mesh(3)
+
+
+class TestLauncherPipeline:
+    def test_pp_run(self, caplog):
+        import logging
+
+        caplog.set_level(logging.INFO)
+        # pp=2 x dp=4 on the 8-device CPU mesh, 2 microbatches/step.
+        assert run(["--model", "tiny", "--steps", "3", "--pp", "2",
+                    "--microbatches", "2", "--batch-size", "4",
+                    "--seq-len", "16"]) == 0
+        assert any("'pp'" in r.message for r in caplog.records
+                   if "mesh" in r.message)
+        assert any("loss" in r.message for r in caplog.records)
+
+    @pytest.mark.parametrize("argv", [
+        ["--pp", "2", "--steps-per-call", "4"],
+        ["--pp", "2", "--tp", "2"],
+        ["--microbatches", "4"],
+        ["--pp", "0"],
+        ["--pp", "2", "--microbatches", "0"],
+        ["--pp", "2", "--batch-size", "6"],
+        ["--model", "moe-tiny", "--pp", "2"],
+    ])
+    def test_flag_validation(self, argv):
+        with pytest.raises(SystemExit):
+            run(argv)
