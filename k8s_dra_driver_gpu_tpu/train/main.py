@@ -114,6 +114,10 @@ def run(argv: list[str] | None = None) -> int:
     devices = jax.devices()
     logger.info("devices: %d x %s", len(devices), devices[0].platform)
 
+    def dense_cfg():
+        return (llama.LlamaConfig.tiny() if args.model == "tiny"
+                else llama.LlamaConfig.llama3_8b())
+
     if args.model == "moe-tiny":
         # Expert-parallel family: a (dp, ep) mesh; ep takes as many
         # devices as divide both the device count and the expert count.
@@ -151,8 +155,13 @@ def run(argv: list[str] | None = None) -> int:
         from ..parallel.mesh import build_pipeline_mesh  # noqa: PLC0415
         from .pp_train import make_pp_train  # noqa: PLC0415
 
-        cfg = (llama.LlamaConfig.tiny() if args.model == "tiny"
-               else llama.LlamaConfig.llama3_8b())
+        cfg = dense_cfg()
+        if int(os.environ.get("TPU_NUM_PROCESSES", "1")) > 1:
+            # The pp batch replicates over the pp axis; per-process
+            # local batches would make gang members disagreeing
+            # "replicas" (silently wrong grads). Single-host only
+            # until the batch shards over pp too.
+            p.error("--pp does not support multi-host gangs yet")
         if len(devices) % args.pp:
             p.error(f"--pp {args.pp} does not divide "
                     f"{len(devices)} devices")
@@ -177,8 +186,7 @@ def run(argv: list[str] | None = None) -> int:
                           devices=devices)
         logger.info("mesh: %s", dict(zip(mesh.axis_names,
                                          mesh.devices.shape)))
-        cfg = (llama.LlamaConfig.tiny() if args.model == "tiny"
-               else llama.LlamaConfig.llama3_8b())
+        cfg = dense_cfg()
         init_fn, step_fn, batch_shard, place = make_sharded_train(mesh, cfg)
         scan_fn = scan_batch_shard = None
         pp_m = 0

@@ -193,6 +193,18 @@ class DemoCluster:
         return self.kube.read_raw(
             f"/api/v1/namespaces/{ns}/pods/{name}/log")
 
+    def wait_job(self, ns: str, job: str, pod: str, timeout=300):
+        def done():
+            j = self.kube.get("batch", "v1", "jobs", job, namespace=ns)
+            if j.get("status", {}).get("succeeded"):
+                return j
+            if j.get("status", {}).get("failed"):
+                raise AssertionError(
+                    f"job {job} failed: " + self.pod_log(ns, pod)
+                    + self.dump_logs())
+            return None
+        return wait_for(done, timeout=timeout, desc=f"{job} job")
+
     def wait_pods(self, ns: str, names: list[str], timeout=300):
         def done():
             phases = {n: self.pod_phase(ns, n) for n in names}
@@ -248,18 +260,7 @@ class TestDemoSpecs:
 
     def test_tpu_test3_whole_host_jax_sees_4(self, demo):
         demo.apply_spec(os.path.join(SPECS, "tpu-test3.yaml"))
-
-        def job_done():
-            job = demo.kube.get("batch", "v1", "jobs", "jax-4chip",
-                                namespace="tpu-test3")
-            if job.get("status", {}).get("succeeded"):
-                return job
-            if job.get("status", {}).get("failed"):
-                raise AssertionError(
-                    "job failed: " + demo.pod_log(
-                        "tpu-test3", "jax-4chip-0") + demo.dump_logs())
-            return None
-        wait_for(job_done, timeout=300, desc="jax-4chip job")
+        demo.wait_job("tpu-test3", "jax-4chip", "jax-4chip-0")
         assert "devices:" in demo.pod_log("tpu-test3", "jax-4chip-0")
 
     def test_tpu_test4_skips_like_reference_mnnvl(self):
@@ -281,3 +282,12 @@ class TestDemoSpecs:
         demo.wait_pods("tpu-test6", ["tenant-a", "tenant-b"])
         assert "HBM cap:" in demo.pod_log("tpu-test6", "tenant-a")
         assert "dir:" in demo.pod_log("tpu-test6", "tenant-b")
+
+    def test_tpu_test7_pipeline_training(self, demo):
+        demo.apply_spec(os.path.join(SPECS, "tpu-test7.yaml"))
+        demo.wait_job("tpu-test7", "pp-train", "pp-train-0")
+        log = demo.pod_log("tpu-test7", "pp-train-0")
+        # The launcher built the (pp, dp) mesh from the claim's 4 chips
+        # and trained through the GPipe schedule.
+        assert "'pp': 2" in log and "'dp': 2" in log
+        assert "step 2 loss" in log

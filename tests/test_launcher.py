@@ -129,3 +129,11 @@ class TestLauncherPipeline:
     def test_flag_validation(self, argv):
         with pytest.raises(SystemExit):
             run(argv)
+
+    def test_pp_rejects_multihost_gang(self, monkeypatch):
+        # The pp batch replicates over the pp axis; distinct per-process
+        # local batches would silently corrupt training (see main.py).
+        monkeypatch.setenv("TPU_NUM_PROCESSES", "2")
+        monkeypatch.setenv("TPU_COORDINATOR_ADDRESS", "")
+        with pytest.raises(SystemExit):
+            run(["--model", "tiny", "--pp", "2", "--steps", "1"])
