@@ -97,6 +97,13 @@ def run(argv: list[str] | None = None) -> int:
             p.error("--microbatches requires --pp > 1")
         if args.microbatches < 1:
             p.error("--microbatches must be >= 1")
+    if args.pp > 1 and int(os.environ.get("TPU_NUM_PROCESSES", "1")) > 1:
+        # The pp batch replicates over the pp axis; per-process local
+        # batches would make gang members disagreeing "replicas"
+        # (silently wrong grads). Single-host only until the batch
+        # shards over pp too. Checked BEFORE the distributed rendezvous
+        # so the misconfiguration fails fast.
+        p.error("--pp does not support multi-host gangs yet")
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
@@ -156,12 +163,6 @@ def run(argv: list[str] | None = None) -> int:
         from .pp_train import make_pp_train  # noqa: PLC0415
 
         cfg = dense_cfg()
-        if int(os.environ.get("TPU_NUM_PROCESSES", "1")) > 1:
-            # The pp batch replicates over the pp axis; per-process
-            # local batches would make gang members disagreeing
-            # "replicas" (silently wrong grads). Single-host only
-            # until the batch shards over pp too.
-            p.error("--pp does not support multi-host gangs yet")
         if len(devices) % args.pp:
             p.error(f"--pp {args.pp} does not divide "
                     f"{len(devices)} devices")

@@ -3,9 +3,10 @@ chart-driven up/downgrade over a live checkpoint.
 
 Reference analogs: tests/bats/test_cd_logging.bats (verbosity levels
 emit/omit the documented lines), test_gpu_stress.bats (shared claims
-churned across many pods, repeated), test_gpu_up_downgrade.bats (old
-release -> new release over live state). All drive the REAL binaries
-as subprocesses against the fake apiserver + fake kubelet.
+churned across many pods, repeated), test_gpu_up_downgrade.bats and
+test_cd_up_downgrade.bats (old release <-> new release over live
+state). All drive the REAL binaries as subprocesses against the fake
+apiserver + fake kubelet.
 """
 
 import os
@@ -357,3 +358,69 @@ class TestChartDrivenUpDowngrade:
                 stop(new, new_log)
         finally:
             api.stop()
+
+
+class TestCdUpDowngrade:
+    """test_cd_up_downgrade.bats role: a live channel claim survives
+    both rollout directions. Downgrade: the current release's v2
+    checkpoint carries a v1 checksum an old reader verifies over its
+    own projection of the payload. Upgrade: a v1-schema file written by
+    an old release is ADOPTED by the current binary -- the live claim
+    still guards its channel against double-allocation and unprepares
+    cleanly, and the next write migrates the file back to v2."""
+
+    def _run(self, root, uid, action):
+        return subprocess.run(
+            [sys.executable, "-m", "tests.cd_prepare_helper",
+             str(root), uid, action],
+            env=ENV, capture_output=True, text=True, timeout=120,
+            cwd=REPO,
+        )
+
+    def test_channel_claim_survives_both_directions(self, tmp_path):
+        import json
+
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import (
+            Checkpoint,
+            _checksum,
+        )
+
+        root = tmp_path / "root"
+        assert self._run(root, "cd-ud-1", "prepare").returncode == 0
+        cp_path = root / "checkpoint.json"
+        doc = json.loads(cp_path.read_text())
+        assert doc["version"] == "v2"
+        assert set(doc["checksums"]) == {"v1", "v2"}
+
+        # Downgrade leg: an old (v1) reader recomputes checksums["v1"]
+        # over its projection -- it must match, or the old release
+        # would refuse the file as corrupt mid-rollback.
+        cp = Checkpoint.from_dict(doc)
+        v1_payload = cp._payload_v1()
+        assert _checksum(v1_payload) == doc["checksums"]["v1"]
+        assert "cd-ud-1" in v1_payload["claims"]
+
+        # ... and the old release rewrites the file in its own schema.
+        cp_path.write_text(json.dumps({
+            "version": "v1",
+            "data": v1_payload,
+            "checksums": {"v1": doc["checksums"]["v1"]},
+        }))
+
+        # Upgrade leg: the current binary adopts the v1 file. Proof of
+        # adoption (not silent invalidation): the live claim still
+        # holds channel-0, so a second claim must hit the
+        # double-allocation guard.
+        clash = self._run(root, "cd-ud-2", "prepare")
+        assert clash.returncode != 0, clash.stdout
+        assert "alloc" in (clash.stdout + clash.stderr).lower()
+
+        done = self._run(root, "cd-ud-1", "unprepare")
+        assert done.returncode == 0, done.stdout + done.stderr
+        doc2 = json.loads(cp_path.read_text())
+        assert doc2["version"] == "v2"  # migrated forward on write
+        assert "cd-ud-1" not in json.dumps(doc2)
+
+        # The channel is reusable after the adopted unprepare.
+        again = self._run(root, "cd-ud-2", "prepare")
+        assert again.returncode == 0, again.stdout + again.stderr
