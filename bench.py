@@ -290,10 +290,11 @@ def bench_model_step_pipelined() -> dict | None:
 
     # Tuned point from the round-3 sweep (docs/benchmarks.md): batch up
     # to the arithmetic-intensity knee, shorter sequence to shrink the
-    # non-matmul share, FULL remat kept -- on this HBM-bound size
-    # recomputing the layer body is cheaper than writing activations
-    # out ("dots" measured 0.396 vs full's 0.427 at B=16/S=1024).
-    B, S, K = 64, 512, 8
+    # non-matmul share, K=16 for deeper sync amortization, FULL remat
+    # required -- at this size "dots"/"none" fail to compile (HBM OOM),
+    # and at B=16/S=1024 where they fit they are also slower ("dots"
+    # 0.396 vs full's 0.427).
+    B, S, K = 64, 512, 16
     cfg = _bench_model_cfg()
     params = llama.init(jax.random.PRNGKey(0), cfg)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
