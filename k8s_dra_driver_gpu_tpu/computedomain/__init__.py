@@ -17,7 +17,14 @@ DAEMON_DEVICE_CLASS = "compute-domain-daemon.tpu.dra.dev"
 NODE_LABEL = "resource.tpu.dra/computeDomain"
 CLIQUE_POD_LABEL = "resource.tpu.dra/cliqueId"
 FINALIZER = "resource.tpu.dra/computedomain-finalizer"
-DOMAIN_DAEMON_PORT = 7077  # JAX coordination service port
+DOMAIN_DAEMON_PORT = 7077  # daemon rendezvous service (STATUS/MEMBERS)
+# The JAX distributed-runtime coordinator. DISTINCT from the rendezvous
+# port: the coordinator is BOUND BY WORKLOAD PROCESS 0 (jax.distributed
+# semantics), while the rendezvous service is bound by the daemon. Both
+# ride the same host network (TPU pods run hostNetwork, daemon and
+# worker 0 share the node), so one address works for both -- but each
+# needs its own port. 8476 is jax.distributed's conventional default.
+JAX_COORDINATOR_PORT = 8476
 API_GROUP = "resource.tpu.dra"
 API_VERSION = "v1beta1"
 

@@ -51,6 +51,16 @@ def build_daemon_daemonset(cd: dict, namespace: str) -> dict:
                 "metadata": {"labels": {NODE_LABEL: uid}},
                 "spec": {
                     "nodeSelector": {NODE_LABEL: uid},
+                    # Host network: the daemon registers the NODE's
+                    # address, and the TPU_COORDINATOR_ADDRESS handed
+                    # to workloads must be bindable by workload process
+                    # 0 on that same node (TPU workload pods run
+                    # hostNetwork; jax.distributed's coordinator is
+                    # bound by process 0, not by this daemon). Without
+                    # this the registered IP would be pod-netns-local
+                    # and the gang could never rendezvous.
+                    "hostNetwork": True,
+                    "dnsPolicy": "ClusterFirstWithHostNet",
                     "containers": [
                         {
                             "name": "compute-domain-daemon",

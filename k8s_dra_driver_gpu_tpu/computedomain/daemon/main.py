@@ -57,6 +57,13 @@ class DaemonConfig:
         self.state_dir = env.get("DOMAIN_STATE_DIR", "/var/run/tpu-domain")
         self.hosts_file = env.get("HOSTS_FILE", "/etc/hosts")
         self.port = int(env.get("COORDINATION_PORT", str(DOMAIN_DAEMON_PORT)))
+        # The JAX coordinator port advertised in bootstrap.json; bound
+        # by workload process 0, not by this daemon (see
+        # computedomain.JAX_COORDINATOR_PORT).
+        from .. import JAX_COORDINATOR_PORT  # noqa: PLC0415
+
+        self.jax_port = int(
+            env.get("JAX_COORDINATOR_PORT", str(JAX_COORDINATOR_PORT)))
         # Bind/probe address for the coordination service. Default: bind
         # all interfaces, probe loopback (one daemon per host). Set to
         # the pod IP when several daemons share one network namespace
@@ -164,15 +171,23 @@ class Daemon:
             json.dump(doc, f, indent=1)
         os.replace(tmp, self.members_file)
 
-    def _write_bootstrap(self, members: list[dict], my_index: int) -> None:
-        """The JAX bootstrap contract consumed by workload pods."""
-        coordinator = f"{daemon_dns_name(0)}:{self.cfg.port}"
+    def _write_bootstrap(self, my_index: int) -> None:
+        """The JAX bootstrap contract consumed by workload pods.
+
+        workerHostnames is POSITIONAL BY PROCESS ID and always
+        num_workers long -- like the CDI env contract
+        (plugin/device_state.py:_prepare_channel), it derives from the
+        declared gang size, never from whichever subset of peers
+        happens to be registered right now: a transient 3-of-4
+        membership must not produce a 3-entry list that consumers
+        rightly reject against numProcesses=4."""
+        coordinator = f"{daemon_dns_name(0)}:{self.cfg.jax_port}"
         doc = {
             "coordinatorAddress": coordinator,
             "numProcesses": self.cfg.num_workers,
             "processId": my_index,
             "workerHostnames": [
-                daemon_dns_name(m.get("index", -1)) for m in members
+                daemon_dns_name(i) for i in range(self.cfg.num_workers)
             ],
         }
         tmp = self.bootstrap_file + ".tmp"
@@ -209,7 +224,7 @@ class Daemon:
         self._last_members = members
         self._write_members(members)
         if self.registrar.index is not None:
-            self._write_bootstrap(members, self.registrar.index)
+            self._write_bootstrap(self.registrar.index)
         try:
             update_hosts_file(self.cfg.hosts_file, dns_name_mappings(members))
         except OSError:

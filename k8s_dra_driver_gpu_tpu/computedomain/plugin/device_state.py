@@ -213,7 +213,15 @@ class CDDeviceState:
         node = self._assert_cd_ready(cd)  # raises RetryableError until ready
 
         channels = [r.device for r in claim.results]
-        port = int(os.environ.get("COORDINATION_PORT", "7077"))
+        # The JAX coordinator port -- NOT the daemon's rendezvous port:
+        # workload process 0 binds this itself (jax.distributed starts
+        # the coordination service on process 0), so it must be free on
+        # the node. The daemon's STATUS/MEMBERS service keeps its own
+        # port (COORDINATION_PORT).
+        from .. import JAX_COORDINATOR_PORT  # noqa: PLC0415
+
+        port = int(os.environ.get("JAX_COORDINATOR_PORT",
+                                  str(JAX_COORDINATOR_PORT)))
         # Coordinator by IP: workload pods have no resolver entry for the
         # daemon DNS names (those live in the daemons' own /etc/hosts), so
         # hand out the index-0 daemon's registered pod IP directly; the

@@ -225,7 +225,7 @@ def make_sharded_generate(
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from ..parallel.mesh import TENSOR_AXIS
+    from ..parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
 
     from .llama import batch_spec, param_specs
 
@@ -250,6 +250,12 @@ def make_sharded_generate(
         if key is None:
             key = jax.random.PRNGKey(0)
         _check_budget(prompt.shape[1], max_new_tokens, max_len)
+        dp = mesh.shape.get(DATA_AXIS, 1)
+        fsdp = mesh.shape.get(FSDP_AXIS, 1)
+        if prompt.shape[0] % (dp * fsdp):
+            raise ValueError(
+                f"prompt batch {prompt.shape[0]} not divisible by "
+                f"dp({dp}) * fsdp({fsdp}) = {dp * fsdp}")
         return jitted(params, prompt, key)
 
     def place_params(params):

@@ -26,22 +26,92 @@ import time
 logger = logging.getLogger(__name__)
 
 
-def initialize_distributed(env=os.environ) -> None:
-    """jax.distributed from the ComputeDomain channel env, if present."""
-    import jax
+class GangEnvError(ValueError):
+    """The injected ComputeDomain gang env is inconsistent.
 
+    Raised BEFORE touching jax.distributed: every one of these
+    misconfigurations would otherwise surface as a hang (a gang member
+    waiting for peers that never come) or a silently wrong mesh.
+    """
+
+
+def validate_gang_env(env=os.environ) -> dict | None:
+    """Check the injected env contract; None when not in a gang.
+
+    Returns {"coordinator", "process_id", "num_processes"} when the
+    pod carries a ComputeDomain channel. The contract (injected by the
+    CD plugin, plugin/device_state.py:_prepare_channel):
+      - TPU_COORDINATOR_ADDRESS implies TPU_PROCESS_ID and
+        TPU_NUM_PROCESSES (a partial contract means a broken prepare,
+        not a single-process run -- fail loudly, don't guess),
+      - TPU_WORKER_HOSTNAMES, when present, is positional by process
+        id, so its length must equal TPU_NUM_PROCESSES,
+      - 0 <= process_id < num_processes.
+    """
     coordinator = env.get("TPU_COORDINATOR_ADDRESS", "")
     if not coordinator:
-        return
+        return None
+    missing = [k for k in ("TPU_PROCESS_ID", "TPU_NUM_PROCESSES")
+               if not env.get(k)]
+    if missing:
+        raise GangEnvError(
+            f"TPU_COORDINATOR_ADDRESS is set but {', '.join(missing)} "
+            "missing: the ComputeDomain channel env is partial (broken "
+            "prepare?); refusing to guess single-process defaults")
+    try:
+        process_id = int(env["TPU_PROCESS_ID"])
+        num_processes = int(env["TPU_NUM_PROCESSES"])
+    except ValueError as e:
+        raise GangEnvError(f"non-integer gang env: {e}") from e
+    if not 0 <= process_id < num_processes:
+        raise GangEnvError(
+            f"TPU_PROCESS_ID={process_id} out of range for "
+            f"TPU_NUM_PROCESSES={num_processes}")
+    hostnames = env.get("TPU_WORKER_HOSTNAMES", "")
+    if hostnames:
+        n = len(hostnames.split(","))
+        if n != num_processes:
+            raise GangEnvError(
+                f"TPU_WORKER_HOSTNAMES lists {n} worker(s) but "
+                f"TPU_NUM_PROCESSES={num_processes}; the list is "
+                "positional by process id and must match exactly")
+    # rpartition: the host may be a bracketed IPv6 literal
+    # ("[fd00::1]:8476") -- only the LAST colon separates the port.
+    host, _, port = coordinator.rpartition(":")
+    if not host or not port.isdigit():
+        raise GangEnvError(
+            f"TPU_COORDINATOR_ADDRESS={coordinator!r} is not host:port")
+    return {
+        "coordinator": coordinator,
+        "process_id": process_id,
+        "num_processes": num_processes,
+    }
+
+
+def initialize_distributed(env=os.environ) -> bool:
+    """jax.distributed from the ComputeDomain channel env, if present.
+
+    Returns True when a gang was joined. TPU_INIT_TIMEOUT_S bounds the
+    rendezvous (default jax's 300 s) so an unreachable coordinator is a
+    clear error, not an indefinite hang.
+    """
+    import jax
+
+    gang = validate_gang_env(env)
+    if gang is None:
+        return False
+    timeout = int(env.get("TPU_INIT_TIMEOUT_S", "300"))
     jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=int(env.get("TPU_NUM_PROCESSES", "1")),
-        process_id=int(env.get("TPU_PROCESS_ID", "0")),
+        coordinator_address=gang["coordinator"],
+        num_processes=gang["num_processes"],
+        process_id=gang["process_id"],
+        initialization_timeout=timeout,
     )
     logger.info(
         "joined gang: process %s/%s via %s",
-        env.get("TPU_PROCESS_ID"), env.get("TPU_NUM_PROCESSES"), coordinator,
+        gang["process_id"], gang["num_processes"], gang["coordinator"],
     )
+    return True
 
 
 def run(argv: list[str] | None = None) -> int:

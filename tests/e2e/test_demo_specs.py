@@ -199,9 +199,12 @@ class DemoCluster:
             if j.get("status", {}).get("succeeded"):
                 return j
             if j.get("status", {}).get("failed"):
+                try:
+                    log = self.pod_log(ns, pod)
+                except Exception as e:  # pod gone / never created
+                    log = f"<pod log unavailable: {e}>"
                 raise AssertionError(
-                    f"job {job} failed: " + self.pod_log(ns, pod)
-                    + self.dump_logs())
+                    f"job {job} failed: " + log + self.dump_logs())
             return None
         return wait_for(done, timeout=timeout, desc=f"{job} job")
 

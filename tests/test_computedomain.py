@@ -332,8 +332,9 @@ class TestGangFlow:
             spec = st0._cdi.read_spec("w0")
             env = spec["containerEdits"]["env"]
             # Coordinator by registered pod IP (workloads can't resolve
-            # the daemon DNS names).
-            assert "TPU_COORDINATOR_ADDRESS=127.0.0.1:7077" in env
+            # the daemon DNS names), on the JAX coordinator port -- NOT
+            # the daemon rendezvous port (process 0 must bind it).
+            assert "TPU_COORDINATOR_ADDRESS=127.0.0.1:8476" in env
             assert "TPU_PROCESS_ID=0" in env
             assert "TPU_NUM_PROCESSES=2" in env
             # Worker addresses are registered pod IPs (workloads cannot

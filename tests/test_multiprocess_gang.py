@@ -1,0 +1,200 @@
+"""Multi-process jax.distributed gang tests: the consumption proof.
+
+The ComputeDomain stack exists so that a workload pod wakes up with the
+channel env and ``jax.distributed`` just works. These tests prove that
+END: real OS processes (not a single-process virtual mesh) rendezvous
+from the contract, form one global mesh, and compute one coherent
+result.
+
+Reference analog: tests/bats/test_cd_mnnvl_workload.bats:18-52 -- the
+reference's proof runs nvbandwidth (NCCL over the prepared IMEX
+domain) inside workload pods and asserts the collective completed.
+
+Two tiers here:
+  - TestMultiprocessDryrun drives __graft_entry__.
+    dryrun_multichip_multiprocess (2 procs x 4 CPU devices) from a
+    bootstrap.json/members.json pair written by REAL Daemon objects
+    rendezvousing over the fake kube -- the daemon's mounted-dir
+    contract, consumed exactly as a pod would.
+  - TestGangEnvNegative covers the misconfigurations a gang bug would
+    produce (partial env, mismatched hostname list, unreachable
+    coordinator): each must fail fast and loud, never hang or guess.
+
+The fake-cluster e2e (tests/e2e/test_computedomain_gang.py) closes the
+loop further out: the same verify workload runs inside fake-node pods
+whose env came from the CDI specs the CD plugin wrote.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.train.main import (
+    GangEnvError,
+    validate_gang_env,
+)
+from tests.test_computedomain import make_cd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def clean_env(**overrides) -> dict:
+    """os.environ minus the ambient gang vars (this image's
+    sitecustomize pre-sets TPU_WORKER_HOSTNAMES etc. for the real
+    chip), plus explicit overrides."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("TPU_COORDINATOR_ADDRESS", "TPU_PROCESS_ID",
+                        "TPU_NUM_PROCESSES", "TPU_WORKER_HOSTNAMES")}
+    env.update(overrides)
+    return env
+
+
+class TestGangEnvValidation:
+    def test_not_a_gang(self):
+        assert validate_gang_env(env={}) is None
+
+    def test_valid_contract(self):
+        got = validate_gang_env(env={
+            "TPU_COORDINATOR_ADDRESS": "10.0.0.1:8476",
+            "TPU_PROCESS_ID": "1",
+            "TPU_NUM_PROCESSES": "2",
+            "TPU_WORKER_HOSTNAMES": "10.0.0.1,10.0.0.2",
+        })
+        assert got == {"coordinator": "10.0.0.1:8476",
+                       "process_id": 1, "num_processes": 2}
+
+    def test_ipv6_coordinator_accepted(self):
+        got = validate_gang_env(env={
+            "TPU_COORDINATOR_ADDRESS": "[fd00::1]:8476",
+            "TPU_PROCESS_ID": "0",
+            "TPU_NUM_PROCESSES": "2",
+        })
+        assert got["coordinator"] == "[fd00::1]:8476"
+
+    @pytest.mark.parametrize("env,fragment", [
+        # Partial env: address without identity = broken prepare.
+        ({"TPU_COORDINATOR_ADDRESS": "10.0.0.1:8476"},
+         "TPU_PROCESS_ID, TPU_NUM_PROCESSES missing"),
+        ({"TPU_COORDINATOR_ADDRESS": "10.0.0.1:8476",
+          "TPU_PROCESS_ID": "0"}, "TPU_NUM_PROCESSES missing"),
+        # Positional hostname list disagreeing with the gang size.
+        ({"TPU_COORDINATOR_ADDRESS": "10.0.0.1:8476",
+          "TPU_PROCESS_ID": "0", "TPU_NUM_PROCESSES": "3",
+          "TPU_WORKER_HOSTNAMES": "a,b"},
+         r"lists 2 worker\(s\) but TPU_NUM_PROCESSES=3"),
+        # Identity out of range.
+        ({"TPU_COORDINATOR_ADDRESS": "10.0.0.1:8476",
+          "TPU_PROCESS_ID": "2", "TPU_NUM_PROCESSES": "2"},
+         "out of range"),
+        # Garbage values.
+        ({"TPU_COORDINATOR_ADDRESS": "10.0.0.1:8476",
+          "TPU_PROCESS_ID": "zero", "TPU_NUM_PROCESSES": "2"},
+         "non-integer"),
+        ({"TPU_COORDINATOR_ADDRESS": "no-port-here",
+          "TPU_PROCESS_ID": "0", "TPU_NUM_PROCESSES": "2"},
+         "not host:port"),
+    ])
+    def test_rejects_broken_contract(self, env, fragment):
+        with pytest.raises(GangEnvError, match=fragment):
+            validate_gang_env(env=env)
+
+
+class TestGangEnvNegative:
+    def test_unreachable_coordinator_fails_within_timeout(self):
+        """A non-zero process whose coordinator never answers must exit
+        with a clear error inside TPU_INIT_TIMEOUT_S -- not hang for
+        jax's 300 s default (exactly what a half-scheduled gang looks
+        like)."""
+        env = clean_env(
+            PYTHONPATH=REPO,
+            # Port 19 answers nothing useful; process id 1 connects
+            # rather than binds.
+            TPU_COORDINATOR_ADDRESS="127.0.0.1:19",
+            TPU_PROCESS_ID="1",
+            TPU_NUM_PROCESSES="2",
+            TPU_INIT_TIMEOUT_S="5",
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s_dra_driver_gpu_tpu.train.verify",
+             "--local-devices", "2", "--require-gang"],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode != 0
+        assert "DEADLINE_EXCEEDED" in proc.stdout + proc.stderr or \
+            "deadline" in (proc.stdout + proc.stderr).lower() or \
+            "timed out" in (proc.stdout + proc.stderr).lower(), (
+                proc.stdout, proc.stderr)
+
+    def test_partial_env_fails_fast(self):
+        """Address without identity fails in validation, pre-jax."""
+        env = clean_env(
+            PYTHONPATH=REPO,
+            TPU_COORDINATOR_ADDRESS="127.0.0.1:8476",
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s_dra_driver_gpu_tpu.train.verify",
+             "--local-devices", "2", "--require-gang"],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode != 0
+        assert "partial" in proc.stdout + proc.stderr, (
+            proc.stdout, proc.stderr)
+
+
+class TestMultiprocessDryrun:
+    def test_gang_from_daemon_bootstrap_file(self, tmp_path):
+        """Two REAL Daemon objects rendezvous over the fake kube and
+        write the domain dir; the 2-process gang then boots from the
+        bootstrap.json/members.json pair alone."""
+        from k8s_dra_driver_gpu_tpu.computedomain.controller.controller import (  # noqa: E501
+            ComputeDomainController,
+        )
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+        from tests.test_computedomain import make_daemon
+
+        kube = FakeKubeClient()
+        for node in ("node-0", "node-1"):
+            kube.create("", "v1", "nodes",
+                        {"kind": "Node", "metadata": {"name": node}})
+        controller = ComputeDomainController(kube)
+        try:
+            cd = make_cd(kube, topology="2x2x2")  # 2 hosts
+            controller.reconcile(cd)
+            uid = cd["metadata"]["uid"]
+            d0 = make_daemon(kube, tmp_path, uid, "node-0", "127.0.0.1",
+                             17171)
+            d1 = make_daemon(kube, tmp_path, uid, "node-1", "127.0.0.1",
+                             17172)
+            assert d0.registrar.register() == 0
+            assert d1.registrar.register() == 1
+            d0.registrar.set_status("Ready")
+            d1.registrar.set_status("Ready")
+            # Membership sync writes members.json + bootstrap.json; the
+            # coordination child isn't needed for the file contract.
+            d0.sync_once()
+            boot_file = d0.bootstrap_file
+            assert os.path.exists(boot_file)
+            with open(boot_file, encoding="utf-8") as f:
+                boot = json.load(f)
+            assert boot["numProcesses"] == 2
+            assert boot["processId"] == 0
+            # The coordinator rides the JAX port, not the daemon's
+            # rendezvous port.
+            assert boot["coordinatorAddress"].endswith(":8476")
+        finally:
+            d0.process.stop()
+            d1.process.stop()
+            controller.queue.shutdown(wait=False)
+
+        sys.path.insert(0, REPO)
+        try:
+            import __graft_entry__ as graft
+        finally:
+            sys.path.pop(0)
+        reports = graft.dryrun_multichip_multiprocess(
+            local_devices=4, bootstrap_file=boot_file)
+        assert {r["processId"] for r in reports} == {0, 1}
+        assert all(r["globalDevices"] == 8 for r in reports)
