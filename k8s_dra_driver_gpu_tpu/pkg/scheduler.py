@@ -111,6 +111,12 @@ class _CounterLedger:
             if key in self._avail and name in self._avail[key]:
                 self._avail[key][name] -= milli
 
+    def credit(self, driver: str, pool: str, consumes: list[dict]):
+        """Undo a debit (the backtracking allocator un-picks devices)."""
+        for key, name, milli in self._iter_demand(driver, pool, consumes):
+            if key in self._avail and name in self._avail[key]:
+                self._avail[key][name] += milli
+
 
 class _Candidate:
     __slots__ = ("driver", "pool", "node", "device")
@@ -128,6 +134,10 @@ class _Candidate:
     @property
     def key(self):
         return (self.driver, self.pool, self.name)
+
+
+class _FitBudgetExceeded(Exception):
+    """The bounded constraint DFS ran out of states (see MAX_FIT_STEPS)."""
 
 
 def _tolerates(taint: dict, tolerations: list[dict]) -> bool:
@@ -354,18 +364,53 @@ class DraScheduler:
             return alloc
         return None
 
+    # DFS budget for the constraint-aware fit: a claim that cannot be
+    # decided within this many visited states is treated as unsatisfiable
+    # on the node (and logged). Topology claims are tiny (a handful of
+    # requests over tens of devices); the bound only guards pathological
+    # specs.
+    MAX_FIT_STEPS = 20_000
+
+    @staticmethod
+    def _attr_value(cand: _Candidate, attr: str):
+        """Typed attribute value as a comparable (type, value) tuple, or
+        None when the device does not carry the attribute. ``attr`` may
+        be plain ("iciY") or driver-qualified ("tpu.dra.dev/iciY") --
+        a driver's own attributes are implicitly qualified by its name
+        (upstream structured-parameters semantics)."""
+        attrs = cand.device.get("attributes") or {}
+        entry = attrs.get(attr)
+        if entry is None and "/" in attr:
+            domain, _, base = attr.partition("/")
+            if domain == cand.driver:
+                entry = attrs.get(base)
+        if not isinstance(entry, dict):
+            return None
+        for kind in ("string", "int", "bool", "version"):
+            if kind in entry:
+                return (kind, entry[kind])
+        return None
+
     def _fit_on_node(self, claim, node, candidates, ledger, allocated,
                      classes):
         """All requests of one claim against one node; returns
         [(request, candidate, class_name)] or None. Counter fits are
         checked against a tentative ledger so multi-device claims can't
-        double-spend."""
-        tentative: list[tuple[str, _Candidate, str]] = []
-        taken: set[tuple] = set()
-        spent = _CounterLedger()
-        spent._avail = {k: dict(v) for k, v in ledger._avail.items()}
-        for req in claim.get("spec", {}).get("devices", {}).get(
-                "requests", []):
+        double-spend.
+
+        ``spec.devices.constraints[].matchAttribute`` (KEP-4381): every
+        device allocated for the constraint's requests (all requests
+        when the list is empty) must carry the SAME value for the named
+        attribute; a device lacking the attribute never satisfies it.
+        For a TPU driver this is THE topology primitive -- e.g.
+        matchAttribute on iciY+iciZ pins a multi-chip claim to one ICI
+        ring. Choices interact across requests, so the fit backtracks
+        (bounded DFS) instead of picking greedily: the first candidate's
+        attribute value must not doom an otherwise-satisfiable claim.
+        """
+        spec = claim.get("spec", {}).get("devices", {})
+        reqs = []
+        for req in spec.get("requests", []):
             exactly = req.get("exactly") or req  # v1 nests under exactly
             class_name = exactly.get("deviceClassName", "")
             cls = classes.get(class_name)
@@ -373,31 +418,125 @@ class DraScheduler:
                 return None
             selectors = list(cls.get("spec", {}).get("selectors") or [])
             selectors += list(exactly.get("selectors") or [])
-            tolerations = list(exactly.get("tolerations") or [])
             mode = exactly.get("allocationMode", "ExactCount")
-            want = int(exactly.get("count", 1)) if mode != "All" else None
-            got = 0
-            for cand in candidates:
-                if cand.node != node or cand.key in allocated or \
-                        cand.key in taken:
-                    continue
-                if not self._device_matches(cand, selectors, tolerations):
-                    continue
-                if not spent.fits(cand.driver, cand.pool,
-                                  cand.device.get("consumesCounters")):
-                    continue
-                spent.debit(cand.driver, cand.pool,
-                            cand.device.get("consumesCounters"))
-                taken.add(cand.key)
-                tentative.append((req.get("name", "r"), cand, class_name))
-                got += 1
-                if want is not None and got >= want:
-                    break
-            if want is not None and got < want:
+            reqs.append({
+                "name": req.get("name", "r"),
+                "class": class_name,
+                "want": (int(exactly.get("count", 1))
+                         if mode != "All" else None),
+                "cands": [
+                    cand for cand in candidates
+                    if cand.node == node and cand.key not in allocated
+                    and self._device_matches(
+                        cand, selectors,
+                        list(exactly.get("tolerations") or []))
+                ],
+            })
+        constraints = []
+        for c in spec.get("constraints") or []:
+            attr = c.get("matchAttribute")
+            if not attr:
+                # Unknown constraint type: fail closed like the upstream
+                # allocator (an unenforceable constraint must not be
+                # silently dropped).
                 return None
-            if want is None and got == 0:
-                return None  # All-mode with nothing to allocate
-        return tentative
+            constraints.append({
+                "requests": set(c.get("requests") or []) or None,
+                "attr": attr,
+            })
+
+        spent = _CounterLedger()
+        spent._avail = {k: dict(v) for k, v in ledger._avail.items()}
+        cvals: list = [None] * len(constraints)
+        state = {"steps": 0}
+
+        def applies(ci, req_name):
+            want = constraints[ci]["requests"]
+            return want is None or req_name in want
+
+        def try_pick(req, cand, taken):
+            """Constraint+counter check for one candidate; returns an
+            undo closure or None."""
+            consumes = cand.device.get("consumesCounters")
+            if not spent.fits(cand.driver, cand.pool, consumes):
+                return None
+            set_cis = []
+            for ci, c in enumerate(constraints):
+                if not applies(ci, req["name"]):
+                    continue
+                val = self._attr_value(cand, c["attr"])
+                if val is None:
+                    return None  # attribute absent: never satisfiable
+                if cvals[ci] is None:
+                    set_cis.append(ci)
+                elif cvals[ci] != val:
+                    return None
+            for ci, c in enumerate(constraints):
+                if ci in set_cis:
+                    cvals[ci] = self._attr_value(cand, c["attr"])
+            spent.debit(cand.driver, cand.pool, consumes)
+            taken.add(cand.key)
+
+            def undo():
+                taken.discard(cand.key)
+                spent.credit(cand.driver, cand.pool, consumes)
+                for ci in set_cis:
+                    cvals[ci] = None
+            return undo
+
+        def fit(ri, slot_start, got, taken):
+            state["steps"] += 1
+            if state["steps"] > self.MAX_FIT_STEPS:
+                raise _FitBudgetExceeded
+            if ri == len(reqs):
+                return []
+            req = reqs[ri]
+            if req["want"] is None:
+                # All-mode: every eligible device, and every one must
+                # satisfy the constraints (no subsetting).
+                picks, undos = [], []
+                for cand in req["cands"]:
+                    if cand.key in taken:
+                        continue
+                    undo = try_pick(req, cand, taken)
+                    if undo is None:
+                        for u in reversed(undos):
+                            u()
+                        return None
+                    undos.append(undo)
+                    picks.append((req["name"], cand, req["class"]))
+                if not picks:
+                    return None
+                rest = fit(ri + 1, 0, 0, taken)
+                if rest is None:
+                    for u in reversed(undos):
+                        u()
+                    return None
+                return picks + rest
+            if got == req["want"]:
+                return fit(ri + 1, 0, 0, taken)
+            for i in range(slot_start, len(req["cands"])):
+                cand = req["cands"][i]
+                if cand.key in taken:
+                    continue
+                undo = try_pick(req, cand, taken)
+                if undo is None:
+                    continue
+                rest = fit(ri, i + 1, got + 1, taken)
+                if rest is not None:
+                    return [(req["name"], cand, req["class"])] + rest
+                undo()
+            return None
+
+        try:
+            return fit(0, 0, 0, set())
+        except _FitBudgetExceeded:
+            logger.warning(
+                "claim %s/%s: constraint fit exceeded %d states on node "
+                "%s; treating as unsatisfiable there",
+                _meta(claim).get("namespace", "default"),
+                _meta(claim).get("name", "?"), self.MAX_FIT_STEPS, node)
+            return None
 
     def _claim_pins(self) -> dict[tuple[str, str], str]:
         """(namespace, claim name) -> node, for claims whose consumer

@@ -185,18 +185,20 @@ def chip_pod(namespace: str, name: str, claim_source: dict,
 
 def claim_template(namespace: str, name: str,
                    device_class: str = "tpu.dra.dev",
-                   cel: str | None = None, count: int = 1) -> dict:
+                   cel: str | None = None, count: int = 1,
+                   match_attribute: str | None = None) -> dict:
     # resource.k8s.io/v1 nests the request spec under "exactly".
     exactly: dict = {"deviceClassName": device_class}
     if count != 1:
         exactly["count"] = count
     if cel:
         exactly["selectors"] = [{"cel": {"expression": cel}}]
+    devices: dict = {"requests": [{"name": "tpu", "exactly": exactly}]}
+    if match_attribute:
+        devices["constraints"] = [{"matchAttribute": match_attribute}]
     return {
         "apiVersion": "resource.k8s.io/v1",
         "kind": "ResourceClaimTemplate",
         "metadata": {"name": name, "namespace": namespace},
-        "spec": {"spec": {"devices": {"requests": [
-            {"name": "tpu", "exactly": exactly},
-        ]}}},
+        "spec": {"spec": {"devices": devices}},
     }

@@ -83,6 +83,53 @@ class TestAllocation:
             desc="both sharers succeed",
         )
 
+    def test_match_attribute_lands_topology_aligned(self, kube, namespace,
+                                                    chip_slice):
+        """constraints.matchAttribute on an ICI coordinate: the 2-chip
+        claim must land on one ICI row of the v5e-4 grid (KEP-4381
+        structured-parameters constraint; SURVEY §5 topology
+        selection)."""
+        apply(kube, claim_template(
+            namespace, "ici-row", count=2,
+            match_attribute="tpu.dra.dev/iciY"))
+        apply(kube, chip_pod(namespace, "aligned", {
+            "resourceClaimTemplateName": "ici-row"}))
+        wait_for(lambda: pod_phase(kube, "aligned", namespace)
+                 == "Succeeded", desc="topology-aligned pod")
+        # The allocated chips really share the constrained coordinate.
+        coords = {d["name"]: d["attributes"]["iciY"]
+                  for d in chip_slice["spec"]["devices"]
+                  if "iciY" in d.get("attributes", {})}
+        claims = kube.list("resource.k8s.io", "v1", "resourceclaims",
+                           namespace=namespace)
+        claim = next(c for c in claims
+                     if c["metadata"]["name"].startswith("aligned-tpu"))
+        got = [r["device"] for r in
+               claim["status"]["allocation"]["devices"]["results"]]
+        assert len(got) == 2
+        ys = {json.dumps(coords[d], sort_keys=True) for d in got}
+        assert len(ys) == 1, f"chips {got} span ICI rows {ys}"
+
+    def test_match_attribute_unalignable_stays_pending(self, kube,
+                                                       namespace):
+        """3 chips on one iciY row cannot exist in the 2x2 grid: the
+        claim must stay Pending rather than mis-allocate."""
+        apply(kube, claim_template(
+            namespace, "ici-impossible", count=3,
+            match_attribute="tpu.dra.dev/iciY"))
+        apply(kube, chip_pod(namespace, "unalignable", {
+            "resourceClaimTemplateName": "ici-impossible"}))
+        import time
+
+        time.sleep(20)
+        assert pod_phase(kube, "unalignable", namespace) in ("Pending", "")
+        claims = kube.list("resource.k8s.io", "v1", "resourceclaims",
+                           namespace=namespace)
+        stuck = [c for c in claims
+                 if c["metadata"]["name"].startswith("unalignable-tpu")]
+        assert stuck and all(
+            not c.get("status", {}).get("allocation") for c in stuck)
+
     def test_unsatisfiable_selector_stays_pending(self, kube, namespace):
         apply(kube, claim_template(
             namespace, "never",

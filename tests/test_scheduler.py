@@ -2,6 +2,7 @@
 (the driver's own publication path) and the REAL chart DeviceClasses,
 claim generation from templates, binding, counters, and taints."""
 
+import json as _json
 import os
 
 import pytest
@@ -15,6 +16,10 @@ from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHART = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
 RES = ("resource.k8s.io", "v1")
+
+
+def json_dumps(v):
+    return _json.dumps(v, sort_keys=True)
 
 
 def apply_device_classes(kube):
@@ -269,3 +274,129 @@ class TestClaimGenerationAndBinding:
             sched.sync_once()
         pod = kube.get("", "v1", "pods", "stuck", "default")
         assert not pod["spec"].get("nodeName")
+
+
+class TestMatchAttribute:
+    """spec.devices.constraints[].matchAttribute (KEP-4381): the
+    topology primitive -- all devices of the constrained requests must
+    share the attribute value. Mock v5e-4 grid: chips at
+    (iciX, iciY) = (0,0),(1,0),(0,1),(1,1)."""
+
+    @staticmethod
+    def constrained_claim(kube, name, *, count, attr,
+                          requests=None, ns="default"):
+        return kube.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"devices": {
+                "requests": [{"name": "tpu", "exactly": {
+                    "deviceClassName": "tpu.dra.dev", "count": count}}],
+                "constraints": [{
+                    **({"requests": requests} if requests else {}),
+                    "matchAttribute": attr,
+                }],
+            }},
+        }, namespace=ns)
+
+    def chip_attr(self, kube, device, attr):
+        for s in kube.list(*RES, "resourceslices"):
+            for dev in s["spec"]["devices"]:
+                if dev["name"] == device:
+                    return dev["attributes"][attr]
+        raise KeyError(device)
+
+    def test_aligned_pair_lands_on_one_row(self, driver, kube, sched):
+        """2 chips constrained on iciY: both allocated chips must sit
+        on the same ICI row."""
+        self.constrained_claim(kube, "row", count=2,
+                               attr="tpu.dra.dev/iciY")
+        sched.sync_once()
+        alloc = allocation(kube, "row")
+        assert alloc, "aligned claim did not allocate"
+        ys = {json_dumps(self.chip_attr(kube, r["device"], "iciY"))
+              for r in alloc["devices"]["results"]}
+        assert len(ys) == 1, f"chips span rows: {ys}"
+
+    def test_unalignable_count_stays_pending(self, driver, kube, sched):
+        """3 chips on one iciY row cannot exist in a 2x2 grid."""
+        self.constrained_claim(kube, "impossible", count=3,
+                               attr="tpu.dra.dev/iciY")
+        for _ in range(2):
+            sched.sync_once()
+        assert allocation(kube, "impossible") is None
+
+    def test_missing_attribute_stays_pending(self, driver, kube, sched):
+        self.constrained_claim(kube, "noattr", count=2,
+                               attr="tpu.dra.dev/noSuchAttr")
+        sched.sync_once()
+        assert allocation(kube, "noattr") is None
+
+    def test_unknown_constraint_type_fails_closed(self, driver, kube,
+                                                  sched):
+        kube.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "future", "namespace": "default"},
+            "spec": {"devices": {
+                "requests": [{"name": "tpu", "exactly": {
+                    "deviceClassName": "tpu.dra.dev"}}],
+                "constraints": [{"someFutureField": {"x": 1}}],
+            }},
+        }, namespace="default")
+        sched.sync_once()
+        assert allocation(kube, "future") is None
+
+    def test_backtracking_escapes_greedy_trap(self, kube, sched):
+        """First candidate's value must not doom the claim: one 'a'
+        device sorts first, but only the two 'b' devices can satisfy
+        count=2. A greedy allocator fails this; the DFS must not."""
+        kube.create(*RES, "resourceslices", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": "trap-slice"},
+            "spec": {
+                "driver": "tpu.dra.dev",
+                "nodeName": "node-a",
+                "pool": {"name": "trap", "generation": 1,
+                         "resourceSliceCount": 1},
+                "devices": [
+                    {"name": "dev-0",
+                     "attributes": {"ring": {"string": "a"},
+                                    "type": {"string": "tpu-chip"}}},
+                    {"name": "dev-1",
+                     "attributes": {"ring": {"string": "b"},
+                                    "type": {"string": "tpu-chip"}}},
+                    {"name": "dev-2",
+                     "attributes": {"ring": {"string": "b"},
+                                    "type": {"string": "tpu-chip"}}},
+                ],
+            },
+        })
+        self.constrained_claim(kube, "trap", count=2,
+                               attr="tpu.dra.dev/ring")
+        sched.sync_once()
+        alloc = allocation(kube, "trap")
+        assert alloc, "backtracking fit failed the satisfiable claim"
+        got = {r["device"] for r in alloc["devices"]["results"]}
+        assert got == {"dev-1", "dev-2"}, got
+
+    def test_constraint_spans_requests(self, driver, kube, sched):
+        """Empty requests list = constraint over ALL requests: two
+        one-chip requests must land on the same iciX column."""
+        kube.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "pair", "namespace": "default"},
+            "spec": {"devices": {
+                "requests": [
+                    {"name": "left", "exactly": {
+                        "deviceClassName": "tpu.dra.dev"}},
+                    {"name": "right", "exactly": {
+                        "deviceClassName": "tpu.dra.dev"}},
+                ],
+                "constraints": [{"matchAttribute": "tpu.dra.dev/iciX"}],
+            }},
+        }, namespace="default")
+        sched.sync_once()
+        alloc = allocation(kube, "pair")
+        assert alloc
+        xs = {json_dumps(self.chip_attr(kube, r["device"], "iciX"))
+              for r in alloc["devices"]["results"]}
+        assert len(xs) == 1, xs
