@@ -340,6 +340,73 @@ def bench_model_step_pipelined() -> dict | None:
     }
 
 
+def bench_model_flagship() -> dict | None:
+    """Flagship-class single-chip training point: the largest
+    flagship-shaped model (head_dim 128, GQA, 738M params --
+    LlamaConfig.flagship) that fits next to fp32 Adam on one 16 GB
+    v5e, at its tuned batch point (B=64, S=512, K=16 pipelined, full
+    remat, chunked loss, bf16 first moment). docs/benchmarks.md has
+    the sweep + the hd=128 flash-vs-einsum A/B behind the attention
+    dispatcher's FLASH_MIN_SEQ crossover."""
+    dev = _tpu_device_or_none()
+    if dev is None:
+        return None
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_dra_driver_gpu_tpu.models import llama
+    from k8s_dra_driver_gpu_tpu.train.train import (
+        make_optimizer,
+        scanned_train_step,
+        TrainState,
+    )
+
+    B, S, K = 64, 512, 16
+    cfg = llama.LlamaConfig.flagship()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    optimizer = make_optimizer(mu_dtype=jnp.bfloat16)
+    state = TrainState(params=params, opt_state=optimizer.init(params),
+                       step=jnp.zeros((), jnp.int32))
+    kind = dev.device_kind.lower().replace("tpu", "").replace(" ", "")
+    peak = next((v for k, v in PEAK_FLOPS.items() if kind.startswith(k)),
+                197e12)
+    scan_jit = jax.jit(
+        partial(scanned_train_step, cfg=cfg, optimizer=optimizer),
+        donate_argnums=(0,),
+    )
+
+    def fresh(seed):
+        t = jax.device_put(jax.random.randint(
+            jax.random.PRNGKey(seed), (K, B, S + 1), 0, cfg.vocab_size,
+            jnp.int32))
+        jax.block_until_ready(t)
+        return t
+
+    state, losses = scan_jit(state, fresh(0))  # compile + warm
+    jax.device_get(losses)
+    flops = 6.0 * n_params * B * S
+    per_step = []
+    for trial in range(1, 4):
+        toks = fresh(trial)
+        t0 = time.perf_counter()
+        state, losses = scan_jit(state, toks)
+        jax.device_get(losses)  # full sync: all K losses fetched
+        per_step.append((time.perf_counter() - t0) / K)
+    dt = statistics.median(per_step)
+    mfu = flops / dt / peak
+    if mfu > 0.9:
+        return None  # tunnel elision: distrust
+    return {
+        "mfu_flagship": round(mfu, 4),
+        "flagship_step_ms": round(dt * 1000, 1),
+        "flagship_tokens_per_s": round(B * S / dt),
+        "flagship_params_m": round(n_params / 1e6, 1),
+    }
+
+
 def bench_decode(budget_left=None) -> dict | None:
     """KV-cache decode throughput on real TPU; None off-hardware. The
     whole generate() loop is one compiled lax.scan; the warm-up call
@@ -528,6 +595,13 @@ def main() -> None:
             pipelined = bench_model_step_pipelined()
             if pipelined:
                 extras.update(pipelined)
+    except Exception:  # noqa: BLE001 - secondary metric must not kill bench
+        pass
+    try:
+        if budget_left():
+            flagship = bench_model_flagship()
+            if flagship:
+                extras.update(flagship)
     except Exception:  # noqa: BLE001 - secondary metric must not kill bench
         pass
     try:

@@ -38,6 +38,11 @@ class LlamaConfig:
     dtype: jnp.dtype = jnp.bfloat16
     # "auto": pallas flash attention on TPU, einsum elsewhere.
     attn_impl: str = "auto"
+    # Training-loss chunking: >0 computes the cross-entropy over
+    # loss_chunk-position chunks of the sequence without materializing
+    # the [B, S, V] logits (ops/xent.py) -- at flagship shapes that
+    # buffer dominates HBM. 0 = dense loss. Must divide the train S.
+    loss_chunk: int = 0
     # Rematerialization of the layer body in the backward pass:
     # "full" recomputes everything (long sequences / big models fit
     # HBM at ~+2 forward-FLOPs per 6 counted), "dots" saves matmul
@@ -53,6 +58,26 @@ class LlamaConfig:
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
         return LlamaConfig()
+
+    @staticmethod
+    def flagship() -> "LlamaConfig":
+        """The flagship single-chip training config: the largest
+        flagship-SHAPED model (head_dim 128, 2:1 GQA, SwiGLU ratio 3)
+        that trains with fp32 Adam state on one 16 GB v5e chip --
+        738M params, 12 layers, d_model 2048. Chunked loss (the
+        [B,S,V] logits never materialize) is what makes it fit at the
+        MFU-optimal batch; pair with
+        ``make_optimizer(mu_dtype=jnp.bfloat16)``. Tuned point and
+        sweep: docs/benchmarks.md flagship section."""
+        return LlamaConfig(
+            vocab_size=32_768,
+            d_model=2048,
+            n_layers=12,
+            n_heads=16,
+            n_kv_heads=8,
+            d_ff=6144,
+            loss_chunk=128,
+        )
 
     @staticmethod
     def tiny() -> "LlamaConfig":
@@ -198,9 +223,15 @@ def apply_remat(body, remat: str):
     raise ValueError(f"unknown remat policy {remat!r}")
 
 
-def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
-            attn_fn=None, positions: jax.Array | None = None) -> jax.Array:
-    """Token ids [B, S] -> logits [B, S, V] (fp32 logits).
+def forward_hidden(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+                   attn_fn=None,
+                   positions: jax.Array | None = None) -> jax.Array:
+    """Token ids [B, S] -> final-normed hidden states [B, S, D].
+
+    The lm_head projection is split out so the training loss can run
+    it CHUNKED (ops/xent.chunked_cross_entropy) without ever
+    materializing [B, S, V] logits; ``forward`` composes the two for
+    callers that want dense logits.
 
     ``positions`` overrides the rope positions ([1, S] or [B, S]) -- a
     sequence-parallel caller passes each shard's GLOBAL offsets so rope
@@ -218,5 +249,11 @@ def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
         _layer(cfg, carry, lp, positions, attn_fn), None)
     x, _ = jax.lax.scan(apply_remat(body, cfg.remat), x, params["layers"])
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig,
+            attn_fn=None, positions: jax.Array | None = None) -> jax.Array:
+    """Token ids [B, S] -> logits [B, S, V] (fp32 logits)."""
+    x = forward_hidden(params, tokens, cfg, attn_fn, positions)
     return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)

@@ -11,6 +11,16 @@ import jax
 import jax.numpy as jnp
 
 
+# Sequence length at which "auto" switches from einsum to the pallas
+# flash kernel. Measured on v5e (docs/benchmarks.md flagship A/B): XLA's
+# fused einsum outruns the flash kernel at every length where it FITS
+# (0.527 vs 0.438 MFU at S=512 on the 738M config; 0.330 vs 0.307 at
+# S=2048), but its O(B*H*S^2) fp32 score transient OOMs a 16 GB chip at
+# S=4096 even at B=4 -- where flash runs fine. Flash's role on TPU is
+# the long-context ENABLER, not a short-sequence speedup.
+FLASH_MIN_SEQ = 4096
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -18,18 +28,22 @@ def attention(
     causal: bool = True,
     impl: str = "auto",
 ) -> jax.Array:
-    """Dispatch: pallas flash attention on TPU, einsum elsewhere.
+    """Dispatch: pallas flash attention on TPU long-context shapes,
+    einsum elsewhere.
 
     impl: "auto" | "flash" | "einsum".
     """
     if impl == "auto":
         from . import is_tpu_backend  # noqa: PLC0415
 
-        # The pallas kernel wants MXU/VPU-aligned head dims (lane = 128);
-        # small-head models (tests, toy configs) take the einsum path.
+        # The pallas kernel wants MXU/VPU-aligned head dims (lane =
+        # 128); small-head models (tests, toy configs) take einsum.
+        # Aligned heads still take einsum below FLASH_MIN_SEQ -- the
+        # measured crossover, not an assumption.
         impl = (
             "flash"
             if is_tpu_backend() and q.shape[-1] % 128 == 0
+            and q.shape[1] >= FLASH_MIN_SEQ
             else "einsum"
         )
     if impl == "flash":

@@ -1,0 +1,66 @@
+"""Chunked next-token cross-entropy: the logits never materialize.
+
+The standard dense loss computes logits ``[B, S, V]`` in fp32 before
+the softmax -- at flagship shapes that one buffer is the largest
+allocation of the whole training step (B=32, S=1024, V=32k -> 4.3 GB)
+and the reason a ~1B-param model cannot fit a 16 GB chip next to its
+fp32 Adam state. TPU-first fix: scan the sequence in chunks, compute
+each chunk's logits, reduce them to per-token losses immediately, and
+``jax.checkpoint`` the chunk body so the backward pass RECOMPUTES the
+chunk logits instead of saving them. Peak logits memory drops from
+``B*S*V`` to ``B*chunk*V`` (128x smaller at chunk=8 on S=1024) for one
+extra lm_head matmul per chunk in the backward -- the classic
+flash-attention trade applied to the loss layer.
+
+The gradient w.r.t. ``lm_head`` accumulates across chunks inside the
+transposed scan; numerics match the dense loss to fp32 reduction
+order.
+
+No reference counterpart (the reference ships no training loss); this
+is framework-native perf work, measured in docs/benchmarks.md.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def chunked_cross_entropy(
+    hidden: jax.Array,
+    lm_head: jax.Array,
+    targets: jax.Array,
+    *,
+    chunk: int,
+) -> jax.Array:
+    """Mean cross-entropy of ``hidden @ lm_head`` against ``targets``.
+
+    hidden:  [B, S, D] final (normed) hidden states, compute dtype.
+    lm_head: [D, V] master weights (cast to hidden dtype for the
+             matmul, logits accumulate in fp32 -- identical to the
+             dense path's ``(x @ lm_head).astype(f32)``).
+    targets: [B, S] int token ids.
+    chunk:   sequence positions per scanned chunk; must divide S.
+    """
+    B, S, D = hidden.shape
+    if S % chunk:
+        raise ValueError(f"loss chunk {chunk} does not divide S={S}")
+    n = S // chunk
+    w = lm_head.astype(hidden.dtype)
+    # [n, B, C, D] / [n, B, C] chunked views, scanned in order.
+    hc = hidden.reshape(B, n, chunk, D).swapaxes(0, 1)
+    tc = targets.reshape(B, n, chunk).swapaxes(0, 1)
+
+    def body(acc, xt):
+        xch, tch = xt
+        logits = (xch @ w).astype(jnp.float32)  # [B, C, V]
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logits, tch[..., None], axis=-1)[..., 0]
+        return acc + (logz - picked).sum(), None
+
+    # checkpoint: the backward recomputes each chunk's logits; only the
+    # scalar carry and the [n,B,C,D] inputs (already live) are kept.
+    total, _ = jax.lax.scan(
+        jax.checkpoint(body), jnp.zeros((), jnp.float32), (hc, tc))
+    return total / (B * S)

@@ -26,17 +26,39 @@ class TrainState(NamedTuple):
     step: jax.Array
 
 
-def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:
+def make_optimizer(lr: float = 3e-4,
+                   mu_dtype=None) -> optax.GradientTransformation:
+    """AdamW with global-norm clipping.
+
+    ``mu_dtype=jnp.bfloat16`` stores the FIRST moment in bf16 (the
+    second moment and master params stay fp32) -- a standard large-model
+    memory trade that frees one 2-bytes/param buffer; on a 16 GB chip
+    it is what lets the ~0.8B flagship config train at batch sizes past
+    the HBM cliff (docs/benchmarks.md flagship section).
+    """
     return optax.chain(
         optax.clip_by_global_norm(1.0),
-        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1),
+        optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1,
+                    mu_dtype=mu_dtype),
     )
 
 
 def loss_fn(params, tokens, cfg: llama.LlamaConfig) -> jax.Array:
-    """Next-token cross-entropy over [B, S] token ids."""
-    logits = llama.forward(params, tokens[:, :-1], cfg)
+    """Next-token cross-entropy over [B, S] token ids.
+
+    cfg.loss_chunk > 0 switches to the chunked loss (ops/xent.py): the
+    [B, S, V] logits never materialize, which is what lets flagship
+    (~1B-param) configs train on a 16 GB chip -- see
+    docs/benchmarks.md.
+    """
     targets = tokens[:, 1:]
+    if cfg.loss_chunk:
+        from ..ops.xent import chunked_cross_entropy  # noqa: PLC0415
+
+        hidden = llama.forward_hidden(params, tokens[:, :-1], cfg)
+        return chunked_cross_entropy(
+            hidden, params["lm_head"], targets, chunk=cfg.loss_chunk)
+    logits = llama.forward(params, tokens[:, :-1], cfg)
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
     return losses.mean()
 
