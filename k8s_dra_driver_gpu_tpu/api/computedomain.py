@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ..computedomain import expected_slices as _expected_slices
+
 
 class ComputeDomainStatusValue:
     READY = "Ready"
@@ -68,6 +70,11 @@ class ComputeDomain:
     # reference sizes domains by numNodes only; on TPU the slice shape is
     # the unit of gang scheduling).
     topology: str = ""
+    # Cross-slice: numNodes hosts split evenly over this many ICI
+    # slices (one clique per slice); >1 adds the MEGASCALE-style DCN
+    # env to the channel contract (TPU-native addition: the reference's
+    # IMEX domains cannot span NVLink partitions).
+    num_slices: int = 1
     # Status.
     status: str = ComputeDomainStatusValue.NOT_READY
     nodes: list[ComputeDomainNode] = field(default_factory=list)
@@ -87,6 +94,7 @@ class ComputeDomain:
             namespace=meta.get("namespace", "default"),
             uid=meta.get("uid", ""),
             num_nodes=spec.get("numNodes", 0),
+            num_slices=_expected_slices(spec),
             channel_resource_claim_template=rct.get("name", ""),
             channel_allocation_mode=channel.get("allocationMode", "Single"),
             topology=spec.get("topology", ""),
@@ -111,6 +119,7 @@ class ComputeDomain:
             },
             "spec": {
                 "numNodes": self.num_nodes,
+                "numSlices": self.num_slices,
                 "topology": self.topology,
                 "channel": {
                     "resourceClaimTemplate": {

@@ -25,6 +25,10 @@ DOMAIN_DAEMON_PORT = 7077  # daemon rendezvous service (STATUS/MEMBERS)
 # worker 0 share the node), so one address works for both -- but each
 # needs its own port. 8476 is jax.distributed's conventional default.
 JAX_COORDINATOR_PORT = 8476
+# Cross-slice (multislice) DCN transport coordinator, MEGASCALE-style:
+# libtpu's DCN layer reads MEGASCALE_* env; slice 0's worker 0 hosts
+# the coordinator on this port (conventional default 8080).
+MEGASCALE_PORT = 8080
 API_GROUP = "resource.tpu.dra"
 API_VERSION = "v1beta1"
 
@@ -36,6 +40,29 @@ DAEMON_DNS_PATTERN = "compute-domain-daemon-{index:04d}"
 def daemon_dns_name(index: int, cd_uid: str = "") -> str:
     base = DAEMON_DNS_PATTERN.format(index=index)
     return f"{base}.{cd_uid}" if cd_uid else base
+
+
+def expected_slices(cd_spec: dict) -> int:
+    """How many ICI slices a ComputeDomain spans (spec.numSlices,
+    default 1). A multi-slice domain gangs numNodes hosts split evenly
+    across numSlices ICI domains (one clique per slice); cross-slice
+    traffic rides DCN with a MEGASCALE-style env contract
+    (SURVEY §2.9: DCN is the cross-slice fallback)."""
+    return max(1, int(cd_spec.get("numSlices", 1) or 1))
+
+
+def per_slice_workers(cd_spec: dict) -> int:
+    """Workers per slice (= per clique). THE divisibility authority:
+    webhook admission, channel prepare, and daemon prepare all call
+    this so they can never disagree on the split rule. Raises
+    ValueError when numNodes does not split evenly over numSlices."""
+    total = expected_workers(cd_spec)
+    slices = expected_slices(cd_spec)
+    if total % slices:
+        raise ValueError(
+            f"numNodes={total} does not split evenly over "
+            f"numSlices={slices}")
+    return total // slices
 
 
 def expected_workers(cd_spec: dict) -> int:

@@ -180,7 +180,15 @@ class Daemon:
         declared gang size, never from whichever subset of peers
         happens to be registered right now: a transient 3-of-4
         membership must not produce a 3-entry list that consumers
-        rightly reject against numProcesses=4."""
+        rightly reject against numProcesses=4.
+
+        SCOPE: this file is CLIQUE-LOCAL (this daemon's slice only --
+        num_workers is already numNodes/numSlices on multi-slice
+        domains, injected by the CD plugin). On a multi-slice domain
+        the authoritative GLOBAL contract is the CDI-injected channel
+        env (slice-major ids + MEGASCALE set); the ``scope`` and
+        ``cliqueID`` fields let consumers tell the two apart instead
+        of mistaking a slice-local gang for the whole domain."""
         coordinator = f"{daemon_dns_name(0)}:{self.cfg.jax_port}"
         doc = {
             "coordinatorAddress": coordinator,
@@ -189,6 +197,8 @@ class Daemon:
             "workerHostnames": [
                 daemon_dns_name(i) for i in range(self.cfg.num_workers)
             ],
+            "scope": "clique",
+            "cliqueID": self.cfg.clique_id,
         }
         tmp = self.bootstrap_file + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:

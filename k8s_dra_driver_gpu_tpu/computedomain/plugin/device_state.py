@@ -99,11 +99,20 @@ class CDDeviceState:
     # -- allocatable devices ----------------------------------------------------
 
     def allocatable_devices(self) -> list[dict]:
-        """channel-0..N + the daemon device (nvlib.go:167-194)."""
+        """channel-0..N + the daemon device (nvlib.go:167-194).
+
+        Every device carries the node's slice identity (``cliqueId``,
+        from --clique-id/TPU_SLICE_ID): a CEL selector or
+        ``matchAttribute`` on it pins channel claims to one ICI slice,
+        and cross-slice tooling can see which slice each published
+        channel belongs to (SURVEY §2.9 DCN attribute annotation)."""
         devices = [
             {
                 "name": DAEMON_DEVICE,
-                "attributes": {"type": {"string": "daemon"}},
+                "attributes": {
+                    "type": {"string": "daemon"},
+                    "cliqueId": {"string": self.clique_id},
+                },
                 "capacity": {},
             }
         ]
@@ -114,6 +123,7 @@ class CDDeviceState:
                     "attributes": {
                         "type": {"string": "channel"},
                         "channel": {"int": i},
+                        "cliqueId": {"string": self.clique_id},
                     },
                     "capacity": {},
                 }
@@ -222,43 +232,45 @@ class CDDeviceState:
 
         port = int(os.environ.get("JAX_COORDINATOR_PORT",
                                   str(JAX_COORDINATOR_PORT)))
+        layout = self._slice_layout(cd, node)
         # Coordinator by IP: workload pods have no resolver entry for the
         # daemon DNS names (those live in the daemons' own /etc/hosts), so
-        # hand out the index-0 daemon's registered pod IP directly; the
+        # hand out global worker 0's registered pod IP directly; the
         # full name<->IP map rides the mounted members.json for consumers
         # that want stable names.
-        nodes = cd.get("status", {}).get("nodes", [])
-        node0 = next((n for n in nodes if n.get("index") == 0), None)
-        coordinator_host = (
-            node0.get("ipAddress") if node0 and node0.get("ipAddress")
-            else daemon_dns_name(0)
-        )
-        # Worker addresses POSITIONAL BY PROCESS ID (libtpu's multi-host
-        # contract): entry i must be worker i's address, and the list
-        # length must equal TPU_NUM_PROCESSES, so both derive from the
-        # gang size the spec declares -- never from whichever subset of
-        # nodes happens to be registered/Ready in a cached status (a
-        # gap would shift every later process's mapping). Like the
-        # coordinator above, emit registered pod IPs (workload pods
-        # can't resolve the daemon DNS names); an unregistered slot
-        # falls back to its stable DNS name.
-        expected = self._expected_workers(cd)
-        by_index = {n.get("index"): n for n in nodes}
-        hostnames = ",".join(
-            by_index.get(i, {}).get("ipAddress") or daemon_dns_name(i)
-            for i in range(expected)
-        )
+        coordinator_host = layout["hostnames"][0]
+        hostnames = ",".join(layout["hostnames"])
+        env = [
+            f"COMPUTE_DOMAIN_UUID={cfg.domain_id}",
+            f"TPU_COORDINATOR_ADDRESS={coordinator_host}:{port}",
+            f"TPU_PROCESS_ID={layout['process_id']}",
+            f"TPU_NUM_PROCESSES={layout['num_processes']}",
+            f"TPU_WORKER_HOSTNAMES={hostnames}",
+            "TPU_DOMAIN_CHANNELS="
+            + ("all" if cfg.allocation_mode == "All"
+               else ",".join(sorted(channels))),
+        ]
+        if layout["num_slices"] > 1:
+            # Cross-slice (multislice) DCN contract, MEGASCALE-style:
+            # one jax.distributed world spans every slice (global
+            # process ids above); libtpu's DCN transport layer reads
+            # the MEGASCALE_* set. Slice order = sorted clique ids;
+            # the DCN coordinator is global worker 0's host.
+            from .. import MEGASCALE_PORT  # noqa: PLC0415
+
+            ms_port = int(os.environ.get("MEGASCALE_PORT_OVERRIDE",
+                                         str(MEGASCALE_PORT)))
+            env += [
+                f"TPU_NUM_SLICES={layout['num_slices']}",
+                f"TPU_SLICE_ID={layout['slice_id']}",
+                f"MEGASCALE_NUM_SLICES={layout['num_slices']}",
+                f"MEGASCALE_SLICE_ID={layout['slice_id']}",
+                f"MEGASCALE_COORDINATOR_ADDRESS={coordinator_host}"
+                f":{ms_port}",
+                f"MEGASCALE_PORT={ms_port}",
+            ]
         edits = ContainerEdits(
-            env=[
-                f"COMPUTE_DOMAIN_UUID={cfg.domain_id}",
-                f"TPU_COORDINATOR_ADDRESS={coordinator_host}:{port}",
-                f"TPU_PROCESS_ID={node.get('index', 0)}",
-                f"TPU_NUM_PROCESSES={expected}",
-                f"TPU_WORKER_HOSTNAMES={hostnames}",
-                "TPU_DOMAIN_CHANNELS="
-                + ("all" if cfg.allocation_mode == "All"
-                   else ",".join(sorted(channels))),
-            ],
+            env=env,
             # The daemon's bootstrap/membership files for this domain,
             # read-only. Host source must match what _prepare_daemon
             # mounts INTO the daemon (same per-domain dir).
@@ -268,6 +280,74 @@ class CDDeviceState:
             )],
         )
         return edits, channels
+
+    def _slice_layout(self, cd: dict, node: dict) -> dict:
+        """Global (slice-major) worker layout of a possibly multi-slice
+        domain.
+
+        Worker addresses are POSITIONAL BY GLOBAL PROCESS ID (libtpu's
+        multi-host contract): entry i must be worker i's address and
+        the list length must equal TPU_NUM_PROCESSES, so both derive
+        from the gang size the SPEC declares -- never from whichever
+        subset of nodes happens to be registered. Slices are ordered by
+        sorted clique id; global id = slice_index * per_slice +
+        clique-local index. Registered pod IPs are emitted (workloads
+        can't resolve daemon DNS names); an unregistered slot falls
+        back to its stable per-clique DNS name.
+
+        Raises PermanentError when numNodes does not split evenly over
+        numSlices, RetryableError while the registered cliques don't
+        yet match the declared slice count (the Ready gate usually
+        guarantees they do).
+        """
+        from .. import expected_slices, per_slice_workers  # noqa: PLC0415
+
+        expected = self._expected_workers(cd)
+        num_slices = expected_slices(cd.get("spec", {}))
+        try:
+            per_slice = per_slice_workers(cd.get("spec", {}))
+        except ValueError as e:
+            raise PermanentError(
+                f"ComputeDomain {cd['metadata']['name']}: {e}") from e
+        nodes = cd.get("status", {}).get("nodes", [])
+        cliques = sorted({n.get("cliqueID", "") or "0" for n in nodes})
+        if num_slices > 1 and len(cliques) != num_slices:
+            raise RetryableError(
+                f"ComputeDomain {cd['metadata']['name']}: {len(cliques)}"
+                f" clique(s) registered, want numSlices={num_slices}")
+        if num_slices == 1:
+            # Single slice: whatever clique id the nodes carry.
+            cliques = cliques or ["0"]
+            slice_of = dict.fromkeys(cliques, 0)
+        else:
+            slice_of = {c: i for i, c in enumerate(cliques)}
+        by_gid: dict[int, dict] = {}
+        for n in nodes:
+            idx = n.get("index", -1)
+            si = slice_of.get(n.get("cliqueID", "") or "0")
+            if idx is None or idx < 0 or idx >= per_slice or si is None:
+                continue
+            by_gid[si * per_slice + idx] = n
+        hostnames = []
+        for gid in range(expected):
+            entry = by_gid.get(gid)
+            if entry and entry.get("ipAddress"):
+                hostnames.append(entry["ipAddress"])
+            else:
+                si, idx = divmod(gid, per_slice)
+                clique = (cliques[si] if si < len(cliques) else str(si))
+                hostnames.append(
+                    daemon_dns_name(idx) if num_slices == 1
+                    else f"{daemon_dns_name(idx)}.{clique}")
+        my_slice = slice_of.get(node.get("cliqueID", "") or "0", 0)
+        return {
+            "num_processes": expected,
+            "num_slices": num_slices,
+            "per_slice": per_slice,
+            "slice_id": my_slice,
+            "process_id": my_slice * per_slice + node.get("index", 0),
+            "hostnames": hostnames,
+        }
 
     def _ready_nodes(self, cd: dict) -> list[dict]:
         return [
@@ -324,7 +404,17 @@ class CDDeviceState:
         cd = self._get_cd(cfg.domain_id)
         domain_dir = os.path.join(self.root, "domains", cfg.domain_id)
         os.makedirs(domain_dir, exist_ok=True)
-        expected = self._expected_workers(cd)
+        # The daemon's quorum is CLIQUE-LOCAL: its rendezvous service
+        # flips READY when its own slice's workers are all registered;
+        # cross-slice readiness is the controller's aggregation. So a
+        # multi-slice domain hands each daemon numNodes/numSlices.
+        from .. import per_slice_workers  # noqa: PLC0415
+
+        try:
+            expected = per_slice_workers(cd.get("spec", {}))
+        except ValueError as e:
+            raise PermanentError(
+                f"ComputeDomain {cd['metadata']['name']}: {e}") from e
         edits = ContainerEdits(
             env=[
                 f"COMPUTE_DOMAIN_UUID={cfg.domain_id}",

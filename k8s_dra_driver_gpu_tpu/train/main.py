@@ -116,8 +116,14 @@ def initialize_distributed(env=os.environ) -> bool:
 
 def run(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tpu-train")
-    p.add_argument("--model", choices=["tiny", "llama3-8b", "moe-tiny"],
+    p.add_argument("--model",
+                   choices=["tiny", "flagship", "llama3-8b", "moe-tiny"],
                    default="tiny")
+    p.add_argument("--mu-dtype", choices=["f32", "bf16"], default=None,
+                   help="Adam first-moment dtype; bf16 frees one "
+                        "2-bytes/param buffer (the flagship single-chip "
+                        "default -- see models.llama.LlamaConfig."
+                        "flagship)")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=128)
@@ -167,13 +173,17 @@ def run(argv: list[str] | None = None) -> int:
             p.error("--microbatches requires --pp > 1")
         if args.microbatches < 1:
             p.error("--microbatches must be >= 1")
-    if args.pp > 1 and int(os.environ.get("TPU_NUM_PROCESSES", "1")) > 1:
-        # The pp batch replicates over the pp axis; per-process local
-        # batches would make gang members disagreeing "replicas"
-        # (silently wrong grads). Single-host only until the batch
-        # shards over pp too. Checked BEFORE the distributed rendezvous
-        # so the misconfiguration fails fast.
-        p.error("--pp does not support multi-host gangs yet")
+    if args.mu_dtype and args.model == "moe-tiny":
+        p.error("--mu-dtype applies to the dense families only "
+                "(the MoE trainer builds its own optimizer)")
+    if args.model == "flagship" and args.seq_len % 128:
+        p.error("--seq-len must be a multiple of 128 for the flagship "
+                "config (its chunked loss scans 128-position chunks)")
+    # Multi-host pp is supported: pp_batch_for assembles the GLOBAL
+    # microbatch stream identically on every process (the pp axis
+    # replicates the batch, so replicas must agree bitwise -- see the
+    # comment there). Stage-to-host mapping follows device order: each
+    # process's devices form whole pp rows when pp >= process count.
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
@@ -192,8 +202,20 @@ def run(argv: list[str] | None = None) -> int:
     logger.info("devices: %d x %s", len(devices), devices[0].platform)
 
     def dense_cfg():
-        return (llama.LlamaConfig.tiny() if args.model == "tiny"
-                else llama.LlamaConfig.llama3_8b())
+        if args.model == "tiny":
+            return llama.LlamaConfig.tiny()
+        if args.model == "flagship":
+            return llama.LlamaConfig.flagship()
+        return llama.LlamaConfig.llama3_8b()
+
+    # The flagship single-chip recipe defaults to the bf16 first
+    # moment; every other config keeps fp32 unless asked.
+    mu = args.mu_dtype or ("bf16" if args.model == "flagship" else "f32")
+    optimizer = None
+    if mu == "bf16":
+        from .train import make_optimizer  # noqa: PLC0415
+
+        optimizer = make_optimizer(mu_dtype=jnp.bfloat16)
 
     if args.model == "moe-tiny":
         # Expert-parallel family: a (dp, ep) mesh; ep takes as many
@@ -242,14 +264,16 @@ def run(argv: list[str] | None = None) -> int:
         pp_m = (args.microbatches if args.microbatches is not None
                 else args.pp)
         dp = len(devices) // args.pp
-        if args.batch_size % dp:
-            p.error(f"--batch-size {args.batch_size} must be divisible "
-                    f"by dp={dp} ({len(devices)} devices / pp={args.pp})")
+        gang_n = int(os.environ.get("TPU_NUM_PROCESSES", "1"))
+        if (args.batch_size * gang_n) % dp:
+            p.error(f"global batch {args.batch_size}x{gang_n} must be "
+                    f"divisible by dp={dp} "
+                    f"({len(devices)} devices / pp={args.pp})")
         mesh = build_pipeline_mesh(args.pp, devices=devices)
         logger.info("mesh: %s microbatches=%d",
                     dict(zip(mesh.axis_names, mesh.devices.shape)), pp_m)
         init_fn, step_fn, batch_shard, place = make_pp_train(
-            mesh, cfg, n_microbatches=pp_m)
+            mesh, cfg, n_microbatches=pp_m, optimizer=optimizer)
         scan_fn = scan_batch_shard = None
         state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
     else:
@@ -258,14 +282,15 @@ def run(argv: list[str] | None = None) -> int:
         logger.info("mesh: %s", dict(zip(mesh.axis_names,
                                          mesh.devices.shape)))
         cfg = dense_cfg()
-        init_fn, step_fn, batch_shard, place = make_sharded_train(mesh, cfg)
+        init_fn, step_fn, batch_shard, place = make_sharded_train(
+            mesh, cfg, optimizer=optimizer)
         scan_fn = scan_batch_shard = None
         pp_m = 0
         if args.steps_per_call > 1:
             from .train import make_scanned_sharded_train  # noqa: PLC0415
 
             _, scan_fn, scan_batch_shard, _ = make_scanned_sharded_train(
-                mesh, cfg)
+                mesh, cfg, optimizer=optimizer)
         state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
 
     ckpt = None
@@ -303,20 +328,34 @@ def run(argv: list[str] | None = None) -> int:
                 "or pick the right --model"
             )
 
+        def shard_batch(step: int, sid: int):
+            if sid == shard_id:
+                return it.batch(step)
+            # Another shard's rows (pp-replica feeding, below): a
+            # sibling iterator with that shard's identity -- batch() is
+            # pure, so every process reconstructs identical rows.
+            other = ShardedBatchIterator(
+                ds, global_batch=global_batch,
+                num_shards=num_shards, shard_id=sid)
+            return other.batch(step)
+
         def local_batch(step: int):
             return it.batch(step)
     else:
         # Synthetic next-token data: each process draws ITS shard's
         # slice (keyed by step and shard) so global semantics match the
         # data path exactly.
-        def local_batch(step: int):
+        def shard_batch(step: int, sid: int):
             import numpy as _np  # noqa: PLC0415
 
-            rng = _np.random.RandomState(step * 65521 + shard_id)
+            rng = _np.random.RandomState(step * 65521 + sid)
             return rng.randint(
                 0, cfg.vocab_size,
                 (args.batch_size, args.seq_len + 1),
             ).astype(_np.int32)
+
+        def local_batch(step: int):
+            return shard_batch(step, shard_id)
 
     def batch_for(step: int):
         # Each process supplies ONLY its local shard; device_put's
@@ -343,11 +382,24 @@ def run(argv: list[str] | None = None) -> int:
     def pp_batch_for(step: int):
         # M distinct microbatches per optimizer step, deterministically
         # keyed so resume replays the same stream.
+        #
+        # The pp batch REPLICATES over the pp axis (token spec
+        # P(None, dp, None)), so on a multi-host gang every process
+        # must supply bitwise-identical microbatch content for the dp
+        # columns its devices cover -- a process-id-keyed local slice
+        # would make the pp replicas silently disagree (wrong grads).
+        # So the GLOBAL batch is assembled on every process (same
+        # shard-keyed rows, concatenated in shard order) and
+        # make_array_from_callback hands each device its slice.
         import numpy as _np  # noqa: PLC0415
 
-        stacked = _np.stack(
-            [local_batch(step * pp_m + i) for i in range(pp_m)])
-        return jax.make_array_from_process_local_data(batch_shard, stacked)
+        stacked = _np.stack([
+            _np.concatenate([shard_batch(step * pp_m + i, s)
+                             for s in range(num_shards)])
+            for i in range(pp_m)
+        ])
+        return jax.make_array_from_callback(
+            stacked.shape, batch_shard, lambda idx: stacked[idx])
 
     step = start_step
     first_timed = None  # first step boundary after the compile call

@@ -73,7 +73,11 @@ def run(argv: list[str] | None = None) -> int:
     from jax.sharding import PartitionSpec as P
 
     from ..models import llama
-    from ..parallel.mesh import build_mesh, plan_for
+    from ..parallel.mesh import (
+        build_mesh,
+        build_multislice_mesh,
+        plan_for,
+    )
     from .train import make_sharded_train
 
     devices = jax.devices()
@@ -81,8 +85,24 @@ def run(argv: list[str] | None = None) -> int:
     local = len(jax.local_devices())
     pid = jax.process_index()
 
+    # Cross-slice domain: the injected MEGASCALE-style env declares the
+    # slice layout; the global mesh leads with a DCN axis over slices
+    # (parallel/mesh.build_multislice_mesh), exactly the multislice
+    # recipe -- driven here ONLY by what the driver injected.
+    num_slices = int(os.environ.get("TPU_NUM_SLICES", "1"))
+    if num_slices > 1:
+        if n % num_slices:
+            raise SystemExit(
+                f"TPU_NUM_SLICES={num_slices} does not divide "
+                f"{n} global devices")
+        mesh = build_multislice_mesh(
+            num_slices, plan_for(n // num_slices), devices=devices)
+        batch_axes = ("dcn", "dp", "fsdp")
+    else:
+        mesh = build_mesh(plan_for(n), devices=devices)
+        batch_axes = None
+
     # -- collective proof: every device AND every process contributed --
-    mesh = build_mesh(plan_for(n), devices=devices)
     flat = NamedSharding(mesh, P(mesh.axis_names))
     repl = NamedSharding(mesh, P())
     ones = jax.make_array_from_process_local_data(
@@ -95,7 +115,12 @@ def run(argv: list[str] | None = None) -> int:
 
     # -- one real sharded training computation over the gang mesh ------
     cfg = llama.LlamaConfig.tiny()
-    init_fn, step_fn, batch_shard, place = make_sharded_train(mesh, cfg)
+    if batch_axes is not None:
+        init_fn, step_fn, batch_shard, place = make_sharded_train(
+            mesh, cfg, batch_axes=batch_axes)
+    else:
+        init_fn, step_fn, batch_shard, place = make_sharded_train(
+            mesh, cfg)
     state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
     loss = None
     for step in range(args.steps):
@@ -121,11 +146,17 @@ def run(argv: list[str] | None = None) -> int:
         # Full repr: pods must agree BITWISE (one global computation).
         "loss": repr(float(loss)),
         "gang": joined,
+        "numSlices": num_slices,
+        "sliceId": int(os.environ.get("TPU_SLICE_ID", "0")),
+        "mesh": dict(zip(mesh.axis_names,
+                         (int(s) for s in mesh.devices.shape))),
         "env": {
             k: os.environ.get(k, "")
             for k in ("TPU_COORDINATOR_ADDRESS", "TPU_PROCESS_ID",
                       "TPU_NUM_PROCESSES", "TPU_WORKER_HOSTNAMES",
-                      "TPU_DOMAIN_CHANNELS", "COMPUTE_DOMAIN_UUID")
+                      "TPU_DOMAIN_CHANNELS", "COMPUTE_DOMAIN_UUID",
+                      "MEGASCALE_COORDINATOR_ADDRESS",
+                      "MEGASCALE_NUM_SLICES", "MEGASCALE_SLICE_ID")
         },
     }))
     return 0

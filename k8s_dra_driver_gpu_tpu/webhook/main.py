@@ -70,6 +70,17 @@ def validate_admission_review(review: dict) -> dict:
                     "code": 422,
                 }
                 break
+    elif obj.get("kind") == "ComputeDomain":
+        # Fail fast at admission what would otherwise surface as a
+        # PermanentError in every node's channel prepare: a
+        # cross-slice domain must split its hosts evenly over slices.
+        from ..computedomain import per_slice_workers  # noqa: PLC0415
+
+        try:
+            per_slice_workers(obj.get("spec") or {})
+        except ValueError as e:
+            response["allowed"] = False
+            response["status"] = {"message": str(e), "code": 422}
     return {
         "apiVersion": review.get(
             "apiVersion", "admission.k8s.io/v1"

@@ -49,11 +49,16 @@ pytestmark = pytest.mark.skipif(
 
 
 class GangCluster:
-    """2 fake nodes, 2 CD plugins, controller, scheduler, apiserver."""
+    """2 fake nodes, 2 CD plugins, controller, scheduler, apiserver.
+
+    ``clique_ids`` gives each node's plugin its --clique-id (slice
+    identity): same id = one ICI slice (the plain gang), distinct ids
+    = a cross-slice domain (the multislice e2e)."""
 
     NODES = ("node-gang-0", "node-gang-1")
 
-    def __init__(self):
+    def __init__(self, clique_ids: tuple[str, ...] = ("0", "0")):
+        self.clique_ids = clique_ids
         self.procs = []
         self.logs = []
         self.nodes = []
@@ -116,6 +121,7 @@ class GangCluster:
                 "k8s_dra_driver_gpu_tpu.computedomain.plugin.main",
                 "--kube-api", self.apiserver.url,
                 "--node-name", node,
+                "--clique-id", self.clique_ids[i],
                 "--state-root", os.path.join(ndir, "state"),
                 "--cdi-root", os.path.join(ndir, "cdi"),
                 "--plugin-dir", os.path.join(ndir, "plugin"),

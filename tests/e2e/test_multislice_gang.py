@@ -1,0 +1,152 @@
+"""Cross-slice (multislice) ComputeDomain e2e on the fake cluster.
+
+A 2-node domain whose nodes sit in DIFFERENT ICI slices (distinct
+plugin --clique-id): spec.numSlices=2 makes the controller/daemons
+treat each clique as one slice, and the channel env becomes the
+slice-major global contract plus the MEGASCALE-style DCN set. The
+workload pods run the REAL verify workload, which builds
+``build_multislice_mesh`` (a leading dcn axis over slices) ONLY from
+the injected env, runs a cross-process psum and 2 train steps with the
+batch sharded over (dcn, dp, fsdp), and must agree bitwise.
+
+SURVEY §2.9: "DCN is the cross-slice fallback (multislice),
+attribute-annotated in ResourceSlices" -- this is that contract,
+driven end to end by the driver binaries. No reference analog (IMEX
+domains cannot span NVLink partitions).
+"""
+
+import json
+
+import pytest
+
+from tests.e2e.conftest import MODE
+from tests.e2e.framework import wait_for
+from tests.e2e.test_computedomain_gang import (
+    CD_DRIVER,
+    GangCluster,
+    workload_pod,
+)
+
+pytestmark = pytest.mark.skipif(
+    MODE != "fake",
+    reason="multislice gang e2e drives the fake cluster",
+)
+
+
+@pytest.fixture(scope="module")
+def ms_gang():
+    cluster = GangCluster(clique_ids=("s0", "s1"))
+    yield cluster
+    cluster.stop()
+
+
+class TestMultisliceGang:
+    NS = "team-ms"
+    CD = "ms-domain"
+    RCT = "ms-channel-rct"
+
+    def test_two_slice_domain_end_to_end(self, ms_gang):
+        kube = ms_gang.kube
+        kube.create("", "v1", "namespaces", {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": self.NS}})
+
+        def cd_slices():
+            pools = {s["spec"].get("pool", {}).get("name", "")
+                     for s in kube.list("resource.k8s.io", "v1",
+                                        "resourceslices")
+                     if s["spec"].get("driver") == CD_DRIVER}
+            return pools if len(pools) >= 2 else None
+        try:
+            wait_for(cd_slices, timeout=180,
+                     desc="CD slices from both nodes")
+        except AssertionError:
+            print(ms_gang.dump_logs())
+            raise
+
+        # Published channel devices carry each node's slice identity.
+        clique_attrs = {
+            d["attributes"]["cliqueId"]["string"]
+            for s in kube.list("resource.k8s.io", "v1", "resourceslices")
+            if s["spec"].get("driver") == CD_DRIVER
+            for d in s["spec"].get("devices", [])
+        }
+        assert clique_attrs == {"s0", "s1"}, clique_attrs
+
+        kube.create("resource.tpu.dra", "v1beta1", "computedomains", {
+            "apiVersion": "resource.tpu.dra/v1beta1",
+            "kind": "ComputeDomain",
+            "metadata": {"name": self.CD, "namespace": self.NS,
+                         "uid": "ms-cd-uid"},
+            "spec": {
+                "numNodes": 2,
+                "numSlices": 2,
+                "channel": {
+                    "resourceClaimTemplate": {"name": self.RCT},
+                    "allocationMode": "Single",
+                },
+            },
+        }, namespace=self.NS)
+
+        wait_for(
+            lambda: any(
+                r["metadata"]["name"] == self.RCT
+                for r in kube.list("resource.k8s.io", "v1",
+                                   "resourceclaimtemplates",
+                                   namespace=self.NS)),
+            timeout=60, desc="workload RCT")
+
+        for name in ("ms-worker-0", "ms-worker-1"):
+            kube.create("", "v1", "pods",
+                        workload_pod(self.NS, name, self.RCT),
+                        namespace=self.NS)
+
+        def phase(name):
+            try:
+                pod = kube.get("", "v1", "pods", name,
+                               namespace=self.NS)
+            except Exception:  # noqa: BLE001
+                return ""
+            return pod.get("status", {}).get("phase", "")
+
+        try:
+            wait_for(
+                lambda: (phase("ms-worker-0") == "Succeeded"
+                         and phase("ms-worker-1") == "Succeeded") or None,
+                timeout=420, desc="multislice workers succeed")
+        except AssertionError:
+            print(ms_gang.dump_logs())
+            for name in ("ms-worker-0", "ms-worker-1"):
+                try:
+                    print(name, kube.read_raw(
+                        f"/api/v1/namespaces/{self.NS}/pods/{name}/log"))
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+
+        reports = {}
+        for name in ("ms-worker-0", "ms-worker-1"):
+            log = kube.read_raw(
+                f"/api/v1/namespaces/{self.NS}/pods/{name}/log")
+            reports[name] = json.loads(log.strip().splitlines()[-1])
+        for rep in reports.values():
+            assert rep["gang"] is True
+            assert rep["numProcesses"] == 2
+            assert rep["numSlices"] == 2
+            # The mesh the workload built from env leads with dcn=2.
+            assert rep["mesh"]["dcn"] == 2, rep["mesh"]
+            assert rep["globalDevices"] == 8
+            assert rep["devSum"] == 8.0, rep
+            assert rep["rankSum"] == 12.0, rep
+            assert rep["steps"] == 2
+        # One coherent cross-slice computation.
+        assert len({rep["loss"] for rep in reports.values()}) == 1, reports
+        # Each pod sits in its own slice; both agree on the DCN
+        # coordinator, and MEGASCALE mirrors the TPU_ slice set.
+        slice_ids = {rep["sliceId"] for rep in reports.values()}
+        assert slice_ids == {0, 1}, slice_ids
+        envs = [rep["env"] for rep in reports.values()]
+        assert len({e["MEGASCALE_COORDINATOR_ADDRESS"]
+                    for e in envs}) == 1
+        assert all(e["MEGASCALE_NUM_SLICES"] == "2" for e in envs)
+        assert {e["MEGASCALE_SLICE_ID"] for e in envs} == {"0", "1"}

@@ -637,3 +637,151 @@ class TestFourDaemonFailover:
         finally:
             for d in daemons:
                 d.process.stop()
+
+
+class TestMultislice:
+    """Cross-slice domains: spec.numSlices > 1 splits numNodes hosts
+    over ICI slices (one clique per slice); the channel env becomes a
+    slice-major GLOBAL contract plus the MEGASCALE-style DCN set
+    (SURVEY §2.9: DCN is the cross-slice fallback)."""
+
+    @staticmethod
+    def make_multislice_cd(kube, num_nodes=4, num_slices=2):
+        cd = {
+            "apiVersion": f"{API_GROUP}/{API_VERSION}",
+            "kind": "ComputeDomain",
+            "metadata": {"name": "ms", "namespace": "team-a"},
+            "spec": {
+                "numNodes": num_nodes,
+                "numSlices": num_slices,
+                "channel": {
+                    "resourceClaimTemplate": {"name": "ms-channel"},
+                    "allocationMode": "Single",
+                },
+            },
+        }
+        return kube.create(API_GROUP, API_VERSION, "computedomains", cd,
+                           namespace="team-a")
+
+    @staticmethod
+    def set_ready(kube, cd, entries):
+        """entries: [(node, cliqueID, index, ip)] -> Ready status."""
+        kube.patch(API_GROUP, API_VERSION, "computedomains",
+                   cd["metadata"]["name"], {"status": {
+                       "status": "Ready",
+                       "nodes": [{
+                           "name": n, "cliqueID": c, "index": i,
+                           "ipAddress": ip, "status": "Ready",
+                       } for n, c, i, ip in entries],
+                   }}, namespace="team-a")
+
+    def channel_env(self, kube, tmp_path, cd_uid, node_name):
+        put_channel_claim(kube, f"w-{node_name}", cd_uid)
+        st = CDDeviceState(str(tmp_path / node_name), kube, node_name,
+                           use_informer=False)
+        drv = CDDriver(st, kube, node_name, retry_timeout=5.0)
+        out = drv.prepare_resource_claims(
+            [{"uid": f"w-{node_name}", "namespace": "team-a",
+              "name": f"w-{node_name}"}])
+        devices, err = out[f"w-{node_name}"]
+        assert err == "", err
+        spec = st._cdi.read_spec(f"w-{node_name}")
+        return dict(e.split("=", 1)
+                    for e in spec["containerEdits"]["env"])
+
+    def test_global_slice_major_contract(self, kube, tmp_path):
+        cd = self.make_multislice_cd(kube)
+        uid = cd["metadata"]["uid"]
+        # Two cliques x two nodes; clique ids sort "s0" < "s1".
+        self.set_ready(kube, cd, [
+            ("node-a", "s0", 0, "10.0.0.1"),
+            ("node-b", "s0", 1, "10.0.0.2"),
+            ("node-c", "s1", 0, "10.0.1.1"),
+            ("node-d", "s1", 1, "10.0.1.2"),
+        ])
+        env_a = self.channel_env(kube, tmp_path, uid, "node-a")
+        env_d = self.channel_env(kube, tmp_path, uid, "node-d")
+        # Slice-major global ids: s0 -> 0,1; s1 -> 2,3.
+        assert env_a["TPU_PROCESS_ID"] == "0"
+        assert env_d["TPU_PROCESS_ID"] == "3"
+        for env, slice_id in ((env_a, "0"), (env_d, "1")):
+            assert env["TPU_NUM_PROCESSES"] == "4"
+            assert env["TPU_WORKER_HOSTNAMES"] == \
+                "10.0.0.1,10.0.0.2,10.0.1.1,10.0.1.2"
+            assert env["TPU_NUM_SLICES"] == "2"
+            assert env["TPU_SLICE_ID"] == slice_id
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == slice_id
+            # DCN coordinator = global worker 0's host, both agree.
+            assert env["MEGASCALE_COORDINATOR_ADDRESS"] == \
+                "10.0.0.1:8080"
+            assert env["TPU_COORDINATOR_ADDRESS"] == "10.0.0.1:8476"
+
+    def test_single_slice_has_no_megascale_env(self, kube, tmp_path):
+        cd = self.make_multislice_cd(kube, num_nodes=2, num_slices=1)
+        uid = cd["metadata"]["uid"]
+        self.set_ready(kube, cd, [
+            ("node-a", "0", 0, "10.0.0.1"),
+            ("node-b", "0", 1, "10.0.0.2"),
+        ])
+        env = self.channel_env(kube, tmp_path, uid, "node-a")
+        assert "MEGASCALE_COORDINATOR_ADDRESS" not in env
+        assert "TPU_NUM_SLICES" not in env
+
+    def test_indivisible_slices_is_permanent_error(self, kube, tmp_path):
+        cd = self.make_multislice_cd(kube, num_nodes=3, num_slices=2)
+        uid = cd["metadata"]["uid"]
+        self.set_ready(kube, cd, [
+            ("node-a", "s0", 0, "10.0.0.1"),
+            ("node-b", "s0", 1, "10.0.0.2"),
+            ("node-c", "s1", 0, "10.0.1.1"),
+        ])
+        put_channel_claim(kube, "w-bad", uid)
+        st = CDDeviceState(str(tmp_path / "bad"), kube, "node-a",
+                           use_informer=False)
+        drv = CDDriver(st, kube, "node-a", retry_timeout=2.0)
+        out = drv.prepare_resource_claims(
+            [{"uid": "w-bad", "namespace": "team-a", "name": "w-bad"}])
+        assert "does not split evenly" in out["w-bad"][1]
+
+    def test_daemon_quorum_is_clique_local(self, kube, tmp_path):
+        """A 2-slice 4-node domain hands each daemon NUM_WORKERS=2:
+        its rendezvous quorum covers its OWN slice only."""
+        from k8s_dra_driver_gpu_tpu.api.configs import (
+            ComputeDomainDaemonConfig,
+        )
+
+        cd = self.make_multislice_cd(kube)
+        uid = cd["metadata"]["uid"]
+        obj = make_claim_dict(
+            "d0", ["daemon"], namespace="team-a", request="daemon",
+            driver="compute-domain.tpu.dra.dev",
+            configs=[{
+                "parameters": {
+                    "apiVersion": "resource.tpu.dra/v1beta1",
+                    "kind": "ComputeDomainDaemonConfig",
+                    "domainID": uid,
+                },
+                "requests": ["daemon"],
+            }],
+        )
+        kube.create("resource.k8s.io", "v1", "resourceclaims", obj,
+                    namespace="team-a")
+        st = CDDeviceState(str(tmp_path / "dq"), kube, "node-a",
+                           use_informer=False)
+        drv = CDDriver(st, kube, "node-a", retry_timeout=5.0)
+        out = drv.prepare_resource_claims(
+            [{"uid": "d0", "namespace": "team-a", "name": "d0"}])
+        assert out["d0"][1] == "", out["d0"][1]
+        spec = st._cdi.read_spec("d0")
+        env = dict(e.split("=", 1)
+                   for e in spec["containerEdits"]["env"])
+        assert env["COMPUTE_DOMAIN_NUM_WORKERS"] == "2"
+
+    def test_devices_carry_clique_attribute(self, kube, tmp_path):
+        st = CDDeviceState(str(tmp_path / "attr"), kube, "node-a",
+                           clique_id="s1", use_informer=False)
+        devs = st.allocatable_devices()
+        assert all(
+            d["attributes"]["cliqueId"] == {"string": "s1"}
+            for d in devs)

@@ -130,10 +130,14 @@ class TestLauncherPipeline:
         with pytest.raises(SystemExit):
             run(argv)
 
-    def test_pp_rejects_multihost_gang(self, monkeypatch):
-        # The pp batch replicates over the pp axis; distinct per-process
-        # local batches would silently corrupt training (see main.py).
+    def test_pp_multihost_batch_divisibility_uses_global(self,
+                                                         monkeypatch):
+        # Multi-host pp is supported (tests/test_multiprocess_gang.py
+        # runs the real 2-process job); the flag check must account the
+        # GLOBAL batch: on 8 devices / pp=2 -> dp=4, per-process batch
+        # 3 in a gang of 2 makes a global batch of 6, indivisible by 4.
         monkeypatch.setenv("TPU_NUM_PROCESSES", "2")
         monkeypatch.setenv("TPU_COORDINATOR_ADDRESS", "")
         with pytest.raises(SystemExit):
-            run(["--model", "tiny", "--pp", "2", "--steps", "1"])
+            run(["--model", "tiny", "--pp", "2", "--steps", "1",
+                 "--batch-size", "3", "--seq-len", "16"])

@@ -104,6 +104,23 @@ class TestValidation:
                                                uid="xyz"))
         assert out["response"]["uid"] == "xyz"
 
+    def test_computedomain_indivisible_slices_rejected(self):
+        out = validate_admission_review(review({
+            "apiVersion": "resource.tpu.dra/v1beta1",
+            "kind": "ComputeDomain",
+            "spec": {"numNodes": 3, "numSlices": 2},
+        }))
+        assert not out["response"]["allowed"]
+        assert "split evenly" in out["response"]["status"]["message"]
+
+    def test_computedomain_even_slices_allowed(self):
+        out = validate_admission_review(review({
+            "apiVersion": "resource.tpu.dra/v1beta1",
+            "kind": "ComputeDomain",
+            "spec": {"numNodes": 4, "numSlices": 2},
+        }))
+        assert out["response"]["allowed"]
+
 
 class TestWebhookHTTP:
     def test_end_to_end(self):
