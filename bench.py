@@ -426,17 +426,18 @@ def bench_decode(budget_left=None) -> dict | None:
     cfg = _bench_model_cfg()
     params = llama.init(jax.random.PRNGKey(0), cfg)
 
-    def measure(B: int, prompt_len: int = 128, new: int = 128):
+    def measure(B: int, prompt_len: int = 128, new: int = 128,
+                kv_quant: bool = False):
         prompt = jax.random.randint(jax.random.PRNGKey(1), (B, prompt_len),
                                     0, cfg.vocab_size, jnp.int32)
         warm = generate(params, prompt, cfg, max_new_tokens=new,
                         max_len=512, temperature=0.7,
-                        key=jax.random.PRNGKey(6))
+                        key=jax.random.PRNGKey(6), kv_quant=kv_quant)
         jax.block_until_ready(warm)  # pays the compile
         t0 = time.perf_counter()
         out = generate(params, prompt, cfg, max_new_tokens=new,
                        max_len=512, temperature=0.7,
-                       key=jax.random.PRNGKey(7))
+                       key=jax.random.PRNGKey(7), kv_quant=kv_quant)
         # Fetching the tokens forces real completion through the tunnel.
         tokens = jax.device_get(out)
         dt = time.perf_counter() - t0
@@ -448,13 +449,20 @@ def bench_decode(budget_left=None) -> dict | None:
         "decode_tokens_per_s": round(tps8),
         "decode_step_ms": round(ms8, 2),
     }
-    # Serving batch: aggregate throughput scales until the KV-cache
-    # HBM traffic dominates (~10k tok/s at B=32-64 on v5e). Budget-
-    # gated: the new batch dim costs a second generate() compile.
+    # Serving batch: aggregate fp throughput knees at B=64 (~10k tok/s
+    # on v5e) where the KV-cache HBM traffic dominates. Budget-gated:
+    # each extra point costs a generate() compile.
     if budget_left is None or budget_left():
         tps32, ms32 = measure(32)
         out["decode_tokens_per_s_b32"] = round(tps32)
         out["decode_step_ms_b32"] = round(ms32, 2)
+    # The tuned serving point: int8 KV cache halves the dominant HBM
+    # stream, pushing the knee to B=128 (+43% aggregate over the fp
+    # peak; full sweep in docs/benchmarks.md).
+    if budget_left is None or budget_left():
+        tps128, ms128 = measure(128, kv_quant=True)
+        out["decode_tokens_per_s_b128_int8"] = round(tps128)
+        out["decode_step_ms_b128_int8"] = round(ms128, 2)
     return out
 
 

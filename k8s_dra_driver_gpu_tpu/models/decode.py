@@ -23,18 +23,49 @@ from .llama import LlamaConfig, rms_norm, rope
 
 
 class KVCache(NamedTuple):
-    k: jax.Array  # [L, B, max_len, K, hd]
+    k: jax.Array  # [L, B, max_len, K, hd] (cfg.dtype, or int8 quantized)
     v: jax.Array  # [L, B, max_len, K, hd]
     length: jax.Array  # [] int32: filled positions
+    # int8 mode only: per-vector scales [L, B, max_len, K, 1] (bf16).
+    # None = native-dtype cache; the choice is static at trace time.
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
 
     @classmethod
-    def empty(cls, cfg: LlamaConfig, batch: int, max_len: int) -> "KVCache":
+    def empty(cls, cfg: LlamaConfig, batch: int, max_len: int,
+              quantized: bool = False) -> "KVCache":
         shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+        if quantized:
+            sshape = shape[:-1] + (1,)
+            return cls(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                length=jnp.zeros((), jnp.int32),
+                k_scale=jnp.zeros(sshape, jnp.bfloat16),
+                v_scale=jnp.zeros(sshape, jnp.bfloat16),
+            )
         return cls(
             k=jnp.zeros(shape, cfg.dtype),
             v=jnp.zeros(shape, cfg.dtype),
             length=jnp.zeros((), jnp.int32),
         )
+
+
+def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-vector symmetric int8: x [..., hd] -> (int8 codes, scale
+    [..., 1] bf16). The KV cache is the HBM-bandwidth driver of batched
+    decode (read in full every step); int8 halves that traffic for a
+    ~0.4% per-vector quantization error (see tests/test_decode.py)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.bfloat16)
+
+
+def _dequantize(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    # The convert+mul fuses into the attention matmul's operand load;
+    # the bf16 tensor never materializes in HBM.
+    return q.astype(dtype) * scale.astype(dtype)
 
 
 def _project_qkv(cfg: LlamaConfig, x, lp, positions):
@@ -57,9 +88,14 @@ def _mlp(cfg: LlamaConfig, x, lp):
     return (gate * up) @ lp["w_down"].astype(cfg.dtype)
 
 
-def _attend_cached(cfg: LlamaConfig, q, ck, cv, valid_len):
+def _attend_cached(cfg: LlamaConfig, q, ck, cv, valid_len,
+                   k_scale=None, v_scale=None):
     """q [B,S,H,hd] vs cache ck/cv [B,max_len,K,hd]; positions >=
-    valid_len are masked."""
+    valid_len are masked. int8 caches pass their scales and are
+    dequantized on the fly (fused into the matmul loads)."""
+    if k_scale is not None:
+        ck = _dequantize(ck, k_scale, q.dtype)
+        cv = _dequantize(cv, v_scale, q.dtype)
     B, S, H, hd = q.shape
     K = ck.shape[2]
     group = H // K
@@ -76,7 +112,8 @@ def _attend_cached(cfg: LlamaConfig, q, ck, cv, valid_len):
 
 
 def prefill(
-    params: dict, tokens: jax.Array, cfg: LlamaConfig, max_len: int
+    params: dict, tokens: jax.Array, cfg: LlamaConfig, max_len: int,
+    quantized: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """Process the prompt; returns (logits for the LAST position [B, V],
     a cache filled up to tokens.shape[1])."""
@@ -87,10 +124,26 @@ def prefill(
     def body(carry, lp):
         h = carry
         q, k, v = _project_qkv(cfg, h, lp, positions)
-        ck = jnp.zeros((B, max_len, cfg.n_kv_heads, cfg.head_dim), cfg.dtype)
-        cv = jnp.zeros_like(ck)
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, 0, 0))
+        if quantized:
+            qk, sk = _quantize_kv(k)
+            qv, sv = _quantize_kv(v)
+            ck = jnp.zeros((B, max_len, cfg.n_kv_heads, cfg.head_dim),
+                           jnp.int8)
+            cv = jnp.zeros_like(ck)
+            sks = jnp.zeros((B, max_len, cfg.n_kv_heads, 1), jnp.bfloat16)
+            svs = jnp.zeros_like(sks)
+            ck = jax.lax.dynamic_update_slice(ck, qk, (0, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, qv, (0, 0, 0, 0))
+            sks = jax.lax.dynamic_update_slice(sks, sk, (0, 0, 0, 0))
+            svs = jax.lax.dynamic_update_slice(svs, sv, (0, 0, 0, 0))
+            out = (ck, cv, sks, svs)
+        else:
+            ck = jnp.zeros((B, max_len, cfg.n_kv_heads, cfg.head_dim),
+                           cfg.dtype)
+            cv = jnp.zeros_like(ck)
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, 0, 0))
+            out = (ck, cv)
         # Causal attention within the prompt: same dispatcher as the
         # training forward (pallas flash on TPU when shapes allow).
         from ..ops.attention import attention  # noqa: PLC0415
@@ -99,12 +152,19 @@ def prefill(
             B, S, cfg.n_heads * cfg.head_dim)
         h = h + attn @ lp["wo"].astype(cfg.dtype)
         h = h + _mlp(cfg, h, lp)
-        return h, (ck, cv)
+        return h, out
 
-    x, (cks, cvs) = jax.lax.scan(body, x, params["layers"])
+    x, caches = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x[:, -1:], params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
-    cache = KVCache(k=cks, v=cvs, length=jnp.asarray(S, jnp.int32))
+    length = jnp.asarray(S, jnp.int32)
+    if quantized:
+        cks, cvs, sks, svs = caches
+        cache = KVCache(k=cks, v=cvs, length=length,
+                        k_scale=sks, v_scale=svs)
+    else:
+        cks, cvs = caches
+        cache = KVCache(k=cks, v=cvs, length=length)
     return logits[:, 0], cache
 
 
@@ -117,24 +177,49 @@ def decode_step(
     x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # [B,1,D]
     positions = jnp.full((B, 1), pos, jnp.int32)
 
+    quantized = cache.k_scale is not None
+
     def body(carry, layer_in):
         h = carry
-        lp, ck, cv = layer_in
+        if quantized:
+            lp, ck, cv, sk, sv = layer_in
+        else:
+            lp, ck, cv = layer_in
+            sk = sv = None
         q, k, v = _project_qkv(cfg, h, lp, positions)
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-        attn = _attend_cached(cfg, q, ck, cv, pos + 1)
+        if quantized:
+            qk, ksc = _quantize_kv(k)
+            qv, vsc = _quantize_kv(v)
+            ck = jax.lax.dynamic_update_slice(ck, qk, (0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, qv, (0, pos, 0, 0))
+            sk = jax.lax.dynamic_update_slice(sk, ksc, (0, pos, 0, 0))
+            sv = jax.lax.dynamic_update_slice(sv, vsc, (0, pos, 0, 0))
+        else:
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+        attn = _attend_cached(cfg, q, ck, cv, pos + 1,
+                              k_scale=sk, v_scale=sv)
         attn = attn.reshape(B, 1, cfg.n_heads * cfg.head_dim)
         h = h + attn @ lp["wo"].astype(cfg.dtype)
         h = h + _mlp(cfg, h, lp)
-        return h, (ck, cv)
+        return h, ((ck, cv, sk, sv) if quantized else (ck, cv))
 
-    x, (cks, cvs) = jax.lax.scan(
-        body, x, (params["layers"], cache.k, cache.v)
-    )
+    if quantized:
+        x, (cks, cvs, sks, svs) = jax.lax.scan(
+            body, x,
+            (params["layers"], cache.k, cache.v,
+             cache.k_scale, cache.v_scale),
+        )
+        new_cache = KVCache(k=cks, v=cvs, length=pos + 1,
+                            k_scale=sks, v_scale=svs)
+    else:
+        x, (cks, cvs) = jax.lax.scan(
+            body, x, (params["layers"], cache.k, cache.v)
+        )
+        new_cache = KVCache(k=cks, v=cvs, length=pos + 1)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
-    return logits[:, 0], KVCache(k=cks, v=cvs, length=pos + 1)
+    return logits[:, 0], new_cache
 
 
 def _check_budget(prompt_len: int, max_new_tokens: int, max_len: int):
@@ -155,8 +240,10 @@ def _generate_impl(
     max_new_tokens: int,
     max_len: int,
     temperature: float,
+    kv_quant: bool = False,
 ) -> jax.Array:
-    logits, cache = prefill(params, prompt, cfg, max_len)
+    logits, cache = prefill(params, prompt, cfg, max_len,
+                            quantized=kv_quant)
 
     def sample(logits, key):
         if temperature > 0:
@@ -178,7 +265,8 @@ def _generate_impl(
 
 _generate_jit = jax.jit(
     _generate_impl,
-    static_argnames=("cfg", "max_new_tokens", "max_len", "temperature"),
+    static_argnames=("cfg", "max_new_tokens", "max_len", "temperature",
+                     "kv_quant"),
 )
 
 
@@ -190,14 +278,21 @@ def generate(
     max_len: int,
     temperature: float = 0.0,
     key: jax.Array | None = None,
+    kv_quant: bool = False,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled generation; returns [B,
-    max_new_tokens]."""
+    max_new_tokens].
+
+    ``kv_quant=True`` stores the KV cache int8 with per-vector scales:
+    the cache is re-read in full every decode step, so halving it
+    halves the dominant HBM traffic of large-batch serving (accuracy
+    bound tested in tests/test_decode.py; throughput in
+    docs/benchmarks.md)."""
     if key is None:
         key = jax.random.PRNGKey(0)
     _check_budget(prompt.shape[1], max_new_tokens, max_len)
     return _generate_jit(params, prompt, key, cfg, max_new_tokens,
-                         max_len, temperature)
+                         max_len, temperature, kv_quant)
 
 
 def make_sharded_generate(
@@ -206,6 +301,7 @@ def make_sharded_generate(
     max_new_tokens: int,
     max_len: int,
     temperature: float = 0.0,
+    kv_quant: bool = False,
 ):
     """Multi-chip serving: generate() jitted over a (dp, fsdp, tp) mesh.
 
@@ -241,7 +337,8 @@ def make_sharded_generate(
 
     jitted = jax.jit(
         partial(_generate_impl, cfg=cfg, max_new_tokens=max_new_tokens,
-                max_len=max_len, temperature=temperature),
+                max_len=max_len, temperature=temperature,
+                kv_quant=kv_quant),
         in_shardings=(param_shard, prompt_shard, repl),
         out_shardings=prompt_shard,
     )

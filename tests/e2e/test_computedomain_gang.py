@@ -131,6 +131,10 @@ class GangCluster:
                 node, os.path.join(ndir, "reg"),
                 os.path.join(ndir, "cdi"), self.kube,
                 pod_ip=pod_ip,
+                # Gang pods pay rendezvous wait + two CPU compiles;
+                # under full-suite load that can exceed the default
+                # 300 s run budget.
+                run_deadline_s=600.0,
                 extra_env={
                     "KUBE_API": self.apiserver.url,
                     "PYTHONPATH": _repo_pythonpath(),
@@ -205,8 +209,12 @@ def workload_pod(namespace, name, rct_name):
                     "--steps", "2",
                 ],
                 # A hung rendezvous must fail inside the pod run budget
-                # so the assertion message carries the real diagnosis.
-                "env": [{"name": "TPU_INIT_TIMEOUT_S", "value": "120"}],
+                # so the assertion message carries the real diagnosis --
+                # but the budget must absorb full-suite load skew: the
+                # two pods start tens of seconds apart when the host is
+                # busy, and the FIRST one's rendezvous clock starts at
+                # its own launch (a 120 s window flaked under load).
+                "env": [{"name": "TPU_INIT_TIMEOUT_S", "value": "240"}],
                 "resources": {"claims": [{"name": "channel"}]},
             }],
             "resourceClaims": [{
@@ -284,7 +292,7 @@ class TestComputeDomainGang:
             wait_for(
                 lambda: (phase("worker-0") == "Succeeded"
                          and phase("worker-1") == "Succeeded") or None,
-                timeout=420, desc="gang workers succeed")
+                timeout=600, desc="gang workers succeed")
         except AssertionError:
             print(gang.dump_logs())
             for name in ("worker-0", "worker-1"):

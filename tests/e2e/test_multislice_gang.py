@@ -113,7 +113,7 @@ class TestMultisliceGang:
             wait_for(
                 lambda: (phase("ms-worker-0") == "Succeeded"
                          and phase("ms-worker-1") == "Succeeded") or None,
-                timeout=420, desc="multislice workers succeed")
+                timeout=600, desc="multislice workers succeed")
         except AssertionError:
             print(ms_gang.dump_logs())
             for name in ("ms-worker-0", "ms-worker-1"):

@@ -90,8 +90,13 @@ class FakeNode:
     def __init__(self, node_name: str, registry_dir: str, cdi_root: str,
                  kube, poll: float = 0.3, pod_ip: str = "127.0.0.1",
                  extra_env: dict[str, str] | None = None,
-                 labels: dict[str, str] | None = None):
+                 labels: dict[str, str] | None = None,
+                 run_deadline_s: float | None = None):
         self.node_name = node_name
+        if run_deadline_s is not None:
+            # Instance override of the class default (gang e2es give
+            # their jax.distributed workloads a longer budget).
+            self.RUN_DEADLINE_S = run_deadline_s
         self.cdi_root = cdi_root
         self.kube = kube
         self.kubelet = FakeKubelet(registry_dir)

@@ -91,6 +91,66 @@ class TestDecode:
         assert int(cache.length) == 0
 
 
+class TestInt8KVCache:
+    """int8 KV cache vs the native-dtype path: a bandwidth trade, not
+    an accuracy rewrite -- logits must track closely and the quantizer
+    itself must bound its per-vector error."""
+
+    def test_quantize_roundtrip_error_bound(self):
+        from k8s_dra_driver_gpu_tpu.models.decode import (
+            _dequantize,
+            _quantize_kv,
+        )
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 9, 2, 32),
+                              jnp.float32)
+        q, s = _quantize_kv(x)
+        assert q.dtype == jnp.int8
+        back = _dequantize(q, s, jnp.float32)
+        err = np.abs(np.asarray(back) - np.asarray(x))
+        amax = np.abs(np.asarray(x)).max(axis=-1, keepdims=True)
+        # Symmetric int8 rounding (scale/2 = amax/254) plus the bf16
+        # scale's own rounding (<= 2^-8 relative on the dequantized
+        # value).
+        assert (err <= amax * (1 / 254 + 2 ** -8) + 1e-6).all()
+
+    def test_quantized_decode_logits_track_fp(self):
+        params = llama.init(jax.random.PRNGKey(0), CFG)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                    CFG.vocab_size)
+        lf, cf = prefill(params, prompt, CFG, max_len=32)
+        lq, cq = prefill(params, prompt, CFG, max_len=32, quantized=True)
+        assert cq.k.dtype == jnp.int8 and cq.k_scale is not None
+        # Prefill logits come from the un-quantized activations either
+        # way -- identical.
+        np.testing.assert_allclose(np.asarray(lf), np.asarray(lq),
+                                   atol=1e-5)
+        nxt = jnp.array([3, 11], jnp.int32)
+        lf2, _ = decode_step(params, cf, nxt, CFG)
+        lq2, cq2 = decode_step(params, cq, nxt, CFG)
+        assert int(cq2.length) == 9
+        assert cq2.k.dtype == jnp.int8
+        # Cached-attention logits through the int8 cache: close in
+        # absolute terms and rank-consistent at the top.
+        lf2, lq2 = np.asarray(lf2), np.asarray(lq2)
+        denom = np.maximum(np.abs(lf2).max(), 1e-6)
+        assert np.abs(lf2 - lq2).max() / denom < 0.05, \
+            np.abs(lf2 - lq2).max()
+        assert (lf2.argmax(-1) == lq2.argmax(-1)).all()
+
+    def test_quantized_greedy_tracks_fp_tokens(self):
+        params = llama.init(jax.random.PRNGKey(0), CFG)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 6), 0,
+                                    CFG.vocab_size)
+        fp = generate(params, prompt, CFG, max_new_tokens=6, max_len=32)
+        q8 = generate(params, prompt, CFG, max_new_tokens=6, max_len=32,
+                      kv_quant=True)
+        # An untrained tiny model has near-flat logits (the hardest
+        # case for rank stability); still demand strong agreement.
+        agree = (np.asarray(fp) == np.asarray(q8)).mean()
+        assert agree >= 0.5, (agree, np.asarray(fp), np.asarray(q8))
+
+
 class TestShardedGenerate:
     def test_sharded_greedy_matches_single_device(self):
         mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
@@ -125,3 +185,20 @@ class TestShardedGenerate:
         mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=4))
         with pytest.raises(ValueError, match="n_kv_heads"):
             make_sharded_generate(mesh, CFG, max_new_tokens=2, max_len=16)
+
+    def test_sharded_int8_matches_single_device_int8(self):
+        """kv_quant composes with the sharded path: the tp-sharded
+        int8 cache (codes AND per-vector scales shard on the kv-head
+        dim) must reproduce the single-device int8 tokens exactly."""
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=2))
+        params = llama.init(jax.random.PRNGKey(0), CFG)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0,
+                                    CFG.vocab_size)
+        single = generate(params, prompt, CFG, max_new_tokens=5,
+                          max_len=32, kv_quant=True)
+        gen_fn, prompt_shard, place = make_sharded_generate(
+            mesh, CFG, max_new_tokens=5, max_len=32, kv_quant=True)
+        sharded = gen_fn(place(params), jax.device_put(prompt,
+                                                       prompt_shard))
+        np.testing.assert_array_equal(np.asarray(single),
+                                      np.asarray(sharded))
