@@ -20,6 +20,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -37,6 +38,45 @@ class _PodRecord:
         self.done = False
         self.deleted = threading.Event()  # pod object gone: tear down
         self.failed_msg = ""
+        self.thread: threading.Thread | None = None
+        self.procs: list[subprocess.Popen] = []  # live container processes
+
+
+try:
+    import ctypes
+
+    _LIBC = ctypes.CDLL("libc.so.6", use_errno=True)
+except OSError:  # pragma: no cover - non-glibc host
+    _LIBC = None
+_PR_SET_PDEATHSIG = 1
+_SIGTERM = int(signal.SIGTERM)
+
+
+def _container_preexec() -> None:
+    """Between fork and exec of a pod container: own session plus
+    parent-death signal. A killed test process (pytest -x, timeout,
+    SIGKILL) must never leak pod containers -- leaked daemon pods keep
+    respawning their coordination children forever and starve the
+    host, which is exactly how the gang e2e went from ~13 s to
+    minutes-and-flaky. Runs post-fork in a multithreaded parent, so
+    the body must not import or allocate -- everything is precomputed
+    at module scope."""
+    os.setsid()
+    if _LIBC is not None:
+        _LIBC.prctl(_PR_SET_PDEATHSIG, _SIGTERM, 0, 0, 0)
+
+
+def _signal_container(proc: subprocess.Popen, sig: int) -> None:
+    """Signal the container's whole process GROUP (it leads its own
+    session via _container_preexec), so supervisor-style containers
+    take their spawned children down with them."""
+    try:
+        os.killpg(proc.pid, sig)
+    except (ProcessLookupError, PermissionError):
+        try:
+            proc.send_signal(sig)
+        except ProcessLookupError:
+            pass
 
 
 def resolve_cdi_devices(cdi_root: str, device_ids: list[str]) -> dict:
@@ -315,16 +355,18 @@ class FakeNode:
                         open(log_path, "a", encoding="utf-8") as lf:
                     proc = subprocess.Popen(
                         command, env=env, stdin=devnull, stdout=lf,
-                        stderr=subprocess.STDOUT, text=True)
+                        stderr=subprocess.STDOUT, text=True,
+                        preexec_fn=_container_preexec)
+                rec.procs.append(proc)
                 deadline = time.monotonic() + self.RUN_DEADLINE_S
                 while proc.poll() is None:
                     if rec.deleted.is_set() or \
                             time.monotonic() > deadline:
-                        proc.terminate()
+                        _signal_container(proc, signal.SIGTERM)
                         try:
                             proc.wait(timeout=10)
                         except subprocess.TimeoutExpired:
-                            proc.kill()
+                            _signal_container(proc, signal.SIGKILL)
                             proc.wait()
                         break
                     time.sleep(0.2)
@@ -416,15 +458,16 @@ class FakeNode:
                         proc = subprocess.Popen(
                             command, env=env, stdin=devnull,
                             stdout=log_file, stderr=subprocess.STDOUT,
-                            text=True,
+                            text=True, preexec_fn=_container_preexec,
                         )
+                    rec.procs.append(proc)
                     while proc.poll() is None:
                         if rec.deleted.is_set():
-                            proc.terminate()
+                            _signal_container(proc, signal.SIGTERM)
                             try:
                                 proc.wait(timeout=10)
                             except subprocess.TimeoutExpired:
-                                proc.kill()
+                                _signal_container(proc, signal.SIGKILL)
                                 proc.wait()
                             return
                         time.sleep(0.2)
@@ -502,6 +545,7 @@ class FakeNode:
             self._records[uid] = rec
             t = threading.Thread(target=self._run_pod, name=f"pod-{uid}",
                                  args=(pod, claims), daemon=True)
+            rec.thread = t
             t.start()
         # Deleted pods: signal the pod thread (long-running containers
         # get SIGTERM), then unprepare claims once it wound down
@@ -533,3 +577,22 @@ class FakeNode:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=10)
+        # Drain every still-running pod container (a real kubelet
+        # drains its pods on shutdown). Without this the daemon pods
+        # and their supervised children outlive the test process and
+        # pile up across runs.
+        records = list(self._records.values())
+        for rec in records:
+            rec.deleted.set()
+        for rec in records:
+            if rec.thread and rec.thread.is_alive():
+                rec.thread.join(timeout=15)
+            for proc in rec.procs:
+                if proc.poll() is None:
+                    _signal_container(proc, signal.SIGKILL)
+                    try:
+                        proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        pass
+        if not (self._thread and self._thread.is_alive()):
+            self._records.clear()
