@@ -343,9 +343,10 @@ def bench_model_step_pipelined() -> dict | None:
 def bench_model_flagship() -> dict | None:
     """Flagship-class single-chip training point: the largest
     flagship-shaped model (head_dim 128, GQA, 738M params --
-    LlamaConfig.flagship) that fits next to fp32 Adam on one 16 GB
-    v5e, at its tuned batch point (B=64, S=512, K=16 pipelined, full
-    remat, chunked loss, bf16 first moment). docs/benchmarks.md has
+    LlamaConfig.flagship) that fits on one 16 GB v5e with the
+    bf16-first-moment Adam recipe (fp32 second moment and master
+    params), at its tuned batch point (B=64, S=512, K=16 pipelined,
+    full remat, chunked loss). docs/benchmarks.md has
     the sweep + the hd=128 flash-vs-einsum A/B behind the attention
     dispatcher's FLASH_MIN_SEQ crossover."""
     dev = _tpu_device_or_none()

@@ -316,7 +316,15 @@ class CDDeviceState:
                 f"ComputeDomain {cd['metadata']['name']}: {len(cliques)}"
                 f" clique(s) registered, want numSlices={num_slices}")
         if num_slices == 1:
-            # Single slice: whatever clique id the nodes carry.
+            # Single slice: exactly one clique id may be registered --
+            # collapsing several onto slice 0 would collide their
+            # clique-local indices in by_gid and hand duplicate
+            # TPU_PROCESS_ID values to different pods.
+            if len(cliques) > 1:
+                raise RetryableError(
+                    f"ComputeDomain {cd['metadata']['name']}: numSlices=1"
+                    f" but {len(cliques)} cliques registered ({cliques});"
+                    " refusing to assign colliding process ids")
             cliques = cliques or ["0"]
             slice_of = dict.fromkeys(cliques, 0)
         else:

@@ -63,7 +63,8 @@ class LlamaConfig:
     def flagship() -> "LlamaConfig":
         """The flagship single-chip training config: the largest
         flagship-SHAPED model (head_dim 128, 2:1 GQA, SwiGLU ratio 3)
-        that trains with fp32 Adam state on one 16 GB v5e chip --
+        that trains on one 16 GB v5e chip with a bf16 first moment
+        (fp32 second moment and master params) --
         738M params, 12 layers, d_model 2048. Chunked loss (the
         [B,S,V] logits never materialize) is what makes it fit at the
         MFU-optimal batch; pair with

@@ -328,15 +328,17 @@ def run(argv: list[str] | None = None) -> int:
                 "or pick the right --model"
             )
 
+        # Sibling iterators (pp-replica feeding, below) are built once
+        # per shard id, not per step: batch() is pure, so every process
+        # reconstructs identical rows from the cached iterator.
+        siblings = {shard_id: it}
+
         def shard_batch(step: int, sid: int):
-            if sid == shard_id:
-                return it.batch(step)
-            # Another shard's rows (pp-replica feeding, below): a
-            # sibling iterator with that shard's identity -- batch() is
-            # pure, so every process reconstructs identical rows.
-            other = ShardedBatchIterator(
-                ds, global_batch=global_batch,
-                num_shards=num_shards, shard_id=sid)
+            other = siblings.get(sid)
+            if other is None:
+                other = siblings[sid] = ShardedBatchIterator(
+                    ds, global_batch=global_batch,
+                    num_shards=num_shards, shard_id=sid)
             return other.batch(step)
 
         def local_batch(step: int):

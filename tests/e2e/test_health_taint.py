@@ -102,3 +102,81 @@ class TestHealthTaintFlow:
         kube.delete(*RES, "resourceclaims", "healthy-three", "default")
         wait_for(lambda: allocation(kube, "whole-host"), timeout=30,
                  desc="whole-host claim after recovery")
+
+
+class TestRepublishStorm:
+    """Rapid taint/untaint churn against the live plugin
+    (test_gpu_robustness.bats republish analog): every republish bumps
+    the pool generation monotonically, the slice set never grows
+    (no leaks from repeated publication), and the storm settles with
+    zero taints and the original slice names."""
+
+    @pytest.fixture(scope="class")
+    def storm_cluster(self, tmp_path_factory):
+        from tests.e2e.framework import PluginCluster
+
+        tmp = tmp_path_factory.mktemp("storm")
+        ctl = tmp / "health.ctl"
+        c = PluginCluster(
+            tmp, "node-storm",
+            plugin_args=["--mock-topology", "v5e-4"],
+            plugin_env={
+                "TPULIB_MOCK_HEALTH_EVENTS": f"@{ctl}",
+                # Tight poll so the storm actually storms.
+                "TPU_DRA_HEALTH_POLL_S": "0.2",
+            },
+            with_node=False)
+        yield c.kube, ctl
+        c.stop()
+
+    def _pool_slices(self, kube):
+        return [s for s in kube.list(*RES, "resourceslices")
+                if s["spec"].get("driver") == "tpu.dra.dev"
+                and s["spec"].get("nodeName") == "node-storm"]
+
+    def _generation(self, slices):
+        gens = {s["spec"]["pool"]["generation"] for s in slices}
+        assert len(gens) == 1, f"pool generation split: {gens}"
+        return gens.pop()
+
+    def test_storm_generation_monotone_no_slice_leaks(self, storm_cluster):
+        import time
+
+        kube, ctl = storm_cluster
+        initial = wait_for(lambda: self._pool_slices(kube) or None,
+                           timeout=90, desc="initial publication")
+        names0 = sorted(s["metadata"]["name"] for s in initial)
+        count0 = len(names0)
+        gen = self._generation(initial)
+        observed = [gen]
+
+        # 6 taint/untaint cycles; each transition is observed before
+        # the next is injected, so every cycle forces two republishes.
+        for cycle in range(6):
+            chip = cycle % 4
+            ctl.write_text(f"chip={chip},kind=hbm_uncorrectable\n")
+            wait_for(lambda c=chip: chip_taints(kube, f"chip-{c}") or None,
+                     timeout=30, desc=f"cycle {cycle}: taint up")
+            slices = self._pool_slices(kube)
+            observed.append(self._generation(slices))
+            assert len(slices) == count0, (
+                f"slice leak while tainted: {len(slices)} != {count0}")
+            ctl.write_text("")
+            wait_for(
+                lambda c=chip: (not chip_taints(kube, f"chip-{c}")) or None,
+                timeout=30, desc=f"cycle {cycle}: taint cleared")
+            slices = self._pool_slices(kube)
+            observed.append(self._generation(slices))
+
+        # Strict monotonicity across every observed republish: a stale
+        # write would show as a repeat or a regression.
+        for a, b in zip(observed, observed[1:]):
+            assert b > a, f"pool generation not monotone: {observed}"
+
+        # Settled: same slice names as the initial publication (nothing
+        # leaked, nothing lost), all taints gone on every chip.
+        time.sleep(1)
+        final = self._pool_slices(kube)
+        assert sorted(s["metadata"]["name"] for s in final) == names0
+        for c in range(4):
+            assert chip_taints(kube, f"chip-{c}") == []

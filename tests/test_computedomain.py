@@ -639,6 +639,102 @@ class TestFourDaemonFailover:
                 d.process.stop()
 
 
+class TestSixDaemonRollingChurn:
+    """DNS-mode membership churn at width 6 (test_cd_failover.bats
+    scale analog): daemons are replaced one at a time with new pod IPs
+    (DaemonSet pod recreation). In DNS-names mode the SURVIVING
+    daemons' coordination children must never restart -- membership
+    changes land as hosts-file rewrites + SIGUSR1 nudges only -- and
+    every hosts file converges to the final IP set."""
+
+    PORTS = tuple(17101 + i for i in range(6))
+
+    def _sync_all(self, daemons):
+        for d in daemons:
+            d._last_members = None
+            d.sync_once()
+
+    def test_rolling_replacement_never_restarts_survivors(
+            self, kube, controller, tmp_path):
+        for i in range(2, 6):
+            kube.create("", "v1", "nodes",
+                        {"kind": "Node", "metadata": {"name": f"node-{i}"}})
+        cd = make_cd(kube, topology="6x2x2")  # 24 chips / 4 per host
+        uid = cd["metadata"]["uid"]
+        controller.reconcile(cd)
+
+        daemons = [
+            make_daemon(kube, tmp_path, uid, f"node-{i}", "127.0.0.1",
+                        self.PORTS[i], num_workers=6)
+            for i in range(6)
+        ]
+        try:
+            for i, d in enumerate(daemons):
+                assert d.cfg.dns_names  # DNS mode is the default gate
+                assert d.registrar.register() == i
+                d.process.ensure_started()
+            for port in self.PORTS:
+                wait_for_service(port)
+            self._sync_all(daemons)
+            for d in daemons:
+                d.registrar.set_status("Ready")
+            self._sync_all(daemons)
+            assert query("127.0.0.1", self.PORTS[0], "STATUS") == "READY"
+
+            # Three rolling replacements: daemons 1, 3, 5 are torn down
+            # and come back as fresh pods with NEW pod IPs, re-claiming
+            # their node's slot.
+            for gen, victim_idx in enumerate((1, 3, 5)):
+                survivors = [d for i, d in enumerate(daemons)
+                             if i != victim_idx]
+                pids_before = {id(d): d.process.pid for d in survivors}
+                daemons[victim_idx].process.stop()
+                replacement = make_daemon(
+                    kube, tmp_path, uid, f"node-{victim_idx}",
+                    f"10.9.{gen}.{victim_idx}", self.PORTS[victim_idx],
+                    num_workers=6)
+                assert replacement.registrar.register() == victim_idx
+                daemons[victim_idx] = replacement
+                self._sync_all(daemons)
+                replacement.registrar.set_status("Ready")
+                self._sync_all(daemons)
+                # DNS mode: membership change must NOT restart any
+                # surviving child -- pids are stable across the churn.
+                for d in survivors:
+                    assert d.process.pid == pids_before[id(d)], (
+                        "DNS-mode daemon restarted its child on a "
+                        "membership change")
+
+            # Every surviving daemon's hosts file carries the final IP
+            # of every replaced slot (rewritten in place, no restart).
+            final_ips = {1: "10.9.0.1", 3: "10.9.1.3", 5: "10.9.2.5"}
+            for i, d in enumerate(daemons):
+                if i in final_ips:
+                    continue
+                hosts = (tmp_path / f"node-{i}" / "hosts").read_text()
+                for slot, ip in final_ips.items():
+                    assert f"{ip}\t{daemon_dns_name(slot)}" in hosts, (
+                        f"node-{i} hosts file missing {ip} for slot {slot}")
+
+            # Quorum view: 6 workers, still READY, on an untouched
+            # daemon's coordination service.
+            members = json.loads(
+                query("127.0.0.1", self.PORTS[0], "MEMBERS"))
+            assert members["numWorkers"] == 6
+            assert len(members["workers"]) == 6
+            assert query("127.0.0.1", self.PORTS[0], "STATUS") == "READY"
+
+            controller.update_global_status(
+                kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                         namespace="team-a"))
+            cd2 = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                           namespace="team-a")
+            assert cd2["status"]["status"] == "Ready"
+        finally:
+            for d in daemons:
+                d.process.stop()
+
+
 class TestMultislice:
     """Cross-slice domains: spec.numSlices > 1 splits numNodes hosts
     over ICI slices (one clique per slice); the channel env becomes a

@@ -190,7 +190,7 @@ class TestShardedGenerate:
         """kv_quant composes with the sharded path: the tp-sharded
         int8 cache (codes AND per-vector scales shard on the kv-head
         dim) must reproduce the single-device int8 tokens exactly."""
-        mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=2))
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
         params = llama.init(jax.random.PRNGKey(0), CFG)
         prompt = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0,
                                     CFG.vocab_size)

@@ -1,0 +1,187 @@
+"""Contract tests for the kind cluster scripts + workflow.
+
+The kind leg has never executed anywhere (no container tooling in this
+environment), so these tests pin down everything checkable WITHOUT
+kind/docker/helm -- bash syntax, the embedded kind config, every
+`--set` key against the chart's real values/schema, the rollout target
+against the chart's rendered DaemonSet name, and the workflow's script
+paths -- so the first real execution fails on substance, not typos.
+
+Reference analog: hack/ci/mock-nvml/ scripts validated by CI before
+the mock-NVML kind pipeline runs them.
+"""
+
+import os
+import re
+import subprocess
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+KIND_DIR = os.path.join(REPO, "demo", "clusters", "kind")
+CHART = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
+WORKFLOW = os.path.join(REPO, ".github", "workflows", "kind-e2e.yaml")
+
+SCRIPTS = sorted(
+    f for f in os.listdir(KIND_DIR) if f.endswith(".sh")
+)
+
+
+def script(name: str) -> str:
+    with open(os.path.join(KIND_DIR, name), encoding="utf-8") as f:
+        return f.read()
+
+
+class TestScriptHygiene:
+    @pytest.mark.parametrize("name", SCRIPTS)
+    def test_bash_syntax(self, name):
+        out = subprocess.run(
+            ["bash", "-n", os.path.join(KIND_DIR, name)],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+
+    @pytest.mark.parametrize("name", SCRIPTS)
+    def test_strict_mode_and_shebang(self, name):
+        text = script(name)
+        assert text.startswith("#!/usr/bin/env bash"), name
+        assert "set -euo pipefail" in text, name
+
+    @pytest.mark.parametrize("name", SCRIPTS)
+    def test_executable_bit(self, name):
+        assert os.access(os.path.join(KIND_DIR, name), os.X_OK), (
+            f"{name} is not executable; the workflow invokes it directly")
+
+    @pytest.mark.parametrize("name", SCRIPTS)
+    def test_referenced_repo_paths_exist(self, name):
+        """Any path the script derives from REPO_ROOT must exist --
+        a renamed Dockerfile or chart dir should fail here, not on the
+        first CI run."""
+        text = script(name)
+        for m in re.finditer(r'"\$\{REPO_ROOT\}/([^"$]+)"', text):
+            rel = m.group(1)
+            assert os.path.exists(os.path.join(REPO, rel)), (
+                f"{name} references missing path {rel}")
+
+
+class TestCreateClusterContract:
+    def _kind_config(self) -> dict:
+        """Extract and parse the heredoc kind config."""
+        text = script("create-cluster.sh")
+        m = re.search(r"--config -\n(.*?)\nEOF", text, re.S)
+        assert m, "create-cluster.sh lost its heredoc kind config"
+        return yaml.safe_load(m.group(1))
+
+    def test_kind_config_parses_with_dra_and_cdi(self):
+        cfg = self._kind_config()
+        assert cfg["kind"] == "Cluster"
+        assert cfg["apiVersion"] == "kind.x-k8s.io/v1alpha4"
+        # DRA is GA in the pinned k8s, but the explicit gate keeps the
+        # config valid for older kindest images too.
+        assert cfg["featureGates"]["DynamicResourceAllocation"] is True
+        patches = "\n".join(cfg.get("containerdConfigPatches", []))
+        assert "enable_cdi = true" in patches, (
+            "CDI must be enabled or the runtime ignores the driver's specs")
+
+    def test_two_workers_for_computedomain_e2e(self):
+        roles = [n["role"] for n in self._kind_config()["nodes"]]
+        assert roles.count("worker") >= 2, (
+            "ComputeDomain gang e2e needs two schedulable nodes")
+
+    def test_pinned_k8s_supports_split_publication(self):
+        """Split-mode ResourceSlices (KEP-4815 counters) need server
+        >= 1.35 -- the publication auto-sniff keys off this."""
+        m = re.search(r"kindest/node:v(\d+)\.(\d+)",
+                      script("create-cluster.sh"))
+        assert m, "K8S_IMAGE default no longer pins a kindest/node tag"
+        assert (int(m.group(1)), int(m.group(2))) >= (1, 35)
+
+
+class TestInstallContract:
+    def _set_pairs(self) -> dict:
+        pairs = {}
+        for m in re.finditer(r'--set\s+([\w.]+)="?([^"\s\\]*)"?',
+                             script("install-dra-driver-tpu.sh")):
+            pairs[m.group(1)] = m.group(2)
+        assert pairs, "install script sets no chart values?"
+        return pairs
+
+    def test_every_set_key_exists_in_chart_values(self):
+        with open(os.path.join(CHART, "values.yaml"),
+                  encoding="utf-8") as f:
+            values = yaml.safe_load(f)
+        for key in self._set_pairs():
+            node = values
+            for part in key.split("."):
+                assert isinstance(node, dict) and part in node, (
+                    f"--set {key} has no counterpart in values.yaml; "
+                    "helm would silently accept the typo")
+                node = node[part]
+
+    def test_rendered_install_matches_rollout_target(self):
+        """Render the chart with the install script's values (nodeSelector
+        and tolerations nulled, mock topology on) and check the DaemonSet
+        the script waits for actually exists under that name/namespace."""
+        from k8s_dra_driver_gpu_tpu.pkg.chartrender import (
+            manifests,
+            render_chart,
+        )
+
+        docs = manifests(render_chart(CHART, {
+            "image": {"repository": "tpu-dra-driver", "tag": "0.2.0-dev",
+                      "pullPolicy": "Never"},
+            "kubeletPlugin": {"mockTopology": "v5e-4",
+                              "nodeSelector": None, "tolerations": None},
+        }))
+        ds = [d for d in docs if d["kind"] == "DaemonSet"
+              and d["metadata"]["name"] == "tpu-dra-kubelet-plugin"]
+        assert ds, "install script rollout-waits on a DS the chart "\
+            "no longer renders"
+        text = script("install-dra-driver-tpu.sh")
+        assert "rollout status ds/tpu-dra-kubelet-plugin" in text
+        m = re.search(r"--namespace (\S+)", text)
+        assert m and ds[0]["metadata"]["namespace"] == m.group(1)
+        # Mock mode must not keep the TPU-node selector: the kind
+        # workers carry no GKE TPU labels.
+        spec = ds[0]["spec"]["template"]["spec"]
+        assert not spec.get("nodeSelector"), (
+            "nodeSelector survived the null override; the DS would "
+            "never schedule on kind workers")
+
+    def test_image_tag_default_matches_chart_app_version(self):
+        """build-image.sh tags with VERSION minus the v prefix and
+        install passes it through; the chart's appVersion (the default
+        tag) must agree so a bare `helm install` after a side-load
+        finds the loaded image."""
+        with open(os.path.join(REPO, "VERSION"), encoding="utf-8") as f:
+            version = f.read().strip()
+        with open(os.path.join(CHART, "Chart.yaml"),
+                  encoding="utf-8") as f:
+            chart = yaml.safe_load(f)
+        assert chart["appVersion"] == version.lstrip("v")
+
+
+class TestWorkflowContract:
+    def test_workflow_scripts_exist_and_steps_are_wired(self):
+        with open(WORKFLOW, encoding="utf-8") as f:
+            wf = yaml.safe_load(f)
+        runs = []
+        for job in wf["jobs"].values():
+            for step in job["steps"]:
+                if "run" in step:
+                    runs.append(step["run"])
+        blob = "\n".join(runs)
+        for m in re.finditer(r"\./demo/clusters/kind/([\w.-]+\.sh)", blob):
+            assert os.path.exists(os.path.join(KIND_DIR, m.group(1))), (
+                f"workflow runs missing script {m.group(1)}")
+        # The publication wait greps for the driver's slices.
+        assert "resourceslices" in blob and "grep -q tpu" in blob
+
+    def test_fake_tier_runs_without_cluster_env(self):
+        """The e2e-fake job must NOT set TPU_DRA_E2E (that flips the
+        suite into live-cluster mode and every test would fail off-kind)."""
+        with open(WORKFLOW, encoding="utf-8") as f:
+            wf = yaml.safe_load(f)
+        fake = wf["jobs"]["e2e-fake"]
+        for step in fake["steps"]:
+            assert "TPU_DRA_E2E" not in str(step.get("env", {}))

@@ -293,6 +293,55 @@ class TestEndToEndEnforcement:
         finally:
             s2.stop()
 
+    def test_agent_sigkill_with_held_claim_reowned(self, state):
+        """The agent is SIGKILLed while its claim is HELD (not across a
+        clean plugin restart): the supervisor watchdog respawns it, the
+        respawn reloads grants from disk, and admission continues from
+        the pre-kill budget -- a tenant admitted before the kill still
+        counts, so the post-kill over-budget tenant is denied.
+        Reference analog: test_gpu_robustness.bats MPS-daemon kill."""
+        import signal as _signal
+        import time as _time
+
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.tenancy_agent import query
+
+        self._prepare_tenancy_claim(state, max_clients=2)
+        d = state._tenancy._dir("c1", "tpu")
+        assert query(d, "STATUS") == "READY"
+        assert query(d, "REGISTER tenant-a 1073741824").startswith("OK")
+
+        with open(os.path.join(d, "agent.pid")) as f:
+            pid = int(f.read().split()[0])
+        os.kill(pid, _signal.SIGKILL)
+
+        # The watchdog respawns it; the fresh agent rebinds agent.sock
+        # and answers READY again without any plugin action.
+        deadline = _time.monotonic() + 15
+        ready = False
+        while _time.monotonic() < deadline:
+            try:
+                if query(d, "STATUS", timeout=1.0) == "READY":
+                    with open(os.path.join(d, "agent.pid")) as f:
+                        if int(f.read().split()[0]) != pid:
+                            ready = True
+                            break
+            except OSError:
+                pass
+            _time.sleep(0.1)
+        assert ready, "agent not respawned after SIGKILL"
+
+        # Grant continuity: tenant-a survived on disk, so the budget
+        # still counts it -- one more fits, a third is denied.
+        members = json.loads(query(d, "MEMBERS"))
+        assert "tenant-a" in members["clients"]
+        assert query(d, "REGISTER tenant-b 1073741824").startswith("OK")
+        assert query(d, "REGISTER tenant-c 1073741824").startswith("DENIED")
+
+        # The claim is still fully operational: unprepare tears the
+        # respawned agent down cleanly.
+        state.unprepare("c1")
+        assert not os.path.isdir(d)
+
     def test_unprepare_stops_agent_and_removes_dir(self, state):
         self._prepare_tenancy_claim(state)
         d = state._tenancy._dir("c1", "tpu")
