@@ -263,19 +263,13 @@ def bench_model_step() -> dict | None:
     }
 
 
-def bench_model_step_pipelined() -> dict | None:
-    """The tuned single-chip configuration: K training steps under ONE
-    lax.scan in ONE jitted call (the production
-    ``train.scanned_train_step`` path, launcher ``--steps-per-call``),
-    fetching every loss once per call. This both amortizes the tunnel's
-    host round-trip over K steps and is how a real input pipeline
-    drives the chip (one dispatch per macro-batch, not one per
-    micro-step) -- fully synced (device_get of all K losses) yet 0.42+
-    MFU vs 0.26 for per-step sync at B=8 (docs/benchmarks.md has the
-    breakdown)."""
-    dev = _tpu_device_or_none()
-    if dev is None:
-        return None
+def _timed_train_point(dev, cfg, B, S, K, optimizer):
+    """Shared protocol for every scanned train-point bench: K steps
+    under one lax.scan per dispatch, compile+warm call first, then the
+    median of 3 dispatches with EVERY loss fetched (full sync -- the
+    tunnel elides un-fetched execution chains). Returns
+    (per-step seconds, MFU, n_params), or None when the result is
+    physically impossible (elision got through: distrust)."""
     from functools import partial
 
     import jax
@@ -283,28 +277,17 @@ def bench_model_step_pipelined() -> dict | None:
 
     from k8s_dra_driver_gpu_tpu.models import llama
     from k8s_dra_driver_gpu_tpu.train.train import (
-        make_optimizer,
         scanned_train_step,
         TrainState,
     )
 
-    # Tuned point from the round-3 sweep (docs/benchmarks.md): batch up
-    # to the arithmetic-intensity knee, shorter sequence to shrink the
-    # non-matmul share, K=16 for deeper sync amortization, FULL remat
-    # required -- at this size "dots"/"none" fail to compile (HBM OOM),
-    # and at B=16/S=1024 where they fit they are also slower ("dots"
-    # 0.396 vs full's 0.427).
-    B, S, K = 64, 512, 16
-    cfg = _bench_model_cfg()
     params = llama.init(jax.random.PRNGKey(0), cfg)
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    optimizer = make_optimizer()
     state = TrainState(params=params, opt_state=optimizer.init(params),
                        step=jnp.zeros((), jnp.int32))
     kind = dev.device_kind.lower().replace("tpu", "").replace(" ", "")
     peak = next((v for k, v in PEAK_FLOPS.items() if kind.startswith(k)),
                 197e12)
-
     scan_jit = jax.jit(
         partial(scanned_train_step, cfg=cfg, optimizer=optimizer),
         donate_argnums=(0,),
@@ -331,6 +314,36 @@ def bench_model_step_pipelined() -> dict | None:
     mfu = flops / dt / peak
     if mfu > 0.9:
         return None  # elided even through the per-call fetch: distrust
+    return dt, mfu, n_params
+
+
+def bench_model_step_pipelined() -> dict | None:
+    """The tuned single-chip configuration: K training steps under ONE
+    lax.scan in ONE jitted call (the production
+    ``train.scanned_train_step`` path, launcher ``--steps-per-call``),
+    fetching every loss once per call. This both amortizes the tunnel's
+    host round-trip over K steps and is how a real input pipeline
+    drives the chip (one dispatch per macro-batch, not one per
+    micro-step) -- fully synced (device_get of all K losses) yet 0.42+
+    MFU vs 0.26 for per-step sync at B=8 (docs/benchmarks.md has the
+    breakdown)."""
+    dev = _tpu_device_or_none()
+    if dev is None:
+        return None
+    from k8s_dra_driver_gpu_tpu.train.train import make_optimizer
+
+    # Tuned point from the round-3 sweep (docs/benchmarks.md): batch up
+    # to the arithmetic-intensity knee, shorter sequence to shrink the
+    # non-matmul share, K=16 for deeper sync amortization, FULL remat
+    # required -- at this size "dots"/"none" fail to compile (HBM OOM),
+    # and at B=16/S=1024 where they fit they are also slower ("dots"
+    # 0.396 vs full's 0.427).
+    B, S, K = 64, 512, 16
+    point = _timed_train_point(dev, _bench_model_cfg(), B, S, K,
+                               make_optimizer())
+    if point is None:
+        return None
+    dt, mfu, _ = point
     return {
         "model_step_pipelined_ms": round(dt * 1000, 2),
         "tokens_per_s_pipelined": round(B * S / dt),
@@ -352,59 +365,56 @@ def bench_model_flagship() -> dict | None:
     dev = _tpu_device_or_none()
     if dev is None:
         return None
-    from functools import partial
-
-    import jax
     import jax.numpy as jnp
 
     from k8s_dra_driver_gpu_tpu.models import llama
-    from k8s_dra_driver_gpu_tpu.train.train import (
-        make_optimizer,
-        scanned_train_step,
-        TrainState,
-    )
+    from k8s_dra_driver_gpu_tpu.train.train import make_optimizer
 
     B, S, K = 64, 512, 16
-    cfg = llama.LlamaConfig.flagship()
-    params = llama.init(jax.random.PRNGKey(0), cfg)
-    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    optimizer = make_optimizer(mu_dtype=jnp.bfloat16)
-    state = TrainState(params=params, opt_state=optimizer.init(params),
-                       step=jnp.zeros((), jnp.int32))
-    kind = dev.device_kind.lower().replace("tpu", "").replace(" ", "")
-    peak = next((v for k, v in PEAK_FLOPS.items() if kind.startswith(k)),
-                197e12)
-    scan_jit = jax.jit(
-        partial(scanned_train_step, cfg=cfg, optimizer=optimizer),
-        donate_argnums=(0,),
-    )
-
-    def fresh(seed):
-        t = jax.device_put(jax.random.randint(
-            jax.random.PRNGKey(seed), (K, B, S + 1), 0, cfg.vocab_size,
-            jnp.int32))
-        jax.block_until_ready(t)
-        return t
-
-    state, losses = scan_jit(state, fresh(0))  # compile + warm
-    jax.device_get(losses)
-    flops = 6.0 * n_params * B * S
-    per_step = []
-    for trial in range(1, 4):
-        toks = fresh(trial)
-        t0 = time.perf_counter()
-        state, losses = scan_jit(state, toks)
-        jax.device_get(losses)  # full sync: all K losses fetched
-        per_step.append((time.perf_counter() - t0) / K)
-    dt = statistics.median(per_step)
-    mfu = flops / dt / peak
-    if mfu > 0.9:
-        return None  # tunnel elision: distrust
+    point = _timed_train_point(
+        dev, llama.LlamaConfig.flagship(), B, S, K,
+        make_optimizer(mu_dtype=jnp.bfloat16))
+    if point is None:
+        return None
+    dt, mfu, n_params = point
     return {
         "mfu_flagship": round(mfu, 4),
         "flagship_step_ms": round(dt * 1000, 1),
         "flagship_tokens_per_s": round(B * S / dt),
         "flagship_params_m": round(n_params / 1e6, 1),
+    }
+
+
+def bench_model_longcontext() -> dict | None:
+    """Long-context flagship training point: S=4096 on the 738M model,
+    where the einsum path cannot even compile (O(B*H*S^2) fp32 score
+    transient) and the pallas flash kernel -- bf16 MXU matmuls forward
+    AND backward, probabilities rebuilt from the saved logsumexp -- is
+    the enabler. Round-5 measured 0.465 MFU fully synced (was 0.207
+    with the einsum-recompute backward). docs/benchmarks.md has the
+    S-sweep and the crossover behind FLASH_MIN_SEQ."""
+    dev = _tpu_device_or_none()
+    if dev is None:
+        return None
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from k8s_dra_driver_gpu_tpu.models import llama
+    from k8s_dra_driver_gpu_tpu.train.train import make_optimizer
+
+    B, S, K = 4, 4096, 2
+    cfg = dataclasses.replace(llama.LlamaConfig.flagship(),
+                              attn_impl="flash")
+    point = _timed_train_point(dev, cfg, B, S, K,
+                               make_optimizer(mu_dtype=jnp.bfloat16))
+    if point is None:
+        return None
+    dt, mfu, _ = point
+    return {
+        "mfu_longcontext_s4096": round(mfu, 4),
+        "longcontext_step_ms": round(dt * 1000, 1),
+        "longcontext_tokens_per_s": round(B * S / dt),
     }
 
 
@@ -611,6 +621,13 @@ def main() -> None:
             flagship = bench_model_flagship()
             if flagship:
                 extras.update(flagship)
+    except Exception:  # noqa: BLE001 - secondary metric must not kill bench
+        pass
+    try:
+        if budget_left():
+            longctx = bench_model_longcontext()
+            if longctx:
+                extras.update(longctx)
     except Exception:  # noqa: BLE001 - secondary metric must not kill bench
         pass
     try:

@@ -12,13 +12,14 @@ import jax.numpy as jnp
 
 
 # Sequence length at which "auto" switches from einsum to the pallas
-# flash kernel. Measured on v5e (docs/benchmarks.md flagship A/B): XLA's
-# fused einsum outruns the flash kernel at every length where it FITS
-# (0.527 vs 0.438 MFU at S=512 on the 738M config; 0.330 vs 0.307 at
-# S=2048), but its O(B*H*S^2) fp32 score transient OOMs a 16 GB chip at
-# S=4096 even at B=4 -- where flash runs fine. Flash's role on TPU is
-# the long-context ENABLER, not a short-sequence speedup.
-FLASH_MIN_SEQ = 4096
+# flash kernel. Measured on v5e (docs/benchmarks.md flagship A/B, 738M
+# config, training step fully synced): with bf16 MXU matmuls and the
+# pallas backward (round 5), flash wins from S=1024 up -- 0.492 vs
+# 0.449 at S=1024, 0.519 vs 0.330 at S=2048/B=8, 0.465 at S=4096 where
+# einsum's O(B*H*S^2) fp32 score transient cannot even compile on a
+# 16 GB chip. XLA's fused einsum still edges it at S=512 (0.525 vs
+# 0.518), so the crossover sits at 1024.
+FLASH_MIN_SEQ = 1024
 
 
 def attention(

@@ -1,14 +1,26 @@
-"""Pallas flash attention for TPU: blocked online-softmax, causal, GQA.
+"""Pallas flash attention for TPU: blocked online-softmax, causal, GQA,
+with a pallas backward (flash-style dq/dk/dv from saved output + lse).
 
 The MXU-friendly formulation: q blocks of (block_q, head_dim) stream
 against the full K/V of their (batch, kv-head) pair held in VMEM; the
 softmax runs online (running max + normalizer) in fp32 scratch while the
-two matmuls stay in the input dtype. Causal masking skips whole k-blocks
-past the diagonal. GQA is expressed in the BlockSpec index maps (q-head
-h reads kv-head h // group) -- no materialized KV repetition.
+two matmuls stay in the INPUT dtype (bf16 on the training path --
+fp32xfp32 runs the MXU at a fraction of bf16 throughput). Causal masking
+skips whole k-blocks past the diagonal. GQA is expressed in the
+BlockSpec index maps (q-head h reads kv-head h // group) -- no
+materialized KV repetition.
+
+The backward recomputes probabilities from the saved logsumexp (never
+the full S x S tensor in HBM): a dq kernel walks k-blocks per q-block,
+a dk/dv kernel walks q-blocks per k-block producing per-q-head partials
+that are group-summed outside (group is small: 2 on the flagship).
+``bwd_impl="chunked"`` keeps the einsum-recompute fallback.
 
 Falls back to interpret mode off-TPU so the same code path runs in CPU
 tests (mirroring the mock-backend strategy of the driver side).
+Measured on v5e (docs/benchmarks.md): the einsum path is HBM-bound at
+long S (it materializes the S x S scores); this kernel is the
+long-context enabler and, from S >= 2048, also the faster forward.
 """
 
 from __future__ import annotations
@@ -18,36 +30,37 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401 - TPU lowering
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  sm_scale: float, kv_len: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  causal: bool, sm_scale: float, kv_len: int):
     """One (batch*head, q-block) program instance.
 
     q_ref: [1, block_q, hd]; k_ref/v_ref: [1, S_padded, hd] (padded to a
-    block_k multiple; kv_len is the true length); o_ref like q_ref.
+    block_k multiple; kv_len is the true length); o_ref like q_ref;
+    lse_ref: [1, block_q, 1] logsumexp residual for the backward.
     """
     _, block_q, hd = q_ref.shape
     seq_len = k_ref.shape[1]
     qi = pl.program_id(1)
     q_start = qi * block_q
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale
+    q = q_ref[0]
 
     def body(ki, carry):
         o_acc, m_prev, l_prev = carry
         k_start = ki * block_k
-        k = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(k_start, block_k), :]
+        v = v_ref[0, pl.ds(k_start, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [block_q, block_k]
+        ) * sm_scale  # [block_q, block_k] fp32
         k_pos = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         # Padding keys never contribute.
@@ -63,7 +76,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         o_new = o_acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return o_new, m_new, l_new
@@ -78,12 +91,132 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     o_acc = jnp.zeros((block_q, hd), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    o_acc, _, l = jax.lax.fori_loop(0, num_k_blocks, body, (o_acc, m0, l0))
-    o_ref[0] = (o_acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    o_acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (o_acc, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o_acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+                     dq_ref, *, block_k: int, causal: bool,
+                     sm_scale: float, kv_len: int):
+    """dq for one (batch*head, q-block): walk k-blocks, probabilities
+    rebuilt from the saved lse. dS = P * (dP - D); dq = scale * dS K."""
+    _, block_q, hd = q_ref.shape
+    seq_len = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]  # [block_q, 1] fp32
+    dsum = dsum_ref[0]  # [block_q, 1] fp32
+
+    def body(ki, dq_acc):
+        k_start = ki * block_k
+        k = k_ref[0, pl.ds(k_start, block_k), :]
+        v = v_ref[0, pl.ds(k_start, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < kv_len
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dsum)
+        return dq_acc + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_k_blocks = jnp.minimum(
+            num_k_blocks, pl.cdiv(q_start + block_q, block_k)
+        )
+    dq = jax.lax.fori_loop(
+        0, num_k_blocks, body, jnp.zeros((block_q, hd), jnp.float32)
+    )
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+                      dk_ref, dv_ref, *, block_q: int, causal: bool,
+                      sm_scale: float, kv_len: int):
+    """dk/dv partials for one (batch*q-head, k-block): walk q-blocks
+    from the diagonal down. Per-Q-HEAD partials -- the GQA group sum
+    happens outside the kernel (group is small)."""
+    _, block_k, hd = k_ref.shape
+    seq_len = q_ref.shape[1]
+    ki = pl.program_id(1)
+    k_start = ki * block_k
+
+    k = k_ref[0]
+    v = v_ref[0]
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        q_start = qi * block_q
+        q = q_ref[0, pl.ds(q_start, block_q), :]
+        do = do_ref[0, pl.ds(q_start, block_q), :]
+        lse = lse_ref[0, pl.ds(q_start, block_q), :]
+        dsum = dsum_ref[0, pl.ds(q_start, block_q), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [block_q, block_k]
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < kv_len
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        pc = p.astype(do.dtype)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            pc, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_k, hd]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - dsum)).astype(q.dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_acc, dv_acc
+
+    num_q_blocks = pl.cdiv(seq_len, block_q)
+    # Causal: q blocks strictly above the diagonal see none of this
+    # k block.
+    first_q_block = (k_start // block_q) if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        first_q_block, num_q_blocks, body,
+        (jnp.zeros((block_k, hd), jnp.float32),
+         jnp.zeros((block_k, hd), jnp.float32)),
+    )
+    dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret",
+                     "bwd_impl"),
 )
 def flash_attention(
     q: jax.Array,  # [B, S, H, hd]
@@ -93,39 +226,52 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool | None = None,
+    bwd_impl: str = "flash",
 ) -> jax.Array:
-    """Differentiable: the forward runs the pallas kernel; the backward
-    recomputes attention one q-chunk at a time under lax.scan
-    (_chunked_attention_bwd) -- O(block_q * S) transient memory, never
-    the full S x S score tensor, and no residuals beyond (q, k, v)."""
-    return _flash_attention_vjp(q, k, v, causal, block_q, block_k, interpret)
+    """Differentiable: forward AND backward run pallas kernels (the
+    backward rebuilds probabilities from the saved logsumexp -- O(S)
+    residuals, never the S x S score tensor). bwd_impl="chunked" uses
+    the einsum-recompute fallback (_chunked_attention_bwd)."""
+    return _flash_attention_vjp(q, k, v, causal, block_q, block_k,
+                                interpret, bwd_impl)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_vjp(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_attention_fwd_impl(
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_vjp(q, k, v, causal, block_q, block_k, interpret,
+                         bwd_impl):
+    out, _ = _flash_attention_fwd_impl(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
+    return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_attention_fwd_impl(
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_impl):
+    out, lse = _flash_attention_fwd_impl(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
-    return out, (q, k, v)
+    if bwd_impl == "chunked":
+        # The chunked backward recomputes from (q, k, v) alone; keeping
+        # out/lse alive would make the memory-fallback path heavier.
+        return out, (q, k, v, None, None)
+    return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, g):
-    del block_k, interpret
-    q, k, v = residuals
-    return _chunked_attention_bwd(q, k, v, g, causal=causal,
-                                  block_q=block_q)
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, bwd_impl,
+                   residuals, g):
+    q, k, v, out, lse = residuals
+    if bwd_impl == "chunked":
+        return _chunked_attention_bwd(q, k, v, g, causal=causal,
+                                      block_q=block_q)
+    return _flash_attention_bwd_impl(
+        q, k, v, out, lse, g, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
 
 
 def _chunked_attention_bwd(q, k, v, g, *, causal: bool, block_q: int):
-    """Flash-style backward: recompute attention one q-chunk at a time
+    """Einsum-recompute backward: attention one q-chunk at a time
     (lax.scan), so peak transient memory is O(block_q * S) per layer --
     never the full S x S score tensor.
 
@@ -189,6 +335,12 @@ def _chunked_attention_bwd(q, k, v, g, *, causal: bool, block_q: int):
 _flash_attention_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _head_major(x: jax.Array) -> jax.Array:
+    """[B, S, N, hd] -> [B*N, S, hd]."""
+    B, S, N, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * N, S, hd)
+
+
 def _flash_attention_fwd_impl(
     q: jax.Array,
     k: jax.Array,
@@ -197,7 +349,8 @@ def _flash_attention_fwd_impl(
     block_q: int,
     block_k: int,
     interpret: bool | None,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (out [B,S,H,hd], lse [B*H, S_qpad, 1] fp32)."""
     from . import is_tpu_backend  # noqa: PLC0415
 
     B, S, H, hd = q.shape
@@ -211,14 +364,14 @@ def _flash_attention_fwd_impl(
     # Pad the kv sequence to a block_k multiple: a clamped pl.ds read on
     # a partial last block would re-read (and double-count) real keys
     # under wrong position labels. Padding keys are masked by kv_len.
-    S_pad = -(-S // block_k) * block_k
+    S_kpad = -(-S // block_k) * block_k
 
     # [B, H|K, S, hd] layout so the grid walks (batch*head, q-block).
-    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, hd)
-    kt = k.transpose(0, 2, 1, 3).reshape(B * K, S, hd)
-    vt = v.transpose(0, 2, 1, 3).reshape(B * K, S, hd)
-    if S_pad != S:
-        pad = ((0, 0), (0, S_pad - S), (0, 0))
+    qt = _head_major(q)
+    kt = _head_major(k)
+    vt = _head_major(v)
+    if S_kpad != S:
+        pad = ((0, 0), (0, S_kpad - S), (0, 0))
         kt = jnp.pad(kt, pad)
         vt = jnp.pad(vt, pad)
 
@@ -233,7 +386,10 @@ def _flash_attention_fwd_impl(
         h = bh % H
         return (b * K + h // group, 0, 0)
 
-    out = pl.pallas_call(
+    def lse_index(bh, qi):
+        return (bh, qi, 0)
+
+    out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel,
             block_k=block_k,
@@ -241,14 +397,148 @@ def _flash_attention_fwd_impl(
             sm_scale=1.0 / (hd ** 0.5),
             kv_len=S,
         ),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
+            jax.ShapeDtypeStruct((B * H, -(-S // block_q) * block_q, 1),
+                                 jnp.float32),
+        ],
         grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), q_index),
+            pl.BlockSpec((1, S_kpad, hd), kv_index),
+            pl.BlockSpec((1, S_kpad, hd), kv_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, hd), q_index),
+            pl.BlockSpec((1, block_q, 1), lse_index),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3), lse
+
+
+def _flash_attention_bwd_impl(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,  # [B*H, S_qpad, 1] fp32 from the forward
+    g: jax.Array,
+    *,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool | None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    from . import is_tpu_backend  # noqa: PLC0415
+
+    B, S, H, hd = q.shape
+    K = k.shape[2]
+    group = H // K
+    if interpret is None:
+        interpret = not is_tpu_backend()
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    sm_scale = 1.0 / (hd ** 0.5)
+
+    # One padded length serves both walk directions (the dkv kernel
+    # slides q-blocks over the padded q stream, the dq kernel slides
+    # k-blocks over the padded kv stream). dO pads with ZEROS, so
+    # padded q rows contribute nothing to dk/dv regardless of their
+    # (masked) probabilities; padded k columns are masked by kv_len.
+    import math  # noqa: PLC0415
+
+    S_pad = -(-S // math.lcm(block_q, block_k)) * math.lcm(block_q, block_k)
+
+    def padq(x):  # [B*H, S, hd] -> [B*H, S_pad, hd]
+        return jnp.pad(x, ((0, 0), (0, S_pad - x.shape[1]), (0, 0)))
+
+    qt = padq(_head_major(q))
+    dot_ = padq(_head_major(g))
+    ot = padq(_head_major(out))
+    kt = padq(_head_major(k))
+    vt = padq(_head_major(v))
+    # lse is [B*H, S_qpad, 1]; rows >= S are kernel output over
+    # UNDEFINED padded q rows (can be NaN) -- force them to 0. With
+    # zero-padded q/dO, p = exp(0 - 0) = 1 there, and every padded-row
+    # contribution is p * dO_pad = 0 / sliced off, so 0 is safe.
+    row = jnp.arange(lse.shape[1])[None, :, None]
+    lse = jnp.where(row < S, lse, 0.0)
+    lse_p = jnp.pad(lse, ((0, 0), (0, S_pad - lse.shape[1]), (0, 0)))
+    # D = rowsum(dO * O) fp32 -- cheap elementwise, XLA fuses it.
+    dsum = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32),
+                   axis=-1, keepdims=True)
+
+    def q_index(bh, i):
+        return (bh, i, 0)
+
+    def full_index(bh, i):
+        return (bh, 0, 0)
+
+    def kv_index(bh, i):
+        b = bh // H
+        h = bh % H
+        return (b * K + h // group, 0, 0)
+
+    n_qb = S_pad // block_q
+    n_kb = S_pad // block_k
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, block_k=block_k, causal=causal,
+            sm_scale=sm_scale, kv_len=S,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, S_pad, hd), q.dtype),
+        grid=(B * H, n_qb),
         in_specs=[
             pl.BlockSpec((1, block_q, hd), q_index),
             pl.BlockSpec((1, S_pad, hd), kv_index),
             pl.BlockSpec((1, S_pad, hd), kv_index),
+            pl.BlockSpec((1, block_q, hd), q_index),
+            pl.BlockSpec((1, block_q, 1), q_index),
+            pl.BlockSpec((1, block_q, 1), q_index),
         ],
         out_specs=pl.BlockSpec((1, block_q, hd), q_index),
         interpret=interpret,
-    )(qt, kt, vt)
-    return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3)
+    )(qt, kt, vt, dot_, lse_p, dsum)
+
+    def kblock_index(bh, i):
+        b = bh // H
+        h = bh % H
+        return (b * K + h // group, i, 0)
+
+    dkp, dvp = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, block_q=block_q, causal=causal,
+            sm_scale=sm_scale, kv_len=S,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S_pad, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, S_pad, hd), jnp.float32),
+        ],
+        grid=(B * H, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, S_pad, hd), full_index),
+            pl.BlockSpec((1, block_k, hd), kblock_index),
+            pl.BlockSpec((1, block_k, hd), kblock_index),
+            pl.BlockSpec((1, S_pad, hd), full_index),
+            pl.BlockSpec((1, S_pad, 1), full_index),
+            pl.BlockSpec((1, S_pad, 1), full_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, hd), q_index),
+            pl.BlockSpec((1, block_k, hd), q_index),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse_p, dsum)
+
+    # GQA group-sum of the per-q-head partials (group is 1-2 on the
+    # model families here; the transient is group x the kv size).
+    dk = dkp.reshape(B, K, group, S_pad, hd).sum(2)[:, :, :S]
+    dv = dvp.reshape(B, K, group, S_pad, hd).sum(2)[:, :, :S]
+    dq_out = dq.reshape(B, H, S_pad, hd)[:, :, :S].transpose(0, 2, 1, 3)
+    return (
+        dq_out.astype(q.dtype),
+        dk.transpose(0, 2, 1, 3).astype(k.dtype),
+        dv.transpose(0, 2, 1, 3).astype(v.dtype),
+    )
