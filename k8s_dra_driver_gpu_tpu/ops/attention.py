@@ -51,6 +51,12 @@ def attention(
         from .flash_attention import flash_attention  # noqa: PLC0415
 
         return flash_attention(q, k, v, causal=causal)
+    if impl != "einsum":
+        # A typo ("Flash", "pallas") must not silently take the einsum
+        # path -- at long S that materializes the O(S^2) scores the
+        # flash kernel exists to avoid.
+        raise ValueError(f"unknown attention impl {impl!r}: "
+                         "want auto | flash | einsum")
     return dot_product_attention(q, k, v, causal=causal)
 
 

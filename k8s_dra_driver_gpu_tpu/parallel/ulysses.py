@@ -22,7 +22,7 @@ from functools import partial
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.attention import dot_product_attention
+from ..ops.attention import attention
 
 
 def _seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
@@ -46,6 +46,7 @@ def ulysses_attention(
     v: jax.Array,
     axis_name: str,
     causal: bool = True,
+    impl: str = "auto",
 ) -> jax.Array:
     n = jax.lax.psum(1, axis_name)
     H = q.shape[2]
@@ -57,12 +58,17 @@ def ulysses_attention(
     qh = _seq_to_heads(q, axis_name)
     kh = _seq_to_heads(k, axis_name)
     vh = _seq_to_heads(v, axis_name)
-    out = dot_product_attention(qh, kh, vh, causal=causal)
+    # After the all_to_all each device holds FULL-sequence q/k/v for
+    # its head subset -- exactly the regime where the dispatcher picks
+    # the pallas flash kernel (S >= FLASH_MIN_SEQ, hd % 128 == 0): at
+    # S >= 4096 the einsum path cannot even materialize its S x S
+    # scores, so Ulysses long-context is only viable through it.
+    out = attention(qh, kh, vh, causal=causal, impl=impl)
     return _heads_to_seq(out, axis_name)
 
 
 def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
-                           causal: bool = True):
+                           causal: bool = True, impl: str = "auto"):
     """jitted [B, S, H, hd] attention with S sharded over ``axis_name``
     (same surface as make_ring_attention)."""
     spec = P(None, axis_name, None, None)
@@ -70,10 +76,16 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
     @jax.jit
     def fn(q, k, v):
         return jax.shard_map(
-            partial(ulysses_attention, axis_name=axis_name, causal=causal),
+            partial(ulysses_attention, axis_name=axis_name, causal=causal,
+                    impl=impl),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
+            # pallas_call outputs carry no varying-mesh-axes annotation;
+            # every input/output here shares one spec, so the vma check
+            # adds nothing (the flash path would otherwise need per-axis
+            # vma on its ShapeDtypeStructs).
+            check_vma=False,
         )(q, k, v)
 
     def place(x):

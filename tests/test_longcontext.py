@@ -159,6 +159,34 @@ class TestUlyssesAttention:
         with pytest.raises(ValueError, match="divisible"):
             fn(place(q), place(k), place(v))
 
+    def test_flash_impl_through_shard_map(self):
+        """Ulysses + the pallas kernel (forced impl): the long-context
+        composition -- all_to_all inside shard_map around the custom-VJP
+        pallas call -- must match einsum forward AND backward."""
+        from k8s_dra_driver_gpu_tpu.parallel.ulysses import (
+            make_ulysses_attention,
+        )
+
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=8))
+        q, k, v = rand_qkv(jax.random.PRNGKey(9), B=1, S=256, H=8, K=8)
+        fn, place = make_ulysses_attention(mesh, "sp", impl="flash")
+        out = fn(place(q), place(k), place(v))
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+        def loss_sp(q, k, v):
+            return jnp.sum(fn(place(q), place(k), place(v)) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+        gs = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gs, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4)
+
 
 class TestRingAttention:
     @pytest.mark.parametrize("causal", [True, False])
