@@ -418,6 +418,48 @@ def bench_model_longcontext() -> dict | None:
     }
 
 
+def bench_prefill_longprompt() -> dict | None:
+    """Long-prompt prefill on the flagship model (serving's compute
+    half): B=4 x S=2048 through the attention dispatcher, which at
+    hd=128/S>=1024 picks the pallas flash kernel -- measured +38% over
+    the einsum path (38.2k vs 27.6k tok/s, round 5, KV-cache writes
+    included)."""
+    dev = _tpu_device_or_none()
+    if dev is None:
+        return None
+    import jax
+
+    from k8s_dra_driver_gpu_tpu.models import decode, llama
+
+    B, S = 4, 2048
+    cfg = llama.LlamaConfig.flagship()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    # The KV cache must stay a LIVE output: jitting prefill(...)[0]
+    # would let XLA dead-code-eliminate the per-layer cache writes and
+    # measure a cheaper program than serving actually runs.
+    fn = jax.jit(lambda p, t: decode.prefill(p, t, cfg, max_len=S + 64))
+    warm = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                              cfg.vocab_size)
+    logits, cache = fn(params, warm)
+    jax.device_get(logits)
+    jax.block_until_ready(cache)
+    per = []
+    for i in range(3):
+        prompt = jax.random.randint(jax.random.PRNGKey(i + 2), (B, S), 0,
+                                    cfg.vocab_size)
+        jax.block_until_ready(prompt)
+        t0 = time.perf_counter()
+        logits, cache = fn(params, prompt)
+        jax.device_get(logits)
+        jax.block_until_ready(cache)
+        per.append(time.perf_counter() - t0)
+    dt = statistics.median(per)
+    return {
+        "prefill_tokens_per_s_s2048": round(B * S / dt),
+        "prefill_ms_s2048": round(dt * 1000, 1),
+    }
+
+
 def bench_decode(budget_left=None) -> dict | None:
     """KV-cache decode throughput on real TPU; None off-hardware. The
     whole generate() loop is one compiled lax.scan; the warm-up call
@@ -628,6 +670,13 @@ def main() -> None:
             longctx = bench_model_longcontext()
             if longctx:
                 extras.update(longctx)
+    except Exception:  # noqa: BLE001 - secondary metric must not kill bench
+        pass
+    try:
+        if budget_left():
+            prefill = bench_prefill_longprompt()
+            if prefill:
+                extras.update(prefill)
     except Exception:  # noqa: BLE001 - secondary metric must not kill bench
         pass
     try:
