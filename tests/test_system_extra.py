@@ -424,3 +424,63 @@ class TestCdUpDowngrade:
         # The channel is reusable after the adopted unprepare.
         again = self._run(root, "cd-ud-2", "prepare")
         assert again.returncode == 0, again.stdout + again.stderr
+
+
+class TestApiserverOutage:
+    """Control-plane outage resilience (test_gpu_robustness.bats
+    class): with the apiserver down, prepare fails with a retriable
+    per-claim ERROR (never a crash) because the claim GET cannot be
+    served; when the apiserver comes back on the same endpoint with
+    the same store, the SAME claim prepares successfully and the
+    plugin process never restarted."""
+
+    def test_prepare_fails_then_recovers_across_outage(self, tmp_path):
+        api = FakeApiServer().start()
+        port = api.port
+        api_up = api  # whichever server is currently live (for finally)
+        proc, log, _ = start_plugin(tmp_path, api.url, name="plugin-outage")
+        try:
+            kubelet = FakeKubelet(str(tmp_path / "registry"))
+            kubelet.wait_for_plugin(DRIVER, timeout=60)
+            kube = KubeClient(host=api.url)
+
+            # Baseline + the claim we will prepare during/after outage.
+            for uid, chip in (("out-base", "chip-0"), ("out-c2", "chip-1")):
+                kube.create(
+                    "resource.k8s.io", "v1", "resourceclaims",
+                    make_claim_dict(uid, [chip], namespace="ns1", name=uid),
+                    namespace="ns1")
+            r = kubelet.prepare(DRIVER, [
+                {"uid": "out-base", "namespace": "ns1", "name": "out-base"}])
+            assert r.claims["out-base"].error == ""
+
+            # Outage: the plugin must degrade to per-claim errors, not die.
+            api.stop()
+            api_up = None
+            r = kubelet.prepare(DRIVER, [
+                {"uid": "out-c2", "namespace": "ns1", "name": "out-c2"}])
+            assert r.claims["out-c2"].error != ""
+            assert proc.poll() is None, "plugin died during apiserver outage"
+
+            # Recovery: same port, same store (an apiserver restart, not
+            # a wipe). The identical claim now prepares.
+            api_up = FakeApiServer(store=api.store, port=port).start()
+            deadline = time.monotonic() + 30
+            last = None
+            while time.monotonic() < deadline:
+                r = kubelet.prepare(DRIVER, [
+                    {"uid": "out-c2", "namespace": "ns1",
+                     "name": "out-c2"}])
+                last = r.claims["out-c2"].error
+                if last == "":
+                    break
+                time.sleep(0.5)
+            assert last == "", f"prepare never recovered: {last}"
+            assert proc.poll() is None
+            for uid in ("out-base", "out-c2"):
+                u = kubelet.unprepare(DRIVER, [uid])
+                assert u.claims[uid].error == ""
+        finally:
+            stop(proc, log)
+            if api_up is not None:
+                api_up.stop()
