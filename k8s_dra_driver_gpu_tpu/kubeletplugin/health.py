@@ -15,7 +15,6 @@ mirroring the reference's Option-A schema.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 from dataclasses import dataclass
 from typing import Callable
@@ -33,27 +32,10 @@ DEFAULT_IGNORED_KINDS = frozenset({"thermal_notice", "clock_throttle"})
 
 # Reference polls NVML events with 5000ms waits; the env override lets
 # operators (and the republish-storm e2e) tighten detection latency.
-# Parsed defensively: a bad value must not crash plugin startup, and a
-# zero/negative value would busy-spin the monitor thread.
+from ..pkg import positive_float_env
 
-
-def _poll_interval_from_env() -> float:
-    raw = os.environ.get("TPU_DRA_HEALTH_POLL_S", "")
-    try:
-        val = float(raw)
-    except ValueError:
-        if raw:
-            logging.getLogger(__name__).warning(
-                "ignoring non-numeric TPU_DRA_HEALTH_POLL_S=%r", raw)
-        return 5.0
-    if val <= 0:
-        logging.getLogger(__name__).warning(
-            "clamping TPU_DRA_HEALTH_POLL_S=%s to 0.05", raw)
-        return 0.05
-    return val
-
-
-POLL_INTERVAL_S = _poll_interval_from_env()
+POLL_INTERVAL_S = positive_float_env(
+    "TPU_DRA_HEALTH_POLL_S", default=5.0, floor=0.05)
 
 
 @dataclass(frozen=True)

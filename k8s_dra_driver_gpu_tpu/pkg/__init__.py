@@ -1,1 +1,25 @@
 """Shared infrastructure packages (reference: pkg/ and internal/)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def positive_float_env(var: str, default: float, floor: float) -> float:
+    """Defensive operator-knob parse: a bad value must never crash a
+    binary at import, and a non-positive (or NaN) value would busy-spin
+    whatever loop waits on it -- clamp to ``floor`` instead."""
+    raw = os.environ.get(var, "")
+    try:
+        val = float(raw)
+    except ValueError:
+        if raw:
+            logging.getLogger(__name__).warning(
+                "ignoring non-numeric %s=%r", var, raw)
+        return default
+    if not (val > 0):  # NaN compares False too
+        logging.getLogger(__name__).warning(
+            "clamping %s=%s to %s", var, raw, floor)
+        return floor
+    return val

@@ -484,3 +484,57 @@ class TestApiserverOutage:
             stop(proc, log)
             if api_up is not None:
                 api_up.stop()
+
+
+class TestStaleClaimGC:
+    """The stale-claim GC against the LIVE binary (cleanup.go role,
+    10-min cadence tightened via TPU_DRA_CLEANUP_INTERVAL_S): deleting
+    a prepared claim's API object makes the plugin unprepare it within
+    the cadence, releasing its chip for the next claim -- without any
+    kubelet unprepare call."""
+
+    def test_deleted_claim_reaped_and_chip_released(self, tmp_path):
+        api = FakeApiServer().start()
+        proc, log, log_path = start_plugin(
+            tmp_path, api.url, {"TPU_DRA_CLEANUP_INTERVAL_S": "1"},
+            name="plugin-gc")
+        try:
+            kubelet = FakeKubelet(str(tmp_path / "registry"))
+            kubelet.wait_for_plugin(DRIVER, timeout=60)
+            kube = KubeClient(host=api.url)
+
+            kube.create(
+                "resource.k8s.io", "v1", "resourceclaims",
+                make_claim_dict("gc-victim", ["chip-0"], namespace="ns1",
+                                name="gc-victim"), namespace="ns1")
+            r = kubelet.prepare(DRIVER, [
+                {"uid": "gc-victim", "namespace": "ns1",
+                 "name": "gc-victim"}])
+            assert r.claims["gc-victim"].error == ""
+
+            # The user deletes the claim object; the kubelet never calls
+            # unprepare (pod gone with it). The GC must notice.
+            kube.delete("resource.k8s.io", "v1", "resourceclaims",
+                        "gc-victim", namespace="ns1")
+            deadline = time.monotonic() + 30
+            reaped = False
+            while time.monotonic() < deadline:
+                if "unpreparing stale claim gc-victim" in \
+                        log_path.read_text():
+                    reaped = True
+                    break
+                time.sleep(0.5)
+            assert reaped, "GC never reaped the deleted claim"
+
+            # chip-0 is free again: an exclusive claim on it prepares.
+            kube.create(
+                "resource.k8s.io", "v1", "resourceclaims",
+                make_claim_dict("gc-next", ["chip-0"], namespace="ns1",
+                                name="gc-next"), namespace="ns1")
+            r = kubelet.prepare(DRIVER, [
+                {"uid": "gc-next", "namespace": "ns1", "name": "gc-next"}])
+            assert r.claims["gc-next"].error == ""
+            kubelet.unprepare(DRIVER, ["gc-next"])
+        finally:
+            stop(proc, log)
+            api.stop()
