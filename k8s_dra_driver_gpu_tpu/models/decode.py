@@ -329,6 +329,9 @@ def make_sharded_generate(
     if cfg.n_kv_heads % tp:
         raise ValueError(
             f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}")
+    from .llama import pin_auto_attn_for_pjit
+
+    cfg = pin_auto_attn_for_pjit(cfg, mesh)
     param_shard = jax.tree.map(
         lambda s: NamedSharding(mesh, s), param_specs(cfg),
         is_leaf=lambda x: isinstance(x, P))

@@ -93,6 +93,23 @@ class LlamaConfig:
         )
 
 
+def pin_auto_attn_for_pjit(cfg: LlamaConfig, mesh) -> LlamaConfig:
+    """attn_impl auto -> einsum when jitting over a MULTI-device mesh:
+    a pallas_call inside jit with sharded operands does not partition
+    (XLA gathers the full arrays per device), silently destroying the
+    sharding at exactly the long-S shapes where auto picks the kernel.
+    Sharded long-context belongs to the shard_map trainers (ring /
+    Ulysses see local shapes). Single-device meshes keep auto -- there
+    the kernel IS the long-context enabler (0.465 MFU at S=4096 where
+    einsum cannot compile, docs/benchmarks.md) -- and an EXPLICIT
+    attn_impl="flash" is always honored as the caller's choice."""
+    if cfg.attn_impl == "auto" and mesh.size > 1:
+        import dataclasses  # noqa: PLC0415
+
+        return dataclasses.replace(cfg, attn_impl="einsum")
+    return cfg
+
+
 def param_specs(cfg: LlamaConfig) -> dict:
     """PartitionSpecs per parameter leaf (layer-stacked leaves lead with
     None for the scan dimension). fsdp shards the long matmul dim, tp the

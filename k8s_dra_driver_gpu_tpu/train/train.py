@@ -101,6 +101,7 @@ def make_sharded_train(mesh: Mesh, cfg: llama.LlamaConfig, optimizer=None,
     cross-slice gradient all-reduce on DCN and everything else on ICI.
     """
     optimizer = optimizer or make_optimizer()
+    cfg = llama.pin_auto_attn_for_pjit(cfg, mesh)
     specs = llama.param_specs(cfg)
     param_shard = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs,
@@ -138,6 +139,7 @@ def make_scanned_sharded_train(mesh: Mesh, cfg: llama.LlamaConfig,
     input's leading extent), the per-step batch shards exactly as in the
     unscanned path."""
     optimizer = optimizer or make_optimizer()
+    cfg = llama.pin_auto_attn_for_pjit(cfg, mesh)
     init_fn, _, batch_shard, place_params = make_sharded_train(
         mesh, cfg, optimizer=optimizer, batch_axes=batch_axes)
     spec = batch_shard.spec

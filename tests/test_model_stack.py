@@ -137,3 +137,82 @@ class TestCollectives:
         res = bench_allreduce(mesh, "tp", nbytes=1 << 20, iters=2)
         assert res["participants"] == 8
         assert res["gbps"] > 0
+
+
+class TestPjitAttentionPin:
+    """The pjit-based trainers/serving pin attn_impl auto -> einsum: a
+    pallas_call inside jit with sharded operands does not partition
+    (XLA gathers the full arrays), so auto must never reach the kernel
+    there. Simulated-TPU backend + a booby-trapped kernel prove the
+    einsum path is taken; the booby trap itself is validated by calling
+    the dispatcher directly."""
+
+    @pytest.fixture()
+    def tpu_backend_with_trapped_flash(self, monkeypatch):
+        import k8s_dra_driver_gpu_tpu.ops as ops_pkg
+        import k8s_dra_driver_gpu_tpu.ops.flash_attention as fa
+
+        monkeypatch.setattr(ops_pkg, "is_tpu_backend", lambda: True)
+
+        def trap(*a, **k):
+            raise AssertionError("flash kernel reached under pjit")
+
+        monkeypatch.setattr(fa, "flash_attention", trap)
+
+    def test_trap_fires_through_auto_dispatch(
+            self, tpu_backend_with_trapped_flash):
+        from k8s_dra_driver_gpu_tpu.ops.attention import attention
+
+        q = jnp.zeros((1, 2048, 2, 128), jnp.bfloat16)
+        with pytest.raises(AssertionError, match="flash kernel reached"):
+            attention(q, q, q, impl="auto")
+
+    def test_sharded_train_auto_takes_einsum(
+            self, tpu_backend_with_trapped_flash):
+        # Flash-eligible shape (S=2048, hd=128) through the pjit
+        # trainer: the auto->einsum pin must keep the trap unsprung.
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
+        cfg = llama.LlamaConfig(
+            vocab_size=128, d_model=256, n_layers=1, n_heads=2,
+            n_kv_heads=2, d_ff=384)
+        assert cfg.head_dim == 128 and cfg.attn_impl == "auto"
+        init_fn, step_fn, batch_shard, place = make_sharded_train(
+            mesh, cfg)
+        state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
+        toks = jnp.zeros((8, 2049), jnp.int32)
+        state, loss = step_fn(state, jax.device_put(toks, batch_shard))
+        assert jnp.isfinite(loss)
+
+    def test_single_device_mesh_keeps_auto(
+            self, tpu_backend_with_trapped_flash):
+        # No sharding to destroy on one device: the pin must NOT fire
+        # (the kernel is the single-chip long-context enabler), so the
+        # trap IS reached through the trainer's auto dispatch.
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1),
+                          devices=jax.devices()[:1])
+        cfg = llama.LlamaConfig(
+            vocab_size=128, d_model=256, n_layers=1, n_heads=2,
+            n_kv_heads=2, d_ff=384)
+        init_fn, step_fn, batch_shard, place = make_sharded_train(
+            mesh, cfg)
+        state = init_fn(place(llama.init(jax.random.PRNGKey(0), cfg)))
+        toks = jnp.zeros((2, 2049), jnp.int32)
+        with pytest.raises(Exception, match="flash kernel reached"):
+            step_fn(state, jax.device_put(toks, batch_shard))
+
+    def test_sharded_generate_auto_takes_einsum(
+            self, tpu_backend_with_trapped_flash):
+        from k8s_dra_driver_gpu_tpu.models.decode import (
+            make_sharded_generate,
+        )
+
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
+        cfg = llama.LlamaConfig(
+            vocab_size=128, d_model=256, n_layers=1, n_heads=2,
+            n_kv_heads=2, d_ff=384)
+        gen_fn, prompt_shard, place = make_sharded_generate(
+            mesh, cfg, max_new_tokens=2, max_len=2048)
+        prompt = jnp.zeros((8, 1024), jnp.int32)
+        out = gen_fn(place(llama.init(jax.random.PRNGKey(0), cfg)),
+                     jax.device_put(prompt, prompt_shard))
+        assert out.shape == (8, 2)
