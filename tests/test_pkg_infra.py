@@ -345,3 +345,27 @@ class TestMetrics:
             assert "tpu_dra_request_duration_seconds_bucket" in body
         finally:
             srv.stop()
+
+
+class TestPositiveFloatEnv:
+    """The shared operator-knob parser behind TPU_DRA_HEALTH_POLL_S and
+    TPU_DRA_CLEANUP_INTERVAL_S: never crashes, never lets a loop
+    busy-spin (NaN included -- `val <= 0` is False for NaN)."""
+
+    @pytest.mark.parametrize("raw,expect", [
+        ("", 9.0),            # unset -> default
+        ("abc", 9.0),         # non-numeric -> default (warned)
+        ("0", 0.25),          # zero -> floor
+        ("-3", 0.25),         # negative -> floor
+        ("nan", 0.25),        # NaN -> floor (the subtle one)
+        ("2.5", 2.5),         # honest value passes through
+        ("inf", float("inf")),  # explicit inf is "positive": honored
+    ])
+    def test_parse(self, monkeypatch, raw, expect):
+        from k8s_dra_driver_gpu_tpu.pkg import positive_float_env
+
+        monkeypatch.setenv("TPU_DRA_TEST_KNOB", raw)
+        if raw == "":
+            monkeypatch.delenv("TPU_DRA_TEST_KNOB", raising=False)
+        assert positive_float_env(
+            "TPU_DRA_TEST_KNOB", default=9.0, floor=0.25) == expect
