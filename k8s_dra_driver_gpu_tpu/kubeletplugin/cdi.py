@@ -9,6 +9,12 @@ a libtpu.so mount, and the TPU_*/JAX env contract a JAX workload needs to
 address exactly the claimed chips:
 
   TPU_VISIBLE_DEVICES        comma-separated local chip indices
+                             (claim-scoped; last-wins when a pod holds
+                             several claims -- see TPU_DEVICE_<i>)
+  TPU_DEVICE_<i>=1           one marker per claimed chip, set on the
+                             chip's own CDI device entry; unique names
+                             merge as the UNION across claims, so the
+                             full visible set is always recoverable
   TPU_ACCELERATOR_TYPE       e.g. v5p-16 (claim-scoped sub-topology)
   TPU_TOPOLOGY               chip-grid dims of the claimed devices
   TPU_WORKER_ID              this host's worker index in the slice

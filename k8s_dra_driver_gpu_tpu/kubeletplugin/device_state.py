@@ -650,6 +650,14 @@ class DeviceState:
             grp[0].update(physical_idxs)
             grp[1].append(result.device)
 
+            # Additive per-chip markers: a pod consuming SEVERAL claims
+            # gets every claim's CDI spec applied, and same-name env
+            # (TPU_VISIBLE_DEVICES below) merges last-wins under CDI --
+            # unique names merge as the union, so consumers can always
+            # recover the full visible set (mock_workload_site does).
+            for i in physical_idxs:
+                edits.env.append(f"TPU_DEVICE_{i}=1")
+
             device_edits[result.device] = edits
             prepared.append(
                 CheckpointedDevice(

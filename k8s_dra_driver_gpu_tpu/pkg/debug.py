@@ -8,6 +8,7 @@ prepare/unprepare flows in the field.
 from __future__ import annotations
 
 import faulthandler
+import os
 import signal
 import sys
 import threading
@@ -41,8 +42,12 @@ def debug_stacks_endpoint() -> tuple[int, str, bytes]:
     return 200, "text/plain", format_thread_stacks().encode()
 
 
-def start_debug_signal_handlers(path: str = DUMP_PATH) -> None:
-    """Install SIGUSR1/SIGUSR2 stack dumpers + SIGABRT faulthandler."""
+def start_debug_signal_handlers(path: str | None = None) -> None:
+    """Install SIGUSR1/SIGUSR2 stack dumpers + SIGABRT faulthandler.
+    ``TPU_DRA_STACK_DUMP`` overrides the dump path (per-pod hostPath in
+    the field; per-test isolation in the system suite)."""
+    if path is None:
+        path = os.environ.get("TPU_DRA_STACK_DUMP", DUMP_PATH)
     signal.signal(signal.SIGUSR1, lambda *a: dump_thread_stacks(path))
     signal.signal(signal.SIGUSR2, lambda *a: dump_thread_stacks(path))
     faulthandler.enable()

@@ -144,3 +144,44 @@ class TestAllocation:
                            namespace=namespace)
         assert all(not c.get("status", {}).get("allocation")
                    for c in claims)
+
+
+class TestTwoClaimsOnePod:
+    """'pod with two ResourceClaimTemplates gets two distinct GPUs'
+    (test_gpu_basic.bats analog): one pod, two separate claims from two
+    templates -- the scheduler must seat them on DIFFERENT chips and
+    the container env must carry both."""
+
+    def test_two_templates_two_distinct_chips(self, kube, namespace):
+        for tname in ("pair-a", "pair-b"):
+            apply(kube, claim_template(namespace, tname))
+        pod = chip_pod(namespace, "pair", {
+            "resourceClaimTemplateName": "pair-a"})
+        spec = pod["spec"]
+        spec["resourceClaims"] = [
+            {"name": "tpu", "resourceClaimTemplateName": "pair-a"},
+            {"name": "tpu2", "resourceClaimTemplateName": "pair-b"},
+        ]
+        spec["containers"][0]["resources"]["claims"] = [
+            {"name": "tpu"}, {"name": "tpu2"}]
+        apply(kube, pod)
+        wait_for(lambda: pod_phase(kube, "pair", namespace) == "Succeeded",
+                 desc="two-claim pod success")
+
+        # Distinct devices allocated across the two claims.
+        allocated = []
+        for rc in kube.list("resource.k8s.io", "v1", "resourceclaims",
+                            namespace=namespace):
+            alloc = rc.get("status", {}).get("allocation")
+            if alloc and rc["metadata"]["name"].startswith("pair-"):
+                allocated.extend(
+                    r["device"] for r in alloc["devices"]["results"])
+        assert len(allocated) == 2, allocated
+        assert len(set(allocated)) == 2, f"same chip twice: {allocated}"
+
+        # The merged env exposes both chips: TPU_VISIBLE_DEVICES is
+        # claim-scoped (CDI same-name env merges last-wins across the
+        # two claims), but the per-chip TPU_DEVICE_<i> markers union.
+        env = json.loads(pod_log(kube, "pair", namespace).strip())
+        markers = {k for k in env if k.startswith("TPU_DEVICE_")}
+        assert len(markers) == 2, env

@@ -17,7 +17,14 @@ PYTHONPATH and setting TPU_MOCK_WORKLOAD=1; inert everywhere else.
 import os
 
 if os.environ.get("TPU_MOCK_WORKLOAD") == "1":
-    chips = [c for c in os.environ.get(
+    # Per-chip markers are authoritative: with SEVERAL claims on one
+    # pod, every claim's CDI spec sets TPU_VISIBLE_DEVICES and CDI env
+    # merges last-wins, but the unique TPU_DEVICE_<i> names union.
+    chips = sorted(
+        k[len("TPU_DEVICE_"):] for k in os.environ
+        if k.startswith("TPU_DEVICE_")
+        and k[len("TPU_DEVICE_"):].isdigit()  # not e.g. TPU_DEVICE_ORDER
+    ) or [c for c in os.environ.get(
         "TPU_VISIBLE_DEVICES", "").split(",") if c != ""]
     if chips:
         os.environ["JAX_PLATFORMS"] = "cpu"

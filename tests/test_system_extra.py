@@ -538,3 +538,65 @@ class TestStaleClaimGC:
         finally:
             stop(proc, log)
             api.stop()
+
+
+class TestDebugAndMetricsSurfaces:
+    """Live-binary observability (test_basics.bats SIGUSR2 +
+    'kubelet-plugin exposes Prometheus metrics' analogs): SIGUSR2
+    makes the running plugin write a thread-stack dump, and its
+    metrics port serves the DRA request histograms after real
+    traffic."""
+
+    def test_sigusr2_dump_and_metrics_scrape(self, tmp_path):
+        import socket
+        import urllib.request
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            mport = s.getsockname()[1]
+        dump = tmp_path / "stacks.dump"
+        api = FakeApiServer().start()
+        proc, log, _ = start_plugin(
+            tmp_path, api.url,
+            {"METRICS_PORT": str(mport),
+             "TPU_DRA_STACK_DUMP": str(dump)},
+            name="plugin-debug")
+        try:
+            kubelet = FakeKubelet(str(tmp_path / "registry"))
+            kubelet.wait_for_plugin(DRIVER, timeout=60)
+            kube = KubeClient(host=api.url)
+            kube.create(
+                "resource.k8s.io", "v1", "resourceclaims",
+                make_claim_dict("dbg-1", ["chip-0"], namespace="ns1",
+                                name="dbg-1"), namespace="ns1")
+            r = kubelet.prepare(DRIVER, [
+                {"uid": "dbg-1", "namespace": "ns1", "name": "dbg-1"}])
+            assert r.claims["dbg-1"].error == ""
+
+            # SIGUSR2 -> stack dump at the overridden path, with the
+            # serving threads visible.
+            proc.send_signal(signal.SIGUSR2)
+            deadline = time.monotonic() + 15
+            text = ""
+            while time.monotonic() < deadline:
+                # Poll for CONTENT, not existence: the handler's
+                # open-then-write is not atomic.
+                if dump.exists() and "MainThread" in (
+                        text := dump.read_text()):
+                    break
+                time.sleep(0.2)
+            assert "MainThread" in text, \
+                f"SIGUSR2 never produced a full stack dump: {text[:200]!r}"
+            assert proc.poll() is None  # the signal must not kill it
+
+            # Prometheus scrape reflects the real prepare above.
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics", timeout=10
+            ).read().decode()
+            assert "tpu_dra_request_duration_seconds_bucket" in body
+            assert 'operation="NodePrepareResources"' in body
+            assert "tpu_dra_prepared_devices 1.0" in body
+            kubelet.unprepare(DRIVER, ["dbg-1"])
+        finally:
+            stop(proc, log)
+            api.stop()
