@@ -226,6 +226,105 @@ class DraScheduler:
                                 statuses + new_statuses}},
                     namespace=ns)
 
+    def _generate_extended_resource_claims(self):
+        """KEP-5004 (DRAExtendedResource): a pod requesting an extended
+        resource that a DeviceClass advertises via
+        ``spec.extendedResourceName`` gets an auto-generated
+        ResourceClaim against that class, recorded in
+        ``pod.status.extendedResourceClaimStatus`` -- the legacy
+        ``google.com/tpu: N`` surface (reference analog: the
+        'nvidia.com/gpu with DRAExtendedResource' bats scenario, which
+        delegates to kube-scheduler; here the in-tree scheduler does
+        it so demo/specs/extended-resources executes for real)."""
+        try:
+            by_resource = self._extended_resource_classes()
+        except KubeError:
+            return
+        if not by_resource:
+            return
+        for pod in self._pods():
+            if pod.get("status", {}).get("extendedResourceClaimStatus"):
+                continue
+            # Finished / terminating pods must not acquire devices.
+            if pod.get("status", {}).get("phase") in ("Succeeded",
+                                                      "Failed"):
+                continue
+            if _meta(pod).get("deletionTimestamp"):
+                continue
+            requests, mappings = [], []
+            bad_qty = False
+            for c in pod.get("spec", {}).get("containers", []):
+                limits = (c.get("resources") or {}).get("limits") or {}
+                for rname, qty in limits.items():
+                    cls_name = by_resource.get(rname)
+                    if not cls_name:
+                        continue
+                    # Extended-resource quantities must be whole
+                    # numbers; a malformed one must not wedge the
+                    # whole scheduling pass.
+                    try:
+                        count = int(str(qty))
+                    except ValueError:
+                        logger.warning(
+                            "pod %s/%s: non-integer extended-resource "
+                            "quantity %s=%r; skipping pod",
+                            _meta(pod).get("namespace", "default"),
+                            _meta(pod)["name"], rname, qty)
+                        bad_qty = True
+                        break
+                    req = f"request-{len(mappings)}"
+                    exactly: dict = {"deviceClassName": cls_name}
+                    if count != 1:
+                        exactly["count"] = count
+                    requests.append({"name": req, "exactly": exactly})
+                    mappings.append({
+                        "containerName": c.get("name", ""),
+                        "resourceName": rname,
+                        "requestName": req,
+                    })
+                if bad_qty:
+                    break
+            if not requests or bad_qty:
+                continue
+            ns = _meta(pod).get("namespace", "default")
+            # DETERMINISTIC name (pod uid, not uuid4): create + status
+            # patch are not atomic, and a retried pass must converge on
+            # the same claim instead of leaking allocated orphans.
+            pod_uid = _meta(pod).get("uid", "") or _meta(pod)["name"]
+            claim_name = (f"{_meta(pod)['name']}-extended-resources-"
+                          f"{pod_uid[-5:]}")
+            claim = {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim",
+                "metadata": {
+                    "name": claim_name,
+                    "namespace": ns,
+                    "uid": f"claim-{uuid.uuid4().hex[:12]}",
+                    "ownerReferences": [{
+                        "apiVersion": "v1", "kind": "Pod",
+                        "name": _meta(pod)["name"],
+                        "uid": _meta(pod).get("uid", ""),
+                        "controller": True,
+                    }],
+                },
+                "spec": {"devices": {"requests": requests}},
+            }
+            try:
+                self.kube.create(*RESOURCE, "resourceclaims", claim,
+                                 namespace=ns)
+            except ConflictError:
+                pass  # an earlier pass created it; converge on it
+            self.kube.patch(
+                "", "v1", "pods", _meta(pod)["name"],
+                {"status": {"extendedResourceClaimStatus": {
+                    "resourceClaimName": claim_name,
+                    "requestMappings": mappings,
+                }}},
+                namespace=ns)
+            logger.info(
+                "generated extended-resource claim %s/%s for pod %s",
+                ns, claim_name, _meta(pod)["name"])
+
     # -- allocation (kube-scheduler DRA plugin) -------------------------------
 
     def _snapshot(self):
@@ -557,6 +656,10 @@ class DraScheduler:
                     ref["name"])
                 if claim_name:
                     pins[(ns, claim_name)] = node
+            ext = pod.get("status", {}).get(
+                "extendedResourceClaimStatus") or {}
+            if ext.get("resourceClaimName"):
+                pins[(ns, ext["resourceClaimName"])] = node
         return pins
 
     def _allocate_claims(self):
@@ -607,6 +710,14 @@ class DraScheduler:
                     namespace=ns)))
             except NotFoundError:
                 out.append((claim_name, None))
+        ext = pod.get("status", {}).get("extendedResourceClaimStatus") or {}
+        if ext.get("resourceClaimName"):
+            try:
+                out.append((ext["resourceClaimName"], self.kube.get(
+                    *RESOURCE, "resourceclaims",
+                    ext["resourceClaimName"], namespace=ns)))
+            except NotFoundError:
+                out.append((ext["resourceClaimName"], None))
         return out
 
     def _reserve(self, claim, pod):
@@ -623,12 +734,46 @@ class DraScheduler:
                 {"status": {"reservedFor": reserved + [entry]}},
                 namespace=ns)
 
+    def _extended_resource_classes(self) -> dict[str, str]:
+        """extended resource name -> DeviceClass name, for classes
+        advertising ``spec.extendedResourceName`` (KEP-5004)."""
+        return {
+            cls["spec"]["extendedResourceName"]: name
+            for name, cls in self._device_classes().items()
+            if cls.get("spec", {}).get("extendedResourceName")
+        }
+
+    def _pending_extended_resource(self, pod,
+                                   names: set[str] | None) -> bool:
+        """True while a pod requests a DRA-served extended resource but
+        its auto-generated claim has not been recorded yet -- binding
+        before that would run the pod deviceless. ``names`` is the
+        advertised-resource set (None = the lookup failed this pass:
+        fail CLOSED for any domain-prefixed limit and retry)."""
+        if pod.get("status", {}).get("extendedResourceClaimStatus"):
+            return False
+        limits = [
+            rname
+            for c in pod.get("spec", {}).get("containers", [])
+            for rname in ((c.get("resources") or {}).get("limits") or {})
+        ]
+        if names is None:
+            return any("/" in rname for rname in limits)
+        return any(rname in names for rname in limits)
+
     def _bind_pods(self):
+        try:
+            ext_names: set[str] | None = set(
+                self._extended_resource_classes())
+        except KubeError:
+            ext_names = None  # fail closed per-pod, retry next pass
         for pod in self._pods():
             if pod.get("spec", {}).get("nodeName"):
                 continue
             if pod.get("status", {}).get("phase") not in (
                     None, "", "Pending"):
+                continue
+            if self._pending_extended_resource(pod, ext_names):
                 continue
             nodes = set()
             ready = True
@@ -811,6 +956,7 @@ class DraScheduler:
         self._sync_daemonsets()
         self._sync_jobs()
         self._generate_claims()
+        self._generate_extended_resource_claims()
         self._allocate_claims()
         self._bind_pods()
 

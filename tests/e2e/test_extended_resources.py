@@ -1,0 +1,118 @@
+"""Extended-resource (KEP-5004 / DRAExtendedResource) e2e: the legacy
+``google.com/tpu: 1`` surface with NO resourceClaims block.
+
+The in-tree scheduler auto-generates a ResourceClaim against the
+DeviceClass advertising ``extendedResourceName``, records it in
+``pod.status.extendedResourceClaimStatus``, and the pod runs with the
+full CDI/env contract -- demo/specs/extended-resources/tpu-full.yaml
+applied VERBATIM. Reference analog: the "handle legacy
+'nvidia.com/gpu: 1' (with DRAExtendedResource)" bats scenario, which
+delegates the claim generation to kube-scheduler.
+"""
+
+import os
+
+import pytest
+import yaml
+
+from tests.e2e.conftest import MODE
+from tests.e2e.framework import REPO, pod_log, pod_phase, wait_for
+
+pytestmark = pytest.mark.skipif(
+    MODE != "fake",
+    reason="extended-resource flow drives the in-tree scheduler",
+)
+
+SPEC = os.path.join(REPO, "demo", "specs", "extended-resources",
+                    "tpu-full.yaml")
+
+
+class TestExtendedResources:
+    @pytest.fixture()
+    def extended_device_class(self, kube):
+        # The chart enables this with --set extendedResources.enabled
+        # =true; the fake cluster applies default values, so flip the
+        # published DeviceClass exactly as the chart would -- and flip
+        # it back (the cluster is session-scoped).
+        kube.patch("resource.k8s.io", "v1", "deviceclasses",
+                   "tpu.dra.dev",
+                   {"spec": {"extendedResourceName": "google.com/tpu"}})
+        yield
+        kube.patch("resource.k8s.io", "v1", "deviceclasses",
+                   "tpu.dra.dev", {"spec": {"extendedResourceName": None}})
+
+    def test_demo_spec_runs_verbatim(self, kube, chip_slice,
+                                     extended_device_class):
+
+        with open(SPEC, encoding="utf-8") as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        assert {d["kind"] for d in docs} == {"Namespace", "Pod"}
+        for doc in docs:
+            ns = doc["metadata"].get("namespace")
+            kube.create(
+                {"Namespace": ("", "v1", "namespaces"),
+                 "Pod": ("", "v1", "pods")}[doc["kind"]][0],
+                "v1",
+                {"Namespace": "namespaces", "Pod": "pods"}[doc["kind"]],
+                doc, namespace=ns)
+
+        wait_for(
+            lambda: pod_phase(kube, "tpu-full", "tpu-extended")
+            == "Succeeded",
+            timeout=180, desc="extended-resource pod success")
+
+        # The scheduler recorded the generated claim on the pod, and
+        # the claim allocated a real device.
+        pod = kube.get("", "v1", "pods", "tpu-full",
+                       namespace="tpu-extended")
+        ext = pod["status"]["extendedResourceClaimStatus"]
+        assert ext["requestMappings"][0]["resourceName"] == \
+            "google.com/tpu"
+        claim = kube.get("resource.k8s.io", "v1", "resourceclaims",
+                         ext["resourceClaimName"],
+                         namespace="tpu-extended")
+        results = claim["status"]["allocation"]["devices"]["results"]
+        assert len(results) == 1 and results[0]["driver"] == "tpu.dra.dev"
+
+        # The container saw the CDI-injected env contract.
+        assert "chips:" in pod_log(kube, "tpu-full", "tpu-extended")
+
+    def test_two_containers_get_their_own_chips(
+            self, kube, chip_slice, extended_device_class):
+        """Two containers each requesting google.com/tpu: 1 -- the
+        generated claim carries one request per container and each
+        container receives ONLY its own request's chip
+        (requestMappings semantics)."""
+        import json
+
+        probe = ("import os, json; print(json.dumps(sorted("
+                 "k for k in os.environ if k.startswith('TPU_DEVICE_'))))")
+        kube.create("", "v1", "pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "tpu-pair", "namespace": "tpu-extended"},
+            "spec": {
+                "restartPolicy": "Never",
+                "containers": [
+                    {"name": f"jax-{i}", "image": "python:3.12",
+                     "command": ["python", "-c", probe],
+                     "resources": {"limits": {"google.com/tpu": 1}}}
+                    for i in range(2)
+                ],
+                "tolerations": [{"key": "google.com/tpu",
+                                 "operator": "Exists",
+                                 "effect": "NoSchedule"}],
+            },
+        }, namespace="tpu-extended")
+        wait_for(
+            lambda: pod_phase(kube, "tpu-pair", "tpu-extended")
+            == "Succeeded",
+            timeout=180, desc="two-container extended pod success")
+        log = pod_log(kube, "tpu-pair", "tpu-extended")
+        markers = {}
+        for line in log.strip().splitlines():
+            # Multi-container logs are prefixed "[name] ".
+            name, _, payload = line.partition("] ")
+            markers[name.lstrip("[")] = json.loads(payload)
+        assert set(markers) == {"jax-0", "jax-1"}, log
+        assert all(len(v) == 1 for v in markers.values()), markers
+        assert markers["jax-0"] != markers["jax-1"], markers

@@ -189,6 +189,41 @@ class FakeNode:
             if not claim.get("status", {}).get("allocation"):
                 return None
             out.append((ref["name"], claim))
+        # KEP-5004: the scheduler-generated extended-resource claim is
+        # referenced from pod STATUS, not spec.resourceClaims. A pod
+        # requesting a DRA-ADVERTISED extended resource with no
+        # recorded claim yet must WAIT, not run deviceless; limits no
+        # DeviceClass serves never block (same predicate as the
+        # scheduler's _pending_extended_resource, so the two sides
+        # cannot deadlock disagreeing).
+        ext = pod.get("status", {}).get("extendedResourceClaimStatus") or {}
+        if not ext:
+            try:
+                served = {
+                    cls.get("spec", {}).get("extendedResourceName")
+                    for cls in self.kube.list(
+                        "resource.k8s.io", "v1", "deviceclasses")
+                }
+            except KubeError:
+                served = set()
+            served.discard(None)
+            if served and any(
+                    rname in served
+                    for c in pod.get("spec", {}).get("containers", [])
+                    for rname in ((c.get("resources") or {}).get("limits")
+                                  or {})):
+                return None
+        if ext.get("resourceClaimName"):
+            try:
+                claim = self.kube.get("resource.k8s.io", "v1",
+                                      "resourceclaims",
+                                      ext["resourceClaimName"],
+                                      namespace=ns)
+            except NotFoundError:
+                return None
+            if not claim.get("status", {}).get("allocation"):
+                return None
+            out.append(("<extended>", claim))
         return out
 
     # -- pod lifecycle --------------------------------------------------------
@@ -245,6 +280,14 @@ class FakeNode:
                 for dev in resp.claims[uid].devices:
                     ids_by_entry.setdefault(entry_name, []).extend(
                         dev.cdi_device_ids)
+                    if entry_name == "<extended>":
+                        # Per-request keys so each mapped container
+                        # receives only ITS request's devices
+                        # (KEP-5004 requestMappings semantics).
+                        for rn in dev.request_names:
+                            ids_by_entry.setdefault(
+                                f"<extended>:{rn}", []
+                            ).extend(dev.cdi_device_ids)
         return ids_by_entry
 
     def _container_env(self, pod, container, edits) -> dict[str, str]:
@@ -324,6 +367,20 @@ class FakeNode:
         ids = []
         for ref in container.get("resources", {}).get("claims") or []:
             ids.extend(ids_by_entry.get(ref["name"], []))
+        # KEP-5004: containers consuming an extended resource never
+        # name a claim; the pod-status mapping says which containers
+        # the generated claim serves, and each gets only its own
+        # request's devices.
+        ext = pod.get("status", {}).get("extendedResourceClaimStatus") or {}
+        if not ids and ext:
+            mine = [m for m in ext.get("requestMappings", [])
+                    if m.get("containerName") == container.get("name")]
+            for m in mine:
+                ids.extend(ids_by_entry.get(
+                    f"<extended>:{m.get('requestName')}", []))
+            if mine and not ids:
+                # Older plugin not reporting request_names: all devices.
+                ids = ids_by_entry.get("<extended>", [])
         if not ids and all_devices_fallback:
             ids = [i for v in ids_by_entry.values() for i in v]
         edits = resolve_cdi_devices(self.cdi_root, ids)
