@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast bench lint clean stamp-version
+.PHONY: all native test test-fast bench bench-smoke lint clean stamp-version
 
 VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
 
@@ -34,6 +34,16 @@ test-fast: native
 	    --ignore=tests/test_moe.py
 
 bench: native
+	$(PYTHON) bench.py
+
+# Tier-1-safe smoke: the full bench pipeline (prepare/unprepare churn,
+# stress lock-wait extras, mock multichip section) at reduced iters, no
+# on-chip model benches. Checkpoint/locking regressions fail fast here
+# before they show up as a BENCH trajectory dip. Mirrored as a non-slow
+# test in tests/test_bench_smoke.py.
+bench-smoke: native
+	BENCH_SKIP_MODEL=1 BENCH_MULTICHIP_MOCK=2 \
+	BENCH_ITERS=5 BENCH_STRESS_ITERS=5 \
 	$(PYTHON) bench.py
 
 lint:

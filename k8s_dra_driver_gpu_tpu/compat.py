@@ -1,0 +1,23 @@
+# Copyright 2025 The tpu-dra-driver Authors.
+# SPDX-License-Identifier: Apache-2.0
+"""Version-compatibility shims for the pinned accelerator toolchain.
+
+jax.shard_map is the stable spelling only in newer JAX releases; the
+toolchain baked into CI (0.4.x) still ships it under
+jax.experimental.shard_map. Every in-tree user imports the symbol from
+here so the version probe lives in exactly one place.
+"""
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - version-dependent import
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, **kwargs):
+        # The replication-check kwarg was renamed check_rep -> check_vma
+        # when shard_map stabilized; callers use the new spelling.
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(f, **kwargs)

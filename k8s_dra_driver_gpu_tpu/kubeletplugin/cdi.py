@@ -27,8 +27,10 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from dataclasses import dataclass, field
 
+from ..pkg.fsutil import stat_signature
 from . import CDI_CLASS, CDI_VENDOR
 
 CDI_VERSION = "0.6.0"
@@ -98,6 +100,12 @@ class CDIHandler:
     ):
         self._root = cdi_root
         self._libtpu = libtpu_path
+        # Stat-validated parse cache: claim_uid -> ((mtime_ns, size,
+        # ino), parsed spec). A warm repeat-prepare's idempotent check
+        # pays a stat instead of a read+json.loads; an externally
+        # rewritten (or crash-truncated) file misses the cache.
+        self._spec_cache: dict[str, tuple[tuple[int, int, int], dict]] = {}
+        self._spec_cache_lock = threading.Lock()
         os.makedirs(self._root, exist_ok=True)
 
     def _spec_path(self, claim_uid: str) -> str:
@@ -146,9 +154,15 @@ class CDIHandler:
             # retried Prepare after any crash (the checkpoint, which IS
             # fsync'd, is the recovery anchor). Saves ~1ms per prepare.
         os.replace(tmp, self._spec_path(claim_uid))
+        sig = self._stat_sig(claim_uid)
+        if sig is not None:
+            with self._spec_cache_lock:
+                self._spec_cache[claim_uid] = (sig, spec)
         return [qualified_device_id(d["name"]) for d in devices]
 
     def delete_claim_spec_file(self, claim_uid: str) -> None:
+        with self._spec_cache_lock:
+            self._spec_cache.pop(claim_uid, None)
         try:
             os.unlink(self._spec_path(claim_uid))
         except FileNotFoundError:
@@ -157,13 +171,30 @@ class CDIHandler:
     def spec_exists(self, claim_uid: str) -> bool:
         return os.path.exists(self._spec_path(claim_uid))
 
+    def _stat_sig(self, claim_uid: str) -> tuple[int, int, int] | None:
+        return stat_signature(self._spec_path(claim_uid))
+
     def read_spec(self, claim_uid: str) -> dict | None:
         """None when absent; raises ValueError on corrupt JSON (a
         crash-truncated un-fsync'd spec)."""
+        sig = self._stat_sig(claim_uid)
+        if sig is None:
+            with self._spec_cache_lock:
+                self._spec_cache.pop(claim_uid, None)
+            return None
+        with self._spec_cache_lock:
+            cached = self._spec_cache.get(claim_uid)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
         try:
             with open(self._spec_path(claim_uid), encoding="utf-8") as f:
-                return json.load(f)
+                spec = json.load(f)
         except FileNotFoundError:
             return None
         except json.JSONDecodeError as e:
+            with self._spec_cache_lock:
+                self._spec_cache.pop(claim_uid, None)
             raise ValueError(f"corrupt CDI spec for {claim_uid}: {e}") from e
+        with self._spec_cache_lock:
+            self._spec_cache[claim_uid] = (sig, spec)
+        return spec

@@ -20,12 +20,15 @@ import difflib
 import json
 import logging
 import os
+import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 from enum import Enum
 
 from ..pkg import bootid
 from ..pkg.flock import Flock
+from ..pkg.fsutil import stat_signature
 
 logger = logging.getLogger(__name__)
 
@@ -79,6 +82,11 @@ class CheckpointedClaim:
     name: str = ""
     state: str = ClaimState.PREPARE_STARTED.value
     devices: list[CheckpointedDevice] = field(default_factory=list)
+    # NOTE: the prepare-reservation pid-lease deliberately does NOT
+    # live in this record: adding fields to the v2 payload would break
+    # cross-version checksum verification during upgrade handover (the
+    # issue-1080 class). It is a sidecar file -- see
+    # device_state._ReservationLeases.
 
     def to_dict(self) -> dict:
         d: dict = {"uid": self.uid, "state": self.state}
@@ -86,6 +94,13 @@ class CheckpointedClaim:
             d["namespace"] = self.namespace
         if self.name:
             d["name"] = self.name
+        if self.devices:
+            d["devices"] = [x.to_dict() for x in self.devices]
+        return d
+
+    def to_dict_v1(self) -> dict:
+        # v1 lacked namespace/name.
+        d: dict = {"uid": self.uid, "state": self.state}
         if self.devices:
             d["devices"] = [x.to_dict() for x in self.devices]
         return d
@@ -127,18 +142,7 @@ class Checkpoint:
     def _payload_v1(self) -> dict:
         # v1 lacked boot-id and namespace/name.
         return {
-            "claims": {
-                uid: {
-                    "uid": c.uid,
-                    "state": c.state,
-                    **(
-                        {"devices": [x.to_dict() for x in c.devices]}
-                        if c.devices
-                        else {}
-                    ),
-                }
-                for uid, c in self.claims.items()
-            }
+            "claims": {uid: c.to_dict_v1() for uid, c in self.claims.items()}
         }
 
     def to_dict(self) -> dict:
@@ -197,12 +201,46 @@ def _diagnose(on_disk: dict, cp: Checkpoint, version: str) -> str:
     return f"checkpoint checksum mismatch ({version}); diff:\n{diff}"
 
 
+class _Commit:
+    """One enqueued checkpoint mutation: the flusher that writes the
+    batch containing it sets ``err`` (None on success) and ``done``."""
+
+    __slots__ = ("fn", "dirty", "done", "err")
+
+    def __init__(self, fn, dirty):
+        self.fn = fn
+        self.dirty = dirty  # uids whose fragments fn touches; None = all
+        self.done = threading.Event()
+        self.err: BaseException | None = None
+
+
 class CheckpointManager:
-    """Flock-guarded read-modify-write of checkpoint.json.
+    """Flock-guarded, group-committed writer of checkpoint.json.
 
     On startup: if the recorded boot ID differs from the node's current
     one, the checkpoint is invalidated wholesale (a reboot destroyed all
     device state; checkpointv.go:74-81, device_state.go:190-215).
+
+    Concurrency design (the claim-prepare hot path):
+
+    - **Stat-validated read cache.** The parsed Checkpoint is kept in
+      memory; get()/update only re-read the file when its
+      (mtime_ns, size) signature changed -- i.e. when ANOTHER process
+      wrote it (upgrade handover). Same-process callers pay a stat, not
+      a parse.
+    - **Dirty-tracked claim fragments.** The canonical JSON encoding of
+      each claim (the input to both the v1 and v2 checksums) is cached
+      per uid and invalidated only for claims a mutation touched, so a
+      single-claim update re-encodes one claim, not all N.
+      ``update_claim`` is the precise API; the legacy ``update(fn)``
+      conservatively marks everything dirty.
+    - **Group commit.** Mutations enqueue; one flusher thread at a time
+      drains the whole queue into ONE read-apply-write-fdatasync cycle
+      under the flock, then wakes every committer whose mutation the
+      batch covered. Concurrent committers therefore share a single
+      fsync instead of serializing N of them. A committer returns only
+      after its mutation is durable, preserving the two-phase-prepare
+      invariant (PrepareStarted on disk before any device mutation).
     """
 
     FILENAME = "checkpoint.json"
@@ -214,9 +252,20 @@ class CheckpointManager:
         self._boot_id = (
             boot_id if boot_id is not None else bootid.read_boot_id()
         )
+        # In-memory mirror + fragment caches; all guarded by self._lock
+        # (its internal thread mutex serializes same-process access).
+        self._cp: Checkpoint | None = None
+        self._sig: tuple[int, int, int] | None = None
+        self._frags_v1: dict[str, str] = {}
+        self._frags_v2: dict[str, str] = {}
+        # Group-commit state, guarded by self._cond.
+        self._cond = threading.Condition()
+        self._pending: list[_Commit] = []
+        self._flusher_active = False
+
         self.invalidated_on_boot = False
         with self._lock.acquire(timeout=10.0):
-            cp = self._read()
+            cp = self._read_locked()
             if cp.node_boot_id and self._boot_id and cp.node_boot_id != self._boot_id:
                 logger.warning(
                     "node boot ID changed (%s -> %s): invalidating checkpoint "
@@ -224,42 +273,183 @@ class CheckpointManager:
                     cp.node_boot_id, self._boot_id, len(cp.claims),
                 )
                 cp = Checkpoint(node_boot_id=self._boot_id)
-                self._write(cp)
+                self._invalidate_frags(None)
+                self._write_locked(cp)
                 self.invalidated_on_boot = True
             elif not cp.node_boot_id:
                 cp.node_boot_id = self._boot_id
-                self._write(cp)
+                self._write_locked(cp)
 
     @property
     def path(self) -> str:
         return self._path
 
-    def _read(self) -> Checkpoint:
-        if not os.path.exists(self._path):
-            return Checkpoint(node_boot_id="")
-        with open(self._path, "r", encoding="utf-8") as f:
-            return Checkpoint.from_dict(json.load(f))
+    # -- cached read / fragment-assembled write (call under self._lock) -------
 
-    def _write(self, cp: Checkpoint) -> None:
+    def _stat_sig(self) -> tuple[int, int, int] | None:
+        return stat_signature(self._path)
+
+    def _read_locked(self) -> Checkpoint:
+        sig = self._stat_sig()
+        if self._cp is not None and sig is not None and sig == self._sig:
+            return self._cp
+        if sig is None:
+            cp = Checkpoint(node_boot_id="")
+        else:
+            with open(self._path, "r", encoding="utf-8") as f:
+                cp = Checkpoint.from_dict(json.load(f))
+        # Cache only after a successful parse; corruption propagates and
+        # leaves the cache untouched so the next read retries the file.
+        self._cp = cp
+        self._sig = sig
+        self._invalidate_frags(None)
+        return cp
+
+    def _invalidate_frags(self, dirty_uids) -> None:
+        if dirty_uids is None:
+            self._frags_v1.clear()
+            self._frags_v2.clear()
+        else:
+            for uid in dirty_uids:
+                self._frags_v1.pop(uid, None)
+                self._frags_v2.pop(uid, None)
+
+    def _payload_str(self, cp: Checkpoint, version: str) -> str:
+        """Canonical JSON (sort_keys + compact separators) assembled
+        from cached per-claim fragments -- byte-identical to
+        ``json.dumps(payload, sort_keys=True, separators=(",", ":"))``
+        over the corresponding ``_payload_vN()`` dict, which is what
+        the checksum verifier re-marshals on read."""
+        frags = self._frags_v2 if version == "v2" else self._frags_v1
+        parts = []
+        for uid in sorted(cp.claims):
+            frag = frags.get(uid)
+            if frag is None:
+                claim = cp.claims[uid]
+                d = claim.to_dict() if version == "v2" else claim.to_dict_v1()
+                frag = json.dumps(d, sort_keys=True, separators=(",", ":"))
+                frags[uid] = frag
+            parts.append(f"{json.dumps(uid)}:{frag}")
+        claims = "{" + ",".join(parts) + "}"
+        if version == "v2":
+            return ('{"claims":' + claims + ',"nodeBootID":'
+                    + json.dumps(cp.node_boot_id) + "}")
+        return '{"claims":' + claims + "}"
+
+    def _write_locked(self, cp: Checkpoint) -> None:
         cp.node_boot_id = cp.node_boot_id or self._boot_id
+        # Stale fragments for uids no longer present would leak; drop them.
+        for uid in set(self._frags_v2) - set(cp.claims):
+            self._frags_v1.pop(uid, None)
+            self._frags_v2.pop(uid, None)
+        v1 = self._payload_str(cp, "v1")
+        v2 = self._payload_str(cp, "v2")
+        doc = (
+            '{"version":"' + LATEST_VERSION + '","data":' + v2
+            + ',"checksums":{"v1":' + str(zlib.crc32(v1.encode()))
+            + ',"v2":' + str(zlib.crc32(v2.encode())) + "}}"
+        )
         tmp = self._path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(cp.to_dict(), f, indent=1)
+            f.write(doc)
             f.flush()
             # fdatasync: the data must be durable before the rename; the
             # tmp file's metadata (mtime) need not be -- saves one
             # journal commit per write on the 2x-per-Prepare hot path.
             os.fdatasync(f.fileno())
         os.replace(tmp, self._path)
+        self._cp = cp
+        self._sig = self._stat_sig()
+
+    # -- public API -----------------------------------------------------------
 
     def get(self) -> Checkpoint:
+        """A read snapshot. The claims mapping is a fresh dict; the claim
+        objects are shared with the cache -- treat them as read-only."""
         with self._lock.acquire(timeout=10.0):
-            return self._read()
+            cp = self._read_locked()
+            return Checkpoint(node_boot_id=cp.node_boot_id,
+                              claims=dict(cp.claims))
 
-    def update(self, fn) -> Checkpoint:
-        """Atomic read-modify-write: fn(checkpoint) mutates in place."""
-        with self._lock.acquire(timeout=10.0):
-            cp = self._read()
-            fn(cp)
-            self._write(cp)
-            return cp
+    def update(self, fn) -> None:
+        """Atomic read-modify-write: fn(checkpoint) mutates in place.
+        Arbitrary mutation -> every claim fragment is marked dirty; hot
+        paths should prefer update_claim()."""
+        self._submit(fn, None)
+
+    def update_claim(self, uid: str, claim: CheckpointedClaim | None,
+                     timer=None) -> None:
+        """Upsert (or, with None, remove) ONE claim record. Re-encodes
+        only that claim; the wait for the (possibly shared) fsync is
+        recorded as the timer's ``ckpt_fsync_wait`` segment."""
+        def fn(cp: Checkpoint) -> None:
+            if claim is None:
+                cp.claims.pop(uid, None)
+            else:
+                cp.claims[uid] = claim
+
+        self._submit(fn, {uid}, timer=timer)
+
+    # -- group commit ---------------------------------------------------------
+
+    def _submit(self, fn, dirty_uids, timer=None) -> None:
+        t0 = time.monotonic()
+        commit = _Commit(fn, dirty_uids)
+        try:
+            with self._cond:
+                self._pending.append(commit)
+            while True:
+                with self._cond:
+                    if commit.done.is_set():
+                        break
+                    if self._flusher_active or not self._pending:
+                        # Another thread's flush covers us (or already
+                        # took us into its batch); it notifies when the
+                        # outcome of OUR batch is known.
+                        self._cond.wait(timeout=1.0)
+                        continue
+                    self._flusher_active = True
+                    batch = self._pending
+                    self._pending = []
+                self._flush(batch)
+            if commit.err is not None:
+                raise RuntimeError(
+                    "checkpoint group commit failed"
+                ) from commit.err
+        finally:
+            if timer is not None:
+                timer.segments["ckpt_fsync_wait"] = timer.segments.get(
+                    "ckpt_fsync_wait", 0.0) + (time.monotonic() - t0)
+
+    def _flush(self, batch: list["_Commit"]) -> None:
+        err: BaseException | None = None
+        try:
+            with self._lock.acquire(timeout=10.0):
+                try:
+                    cp = self._read_locked()
+                    for commit in batch:
+                        commit.fn(cp)
+                        self._invalidate_frags(commit.dirty)
+                    self._write_locked(cp)
+                except BaseException:
+                    # The cached Checkpoint may hold the batch's partial
+                    # (never-persisted) mutations: poison it so the next
+                    # reader re-parses the durable file.
+                    self._cp = None
+                    self._sig = None
+                    self._invalidate_frags(None)
+                    raise
+        except BaseException as e:  # noqa: BLE001 - propagated to waiters
+            err = e
+        with self._cond:
+            self._flusher_active = False
+            # Per-commit outcome: only the commits whose mutations were
+            # in THIS failed batch see the error; a commit that already
+            # flushed durably can never be failed retroactively by a
+            # later batch's write error.
+            for commit in batch:
+                commit.err = err
+                commit.done.set()
+            self._cond.notify_all()
+        # No raise here: every committer in the batch (this thread
+        # included) reports through its own commit.err in _submit.

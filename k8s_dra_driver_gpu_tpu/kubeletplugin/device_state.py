@@ -23,6 +23,8 @@ import os
 import threading
 import time
 import uuid as uuidlib
+from collections import deque
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from ..api import configs as api_configs
@@ -35,6 +37,7 @@ from ..pkg.featuregates import (
     FeatureGates,
 )
 from ..pkg.flock import Flock
+from ..pkg.fsutil import write_json_atomic
 from ..pkg.timing import SegmentTimer
 from ..tpulib.binding import EnumerateOptions, TpuHostInfo, load as load_tpulib
 from .cdi import CDIHandler, ContainerEdits
@@ -110,10 +113,16 @@ class Config:
 class SubSliceRegistry:
     """Node-local registry of live dynamic carve-outs (hardware truth for
     crash reconciliation; the analog of walking NVML for stray MIG
-    devices, nvlib.go:420)."""
+    devices, nvlib.go:420).
+
+    The read-modify-write is flock-guarded: with the sharded prepare
+    pipeline, carve-out creates for disjoint claims run concurrently
+    (across threads AND processes during upgrade handover) and all land
+    in this one file."""
 
     def __init__(self, root: str):
         self._path = os.path.join(root, "subslices.json")
+        self._lock = Flock(self._path + ".lock")
 
     def list(self) -> dict[str, dict]:
         try:
@@ -133,14 +142,125 @@ class SubSliceRegistry:
         os.replace(tmp, self._path)
 
     def create(self, live: SubSliceLiveTuple) -> None:
-        entries = self.list()
-        entries[live.uuid] = live.to_dict()
-        self._write(entries)
+        with self._lock.acquire(timeout=10.0):
+            entries = self.list()
+            entries[live.uuid] = live.to_dict()
+            self._write(entries)
 
     def destroy(self, uuid: str) -> None:
-        entries = self.list()
-        if entries.pop(uuid, None) is not None:
-            self._write(entries)
+        with self._lock.acquire(timeout=10.0):
+            entries = self.list()
+            if entries.pop(uuid, None) is not None:
+                self._write(entries)
+
+
+def _proc_start_ticks(pid: int) -> int:
+    """The process's starttime in clock ticks from /proc/<pid>/stat
+    (field 22) -- the kernel's stable identity for a pid within one
+    boot. 0 when the process doesn't exist (or /proc is unreadable)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read()
+        # comm (field 2) is parenthesized and may itself contain spaces
+        # and parens; split only after its LAST ')'. starttime is field
+        # 22 overall = index 19 of the fields after state (field 3).
+        rest = data[data.rindex(b")") + 2:].split()
+        return int(rest[19])
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+class _ReservationLeases:
+    """Sidecar pid-leases for PrepareStarted reservations.
+
+    Deliberately NOT part of checkpoint.json: extra fields in the v2
+    payload would break cross-version checksum verification during
+    upgrade handover (the issue-1080 class). A lease pins the pid +
+    /proc starttime of the process whose prepare owns the reservation,
+    so a same-claim retry in another process can distinguish a live
+    peer's in-flight middle (fail retriable) from a crashed one (roll
+    back) -- and a recycled pid reads as dead, never wedging the claim.
+    A STARTED record with no lease is treated as crashed (that is also
+    the pre-lease format's semantics). Written under the global
+    reservation flock; advisory, so no fsync."""
+
+    def __init__(self, root: str):
+        self._dir = os.path.join(root, "leases")
+        os.makedirs(self._dir, exist_ok=True)
+
+    def _path(self, uid: str) -> str:
+        return os.path.join(self._dir, f"{uid}.json")
+
+    def write(self, uid: str) -> None:
+        # Recreate the dir: boot-ID invalidation rmtree's it wholesale.
+        os.makedirs(self._dir, exist_ok=True)
+        pid = os.getpid()
+        write_json_atomic(self._path(uid),
+                          {"pid": pid, "start": _proc_start_ticks(pid)})
+
+    def read(self, uid: str) -> tuple[int, int] | None:
+        try:
+            with open(self._path(uid), encoding="utf-8") as f:
+                doc = json.load(f)
+            return int(doc["pid"]), int(doc["start"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def clear(self, uid: str) -> None:
+        try:
+            os.unlink(self._path(uid))
+        except FileNotFoundError:
+            pass
+
+
+class ShardedLocks:
+    """Per-chip-position locks for the expensive middle of Prepare.
+
+    Claims touching disjoint chips hold disjoint shard sets and run
+    concurrently; shards are acquired in sorted order so overlapping
+    holders (same-chip core-level carve-outs, unprepare vs. stale
+    rollback) can never deadlock."""
+
+    def __init__(self):
+        self._locks: dict[int, threading.Lock] = {}
+        self._mutex = threading.Lock()
+
+    def _lock_for(self, shard: int) -> threading.Lock:
+        with self._mutex:
+            lock = self._locks.get(shard)
+            if lock is None:
+                lock = self._locks[shard] = threading.Lock()
+            return lock
+
+    # Bounded like the node flock's 10s (driver.go:381): a wedged
+    # middle (hung vfio rebind, stuck tenancy agent) must fail later
+    # same-chip operations with a clear error, not park kubelet's gRPC
+    # threads on the lock forever.
+    TIMEOUT_S = 10.0
+
+    @contextmanager
+    def hold(self, shards, timer: SegmentTimer | None = None):
+        locks = [self._lock_for(s) for s in sorted(set(shards))]
+        t0 = time.monotonic()
+        deadline = t0 + self.TIMEOUT_S
+        acquired: list[threading.Lock] = []
+        try:
+            for lock in locks:
+                if not lock.acquire(
+                        timeout=max(0.0, deadline - time.monotonic())):
+                    raise PrepareError(
+                        f"timed out after {self.TIMEOUT_S}s waiting for "
+                        "chip shard lock (another claim's "
+                        "prepare/unprepare is wedged on this chip)"
+                    )
+                acquired.append(lock)
+            if timer is not None:
+                timer.segments["prep_lock_wait"] = timer.segments.get(
+                    "prep_lock_wait", 0.0) + (time.monotonic() - t0)
+            yield
+        finally:
+            for lock in reversed(acquired):
+                lock.release()
 
 
 class DeviceState:
@@ -149,10 +269,27 @@ class DeviceState:
     def __init__(self, config: Config):
         self._config = config
         os.makedirs(config.root, exist_ok=True)
+        # Guards the short global reservation section and the in-flight
+        # claim set; the expensive middle of Prepare runs under per-chip
+        # shard locks instead (see prepare()).
         self._lock = threading.Lock()
-        # Node-global prepare/unprepare flock: excludes other plugin
-        # processes across upgrades (reference driver.go:46-47).
+        self._shards = ShardedLocks()
+        self._inflight: set[str] = set()
+        # Node-global reservation flock: excludes other plugin processes'
+        # overlap-validation/reservation sections across upgrades
+        # (reference driver.go:46-47). Held only for the reservation
+        # critical section, not the whole prepare.
         self.pu_lock = Flock(os.path.join(config.root, "pu.lock"))
+        # Sidecar pid-leases for in-flight PrepareStarted reservations
+        # (kept out of checkpoint.json for cross-version checksum
+        # compatibility).
+        self._leases = _ReservationLeases(config.root)
+        # Per-segment wall-time history (lock waits, fsync waits, ...)
+        # for bench.py percentiles, plus an optional live observer
+        # (pkg/metrics.py histogram, wired by the Driver).
+        self._segment_history: dict[str, deque] = {}
+        self._history_lock = threading.Lock()
+        self.segment_observer = None  # callable(operation, segments) | None
 
         self._tpulib = load_tpulib()
         self.host: TpuHostInfo = self._tpulib.enumerate(config.tpulib_opts)
@@ -189,11 +326,23 @@ class DeviceState:
             self._cleanup_all_side_state()
         self.destroy_unknown_subslices()
         # Re-own tenancy state for claims that survived the restart
-        # (respawn their enforcement agents; drop orphan dirs).
+        # (respawn their enforcement agents; drop orphan dirs). A live
+        # PEER's in-flight reservation (upgrade handover) counts as
+        # active: its tenancy dir is mid-setup, not an orphan.
         self._tenancy.reconcile({
             uid for uid, c in self._checkpoint.get().claims.items()
             if c.state == ClaimState.PREPARE_COMPLETED.value
-        })
+        } | self._live_foreign_reservations())
+
+    def _live_foreign_reservations(self) -> set[str]:
+        """Uids of PrepareStarted reservations owned by a LIVE peer
+        plugin process (upgrade handover): their partial device state
+        is in active mutation and must be left alone by sweeps."""
+        return {
+            uid for uid, c in self._checkpoint.get().claims.items()
+            if c.state == ClaimState.PREPARE_STARTED.value
+            and self._foreign_owner_alive(uid)
+        }
 
     def stop(self) -> None:
         """Stop background machinery (supervised tenancy agents)."""
@@ -287,7 +436,7 @@ class DeviceState:
     def _cleanup_all_side_state(self) -> None:
         import shutil  # noqa: PLC0415
 
-        for sub in ("timeslice", "tenancy"):
+        for sub in ("timeslice", "tenancy", "leases"):
             shutil.rmtree(os.path.join(self._config.root, sub),
                           ignore_errors=True)
         os.makedirs(os.path.join(self._config.root, "timeslice"), exist_ok=True)
@@ -310,7 +459,20 @@ class DeviceState:
     def destroy_unknown_subslices(self) -> int:
         """Tear down live carve-outs AND orphaned vfio rebinds not
         referenced by any checkpointed claim (checkpoint is source of
-        truth; device_state.go:388)."""
+        truth; device_state.go:388).
+
+        Deferred wholesale while a LIVE peer process's prepare is in
+        flight (upgrade handover): its just-created carve-out/rebind
+        has no claim record yet and would read as an orphan. True
+        orphans are swept on the next pass, once no handover is live."""
+        live_peers = self._live_foreign_reservations()
+        if live_peers:
+            logger.warning(
+                "deferring unknown-state sweep: claim(s) %s are mid-"
+                "prepare in a live peer plugin process",
+                sorted(live_peers),
+            )
+            return 0
         cp = self._checkpoint.get()
         referenced = {
             dev.live["uuid"]
@@ -349,122 +511,256 @@ class DeviceState:
     def prepare(self, claim: ResourceClaim) -> list[str]:
         """Idempotent two-phase prepare; returns CDI device IDs.
 
-        Holds the node-global flock for the whole operation so a second
-        plugin process (upgrade handover) can't interleave its own
-        prepare/unprepare between our overlap validation and checkpoint
-        writes (reference driver.go:381, pulock.Acquire with 10s timeout).
+        Locking hierarchy (disjoint claims prepare in PARALLEL):
+
+        1. **Global reservation section** -- node flock (excludes other
+           plugin processes, reference driver.go:381) + process lock,
+           held only for overlap validation, config resolution, and the
+           durable PrepareStarted record. The record carries the claim's
+           device names, so a competing validation (this process or
+           another) sees the reservation the instant the lock drops.
+        2. **Per-chip shard locks** -- the expensive middle (carve-out
+           create, sharing setup, CDI spec write) runs under the locks
+           of just the chips the claim touches.
+        3. **Group-committed checkpoint writes** -- concurrent claims
+           share fsyncs (see CheckpointManager).
 
         Per-segment wall times are logged at debug level (the t_prep_*
-        instrumentation, reference driver.go:394-404).
+        instrumentation, reference driver.go:394-404); ``prep_lock_wait``
+        and ``ckpt_fsync_wait`` also feed the metrics histogram and
+        bench.py's stress extras.
         """
         timer = SegmentTimer("prepare", claim.uid)
         try:
-            t0 = time.monotonic()
-            # Keep acquisition inside the with-statement: pulling the
-            # guard out would open an async-exception window where the
-            # non-reentrant flock leaks held.
-            with self.pu_lock.acquire(timeout=10.0), self._lock:
-                timer.segments["prep_lock_acq"] = time.monotonic() - t0
-                with timer.segment("prep_get_checkpoint"):
-                    cp = self._checkpoint.get()
-                existing = cp.claims.get(claim.uid)
-                if (existing
-                        and existing.state == ClaimState.PREPARE_COMPLETED.value):
-                    # Idempotent return ONLY if the (un-fsync'd,
-                    # regenerable) CDI spec actually survived; a
-                    # crash-truncated spec falls through to a full
-                    # re-prepare.
-                    try:
-                        spec_ok = self._cdi.read_spec(claim.uid) is not None
-                    except ValueError:
-                        spec_ok = False  # corrupt JSON
-                    if spec_ok:
-                        return [
-                            i for d in existing.devices
-                            for i in d.cdi_device_ids
-                        ]
-                    # Regenerating via rollback+re-prepare is only safe
-                    # when it can't disturb state a RUNNING workload may
-                    # hold: vfio rebinds and tenancy rendezvous dirs
-                    # must not be torn down under a live pod.
-                    disruptive = any(
-                        d.live and d.live.get("vfio")
-                        for d in existing.devices
-                    ) or self._tenancy.active(claim.uid)
-                    if disruptive:
-                        logger.error(
-                            "claim %s completed but CDI spec missing/"
-                            "corrupt; NOT re-preparing (live vfio/"
-                            "tenancy state) -- unprepare to recover",
-                            claim.uid,
-                        )
-                        return [
-                            i for d in existing.devices
-                            for i in d.cdi_device_ids
-                        ]
-                    logger.warning(
-                        "claim %s completed but CDI spec missing/corrupt; "
-                        "re-preparing", claim.uid,
+            return self._prepare_inner(claim, timer)
+        finally:
+            # Failed/slow/idempotent prepares need the breakdown most.
+            self._record_segments(timer)
+            timer.done()
+
+    def _prepare_inner(self, claim: ResourceClaim, timer: SegmentTimer
+                       ) -> list[str]:
+        t0 = time.monotonic()
+        # Keep acquisition inside the with-statement: pulling the
+        # guard out would open an async-exception window where the
+        # non-reentrant flock leaks held.
+        with self.pu_lock.acquire(timeout=10.0), self._lock:
+            timer.segments["prep_lock_wait"] = time.monotonic() - t0
+            if claim.uid in self._inflight:
+                raise PrepareError(
+                    f"claim {claim.uid} prepare already in flight"
+                )
+            with timer.segment("prep_get_checkpoint"):
+                cp = self._checkpoint.get()
+            existing = cp.claims.get(claim.uid)
+            if (existing
+                    and existing.state == ClaimState.PREPARE_COMPLETED.value):
+                # Idempotent return ONLY if the (un-fsync'd,
+                # regenerable) CDI spec actually survived; a
+                # crash-truncated spec falls through to a full
+                # re-prepare.
+                try:
+                    spec_ok = self._cdi.read_spec(claim.uid) is not None
+                except ValueError:
+                    spec_ok = False  # corrupt JSON
+                if spec_ok:
+                    return [
+                        i for d in existing.devices
+                        for i in d.cdi_device_ids
+                    ]
+                # Regenerating via rollback+re-prepare is only safe
+                # when it can't disturb state a RUNNING workload may
+                # hold: vfio rebinds and tenancy rendezvous dirs
+                # must not be torn down under a live pod.
+                disruptive = any(
+                    d.live and d.live.get("vfio")
+                    for d in existing.devices
+                ) or self._tenancy.active(claim.uid)
+                if disruptive:
+                    logger.error(
+                        "claim %s completed but CDI spec missing/"
+                        "corrupt; NOT re-preparing (live vfio/"
+                        "tenancy state) -- unprepare to recover",
+                        claim.uid,
                     )
-                    with timer.segment("prep_rollback_stale"):
-                        self._rollback(existing)
-                if (existing
-                        and existing.state == ClaimState.PREPARE_STARTED.value):
-                    # A previous Prepare died mid-flight: roll back its
-                    # partial state, then retry fresh (device_state.go:277).
-                    with timer.segment("prep_rollback_stale"):
-                        self._rollback(existing)
-
-                self._validate_no_overlap(cp, claim)
-
-                # Resolve + validate configs BEFORE the PrepareStarted
-                # write: a claim with a bad config now fails without
-                # ever touching the checkpoint (no write+rollback pair).
-                cfgs = self._resolve_configs(claim)
-
-                with timer.segment("checkpoint_write_started"):
-                    self._checkpoint.update(
-                        lambda c: c.claims.__setitem__(
-                            claim.uid,
-                            CheckpointedClaim(
-                                uid=claim.uid,
-                                namespace=claim.namespace,
-                                name=claim.name,
-                                state=ClaimState.PREPARE_STARTED.value,
-                            ),
-                        )
+                    return [
+                        i for d in existing.devices
+                        for i in d.cdi_device_ids
+                    ]
+                logger.warning(
+                    "claim %s completed but CDI spec missing/corrupt; "
+                    "re-preparing", claim.uid,
+                )
+                # Under the record's chip shards: another claim's
+                # middle on a shared chip must not interleave with
+                # this teardown (same invariant as unprepare). Shard
+                # holders never wait on the global locks we hold, so
+                # the ordering is deadlock-free.
+                with timer.segment("prep_rollback_stale"), \
+                        self._shards.hold(
+                            self._shards_of_checkpointed(existing), timer):
+                    self._rollback(existing)
+            if (existing
+                    and existing.state == ClaimState.PREPARE_STARTED.value):
+                # A reservation from a prepare that isn't OURS (our own
+                # in-flight one was rejected above). If the lease's
+                # owner process is still alive -- upgrade handover with
+                # a kubelet retry racing the old plugin's live middle --
+                # rolling back would destroy state that process is
+                # actively mutating: fail retriable instead. Only a
+                # DEAD owner's partial state is rolled back
+                # (device_state.go:277).
+                owner = self._foreign_owner_alive(claim.uid)
+                if owner:
+                    raise PrepareError(
+                        f"claim {claim.uid} prepare in progress in "
+                        f"plugin process {owner}; retry"
                     )
+                with timer.segment("prep_rollback_stale"), \
+                        self._shards.hold(
+                            self._shards_of_checkpointed(existing), timer):
+                    self._rollback(existing)
 
+            self._validate_no_overlap(cp, claim)
+
+            # Resolve + validate configs BEFORE the PrepareStarted
+            # write: a claim with a bad config now fails without
+            # ever touching the checkpoint (no write+rollback pair).
+            cfgs = self._resolve_configs(claim)
+
+            # The PrepareStarted record doubles as the RESERVATION:
+            # recording the device names here makes the claim's chips
+            # visible to every later overlap validation while the
+            # expensive middle runs outside the global lock.
+            reservation = CheckpointedClaim(
+                uid=claim.uid,
+                namespace=claim.namespace,
+                name=claim.name,
+                state=ClaimState.PREPARE_STARTED.value,
+                devices=[
+                    CheckpointedDevice(
+                        canonical_name=r.device,
+                        kind=self._known_kind(r.device),
+                    )
+                    for r in claim.results
+                ],
+            )
+            # Lease first, then the durable record: a crash in between
+            # leaves an orphan lease that the next writer overwrites.
+            self._leases.write(claim.uid)
+            with timer.segment("checkpoint_write_started"):
+                self._checkpoint.update_claim(
+                    claim.uid, reservation, timer=timer)
+            # Fault-injection seam INSIDE the reservation section,
+            # after the durable PrepareStarted write (the handover and
+            # crash-sweep system tests hook it).
+            with timer.segment("prep_reserved"):
+                pass
+            # Compute shards BEFORE registering in flight: a raise here
+            # must not leave the uid stuck in _inflight (the discard in
+            # the finally below isn't armed yet).
+            shards = self._shards_of_claim(claim)
+            self._inflight.add(claim.uid)
+
+        try:
+            with self._shards.hold(shards, timer):
                 try:
                     with timer.segment("prep_devices"):
                         prepared = self._prepare_devices(claim, timer, cfgs)
                 except BaseException:
                     # _prepare_devices rolled back its own partial device
-                    # state; drop the PrepareStarted checkpoint entry.
-                    self._checkpoint.update(
-                        lambda c: c.claims.pop(claim.uid, None)
-                    )
+                    # state; drop the PrepareStarted reservation.
+                    self._checkpoint.update_claim(claim.uid, None)
+                    self._leases.clear(claim.uid)
                     raise
 
-                def complete(c):
-                    c.claims[claim.uid] = CheckpointedClaim(
-                        uid=claim.uid,
-                        namespace=claim.namespace,
-                        name=claim.name,
-                        state=ClaimState.PREPARE_COMPLETED.value,
-                        devices=prepared,
-                    )
-
+                completed = CheckpointedClaim(
+                    uid=claim.uid,
+                    namespace=claim.namespace,
+                    name=claim.name,
+                    state=ClaimState.PREPARE_COMPLETED.value,
+                    devices=prepared,
+                )
                 with timer.segment("checkpoint_write_completed"):
-                    self._checkpoint.update(complete)
+                    self._checkpoint.update_claim(
+                        claim.uid, completed, timer=timer)
+                self._leases.clear(claim.uid)
                 return [i for d in prepared for i in d.cdi_device_ids]
         finally:
-            # Failed/slow/idempotent prepares need the breakdown most.
-            timer.done()
+            with self._lock:
+                self._inflight.discard(claim.uid)
+
+    def _foreign_owner_alive(self, claim_uid: str) -> int:
+        """The live foreign owner pid of a PrepareStarted reservation,
+        or 0. Our own pid can't be a live foreign owner: a record we
+        didn't register in _inflight is a crashed predecessor's. The
+        /proc starttime pins the process IDENTITY -- a recycled pid
+        (same number, different process) reads as dead, so a stale
+        reservation can't wedge the claim. Plugin pods must share the
+        host pid namespace (hostPID: true in the chart), as the
+        handover flock already requires a shared state root."""
+        lease = self._leases.read(claim_uid)
+        if lease is None:
+            return 0  # no lease = pre-lease writer or crashed mid-write
+        pid, start = lease
+        if not pid or pid == os.getpid():
+            return 0
+        current_start = _proc_start_ticks(pid)
+        if current_start == 0 or (start and start != current_start):
+            return 0  # dead, or the pid was recycled
+        return pid
+
+    def _known_kind(self, canonical_name: str) -> str:
+        """Device kind for the reservation record; rejects unknown
+        devices BEFORE the PrepareStarted write (no write+rollback
+        pair for a claim that could never prepare)."""
+        dev = self.allocatable.get(canonical_name)
+        if dev is None:
+            raise PrepareError(f"unknown device {canonical_name!r}")
+        return dev.kind.value
+
+    def _shards_of_claim(self, claim: ResourceClaim) -> set[int]:
+        """Chip-position shard set of a claim. Core-level carve-outs on
+        one chip share its shard (their sharing-policy files are
+        per-chip); distinct chips never contend."""
+        shards: set[int] = set()
+        for result in claim.results:
+            for core in self._cores_of(result.device):
+                shards.add(core // self.host.cores_per_chip)
+        return shards
+
+    def _shards_of_checkpointed(self, checkpointed: CheckpointedClaim
+                                ) -> set[int]:
+        shards: set[int] = set()
+        for dev in checkpointed.devices:
+            for core in self._cores_of(dev.canonical_name):
+                shards.add(core // self.host.cores_per_chip)
+        return shards
+
+    def _record_segments(self, timer: SegmentTimer) -> None:
+        with self._history_lock:
+            for name, dt in timer.segments.items():
+                self._segment_history.setdefault(
+                    name, deque(maxlen=4096)).append(dt)
+        observer = self.segment_observer
+        if observer is not None:
+            try:
+                observer(timer.operation, dict(timer.segments))
+            except Exception:  # noqa: BLE001 - metrics must not kill prepare
+                logger.exception("segment observer failed")
+
+    def segment_samples(self, name: str) -> list[float]:
+        """Recent wall-time samples (seconds) of one timer segment."""
+        with self._history_lock:
+            return list(self._segment_history.get(name, ()))
 
     def _validate_no_overlap(self, cp, claim: ResourceClaim) -> None:
         """Reject preparing a device whose chips/cores another claim holds
-        (guards scheduler races; device_state.go:1212-1249)."""
+        (guards scheduler races; device_state.go:1212-1249).
+
+        PrepareStarted claims count too: their device list is the
+        RESERVATION an in-flight prepare wrote before leaving the global
+        section (legacy records without devices can't conflict)."""
         held: dict[int, str] = {}  # core index -> claim uid
         for other in cp.claims.values():
             if other.uid == claim.uid:
@@ -472,7 +768,6 @@ class DeviceState:
             for dev in other.devices:
                 for core in self._cores_of(dev.canonical_name):
                     held[core] = other.uid
-        # Claims in PrepareStarted with no devices yet can't conflict.
         for result in claim.results:
             for core in self._cores_of(result.device):
                 if core in held:
@@ -747,23 +1042,67 @@ class DeviceState:
     # -- unprepare ------------------------------------------------------------
 
     def unprepare(self, claim_uid: str) -> None:
-        """Idempotent unprepare + cleanup (device_state.go:426)."""
-        with self.pu_lock.acquire(timeout=10.0), self._lock:
-            cp = self._checkpoint.get()
-            existing = cp.claims.get(claim_uid)
-            if existing is None:
-                # Never prepared or already unprepared. Defensive spec
-                # delete (idempotent): this plugin's own two-phase flow
-                # can't leave a spec without a checkpoint entry, but an
-                # externally-manipulated/cross-version state root might.
-                self._cdi.delete_claim_spec_file(claim_uid)
-                return
-            self._rollback(existing)
+        """Idempotent unprepare + cleanup (device_state.go:426).
 
-    def _rollback(self, checkpointed: CheckpointedClaim) -> None:
+        Mirrors prepare's locking: the global section only looks up the
+        claim and marks it in flight; the teardown runs under the
+        claim's chip shards so disjoint claims unprepare concurrently.
+        Until the rollback's checkpoint removal commits, overlap
+        validation still counts the claim's chips as held -- no one can
+        grab a device mid-teardown."""
+        timer = SegmentTimer("unprepare", claim_uid)
+        try:
+            t0 = time.monotonic()
+            with self.pu_lock.acquire(timeout=10.0), self._lock:
+                timer.segments["prep_lock_wait"] = time.monotonic() - t0
+                cp = self._checkpoint.get()
+                existing = cp.claims.get(claim_uid)
+                if existing is None:
+                    # Never prepared or already unprepared. Defensive spec
+                    # delete (idempotent): this plugin's own two-phase flow
+                    # can't leave a spec without a checkpoint entry, but an
+                    # externally-manipulated/cross-version state root might.
+                    # Same for the lease: a crash between the lease write
+                    # and the reservation write orphans it.
+                    self._cdi.delete_claim_spec_file(claim_uid)
+                    self._leases.clear(claim_uid)
+                    return
+                if claim_uid in self._inflight:
+                    raise PrepareError(
+                        f"claim {claim_uid} prepare/unprepare in flight"
+                    )
+                if existing.state == ClaimState.PREPARE_STARTED.value:
+                    owner = self._foreign_owner_alive(claim_uid)
+                    if owner:
+                        # A live peer process's prepare owns this
+                        # claim's reservation (handover window):
+                        # tearing it down now would race its device
+                        # mutations. Retriable.
+                        raise PrepareError(
+                            f"claim {claim_uid} prepare in progress in "
+                            f"plugin process {owner}; retry"
+                        )
+                # Shards first: a raise must not leave the uid stuck in
+                # _inflight (see the same ordering in prepare()).
+                shards = self._shards_of_checkpointed(existing)
+                self._inflight.add(claim_uid)
+            try:
+                with self._shards.hold(shards, timer):
+                    self._rollback(existing, timer=timer)
+            finally:
+                with self._lock:
+                    self._inflight.discard(claim_uid)
+        finally:
+            self._record_segments(timer)
+            timer.done()
+
+    def _rollback(self, checkpointed: CheckpointedClaim,
+                  timer: SegmentTimer | None = None) -> None:
         """Tear down whatever a claim holds: dynamic carve-outs, sharing
         state, CDI spec, checkpoint entry (unprepareDevices :898 +
-        unpreparePartiallyPrepairedClaim :536)."""
+        unpreparePartiallyPrepairedClaim :536). Reservation-only records
+        (PrepareStarted, no live state) fall through every branch
+        harmlessly -- holder-counted releases and rmtree are no-ops."""
         chip_indices: set[int] = set()
         for dev in checkpointed.devices:
             if dev.live and dev.live.get("vfio"):
@@ -782,9 +1121,8 @@ class DeviceState:
         self._timeslicing.release(checkpointed.uid, sorted(chip_indices))
         self._tenancy.stop(checkpointed.uid)
         self._cdi.delete_claim_spec_file(checkpointed.uid)
-        self._checkpoint.update(
-            lambda c: c.claims.pop(checkpointed.uid, None)
-        )
+        self._checkpoint.update_claim(checkpointed.uid, None, timer=timer)
+        self._leases.clear(checkpointed.uid)
 
     # -- introspection --------------------------------------------------------
 

@@ -24,9 +24,11 @@ import logging
 import os
 import shutil
 import sys
+import threading
 import time
 
 from ..api.configs import MultiTenancyConfig, TimeSlicingConfig
+from ..pkg.flock import Flock
 from ..pkg.fsutil import write_json_atomic
 from .cdi import ContainerEdits
 
@@ -52,6 +54,12 @@ class TimeSlicingManager:
     def __init__(self, policy_root: str):
         self._root = os.path.join(policy_root, "timeslice")
         os.makedirs(self._root, exist_ok=True)
+        # Holder-file read-modify-write guard: with sharded prepares,
+        # two claims sharing a chip via disjoint core-level carve-outs
+        # still serialize on the SAME shard in-process, but another
+        # plugin process (upgrade handover) does not -- the flock covers
+        # both.
+        self._lock = Flock(os.path.join(policy_root, "timeslice.lock"))
 
     def _path(self, chip_index: int) -> str:
         return os.path.join(self._root, f"chip-{chip_index}.json")
@@ -67,13 +75,14 @@ class TimeSlicingManager:
         self, claim_uid: str, chip_indices: list[int], cfg: TimeSlicingConfig
     ) -> ContainerEdits:
         interval_us = _INTERVALS_US[cfg.interval]
-        for idx in chip_indices:
-            doc = self._load(idx) or {"holders": {}}
-            doc["interval"] = cfg.interval  # last setter wins
-            doc["intervalUs"] = interval_us
-            doc.setdefault("holders", {})[claim_uid] = cfg.interval
-            with open(self._path(idx), "w", encoding="utf-8") as f:
-                json.dump(doc, f)
+        with self._lock.acquire(timeout=10.0):
+            for idx in chip_indices:
+                doc = self._load(idx) or {"holders": {}}
+                doc["interval"] = cfg.interval  # last setter wins
+                doc["intervalUs"] = interval_us
+                doc.setdefault("holders", {})[claim_uid] = cfg.interval
+                with open(self._path(idx), "w", encoding="utf-8") as f:
+                    json.dump(doc, f)
         return ContainerEdits(
             env=[
                 f"TPU_TIMESLICE_INTERVAL_US={interval_us}",
@@ -84,19 +93,20 @@ class TimeSlicingManager:
     def release(self, claim_uid: str, chip_indices: list[int]) -> None:
         """Drop this claim's hold; the policy file disappears only when no
         other claim still shares the chip."""
-        for idx in chip_indices:
-            doc = self._load(idx)
-            if doc is None:
-                continue
-            doc.get("holders", {}).pop(claim_uid, None)
-            if doc.get("holders"):
-                with open(self._path(idx), "w", encoding="utf-8") as f:
-                    json.dump(doc, f)
-            else:
-                try:
-                    os.unlink(self._path(idx))
-                except FileNotFoundError:
-                    pass
+        with self._lock.acquire(timeout=10.0):
+            for idx in chip_indices:
+                doc = self._load(idx)
+                if doc is None:
+                    continue
+                doc.get("holders", {}).pop(claim_uid, None)
+                if doc.get("holders"):
+                    with open(self._path(idx), "w", encoding="utf-8") as f:
+                        json.dump(doc, f)
+                else:
+                    try:
+                        os.unlink(self._path(idx))
+                    except FileNotFoundError:
+                        pass
 
     def current(self, chip_index: int) -> dict | None:
         return self._load(chip_index)
@@ -135,7 +145,21 @@ class MultiTenancyManager:
         self._spawn = spawn_agents
         self._ready_timeout = ready_timeout
         self._agents: dict[str, "object"] = {}  # dir -> ProcessManager
+        # Concurrent sharded prepares/unprepares of different claims
+        # mutate the agent map from different threads. _agents_lock
+        # guards ONLY the map; the slow spawn/ready of one agent runs
+        # under its per-dir lock so disjoint claims' tenancy setup
+        # stays parallel (the point of the sharded pipeline).
+        self._agents_lock = threading.Lock()
+        self._dir_locks: dict[str, threading.Lock] = {}
         os.makedirs(self._root, exist_ok=True)
+
+    def _dir_lock(self, d: str) -> threading.Lock:
+        with self._agents_lock:
+            lock = self._dir_locks.get(d)
+            if lock is None:
+                lock = self._dir_locks[d] = threading.Lock()
+            return lock
 
     def _dir(self, claim_uid: str, request: str | None = None) -> str:
         d = os.path.join(self._root, claim_uid)
@@ -277,25 +301,30 @@ class MultiTenancyManager:
         )
         from .tenancy_agent import query  # noqa: PLC0415
 
-        pm = self._agents.get(d)
-        if pm is None or not pm.alive():
-            pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__))))
-            child_env = dict(os.environ)
-            child_env["PYTHONPATH"] = (
-                pkg_root + os.pathsep + child_env.get("PYTHONPATH", "")
-            ).rstrip(os.pathsep)
-            # pidfile + PDEATHSIG (ProcessManager): a SIGKILLed plugin
-            # can't leak agents, and a respawn kills any stale survivor
-            # before the fresh agent rebinds agent.sock.
-            pm = ProcessManager([
-                sys.executable, "-m",
-                "k8s_dra_driver_gpu_tpu.kubeletplugin.tenancy_agent",
-                "--dir", d,
-            ], env=child_env, pidfile=os.path.join(d, "agent.pid"))
-            pm.ensure_started()
-            pm.start_watchdog()
-            self._agents[d] = pm
+        # Per-dir lock: only same-dir callers serialize on the (slow)
+        # fork/exec + readiness; disjoint claims spawn concurrently.
+        with self._dir_lock(d):
+            with self._agents_lock:
+                pm = self._agents.get(d)
+            if pm is None or not pm.alive():
+                pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+                child_env = dict(os.environ)
+                child_env["PYTHONPATH"] = (
+                    pkg_root + os.pathsep + child_env.get("PYTHONPATH", "")
+                ).rstrip(os.pathsep)
+                # pidfile + PDEATHSIG (ProcessManager): a SIGKILLed plugin
+                # can't leak agents, and a respawn kills any stale survivor
+                # before the fresh agent rebinds agent.sock.
+                pm = ProcessManager([
+                    sys.executable, "-m",
+                    "k8s_dra_driver_gpu_tpu.kubeletplugin.tenancy_agent",
+                    "--dir", d,
+                ], env=child_env, pidfile=os.path.join(d, "agent.pid"))
+                pm.ensure_started()
+                pm.start_watchdog()
+                with self._agents_lock:
+                    self._agents[d] = pm
         deadline = time.monotonic() + self._ready_timeout
         while time.monotonic() < deadline:
             try:
@@ -346,27 +375,42 @@ class MultiTenancyManager:
 
     def stop(self, claim_uid: str) -> None:
         claim_dir = os.path.realpath(self._dir(claim_uid))
-        for d, pm in list(self._agents.items()):
-            real = os.path.realpath(d)  # agents are keyed by short path
-            if real.startswith(claim_dir + os.sep) or real == claim_dir:
+        # Claim the matching entries under the map lock, then stop the
+        # processes outside it: a slow agent exit must not stall other
+        # claims' setup/stop.
+        mine: list[tuple[str, "object"]] = []
+        with self._agents_lock:
+            for d, pm in list(self._agents.items()):
+                real = os.path.realpath(d)  # agents are keyed by short path
+                if real.startswith(claim_dir + os.sep) or real == claim_dir:
+                    del self._agents[d]
+                    mine.append((d, pm))
+        for d, pm in mine:
+            with self._dir_lock(d):
                 pm.stop()
-                del self._agents[d]
-                if os.path.islink(d):
-                    try:
-                        os.unlink(d)
-                    except OSError:
-                        pass
+            with self._agents_lock:
+                # The dir is gone with the claim; drop its lock too or
+                # a months-lived daemon leaks one lock per churned claim.
+                self._dir_locks.pop(d, None)
+            if os.path.islink(d):
+                try:
+                    os.unlink(d)
+                except OSError:
+                    pass
         shutil.rmtree(self._dir(claim_uid), ignore_errors=True)
 
     def agent_count(self) -> int:
-        return len(self._agents)
+        with self._agents_lock:
+            return len(self._agents)
 
     def shutdown(self) -> None:
         """Stop every supervised agent (plugin shutdown; dirs stay --
         prepared claims survive plugin restarts via reconcile())."""
-        for pm in self._agents.values():
+        with self._agents_lock:
+            agents = list(self._agents.values())
+            self._agents.clear()
+        for pm in agents:
             pm.stop()
-        self._agents.clear()
 
     def active(self, claim_uid: str) -> bool:
         return os.path.isdir(self._dir(claim_uid))

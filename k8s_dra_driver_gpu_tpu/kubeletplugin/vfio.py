@@ -17,6 +17,7 @@ import logging
 import os
 
 from ..api.configs import PassthroughConfig
+from ..pkg.flock import Flock
 from .cdi import ContainerEdits
 
 logger = logging.getLogger(__name__)
@@ -34,6 +35,11 @@ class VfioRegistry:
     def __init__(self, root: str):
         os.makedirs(root, exist_ok=True)
         self._path = os.path.join(root, "vfio.json")
+        # Flock-guarded read-modify-write: with the sharded prepare
+        # pipeline, disjoint passthrough claims rebind concurrently
+        # (across threads AND processes during upgrade handover) and
+        # all land in this one file -- same pattern as SubSliceRegistry.
+        self._lock = Flock(self._path + ".lock")
 
     def list(self) -> dict[str, dict]:
         import json  # noqa: PLC0415
@@ -55,14 +61,16 @@ class VfioRegistry:
         os.replace(tmp, self._path)
 
     def add(self, pci_bdf: str, native_driver: str | None) -> None:
-        entries = self.list()
-        entries[pci_bdf] = {"nativeDriver": native_driver or ""}
-        self._write(entries)
+        with self._lock.acquire(timeout=10.0):
+            entries = self.list()
+            entries[pci_bdf] = {"nativeDriver": native_driver or ""}
+            self._write(entries)
 
     def remove(self, pci_bdf: str) -> None:
-        entries = self.list()
-        if entries.pop(pci_bdf, None) is not None:
-            self._write(entries)
+        with self._lock.acquire(timeout=10.0):
+            entries = self.list()
+            if entries.pop(pci_bdf, None) is not None:
+                self._write(entries)
 
     def native_driver(self, pci_bdf: str) -> str | None:
         return self.list().get(pci_bdf, {}).get("nativeDriver") or None

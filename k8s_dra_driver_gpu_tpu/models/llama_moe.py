@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map
+
 from ..parallel.mesh import DATA_AXIS, EXPERT_AXIS
 from ..train.train import TrainState, make_optimizer
 from . import llama
@@ -257,7 +259,7 @@ def make_moe_train(
         if key not in compiled:
             state_specs = jax.tree_util.tree_map(leaf_spec, state)
             compiled[key] = jax.jit(
-                lambda s, t: jax.shard_map(
+                lambda s, t: shard_map(
                     local_step,
                     mesh=mesh,
                     in_specs=(state_specs, token_spec),

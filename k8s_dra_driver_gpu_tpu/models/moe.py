@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map
+
 
 def init_moe(key, d_model: int, d_ff: int, n_experts: int, dtype=jnp.float32):
     k1, k2, k3 = jax.random.split(key, 3)
@@ -110,7 +112,7 @@ def make_sharded_moe(mesh: Mesh, axis_name: str, top_k: int = 2,
 
     @jax.jit
     def fn(params, x):
-        return jax.shard_map(
+        return shard_map(
             local_fn,
             mesh=mesh,
             in_specs=(specs, x_spec),

@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map as _shard_map
+
 
 def allreduce_fn(mesh: Mesh, axis: str):
     """A jitted psum over ``axis`` of ``mesh`` for [N] fp32 buffers."""
@@ -25,7 +27,7 @@ def allreduce_fn(mesh: Mesh, axis: str):
         out_shardings=NamedSharding(mesh, P()),
     )
     def _psum(x):
-        return jax.shard_map(
+        return _shard_map(
             lambda v: jax.lax.psum(v, axis),
             mesh=mesh,
             in_specs=P(),

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -90,7 +92,11 @@ def ring_attention(
     def vary(x):
         if hasattr(jax.lax, "pcast"):  # jax >= the pvary deprecation
             return jax.lax.pcast(x, (axis_name,), to="varying")
-        return jax.lax.pvary(x, (axis_name,))
+        if hasattr(jax.lax, "pvary"):
+            return jax.lax.pvary(x, (axis_name,))
+        # Pre-vma JAX (experimental shard_map, check_rep=False): the
+        # varying annotation doesn't exist and isn't needed.
+        return x
 
     o0 = vary(jnp.zeros((B, S, H, hd), jnp.float32))
     m0 = vary(jnp.full((B, S, H, 1), NEG_INF, jnp.float32))
@@ -120,7 +126,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp", causal: bool = True):
 
     @jax.jit
     def fn(q, k, v):
-        return jax.shard_map(
+        return shard_map(
             partial(ring_attention, axis_name=axis_name, causal=causal),
             mesh=mesh,
             in_specs=(spec, spec, spec),

@@ -22,6 +22,8 @@ from functools import partial
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map
+
 from ..ops.attention import attention
 
 
@@ -75,7 +77,7 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
 
     @jax.jit
     def fn(q, k, v):
-        return jax.shard_map(
+        return shard_map(
             partial(ulysses_attention, axis_name=axis_name, causal=causal,
                     impl=impl),
             mesh=mesh,

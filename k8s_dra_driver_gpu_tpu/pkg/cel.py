@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass
+from functools import lru_cache
 
 
 class CelParseError(Exception):
@@ -463,12 +464,25 @@ def _as_quantity(v) -> Quantity:
     raise CelEvalError(f"not a quantity: {v!r}")
 
 
+@lru_cache(maxsize=1024)
+def _compile_ast(expression: str):
+    """Memoized lex+parse keyed by source text. Selector expressions
+    repeat across candidate devices within a scheduling pass AND across
+    passes (the same DeviceClass/request selectors are evaluated for
+    every device every sync), so the AST is compiled once per distinct
+    source string. ASTs are immutable tuples -- safe to share across
+    threads and CelProgram instances. Parse failures are NOT cached
+    (lru_cache does not memoize exceptions); callers that want negative
+    caching layer it on top (scheduler._CompiledSelectors does)."""
+    return _Parser(_lex(expression)).parse()
+
+
 class CelProgram:
     """A compiled selector expression, reusable across devices."""
 
     def __init__(self, expression: str):
         self.expression = expression
-        self._ast = _Parser(_lex(expression)).parse()
+        self._ast = _compile_ast(expression)
 
     def evaluate(self, env: dict):
         return _Eval(env).run(self._ast)

@@ -22,6 +22,15 @@ class FlockTimeoutError(TimeoutError):
     """Raised when the lock cannot be acquired within the timeout."""
 
 
+class FlockReentrantError(RuntimeError):
+    """The holding thread tried to re-acquire its own non-reentrant lock.
+
+    Without this check a re-entrant acquire would spin against the
+    holder's own thread lock until the timeout -- a silent 10s stall
+    that reads like cross-process contention. Failing fast names the
+    actual bug (a lock-ordering error in the caller)."""
+
+
 class Flock:
     """A file-based advisory lock.
 
@@ -37,6 +46,10 @@ class Flock:
         # Serializes acquire/release within this process; flock(2) itself
         # only excludes other processes' fds.
         self._thread_lock = threading.Lock()
+        # Held-state tracking: ident of the owning thread while held.
+        # Only the owner ever matches its own ident, so the unlocked
+        # read in acquire() is race-free for the re-entrancy check.
+        self._owner: int | None = None
 
     @property
     def path(self) -> str:
@@ -50,13 +63,19 @@ class Flock:
     ) -> "_FlockGuard":
         """Acquire the lock, polling until ``timeout`` seconds elapse.
 
-        Raises FlockTimeoutError on timeout and InterruptedError if
-        ``cancel`` is set while waiting.
+        Raises FlockTimeoutError on timeout, FlockReentrantError when
+        the calling thread already holds this lock, and InterruptedError
+        if ``cancel`` is set while waiting.
         """
+        if self._owner == threading.get_ident():
+            raise FlockReentrantError(
+                f"thread {self._owner} already holds {self._path}; "
+                "Flock is not re-entrant"
+            )
         deadline = time.monotonic() + timeout
-        # Honor timeout/cancel for intra-process contention too (the thread
-        # lock is non-reentrant: re-acquiring from the holding thread times
-        # out rather than deadlocking forever).
+        # Honor timeout/cancel for intra-process contention from OTHER
+        # threads (the thread lock is non-reentrant; the holding thread
+        # itself was rejected above).
         while not self._thread_lock.acquire(timeout=poll_interval):
             if cancel is not None and cancel.is_set():
                 raise InterruptedError(
@@ -76,6 +95,7 @@ class Flock:
             try:
                 fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
                 self._fd = fd
+                self._owner = threading.get_ident()
                 return _FlockGuard(self)
             except BlockingIOError:
                 if cancel is not None and cancel.is_set():
@@ -104,6 +124,7 @@ class Flock:
         finally:
             os.close(self._fd)
             self._fd = None
+            self._owner = None
             self._thread_lock.release()
 
     @property

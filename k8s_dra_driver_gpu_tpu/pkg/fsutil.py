@@ -16,3 +16,15 @@ def write_json_atomic(path: str, obj, fsync: bool = False) -> None:
             f.flush()
             os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def stat_signature(path: str) -> tuple[int, int, int] | None:
+    """(mtime_ns, size, inode) identity of a file for stat-validated
+    parse caches, or None when absent. The inode catches same-size
+    same-mtime cross-process rewrites: every atomic write lands via
+    os.replace of a fresh tmp inode."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size, st.st_ino)

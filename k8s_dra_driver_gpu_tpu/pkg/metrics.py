@@ -65,6 +65,24 @@ class DRARequestMetrics:
             "Supervised multi-tenancy enforcement agents running.",
             registry=self.registry,
         )
+        # Per-segment breakdown of the prepare/unprepare pipeline
+        # (prep_lock_wait, ckpt_fsync_wait, prep_devices, ...): the
+        # observability half of the sharded-lock work -- lock-wait
+        # regressions show up here before they move the p99.
+        self.prepare_segment = Histogram(
+            "tpu_dra_prepare_segment_seconds",
+            "Wall time of instrumented prepare/unprepare segments "
+            "(lock waits, checkpoint fsync waits, device setup).",
+            ["operation", "segment"],
+            buckets=_BUCKETS,
+            registry=self.registry,
+        )
+
+    def observe_segments(self, operation: str, segments: dict) -> None:
+        """DeviceState.segment_observer hook: one histogram sample per
+        timed segment of a prepare/unprepare."""
+        for name, dt in segments.items():
+            self.prepare_segment.labels(operation, name).observe(dt)
 
     def set_taints(self, taints) -> None:
         """Reconcile the taint gauge from the full current taint list

@@ -59,20 +59,38 @@ def json_copy(obj):
 class _CompiledSelectors:
     """Expression -> CelProgram cache; a selector that fails to compile
     permanently matches nothing (and is logged once), like a CEL
-    compile error surfaced in the scheduler."""
+    compile error surfaced in the scheduler.
+
+    The cache is shared process-wide (class-level, lock-guarded) and
+    keyed by source text: a scheduler instantiated per sync pass still
+    reuses every previously compiled selector, and within one pass each
+    distinct expression compiles at most once no matter how many
+    candidate devices it filters. cel.compile_expression additionally
+    memoizes the parsed AST, so even a fresh cache entry skips the
+    lex+parse for text seen anywhere else in the process."""
+
+    _shared: dict[str, CelProgram | None] = {}
+    _shared_lock = threading.Lock()
+    _MAX = 4096  # selectors are operator-authored; this is a leak bound
 
     def __init__(self):
-        self._cache: dict[str, CelProgram | None] = {}
+        self._cache = self._shared
 
     def get(self, expression: str) -> CelProgram | None:
-        if expression not in self._cache:
-            try:
-                self._cache[expression] = compile_expression(expression)
-            except Exception as e:  # noqa: BLE001 - compile boundary
-                logger.error("selector does not compile (%s): %s",
-                             e, expression)
-                self._cache[expression] = None
-        return self._cache[expression]
+        with self._shared_lock:
+            if expression in self._cache:
+                return self._cache[expression]
+        try:
+            prog = compile_expression(expression)
+        except Exception as e:  # noqa: BLE001 - compile boundary
+            logger.error("selector does not compile (%s): %s",
+                         e, expression)
+            prog = None
+        with self._shared_lock:
+            if len(self._cache) >= self._MAX:
+                self._cache.clear()
+            self._cache[expression] = prog
+        return prog
 
 
 class _CounterLedger:

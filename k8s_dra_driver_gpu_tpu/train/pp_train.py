@@ -40,6 +40,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map
+
 from ..models import llama
 from ..parallel.mesh import DATA_AXIS, PIPELINE_AXIS
 from .train import TrainState, make_optimizer
@@ -171,7 +173,7 @@ def make_pp_train(
         if tokens.ndim != 3 or tokens.shape[0] != M:
             raise ValueError(
                 f"tokens must be [M={M}, B, S+1], got {tokens.shape}")
-        loss, grads = jax.shard_map(
+        loss, grads = shard_map(
             local_value_and_grad,
             mesh=mesh,
             in_specs=(specs, token_spec),

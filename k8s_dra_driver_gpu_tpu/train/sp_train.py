@@ -23,6 +23,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map
+
 from ..models import llama
 from ..parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 from ..parallel.ring_attention import ring_attention
@@ -98,7 +100,7 @@ def make_sp_train(
 
     @partial(jax.jit, donate_argnums=(0,))
     def step_fn(state, tokens):
-        return jax.shard_map(
+        return shard_map(
             local_step,
             mesh=mesh,
             in_specs=(P(), token_spec),

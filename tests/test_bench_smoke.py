@@ -1,0 +1,42 @@
+"""Tier-1 bench smoke: the `make bench-smoke` contract as a non-slow
+test. Runs bench.py at reduced iters (env knobs) with the on-chip model
+sections skipped and asserts the claim-pipeline metrics -- including the
+new stress lock-wait extras -- are populated, so a checkpoint/locking
+regression fails fast here instead of surfacing as a BENCH dip."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-smoke target.
+SMOKE_ENV = {
+    "BENCH_SKIP_MODEL": "1",
+    "BENCH_MULTICHIP_MOCK": "2",
+    "BENCH_ITERS": "5",
+    "BENCH_STRESS_ITERS": "5",
+}
+
+
+def test_bench_smoke_reports_lock_wait_extras():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV},
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "dra_claim_prepare_p50"
+    assert doc["value"] > 0
+    extras = doc["extras"]
+    # Stress churn ran and the lock-wait observability fields landed.
+    assert extras["stress_p50_ms"] > 0
+    assert extras["stress_p99_ms"] >= extras["stress_p50_ms"]
+    assert "stress_lock_wait_p99_ms" in extras
+    assert "stress_ckpt_fsync_wait_p99_ms" in extras
+    assert extras["stress_lock_wait_p99_ms"] >= 0
+    assert extras["stress_ckpt_fsync_wait_p99_ms"] >= 0
+    # The dynamic-partition claim class backing vs_baseline ran too.
+    assert extras["subslice_prepare_p50_ms"] > 0

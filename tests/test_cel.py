@@ -201,3 +201,34 @@ class TestShippedSelectors:
             assert hits, f"selector matched nothing: {expr}"
             assert all(h == own_driver or "device.driver" not in expr
                        for h in hits), (expr, hits)
+
+
+class TestCompileMemoization:
+    def test_ast_shared_across_programs(self):
+        """compile_expression memoizes the parsed AST by source text:
+        two programs for the same expression (e.g. the same selector
+        evaluated for every candidate device, pass after pass) share
+        one immutable AST instead of re-lexing/re-parsing."""
+        expr = 'device.driver == "tpu.dra.dev"'
+        p1 = compile_expression(expr)
+        p2 = compile_expression(expr)
+        assert p1._ast is p2._ast
+        assert p1.evaluate({"device": {"driver": "tpu.dra.dev"}}) is True
+        assert p2.evaluate({"device": {"driver": "other"}}) is False
+
+    def test_parse_failure_not_cached_as_success(self):
+        bad = 'device.driver =='
+        with pytest.raises(CelParseError):
+            compile_expression(bad)
+        with pytest.raises(CelParseError):
+            compile_expression(bad)
+
+    def test_scheduler_selector_cache_shared_across_instances(self):
+        from k8s_dra_driver_gpu_tpu.pkg.scheduler import _CompiledSelectors
+
+        expr = 'device.driver == "tpu.dra.dev"'
+        s1, s2 = _CompiledSelectors(), _CompiledSelectors()
+        assert s1.get(expr) is s2.get(expr)
+        # A broken selector is negatively cached (matches nothing).
+        assert s1.get("device.driver ==") is None
+        assert s2.get("device.driver ==") is None

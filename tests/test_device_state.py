@@ -453,3 +453,39 @@ class TestCheckpointFile:
         from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import Checkpoint
         cp = Checkpoint.from_dict(doc)
         assert "c1" in cp.claims
+
+
+class TestCdiSpecCache:
+    """read_spec keeps a stat-validated parse cache so the warm
+    repeat-prepare idempotent check skips the read+json.loads."""
+
+    def test_warm_read_returns_cached_object(self, state):
+        state.prepare(make_claim("c1", ["chip-0"]))
+        r1 = state._cdi.read_spec("c1")
+        r2 = state._cdi.read_spec("c1")
+        assert r1 is r2, "second read should hit the parse cache"
+
+    def test_external_rewrite_invalidates(self, state):
+        state.prepare(make_claim("c1", ["chip-0"]))
+        assert state._cdi.read_spec("c1") is not None
+        path = state._cdi._spec_path("c1")
+        with open(path, "w") as f:
+            json.dump({"cdiVersion": "0.6.0", "devices": []}, f)
+        assert state._cdi.read_spec("c1") == {
+            "cdiVersion": "0.6.0", "devices": []}
+
+    def test_truncation_bypasses_cache(self, state):
+        """The crash-truncated-spec recovery path must still see the
+        corruption (ValueError), never a stale cached parse."""
+        state.prepare(make_claim("c1", ["chip-0"]))
+        assert state._cdi.read_spec("c1") is not None
+        with open(state._cdi._spec_path("c1"), "w") as f:
+            f.write("{trunc")
+        with pytest.raises(ValueError):
+            state._cdi.read_spec("c1")
+
+    def test_delete_drops_cache(self, state):
+        state.prepare(make_claim("c1", ["chip-0"]))
+        assert state._cdi.read_spec("c1") is not None
+        state.unprepare("c1")
+        assert state._cdi.read_spec("c1") is None

@@ -227,6 +227,35 @@ class TestPrepareFlow:
         assert out == {"u1": ""}
         assert driver.state.prepared_claims() == {}
 
+    def test_multi_claim_fanout_prepares_all(self, driver, kube,
+                                             monkeypatch):
+        """A multi-claim NodePrepareResources fans out to the thread
+        pool: all claims land, per-claim errors stay isolated, and the
+        stalled middle of one claim doesn't serialize the others (wall
+        ~max, not sum, of the per-claim stalls)."""
+        refs = []
+        for i in range(3):
+            put_claim(kube, f"fan-{i}", [f"chip-{i}"])
+            refs.append({"uid": f"fan-{i}", "namespace": "default",
+                         "name": f"fan-{i}"})
+        refs.append({"uid": "fan-bad", "namespace": "default",
+                     "name": "missing"})
+        monkeypatch.setenv("TPU_DRA_STALL_AT_SEGMENT", "prep_devices")
+        monkeypatch.setenv("TPU_DRA_STALL_SECONDS", "1.2")
+        t0 = time.monotonic()
+        out = driver.prepare_resource_claims(refs)
+        wall = time.monotonic() - t0
+        for i in range(3):
+            devices, err = out[f"fan-{i}"]
+            assert err == ""
+            assert [d["device_name"] for d in devices] == [f"chip-{i}"]
+        devices, err = out["fan-bad"]
+        assert devices == [] and err != ""
+        # Serialized would be >= 3.6s of stalls alone; the generous
+        # margin absorbs the multi-second fsync hiccups BASELINE.md
+        # documents for CI boxes.
+        assert wall < 3.0, f"fan-out serialized: {wall:.2f}s"
+
 
 class TestHealthTaints:
     def test_real_devfs_chip_lost_taints_and_republish(

@@ -76,6 +76,41 @@ class TestFlock:
             other.acquire(timeout=5.0, cancel=cancel)
         guard.__exit__(None, None, None)
 
+    def test_reentrant_acquire_fails_fast(self, tmp_root):
+        """The holding thread re-acquiring its own lock is a caller bug:
+        it must raise immediately (FlockReentrantError), not burn the
+        full timeout as a fake cross-process contention stall."""
+        from k8s_dra_driver_gpu_tpu.pkg.flock import FlockReentrantError
+
+        lock = Flock(os.path.join(tmp_root, "pu.lock"))
+        with lock.acquire(timeout=1.0):
+            t0 = time.monotonic()
+            with pytest.raises(FlockReentrantError):
+                lock.acquire(timeout=5.0)
+            assert time.monotonic() - t0 < 1.0, "re-entry burned timeout"
+        # Released cleanly: a fresh acquire (same thread) succeeds.
+        with lock.acquire(timeout=1.0):
+            assert lock.held
+
+    def test_other_thread_still_waits_not_reentrant_error(self, tmp_root):
+        """Only the OWNING thread gets FlockReentrantError; another
+        thread contends normally (times out while held)."""
+        lock = Flock(os.path.join(tmp_root, "pu.lock"))
+        outcome = {}
+
+        def contender():
+            try:
+                with lock.acquire(timeout=0.3):
+                    outcome["got"] = True
+            except FlockTimeoutError:
+                outcome["timeout"] = True
+
+        with lock.acquire(timeout=1.0):
+            t = threading.Thread(target=contender)
+            t.start()
+            t.join()
+        assert outcome == {"timeout": True}
+
 
 class TestBootID:
     def test_read_from_seam(self, tmp_root):

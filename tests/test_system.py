@@ -392,7 +392,14 @@ class TestUpDowngradeHandover:
     """Two plugin processes contending the node-global pu.lock
     mid-claim; the old one is SIGKILLed (upgrade rollout) and the new
     one must proceed -- the kernel releases the flock with the process
-    (reference test_gpu_up_downgrade.bats role)."""
+    (reference test_gpu_up_downgrade.bats role).
+
+    With the sharded prepare pipeline the flock guards only the
+    reservation critical section, so the stall is injected at the
+    prep_reserved seam (inside the section, after the durable
+    PrepareStarted write); a stall in the expensive middle
+    (prep_devices) no longer blocks a disjoint successor at all --
+    proved by the second test."""
 
     def test_sigkill_mid_prepare_releases_lock_to_successor(
         self, tmp_path
@@ -404,16 +411,17 @@ class TestUpDowngradeHandover:
         old = subprocess.Popen(
             [sys.executable, "-m", "tests.prepare_helper",
              str(root), "old-claim", "chip-0"],
-            env={**ENV, "TPU_DRA_STALL_AT_SEGMENT": "prep_devices",
+            env={**ENV, "TPU_DRA_STALL_AT_SEGMENT": "prep_reserved",
                  "TPU_DRA_STALL_SECONDS": "60"},
             cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
         try:
-            # The stalled process holds pu.lock INSIDE prepare once its
-            # claim reaches PrepareStarted in the checkpoint (written
-            # under the lock, right before the prep_devices stall) --
-            # poll for that instead of guessing with sleeps.
+            # The stalled process holds pu.lock INSIDE the reservation
+            # section once its claim reaches PrepareStarted in the
+            # checkpoint (written under the lock, right before the
+            # prep_reserved stall) -- poll for that instead of guessing
+            # with sleeps.
             def old_claim_started():
                 cp = root / "checkpoint.json"
                 try:
@@ -452,6 +460,47 @@ class TestUpDowngradeHandover:
             if old.poll() is None:
                 old.kill()
                 old.wait()
+
+    def test_disjoint_successor_completes_during_stalled_middle(
+        self, tmp_path
+    ):
+        """A process stalled in the EXPENSIVE middle of Prepare
+        (prep_devices -- outside the reservation section) must NOT
+        block another process preparing a disjoint device: the whole
+        point of dropping the node flock after reservation."""
+        root = tmp_path / "root"
+        assert run_helper(root, "seed", "chip-3", "cycle").returncode == 0
+        old = subprocess.Popen(
+            [sys.executable, "-m", "tests.prepare_helper",
+             str(root), "old-claim", "chip-0"],
+            env={**ENV, "TPU_DRA_STALL_AT_SEGMENT": "prep_devices",
+                 "TPU_DRA_STALL_SECONDS": "60"},
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            def old_claim_started():
+                cp = root / "checkpoint.json"
+                try:
+                    return "old-claim" in cp.read_text()
+                except OSError:
+                    return False
+
+            assert wait_for(old_claim_started, timeout=60)
+            # The successor prepares AND unprepares a disjoint chip to
+            # completion while the old process is still stalled.
+            new = run_helper(root, "new-claim", "chip-1", "cycle",
+                             timeout=30)
+            assert new.returncode == 0, new.stdout + new.stderr
+            assert old.poll() is None, "old process exited early"
+            # The stalled claim's reservation stayed visible throughout:
+            # an overlapping prepare is rejected, not raced.
+            clash = run_helper(root, "clash-claim", "chip-0", timeout=30)
+            assert clash.returncode != 0
+            assert "overlap" in (clash.stdout + clash.stderr)
+        finally:
+            old.kill()
+            old.wait()
 
 
 class TestDeploymentManifests:
