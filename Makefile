@@ -2,7 +2,8 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast bench bench-smoke lint clean stamp-version
+.PHONY: all native test test-fast bench bench-smoke \
+	bench-placement-smoke lint clean stamp-version
 
 VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
 
@@ -45,6 +46,13 @@ bench-smoke: native
 	BENCH_SKIP_MODEL=1 BENCH_MULTICHIP_MOCK=2 \
 	BENCH_ITERS=5 BENCH_STRESS_ITERS=5 \
 	$(PYTHON) bench.py
+
+# Placement-simulator smoke: claim churn on v5e/v5p grids, first-fit
+# vs. the pkg/topology scorer, at reduced steps. Asserts-by-running
+# that the frag/compactness metrics pipeline produces; mirrored as a
+# non-slow test in tests/test_bench_placement_smoke.py.
+bench-placement-smoke:
+	BENCH_PLACEMENT_STEPS=80 $(PYTHON) bench.py --placement-sim
 
 lint:
 	ruff check --select E9,F k8s_dra_driver_gpu_tpu/ tests/ bench.py __graft_entry__.py

@@ -8,7 +8,54 @@ jax.experimental.shard_map. Every in-tree user imports the symbol from
 here so the version probe lives in exactly one place.
 """
 
+import os
+import re
+
 import jax
+
+
+def _xla_bridge():
+    """jax's backend registry module (stable private location across
+    the versions this repo spans); None-ish object when it moves."""
+    try:
+        from jax._src import xla_bridge  # noqa: PLC0415
+
+        return xla_bridge
+    except ImportError:  # pragma: no cover - future jax relayout
+        return None
+
+
+def force_cpu_devices(n: int) -> None:
+    """Force an ``n``-device CPU backend for THIS process. Must run
+    before any JAX backend initialization (jax.devices(), first op).
+
+    Newer JAX spells this as the ``jax_num_cpu_devices`` config option;
+    the pinned 0.4.x toolchain predates it and only honors the
+    ``--xla_force_host_platform_device_count`` XLA flag, which is read
+    from the environment at backend init. Raises RuntimeError when a
+    backend is already live (0.4.x accepts the config mutations
+    without complaint and then silently ignores them -- a silent no-op
+    here would leave the caller on the wrong backend with the wrong
+    device count), so callers keep one except clause either way.
+    """
+    backends = getattr(
+        getattr(_xla_bridge(), "_backends", None), "keys", lambda: ())()
+    if backends:
+        raise RuntimeError(
+            f"JAX backend(s) {sorted(backends)} already initialized; "
+            "cannot force CPU device count")
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:  # pre-option JAX: go through XLA_FLAGS
+        flag = f"--xla_force_host_platform_device_count={n}"
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags, subs = re.subn(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags)
+        if not subs:
+            flags = f"{flags} {flag}".strip()
+        os.environ["XLA_FLAGS"] = flags
+
 
 if hasattr(jax, "shard_map"):
     shard_map = jax.shard_map

@@ -15,6 +15,11 @@ COMPUTE_DOMAIN_DRIVER_NAME = "compute-domain.tpu.dra.dev"
 CHANNEL_DEVICE_CLASS = "compute-domain-default-channel.tpu.dra.dev"
 DAEMON_DEVICE_CLASS = "compute-domain-daemon.tpu.dra.dev"
 NODE_LABEL = "resource.tpu.dra/computeDomain"
+# Controller-computed ICI-adjacent host window for the gang
+# (comma-joined node names, best window of consecutive workerIds). The
+# in-tree scheduler consults it when allocating this domain's channel
+# claims (TopologyAwarePlacement gate, pkg/topology/hosts.py).
+PREFERRED_NODES_ANNOTATION = "resource.tpu.dra/preferredNodes"
 CLIQUE_POD_LABEL = "resource.tpu.dra/cliqueId"
 FINALIZER = "resource.tpu.dra/computedomain-finalizer"
 DOMAIN_DAEMON_PORT = 7077  # daemon rendezvous service (STATUS/MEMBERS)

@@ -15,9 +15,22 @@ import logging
 import threading
 
 from ...api.computedomain import ComputeDomainStatusValue
+from ...pkg.featuregates import (
+    TOPOLOGY_AWARE_PLACEMENT,
+    FeatureGateError,
+    FeatureGates,
+)
 from ...pkg.kubeclient import ConflictError, NotFoundError
+from ...pkg.topology import rank_adjacent_hosts
 from ...pkg.workqueue import CONTROLLER_DEFAULT_LIMITER, WorkQueue
-from .. import API_GROUP, API_VERSION, FINALIZER, NODE_LABEL, expected_workers
+from .. import (
+    API_GROUP,
+    API_VERSION,
+    FINALIZER,
+    NODE_LABEL,
+    PREFERRED_NODES_ANNOTATION,
+    expected_workers,
+)
 from .objects import (
     build_daemon_daemonset,
     build_daemon_rct,
@@ -34,10 +47,23 @@ CLIQUE_RESOURCE = "computedomaincliques"
 
 class ComputeDomainController:
     def __init__(self, kube, driver_namespace: str = "tpu-dra-driver",
-                 metrics=None):
+                 metrics=None, gates: FeatureGates | None = None):
         self.kube = kube
         self.ns = driver_namespace
         self.metrics = metrics  # ComputeDomainMetrics or None
+        if gates is None:
+            try:
+                gates = FeatureGates.from_env()
+            except FeatureGateError:
+                logger.exception("FEATURE_GATES unparseable; using defaults")
+                gates = FeatureGates()
+        # ICI-adjacent host preference for multi-host gangs
+        # (pkg/topology/hosts.py; consumed by the in-tree scheduler).
+        self._topology = gates.is_enabled(TOPOLOGY_AWARE_PLACEMENT)
+        # (expiry, node -> workerId): the map changes only when slices
+        # (re)publish, but reconcile runs per CD per resync -- a short
+        # TTL keeps W domains from costing W cluster-wide slice LISTs.
+        self._host_workers_memo: tuple[float, dict[str, int]] | None = None
         self.queue = WorkQueue(
             limiter=CONTROLLER_DEFAULT_LIMITER, name="cd-controller"
         )
@@ -153,6 +179,8 @@ class ComputeDomainController:
             self._ensure(workload_rct, "resourceclaimtemplates",
                          "resource.k8s.io", "v1",
                          workload_rct["metadata"]["namespace"])
+        if self._topology:
+            self._sync_preferred_nodes(cd)
         self.update_global_status(cd)
 
     def _ensure(self, obj, resource, group, version, namespace) -> None:
@@ -161,6 +189,81 @@ class ComputeDomainController:
                              namespace=namespace)
         except ConflictError:
             pass  # already exists; spec is immutable per CD generation
+
+    # -- ICI-adjacent node preference (topology-aware gangs) ------------------
+
+    _HOST_WORKERS_TTL_S = 10.0
+
+    def _host_workers(self) -> dict[str, int]:
+        """node -> workerId, from the chip driver's published
+        ResourceSlices (the ``workerId`` attribute every chip carries,
+        deviceinfo.py), memoized for a few seconds. Nodes publishing no
+        workerId -- CD channel pools, degraded slices -- simply don't
+        participate."""
+        import time  # noqa: PLC0415
+
+        now = time.monotonic()
+        if self._host_workers_memo and self._host_workers_memo[0] > now:
+            return self._host_workers_memo[1]
+        try:
+            slices = self.kube.list("resource.k8s.io", "v1",
+                                    "resourceslices")
+        except Exception:  # noqa: BLE001 - preference is best-effort
+            return {}
+        from ...pkg.topology.grid import attr_int  # noqa: PLC0415
+
+        workers: dict[str, int] = {}
+        for s in slices:
+            spec = s.get("spec", {})
+            node = spec.get("nodeName")
+            if not node or node in workers:
+                continue
+            for dev in spec.get("devices", []):
+                wid = attr_int(dev.get("attributes") or {}, "workerId")
+                if wid is not None:
+                    workers[node] = wid
+                    break
+        # workerIds are slice-LOCAL and chip slices carry no slice
+        # identity: a duplicated workerId means several independent ICI
+        # fabrics are visible, and a worker-order window would
+        # interleave them (hosts with "adjacent" ids on different
+        # fabrics). No trustworthy signal -> no preference, which is
+        # plain load-spread first-fit, never a wrong bias.
+        if len(set(workers.values())) != len(workers):
+            workers = {}
+        self._host_workers_memo = (now + self._HOST_WORKERS_TTL_S,
+                                   workers)
+        return workers
+
+    def _sync_preferred_nodes(self, cd: dict) -> None:
+        """Stamp the ICI-adjacent host window (gang-size run of
+        consecutive workerIds) on the CD; the scheduler biases this
+        domain's channel-claim placement toward it. Best-effort and
+        idempotent: no workerId data (or a single-host domain) clears
+        the annotation rather than freezing a stale window."""
+        meta = cd["metadata"]
+        expected = self._expected_nodes(cd)
+        workers = self._host_workers()
+        window: list[str] = []
+        if expected > 1 and len(workers) >= expected:
+            window = rank_adjacent_hosts(workers, expected)[:expected]
+        want = ",".join(window)
+        have = (meta.get("annotations") or {}).get(
+            PREFERRED_NODES_ANNOTATION, "")
+        if want == have:
+            return
+        try:
+            self.kube.patch(
+                API_GROUP, API_VERSION, CD_RESOURCE, meta["name"],
+                {"metadata": {"annotations": {
+                    PREFERRED_NODES_ANNOTATION: want or None}}},
+                namespace=meta.get("namespace", "default"),
+            )
+            logger.info("CD %s/%s preferred ICI-adjacent nodes: %s",
+                        meta.get("namespace", "default"), meta["name"],
+                        window or "(none)")
+        except NotFoundError:
+            pass
 
     # -- status ---------------------------------------------------------------
 

@@ -131,6 +131,12 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp", causal: bool = True):
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
+            # Replication is argued by the vary() annotations on the
+            # fori_loop carry (vma-capable JAX); the pre-vma checker
+            # cannot see through the DIFFERENTIATED loop (the grad's
+            # scan carry mixes replicated cotangents into the varying
+            # ring state) and rejects a correct program.
+            check_vma=False,
         )(q, k, v)
 
     def place(x):

@@ -52,6 +52,12 @@ DYNAMIC_SUB_SLICE = "DynamicSubSlice"
 COMPUTE_DOMAIN_CLIQUES = "ComputeDomainCliques"
 CRASH_ON_ICI_FABRIC_ERRORS = "CrashOnICIFabricErrors"
 DEVICE_METADATA = "DeviceMetadata"
+# ICI topology-aware placement (pkg/topology): the in-tree scheduler
+# ranks candidate device sets by compactness + fragmentation cost and
+# the CD controller prefers ICI-adjacent hosts for multi-host gangs.
+# Off = the historical first-fit pick. No reference analog (the
+# reference delegates placement entirely to kube-scheduler).
+TOPOLOGY_AWARE_PLACEMENT = "TopologyAwarePlacement"
 
 KNOWN_FEATURES: dict[str, FeatureSpec] = {
     s.name: s
@@ -79,6 +85,8 @@ KNOWN_FEATURES: dict[str, FeatureSpec] = {
         FeatureSpec(COMPUTE_DOMAIN_CLIQUES, default=True, stage=Stage.BETA),
         FeatureSpec(CRASH_ON_ICI_FABRIC_ERRORS, default=True, stage=Stage.BETA),
         FeatureSpec(DEVICE_METADATA, default=False, stage=Stage.ALPHA),
+        FeatureSpec(TOPOLOGY_AWARE_PLACEMENT, default=True,
+                    stage=Stage.BETA),
     ]
 }
 

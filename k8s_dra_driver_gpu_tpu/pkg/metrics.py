@@ -112,6 +112,45 @@ class DRARequestMetrics:
             self.in_flight.labels(operation).dec()
 
 
+class PlacementMetrics:
+    """Topology-aware placement observability (pkg/topology).
+
+    ``pool`` labels carry the resource-pool identity (scheduler) or
+    the ``<grid>/<policy>`` identity (placement simulator). The frag
+    gauge is THE churn-health signal: rising values mean the free
+    space is shredding and large claims will start starving."""
+
+    # Max-hop distances are tiny integers; a torus diameter above 16
+    # does not exist on shipping slices.
+    _HOP_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16)
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.frag_score = Gauge(
+            "tpu_dra_placement_frag_score",
+            "Fragmentation of a pool's free chips: 1 - largest "
+            "allocatable sub-torus / free chips (0 = one perfect "
+            "contiguous block).",
+            ["pool"],
+            registry=self.registry,
+        )
+        self.largest_shape = Gauge(
+            "tpu_dra_placement_largest_free_shape_chips",
+            "Chips in the largest sub-torus shape still allocatable "
+            "from a pool's free chips.",
+            ["pool"],
+            registry=self.registry,
+        )
+        self.compactness = Histogram(
+            "tpu_dra_placement_compactness",
+            "Max ICI hop distance inside each allocated device set "
+            "(0 = single chip; lower = tighter collective).",
+            ["pool"],
+            buckets=self._HOP_BUCKETS,
+            registry=self.registry,
+        )
+
+
 class ComputeDomainMetrics:
     """Cluster-level ComputeDomain status gauge (computedomain_cluster.go)."""
 

@@ -39,7 +39,16 @@ import time
 import uuid
 
 from .cel import CelEvalError, CelProgram, Quantity, compile_expression
+from .featuregates import (
+    TOPOLOGY_AWARE_PLACEMENT,
+    FeatureGateError,
+    FeatureGates,
+)
 from .kubeclient import ConflictError, KubeError, NotFoundError
+from .topology import TorusGrid, largest_free_shape
+from .topology.score import frag_from_largest
+from .topology import order_candidates as topo_order_candidates
+from .topology import set_compactness
 
 logger = logging.getLogger(__name__)
 
@@ -175,12 +184,32 @@ def _tolerates(taint: dict, tolerations: list[dict]) -> bool:
 class DraScheduler:
     """Single-pass-capable scheduler; call sync_once() or run()."""
 
-    def __init__(self, kube, default_node: str | None = None):
+    def __init__(self, kube, default_node: str | None = None,
+                 gates: FeatureGates | None = None, metrics=None):
         self.kube = kube
         self.default_node = default_node
         self._selectors = _CompiledSelectors()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        if gates is None:
+            try:
+                gates = FeatureGates.from_env()
+            except FeatureGateError:
+                # A malformed FEATURE_GATES env must not kill the
+                # control plane; defaults are the safe fallback.
+                logger.exception("FEATURE_GATES unparseable; using defaults")
+                gates = FeatureGates()
+        self.gates = gates
+        # ICI topology-aware device picking (pkg/topology). Off = the
+        # historical first-fit order, which also remains the automatic
+        # fallback whenever devices publish no usable coordinates.
+        self._topology = gates.is_enabled(TOPOLOGY_AWARE_PLACEMENT)
+        self.metrics = metrics  # PlacementMetrics or None
+        # Per-sync-pass memos (reset in _allocate_claims): scoring a
+        # pool and resolving CD windows are pure functions of snapshot
+        # state, and one pass asks the same questions per claim x node.
+        self._pass_order_cache: dict[tuple, list[str] | None] = {}
+        self._pass_cd_windows: dict[str, list[str]] | None = None
 
     # -- claim generation (kcm resourceclaim controller) ----------------------
 
@@ -263,14 +292,21 @@ class DraScheduler:
         for pod in self._pods():
             if pod.get("status", {}).get("extendedResourceClaimStatus"):
                 continue
-            # Finished / terminating pods must not acquire devices.
-            if pod.get("status", {}).get("phase") in ("Succeeded",
-                                                      "Failed"):
+            # KEP-5004 generates claims only while a pod is still being
+            # SCHEDULED: one already bound (spec.nodeName set -- e.g.
+            # scheduled before the class advertised
+            # extendedResourceName, or born bound like a DaemonSet pod)
+            # or past Pending must not retroactively acquire devices
+            # and double-count them under a running workload.
+            if pod.get("spec", {}).get("nodeName"):
+                continue
+            if pod.get("status", {}).get("phase") not in (None, "",
+                                                          "Pending"):
                 continue
             if _meta(pod).get("deletionTimestamp"):
                 continue
             requests, mappings = [], []
-            bad_qty = False
+            bad_qty = None
             for c in pod.get("spec", {}).get("containers", []):
                 limits = (c.get("resources") or {}).get("limits") or {}
                 for rname, qty in limits.items():
@@ -288,7 +324,7 @@ class DraScheduler:
                             "quantity %s=%r; skipping pod",
                             _meta(pod).get("namespace", "default"),
                             _meta(pod)["name"], rname, qty)
-                        bad_qty = True
+                        bad_qty = f"{rname}={qty!r}"
                         break
                     req = f"request-{len(mappings)}"
                     exactly: dict = {"deviceClassName": cls_name}
@@ -302,7 +338,19 @@ class DraScheduler:
                     })
                 if bad_qty:
                     break
-            if not requests or bad_qty:
+            if bad_qty:
+                # The pod can never schedule (the generation skip keeps
+                # _pending_extended_resource blocking its bind forever):
+                # surface that ON THE POD -- real k8s rejects
+                # non-integer extended resources at admission, but this
+                # control plane has no pod admission, so a condition +
+                # event is the observable analog.
+                self._flag_unschedulable_pod(
+                    pod, "InvalidExtendedResourceQuantity",
+                    f"extended-resource quantity {bad_qty} is not a "
+                    "whole number; the pod cannot be scheduled")
+                continue
+            if not requests:
                 continue
             ns = _meta(pod).get("namespace", "default")
             # DETERMINISTIC name (pod uid, not uuid4): create + status
@@ -342,6 +390,55 @@ class DraScheduler:
             logger.info(
                 "generated extended-resource claim %s/%s for pod %s",
                 ns, claim_name, _meta(pod)["name"])
+
+    def _flag_unschedulable_pod(self, pod, reason: str,
+                                message: str) -> None:
+        """Surface a permanent scheduling failure ON THE POD: a
+        PodScheduled=False condition plus a Warning Event, so `kubectl
+        describe pod` explains the wedge instead of only a scheduler
+        log line. Deduped on (reason, message): a condition already
+        saying exactly this is not re-emitted every sync pass."""
+        ns = _meta(pod).get("namespace", "default")
+        name = _meta(pod)["name"]
+        conditions = pod.get("status", {}).get("conditions") or []
+        for c in conditions:
+            if c.get("type") == "PodScheduled" and \
+                    c.get("reason") == reason and \
+                    c.get("message") == message:
+                return
+        kept = [c for c in conditions if c.get("type") != "PodScheduled"]
+        kept.append({
+            "type": "PodScheduled",
+            "status": "False",
+            "reason": reason,
+            "message": message,
+        })
+        try:
+            self.kube.patch("", "v1", "pods", name,
+                            {"status": {"conditions": kept}},
+                            namespace=ns)
+        except (NotFoundError, ConflictError):
+            return
+        event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{name}.{uuid.uuid4().hex[:10]}",
+                "namespace": ns,
+            },
+            "type": "Warning",
+            "reason": reason,
+            "message": message,
+            "involvedObject": {
+                "kind": "Pod", "name": name, "namespace": ns,
+                "uid": _meta(pod).get("uid", ""),
+            },
+            "source": {"component": "tpu-dra-scheduler"},
+        }
+        try:
+            self.kube.create("", "v1", "events", event, namespace=ns)
+        except KubeError:
+            pass  # events are best-effort, the condition already landed
 
     # -- allocation (kube-scheduler DRA plugin) -------------------------------
 
@@ -428,8 +525,14 @@ class DraScheduler:
             cand = by_key.get(key)
             if cand is not None:
                 load[cand.node] = load.get(cand.node, 0) + 1
+        # ComputeDomain gangs first try the ICI-adjacent host window
+        # the CD controller picked; load still spreads the gang's
+        # members WITHIN the window, and non-window nodes remain as
+        # overflow so a full window degrades instead of wedging.
+        window = set(self._preferred_gang_nodes(claim) or ())
         nodes = sorted({c.node for c in candidates},
-                       key=lambda n: (load.get(n, 0), n))
+                       key=lambda n: (0 if not window or n in window
+                                      else 1, load.get(n, 0), n))
         if pinned_node is not None:
             nodes = [n for n in nodes if n == pinned_node]
         for node in nodes:
@@ -508,6 +611,131 @@ class DraScheduler:
                 return (kind, entry[kind])
         return None
 
+    # -- ICI topology-aware ordering (pkg/topology) ---------------------------
+
+    @staticmethod
+    def _grid_for(cands: list["_Candidate"]) -> TorusGrid:
+        return TorusGrid.from_devices([c.device for c in cands])
+
+    def _topology_order(self, cands: list["_Candidate"],
+                        want: int | None) -> list["_Candidate"]:
+        """Reorder one request's candidates so the scorer's best
+        sub-torus placements come first. Pure preference: every
+        candidate stays in the list, so the backtracking fit (and
+        therefore matchAttributes, counters, taints) is untouched --
+        with no usable coordinates the original first-fit order
+        survives verbatim. ``want`` None (All-mode) takes everything
+        anyway; nothing to order."""
+        if want is None or want < 1 or len(cands) < 2:
+            return cands
+        by_pool: dict[tuple, list[_Candidate]] = {}
+        for c in cands:
+            by_pool.setdefault((c.driver, c.pool), []).append(c)
+        out: list[_Candidate] = []
+        any_signal = False
+        for (driver, pool), group in by_pool.items():
+            ordered = None
+            if len(group) >= want:
+                names = tuple(c.name for c in group)
+                key = (driver, pool, names, want)
+                if key in self._pass_order_cache:
+                    ordered = self._pass_order_cache[key]
+                else:
+                    grid = self._grid_for(group)
+                    ordered = topo_order_candidates(grid, list(names),
+                                                    want)
+                    self._pass_order_cache[key] = ordered
+            if ordered is None:
+                out.extend(group)
+            else:
+                any_signal = True
+                by_name = {c.name: c for c in group}
+                out.extend(by_name[n] for n in ordered)
+        # No group produced a ranking: keep the ORIGINAL interleaved
+        # order, not the per-pool regrouping -- the documented fallback
+        # is the pre-topology first-fit order, verbatim.
+        return out if any_signal else cands
+
+    def _preferred_gang_nodes(self, claim) -> list[str] | None:
+        """ComputeDomain channel claims prefer the ICI-adjacent host
+        window the CD controller picked (its preferred-nodes
+        annotation): the gang's workers land on consecutive workerIds
+        instead of whatever nodes happened to be least loaded."""
+        if not self._topology:
+            return None
+        for cfg in claim.get("spec", {}).get("devices", {}).get(
+                "config", []) or []:
+            params = (cfg.get("opaque") or {}).get("parameters") or {}
+            if params.get("kind") != "ComputeDomainChannelConfig":
+                continue
+            uid = params.get("domainID")
+            if not uid:
+                continue
+            return self._cd_window_map().get(uid) or None
+        return None
+
+    def _cd_window_map(self) -> dict[str, list[str]]:
+        """uid -> preferred-node window for every ComputeDomain, listed
+        once per sync pass (N pending channel claims must not mean N
+        full CD lists against the apiserver)."""
+        if self._pass_cd_windows is not None:
+            return self._pass_cd_windows
+        from ..computedomain import (  # noqa: PLC0415 - leaf consts
+            API_GROUP,
+            API_VERSION,
+            PREFERRED_NODES_ANNOTATION,
+        )
+
+        try:
+            cds = self.kube.list(API_GROUP, API_VERSION,
+                                 "computedomains")
+        except KubeError:
+            # Transient failure: cache the empty answer for the REST of
+            # this pass (don't hammer a struggling apiserver once per
+            # pending claim); the next pass retries fresh.
+            self._pass_cd_windows = {}
+            return self._pass_cd_windows
+        windows: dict[str, list[str]] = {}
+        for cd in cds:
+            uid = _meta(cd).get("uid")
+            ann = (_meta(cd).get("annotations") or {}).get(
+                PREFERRED_NODES_ANNOTATION, "")
+            if uid:
+                windows[uid] = [n for n in ann.split(",") if n]
+        self._pass_cd_windows = windows
+        return windows
+
+    def _observe_placement(self, alloc, candidates, allocated) -> None:
+        """Export placement quality for a fresh allocation: compactness
+        of the chosen set, plus the post-pick fragmentation / largest
+        allocatable shape of every pool it drew from."""
+        if self.metrics is None or not self._topology:
+            return
+        by_pool: dict[tuple, list[str]] = {}
+        for res in alloc.get("devices", {}).get("results", []):
+            by_pool.setdefault((res.get("driver", ""), res.get("pool", "")),
+                               []).append(res.get("device", ""))
+        for (driver, pool), picked in by_pool.items():
+            devs = [c for c in candidates
+                    if c.driver == driver and c.pool == pool]
+            if not devs:
+                continue
+            grid = self._grid_for(devs)
+            cells = {grid.coords[n] for n in picked if n in grid.coords}
+            if not cells:
+                continue  # uncoordinated pool: nothing to report
+            label = f"{driver}/{pool}"
+            hops, _ = set_compactness(grid, cells)
+            self.metrics.compactness.labels(label).observe(hops)
+            free = {grid.coords[c.name] for c in devs
+                    if c.key not in allocated and c.name in grid.coords}
+            # One largest_free_shape sweep feeds both gauges (it is the
+            # most expensive topology operation on big pools).
+            _, chips = largest_free_shape(grid, free)
+            self.metrics.frag_score.labels(label).set(
+                frag_from_largest(chips, len(free)))
+            self.metrics.largest_shape.labels(label).set(chips)
+
     def _fit_on_node(self, claim, node, candidates, ledger, allocated,
                      classes):
         """All requests of one claim against one node; returns
@@ -549,6 +777,9 @@ class DraScheduler:
                         list(exactly.get("tolerations") or []))
                 ],
             })
+        if self._topology:
+            for r in reqs:
+                r["cands"] = self._topology_order(r["cands"], r["want"])
         constraints = []
         for c in spec.get("constraints") or []:
             attr = c.get("matchAttribute")
@@ -681,6 +912,8 @@ class DraScheduler:
         return pins
 
     def _allocate_claims(self):
+        self._pass_order_cache = {}
+        self._pass_cd_windows = None
         candidates, ledger, allocated, by_key = self._snapshot()
         classes = self._device_classes()
         pins = self._claim_pins()
@@ -703,6 +936,7 @@ class DraScheduler:
                     {"status": {"allocation": alloc}}, namespace=ns)
             except (NotFoundError, ConflictError):
                 continue
+            self._observe_placement(alloc, candidates, allocated)
             logger.info(
                 "allocated claim %s/%s -> %s", ns, _meta(claim)["name"],
                 [r["device"] for r in alloc["devices"]["results"]])
@@ -999,23 +1233,42 @@ class DraScheduler:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import os
+
     from .kubeclient import KubeClient
 
     p = argparse.ArgumentParser(prog="tpu-dra-scheduler")
     p.add_argument("--kube-api", required=True)
     p.add_argument("--default-node", default=None)
     p.add_argument("--interval", type=float, default=0.25)
+    p.add_argument("--metrics-port", type=int,
+                   default=int(os.environ.get("METRICS_PORT", "0")),
+                   help="serve /metrics (placement frag/compactness) "
+                        "on this port; 0 = disabled [METRICS_PORT]")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    metrics = None
+    server = None
+    if args.metrics_port:
+        from .metrics import MetricsServer, PlacementMetrics
+
+        metrics = PlacementMetrics()
+        server = MetricsServer(metrics.registry, host="0.0.0.0",
+                               port=args.metrics_port)
+        server.start()
     sched = DraScheduler(KubeClient(host=args.kube_api),
-                         default_node=args.default_node)
+                         default_node=args.default_node,
+                         metrics=metrics)
     print("scheduler running", flush=True)
     try:
         sched.run(args.interval)
     except KeyboardInterrupt:
         pass
+    finally:
+        if server is not None:
+            server.stop()
     return 0
 
 

@@ -1,0 +1,188 @@
+"""The placement scorer: compactness first, fragmentation cost second.
+
+Given a pool grid, its free chips, and a claim's chip count, the
+scorer ranks candidate device sets by:
+
+1. **Compactness**: max pairwise ICI hop distance (the collective's
+   worst-case path -- the property the CLAIM's owner feels), then
+   exposed surface area (fabric links crossing the allocation
+   boundary). A 2x2 quad always beats a 4x1 line.
+2. **Fragmentation cost** (best-fit): among equally-compact choices,
+   how many future large-shape placements the pick destroys, weighted
+   by shape volume -- the property the FLEET feels under churn. The
+   protected-shape catalog is the power-of-two claim sizes (2, 4, 8,
+   ... up to the slice) -- the sizes TPU sub-slices actually come in --
+   which keeps scoring O(hundreds) of placement checks instead of the
+   full shape lattice.
+3. A deterministic name tiebreak, so equal-score rankings are stable
+   across processes and test runs.
+
+Exact sub-torus placements are preferred; when fragmentation (or a
+non-factorizable count) leaves none, a greedy nearest-neighbor
+fallback still produces compact -- just not box-shaped -- sets.
+"""
+
+from __future__ import annotations
+
+from .grid import Coord, TorusGrid
+from .shapes import enumerate_shapes, placements, shapes_for_count
+
+
+def set_compactness(grid: TorusGrid, cells: set[Coord]
+                    ) -> tuple[int, int]:
+    """(max ICI hops, exposed surface area) -- lower is tighter."""
+    return (grid.max_hops(cells), grid.surface_area(cells))
+
+
+def _protected_shapes(grid: TorusGrid) -> list[tuple[int, int, int]]:
+    """The power-of-two shape catalog the frag scorer defends."""
+    total = grid.dims[0] * grid.dims[1] * grid.dims[2]
+    shapes: list[tuple[int, int, int]] = []
+    size = 2
+    while size <= total:
+        shapes.extend(shapes_for_count(grid, size))
+        size *= 2
+    return shapes
+
+
+def _free_placements(grid: TorusGrid, free: set[Coord],
+                     shapes: list[tuple[int, int, int]]
+                     ) -> list[tuple[int, frozenset[Coord]]]:
+    """(volume, cells) for every protected placement currently fully
+    free -- the standing inventory a pick can destroy."""
+    out = []
+    for shape in shapes:
+        vol = shape[0] * shape[1] * shape[2]
+        for cells in placements(grid, shape):
+            if all(c in free for c in cells):
+                out.append((vol, frozenset(cells)))
+    return out
+
+
+def frag_cost(pick: set[Coord],
+              inventory: list[tuple[int, frozenset[Coord]]]) -> int:
+    """Volume-weighted count of inventory placements the pick
+    intersects (and therefore destroys)."""
+    return sum(vol for vol, cells in inventory
+               if not cells.isdisjoint(pick))
+
+
+def largest_free_shape(grid: TorusGrid, free: set[Coord]
+                       ) -> tuple[tuple[int, int, int], int]:
+    """The biggest sub-torus shape still fully placeable in ``free``
+    -> (shape, chips); ((0, 0, 0), 0) when nothing is free."""
+    for shape in enumerate_shapes(grid, max_chips=len(free)):
+        for cells in placements(grid, shape):
+            if all(c in free for c in cells):
+                return shape, shape[0] * shape[1] * shape[2]
+    return (0, 0, 0), 0
+
+
+def frag_from_largest(largest_chips: int, free_count: int) -> float:
+    """THE fragmentation formula: 1 - largest-allocatable-shape /
+    free-chips, in [0, 1). Exposed separately so callers that already
+    paid the largest_free_shape sweep (the expensive half) don't
+    re-derive -- or worse, re-implement -- the division."""
+    if free_count <= 0:
+        return 0.0
+    return 1.0 - largest_chips / free_count
+
+
+def fragmentation_score(grid: TorusGrid, free: set[Coord]) -> float:
+    """0.0 means the free space is one perfect sub-torus (or there is
+    none); rising values mean churn has shredded the big shapes."""
+    _, chips = largest_free_shape(grid, free)
+    return frag_from_largest(chips, len(free))
+
+
+def _greedy_sets(grid: TorusGrid, free: set[Coord], count: int
+                 ) -> list[tuple[Coord, ...]]:
+    """Fallback candidate sets when no exact sub-torus placement is
+    free: grow from each seed by nearest free chip (hop distance to
+    the set, deterministic coord tiebreak)."""
+    out: list[tuple[Coord, ...]] = []
+    seen: set[frozenset[Coord]] = set()
+    for seed in sorted(free):
+        picked = [seed]
+        pool = set(free)
+        pool.discard(seed)
+        while len(picked) < count and pool:
+            best = min(
+                pool,
+                key=lambda c: (min(grid.hop_distance(c, p)
+                                   for p in picked), c),
+            )
+            picked.append(best)
+            pool.discard(best)
+        if len(picked) == count:
+            key = frozenset(picked)
+            if key not in seen:
+                seen.add(key)
+                out.append(tuple(sorted(picked)))
+    return out
+
+
+def rank_placements(grid: TorusGrid, free_names: list[str], count: int
+                    ) -> list[list[str]]:
+    """Candidate device sets for a ``count``-chip claim, best first.
+
+    Only names with coordinates participate; an empty result means the
+    caller should keep its first-fit order (no grid information, or
+    count exceeds the coordinated free chips).
+    """
+    if count < 1:
+        return []
+    free = {grid.coords[n] for n in free_names if n in grid.coords}
+    if len(free) < count:
+        return []
+    inventory = _free_placements(grid, free, _protected_shapes(grid))
+    candidates: list[tuple[Coord, ...]] = []
+    for shape in shapes_for_count(grid, count):
+        for cells in placements(grid, shape):
+            if all(c in free for c in cells):
+                candidates.append(cells)
+    if not candidates:
+        candidates = _greedy_sets(grid, free, count)
+    # One coord->name inversion for every candidate (cell_names would
+    # rebuild it per placement).
+    by_coord = {c: n for n, c in grid.coords.items()}
+    scored = []
+    for cells in candidates:
+        cellset = set(cells)
+        names = [by_coord.get(c) for c in cells]
+        if None in names:
+            continue  # a cell with no published device: not realizable
+        max_hops, surface = set_compactness(grid, cellset)
+        scored.append((
+            max_hops,
+            frag_cost(cellset, inventory),
+            surface,
+            sorted(names),
+            names,
+        ))
+    scored.sort(key=lambda t: t[:4])
+    return [t[4] for t in scored]
+
+
+def order_candidates(grid: TorusGrid, free_names: list[str], count: int
+                     ) -> list[str] | None:
+    """A full preference ordering of ``free_names`` for a backtracking
+    allocator: the best-ranked placement's devices first, then each
+    next placement's unseen devices, then any remaining names in their
+    original (first-fit) order. None = no topology signal; keep the
+    caller's order."""
+    ranked = rank_placements(grid, free_names, count)
+    if not ranked:
+        return None
+    ordered: list[str] = []
+    seen: set[str] = set()
+    for names in ranked:
+        for name in names:
+            if name not in seen:
+                seen.add(name)
+                ordered.append(name)
+    for name in free_names:
+        if name not in seen:
+            seen.add(name)
+            ordered.append(name)
+    return ordered

@@ -1,0 +1,174 @@
+"""Placement simulator: claim churn against v5e/v5p-shaped grids.
+
+Replays a randomized-but-deterministic claim arrival/departure trace
+against a slice grid twice -- once with the scheduler's historical
+first-fit policy, once with the topology scorer -- and reports
+fragmentation over time: fragmentation score, allocatable largest
+shape, allocation compactness. The SAME trace drives both policies
+(sizes/lifetimes are pre-drawn from the seed), so the comparison is
+paired, not statistical.
+
+This is the `bench.py --placement-sim` engine and the fixture behind
+the tier-1 placement smoke test.
+"""
+
+from __future__ import annotations
+
+import random
+import statistics
+from dataclasses import dataclass
+
+from .grid import TorusGrid, default_wrap
+from .score import (
+    frag_from_largest,
+    largest_free_shape,
+    rank_placements,
+    set_compactness,
+)
+
+# Typical TPU claim sizes: single chips up to half-slice blocks.
+DEFAULT_SIZES = (1, 1, 2, 4, 4, 8)
+
+
+def grid_for_type(accelerator_type: str) -> TorusGrid:
+    """A fully-populated TorusGrid for an accelerator type string
+    (e.g. ``v5e-16``, ``v5p-32``), chips named ``chip-<i>`` in
+    row-major publication order."""
+    from ...tpulib.binding import (  # noqa: PLC0415 - leaf dependency
+        _parse_type,
+        _slice_shape,
+    )
+
+    parsed = _parse_type(accelerator_type)
+    if parsed is None:
+        raise ValueError(f"unknown accelerator type {accelerator_type!r}")
+    gen, chips = parsed
+    dims = _slice_shape(gen, chips)
+    coords = {}
+    i = 0
+    for z in range(dims[2]):
+        for y in range(dims[1]):
+            for x in range(dims[0]):
+                coords[f"chip-{i}"] = (x, y, z)
+                i += 1
+    return TorusGrid(dims=dims, wrap=default_wrap(gen.name, dims),
+                     coords=coords)
+
+
+@dataclass(frozen=True)
+class TraceEvent:
+    """One simulator step: an optional arrival (size, lifetime)."""
+
+    size: int  # 0 = no arrival this step
+    lifetime: int
+
+
+def make_trace(steps: int, seed: int, sizes=DEFAULT_SIZES,
+               arrival_prob: float = 0.7, max_lifetime: int = 25
+               ) -> list[TraceEvent]:
+    """The deterministic churn trace both policies replay."""
+    rng = random.Random(seed)
+    out = []
+    for _ in range(steps):
+        if rng.random() < arrival_prob:
+            out.append(TraceEvent(size=rng.choice(sizes),
+                                  lifetime=rng.randint(1, max_lifetime)))
+        else:
+            out.append(TraceEvent(size=0, lifetime=0))
+    return out
+
+
+def _first_fit_pick(grid: TorusGrid, free_names: list[str], size: int
+                    ) -> list[str] | None:
+    """The pre-topology scheduler policy: first ``size`` free devices
+    in publication order, scattered or not."""
+    if len(free_names) < size:
+        return None
+    return free_names[:size]
+
+
+def _scored_pick(grid: TorusGrid, free_names: list[str], size: int
+                 ) -> list[str] | None:
+    ranked = rank_placements(grid, free_names, size)
+    if ranked:
+        return ranked[0]
+    return _first_fit_pick(grid, free_names, size)
+
+
+_POLICIES = {"first_fit": _first_fit_pick, "scored": _scored_pick}
+
+
+def simulate_churn(grid: TorusGrid, trace: list[TraceEvent],
+                   policy: str = "scored", metrics=None,
+                   pool: str = "sim") -> dict:
+    """Replay ``trace`` under ``policy``; returns the fragmentation /
+    compactness summary. ``metrics`` (a ``PlacementMetrics``) gets the
+    per-step gauges + per-allocation compactness observations, proving
+    the exporter wiring end to end."""
+    pick = _POLICIES[policy]
+    all_names = sorted(grid.coords, key=lambda n: (len(n), n))
+    allocated: dict[int, tuple[list[str], int]] = {}  # id -> (devs, expiry)
+    next_id = 0
+    frag_series: list[float] = []
+    largest_series: list[int] = []
+    hops: list[int] = []
+    failed = 0
+    for step, ev in enumerate(trace):
+        for cid in [c for c, (_, exp) in allocated.items() if exp <= step]:
+            del allocated[cid]
+        taken = {d for devs, _ in allocated.values() for d in devs}
+        free_names = [n for n in all_names if n not in taken]
+        if ev.size:
+            devs = pick(grid, free_names, ev.size)
+            if devs is None:
+                failed += 1
+            else:
+                allocated[next_id] = (devs, step + ev.lifetime)
+                next_id += 1
+                cells = {grid.coords[d] for d in devs}
+                max_hops, _ = set_compactness(grid, cells)
+                hops.append(max_hops)
+                if metrics is not None:
+                    metrics.compactness.labels(pool).observe(max_hops)
+                taken |= set(devs)
+                free_names = [n for n in all_names if n not in taken]
+        free = {grid.coords[n] for n in free_names}
+        # One sweep per step: frag is derived from the same
+        # largest-shape result instead of recomputing it.
+        _, chips = largest_free_shape(grid, free)
+        frag = frag_from_largest(chips, len(free))
+        frag_series.append(frag)
+        largest_series.append(chips)
+        if metrics is not None:
+            metrics.frag_score.labels(pool).set(frag)
+            metrics.largest_shape.labels(pool).set(chips)
+    return {
+        "frag_mean": round(statistics.fmean(frag_series), 4),
+        "frag_max": round(max(frag_series), 4),
+        "frag_final": round(frag_series[-1], 4),
+        "largest_shape_mean_chips": round(
+            statistics.fmean(largest_series), 2),
+        "largest_shape_min_chips": min(largest_series),
+        "compactness_mean_hops": round(statistics.fmean(hops), 3)
+        if hops else 0.0,
+        "compactness_max_hops": max(hops) if hops else 0,
+        "allocs": len(hops),
+        "alloc_failures": failed,
+    }
+
+
+def run_placement_bench(topologies=("v5e-16", "v5p-32"), steps: int = 400,
+                        seed: int = 20260802, metrics=None) -> dict:
+    """First-fit vs. scored on the same trace per topology; the
+    structure bench.py flattens into its extras."""
+    out: dict = {}
+    for topo in topologies:
+        grid = grid_for_type(topo)
+        trace = make_trace(steps, seed)
+        out[topo] = {
+            policy: simulate_churn(
+                grid, trace, policy=policy, metrics=metrics,
+                pool=f"{topo}/{policy}")
+            for policy in ("first_fit", "scored")
+        }
+    return out

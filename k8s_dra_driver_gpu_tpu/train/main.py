@@ -100,6 +100,18 @@ def initialize_distributed(env=os.environ) -> bool:
     gang = validate_gang_env(env)
     if gang is None:
         return False
+    # A gang on the CPU backend (CI / the mock e2e tier) needs the gloo
+    # cross-process collectives; without them every psum dies with
+    # "Multiprocess computations aren't implemented on the CPU
+    # backend". Must be set before initialize(). Best-effort: jaxlibs
+    # without gloo keep the old behavior.
+    plats = str(getattr(jax.config, "jax_platforms", "") or "")
+    if "cpu" in plats.split(","):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except (AttributeError, ValueError):
+            pass
     timeout = int(env.get("TPU_INIT_TIMEOUT_S", "300"))
     jax.distributed.initialize(
         coordinator_address=gang["coordinator"],

@@ -57,9 +57,10 @@ def run(argv: list[str] | None = None) -> int:
     import jax
 
     if args.local_devices > 0:
+        from ..compat import force_cpu_devices
+
         # Must precede any JAX backend initialization.
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.local_devices)
+        force_cpu_devices(args.local_devices)
 
     joined = initialize_distributed()
     if args.require_gang and not joined:

@@ -1,0 +1,55 @@
+"""Tier-1 placement-sim smoke: the `make bench-placement-smoke`
+contract as a non-slow test. Runs `bench.py --placement-sim` at
+reduced churn steps and asserts the frag/compactness metrics are
+produced for both grids and both policies -- and that on the
+deterministic default trace the topology scorer fragments the fleet
+no worse than first-fit (the subsystem's whole point)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-placement-smoke target.
+SMOKE_ENV = {"BENCH_PLACEMENT_STEPS": "80"}
+
+GRIDS = ("v5e-16", "v5p-32")
+POLICIES = ("first_fit", "scored")
+
+
+def test_bench_placement_smoke_reports_frag_and_compactness():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--placement-sim"],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV},
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "placement_frag_score"
+    assert 0.0 <= doc["value"] < 1.0
+    extras = doc["extras"]
+    # The PlacementMetrics exporter really emitted the gauge +
+    # histogram families (not just the summary dict).
+    assert extras["placement_metrics_exported"] == 1
+    for grid in GRIDS:
+        for policy in POLICIES:
+            for key in ("frag_mean", "frag_final",
+                        "largest_shape_mean_chips",
+                        "compactness_mean_hops", "allocs"):
+                assert f"{grid}/{policy}/{key}" in extras, \
+                    f"missing {grid}/{policy}/{key}"
+        # Same trace, paired comparison: the scorer must not fragment
+        # worse than first-fit (deterministic seed; recorded in
+        # BASELINE.md).
+        assert extras[f"{grid}/scored/frag_mean"] <= \
+            extras[f"{grid}/first_fit/frag_mean"]
+        assert extras[f"{grid}/scored/compactness_mean_hops"] <= \
+            extras[f"{grid}/first_fit/compactness_mean_hops"]
+        # Both policies replayed the identical trace.
+        assert extras[f"{grid}/scored/allocs"] == \
+            extras[f"{grid}/first_fit/allocs"]
+    # vs_baseline is the first-fit/scored frag ratio; >= 1 = scorer wins.
+    assert doc["vs_baseline"] >= 1.0

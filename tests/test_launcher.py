@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from k8s_dra_driver_gpu_tpu.compat import shard_map
 from k8s_dra_driver_gpu_tpu.parallel.mesh import (
     MeshPlan,
     build_multislice_mesh,
@@ -90,7 +91,7 @@ class TestMultisliceMesh:
         # DCN-axis psum crosses the slice boundary.
 
         out = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda x: jax.lax.psum(x, "dcn"),
                 mesh=mesh,
                 in_specs=jax.sharding.PartitionSpec("dcn"),

@@ -98,8 +98,13 @@ class TestExpertParallelTrain:
         ref_val, ref_grads = jax.value_and_grad(ref_loss)(params)
         ref_params = jax.tree_util.tree_map(
             lambda p, g: p - lr * g, params, ref_grads)
+        # The loss rides the same dtype-dependent matmul-order noise
+        # as the params (bf16 accumulates in whatever order the CPU
+        # backend's XLA picks); floor at 3e-4 so fp32 stays as strict
+        # as ever.
+        loss_tol = max(tol, 3e-4)
         np.testing.assert_allclose(float(loss), float(ref_val),
-                                   rtol=3e-4, atol=3e-4)
+                                   rtol=loss_tol, atol=loss_tol)
         for a, b in zip(jax.tree_util.tree_leaves(state.params),
                         jax.tree_util.tree_leaves(ref_params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),

@@ -276,6 +276,101 @@ class TestClaimGenerationAndBinding:
         assert not pod["spec"].get("nodeName")
 
 
+class TestExtendedResourceClaims:
+    """KEP-5004 claim generation hygiene: only pods still being
+    scheduled acquire claims, and malformed quantities surface on the
+    pod (condition + event) instead of wedging silently."""
+
+    @pytest.fixture()
+    def ext_class(self, kube):
+        kube.patch(*RES, "deviceclasses", "tpu.dra.dev",
+                   {"spec": {"extendedResourceName": "google.com/tpu"}})
+
+    def make_pod(self, kube, name, qty="1", node=None, phase=None):
+        pod = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c",
+                "resources": {"limits": {"google.com/tpu": qty}},
+            }]},
+        }
+        if node:
+            pod["spec"]["nodeName"] = node
+        if phase:
+            pod["status"] = {"phase": phase}
+        return kube.create("", "v1", "pods", pod, namespace="default")
+
+    def ext_status(self, kube, name):
+        pod = kube.get("", "v1", "pods", name, "default")
+        return pod.get("status", {}).get("extendedResourceClaimStatus")
+
+    def test_pending_pod_gets_claim_and_binds(self, driver, kube, sched,
+                                              ext_class):
+        self.make_pod(kube, "legacy")
+        sched.sync_once()
+        sched.sync_once()
+        ext = self.ext_status(kube, "legacy")
+        assert ext and ext["requestMappings"][0]["resourceName"] == \
+            "google.com/tpu"
+        claim = kube.get(*RES, "resourceclaims",
+                         ext["resourceClaimName"], "default")
+        assert claim["status"]["allocation"]
+        pod = kube.get("", "v1", "pods", "legacy", "default")
+        assert pod["spec"]["nodeName"] == "node-a"
+
+    def test_already_bound_pod_is_skipped(self, driver, kube, sched,
+                                          ext_class):
+        """A pod scheduled before the class advertised the resource
+        (or born bound) must not retroactively acquire devices."""
+        self.make_pod(kube, "bound", node="node-a")
+        sched.sync_once()
+        assert self.ext_status(kube, "bound") is None
+        assert kube.objects("resource.k8s.io", "resourceclaims") == []
+
+    def test_pod_past_pending_is_skipped(self, driver, kube, sched,
+                                         ext_class):
+        self.make_pod(kube, "running", phase="Running")
+        sched.sync_once()
+        assert self.ext_status(kube, "running") is None
+        assert kube.objects("resource.k8s.io", "resourceclaims") == []
+
+    def test_malformed_quantity_surfaces_on_the_pod(self, driver, kube,
+                                                    sched, ext_class):
+        self.make_pod(kube, "bad", qty="1.5")
+        sched.sync_once()
+        assert self.ext_status(kube, "bad") is None
+        pod = kube.get("", "v1", "pods", "bad", "default")
+        conds = pod["status"]["conditions"]
+        sched_cond = next(c for c in conds
+                          if c["type"] == "PodScheduled")
+        assert sched_cond["status"] == "False"
+        assert sched_cond["reason"] == "InvalidExtendedResourceQuantity"
+        assert "1.5" in sched_cond["message"]
+        events = [e for e in kube.objects("", "events")
+                  if e.get("involvedObject", {}).get("name") == "bad"]
+        assert len(events) == 1
+        assert events[0]["type"] == "Warning"
+        # Deduped: another pass must not stack conditions or events.
+        sched.sync_once()
+        pod = kube.get("", "v1", "pods", "bad", "default")
+        assert len([c for c in pod["status"]["conditions"]
+                    if c["type"] == "PodScheduled"]) == 1
+        assert len([e for e in kube.objects("", "events")
+                    if e.get("involvedObject", {}).get("name") == "bad"
+                    ]) == 1
+
+    def test_malformed_pod_does_not_wedge_others(self, driver, kube,
+                                                 sched, ext_class):
+        self.make_pod(kube, "bad", qty="1.5")
+        self.make_pod(kube, "good")
+        sched.sync_once()
+        sched.sync_once()
+        assert self.ext_status(kube, "bad") is None
+        good = kube.get("", "v1", "pods", "good", "default")
+        assert good["spec"].get("nodeName") == "node-a"
+
+
 class TestMatchAttribute:
     """spec.devices.constraints[].matchAttribute (KEP-4381): the
     topology primitive -- all devices of the constrained requests must

@@ -1,0 +1,582 @@
+"""ICI topology-aware placement engine tier: grid parsing (wraparound,
+partial grids, missing coordinates), sub-torus shape enumeration,
+scorer ranking determinism, host-adjacency ranking, simulator
+determinism + metrics export -- and the scheduler-level proof that a
+4-chip claim lands on a contiguous 2x2 sub-torus instead of a
+scattered set (plus the first-fit fallback when the gate is off)."""
+
+import random
+
+import pytest
+from prometheus_client import generate_latest
+
+from k8s_dra_driver_gpu_tpu.computedomain import (
+    API_GROUP,
+    API_VERSION,
+    PREFERRED_NODES_ANNOTATION,
+)
+from k8s_dra_driver_gpu_tpu.computedomain.controller.controller import (
+    ComputeDomainController,
+)
+from k8s_dra_driver_gpu_tpu.pkg.featuregates import FeatureGates
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.metrics import PlacementMetrics
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+from k8s_dra_driver_gpu_tpu.pkg.topology import (
+    TorusGrid,
+    default_wrap,
+    enumerate_shapes,
+    fragmentation_score,
+    largest_free_shape,
+    order_candidates,
+    placements,
+    rank_adjacent_hosts,
+    rank_placements,
+    shapes_for_count,
+)
+from k8s_dra_driver_gpu_tpu.pkg.topology.sim import (
+    grid_for_type,
+    make_trace,
+    run_placement_bench,
+    simulate_churn,
+)
+
+RES = ("resource.k8s.io", "v1")
+
+
+def chip_device(name, x=None, y=None, z=None, topology="4x4",
+                platform="v5e", **extra):
+    attrs = {
+        "platform": {"string": platform},
+        "topology": {"string": topology},
+    }
+    if x is not None:
+        attrs["iciX"] = {"int": x}
+    if y is not None:
+        attrs["iciY"] = {"int": y}
+    if z is not None:
+        attrs["iciZ"] = {"int": z}
+    for k, v in extra.items():
+        attrs[k] = v
+    return {"name": name, "attributes": attrs, "capacity": {}}
+
+
+def grid_4x4(names=None):
+    devs = []
+    i = 0
+    for y in range(4):
+        for x in range(4):
+            name = names[i] if names else f"chip-{i}"
+            devs.append(chip_device(name, x, y))
+            i += 1
+    return TorusGrid.from_devices(devs)
+
+
+class TestGridParsing:
+    def test_parses_typed_attributes_and_dims(self):
+        g = grid_4x4()
+        assert g.dims == (4, 4, 1)
+        assert g.coords["chip-0"] == (0, 0, 0)
+        assert g.coords["chip-5"] == (1, 1, 0)
+        assert g.uncoordinated == ()
+        assert g.wrap == (False, False, False)  # v5e 4x4: mesh, no rings
+
+    def test_v5p_axes_of_four_wrap(self):
+        devs = [chip_device(f"c{i}", i % 2, (i // 2) % 2, i // 4,
+                            topology="2x2x4", platform="v5p")
+                for i in range(16)]
+        g = TorusGrid.from_devices(devs)
+        assert g.dims == (2, 2, 4)
+        assert g.wrap == (False, False, True)
+        # Ring distance across the z seam: 0 -> 3 is one hop.
+        assert g.hop_distance((0, 0, 0), (0, 0, 3)) == 1
+
+    def test_missing_coordinates_are_quarantined(self):
+        devs = [chip_device("good", 0, 0),
+                chip_device("no-coords"),  # e.g. a sub-slice device
+                chip_device("half", x=1)]  # iciY missing
+        g = TorusGrid.from_devices(devs)
+        assert set(g.coords) == {"good"}
+        assert set(g.uncoordinated) == {"no-coords", "half"}
+
+    def test_duplicate_and_out_of_grid_coords_demoted(self):
+        devs = [chip_device("a", 0, 0), chip_device("b", 0, 0),
+                chip_device("oob", 9, 9)]
+        g = TorusGrid.from_devices(devs)
+        assert set(g.coords) == {"a"}
+        assert set(g.uncoordinated) == {"b", "oob"}
+
+    def test_partial_grid_keeps_full_slice_dims(self):
+        # One host of a 4x4 slice: only a 2x2 corner visible, global
+        # coordinates, dims still the declared full slice.
+        devs = [chip_device(f"c{i}", 2 + i % 2, 2 + i // 2)
+                for i in range(4)]
+        g = TorusGrid.from_devices(devs)
+        assert g.dims == (4, 4, 1)
+        assert g.coords["c3"] == (3, 3, 0)
+
+    def test_dims_fall_back_to_bounding_box(self):
+        devs = [{"name": "a", "attributes": {"iciX": {"int": 1},
+                                             "iciY": {"int": 2}}}]
+        g = TorusGrid.from_devices(devs)
+        assert g.dims == (2, 3, 1)
+
+    def test_default_wrap_policy(self):
+        assert default_wrap("v5p", (4, 4, 4)) == (True, True, True)
+        assert default_wrap("v5p", (2, 2, 4)) == (False, False, True)
+        assert default_wrap("v5e", (4, 4, 1)) == (False, False, False)
+        assert default_wrap("v5e", (16, 16, 1)) == (True, True, False)
+        assert default_wrap("", (8, 8, 8)) == (False, False, False)
+
+
+class TestShapes:
+    def test_shapes_for_count_prefers_cubic(self):
+        g = grid_4x4()
+        assert shapes_for_count(g, 4)[0] == (2, 2, 1)
+        assert (4, 1, 1) in shapes_for_count(g, 4)
+        assert shapes_for_count(g, 16)[0] == (4, 4, 1)
+        assert shapes_for_count(g, 3) == [(1, 3, 1), (3, 1, 1)]
+        assert shapes_for_count(g, 32) == []  # bigger than the slice
+
+    def test_enumerate_shapes_largest_first(self):
+        g = grid_4x4()
+        shapes = enumerate_shapes(g)
+        assert shapes[0] == (4, 4, 1)
+        vols = [w * h * d for w, h, d in shapes]
+        assert vols == sorted(vols, reverse=True)
+
+    def test_placement_counts_no_wrap(self):
+        g = grid_4x4()
+        assert len(placements(g, (2, 2, 1))) == 9
+        assert len(placements(g, (4, 1, 1))) == 4
+        assert len(placements(g, (4, 4, 1))) == 1
+
+    def test_wraparound_placements_cross_the_seam(self):
+        devs = [chip_device(f"c{i}", i % 2, (i // 2) % 2, i // 4,
+                            topology="2x2x4", platform="v5p")
+                for i in range(16)]
+        g = TorusGrid.from_devices(devs)
+        # A 2-deep block: the wrapping z ring contributes 4 anchors per
+        # (x, y) column (incl. the seam-crossing z=3 one), not 3.
+        zs = placements(g, (1, 1, 2))
+        assert len(zs) == 2 * 2 * 4
+        assert ((0, 0, 3), (0, 0, 0)) in zs
+        # The non-wrapping x axis: 1 anchor only.
+        assert len(placements(g, (2, 1, 1))) == 1 * 2 * 4
+
+
+class TestScorer:
+    def test_four_chips_pick_a_quad_on_an_empty_grid(self):
+        g = grid_4x4()
+        best = rank_placements(g, list(g.coords), 4)[0]
+        cells = {g.coords[n] for n in best}
+        xs = {c[0] for c in cells}
+        ys = {c[1] for c in cells}
+        assert len(xs) == 2 and len(ys) == 2, f"not a 2x2: {cells}"
+        assert g.max_hops(cells) == 2
+
+    def test_ranking_is_deterministic_under_input_shuffle(self):
+        g = grid_4x4()
+        names = list(g.coords)
+        baseline = rank_placements(g, names, 4)
+        for seed in range(3):
+            shuffled = names[:]
+            random.Random(seed).shuffle(shuffled)
+            assert rank_placements(g, shuffled, 4) == baseline
+        assert order_candidates(g, names, 4) == \
+            order_candidates(g, names, 4)
+
+    def test_fragmented_grid_finds_the_surviving_quad(self):
+        g = grid_4x4()
+        # Take the whole grid except a 2x2 at (2..3, 2..3) plus two
+        # scattered singles; the only contiguous quad must win.
+        keep = {(2, 2, 0), (3, 2, 0), (2, 3, 0), (3, 3, 0),
+                (0, 0, 0), (0, 2, 0)}
+        free = [n for n, c in g.coords.items() if c in keep]
+        best = rank_placements(g, free, 4)[0]
+        assert {g.coords[n] for n in best} == \
+            {(2, 2, 0), (3, 2, 0), (2, 3, 0), (3, 3, 0)}
+
+    def test_greedy_fallback_when_no_exact_subtorus(self):
+        g = grid_4x4()
+        # An L of 3 cells: count=3 needs a 1x3 line, none is free ->
+        # the greedy fallback must still return the (compact) L.
+        keep = {(0, 0, 0), (1, 0, 0), (0, 1, 0)}
+        free = [n for n, c in g.coords.items() if c in keep]
+        ranked = rank_placements(g, free, 3)
+        assert ranked, "fallback produced nothing"
+        assert {g.coords[n] for n in ranked[0]} == keep
+
+    def test_order_candidates_keeps_every_name(self):
+        g = grid_4x4()
+        names = list(g.coords)
+        ordered = order_candidates(g, names, 4)
+        assert sorted(ordered) == sorted(names)
+        # Uncoordinated-only input: no signal, caller keeps first-fit.
+        g2 = TorusGrid.from_devices([chip_device("u1"),
+                                     chip_device("u2")])
+        assert order_candidates(g2, ["u1", "u2"], 2) is None
+
+    def test_fragmentation_score_and_largest_shape(self):
+        g = grid_4x4()
+        whole = set(g.coords.values())
+        assert fragmentation_score(g, whole) == 0.0
+        assert largest_free_shape(g, whole) == ((4, 4, 1), 16)
+        assert fragmentation_score(g, set()) == 0.0
+        # A diagonal: 4 free chips, nothing bigger than a single fits.
+        diag = {(i, i, 0) for i in range(4)}
+        assert largest_free_shape(g, diag)[1] == 1
+        assert fragmentation_score(g, diag) == pytest.approx(0.75)
+
+
+class TestHostRanking:
+    def test_best_window_of_consecutive_workers_first(self):
+        hosts = {"node-a": 0, "node-b": 2, "node-c": 1, "node-d": 5}
+        assert rank_adjacent_hosts(hosts, 2) == \
+            ["node-a", "node-c", "node-b", "node-d"]
+        # Gang of 3: workers 0,1,2 -> a,c,b; d trails.
+        assert rank_adjacent_hosts(hosts, 3) == \
+            ["node-a", "node-c", "node-b", "node-d"]
+
+    def test_window_skips_a_gap(self):
+        hosts = {"h0": 0, "h4": 4, "h5": 5}
+        assert rank_adjacent_hosts(hosts, 2) == ["h4", "h5", "h0"]
+
+    def test_degenerate_sizes(self):
+        hosts = {"b": 1, "a": 0}
+        assert rank_adjacent_hosts(hosts, 1) == ["a", "b"]
+        assert rank_adjacent_hosts(hosts, 9) == ["a", "b"]
+        assert rank_adjacent_hosts({}, 2) == []
+
+
+class TestSimulator:
+    def test_same_seed_same_results(self):
+        g = grid_for_type("v5e-16")
+        trace = make_trace(60, seed=3)
+        a = simulate_churn(g, trace, policy="scored")
+        b = simulate_churn(g, trace, policy="scored")
+        assert a == b
+
+    def test_scored_beats_first_fit_on_the_default_trace(self):
+        res = run_placement_bench(steps=120)
+        for topo, policies in res.items():
+            assert policies["scored"]["frag_mean"] <= \
+                policies["first_fit"]["frag_mean"], topo
+            assert policies["scored"]["compactness_mean_hops"] <= \
+                policies["first_fit"]["compactness_mean_hops"], topo
+
+    def test_metrics_families_are_exported(self):
+        m = PlacementMetrics()
+        g = grid_for_type("v5e-16")
+        simulate_churn(g, make_trace(40, seed=1), policy="scored",
+                       metrics=m, pool="test-pool")
+        text = generate_latest(m.registry).decode()
+        assert 'tpu_dra_placement_frag_score{pool="test-pool"}' in text
+        assert 'tpu_dra_placement_largest_free_shape_chips' in text
+        assert 'tpu_dra_placement_compactness_bucket' in text
+
+
+# -- scheduler-level: topology-scored device picking --------------------------
+
+
+def publish_grid_slice(kube, node="node-a", pool="node-a", count=16,
+                       side=4):
+    devices = []
+    for i in range(count):
+        devices.append(chip_device(f"chip-{i}", i % side, i // side,
+                                   topology=f"{side}x{side}"))
+    kube.create(*RES, "resourceslices", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+        "metadata": {"name": f"grid-{node}"},
+        "spec": {
+            "driver": "tpu.dra.dev", "nodeName": node,
+            "pool": {"name": pool, "generation": 1,
+                     "resourceSliceCount": 1},
+            "devices": devices,
+        },
+    })
+
+
+def block_devices(kube, devices, name="blocker", node="node-a",
+                  pool="node-a"):
+    """A pre-existing allocation pinning specific chips (fragmenter)."""
+    kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu",
+             "exactly": {"deviceClassName": "tpu.dra.dev"}}]}},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": "tpu.dra.dev", "pool": pool,
+             "device": d} for d in devices
+        ]}}},
+    }, namespace="default")
+
+
+@pytest.fixture()
+def kube():
+    import os
+
+    from k8s_dra_driver_gpu_tpu.pkg.chartrender import (
+        manifests,
+        render_chart,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    chart = os.path.join(repo, "deployments", "helm", "tpu-dra-driver")
+    k = FakeKubeClient()
+    for doc in manifests(render_chart(chart)):
+        if doc.get("kind") == "DeviceClass":
+            k.create(*RES, "deviceclasses", doc)
+    return k
+
+
+def four_chip_claim(kube, name="quad", count=4):
+    kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "exactly": {
+                "deviceClassName": "tpu.dra.dev", "count": count}}]}},
+    }, namespace="default")
+
+
+def allocated_devices(kube, name):
+    claim = kube.get(*RES, "resourceclaims", name, "default")
+    alloc = claim.get("status", {}).get("allocation")
+    assert alloc, f"claim {name} not allocated"
+    return [r["device"] for r in alloc["devices"]["results"]]
+
+
+class TestSchedulerTopologyPlacement:
+    def coords_of(self, devices, side=4):
+        out = set()
+        for d in devices:
+            i = int(d.split("-")[1])
+            out.add((i % side, i // side))
+        return out
+
+    def test_quad_lands_on_contiguous_2x2(self, kube):
+        """Gate on: a 4-chip claim on a fragmented 4x4 v5e grid must
+        allocate the ICI-contiguous 2x2 sub-torus, not the scattered
+        first-fit set."""
+        publish_grid_slice(kube)
+        # Fragment: pin everything except a 2x2 at (1..2, 1..2) and
+        # four scattered chips that name-sort FIRST (first-fit bait).
+        free = {(1, 1), (2, 1), (1, 2), (2, 2),
+                (0, 0), (3, 0), (0, 3), (3, 3)}
+        blocked = [f"chip-{y * 4 + x}" for y in range(4)
+                   for x in range(4) if (x, y) not in free]
+        block_devices(kube, blocked)
+        four_chip_claim(kube)
+        DraScheduler(kube, gates=FeatureGates()).sync_once()
+        got = self.coords_of(allocated_devices(kube, "quad"))
+        assert got == {(1, 1), (2, 1), (1, 2), (2, 2)}, got
+
+    def test_gate_off_falls_back_to_first_fit(self, kube):
+        publish_grid_slice(kube)
+        free = {(1, 1), (2, 1), (1, 2), (2, 2),
+                (0, 0), (3, 0), (0, 3), (3, 3)}
+        blocked = [f"chip-{y * 4 + x}" for y in range(4)
+                   for x in range(4) if (x, y) not in free]
+        block_devices(kube, blocked)
+        four_chip_claim(kube)
+        gates = FeatureGates({"TopologyAwarePlacement": False})
+        DraScheduler(kube, gates=gates).sync_once()
+        got = self.coords_of(allocated_devices(kube, "quad"))
+        # First-fit takes the four first free devices in publication
+        # order -- a scattered set, NOT the quad.
+        assert got != {(1, 1), (2, 1), (1, 2), (2, 2)}, \
+            "gate off still picked the scored placement"
+
+    def test_empty_grid_quad_is_compact(self, kube):
+        publish_grid_slice(kube)
+        four_chip_claim(kube)
+        DraScheduler(kube, gates=FeatureGates()).sync_once()
+        cells = self.coords_of(allocated_devices(kube, "quad"))
+        xs = {c[0] for c in cells}
+        ys = {c[1] for c in cells}
+        assert len(xs) == 2 and len(ys) == 2, f"not a 2x2: {cells}"
+
+    def test_match_attribute_still_enforced_with_scoring(self, kube):
+        """matchAttribute pins, the scorer chooses: constraining iciY
+        on 2 chips must still land one row, topology gate on."""
+        publish_grid_slice(kube)
+        kube.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "row", "namespace": "default"},
+            "spec": {"devices": {
+                "requests": [{"name": "tpu", "exactly": {
+                    "deviceClassName": "tpu.dra.dev", "count": 2}}],
+                "constraints": [{"matchAttribute": "tpu.dra.dev/iciY"}],
+            }},
+        }, namespace="default")
+        DraScheduler(kube, gates=FeatureGates()).sync_once()
+        cells = self.coords_of(allocated_devices(kube, "row"))
+        assert len({y for _, y in cells}) == 1, cells
+        # And adjacent, because the scorer ranked the pair.
+        xs = sorted(x for x, _ in cells)
+        assert xs[1] - xs[0] == 1, cells
+
+    def test_placement_metrics_observed(self, kube):
+        publish_grid_slice(kube)
+        four_chip_claim(kube)
+        metrics = PlacementMetrics()
+        DraScheduler(kube, gates=FeatureGates(),
+                     metrics=metrics).sync_once()
+        text = generate_latest(metrics.registry).decode()
+        assert 'tpu_dra_placement_frag_score' in text
+        assert 'tpu_dra_placement_compactness_bucket' in text
+
+
+# -- ComputeDomain: ICI-adjacent host preference ------------------------------
+
+
+def publish_channel_slice(kube, node):
+    kube.create(*RES, "resourceslices", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+        "metadata": {"name": f"cd-{node}"},
+        "spec": {
+            "driver": "compute-domain.tpu.dra.dev", "nodeName": node,
+            "pool": {"name": f"cd-{node}", "generation": 1,
+                     "resourceSliceCount": 1},
+            "devices": [
+                {"name": f"channel-{i}",
+                 "attributes": {"type": {"string": "channel"},
+                                "channel": {"int": i},
+                                "cliqueId": {"string": "0"}},
+                 "capacity": {}}
+                for i in range(4)
+            ],
+        },
+    })
+
+
+def make_cd(kube, name="cd1", num_nodes=2, annotations=None):
+    return kube.create(API_GROUP, API_VERSION, "computedomains", {
+        "apiVersion": f"{API_GROUP}/{API_VERSION}",
+        "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": "default",
+                     **({"annotations": annotations} if annotations
+                        else {})},
+        "spec": {"numNodes": num_nodes,
+                 "channel": {"resourceClaimTemplate":
+                             {"name": f"{name}-channel"}}},
+    }, namespace="default")
+
+
+def channel_claim(kube, name, cd_uid):
+    kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"devices": {
+            "requests": [{"name": "channel", "exactly": {
+                "deviceClassName":
+                    "compute-domain-default-channel.tpu.dra.dev"}}],
+            "config": [{"requests": ["channel"], "opaque": {
+                "driver": "compute-domain.tpu.dra.dev",
+                "parameters": {
+                    "apiVersion": f"{API_GROUP}/{API_VERSION}",
+                    "kind": "ComputeDomainChannelConfig",
+                    "domainID": cd_uid,
+                },
+            }}],
+        }},
+    }, namespace="default")
+
+
+class TestGangNodePreference:
+    def test_controller_stamps_adjacent_window(self, kube):
+        # workerIds: node-a=0, node-b=2, node-c=1, node-d=5. Gang of 2
+        # -> the tight window is workers 0,1 = node-a,node-c.
+        for node, wid in (("node-a", 0), ("node-b", 2),
+                          ("node-c", 1), ("node-d", 5)):
+            kube.create(*RES, "resourceslices", {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceSlice",
+                "metadata": {"name": f"chips-{node}"},
+                "spec": {
+                    "driver": "tpu.dra.dev", "nodeName": node,
+                    "pool": {"name": node, "generation": 1,
+                             "resourceSliceCount": 1},
+                    "devices": [chip_device(
+                        "chip-0", 0, 0,
+                        workerId={"int": wid})],
+                },
+            })
+        cd = make_cd(kube, num_nodes=2)
+        controller = ComputeDomainController(kube)
+        try:
+            controller.reconcile(
+                kube.get(API_GROUP, API_VERSION, "computedomains",
+                         "cd1", "default"))
+        finally:
+            controller.queue.shutdown(wait=False)
+        got = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                       "default")
+        ann = got["metadata"]["annotations"][PREFERRED_NODES_ANNOTATION]
+        assert ann == "node-a,node-c", ann
+        assert cd["metadata"]["uid"]  # uid existed for the scheduler
+
+    def test_duplicate_worker_ids_stamp_no_window(self, kube):
+        """workerIds are slice-local; duplicates mean several ICI
+        fabrics are visible and a worker-order window would interleave
+        them -- the controller must stamp nothing."""
+        for node, wid in (("node-a", 0), ("node-b", 1),
+                          ("node-c", 0), ("node-d", 1)):
+            kube.create(*RES, "resourceslices", {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceSlice",
+                "metadata": {"name": f"chips-{node}"},
+                "spec": {
+                    "driver": "tpu.dra.dev", "nodeName": node,
+                    "pool": {"name": node, "generation": 1,
+                             "resourceSliceCount": 1},
+                    "devices": [chip_device(
+                        "chip-0", 0, 0, workerId={"int": wid})],
+                },
+            })
+        make_cd(kube, num_nodes=2)
+        controller = ComputeDomainController(kube)
+        try:
+            controller.reconcile(
+                kube.get(API_GROUP, API_VERSION, "computedomains",
+                         "cd1", "default"))
+        finally:
+            controller.queue.shutdown(wait=False)
+        got = kube.get(API_GROUP, API_VERSION, "computedomains", "cd1",
+                       "default")
+        assert PREFERRED_NODES_ANNOTATION not in (
+            got["metadata"].get("annotations") or {})
+
+    def test_scheduler_prefers_the_window(self, kube):
+        for node in ("node-a", "node-b", "node-c"):
+            publish_channel_slice(kube, node)
+        cd = make_cd(kube, annotations={
+            PREFERRED_NODES_ANNOTATION: "node-b,node-c"})
+        channel_claim(kube, "gang-0", cd["metadata"]["uid"])
+        channel_claim(kube, "gang-1", cd["metadata"]["uid"])
+        DraScheduler(kube, gates=FeatureGates()).sync_once()
+        nodes = set()
+        for name in ("gang-0", "gang-1"):
+            claim = kube.get(*RES, "resourceclaims", name, "default")
+            alloc = claim["status"]["allocation"]
+            for term in alloc["nodeSelector"]["nodeSelectorTerms"]:
+                for mf in term["matchFields"]:
+                    nodes.add(mf["values"][0])
+        # Both members in the ICI-adjacent window, spread over it --
+        # node-a (name-sorts first, equally empty) must lose.
+        assert nodes == {"node-b", "node-c"}, nodes
+
+    def test_gate_off_ignores_the_window(self, kube):
+        for node in ("node-a", "node-b"):
+            publish_channel_slice(kube, node)
+        cd = make_cd(kube, annotations={
+            PREFERRED_NODES_ANNOTATION: "node-b"})
+        channel_claim(kube, "solo", cd["metadata"]["uid"])
+        gates = FeatureGates({"TopologyAwarePlacement": False})
+        DraScheduler(kube, gates=gates).sync_once()
+        claim = kube.get(*RES, "resourceclaims", "solo", "default")
+        term = claim["status"]["allocation"]["nodeSelector"][
+            "nodeSelectorTerms"][0]
+        assert term["matchFields"][0]["values"] == ["node-a"]
