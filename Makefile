@@ -3,7 +3,7 @@
 PYTHON ?= python
 
 .PHONY: all native test test-fast bench bench-smoke \
-	bench-placement-smoke lint clean stamp-version
+	bench-placement-smoke lint lint-analysis clean stamp-version
 
 VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
 
@@ -56,6 +56,18 @@ bench-placement-smoke:
 
 lint:
 	ruff check --select E9,F k8s_dra_driver_gpu_tpu/ tests/ bench.py __graft_entry__.py
+
+# Concurrency invariant analyzer (pkg/analysis): lock-hierarchy lint,
+# informer-cache discipline, checkpoint state-machine wiring. Fails on
+# any non-baselined TPUDRA finding; writes the Prometheus-text summary
+# (tpu_dra_lint_findings_total by rule) BASELINE.md tracks across PRs.
+# Mirrored as a tier-1 test in tests/test_analysis_lint.py. See
+# docs/analysis.md for rule IDs and the suppression format.
+lint-analysis:
+	$(PYTHON) -m k8s_dra_driver_gpu_tpu.pkg.analysis \
+	    k8s_dra_driver_gpu_tpu \
+	    --baseline analysis-baseline.json \
+	    --metrics-out analysis-metrics.prom
 
 clean:
 	$(MAKE) -C k8s_dra_driver_gpu_tpu/tpulib/native clean

@@ -696,6 +696,30 @@ def bench_placement_sim() -> dict:
     }
 
 
+def bench_lint_findings() -> dict:
+    """Static-analysis finding counts (pkg/analysis linter) in the
+    metrics-friendly shape BASELINE.md tracks across PRs: the bench/CI
+    run's `tpu_dra_lint_findings_total` by rule ID, plus the total.
+    Baselined findings are counted separately so a growing baseline is
+    as visible as a growing finding count. BENCH_SKIP_LINT=1 skips."""
+    from k8s_dra_driver_gpu_tpu.pkg.analysis.lint import run_lint
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    report = run_lint(
+        [os.path.join(repo, "k8s_dra_driver_gpu_tpu")],
+        baseline=os.path.join(repo, "analysis-baseline.json"),
+        root=repo,
+    )
+    out: dict = {
+        "lint_findings_total": len(report.active),
+        "lint_findings_baselined": len(report.baselined),
+    }
+    for rule, n in sorted(report.counts().items()):
+        if n:
+            out[f"lint_findings_{rule}"] = n
+    return out
+
+
 def main() -> None:
     if "--placement-sim" in sys.argv[1:]:
         print(json.dumps(bench_placement_sim()))
@@ -787,6 +811,11 @@ def main() -> None:
             ar = bench_allreduce_multichip() or bench_allreduce_mock()
             if ar:
                 extras.update(ar)
+    except Exception:  # noqa: BLE001 - secondary metric must not kill bench
+        pass
+    try:
+        if not os.environ.get("BENCH_SKIP_LINT"):
+            extras.update(bench_lint_findings())
     except Exception:  # noqa: BLE001 - secondary metric must not kill bench
         pass
     # Like-for-like ratio: the reference's O(1s) envelope applies to

@@ -15,6 +15,7 @@ import logging
 import threading
 
 from ...api.computedomain import ComputeDomainStatusValue
+from ...pkg import json_copy
 from ...pkg.featuregates import (
     TOPOLOGY_AWARE_PLACEMENT,
     FeatureGateError,
@@ -165,11 +166,17 @@ class ComputeDomainController:
             self._teardown(cd)
             return
         if FINALIZER not in meta.get("finalizers", []):
-            meta.setdefault("finalizers", []).append(FINALIZER)
+            # reconcile() receives shared objects (watch events, test
+            # fixtures, one day an informer cache): never mutate them
+            # in place -- deep-copy, mutate the copy, write that back
+            # (lint TPUDRA006).
+            cd = json_copy(cd)
+            cd["metadata"].setdefault("finalizers", []).append(FINALIZER)
             cd = self.kube.update(
                 API_GROUP, API_VERSION, CD_RESOURCE, meta["name"], cd,
                 namespace=meta.get("namespace", "default"),
             )
+            meta = cd["metadata"]
         self._ensure(build_daemon_rct(cd, self.ns), "resourceclaimtemplates",
                      "resource.k8s.io", "v1", self.ns)
         self._ensure(build_daemon_daemonset(cd, self.ns), "daemonsets",

@@ -34,6 +34,7 @@ from ...kubeletplugin.checkpoint import (
     ClaimState,
 )
 from ...kubeletplugin.claim import ResourceClaim
+from ...pkg.analysis.statemachine import SINGLE_PHASE_POLICY
 from ...pkg.kubeclient import NotFoundError
 from ...pkg.timing import SegmentTimer
 from ...pkg.workqueue import PermanentError
@@ -75,7 +76,13 @@ class CDDeviceState:
         self.clique_id = clique_id
         self.ns = driver_namespace
         self._lock = threading.Lock()
-        self._checkpoint = CheckpointManager(root, boot_id=boot_id)
+        # CD prepares mutate no device state, so the lifecycle is
+        # single-phase: absent -> PrepareCompleted -> absent. The
+        # runtime validator makes a PrepareStarted in a CD checkpoint
+        # (someone porting two-phase code here) fail loudly.
+        self._checkpoint = CheckpointManager(
+            root, boot_id=boot_id,
+            transition_policy=SINGLE_PHASE_POLICY)
         self._cdi = CDIHandler(cdi_root=cdi_root or os.path.join(root, "cdi"))
         # ComputeDomains are read through an informer cache: Prepare sits
         # in a retry loop for up to 45s, and a full list() per attempt

@@ -27,7 +27,8 @@ from dataclasses import dataclass, field
 from enum import Enum
 
 from ..pkg import bootid
-from ..pkg.flock import Flock
+from ..pkg.analysis.statemachine import TransitionPolicy
+from ..pkg.flock import Flock, FlockReentrantError
 from ..pkg.fsutil import stat_signature
 
 logger = logging.getLogger(__name__)
@@ -245,10 +246,17 @@ class CheckpointManager:
 
     FILENAME = "checkpoint.json"
 
-    def __init__(self, root: str, boot_id: str | None = None):
+    def __init__(self, root: str, boot_id: str | None = None,
+                 transition_policy: TransitionPolicy | None = None):
         os.makedirs(root, exist_ok=True)
         self._path = os.path.join(root, self.FILENAME)
         self._lock = Flock(os.path.join(root, "checkpoint.lock"))
+        # Checkpoint state-machine runtime validator
+        # (pkg/analysis/statemachine.py): every committed mutation's
+        # per-claim state change must be a legal lifecycle transition,
+        # or the batch fails and the cache is poisoned. None = legacy
+        # unvalidated (tests exercising corruption paths).
+        self.transition_policy = transition_policy
         self._boot_id = (
             boot_id if boot_id is not None else bootid.read_boot_id()
         )
@@ -258,10 +266,15 @@ class CheckpointManager:
         self._sig: tuple[int, int, int] | None = None
         self._frags_v1: dict[str, str] = {}
         self._frags_v2: dict[str, str] = {}
-        # Group-commit state, guarded by self._cond.
+        # Group-commit state, guarded by self._cond. _flusher_thread is
+        # the flusher's thread ident: only the flusher itself can ever
+        # match its own ident, so the unlocked read in _submit is
+        # race-free for the re-entrancy check (same argument as
+        # Flock._owner).
         self._cond = threading.Condition()
         self._pending: list[_Commit] = []
         self._flusher_active = False
+        self._flusher_thread: int | None = None
 
         self.invalidated_on_boot = False
         with self._lock.acquire(timeout=10.0):
@@ -393,6 +406,18 @@ class CheckpointManager:
     # -- group commit ---------------------------------------------------------
 
     def _submit(self, fn, dirty_uids, timer=None) -> None:
+        # A mutation fn calling back into update()/update_claim() would
+        # park the flusher on its own queue: _flusher_active stays set,
+        # so the nested commit's wait loop can never be satisfied -- an
+        # unbounded 1s-poll stall that reads like fsync trouble. Fail
+        # fast and name the bug, exactly like Flock re-entrancy
+        # (surfaced by the interleaving explorer work, ISSUE 3).
+        if self._flusher_thread == threading.get_ident():
+            raise FlockReentrantError(
+                f"checkpoint commit on {self._path} re-entered from "
+                "inside its own mutation fn; commit fns must not call "
+                "update()/update_claim()/get()"
+            )
         t0 = time.monotonic()
         commit = _Commit(fn, dirty_uids)
         try:
@@ -409,6 +434,7 @@ class CheckpointManager:
                         self._cond.wait(timeout=1.0)
                         continue
                     self._flusher_active = True
+                    self._flusher_thread = threading.get_ident()
                     batch = self._pending
                     self._pending = []
                 self._flush(batch)
@@ -421,6 +447,25 @@ class CheckpointManager:
                 timer.segments["ckpt_fsync_wait"] = timer.segments.get(
                     "ckpt_fsync_wait", 0.0) + (time.monotonic() - t0)
 
+    def _apply_one_locked(self, cp: Checkpoint, fn, dirty_uids) -> None:
+        """Apply one mutation to the in-memory checkpoint (under the
+        flock) and validate its claim-state transitions against the
+        declared policy. Shared by the group-commit flusher and the
+        interleaving explorer's deterministic commit path."""
+        policy = self.transition_policy
+        old_states = (
+            {uid: c.state for uid, c in cp.claims.items()}
+            if policy is not None else None
+        )
+        fn(cp)
+        if policy is not None:
+            policy.validate_states(
+                old_states,
+                {uid: c.state for uid, c in cp.claims.items()},
+                scope=dirty_uids,
+            )
+        self._invalidate_frags(dirty_uids)
+
     def _flush(self, batch: list["_Commit"]) -> None:
         err: BaseException | None = None
         try:
@@ -428,8 +473,7 @@ class CheckpointManager:
                 try:
                     cp = self._read_locked()
                     for commit in batch:
-                        commit.fn(cp)
-                        self._invalidate_frags(commit.dirty)
+                        self._apply_one_locked(cp, commit.fn, commit.dirty)
                     self._write_locked(cp)
                 except BaseException:
                     # The cached Checkpoint may hold the batch's partial
@@ -443,6 +487,7 @@ class CheckpointManager:
             err = e
         with self._cond:
             self._flusher_active = False
+            self._flusher_thread = None
             # Per-commit outcome: only the commits whose mutations were
             # in THIS failed batch see the error; a commit that already
             # flushed durably can never be failed retroactively by a

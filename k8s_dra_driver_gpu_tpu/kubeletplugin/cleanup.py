@@ -13,6 +13,7 @@ import logging
 import threading
 
 from ..pkg.kubeclient import NotFoundError
+from .checkpoint import ClaimState
 
 logger = logging.getLogger(__name__)
 
@@ -70,7 +71,7 @@ class CheckpointCleanupManager:
         if not claim.namespace or not claim.name:
             # No identity recorded (crashed before v2 fields landed):
             # only PrepareStarted leftovers are safe to reap.
-            return claim.state == "PrepareStarted"
+            return claim.state == ClaimState.PREPARE_STARTED.value
         try:
             obj = self._kube.get(
                 "resource.k8s.io", "v1", "resourceclaims",

@@ -36,6 +36,7 @@ from ..pkg.featuregates import (
     TIME_SLICING_SETTINGS,
     FeatureGates,
 )
+from ..pkg.analysis.statemachine import TWO_PHASE_POLICY
 from ..pkg.flock import Flock
 from ..pkg.fsutil import write_json_atomic
 from ..pkg.timing import SegmentTimer
@@ -306,7 +307,12 @@ class DeviceState:
             registry=VfioRegistry(config.root),
         )
         self.allocatable = self._enumerate_allocatable()
-        self._checkpoint = CheckpointManager(config.root, boot_id=config.boot_id)
+        # Two-phase lifecycle enforced at commit time: absent ->
+        # PrepareStarted -> PrepareCompleted -> absent (statemachine
+        # runtime validator; lint TPUDRA007 keeps this wired).
+        self._checkpoint = CheckpointManager(
+            config.root, boot_id=config.boot_id,
+            transition_policy=TWO_PHASE_POLICY)
         self._registry = SubSliceRegistry(config.root)
         self._cdi = CDIHandler(
             cdi_root=config.cdi_root or os.path.join(config.root, "cdi")

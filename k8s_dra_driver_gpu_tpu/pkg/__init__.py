@@ -2,8 +2,19 @@
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+
+
+def json_copy(obj):
+    """Deep copy of a JSON-shaped API object.
+
+    THE sanctioned way to take a mutable copy of anything read from a
+    kube client, an informer cache, or a watch event before changing it
+    (the client-go "never mutate cache objects" rule; enforced by lint
+    rule TPUDRA006, pkg/analysis/lint.py)."""
+    return json.loads(json.dumps(obj))
 
 
 def positive_float_env(var: str, default: float, floor: float) -> float:

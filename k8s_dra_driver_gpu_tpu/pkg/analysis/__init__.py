@@ -1,0 +1,36 @@
+"""Concurrency invariant analysis for the TPU DRA driver.
+
+The locking hierarchy and checkpoint state machine that PR 1/PR 2
+introduced (docs/architecture.md "Locking hierarchy") live here as
+*checked* artifacts instead of prose:
+
+- ``lint``: an AST-based lock-hierarchy linter (rule IDs TPUDRA001..)
+  with a committed baseline-suppression file -- the ``go vet`` analog
+  the Go reference gets for free.
+- ``interleave``: a deterministic interleaving explorer -- a controlled
+  scheduler with virtual locks that exhaustively (or seeded-randomly)
+  permutes thread schedules over the prepare/unprepare pipeline and
+  asserts checkpoint consistency after every one (the targeted
+  ``-race`` analog).
+- ``statemachine``: the declarative model of legal checkpoint claim
+  transitions plus the runtime validator CheckpointManager enforces on
+  every group-committed mutation.
+
+Run the linter: ``python -m k8s_dra_driver_gpu_tpu.pkg.analysis`` (or
+``make lint-analysis``). See docs/analysis.md.
+
+Only the (dependency-free) state-machine model is re-exported here:
+``kubeletplugin/checkpoint.py`` imports through this package on the
+PRODUCTION path, so the dev-tooling modules (``lint``, ``interleave``)
+must be imported explicitly by their consumers -- an import-time bug in
+the linter must never be able to take down a node plugin.
+"""
+
+from __future__ import annotations
+
+from .statemachine import (  # noqa: F401
+    CheckpointTransitionError,
+    SINGLE_PHASE_POLICY,
+    TWO_PHASE_POLICY,
+    TransitionPolicy,
+)

@@ -1,0 +1,99 @@
+"""CLI runner: ``python -m k8s_dra_driver_gpu_tpu.pkg.analysis``.
+
+Exit status is 0 when every finding is baselined (or none exist), 1
+otherwise -- the ``make lint-analysis`` / CI contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .lint import RULES, Baseline, metrics_exposition, run_lint
+
+DEFAULT_BASELINE = "analysis-baseline.json"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu-dra-analysis",
+        description="Concurrency invariant linter (lock hierarchy, "
+                    "checkpoint state machine, informer-cache "
+                    "discipline). Rule IDs TPUDRA001..; see "
+                    "docs/analysis.md.",
+    )
+    p.add_argument("paths", nargs="*", default=["k8s_dra_driver_gpu_tpu"],
+                   help="files/directories to lint "
+                        "(default: k8s_dra_driver_gpu_tpu)")
+    p.add_argument("--root", default=".",
+                   help="path root for fingerprints (default: cwd)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help=f"baseline suppression file "
+                        f"(default: {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline file entirely")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write every current finding into the baseline "
+                        "and exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout (for "
+                        "dashboard ingestion)")
+    p.add_argument("--metrics-out", default=None, metavar="FILE",
+                   help="write a Prometheus text summary "
+                        "(tpu_dra_lint_findings_total by rule) to FILE")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    paths = args.paths or ["k8s_dra_driver_gpu_tpu"]
+    baseline = None if args.no_baseline else Baseline.load(args.baseline)
+    report = run_lint(paths, baseline=baseline,
+                      root=os.path.abspath(args.root))
+
+    if args.update_baseline:
+        # REBUILD from the current findings (keeping reasons for the
+        # survivors) rather than merging: a stale fingerprint left
+        # behind would silently re-suppress the same-shaped defect if
+        # it is ever reintroduced.
+        old = baseline.suppressions if baseline else {}
+        bl = Baseline(path=args.baseline)
+        for f in report.findings:
+            bl.suppressions[f.fingerprint] = old.get(
+                f.fingerprint, "baselined finding")
+        pruned = len(set(old) - set(bl.suppressions))
+        bl.save(args.baseline)
+        print(f"baseline updated: {len(bl.suppressions)} suppression(s)"
+              f" ({pruned} stale pruned) -> {args.baseline}")
+        return 0
+
+    if args.metrics_out:
+        with open(args.metrics_out, "w", encoding="utf-8") as f:
+            f.write(metrics_exposition(report))
+
+    if args.as_json:
+        json.dump(report.to_dict(), sys.stdout, indent=1)
+        print()
+    else:
+        for f in report.findings:
+            print(f)
+        counts = report.counts()
+        total = sum(counts.values())
+        print(f"{report.files_scanned} file(s) scanned; {total} "
+              f"non-baselined finding(s), {len(report.baselined)} "
+              "baselined")
+        if total:
+            for rule, n in sorted(counts.items()):
+                if n:
+                    print(f"  {rule}: {n}  ({RULES[rule]})")
+    return 1 if report.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,552 @@
+"""Deterministic interleaving explorer -- a targeted ``-race`` analog.
+
+A ``ControlledScheduler`` runs N worker threads ONE at a time: every
+instrumented operation (virtual lock acquire, explicit yield point)
+hands control back to the scheduler, which picks the next thread to run
+from the currently-runnable set. The sequence of picks IS the schedule;
+``explore()`` enumerates schedules depth-first (exhaustive on small
+state spaces, bounded otherwise) and ``explore_random()`` samples them
+with a seeded RNG. After every complete schedule an invariant callback
+inspects the end state -- a schedule that violates it is returned with
+its full decision trace, i.e. a deterministic reproducer.
+
+Locks are **virtual**: the scheduler tracks ownership and wait queues
+itself, so a "blocked" thread never blocks a real OS thread -- which is
+what lets the scheduler (a) suspend threads at arbitrary points without
+deadlocking the harness and (b) detect true deadlocks (no runnable
+thread, not all done) as findings instead of hangs.
+
+``instrument_device_state`` wires the real prepare/unprepare pipeline
+into the scheduler: ``Flock`` acquire/release, ``ShardedLocks.hold``,
+``DeviceState._lock`` and the ``CheckpointManager`` commit point all
+become virtual-lock choice points, so the explorer permutes exactly the
+interleavings the locking hierarchy (docs/architecture.md) claims to
+make safe.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from ..flock import Flock, FlockReentrantError
+
+_RUNNABLE = "runnable"
+_BLOCKED = "blocked"
+_DONE = "done"
+
+# Scheduler <-> worker handoff bound. Generous: a worker doing real
+# file I/O between yield points finishes in microseconds; hitting this
+# means a worker blocked on something the harness does not control
+# (an uninstrumented real lock) -- a harness bug worth a loud error.
+_HANDOFF_TIMEOUT_S = 30.0
+
+
+class DeadlockError(Exception):
+    """No runnable thread while some are still blocked: the schedule
+    drove the system into a true deadlock. Carries who-waits-on-what."""
+
+
+class HarnessStallError(Exception):
+    """A worker failed to return control: it blocked on something
+    uninstrumented. Fix the instrumentation, not the schedule."""
+
+
+class _ScheduleAborted(BaseException):
+    """Internal: unwinds workers parked at a choice point when their
+    schedule ends abnormally (deadlock, stall, step cap) so failed
+    schedules do not leak a thread each. BaseException on purpose --
+    worker code's ``except Exception`` must not swallow the unwind."""
+
+
+class _Worker:
+    __slots__ = ("name", "fn", "thread", "event", "state", "waiting_on",
+                 "exc", "started", "aborted")
+
+    def __init__(self, name: str, fn):
+        self.name = name
+        self.fn = fn
+        self.thread: threading.Thread | None = None
+        self.event = threading.Event()
+        self.state = _RUNNABLE
+        self.waiting_on = None
+        self.exc: BaseException | None = None
+        self.started = False
+        self.aborted = False
+
+
+class _VLock:
+    __slots__ = ("owner", "waiters", "reentrant_error")
+
+    def __init__(self, reentrant_error: bool = True):
+        self.owner: _Worker | None = None
+        self.waiters: list[_Worker] = []
+        self.reentrant_error = reentrant_error
+
+
+class VirtualLock:
+    """threading.Lock-shaped adapter over a scheduler-managed lock, so
+    instrumented code can swap a real mutex for a virtual one."""
+
+    def __init__(self, sched: "ControlledScheduler", lock_id):
+        self._sched = sched
+        self._id = lock_id
+
+    def acquire(self, timeout: float | None = None, blocking: bool = True):
+        self._sched.lock_acquire(self._id)
+        return True
+
+    def release(self) -> None:
+        self._sched.lock_release(self._id)
+
+    def __enter__(self) -> "VirtualLock":
+        self.acquire()  # lock adapter implementation; tpudra: allow=TPUDRA002
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class Chooser:
+    """Base chooser: always the first runnable thread."""
+
+    def choose(self, n_options: int) -> int:
+        return 0
+
+
+class ReplayChooser(Chooser):
+    """Replays a recorded prefix, then picks option 0 -- the DFS
+    workhorse."""
+
+    def __init__(self, prefix: list[int]):
+        self.prefix = list(prefix)
+        self._pos = 0
+
+    def choose(self, n_options: int) -> int:
+        if self._pos < len(self.prefix):
+            pick = self.prefix[self._pos]
+            self._pos += 1
+            return min(pick, n_options - 1)
+        return 0
+
+
+class RandomChooser(Chooser):
+    def __init__(self, rng: random.Random):
+        self.rng = rng
+
+    def choose(self, n_options: int) -> int:
+        return self.rng.randrange(n_options)
+
+
+class ControlledScheduler:
+    def __init__(self, chooser: Chooser | None = None):
+        self._chooser = chooser or Chooser()
+        self._workers: list[_Worker] = []
+        self._by_ident: dict[int, _Worker] = {}
+        self._locks: dict = {}
+        self._wake = threading.Event()
+        self._started = False
+        #: [(n_options, chosen_index)] -- the schedule's identity.
+        self.choice_log: list[tuple[int, int]] = []
+        #: [(worker name, label)] -- human-readable decision trace.
+        self.trace: list[tuple[str, str]] = []
+
+    # -- driver side ----------------------------------------------------------
+
+    def spawn(self, fn, name: str | None = None) -> None:
+        if self._started:
+            raise RuntimeError("spawn() after run() started")
+        self._workers.append(_Worker(name or f"t{len(self._workers)}", fn))
+
+    def run(self, max_steps: int = 100_000) -> "ControlledScheduler":
+        """Drive all workers to completion under one schedule."""
+        self._started = True
+        for w in self._workers:
+            w.thread = threading.Thread(
+                target=self._worker_main, args=(w,), name=w.name,
+                daemon=True,
+            )
+            w.thread.start()
+        steps = 0
+        while True:
+            runnable = [w for w in self._workers if w.state == _RUNNABLE]
+            if not runnable:
+                blocked = [w for w in self._workers if w.state == _BLOCKED]
+                if not blocked:
+                    break  # all done
+                msg = (
+                    "deadlock: "
+                    + "; ".join(
+                        f"{w.name} waits on {w.waiting_on!r}"
+                        for w in blocked
+                    )
+                    + " | held: "
+                    + ", ".join(
+                        f"{lid!r} by {vl.owner.name}"
+                        for lid, vl in self._locks.items()
+                        if vl.owner is not None
+                    )
+                )
+                self._abort_parked()
+                raise DeadlockError(msg)
+            steps += 1
+            if steps > max_steps:
+                self._abort_parked()
+                raise HarnessStallError(
+                    f"schedule exceeded {max_steps} steps"
+                )
+            idx = self._chooser.choose(len(runnable))
+            idx = max(0, min(idx, len(runnable) - 1))
+            self.choice_log.append((len(runnable), idx))
+            worker = runnable[idx]
+            self._wake.clear()
+            worker.event.set()
+            if not self._wake.wait(timeout=_HANDOFF_TIMEOUT_S):
+                self._abort_parked()
+                raise HarnessStallError(
+                    f"worker {worker.name} did not return control "
+                    f"within {_HANDOFF_TIMEOUT_S}s (blocked on an "
+                    "uninstrumented primitive?)"
+                )
+        return self
+
+    def _abort_parked(self) -> None:
+        """Unwind every not-yet-done worker before an abnormal schedule
+        end: without this each deadlocking schedule would leak its
+        blocked threads parked on worker.event.wait() forever -- a DFS
+        that finds hundreds of deadlocks (the tool's purpose) would
+        drown the process in stuck daemon threads."""
+        for w in self._workers:
+            if w.state != _DONE:
+                w.aborted = True
+                w.event.set()
+
+    @property
+    def errors(self) -> list[BaseException]:
+        return [w.exc for w in self._workers if w.exc is not None]
+
+    @property
+    def choices(self) -> list[int]:
+        return [c for _, c in self.choice_log]
+
+    # -- worker side ----------------------------------------------------------
+
+    def _worker_main(self, worker: _Worker) -> None:
+        self._by_ident[threading.get_ident()] = worker
+        worker.event.wait()
+        worker.event.clear()
+        try:
+            if worker.aborted:
+                raise _ScheduleAborted
+            worker.fn()
+        except _ScheduleAborted:
+            pass  # harness unwind, not a workload error
+        except BaseException as e:  # noqa: BLE001 - reported to driver
+            worker.exc = e
+        finally:
+            # Release anything the worker still owns so one failed
+            # thread doesn't wedge the rest of the schedule.
+            for vl in self._locks.values():
+                if vl.owner is worker:
+                    vl.owner = None
+                    for w in vl.waiters:
+                        w.state = _RUNNABLE
+                    vl.waiters.clear()
+            worker.state = _DONE
+            self._wake.set()
+
+    def _current(self) -> _Worker | None:
+        return self._by_ident.get(threading.get_ident())
+
+    def _pause(self, worker: _Worker, label: str) -> None:
+        self.trace.append((worker.name, label))
+        self._wake.set()
+        worker.event.wait()
+        worker.event.clear()
+        if worker.aborted:
+            raise _ScheduleAborted
+
+    def yield_point(self, label: str = "") -> None:
+        """A schedule choice point. No-op from uninstrumented threads,
+        so instrumented library code stays usable outside the
+        explorer."""
+        worker = self._current()
+        if worker is not None:
+            self._pause(worker, label or "yield")
+
+    def lock_acquire(self, lock_id, reentrant_error: bool = True) -> None:
+        worker = self._current()
+        if worker is None:
+            return  # uninstrumented thread: scheduler not in control
+        self._pause(worker, f"acquire {lock_id!r}")
+        vl = self._locks.setdefault(lock_id, _VLock(reentrant_error))
+        if vl.owner is worker:
+            if vl.reentrant_error:
+                raise FlockReentrantError(
+                    f"{worker.name} re-acquired virtual lock {lock_id!r}"
+                )
+            return
+        while vl.owner is not None:
+            worker.state = _BLOCKED
+            worker.waiting_on = lock_id
+            vl.waiters.append(worker)
+            self._pause(worker, f"blocked {lock_id!r}")
+            # Woken: we are runnable again; the lock may have been
+            # re-taken by a thread scheduled before us -- re-check.
+        worker.waiting_on = None
+        vl.owner = worker
+
+    def lock_release(self, lock_id) -> None:
+        worker = self._current()
+        if worker is None:
+            return
+        vl = self._locks.get(lock_id)
+        if vl is None or vl.owner is not worker:
+            return  # release of a lock taken outside scheduler control
+        vl.owner = None
+        for w in vl.waiters:
+            w.state = _RUNNABLE
+        vl.waiters.clear()
+
+
+# -- exploration --------------------------------------------------------------
+
+
+@dataclass
+class ScheduleFailure:
+    choices: list[int]
+    error: BaseException
+    trace: list[tuple[str, str]]
+
+    def __str__(self) -> str:
+        steps = " -> ".join(f"{n}:{lbl}" for n, lbl in self.trace)
+        return (f"schedule {self.choices} failed: "
+                f"{type(self.error).__name__}: {self.error}\n  {steps}")
+
+
+@dataclass
+class ExplorationResult:
+    schedules_run: int = 0
+    failures: list[ScheduleFailure] = field(default_factory=list)
+    #: True when the DFS drained every branch: the run was exhaustive.
+    exhausted: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+
+def _run_one(build, invariant, chooser, cleanup=None) -> tuple[
+        ControlledScheduler, BaseException | None]:
+    sched = ControlledScheduler(chooser)
+    build(sched)
+    err: BaseException | None = None
+    try:
+        sched.run()
+        if sched.errors:
+            err = sched.errors[0]
+    except (DeadlockError, AssertionError, HarnessStallError) as e:
+        err = e
+    finally:
+        # Cleanup runs after EVERY schedule (also failed ones): it is
+        # where instrumentation contexts unpatch, so one bad schedule
+        # cannot leak monkeypatches into the next.
+        if cleanup is not None:
+            try:
+                cleanup(sched)
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                err = err or e
+    if err is None and invariant is not None:
+        try:
+            invariant(sched)
+        except Exception as e:  # noqa: BLE001 - any violation is a finding
+            # Not just AssertionError: the worst violations surface as
+            # e.g. CheckpointCorruptError from re-parsing the file --
+            # they must become ScheduleFailures with a reproducer, not
+            # abort the whole exploration.
+            err = e
+    return sched, err
+
+
+def explore(build, invariant=None, max_schedules: int = 1000,
+            stop_at_first_failure: bool = False,
+            cleanup=None) -> ExplorationResult:
+    """Depth-first systematic exploration.
+
+    ``build(sched)`` spawns the worker threads (fresh state per
+    schedule!); ``invariant(sched)`` raises AssertionError on a
+    violated end-state; ``cleanup(sched)`` always runs after each
+    schedule (unpatch instrumentation there). Worker exceptions and
+    deadlocks count as failures too (workers that EXPECT errors must
+    catch them and fold the outcome into state the invariant judges).
+    """
+    result = ExplorationResult()
+    pending: list[list[int]] = [[]]
+    seen: set[tuple[int, ...]] = set()
+    while pending and result.schedules_run < max_schedules:
+        prefix = pending.pop()
+        sched, err = _run_one(build, invariant, ReplayChooser(prefix),
+                              cleanup)
+        result.schedules_run += 1
+        if err is not None:
+            result.failures.append(ScheduleFailure(
+                choices=sched.choices, error=err, trace=sched.trace))
+            if stop_at_first_failure:
+                return result
+        # Enqueue every unexplored sibling at/beyond the replayed
+        # prefix (standard stateless-model-checking DFS frontier).
+        log = sched.choice_log
+        for pos in range(len(prefix), len(log)):
+            n_options, chosen = log[pos]
+            for alt in range(n_options):
+                if alt == chosen:
+                    continue
+                branch = [c for _, c in log[:pos]] + [alt]
+                key = tuple(branch)
+                if key not in seen:
+                    seen.add(key)
+                    pending.append(branch)
+    result.exhausted = not pending
+    return result
+
+
+def explore_random(build, invariant=None, schedules: int = 100,
+                   seed: int = 0, cleanup=None) -> ExplorationResult:
+    """Seeded-random schedule sampling -- the cheap wide net for state
+    spaces too big to exhaust."""
+    result = ExplorationResult()
+    rng = random.Random(seed)
+    for _ in range(schedules):
+        sched, err = _run_one(build, invariant, RandomChooser(rng),
+                              cleanup)
+        result.schedules_run += 1
+        if err is not None:
+            result.failures.append(ScheduleFailure(
+                choices=sched.choices, error=err, trace=sched.trace))
+    return result
+
+
+# -- DeviceState instrumentation ----------------------------------------------
+
+
+class _VFlockGuard:
+    __slots__ = ("_sched", "_id", "_flock")
+
+    def __init__(self, sched, lock_id, flock):
+        self._sched = sched
+        self._id = lock_id
+        self._flock = flock
+
+    def __enter__(self):
+        return self._flock
+
+    def __exit__(self, *exc) -> None:
+        self._sched.lock_release(self._id)
+
+
+@contextmanager
+def instrument_device_state(sched: ControlledScheduler, state,
+                            fast_io: bool = True):
+    """Route every lock in a DeviceState's prepare/unprepare pipeline
+    through ``sched``'s virtual locks, and make the checkpoint commit
+    point a deterministic choice point.
+
+    - ``Flock.acquire/release`` (class-wide, keyed by lock-file path):
+      covers the reservation ``pu.lock``, the checkpoint flock, and the
+      sub-slice registry flock. Re-entrant virtual acquisition raises
+      the real ``FlockReentrantError``, preserving fail-fast fidelity.
+    - ``state._lock`` / ``ShardedLocks.hold``: virtual mutex / sorted
+      virtual shard set.
+    - ``CheckpointManager._submit``: the group-commit condition-variable
+      machinery is inherently timing-driven, so under the explorer each
+      commit applies directly under the (virtual) checkpoint flock --
+      same mutation + durability semantics, deterministic schedule.
+    - ``fast_io``: stubs ``os.fsync``/``os.fdatasync`` for the duration
+      of the context -- PROCESS-WIDE, unlike the lock hooks below;
+      consistency is judged by re-parsing the file, not by crash
+      durability. Leave it off if anything else in the process needs
+      real durability while the exploration runs.
+
+    The lock/commit hooks only affect threads spawned on ``sched``:
+    from uninstrumented threads every hook falls through to the
+    original implementation.
+    """
+    import os as _os
+
+    orig_acquire = Flock.acquire
+    orig_release = Flock.release
+
+    def v_acquire(self, timeout: float = 10.0, poll_interval: float = 0.01,
+                  cancel=None):
+        if sched._current() is None:
+            return orig_acquire(self, timeout=timeout,
+                                poll_interval=poll_interval, cancel=cancel)
+        lock_id = ("flock", self._path)
+        sched.lock_acquire(lock_id)  # raises FlockReentrantError on re-entry
+        return _VFlockGuard(sched, lock_id, self)
+
+    def v_release(self) -> None:
+        if sched._current() is None:
+            return orig_release(self)
+        sched.lock_release(("flock", self._path))
+
+    checkpoint = state._checkpoint
+    orig_submit = type(checkpoint)._submit
+
+    def v_submit(self, fn, dirty_uids, timer=None):
+        if sched._current() is None:
+            return orig_submit(self, fn, dirty_uids, timer=timer)
+        with self._lock.acquire(timeout=10.0):  # virtual via v_acquire
+            try:
+                cp = self._read_locked()
+                self._apply_one_locked(cp, fn, dirty_uids)
+                self._write_locked(cp)
+            except BaseException:
+                self._cp = None
+                self._sig = None
+                self._invalidate_frags(None)
+                raise
+
+    shards = state._shards
+    orig_hold = type(shards).hold
+
+    @contextmanager
+    def v_hold(self, shard_ids, timer=None):
+        if sched._current() is None:
+            with orig_hold(self, shard_ids, timer):
+                yield
+            return
+        ordered = sorted(set(shard_ids))
+        taken = []
+        try:
+            for s in ordered:
+                sched.lock_acquire(("shard", s), reentrant_error=False)
+                taken.append(s)
+            yield
+        finally:
+            for s in reversed(taken):
+                sched.lock_release(("shard", s))
+
+    orig_state_lock = state._lock
+    orig_fsync = _os.fsync
+    orig_fdatasync = _os.fdatasync
+    try:
+        Flock.acquire = v_acquire
+        Flock.release = v_release
+        type(checkpoint)._submit = v_submit
+        type(shards).hold = v_hold
+        state._lock = VirtualLock(sched, ("mutex", "device_state"))
+        if fast_io:
+            _os.fsync = lambda fd: None
+            _os.fdatasync = lambda fd: None
+        yield sched
+    finally:
+        Flock.acquire = orig_acquire
+        Flock.release = orig_release
+        type(checkpoint)._submit = orig_submit
+        type(shards).hold = orig_hold
+        state._lock = orig_state_lock
+        _os.fsync = orig_fsync
+        _os.fdatasync = orig_fdatasync

@@ -1,0 +1,839 @@
+"""AST lock-hierarchy + cache-discipline linter (flake8-style runner).
+
+Encodes the concurrency invariants docs/architecture.md documents but
+nothing previously enforced. Rules carry stable IDs:
+
+- **TPUDRA001** lock-hierarchy order: acquiring an outer (lower-level)
+  lock while a narrower one is held. The documented order is
+  1. global reservation (``pu_lock`` flock) -> 2. per-chip shard locks
+  (``ShardedLocks.hold``) -> 3. checkpoint group commit
+  (``CheckpointManager`` calls). Taking level 1 inside level 2 (etc.)
+  is the deadlock shape the hierarchy exists to prevent.
+- **TPUDRA002** unguarded lock acquire: a ``.acquire(...)`` whose guard
+  is discarded, or that has no ``.release()``/``__exit__`` reachable
+  from a ``finally`` in the same function. Locks must be held through
+  ``with`` or an explicit try/finally.
+- **TPUDRA003** blocking call under a shard lock or flock: kube API
+  verbs, ``time.sleep``, and subprocess waits inside a
+  ``with <shards>.hold(...)`` / ``with <flock>.acquire(...)`` body
+  park every same-shard claim (and, for the flock, every process on
+  the node) behind one slow RPC.
+- **TPUDRA004** re-entrant flock acquire: lexically re-acquiring a
+  flock already held by the enclosing ``with`` -- guaranteed
+  ``FlockReentrantError`` at runtime.
+- **TPUDRA005** raw claim-state literal: ``"PrepareStarted"`` /
+  ``"PrepareCompleted"`` string literals outside the enum/model
+  definition sites bypass the state machine's single source of truth.
+- **TPUDRA006** cached-API-object mutation: in-place mutation of an
+  object obtained from an informer cache or a kube client (or of an
+  API-object parameter) without a deep copy first -- the client-go
+  "never mutate informer objects" rule.
+- **TPUDRA007** unmodeled checkpoint manager: constructing a
+  ``CheckpointManager`` without an explicit ``transition_policy=``
+  keyword opts the call site out of the checkpoint state-machine
+  validator silently.
+
+Suppression: per-line ``# tpudra: allow=TPUDRA002[,TPUDRA003] reason``
+comments, or the committed baseline file (``analysis-baseline.json``)
+keyed by stable line-number-free fingerprints.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+RULES: dict[str, str] = {
+    "TPUDRA000": "file could not be parsed (syntax error)",
+    "TPUDRA001": "lock acquired out of documented hierarchy order",
+    "TPUDRA002": "lock acquire without with-guard or release in finally",
+    "TPUDRA003": "blocking I/O / kube API call while holding a shard "
+                 "lock or flock",
+    "TPUDRA004": "re-entrant flock acquire (FlockReentrantError at "
+                 "runtime)",
+    "TPUDRA005": "raw claim-state string literal bypasses the "
+                 "ClaimState enum / state-machine model",
+    "TPUDRA006": "in-place mutation of an informer-cached / kube API "
+                 "object without deep copy",
+    "TPUDRA007": "CheckpointManager constructed without an explicit "
+                 "transition_policy",
+}
+
+# Lock model (docs/architecture.md "Locking hierarchy"). Matched on the
+# unparsed base expression of an acquisition.
+_LEVEL_RESERVATION = 1
+_LEVEL_SHARD = 2
+_LEVEL_CHECKPOINT = 3
+
+_KUBE_VERBS = {"get", "list", "patch", "create", "delete", "update",
+               "watch"}
+_CHECKPOINT_CALLS = {"update", "update_claim", "get"}
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+             "clear", "update", "setdefault", "sort", "reverse", "add",
+             "discard"}
+_META_KEYS = {"metadata", "spec", "status"}
+# Files allowed to spell the state literals: the enum definition, the
+# declarative model, and this linter's own rule table.
+_STATE_LITERAL_FILES = {"checkpoint.py", "statemachine.py", "lint.py"}
+_STATE_LITERALS = {"PrepareStarted", "PrepareCompleted"}
+# Copy constructors that launder taint (deep or top-level).
+_COPY_CALLS = {"json_copy", "deepcopy", "dict", "list", "sorted",
+               "json_loads"}
+
+_ALLOW_RE = re.compile(r"#.*?tpudra:\s*allow=([A-Z0-9,\*]+)")
+# Module-wide allow (for server-side fakes that legitimately own and
+# mutate the stored API objects): a comment `tpudra: allow-file=<RULE>`
+# anywhere in the module. (Spelled with <RULE> here so this very
+# comment cannot allow-file the linter itself.)
+_FILE_ALLOW_RE = re.compile(r"#.*?tpudra:\s*allow-file=([A-Z0-9,\*]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    qualname: str
+    message: str
+    key: str
+    baselined: bool = False
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity: survives reformatting, moves with
+        the enclosing function."""
+        return f"{self.rule}:{self.path}:{self.qualname}:{self.key}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "qualname": self.qualname,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+            "baselined": self.baselined,
+        }
+
+    def __str__(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message}{tag}")
+
+
+@dataclass
+class LintReport:
+    findings: list[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def active(self) -> list[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+    @property
+    def baselined(self) -> list[Finding]:
+        return [f for f in self.findings if f.baselined]
+
+    def counts(self, include_baselined: bool = False) -> dict[str, int]:
+        out = {rule: 0 for rule in RULES}
+        for f in (self.findings if include_baselined else self.active):
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "files_scanned": self.files_scanned,
+            "rules": RULES,
+            "counts": self.counts(),
+            "baselined_counts": {
+                rule: n for rule, n in (
+                    (r, sum(1 for f in self.baselined if f.rule == r))
+                    for r in RULES
+                ) if n
+            },
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 - diagnostics only
+        return "<expr>"
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """['self', 'kube', 'list'] for self.kube.list; [] if not a plain
+    name/attribute chain (calls/subscripts break the chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def _root_name(node: ast.AST) -> str | None:
+    """The root variable of an expression chain, looking through
+    attributes, subscripts, and .get()/_meta()-style call wrappers."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                node = node.func.value
+            elif isinstance(node.func, ast.Name) and node.args:
+                # helper(obj) -- derive through the first argument
+                node = node.args[0]
+            else:
+                return None
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return None
+
+
+@dataclass
+class _Held:
+    family: str  # "flock" | "shard"
+    level: int | None
+    key: str  # normalized base-expression source
+    line: int
+
+
+class _FuncState:
+    def __init__(self, qualname: str):
+        self.qualname = qualname
+        self.tainted: set[str] = set()
+        # Base expressions released inside a finally; True = wildcard
+        # (an __exit__ call, which may cover any guard).
+        self.released_in_finally: set[str] = set()
+        self.exit_in_finally = False
+        self.api_params: set[str] = set()
+
+
+class _ModuleLinter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, source: str,
+                 api_helpers: set[str]):
+        self.path = path
+        self.rel = rel
+        self.basename = os.path.basename(rel)
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.scope: list[str] = []
+        self.held: list[_Held] = []
+        self.funcs: list[_FuncState] = []
+        # Same-module helper functions returning kube/informer objects
+        # (pass 1 of the two-pass taint analysis).
+        self.api_helpers = api_helpers
+        self.file_allowed: set[str] = set()
+        for m in _FILE_ALLOW_RE.finditer(source):
+            self.file_allowed.update(m.group(1).split(","))
+        # Local names bound to the DRIVER's CheckpointManager class,
+        # and to its defining MODULE (`from ..kubeletplugin import
+        # checkpoint` -> checkpoint.CheckpointManager(...)); TPUDRA007
+        # scope. orbax's `orbax.checkpoint` never lands in either set.
+        self.checkpoint_manager_aliases: set[str] = set()
+        self.checkpoint_module_aliases: set[str] = set()
+        # Disambiguate same-shaped findings in one function: fingerprint
+        # keys get a #N suffix per repeated (qualname, rule, key).
+        self._key_seen: dict[tuple[str, str, str], int] = {}
+
+    # -- plumbing -------------------------------------------------------------
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self.scope) or "<module>"
+
+    def _allowed(self, line: int, rule: str) -> bool:
+        if rule in self.file_allowed or "*" in self.file_allowed:
+            return True
+        # The allow comment may sit on the finding's line or -- for
+        # lines with no room -- on the (comment-only) line above it.
+        for lineno in (line, line - 1):
+            if not 1 <= lineno <= len(self.lines):
+                continue
+            text = self.lines[lineno - 1]
+            if lineno != line and not text.lstrip().startswith("#"):
+                continue
+            m = _ALLOW_RE.search(text)
+            if m:
+                rules = m.group(1).split(",")
+                if "*" in rules or rule in rules:
+                    return True
+        return False
+
+    def _emit(self, rule: str, node: ast.AST, message: str,
+              key: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if self._allowed(line, rule):
+            return
+        # A second same-shaped finding in the same function gets a
+        # distinct fingerprint (key#2, key#3, ...): one baseline entry
+        # must never blanket-suppress future occurrences.
+        seen_key = (self.qualname, rule, key)
+        n = self._key_seen.get(seen_key, 0) + 1
+        self._key_seen[seen_key] = n
+        if n > 1:
+            key = f"{key}#{n}"
+        self.findings.append(Finding(
+            rule=rule, path=self.rel, line=line,
+            col=getattr(node, "col_offset", 0),
+            qualname=self.qualname, message=message, key=key,
+        ))
+
+    # -- scope handling -------------------------------------------------------
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        module = node.module or ""
+        if module == "checkpoint" or module.endswith(".checkpoint"):
+            for alias in node.names:
+                if alias.name == "CheckpointManager":
+                    self.checkpoint_manager_aliases.add(
+                        alias.asname or alias.name)
+        # `from ..kubeletplugin import checkpoint` (or `from . import
+        # checkpoint` inside kubeletplugin/) binds the MODULE.
+        if module.endswith("kubeletplugin") or (
+                node.level and not module
+                and "kubeletplugin/" in self.rel.replace(os.sep, "/")):
+            for alias in node.names:
+                if alias.name == "checkpoint":
+                    self.checkpoint_module_aliases.add(
+                        alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name.endswith("kubeletplugin.checkpoint") and \
+                    alias.asname:
+                self.checkpoint_module_aliases.add(alias.asname)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    def _visit_func(self, node) -> None:
+        self.scope.append(node.name)
+        fs = _FuncState(self.qualname)
+        fs.api_params = self._api_object_params(node)
+        fs.tainted |= fs.api_params
+        fs.released_in_finally, fs.exit_in_finally = \
+            self._releases_in_finally(node)
+        self.funcs.append(fs)
+        outer_held = self.held
+        self.held = []  # lock regions don't cross function boundaries
+        self.generic_visit(node)
+        self.held = outer_held
+        self.funcs.pop()
+        self.scope.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    @staticmethod
+    def _releases_in_finally(func) -> tuple[set[str], bool]:
+        """Base expressions ``.release()``d in a finally block, plus a
+        wildcard flag for ``__exit__`` calls. Matching the RELEASED
+        lock against the ACQUIRED one is what keeps an unrelated
+        ``b.release()`` from excusing a leaked ``a.acquire()``."""
+        released: set[str] = set()
+        exit_seen = False
+        for node in ast.walk(func):
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call) and isinstance(
+                                sub.func, ast.Attribute):
+                            if sub.func.attr == "release":
+                                released.add(_unparse(sub.func.value))
+                            elif sub.func.attr == "__exit__":
+                                exit_seen = True
+        return released, exit_seen
+
+    @staticmethod
+    def _api_object_params(func) -> set[str]:
+        """Parameters the function treats as k8s API objects: anything
+        it subscripts/.get()s with a metadata/spec/status key."""
+        params = {a.arg for a in func.args.args + func.args.kwonlyargs
+                  if a.arg != "self"}
+        if not params:
+            return set()
+        hits: set[str] = set()
+        for node in ast.walk(func):
+            key = None
+            base = None
+            if isinstance(node, ast.Subscript) and isinstance(
+                    node.slice, ast.Constant):
+                key, base = node.slice.value, node.value
+            elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "get" \
+                    and node.args and isinstance(node.args[0], ast.Constant):
+                key, base = node.args[0].value, node.func.value
+            if key in _META_KEYS and isinstance(base, ast.Name) and \
+                    base.id in params:
+                hits.add(base.id)
+        return hits
+
+    # -- taint helpers (TPUDRA006) -------------------------------------------
+
+    def _fs(self) -> _FuncState | None:
+        return self.funcs[-1] if self.funcs else None
+
+    def _is_api_source(self, node: ast.AST) -> bool:
+        """Does this expression read from a kube client / informer
+        cache / API-object helper?"""
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                chain = _attr_chain(func)
+                base = chain[:-1]
+                verb = func.attr
+                if base and base[-1] == "kube" and verb in ("get", "list"):
+                    return True
+                if any("informer" in part for part in base) and verb in (
+                        "get", "get_by_uid", "list"):
+                    return True
+                if verb in self.api_helpers and base[:1] == ["self"] \
+                        and len(base) == 1:
+                    return True
+            elif isinstance(func, ast.Name) and func.id in self.api_helpers:
+                return True
+        return False
+
+    def _is_tainted(self, node: ast.AST) -> bool:
+        fs = self._fs()
+        if fs is None:
+            return False
+        if self._is_api_source(node):
+            return True
+        root = _root_name(node)
+        return root is not None and root in fs.tainted
+
+    def _is_copy_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            # {**x} / dict literal / comprehension build new containers
+            return isinstance(node, (ast.Dict, ast.DictComp, ast.ListComp,
+                                     ast.SetComp, ast.List, ast.Set,
+                                     ast.BinOp))
+        func = node.func
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if name in _COPY_CALLS:
+            return True
+        # json.loads(json.dumps(x)) spelled out
+        return name == "loads"
+
+    # -- lock model -----------------------------------------------------------
+
+    def _classify_acquisition(self, expr: ast.AST):
+        """(family, level, key) when ``expr`` acquires a lock:
+        ``X.acquire(...)`` (flock-like: guard-returning) or
+        ``X.hold(...)`` (sharded locks)."""
+        if not (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)):
+            return None
+        attr = expr.func.attr
+        base = expr.func.value
+        base_src = _unparse(base)
+        if attr == "hold" and "shard" in base_src:
+            return ("shard", _LEVEL_SHARD, base_src)
+        if attr == "acquire":
+            level = _LEVEL_RESERVATION if base_src.endswith("pu_lock") \
+                else None
+            return ("flock", level, base_src)
+        return None
+
+    def _check_acquisition_order(self, family: str, level: int | None,
+                                 key: str, node: ast.AST) -> None:
+        held_levels = [h.level for h in self.held if h.level is not None]
+        if level is not None and held_levels and level < max(held_levels):
+            inner = max(self.held, key=lambda h: h.level or 0)
+            self._emit(
+                "TPUDRA001", node,
+                f"acquires level-{level} lock {key!r} while holding "
+                f"level-{inner.level} lock {inner.key!r} (line "
+                f"{inner.line}); documented order is reservation -> "
+                "shard -> checkpoint",
+                key=f"{inner.key}>{key}",
+            )
+        if family == "flock":
+            for h in self.held:
+                if h.family == "flock" and h.key == key:
+                    self._emit(
+                        "TPUDRA004", node,
+                        f"re-acquires flock {key!r} already held since "
+                        f"line {h.line}; Flock is not re-entrant "
+                        "(FlockReentrantError at runtime)",
+                        key=key,
+                    )
+
+    # -- visitors -------------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        entered: list[_Held] = []
+        for item in node.items:
+            acq = self._classify_acquisition(item.context_expr)
+            if acq is not None:
+                family, level, key = acq
+                self._check_acquisition_order(family, level, key,
+                                              item.context_expr)
+                held = _Held(family, level, key, node.lineno)
+                self.held.append(held)
+                entered.append(held)
+                # Mark the with-item call visited so visit_Call's bare-
+                # acquire check skips it.
+                item.context_expr._tpudra_with = True  # type: ignore[attr-defined]
+        self.generic_visit(node)
+        for _ in entered:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            base_src = _unparse(func.value)
+
+            # TPUDRA002: acquire outside a with-guard. The release in
+            # the finally must be of the SAME lock expression (or an
+            # __exit__ wildcard) -- an unrelated b.release() must not
+            # excuse a leaked a.acquire().
+            if attr == "acquire" and not getattr(node, "_tpudra_with",
+                                                 False):
+                fs = self._fs()
+                if fs is None or not (
+                        fs.exit_in_finally
+                        or base_src in fs.released_in_finally):
+                    self._emit(
+                        "TPUDRA002", node,
+                        f"{base_src}.acquire(...) without a with-guard "
+                        f"or a {base_src}.release() in a finally block "
+                        f"in {self.qualname}",
+                        key=base_src,
+                    )
+
+            # Out-of-with acquisitions still participate in ordering /
+            # re-entrancy checks (e.g. bare pu_lock.acquire in a shard
+            # region).
+            if attr in ("acquire", "hold") and not getattr(
+                    node, "_tpudra_with", False):
+                acq = self._classify_acquisition(node)
+                if acq is not None:
+                    self._check_acquisition_order(*acq, node)
+
+            # Checkpoint-manager calls are level-3 acquisitions for the
+            # ordering model (they take the checkpoint flock inside).
+            if attr in _CHECKPOINT_CALLS and base_src.endswith("_checkpoint"):
+                self._check_acquisition_order(
+                    "checkpoint", _LEVEL_CHECKPOINT, base_src, node)
+
+            # TPUDRA003: blocking calls under shard lock / flock.
+            if any(h.family in ("flock", "shard") for h in self.held):
+                blocking = None
+                chain = _attr_chain(func)
+                if chain == ["time", "sleep"]:
+                    blocking = "time.sleep"
+                elif chain[:1] == ["subprocess"] and attr in (
+                        "run", "call", "check_call", "check_output"):
+                    blocking = f"subprocess.{attr}"
+                elif attr == "wait" and chain[:1] != ["self"] and \
+                        "event" not in base_src.lower() and \
+                        base_src.endswith("proc"):
+                    blocking = f"{base_src}.wait"
+                elif attr in _KUBE_VERBS and chain[:-1] and \
+                        chain[-2] == "kube":
+                    blocking = f"{base_src}.{attr}"
+                if blocking is not None:
+                    holder = next(h for h in self.held
+                                  if h.family in ("flock", "shard"))
+                    self._emit(
+                        "TPUDRA003", node,
+                        f"blocking call {blocking}(...) while holding "
+                        f"{holder.family} lock {holder.key!r} (held "
+                        f"since line {holder.line})",
+                        key=f"{holder.key}:{blocking}",
+                    )
+
+            # TPUDRA006: mutator method on a tainted object.
+            if attr in _MUTATORS and self._is_tainted(func.value):
+                self._emit(
+                    "TPUDRA006", node,
+                    f"in-place .{attr}() on cached API object "
+                    f"{_unparse(func.value)!r}; deep-copy before "
+                    "mutating (client-go informer rule)",
+                    key=f"{_root_name(func.value)}.{attr}",
+                )
+
+        # TPUDRA007: CheckpointManager(...) without transition_policy.
+        # In scope: the class imported from the driver's checkpoint
+        # module, by name or through a module alias -- an
+        # `ocp.CheckpointManager(...)` (orbax) or any other same-named
+        # class must not trip the rule.
+        is_driver_cm = (
+            isinstance(func, ast.Name)
+            and func.id in self.checkpoint_manager_aliases
+        ) or (
+            isinstance(func, ast.Attribute)
+            and func.attr == "CheckpointManager"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in self.checkpoint_module_aliases
+        )
+        if is_driver_cm:
+            if not any(kw.arg == "transition_policy"
+                       for kw in node.keywords):
+                self._emit(
+                    "TPUDRA007", node,
+                    "CheckpointManager constructed without "
+                    "transition_policy=: the mutation site opts out of "
+                    "the checkpoint state-machine validator",
+                    key="CheckpointManager",
+                )
+
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        fs = self._fs()
+        if fs is not None:
+            value_tainted = self._is_tainted(node.value) and \
+                not self._is_copy_call(node.value)
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    if value_tainted:
+                        fs.tainted.add(target.id)
+                    else:
+                        fs.tainted.discard(target.id)
+                elif isinstance(target, (ast.Subscript, ast.Attribute)):
+                    # TPUDRA006: writing into a tainted object.
+                    if self._is_tainted(target.value):
+                        self._emit(
+                            "TPUDRA006", node,
+                            "in-place assignment into cached API object "
+                            f"{_unparse(target.value)!r}; deep-copy "
+                            "before mutating",
+                            key=f"{_root_name(target.value)}[]=",
+                        )
+                elif isinstance(target, (ast.Tuple, ast.List)):
+                    for elt in target.elts:
+                        if isinstance(elt, ast.Name):
+                            if value_tainted:
+                                fs.tainted.add(elt.id)
+                            else:
+                                fs.tainted.discard(elt.id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        target = node.target
+        if isinstance(target, (ast.Subscript, ast.Attribute)) and \
+                self._is_tainted(target.value):
+            self._emit(
+                "TPUDRA006", node,
+                "augmented assignment into cached API object "
+                f"{_unparse(target.value)!r}; deep-copy before mutating",
+                key=f"{_root_name(target.value)}aug=",
+            )
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            if isinstance(target, (ast.Subscript, ast.Attribute)) and \
+                    self._is_tainted(target.value):
+                self._emit(
+                    "TPUDRA006", node,
+                    "del on cached API object "
+                    f"{_unparse(target.value)!r}; deep-copy before "
+                    "mutating",
+                    key=f"del {_root_name(target.value)}",
+                )
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        fs = self._fs()
+        if fs is not None and self._is_tainted(node.iter):
+            for elt in ast.walk(node.target):
+                if isinstance(elt, ast.Name):
+                    fs.tainted.add(elt.id)
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if isinstance(node.value, str) and node.value in _STATE_LITERALS \
+                and self.basename not in _STATE_LITERAL_FILES:
+            self._emit(
+                "TPUDRA005", node,
+                f"raw claim-state literal {node.value!r}; use "
+                "ClaimState (kubeletplugin/checkpoint.py) or the "
+                "statemachine model constants",
+                key=node.value,
+            )
+        self.generic_visit(node)
+
+
+def _collect_api_helpers(tree: ast.Module) -> set[str]:
+    """Pass 1: names of module functions/methods that return kube- or
+    informer-derived objects (one level deep)."""
+    helpers: set[str] = set()
+
+    def returns_api(func) -> bool:
+        sources: set[str] = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign) and _looks_api(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        sources.add(t.id)
+            if isinstance(node, ast.For) and _looks_api(node.iter):
+                for elt in ast.walk(node.target):
+                    if isinstance(elt, ast.Name):
+                        sources.add(elt.id)
+        for node in ast.walk(func):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if _looks_api(node.value):
+                    return True
+                root = _root_name(node.value)
+                if root is not None and root in sources:
+                    return True
+        return False
+
+    def _looks_api(node: ast.AST) -> bool:
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            chain = _attr_chain(node.func)
+            if len(chain) >= 2 and chain[-2] == "kube" and \
+                    chain[-1] in ("get", "list"):
+                return True
+            if any("informer" in p for p in chain[:-1]) and chain[-1] in (
+                    "get", "get_by_uid", "list"):
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if returns_api(node):
+                helpers.add(node.name)
+    return helpers
+
+
+def lint_source(source: str, rel: str = "<string>",
+                path: str = "<string>") -> list[Finding]:
+    """Lint one module's source; returns its findings (unbaselined)."""
+    tree = ast.parse(source, filename=rel)
+    linter = _ModuleLinter(path, rel, source,
+                           api_helpers=_collect_api_helpers(tree))
+    linter.visit(tree)
+    return linter.findings
+
+
+def iter_python_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git", "native")]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+class Baseline:
+    """The committed suppression file: fingerprint -> reason."""
+
+    def __init__(self, suppressions: dict[str, str] | None = None,
+                 path: str | None = None):
+        self.suppressions = dict(suppressions or {})
+        self.path = path
+
+    @classmethod
+    def load(cls, path: str | None) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return cls(path=path)
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        return cls(doc.get("suppressions", {}), path=path)
+
+    def save(self, path: str | None = None) -> None:
+        target = path or self.path
+        if not target:
+            raise ValueError("baseline has no path")
+        doc = {"version": 1, "suppressions": dict(sorted(
+            self.suppressions.items()))}
+        with open(target, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    def apply(self, findings: list[Finding]) -> None:
+        for f in findings:
+            if f.fingerprint in self.suppressions:
+                f.baselined = True
+
+
+def run_lint(paths: list[str], baseline: Baseline | str | None = None,
+             root: str | None = None) -> LintReport:
+    """Lint every .py under ``paths``. ``root`` anchors the relative
+    paths used in fingerprints (defaults to the common prefix's dir)."""
+    if isinstance(baseline, str):
+        baseline = Baseline.load(baseline)
+    files = iter_python_files(paths)
+    if root is None:
+        root = os.path.commonpath([os.path.abspath(p) for p in paths]) \
+            if paths else os.getcwd()
+        if os.path.isfile(root):
+            root = os.path.dirname(root)
+    report = LintReport()
+    for path in files:
+        rel = os.path.relpath(os.path.abspath(path), root)
+        # Fingerprints must be stable across checkouts.
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            report.findings.extend(lint_source(source, rel=rel, path=path))
+        except SyntaxError as e:
+            report.findings.append(Finding(
+                rule="TPUDRA000", path=rel, line=e.lineno or 1, col=0,
+                qualname="<module>", message=f"syntax error: {e.msg}",
+                key="syntax",
+            ))
+        report.files_scanned += 1
+    if baseline is not None:
+        baseline.apply(report.findings)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report
+
+
+def metrics_exposition(report: LintReport) -> str:
+    """Prometheus text exposition of the finding counts
+    (``tpu_dra_lint_findings_total`` by rule ID) for BASELINE.md /
+    dashboard ingestion from bench or CI runs."""
+    lines = [
+        "# HELP tpu_dra_lint_findings_total Non-baselined static-"
+        "analysis findings by rule ID.",
+        "# TYPE tpu_dra_lint_findings_total gauge",
+    ]
+    for rule, n in sorted(report.counts().items()):
+        lines.append(f'tpu_dra_lint_findings_total{{rule="{rule}"}} {n}')
+    lines += [
+        "# HELP tpu_dra_lint_baselined_total Baseline-suppressed "
+        "findings by rule ID.",
+        "# TYPE tpu_dra_lint_baselined_total gauge",
+    ]
+    counts_base: dict[str, int] = {rule: 0 for rule in RULES}
+    for f in report.baselined:
+        counts_base[f.rule] = counts_base.get(f.rule, 0) + 1
+    for rule, n in sorted(counts_base.items()):
+        lines.append(
+            f'tpu_dra_lint_baselined_total{{rule="{rule}"}} {n}')
+    return "\n".join(lines) + "\n"

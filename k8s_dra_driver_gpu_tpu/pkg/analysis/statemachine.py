@@ -1,0 +1,123 @@
+"""The checkpoint claim state machine, as a checked artifact.
+
+Legal lifecycles (docs/architecture.md "Crash safety model"):
+
+- **Two-phase** (chip kubelet plugin, ``kubeletplugin/device_state.py``):
+  absent -> PrepareStarted (the durable reservation) -> PrepareCompleted,
+  torn down from either state back to absent (failure rollback /
+  unprepare). A claim may NEVER appear as PrepareCompleted without its
+  PrepareStarted reservation having been durable first -- that ordering
+  is what crash recovery replays against.
+- **Single-phase** (compute-domain kubelet plugin,
+  ``computedomain/plugin/device_state.py``): channel/daemon prepares
+  mutate no device state, so they write PrepareCompleted in one step;
+  PrepareStarted must never appear in a CD checkpoint.
+
+``TransitionPolicy`` is the declarative model; CheckpointManager runs
+``validate_states`` on every group-committed mutation (the runtime
+validator), and the AST pass (lint rule TPUDRA007) verifies every
+CheckpointManager construction site in the package declares which
+policy it lives under -- so a new mutation site cannot silently opt
+out of the model.
+
+This module is dependency-free on purpose: kubeletplugin/checkpoint.py
+imports it, so it must not import anything from kubeletplugin back.
+"""
+
+from __future__ import annotations
+
+# Canonical state names. kubeletplugin/checkpoint.py's ClaimState enum
+# must agree with these (tests/test_analysis_statemachine.py pins it).
+ABSENT = None
+PREPARE_STARTED = "PrepareStarted"
+PREPARE_COMPLETED = "PrepareCompleted"
+
+
+class CheckpointTransitionError(RuntimeError):
+    """A checkpoint mutation attempted an illegal claim-state
+    transition. Raised inside the group-commit flush, so the batch
+    fails and the read cache is poisoned -- the illegal state never
+    becomes durable and never surfaces from the cache."""
+
+
+class TransitionPolicy:
+    """A declarative set of legal (old_state, new_state) transitions.
+
+    ``None`` stands for "claim absent from the checkpoint". Identity
+    transitions (old == new) are always legal: idempotent re-writes of
+    an unchanged state (e.g. a retried reservation after rollback)
+    carry no lifecycle meaning.
+    """
+
+    def __init__(self, name: str,
+                 allowed: frozenset[tuple[str | None, str | None]]):
+        self.name = name
+        self.allowed = frozenset(allowed)
+
+    def __repr__(self) -> str:  # diagnostics in transition errors
+        return f"TransitionPolicy({self.name!r})"
+
+    def is_legal(self, old: str | None, new: str | None) -> bool:
+        return old == new or (old, new) in self.allowed
+
+    def validate(self, uid: str, old: str | None, new: str | None) -> None:
+        if not self.is_legal(old, new):
+            raise CheckpointTransitionError(
+                f"claim {uid}: illegal checkpoint transition "
+                f"{old or 'absent'} -> {new or 'absent'} under the "
+                f"{self.name} policy (legal: "
+                f"{sorted((o or 'absent', n or 'absent') for o, n in self.allowed)})"
+            )
+
+    def validate_states(
+        self,
+        old_states: dict[str, str],
+        new_states: dict[str, str],
+        scope=None,
+    ) -> None:
+        """Validate every per-claim state change between two checkpoint
+        snapshots. ``scope`` (an iterable of uids, or None for all)
+        narrows the check to the claims one commit declared dirty --
+        but a commit that mutated OUTSIDE its declared scope is itself
+        a bug, so out-of-scope changes fail too."""
+        uids = set(old_states) | set(new_states)
+        scoped = set(scope) if scope is not None else None
+        for uid in uids:
+            old = old_states.get(uid)
+            new = new_states.get(uid)
+            if old == new:
+                continue
+            if scoped is not None and uid not in scoped:
+                raise CheckpointTransitionError(
+                    f"claim {uid}: checkpoint mutation changed state "
+                    f"{old or 'absent'} -> {new or 'absent'} outside its "
+                    f"declared dirty set {sorted(scoped)}"
+                )
+            self.validate(uid, old, new)
+
+
+TWO_PHASE_POLICY = TransitionPolicy(
+    "two-phase",
+    frozenset({
+        (ABSENT, PREPARE_STARTED),            # durable reservation
+        (PREPARE_STARTED, PREPARE_COMPLETED),  # middle finished
+        (PREPARE_STARTED, ABSENT),             # failure/stale rollback
+        (PREPARE_COMPLETED, ABSENT),           # unprepare
+    }),
+)
+
+SINGLE_PHASE_POLICY = TransitionPolicy(
+    "single-phase",
+    frozenset({
+        (ABSENT, PREPARE_COMPLETED),  # one-step prepare (no device state)
+        (PREPARE_COMPLETED, ABSENT),  # unprepare
+    }),
+)
+
+#: Registry for the AST pass (lint TPUDRA007): modules constructing a
+#: CheckpointManager must pass transition_policy= explicitly -- one of
+#: these, or None with an inline-allow comment stating why.
+POLICIES = {
+    "two-phase": TWO_PHASE_POLICY,
+    "single-phase": SINGLE_PHASE_POLICY,
+}

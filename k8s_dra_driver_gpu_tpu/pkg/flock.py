@@ -76,6 +76,9 @@ class Flock:
         # Honor timeout/cancel for intra-process contention from OTHER
         # threads (the thread lock is non-reentrant; the holding thread
         # itself was rejected above).
+        # The lock IMPLEMENTATION itself: the guard object (not a
+        # finally) owns the release, and every failure path below
+        # releases explicitly. tpudra: allow=TPUDRA002
         while not self._thread_lock.acquire(timeout=poll_interval):
             if cancel is not None and cancel.is_set():
                 raise InterruptedError(

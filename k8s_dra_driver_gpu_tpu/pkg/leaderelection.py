@@ -12,6 +12,7 @@ import threading
 import time
 from datetime import datetime, timezone
 
+from . import json_copy
 from .kubeclient import ConflictError, NotFoundError
 
 logger = logging.getLogger(__name__)
@@ -100,6 +101,12 @@ class LeaderElector:
         # An empty holder means the previous leader released on cancel.
         if holder and holder != self.identity and not expired:
             return False
+        # Mutate a deep copy, never the fetched object (TPUDRA006);
+        # setdefault also re-attaches the spec -- the old
+        # `lease.get("spec", {})` silently DROPPED the holder write for
+        # a lease that had no spec at all.
+        lease = json_copy(lease)
+        spec = lease.setdefault("spec", {})
         spec["holderIdentity"] = self.identity
         spec["renewTime"] = _now()
         if holder != self.identity:
@@ -121,6 +128,7 @@ class LeaderElector:
             return
         if lease.get("spec", {}).get("holderIdentity") != self.identity:
             return
+        lease = json_copy(lease)
         lease["spec"]["holderIdentity"] = ""
         try:
             self.kube.update("coordination.k8s.io", "v1", "leases",

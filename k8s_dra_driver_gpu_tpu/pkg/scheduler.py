@@ -59,10 +59,9 @@ def _meta(obj):
     return obj.get("metadata", {})
 
 
-def json_copy(obj):
-    import json
-
-    return json.loads(json.dumps(obj))
+# Deep-copy discipline for API objects lives in one place now
+# (pkg.json_copy); re-exported here for the existing import sites.
+from . import json_copy  # noqa: E402,F401
 
 
 class _CompiledSelectors:

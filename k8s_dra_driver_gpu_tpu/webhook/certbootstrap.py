@@ -131,8 +131,12 @@ def ensure_secret(kube, name: str, namespace: str, service: str) -> bytes:
 
 
 def patch_ca_bundle(kube, webhook_config: str, ca_pem: bytes) -> None:
-    obj = kube.get("admissionregistration.k8s.io", "v1",
-                   "validatingwebhookconfigurations", webhook_config)
+    from ..pkg import json_copy  # noqa: PLC0415 - leaf helper
+
+    # Deep-copy before mutating the fetched config (TPUDRA006).
+    obj = json_copy(kube.get("admissionregistration.k8s.io", "v1",
+                             "validatingwebhookconfigurations",
+                             webhook_config))
     for wh in obj.get("webhooks", []):
         wh.setdefault("clientConfig", {})["caBundle"] = base64.b64encode(
             ca_pem).decode()

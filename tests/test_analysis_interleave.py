@@ -1,0 +1,387 @@
+"""Deterministic interleaving explorer tests (pkg/analysis/interleave).
+
+The harness must prove it can CATCH races before its clean verdicts
+mean anything, so the suite leads with a deliberately-buggy toy
+pipeline (unlocked read-modify-write) the explorer has to break within
+a small schedule budget; then the real prepare/unprepare pipeline runs
+under the same exploration and must hold its invariants on every
+schedule (the ISSUE-3 acceptance pair).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import (
+    CheckpointManager,
+    ClaimState,
+)
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+    Config,
+    DeviceState,
+    PrepareError,
+)
+from k8s_dra_driver_gpu_tpu.pkg.analysis.interleave import (
+    ControlledScheduler,
+    DeadlockError,
+    ReplayChooser,
+    explore,
+    explore_random,
+    instrument_device_state,
+)
+from k8s_dra_driver_gpu_tpu.pkg.flock import FlockReentrantError
+from tests.fake_kube import make_claim
+
+
+class _Counter:
+    def __init__(self):
+        self.value = 0
+
+
+def _build_buggy(sched):
+    """Two unlocked read-modify-write increments: the canonical lost
+    update. The explorer owns proving it can find the bad schedule."""
+    counter = _Counter()
+    sched.counter = counter
+
+    def inc():
+        tmp = counter.value
+        sched.yield_point("between read and write")
+        counter.value = tmp + 1
+
+    sched.spawn(inc, "a")
+    sched.spawn(inc, "b")
+
+
+def _build_locked(sched):
+    counter = _Counter()
+    sched.counter = counter
+
+    def inc():
+        sched.lock_acquire("counter", reentrant_error=False)
+        try:
+            tmp = counter.value
+            sched.yield_point("between read and write")
+            counter.value = tmp + 1
+        finally:
+            sched.lock_release("counter")
+
+    sched.spawn(inc, "a")
+    sched.spawn(inc, "b")
+
+
+def _both_incremented(sched):
+    assert sched.counter.value == 2, (
+        f"lost update: counter == {sched.counter.value}"
+    )
+
+
+class TestToyRaceDetection:
+    # The acceptance bound from ISSUE 3: the seeded race must fall
+    # within this many schedules (it actually falls on schedule 2).
+    MAX_SCHEDULES_TO_CATCH = 8
+
+    def test_explorer_catches_seeded_race(self):
+        result = explore(_build_buggy, _both_incremented,
+                         max_schedules=self.MAX_SCHEDULES_TO_CATCH,
+                         stop_at_first_failure=True)
+        assert result.failures, (
+            f"unlocked RMW not caught in {result.schedules_run} schedules"
+        )
+        assert result.schedules_run <= self.MAX_SCHEDULES_TO_CATCH
+        failure = result.failures[0]
+        assert "lost update" in str(failure.error)
+        # The failure carries a deterministic reproducer.
+        assert failure.choices and failure.trace
+
+    def test_failure_schedule_replays_deterministically(self):
+        result = explore(_build_buggy, _both_incremented,
+                         max_schedules=8, stop_at_first_failure=True)
+        choices = result.failures[0].choices
+        for _ in range(3):
+            sched = ControlledScheduler(ReplayChooser(choices))
+            _build_buggy(sched)
+            sched.run()
+            assert sched.counter.value == 1  # same bug, every replay
+
+    def test_exhaustive_on_small_space(self):
+        result = explore(_build_buggy, _both_incremented,
+                         max_schedules=64)
+        assert result.exhausted
+        # 2 threads x 1 yield point each: both orders of the critical
+        # section interleave -> some schedules lose an update.
+        assert result.failures and result.schedules_run < 64
+
+    def test_locked_pipeline_survives_every_schedule(self):
+        result = explore(_build_locked, _both_incremented,
+                         max_schedules=256)
+        assert result.exhausted and result.ok
+
+    def test_random_mode_is_seeded_and_catches_too(self):
+        r1 = explore_random(_build_buggy, _both_incremented,
+                            schedules=16, seed=7)
+        r2 = explore_random(_build_buggy, _both_incremented,
+                            schedules=16, seed=7)
+        assert [f.choices for f in r1.failures] == \
+            [f.choices for f in r2.failures]
+        assert r1.failures
+
+
+class TestVirtualLocks:
+    def test_deadlock_detected_not_hung(self):
+        def build(sched):
+            def ab():
+                sched.lock_acquire("A", reentrant_error=False)
+                sched.lock_acquire("B", reentrant_error=False)
+                sched.lock_release("B")
+                sched.lock_release("A")
+
+            def ba():
+                sched.lock_acquire("B", reentrant_error=False)
+                sched.lock_acquire("A", reentrant_error=False)
+                sched.lock_release("A")
+                sched.lock_release("B")
+
+            sched.spawn(ab, "ab")
+            sched.spawn(ba, "ba")
+
+        result = explore(build, max_schedules=64)
+        assert result.exhausted
+        deadlocks = [f for f in result.failures
+                     if isinstance(f.error, DeadlockError)]
+        assert deadlocks, "AB/BA inversion never deadlocked"
+        assert "waits on" in str(deadlocks[0].error)
+
+    def test_sorted_acquisition_never_deadlocks(self):
+        def build(sched):
+            def worker():
+                for lock in ("A", "B"):  # both threads: sorted order
+                    sched.lock_acquire(lock, reentrant_error=False)
+                for lock in ("B", "A"):
+                    sched.lock_release(lock)
+
+            sched.spawn(worker, "w1")
+            sched.spawn(worker, "w2")
+
+        result = explore(build, max_schedules=256)
+        assert result.exhausted and result.ok
+
+    def test_deadlock_schedules_do_not_leak_threads(self):
+        """Blocked workers of a deadlocking schedule are unwound, not
+        left parked on their events forever -- a DFS finding hundreds
+        of deadlocks must not drown the process in stuck threads."""
+        import threading
+
+        def build(sched):
+            def ab():
+                sched.lock_acquire("A", reentrant_error=False)
+                sched.lock_acquire("B", reentrant_error=False)
+                sched.lock_release("B")
+                sched.lock_release("A")
+
+            def ba():
+                sched.lock_acquire("B", reentrant_error=False)
+                sched.lock_acquire("A", reentrant_error=False)
+                sched.lock_release("A")
+                sched.lock_release("B")
+
+            sched.spawn(ab, "ab")
+            sched.spawn(ba, "ba")
+
+        before = threading.active_count()
+        result = explore(build, max_schedules=64)
+        assert any(isinstance(f.error, DeadlockError)
+                   for f in result.failures)
+        deadline = time.monotonic() + 5
+        while threading.active_count() > before and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        leaked = threading.active_count() - before
+        assert leaked <= 0, f"{leaked} worker thread(s) leaked"
+
+    def test_invariant_exceptions_become_failures(self):
+        """A non-AssertionError from the invariant (e.g. a
+        CheckpointCorruptError while re-parsing) must be captured as a
+        ScheduleFailure with a reproducer, not abort the exploration."""
+        def build(sched):
+            sched.spawn(lambda: None, "w")
+
+        def invariant(sched):
+            raise RuntimeError("corrupt checkpoint")
+
+        result = explore(build, invariant, max_schedules=4)
+        assert result.failures
+        assert isinstance(result.failures[0].error, RuntimeError)
+        assert result.schedules_run >= 1  # loop survived the raise
+
+    def test_reentrant_virtual_flock_raises(self):
+        seen = {}
+
+        def build(sched):
+            def worker():
+                sched.lock_acquire("flock")
+                try:
+                    sched.lock_acquire("flock")
+                except FlockReentrantError as e:
+                    seen["err"] = e
+
+            sched.spawn(worker, "w")
+
+        explore(build, max_schedules=4)
+        assert isinstance(seen["err"], FlockReentrantError)
+
+
+@pytest.fixture()
+def pipeline_tmp(tmp_path):
+    return tmp_path
+
+
+def _pipeline_build(tmp_path, chips_by_worker, counter):
+    """build() factory: a fresh DeviceState per schedule, each worker
+    preparing+unpreparing one claim. Worker-visible PrepareErrors are
+    recorded (overlap rejections are legal outcomes the invariant
+    judges), anything else propagates as a failure."""
+
+    def build(sched):
+        counter[0] += 1
+        root = str(tmp_path / f"s{counter[0]}")
+        state = DeviceState(Config.mock(root=root, topology="v5e-4"))
+        sched.root = root
+        sched.outcomes = {}
+        sched._ctx = instrument_device_state(sched, state)
+        sched._ctx.__enter__()
+
+        def worker(uid, chip):
+            def run():
+                try:
+                    ids = state.prepare(make_claim(uid, [chip]))
+                    assert len(ids) == 1
+                    state.unprepare(uid)
+                    sched.outcomes[uid] = "ok"
+                except PrepareError as e:
+                    sched.outcomes[uid] = f"rejected: {e}"
+            return run
+
+        for i, (uid, chip) in enumerate(chips_by_worker):
+            sched.spawn(worker(uid, chip), f"w{i}")
+
+    return build
+
+
+def _pipeline_cleanup(sched):
+    sched._ctx.__exit__(None, None, None)
+
+
+def _pipeline_invariant_factory(require_all_ok):
+    def invariant(sched):
+        # 1. Checkpoint parses AND checksum-verifies in a fresh manager.
+        cp = CheckpointManager(sched.root).get()
+        # 2. No lost/leaked devices: every claim unwound.
+        assert cp.claims == {}, f"leaked claims: {sorted(cp.claims)}"
+        reg = os.path.join(sched.root, "subslices.json")
+        if os.path.exists(reg):
+            with open(reg, encoding="utf-8") as f:
+                assert json.load(f) == {}, "leaked live carve-outs"
+        leases = os.path.join(sched.root, "leases")
+        if os.path.isdir(leases):
+            assert os.listdir(leases) == [], "leaked reservation leases"
+        if require_all_ok:
+            bad = {u: o for u, o in sched.outcomes.items() if o != "ok"}
+            assert not bad, f"disjoint claims must never reject: {bad}"
+        else:
+            ok = [u for u, o in sched.outcomes.items() if o == "ok"]
+            assert ok, "no worker ever made progress"
+    return invariant
+
+
+class TestRealPipelineUnderExploration:
+    """The clean half of the acceptance pair: the sharded
+    prepare/unprepare pipeline holds its invariants on every explored
+    schedule. Budgets are tuned to ~15s total on a 2-vCPU CI box."""
+
+    def test_disjoint_claims_dfs(self, pipeline_tmp):
+        counter = [0]
+        build = _pipeline_build(
+            pipeline_tmp,
+            [("u0", "chip-0"), ("u1", "chip-1")], counter)
+        result = explore(
+            build, _pipeline_invariant_factory(require_all_ok=True),
+            max_schedules=30, cleanup=_pipeline_cleanup)
+        assert result.schedules_run == 30
+        assert result.ok, "\n".join(str(f) for f in result.failures)
+
+    def test_disjoint_claims_random(self, pipeline_tmp):
+        counter = [0]
+        build = _pipeline_build(
+            pipeline_tmp,
+            [("u0", "chip-0"), ("u1", "chip-1")], counter)
+        result = explore_random(
+            build, _pipeline_invariant_factory(require_all_ok=True),
+            schedules=15, seed=1234, cleanup=_pipeline_cleanup)
+        assert result.ok, "\n".join(str(f) for f in result.failures)
+
+    def test_same_chip_contention(self, pipeline_tmp):
+        """Two claims fighting over chip-0: schedules where one gets
+        rejected by overlap validation are fine; double allocation,
+        leaked state, or a corrupted checkpoint are not."""
+        counter = [0]
+        build = _pipeline_build(
+            pipeline_tmp,
+            [("ca", "chip-0"), ("cb", "chip-0")], counter)
+        result = explore(
+            build, _pipeline_invariant_factory(require_all_ok=False),
+            max_schedules=30, cleanup=_pipeline_cleanup)
+        assert result.ok, "\n".join(str(f) for f in result.failures)
+
+    def test_instrumentation_is_scoped(self, pipeline_tmp):
+        """After a run (including failed ones), the patches are gone:
+        a plain DeviceState works with the real locks again."""
+        counter = [0]
+        build = _pipeline_build(pipeline_tmp, [("u0", "chip-0")], counter)
+        explore(build, _pipeline_invariant_factory(require_all_ok=True),
+                max_schedules=3, cleanup=_pipeline_cleanup)
+        state = DeviceState(Config.mock(
+            root=str(pipeline_tmp / "plain"), topology="v5e-4"))
+        ids = state.prepare(make_claim("plain-1", ["chip-0"]))
+        assert len(ids) == 1
+        state.unprepare("plain-1")
+        assert state.prepared_claims() == {}
+        rec = state._checkpoint  # real group commit restored
+        assert type(rec)._submit.__name__ == "_submit"
+
+
+class TestExplorerProvesRealInvariant:
+    def test_checkpoint_without_reservation_would_be_caught(
+            self, tmp_path):
+        """Negative control for the real-pipeline run: break the
+        two-phase invariant on purpose (skip the unprepare, i.e. leak
+        the claim) and the same invariant must flag it -- the clean
+        verdicts above are meaningful."""
+        counter = [0]
+
+        def build(sched):
+            counter[0] += 1
+            root = str(tmp_path / f"s{counter[0]}")
+            state = DeviceState(Config.mock(root=root, topology="v5e-4"))
+            sched.root = root
+            sched.outcomes = {}
+            sched._ctx = instrument_device_state(sched, state)
+            sched._ctx.__enter__()
+
+            def leaky():
+                state.prepare(make_claim("leak-1", ["chip-0"]))
+                sched.outcomes["leak-1"] = "ok"  # never unprepared
+
+            sched.spawn(leaky, "w0")
+
+        result = explore(
+            build, _pipeline_invariant_factory(require_all_ok=True),
+            max_schedules=2, cleanup=_pipeline_cleanup)
+        assert result.failures
+        assert "leaked claims" in str(result.failures[0].error)
+        # And the leaked record is the durable two-phase COMPLETED one.
+        cp = CheckpointManager(os.path.join(str(tmp_path), "s1")).get()
+        assert cp.claims["leak-1"].state == \
+            ClaimState.PREPARE_COMPLETED.value

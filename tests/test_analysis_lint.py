@@ -1,0 +1,470 @@
+"""Lock-hierarchy / cache-discipline linter tests (pkg/analysis/lint).
+
+Two halves:
+- per-rule unit tests over small synthetic modules (each rule must
+  fire on its counterexample and stay quiet on the disciplined form);
+- THE CI gate: the linter runs over the whole shipped package and must
+  report zero non-baselined findings (real violations get fixed, not
+  suppressed -- the committed baseline is empty and stays that way).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg.analysis.lint import (
+    RULES,
+    Baseline,
+    lint_source,
+    metrics_exposition,
+    run_lint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "k8s_dra_driver_gpu_tpu")
+BASELINE = os.path.join(REPO, "analysis-baseline.json")
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+class TestLockHierarchyRules:
+    def test_out_of_order_acquisition_flagged(self):
+        src = (
+            "class S:\n"
+            "    def bad(self):\n"
+            "        with self._shards.hold([1]):\n"
+            "            with self.pu_lock.acquire(timeout=1.0):\n"
+            "                pass\n"
+        )
+        findings = lint_source(src)
+        assert "TPUDRA001" in rules_of(findings)
+
+    def test_documented_order_clean(self):
+        src = (
+            "class S:\n"
+            "    def good(self):\n"
+            "        with self.pu_lock.acquire(timeout=1.0):\n"
+            "            with self._shards.hold([1]):\n"
+            "                self._checkpoint.update_claim('u', None)\n"
+        )
+        assert lint_source(src) == []
+
+    def test_checkpoint_call_under_locks_is_legal(self):
+        # Level 3 inside level 1/2 is the documented direction.
+        src = (
+            "class S:\n"
+            "    def good(self):\n"
+            "        with self.pu_lock.acquire(timeout=1.0):\n"
+            "            self._checkpoint.get()\n"
+        )
+        assert lint_source(src) == []
+
+    def test_reentrant_flock_flagged(self):
+        src = (
+            "class S:\n"
+            "    def bad(self):\n"
+            "        with self.pu_lock.acquire(timeout=1.0):\n"
+            "            with self.pu_lock.acquire(timeout=1.0):\n"
+            "                pass\n"
+        )
+        findings = lint_source(src)
+        assert "TPUDRA004" in rules_of(findings)
+
+    def test_distinct_flocks_nested_clean(self):
+        src = (
+            "class S:\n"
+            "    def good(self):\n"
+            "        with self.a_lock.acquire(timeout=1.0):\n"
+            "            with self.b_lock.acquire(timeout=1.0):\n"
+            "                pass\n"
+        )
+        assert lint_source(src) == []
+
+
+class TestBareAcquireRule:
+    def test_discarded_acquire_flagged(self):
+        src = (
+            "def bad(lock):\n"
+            "    lock.acquire(timeout=1.0)\n"
+            "    do_work()\n"
+            "    lock.release()\n"
+        )
+        findings = lint_source(src)
+        assert "TPUDRA002" in rules_of(findings)
+
+    def test_unrelated_release_in_finally_still_flagged(self):
+        """An unrelated b.release() in a finally must not excuse a
+        leaked a.acquire() -- the release must match the lock."""
+        src = (
+            "def bad(self):\n"
+            "    self.a.acquire(timeout=1.0)\n"
+            "    try:\n"
+            "        do_work()\n"
+            "    finally:\n"
+            "        self.b.release()\n"
+        )
+        findings = lint_source(src)
+        assert "TPUDRA002" in rules_of(findings)
+
+    def test_release_in_finally_clean(self):
+        src = (
+            "def good(lock):\n"
+            "    lock.acquire(timeout=1.0)\n"
+            "    try:\n"
+            "        do_work()\n"
+            "    finally:\n"
+            "        lock.release()\n"
+        )
+        assert lint_source(src) == []
+
+    def test_with_guard_clean(self):
+        src = (
+            "def good(lock):\n"
+            "    with lock.acquire(timeout=1.0):\n"
+            "        do_work()\n"
+        )
+        assert lint_source(src) == []
+
+
+class TestBlockingUnderLockRule:
+    def test_kube_call_under_shard_lock_flagged(self):
+        src = (
+            "class S:\n"
+            "    def bad(self):\n"
+            "        with self._shards.hold([0]):\n"
+            "            self.kube.patch('', 'v1', 'nodes', 'n', {})\n"
+        )
+        findings = lint_source(src)
+        assert "TPUDRA003" in rules_of(findings)
+
+    def test_sleep_under_flock_flagged(self):
+        src = (
+            "import time\n"
+            "class S:\n"
+            "    def bad(self):\n"
+            "        with self.pu_lock.acquire(timeout=1.0):\n"
+            "            time.sleep(5)\n"
+        )
+        findings = lint_source(src)
+        assert "TPUDRA003" in rules_of(findings)
+
+    def test_kube_call_outside_lock_clean(self):
+        src = (
+            "class S:\n"
+            "    def good(self):\n"
+            "        with self._shards.hold([0]):\n"
+            "            x = 1\n"
+            "        self.kube.patch('', 'v1', 'nodes', 'n', {})\n"
+        )
+        assert lint_source(src) == []
+
+
+class TestStateLiteralRule:
+    def test_raw_state_literal_flagged(self):
+        src = "def f(c):\n    return c.state == 'PrepareStarted'\n"
+        findings = lint_source(src, rel="kubeletplugin/cleanup.py")
+        assert "TPUDRA005" in rules_of(findings)
+
+    def test_enum_definition_site_exempt(self):
+        src = "PREPARE_STARTED = 'PrepareStarted'\n"
+        assert lint_source(src, rel="kubeletplugin/checkpoint.py") == []
+
+
+class TestCachedObjectMutationRule:
+    def test_mutating_kube_get_result_flagged(self):
+        src = (
+            "class S:\n"
+            "    def bad(self):\n"
+            "        obj = self.kube.get('g', 'v1', 'r', 'n')\n"
+            "        obj['metadata']['labels'] = {}\n"
+        )
+        findings = lint_source(src)
+        assert "TPUDRA006" in rules_of(findings)
+
+    def test_mutating_informer_object_flagged(self):
+        src = (
+            "class S:\n"
+            "    def bad(self):\n"
+            "        cd = self._cd_informer.get_by_uid('u')\n"
+            "        cd['status'].update({'x': 1})\n"
+        )
+        findings = lint_source(src)
+        assert "TPUDRA006" in rules_of(findings)
+
+    def test_mutating_api_shaped_param_flagged(self):
+        # The controller.reconcile shape: an API object arrives as a
+        # parameter and its metadata subtree is mutated in place.
+        src = (
+            "def reconcile(cd):\n"
+            "    meta = cd['metadata']\n"
+            "    meta.setdefault('finalizers', []).append('fin')\n"
+        )
+        findings = lint_source(src)
+        assert "TPUDRA006" in rules_of(findings)
+
+    def test_deep_copy_launders_taint(self):
+        src = (
+            "def good(cd):\n"
+            "    meta = cd['metadata']\n"
+            "    cd = json_copy(cd)\n"
+            "    cd['metadata'].setdefault('finalizers', []).append('f')\n"
+        )
+        assert lint_source(src) == []
+
+    def test_helper_returning_kube_objects_taints(self):
+        src = (
+            "class S:\n"
+            "    def _pods(self):\n"
+            "        return self.kube.list('', 'v1', 'pods')\n"
+            "    def bad(self):\n"
+            "        for pod in self._pods():\n"
+            "            pod['status']['phase'] = 'Failed'\n"
+        )
+        findings = lint_source(src)
+        assert "TPUDRA006" in rules_of(findings)
+
+    def test_fresh_container_mutation_clean(self):
+        src = (
+            "def good(pod):\n"
+            "    kept = [c for c in pod.get('status', {})"
+            ".get('conditions') or []]\n"
+            "    kept.append({'type': 'PodScheduled'})\n"
+        )
+        assert lint_source(src) == []
+
+
+class TestCheckpointManagerRule:
+    IMPORT = "from .checkpoint import CheckpointManager\n"
+
+    def test_missing_policy_flagged(self):
+        src = self.IMPORT + "cm = CheckpointManager(root, boot_id='b')\n"
+        findings = lint_source(src, rel="kubeletplugin/device_state.py")
+        assert "TPUDRA007" in rules_of(findings)
+
+    def test_aliased_import_flagged(self):
+        src = ("from ...kubeletplugin.checkpoint import "
+               "CheckpointManager as CM\n"
+               "cm = CM(root)\n")
+        findings = lint_source(src, rel="computedomain/x.py")
+        assert "TPUDRA007" in rules_of(findings)
+
+    def test_policy_present_clean(self):
+        src = (self.IMPORT
+               + "cm = CheckpointManager(root, boot_id='b', "
+                 "transition_policy=TWO_PHASE_POLICY)\n")
+        assert lint_source(src, rel="kubeletplugin/device_state.py") == []
+
+    def test_unrelated_same_named_class_not_flagged(self):
+        # orbax's ocp.CheckpointManager (train/checkpoint.py) must not
+        # trip the rule: scope is the name imported from the driver's
+        # checkpoint module, not any class that happens to share it.
+        src = ("import orbax.checkpoint as ocp\n"
+               "mngr = ocp.CheckpointManager(directory)\n")
+        assert lint_source(src, rel="train/anything.py") == []
+
+    def test_module_attribute_construction_flagged(self):
+        # `from ..kubeletplugin import checkpoint` then
+        # `checkpoint.CheckpointManager(...)` must not slip the rule.
+        src = ("from ..kubeletplugin import checkpoint\n"
+               "cm = checkpoint.CheckpointManager(root)\n")
+        findings = lint_source(src, rel="computedomain/x.py")
+        assert "TPUDRA007" in rules_of(findings)
+
+    def test_orbax_module_attribute_not_flagged(self):
+        src = ("import orbax.checkpoint as ocp\n"
+               "m = ocp.CheckpointManager('d')\n")
+        assert lint_source(src, rel="train/checkpoint.py") == []
+
+    def test_definition_module_not_flagged(self):
+        # checkpoint.py DEFINES the class (no import): out of scope.
+        src = "cm = CheckpointManager(root)\n"
+        assert lint_source(src, rel="kubeletplugin/checkpoint.py") == []
+
+
+class TestSuppression:
+    SRC_BAD = "def bad(lock):\n    lock.acquire(timeout=1.0)\n"
+
+    def test_inline_allow_same_line(self):
+        src = ("def bad(lock):\n"
+               "    lock.acquire(timeout=1.0)  # tpudra: allow=TPUDRA002\n")
+        assert lint_source(src) == []
+
+    def test_inline_allow_previous_comment_line(self):
+        src = ("def bad(lock):\n"
+               "    # guard object owns release; tpudra: allow=TPUDRA002\n"
+               "    lock.acquire(timeout=1.0)\n")
+        assert lint_source(src) == []
+
+    def test_inline_allow_wrong_rule_still_fires(self):
+        src = ("def bad(lock):\n"
+               "    lock.acquire(timeout=1.0)  # tpudra: allow=TPUDRA003\n")
+        assert rules_of(lint_source(src)) == ["TPUDRA002"]
+
+    def test_file_allow(self):
+        src = ("# server-side fake; tpudra: allow-file=TPUDRA002\n"
+               + self.SRC_BAD)
+        assert lint_source(src) == []
+
+    def test_baseline_fingerprint_is_line_number_free(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(self.SRC_BAD)
+        report = run_lint([str(mod)], root=str(tmp_path))
+        (fp,) = [f.fingerprint for f in report.findings]
+        baseline = Baseline({fp: "known"}, path=str(tmp_path / "b.json"))
+        # Shift the finding by 5 lines: the fingerprint must not move.
+        mod.write_text("# pad\n" * 5 + self.SRC_BAD)
+        report2 = run_lint([str(mod)], baseline=baseline,
+                           root=str(tmp_path))
+        assert [f.fingerprint for f in report2.findings] == [fp]
+        assert report2.active == [] and len(report2.baselined) == 1
+
+
+class TestRunnerAndOutput:
+    def test_json_output_mode(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text("def bad(lock):\n    lock.acquire(timeout=1.0)\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s_dra_driver_gpu_tpu.pkg.analysis",
+             str(mod), "--root", str(tmp_path), "--no-baseline", "--json"],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["counts"]["TPUDRA002"] == 1
+        assert doc["findings"][0]["rule"] == "TPUDRA002"
+        assert set(doc["rules"]) == set(RULES)
+
+    def test_metrics_exposition(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text("def bad(lock):\n    lock.acquire(timeout=1.0)\n")
+        report = run_lint([str(mod)], root=str(tmp_path))
+        text = metrics_exposition(report)
+        assert 'tpu_dra_lint_findings_total{rule="TPUDRA002"} 1' in text
+        assert 'tpu_dra_lint_findings_total{rule="TPUDRA001"} 0' in text
+
+    def test_bench_lint_summary_shape(self):
+        import bench
+
+        out = bench.bench_lint_findings()
+        assert out["lint_findings_total"] == 0
+        assert out["lint_findings_baselined"] == 0
+
+    def test_update_baseline_roundtrip_and_prunes_stale(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text("def bad(lock):\n    lock.acquire(timeout=1.0)\n")
+        bl_path = tmp_path / "baseline.json"
+        env = {**os.environ, "PYTHONPATH": REPO}
+        args = [sys.executable, "-m",
+                "k8s_dra_driver_gpu_tpu.pkg.analysis", str(mod),
+                "--root", str(tmp_path), "--baseline", str(bl_path)]
+        proc = subprocess.run(args + ["--update-baseline"],
+                              capture_output=True, text=True, cwd=REPO,
+                              env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              cwd=REPO, env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # Fix the violation at the source: re-updating must PRUNE the
+        # stale fingerprint, or a reintroduced same-shaped defect would
+        # be silently suppressed forever.
+        mod.write_text(
+            "def good(lock):\n"
+            "    with lock.acquire(timeout=1.0):\n"
+            "        pass\n")
+        proc = subprocess.run(args + ["--update-baseline"],
+                              capture_output=True, text=True, cwd=REPO,
+                              env=env)
+        assert proc.returncode == 0 and "1 stale pruned" in proc.stdout
+        assert json.load(open(bl_path))["suppressions"] == {}
+
+    def test_syntax_error_reported_not_crash(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text("def broken(:\n")
+        report = run_lint([str(mod)], root=str(tmp_path))
+        assert [f.rule for f in report.findings] == ["TPUDRA000"]
+        # TPUDRA000 is a cataloged rule: the CLI summary, counts() and
+        # the metrics exposition must all carry it (a syntax error in a
+        # linted tree once crashed the summary loop with a KeyError).
+        assert "TPUDRA000" in RULES
+        assert report.counts()["TPUDRA000"] == 1
+        assert ('tpu_dra_lint_findings_total{rule="TPUDRA000"} 1'
+                in metrics_exposition(report))
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s_dra_driver_gpu_tpu.pkg.analysis",
+             str(mod), "--root", str(tmp_path), "--no-baseline"],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "TPUDRA000" in proc.stdout
+        assert "Traceback" not in proc.stderr
+
+    def test_same_shaped_findings_get_distinct_fingerprints(
+            self, tmp_path):
+        """One baseline entry must never blanket-suppress a FUTURE
+        same-shaped finding in the same function."""
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "def bad(self):\n"
+            "    obj = self.kube.get('g', 'v1', 'r', 'n')\n"
+            "    obj['metadata']['labels'] = {}\n"
+            "    obj['metadata']['annotations'] = {}\n"
+        )
+        report = run_lint([str(mod)], root=str(tmp_path))
+        fps = [f.fingerprint for f in report.findings
+               if f.rule == "TPUDRA006"]
+        assert len(fps) == 2 and len(set(fps)) == 2, fps
+        # Baselining only the first leaves the second active.
+        baseline = Baseline({fps[0]: "known"})
+        report2 = run_lint([str(mod)], baseline=baseline,
+                           root=str(tmp_path))
+        active = [f.fingerprint for f in report2.active
+                  if f.rule == "TPUDRA006"]
+        assert active == [fps[1]]
+
+
+class TestWholePackageGate:
+    """The tier-1 CI gate from ISSUE 3: zero non-baselined findings
+    over the shipped package, with the committed baseline EMPTY (every
+    real violation the linter surfaced was fixed, not suppressed)."""
+
+    def test_package_is_clean(self):
+        report = run_lint([PKG], baseline=BASELINE, root=REPO)
+        assert report.files_scanned > 90
+        active = report.active
+        assert not active, "non-baselined findings:\n" + "\n".join(
+            str(f) for f in active)
+
+    def test_committed_baseline_is_empty(self):
+        with open(BASELINE, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["suppressions"] == {}, (
+            "the baseline exists for FUTURE pre-existing findings; "
+            "everything current must be fixed at the source"
+        )
+
+    def test_make_target_contract(self):
+        """`make lint-analysis` == the module CLI over the package with
+        the committed baseline; pin the exit-0 contract."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s_dra_driver_gpu_tpu.pkg.analysis",
+             "k8s_dra_driver_gpu_tpu", "--baseline", BASELINE],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 non-baselined finding(s)" in proc.stdout
+
+
+@pytest.mark.parametrize("rule", sorted(RULES))
+def test_rule_catalog_documented(rule):
+    """Every rule ID must be described in docs/analysis.md."""
+    doc = open(os.path.join(REPO, "docs", "analysis.md"),
+               encoding="utf-8").read()
+    assert rule in doc, f"{rule} missing from docs/analysis.md"

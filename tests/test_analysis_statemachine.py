@@ -1,0 +1,168 @@
+"""Checkpoint state-machine model + runtime validator tests
+(pkg/analysis/statemachine wired through CheckpointManager)."""
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import (
+    CheckpointedClaim,
+    CheckpointManager,
+    ClaimState,
+)
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+    Config,
+    DeviceState,
+)
+from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    SINGLE_PHASE_POLICY,
+    TWO_PHASE_POLICY,
+    CheckpointTransitionError,
+    TransitionPolicy,
+)
+from tests.fake_kube import make_claim
+
+
+def started(uid="u"):
+    return CheckpointedClaim(uid=uid,
+                             state=ClaimState.PREPARE_STARTED.value)
+
+
+def completed(uid="u"):
+    return CheckpointedClaim(uid=uid,
+                             state=ClaimState.PREPARE_COMPLETED.value)
+
+
+class TestModelConstants:
+    def test_model_agrees_with_claimstate_enum(self):
+        """The dependency-free model constants and the checkpoint enum
+        must never drift apart."""
+        assert PREPARE_STARTED == ClaimState.PREPARE_STARTED.value
+        assert PREPARE_COMPLETED == ClaimState.PREPARE_COMPLETED.value
+
+
+class TestTransitionPolicy:
+    @pytest.mark.parametrize("old,new", [
+        (None, PREPARE_STARTED),
+        (PREPARE_STARTED, PREPARE_COMPLETED),
+        (PREPARE_STARTED, None),
+        (PREPARE_COMPLETED, None),
+    ])
+    def test_two_phase_legal(self, old, new):
+        TWO_PHASE_POLICY.validate("u", old, new)  # no raise
+
+    @pytest.mark.parametrize("old,new", [
+        (None, PREPARE_COMPLETED),           # skipped the reservation
+        (PREPARE_COMPLETED, PREPARE_STARTED),  # backwards
+    ])
+    def test_two_phase_illegal(self, old, new):
+        with pytest.raises(CheckpointTransitionError):
+            TWO_PHASE_POLICY.validate("u", old, new)
+
+    def test_identity_transition_always_legal(self):
+        TWO_PHASE_POLICY.validate("u", PREPARE_STARTED, PREPARE_STARTED)
+        SINGLE_PHASE_POLICY.validate("u", None, None)
+
+    def test_single_phase_rejects_two_phase_reservation(self):
+        with pytest.raises(CheckpointTransitionError):
+            SINGLE_PHASE_POLICY.validate("u", None, PREPARE_STARTED)
+        SINGLE_PHASE_POLICY.validate("u", None, PREPARE_COMPLETED)
+
+    def test_out_of_scope_mutation_rejected(self):
+        policy = TransitionPolicy("t", frozenset({(None, PREPARE_STARTED)}))
+        with pytest.raises(CheckpointTransitionError, match="outside"):
+            policy.validate_states(
+                {}, {"other": PREPARE_STARTED}, scope={"mine"})
+
+    def test_error_names_claim_and_policy(self):
+        with pytest.raises(CheckpointTransitionError,
+                           match="claim u-1.*two-phase"):
+            TWO_PHASE_POLICY.validate("u-1", None, PREPARE_COMPLETED)
+
+
+class TestRuntimeValidatorInCheckpointManager:
+    def test_legal_lifecycle_commits(self, tmp_root):
+        cm = CheckpointManager(tmp_root, boot_id="b",
+                               transition_policy=TWO_PHASE_POLICY)
+        cm.update_claim("u", started())
+        cm.update_claim("u", completed())
+        cm.update_claim("u", None)
+        assert cm.get().claims == {}
+
+    def test_illegal_transition_fails_batch_and_poisons_cache(
+            self, tmp_root):
+        cm = CheckpointManager(tmp_root, boot_id="b",
+                               transition_policy=TWO_PHASE_POLICY)
+        cm.update_claim("keep", started("keep"))
+        with pytest.raises(RuntimeError) as exc_info:
+            cm.update_claim("u", completed())  # absent -> Completed
+        assert isinstance(exc_info.value.__cause__,
+                          CheckpointTransitionError)
+        # The illegal mutation never became durable OR cached.
+        assert set(cm.get().claims) == {"keep"}
+        assert set(CheckpointManager(tmp_root, boot_id="b").get().claims) \
+            == {"keep"}
+        # The manager still works afterwards.
+        cm.update_claim("u", started())
+        assert set(cm.get().claims) == {"keep", "u"}
+
+    def test_legacy_update_validated_across_all_claims(self, tmp_root):
+        cm = CheckpointManager(tmp_root, boot_id="b",
+                               transition_policy=TWO_PHASE_POLICY)
+        cm.update_claim("u", started())
+
+        def bad(cp):
+            cp.claims["u"] = completed()     # legal
+            cp.claims["x"] = completed("x")  # illegal: absent->Completed
+
+        with pytest.raises(RuntimeError):
+            cm.update(bad)
+        assert set(cm.get().claims) == {"u"}
+        assert cm.get().claims["u"].state == \
+            ClaimState.PREPARE_STARTED.value
+
+    def test_single_phase_manager_accepts_cd_lifecycle(self, tmp_root):
+        cm = CheckpointManager(tmp_root, boot_id="b",
+                               transition_policy=SINGLE_PHASE_POLICY)
+        cm.update_claim("cd", completed("cd"))
+        cm.update_claim("cd", None)
+        with pytest.raises(RuntimeError):
+            cm.update_claim("cd", started("cd"))
+
+    def test_no_policy_is_backward_compatible(self, tmp_root):
+        cm = CheckpointManager(tmp_root, boot_id="b")
+        cm.update_claim("u", completed())  # unvalidated legacy mode
+        assert set(cm.get().claims) == {"u"}
+
+
+class TestDeviceStateWiring:
+    def test_chip_plugin_runs_under_two_phase_policy(self, tmp_root):
+        state = DeviceState(Config.mock(root=tmp_root, topology="v5e-4"))
+        assert state._checkpoint.transition_policy is TWO_PHASE_POLICY
+        ids = state.prepare(make_claim("w-1", ["chip-0"]))
+        assert len(ids) == 1
+        state.unprepare("w-1")
+        assert state.prepared_claims() == {}
+
+    def test_cd_plugin_runs_under_single_phase_policy(self, tmp_root):
+        from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state \
+            import CDDeviceState
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+
+        state = CDDeviceState(tmp_root, FakeKubeClient(), "node-0",
+                              use_informer=False)
+        assert state._checkpoint.transition_policy is SINGLE_PHASE_POLICY
+
+    def test_validator_blocks_a_regressed_two_phase_skip(self, tmp_root):
+        """The guard the validator exists for: a future refactor that
+        writes PrepareCompleted without the durable PrepareStarted
+        reservation must die at commit time, not in a post-crash
+        debugging session."""
+        state = DeviceState(Config.mock(root=tmp_root, topology="v5e-4"))
+        with pytest.raises(RuntimeError) as exc_info:
+            state._checkpoint.update_claim("skip", completed("skip"))
+        assert isinstance(exc_info.value.__cause__,
+                          CheckpointTransitionError)
+        # ...and the claim can still prepare normally afterwards.
+        ids = state.prepare(make_claim("skip", ["chip-0"]))
+        assert len(ids) == 1

@@ -1,0 +1,200 @@
+"""FlockReentrantError regression coverage for the CD-plugin and
+daemon paths.
+
+PR 1 made re-entrant Flock acquisition fail fast (FlockReentrantError
+instead of a silent 10s timeout burn) but only covered the GPU-plugin
+path (tests/test_pkg_infra.py + kubeletplugin flows). The compute-
+domain plugin owns its own checkpoint flock
+(computedomain/plugin/device_state.py), and the daemon's supervisor
+(computedomain/daemon/process.py) carries the same non-reentrant-lock
+discipline with a threading.Lock -- both get pinned here so a future
+refactor that introduces a nested acquire dies in CI within seconds,
+not as a field stall.
+"""
+
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.computedomain.daemon.process import (
+    ProcessManager,
+)
+from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state import (
+    CDDeviceState,
+)
+from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import (
+    CheckpointedClaim,
+    ClaimState,
+)
+from k8s_dra_driver_gpu_tpu.pkg.flock import FlockReentrantError
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+
+# Re-entrancy must fail FAST: well under the 10s flock timeout it
+# used to burn as fake cross-process contention.
+FAST_S = 2.0
+
+
+@pytest.fixture()
+def cd_state(tmp_root):
+    state = CDDeviceState(tmp_root, FakeKubeClient(), "node-0",
+                          use_informer=False)
+    yield state
+    state.stop()
+
+
+class TestCDPluginCheckpointReentrancy:
+    def test_commit_fn_reentering_checkpoint_fails_fast(self, cd_state):
+        """A commit mutation that calls back into its own
+        CheckpointManager (get/update while the flush holds the
+        checkpoint flock) is the CD-plugin shape of the re-entrancy
+        bug. It must surface FlockReentrantError immediately."""
+        cm = cd_state._checkpoint
+
+        def reentrant(cp):
+            cm.get()  # same flock, same thread: the bug under test
+
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError) as exc_info:
+            cm.update(reentrant)
+        elapsed = time.monotonic() - t0
+        assert isinstance(exc_info.value.__cause__, FlockReentrantError)
+        assert elapsed < FAST_S, (
+            f"re-entrant acquire burned {elapsed:.1f}s as fake contention"
+        )
+
+    def test_nested_update_from_commit_fn_fails_fast(self, cd_state):
+        """Re-entering the group-commit machinery itself (not just the
+        flock) used to park the flusher on its own queue FOREVER -- an
+        unbounded stall, worse than the 10s the flock case burned.
+        Now it fails fast with the same FlockReentrantError contract."""
+        cm = cd_state._checkpoint
+
+        def nested(cp):
+            cm.update_claim("inner", None)
+
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError) as exc_info:
+            cm.update(nested)
+        assert time.monotonic() - t0 < FAST_S
+        assert isinstance(exc_info.value.__cause__, FlockReentrantError)
+        assert "re-entered" in str(exc_info.value.__cause__)
+
+    def test_state_survives_the_failed_reentrant_commit(self, cd_state):
+        """After the fast failure the checkpoint is intact and the CD
+        plugin's normal single-phase lifecycle still works."""
+        cm = cd_state._checkpoint
+        with pytest.raises(RuntimeError):
+            cm.update(lambda cp: cm.get())
+
+        def complete(cp):
+            cp.claims["cd-claim"] = CheckpointedClaim(
+                uid="cd-claim",
+                state=ClaimState.PREPARE_COMPLETED.value)
+
+        cm.update(complete)
+        assert set(cd_state.prepared_claims()) == {"cd-claim"}
+        cd_state.unprepare("cd-claim")
+        assert cd_state.prepared_claims() == {}
+
+
+class _SleepChild:
+    """A ProcessManager running a long-sleeping python child."""
+
+    ARGV = [sys.executable, "-c", "import time; time.sleep(60)"]
+
+    def __init__(self, pidfile=None):
+        self.pm = ProcessManager(list(self.ARGV), pidfile=pidfile)
+
+
+class TestDaemonProcessManagerLockDiscipline:
+    """process.py uses a non-reentrant threading.Lock with the same
+    rule the flocks follow: never call back into a lock-taking method
+    while holding it, never sleep under it. These pin the observable
+    contract (methods stay responsive around the watchdog's backoff
+    sleep) so a refactor that moves the sleep under the lock -- the
+    threading.Lock twin of the FlockReentrantError bug -- fails here
+    fast instead of deadlocking a daemon in the field."""
+
+    def test_api_responsive_while_watchdog_handles_crash(self):
+        child = _SleepChild()
+        pm = child.pm
+        pm.ensure_started()
+        pm.start_watchdog()
+        try:
+            # Kill the child: the watchdog notices and sleeps its 1s
+            # backoff OUTSIDE the lock before restarting.
+            pm.signal(signal.SIGKILL)
+            deadline = time.monotonic() + 5
+            while pm.alive() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            # While the watchdog is in its backoff window, every
+            # lock-taking API must answer promptly from other threads.
+            results = {}
+
+            def probe():
+                t0 = time.monotonic()
+                results["alive"] = pm.alive()
+                results["pid"] = pm.pid
+                results["elapsed"] = time.monotonic() - t0
+
+            t = threading.Thread(target=probe)
+            t.start()
+            t.join(timeout=FAST_S)
+            assert not t.is_alive(), (
+                "alive()/pid blocked: a lock is held across the "
+                "watchdog backoff sleep"
+            )
+            assert results["elapsed"] < FAST_S
+        finally:
+            pm.stop()
+        assert not pm.alive()
+
+    def test_stop_during_backoff_does_not_deadlock(self):
+        child = _SleepChild()
+        pm = child.pm
+        pm.ensure_started()
+        pm.start_watchdog()
+        pm.signal(signal.SIGKILL)
+        time.sleep(0.1)  # let the watchdog observe the death
+        t0 = time.monotonic()
+        pm.stop()  # takes the lock + joins the watchdog
+        elapsed = time.monotonic() - t0
+        assert elapsed < 8.0, f"stop() took {elapsed:.1f}s"
+        assert not pm.alive()
+
+    def test_restart_is_not_reentrant_from_signal_path(self):
+        """restart() and ensure_started() both take the lock; calling
+        one from under the other would self-deadlock (the
+        threading.Lock analog of FlockReentrantError). Pin that the
+        public methods run lock-balanced: a tight interleaved sequence
+        from two threads completes promptly."""
+        child = _SleepChild()
+        pm = child.pm
+        pm.ensure_started()
+        errors = []
+
+        def churn():
+            try:
+                for _ in range(3):
+                    pm.restart()
+                    pm.ensure_started()
+                    pm.alive()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn) for _ in range(2)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        alive = [t for t in threads if t.is_alive()]
+        try:
+            assert not alive, "restart/ensure_started churn deadlocked"
+            assert not errors, errors
+            assert time.monotonic() - t0 < 30
+        finally:
+            pm.stop()
