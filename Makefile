@@ -3,7 +3,8 @@
 PYTHON ?= python
 
 .PHONY: all native test test-fast bench bench-smoke \
-	bench-placement-smoke lint lint-analysis clean stamp-version
+	bench-placement-smoke bench-chaos-smoke lint lint-analysis clean \
+	stamp-version
 
 VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
 
@@ -53,6 +54,16 @@ bench-smoke: native
 # non-slow test in tests/test_bench_placement_smoke.py.
 bench-placement-smoke:
 	BENCH_PLACEMENT_STEPS=80 $(PYTHON) bench.py --placement-sim
+
+# Chaos smoke: the claim-churn stress under a short SEEDED fault
+# schedule (kube 5xx burst, flaky prepare middle, slow fsync/flock),
+# plus a straggler-gang abort, a flapping-chip quarantine, a breaker
+# trip, and a rendezvous-barrier timeout. Exits nonzero on ANY stuck
+# claim / leaked lease / leaked carve-out / hung rendezvous; mirrored
+# as a non-slow test in tests/test_bench_chaos_smoke.py. See
+# docs/operations.md "Fault injection" for the env matrix.
+bench-chaos-smoke:
+	BENCH_CHAOS_ITERS=3 BENCH_CHAOS_ROUNDS=8 $(PYTHON) bench.py --chaos
 
 lint:
 	ruff check --select E9,F k8s_dra_driver_gpu_tpu/ tests/ bench.py __graft_entry__.py

@@ -696,6 +696,325 @@ def bench_placement_sim() -> dict:
     }
 
 
+def bench_chaos() -> dict:
+    """Chaos mode (`bench.py --chaos`): the claim-churn stress under a
+    SEEDED fault schedule, plus the two gang-scale failure scenarios the
+    unit suites can't stage at once.
+
+    Schedule (pkg/faults; seed = BENCH_CHAOS_SEED): kube API 5xx burst
+    (absorbed by RetryingKubeClient), prepare-middle faults
+    (segment:prep_devices), checkpoint-fsync + flock latency. On top:
+    a straggler node blowing the CD gang-prepare deadline (abort +
+    unwind), a flapping chip escalating into quarantine (and releasing
+    after hysteresis), a circuit-breaker trip under a hard outage, and
+    a rendezvous WAIT barrier that times out instead of hanging.
+
+    The acceptance bar this enforces: every claim ends PREPARED or
+    CLEANLY FAILED-RETRIABLE -- zero stuck checkpoint entries, zero
+    leaked carve-outs, zero leases left behind -- and the retry /
+    gang-abort / quarantine / circuit counters all moved. ``main``
+    exits nonzero when ``chaos_stuck_claims`` > 0, which is what
+    `make bench-chaos-smoke` gates CI on.
+
+    Knobs: BENCH_CHAOS_ITERS (claims per chip, default 6),
+    BENCH_CHAOS_ROUNDS (kubelet-style retry rounds, default 8),
+    BENCH_CHAOS_SEED."""
+    import concurrent.futures
+
+    from prometheus_client import generate_latest
+
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import ClaimState
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import Config
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.health import QuarantineTracker
+    from k8s_dra_driver_gpu_tpu.pkg import faults
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.metrics import (
+        DRARequestMetrics,
+        ResilienceMetrics,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.retry import (
+        CircuitBreaker,
+        RetryingKubeClient,
+        RetryPolicy,
+    )
+    from tests.fake_kube import make_claim_dict
+
+    iters = _env_int("BENCH_CHAOS_ITERS", 6)
+    rounds = _env_int("BENCH_CHAOS_ROUNDS", 8)
+    seed = _env_int("BENCH_CHAOS_SEED", 20260803)
+    faults.reset()
+    faults.reseed(seed)
+
+    resilience = ResilienceMetrics()
+    extras: dict = {"chaos_seed": seed, "chaos_iters": iters}
+
+    # -- scenario 1: claim churn through the real Driver under faults --
+    with tempfile.TemporaryDirectory() as root:
+        fake = FakeKubeClient()
+        # Fast-reset breaker: the injected 5xx burst is long enough to
+        # trip it (that's part of the proof), and the churn then rides
+        # the half-open probe back to closed once the storm passes.
+        rkube = RetryingKubeClient(
+            fake,
+            policy=RetryPolicy(base_delay=0.002, max_delay=0.02,
+                               jitter=0.2, deadline_s=5.0),
+            breaker=CircuitBreaker(threshold=5, reset_s=0.05),
+            metrics=resilience, seed=seed,
+        )
+        metrics = DRARequestMetrics()
+        driver = Driver(Config.mock(root=root, topology="v5e-4"), rkube,
+                        "chaos-node", metrics=metrics,
+                        enable_health_monitor=False)
+        state = driver.state
+
+        claims = []  # (uid, ref) -- one single-chip claim per chip slot
+        for i in range(iters):
+            for chip in range(4):
+                uid = f"chaos-{chip}-{i}"
+                obj = make_claim_dict(uid, [f"chip-{chip}"])
+                obj["metadata"]["name"] = uid
+                fake.create("resource.k8s.io", "v1", "resourceclaims",
+                            obj, namespace="default")
+                claims.append((uid, {"uid": uid, "namespace": "default",
+                                     "name": uid}))
+
+        # The fault storm. The error bursts are COUNT-capped at p=1.0
+        # (first N calls fail, then the storm passes): the smoke gate
+        # asserts the retry/recovery counters moved, so the schedule
+        # must fire deterministically even at 8-claim smoke scale.
+        # The latency faults stay probabilistic (seeded RNG) -- they
+        # shake timings, not outcomes.
+        kube_burst = max(3, len(claims) // 2)
+        faults.arm("kube.request", mode="error", count=kube_burst)
+        faults.arm("segment:prep_devices", mode="error", count=3)
+        faults.arm("ckpt.fsync", mode="latency", probability=0.3,
+                   latency=0.002)
+        faults.arm("flock.acquire", mode="latency", probability=0.3,
+                   latency=0.001)
+
+        failed_attempts = 0
+        recovered = 0
+
+        def drive(batch, op) -> dict:
+            """One kubelet-style round over ``batch``; returns uid->err
+            ('' = success)."""
+            out = {}
+            if op == "prepare":
+                for uid, (devs, err) in driver.prepare_resource_claims(
+                        [ref for _, ref in batch]).items():
+                    out[uid] = err
+            else:
+                for uid, err in driver.unprepare_resource_claims(
+                        [ref for _, ref in batch]).items():
+                    out[uid] = err
+            return out
+
+        def churn_chip(chip: int) -> tuple[int, int, list]:
+            """Per-chip worker: prepare->unprepare each claim with
+            bounded kubelet-style retries (a short backoff between
+            failed rounds, like kubelet's -- instant re-spins would
+            burn every round inside one circuit-breaker open window).
+            Returns (failed_attempts, recovered, leftover_uids)."""
+            fails = rec = 0
+            leftovers = []
+            mine = [c for c in claims if c[0].split("-")[1] == str(chip)]
+            for uid, ref in mine:
+                done = False
+                attempts = 0
+                for _ in range(rounds):
+                    attempts += 1
+                    err = drive([(uid, ref)], "prepare")[uid]
+                    if not err:
+                        done = True
+                        break
+                    fails += 1
+                    time.sleep(0.03)
+                if done and attempts > 1:
+                    rec += 1
+                if not done:
+                    leftovers.append(uid)  # cleanly failed-retriable
+                    continue
+                for _ in range(rounds):
+                    err = drive([(uid, ref)], "unprepare")[uid]
+                    if not err:
+                        break
+                    fails += 1
+                    time.sleep(0.03)
+            return fails, rec, leftovers
+
+        with concurrent.futures.ThreadPoolExecutor(4) as ex:
+            results = list(ex.map(churn_chip, range(4)))
+        for fails, rec, _ in results:
+            failed_attempts += fails
+            recovered += rec
+        never_prepared = [uid for _, _, left in results for uid in left]
+
+        # The storm passes: kubelet keeps retrying. Everything must
+        # drain -- a claim that STILL can't unprepare is stuck for real.
+        faults.reset()
+        for uid, ref in claims:
+            drive([(uid, ref)], "unprepare")
+
+        cp = state._checkpoint.get()
+        stuck_claims = len(cp.claims)
+        stuck_started = sum(
+            1 for c in cp.claims.values()
+            if c.state == ClaimState.PREPARE_STARTED.value)
+        leases_dir = os.path.join(root, "leases")
+        leaked_leases = len(os.listdir(leases_dir)) \
+            if os.path.isdir(leases_dir) else 0
+        leaked_subslices = len(state._registry.list())
+        extras.update({
+            "chaos_claims_total": len(claims),
+            "chaos_failed_attempts": failed_attempts,
+            "chaos_recovered_claims": recovered,
+            "chaos_failed_retriable": len(never_prepared),
+            "chaos_stuck_started": stuck_started,
+            "chaos_leaked_leases": leaked_leases,
+            "chaos_leaked_subslices": leaked_subslices,
+            "chaos_kube_retry_total": rkube.retry_count,
+            "chaos_churn_circuit_trips": rkube.breaker.trips,
+        })
+
+    # -- scenario 2: straggler node past the gang-prepare deadline -----
+    from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state import (
+        CDDeviceState,
+    )
+    from k8s_dra_driver_gpu_tpu.computedomain.plugin.driver import CDDriver
+    from k8s_dra_driver_gpu_tpu.computedomain import NODE_LABEL
+
+    with tempfile.TemporaryDirectory() as root:
+        fake = FakeKubeClient()
+        fake.create("", "v1", "nodes",
+                    {"metadata": {"name": "chaos-node", "labels": {}}})
+        # A 2-node domain where the peer never registers: this node's
+        # channel prepare parks on the Ready gate until the deadline.
+        fake.create("resource.tpu.dra", "v1beta1", "computedomains", {
+            "metadata": {"name": "cd", "uid": "cd-uid",
+                         "namespace": "default"},
+            "spec": {"numNodes": 2},
+            "status": {"status": "NotReady", "nodes": []},
+        }, namespace="default")
+        cd_state = CDDeviceState(root=root, kube=fake,
+                                 node_name="chaos-node",
+                                 use_informer=False)
+        cd_driver = CDDriver(cd_state, fake, "chaos-node",
+                             retry_timeout=0.4, resilience=resilience)
+        uid = "chaos-gang-claim"
+        obj = make_claim_dict(
+            uid, ["channel-0"],
+            driver="compute-domain.tpu.dra.dev",
+            configs=[{"parameters": {
+                "apiVersion": "resource.tpu.dra/v1beta1",
+                "kind": "ComputeDomainChannelConfig",
+                "domainID": "cd-uid",
+            }}],
+        )
+        obj["metadata"]["name"] = uid
+        fake.create("resource.k8s.io", "v1", "resourceclaims", obj,
+                    namespace="default")
+        out = cd_driver.prepare_resource_claims(
+            [{"uid": uid, "namespace": "default", "name": uid}])
+        gang_err = out[uid][1]
+        # While the CD lives the label must SURVIVE the abort (it is
+        # the DaemonSet bootstrap); once the user deletes the
+        # never-formed domain, the next abort reclaims it.
+        node = fake.get("", "v1", "nodes", "chaos-node")
+        label_kept = NODE_LABEL in node["metadata"].get("labels", {})
+        fake.delete("resource.tpu.dra", "v1beta1", "computedomains",
+                    "cd", namespace="default")
+        cd_driver.prepare_resource_claims(
+            [{"uid": uid, "namespace": "default", "name": uid}])
+        node = fake.get("", "v1", "nodes", "chaos-node")
+        extras.update({
+            "chaos_gang_abort_total": cd_driver.gang_aborts,
+            "chaos_gang_error_retriable": int(
+                "retriable" in gang_err.lower()),
+            "chaos_gang_label_kept_while_cd_lives": int(label_kept),
+            "chaos_gang_label_unwound": int(
+                NODE_LABEL not in node["metadata"].get("labels", {})),
+        })
+
+    # -- scenario 3: flapping chip -> quarantine (+hysteresis release) --
+    clock = [0.0]
+    quarantined = []
+    tracker = QuarantineTracker(threshold=3, window_s=60.0,
+                                hysteresis_s=120.0,
+                                on_quarantine=lambda d: (
+                                    quarantined.append(d),
+                                    resilience.quarantines.labels(d).inc()),
+                                clock=lambda: clock[0])
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.health import DeviceTaint
+    flap = [DeviceTaint(device="chip-2", key="tpu.dra.dev/thermal",
+                        value="true", effect="")]
+    for step in range(6):  # healthy/sick flapping
+        clock[0] += 5.0
+        tracker.observe(flap if step % 2 == 0 else [])
+    in_quarantine = "chip-2" in tracker.quarantined
+    clock[0] += 121.0  # clean past the hysteresis window
+    released = not tracker.observe([])
+    extras.update({
+        "chaos_quarantine_total": tracker.total_quarantines,
+        "chaos_quarantine_escalated": int(in_quarantine),
+        "chaos_quarantine_released": int(released),
+    })
+
+    # -- scenario 4: circuit breaker under a hard outage ----------------
+    breaker = CircuitBreaker(threshold=3, reset_s=0.2)
+    rk = RetryingKubeClient(
+        FakeKubeClient(),
+        policy=RetryPolicy(base_delay=0.001, max_delay=0.002,
+                           deadline_s=0.02),
+        breaker=breaker, metrics=resilience, seed=seed)
+    faults.arm("kube.request", mode="error")
+    try:
+        for _ in range(4):
+            try:
+                rk.get("", "v1", "pods", "missing")
+            except Exception:  # noqa: BLE001 - outage scenario
+                pass
+    finally:
+        faults.reset()
+    extras["chaos_circuit_open_total"] = breaker.trips
+
+    # -- scenario 5: rendezvous barrier times out, never hangs ----------
+    from k8s_dra_driver_gpu_tpu.computedomain.daemon.rendezvous import (
+        MembershipState,
+    )
+    with tempfile.TemporaryDirectory() as d:
+        members = os.path.join(d, "members.json")
+        with open(members, "w", encoding="utf-8") as f:
+            json.dump({"numWorkers": 2, "workers": [
+                {"index": 0, "status": "Ready"}]}, f)
+        ms = MembershipState(members)
+        t0 = time.perf_counter()
+        ready = ms.wait_ready(0.2)
+        waited = time.perf_counter() - t0
+        extras["chaos_rendezvous_timed_out"] = int(
+            not ready and waited < 5.0)
+
+    exposition = generate_latest(resilience.registry).decode()
+    extras["chaos_metrics_exported"] = int(
+        'tpu_dra_retry_total{verb="get"}' in exposition
+        and "tpu_dra_gang_abort_total" in exposition
+        and "tpu_dra_quarantine_total" in exposition)
+
+    stuck = (stuck_claims + leaked_leases + leaked_subslices
+             + (0 if extras["chaos_rendezvous_timed_out"] else 1))
+    total = extras["chaos_claims_total"]
+    prepared_or_clean = total - stuck_claims
+    return {
+        "metric": "chaos_stuck_claims",
+        "value": stuck,
+        "unit": "claims",
+        # Ratio of claims that ended prepared-or-cleanly-failed; 1.0 is
+        # the acceptance bar, anything lower means leaked state.
+        "vs_baseline": round(prepared_or_clean / max(total, 1), 3),
+        "extras": extras,
+    }
+
+
 def bench_lint_findings() -> dict:
     """Static-analysis finding counts (pkg/analysis linter) in the
     metrics-friendly shape BASELINE.md tracks across PRs: the bench/CI
@@ -723,6 +1042,14 @@ def bench_lint_findings() -> dict:
 def main() -> None:
     if "--placement-sim" in sys.argv[1:]:
         print(json.dumps(bench_placement_sim()))
+        return
+    if "--chaos" in sys.argv[1:]:
+        result = bench_chaos()
+        print(json.dumps(result))
+        # The CI gate (`make bench-chaos-smoke`): stuck claims or a
+        # hung rendezvous are hard failures, not trajectory dips.
+        if result["value"] > 0:
+            sys.exit(1)
         return
     extras: dict = {}
     t_start = time.monotonic()

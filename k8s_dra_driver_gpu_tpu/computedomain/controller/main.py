@@ -59,9 +59,16 @@ def run(argv: list[str] | None = None) -> int:
     # over a stale inherited V.
     os.environ["V"] = str(args.verbosity)
 
-    kube = FakeKubeClient() if args.standalone else KubeClient(
-        host=args.kube_api or None)
     metrics = ComputeDomainMetrics()
+    from ...pkg.metrics import ResilienceMetrics  # noqa: PLC0415
+    from ...pkg.retry import RetryingKubeClient  # noqa: PLC0415
+
+    resilience = ResilienceMetrics(registry=metrics.registry)
+    kube = RetryingKubeClient(
+        FakeKubeClient() if args.standalone else KubeClient(
+            host=args.kube_api or None),
+        metrics=resilience,
+    )
     metrics_server = None
     if args.metrics_port > 0:
         metrics_server = MetricsServer(metrics.registry, host="0.0.0.0",

@@ -89,9 +89,12 @@ class DaemonConfig:
 class Daemon:
     def __init__(self, config: DaemonConfig, kube=None):
         self.cfg = config
-        self.kube = kube or (
-            FakeKubeClient() if config.standalone else KubeClient()
-        )
+        if kube is None:
+            from ...pkg.retry import RetryingKubeClient  # noqa: PLC0415
+
+            kube = RetryingKubeClient(
+                FakeKubeClient() if config.standalone else KubeClient())
+        self.kube = kube
         os.makedirs(config.state_dir, exist_ok=True)
         self.members_file = os.path.join(config.state_dir, "members.json")
         self.bootstrap_file = os.path.join(config.state_dir, "bootstrap.json")

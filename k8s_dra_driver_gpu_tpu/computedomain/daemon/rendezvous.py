@@ -9,6 +9,11 @@ liveness the gang can gate on. This small TCP service provides both:
                the analog of `nvidia-imex-ctl -q` == READY)
   MEMBERS\n -> one-line JSON of the current membership (workers, ips,
                coordinator address, worker count)
+  WAIT <s>\n -> READY\n | TIMEOUT\n    (rendezvous BARRIER with a
+               deadline: blocks until quorum or <s> seconds elapse --
+               gang members gate on this instead of spinning STATUS,
+               and a straggler node past the deadline yields TIMEOUT,
+               never a hung connection)
 
 Membership lives in a JSON file the daemon rewrites on peer changes;
 SIGUSR1 reloads it without dropping connections (the reference's
@@ -29,8 +34,15 @@ import signal
 import socketserver
 import sys
 import threading
+import time
+
+from ...pkg import faults
 
 logger = logging.getLogger(__name__)
+
+# Upper bound a WAIT client may request; a typo'd huge deadline must
+# not pin a handler thread for hours.
+MAX_WAIT_S = 600.0
 
 
 class MembershipState:
@@ -38,6 +50,9 @@ class MembershipState:
         self._file = members_file
         self._lock = threading.Lock()
         self._doc: dict = {}
+        # Pulsed on every reload so WAIT barriers wake immediately on
+        # membership changes instead of polling.
+        self._changed = threading.Condition(self._lock)
         self.reload()
 
     def reload(self) -> None:
@@ -48,6 +63,7 @@ class MembershipState:
             doc = {}
         with self._lock:
             self._doc = doc
+            self._changed.notify_all()
         logger.info(
             "membership reloaded: %d/%s workers",
             len(doc.get("workers", [])), doc.get("numWorkers", "?"),
@@ -57,8 +73,8 @@ class MembershipState:
         with self._lock:
             return dict(self._doc)
 
-    def ready(self) -> bool:
-        doc = self.snapshot()
+    @staticmethod
+    def _doc_ready(doc: dict) -> bool:
         expected = doc.get("numWorkers", 0)
         workers = doc.get("workers", [])
         return (
@@ -67,17 +83,48 @@ class MembershipState:
             and all(w.get("status") == "Ready" for w in workers)
         )
 
+    def ready(self) -> bool:
+        return self._doc_ready(self.snapshot())
+
+    def wait_ready(self, timeout: float) -> bool:
+        """Rendezvous barrier: block until quorum or the deadline.
+        Returns the final ready state -- a False IS the straggler
+        signal, never an exception or a hang."""
+        deadline = time.monotonic() + min(max(timeout, 0.0), MAX_WAIT_S)
+        with self._changed:
+            while not self._doc_ready(self._doc):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                # Wake on the next reload pulse (short tick as the
+                # safety net against a missed notify).
+                self._changed.wait(min(remaining, 0.5))
+            return True
+
 
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self) -> None:
         state: MembershipState = self.server.state  # type: ignore[attr-defined]
         line = self.rfile.readline().decode(errors="replace").strip().upper()
+        # Fault seam: error mode drops the connection mid-command (the
+        # probe/barrier client sees a reset, exactly like a dying
+        # daemon); latency mode delays the answer past probe timeouts.
+        faults.fault_point("rendezvous.handle",
+                          error=lambda m: ConnectionResetError(m))
         if line == "STATUS":
             self.wfile.write(b"READY\n" if state.ready() else b"NOT_READY\n")
         elif line == "MEMBERS":
             self.wfile.write(
                 (json.dumps(state.snapshot()) + "\n").encode()
             )
+        elif line.startswith("WAIT"):
+            try:
+                timeout = float(line.split(None, 1)[1])
+            except (IndexError, ValueError):
+                self.wfile.write(b"ERR bad WAIT timeout\n")
+                return
+            ok = state.wait_ready(timeout)
+            self.wfile.write(b"READY\n" if ok else b"TIMEOUT\n")
         else:
             self.wfile.write(b"ERR unknown command\n")
 
@@ -99,6 +146,38 @@ def query(host: str, port: int, command: str, timeout: float = 3.0) -> str:
         s.sendall(command.encode() + b"\n")
         data = s.makefile().readline()
     return data.strip()
+
+
+def wait_for_quorum(host: str, port: int, deadline_s: float) -> bool:
+    """Client-side rendezvous barrier: True once the gang is READY,
+    False when ``deadline_s`` elapses first (straggler). Connection
+    errors count against the deadline and are retried -- the daemon may
+    still be starting."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        try:
+            answer = query(host, port, f"WAIT {remaining:.3f}",
+                           timeout=remaining + 2.0)
+        except OSError:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(min(0.2, deadline_s / 10))
+            continue
+        if answer == "READY":
+            return True
+        if answer == "TIMEOUT":
+            return False
+        # ERR / garbage: an old daemon without WAIT -- fall back to a
+        # STATUS poll for the rest of the budget.
+        try:
+            if query(host, port, "STATUS") == "READY":
+                return True
+        except OSError:
+            pass
+        time.sleep(min(0.2, deadline_s / 10))
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -35,7 +35,7 @@ from ...kubeletplugin.checkpoint import (
 )
 from ...kubeletplugin.claim import ResourceClaim
 from ...pkg.analysis.statemachine import SINGLE_PHASE_POLICY
-from ...pkg.kubeclient import NotFoundError
+from ...pkg.kubeclient import KubeError, NotFoundError
 from ...pkg.timing import SegmentTimer
 from ...pkg.workqueue import PermanentError
 from .. import (
@@ -467,20 +467,95 @@ class CDDeviceState:
             # pod drains (computedomain.go:312-364 removal path). The
             # daemon's own claim must not keep the label alive -- the
             # daemon only exists because of the label.
-            remaining = self._checkpoint.get().claims.values()
-            any_channels = any(
-                d.canonical_name.startswith("channel-")
-                for c in remaining
-                for d in c.devices
-            )
-            if not any_channels:
+            self._drop_node_label_if_unused()
+
+    def unwind_failed_prepare(self, claim_uid: str) -> None:
+        """Gang-abort unwind: tear down whatever a FAILED (never
+        completed) prepare left on this node -- the CDI spec, any
+        checkpoint record, and (conditionally) the daemon node label.
+
+        The label needs care in both directions. While the
+        ComputeDomain still EXISTS, the label must SURVIVE the abort:
+        it is the DaemonSet trigger, i.e. the very bootstrap that lets
+        the kubelet's next retry find a Ready gang -- dropping it on
+        every blown deadline would kill each node's daemon out of
+        phase and livelock a slow gang. But once the CD is GONE (the
+        user deleted a domain that never formed), the label is a
+        permanent leak that pins a daemon pod to a dead gang -- THAT
+        is what a blown deadline must clean up, because no unprepare
+        ever comes for a claim that never prepared.
+        Idempotent; safe to call for claims that never started."""
+        with self._lock:
+            self._cdi.delete_claim_spec_file(claim_uid)
+            if claim_uid in self._checkpoint.get().claims:
+                self._checkpoint.update(
+                    lambda c: c.claims.pop(claim_uid, None)
+                )
+        # The EVIDENCE gathering (node read + CD list) runs OUTSIDE
+        # self._lock: it is kube I/O, up to the retry deadline during
+        # the very degradation that caused the abort, and must not park
+        # every other claim operation on this node. The final
+        # check-and-drop re-takes the lock so it cannot race a
+        # concurrent channel prepare for a NEW domain that just set the
+        # label: under the lock, that prepare's completed checkpoint
+        # record is visible and vetoes the drop.
+        try:
+            node = self.kube.get("", "v1", "nodes", self.node_name)
+            labeled_cd = node.get("metadata", {}).get(
+                "labels", {}).get(NODE_LABEL)
+        except (KubeError, OSError):
+            return  # can't even read the node: change nothing
+        if labeled_cd and self._cd_definitely_gone(labeled_cd):
+            with self._lock:
+                node = None
                 try:
-                    self.kube.patch(
-                        "", "v1", "nodes", self.node_name,
-                        {"metadata": {"labels": {NODE_LABEL: None}}},
-                    )
-                except NotFoundError:
-                    pass
+                    node = self.kube.get("", "v1", "nodes",
+                                         self.node_name)
+                except (KubeError, OSError):
+                    return
+                # Re-check under the lock: a concurrent prepare may
+                # have re-pointed the label at a LIVE domain.
+                if node.get("metadata", {}).get(
+                        "labels", {}).get(NODE_LABEL) == labeled_cd:
+                    self._drop_node_label_if_unused()
+
+    def _cd_definitely_gone(self, cd_uid: str) -> bool:
+        """POSITIVE evidence that a ComputeDomain no longer exists: a
+        SUCCESSFUL apiserver list that does not contain the uid. An
+        informer cache miss is NOT evidence -- the cache is legitimately
+        empty right after a restart during an apiserver blip (informer
+        start tolerates a failed initial relist), and dropping the node
+        label on that signal would dissolve a living gang. Any API
+        error reads as 'unknown' -> keep the label (safe default; a
+        truly dead domain is reclaimed on a later abort)."""
+        try:
+            return not any(
+                cd["metadata"].get("uid") == cd_uid
+                for cd in self.kube.list(API_GROUP, API_VERSION,
+                                         "computedomains")
+            )
+        except (KubeError, OSError):
+            return False
+
+    def _drop_node_label_if_unused(self) -> None:
+        """Remove the daemon-scheduling node label when no completed
+        claim holds a channel device (call under self._lock, so the
+        checkpoint read and the patch can't interleave with a
+        concurrent prepare's label-set + completion)."""
+        remaining = self._checkpoint.get().claims.values()
+        any_channels = any(
+            d.canonical_name.startswith("channel-")
+            for c in remaining
+            for d in c.devices
+        )
+        if not any_channels:
+            try:
+                self.kube.patch(
+                    "", "v1", "nodes", self.node_name,
+                    {"metadata": {"labels": {NODE_LABEL: None}}},
+                )
+            except NotFoundError:
+                pass
 
     def prepared_claims(self):
         return self._checkpoint.get().claims

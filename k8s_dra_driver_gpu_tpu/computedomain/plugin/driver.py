@@ -15,7 +15,8 @@ import logging
 import time
 
 from ...kubeletplugin.claim import ResourceClaim
-from ...pkg.kubeclient import NotFoundError
+from ...pkg.kubeclient import KubeError, NotFoundError
+from ...pkg.retry import RETRIABLE_STATUSES
 from ...pkg.metrics import DRARequestMetrics
 from ...pkg.sliceutil import publish_resource_slices
 from ...pkg.workqueue import PermanentError, RateLimiter
@@ -39,12 +40,15 @@ class CDDriver:
         node_name: str,
         metrics: DRARequestMetrics | None = None,
         retry_timeout: float = ERROR_RETRY_MAX_TIMEOUT_S,
+        resilience=None,  # pkg.metrics.ResilienceMetrics | None
     ):
         self.state = state
         self.kube = kube
         self.node_name = node_name
         self.metrics = metrics or DRARequestMetrics()
         self.retry_timeout = retry_timeout
+        self.resilience = resilience
+        self.gang_aborts = 0  # lifetime rendezvous-deadline aborts
         self._gc_stop = None
 
     def start_background(self) -> None:
@@ -94,7 +98,19 @@ class CDDriver:
 
     def _prepare_with_retry(self, ref) -> list[dict]:
         """Bounded retry loop (the reference's per-call retry engine with
-        ErrorRetryMaxTimeout; driver.go:165-233)."""
+        ErrorRetryMaxTimeout; driver.go:165-233).
+
+        The retry budget IS the gang-prepare deadline: a channel
+        Prepare blocks on the CD rendezvous (every node of the gang
+        registered + Ready), so a straggler node parks every punctual
+        one in this loop. When the budget blows on a RETRIABLE
+        condition, the node unwinds its own prepared state (CDI spec,
+        checkpoint record, daemon node label -- see
+        CDDeviceState.unwind_failed_prepare) and reports a retriable
+        NodePrepareResources failure, instead of hanging the gang with
+        a half-labeled fleet. Kubelet retries the whole Prepare later;
+        an intact gang then goes clean end to end."""
+        uid = getattr(ref, "uid", None) or ref.get("uid")
         deadline = time.monotonic() + self.retry_timeout
         failures = 0
         while True:
@@ -112,17 +128,47 @@ class CDDriver:
                 ]
             except PermanentError:
                 raise
-            except (RetryableError, NotFoundError, OSError) as e:
+            except (RetryableError, KubeError, OSError,
+                    TimeoutError) as e:
+                # Retriable here: the gang gate (RetryableError), a
+                # claim not visible yet (404), connection trouble, and
+                # 429/5xx incl. CircuitOpenError from the retrying
+                # client -- an apiserver outage mid-gang is bounded by
+                # the same deadline instead of surfacing a raw wire
+                # error. A PERMANENT 4xx (403 RBAC, 400/422) must NOT
+                # burn the 45s budget reporting itself 'retriable'.
+                if isinstance(e, KubeError) and \
+                        not isinstance(e, NotFoundError) and \
+                        e.status not in RETRIABLE_STATUSES:
+                    raise
                 failures += 1
                 delay = RETRY_LIMITER.delay_for(failures)
                 if time.monotonic() + delay >= deadline:
+                    self._abort_gang_prepare(uid, e)
                     raise TimeoutError(
-                        f"prepare retry budget ({self.retry_timeout}s) "
-                        f"exhausted: {e}"
+                        f"gang prepare deadline ({self.retry_timeout}s) "
+                        f"exceeded; node state unwound, retriable: {e}"
                     ) from e
                 logger.info("prepare retry %d in %.2fs: %s",
                             failures, delay, e)
                 time.sleep(delay)
+
+    def _abort_gang_prepare(self, uid: str, cause: Exception) -> None:
+        """Deadline blown: unwind this node's own half-prepared state so
+        a kubelet retry starts clean (and a dissolved gang leaves no
+        daemon pods pinned by a stale node label)."""
+        self.gang_aborts += 1
+        if self.resilience is not None:
+            self.resilience.gang_aborts.inc()
+        logger.warning(
+            "gang prepare abort for claim %s after %.0fs: %s "
+            "(unwinding node-local state)", uid, self.retry_timeout,
+            cause,
+        )
+        try:
+            self.state.unwind_failed_prepare(uid)
+        except Exception:  # noqa: BLE001 - best-effort unwind
+            logger.exception("gang-abort unwind failed for %s", uid)
 
     def unprepare_resource_claims(self, claim_refs: list) -> dict:
         out = {}

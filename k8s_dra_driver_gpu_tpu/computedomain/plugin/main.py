@@ -66,8 +66,16 @@ def run(argv: list[str] | None = None) -> int:
                          __version__, args)
 
     node_name = args.node_name or os.uname().nodename
-    kube = FakeKubeClient() if args.standalone else KubeClient(
-        host=args.kube_api or None)
+    metrics = DRARequestMetrics()
+    from ...pkg.metrics import ResilienceMetrics  # noqa: PLC0415
+    from ...pkg.retry import RetryingKubeClient  # noqa: PLC0415
+
+    resilience = ResilienceMetrics(registry=metrics.registry)
+    kube = RetryingKubeClient(
+        FakeKubeClient() if args.standalone else KubeClient(
+            host=args.kube_api or None),
+        metrics=resilience,
+    )
     state = CDDeviceState(
         root=args.state_root,
         kube=kube,
@@ -76,8 +84,8 @@ def run(argv: list[str] | None = None) -> int:
         cdi_root=args.cdi_root,
         driver_namespace=args.driver_namespace,
     )
-    metrics = DRARequestMetrics()
-    driver = CDDriver(state, kube, node_name, metrics=metrics)
+    driver = CDDriver(state, kube, node_name, metrics=metrics,
+                      resilience=resilience)
     driver.publish_resources()
     driver.start_background()
 

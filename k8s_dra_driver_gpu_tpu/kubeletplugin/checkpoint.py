@@ -26,7 +26,7 @@ import zlib
 from dataclasses import dataclass, field
 from enum import Enum
 
-from ..pkg import bootid
+from ..pkg import bootid, faults
 from ..pkg.analysis.statemachine import TransitionPolicy
 from ..pkg.flock import Flock, FlockReentrantError
 from ..pkg.fsutil import stat_signature
@@ -362,10 +362,17 @@ class CheckpointManager:
             + ',"checksums":{"v1":' + str(zlib.crc32(v1.encode()))
             + ',"v2":' + str(zlib.crc32(v2.encode())) + "}}"
         )
+        # Fault seams bracketing durability: "ckpt.write" fails the
+        # whole write; "ckpt.fsync" fires AFTER the tmp file holds the
+        # bytes but BEFORE they are durable/renamed -- the
+        # crash-between-write-and-fsync window the recovery sweep must
+        # tolerate (tests/test_prepare_concurrency.py).
+        faults.fault_point("ckpt.write", error=lambda m: OSError(m))
         tmp = self._path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(doc)
             f.flush()
+            faults.fault_point("ckpt.fsync", error=lambda m: OSError(m))
             # fdatasync: the data must be durable before the rename; the
             # tmp file's metadata (mtime) need not be -- saves one
             # journal commit per write on the 2x-per-Prepare hot path.

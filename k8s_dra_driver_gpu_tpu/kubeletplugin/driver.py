@@ -43,6 +43,7 @@ class Driver:
         enable_health_monitor: bool = True,
         publication_mode: str | None = None,
         additional_ignored_health_kinds: tuple[str, ...] = (),
+        resilience=None,  # pkg.metrics.ResilienceMetrics | None
     ):
         self.state = DeviceState(config)
         self.kube = kube_client
@@ -87,11 +88,17 @@ class Driver:
                 # AER fallback path for class-less hosts (see binding.py)
                 expected_bdfs=",".join(b for _, b in baseline),
             )
+            on_quarantine = None
+            if resilience is not None:
+                on_quarantine = (
+                    lambda device: resilience.quarantines.labels(
+                        device).inc())
             self.health_monitor = ChipHealthMonitor(
                 self.state._tpulib,
                 monitor_opts,
                 self._on_health_taints,
                 additional_ignored=additional_ignored_health_kinds,
+                on_quarantine=on_quarantine,
             )
         else:
             # Health monitoring off: mark every chip observably

@@ -10,21 +10,34 @@ TPU translation: tpulib health kinds (hbm_uncorrectable, ici_link_down,
 chip_lost, thermal, ...) map to taints under tpu.dra.dev/. Non-fatal
 kinds produce Effect=None taints (observability without eviction),
 mirroring the reference's Option-A schema.
+
+Quarantine (the flapping-chip escalation the reference lacks): a chip
+that keeps emitting NON-FATAL events -- healthy, sick, healthy, sick --
+never trips the fatal path, yet every workload placed on it eats the
+flap. The QuarantineTracker counts non-fatal events per chip inside a
+sliding window; at the threshold it escalates to a
+``tpu.dra.dev/degraded`` NoSchedule taint (published through the same
+reconcile-and-republish pipeline), and only releases after the chip has
+stayed clean for a hysteresis period -- so a flapper can't oscillate in
+and out of the schedulable pool at poll frequency.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable
 
+from ..pkg import faults
 from ..tpulib.binding import EnumerateOptions, HealthEvent
 from .subslice import chip_name
 
 logger = logging.getLogger(__name__)
 
 TAINT_KEY_PREFIX = "tpu.dra.dev"
+QUARANTINE_KIND = "degraded"
 
 # Benign kinds never surfaced as NoSchedule/NoExecute (skip-list analog,
 # device_health.go:394-443).
@@ -36,6 +49,20 @@ from ..pkg import positive_float_env
 
 POLL_INTERVAL_S = positive_float_env(
     "TPU_DRA_HEALTH_POLL_S", default=5.0, floor=0.05)
+# A failing poll (tpulib enumeration error, callback bug) backs off
+# exponentially up to this cap instead of hammering a sick library --
+# and NEVER kills the poll thread.
+POLL_BACKOFF_MAX_S = positive_float_env(
+    "TPU_DRA_HEALTH_BACKOFF_MAX_S", default=60.0, floor=0.05)
+
+# Quarantine knobs: N non-fatal events within the window escalate; the
+# chip must then stay clean for the hysteresis period to untaint.
+QUARANTINE_EVENTS = int(positive_float_env(
+    "TPU_DRA_QUARANTINE_EVENTS", default=3, floor=1))
+QUARANTINE_WINDOW_S = positive_float_env(
+    "TPU_DRA_QUARANTINE_WINDOW_S", default=300.0, floor=0.05)
+QUARANTINE_HYSTERESIS_S = positive_float_env(
+    "TPU_DRA_QUARANTINE_HYSTERESIS_S", default=600.0, floor=0.05)
 
 
 @dataclass(frozen=True)
@@ -70,11 +97,118 @@ def health_event_to_taints(
     ]
 
 
+class QuarantineTracker:
+    """Escalates flapping chips to NoSchedule quarantine, with
+    hysteresis on the way back.
+
+    State machine per device:
+      healthy --(>= threshold non-fatal events inside window)--> quarantined
+      quarantined --(clean for >= hysteresis)--> healthy
+
+    ``observe(taints)`` is called once per poll with the RAW taint list
+    and returns the quarantine taints to merge in. ``on_quarantine``
+    fires once per escalation (metrics hook)."""
+
+    def __init__(
+        self,
+        threshold: int = QUARANTINE_EVENTS,
+        window_s: float = QUARANTINE_WINDOW_S,
+        hysteresis_s: float = QUARANTINE_HYSTERESIS_S,
+        on_quarantine: Callable[[str], None] | None = None,
+        clock=time.monotonic,
+    ):
+        self.threshold = max(1, int(threshold))
+        self.window_s = window_s
+        self.hysteresis_s = hysteresis_s
+        self.on_quarantine = on_quarantine
+        self._clock = clock
+        # device -> recent healthy->sick TRANSITION timestamps
+        # (window-pruned). Transitions, not per-poll presence: tpulib
+        # reports a chip's CURRENT condition every poll, so a single
+        # steady non-fatal warning would otherwise hit the threshold in
+        # `threshold` polls (~15s) -- but a steady condition is exactly
+        # the "observability without eviction" case; only FLAPPING
+        # earns quarantine.
+        self._events: dict[str, list[float]] = {}
+        # Previous poll's sick set (the edge detector).
+        self._prev_flapping: set[str] = set()
+        # device -> timestamp of the LAST observed event while
+        # quarantined (hysteresis restarts on every flap)
+        self._quarantined: dict[str, float] = {}
+        self.total_quarantines = 0
+
+    @property
+    def quarantined(self) -> frozenset[str]:
+        return frozenset(self._quarantined)
+
+    def observe(self, taints: list[DeviceTaint]) -> list[DeviceTaint]:
+        now = self._clock()
+        flapping = {
+            t.device for t in taints
+            # Non-fatal, non-quarantine signals only: fatal events carry
+            # their own NoExecute taint, and our own degraded taint must
+            # not feed back into the event count.
+            if not t.effect and t.key != f"{TAINT_KEY_PREFIX}/{QUARANTINE_KIND}"
+        }
+        for device in flapping:
+            if device in self._quarantined:
+                # ANY presence (steady or edge) restarts hysteresis: a
+                # chip must be fully clean to earn release.
+                self._quarantined[device] = now
+                continue
+            if device in self._prev_flapping:
+                continue  # steady condition, not a new flap
+            events = self._events.setdefault(device, [])
+            events.append(now)
+        self._prev_flapping = flapping
+        # Window prune + escalation.
+        for device, events in list(self._events.items()):
+            events[:] = [t for t in events if now - t <= self.window_s]
+            if not events:
+                del self._events[device]
+                continue
+            if len(events) >= self.threshold and \
+                    device not in self._quarantined:
+                self._quarantined[device] = now
+                del self._events[device]
+                self.total_quarantines += 1
+                logger.warning(
+                    "quarantining %s: %d non-fatal health events within "
+                    "%.0fs (NoSchedule until clean for %.0fs)",
+                    device, self.threshold, self.window_s,
+                    self.hysteresis_s,
+                )
+                if self.on_quarantine is not None:
+                    try:
+                        self.on_quarantine(device)
+                    except Exception:  # noqa: BLE001 - metrics hook
+                        logger.exception("quarantine hook failed")
+        # Hysteresis release: clean for the full period.
+        for device, last_event in list(self._quarantined.items()):
+            if device not in flapping and \
+                    now - last_event >= self.hysteresis_s:
+                del self._quarantined[device]
+                logger.warning(
+                    "releasing %s from quarantine (clean for %.0fs)",
+                    device, now - last_event,
+                )
+        return [
+            DeviceTaint(
+                device=device,
+                key=f"{TAINT_KEY_PREFIX}/{QUARANTINE_KIND}",
+                value="true",
+                effect="NoSchedule",
+            )
+            for device in sorted(self._quarantined)
+        ]
+
+
 class ChipHealthMonitor:
     """Polls tpulib health and pushes taint updates to a callback.
 
-    The callback receives the full current taint list (per poll), so the
-    consumer can reconcile (add + clear) rather than accumulate.
+    The callback receives the full current taint list (per poll) --
+    raw event taints plus quarantine escalations -- so the consumer can
+    reconcile (add + clear) rather than accumulate.
     """
 
     def __init__(
@@ -85,6 +219,8 @@ class ChipHealthMonitor:
         ignored_kinds: frozenset[str] = DEFAULT_IGNORED_KINDS,
         additional_ignored: tuple[str, ...] = (),
         poll_interval: float = POLL_INTERVAL_S,
+        quarantine: QuarantineTracker | None = None,
+        on_quarantine: Callable[[str], None] | None = None,
     ):
         self._tpulib = tpulib
         self._opts = opts
@@ -96,6 +232,9 @@ class ChipHealthMonitor:
             target=self._run, name="chip-health", daemon=True
         )
         self._last: list[DeviceTaint] | None = None
+        self.quarantine = quarantine or QuarantineTracker(
+            on_quarantine=on_quarantine)
+        self.consecutive_failures = 0
 
     def start(self) -> None:
         self._thread.start()
@@ -106,19 +245,56 @@ class ChipHealthMonitor:
             self._thread.join(timeout=self._interval + 1)
 
     def poll_once(self) -> list[DeviceTaint]:
+        # Fault seam: the chaos suite's flapping-chip and sick-tpulib
+        # scenarios act here (error mode must NOT kill the poll thread;
+        # see _run's backoff).
+        faults.fault_point("health.poll")
         events = self._tpulib.health(self._opts)
         taints: list[DeviceTaint] = []
         for ev in events:
             taints.extend(health_event_to_taints(ev, self._ignored))
         return taints
 
+    def poll_and_reconcile(self) -> list[DeviceTaint]:
+        """One poll + quarantine pass: the merged taint list the
+        callback sees (also the direct-drive entry for tests/bench)."""
+        taints = self.poll_once()
+        return taints + self.quarantine.observe(taints)
+
+    def _backoff(self) -> float:
+        """Current sleep: the base interval, doubled per consecutive
+        failure (capped) so a dying tpulib isn't hammered at full poll
+        rate forever."""
+        if self.consecutive_failures == 0:
+            return self._interval
+        return min(
+            self._interval * (2 ** min(self.consecutive_failures, 16)),
+            max(POLL_BACKOFF_MAX_S, self._interval),
+        )
+
     def _run(self) -> None:
-        while not self._stop.wait(self._interval):
+        while not self._stop.wait(self._backoff()):
+            # The WHOLE body is guarded: an exception from tpulib
+            # enumeration -- or from the consumer's callback -- logs and
+            # backs off instead of silently killing the poll thread (a
+            # dead monitor reads as "all healthy" forever).
             try:
-                taints = self.poll_once()
+                taints = self.poll_and_reconcile()
             except Exception:  # noqa: BLE001 - monitor must survive
-                logger.exception("health poll failed")
+                self.consecutive_failures += 1
+                logger.exception(
+                    "health poll failed (%d consecutive; next attempt "
+                    "in %.1fs)", self.consecutive_failures,
+                    self._backoff())
                 continue
+            self.consecutive_failures = 0
             if taints != self._last:
                 self._last = taints
-                self._on_taints(taints)
+                try:
+                    self._on_taints(taints)
+                except Exception:  # noqa: BLE001 - consumer bug
+                    # Re-deliver next poll: _last must not claim this
+                    # list was delivered.
+                    self._last = None
+                    self.consecutive_failures += 1
+                    logger.exception("health taint callback failed")

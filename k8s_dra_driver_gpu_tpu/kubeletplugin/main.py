@@ -132,10 +132,19 @@ def run(argv: list[str] | None = None) -> int:
     )
     node_name = args.node_name or os.uname().nodename
 
-    kube = FakeKubeClient() if args.standalone else KubeClient(
-        host=args.kube_api or None
-    )
     metrics = DRARequestMetrics()
+    # Retry/breaker/quarantine counters share the request-metrics
+    # registry so one /metrics endpoint carries the whole story.
+    from ..pkg.metrics import ResilienceMetrics  # noqa: PLC0415
+    from ..pkg.retry import RetryingKubeClient  # noqa: PLC0415
+
+    resilience = ResilienceMetrics(registry=metrics.registry)
+    kube = RetryingKubeClient(
+        FakeKubeClient() if args.standalone else KubeClient(
+            host=args.kube_api or None
+        ),
+        metrics=resilience,
+    )
     ignored = tuple(
         k.strip()
         for k in args.additional_health_kinds_to_ignore.split(",")
@@ -144,7 +153,8 @@ def run(argv: list[str] | None = None) -> int:
     driver = Driver(config, kube, node_name, metrics=metrics,
                     publication_mode=(None if args.publication_mode == "auto"
                                       else args.publication_mode),
-                    additional_ignored_health_kinds=ignored)
+                    additional_ignored_health_kinds=ignored,
+                    resilience=resilience)
 
     server = PluginServer(
         DRIVER_NAME,

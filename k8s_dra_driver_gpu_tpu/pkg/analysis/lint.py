@@ -32,6 +32,12 @@ nothing previously enforced. Rules carry stable IDs:
   ``CheckpointManager`` without an explicit ``transition_policy=``
   keyword opts the call site out of the checkpoint state-machine
   validator silently.
+- **TPUDRA008** raw kube client: constructing ``KubeClient`` outside a
+  ``RetryingKubeClient(...)`` wrap (pkg/retry.py) hands production code
+  a client with no backoff, no deadline discipline, and no circuit
+  breaker; kube verb calls on such a raw client without an explicit
+  ``timeout=`` are flagged too (they park threads on the urllib
+  default when the apiserver wedges).
 
 Suppression: per-line ``# tpudra: allow=TPUDRA002[,TPUDRA003] reason``
 comments, or the committed baseline file (``analysis-baseline.json``)
@@ -60,6 +66,8 @@ RULES: dict[str, str] = {
                  "object without deep copy",
     "TPUDRA007": "CheckpointManager constructed without an explicit "
                  "transition_policy",
+    "TPUDRA008": "raw KubeClient outside the RetryingKubeClient "
+                 "wrapper (or kube call without an explicit timeout)",
 }
 
 # Lock model (docs/architecture.md "Locking hierarchy"). Matched on the
@@ -78,6 +86,9 @@ _META_KEYS = {"metadata", "spec", "status"}
 # Files allowed to spell the state literals: the enum definition, the
 # declarative model, and this linter's own rule table.
 _STATE_LITERAL_FILES = {"checkpoint.py", "statemachine.py", "lint.py"}
+# Files allowed to construct a raw KubeClient: the client's own module
+# and the retry wrapper that sanctions it (TPUDRA008 scope).
+_RAW_KUBECLIENT_FILES = {"kubeclient.py", "retry.py"}
 _STATE_LITERALS = {"PrepareStarted", "PrepareCompleted"}
 # Copy constructors that launder taint (deep or top-level).
 _COPY_CALLS = {"json_copy", "deepcopy", "dict", "list", "sorted",
@@ -219,6 +230,9 @@ class _FuncState:
         self.released_in_finally: set[str] = set()
         self.exit_in_finally = False
         self.api_params: set[str] = set()
+        # Locals bound to a RAW (unwrapped) KubeClient(...): verb calls
+        # on them without an explicit timeout are TPUDRA008 findings.
+        self.raw_kube: set[str] = set()
 
 
 class _ModuleLinter(ast.NodeVisitor):
@@ -434,6 +448,30 @@ class _ModuleLinter(ast.NodeVisitor):
         # json.loads(json.dumps(x)) spelled out
         return name == "loads"
 
+    # -- kube client model (TPUDRA008) ----------------------------------------
+
+    @staticmethod
+    def _is_kubeclient_ctor(node: ast.AST) -> bool:
+        """``KubeClient(...)``, ``kubeclient.KubeClient(...)``, or
+        ``KubeClient.from_kubeconfig(...)`` -- the raw-client entry
+        points. FakeKubeClient is exempt: the rule polices production
+        transport, and the retry wrapper accepts fakes anyway."""
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        if isinstance(func, ast.Name):
+            return func.id == "KubeClient"
+        if isinstance(func, ast.Attribute):
+            if func.attr == "KubeClient":
+                return True
+            if func.attr == "from_kubeconfig":
+                base = func.value
+                return (isinstance(base, ast.Name)
+                        and base.id == "KubeClient") or (
+                            isinstance(base, ast.Attribute)
+                            and base.attr == "KubeClient")
+        return False
+
     # -- lock model -----------------------------------------------------------
 
     def _classify_acquisition(self, expr: ast.AST):
@@ -502,6 +540,29 @@ class _ModuleLinter(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call) -> None:
         func = node.func
+
+        # TPUDRA008 plumbing: a RetryingKubeClient(...) call sanctions
+        # every KubeClient construction anywhere inside its arguments
+        # (incl. `Fake() if standalone else KubeClient()` conditionals).
+        wrapper_name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if wrapper_name == "RetryingKubeClient":
+            for sub in ast.walk(node):
+                if sub is not node and self._is_kubeclient_ctor(sub):
+                    sub._tpudra_wrapped = True  # type: ignore[attr-defined]
+
+        # TPUDRA008: raw KubeClient construction outside the wrapper.
+        if self._is_kubeclient_ctor(node) and \
+                not getattr(node, "_tpudra_wrapped", False) and \
+                self.basename not in _RAW_KUBECLIENT_FILES:
+            self._emit(
+                "TPUDRA008", node,
+                "raw KubeClient constructed outside RetryingKubeClient: "
+                "no backoff/deadline/circuit-breaker discipline "
+                "(pkg/retry.py)",
+                key="KubeClient",
+            )
+
         if isinstance(func, ast.Attribute):
             attr = func.attr
             base_src = _unparse(func.value)
@@ -566,6 +627,22 @@ class _ModuleLinter(ast.NodeVisitor):
                         key=f"{holder.key}:{blocking}",
                     )
 
+            # TPUDRA008 (second half): a kube verb on a raw (unwrapped)
+            # KubeClient local without an explicit timeout parks the
+            # calling thread on the urllib default when the apiserver
+            # wedges -- the retry wrapper injects one per attempt.
+            fs = self._fs()
+            if fs is not None and attr in _KUBE_VERBS and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id in fs.raw_kube and \
+                    not any(kw.arg == "timeout" for kw in node.keywords):
+                self._emit(
+                    "TPUDRA008", node,
+                    f"kube {attr}() on raw client {func.value.id!r} "
+                    "without an explicit timeout=",
+                    key=f"{func.value.id}.{attr}:timeout",
+                )
+
             # TPUDRA006: mutator method on a tainted object.
             if attr in _MUTATORS and self._is_tainted(func.value):
                 self._emit(
@@ -606,6 +683,15 @@ class _ModuleLinter(ast.NodeVisitor):
     def visit_Assign(self, node: ast.Assign) -> None:
         fs = self._fs()
         if fs is not None:
+            # TPUDRA008 bookkeeping: locals bound to a raw KubeClient.
+            raw_ctor = self._is_kubeclient_ctor(node.value) and \
+                not getattr(node.value, "_tpudra_wrapped", False)
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    if raw_ctor:
+                        fs.raw_kube.add(target.id)
+                    else:
+                        fs.raw_kube.discard(target.id)
             value_tainted = self._is_tainted(node.value) and \
                 not self._is_copy_call(node.value)
             for target in node.targets:

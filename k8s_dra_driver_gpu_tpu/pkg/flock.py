@@ -17,6 +17,8 @@ import os
 import threading
 import time
 
+from . import faults
+
 
 class FlockTimeoutError(TimeoutError):
     """Raised when the lock cannot be acquired within the timeout."""
@@ -72,6 +74,12 @@ class Flock:
                 f"thread {self._owner} already holds {self._path}; "
                 "Flock is not re-entrant"
             )
+        # Fault seam: latency here simulates cross-process lock
+        # contention; error simulates a wedged holder (the caller sees
+        # the same FlockTimeoutError a real 10s stall produces).
+        faults.fault_point(
+            "flock.acquire",
+            error=lambda m: FlockTimeoutError(f"{m} ({self._path})"))
         deadline = time.monotonic() + timeout
         # Honor timeout/cancel for intra-process contention from OTHER
         # threads (the thread lock is non-reentrant; the holding thread

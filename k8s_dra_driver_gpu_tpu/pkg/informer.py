@@ -72,6 +72,10 @@ class Informer:
                 self.group, self.version, self.resource,
                 self._on_watch_event,
                 namespace=self.namespace, stop=self._stop,
+                # Watch-gap (410 Gone / ERROR event): events from the
+                # gap are never replayed, so relist NOW instead of
+                # serving a stale cache until the next periodic resync.
+                on_gap=self._relist_on_gap,
             )
             t = threading.Thread(
                 target=self._resync_loop,
@@ -130,6 +134,14 @@ class Informer:
         if obj.get("kind") not in (self.kind, None):
             return
         self.relist()
+
+    def _relist_on_gap(self) -> None:
+        if self._stop.is_set():
+            return
+        try:
+            self.relist()
+        except Exception:  # noqa: BLE001 - the resync loop converges
+            logger.exception("relist after watch gap failed")
 
     def _resync_loop(self) -> None:
         while not self._stop.wait(self.resync_period):

@@ -22,6 +22,8 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterator
 
+from . import faults
+
 logger = logging.getLogger(__name__)
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -205,15 +207,21 @@ class KubeClient:
             raise KubeError(e.code, msg) from e
 
     # -- typed surface --------------------------------------------------------
+    # Every verb accepts an explicit per-attempt ``timeout`` (seconds);
+    # the RetryingKubeClient wrapper (pkg/retry.py) supplies one on each
+    # attempt so no call can park a thread for the urllib default.
 
-    def get(self, group, version, resource, name, namespace=None) -> dict:
+    def get(self, group, version, resource, name, namespace=None,
+            timeout: float = 30.0) -> dict:
         return self._request(
-            "GET", _resource_path(group, version, resource, namespace, name)
+            "GET", _resource_path(group, version, resource, namespace, name),
+            timeout=timeout,
         )
 
     def list(self, group, version, resource, namespace=None,
              label_selector: str | None = None,
-             field_selector: str | None = None) -> list[dict]:
+             field_selector: str | None = None,
+             timeout: float = 30.0) -> list[dict]:
         path = _resource_path(group, version, resource, namespace, None)
         query = []
         if label_selector:
@@ -224,37 +232,43 @@ class KubeClient:
                 f"fieldSelector={urllib.request.quote(field_selector)}")
         if query:
             path += "?" + "&".join(query)
-        return self._request("GET", path).get("items", [])
+        return self._request("GET", path, timeout=timeout).get("items", [])
 
-    def create(self, group, version, resource, obj, namespace=None) -> dict:
+    def create(self, group, version, resource, obj, namespace=None,
+               timeout: float = 30.0) -> dict:
         return self._request(
             "POST", _resource_path(group, version, resource, namespace, None),
-            body=obj,
+            body=obj, timeout=timeout,
         )
 
-    def update(self, group, version, resource, name, obj, namespace=None) -> dict:
+    def update(self, group, version, resource, name, obj, namespace=None,
+               timeout: float = 30.0) -> dict:
         return self._request(
             "PUT", _resource_path(group, version, resource, namespace, name),
-            body=obj,
+            body=obj, timeout=timeout,
         )
 
-    def patch(self, group, version, resource, name, patch, namespace=None) -> dict:
+    def patch(self, group, version, resource, name, patch, namespace=None,
+              timeout: float = 30.0) -> dict:
         return self._request(
             "PATCH", _resource_path(group, version, resource, namespace, name),
             body=patch, content_type="application/merge-patch+json",
+            timeout=timeout,
         )
 
-    def delete(self, group, version, resource, name, namespace=None) -> None:
+    def delete(self, group, version, resource, name, namespace=None,
+               timeout: float = 30.0) -> None:
         try:
             self._request(
                 "DELETE",
                 _resource_path(group, version, resource, namespace, name),
+                timeout=timeout,
             )
         except NotFoundError:
             pass
 
-    def server_version(self) -> dict:
-        return self._request("GET", "/version")
+    def server_version(self, timeout: float = 30.0) -> dict:
+        return self._request("GET", "/version", timeout=timeout)
 
     # -- watch ----------------------------------------------------------------
 
@@ -267,6 +281,7 @@ class KubeClient:
         namespace: str | None = None,
         stop: threading.Event | None = None,
         reconnect_delay: float = 2.0,
+        on_gap: Callable[[], None] | None = None,
     ) -> threading.Thread:
         """Streamed watch (chunked JSON lines, `?watch=true`), with
         resourceVersion bookmarking and automatic reconnect. Events are
@@ -274,10 +289,21 @@ class KubeClient:
         FakeKubeClient watchers. Returns the (daemon) watch thread.
 
         After a 410 Gone (resourceVersion aged out of the watch cache)
-        the stream resumes from "now" without replaying the gap, so
-        consumers MUST pair the watch with a periodic relist/resync to
-        converge on anything missed (informer-style)."""
+        the stream resumes from "now" without replaying the gap --
+        ``on_gap`` fires at that moment so the consumer can RELIST
+        immediately (informer-style) instead of waiting for its periodic
+        resync; consumers without on_gap MUST still pair the watch with
+        a resync to converge on anything missed."""
         stop = stop or threading.Event()
+
+        def gap():
+            if on_gap is None:
+                return
+            try:
+                on_gap()
+            except Exception:  # noqa: BLE001
+                logger.exception("watch gap callback failed for %s",
+                                 resource)
 
         def run():
             resource_version = ""
@@ -293,6 +319,11 @@ class KubeClient:
                 if self._token:
                     req.add_header("Authorization", f"Bearer {self._token}")
                 try:
+                    # Fault seam: error mode simulates a broken watch
+                    # stream (apiserver blip); the reconnect + gap
+                    # handling below is exactly what it exercises.
+                    faults.fault_point("kube.watch",
+                                       error=lambda m: OSError(m))
                     with urllib.request.urlopen(
                         req, timeout=300, context=self._ssl
                     ) as resp:
@@ -316,6 +347,7 @@ class KubeClient:
                                 continue
                             if ev_type == "ERROR":
                                 resource_version = ""  # relist from now
+                                gap()
                                 break
                             if not ev_type or not obj.get("metadata"):
                                 continue  # not a usable watch event
@@ -333,8 +365,10 @@ class KubeClient:
                         # (long disconnect): drop the bookmark and
                         # re-watch from "now" instead of redialing with
                         # the stale version forever. Events from the gap
-                        # are NOT replayed -- see the docstring.
+                        # are NOT replayed -- on_gap lets the consumer
+                        # relist right away.
                         resource_version = ""
+                        gap()
                 except (urllib.error.URLError, OSError, TimeoutError):
                     pass
                 stop.wait(reconnect_delay)
@@ -400,8 +434,12 @@ class FakeKubeClient:
             ]
 
     # -- surface --------------------------------------------------------------
+    # ``timeout`` mirrors the real client's per-attempt timeout and is
+    # ignored (in-memory store); keeping the signatures identical lets
+    # the RetryingKubeClient wrapper treat both clients uniformly.
 
-    def get(self, group, version, resource, name, namespace=None) -> dict:
+    def get(self, group, version, resource, name, namespace=None,
+            timeout: float = 30.0) -> dict:
         with self._lock:
             obj = self._store.get(self._key(group, resource, namespace, name))
             if obj is None:
@@ -410,7 +448,8 @@ class FakeKubeClient:
 
     def list(self, group, version, resource, namespace=None,
              label_selector: str | None = None,
-             field_selector: str | None = None) -> list[dict]:
+             field_selector: str | None = None,
+             timeout: float = 30.0) -> list[dict]:
         sel = {}
         if label_selector:
             for part in label_selector.split(","):
@@ -444,7 +483,8 @@ class FakeKubeClient:
                     out.append(json.loads(json.dumps(obj)))
             return out
 
-    def create(self, group, version, resource, obj, namespace=None) -> dict:
+    def create(self, group, version, resource, obj, namespace=None,
+               timeout: float = 30.0) -> dict:
         name = obj.get("metadata", {}).get("name", "")
         key = self._key(group, resource, namespace, name)
         with self._lock:
@@ -462,7 +502,8 @@ class FakeKubeClient:
         self._notify("ADDED", obj, group, resource, namespace or "")
         return json.loads(json.dumps(obj))
 
-    def update(self, group, version, resource, name, obj, namespace=None) -> dict:
+    def update(self, group, version, resource, name, obj, namespace=None,
+               timeout: float = 30.0) -> dict:
         key = self._key(group, resource, namespace, name)
         with self._lock:
             if key not in self._store:
@@ -488,7 +529,8 @@ class FakeKubeClient:
         self._notify("MODIFIED", obj, group, resource, namespace or "")
         return json.loads(json.dumps(obj))
 
-    def patch(self, group, version, resource, name, patch, namespace=None) -> dict:
+    def patch(self, group, version, resource, name, patch, namespace=None,
+              timeout: float = 30.0) -> dict:
         def merge(dst, src):
             for k, v in src.items():
                 if v is None:
@@ -517,7 +559,8 @@ class FakeKubeClient:
         self._notify("MODIFIED", out, group, resource, namespace or "")
         return out
 
-    def delete(self, group, version, resource, name, namespace=None) -> None:
+    def delete(self, group, version, resource, name, namespace=None,
+               timeout: float = 30.0) -> None:
         key = self._key(group, resource, namespace, name)
         with self._lock:
             obj = self._store.pop(key, None)
@@ -533,7 +576,7 @@ class FakeKubeClient:
         for (g, r, ns, _), victim in cascade:
             self._notify("DELETED", victim, g, r, ns)
 
-    def server_version(self) -> dict:
+    def server_version(self, timeout: float = 30.0) -> dict:
         return self.version
 
     def read_raw(self, path: str, timeout: float = 30.0) -> str:

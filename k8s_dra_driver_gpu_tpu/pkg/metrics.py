@@ -112,6 +112,46 @@ class DRARequestMetrics:
             self.in_flight.labels(operation).dec()
 
 
+class ResilienceMetrics:
+    """Retry / circuit-breaker / gang-abort / quarantine observability
+    (the resilience layer, pkg/retry.py + kubeletplugin/health.py +
+    computedomain/plugin/driver.py).
+
+    Every self-healing decision the stack takes under failure shows up
+    here: a rising ``retry_total`` is an apiserver (or network) getting
+    sick, ``circuit_open_total`` is it being DOWN, ``gang_abort_total``
+    is straggler nodes blowing multi-host prepare deadlines, and
+    ``quarantine_total`` is chips flapping their way out of the
+    schedulable pool."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.retries = Counter(
+            "tpu_dra_retry_total",
+            "Retried kube API attempts by verb (RetryingKubeClient).",
+            ["verb"],
+            registry=self.registry,
+        )
+        self.circuit_open = Counter(
+            "tpu_dra_circuit_open_total",
+            "Times the kube circuit breaker tripped open.",
+            registry=self.registry,
+        )
+        self.gang_aborts = Counter(
+            "tpu_dra_gang_abort_total",
+            "Gang prepares aborted at the rendezvous deadline (own "
+            "node's state unwound, failure reported retriable).",
+            registry=self.registry,
+        )
+        self.quarantines = Counter(
+            "tpu_dra_quarantine_total",
+            "Chips escalated to NoSchedule quarantine after repeated "
+            "non-fatal health events.",
+            ["device"],
+            registry=self.registry,
+        )
+
+
 class PlacementMetrics:
     """Topology-aware placement observability (pkg/topology).
 

@@ -1257,7 +1257,15 @@ def main(argv: list[str] | None = None) -> int:
         server = MetricsServer(metrics.registry, host="0.0.0.0",
                                port=args.metrics_port)
         server.start()
-    sched = DraScheduler(KubeClient(host=args.kube_api),
+    from .retry import RetryingKubeClient  # noqa: PLC0415
+
+    resilience = None
+    if server is not None:
+        from .metrics import ResilienceMetrics  # noqa: PLC0415
+
+        resilience = ResilienceMetrics(registry=metrics.registry)
+    sched = DraScheduler(RetryingKubeClient(KubeClient(host=args.kube_api),
+                                            metrics=resilience),
                          default_node=args.default_node,
                          metrics=metrics)
     print("scheduler running", flush=True)

@@ -14,6 +14,8 @@ import os
 import time
 from contextlib import contextmanager
 
+from . import faults
+
 logger = logging.getLogger(__name__)
 
 # Fault-injection seams (robustness tests; the bats-suite kill-9 sweep
@@ -21,6 +23,9 @@ logger = logging.getLogger(__name__)
 # the named segment and only when the env var is set:
 #   TPU_DRA_CRASH_AT_SEGMENT=<name>  -> os._exit(86)  (SIGKILL analog)
 #   TPU_DRA_STALL_AT_SEGMENT=<name> [TPU_DRA_STALL_SECONDS=N] -> sleep
+# The pkg/faults registry supersedes both for new tests: every segment
+# is also the fault point "segment:<name>" (error/crash/latency modes,
+# probability + count, seeded schedules -- see docs/operations.md).
 ENV_CRASH_AT = "TPU_DRA_CRASH_AT_SEGMENT"
 ENV_STALL_AT = "TPU_DRA_STALL_AT_SEGMENT"
 ENV_STALL_SECONDS = "TPU_DRA_STALL_SECONDS"
@@ -42,6 +47,7 @@ class SegmentTimer:
             os._exit(86)
         if os.environ.get(ENV_STALL_AT) == name:
             time.sleep(float(os.environ.get(ENV_STALL_SECONDS, "5")))
+        faults.fault_point(f"segment:{name}")
         t0 = time.monotonic()
         try:
             yield

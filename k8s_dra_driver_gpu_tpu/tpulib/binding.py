@@ -17,6 +17,8 @@ import re
 import subprocess
 from dataclasses import dataclass, field
 
+from ..pkg.faults import fault_point as _fault_point
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libtpuinfo.so")
 
@@ -185,6 +187,8 @@ class NativeTpuLib:
             self._lib.tpuinfo_free(ptr)
 
     def enumerate(self, opts: EnumerateOptions | None = None) -> TpuHostInfo:
+        _fault_point("tpulib.enumerate",
+                     error=lambda m: TpuLibError(m))
         return _host_from_json(
             self._call("tpuinfo_enumerate", opts or EnumerateOptions.from_env())
         )
@@ -207,6 +211,7 @@ class NativeTpuLib:
         )
 
     def health(self, opts: EnumerateOptions | None = None) -> tuple[HealthEvent, ...]:
+        _fault_point("tpulib.health", error=lambda m: TpuLibError(m))
         doc = self._call("tpuinfo_health", opts or EnumerateOptions.from_env())
         return tuple(
             HealthEvent(chip=e["chip"], kind=e["kind"], fatal=e["fatal"])
@@ -326,6 +331,8 @@ class PyTpuLib:
         return "0.1.0"
 
     def enumerate(self, opts: EnumerateOptions | None = None) -> TpuHostInfo:
+        _fault_point("tpulib.enumerate",
+                     error=lambda m: TpuLibError(m))
         opts = opts or EnumerateOptions.from_env()
         if opts.mock_topology:
             return self._mock(opts)
@@ -484,6 +491,7 @@ class PyTpuLib:
         return tuple(profiles)
 
     def health(self, opts: EnumerateOptions | None = None) -> tuple[HealthEvent, ...]:
+        _fault_point("tpulib.health", error=lambda m: TpuLibError(m))
         opts = opts or EnumerateOptions.from_env()
         events = []
         spec = opts.health_events or ""

@@ -178,8 +178,11 @@ def main(argv: list[str] | None = None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
-    return run(KubeClient(), args.service, args.namespace,
-               args.secret_name, args.webhook_config, mode=args.mode)
+    from ..pkg.retry import RetryingKubeClient  # noqa: PLC0415
+
+    return run(RetryingKubeClient(KubeClient()), args.service,
+               args.namespace, args.secret_name, args.webhook_config,
+               mode=args.mode)
 
 
 if __name__ == "__main__":

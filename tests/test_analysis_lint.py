@@ -286,6 +286,65 @@ class TestCheckpointManagerRule:
         assert lint_source(src, rel="kubeletplugin/checkpoint.py") == []
 
 
+class TestRawKubeClientRule:
+    """TPUDRA008: raw KubeClient outside the RetryingKubeClient wrap,
+    and kube verbs on a raw client without an explicit timeout."""
+
+    def test_raw_construction_flagged(self):
+        src = ("def main():\n"
+               "    kube = KubeClient(host='https://x')\n")
+        findings = lint_source(src, rel="pkg/somewhere.py")
+        assert "TPUDRA008" in rules_of(findings)
+
+    def test_wrapped_construction_clean(self):
+        src = ("def main():\n"
+               "    kube = RetryingKubeClient(KubeClient(host='x'))\n")
+        assert lint_source(src, rel="pkg/somewhere.py") == []
+
+    def test_conditional_fake_or_real_inside_wrapper_clean(self):
+        # The standard main-entry shape: the wrapper sanctions every
+        # ctor anywhere inside its argument tree.
+        src = ("def main(standalone):\n"
+               "    kube = RetryingKubeClient(\n"
+               "        FakeKubeClient() if standalone else KubeClient())\n")
+        assert lint_source(src, rel="pkg/somewhere.py") == []
+
+    def test_from_kubeconfig_flagged(self):
+        src = ("def main():\n"
+               "    kube = KubeClient.from_kubeconfig('/tmp/kc')\n")
+        findings = lint_source(src, rel="pkg/somewhere.py")
+        assert "TPUDRA008" in rules_of(findings)
+
+    def test_fake_client_not_flagged(self):
+        src = ("def main():\n"
+               "    kube = FakeKubeClient()\n"
+               "    kube.get('', 'v1', 'pods', 'p')\n")
+        assert lint_source(src, rel="pkg/somewhere.py") == []
+
+    def test_verb_without_timeout_on_raw_client_flagged(self):
+        src = ("def main():\n"
+               "    kube = KubeClient()\n"
+               "    kube.list('', 'v1', 'pods')\n")
+        findings = lint_source(src, rel="pkg/somewhere.py")
+        eights = [f for f in findings if f.rule == "TPUDRA008"]
+        assert len(eights) == 2  # the ctor AND the timeout-less verb
+
+    def test_verb_with_timeout_on_raw_client_single_finding(self):
+        src = ("def main():\n"
+               "    kube = KubeClient()\n"
+               "    kube.list('', 'v1', 'pods', timeout=5.0)\n")
+        findings = lint_source(src, rel="pkg/somewhere.py")
+        eights = [f for f in findings if f.rule == "TPUDRA008"]
+        assert len(eights) == 1  # only the raw ctor
+
+    def test_definition_modules_exempt(self):
+        src = "client = KubeClient(host='x')\n"
+        assert lint_source(src, rel="pkg/kubeclient.py") == []
+        assert lint_source(src, rel="pkg/retry.py") == []
+        assert "TPUDRA008" in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+
 class TestSuppression:
     SRC_BAD = "def bad(lock):\n    lock.acquire(timeout=1.0)\n"
 

@@ -1,0 +1,55 @@
+"""Tier-1 chaos smoke: the `make bench-chaos-smoke` contract as a
+non-slow test. Runs bench.py --chaos with a short seeded fault schedule
+and asserts the resilience layer's acceptance bar: every claim prepared
+or cleanly failed-retriable (zero stuck/leaked state), AND the
+retry / gang-abort / quarantine / circuit-breaker counters all moved --
+a schedule that silently stops injecting would otherwise read as
+"everything recovered"."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-chaos-smoke target.
+SMOKE_ENV = {
+    "BENCH_CHAOS_ITERS": "3",
+    "BENCH_CHAOS_ROUNDS": "8",
+}
+
+
+def test_bench_chaos_smoke_recovers_every_claim():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--chaos"],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV},
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "chaos_stuck_claims"
+    # THE acceptance bar: nothing stuck, nothing leaked, no hang.
+    assert doc["value"] == 0
+    extras = doc["extras"]
+    assert extras["chaos_stuck_started"] == 0
+    assert extras["chaos_leaked_leases"] == 0
+    assert extras["chaos_leaked_subslices"] == 0
+    assert extras["chaos_rendezvous_timed_out"] == 1
+
+    # The schedule actually injected, and the stack actually recovered.
+    assert extras["chaos_failed_attempts"] > 0
+    assert extras["chaos_recovered_claims"] > 0
+    assert extras["chaos_claims_total"] >= 12
+
+    # Every resilience counter is NONZERO and exported.
+    assert extras["chaos_kube_retry_total"] > 0
+    assert extras["chaos_gang_abort_total"] > 0
+    assert extras["chaos_gang_error_retriable"] == 1
+    assert extras["chaos_gang_label_kept_while_cd_lives"] == 1
+    assert extras["chaos_gang_label_unwound"] == 1
+    assert extras["chaos_quarantine_total"] > 0
+    assert extras["chaos_quarantine_escalated"] == 1
+    assert extras["chaos_quarantine_released"] == 1
+    assert extras["chaos_circuit_open_total"] > 0
+    assert extras["chaos_metrics_exported"] == 1

@@ -285,7 +285,10 @@ class TestGangFlow:
         out = drv0.prepare_resource_claims(
             [{"uid": "w0", "namespace": "team-a", "name": "w0"}]
         )
-        assert "retry budget" in out["w0"][1]
+        assert "gang prepare deadline" in out["w0"][1]
+        assert "retriable" in out["w0"][1]
+        # The gang-abort unwind must KEEP the label while the CD
+        # exists: it is the DaemonSet trigger the next retry needs.
         node0 = kube.get("", "v1", "nodes", "node-0")
         assert node0["metadata"]["labels"][NODE_LABEL] == uid
 

@@ -1,0 +1,195 @@
+"""Fault-injection harness tests (pkg/faults).
+
+The registry itself (modes, probability determinism, count/after caps,
+env grammar) plus the compiled-in seams: SegmentTimer segments, flock
+acquisition, checkpoint write/fsync, tpulib enumerate/health, and the
+rendezvous handler.
+"""
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg import faults
+from k8s_dra_driver_gpu_tpu.pkg.faults import (
+    FaultRegistry,
+    FaultSpec,
+    InjectedCrash,
+    InjectedFault,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class TestRegistry:
+    def test_unarmed_point_is_noop(self):
+        faults.fault_point("nothing.armed")  # must not raise
+
+    def test_error_mode_default_exception(self):
+        faults.arm("p1", mode="error")
+        with pytest.raises(InjectedFault):
+            faults.fault_point("p1")
+
+    def test_error_mode_call_site_factory(self):
+        faults.arm("p1", mode="error")
+        with pytest.raises(OSError, match="injected"):
+            faults.fault_point("p1", error=lambda m: OSError(m))
+
+    def test_crash_mode_is_base_exception(self):
+        """InjectedCrash must sail through `except Exception` wire
+        boundaries -- that's the whole point of the crash mode."""
+        faults.arm("p1", mode="crash")
+        with pytest.raises(InjectedCrash):
+            try:
+                faults.fault_point("p1")
+            except Exception:  # noqa: BLE001
+                pytest.fail("InjectedCrash was swallowed by except Exception")
+
+    def test_count_caps_fires(self):
+        faults.arm("p1", mode="error", count=2)
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                faults.fault_point("p1")
+        faults.fault_point("p1")  # third evaluation: capped, no raise
+        assert faults.snapshot()["fires"]["p1"] == 2
+        assert faults.snapshot()["evaluations"]["p1"] == 3
+
+    def test_after_skips_first_evaluations(self):
+        faults.arm("p1", mode="error", after=2)
+        faults.fault_point("p1")
+        faults.fault_point("p1")
+        with pytest.raises(InjectedFault):
+            faults.fault_point("p1")
+
+    def test_latency_mode_sleeps_and_continues(self):
+        import time
+
+        faults.arm("p1", mode="latency", latency=0.05)
+        t0 = time.monotonic()
+        faults.fault_point("p1")
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_probability_deterministic_under_seed(self):
+        def schedule(seed):
+            reg = FaultRegistry(seed=seed)
+            reg.arm(FaultSpec(point="p", probability=0.5))
+            fired = []
+            for _ in range(32):
+                try:
+                    reg.fire("p")
+                    fired.append(0)
+                except InjectedFault:
+                    fired.append(1)
+            return fired
+
+        a, b, c = schedule(7), schedule(7), schedule(8)
+        assert a == b
+        assert a != c  # different seed, different schedule
+        assert 0 < sum(a) < 32  # actually probabilistic
+
+    def test_inject_context_manager_disarms(self):
+        with faults.inject("p1", mode="error"):
+            with pytest.raises(InjectedFault):
+                faults.fault_point("p1")
+        faults.fault_point("p1")
+
+    def test_env_grammar(self):
+        reg = FaultRegistry()
+        n = reg.configure_from_env({
+            "TPU_DRA_FAULTS":
+                "kube.request:error:p=0.3:count=5;ckpt.fsync:crash:count=1;"
+                "flock.acquire:latency:latency=0.01",
+            "TPU_DRA_FAULTS_SEED": "42",
+        })
+        assert n == 3
+        assert set(reg.snapshot()["armed"]) == {
+            "kube.request", "ckpt.fsync", "flock.acquire"}
+
+    def test_env_bad_specs_ignored(self):
+        reg = FaultRegistry()
+        assert reg.configure_from_env(
+            {"TPU_DRA_FAULTS": "p:badmode;q:error:bogus=1;ok:error"}) == 1
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError):
+            FaultSpec(point="p", mode="teleport")
+
+
+class TestSeams:
+    def test_segment_seam(self):
+        from k8s_dra_driver_gpu_tpu.pkg.timing import SegmentTimer
+
+        timer = SegmentTimer("op")
+        with faults.inject("segment:prep_devices", mode="error"):
+            with pytest.raises(InjectedFault):
+                with timer.segment("prep_devices"):
+                    pass
+            with timer.segment("other_segment"):
+                pass  # other segments unaffected
+
+    def test_flock_seam(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.pkg.flock import Flock, FlockTimeoutError
+
+        lock = Flock(str(tmp_path / "l.lock"))
+        with faults.inject("flock.acquire", mode="error"):
+            with pytest.raises(FlockTimeoutError):
+                lock.acquire(timeout=0.5)
+        with lock.acquire(timeout=0.5):
+            pass  # seam disarmed: lock healthy (and was never leaked)
+
+    def test_ckpt_fsync_seam_fails_commit_cleanly(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import (
+            CheckpointedClaim,
+            CheckpointManager,
+            ClaimState,
+        )
+
+        cm = CheckpointManager(str(tmp_path), boot_id="b1")
+        cm.update_claim("keep", CheckpointedClaim(
+            uid="keep", state=ClaimState.PREPARE_STARTED.value))
+        with faults.inject("ckpt.fsync", mode="error"):
+            with pytest.raises(RuntimeError):
+                cm.update_claim("lost", CheckpointedClaim(
+                    uid="lost", state=ClaimState.PREPARE_STARTED.value))
+        # Poisoned cache re-reads the durable file: only "keep" survives.
+        assert set(cm.get().claims) == {"keep"}
+
+    def test_tpulib_seams(self):
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+            EnumerateOptions,
+            PyTpuLib,
+            TpuLibError,
+        )
+
+        lib = PyTpuLib()
+        opts = EnumerateOptions(mock_topology="v5e-4")
+        with faults.inject("tpulib.enumerate", mode="error"):
+            with pytest.raises(TpuLibError):
+                lib.enumerate(opts)
+        with faults.inject("tpulib.health", mode="error"):
+            with pytest.raises(TpuLibError):
+                lib.health(opts)
+        assert len(lib.enumerate(opts).chips) == 4  # disarmed: clean
+
+    def test_kube_request_seam_via_retrying_client(self):
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import (
+            FakeKubeClient,
+            KubeError,
+        )
+        from k8s_dra_driver_gpu_tpu.pkg.retry import (
+            RetryingKubeClient,
+            RetryPolicy,
+        )
+
+        rk = RetryingKubeClient(
+            FakeKubeClient(),
+            policy=RetryPolicy(base_delay=0.001, max_delay=0.002,
+                               deadline_s=0.01))
+        with faults.inject("kube.request", mode="error"):
+            with pytest.raises(KubeError) as e:
+                rk.server_version()
+            assert e.value.status == 503
+        assert rk.retry_count > 0

@@ -313,6 +313,109 @@ class TestForeignOwnerLease:
         assert "lease-1" not in seed.prepared_claims()
 
 
+class TestInjectedCrashRecovery:
+    """pkg/faults crash points through the two-phase pipeline: an
+    InjectedCrash (BaseException -- wire boundaries can't swallow it)
+    fired at a precise seam, then a FRESH DeviceState over the same
+    root must reconcile back to a consistent, claimable state."""
+
+    @pytest.fixture(autouse=True)
+    def clean_faults(self):
+        from k8s_dra_driver_gpu_tpu.pkg import faults
+
+        faults.reset()
+        yield
+        faults.reset()
+
+    def test_crash_between_started_and_completed(self, tmp_root):
+        """InjectedCrash inside the reservation section, right after
+        the durable PrepareStarted write: the reservation (with its
+        device list) survives on disk, the 'restarted' plugin treats
+        the dead owner's record as stale, rolls it back, and the
+        retried prepare completes."""
+        from k8s_dra_driver_gpu_tpu.pkg import faults
+        from k8s_dra_driver_gpu_tpu.pkg.faults import InjectedCrash
+
+        state = DeviceState(Config.mock(root=tmp_root, topology="v5e-4"))
+        with faults.inject("segment:prep_reserved", mode="crash"):
+            with pytest.raises(InjectedCrash):
+                state.prepare(make_claim("icrash-1", ["chip-0"]))
+        # The reservation is durable and carries the device names.
+        on_disk = json.load(open(os.path.join(tmp_root, "checkpoint.json")))
+        rec = on_disk["data"]["claims"]["icrash-1"]
+        assert rec["state"] == ClaimState.PREPARE_STARTED.value
+        assert rec["devices"][0]["canonicalName"] == "chip-0"
+
+        # "Restart": a fresh DeviceState over the same root. The
+        # startup sweep runs clean (no live peer -- the lease's pid is
+        # OUR dead-prepare pid) and the retry rolls back + completes.
+        fresh = DeviceState(Config.mock(root=tmp_root, topology="v5e-4"))
+        assert fresh.destroy_unknown_subslices() == 0
+        ids = fresh.prepare(make_claim("icrash-1", ["chip-0"]))
+        assert len(ids) == 1
+        assert fresh.prepared_claims()["icrash-1"].state == \
+            ClaimState.PREPARE_COMPLETED.value
+        fresh.unprepare("icrash-1")
+        assert fresh.prepared_claims() == {}
+
+    def test_crash_between_ckpt_write_and_fsync(self, tmp_root):
+        """InjectedCrash between the checkpoint tmp-file write and its
+        fdatasync, during the PrepareCompleted commit of a dynamic
+        sub-slice claim: the carve-out exists but its completion never
+        became durable. The startup sweep must destroy the orphan
+        carve-out and the claim must prepare cleanly afterwards."""
+        from k8s_dra_driver_gpu_tpu.pkg import faults
+        from k8s_dra_driver_gpu_tpu.pkg.faults import InjectedCrash
+
+        state = DeviceState(Config.mock(root=tmp_root, topology="v5e-4"))
+        device = next(n for n in sorted(state.allocatable) if "ss-" in n)
+        # after=1: the PrepareStarted commit (write #1) goes through;
+        # the PrepareCompleted commit (write #2) crashes pre-fsync.
+        with faults.inject("ckpt.fsync", mode="crash", after=1, count=1):
+            with pytest.raises((InjectedCrash, RuntimeError)):
+                state.prepare(make_claim("icrash-2", [device]))
+        # The durable file still checksum-verifies and holds at most
+        # the reservation (never the completion).
+        fresh_cm = CheckpointManager(tmp_root)
+        cp = fresh_cm.get()
+        if "icrash-2" in cp.claims:
+            assert cp.claims["icrash-2"].state == \
+                ClaimState.PREPARE_STARTED.value
+
+        # "Restart": the sweep reconciles the orphan carve-out (its
+        # uuid is referenced by no durable completed record)...
+        fresh = DeviceState(Config.mock(root=tmp_root, topology="v5e-4"))
+        assert fresh._registry.list() == {}
+        # ...and the claim lifecycle is healthy again end to end.
+        ids = fresh.prepare(make_claim("icrash-2", [device]))
+        assert len(ids) == 1
+        fresh.unprepare("icrash-2")
+        assert fresh.prepared_claims() == {}
+        assert fresh._registry.list() == {}
+
+    def test_crash_mode_not_swallowed_by_wire_boundary(self, tmp_root):
+        """The Driver's gRPC boundary catches Exception to keep
+        serving; a simulated process death must NOT be absorbed into a
+        per-claim error string."""
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+        from k8s_dra_driver_gpu_tpu.pkg import faults
+        from k8s_dra_driver_gpu_tpu.pkg.faults import InjectedCrash
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+        from tests.fake_kube import make_claim_dict
+
+        kube = FakeKubeClient()
+        obj = make_claim_dict("icrash-3", ["chip-0"])
+        kube.create("resource.k8s.io", "v1", "resourceclaims", obj,
+                    namespace="default")
+        driver = Driver(Config.mock(root=tmp_root, topology="v5e-4"),
+                        kube, "n1", enable_health_monitor=False)
+        with faults.inject("segment:prep_reserved", mode="crash"):
+            with pytest.raises(InjectedCrash):
+                driver.prepare_resource_claims(
+                    [{"uid": "icrash-3", "namespace": "default",
+                      "name": "icrash-3"}])
+
+
 class TestInFlightGuards:
     def test_unprepare_of_inflight_prepare_rejected(
         self, state, monkeypatch

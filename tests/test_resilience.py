@@ -1,0 +1,687 @@
+"""End-to-end resilience layer tests.
+
+RetryingKubeClient (backoff, deadlines, classification, circuit
+breaker), watch gap -> immediate relist, degraded-chip quarantine with
+hysteresis, the health poll loop's failure backoff, the CD gang-prepare
+deadline with node-state unwind, and the rendezvous WAIT barrier.
+"""
+
+import threading
+import time
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg import faults
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import (
+    ConflictError,
+    FakeKubeClient,
+    KubeError,
+    NotFoundError,
+)
+from k8s_dra_driver_gpu_tpu.pkg.metrics import ResilienceMetrics
+from k8s_dra_driver_gpu_tpu.pkg.retry import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryingKubeClient,
+    RetryPolicy,
+    classify,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+FAST = RetryPolicy(base_delay=0.001, max_delay=0.004, jitter=0.0,
+                   deadline_s=1.0)
+
+
+class FlakyKube:
+    """Inner client that fails the first N calls of each verb."""
+
+    def __init__(self, failures: int, exc_factory=None):
+        self.inner = FakeKubeClient()
+        self.remaining = failures
+        self.exc_factory = exc_factory or (
+            lambda: KubeError(503, "flaky"))
+        self.calls = []
+
+    def __getattr__(self, name):
+        inner_fn = getattr(self.inner, name)
+
+        def wrapped(*a, **kw):
+            self.calls.append((name, kw.get("timeout")))
+            if self.remaining > 0:
+                self.remaining -= 1
+                raise self.exc_factory()
+            return inner_fn(*a, **kw)
+
+        return wrapped
+
+
+class TestRetryingKubeClient:
+    def test_transient_5xx_absorbed(self):
+        flaky = FlakyKube(3)
+        rk = RetryingKubeClient(flaky, policy=FAST)
+        assert rk.server_version()["major"] == "1"
+        assert rk.retry_count == 3
+        assert rk.retries_by_verb["server_version"] == 3
+
+    def test_connection_reset_absorbed(self):
+        flaky = FlakyKube(2, exc_factory=lambda: ConnectionResetError("rst"))
+        rk = RetryingKubeClient(flaky, policy=FAST)
+        assert rk.server_version()["major"] == "1"
+        assert rk.retry_count == 2
+
+    def test_deadline_exhaustion_raises_last_error(self):
+        rk = RetryingKubeClient(
+            FlakyKube(10_000),
+            policy=RetryPolicy(base_delay=0.002, max_delay=0.004,
+                               jitter=0.0, deadline_s=0.05),
+            breaker=CircuitBreaker(threshold=1000))
+        with pytest.raises(KubeError, match="flaky"):
+            rk.server_version()
+        assert rk.retry_count > 0
+
+    def test_404_not_retried(self):
+        rk = RetryingKubeClient(FakeKubeClient(), policy=FAST)
+        with pytest.raises(NotFoundError):
+            rk.get("", "v1", "pods", "missing")
+        assert rk.retry_count == 0
+
+    def test_409_surfaces_immediately_for_caller_refetch(self):
+        kube = FakeKubeClient()
+        kube.create("", "v1", "pods", {"metadata": {"name": "p"}})
+        rk = RetryingKubeClient(kube, policy=FAST)
+        stale = rk.get("", "v1", "pods", "p")
+        rk.update("", "v1", "pods", "p", stale)  # bumps the rv
+        with pytest.raises(ConflictError):
+            rk.update("", "v1", "pods", "p", stale)  # stale rv -> 409
+        assert rk.retry_count == 0  # replaying a stale write can't win
+
+    def test_409_retried_when_opted_in(self):
+        flaky = FlakyKube(2, exc_factory=lambda: ConflictError("busy"))
+        rk = RetryingKubeClient(
+            flaky, policy=RetryPolicy(base_delay=0.001, max_delay=0.002,
+                                      jitter=0.0, deadline_s=1.0,
+                                      retry_conflicts=True))
+        assert rk.server_version()["major"] == "1"
+        assert rk.retry_count == 2
+
+    def test_per_attempt_timeout_injected(self):
+        flaky = FlakyKube(0)
+        rk = RetryingKubeClient(flaky, policy=RetryPolicy(
+            base_delay=0.001, attempt_timeout_s=7.5, deadline_s=1.0))
+        rk.server_version()
+        assert flaky.calls[-1] == ("server_version", 7.5)
+
+    def test_explicit_timeout_wins(self):
+        flaky = FlakyKube(0)
+        rk = RetryingKubeClient(flaky, policy=FAST)
+        rk.server_version(timeout=3.0)
+        assert flaky.calls[-1] == ("server_version", 3.0)
+
+    def test_non_verb_attributes_delegate(self):
+        kube = FakeKubeClient()
+        rk = RetryingKubeClient(kube, policy=FAST)
+        seen = []
+        rk.add_watcher(lambda t, o: seen.append(t))
+        rk.create("", "v1", "pods", {"metadata": {"name": "p"}})
+        assert seen == ["ADDED"]
+        assert len(rk.objects(resource="pods")) == 1
+
+    def test_metrics_counter_exported(self):
+        from prometheus_client import generate_latest
+
+        metrics = ResilienceMetrics()
+        rk = RetryingKubeClient(FlakyKube(2), policy=FAST, metrics=metrics)
+        rk.server_version()
+        text = generate_latest(metrics.registry).decode()
+        assert 'tpu_dra_retry_total{verb="server_version"} 2.0' in text
+
+    def test_classification_table(self):
+        p = RetryPolicy()
+        assert classify(KubeError(503, "x"), p) == "retriable"
+        assert classify(KubeError(429, "x"), p) == "retriable"
+        assert classify(KubeError(422, "x"), p) == "permanent"
+        assert classify(NotFoundError("x"), p) == "permanent"
+        assert classify(ConflictError("x"), p) == "conflict"
+        assert classify(ConnectionResetError(), p) == "retriable"
+        assert classify(TimeoutError(), p) == "retriable"
+        assert classify(faults.InjectedFault("x"), p) == "retriable"
+        assert classify(faults.InjectedCrash("x"), p) == "permanent"
+        assert classify(ValueError("x"), p) == "permanent"
+
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold_and_fails_fast(self):
+        clock = [0.0]
+        breaker = CircuitBreaker(threshold=3, reset_s=10.0,
+                                 clock=lambda: clock[0])
+        rk = RetryingKubeClient(
+            FlakyKube(10_000),
+            policy=RetryPolicy(base_delay=0.001, max_delay=0.002,
+                               jitter=0.0, deadline_s=0.02),
+            breaker=breaker)
+        with pytest.raises(KubeError):
+            rk.server_version()
+        assert breaker.trips == 1
+        with pytest.raises(CircuitOpenError):
+            rk.server_version()  # open: fail fast, no attempt
+
+    def test_half_open_probe_closes_on_success(self):
+        clock = [0.0]
+        breaker = CircuitBreaker(threshold=2, reset_s=5.0,
+                                 clock=lambda: clock[0])
+        breaker.record_failure()
+        assert breaker.record_failure() is True  # trips
+        assert not breaker.allow()
+        clock[0] += 6.0
+        assert breaker.allow()  # the half-open probe slot
+        assert not breaker.allow()  # only ONE probe at a time
+        breaker.record_success()
+        assert breaker.allow() and breaker.allow()  # closed again
+
+    def test_failed_probe_reopens_without_new_trip(self):
+        clock = [0.0]
+        breaker = CircuitBreaker(threshold=2, reset_s=5.0,
+                                 clock=lambda: clock[0])
+        breaker.record_failure()
+        breaker.record_failure()
+        clock[0] += 6.0
+        assert breaker.allow()
+        assert breaker.record_failure() is False  # re-open, same outage
+        assert breaker.trips == 1
+        assert not breaker.allow()
+
+    def test_permanent_non_kube_error_releases_probe_slot(self):
+        # A malformed-response ValueError during the half-open probe
+        # must not leak the probe slot (breaker wedged open forever).
+        clock = [0.0]
+        breaker = CircuitBreaker(threshold=2, reset_s=1.0,
+                                 clock=lambda: clock[0])
+
+        class Weird:
+            def server_version(self, timeout=30.0):
+                raise ValueError("malformed response body")
+
+        rk = RetryingKubeClient(
+            Weird(), policy=RetryPolicy(base_delay=0.001, deadline_s=0.01),
+            breaker=breaker)
+        breaker.record_failure()
+        breaker.record_failure()  # open
+        clock[0] += 2.0
+        with pytest.raises(ValueError):
+            rk.server_version()  # the probe: permanent, non-KubeError
+        # The slot was released (window re-opened, not wedged): after
+        # the reset the NEXT probe is grantable again.
+        clock[0] += 2.0
+        assert breaker.allow()
+
+    def test_answered_error_closes_circuit(self):
+        # A 404 means the apiserver is UP: it must release a half-open
+        # probe instead of wedging the breaker open forever.
+        clock = [0.0]
+        breaker = CircuitBreaker(threshold=1, reset_s=1.0,
+                                 clock=lambda: clock[0])
+        rk = RetryingKubeClient(
+            FakeKubeClient(),
+            policy=RetryPolicy(base_delay=0.001, deadline_s=0.01),
+            breaker=breaker)
+        with faults.inject("kube.request", mode="error", count=5):
+            with pytest.raises(KubeError):
+                rk.server_version()
+        clock[0] += 2.0
+        with pytest.raises(NotFoundError):
+            rk.get("", "v1", "pods", "missing")  # probe: answered 404
+        assert breaker.allow()  # closed, not stuck half-open
+
+
+class TestWatchGapRelist:
+    def test_on_gap_fires_on_410(self):
+        from tests.test_kubeclient import ApiServerStub
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeClient
+
+        stub = ApiServerStub()
+        try:
+            stub.watch_events = [
+                {"type": "ADDED", "object": {
+                    "metadata": {"name": "x", "resourceVersion": "9"}}},
+            ]
+            stub.gone_on_rv = True  # resuming with a rv answers 410
+            gaps = []
+            stop = threading.Event()
+            client = KubeClient(host=stub.url)
+            client.watch(
+                "resource.tpu.dra", "v1beta1", "computedomains",
+                lambda t, o: None, stop=stop, reconnect_delay=0.05,
+                on_gap=lambda: gaps.append(1),
+            )
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not gaps:
+                time.sleep(0.02)
+            stop.set()
+            assert gaps, "410 Gone never surfaced through on_gap"
+            assert stub.gone_replies >= 1
+        finally:
+            stub.shutdown()
+            stub.server_close()
+
+
+class TestQuarantine:
+    def _taint(self, device="chip-1", fatal=False):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.health import DeviceTaint
+
+        return DeviceTaint(device=device, key="tpu.dra.dev/thermal",
+                           value="true",
+                           effect="NoExecute" if fatal else "")
+
+    def _tracker(self, **kw):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.health import (
+            QuarantineTracker,
+        )
+
+        clock = [0.0]
+        kw.setdefault("threshold", 3)
+        kw.setdefault("window_s", 100.0)
+        kw.setdefault("hysteresis_s", 300.0)
+        tracker = QuarantineTracker(clock=lambda: clock[0], **kw)
+        return tracker, clock
+
+    def _flap(self, tracker, clock, times, step=10):
+        """Drive ``times`` healthy->sick transitions (one edge per
+        sick poll, a clean poll in between); returns the quarantine
+        taints visible after the last clean poll."""
+        out = []
+        for _ in range(times):
+            clock[0] += step
+            tracker.observe([self._taint()])
+            clock[0] += step
+            out = tracker.observe([])
+        return out
+
+    def test_escalates_at_flap_threshold(self):
+        hits = []
+        tracker, clock = self._tracker(on_quarantine=hits.append)
+        clock[0] += 1
+        assert self._flap(tracker, clock, 2, step=5) == []
+        clock[0] += 5
+        out = tracker.observe([self._taint()])  # third edge
+        assert [t.effect for t in out] == ["NoSchedule"]
+        assert out[0].key == "tpu.dra.dev/degraded"
+        assert hits == ["chip-1"]
+        assert tracker.total_quarantines == 1
+
+    def test_steady_condition_never_quarantines(self):
+        # tpulib reports the CURRENT condition every poll: a single
+        # persistent thermal warning is ONE transition, not N events --
+        # steady non-fatal conditions stay observe-only forever.
+        tracker, clock = self._tracker(threshold=3, window_s=1000.0)
+        for _ in range(50):
+            clock[0] += 5
+            assert tracker.observe([self._taint()]) == []
+
+    def test_window_prunes_slow_flaps(self):
+        tracker, clock = self._tracker(window_s=50.0)
+        # One full sick/clean flap per 60s: edges 60s apart, never 3
+        # inside any 50s window.
+        assert self._flap(tracker, clock, 6, step=30) == []
+
+    def test_fatal_events_do_not_count(self):
+        tracker, clock = self._tracker()
+        for _ in range(5):
+            clock[0] += 1
+            out = tracker.observe([self._taint(fatal=True)])
+            clock[0] += 1
+            tracker.observe([])
+        assert out == []  # fatal path has its own NoExecute taint
+
+    def test_hysteresis_restarts_on_flap(self):
+        tracker, clock = self._tracker(hysteresis_s=300.0)
+        self._flap(tracker, clock, 3, step=1)
+        assert tracker.quarantined == {"chip-1"}
+        clock[0] += 299  # almost clean...
+        tracker.observe([self._taint()])  # ...then one more flap
+        clock[0] += 299
+        assert tracker.observe([]) != []  # still quarantined
+        clock[0] += 2
+        assert tracker.observe([]) == []  # clean for the full window
+
+    def test_monitor_merges_quarantine_into_callback(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.health import (
+            ChipHealthMonitor,
+            QuarantineTracker,
+        )
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+            EnumerateOptions,
+            PyTpuLib,
+        )
+
+        control = tmp_path / "health"
+        control.write_text("chip=0,kind=ici_link_flap")
+        clock = [0.0]
+        monitor = ChipHealthMonitor(
+            PyTpuLib(),
+            EnumerateOptions(mock_topology="v5e-4",
+                             health_events=f"@{control}"),
+            on_taints=lambda taints: None,
+            quarantine=QuarantineTracker(threshold=2, window_s=100.0,
+                                         hysteresis_s=100.0,
+                                         clock=lambda: clock[0]),
+        )
+        clock[0] += 1
+        taints = monitor.poll_and_reconcile()  # first edge
+        assert all(t.effect != "NoSchedule" for t in taints)
+        control.write_text("")  # chip recovers...
+        clock[0] += 1
+        monitor.poll_and_reconcile()
+        control.write_text("chip=0,kind=ici_link_flap")  # ...and flaps
+        clock[0] += 1
+        taints = monitor.poll_and_reconcile()  # second edge: threshold
+        assert any(t.effect == "NoSchedule" and t.device == "chip-0"
+                   for t in taints)
+        # The raw non-fatal taint still rides along for observability.
+        assert any(t.key.endswith("ici_link_flap") for t in taints)
+
+
+class TestHealthPollBackoff:
+    def test_poll_survives_tpulib_errors_with_backoff(self):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.health import (
+            ChipHealthMonitor,
+        )
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+            EnumerateOptions,
+            PyTpuLib,
+        )
+
+        delivered = []
+        monitor = ChipHealthMonitor(
+            PyTpuLib(),
+            EnumerateOptions(mock_topology="v5e-4",
+                             health_events="chip=1,kind=thermal"),
+            on_taints=delivered.append,
+            poll_interval=0.01,
+        )
+        faults.arm("health.poll", mode="error", count=3)
+        monitor.start()
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not delivered:
+                time.sleep(0.01)
+            # The three failed polls were absorbed (with growing
+            # backoff), the thread survived, and the next clean poll
+            # delivered the taints.
+            assert delivered, "poll thread died instead of backing off"
+            assert faults.snapshot()["fires"]["health.poll"] == 3
+            assert monitor.consecutive_failures == 0
+        finally:
+            monitor.stop()
+
+    def test_callback_exception_does_not_kill_thread(self):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.health import (
+            ChipHealthMonitor,
+        )
+        from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+            EnumerateOptions,
+            PyTpuLib,
+        )
+
+        calls = []
+
+        def exploding(taints):
+            calls.append(list(taints))
+            if len(calls) == 1:
+                raise RuntimeError("consumer bug")
+
+        monitor = ChipHealthMonitor(
+            PyTpuLib(),
+            EnumerateOptions(mock_topology="v5e-4",
+                             health_events="chip=1,kind=thermal"),
+            on_taints=exploding,
+            poll_interval=0.01,
+        )
+        monitor.start()
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and len(calls) < 2:
+                time.sleep(0.01)
+            # The failed delivery was retried on a later poll.
+            assert len(calls) >= 2
+            assert calls[0] == calls[1]
+        finally:
+            monitor.stop()
+
+
+class TestGangPrepareDeadline:
+    def _setup(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state import (
+            CDDeviceState,
+        )
+        from k8s_dra_driver_gpu_tpu.computedomain.plugin.driver import (
+            CDDriver,
+        )
+
+        kube = FakeKubeClient()
+        kube.create("", "v1", "nodes",
+                    {"metadata": {"name": "n1", "labels": {}}})
+        kube.create("resource.tpu.dra", "v1beta1", "computedomains", {
+            "metadata": {"name": "cd", "uid": "cd-uid",
+                         "namespace": "default"},
+            "spec": {"numNodes": 2},
+            "status": {"status": "NotReady", "nodes": []},
+        }, namespace="default")
+        state = CDDeviceState(root=str(tmp_path), kube=kube,
+                              node_name="n1", use_informer=False)
+        metrics = ResilienceMetrics()
+        driver = CDDriver(state, kube, "n1", retry_timeout=0.3,
+                          resilience=metrics)
+        uid = "gang-1"
+        from tests.fake_kube import make_claim_dict
+
+        obj = make_claim_dict(
+            uid, ["channel-0"], request="channel",
+            driver="compute-domain.tpu.dra.dev",
+            configs=[{"parameters": {
+                "apiVersion": "resource.tpu.dra/v1beta1",
+                "kind": "ComputeDomainChannelConfig",
+                "domainID": "cd-uid",
+            }, "requests": ["channel"]}],
+        )
+        kube.create("resource.k8s.io", "v1", "resourceclaims", obj,
+                    namespace="default")
+        return kube, state, driver, metrics, uid
+
+    def test_straggler_gang_aborts_retriable(self, tmp_path):
+        from k8s_dra_driver_gpu_tpu.computedomain import NODE_LABEL
+        from prometheus_client import generate_latest
+
+        kube, state, driver, metrics, uid = self._setup(tmp_path)
+        out = driver.prepare_resource_claims(
+            [{"uid": uid, "namespace": "default", "name": uid}])
+        devices, err = out[uid]
+        assert devices == [] and "retriable" in err
+        assert "gang prepare deadline" in err
+        assert driver.gang_aborts == 1
+        # The CD still exists: the label SURVIVES the abort -- it is
+        # the DaemonSet trigger the kubelet's next retry depends on.
+        node = kube.get("", "v1", "nodes", "n1")
+        assert node["metadata"]["labels"].get(NODE_LABEL) == "cd-uid"
+        # No checkpoint residue.
+        assert state.prepared_claims() == {}
+        assert "tpu_dra_gang_abort_total 1.0" in \
+            generate_latest(metrics.registry).decode()
+
+    def test_dissolved_gang_unwinds_node_label(self, tmp_path):
+        """Once the ComputeDomain is DELETED (the gang dissolved for
+        good -- no unprepare will ever come for a claim that never
+        prepared), the abort unwind drops the node label so no daemon
+        pod stays pinned to a dead gang."""
+        from k8s_dra_driver_gpu_tpu.computedomain import NODE_LABEL
+
+        kube, state, driver, metrics, uid = self._setup(tmp_path)
+        # First abort: CD alive -> label stays (bootstrap preserved).
+        driver.prepare_resource_claims(
+            [{"uid": uid, "namespace": "default", "name": uid}])
+        assert kube.get("", "v1", "nodes", "n1")["metadata"][
+            "labels"].get(NODE_LABEL) == "cd-uid"
+        # The user deletes the never-formed domain; the next retry
+        # blows the deadline and the unwind reclaims the label.
+        kube.delete("resource.tpu.dra", "v1beta1", "computedomains",
+                    "cd", namespace="default")
+        out = driver.prepare_resource_claims(
+            [{"uid": uid, "namespace": "default", "name": uid}])
+        assert out[uid][1]
+        assert driver.gang_aborts == 2
+        node = kube.get("", "v1", "nodes", "n1")
+        assert NODE_LABEL not in node["metadata"].get("labels", {})
+
+    def test_permanent_4xx_surfaces_without_burning_deadline(self, tmp_path):
+        # 403 RBAC-class failures must fail the claim IMMEDIATELY, not
+        # loop for the whole gang deadline reporting 'retriable'.
+        kube, state, driver, metrics, uid = self._setup(tmp_path)
+        orig_get = kube.get
+
+        def forbidden(*a, **kw):
+            raise KubeError(403, "forbidden")
+
+        kube.get = forbidden
+        t0 = time.monotonic()
+        out = driver.prepare_resource_claims(
+            [{"uid": uid, "namespace": "default", "name": uid}])
+        kube.get = orig_get
+        assert "403" in out[uid][1]
+        assert "gang prepare deadline" not in out[uid][1]
+        assert time.monotonic() - t0 < 0.25  # no 0.3s budget burned
+        assert driver.gang_aborts == 0
+
+    def test_unreachable_apiserver_keeps_node_label(self, tmp_path):
+        # An informer cache miss / failed list is NOT evidence the CD
+        # was deleted: the unwind must keep the label (safe default).
+        from k8s_dra_driver_gpu_tpu.computedomain import NODE_LABEL
+
+        kube, state, driver, metrics, uid = self._setup(tmp_path)
+        driver.prepare_resource_claims(
+            [{"uid": uid, "namespace": "default", "name": uid}])  # labels
+        orig_list = kube.list
+
+        def down(*a, **kw):
+            raise OSError("apiserver unreachable")
+
+        kube.list = down
+        try:
+            state.unwind_failed_prepare(uid)
+        finally:
+            kube.list = orig_list
+        node = kube.get("", "v1", "nodes", "n1")
+        assert node["metadata"]["labels"].get(NODE_LABEL) == "cd-uid"
+
+    def test_retry_succeeds_once_gang_forms(self, tmp_path):
+        kube, state, driver, metrics, uid = self._setup(tmp_path)
+        out = driver.prepare_resource_claims(
+            [{"uid": uid, "namespace": "default", "name": uid}])
+        assert out[uid][1]  # first pass: straggler, aborted
+        # The gang forms (both nodes register Ready) and kubelet
+        # retries the same claim: it must now prepare cleanly.
+        cd = kube.get("resource.tpu.dra", "v1beta1", "computedomains",
+                      "cd", namespace="default")
+        from k8s_dra_driver_gpu_tpu.pkg import json_copy
+
+        cd = json_copy(cd)
+        cd["status"] = {"status": "Ready", "nodes": [
+            {"name": "n1", "index": 0, "cliqueID": "0",
+             "ipAddress": "10.0.0.1", "status": "Ready"},
+            {"name": "n2", "index": 1, "cliqueID": "0",
+             "ipAddress": "10.0.0.2", "status": "Ready"},
+        ]}
+        kube.update("resource.tpu.dra", "v1beta1", "computedomains",
+                    "cd", cd, namespace="default")
+        out = driver.prepare_resource_claims(
+            [{"uid": uid, "namespace": "default", "name": uid}])
+        devices, err = out[uid]
+        assert err == "" and len(devices) == 1
+        assert uid in state.prepared_claims()
+
+
+class TestRendezvousBarrier:
+    def test_wait_times_out_instead_of_hanging(self, tmp_path):
+        import json as json_mod
+
+        from k8s_dra_driver_gpu_tpu.computedomain.daemon.rendezvous import (
+            CoordinationService,
+            MembershipState,
+            query,
+            wait_for_quorum,
+        )
+
+        members = tmp_path / "members.json"
+        members.write_text(json_mod.dumps({
+            "numWorkers": 2,
+            "workers": [{"index": 0, "status": "Ready"}],
+        }))
+        state = MembershipState(str(members))
+        server = CoordinationService("127.0.0.1", 0, state)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever,
+                             kwargs={"poll_interval": 0.05}, daemon=True)
+        t.start()
+        try:
+            t0 = time.monotonic()
+            assert query("127.0.0.1", port, "WAIT 0.2",
+                         timeout=5.0) == "TIMEOUT"
+            assert time.monotonic() - t0 < 3.0
+            assert not wait_for_quorum("127.0.0.1", port, 0.2)
+
+            # The straggler arrives; a reload pulse wakes waiters.
+            waiter = {}
+
+            def wait():
+                waiter["answer"] = query("127.0.0.1", port, "WAIT 30",
+                                         timeout=35.0)
+
+            wt = threading.Thread(target=wait, daemon=True)
+            wt.start()
+            time.sleep(0.1)
+            members.write_text(json_mod.dumps({
+                "numWorkers": 2,
+                "workers": [{"index": 0, "status": "Ready"},
+                            {"index": 1, "status": "Ready"}],
+            }))
+            state.reload()
+            wt.join(timeout=10)
+            assert waiter.get("answer") == "READY"
+            assert wait_for_quorum("127.0.0.1", port, 1.0)
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_handler_fault_seam_drops_connection(self, tmp_path):
+        import json as json_mod
+
+        from k8s_dra_driver_gpu_tpu.computedomain.daemon.rendezvous import (
+            CoordinationService,
+            MembershipState,
+            query,
+        )
+
+        members = tmp_path / "members.json"
+        members.write_text(json_mod.dumps({"numWorkers": 1, "workers": []}))
+        state = MembershipState(str(members))
+        server = CoordinationService("127.0.0.1", 0, state)
+        port = server.server_address[1]
+        t = threading.Thread(target=server.serve_forever,
+                             kwargs={"poll_interval": 0.05}, daemon=True)
+        t.start()
+        try:
+            with faults.inject("rendezvous.handle", mode="error"):
+                # The handler dies mid-command: the client sees an empty
+                # reply (connection closed), the probe's NOT_READY path.
+                assert query("127.0.0.1", port, "STATUS",
+                             timeout=5.0) == ""
+            assert query("127.0.0.1", port, "STATUS",
+                         timeout=5.0) == "NOT_READY"
+        finally:
+            server.shutdown()
+            server.server_close()

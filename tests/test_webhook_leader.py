@@ -7,6 +7,7 @@ encode/decode/validate matrix) and the controller's leader election.
 import json
 import os
 import threading
+import time
 import urllib.request
 
 import pytest
@@ -341,3 +342,136 @@ class TestLeaderElection:
         lease = kube.get("coordination.k8s.io", "v1", "leases", "lease1",
                          namespace="ns")
         assert lease["spec"]["holderIdentity"] == ""
+
+
+class _ErrorInjectingKube:
+    """FakeKubeClient wrapper whose verbs raise while ``failing`` is
+    set (the apiserver-outage stand-in for renew-loop tests)."""
+
+    def __init__(self):
+        self.inner = FakeKubeClient()
+        self.failing = False
+
+    def __getattr__(self, name):
+        fn = getattr(self.inner, name)
+
+        def wrapped(*a, **kw):
+            if self.failing and name in ("get", "list", "create",
+                                         "update", "patch", "delete"):
+                raise OSError("apiserver down")
+            return fn(*a, **kw)
+
+        return wrapped
+
+
+class TestLeaseClientDeadline:
+    def test_retrying_client_deadline_bounded_by_renew_period(self):
+        """A renew parked inside a 30s kube retry budget while the
+        server-side lease expires at 30s is a dual-leader window: the
+        elector must rebuild a wrapped client with a deadline BELOW
+        the renew period (the renew LOOP is the retry mechanism)."""
+        from k8s_dra_driver_gpu_tpu.pkg.retry import (
+            RetryingKubeClient,
+            RetryPolicy,
+        )
+
+        wrapped = RetryingKubeClient(FakeKubeClient(),
+                                     policy=RetryPolicy(deadline_s=30.0))
+        elector = LeaderElector(wrapped, "lease1", "ns", "pod-a",
+                                renew_period=10.0)
+        assert elector.kube.policy.deadline_s == 8.0  # 0.8 * renew
+        assert elector.kube.policy.attempt_timeout_s <= 8.0
+        assert elector.try_acquire_or_renew()  # still fully functional
+        # A plain client passes through untouched.
+        plain = FakeKubeClient()
+        assert LeaderElector(plain, "l2", "ns", "x").kube is plain
+
+
+class TestLeaderStepDown:
+    """Renew-failure policy regression: repeated renew ERRORS step the
+    leader down CLEANLY (stop-callback exactly once, loop exits, lease
+    release attempted) instead of looping as a zombie holder; a
+    transient blip inside the lease-duration budget keeps leadership."""
+
+    def _run_leader(self, kube, elector, stop, stopped):
+        def lead():
+            stop.wait()  # the controller shape: lead until stop
+
+        t = threading.Thread(
+            target=lambda: elector.run(
+                lead, stop, on_stopped_leading=lambda: stopped.append(1)),
+            daemon=True)
+        t.start()
+        return t
+
+    def test_persistent_renew_errors_step_down_once(self):
+        kube = _ErrorInjectingKube()
+        elector = LeaderElector(kube, "lease1", "ns", "pod-a",
+                                lease_duration=0.2, renew_period=0.02,
+                                retry_period=0.02)
+        stop = threading.Event()
+        stopped = []
+        t = self._run_leader(kube, elector, stop, stopped)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not elector.is_leader:
+            time.sleep(0.005)
+        assert elector.is_leader
+        kube.failing = True  # the outage begins -- and never ends
+        t.join(timeout=10)
+        assert not t.is_alive(), "leader looped as a zombie holder"
+        assert stopped == [1], "stop-callback must fire exactly once"
+        assert not elector.is_leader
+        assert stop.is_set()
+
+    def test_transient_errors_keep_leadership(self):
+        kube = _ErrorInjectingKube()
+        elector = LeaderElector(kube, "lease1", "ns", "pod-a",
+                                lease_duration=5.0, renew_period=0.02,
+                                retry_period=0.02)
+        stop = threading.Event()
+        stopped = []
+        t = self._run_leader(kube, elector, stop, stopped)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not elector.is_leader:
+            time.sleep(0.005)
+        assert elector.is_leader
+        # A short blip, well inside the 5s lease budget.
+        kube.failing = True
+        time.sleep(0.1)
+        kube.failing = False
+        time.sleep(0.1)
+        assert elector.is_leader, "one blip must not churn leadership"
+        assert stopped == []
+        stop.set()
+        t.join(timeout=10)
+        assert stopped == []  # normal stop: no step-down callback
+        lease = kube.get("coordination.k8s.io", "v1", "leases", "lease1",
+                         namespace="ns")
+        assert lease["spec"]["holderIdentity"] == ""  # released
+
+    def test_lost_lease_steps_down_immediately(self):
+        kube = _ErrorInjectingKube()
+        elector = LeaderElector(kube, "lease1", "ns", "pod-a",
+                                lease_duration=5.0, renew_period=0.02,
+                                retry_period=0.02)
+        stop = threading.Event()
+        stopped = []
+        t = self._run_leader(kube, elector, stop, stopped)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not elector.is_leader:
+            time.sleep(0.005)
+        # A peer seizes the lease (simulating expiry-takeover): the
+        # next renew sees a live foreign holder and steps down fast --
+        # no 5s error budget applies to a DEFINITIVE loss.
+        lease = kube.get("coordination.k8s.io", "v1", "leases", "lease1",
+                         namespace="ns")
+        from k8s_dra_driver_gpu_tpu.pkg import json_copy
+
+        lease = json_copy(lease)
+        lease["spec"]["holderIdentity"] = "pod-b"
+        kube.update("coordination.k8s.io", "v1", "leases", "lease1",
+                    lease, namespace="ns")
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert stopped == [1]
+        assert not elector.is_leader
