@@ -3,8 +3,8 @@
 PYTHON ?= python
 
 .PHONY: all native test test-fast bench bench-smoke \
-	bench-placement-smoke bench-chaos-smoke lint lint-analysis clean \
-	stamp-version
+	bench-placement-smoke bench-chaos-smoke bench-sched-smoke lint \
+	lint-analysis clean stamp-version
 
 VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
 
@@ -64,6 +64,20 @@ bench-placement-smoke:
 # docs/operations.md "Fault injection" for the env matrix.
 bench-chaos-smoke:
 	BENCH_CHAOS_ITERS=3 BENCH_CHAOS_ROUNDS=8 $(PYTHON) bench.py --chaos
+
+# Scheduler-churn smoke: a shrunk `--sched-churn` trace (8 nodes x 24
+# claims of paired pod+claim churn + unchanged health republishes)
+# comparing the polled full-resync baseline against the event-driven
+# incremental scheduler. Gated on the DETERMINISTIC write-amp ratio
+# plus a loose convergence-latency floor (the full 200-claim trace
+# lands ~6x / ~70x; see BASELINE.md). Mirrored as a non-slow test in
+# tests/test_bench_sched_smoke.py; the full-scale trajectory file is
+# BENCH_scheduler.json (plain `bench.py --sched-churn`).
+bench-sched-smoke:
+	BENCH_SCHED_NODES=8 BENCH_SCHED_CLAIMS=24 BENCH_SCHED_BATCH=8 \
+	BENCH_SCHED_MIN_WRITE_RATIO=1.7 BENCH_SCHED_MIN_CONV_RATIO=1.5 \
+	BENCH_SCHED_OUT=$(or $(BENCH_SCHED_OUT),/tmp/BENCH_scheduler_smoke.json) \
+	$(PYTHON) bench.py --sched-churn
 
 lint:
 	ruff check --select E9,F k8s_dra_driver_gpu_tpu/ tests/ bench.py __graft_entry__.py

@@ -696,6 +696,237 @@ def bench_placement_sim() -> dict:
     }
 
 
+class _CountingKube:
+    """KubeClient wrapper counting control-plane WRITES (create/update/
+    patch/delete) and timestamping the allocation patch per claim --
+    the two quantities `--sched-churn` gates on. Reads and watch hooks
+    pass through untouched."""
+
+    def __init__(self, inner, alloc_times: dict):
+        self._inner = inner
+        self._alloc_times = alloc_times
+        self.writes = 0
+
+    def create(self, *a, **kw):
+        self.writes += 1
+        return self._inner.create(*a, **kw)
+
+    def update(self, *a, **kw):
+        self.writes += 1
+        return self._inner.update(*a, **kw)
+
+    def delete(self, *a, **kw):
+        self.writes += 1
+        return self._inner.delete(*a, **kw)
+
+    def patch(self, group, version, resource, name, patch,
+              namespace=None, **kw):
+        self.writes += 1
+        out = self._inner.patch(group, version, resource, name, patch,
+                                namespace=namespace, **kw)
+        if resource == "resourceclaims" and \
+                (patch.get("status") or {}).get("allocation"):
+            self._alloc_times.setdefault(
+                (namespace or "default", name), time.perf_counter())
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def bench_sched_churn() -> dict:
+    """Scheduler-churn mode (`bench.py --sched-churn`): N nodes x M
+    claims of paired pod+claim churn through FakeKube, with the
+    periodic health republish a real fleet generates (every node
+    re-publishing its UNCHANGED slice set every poll tick), under two
+    control planes:
+
+    - **polled** baseline: the legacy full-resync loop (`run(0.25)`)
+      plus write-always publishing (`publish diff=False`) -- the seed
+      behavior.
+    - **incremental**: event-driven dirty-set sync
+      (`start_event_driven()`) plus content-hash diffed publishing.
+
+    Reports kube writes per converged claim, syncs/sec, and p50/p99
+    claim-to-allocation latency per mode, and emits
+    ``BENCH_scheduler.json``. Gates (exit nonzero) when
+    BENCH_SCHED_MIN_WRITE_RATIO / BENCH_SCHED_MIN_CONV_RATIO are set
+    (the `make bench-sched-smoke` thresholds).
+
+    Knobs: BENCH_SCHED_NODES (default 40), BENCH_SCHED_CLAIMS (200),
+    BENCH_SCHED_CHIPS (8 per node), BENCH_SCHED_BATCH (8 claims per
+    churn step), BENCH_SCHED_HEALTH_TICKS (3 republish ticks per
+    step)."""
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.metrics import SchedulerMetrics
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+    from k8s_dra_driver_gpu_tpu.pkg.sliceutil import (
+        publish_resource_slices,
+    )
+
+    nodes_n = _env_int("BENCH_SCHED_NODES", 40)
+    claims_total = _env_int("BENCH_SCHED_CLAIMS", 200)
+    chips = _env_int("BENCH_SCHED_CHIPS", 8)
+    batch = _env_int("BENCH_SCHED_BATCH", 8)
+    health_ticks = _env_int("BENCH_SCHED_HEALTH_TICKS", 3)
+    steps = max(1, (claims_total + batch - 1) // batch)
+    RES = ("resource.k8s.io", "v1")
+
+    def node_slices(i: int) -> list:
+        devices = []
+        for j in range(chips):
+            dev = {
+                "name": f"chip-{j}",
+                "attributes": {
+                    "type": {"string": "tpu-chip"},
+                    "index": {"int": j},
+                },
+            }
+            if j == 0:
+                # A persistent observe-only taint: the republish tick
+                # carries real content that simply has not changed.
+                dev["taints"] = [{"key": "tpu.dra.dev/unmonitored",
+                                  "value": "true"}]
+            devices.append(dev)
+        return [{
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": f"node-{i}-tpu.dra.dev"},
+            "spec": {
+                "driver": "tpu.dra.dev", "nodeName": f"node-{i}",
+                "pool": {"name": f"node-{i}", "generation": 1,
+                         "resourceSliceCount": 1},
+                "devices": devices,
+            },
+        }]
+
+    def _sync_count(sm, mode: str) -> int:
+        for metric in sm.sync_seconds.collect():
+            for s in metric.samples:
+                if s.name.endswith("_count") and \
+                        s.labels.get("mode") == mode:
+                    return int(s.value)
+        return 0
+
+    def run_trace(mode: str) -> dict:
+        fake = FakeKubeClient()
+        alloc_times: dict = {}
+        counted = _CountingKube(fake, alloc_times)
+        fake.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "tpu.dra.dev"},
+            "spec": {"selectors": [{"cel": {
+                "expression": 'device.driver == "tpu.dra.dev"'}}]},
+        })
+        for i in range(nodes_n):
+            publish_resource_slices(fake, node_slices(i))  # setup
+        sm = SchedulerMetrics()
+        sched = DraScheduler(counted, sched_metrics=sm)
+        diff = mode == "incremental"
+        if diff:
+            sched.start_event_driven()
+            sched.drain(30)
+        else:
+            sched.start()  # the historical 0.25s full-resync loop
+        create_times: dict = {}
+        converged = 0
+        prev: list = []
+        t0 = time.perf_counter()
+        for step in range(steps):
+            for _ in range(health_ticks):
+                for i in range(nodes_n):
+                    # Counted: this is the per-poll republish a node
+                    # driver performs; diff=False is the seed path.
+                    publish_resource_slices(counted, node_slices(i),
+                                            diff=diff)
+            for name in prev:
+                fake.delete(*RES, "resourceclaims", name,
+                            namespace="default")
+                fake.delete("", "v1", "pods", f"{name}-pod",
+                            namespace="default")
+            prev = []
+            want = min(batch, claims_total - step * batch)
+            names = [f"c-{step}-{k}" for k in range(want)]
+            for name in names:
+                fake.create(*RES, "resourceclaims", {
+                    "apiVersion": "resource.k8s.io/v1",
+                    "kind": "ResourceClaim",
+                    "metadata": {"name": name, "namespace": "default"},
+                    "spec": {"devices": {"requests": [{
+                        "name": "tpu",
+                        "exactly": {"deviceClassName": "tpu.dra.dev"},
+                    }]}},
+                }, namespace="default")
+                create_times[("default", name)] = time.perf_counter()
+                fake.create("", "v1", "pods", {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"{name}-pod",
+                                 "namespace": "default"},
+                    "spec": {
+                        "containers": [{"name": "c"}],
+                        "resourceClaims": [{
+                            "name": "tpu", "resourceClaimName": name}],
+                    },
+                }, namespace="default")
+            deadline = time.perf_counter() + 60.0
+            pending = set(("default", n) for n in names)
+            while pending and time.perf_counter() < deadline:
+                pending -= set(alloc_times)
+                if pending:
+                    time.sleep(0.002)
+            converged += len(names) - len(pending)
+            prev = names
+        elapsed = time.perf_counter() - t0
+        sched.stop()
+        lats = sorted(
+            alloc_times[k] - create_times[k]
+            for k in alloc_times if k in create_times
+        )
+        syncs = (_sync_count(sm, "incremental") if diff
+                 else _sync_count(sm, "full"))
+        return {
+            "writes": counted.writes,
+            "converged": converged,
+            "elapsed_s": round(elapsed, 3),
+            "syncs": syncs,
+            "syncs_per_sec": round(syncs / max(elapsed, 1e-9), 1),
+            "p50_ms": round(lats[len(lats) // 2] * 1000, 2)
+            if lats else None,
+            "p99_ms": round(lats[max(0, int(len(lats) * 0.99) - 1)]
+                            * 1000, 2) if lats else None,
+        }
+
+    polled = run_trace("polled")
+    incremental = run_trace("incremental")
+    wpc_polled = polled["writes"] / max(polled["converged"], 1)
+    wpc_inc = incremental["writes"] / max(incremental["converged"], 1)
+    write_ratio = wpc_polled / max(wpc_inc, 1e-9)
+    conv_ratio = (polled["p50_ms"] / max(incremental["p50_ms"], 1e-9)
+                  if polled["p50_ms"] and incremental["p50_ms"] else 0.0)
+    extras = {
+        "sched_nodes": nodes_n,
+        "sched_claims": claims_total,
+        "sched_chips_per_node": chips,
+        "sched_health_ticks_per_step": health_ticks,
+        "sched_write_reduction": round(write_ratio, 2),
+        "sched_convergence_speedup_p50": round(conv_ratio, 2),
+    }
+    for mode, r in (("polled", polled), ("incremental", incremental)):
+        for key, val in r.items():
+            extras[f"sched_{mode}_{key}"] = val
+    extras["sched_polled_writes_per_claim"] = round(wpc_polled, 2)
+    extras["sched_incremental_writes_per_claim"] = round(wpc_inc, 2)
+    return {
+        "metric": "sched_kube_writes_per_converged_claim",
+        "value": round(wpc_inc, 2),
+        "unit": "writes/claim",
+        # >1 = the incremental control plane beats the polled baseline
+        # (geometric mean of the two gated ratios).
+        "vs_baseline": round((write_ratio * max(conv_ratio, 1e-9))
+                             ** 0.5, 2),
+        "extras": extras,
+    }
+
+
 def bench_chaos() -> dict:
     """Chaos mode (`bench.py --chaos`): the claim-churn stress under a
     SEEDED fault schedule, plus the two gang-scale failure scenarios the
@@ -1042,6 +1273,37 @@ def bench_lint_findings() -> dict:
 def main() -> None:
     if "--placement-sim" in sys.argv[1:]:
         print(json.dumps(bench_placement_sim()))
+        return
+    if "--sched-churn" in sys.argv[1:]:
+        result = bench_sched_churn()
+        out_path = os.environ.get(
+            "BENCH_SCHED_OUT",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_scheduler.json"))
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(result))
+        # CI gate (`make bench-sched-smoke`): the write-amp ratio is
+        # deterministic (counted writes), the convergence ratio is a
+        # timing measurement -- both gates opt-in via env.
+        def _gate(env: str, key: str) -> bool:
+            try:
+                floor = float(os.environ.get(env, "0"))
+            except ValueError:
+                floor = 0.0
+            actual = result["extras"][key]
+            if floor and actual < floor:
+                print(f"sched-churn gate failed: {key}={actual} < "
+                      f"{env}={floor}", file=sys.stderr)
+                return False
+            return True
+        ok = _gate("BENCH_SCHED_MIN_WRITE_RATIO",
+                   "sched_write_reduction")
+        ok = _gate("BENCH_SCHED_MIN_CONV_RATIO",
+                   "sched_convergence_speedup_p50") and ok
+        if not ok:
+            sys.exit(1)
         return
     if "--chaos" in sys.argv[1:]:
         result = bench_chaos()

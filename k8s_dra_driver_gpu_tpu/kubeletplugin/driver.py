@@ -10,13 +10,14 @@ DeviceTaints -> republish (:496-566).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 from . import DRIVER_NAME
 from ..pkg.kubeclient import NotFoundError
 from ..pkg.metrics import DRARequestMetrics
-from ..pkg.sliceutil import publish_resource_slices
+from ..pkg.sliceutil import publish_resource_slices, slice_content_hash
 from .claim import ResourceClaim
 from .cleanup import CheckpointCleanupManager
 from .device_state import Config, DeviceState
@@ -66,6 +67,23 @@ class Driver:
         if publication_mode not in ("legacy", "combined", "split"):
             raise ValueError(f"unknown publication mode {publication_mode!r}")
         self.publication_mode = publication_mode
+
+        # Content hashes of the last slice set this driver successfully
+        # published: the health-event republish path short-circuits to
+        # ZERO kube calls when a poll reconciles to an unchanged taint
+        # set (the publish-level diff additionally protects explicit
+        # publishes, at the cost of one list read). The memo is
+        # re-verified against LIVE state every TPU_DRA_PUBLISH_RECHECK_S
+        # (a list read, zero writes when converged), so a slice deleted
+        # or mutated behind our back still self-heals within one recheck
+        # window instead of never.
+        self._published_hashes: tuple | None = None
+        self._published_verified_at = 0.0
+        try:
+            self._publish_recheck_s = float(os.environ.get(
+                "TPU_DRA_PUBLISH_RECHECK_S", "300"))
+        except ValueError:
+            self._publish_recheck_s = 300.0
 
         self.cleanup = CheckpointCleanupManager(self.state, kube_client)
         self.health_monitor = None
@@ -308,19 +326,57 @@ class Driver:
             s["spec"]["pool"]["resourceSliceCount"] = len(slices)
         return slices
 
-    def publish_resources(self) -> None:
-        publish_resource_slices(self.kube, self.generate_resource_slices())
+    @staticmethod
+    def _slice_hashes(slices: list[dict]) -> tuple:
+        return tuple(sorted(
+            (s["metadata"]["name"], slice_content_hash(s)) for s in slices
+        ))
+
+    def publish_resources(self) -> dict:
+        """Publish the node's slices through the content-hash diff
+        (pkg/sliceutil): unchanged specs cost zero kube writes, and the
+        pool generation only moves when device inventory changed."""
+        slices = self.generate_resource_slices()
+        hashes = self._slice_hashes(slices)
+        stats = publish_resource_slices(
+            self.kube, slices,
+            on_skip=self.metrics.slice_publish_skipped.inc,
+        )
+        self._published_hashes = hashes
+        self._published_verified_at = time.monotonic()
+        return stats
 
     # -- health ---------------------------------------------------------------
 
     def _on_health_taints(self, taints: list[DeviceTaint]) -> None:
-        """Reconcile device taints and republish (driver.go:496-566)."""
+        """Reconcile device taints and republish (driver.go:496-566).
+
+        The health monitor reports the FULL current taint list every
+        poll, so steady state arrives here once per poll interval with
+        nothing changed -- short-circuit on the published content hash
+        and touch the apiserver ZERO times (no list, no writes)."""
         new: dict[str, list[dict]] = {}
         for t in taints:
             new.setdefault(t.device, []).append(t.to_dict())
         self._taints = new
         self.metrics.set_taints(taints)
+        slices = self.generate_resource_slices()
+        hashes = self._slice_hashes(slices)
+        fresh = (time.monotonic() - self._published_verified_at
+                 < self._publish_recheck_s)
+        if hashes == self._published_hashes and fresh:
+            self.metrics.slice_publish_skipped.inc(len(slices))
+            return
+        # Changed content, or the periodic live recheck: the publish
+        # diff lists the live pool and writes only what differs (zero
+        # writes when still converged -- but it repairs slices another
+        # actor deleted or mutated behind the memo).
         try:
-            self.publish_resources()
+            publish_resource_slices(
+                self.kube, slices,
+                on_skip=self.metrics.slice_publish_skipped.inc,
+            )
+            self._published_hashes = hashes
+            self._published_verified_at = time.monotonic()
         except Exception:  # noqa: BLE001 - known reference gap: no retry
             logger.exception("republish after health event failed")

@@ -38,6 +38,13 @@ nothing previously enforced. Rules carry stable IDs:
   breaker; kube verb calls on such a raw client without an explicit
   ``timeout=`` are flagged too (they park threads on the urllib
   default when the apiserver wedges).
+- **TPUDRA009** scheduler sync path lists a watched resource straight
+  off the kube client: inside pkg/scheduler.py every read of a watched
+  resource (pods, claims, slices, classes, CDs, ...) must go through
+  the informer-backed ClusterView / inventory snapshot
+  (pkg/schedcache.py) -- a raw ``kube.list`` there reintroduces the
+  O(cluster)-per-tick full resync the incremental scheduler exists to
+  remove.
 
 Suppression: per-line ``# tpudra: allow=TPUDRA002[,TPUDRA003] reason``
 comments, or the committed baseline file (``analysis-baseline.json``)
@@ -68,6 +75,9 @@ RULES: dict[str, str] = {
                  "transition_policy",
     "TPUDRA008": "raw KubeClient outside the RetryingKubeClient "
                  "wrapper (or kube call without an explicit timeout)",
+    "TPUDRA009": "scheduler sync path lists a watched resource via the "
+                 "raw kube client instead of the informer-backed "
+                 "ClusterView/snapshot (pkg/schedcache)",
 }
 
 # Lock model (docs/architecture.md "Locking hierarchy"). Matched on the
@@ -89,6 +99,17 @@ _STATE_LITERAL_FILES = {"checkpoint.py", "statemachine.py", "lint.py"}
 # Files allowed to construct a raw KubeClient: the client's own module
 # and the retry wrapper that sanctions it (TPUDRA008 scope).
 _RAW_KUBECLIENT_FILES = {"kubeclient.py", "retry.py"}
+# TPUDRA009 scope: the scheduler's sync paths (the ClusterView in
+# schedcache.py is the sanctioned listing layer and is out of scope).
+_SCHED_SYNC_FILES = {"scheduler.py"}
+# Resources the scheduler watches (mirror of
+# pkg/schedcache.WATCHED_RESOURCES, kept literal so the linter has no
+# runtime import of the code under analysis).
+_WATCHED_RESOURCES = {
+    "pods", "nodes", "daemonsets", "jobs", "resourceclaims",
+    "resourceslices", "deviceclasses", "resourceclaimtemplates",
+    "computedomains",
+}
 _STATE_LITERALS = {"PrepareStarted", "PrepareCompleted"}
 # Copy constructors that launder taint (deep or top-level).
 _COPY_CALLS = {"json_copy", "deepcopy", "dict", "list", "sorted",
@@ -566,6 +587,27 @@ class _ModuleLinter(ast.NodeVisitor):
         if isinstance(func, ast.Attribute):
             attr = func.attr
             base_src = _unparse(func.value)
+
+            # TPUDRA009: raw kube.list of a watched resource inside the
+            # scheduler's sync paths -- these reads must come from the
+            # informer-backed ClusterView / inventory snapshot.
+            if attr == "list" and self.basename in _SCHED_SYNC_FILES:
+                chain = _attr_chain(func)
+                listed = {
+                    a.value for a in node.args
+                    if isinstance(a, ast.Constant)
+                    and isinstance(a.value, str)
+                }
+                watched = sorted(listed & _WATCHED_RESOURCES)
+                if watched and chain[:-1] and "kube" in chain[-2]:
+                    self._emit(
+                        "TPUDRA009", node,
+                        f"scheduler sync path lists watched resource"
+                        f"(s) {', '.join(watched)} via {base_src}.list; "
+                        "read through the ClusterView/snapshot "
+                        "(pkg/schedcache) instead",
+                        key=f"{base_src}.list:{','.join(watched)}",
+                    )
 
             # TPUDRA002: acquire outside a with-guard. The release in
             # the finally must be of the SAME lock expression (or an

@@ -11,14 +11,21 @@ gap missed (required: the watch does not replay events lost across a
 
 Works against both clients:
 - KubeClient: real `?watch=true` stream + timer-driven relist.
-- FakeKubeClient: its global watch hook; events for other resources are
-  filtered by `kind`, and each matching event triggers a relist (the
-  fake store is tiny, and relisting sidesteps incremental bookkeeping
-  differences between patch/update notification shapes).
+- FakeKubeClient: its resource-scoped watch hook; events are applied
+  INCREMENTALLY (the fake delivers full post-merge objects, so the
+  watch-event path handles them verbatim). Earlier builds relisted the
+  whole store on every matching event, which turned one burst of N
+  writes into N full lists -- the relist path now survives only as the
+  conservative fallback for events without usable metadata, and
+  concurrent relist requests coalesce into a single trailing relist
+  per burst. ``relist_total`` (exported as
+  ``tpu_dra_informer_relist_total`` by consumers wiring ``on_relist``)
+  counts how often the expensive path actually runs.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 from typing import Callable
@@ -36,6 +43,7 @@ class Informer:
         kind: str,
         namespace: str | None = None,
         resync_period: float = 30.0,
+        on_relist: Callable[[], None] | None = None,
     ):
         self.kube = kube
         self.group = group
@@ -48,9 +56,19 @@ class Informer:
         self._cache: dict[tuple[str, str], dict] = {}  # (ns, name) -> obj
         self._by_uid: dict[str, tuple[str, str]] = {}
         self._hooks: list[Callable[[], None]] = []
+        self._event_hooks: list[Callable[[str, dict], None]] = []
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._started = False
+        # Relist accounting + burst coalescing: while one relist runs,
+        # further requests just mark it pending; ONE trailing relist
+        # covers the whole burst.
+        self.relist_total = 0
+        self._on_relist = on_relist
+        self._relist_lock = threading.Lock()
+        self._relist_active = False
+        self._relist_pending = False
+        self._fake_hook = None
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -65,7 +83,10 @@ class Informer:
             # server answers; consumers see an empty cache until then
             # (RetryableError semantics), never a crashed constructor.
             logger.exception("initial informer list failed; will resync")
-        if hasattr(self.kube, "add_watcher"):  # FakeKubeClient
+        if hasattr(self.kube, "add_resource_watcher"):  # FakeKubeClient
+            self._fake_hook = self._on_fake_resource_event
+            self.kube.add_resource_watcher(self._fake_hook)
+        elif hasattr(self.kube, "add_watcher"):  # legacy fake surface
             self.kube.add_watcher(self._on_fake_event)
         else:
             self.kube.watch(
@@ -86,6 +107,10 @@ class Informer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._fake_hook is not None and hasattr(
+                self.kube, "remove_resource_watcher"):
+            self.kube.remove_resource_watcher(self._fake_hook)
+            self._fake_hook = None
 
     def wait_for_sync(self, timeout: float = 10.0) -> bool:
         return self._synced.wait(timeout)
@@ -97,12 +122,26 @@ class Informer:
         consumers re-read the cache, informer-handler style)."""
         self._hooks.append(fn)
 
+    def add_event_hook(self, fn: Callable[[str, dict], None]) -> None:
+        """fn(ev_type, obj) fires once per changed OBJECT (watch events
+        and relist diffs alike) -- the payload-carrying feed a keyed
+        workqueue consumer needs to stay O(changes)."""
+        self._event_hooks.append(fn)
+
     def _fire(self) -> None:
         for fn in list(self._hooks):
             try:
                 fn()
             except Exception:  # noqa: BLE001 - consumer bug must not kill us
                 logger.exception("informer change hook failed")
+
+    def _fire_events(self, events: list[tuple[str, dict]]) -> None:
+        for fn in list(self._event_hooks):
+            for ev_type, obj in events:
+                try:
+                    fn(ev_type, obj)
+                except Exception:  # noqa: BLE001 - consumer bug
+                    logger.exception("informer event hook failed")
 
     def _key(self, obj: dict) -> tuple[str, str]:
         md = obj.get("metadata", {})
@@ -124,13 +163,36 @@ class Informer:
                 if uid:
                     self._by_uid[uid] = key
         if changed:
+            self._fire_events([(ev_type, obj)])
             self._fire()
 
-    def _on_fake_event(self, ev_type: str, obj: dict) -> None:
+    def _on_fake_resource_event(self, group: str, resource: str,
+                                namespace: str, ev_type: str,
+                                obj: dict) -> None:
+        """Resource-scoped FakeKubeClient events apply incrementally:
+        exact (group, resource) match, full post-merge objects -- no
+        kind guessing, no relist."""
         if self._stop.is_set():
-            return  # FakeKubeClient has no watcher-removal path
-        # Objects in the fake store usually carry their kind; ones that
-        # don't (bare test fixtures) relist conservatively.
+            return
+        if group != self.group or resource != self.resource:
+            return
+        if self.namespace and namespace != self.namespace:
+            return
+        if not obj.get("metadata", {}).get("name"):
+            self.relist()  # unusable payload: conservative fallback
+            return
+        self._synced.set()
+        # The fake store may later mutate this very dict in place (its
+        # ADDED payload is the stored object): cache a private copy so
+        # change detection compares against what was actually seen.
+        self._on_watch_event(ev_type, json.loads(json.dumps(obj)))
+
+    def _on_fake_event(self, ev_type: str, obj: dict) -> None:
+        """Legacy global-watcher surface (fakes without resource-scoped
+        hooks): filter by kind and relist -- events for other kinds
+        can't be told apart reliably, so the conservative path stays."""
+        if self._stop.is_set():
+            return
         if obj.get("kind") not in (self.kind, None):
             return
         self.relist()
@@ -151,6 +213,32 @@ class Informer:
                 logger.exception("informer relist failed")
 
     def relist(self) -> None:
+        """Full list + cache swap. Concurrent requests coalesce: while
+        one relist is in flight, any number of further requests fold
+        into a single trailing relist (one per drained burst)."""
+        with self._relist_lock:
+            if self._relist_active:
+                self._relist_pending = True
+                return
+            self._relist_active = True
+        try:
+            while True:
+                self._relist_once()
+                with self._relist_lock:
+                    if not self._relist_pending:
+                        return
+                    self._relist_pending = False
+        finally:
+            with self._relist_lock:
+                self._relist_active = False
+
+    def _relist_once(self) -> None:
+        self.relist_total += 1
+        if self._on_relist is not None:
+            try:
+                self._on_relist()
+            except Exception:  # noqa: BLE001 - metrics hook
+                logger.exception("informer relist hook failed")
         items = self.kube.list(
             self.group, self.version, self.resource,
             namespace=self.namespace,
@@ -164,8 +252,18 @@ class Informer:
                 if o.get("metadata", {}).get("uid")
             }
             changed = old != self._cache
+            events: list[tuple[str, dict]] = []
+            if changed and self._event_hooks:
+                for key, obj in self._cache.items():
+                    if old.get(key) != obj:
+                        ev = "MODIFIED" if key in old else "ADDED"
+                        events.append((ev, obj))
+                for key, obj in old.items():
+                    if key not in self._cache:
+                        events.append(("DELETED", obj))
         self._synced.set()
         if changed:
+            self._fire_events(events)
             self._fire()
 
     # -- cache reads ----------------------------------------------------------

@@ -69,6 +69,16 @@ class DRARequestMetrics:
         # (prep_lock_wait, ckpt_fsync_wait, prep_devices, ...): the
         # observability half of the sharded-lock work -- lock-wait
         # regressions show up here before they move the p99.
+        # Publish-diff effectiveness (pkg/sliceutil): slice writes
+        # avoided because the live spec already matched by content
+        # hash. A health-republish storm that stays write-free shows
+        # up here instead of as apiserver load.
+        self.slice_publish_skipped = Counter(
+            "tpu_dra_slice_publish_skipped_total",
+            "ResourceSlice writes skipped by the content-hash publish "
+            "diff (unchanged spec, no PUT issued).",
+            registry=self.registry,
+        )
         self.prepare_segment = Histogram(
             "tpu_dra_prepare_segment_seconds",
             "Wall time of instrumented prepare/unprepare segments "
@@ -187,6 +197,53 @@ class PlacementMetrics:
             "(0 = single chip; lower = tighter collective).",
             ["pool"],
             buckets=self._HOP_BUCKETS,
+            registry=self.registry,
+        )
+
+
+class SchedulerMetrics:
+    """Event-driven scheduler observability (pkg/scheduler +
+    pkg/schedcache + pkg/informer).
+
+    The headline health signal is the PAIR (sync_seconds by mode,
+    dirty_queue_depth): a healthy event-driven control plane shows
+    cheap ``incremental`` samples dominating, rare ``full`` safety
+    resyncs, and a dirty queue that returns to zero between bursts.
+    ``informer_relist_total`` rising means the cheap incremental event
+    path is being bypassed (watch gaps, kind-less fake events);
+    ``slice_publish_skipped_total`` counts the writes the content-hash
+    publish diff avoided (pkg/sliceutil) for publishers wired to THIS
+    registry -- node drivers run in their own processes and export
+    their own copy via DRARequestMetrics, so in the scheduler binary
+    this reads 0 unless a scheduler-side publisher exists; dashboards
+    should aggregate the metric name across jobs."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.sync_seconds = Histogram(
+            "tpu_dra_sched_sync_seconds",
+            "Scheduler sync work duration by mode (full resync pass "
+            "vs. one incremental dirty-key drain).",
+            ["mode"],
+            buckets=_BUCKETS,
+            registry=self.registry,
+        )
+        self.dirty_depth = Gauge(
+            "tpu_dra_sched_dirty_queue_depth",
+            "Dirty keys currently queued for incremental sync.",
+            registry=self.registry,
+        )
+        self.publish_skipped = Counter(
+            "tpu_dra_slice_publish_skipped_total",
+            "ResourceSlice writes skipped because the desired spec "
+            "matched the live spec by canonical content hash.",
+            registry=self.registry,
+        )
+        self.informer_relists = Counter(
+            "tpu_dra_informer_relist_total",
+            "Full informer relists by resource (the expensive fallback "
+            "path; incremental watch events do not count).",
+            ["resource"],
             registry=self.registry,
         )
 

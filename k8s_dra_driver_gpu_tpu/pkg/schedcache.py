@@ -1,0 +1,518 @@
+"""Indexed allocation snapshots + informer-backed cluster view.
+
+The scheduler stand-in used to re-derive the world on every 0.25s pass:
+re-list every watched resource, rebuild every candidate device list,
+re-evaluate every CEL selector per claim. This module is the
+incremental-state backbone that replaces that:
+
+- ``InventorySnapshot``: the device inventory (candidates, per-node
+  index, KEP-4815 counter seeds, static CEL selector evaluations, the
+  topology scorer's ordering memos) built ONCE per ResourceSlice
+  change and shared across claims and sync passes. The snapshot
+  signature covers every slice's (name, resourceVersion, pool
+  generation): any slice write -- including a pool-generation bump --
+  invalidates it.
+- ``AllocationState``: the allocated-device set and the debited
+  counter ledger, maintained INCREMENTALLY from ResourceClaim events
+  (observe/forget) instead of being rebuilt per claim per pass.
+- ``ClusterView``: one read surface for the scheduler's sync paths.
+  Event-driven mode backs it with per-resource informers (list+watch
+  caches, pkg/informer.py) so a sync pass performs zero kube reads;
+  direct mode (unit tests, one-shot sync) falls through to the kube
+  client. Scheduler sync code must read through this view -- lint rule
+  TPUDRA009 (pkg/analysis) forbids raw ``kube.list`` of watched
+  resources inside pkg/scheduler.py.
+
+Reference: controller-runtime's informer-indexed reconcilers and the
+structured-parameters DRA plugin's allocator snapshot (see PAPERS.md);
+the reference driver consumes CRs exclusively through informer caches.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable
+
+from .cel import CelProgram, Quantity, compile_expression
+from .informer import Informer
+from .kubeclient import KubeError, NotFoundError
+
+logger = logging.getLogger(__name__)
+
+RESOURCE = ("resource.k8s.io", "v1")
+
+# ComputeDomain CRD coordinates (kept literal: importing the
+# computedomain package here would cycle through the plugin stack).
+CD_GROUP = "resource.tpu.dra"
+CD_VERSION = "v1beta1"
+PREFERRED_NODES_ANNOTATION = "resource.tpu.dra/preferredNodes"
+
+
+def tolerates(taint: dict, tolerations: list[dict]) -> bool:
+    for tol in tolerations or []:
+        if tol.get("effect") and tol["effect"] != taint.get("effect"):
+            continue
+        op = tol.get("operator", "Equal")
+        if op == "Exists":
+            if not tol.get("key") or tol["key"] == taint.get("key"):
+                return True
+        elif tol.get("key") == taint.get("key") and \
+                tol.get("value", "") == taint.get("value", ""):
+            return True
+    return False
+
+
+class CompiledSelectors:
+    """Expression -> CelProgram cache; a selector that fails to compile
+    permanently matches nothing (and is logged once), like a CEL
+    compile error surfaced in the scheduler.
+
+    The cache is shared process-wide (class-level, lock-guarded) and
+    keyed by source text: a scheduler instantiated per sync pass still
+    reuses every previously compiled selector. cel.compile_expression
+    additionally memoizes the parsed AST, so even a fresh cache entry
+    skips the lex+parse for text seen anywhere else in the process."""
+
+    _shared: dict[str, CelProgram | None] = {}
+    _shared_lock = threading.Lock()
+    _MAX = 4096  # selectors are operator-authored; this is a leak bound
+
+    def __init__(self):
+        self._cache = self._shared
+
+    def get(self, expression: str) -> CelProgram | None:
+        with self._shared_lock:
+            if expression in self._cache:
+                return self._cache[expression]
+        try:
+            prog = compile_expression(expression)
+        except Exception as e:  # noqa: BLE001 - compile boundary
+            logger.error("selector does not compile (%s): %s",
+                         e, expression)
+            prog = None
+        with self._shared_lock:
+            if len(self._cache) >= self._MAX:
+                self._cache.clear()
+            self._cache[expression] = prog
+        return prog
+
+
+class CounterLedger:
+    """Available KEP-4815 counters per (driver, pool, counterSet),
+    seeded from sharedCounters and debited by consumesCounters."""
+
+    def __init__(self):
+        self._avail: dict[tuple, dict[str, int]] = {}
+
+    def seed(self, driver: str, pool: str, counter_sets: list[dict]):
+        for cs in counter_sets or []:
+            key = (driver, pool, cs.get("name", ""))
+            if key in self._avail:
+                continue
+            self._avail[key] = {
+                name: Quantity.parse(val.get("value", "0")).milli
+                for name, val in (cs.get("counters") or {}).items()
+            }
+
+    def _iter_demand(self, driver, pool, consumes):
+        for block in consumes or []:
+            key = (driver, pool, block.get("counterSet", ""))
+            for name, val in (block.get("counters") or {}).items():
+                yield key, name, Quantity.parse(
+                    val.get("value", "0")).milli
+
+    def fits(self, driver: str, pool: str, consumes: list[dict]) -> bool:
+        for key, name, milli in self._iter_demand(driver, pool, consumes):
+            have = self._avail.get(key, {}).get(name)
+            if have is None or have < milli:
+                return False
+        return True
+
+    def debit(self, driver: str, pool: str, consumes: list[dict]):
+        for key, name, milli in self._iter_demand(driver, pool, consumes):
+            if key in self._avail and name in self._avail[key]:
+                self._avail[key][name] -= milli
+
+    def credit(self, driver: str, pool: str, consumes: list[dict]):
+        """Undo a debit (the backtracking allocator un-picks devices)."""
+        for key, name, milli in self._iter_demand(driver, pool, consumes):
+            if key in self._avail and name in self._avail[key]:
+                self._avail[key][name] += milli
+
+
+class Candidate:
+    __slots__ = ("driver", "pool", "node", "device", "blocking_taints")
+
+    def __init__(self, driver, pool, node, device):
+        self.driver = driver
+        self.pool = pool
+        self.node = node
+        self.device = device
+        # Pre-extracted at snapshot build: the taints that can block
+        # allocation, so the per-claim check touches a (usually empty)
+        # list instead of re-walking the device dict.
+        self.blocking_taints = [
+            t for t in device.get("taints") or []
+            if t.get("effect") in ("NoSchedule", "NoExecute")
+        ]
+
+    @property
+    def name(self):
+        return self.device["name"]
+
+    @property
+    def key(self):
+        return (self.driver, self.pool, self.name)
+
+
+class InventorySnapshot:
+    """The allocation-relevant projection of the published
+    ResourceSlices, built once per slice change:
+
+    - ``candidates`` / ``by_key`` / ``by_node``: newest-generation
+      devices, indexed for the per-node fit.
+    - counter seeds for a fresh :class:`CounterLedger`.
+    - ``cel_match``: memoized static-selector evaluation -- one CEL
+      run per (expression, device) for the snapshot's LIFETIME, not
+      per claim per pass.
+    - ``order_cache``: the topology scorer's candidate-ordering memos
+      (moved here from the scheduler's per-pass cache; they are pure
+      functions of the inventory, so they live exactly as long as it
+      does and invalidate on any slice write / generation bump).
+    """
+
+    @staticmethod
+    def signature_of(slices: list[dict]) -> tuple:
+        return tuple(sorted(
+            (s.get("metadata", {}).get("name", ""),
+             s.get("metadata", {}).get("resourceVersion", ""),
+             s.get("spec", {}).get("pool", {}).get("generation", 0))
+            for s in slices
+        ))
+
+    def __init__(self, slices: list[dict], signature: tuple | None = None,
+                 default_node: str | None = None):
+        self.signature = (self.signature_of(slices)
+                          if signature is None else signature)
+        newest: dict[tuple, int] = {}
+        for s in slices:
+            spec = s.get("spec", {})
+            pool = spec.get("pool", {})
+            key = (spec.get("driver", ""), pool.get("name", ""))
+            newest[key] = max(newest.get(key, 0),
+                              pool.get("generation", 0))
+        self.pool_generations = newest
+        self.candidates: list[Candidate] = []
+        self._counter_seeds: list[tuple[str, str, list[dict]]] = []
+        for s in slices:
+            spec = s.get("spec", {})
+            pool = spec.get("pool", {})
+            driver = spec.get("driver", "")
+            pool_name = pool.get("name", "")
+            if pool.get("generation", 0) != newest[(driver, pool_name)]:
+                continue  # stale generation: invisible to allocation
+            node = spec.get("nodeName") or default_node or ""
+            if spec.get("sharedCounters"):
+                self._counter_seeds.append(
+                    (driver, pool_name, spec["sharedCounters"]))
+            for dev in spec.get("devices", []):
+                self.candidates.append(
+                    Candidate(driver, pool_name, node, dev))
+        self.by_key: dict[tuple, Candidate] = {
+            c.key: c for c in self.candidates}
+        self.by_node: dict[str, list[Candidate]] = {}
+        for c in self.candidates:
+            self.by_node.setdefault(c.node, []).append(c)
+        self.order_cache: dict[tuple, list[str] | None] = {}
+        self._sel_cache: dict[tuple[str, tuple], bool] = {}
+
+    def make_ledger(self) -> CounterLedger:
+        ledger = CounterLedger()
+        for driver, pool, sets in self._counter_seeds:
+            ledger.seed(driver, pool, sets)
+        return ledger
+
+    def cel_match(self, expression: str, prog: CelProgram,
+                  cand: Candidate) -> bool:
+        key = (expression, cand.key)
+        hit = self._sel_cache.get(key)
+        if hit is None:
+            try:
+                hit = bool(prog.matches_device(cand.device, cand.driver))
+            except Exception:  # noqa: BLE001 - CEL eval boundary
+                hit = False
+            self._sel_cache[key] = hit
+        return hit
+
+
+class AllocationState:
+    """Allocated-device keys + debited counter budgets, incrementally
+    maintained from ResourceClaim allocations.
+
+    ``observe`` is idempotent per claim (keyed by uid, falling back to
+    namespace/name): replaying the same allocation -- e.g. the watch
+    event for a patch the scheduler itself just wrote -- is a no-op,
+    and a changed allocation releases the previous devices first.
+    """
+
+    def __init__(self, snapshot: InventorySnapshot):
+        self.snapshot = snapshot
+        self.ledger = snapshot.make_ledger()
+        self.allocated: set[tuple] = set()
+        self._claims: dict[str, frozenset] = {}
+
+    @staticmethod
+    def claim_id(claim: dict) -> str:
+        md = claim.get("metadata", {})
+        return md.get("uid") or f"{md.get('namespace', 'default')}/" \
+                                f"{md.get('name', '')}"
+
+    @staticmethod
+    def _alloc_keys(claim: dict) -> frozenset:
+        alloc = claim.get("status", {}).get("allocation") or {}
+        return frozenset(
+            (r.get("driver", ""), r.get("pool", ""), r.get("device", ""))
+            for r in alloc.get("devices", {}).get("results", [])
+        )
+
+    def rebuild(self, claims: list[dict]) -> None:
+        self.ledger = self.snapshot.make_ledger()
+        self.allocated = set()
+        self._claims = {}
+        for claim in claims:
+            self.observe(claim)
+
+    def observe(self, claim: dict) -> bool:
+        """Fold one claim's current allocation in. Returns True when
+        the state changed."""
+        cid = self.claim_id(claim)
+        keys = self._alloc_keys(claim)
+        old = self._claims.get(cid, frozenset())
+        if keys == old:
+            return False
+        self._release(old)
+        for key in keys:
+            self.allocated.add(key)
+            cand = self.snapshot.by_key.get(key)
+            if cand is not None:
+                self.ledger.debit(cand.driver, cand.pool,
+                                  cand.device.get("consumesCounters"))
+        if keys:
+            self._claims[cid] = keys
+        else:
+            self._claims.pop(cid, None)
+        return True
+
+    def forget(self, claim: dict) -> bool:
+        """Drop a deleted claim; its devices return to the free pool."""
+        cid = self.claim_id(claim)
+        old = self._claims.pop(cid, None)
+        if not old:
+            return False
+        self._release(old)
+        return True
+
+    def _release(self, keys: frozenset) -> None:
+        for key in keys:
+            self.allocated.discard(key)
+            cand = self.snapshot.by_key.get(key)
+            if cand is not None:
+                self.ledger.credit(cand.driver, cand.pool,
+                                   cand.device.get("consumesCounters"))
+
+
+# (group, version, resource, kind) for every resource the scheduler's
+# sync paths read. TPUDRA009 (pkg/analysis) enforces that reads of
+# these inside pkg/scheduler.py go through this view.
+WATCHED_RESOURCES: tuple[tuple[str, str, str, str], ...] = (
+    ("", "v1", "pods", "Pod"),
+    ("", "v1", "nodes", "Node"),
+    ("apps", "v1", "daemonsets", "DaemonSet"),
+    ("batch", "v1", "jobs", "Job"),
+    ("resource.k8s.io", "v1", "resourceclaims", "ResourceClaim"),
+    ("resource.k8s.io", "v1", "resourceslices", "ResourceSlice"),
+    ("resource.k8s.io", "v1", "deviceclasses", "DeviceClass"),
+    ("resource.k8s.io", "v1", "resourceclaimtemplates",
+     "ResourceClaimTemplate"),
+    (CD_GROUP, CD_VERSION, "computedomains", "ComputeDomain"),
+)
+
+
+class ClusterView:
+    """One read surface for scheduler sync paths.
+
+    Direct mode (default): every accessor falls through to the kube
+    client, preserving the one-shot ``sync_once()`` semantics unit
+    tests rely on (KubeErrors propagate so fail-closed call sites keep
+    failing closed). Event mode (``start()``): every watched resource
+    gets an informer; accessors become pure cache reads and
+    ``on_event(resource, ev_type, obj)`` fires per object change so
+    the scheduler can maintain its dirty set.
+
+    The inventory snapshot is cached in BOTH modes and rebuilt only
+    when the slice signature changes (any slice create/update/delete,
+    including pool-generation bumps)."""
+
+    def __init__(self, kube, on_event: Callable | None = None,
+                 on_relist: Callable[[str], None] | None = None,
+                 resync_period: float = 300.0,
+                 default_node: str | None = None):
+        self.kube = kube
+        self._on_event = on_event
+        self._on_relist = on_relist
+        self._resync_period = resync_period
+        self._default_node = default_node
+        self._informers: dict[str, Informer] = {}
+        self._snapshot: InventorySnapshot | None = None
+        self._snapshot_lock = threading.Lock()
+        self._cd_windows: dict[str, list[str]] | None = None
+        self._started = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def event_driven(self) -> bool:
+        return self._started
+
+    def start(self) -> "ClusterView":
+        if self._started:
+            return self
+        self._started = True
+        for group, version, resource, kind in WATCHED_RESOURCES:
+            inf = Informer(self.kube, group, version, resource, kind=kind,
+                           resync_period=self._resync_period,
+                           on_relist=self._relist_hook(resource))
+            if self._on_event is not None:
+                inf.add_event_hook(self._event_hook(resource))
+            self._informers[resource] = inf
+            inf.start()
+        return self
+
+    def stop(self) -> None:
+        for inf in self._informers.values():
+            inf.stop()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        deadline = timeout
+        return all(inf.wait_for_sync(deadline)
+                   for inf in self._informers.values())
+
+    def _event_hook(self, resource: str):
+        def hook(ev_type: str, obj: dict, _r=resource):
+            self._on_local_event(_r, ev_type, obj)
+            if self._on_event is not None:
+                self._on_event(_r, ev_type, obj)
+        return hook
+
+    def _relist_hook(self, resource: str):
+        def hook(_r=resource):
+            if self._on_relist is not None:
+                self._on_relist(_r)
+        return hook
+
+    def _on_local_event(self, resource: str, ev_type: str,
+                        obj: dict) -> None:
+        if resource == "computedomains":
+            self._cd_windows = None
+
+    # -- per-pass bookkeeping -------------------------------------------------
+
+    def begin_pass(self) -> None:
+        """Reset per-pass memos that event mode invalidates by event
+        (direct mode has no events, so a full pass starts fresh)."""
+        if not self._started:
+            self._cd_windows = None
+
+    # -- reads ----------------------------------------------------------------
+
+    def _list(self, group: str, version: str, resource: str) -> list[dict]:
+        inf = self._informers.get(resource)
+        if inf is not None:
+            return inf.list()
+        return self.kube.list(group, version, resource)
+
+    def pods(self) -> list[dict]:
+        return self._list("", "v1", "pods")
+
+    def nodes(self) -> list[dict]:
+        return self._list("", "v1", "nodes")
+
+    def daemonsets(self) -> list[dict]:
+        return self._list("apps", "v1", "daemonsets")
+
+    def jobs(self) -> list[dict]:
+        return self._list("batch", "v1", "jobs")
+
+    def claims(self) -> list[dict]:
+        return self._list(*RESOURCE, "resourceclaims")
+
+    def slices(self) -> list[dict]:
+        return self._list(*RESOURCE, "resourceslices")
+
+    def device_classes(self) -> list[dict]:
+        return self._list(*RESOURCE, "deviceclasses")
+
+    def get_claim(self, name: str, namespace: str = "default") -> dict:
+        inf = self._informers.get("resourceclaims")
+        if inf is not None:
+            obj = inf.get(name, namespace)
+            if obj is None:
+                raise NotFoundError(f"resourceclaims/{name}")
+            return obj
+        return self.kube.get(*RESOURCE, "resourceclaims", name,
+                             namespace=namespace)
+
+    def get_template(self, name: str, namespace: str = "default") -> dict:
+        inf = self._informers.get("resourceclaimtemplates")
+        if inf is not None:
+            obj = inf.get(name, namespace)
+            if obj is None:
+                raise NotFoundError(f"resourceclaimtemplates/{name}")
+            return obj
+        return self.kube.get(*RESOURCE, "resourceclaimtemplates", name,
+                             namespace=namespace)
+
+    # -- indexed snapshot -----------------------------------------------------
+
+    def snapshot(self) -> InventorySnapshot:
+        """The current inventory snapshot, rebuilt only when any slice
+        changed (tracked via (name, resourceVersion, generation))."""
+        slices = self.slices()
+        sig = InventorySnapshot.signature_of(slices)
+        with self._snapshot_lock:
+            if self._snapshot is None or self._snapshot.signature != sig:
+                self._snapshot = InventorySnapshot(
+                    slices, signature=sig,
+                    default_node=self._default_node)
+            return self._snapshot
+
+    def invalidate_snapshot(self) -> None:
+        with self._snapshot_lock:
+            self._snapshot = None
+
+    # -- ComputeDomain windows ------------------------------------------------
+
+    def cd_windows(self) -> dict[str, list[str]]:
+        """uid -> preferred-node window for every ComputeDomain.
+        Cached until a CD event (event mode) / the next pass (direct
+        mode); a transient list failure caches the empty answer so N
+        pending channel claims never mean N failing lists."""
+        cached = self._cd_windows
+        if cached is not None:
+            return cached
+        try:
+            cds = self._list(CD_GROUP, CD_VERSION, "computedomains")
+        except KubeError:
+            self._cd_windows = {}
+            return self._cd_windows
+        windows: dict[str, list[str]] = {}
+        for cd in cds:
+            md = cd.get("metadata", {})
+            uid = md.get("uid")
+            ann = (md.get("annotations") or {}).get(
+                PREFERRED_NODES_ANNOTATION, "")
+            if uid:
+                windows[uid] = [n for n in ann.split(",") if n]
+        self._cd_windows = windows
+        return windows

@@ -24,6 +24,18 @@ difference:
 3. **Binding**: pods whose claims are all allocated get
    ``spec.nodeName`` patched to the (single) node the allocation pins.
 
+Two execution modes share the same sync logic:
+
+- **Polled** (``run(interval)``): the historical full-resync loop --
+  every pass re-reads the world. Kept as the compatibility mode and as
+  the low-frequency safety resync.
+- **Event-driven** (``start_event_driven()``): informers
+  (pkg/schedcache.ClusterView) feed per-object events into a keyed
+  workqueue (pkg/workqueue); ``sync`` work degrades to draining dirty
+  keys -- O(changes), not O(cluster) per tick -- with a low-frequency
+  full resync as the safety net. Inventory state is served from an
+  indexed snapshot rebuilt only when a ResourceSlice actually changes.
+
 Used by the executable e2e tier (TPU_DRA_E2E=fake) and runnable as a
 standalone control-plane binary:
 
@@ -34,17 +46,26 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import threading
 import time
 import uuid
 
-from .cel import CelEvalError, CelProgram, Quantity, compile_expression
 from .featuregates import (
     TOPOLOGY_AWARE_PLACEMENT,
     FeatureGateError,
     FeatureGates,
 )
 from .kubeclient import ConflictError, KubeError, NotFoundError
+from .schedcache import (
+    AllocationState,
+    Candidate as _Candidate,
+    ClusterView,
+    CompiledSelectors as _CompiledSelectors,
+    CounterLedger as _CounterLedger,
+    InventorySnapshot,
+    tolerates as _tolerates,
+)
 from .topology import TorusGrid, largest_free_shape
 from .topology.score import frag_from_largest
 from .topology import order_candidates as topo_order_candidates
@@ -53,6 +74,11 @@ from .topology import set_compactness
 logger = logging.getLogger(__name__)
 
 RESOURCE = ("resource.k8s.io", "v1")
+
+# Safety-net full-resync period for the event-driven mode: dirty keys
+# carry the steady state, this only catches watch gaps and software
+# bugs. Override with TPU_DRA_SCHED_RESYNC (seconds).
+DEFAULT_RESYNC_S = 30.0
 
 
 def _meta(obj):
@@ -64,127 +90,17 @@ def _meta(obj):
 from . import json_copy  # noqa: E402,F401
 
 
-class _CompiledSelectors:
-    """Expression -> CelProgram cache; a selector that fails to compile
-    permanently matches nothing (and is logged once), like a CEL
-    compile error surfaced in the scheduler.
-
-    The cache is shared process-wide (class-level, lock-guarded) and
-    keyed by source text: a scheduler instantiated per sync pass still
-    reuses every previously compiled selector, and within one pass each
-    distinct expression compiles at most once no matter how many
-    candidate devices it filters. cel.compile_expression additionally
-    memoizes the parsed AST, so even a fresh cache entry skips the
-    lex+parse for text seen anywhere else in the process."""
-
-    _shared: dict[str, CelProgram | None] = {}
-    _shared_lock = threading.Lock()
-    _MAX = 4096  # selectors are operator-authored; this is a leak bound
-
-    def __init__(self):
-        self._cache = self._shared
-
-    def get(self, expression: str) -> CelProgram | None:
-        with self._shared_lock:
-            if expression in self._cache:
-                return self._cache[expression]
-        try:
-            prog = compile_expression(expression)
-        except Exception as e:  # noqa: BLE001 - compile boundary
-            logger.error("selector does not compile (%s): %s",
-                         e, expression)
-            prog = None
-        with self._shared_lock:
-            if len(self._cache) >= self._MAX:
-                self._cache.clear()
-            self._cache[expression] = prog
-        return prog
-
-
-class _CounterLedger:
-    """Available KEP-4815 counters per (driver, pool, counterSet),
-    seeded from sharedCounters and debited by consumesCounters."""
-
-    def __init__(self):
-        self._avail: dict[tuple, dict[str, int]] = {}
-
-    def seed(self, driver: str, pool: str, counter_sets: list[dict]):
-        for cs in counter_sets or []:
-            key = (driver, pool, cs.get("name", ""))
-            if key in self._avail:
-                continue
-            self._avail[key] = {
-                name: Quantity.parse(val.get("value", "0")).milli
-                for name, val in (cs.get("counters") or {}).items()
-            }
-
-    def _iter_demand(self, driver, pool, consumes):
-        for block in consumes or []:
-            key = (driver, pool, block.get("counterSet", ""))
-            for name, val in (block.get("counters") or {}).items():
-                yield key, name, Quantity.parse(
-                    val.get("value", "0")).milli
-
-    def fits(self, driver: str, pool: str, consumes: list[dict]) -> bool:
-        for key, name, milli in self._iter_demand(driver, pool, consumes):
-            have = self._avail.get(key, {}).get(name)
-            if have is None or have < milli:
-                return False
-        return True
-
-    def debit(self, driver: str, pool: str, consumes: list[dict]):
-        for key, name, milli in self._iter_demand(driver, pool, consumes):
-            if key in self._avail and name in self._avail[key]:
-                self._avail[key][name] -= milli
-
-    def credit(self, driver: str, pool: str, consumes: list[dict]):
-        """Undo a debit (the backtracking allocator un-picks devices)."""
-        for key, name, milli in self._iter_demand(driver, pool, consumes):
-            if key in self._avail and name in self._avail[key]:
-                self._avail[key][name] += milli
-
-
-class _Candidate:
-    __slots__ = ("driver", "pool", "node", "device")
-
-    def __init__(self, driver, pool, node, device):
-        self.driver = driver
-        self.pool = pool
-        self.node = node
-        self.device = device
-
-    @property
-    def name(self):
-        return self.device["name"]
-
-    @property
-    def key(self):
-        return (self.driver, self.pool, self.name)
-
-
 class _FitBudgetExceeded(Exception):
     """The bounded constraint DFS ran out of states (see MAX_FIT_STEPS)."""
 
 
-def _tolerates(taint: dict, tolerations: list[dict]) -> bool:
-    for tol in tolerations or []:
-        if tol.get("effect") and tol["effect"] != taint.get("effect"):
-            continue
-        op = tol.get("operator", "Equal")
-        if op == "Exists":
-            if not tol.get("key") or tol["key"] == taint.get("key"):
-                return True
-        elif tol.get("key") == taint.get("key") and \
-                tol.get("value", "") == taint.get("value", ""):
-            return True
-    return False
-
-
 class DraScheduler:
-    """Single-pass-capable scheduler; call sync_once() or run()."""
+    """Single-pass-capable scheduler; call sync_once(), run(), or
+    start_event_driven()."""
 
     def __init__(self, kube, default_node: str | None = None,
-                 gates: FeatureGates | None = None, metrics=None):
+                 gates: FeatureGates | None = None, metrics=None,
+                 sched_metrics=None, resync_period: float | None = None):
         self.kube = kube
         self.default_node = default_node
         self._selectors = _CompiledSelectors()
@@ -204,73 +120,125 @@ class DraScheduler:
         # fallback whenever devices publish no usable coordinates.
         self._topology = gates.is_enabled(TOPOLOGY_AWARE_PLACEMENT)
         self.metrics = metrics  # PlacementMetrics or None
-        # Per-sync-pass memos (reset in _allocate_claims): scoring a
-        # pool and resolving CD windows are pure functions of snapshot
-        # state, and one pass asks the same questions per claim x node.
-        self._pass_order_cache: dict[tuple, list[str] | None] = {}
-        self._pass_cd_windows: dict[str, list[str]] | None = None
+        self.sched_metrics = sched_metrics  # SchedulerMetrics or None
+        if resync_period is None:
+            try:
+                resync_period = float(os.environ.get(
+                    "TPU_DRA_SCHED_RESYNC", DEFAULT_RESYNC_S))
+            except ValueError:
+                resync_period = DEFAULT_RESYNC_S
+        self.resync_period = resync_period
+        # All reads in sync paths go through the view (lint TPUDRA009):
+        # informer caches in event mode, list-through in direct mode.
+        self.view = ClusterView(kube, on_event=self._on_informer_event,
+                                on_relist=self._on_informer_relist,
+                                default_node=default_node)
+        # Inventory snapshot + incrementally-maintained allocation
+        # state; rebuilt whenever the snapshot changes and on every
+        # full pass (the safety property of the resync).
+        self._snap: InventorySnapshot | None = None
+        self._alloc: AllocationState | None = None
+        self._state_lock = threading.RLock()
+        # Allocations THIS scheduler committed recently, replayed into
+        # every rebuilt AllocationState: with a real apiserver the
+        # informer cache can lag our own allocation patch, and a
+        # rebuild from that stale cache would otherwise see the devices
+        # as free and double-allocate them. Entries retire when the
+        # cache catches up (the claim's watch event carries the
+        # allocation) or after the TTL.
+        self._commit_log: dict[tuple[str, str], tuple[float, dict]] = {}
+        # Event mode plumbing.
+        self._queue = None  # WorkQueue, created by start_event_driven
+        self._resync_thread: threading.Thread | None = None
+        # pod <-> claim reverse index (event mode): which pods to
+        # re-check when a claim changes, without scanning all pods.
+        self._pods_of_claim: dict[tuple[str, str], set[str]] = {}
+        self._claims_of_pod: dict[tuple[str, str], set[str]] = {}
 
     # -- claim generation (kcm resourceclaim controller) ----------------------
 
     def _pods(self) -> list[dict]:
         try:
-            return self.kube.list("", "v1", "pods")
+            return self.view.pods()
         except KubeError:
             return []
 
     def _generate_claims(self):
         for pod in self._pods():
             refs = pod.get("spec", {}).get("resourceClaims") or []
-            statuses = pod.get("status", {}).get(
-                "resourceClaimStatuses") or []
-            have = {s["name"] for s in statuses}
-            ns = _meta(pod).get("namespace", "default")
-            new_statuses = []
-            for ref in refs:
-                tmpl = ref.get("resourceClaimTemplateName")
-                if not tmpl or ref["name"] in have:
-                    continue
+            have = {s["name"] for s in pod.get("status", {}).get(
+                "resourceClaimStatuses") or []}
+            if not any(r.get("resourceClaimTemplateName")
+                       and r["name"] not in have for r in refs):
+                continue
+            if self.view.event_driven:
+                # Generated claim names carry a uuid suffix, so a
+                # ConflictError can never dedupe them: in event mode
+                # the cached pod may lag our OWN status patch, and
+                # generating off it would orphan the first claim.
+                # Re-read the pod before deciding.
                 try:
-                    template = self.kube.get(
-                        *RESOURCE, "resourceclaimtemplates", tmpl,
-                        namespace=ns)
+                    pod = self.kube.get(
+                        "", "v1", "pods", _meta(pod)["name"],
+                        namespace=_meta(pod).get("namespace", "default"))
                 except NotFoundError:
-                    continue  # template not applied yet; retry next pass
-                claim_name = (f"{_meta(pod)['name']}-{ref['name']}-"
-                              f"{uuid.uuid4().hex[:5]}")
-                claim = {
-                    "apiVersion": "resource.k8s.io/v1",
-                    "kind": "ResourceClaim",
-                    "metadata": {
-                        "name": claim_name,
-                        "namespace": ns,
-                        "uid": f"claim-{uuid.uuid4().hex[:12]}",
-                        "annotations": {
-                            "resource.kubernetes.io/pod-claim-name":
-                                ref["name"],
-                        },
-                        "ownerReferences": [{
-                            "apiVersion": "v1", "kind": "Pod",
-                            "name": _meta(pod)["name"],
-                            "uid": _meta(pod).get("uid", ""),
-                            "controller": True,
-                        }],
+                    continue
+            self._generate_claims_for(pod)
+
+    def _generate_claims_for(self, pod) -> bool:
+        """Template-driven claim generation for one pod. Returns True
+        when the pod's claim statuses were extended."""
+        refs = pod.get("spec", {}).get("resourceClaims") or []
+        statuses = pod.get("status", {}).get(
+            "resourceClaimStatuses") or []
+        have = {s["name"] for s in statuses}
+        ns = _meta(pod).get("namespace", "default")
+        new_statuses = []
+        for ref in refs:
+            tmpl = ref.get("resourceClaimTemplateName")
+            if not tmpl or ref["name"] in have:
+                continue
+            try:
+                template = self.view.get_template(tmpl, namespace=ns)
+            except NotFoundError:
+                continue  # template not applied yet; retry next pass
+            claim_name = (f"{_meta(pod)['name']}-{ref['name']}-"
+                          f"{uuid.uuid4().hex[:5]}")
+            claim = {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim",
+                "metadata": {
+                    "name": claim_name,
+                    "namespace": ns,
+                    "uid": f"claim-{uuid.uuid4().hex[:12]}",
+                    "annotations": {
+                        "resource.kubernetes.io/pod-claim-name":
+                            ref["name"],
                     },
-                    "spec": template.get("spec", {}).get("spec", {}),
-                }
-                try:
-                    self.kube.create(*RESOURCE, "resourceclaims", claim,
-                                     namespace=ns)
-                except ConflictError:
-                    pass
-                new_statuses.append(
-                    {"name": ref["name"], "resourceClaimName": claim_name})
-            if new_statuses:
-                self.kube.patch(
-                    "", "v1", "pods", _meta(pod)["name"],
-                    {"status": {"resourceClaimStatuses":
-                                statuses + new_statuses}},
-                    namespace=ns)
+                    "ownerReferences": [{
+                        "apiVersion": "v1", "kind": "Pod",
+                        "name": _meta(pod)["name"],
+                        "uid": _meta(pod).get("uid", ""),
+                        "controller": True,
+                    }],
+                },
+                "spec": template.get("spec", {}).get("spec", {}),
+            }
+            try:
+                self.kube.create(*RESOURCE, "resourceclaims", claim,
+                                 namespace=ns)
+            except ConflictError:
+                pass
+            new_statuses.append(
+                {"name": ref["name"], "resourceClaimName": claim_name})
+        if new_statuses:
+            self.kube.patch(
+                "", "v1", "pods", _meta(pod)["name"],
+                {"status": {"resourceClaimStatuses":
+                            statuses + new_statuses}},
+                namespace=ns)
+            return True
+        return False
 
     def _generate_extended_resource_claims(self):
         """KEP-5004 (DRAExtendedResource): a pod requesting an extended
@@ -289,106 +257,111 @@ class DraScheduler:
         if not by_resource:
             return
         for pod in self._pods():
-            if pod.get("status", {}).get("extendedResourceClaimStatus"):
-                continue
-            # KEP-5004 generates claims only while a pod is still being
-            # SCHEDULED: one already bound (spec.nodeName set -- e.g.
-            # scheduled before the class advertised
-            # extendedResourceName, or born bound like a DaemonSet pod)
-            # or past Pending must not retroactively acquire devices
-            # and double-count them under a running workload.
-            if pod.get("spec", {}).get("nodeName"):
-                continue
-            if pod.get("status", {}).get("phase") not in (None, "",
-                                                          "Pending"):
-                continue
-            if _meta(pod).get("deletionTimestamp"):
-                continue
-            requests, mappings = [], []
-            bad_qty = None
-            for c in pod.get("spec", {}).get("containers", []):
-                limits = (c.get("resources") or {}).get("limits") or {}
-                for rname, qty in limits.items():
-                    cls_name = by_resource.get(rname)
-                    if not cls_name:
-                        continue
-                    # Extended-resource quantities must be whole
-                    # numbers; a malformed one must not wedge the
-                    # whole scheduling pass.
-                    try:
-                        count = int(str(qty))
-                    except ValueError:
-                        logger.warning(
-                            "pod %s/%s: non-integer extended-resource "
-                            "quantity %s=%r; skipping pod",
-                            _meta(pod).get("namespace", "default"),
-                            _meta(pod)["name"], rname, qty)
-                        bad_qty = f"{rname}={qty!r}"
-                        break
-                    req = f"request-{len(mappings)}"
-                    exactly: dict = {"deviceClassName": cls_name}
-                    if count != 1:
-                        exactly["count"] = count
-                    requests.append({"name": req, "exactly": exactly})
-                    mappings.append({
-                        "containerName": c.get("name", ""),
-                        "resourceName": rname,
-                        "requestName": req,
-                    })
-                if bad_qty:
+            self._generate_extended_resource_claims_for(pod, by_resource)
+
+    def _generate_extended_resource_claims_for(self, pod,
+                                               by_resource) -> bool:
+        if pod.get("status", {}).get("extendedResourceClaimStatus"):
+            return False
+        # KEP-5004 generates claims only while a pod is still being
+        # SCHEDULED: one already bound (spec.nodeName set -- e.g.
+        # scheduled before the class advertised
+        # extendedResourceName, or born bound like a DaemonSet pod)
+        # or past Pending must not retroactively acquire devices
+        # and double-count them under a running workload.
+        if pod.get("spec", {}).get("nodeName"):
+            return False
+        if pod.get("status", {}).get("phase") not in (None, "",
+                                                      "Pending"):
+            return False
+        if _meta(pod).get("deletionTimestamp"):
+            return False
+        requests, mappings = [], []
+        bad_qty = None
+        for c in pod.get("spec", {}).get("containers", []):
+            limits = (c.get("resources") or {}).get("limits") or {}
+            for rname, qty in limits.items():
+                cls_name = by_resource.get(rname)
+                if not cls_name:
+                    continue
+                # Extended-resource quantities must be whole
+                # numbers; a malformed one must not wedge the
+                # whole scheduling pass.
+                try:
+                    count = int(str(qty))
+                except ValueError:
+                    logger.warning(
+                        "pod %s/%s: non-integer extended-resource "
+                        "quantity %s=%r; skipping pod",
+                        _meta(pod).get("namespace", "default"),
+                        _meta(pod)["name"], rname, qty)
+                    bad_qty = f"{rname}={qty!r}"
                     break
+                req = f"request-{len(mappings)}"
+                exactly: dict = {"deviceClassName": cls_name}
+                if count != 1:
+                    exactly["count"] = count
+                requests.append({"name": req, "exactly": exactly})
+                mappings.append({
+                    "containerName": c.get("name", ""),
+                    "resourceName": rname,
+                    "requestName": req,
+                })
             if bad_qty:
-                # The pod can never schedule (the generation skip keeps
-                # _pending_extended_resource blocking its bind forever):
-                # surface that ON THE POD -- real k8s rejects
-                # non-integer extended resources at admission, but this
-                # control plane has no pod admission, so a condition +
-                # event is the observable analog.
-                self._flag_unschedulable_pod(
-                    pod, "InvalidExtendedResourceQuantity",
-                    f"extended-resource quantity {bad_qty} is not a "
-                    "whole number; the pod cannot be scheduled")
-                continue
-            if not requests:
-                continue
-            ns = _meta(pod).get("namespace", "default")
-            # DETERMINISTIC name (pod uid, not uuid4): create + status
-            # patch are not atomic, and a retried pass must converge on
-            # the same claim instead of leaking allocated orphans.
-            pod_uid = _meta(pod).get("uid", "") or _meta(pod)["name"]
-            claim_name = (f"{_meta(pod)['name']}-extended-resources-"
-                          f"{pod_uid[-5:]}")
-            claim = {
-                "apiVersion": "resource.k8s.io/v1",
-                "kind": "ResourceClaim",
-                "metadata": {
-                    "name": claim_name,
-                    "namespace": ns,
-                    "uid": f"claim-{uuid.uuid4().hex[:12]}",
-                    "ownerReferences": [{
-                        "apiVersion": "v1", "kind": "Pod",
-                        "name": _meta(pod)["name"],
-                        "uid": _meta(pod).get("uid", ""),
-                        "controller": True,
-                    }],
-                },
-                "spec": {"devices": {"requests": requests}},
-            }
-            try:
-                self.kube.create(*RESOURCE, "resourceclaims", claim,
-                                 namespace=ns)
-            except ConflictError:
-                pass  # an earlier pass created it; converge on it
-            self.kube.patch(
-                "", "v1", "pods", _meta(pod)["name"],
-                {"status": {"extendedResourceClaimStatus": {
-                    "resourceClaimName": claim_name,
-                    "requestMappings": mappings,
-                }}},
-                namespace=ns)
-            logger.info(
-                "generated extended-resource claim %s/%s for pod %s",
-                ns, claim_name, _meta(pod)["name"])
+                break
+        if bad_qty:
+            # The pod can never schedule (the generation skip keeps
+            # _pending_extended_resource blocking its bind forever):
+            # surface that ON THE POD -- real k8s rejects
+            # non-integer extended resources at admission, but this
+            # control plane has no pod admission, so a condition +
+            # event is the observable analog.
+            self._flag_unschedulable_pod(
+                pod, "InvalidExtendedResourceQuantity",
+                f"extended-resource quantity {bad_qty} is not a "
+                "whole number; the pod cannot be scheduled")
+            return False
+        if not requests:
+            return False
+        ns = _meta(pod).get("namespace", "default")
+        # DETERMINISTIC name (pod uid, not uuid4): create + status
+        # patch are not atomic, and a retried pass must converge on
+        # the same claim instead of leaking allocated orphans.
+        pod_uid = _meta(pod).get("uid", "") or _meta(pod)["name"]
+        claim_name = (f"{_meta(pod)['name']}-extended-resources-"
+                      f"{pod_uid[-5:]}")
+        claim = {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaim",
+            "metadata": {
+                "name": claim_name,
+                "namespace": ns,
+                "uid": f"claim-{uuid.uuid4().hex[:12]}",
+                "ownerReferences": [{
+                    "apiVersion": "v1", "kind": "Pod",
+                    "name": _meta(pod)["name"],
+                    "uid": _meta(pod).get("uid", ""),
+                    "controller": True,
+                }],
+            },
+            "spec": {"devices": {"requests": requests}},
+        }
+        try:
+            self.kube.create(*RESOURCE, "resourceclaims", claim,
+                             namespace=ns)
+        except ConflictError:
+            pass  # an earlier pass created it; converge on it
+        self.kube.patch(
+            "", "v1", "pods", _meta(pod)["name"],
+            {"status": {"extendedResourceClaimStatus": {
+                "resourceClaimName": claim_name,
+                "requestMappings": mappings,
+            }}},
+            namespace=ns)
+        logger.info(
+            "generated extended-resource claim %s/%s for pod %s",
+            ns, claim_name, _meta(pod)["name"])
+        return True
 
     def _flag_unschedulable_pod(self, pod, reason: str,
                                 message: str) -> None:
@@ -441,75 +414,90 @@ class DraScheduler:
 
     # -- allocation (kube-scheduler DRA plugin) -------------------------------
 
-    def _snapshot(self):
-        """(candidates, ledger, allocated-device keys) from the newest
-        generation of every published pool."""
-        slices = self.kube.list(*RESOURCE, "resourceslices")
-        newest: dict[tuple, int] = {}
-        for s in slices:
-            spec = s.get("spec", {})
-            pool = spec.get("pool", {})
-            key = (spec.get("driver", ""), pool.get("name", ""))
-            newest[key] = max(newest.get(key, 0), pool.get("generation", 0))
-        candidates: list[_Candidate] = []
-        ledger = _CounterLedger()
-        for s in slices:
-            spec = s.get("spec", {})
-            pool = spec.get("pool", {})
-            driver = spec.get("driver", "")
-            pool_name = pool.get("name", "")
-            if pool.get("generation", 0) != newest[(driver, pool_name)]:
-                continue  # stale generation: invisible to allocation
-            node = spec.get("nodeName") or self.default_node or ""
-            ledger.seed(driver, pool_name, spec.get("sharedCounters"))
-            for dev in spec.get("devices", []):
-                candidates.append(_Candidate(driver, pool_name, node, dev))
+    # Commit-log retention: long enough to outlive any realistic watch
+    # lag between our allocation patch and its event, short enough to
+    # bound memory. Replay is idempotent, so erring long is safe.
+    COMMIT_LOG_TTL_S = 120.0
 
-        allocated: set[tuple] = set()
-        for claim in self.kube.list(*RESOURCE, "resourceclaims"):
-            alloc = claim.get("status", {}).get("allocation")
-            if not alloc:
-                continue
-            for res in alloc.get("devices", {}).get("results", []):
-                key = (res.get("driver", ""), res.get("pool", ""),
-                       res.get("device", ""))
-                allocated.add(key)
-        by_key = {c.key: c for c in candidates}
-        for key in allocated:
-            cand = by_key.get(key)
-            if cand is not None:
-                ledger.debit(cand.driver, cand.pool,
-                             cand.device.get("consumesCounters"))
-        return candidates, ledger, allocated, by_key
+    def _replay_commits_locked(self, claims: list[dict]) -> None:
+        """Fold recently committed allocations into the (freshly
+        rebuilt) allocation state. Caller holds _state_lock; ``claims``
+        is the list the rebuild used.
 
-    def _device_matches(self, cand: _Candidate, selectors: list[dict],
+        In direct mode that list is a FRESH kube list, so an entry for
+        an absent claim means the claim was deleted -- drop it (its
+        devices are free again). In event mode the cache may lag our
+        own claim's create, so absent entries survive until the
+        DELETED event (which retires them) or the TTL."""
+        now = time.monotonic()
+        present = {(c.get("metadata", {}).get("namespace", "default"),
+                    c.get("metadata", {}).get("name", ""))
+                   for c in claims}
+        authoritative = not self.view.event_driven
+        for key in list(self._commit_log):
+            t, claim_like = self._commit_log[key]
+            if now - t > self.COMMIT_LOG_TTL_S or (
+                    authoritative and key not in present):
+                del self._commit_log[key]
+            else:
+                self._alloc.observe(claim_like)
+
+    def _ensure_alloc_state(self) -> tuple[InventorySnapshot,
+                                           AllocationState]:
+        """Current snapshot + allocation state; a snapshot rebuild
+        (any slice write / pool-generation bump) rebuilds the
+        allocation state from the claim set."""
+        with self._state_lock:
+            snap = self.view.snapshot()
+            if snap is not self._snap or self._alloc is None:
+                self._snap = snap
+                self._alloc = AllocationState(snap)
+                claims = self.view.claims()
+                self._alloc.rebuild(claims)
+                self._replay_commits_locked(claims)
+            return self._snap, self._alloc
+
+    def _rebuild_alloc_state(self) -> tuple[InventorySnapshot,
+                                            AllocationState]:
+        """Full defensive rebuild (every full pass does this, which is
+        what makes the safety resync actually safe)."""
+        with self._state_lock:
+            snap = self.view.snapshot()
+            self._snap = snap
+            self._alloc = AllocationState(snap)
+            claims = self.view.claims()
+            self._alloc.rebuild(claims)
+            self._replay_commits_locked(claims)
+            return self._snap, self._alloc
+
+    def _device_matches(self, snap: InventorySnapshot, cand: _Candidate,
+                        selectors: list[dict],
                         tolerations: list[dict]) -> bool:
-        for taint in cand.device.get("taints") or []:
-            if taint.get("effect") in ("NoSchedule", "NoExecute") and \
-                    not _tolerates(taint, tolerations):
+        for taint in cand.blocking_taints:
+            if not _tolerates(taint, tolerations):
                 return False
         for sel in selectors:
             expr = (sel.get("cel") or {}).get("expression", "")
             prog = self._selectors.get(expr)
-            if prog is None or not prog.matches_device(
-                    cand.device, cand.driver):
+            if prog is None or not snap.cel_match(expr, prog, cand):
                 return False
         return True
 
     def _device_classes(self) -> dict[str, dict]:
         return {
             _meta(c)["name"]: c
-            for c in self.kube.list(*RESOURCE, "deviceclasses")
+            for c in self.view.device_classes()
         }
 
-    def _try_allocate(self, claim, candidates, ledger, allocated,
-                      classes, by_key, pinned_node: str | None = None
-                      ) -> dict | None:
+    def _try_allocate(self, claim, snap: InventorySnapshot,
+                      alloc: AllocationState, classes,
+                      pinned_node: str | None = None) -> dict | None:
         """One claim against the snapshot. Returns the allocation or
-        None; mutates ledger/allocated on success. ``pinned_node``
-        restricts placement to the node a consumer pod is already bound
-        to (real DRA allocates during that pod's scheduling, so the
-        choice is inherently per-node)."""
+        None; the caller commits it (patch + ``alloc.observe``) so the
+        incremental state only ever reflects allocations that landed.
+        ``pinned_node`` restricts placement to the node a consumer pod
+        is already bound to (real DRA allocates during that pod's
+        scheduling, so the choice is inherently per-node)."""
         requests = claim.get("spec", {}).get("devices", {}).get(
             "requests", [])
         if not requests:
@@ -520,8 +508,8 @@ class DraScheduler:
         # spreading a real scheduler gets from per-pod Filter/Score;
         # without it a multi-node gang would pile onto one node.
         load: dict[str, int] = {}
-        for key in allocated:
-            cand = by_key.get(key)
+        for key in alloc.allocated:
+            cand = snap.by_key.get(key)
             if cand is not None:
                 load[cand.node] = load.get(cand.node, 0) + 1
         # ComputeDomain gangs first try the ICI-adjacent host window
@@ -529,14 +517,13 @@ class DraScheduler:
         # members WITHIN the window, and non-window nodes remain as
         # overflow so a full window degrades instead of wedging.
         window = set(self._preferred_gang_nodes(claim) or ())
-        nodes = sorted({c.node for c in candidates},
+        nodes = sorted(snap.by_node,
                        key=lambda n: (0 if not window or n in window
                                       else 1, load.get(n, 0), n))
         if pinned_node is not None:
             nodes = [n for n in nodes if n == pinned_node]
         for node in nodes:
-            picks = self._fit_on_node(
-                claim, node, candidates, ledger, allocated, classes)
+            picks = self._fit_on_node(claim, node, snap, alloc, classes)
             if picks is None:
                 continue
             results, configs = [], []
@@ -548,9 +535,6 @@ class DraScheduler:
                     "pool": cand.pool,
                     "device": cand.name,
                 })
-                allocated.add(cand.key)
-                ledger.debit(cand.driver, cand.pool,
-                             cand.device.get("consumesCounters"))
                 if class_name not in seen_classes:
                     seen_classes.append(class_name)
             for class_name in seen_classes:
@@ -570,17 +554,19 @@ class DraScheduler:
                         "requests": cfg.get("requests", []),
                         "source": "FromClaim",
                     })
-            alloc = {
+            bind_node = node or self.default_node
+            alloc_obj = {
                 "devices": {"results": results, "config": configs},
-                "nodeSelector": {"nodeSelectorTerms": [{
+            }
+            if bind_node:
+                alloc_obj["nodeSelector"] = {"nodeSelectorTerms": [{
                     "matchFields": [{
                         "key": "metadata.name",
                         "operator": "In",
-                        "values": [node],
+                        "values": [bind_node],
                     }],
-                }]},
-            }
-            return alloc
+                }]}
+            return alloc_obj
         return None
 
     # DFS budget for the constraint-aware fit: a claim that cannot be
@@ -616,7 +602,8 @@ class DraScheduler:
     def _grid_for(cands: list["_Candidate"]) -> TorusGrid:
         return TorusGrid.from_devices([c.device for c in cands])
 
-    def _topology_order(self, cands: list["_Candidate"],
+    def _topology_order(self, snap: InventorySnapshot,
+                        cands: list["_Candidate"],
                         want: int | None) -> list["_Candidate"]:
         """Reorder one request's candidates so the scorer's best
         sub-torus placements come first. Pure preference: every
@@ -624,7 +611,10 @@ class DraScheduler:
         therefore matchAttributes, counters, taints) is untouched --
         with no usable coordinates the original first-fit order
         survives verbatim. ``want`` None (All-mode) takes everything
-        anyway; nothing to order."""
+        anyway; nothing to order. The ordering memo lives on the
+        inventory snapshot: it is a pure function of the published
+        devices, so it survives across passes and invalidates exactly
+        when they change."""
         if want is None or want < 1 or len(cands) < 2:
             return cands
         by_pool: dict[tuple, list[_Candidate]] = {}
@@ -637,13 +627,13 @@ class DraScheduler:
             if len(group) >= want:
                 names = tuple(c.name for c in group)
                 key = (driver, pool, names, want)
-                if key in self._pass_order_cache:
-                    ordered = self._pass_order_cache[key]
+                if key in snap.order_cache:
+                    ordered = snap.order_cache[key]
                 else:
                     grid = self._grid_for(group)
                     ordered = topo_order_candidates(grid, list(names),
                                                     want)
-                    self._pass_order_cache[key] = ordered
+                    snap.order_cache[key] = ordered
             if ordered is None:
                 out.extend(group)
             else:
@@ -670,52 +660,22 @@ class DraScheduler:
             uid = params.get("domainID")
             if not uid:
                 continue
-            return self._cd_window_map().get(uid) or None
+            return self.view.cd_windows().get(uid) or None
         return None
 
-    def _cd_window_map(self) -> dict[str, list[str]]:
-        """uid -> preferred-node window for every ComputeDomain, listed
-        once per sync pass (N pending channel claims must not mean N
-        full CD lists against the apiserver)."""
-        if self._pass_cd_windows is not None:
-            return self._pass_cd_windows
-        from ..computedomain import (  # noqa: PLC0415 - leaf consts
-            API_GROUP,
-            API_VERSION,
-            PREFERRED_NODES_ANNOTATION,
-        )
-
-        try:
-            cds = self.kube.list(API_GROUP, API_VERSION,
-                                 "computedomains")
-        except KubeError:
-            # Transient failure: cache the empty answer for the REST of
-            # this pass (don't hammer a struggling apiserver once per
-            # pending claim); the next pass retries fresh.
-            self._pass_cd_windows = {}
-            return self._pass_cd_windows
-        windows: dict[str, list[str]] = {}
-        for cd in cds:
-            uid = _meta(cd).get("uid")
-            ann = (_meta(cd).get("annotations") or {}).get(
-                PREFERRED_NODES_ANNOTATION, "")
-            if uid:
-                windows[uid] = [n for n in ann.split(",") if n]
-        self._pass_cd_windows = windows
-        return windows
-
-    def _observe_placement(self, alloc, candidates, allocated) -> None:
+    def _observe_placement(self, alloc_obj, snap: InventorySnapshot,
+                           alloc: AllocationState) -> None:
         """Export placement quality for a fresh allocation: compactness
         of the chosen set, plus the post-pick fragmentation / largest
         allocatable shape of every pool it drew from."""
         if self.metrics is None or not self._topology:
             return
         by_pool: dict[tuple, list[str]] = {}
-        for res in alloc.get("devices", {}).get("results", []):
+        for res in alloc_obj.get("devices", {}).get("results", []):
             by_pool.setdefault((res.get("driver", ""), res.get("pool", "")),
                                []).append(res.get("device", ""))
         for (driver, pool), picked in by_pool.items():
-            devs = [c for c in candidates
+            devs = [c for c in snap.candidates
                     if c.driver == driver and c.pool == pool]
             if not devs:
                 continue
@@ -727,7 +687,8 @@ class DraScheduler:
             hops, _ = set_compactness(grid, cells)
             self.metrics.compactness.labels(label).observe(hops)
             free = {grid.coords[c.name] for c in devs
-                    if c.key not in allocated and c.name in grid.coords}
+                    if c.key not in alloc.allocated
+                    and c.name in grid.coords}
             # One largest_free_shape sweep feeds both gauges (it is the
             # most expensive topology operation on big pools).
             _, chips = largest_free_shape(grid, free)
@@ -735,8 +696,8 @@ class DraScheduler:
                 frag_from_largest(chips, len(free)))
             self.metrics.largest_shape.labels(label).set(chips)
 
-    def _fit_on_node(self, claim, node, candidates, ledger, allocated,
-                     classes):
+    def _fit_on_node(self, claim, node, snap: InventorySnapshot,
+                     alloc: AllocationState, classes):
         """All requests of one claim against one node; returns
         [(request, candidate, class_name)] or None. Counter fits are
         checked against a tentative ledger so multi-device claims can't
@@ -753,6 +714,8 @@ class DraScheduler:
         attribute value must not doom an otherwise-satisfiable claim.
         """
         spec = claim.get("spec", {}).get("devices", {})
+        node_cands = snap.by_node.get(node, ())
+        allocated = alloc.allocated
         reqs = []
         for req in spec.get("requests", []):
             exactly = req.get("exactly") or req  # v1 nests under exactly
@@ -769,16 +732,17 @@ class DraScheduler:
                 "want": (int(exactly.get("count", 1))
                          if mode != "All" else None),
                 "cands": [
-                    cand for cand in candidates
-                    if cand.node == node and cand.key not in allocated
+                    cand for cand in node_cands
+                    if cand.key not in allocated
                     and self._device_matches(
-                        cand, selectors,
+                        snap, cand, selectors,
                         list(exactly.get("tolerations") or []))
                 ],
             })
         if self._topology:
             for r in reqs:
-                r["cands"] = self._topology_order(r["cands"], r["want"])
+                r["cands"] = self._topology_order(snap, r["cands"],
+                                                 r["want"])
         constraints = []
         for c in spec.get("constraints") or []:
             attr = c.get("matchAttribute")
@@ -793,7 +757,8 @@ class DraScheduler:
             })
 
         spent = _CounterLedger()
-        spent._avail = {k: dict(v) for k, v in ledger._avail.items()}
+        spent._avail = {k: dict(v)
+                        for k, v in alloc.ledger._avail.items()}
         cvals: list = [None] * len(constraints)
         state = {"steps": 0}
 
@@ -890,55 +855,78 @@ class DraScheduler:
         pod is already bound (DaemonSet pods are born bound)."""
         pins: dict[tuple[str, str], str] = {}
         for pod in self._pods():
-            node = pod.get("spec", {}).get("nodeName")
-            if not node:
-                continue
-            ns = _meta(pod).get("namespace", "default")
-            statuses = {
-                s["name"]: s.get("resourceClaimName")
-                for s in pod.get("status", {}).get(
-                    "resourceClaimStatuses") or []
-            }
-            for ref in pod.get("spec", {}).get("resourceClaims") or []:
-                claim_name = ref.get("resourceClaimName") or statuses.get(
-                    ref["name"])
-                if claim_name:
-                    pins[(ns, claim_name)] = node
-            ext = pod.get("status", {}).get(
-                "extendedResourceClaimStatus") or {}
-            if ext.get("resourceClaimName"):
-                pins[(ns, ext["resourceClaimName"])] = node
+            self._pins_from_pod(pod, pins)
         return pins
 
+    @staticmethod
+    def _pins_from_pod(pod, pins: dict[tuple[str, str], str]) -> None:
+        node = pod.get("spec", {}).get("nodeName")
+        if not node:
+            return
+        ns = _meta(pod).get("namespace", "default")
+        statuses = {
+            s["name"]: s.get("resourceClaimName")
+            for s in pod.get("status", {}).get(
+                "resourceClaimStatuses") or []
+        }
+        for ref in pod.get("spec", {}).get("resourceClaims") or []:
+            claim_name = ref.get("resourceClaimName") or statuses.get(
+                ref["name"])
+            if claim_name:
+                pins[(ns, claim_name)] = node
+        ext = pod.get("status", {}).get(
+            "extendedResourceClaimStatus") or {}
+        if ext.get("resourceClaimName"):
+            pins[(ns, ext["resourceClaimName"])] = node
+
+    def _commit_allocation(self, claim, alloc_obj,
+                           snap: InventorySnapshot,
+                           alloc: AllocationState) -> bool:
+        """Patch the allocation; fold it into the incremental state
+        only when the write landed."""
+        ns = _meta(claim).get("namespace", "default")
+        try:
+            self.kube.patch(
+                *RESOURCE, "resourceclaims", _meta(claim)["name"],
+                {"status": {"allocation": alloc_obj}}, namespace=ns)
+        except (NotFoundError, ConflictError):
+            return False
+        claim_like = {
+            "metadata": _meta(claim),
+            "status": {"allocation": alloc_obj},
+        }
+        with self._state_lock:
+            alloc.observe(claim_like)
+            self._commit_log[(ns, _meta(claim)["name"])] = (
+                time.monotonic(), claim_like)
+        self._observe_placement(alloc_obj, snap, alloc)
+        logger.info(
+            "allocated claim %s/%s -> %s", ns, _meta(claim)["name"],
+            [r["device"] for r in alloc_obj["devices"]["results"]])
+        return True
+
     def _allocate_claims(self):
-        self._pass_order_cache = {}
-        self._pass_cd_windows = None
-        candidates, ledger, allocated, by_key = self._snapshot()
-        classes = self._device_classes()
-        pins = self._claim_pins()
-        for claim in self.kube.list(*RESOURCE, "resourceclaims"):
-            if claim.get("status", {}).get("allocation"):
-                continue
-            if _meta(claim).get("deletionTimestamp"):
-                continue
-            pin = pins.get((_meta(claim).get("namespace", "default"),
-                            _meta(claim)["name"]))
-            alloc = self._try_allocate(
-                claim, candidates, ledger, allocated, classes, by_key,
-                pinned_node=pin)
-            if alloc is None:
-                continue
-            ns = _meta(claim).get("namespace", "default")
-            try:
-                self.kube.patch(
-                    *RESOURCE, "resourceclaims", _meta(claim)["name"],
-                    {"status": {"allocation": alloc}}, namespace=ns)
-            except (NotFoundError, ConflictError):
-                continue
-            self._observe_placement(alloc, candidates, allocated)
-            logger.info(
-                "allocated claim %s/%s -> %s", ns, _meta(claim)["name"],
-                [r["device"] for r in alloc["devices"]["results"]])
+        # The whole pass holds _state_lock: informer threads mutate the
+        # allocation state under it, and an unguarded reader iterating
+        # alloc.allocated mid-event would die on set-changed-during-
+        # iteration (event hooks from our OWN patches re-enter on this
+        # thread -- RLock).
+        with self._state_lock:
+            snap, alloc = self._rebuild_alloc_state()
+            classes = self._device_classes()
+            pins = self._claim_pins()
+            for claim in self.view.claims():
+                if claim.get("status", {}).get("allocation"):
+                    continue
+                if _meta(claim).get("deletionTimestamp"):
+                    continue
+                pin = pins.get((_meta(claim).get("namespace", "default"),
+                                _meta(claim)["name"]))
+                alloc_obj = self._try_allocate(claim, snap, alloc,
+                                               classes, pinned_node=pin)
+                if alloc_obj is None:
+                    continue
+                self._commit_allocation(claim, alloc_obj, snap, alloc)
 
     # -- binding --------------------------------------------------------------
 
@@ -956,16 +944,14 @@ class DraScheduler:
                 out.append((ref["name"], None))
                 continue
             try:
-                out.append((claim_name, self.kube.get(
-                    *RESOURCE, "resourceclaims", claim_name,
-                    namespace=ns)))
+                out.append((claim_name, self.view.get_claim(
+                    claim_name, namespace=ns)))
             except NotFoundError:
                 out.append((claim_name, None))
         ext = pod.get("status", {}).get("extendedResourceClaimStatus") or {}
         if ext.get("resourceClaimName"):
             try:
-                out.append((ext["resourceClaimName"], self.kube.get(
-                    *RESOURCE, "resourceclaims",
+                out.append((ext["resourceClaimName"], self.view.get_claim(
                     ext["resourceClaimName"], namespace=ns)))
             except NotFoundError:
                 out.append((ext["resourceClaimName"], None))
@@ -1019,54 +1005,58 @@ class DraScheduler:
         except KubeError:
             ext_names = None  # fail closed per-pod, retry next pass
         for pod in self._pods():
-            if pod.get("spec", {}).get("nodeName"):
-                continue
-            if pod.get("status", {}).get("phase") not in (
-                    None, "", "Pending"):
-                continue
-            if self._pending_extended_resource(pod, ext_names):
-                continue
-            nodes = set()
-            ready = True
-            claim_objs = []
-            for _, claim in self._claims_for_pod(pod):
-                if claim is None:
-                    ready = False
-                    break
-                alloc = claim.get("status", {}).get("allocation")
-                if not alloc:
-                    ready = False
-                    break
-                claim_objs.append(claim)
-                for term in alloc.get("nodeSelector", {}).get(
-                        "nodeSelectorTerms", []):
-                    for mf in term.get("matchFields", []):
-                        if mf.get("key") == "metadata.name":
-                            nodes.add(mf["values"][0])
-            if not ready:
-                continue
-            if len(nodes) > 1:
-                # Claims allocated independently landed on different
-                # nodes: binding anywhere would strand a device. The
-                # real scheduler avoids this by filtering per-node
-                # before allocating; surface it instead of mis-binding.
-                logger.warning(
-                    "pod %s/%s claims span nodes %s; not binding",
-                    _meta(pod).get("namespace", "default"),
-                    _meta(pod)["name"], sorted(nodes))
-                continue
-            node = next(iter(nodes)) if nodes else None
-            if node is None:
-                node = self.default_node
-            if node is None:
-                continue
-            ns = _meta(pod).get("namespace", "default")
-            for claim in claim_objs:
-                self._reserve(claim, pod)
-            self.kube.patch("", "v1", "pods", _meta(pod)["name"],
-                            {"spec": {"nodeName": node}}, namespace=ns)
-            logger.info("bound pod %s/%s -> %s", ns,
-                        _meta(pod)["name"], node)
+            self._bind_pod(pod, ext_names)
+
+    def _bind_pod(self, pod, ext_names: set[str] | None) -> bool:
+        if pod.get("spec", {}).get("nodeName"):
+            return False
+        if pod.get("status", {}).get("phase") not in (
+                None, "", "Pending"):
+            return False
+        if self._pending_extended_resource(pod, ext_names):
+            return False
+        nodes = set()
+        ready = True
+        claim_objs = []
+        for _, claim in self._claims_for_pod(pod):
+            if claim is None:
+                ready = False
+                break
+            alloc = claim.get("status", {}).get("allocation")
+            if not alloc:
+                ready = False
+                break
+            claim_objs.append(claim)
+            for term in alloc.get("nodeSelector", {}).get(
+                    "nodeSelectorTerms", []):
+                for mf in term.get("matchFields", []):
+                    if mf.get("key") == "metadata.name":
+                        nodes.add(mf["values"][0])
+        if not ready:
+            return False
+        if len(nodes) > 1:
+            # Claims allocated independently landed on different
+            # nodes: binding anywhere would strand a device. The
+            # real scheduler avoids this by filtering per-node
+            # before allocating; surface it instead of mis-binding.
+            logger.warning(
+                "pod %s/%s claims span nodes %s; not binding",
+                _meta(pod).get("namespace", "default"),
+                _meta(pod)["name"], sorted(nodes))
+            return False
+        node = next(iter(nodes)) if nodes else None
+        if node is None:
+            node = self.default_node
+        if node is None:
+            return False
+        ns = _meta(pod).get("namespace", "default")
+        for claim in claim_objs:
+            self._reserve(claim, pod)
+        self.kube.patch("", "v1", "pods", _meta(pod)["name"],
+                        {"spec": {"nodeName": node}}, namespace=ns)
+        logger.info("bound pod %s/%s -> %s", ns,
+                    _meta(pod)["name"], node)
+        return True
 
     # -- DaemonSet controller (kcm daemonset controller) ----------------------
 
@@ -1076,11 +1066,11 @@ class DraScheduler:
         labeled nodes). Pod name is deterministic per (ds, node) so the
         pass is idempotent; pods on no-longer-matching nodes drain."""
         try:
-            daemonsets = self.kube.list("apps", "v1", "daemonsets")
+            daemonsets = self.view.daemonsets()
         except KubeError:
             return
         try:
-            nodes = self.kube.list("", "v1", "nodes")
+            nodes = self.view.nodes()
         except KubeError:
             nodes = []
         pods = self._pods()
@@ -1153,7 +1143,7 @@ class DraScheduler:
         """One pod per Job (the demo specs' workloads are Jobs); pod
         phase feeds Job status (succeeded/failed + Complete)."""
         try:
-            jobs = self.kube.list("batch", "v1", "jobs")
+            jobs = self.view.jobs()
         except KubeError:
             return
         for job in jobs:
@@ -1201,15 +1191,270 @@ class DraScheduler:
                         {"type": "Failed", "status": "True"}]},
                 }, namespace=ns)
 
-    # -- loop -----------------------------------------------------------------
+    # -- full pass ------------------------------------------------------------
 
     def sync_once(self):
+        t0 = time.monotonic()
+        self.view.begin_pass()
         self._sync_daemonsets()
         self._sync_jobs()
         self._generate_claims()
         self._generate_extended_resource_claims()
         self._allocate_claims()
         self._bind_pods()
+        if self.sched_metrics is not None:
+            self.sched_metrics.sync_seconds.labels("full").observe(
+                time.monotonic() - t0)
+
+    # -- event-driven incremental sync ----------------------------------------
+
+    def start_event_driven(self) -> "DraScheduler":
+        """Informer-fed dirty-set mode: per-object events enqueue keyed
+        work; the periodic FULL resync survives only as the safety net
+        (``resync_period``, default 30s / TPU_DRA_SCHED_RESYNC)."""
+        from .workqueue import RateLimiter, WorkQueue  # noqa: PLC0415
+
+        if self._queue is not None:
+            return self
+        self._queue = WorkQueue(
+            limiter=RateLimiter(base_delay=0.05, max_delay=2.0),
+            workers=1, name="sched-sync",
+        )
+        self.view.start()
+        self._enqueue(("full",))
+        self._resync_thread = threading.Thread(
+            target=self._resync_loop, name="sched-resync", daemon=True)
+        self._resync_thread.start()
+        return self
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync_period):
+            self._enqueue(("full",))
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until the dirty set is fully processed (tests/bench)."""
+        if self._queue is None:
+            return True
+        return self._queue.wait_idle(timeout)
+
+    def _enqueue(self, key: tuple) -> None:
+        if self._queue is None or self._stop.is_set():
+            return
+        self._queue.enqueue(key, self._sync_key)
+        if self.sched_metrics is not None:
+            self.sched_metrics.dirty_depth.set(self._queue.len())
+
+    def _on_informer_relist(self, resource: str) -> None:
+        if self.sched_metrics is not None:
+            self.sched_metrics.informer_relists.labels(resource).inc()
+
+    def _on_informer_event(self, resource: str, ev_type: str,
+                           obj: dict) -> None:
+        """Informer event -> dirty keys. Runs on watch/notify threads;
+        does index + allocation-state bookkeeping inline (cheap, lock
+        guarded) and defers all kube I/O to the queue worker."""
+        md = _meta(obj)
+        ns = md.get("namespace", "default")
+        name = md.get("name", "")
+        if resource == "pods":
+            self._index_pod(ev_type, ns, name, obj)
+            self._enqueue(("pod", ns, name))
+            owners = md.get("ownerReferences") or []
+            if any(o.get("kind") == "Job" for o in owners):
+                self._enqueue(("jobs",))
+            if ev_type == "DELETED" and any(
+                    o.get("kind") == "DaemonSet" for o in owners):
+                self._enqueue(("daemonsets",))
+        elif resource == "resourceclaims":
+            with self._state_lock:
+                if self._alloc is not None:
+                    if ev_type == "DELETED":
+                        self._alloc.forget(obj)
+                    else:
+                        self._alloc.observe(obj)
+                if ev_type == "DELETED" or obj.get("status", {}).get(
+                        "allocation"):
+                    # The cache caught up with (or outlived) our own
+                    # committed allocation: the replay record retires.
+                    self._commit_log.pop((ns, name), None)
+            if ev_type == "DELETED":
+                # Freed devices may unblock any pending claim.
+                self._pods_of_claim.pop((ns, name), None)
+                self._enqueue(("pending",))
+            else:
+                self._enqueue(("claim", ns, name))
+            for pod_name in self._dependent_pods(ns, name, obj):
+                self._enqueue(("pod", ns, pod_name))
+        elif resource == "resourceslices":
+            self._enqueue(("inventory",))
+        elif resource == "deviceclasses":
+            self._enqueue(("pending",))
+        elif resource == "computedomains":
+            self._enqueue(("pending",))
+        elif resource in ("daemonsets", "nodes"):
+            self._enqueue(("daemonsets",))
+        elif resource == "jobs":
+            self._enqueue(("jobs",))
+        elif resource == "resourceclaimtemplates":
+            self._enqueue(("pods-rescan",))
+
+    def _index_pod(self, ev_type: str, ns: str, name: str,
+                   pod: dict) -> None:
+        pod_key = (ns, name)
+        with self._state_lock:
+            for claim_name in self._claims_of_pod.pop(pod_key, ()):
+                peers = self._pods_of_claim.get((ns, claim_name))
+                if peers is not None:
+                    peers.discard(name)
+            if ev_type == "DELETED":
+                return
+            claims: set[str] = set()
+            statuses = pod.get("status", {}).get(
+                "resourceClaimStatuses") or []
+            by_ref = {s["name"]: s.get("resourceClaimName")
+                      for s in statuses}
+            for ref in pod.get("spec", {}).get("resourceClaims") or []:
+                claim_name = ref.get("resourceClaimName") or by_ref.get(
+                    ref["name"])
+                if claim_name:
+                    claims.add(claim_name)
+            ext = pod.get("status", {}).get(
+                "extendedResourceClaimStatus") or {}
+            if ext.get("resourceClaimName"):
+                claims.add(ext["resourceClaimName"])
+            if claims:
+                self._claims_of_pod[pod_key] = claims
+                for claim_name in claims:
+                    self._pods_of_claim.setdefault(
+                        (ns, claim_name), set()).add(name)
+
+    def _dependent_pods(self, ns: str, claim_name: str,
+                        claim: dict) -> set[str]:
+        with self._state_lock:
+            pods = set(self._pods_of_claim.get((ns, claim_name), ()))
+        for o in _meta(claim).get("ownerReferences") or []:
+            if o.get("kind") == "Pod" and o.get("name"):
+                pods.add(o["name"])
+        return pods
+
+    def _sync_key(self, key: tuple) -> None:
+        t0 = time.monotonic()
+        kind = key[0]
+        try:
+            if kind == "full":
+                self.sync_once()
+                return  # sync_once observed itself as a full pass
+            if kind == "pod":
+                self._sync_pod_key(key[1], key[2])
+            elif kind == "claim":
+                self._sync_claim_key(key[1], key[2])
+            elif kind == "pending":
+                self._retry_pending_claims()
+            elif kind == "inventory":
+                self.view.invalidate_snapshot()
+                self._retry_pending_claims()
+            elif kind == "daemonsets":
+                self._sync_daemonsets()
+            elif kind == "jobs":
+                self._sync_jobs()
+            elif kind == "pods-rescan":
+                for pod in self._pods():
+                    refs = pod.get("spec", {}).get("resourceClaims") or []
+                    have = {s["name"] for s in pod.get("status", {}).get(
+                        "resourceClaimStatuses") or []}
+                    if any(r.get("resourceClaimTemplateName")
+                           and r["name"] not in have for r in refs):
+                        self._enqueue(("pod",
+                                       _meta(pod).get("namespace",
+                                                      "default"),
+                                       _meta(pod)["name"]))
+        finally:
+            if self.sched_metrics is not None:
+                if kind != "full":
+                    self.sched_metrics.sync_seconds.labels(
+                        "incremental").observe(time.monotonic() - t0)
+                if self._queue is not None:
+                    self.sched_metrics.dirty_depth.set(self._queue.len())
+
+    def _sync_pod_key(self, ns: str, name: str) -> None:
+        """Claim generation + binding for ONE pod. The pod is re-read
+        from the apiserver (a GET, not a list): claim generation must
+        never double-create off a stale cache."""
+        try:
+            pod = self.kube.get("", "v1", "pods", name, namespace=ns)
+        except NotFoundError:
+            return
+        try:
+            by_resource = self._extended_resource_classes()
+            ext_names: set[str] | None = set(by_resource)
+        except KubeError:
+            by_resource, ext_names = {}, None
+        changed = self._generate_claims_for(pod)
+        if by_resource:
+            changed |= self._generate_extended_resource_claims_for(
+                pod, by_resource)
+        if changed:
+            try:
+                pod = self.kube.get("", "v1", "pods", name, namespace=ns)
+            except NotFoundError:
+                return
+        self._bind_pod(pod, ext_names)
+
+    def _sync_claim_key(self, ns: str, name: str) -> None:
+        """Allocation attempt for ONE claim, re-read fresh so a stale
+        cache can never double-allocate."""
+        try:
+            claim = self.kube.get(*RESOURCE, "resourceclaims", name,
+                                  namespace=ns)
+        except NotFoundError:
+            return
+        if _meta(claim).get("deletionTimestamp"):
+            return
+        # _state_lock spans the read-allocate-commit sequence: the
+        # allocation state is mutated under this lock by informer
+        # threads, so the _try_allocate reader must hold it too.
+        with self._state_lock:
+            snap, alloc = self._ensure_alloc_state()
+            if claim.get("status", {}).get("allocation"):
+                alloc.observe(claim)
+                return
+            classes = self._device_classes()
+            pin = self._pin_for_claim(ns, name)
+            alloc_obj = self._try_allocate(claim, snap, alloc, classes,
+                                           pinned_node=pin)
+            if alloc_obj is not None:
+                self._commit_allocation(claim, alloc_obj, snap, alloc)
+
+    def _pin_for_claim(self, ns: str, claim_name: str) -> str | None:
+        """Bound-consumer pin for one claim via the reverse index (no
+        full pod scan)."""
+        with self._state_lock:
+            pod_names = set(self._pods_of_claim.get((ns, claim_name), ()))
+        for pod_name in pod_names:
+            try:
+                pod = self.kube.get("", "v1", "pods", pod_name,
+                                    namespace=ns)
+            except NotFoundError:
+                continue
+            node = pod.get("spec", {}).get("nodeName")
+            if node:
+                return node
+        return None
+
+    def _retry_pending_claims(self) -> None:
+        """Re-try every still-pending claim (cache scan, then a fresh
+        GET per pending claim inside _sync_claim_key). O(pending), and
+        pending claims are exactly the ones worth O(1 GET) each."""
+        for claim in self.view.claims():
+            if claim.get("status", {}).get("allocation"):
+                continue
+            if _meta(claim).get("deletionTimestamp"):
+                continue
+            self._sync_claim_key(
+                _meta(claim).get("namespace", "default"),
+                _meta(claim)["name"])
+
+    # -- loop -----------------------------------------------------------------
 
     def run(self, interval: float = 0.25):
         while not self._stop.is_set():
@@ -1229,31 +1474,47 @@ class DraScheduler:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._queue is not None:
+            self._queue.shutdown(wait=True)
+            self._queue = None
+        self.view.stop()
 
 
 def main(argv: list[str] | None = None) -> int:
-    import os
-
     from .kubeclient import KubeClient
 
     p = argparse.ArgumentParser(prog="tpu-dra-scheduler")
     p.add_argument("--kube-api", required=True)
     p.add_argument("--default-node", default=None)
     p.add_argument("--interval", type=float, default=0.25)
+    p.add_argument("--sched-mode",
+                   choices=("events", "poll"),
+                   default=os.environ.get("TPU_DRA_SCHED_MODE", "events"),
+                   help="'events' (default): informer-fed incremental "
+                        "sync with a low-frequency safety resync; "
+                        "'poll': the legacy full-resync loop at "
+                        "--interval [TPU_DRA_SCHED_MODE]")
     p.add_argument("--metrics-port", type=int,
                    default=int(os.environ.get("METRICS_PORT", "0")),
-                   help="serve /metrics (placement frag/compactness) "
-                        "on this port; 0 = disabled [METRICS_PORT]")
+                   help="serve /metrics (placement frag/compactness + "
+                        "scheduler sync/dirty-queue) on this port; "
+                        "0 = disabled [METRICS_PORT]")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     metrics = None
+    sched_metrics = None
     server = None
     if args.metrics_port:
-        from .metrics import MetricsServer, PlacementMetrics
+        from .metrics import (  # noqa: PLC0415
+            MetricsServer,
+            PlacementMetrics,
+            SchedulerMetrics,
+        )
 
         metrics = PlacementMetrics()
+        sched_metrics = SchedulerMetrics(registry=metrics.registry)
         server = MetricsServer(metrics.registry, host="0.0.0.0",
                                port=args.metrics_port)
         server.start()
@@ -1267,13 +1528,19 @@ def main(argv: list[str] | None = None) -> int:
     sched = DraScheduler(RetryingKubeClient(KubeClient(host=args.kube_api),
                                             metrics=resilience),
                          default_node=args.default_node,
-                         metrics=metrics)
+                         metrics=metrics, sched_metrics=sched_metrics)
     print("scheduler running", flush=True)
     try:
-        sched.run(args.interval)
+        if args.sched_mode == "events":
+            sched.start_event_driven()
+            while True:
+                time.sleep(60)
+        else:
+            sched.run(args.interval)
     except KeyboardInterrupt:
         pass
     finally:
+        sched.stop()
         if server is not None:
             server.stop()
     return 0

@@ -6,14 +6,47 @@ publish pass stamps a single shared pool generation on every slice of
 the pool and deletes slices of this driver/node that are no longer in
 the desired set (e.g. after a combined->split mode transition), so no
 stale slice can shadow the pool at a higher generation.
+
+Write-amplification discipline: the desired spec is diffed against the
+live spec by CANONICAL CONTENT HASH (``slice_content_hash``: the spec
+with the pool generation masked out). A publish whose desired set
+matches the live set performs ZERO kube writes -- the health monitor's
+periodic republish of an unchanged taint set no longer rewrites the
+pool every poll -- and the pool generation is bumped only when the
+DEVICE INVENTORY actually changed (a device appearing, disappearing, or
+moving between slices). A content-only change on an unchanged inventory
+(taint flips, attribute updates) rewrites just the changed slices at
+the CURRENT generation: the real kube-scheduler DRA plugin (KEP-4381)
+treats a generation bump as inventory churn and re-evaluates the whole
+pool, so taint noise must not masquerade as churn.
 """
 
 from __future__ import annotations
+
+import hashlib
+import json
 
 from .kubeclient import NotFoundError
 
 RESOURCE_GROUP = "resource.k8s.io"
 RESOURCE_VERSION = "v1"
+
+
+def slice_content_hash(obj: dict) -> str:
+    """Canonical content hash of a ResourceSlice's spec with the pool
+    generation masked out: two slices that differ only by generation
+    (or metadata bookkeeping) hash identically."""
+    spec = dict(obj.get("spec", {}))
+    pool = dict(spec.get("pool") or {})
+    pool.pop("generation", None)
+    spec["pool"] = pool
+    blob = json.dumps(spec, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _device_names(obj: dict) -> list[str]:
+    return [d.get("name", "") for d in obj.get("spec", {}).get(
+        "devices", [])]
 
 
 def _existing_pool_slices(kube, driver: str, node_name: str) -> list[dict]:
@@ -32,35 +65,105 @@ def _existing_pool_slices(kube, driver: str, node_name: str) -> list[dict]:
     ]
 
 
-def publish_resource_slices(kube, slices: list[dict]) -> None:
+def publish_resource_slices(kube, slices: list[dict], diff: bool = True,
+                            on_skip=None) -> dict:
     """Publish the desired slice set for one (driver, node) pool.
 
-    All slices must belong to the same driver/node. The whole set gets
-    one pool generation (max existing + 1); stale slices of that pool
-    are deleted. An empty set is a no-op (the pool identity would be
-    unknown): a driver with zero devices still publishes one slice with
-    an empty device list rather than an empty set, which is what both
-    in-tree drivers do.
+    All slices must belong to the same driver/node. An empty set is a
+    no-op (the pool identity would be unknown): a driver with zero
+    devices still publishes one slice with an empty device list rather
+    than an empty set, which is what both in-tree drivers do.
+
+    With ``diff`` (the default) the desired set is compared against the
+    live set by content hash:
+
+    - identical -> zero writes (``skipped`` counts the no-op PUTs
+      avoided; ``on_skip(n)`` fires for metrics).
+    - same slice names AND same per-slice device-name inventory at one
+      shared generation -> only the changed slices are rewritten, at
+      the CURRENT generation (no bump: taint/attribute updates are not
+      inventory churn).
+    - anything else (slices added/removed, devices added/removed/moved,
+      or a previously inconsistent pool) -> the whole set is written at
+      max(existing)+1 and stale slices are deleted, exactly the legacy
+      behavior.
+
+    ``diff=False`` forces that legacy write-always path (the polled
+    baseline mode in bench.py --sched-churn).
+
+    Returns ``{"writes", "deletes", "skipped", "generation",
+    "changed"}``.
     """
+    stats = {"writes": 0, "deletes": 0, "skipped": 0,
+             "generation": None, "changed": False}
     if not slices:
-        return
+        return stats
     driver = slices[0]["spec"]["driver"]
     node_name = slices[0]["spec"]["nodeName"]
     existing = _existing_pool_slices(kube, driver, node_name)
     existing_by_name = {s["metadata"]["name"]: s for s in existing}
-    generation = 1 + max(
-        (s["spec"].get("pool", {}).get("generation", 0) for s in existing),
-        default=0,
-    )
-    desired_names = set()
+    desired_names = {obj["metadata"]["name"] for obj in slices}
+    existing_gens = {
+        s["spec"].get("pool", {}).get("generation", 0) for s in existing
+    }
+
+    if diff and desired_names == set(existing_by_name) and \
+            len(existing_gens) == 1:
+        unchanged = {
+            name for name in desired_names
+            if slice_content_hash(existing_by_name[name])
+            == slice_content_hash(next(
+                o for o in slices if o["metadata"]["name"] == name))
+        }
+        generation = next(iter(existing_gens))
+        if len(unchanged) == len(desired_names):
+            # Fully converged: zero kube writes, generation untouched.
+            stats["skipped"] = len(slices)
+            stats["generation"] = generation
+            if on_skip is not None:
+                on_skip(len(slices))
+            return stats
+        same_inventory = all(
+            _device_names(obj)
+            == _device_names(existing_by_name[obj["metadata"]["name"]])
+            for obj in slices
+        )
+        if same_inventory:
+            # Content-only change (taints, attributes): rewrite just
+            # the changed slices at the CURRENT generation -- device
+            # inventory did not change, so consumers must not see a
+            # pool-generation bump.
+            for obj in slices:
+                name = obj["metadata"]["name"]
+                obj["spec"]["pool"]["generation"] = generation
+                if name in unchanged:
+                    stats["skipped"] += 1
+                    continue
+                try:
+                    kube.update(RESOURCE_GROUP, RESOURCE_VERSION,
+                                "resourceslices", name, obj)
+                except NotFoundError:
+                    kube.create(RESOURCE_GROUP, RESOURCE_VERSION,
+                                "resourceslices", obj)
+                stats["writes"] += 1
+            stats["generation"] = generation
+            stats["changed"] = True
+            if on_skip is not None and stats["skipped"]:
+                on_skip(stats["skipped"])
+            return stats
+
+    # Inventory change (or legacy/no-diff path): one new shared pool
+    # generation over the whole desired set; stale slices deleted so
+    # they can never shadow the pool at a higher generation.
+    generation = 1 + max(existing_gens, default=0)
     for obj in slices:
         name = obj["metadata"]["name"]
-        desired_names.add(name)
         obj["spec"]["pool"]["generation"] = generation
         if name in existing_by_name:
             try:
                 kube.update(
-                    RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", name, obj
+                    RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices",
+                    name, obj
                 )
             except NotFoundError:
                 kube.create(
@@ -70,6 +173,12 @@ def publish_resource_slices(kube, slices: list[dict]) -> None:
             kube.create(
                 RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", obj
             )
+        stats["writes"] += 1
     for name in existing_by_name:
         if name not in desired_names:
-            kube.delete(RESOURCE_GROUP, RESOURCE_VERSION, "resourceslices", name)
+            kube.delete(RESOURCE_GROUP, RESOURCE_VERSION,
+                        "resourceslices", name)
+            stats["deletes"] += 1
+    stats["generation"] = generation
+    stats["changed"] = True
+    return stats

@@ -106,10 +106,13 @@ class TestHealthTaintFlow:
 
 class TestRepublishStorm:
     """Rapid taint/untaint churn against the live plugin
-    (test_gpu_robustness.bats republish analog): every republish bumps
-    the pool generation monotonically, the slice set never grows
-    (no leaks from repeated publication), and the storm settles with
-    zero taints and the original slice names."""
+    (test_gpu_robustness.bats republish analog): taint flips are
+    CONTENT-only changes, so the pool generation never moves (the real
+    DRA plugin treats generation bumps as inventory churn -- the
+    content-hash publish diff rewrites the changed slice in place),
+    the slice set never grows (no leaks from repeated publication),
+    and the storm settles with zero taints and the original slice
+    names."""
 
     @pytest.fixture(scope="class")
     def storm_cluster(self, tmp_path_factory):
@@ -168,10 +171,12 @@ class TestRepublishStorm:
             slices = self._pool_slices(kube)
             observed.append(self._generation(slices))
 
-        # Strict monotonicity across every observed republish: a stale
-        # write would show as a repeat or a regression.
-        for a, b in zip(observed, observed[1:]):
-            assert b > a, f"pool generation not monotone: {observed}"
+        # Taint churn is not inventory churn: the generation observed
+        # after every republish must equal the initial one -- a bump
+        # here would make the whole fleet's schedulers re-ingest the
+        # pool once per health flap.
+        assert observed == [gen] * len(observed), (
+            f"taint storm moved the pool generation: {observed}")
 
         # Settled: same slice names as the initial publication (nothing
         # leaked, nothing lost), all taints gone on every chip.

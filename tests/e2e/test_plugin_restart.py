@@ -5,7 +5,8 @@ Reference analog: tests/bats/test_gpu_robustness.bats (plugin pod
 kills over live claims) + the checkpoint/resume design
 (device_state.go:83-215). The crashed plugin held a prepared claim;
 after restart it must (1) re-register with the kubelet watcher over
-the same sockets, (2) republish its pool at a higher generation,
+the same sockets, (2) leave the published pool UNTOUCHED (unchanged
+inventory hashes identical -- a restart must not look like churn),
 (3) serve NEW prepares without conflicting with the restored claim
 (per-core overlap guard against resumed state, not empty state), and
 (4) honor unprepare of a claim prepared by the PREVIOUS incarnation
@@ -37,6 +38,29 @@ class RestartCluster(PluginCluster):
                 for s in self.kube.list(*RES, "resourceslices")
                 if s["spec"].get("driver") == "tpu.dra.dev"]
         return max(gens) if gens else 0
+
+    def wait_plugin_serving(self, timeout=90.0):
+        """Block until the plugin's DRA socket accepts connections.
+        (The old barrier -- waiting for a pool-generation bump -- died
+        with write-amplification-free publishing: a restart over an
+        unchanged inventory publishes NOTHING.)"""
+        import os
+        import socket
+
+        path = os.path.join(self.workdir, "plugin", "tpu.dra.dev.sock")
+
+        def serving():
+            if not os.path.exists(path):
+                return None
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                s.connect(path)
+                return True
+            except OSError:
+                return None
+            finally:
+                s.close()
+        wait_for(serving, timeout=timeout, desc="plugin socket serving")
 
     def run_probe_pod(self, ns, name, count, timeout=180):
         self.kube.create(*RES, "resourceclaims", {
@@ -105,9 +129,12 @@ class TestPluginRestart:
         cluster.plugin.wait()
         cluster.spawn_plugin()
 
-        # Incarnation #2 republishes at a higher generation.
-        wait_for(lambda: cluster.pool_generation() > gen_before or None,
-                 timeout=90, desc="republish after restart")
+        # Incarnation #2's startup publish finds an UNCHANGED inventory
+        # and (content-hash diff) leaves the pool alone: the generation
+        # must NOT move on a mere restart -- the fleet's schedulers
+        # would otherwise re-ingest every pool on every plugin roll.
+        cluster.wait_plugin_serving()
+        assert cluster.pool_generation() == gen_before
 
         # New prepare against RESUMED state: 3 chips remain free
         # (pod1's chip is still checkpoint-held); the overlap guard

@@ -54,6 +54,50 @@ def make_claim(uid: str, devices: list[str], **kw) -> ResourceClaim:
     return ResourceClaim.from_dict(make_claim_dict(uid, devices, **kw))
 
 
+class CountingKube:
+    """KubeClient wrapper counting reads (get/list/server_version) and
+    writes (create/update/patch/delete); watch hooks and everything
+    else pass through, so informers keep working against the inner
+    fake. The no-op steady-state and publish-diff regression tests
+    gate on these counters."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.reads = 0
+        self.writes = 0
+
+    def get(self, *a, **kw):
+        self.reads += 1
+        return self._inner.get(*a, **kw)
+
+    def list(self, *a, **kw):
+        self.reads += 1
+        return self._inner.list(*a, **kw)
+
+    def server_version(self, *a, **kw):
+        self.reads += 1
+        return self._inner.server_version(*a, **kw)
+
+    def create(self, *a, **kw):
+        self.writes += 1
+        return self._inner.create(*a, **kw)
+
+    def update(self, *a, **kw):
+        self.writes += 1
+        return self._inner.update(*a, **kw)
+
+    def patch(self, *a, **kw):
+        self.writes += 1
+        return self._inner.patch(*a, **kw)
+
+    def delete(self, *a, **kw):
+        self.writes += 1
+        return self._inner.delete(*a, **kw)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
 def opaque(kind: str, **fields) -> dict:
     return {
         "apiVersion": "resource.tpu.dra/v1beta1",

@@ -488,6 +488,48 @@ class TestRunnerAndOutput:
         assert active == [fps[1]]
 
 
+class TestSchedulerSyncListRule:
+    """TPUDRA009: scheduler sync paths must read watched resources
+    through the informer-backed ClusterView/snapshot (pkg/schedcache),
+    never via a raw kube.list."""
+
+    def test_raw_list_of_watched_resource_flagged(self):
+        src = ("class DraScheduler:\n"
+               "    def _allocate_claims(self):\n"
+               "        return self.kube.list('resource.k8s.io', 'v1',\n"
+               "                              'resourceclaims')\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA009" in rules_of(findings)
+
+    def test_starred_resource_tuple_still_flagged(self):
+        # The common call shape: self.kube.list(*RESOURCE, "pods").
+        src = ("class DraScheduler:\n"
+               "    def _pods(self):\n"
+               "        return self.kube.list(*RESOURCE, 'pods')\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA009" in rules_of(findings)
+
+    def test_view_reads_clean(self):
+        src = ("class DraScheduler:\n"
+               "    def _pods(self):\n"
+               "        return self.view.pods()\n")
+        assert lint_source(src, rel="pkg/scheduler.py") == []
+
+    def test_unwatched_resource_clean(self):
+        src = ("class DraScheduler:\n"
+               "    def _events(self):\n"
+               "        return self.kube.list('', 'v1', 'events')\n")
+        assert lint_source(src, rel="pkg/scheduler.py") == []
+
+    def test_other_files_out_of_scope(self):
+        # schedcache.py IS the sanctioned listing layer.
+        src = ("class ClusterView:\n"
+               "    def pods(self):\n"
+               "        return self.kube.list('', 'v1', 'pods')\n")
+        assert "TPUDRA009" not in rules_of(
+            lint_source(src, rel="pkg/schedcache.py"))
+
+
 class TestWholePackageGate:
     """The tier-1 CI gate from ISSUE 3: zero non-baselined findings
     over the shipped package, with the committed baseline EMPTY (every

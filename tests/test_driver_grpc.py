@@ -82,10 +82,14 @@ class TestResourceSlices:
         assert any("chips" in n for n in names)
         assert any("partitions" in n for n in names)
 
-    def test_republish_bumps_generation(self, driver, kube):
-        driver.publish_resources()
+    def test_republish_unchanged_is_write_free(self, driver, kube):
+        # Content-hash diff: re-publishing an unchanged node costs
+        # zero kube writes and the generation does not move (the real
+        # DRA plugin treats generation bumps as inventory churn).
+        stats = driver.publish_resources()
+        assert stats["writes"] == 0 and stats["skipped"] >= 1
         s = kube.list("resource.k8s.io", "v1", "resourceslices")[0]
-        assert s["spec"]["pool"]["generation"] == 2
+        assert s["spec"]["pool"]["generation"] == 1
 
     def test_split_mode_without_partitions_publishes_complete_pool(
         self, tmp_root, kube
@@ -131,7 +135,7 @@ class TestResourceSlices:
             publication_mode="combined",
         )
         d1.publish_resources()
-        d1.publish_resources()  # combined slice reaches generation 2
+        d1.publish_resources()  # no-op: combined slice stays at gen 1
         d2 = Driver(
             Config.mock(root=root, topology="v5e-4"),
             kube, node_name="node-b", enable_health_monitor=False,
@@ -143,7 +147,7 @@ class TestResourceSlices:
         assert len(slices) == 2
         assert all("chips" in n or "partitions" in n for n in names)
         # The new slices outrank the deleted combined slice's generation.
-        assert all(s["spec"]["pool"]["generation"] == 3 for s in slices)
+        assert all(s["spec"]["pool"]["generation"] == 2 for s in slices)
 
     def test_legacy_mode_publishes_whole_chips_only(self, tmp_root, kube):
         d = Driver(
@@ -354,6 +358,82 @@ class TestHealthTaints:
         assert health_event_to_taints(
             HealthEvent(chip=0, kind="thermal_notice", fatal=False)
         ) == []
+
+    def test_unchanged_taint_republish_is_zero_kube_calls(self,
+                                                          tmp_root):
+        """ISSUE 5 satellite regression: the health monitor reports the
+        FULL taint list every poll, so a steady (even non-empty) taint
+        set arrives unchanged once per interval -- the republish must
+        short-circuit on the content hash and touch the apiserver ZERO
+        times. A real taint change still publishes (one write, no
+        pool-generation bump: taints are not inventory churn)."""
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.health import (
+            DeviceTaint,
+        )
+        from tests.fake_kube import CountingKube
+
+        fake = FakeKubeClient()
+        counting = CountingKube(fake)
+        d = Driver(
+            Config.mock(root=os.path.join(tmp_root, "zh"),
+                        topology="v5e-4"),
+            counting, node_name="node-zh", enable_health_monitor=False,
+        )
+        d.publish_resources()
+        taints = [DeviceTaint(device="chip-2",
+                              key="tpu.dra.dev/thermal",
+                              value="true", effect="")]
+        d._on_health_taints(taints)  # taint appears: one write...
+        s = fake.list("resource.k8s.io", "v1", "resourceslices")[0]
+        assert s["spec"]["pool"]["generation"] == 1  # ...but no bump
+        writes0, reads0 = counting.writes, counting.reads
+
+        def skip_count():
+            metric = next(iter(
+                d.metrics.slice_publish_skipped.collect()))
+            return next(s.value for s in metric.samples
+                        if s.name.endswith("_total"))
+
+        skipped0 = skip_count()
+        for _ in range(5):  # five no-op health polls
+            d._on_health_taints(taints)
+        assert counting.writes == writes0, \
+            "unchanged taint set must republish with zero kube writes"
+        assert counting.reads == reads0, \
+            "the hash short-circuit must not even list live slices"
+        assert skip_count() > skipped0
+        # The taint CLEARING is a real change again: exactly one slice
+        # write, still no generation bump.
+        d._on_health_taints([])
+        assert counting.writes == writes0 + 1
+        s = fake.list("resource.k8s.io", "v1", "resourceslices")[0]
+        assert s["spec"]["pool"]["generation"] == 1
+        assert all("taints" not in dev or not any(
+            t.get("key") == "tpu.dra.dev/thermal"
+            for t in dev["taints"])
+            for dev in s["spec"]["devices"])
+
+    def test_publish_recheck_repairs_external_slice_deletion(
+            self, tmp_root, monkeypatch):
+        """The hash memo must not mask external drift forever: past
+        TPU_DRA_PUBLISH_RECHECK_S the health republish goes through the
+        live diff (one list read, zero writes when converged) and
+        recreates a slice some other actor deleted."""
+        monkeypatch.setenv("TPU_DRA_PUBLISH_RECHECK_S", "0")
+        fake = FakeKubeClient()
+        d = Driver(
+            Config.mock(root=os.path.join(tmp_root, "rh"),
+                        topology="v5e-4"),
+            fake, node_name="node-rh", enable_health_monitor=False,
+        )
+        d.publish_resources()
+        name = fake.list("resource.k8s.io", "v1",
+                         "resourceslices")[0]["metadata"]["name"]
+        fake.delete("resource.k8s.io", "v1", "resourceslices", name)
+        assert fake.list("resource.k8s.io", "v1", "resourceslices") == []
+        d._on_health_taints([])  # unchanged taints, but the recheck is due
+        restored = fake.list("resource.k8s.io", "v1", "resourceslices")
+        assert [s["metadata"]["name"] for s in restored] == [name]
 
 
 class TestCleanup:

@@ -99,6 +99,93 @@ class TestInformer:
         assert inf.list() == []
 
 
+class TestRelistDiscipline:
+    """ISSUE 5 satellite: FakeKubeClient-backed informers used to
+    relist the WHOLE store on every matching event. Events now apply
+    incrementally; the relist path survives only as the conservative
+    fallback and concurrent requests coalesce into one trailing
+    relist per burst."""
+
+    def test_fake_events_apply_incrementally_without_relist(self):
+        kube = FakeKubeClient()
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()
+        base = inf.relist_total  # the priming list
+        assert base == 1
+        for i in range(10):
+            make_cd(kube, f"cd{i}", uid=f"u{i}")
+        kube.patch(API_GROUP, API_VERSION, "computedomains", "cd0",
+                   {"status": {"status": "Ready"}}, namespace="default")
+        kube.delete(API_GROUP, API_VERSION, "computedomains", "cd9",
+                    namespace="default")
+        assert inf.relist_total == base, \
+            "incremental events must not trigger relists"
+        assert len(inf.list()) == 9
+        assert inf.get_by_uid("u0")["status"]["status"] == "Ready"
+        assert inf.get_by_uid("u9") is None
+
+    def test_event_hooks_carry_payloads(self):
+        kube = FakeKubeClient()
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()
+        events = []
+        inf.add_event_hook(
+            lambda t, o: events.append((t, o["metadata"]["name"])))
+        make_cd(kube, "cd1", uid="u1")
+        kube.patch(API_GROUP, API_VERSION, "computedomains", "cd1",
+                   {"status": {"status": "Ready"}}, namespace="default")
+        kube.delete(API_GROUP, API_VERSION, "computedomains", "cd1",
+                    namespace="default")
+        assert events == [("ADDED", "cd1"), ("MODIFIED", "cd1"),
+                          ("DELETED", "cd1")]
+
+    def test_events_for_other_resources_ignored_without_relist(self):
+        kube = FakeKubeClient()
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()
+        base = inf.relist_total
+        kube.create(API_GROUP, API_VERSION, "computedomaincliques", {
+            "metadata": {"name": "u1.0", "namespace": "ns"},
+        }, namespace="ns")
+        assert inf.relist_total == base
+        assert inf.list() == []
+
+    def test_concurrent_relists_coalesce(self):
+        import threading
+
+        class SlowListKube(FakeKubeClient):
+            def list(self, *a, **kw):
+                import time
+                time.sleep(0.03)
+                return super().list(*a, **kw)
+
+        kube = SlowListKube()
+        make_cd(kube, "cd1", uid="u1")
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()
+        base = inf.relist_total
+        threads = [threading.Thread(target=inf.relist)
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # One active relist + at most one trailing relist for the
+        # whole coalesced burst (8 naive relists before this fix).
+        assert inf.relist_total - base <= 2
+        assert inf.get_by_uid("u1") is not None
+
+    def test_relist_counter_hook_fires(self):
+        counted = []
+        kube = FakeKubeClient()
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain",
+                       on_relist=lambda: counted.append(1))
+        inf.start()
+        inf.relist()
+        assert len(counted) == inf.relist_total == 2
+
+
 class TestCDPluginInformerPath:
     def test_get_cd_via_cache_and_retryable_miss(self, tmp_root):
         from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state import (

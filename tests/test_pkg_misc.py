@@ -38,29 +38,77 @@ class TestSegmentTimer:
 
 
 class TestSliceUtil:
-    def _slice(self, name, gen=1, driver="tpu.dra.dev", node="n"):
+    def _slice(self, name, gen=1, driver="tpu.dra.dev", node="n",
+               devices=None):
         return {
             "metadata": {"name": name},
             "spec": {"driver": driver, "nodeName": node,
                      "pool": {"name": node, "generation": gen,
                               "resourceSliceCount": 1},
-                     "devices": []},
+                     "devices": devices if devices is not None else []},
         }
 
-    def test_create_then_update_bumps_generation(self):
+    def test_unchanged_republish_is_write_free(self):
+        """Publishing the same content twice performs zero kube writes
+        and leaves the generation alone (the content-hash diff)."""
         kube = FakeKubeClient()
-        publish_resource_slices(kube, [self._slice("s1")])
-        publish_resource_slices(kube, [self._slice("s1")])
+        first = publish_resource_slices(kube, [self._slice("s1")])
+        assert first["writes"] == 1 and first["changed"]
+        skipped = []
+        again = publish_resource_slices(kube, [self._slice("s1")],
+                                        on_skip=skipped.append)
+        assert again == {"writes": 0, "deletes": 0, "skipped": 1,
+                         "generation": 1, "changed": False}
+        assert skipped == [1]
+        obj = kube.get("resource.k8s.io", "v1", "resourceslices", "s1")
+        assert obj["spec"]["pool"]["generation"] == 1
+
+    def test_diff_false_forces_legacy_write_always(self):
+        kube = FakeKubeClient()
+        publish_resource_slices(kube, [self._slice("s1")], diff=False)
+        stats = publish_resource_slices(kube, [self._slice("s1")],
+                                        diff=False)
+        assert stats["writes"] == 1
+        obj = kube.get("resource.k8s.io", "v1", "resourceslices", "s1")
+        assert obj["spec"]["pool"]["generation"] == 2
+
+    def test_content_change_same_inventory_keeps_generation(self):
+        """A taint-style content change on an unchanged device
+        inventory rewrites the slice WITHOUT a pool-generation bump --
+        the real DRA plugin treats generation bumps as inventory
+        churn."""
+        kube = FakeKubeClient()
+        dev = {"name": "chip-0", "attributes": {}}
+        publish_resource_slices(kube, [self._slice("s1", devices=[dev])])
+        tainted = {"name": "chip-0", "attributes": {},
+                   "taints": [{"key": "k", "effect": "NoSchedule"}]}
+        stats = publish_resource_slices(
+            kube, [self._slice("s1", devices=[tainted])])
+        assert stats["writes"] == 1 and stats["changed"]
+        obj = kube.get("resource.k8s.io", "v1", "resourceslices", "s1")
+        assert obj["spec"]["pool"]["generation"] == 1  # no bump
+        assert obj["spec"]["devices"][0]["taints"]
+
+    def test_inventory_change_bumps_generation(self):
+        kube = FakeKubeClient()
+        publish_resource_slices(
+            kube, [self._slice("s1", devices=[{"name": "chip-0"}])])
+        stats = publish_resource_slices(
+            kube, [self._slice("s1", devices=[{"name": "chip-0"},
+                                              {"name": "chip-1"}])])
+        assert stats["changed"] and stats["generation"] == 2
         obj = kube.get("resource.k8s.io", "v1", "resourceslices", "s1")
         assert obj["spec"]["pool"]["generation"] == 2
 
     def test_one_shared_generation_and_stale_deletion(self):
         kube = FakeKubeClient()
-        publish_resource_slices(kube, [self._slice("s1")])
-        publish_resource_slices(kube, [self._slice("s1")])
+        publish_resource_slices(kube, [self._slice("s1")], diff=False)
+        publish_resource_slices(kube, [self._slice("s1")], diff=False)
         # New desired set {s2, s3}: both get generation 3 (> s1's 2) and
         # the stale s1 is deleted so it can't shadow the pool.
-        publish_resource_slices(kube, [self._slice("s2"), self._slice("s3")])
+        stats = publish_resource_slices(
+            kube, [self._slice("s2"), self._slice("s3")])
+        assert stats["deletes"] == 1
         slices = kube.list("resource.k8s.io", "v1", "resourceslices")
         assert {s["metadata"]["name"] for s in slices} == {"s2", "s3"}
         assert all(s["spec"]["pool"]["generation"] == 3 for s in slices)

@@ -1,0 +1,373 @@
+"""Event-driven incremental scheduler tier (pkg/scheduler +
+pkg/schedcache): dirty-set sync, indexed snapshot lifecycle, and the
+three proofs ISSUE 5 demands --
+
+- **no-op steady state**: a quiesced cluster performs ZERO kube writes
+  (and, in event mode, zero kube reads) across 10 sync drains
+  including forced full safety resyncs;
+- **incremental-vs-full equivalence**: the same recorded churn trace
+  produces IDENTICAL final allocations under the polled full-resync
+  loop and the event-driven dirty-set loop;
+- **snapshot invalidation**: the inventory snapshot is reused while
+  slices are untouched and rebuilt on any slice write / pool-generation
+  bump, with the incremental allocation state rebuilt alongside it.
+"""
+
+import time
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.metrics import SchedulerMetrics
+from k8s_dra_driver_gpu_tpu.pkg.schedcache import (
+    AllocationState,
+    ClusterView,
+    InventorySnapshot,
+)
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+from k8s_dra_driver_gpu_tpu.pkg.sliceutil import publish_resource_slices
+
+from tests.fake_kube import CountingKube
+
+RES = ("resource.k8s.io", "v1")
+
+
+def apply_class(kube, name="tpu.dra.dev"):
+    kube.create(*RES, "deviceclasses", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": name},
+        "spec": {"selectors": [{"cel": {
+            "expression": f'device.driver == "{name}"'}}]},
+    })
+
+
+def node_slices(node, chips=4, driver="tpu.dra.dev", taints=None):
+    devices = []
+    for j in range(chips):
+        dev = {"name": f"chip-{j}", "attributes": {
+            "type": {"string": "tpu-chip"}, "index": {"int": j}}}
+        if taints and j in taints:
+            dev["taints"] = list(taints[j])
+        devices.append(dev)
+    return [{
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+        "metadata": {"name": f"{node}-{driver}"},
+        "spec": {"driver": driver, "nodeName": node,
+                 "pool": {"name": node, "generation": 1,
+                          "resourceSliceCount": 1},
+                 "devices": devices},
+    }]
+
+
+def make_claim(kube, name, count=1, ns="default", cel=None):
+    exactly = {"deviceClassName": "tpu.dra.dev"}
+    if count != 1:
+        exactly["count"] = count
+    if cel:
+        exactly["selectors"] = [{"cel": {"expression": cel}}]
+    kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "exactly": exactly}]}},
+    }, namespace=ns)
+
+
+def make_pod(kube, name, claim_name, ns="default"):
+    kube.create("", "v1", "pods", {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"containers": [{"name": "c"}],
+                 "resourceClaims": [{"name": "tpu",
+                                     "resourceClaimName": claim_name}]},
+    }, namespace=ns)
+
+
+def allocation(kube, name, ns="default"):
+    return kube.get(*RES, "resourceclaims", name, ns).get(
+        "status", {}).get("allocation")
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+@pytest.fixture()
+def event_sched():
+    """(counting kube, event-driven scheduler) over a 2-node x 4-chip
+    inventory; the scheduler writes through the counter, the trace
+    mutations go straight to the fake."""
+    fake = FakeKubeClient()
+    apply_class(fake)
+    for node in ("node-a", "node-b"):
+        publish_resource_slices(fake, node_slices(node))
+    counting = CountingKube(fake)
+    sched = DraScheduler(counting, sched_metrics=SchedulerMetrics())
+    sched.start_event_driven()
+    assert sched.drain(15.0)
+    try:
+        yield fake, counting, sched
+    finally:
+        sched.stop()
+
+
+class TestEventDrivenFlow:
+    def test_claim_event_allocates_and_binds_pod(self, event_sched):
+        fake, counting, sched = event_sched
+        make_claim(fake, "c1")
+        make_pod(fake, "p1", "c1")
+        assert sched.drain(15.0)
+        assert wait_for(lambda: allocation(fake, "c1"))
+        assert wait_for(lambda: fake.get("", "v1", "pods", "p1",
+                                         "default")["spec"].get(
+            "nodeName"))
+        claim = fake.get(*RES, "resourceclaims", "c1", "default")
+        assert claim["status"]["reservedFor"][0]["name"] == "p1"
+
+    def test_template_pod_generates_claim_event_driven(self, event_sched):
+        fake, counting, sched = event_sched
+        fake.create(*RES, "resourceclaimtemplates", {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": "tpl", "namespace": "default"},
+            "spec": {"spec": {"devices": {"requests": [
+                {"name": "tpu",
+                 "exactly": {"deviceClassName": "tpu.dra.dev"}}]}}},
+        }, namespace="default")
+        fake.create("", "v1", "pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "worker", "namespace": "default"},
+            "spec": {"containers": [{"name": "c"}],
+                     "resourceClaims": [{
+                         "name": "tpu",
+                         "resourceClaimTemplateName": "tpl"}]},
+        }, namespace="default")
+        assert sched.drain(15.0)
+
+        def bound():
+            pod = fake.get("", "v1", "pods", "worker", "default")
+            return pod["spec"].get("nodeName")
+        assert wait_for(bound)
+        pod = fake.get("", "v1", "pods", "worker", "default")
+        generated = pod["status"]["resourceClaimStatuses"][0][
+            "resourceClaimName"]
+        assert allocation(fake, generated)
+
+    def test_claim_delete_unblocks_pending_claim(self, event_sched):
+        fake, counting, sched = event_sched
+        # 8 chips total; c-big takes 8, c-wait must pend.
+        make_claim(fake, "c-big-a", count=4)
+        make_claim(fake, "c-big-b", count=4)
+        make_claim(fake, "c-wait")
+        assert sched.drain(15.0)
+        assert wait_for(lambda: allocation(fake, "c-big-a"))
+        assert wait_for(lambda: allocation(fake, "c-big-b"))
+        assert allocation(fake, "c-wait") is None
+        fake.delete(*RES, "resourceclaims", "c-big-a", "default")
+        assert sched.drain(15.0)
+        assert wait_for(lambda: allocation(fake, "c-wait"))
+
+    def test_slice_publish_retries_pending_claims(self, event_sched):
+        fake, counting, sched = event_sched
+        make_claim(fake, "c-gpu", cel=(
+            'device.attributes["tpu.dra.dev"].index == 9'))
+        assert sched.drain(15.0)
+        assert allocation(fake, "c-gpu") is None
+        # A new node appears whose chip-9 satisfies the selector.
+        publish_resource_slices(fake, node_slices("node-c", chips=10))
+        assert sched.drain(15.0)
+        assert wait_for(lambda: allocation(fake, "c-gpu"))
+
+
+class TestNoOpSteadyState:
+    def test_quiesced_cluster_zero_kube_traffic_over_10_drains(
+            self, event_sched):
+        """The satellite proof: once converged, 10 sync drains --
+        including forced FULL safety resyncs -- perform ZERO kube
+        writes (and in event mode, zero reads: everything comes from
+        the informer caches)."""
+        fake, counting, sched = event_sched
+        for i in range(3):
+            make_claim(fake, f"c{i}")
+            make_pod(fake, f"p{i}", f"c{i}")
+        assert sched.drain(15.0)
+        assert wait_for(lambda: all(
+            allocation(fake, f"c{i}") for i in range(3)))
+        assert wait_for(lambda: all(
+            fake.get("", "v1", "pods", f"p{i}", "default")["spec"].get(
+                "nodeName") for i in range(3)))
+        assert sched.drain(15.0)
+        writes0, reads0 = counting.writes, counting.reads
+        for _ in range(10):
+            sched._enqueue(("full",))
+            assert sched.drain(15.0)
+        assert counting.writes == writes0, \
+            "a quiesced cluster must cost zero kube writes"
+        assert counting.reads == reads0, \
+            "event mode must serve full resyncs from informer caches"
+
+
+class TestIncrementalFullEquivalence:
+    # A recorded churn trace: creations (with varying counts and a
+    # selector), interleaved deletions, then a final wave. Both
+    # schedulers must land on IDENTICAL final allocations.
+    TRACE = [
+        ("create", "a", {"count": 2}),
+        ("create", "b", {"count": 1}),
+        ("create", "c", {"count": 1,
+                         "cel": 'device.attributes["tpu.dra.dev"]'
+                                '.index == 0'}),
+        ("delete", "b", None),
+        ("create", "d", {"count": 3}),
+        ("create", "e", {"count": 1}),
+        ("delete", "a", None),
+        ("create", "f", {"count": 2}),
+        ("create", "g", {"count": 4}),
+    ]
+
+    @staticmethod
+    def _setup(fake):
+        apply_class(fake)
+        for node in ("node-a", "node-b"):
+            publish_resource_slices(fake, node_slices(node))
+
+    @staticmethod
+    def _final_allocations(fake):
+        out = {}
+        for claim in fake.objects("resource.k8s.io", "resourceclaims"):
+            alloc = claim.get("status", {}).get("allocation")
+            name = claim["metadata"]["name"]
+            if alloc is None:
+                out[name] = None
+                continue
+            out[name] = sorted(
+                (r["pool"], r["device"])
+                for r in alloc["devices"]["results"])
+        return out
+
+    def _apply(self, fake, op, name, kw, settle):
+        if op == "create":
+            make_claim(fake, name, count=kw.get("count", 1),
+                       cel=kw.get("cel"))
+        else:
+            fake.delete(*RES, "resourceclaims", name, "default")
+        settle()
+
+    def test_same_final_allocations(self):
+        polled = FakeKubeClient()
+        self._setup(polled)
+        sched_p = DraScheduler(polled)
+        for op, name, kw in self.TRACE:
+            self._apply(polled, op, name, kw,
+                        settle=lambda: (sched_p.sync_once(),
+                                        sched_p.sync_once()))
+
+        evented = FakeKubeClient()
+        self._setup(evented)
+        sched_e = DraScheduler(evented)
+        sched_e.start_event_driven()
+        assert sched_e.drain(15.0)
+        try:
+            for op, name, kw in self.TRACE:
+                self._apply(evented, op, name, kw,
+                            settle=lambda: sched_e.drain(15.0))
+        finally:
+            sched_e.stop()
+
+        got_p = self._final_allocations(polled)
+        got_e = self._final_allocations(evented)
+        assert got_p == got_e, (got_p, got_e)
+        # And the trace exercised real allocation: everything final is
+        # allocated (capacity: 8 chips; live demand at the end: 1+3+1+
+        # 2 = 7 plus g's 4 won't fit -> g pends identically).
+        assert got_p["g"] is None
+        assert all(got_p[n] for n in ("c", "d", "e", "f"))
+
+
+class TestSnapshotLifecycle:
+    def test_snapshot_cached_until_slice_change(self):
+        fake = FakeKubeClient()
+        publish_resource_slices(fake, node_slices("node-a"))
+        view = ClusterView(fake)
+        s1 = view.snapshot()
+        assert {c.name for c in s1.candidates} == {
+            "chip-0", "chip-1", "chip-2", "chip-3"}
+        assert view.snapshot() is s1  # nothing changed: same object
+        # An unchanged diffed republish performs no writes -> the
+        # snapshot (and its selector/topology memos) survives.
+        stats = publish_resource_slices(fake, node_slices("node-a"))
+        assert stats["writes"] == 0
+        assert view.snapshot() is s1
+
+    def test_snapshot_rebuilt_on_pool_generation_bump(self):
+        fake = FakeKubeClient()
+        publish_resource_slices(fake, node_slices("node-a"))
+        view = ClusterView(fake)
+        s1 = view.snapshot()
+        s1.order_cache[("sentinel",)] = ["stale"]
+        # Device inventory change -> generation bump -> new snapshot,
+        # fresh memos.
+        publish_resource_slices(fake, node_slices("node-a", chips=5))
+        s2 = view.snapshot()
+        assert s2 is not s1
+        assert ("sentinel",) not in s2.order_cache
+        assert "chip-4" in {c.name for c in s2.candidates}
+        assert s2.pool_generations[("tpu.dra.dev", "node-a")] == 2
+
+    def test_stale_generation_filtered_from_snapshot(self):
+        fake = FakeKubeClient()
+        publish_resource_slices(fake, node_slices("node-a"))
+        stale = node_slices("node-a")[0]
+        stale["metadata"]["name"] = "stale"
+        stale["spec"]["pool"]["generation"] = 0
+        stale["spec"]["devices"] = [{"name": "phantom"}]
+        fake.create(*RES, "resourceslices", stale)
+        snap = ClusterView(fake).snapshot()
+        assert "phantom" not in {c.name for c in snap.candidates}
+
+    def test_default_node_fallback_for_nodeless_slices(self):
+        # Cluster-scoped (nodeName-less) slices bucket under the
+        # scheduler's --default-node so bound-pod pins can still match.
+        fake = FakeKubeClient()
+        nodeless = node_slices("node-a")[0]
+        del nodeless["spec"]["nodeName"]
+        fake.create(*RES, "resourceslices", nodeless)
+        snap = ClusterView(fake, default_node="node-dflt").snapshot()
+        assert set(snap.by_node) == {"node-dflt"}
+        assert ClusterView(fake).snapshot().by_node.keys() == {""}
+
+    def test_allocation_state_observe_idempotent_and_forget(self):
+        snap = InventorySnapshot(node_slices("node-a"))
+        alloc = AllocationState(snap)
+        claim = {
+            "metadata": {"uid": "u1", "namespace": "default",
+                         "name": "c1"},
+            "status": {"allocation": {"devices": {"results": [{
+                "driver": "tpu.dra.dev", "pool": "node-a",
+                "device": "chip-0"}]}}},
+        }
+        assert alloc.observe(claim) is True
+        assert alloc.observe(claim) is False  # replay: no-op
+        assert ("tpu.dra.dev", "node-a", "chip-0") in alloc.allocated
+        assert alloc.forget(claim) is True
+        assert not alloc.allocated
+        assert alloc.forget(claim) is False
+
+
+class TestSchedulerMetricsWiring:
+    def test_sync_histogram_and_queue_depth_exported(self, event_sched):
+        from prometheus_client import generate_latest
+
+        fake, counting, sched = event_sched
+        make_claim(fake, "c1")
+        assert sched.drain(15.0)
+        text = generate_latest(sched.sched_metrics.registry).decode()
+        assert 'tpu_dra_sched_sync_seconds_count{mode="full"}' in text
+        assert 'mode="incremental"' in text
+        assert "tpu_dra_sched_dirty_queue_depth" in text
+        assert "tpu_dra_informer_relist_total" in text

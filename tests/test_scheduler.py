@@ -194,15 +194,17 @@ class TestAllocation:
         assert cfg[0]["opaque"]["parameters"]["kind"] == "TpuConfig"
 
     def test_stale_pool_generation_invisible(self, driver, kube, sched):
-        # Re-publish bumps the generation; hand-craft a stale slice with
-        # a phantom device at the old generation.
-        driver.publish_resources()
+        # Re-publishing an unchanged set is a write-free no-op now
+        # (content-hash diff), so the pool stays at generation 1;
+        # hand-craft a stale slice with a phantom device at an OLDER
+        # generation.
+        assert driver.publish_resources()["writes"] == 0
         kube.create(*RES, "resourceslices", {
             "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
             "metadata": {"name": "stale-slice"},
             "spec": {
                 "driver": "tpu.dra.dev", "nodeName": "node-a",
-                "pool": {"name": "node-a", "generation": 1,
+                "pool": {"name": "node-a", "generation": 0,
                          "resourceSliceCount": 1},
                 "devices": [{"name": "phantom-chip", "attributes": {
                     "platform": {"string": "v5e"}}}],
