@@ -3,8 +3,8 @@
 PYTHON ?= python
 
 .PHONY: all native test test-fast bench bench-smoke \
-	bench-placement-smoke bench-chaos-smoke bench-sched-smoke lint \
-	lint-analysis clean stamp-version
+	bench-placement-smoke bench-chaos-smoke bench-sched-smoke \
+	bench-recovery-smoke lint lint-analysis clean stamp-version
 
 VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
 
@@ -62,8 +62,30 @@ bench-placement-smoke:
 # claim / leaked lease / leaked carve-out / hung rendezvous; mirrored
 # as a non-slow test in tests/test_bench_chaos_smoke.py. See
 # docs/operations.md "Fault injection" for the env matrix.
+# (--chaos also replays the recovery scenarios at reduced scale here;
+# the dedicated full gate is bench-recovery-smoke below, and the smoke
+# keeps the committed BENCH_recovery.json trajectory untouched.)
 bench-chaos-smoke:
-	BENCH_CHAOS_ITERS=3 BENCH_CHAOS_ROUNDS=8 $(PYTHON) bench.py --chaos
+	BENCH_CHAOS_ITERS=3 BENCH_CHAOS_ROUNDS=8 \
+	BENCH_RECOVERY_NODES=3 BENCH_RECOVERY_CLAIMS=8 \
+	BENCH_RECOVERY_DEADLINE_S=1.0 \
+	BENCH_RECOVERY_OUT=$(or $(BENCH_RECOVERY_OUT),/tmp/BENCH_recovery_smoke.json) \
+	$(PYTHON) bench.py --chaos
+
+# Permanent-failure recovery smoke: the three chaos scenarios the
+# resilience layer can't cover (node killed outright under load,
+# plugin wiped + restarted, eviction controller crashed mid-eviction)
+# at reduced scale. Exits nonzero when ANY claim on the killed node
+# fails to converge (re-allocated or cleanly Failed), ANY node-local
+# layer leaks (carve-outs / CDI specs / leases), the hand-planted
+# orphan survives one sweep, or a crash fails to resume. Mirrored as a
+# non-slow test in tests/test_bench_recovery_smoke.py; trajectory file
+# is BENCH_recovery.json (also refreshed by plain `bench.py --chaos`).
+bench-recovery-smoke:
+	BENCH_RECOVERY_NODES=3 BENCH_RECOVERY_CLAIMS=10 \
+	BENCH_RECOVERY_DEADLINE_S=1.0 \
+	BENCH_RECOVERY_OUT=$(or $(BENCH_RECOVERY_OUT),/tmp/BENCH_recovery_smoke.json) \
+	$(PYTHON) bench.py --recovery
 
 # Scheduler-churn smoke: a shrunk `--sched-churn` trace (8 nodes x 24
 # claims of paired pod+claim churn + unchanged health republishes)

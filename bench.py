@@ -1246,6 +1246,385 @@ def bench_chaos() -> dict:
     }
 
 
+def bench_recovery() -> dict:
+    """Permanent-failure recovery mode (`bench.py --recovery`, also
+    appended to `--chaos`): the three failure classes the unit suites
+    can't stage end-to-end at once, against the REAL event-driven
+    scheduler + eviction controller + node plugin.
+
+    1. **Node-kill under load**: N nodes x M claims (one node backed by
+       a real DeviceState plugin, two claims forming a gang); a node is
+       deleted outright. Every claim it held must converge --
+       re-allocated on surviving capacity or cleanly Failed at the
+       recovery deadline -- with the gang's surviving member drained
+       off the live plugin and ZERO leaked carve-outs/CDI specs/leases
+       there, and a hand-planted orphan repaired in ONE sweep pass.
+    2. **Plugin wipe + restart**: claims prepared, a prepare crashed
+       mid-middle (InjectedCrash), the plugin process replaced
+       wholesale; the fresh plugin + one reconcile sweep must restore
+       checkpoint/kube/CDI/carve-out/lease agreement.
+    3. **Mid-eviction controller crash**: InjectedCrash between drain
+       and deallocate; a FRESH controller on the same state root must
+       resume from the durable eviction record and converge.
+
+    Emits BENCH_recovery.json; `main` exits nonzero when ANY claim
+    fails to converge or ANY layer leaks (`make bench-recovery-smoke`
+    gates CI on this). Knobs: BENCH_RECOVERY_NODES (default 4),
+    BENCH_RECOVERY_CLAIMS (default 14 -- two more than the surviving
+    capacity, so the cleanly-failed path is exercised too),
+    BENCH_RECOVERY_DEADLINE_S (default 1.5)."""
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import ClaimState
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.claim import ResourceClaim
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.cleanup import (
+        CheckpointCleanupManager,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+        Config,
+        DeviceState,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.reconcile import (
+        NodeStateReconciler,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.subslice import (
+        SubSliceLiveTuple,
+        SubSliceSpecTuple,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg import faults
+    from k8s_dra_driver_gpu_tpu.pkg.faults import InjectedCrash
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.metrics import RecoveryMetrics
+    from k8s_dra_driver_gpu_tpu.pkg.recovery import (
+        EvictionController,
+        PERMANENT_FAILURE_CONDITION,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+    from k8s_dra_driver_gpu_tpu.pkg.sliceutil import (
+        publish_resource_slices,
+    )
+    from tests.fake_kube import make_claim, make_claim_dict
+
+    RES = ("resource.k8s.io", "v1")
+    nodes_n = max(2, _env_int("BENCH_RECOVERY_NODES", 4))
+    claims_n = _env_int("BENCH_RECOVERY_CLAIMS", 14)
+    try:
+        deadline_s = float(os.environ.get("BENCH_RECOVERY_DEADLINE_S",
+                                          "1.5"))
+    except ValueError:
+        deadline_s = 1.5
+    chips = 4
+    faults.reset()
+    extras: dict = {"recovery_nodes": nodes_n,
+                    "recovery_claims_total": claims_n}
+    violations = 0
+
+    def node_slices(node):
+        return [{
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": f"{node}-tpu.dra.dev"},
+            "spec": {"driver": "tpu.dra.dev", "nodeName": node,
+                     "pool": {"name": node, "generation": 1,
+                              "resourceSliceCount": 1},
+                     "devices": [
+                         {"name": f"chip-{j}", "attributes": {
+                             "type": {"string": "tpu-chip"}}}
+                         for j in range(chips)]},
+        }]
+
+    def alloc_of(fake, name):
+        claim = fake.get(*RES, "resourceclaims", name,
+                         namespace="default")
+        return claim.get("status", {}).get("allocation")
+
+    def cond_reason(fake, name):
+        claim = fake.get(*RES, "resourceclaims", name,
+                         namespace="default")
+        for c in claim.get("status", {}).get("conditions") or []:
+            if c.get("type") == PERMANENT_FAILURE_CONDITION:
+                return c.get("reason")
+        return None
+
+    # -- scenario 1: node-kill under load ------------------------------
+    with tempfile.TemporaryDirectory() as root:
+        fake = FakeKubeClient()
+        fake.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "tpu.dra.dev"},
+            "spec": {"selectors": [{"cel": {
+                "expression": 'device.driver == "tpu.dra.dev"'}}]},
+        })
+        for i in range(nodes_n):
+            fake.create("", "v1", "nodes", {
+                "metadata": {"name": f"node-{i}", "labels": {}},
+                "status": {"conditions": [
+                    {"type": "Ready", "status": "True"}]}})
+            publish_resource_slices(fake, node_slices(f"node-{i}"))
+        for k in range(claims_n):
+            spec = {"devices": {"requests": [{
+                "name": "tpu",
+                "exactly": {"deviceClassName": "tpu.dra.dev"}}]}}
+            if k < 2:
+                # The gang pair: least-loaded spreading puts them on
+                # node-0 (the real plugin) and node-1 (the victim).
+                # The opaque config targets the CD driver, so the chip
+                # plugin ignores it; the recovery controller reads the
+                # domainID for gang grouping.
+                spec["devices"]["config"] = [{"opaque": {
+                    "driver": "compute-domain.tpu.dra.dev",
+                    "parameters": {
+                        "apiVersion": "resource.tpu.dra/v1beta1",
+                        "kind": "ComputeDomainChannelConfig",
+                        "domainID": "bench-gang"}}}]
+            fake.create(*RES, "resourceclaims", {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": f"r{k}", "namespace": "default"},
+                "spec": spec}, namespace="default")
+            fake.create("", "v1", "pods", {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"r{k}-pod",
+                             "namespace": "default"},
+                "spec": {"containers": [{"name": "c"}],
+                         "resourceClaims": [{
+                             "name": "tpu",
+                             "resourceClaimName": f"r{k}"}]},
+            }, namespace="default")
+
+        metrics = RecoveryMetrics()
+        sched = DraScheduler(fake, resync_period=0.2)
+        ctrl = EvictionController(
+            fake, os.path.join(root, "controller"), metrics=metrics,
+            notready_grace_s=0.05, deadline_s=deadline_s,
+            max_concurrent=8)
+        sched.attach_recovery(ctrl)
+        sched.start_event_driven()
+        try:
+            sched.drain(30)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if all(alloc_of(fake, f"r{k}")
+                       for k in range(claims_n)):
+                    break
+                time.sleep(0.05)
+            placed = {f"r{k}": alloc_of(fake, f"r{k}")
+                      for k in range(claims_n)}
+            unplaced = [n for n, a in placed.items() if a is None]
+            if unplaced:
+                violations += len(unplaced)
+            extras["recovery_initially_placed"] = \
+                claims_n - len(unplaced)
+
+            def node_of(alloc):
+                return alloc["nodeSelector"]["nodeSelectorTerms"][0][
+                    "matchFields"][0]["values"][0] if alloc else None
+
+            # The real plugin backs node-0: prepare its claims there.
+            plugin = DeviceState(Config.mock(
+                root=os.path.join(root, "plugin"), topology="v5e-4"))
+            prepared_here = []
+            for name, alloc in placed.items():
+                if alloc and node_of(alloc) == "node-0":
+                    obj = fake.get(*RES, "resourceclaims", name,
+                                   namespace="default")
+                    plugin.prepare(ResourceClaim.from_dict(obj))
+                    prepared_here.append(name)
+            extras["recovery_prepared_on_plugin"] = len(prepared_here)
+
+            victims = [n for n, a in placed.items()
+                       if node_of(a) == "node-1"]
+            gang_survivor_evicted = any(
+                node_of(placed[f"r{k}"]) == "node-0" for k in (0, 1)
+            ) and any(node_of(placed[f"r{k}"]) == "node-1"
+                      for k in (0, 1))
+            extras["recovery_victims"] = len(victims)
+
+            # THE KILL: the node object goes away entirely.
+            fake.delete("", "v1", "nodes", "node-1")
+
+            def converged(name):
+                alloc = alloc_of(fake, name)
+                if alloc is not None and node_of(alloc) != "node-1":
+                    return True
+                return (alloc is None and cond_reason(fake, name)
+                        == "RecoveryDeadlineExceeded")
+
+            deadline = time.monotonic() + 45 + 10 * deadline_s
+            while time.monotonic() < deadline:
+                if all(converged(v) for v in victims) and \
+                        not ctrl.active_evictions():
+                    break
+                time.sleep(0.05)
+            replaced = sum(
+                1 for v in victims
+                if alloc_of(fake, v) is not None
+                and node_of(alloc_of(fake, v)) != "node-1")
+            cleanly_failed = sum(
+                1 for v in victims
+                if alloc_of(fake, v) is None
+                and cond_reason(fake, v) == "RecoveryDeadlineExceeded")
+            unconverged = len(victims) - replaced - cleanly_failed
+            violations += unconverged + len(ctrl.active_evictions())
+            extras.update({
+                "recovery_replaced": replaced,
+                "recovery_cleanly_failed": cleanly_failed,
+                "recovery_unconverged": unconverged,
+                "recovery_in_flight_after": len(
+                    ctrl.active_evictions()),
+                "recovery_gang_member_on_plugin": int(
+                    gang_survivor_evicted),
+            })
+
+            # Surviving-plugin audit: hand-plant one orphan, then ONE
+            # sweep must repair it AND drain every claim the eviction
+            # moved off this node -- zero leaks of any kind.
+            plugin._registry.create(SubSliceLiveTuple(
+                spec=SubSliceSpecTuple.from_canonical_name("ss-2x1-0"),
+                uuid="tpu-ss-bench-orphan"))
+            sweeper = NodeStateReconciler(
+                plugin, fake,
+                cleanup=CheckpointCleanupManager(plugin, fake),
+                metrics=metrics, node_name="node-0")
+            counts = sweeper.reconcile_once()
+            extras["recovery_orphan_repaired_one_sweep"] = int(
+                counts["carveout"] >= 1)
+            violations += int(counts["carveout"] < 1)
+            leaked_carveouts = len(plugin._registry.list())
+            leases_dir = os.path.join(root, "plugin", "leases")
+            live_records = set(plugin.prepared_claims())
+            leaked_leases = sum(
+                1 for f in os.listdir(leases_dir)
+                if f.endswith(".json")
+                and f[:-len(".json")] not in live_records
+            ) if os.path.isdir(leases_dir) else 0
+            leaked_specs = sum(
+                1 for uid in plugin._cdi.list_claim_uids()
+                if uid not in live_records)
+            stale_records = sum(
+                1 for uid, rec in plugin.prepared_claims().items()
+                if rec.state == ClaimState.PREPARE_COMPLETED.value
+                and converged(rec.name)
+                and alloc_of(fake, rec.name) is not None
+                and node_of(alloc_of(fake, rec.name)) != "node-0")
+            violations += (leaked_carveouts + leaked_leases
+                           + leaked_specs + stale_records)
+            extras.update({
+                "recovery_leaked_carveouts": leaked_carveouts,
+                "recovery_leaked_leases": leaked_leases,
+                "recovery_leaked_cdi_specs": leaked_specs,
+                "recovery_stale_plugin_records": stale_records,
+            })
+        finally:
+            sched.stop()
+
+    # -- scenario 2: plugin wipe + restart -----------------------------
+    with tempfile.TemporaryDirectory() as root:
+        fake = FakeKubeClient()
+        state = DeviceState(Config.mock(root=root, topology="v5e-4"))
+        for i in range(2):
+            obj = make_claim_dict(f"wipe-{i}", [f"chip-{i}"])
+            obj["metadata"]["name"] = f"wipe-{i}"
+            fake.create(*RES, "resourceclaims", obj,
+                        namespace="default")
+            state.prepare(make_claim(f"wipe-{i}", [f"chip-{i}"]))
+        # A third prepare dies mid-middle (the wipe moment).
+        faults.arm("segment:prep_devices", mode="crash", count=1)
+        try:
+            try:
+                state.prepare(make_claim("wipe-crash", ["chip-2"]))
+            except (InjectedCrash, RuntimeError):
+                pass
+        finally:
+            faults.reset()
+        # The claim for wipe-1 disappears while the plugin is down.
+        fake.delete(*RES, "resourceclaims", "wipe-1",
+                    namespace="default")
+        fresh = DeviceState(Config.mock(root=root, topology="v5e-4"))
+        sweeper = NodeStateReconciler(
+            fresh, fake,
+            cleanup=CheckpointCleanupManager(fresh, fake))
+        sweeper.reconcile_once()
+        counts2 = sweeper.reconcile_once()
+        consistent = (
+            set(fresh.prepared_claims()) == {"wipe-0"}
+            and fresh._registry.list() == {}
+            and sorted(fresh._cdi.list_claim_uids()) == ["wipe-0"]
+            and not any(counts2.values())
+        )
+        extras["recovery_wipe_restart_consistent"] = int(consistent)
+        violations += int(not consistent)
+
+    # -- scenario 3: controller crash mid-eviction ---------------------
+    with tempfile.TemporaryDirectory() as root:
+        fake = FakeKubeClient()
+        fake.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "tpu.dra.dev"},
+            "spec": {"selectors": [{"cel": {
+                "expression": 'device.driver == "tpu.dra.dev"'}}]},
+        })
+        for node in ("node-a", "node-b"):
+            fake.create("", "v1", "nodes", {
+                "metadata": {"name": node, "labels": {}},
+                "status": {"conditions": [
+                    {"type": "Ready", "status": "True"}]}})
+            publish_resource_slices(fake, node_slices(node))
+        fake.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "cc", "namespace": "default"},
+            "spec": {"devices": {"requests": [{
+                "name": "tpu",
+                "exactly": {"deviceClassName": "tpu.dra.dev"}}]}},
+        }, namespace="default")
+        sched = DraScheduler(fake)
+        ctrl_root = os.path.join(root, "ctrl")
+        ctrl = EvictionController(fake, ctrl_root,
+                                  notready_grace_s=0.0,
+                                  deadline_s=60.0)
+        sched.attach_recovery(ctrl)
+        sched.sync_once()
+        victim_node = alloc_of(fake, "cc")["nodeSelector"][
+            "nodeSelectorTerms"][0]["matchFields"][0]["values"][0]
+        fake.patch("", "v1", "nodes", victim_node,
+                   {"status": {"conditions": [
+                       {"type": "Ready", "status": "False"}]}})
+        crashed = False
+        faults.arm("recovery.dealloc", mode="crash", count=1)
+        try:
+            for _ in range(4):
+                try:
+                    ctrl.sync_once()
+                except InjectedCrash:
+                    crashed = True
+                    break
+        finally:
+            faults.reset()
+        resumed = EvictionController(fake, ctrl_root,
+                                     notready_grace_s=0.0,
+                                     deadline_s=60.0)
+        sched.attach_recovery(resumed)
+        for _ in range(6):
+            sched.sync_once()
+        alloc = alloc_of(fake, "cc")
+        ok = (crashed and alloc is not None
+              and alloc["nodeSelector"]["nodeSelectorTerms"][0][
+                  "matchFields"][0]["values"][0] != victim_node
+              and resumed.active_evictions() == {})
+        extras["recovery_controller_crash_resumed"] = int(ok)
+        violations += int(not ok)
+
+    victims_total = extras.get("recovery_victims", 0)
+    converged_total = (extras.get("recovery_replaced", 0)
+                       + extras.get("recovery_cleanly_failed", 0))
+    return {
+        "metric": "recovery_violations",
+        "value": violations,
+        "unit": "violations",
+        # 1.0 = every killed-node claim converged (the acceptance bar).
+        "vs_baseline": round(
+            converged_total / max(victims_total, 1), 3),
+        "extras": extras,
+    }
+
+
 def bench_lint_findings() -> dict:
     """Static-analysis finding counts (pkg/analysis linter) in the
     metrics-friendly shape BASELINE.md tracks across PRs: the bench/CI
@@ -1268,6 +1647,16 @@ def bench_lint_findings() -> dict:
         if n:
             out[f"lint_findings_{rule}"] = n
     return out
+
+
+def _write_recovery_json(result: dict) -> None:
+    out_path = os.environ.get(
+        "BENCH_RECOVERY_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_recovery.json"))
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
 
 
 def main() -> None:
@@ -1305,12 +1694,28 @@ def main() -> None:
         if not ok:
             sys.exit(1)
         return
+    if "--recovery" in sys.argv[1:]:
+        result = bench_recovery()
+        _write_recovery_json(result)
+        print(json.dumps(result))
+        # The CI gate (`make bench-recovery-smoke`): an unconverged
+        # claim or ANY leaked layer is a hard failure.
+        if result["value"] > 0:
+            sys.exit(1)
+        return
     if "--chaos" in sys.argv[1:]:
+        # The recovery scenarios ride the chaos run too (node-kill,
+        # plugin wipe+restart, mid-eviction controller crash), with
+        # their own trajectory file. Printed FIRST: the chaos result
+        # stays the last line (the smoke tests parse it there).
+        recovery = bench_recovery()
+        _write_recovery_json(recovery)
+        print(json.dumps(recovery))
         result = bench_chaos()
         print(json.dumps(result))
         # The CI gate (`make bench-chaos-smoke`): stuck claims or a
         # hung rendezvous are hard failures, not trajectory dips.
-        if result["value"] > 0:
+        if result["value"] > 0 or recovery["value"] > 0:
             sys.exit(1)
         return
     extras: dict = {}

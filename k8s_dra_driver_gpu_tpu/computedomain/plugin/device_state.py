@@ -484,10 +484,25 @@ class CDDeviceState:
         permanent leak that pins a daemon pod to a dead gang -- THAT
         is what a blown deadline must clean up, because no unprepare
         ever comes for a claim that never prepared.
-        Idempotent; safe to call for claims that never started."""
+        Idempotent; safe to call for claims that never started.
+
+        A COMPLETED record is never unwound: an aborted prepare by
+        definition never committed one, so a completed record here
+        means a prepare WON a race against this unwind (e.g. the
+        reconcile sweep snapshotting the spec-written-but-uncommitted
+        window of the single-phase prepare) -- destroying its spec and
+        record would hand the kubelet dead CDI ids. Teardown of
+        completed claims belongs to unprepare() alone."""
         with self._lock:
+            existing = self._checkpoint.get().claims.get(claim_uid)
+            if existing is not None and \
+                    existing.state == ClaimState.PREPARE_COMPLETED.value:
+                logger.warning(
+                    "unwind requested for COMPLETED claim %s; refusing "
+                    "(a live prepare owns this state)", claim_uid)
+                return
             self._cdi.delete_claim_spec_file(claim_uid)
-            if claim_uid in self._checkpoint.get().claims:
+            if existing is not None:
                 self._checkpoint.update(
                     lambda c: c.claims.pop(claim_uid, None)
                 )

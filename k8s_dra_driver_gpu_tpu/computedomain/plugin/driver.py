@@ -41,6 +41,7 @@ class CDDriver:
         metrics: DRARequestMetrics | None = None,
         retry_timeout: float = ERROR_RETRY_MAX_TIMEOUT_S,
         resilience=None,  # pkg.metrics.ResilienceMetrics | None
+        recovery_metrics=None,  # pkg.metrics.RecoveryMetrics | None
     ):
         self.state = state
         self.kube = kube
@@ -50,9 +51,19 @@ class CDDriver:
         self.resilience = resilience
         self.gang_aborts = 0  # lifetime rendezvous-deadline aborts
         self._gc_stop = None
+        # Cross-layer reconcile sweep (kubeletplugin/reconcile.py):
+        # stale CD claim records unprepare (dropping the daemon node
+        # label with the last channel), orphaned CD CDI specs unwind.
+        from ...kubeletplugin.reconcile import (  # noqa: PLC0415
+            CDStateReconciler,
+        )
+
+        self.reconciler = CDStateReconciler(
+            state, kube, metrics=recovery_metrics)
 
     def start_background(self) -> None:
-        """Periodic stale-domain-dir GC (computedomain.go:384)."""
+        """Periodic stale-domain-dir GC (computedomain.go:384) + the
+        cross-layer CD reconcile sweep."""
         import threading  # noqa: PLC0415
 
         self._gc_stop = threading.Event()
@@ -63,6 +74,10 @@ class CDDriver:
                     self.state.cleanup_stale_domain_dirs()
                 except Exception:  # noqa: BLE001
                     logger.exception("stale domain dir GC failed")
+                try:
+                    self.reconciler.reconcile_once()
+                except Exception:  # noqa: BLE001
+                    logger.exception("CD recovery sweep failed")
 
         threading.Thread(target=loop, name="cd-domain-gc",
                          daemon=True).start()

@@ -160,6 +160,21 @@ class CDIHandler:
                 self._spec_cache[claim_uid] = (sig, spec)
         return [qualified_device_id(d["name"]) for d in devices]
 
+    def list_claim_uids(self) -> list[str]:
+        """Claim uids with a transient spec file on disk -- the
+        reconcile sweep's CDI-layer inventory (orphan = a uid here with
+        no checkpoint record)."""
+        prefix = f"{CDI_VENDOR}-{CDI_CLASS}_"
+        try:
+            names = os.listdir(self._root)
+        except FileNotFoundError:
+            return []
+        return [
+            name[len(prefix):-len(".json")]
+            for name in names
+            if name.startswith(prefix) and name.endswith(".json")
+        ]
+
     def delete_claim_spec_file(self, claim_uid: str) -> None:
         with self._spec_cache_lock:
             self._spec_cache.pop(claim_uid, None)

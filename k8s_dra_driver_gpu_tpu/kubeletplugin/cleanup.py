@@ -25,6 +25,36 @@ DEFAULT_INTERVAL_S = positive_float_env(
     "TPU_DRA_CLEANUP_INTERVAL_S", default=600.0, floor=0.5)
 
 
+def lookup_claim(kube, uid: str, namespace: str, name: str
+                 ) -> tuple[str, dict | None]:
+    """Validate a checkpointed claim identity against the API server
+    (cheap Get, not List; cleanup.go:149-190). Returns one of:
+
+      ("live", obj)      the object exists with the SAME uid
+      ("gone", None)     deleted, or recreated under a new uid
+      ("unknown", None)  no identity recorded / apiserver unavailable
+                         -- callers must fail safe (keep state)
+
+    Shared by the stale-claim GC and both reconcile sweeps so the
+    staleness semantics can never drift apart."""
+    if not namespace or not name:
+        return "unknown", None
+    try:
+        obj = kube.get(
+            "resource.k8s.io", "v1", "resourceclaims",
+            name, namespace=namespace,
+        )
+    except NotFoundError:
+        return "gone", None
+    except Exception:  # noqa: BLE001 - apiserver unavailable: keep
+        logger.exception("claim staleness check failed for %s/%s (%s)",
+                         namespace, name, uid)
+        return "unknown", None
+    if obj.get("metadata", {}).get("uid") != uid:
+        return "gone", None
+    return "live", obj
+
+
 class CheckpointCleanupManager:
     def __init__(
         self,
@@ -48,11 +78,14 @@ class CheckpointCleanupManager:
         if self._thread.ident is not None:  # join only a started thread
             self._thread.join(timeout=2.0)
 
-    def cleanup_once(self) -> list[str]:
-        """Returns the claim UIDs unprepared this pass."""
+    def cleanup_once(self, lookups=None) -> list[str]:
+        """Returns the claim UIDs unprepared this pass. ``lookups``
+        optionally carries precomputed ``lookup_claim`` results keyed
+        by uid (the reconcile sweep shares one GET pass across its
+        consumers); absent entries fall back to a fresh Get."""
         removed = []
         for uid, claim in list(self._state.prepared_claims().items()):
-            if not self._is_stale(uid, claim):
+            if not self._is_stale(uid, claim, lookups):
                 continue
             logger.warning(
                 "unpreparing stale claim %s (%s/%s)",
@@ -65,24 +98,18 @@ class CheckpointCleanupManager:
                 logger.exception("stale-claim unprepare failed for %s", uid)
         return removed
 
-    def _is_stale(self, uid: str, claim) -> bool:
+    def _is_stale(self, uid: str, claim, lookups=None) -> bool:
         """A claim is stale when its API object is gone or has a
         different UID (deleted + recreated under the same name)."""
         if not claim.namespace or not claim.name:
             # No identity recorded (crashed before v2 fields landed):
             # only PrepareStarted leftovers are safe to reap.
             return claim.state == ClaimState.PREPARE_STARTED.value
-        try:
-            obj = self._kube.get(
-                "resource.k8s.io", "v1", "resourceclaims",
-                claim.name, namespace=claim.namespace,
-            )
-        except NotFoundError:
-            return True
-        except Exception:  # noqa: BLE001 - apiserver unavailable: keep
-            logger.exception("claim staleness check failed for %s", uid)
-            return False
-        return obj.get("metadata", {}).get("uid") != uid
+        hit = lookups.get(uid) if lookups else None
+        if hit is None:
+            hit = lookup_claim(self._kube, uid, claim.namespace,
+                               claim.name)
+        return hit[0] == "gone"
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):

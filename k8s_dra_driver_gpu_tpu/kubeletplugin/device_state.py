@@ -467,10 +467,19 @@ class DeviceState:
         referenced by any checkpointed claim (checkpoint is source of
         truth; device_state.go:388).
 
-        Deferred wholesale while a LIVE peer process's prepare is in
-        flight (upgrade handover): its just-created carve-out/rebind
-        has no claim record yet and would read as an orphan. True
-        orphans are swept on the next pass, once no handover is live."""
+        Deferred wholesale while ANY prepare is in flight -- a LIVE
+        peer process's (upgrade handover) or this process's own (the
+        periodic reconcile sweep runs concurrently with served
+        prepares): a mid-middle prepare has created its carve-out but
+        its durable record is still the live-less PrepareStarted
+        reservation, so the carve-out would read as an orphan. The
+        whole audit runs under ``self._lock`` with the in-flight check
+        LAST-WRITER-WINS safe: a prepare registers in ``_inflight``
+        inside the reservation section (under this same lock) BEFORE
+        it can create any carve-out, so an empty in-flight set under
+        the lock guarantees every registry entry seen here belongs to
+        a settled claim state. True orphans are swept on the next
+        pass, once nothing is in flight."""
         live_peers = self._live_foreign_reservations()
         if live_peers:
             logger.warning(
@@ -479,33 +488,43 @@ class DeviceState:
                 sorted(live_peers),
             )
             return 0
-        cp = self._checkpoint.get()
-        referenced = {
-            dev.live["uuid"]
-            for c in cp.claims.values()
-            for dev in c.devices
-            if dev.live and "uuid" in dev.live  # vfio lives carry no uuid
-        }
-        destroyed = 0
-        for uid in list(self._registry.list()):
-            if uid not in referenced:
-                self._registry.destroy(uid)
-                destroyed += 1
-        # Orphaned passthrough rebinds: a crash between configure() and
-        # the completed checkpoint leaves the chip on vfio-pci with no
-        # claim record; the vfio registry lets us rebind it back.
-        claimed_bdfs = {
-            dev.live["pciBdf"]
-            for c in cp.claims.values()
-            for dev in c.devices
-            if dev.live and dev.live.get("vfio")
-        }
-        if self._vfio.registry is not None:
-            for bdf in list(self._vfio.registry.list()):
-                if bdf not in claimed_bdfs:
-                    logger.warning("unbinding orphaned vfio rebind of %s", bdf)
-                    self._vfio.unconfigure(bdf)
+        with self._lock:
+            if self._inflight:
+                logger.info(
+                    "deferring unknown-state sweep: %d prepare/"
+                    "unprepare operation(s) in flight in this process",
+                    len(self._inflight),
+                )
+                return 0
+            cp = self._checkpoint.get()
+            referenced = {
+                dev.live["uuid"]
+                for c in cp.claims.values()
+                for dev in c.devices
+                if dev.live and "uuid" in dev.live  # vfio: no uuid
+            }
+            destroyed = 0
+            for uid in list(self._registry.list()):
+                if uid not in referenced:
+                    self._registry.destroy(uid)
                     destroyed += 1
+            # Orphaned passthrough rebinds: a crash between configure()
+            # and the completed checkpoint leaves the chip on vfio-pci
+            # with no claim record; the vfio registry lets us rebind it
+            # back.
+            claimed_bdfs = {
+                dev.live["pciBdf"]
+                for c in cp.claims.values()
+                for dev in c.devices
+                if dev.live and dev.live.get("vfio")
+            }
+            if self._vfio.registry is not None:
+                for bdf in list(self._vfio.registry.list()):
+                    if bdf not in claimed_bdfs:
+                        logger.warning(
+                            "unbinding orphaned vfio rebind of %s", bdf)
+                        self._vfio.unconfigure(bdf)
+                        destroyed += 1
         if destroyed:
             logger.warning(
                 "reconciled %d unknown sub-slice(s)/rebind(s)", destroyed

@@ -24,6 +24,7 @@ from .device_state import Config, DeviceState
 from .deviceinfo import DeviceKind
 from .health import ChipHealthMonitor, DeviceTaint
 from .partitions import consumed_counters, shared_counter_sets
+from .reconcile import NodeStateReconciler
 
 logger = logging.getLogger(__name__)
 
@@ -45,6 +46,7 @@ class Driver:
         publication_mode: str | None = None,
         additional_ignored_health_kinds: tuple[str, ...] = (),
         resilience=None,  # pkg.metrics.ResilienceMetrics | None
+        recovery_metrics=None,  # pkg.metrics.RecoveryMetrics | None
     ):
         self.state = DeviceState(config)
         self.kube = kube_client
@@ -86,6 +88,13 @@ class Driver:
             self._publish_recheck_s = 300.0
 
         self.cleanup = CheckpointCleanupManager(self.state, kube_client)
+        # Cross-layer reconcile sweep (kubeletplugin/reconcile.py):
+        # wraps the stale-claim GC and additionally repairs orphans in
+        # every node-local layer (CDI specs, carve-outs, leases) and
+        # re-declares failure for claims whose devices vanished.
+        self.reconciler = NodeStateReconciler(
+            self.state, kube_client, cleanup=self.cleanup,
+            metrics=recovery_metrics, node_name=node_name)
         self.health_monitor = None
         if enable_health_monitor:
             # The startup enumeration is the health baseline: a chip seen
@@ -111,12 +120,20 @@ class Driver:
                 on_quarantine = (
                     lambda device: resilience.quarantines.labels(
                         device).inc())
+            on_failed = None
+            if recovery_metrics is not None:
+                on_failed = (
+                    lambda device: recovery_metrics.permanent_failures
+                    .labels("device").inc())
+            from .health import QuarantineTracker  # noqa: PLC0415
+
             self.health_monitor = ChipHealthMonitor(
                 self.state._tpulib,
                 monitor_opts,
                 self._on_health_taints,
                 additional_ignored=additional_ignored_health_kinds,
-                on_quarantine=on_quarantine,
+                quarantine=QuarantineTracker(
+                    on_quarantine=on_quarantine, on_failed=on_failed),
             )
         else:
             # Health monitoring off: mark every chip observably
@@ -136,7 +153,11 @@ class Driver:
             }
 
     def start(self) -> None:
-        self.cleanup.start()
+        # The reconcile sweep subsumes the stale-claim GC (it calls
+        # cleanup_once() as its first pass), so only its thread runs;
+        # the cleanup manager survives as the sweep's collaborator and
+        # for callers driving cleanup_once() directly.
+        self.reconciler.start()
         if self.health_monitor:
             self.health_monitor.start()
         # Restart reconciliation may have respawned tenancy agents and
@@ -147,6 +168,7 @@ class Driver:
         self.publish_resources()
 
     def stop(self) -> None:
+        self.reconciler.stop()
         self.cleanup.stop()
         if self.health_monitor:
             self.health_monitor.stop()

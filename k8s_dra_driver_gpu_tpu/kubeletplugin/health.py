@@ -64,6 +64,16 @@ QUARANTINE_WINDOW_S = positive_float_env(
 QUARANTINE_HYSTERESIS_S = positive_float_env(
     "TPU_DRA_QUARANTINE_HYSTERESIS_S", default=600.0, floor=0.05)
 
+# Permanent-failure escalation (pkg/recovery.py consumes the taint): a
+# chip that earns quarantine this many SEPARATE times has proven the
+# hysteresis release wrong repeatedly -- it is hardware going bad, not
+# a transient. It escalates to a sticky `tpu.dra.dev/failed` NoExecute
+# taint that never releases (only a plugin restart after repair, or an
+# operator clearing the knob, brings the chip back).
+FAILED_KIND = "failed"
+QUARANTINE_FATAL_ESCALATIONS = int(positive_float_env(
+    "TPU_DRA_RECOVERY_FATAL_QUARANTINES", default=3, floor=1))
+
 
 @dataclass(frozen=True)
 class DeviceTaint:
@@ -104,10 +114,18 @@ class QuarantineTracker:
     State machine per device:
       healthy --(>= threshold non-fatal events inside window)--> quarantined
       quarantined --(clean for >= hysteresis)--> healthy
+      quarantined --(earned quarantine >= fatal_after times)--> FAILED
+
+    FAILED is terminal and sticky (``tpu.dra.dev/failed`` NoExecute):
+    a chip that keeps cycling healthy -> quarantined -> "healed" ->
+    quarantined has proven the hysteresis release wrong repeatedly --
+    that is hardware dying, and pkg/recovery.py escalates its claims
+    to PermanentFailure + eviction off the published taint.
 
     ``observe(taints)`` is called once per poll with the RAW taint list
-    and returns the quarantine taints to merge in. ``on_quarantine``
-    fires once per escalation (metrics hook)."""
+    and returns the quarantine + failure taints to merge in.
+    ``on_quarantine`` / ``on_failed`` fire once per escalation
+    (metrics hooks)."""
 
     def __init__(
         self,
@@ -115,12 +133,16 @@ class QuarantineTracker:
         window_s: float = QUARANTINE_WINDOW_S,
         hysteresis_s: float = QUARANTINE_HYSTERESIS_S,
         on_quarantine: Callable[[str], None] | None = None,
+        fatal_after: int = QUARANTINE_FATAL_ESCALATIONS,
+        on_failed: Callable[[str], None] | None = None,
         clock=time.monotonic,
     ):
         self.threshold = max(1, int(threshold))
         self.window_s = window_s
         self.hysteresis_s = hysteresis_s
         self.on_quarantine = on_quarantine
+        self.fatal_after = max(1, int(fatal_after))
+        self.on_failed = on_failed
         self._clock = clock
         # device -> recent healthy->sick TRANSITION timestamps
         # (window-pruned). Transitions, not per-poll presence: tpulib
@@ -135,11 +157,42 @@ class QuarantineTracker:
         # device -> timestamp of the LAST observed event while
         # quarantined (hysteresis restarts on every flap)
         self._quarantined: dict[str, float] = {}
+        # device -> how many SEPARATE times it earned quarantine; at
+        # fatal_after it escalates to the sticky failed set.
+        self._escalations: dict[str, int] = {}
+        self._failed: set[str] = set()
         self.total_quarantines = 0
+        self.total_failures = 0
 
     @property
     def quarantined(self) -> frozenset[str]:
         return frozenset(self._quarantined)
+
+    @property
+    def failed(self) -> frozenset[str]:
+        """Devices escalated to sticky permanent failure."""
+        return frozenset(self._failed)
+
+    def mark_failed(self, device: str) -> None:
+        """Declare a device permanently failed directly (the fatal-
+        event and reconcile-sweep escalation path: bypasses the
+        quarantine counting entirely)."""
+        if device in self._failed:
+            return
+        self._failed.add(device)
+        self._quarantined.pop(device, None)
+        self._events.pop(device, None)
+        self.total_failures += 1
+        logger.error(
+            "chip %s declared PERMANENTLY FAILED (sticky %s/%s "
+            "NoExecute taint; claims on it will be evicted)",
+            device, TAINT_KEY_PREFIX, FAILED_KIND,
+        )
+        if self.on_failed is not None:
+            try:
+                self.on_failed(device)
+            except Exception:  # noqa: BLE001 - metrics hook
+                logger.exception("failure hook failed")
 
     def observe(self, taints: list[DeviceTaint]) -> list[DeviceTaint]:
         now = self._clock()
@@ -147,8 +200,10 @@ class QuarantineTracker:
             t.device for t in taints
             # Non-fatal, non-quarantine signals only: fatal events carry
             # their own NoExecute taint, and our own degraded taint must
-            # not feed back into the event count.
-            if not t.effect and t.key != f"{TAINT_KEY_PREFIX}/{QUARANTINE_KIND}"
+            # not feed back into the event count. A permanently failed
+            # device is past all of this bookkeeping.
+            if not t.effect and t.device not in self._failed
+            and t.key != f"{TAINT_KEY_PREFIX}/{QUARANTINE_KIND}"
         }
         for device in flapping:
             if device in self._quarantined:
@@ -183,6 +238,13 @@ class QuarantineTracker:
                         self.on_quarantine(device)
                     except Exception:  # noqa: BLE001 - metrics hook
                         logger.exception("quarantine hook failed")
+                # A chip earning quarantine for the Nth time has blown
+                # through the hysteresis release N-1 times: escalate
+                # from quarantine to declared permanent failure.
+                n = self._escalations.get(device, 0) + 1
+                self._escalations[device] = n
+                if n >= self.fatal_after:
+                    self.mark_failed(device)
         # Hysteresis release: clean for the full period.
         for device, last_event in list(self._quarantined.items()):
             if device not in flapping and \
@@ -200,6 +262,16 @@ class QuarantineTracker:
                 effect="NoSchedule",
             )
             for device in sorted(self._quarantined)
+        ] + [
+            # Sticky: a failed chip stays NoExecute-tainted every poll
+            # until the plugin restarts after physical repair.
+            DeviceTaint(
+                device=device,
+                key=f"{TAINT_KEY_PREFIX}/{FAILED_KIND}",
+                value="true",
+                effect="NoExecute",
+            )
+            for device in sorted(self._failed)
         ]
 
 

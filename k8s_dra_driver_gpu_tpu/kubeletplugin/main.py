@@ -133,12 +133,17 @@ def run(argv: list[str] | None = None) -> int:
     node_name = args.node_name or os.uname().nodename
 
     metrics = DRARequestMetrics()
-    # Retry/breaker/quarantine counters share the request-metrics
-    # registry so one /metrics endpoint carries the whole story.
-    from ..pkg.metrics import ResilienceMetrics  # noqa: PLC0415
+    # Retry/breaker/quarantine + recovery-sweep counters share the
+    # request-metrics registry so one /metrics endpoint carries the
+    # whole story.
+    from ..pkg.metrics import (  # noqa: PLC0415
+        RecoveryMetrics,
+        ResilienceMetrics,
+    )
     from ..pkg.retry import RetryingKubeClient  # noqa: PLC0415
 
     resilience = ResilienceMetrics(registry=metrics.registry)
+    recovery_metrics = RecoveryMetrics(registry=metrics.registry)
     kube = RetryingKubeClient(
         FakeKubeClient() if args.standalone else KubeClient(
             host=args.kube_api or None
@@ -154,7 +159,8 @@ def run(argv: list[str] | None = None) -> int:
                     publication_mode=(None if args.publication_mode == "auto"
                                       else args.publication_mode),
                     additional_ignored_health_kinds=ignored,
-                    resilience=resilience)
+                    resilience=resilience,
+                    recovery_metrics=recovery_metrics)
 
     server = PluginServer(
         DRIVER_NAME,

@@ -1,0 +1,326 @@
+"""Node-side cross-layer reconciliation sweep (permanent-failure
+recovery, the plugin half of pkg/recovery.py).
+
+Four layers describe the same claims on a node and MUST agree:
+
+  1. the durable checkpoint (kubeletplugin/checkpoint.py),
+  2. the live kube API (the claims the scheduler believes exist),
+  3. the transient CDI spec files (kubeletplugin/cdi.py),
+  4. the hardware-truth artifacts: live sub-slice carve-outs,
+     vfio rebinds, and reservation pid-leases.
+
+Any single crash window (plugin death mid-prepare, a wiped state dir,
+a controller eviction racing a node restart) can leave exactly one
+layer ahead of or behind the others. The startup reconciliation
+(DeviceState.destroy_unknown_subslices, boot-ID invalidation) repairs
+what a RESTART can see; this sweep repairs the same divergences
+PERIODICALLY on a live plugin, in both directions:
+
+- artifacts whose claim is gone are destroyed (orphan carve-outs,
+  CDI specs, leases, stale checkpoint records -- reusing the stale-
+  claim GC), and
+- claims whose DEVICES are gone (a chip that fell off the host) are
+  re-declared failed on the kube API (PermanentFailure condition) so
+  the eviction controller migrates them off the broken hardware.
+
+The CD plugin gets the same treatment (``CDStateReconciler``): stale
+CD claim records unprepare (dropping the daemon node label when the
+last channel goes), and orphaned CD CDI specs unwind through
+``CDDeviceState.unwind_failed_prepare`` -- which also reclaims the
+node label of a ComputeDomain that no longer exists.
+
+Everything exports ``tpu_dra_recovery_*`` metrics
+(pkg/metrics.RecoveryMetrics): ``orphans_repaired_total`` by kind,
+and ``reconcile_drift`` -- the per-sweep divergence count that should
+read 0 on a healthy node.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+from ..pkg import positive_float_env
+from ..pkg.recovery import (
+    allocation_nodes,
+    set_permanent_failure_condition,
+)
+from .checkpoint import ClaimState
+from .cleanup import DEFAULT_INTERVAL_S as _CLEANUP_INTERVAL_S
+from .cleanup import lookup_claim
+
+logger = logging.getLogger(__name__)
+
+# The sweep subsumes the stale-claim GC (cleanup.py), so a tightened
+# TPU_DRA_CLEANUP_INTERVAL_S tightens the whole sweep too.
+SWEEP_INTERVAL_S = min(
+    positive_float_env("TPU_DRA_RECOVERY_SWEEP_S", default=120.0,
+                       floor=0.05),
+    _CLEANUP_INTERVAL_S,
+)
+
+
+class NodeStateReconciler:
+    """Periodic cross-layer audit for the chip kubelet plugin."""
+
+    def __init__(self, device_state, kube, cleanup=None, metrics=None,
+                 interval: float = SWEEP_INTERVAL_S,
+                 node_name: str | None = None):
+        self._state = device_state
+        self._kube = kube
+        self._cleanup = cleanup  # CheckpointCleanupManager | None
+        self._metrics = metrics  # pkg.metrics.RecoveryMetrics | None
+        self._interval = interval
+        # This node's identity (== its ResourceSlice pool name): the
+        # moved-claim sweep needs it to tell "re-placed elsewhere onto
+        # a same-named device" from "still allocated here". None =
+        # fall back to device-name matching only (direct-driven test
+        # states with no node identity).
+        self._node = node_name
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="recovery-sweep", daemon=True)
+        self.last_sweep: dict = {}
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001 - sweep must survive
+                logger.exception("recovery sweep failed")
+
+    # -- one sweep ------------------------------------------------------------
+
+    def reconcile_once(self) -> dict:
+        """One full audit; returns repaired/declared counts by kind.
+        Order matters: stale checkpoint records are unprepared FIRST
+        (their teardown removes the matching CDI spec / carve-out /
+        lease through the normal pipeline), so the later orphan passes
+        only see artifacts with genuinely no owning record. The live-
+        claim lookups are computed ONCE and shared by the stale GC and
+        both claim audits -- one GET per checkpointed claim per sweep,
+        not three."""
+        counts = {"stale_claim": 0, "moved_claim": 0, "cdi_spec": 0,
+                  "carveout": 0, "lease": 0, "devices_gone": 0}
+        lookups = {
+            uid: lookup_claim(self._kube, uid, rec.namespace, rec.name)
+            for uid, rec in self._state.prepared_claims().items()
+        }
+        if self._cleanup is not None:
+            counts["stale_claim"] = len(
+                self._cleanup.cleanup_once(lookups=lookups))
+        counts["moved_claim"] = self._sweep_moved_claims(
+            self._state.prepared_claims(), lookups)
+        counts["cdi_spec"] = self._sweep_cdi_specs()
+        counts["carveout"] = self._state.destroy_unknown_subslices()
+        counts["lease"] = self._sweep_leases()
+        counts["devices_gone"] = self._declare_gone_devices(
+            self._state.prepared_claims(), lookups)
+        self._observe(counts)
+        if any(counts.values()):
+            logger.warning("recovery sweep repaired/declared: %s",
+                           {k: v for k, v in counts.items() if v})
+        self.last_sweep = counts
+        return counts
+
+    def _lookup(self, lookups, uid, rec):
+        hit = lookups.get(uid)
+        if hit is None:
+            hit = lookup_claim(self._kube, uid, rec.namespace, rec.name)
+        return hit
+
+    def _sweep_moved_claims(self, claims, lookups) -> int:
+        """Completed records whose live claim no longer holds any of
+        this NODE's checkpointed devices -- deallocated by the eviction
+        controller, or re-placed onto another node. The plugin-side
+        completion of a drain: unprepare through the normal pipeline
+        (carve-outs destroyed, sharing released, CDI spec + record
+        dropped) exactly as a kubelet unprepare would.
+
+        Device names are node-local indices (chip-0 exists on every
+        node), so name overlap alone cannot prove the claim is still
+        ours: with a node identity configured, an allocation whose
+        nodeSelector POSITIVELY pins another node drains too. An
+        allocation with no node evidence at all is kept (fail-safe for
+        externally authored claims)."""
+        drained = 0
+        for uid, claim in list(claims.items()):
+            if claim.state != ClaimState.PREPARE_COMPLETED.value:
+                continue
+            status, obj = self._lookup(lookups, uid, claim)
+            if status != "live":
+                continue  # stale-claim GC owns gone; unknown = keep
+            if self._still_local(obj, claim):
+                continue
+            try:
+                self._state.unprepare(uid)
+            except Exception:  # noqa: BLE001 - sweep must survive
+                logger.exception("drain unprepare failed for moved "
+                                 "claim %s", uid)
+                continue
+            drained += 1
+            logger.warning(
+                "unprepared moved claim %s (%s/%s): its allocation no "
+                "longer references this node's devices", uid,
+                claim.namespace, claim.name)
+        return drained
+
+    def _still_local(self, obj: dict, claim) -> bool:
+        alloc = obj.get("status", {}).get("allocation") or {}
+        results = alloc.get("devices", {}).get("results", [])
+        held = {r.get("device", "") for r in results}
+        mine = {d.canonical_name for d in claim.devices}
+        if not held & mine:
+            return False  # deallocated, or holding other devices
+        if self._node is None:
+            return True  # no node identity: name match is all we have
+        nodes = allocation_nodes(obj)
+        if nodes and self._node not in nodes:
+            return False  # positively pinned to another node
+        return True  # pinned here, or no node evidence: fail safe
+
+    def _sweep_cdi_specs(self) -> int:
+        """CDI specs whose claim has no checkpoint record. The record
+        snapshot is taken AFTER the spec listing: a prepare commits its
+        PrepareStarted reservation before it writes the spec, so any
+        spec seen by the listing either has its record in the (later)
+        snapshot or is a true orphan (e.g. a crash between a rollback's
+        spec delete and its checkpoint commit, replayed in the other
+        order). A stale pre-listing snapshot would miss a prepare that
+        started mid-sweep and delete its LIVE spec."""
+        uids = self._state._cdi.list_claim_uids()
+        claims = self._state.prepared_claims()
+        repaired = 0
+        for uid in uids:
+            if uid not in claims:
+                self._state._cdi.delete_claim_spec_file(uid)
+                repaired += 1
+                logger.warning("destroyed orphan CDI spec for %s", uid)
+        return repaired
+
+    def _sweep_leases(self) -> int:
+        """Reservation leases with no checkpoint record and no LIVE
+        owner process. Runs under the node reservation flock: the
+        lease-then-record write order in prepare() happens entirely
+        inside that critical section, so holding it here means no
+        in-flight reservation can be sliced between our two reads."""
+        leases = self._state._leases
+        try:
+            names = os.listdir(leases._dir)
+        except FileNotFoundError:
+            return 0
+        repaired = 0
+        with self._state.pu_lock.acquire(timeout=10.0):
+            claims = self._state.prepared_claims()
+            for name in names:
+                if not name.endswith(".json"):
+                    continue
+                uid = name[:-len(".json")]
+                if uid in claims:
+                    continue
+                if self._state._foreign_owner_alive(uid):
+                    continue  # a peer's reservation section, mid-write
+                leases.clear(uid)
+                repaired += 1
+                logger.warning("cleared orphan reservation lease %s",
+                               uid)
+        return repaired
+
+    def _declare_gone_devices(self, claims, lookups) -> int:
+        """Claims whose checkpointed devices no longer exist on this
+        host (a chip fell out of enumeration): re-declare failure ON
+        THE CLAIM so the eviction controller migrates it -- the node
+        cannot repair missing hardware, only report it honestly."""
+        declared = 0
+        allocatable = self._state.allocatable
+        for uid, claim in claims.items():
+            if claim.state != ClaimState.PREPARE_COMPLETED.value:
+                continue
+            gone = [d.canonical_name for d in claim.devices
+                    if d.canonical_name not in allocatable]
+            if not gone:
+                continue
+            status, obj = self._lookup(lookups, uid, claim)
+            if status != "live":
+                continue  # gone: stale GC's case; unknown: next sweep
+            if set_permanent_failure_condition(
+                    self._kube, obj, "True", "DevicesGone",
+                    f"device(s) {sorted(gone)} no longer exist on this "
+                    "host; claim needs migration"):
+                declared += 1
+                if self._metrics is not None:
+                    self._metrics.permanent_failures.labels(
+                        "sweep").inc()
+                logger.error(
+                    "claim %s references vanished device(s) %s: "
+                    "declared PermanentFailure", uid, sorted(gone))
+        return declared
+
+    def _observe(self, counts: dict) -> None:
+        if self._metrics is None:
+            return
+        for kind in ("stale_claim", "moved_claim", "cdi_spec",
+                     "carveout", "lease"):
+            if counts[kind]:
+                self._metrics.orphans_repaired.labels(kind).inc(
+                    counts[kind])
+        for kind, n in counts.items():
+            self._metrics.reconcile_drift.labels(kind).set(n)
+
+
+class CDStateReconciler:
+    """The same audit for the compute-domain plugin's (single-phase)
+    state: stale claim records unprepare through the normal path, and
+    orphaned CDI specs unwind via ``unwind_failed_prepare`` -- which
+    also reclaims the daemon node label when the labeled ComputeDomain
+    is positively gone (a dissolved gang must not pin daemon pods)."""
+
+    def __init__(self, cd_state, kube, metrics=None):
+        self._state = cd_state
+        self._kube = kube
+        self._metrics = metrics
+        self.last_sweep: dict = {}
+
+    def reconcile_once(self) -> dict:
+        counts = {"cd_stale_claim": 0, "cd_cdi_spec": 0}
+        claims = self._state.prepared_claims()
+        for uid, rec in list(claims.items()):
+            if not self._claim_gone(uid, rec):
+                continue
+            try:
+                self._state.unprepare(uid)
+            except Exception:  # noqa: BLE001 - sweep must survive
+                logger.exception("stale CD claim unprepare failed "
+                                 "for %s", uid)
+                continue
+            counts["cd_stale_claim"] += 1
+            logger.warning("unprepared stale CD claim %s (%s/%s)",
+                           uid, rec.namespace, rec.name)
+        claims = self._state.prepared_claims()
+        for uid in self._state._cdi.list_claim_uids():
+            if uid in claims:
+                continue
+            self._state.unwind_failed_prepare(uid)
+            counts["cd_cdi_spec"] += 1
+            logger.warning("unwound orphan CD CDI spec for %s", uid)
+        if self._metrics is not None:
+            for kind, n in counts.items():
+                if n:
+                    self._metrics.orphans_repaired.labels(kind).inc(n)
+                self._metrics.reconcile_drift.labels(kind).set(n)
+        self.last_sweep = counts
+        return counts
+
+    def _claim_gone(self, uid: str, rec) -> bool:
+        status, _ = lookup_claim(self._kube, uid, rec.namespace,
+                                 rec.name)
+        return status == "gone"

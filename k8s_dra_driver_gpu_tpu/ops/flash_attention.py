@@ -37,13 +37,16 @@ DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                  causal: bool, sm_scale: float, kv_len: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *,
+                  block_k: int, causal: bool, sm_scale: float,
+                  kv_len: int):
     """One (batch*head, q-block) program instance.
 
     q_ref: [1, block_q, hd]; k_ref/v_ref: [1, S_padded, hd] (padded to a
     block_k multiple; kv_len is the true length); o_ref like q_ref;
-    lse_ref: [1, block_q, 1] logsumexp residual for the backward.
+    lse_ref: [1, block_q, 1] logsumexp residual for the backward --
+    absent on the forward-only (pure inference) variant, whose
+    pallas_call declares a single output and so passes no lse ref.
     """
     _, block_q, hd = q_ref.shape
     seq_len = k_ref.shape[1]
@@ -94,7 +97,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     o_acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (o_acc, m0, l0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (o_acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    if lse_ref is not None:
+        lse_ref[0] = m + jnp.log(l_safe)
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
@@ -239,9 +243,13 @@ def flash_attention(
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention_vjp(q, k, v, causal, block_q, block_k, interpret,
                          bwd_impl):
+    # Primal (never-differentiated) path: pallas_call outputs are not
+    # dead-code-eliminated, so the forward-only variant declares NO lse
+    # output -- pure-inference callers skip the [B*H, S_qpad, 1] fp32
+    # HBM write the vjp forward pays for its backward residual.
     out, _ = _flash_attention_fwd_impl(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, with_lse=False,
     )
     return out
 
@@ -349,8 +357,14 @@ def _flash_attention_fwd_impl(
     block_q: int,
     block_k: int,
     interpret: bool | None,
-) -> tuple[jax.Array, jax.Array]:
-    """Returns (out [B,S,H,hd], lse [B*H, S_qpad, 1] fp32)."""
+    with_lse: bool = True,
+) -> tuple[jax.Array, jax.Array | None]:
+    """Returns (out [B,S,H,hd], lse [B*H, S_qpad, 1] fp32).
+
+    ``with_lse=False`` is the forward-only variant: the pallas_call
+    declares a single output, so the kernel never materializes (nor
+    HBM-writes) the logsumexp residual only the backward needs. Same
+    kernel body, bit-identical ``out``."""
     from . import is_tpu_backend  # noqa: PLC0415
 
     B, S, H, hd = q.shape
@@ -389,25 +403,37 @@ def _flash_attention_fwd_impl(
     def lse_index(bh, qi):
         return (bh, qi, 0)
 
+    kernel = functools.partial(
+        _flash_kernel,
+        block_k=block_k,
+        causal=causal,
+        sm_scale=1.0 / (hd ** 0.5),
+        kv_len=S,
+    )
+    in_specs = [
+        pl.BlockSpec((1, block_q, hd), q_index),
+        pl.BlockSpec((1, S_kpad, hd), kv_index),
+        pl.BlockSpec((1, S_kpad, hd), kv_index),
+    ]
+    if not with_lse:
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, block_q, hd), q_index),
+            interpret=interpret,
+        )(qt, kt, vt)
+        return out.reshape(B, H, S, hd).transpose(0, 2, 1, 3), None
     out, lse = pl.pallas_call(
-        functools.partial(
-            _flash_kernel,
-            block_k=block_k,
-            causal=causal,
-            sm_scale=1.0 / (hd ** 0.5),
-            kv_len=S,
-        ),
+        kernel,
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
             jax.ShapeDtypeStruct((B * H, -(-S // block_q) * block_q, 1),
                                  jnp.float32),
         ],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, hd), q_index),
-            pl.BlockSpec((1, S_kpad, hd), kv_index),
-            pl.BlockSpec((1, S_kpad, hd), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, hd), q_index),
             pl.BlockSpec((1, block_q, 1), lse_index),

@@ -110,7 +110,12 @@ _WATCHED_RESOURCES = {
     "resourceslices", "deviceclasses", "resourceclaimtemplates",
     "computedomains",
 }
-_STATE_LITERALS = {"PrepareStarted", "PrepareCompleted"}
+_STATE_LITERALS = {"PrepareStarted", "PrepareCompleted",
+                   # Eviction lifecycle (pkg/recovery.py): raw literals
+                   # outside the declarative model bypass the eviction
+                   # TransitionPolicy exactly like raw claim states.
+                   "EvictionPlanned", "EvictionDraining",
+                   "EvictionDeallocated"}
 # Copy constructors that launder taint (deep or top-level).
 _COPY_CALLS = {"json_copy", "deepcopy", "dict", "list", "sorted",
                "json_loads"}

@@ -114,10 +114,47 @@ SINGLE_PHASE_POLICY = TransitionPolicy(
     }),
 )
 
+# -- eviction (permanent-failure recovery, pkg/recovery.py) -------------------
+#
+# The claim-eviction controller persists one record per in-flight
+# eviction through the same group-committed CheckpointManager the node
+# plugins use, so a controller crash mid-eviction resumes exactly where
+# the durable record says it stopped. States:
+#
+#   absent -> EvictionPlanned      (failure declared, move planned)
+#   EvictionPlanned -> EvictionDraining    (consumer pods evicted,
+#                                           reservations dropped)
+#   EvictionDraining -> EvictionDeallocated (allocation cleared; the
+#                                           incremental scheduler owns
+#                                           re-placement from here)
+#   <any> -> absent                (re-placed, claim gone, or cleanly
+#                                   failed at the recovery deadline)
+#
+# Skipping a stage (absent -> Draining, Planned -> Deallocated) would
+# mean a drain or deallocation ran without its durable intent record --
+# exactly the class of bug the runtime validator exists to catch.
+
+EVICTION_PLANNED = "EvictionPlanned"
+EVICTION_DRAINING = "EvictionDraining"
+EVICTION_DEALLOCATED = "EvictionDeallocated"
+
+EVICTION_POLICY = TransitionPolicy(
+    "eviction",
+    frozenset({
+        (ABSENT, EVICTION_PLANNED),               # failure declared
+        (EVICTION_PLANNED, EVICTION_DRAINING),    # pods evicted
+        (EVICTION_DRAINING, EVICTION_DEALLOCATED),  # allocation cleared
+        (EVICTION_PLANNED, ABSENT),               # canceled (claim gone)
+        (EVICTION_DRAINING, ABSENT),              # canceled (claim gone)
+        (EVICTION_DEALLOCATED, ABSENT),           # re-placed / failed
+    }),
+)
+
 #: Registry for the AST pass (lint TPUDRA007): modules constructing a
 #: CheckpointManager must pass transition_policy= explicitly -- one of
 #: these, or None with an inline-allow comment stating why.
 POLICIES = {
     "two-phase": TWO_PHASE_POLICY,
     "single-phase": SINGLE_PHASE_POLICY,
+    "eviction": EVICTION_POLICY,
 }

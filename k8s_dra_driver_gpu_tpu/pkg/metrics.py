@@ -162,6 +162,74 @@ class ResilienceMetrics:
         )
 
 
+class RecoveryMetrics:
+    """Permanent-failure recovery observability (pkg/recovery.py +
+    kubeletplugin/reconcile.py).
+
+    Two producers share this family: the scheduler-side eviction &
+    migration controller (evictions, replacements, deadline failures,
+    declared permanent failures) and the per-node reconciliation sweep
+    (orphans repaired, cross-layer drift). A healthy fleet shows
+    ``permanent_failures_total`` rising only with real hardware events,
+    every eviction paired with a ``replaced``/``failed`` retirement,
+    ``active_evictions`` returning to zero, and a sweep that finds
+    nothing (``reconcile_drift`` at 0) -- persistent drift means some
+    layer is leaking state faster than the sweep repairs it."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.permanent_failures = Counter(
+            "tpu_dra_recovery_permanent_failures_total",
+            "Claims declared permanently failed, by failure source "
+            "(node = NotReady past deadline / deleted; device = fatal "
+            "chip taint; gang = healthy companion of a failed gang "
+            "member; sweep = node sweep found devices gone).",
+            ["source"],
+            registry=self.registry,
+        )
+        self.evictions = Counter(
+            "tpu_dra_recovery_evictions_total",
+            "Claim evictions started by the recovery controller "
+            "(drain + deallocate of a permanently failed claim).",
+            registry=self.registry,
+        )
+        self.replaced = Counter(
+            "tpu_dra_recovery_replaced_total",
+            "Evicted claims that converged to a fresh allocation on "
+            "surviving capacity.",
+            registry=self.registry,
+        )
+        self.failed = Counter(
+            "tpu_dra_recovery_failed_total",
+            "Evicted claims that blew the per-claim recovery deadline "
+            "and were retired as cleanly Failed (no allocation, no "
+            "in-flight eviction record).",
+            registry=self.registry,
+        )
+        self.active_evictions = Gauge(
+            "tpu_dra_recovery_active_evictions",
+            "Eviction records currently in flight (bounded by "
+            "TPU_DRA_RECOVERY_MAX_CONCURRENT).",
+            registry=self.registry,
+        )
+        self.orphans_repaired = Counter(
+            "tpu_dra_recovery_orphans_repaired_total",
+            "Orphaned node-local artifacts repaired by the reconcile "
+            "sweep, by kind (carveout, cdi_spec, lease, stale_claim, "
+            "cd_stale_claim, cd_cdi_spec, slice).",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.reconcile_drift = Gauge(
+            "tpu_dra_recovery_reconcile_drift",
+            "Cross-layer divergences observed by the LAST reconcile "
+            "sweep, by kind (devices_gone counts claims whose "
+            "checkpointed devices no longer exist on the host).",
+            ["kind"],
+            registry=self.registry,
+        )
+
+
 class PlacementMetrics:
     """Topology-aware placement observability (pkg/topology).
 

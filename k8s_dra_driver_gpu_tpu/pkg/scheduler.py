@@ -154,6 +154,22 @@ class DraScheduler:
         # re-check when a claim changes, without scanning all pods.
         self._pods_of_claim: dict[tuple[str, str], set[str]] = {}
         self._claims_of_pod: dict[tuple[str, str], set[str]] = {}
+        # Permanent-failure recovery (pkg/recovery.EvictionController):
+        # attached controllers ride this scheduler's sync loop (node /
+        # slice / claim events + the safety resync) and veto allocation
+        # onto permanently failed nodes.
+        self.recovery = None
+
+    def attach_recovery(self, controller) -> "DraScheduler":
+        """Drive a pkg/recovery.EvictionController from this
+        scheduler's loop: its sync runs inside every full pass and on
+        node / slice / eviction-relevant claim dirty keys, its reads
+        come from this scheduler's informer-backed view (zero kube
+        lists per pass in event mode), and ``_try_allocate`` excludes
+        the nodes it has declared permanently failed."""
+        controller.view = self.view
+        self.recovery = controller
+        return self
 
     # -- claim generation (kcm resourceclaim controller) ----------------------
 
@@ -520,6 +536,13 @@ class DraScheduler:
         nodes = sorted(snap.by_node,
                        key=lambda n: (0 if not window or n in window
                                       else 1, load.get(n, 0), n))
+        if self.recovery is not None:
+            # Permanently failed nodes may still have slices published
+            # (a dead kubelet can't retract them): allocation must
+            # never re-place a claim onto them.
+            excluded = self.recovery.excluded_nodes()
+            if excluded:
+                nodes = [n for n in nodes if n not in excluded]
         if pinned_node is not None:
             nodes = [n for n in nodes if n == pinned_node]
         for node in nodes:
@@ -1196,6 +1219,7 @@ class DraScheduler:
     def sync_once(self):
         t0 = time.monotonic()
         self.view.begin_pass()
+        self._sync_recovery()
         self._sync_daemonsets()
         self._sync_jobs()
         self._generate_claims()
@@ -1205,6 +1229,19 @@ class DraScheduler:
         if self.sched_metrics is not None:
             self.sched_metrics.sync_seconds.labels("full").observe(
                 time.monotonic() - t0)
+
+    def _sync_recovery(self) -> None:
+        """One recovery-controller pass, ahead of allocation so the
+        failed-node exclusion and freshly deallocated claims are
+        visible to the SAME pass. InjectedCrash (a BaseException) sails
+        through on purpose -- the chaos suite's controller-death
+        scenarios depend on it."""
+        if self.recovery is None:
+            return
+        try:
+            self.recovery.sync_once()
+        except Exception:  # noqa: BLE001 - control loop
+            logger.exception("recovery sync failed")
 
     # -- event-driven incremental sync ----------------------------------------
 
@@ -1283,16 +1320,29 @@ class DraScheduler:
                 self._enqueue(("pending",))
             else:
                 self._enqueue(("claim", ns, name))
+            if self.recovery is not None and self.recovery.busy():
+                # Allocation changes advance IN-FLIGHT evictions
+                # (replaced claims retire; deleted claims cancel);
+                # ordinary claim churn with nothing in flight never
+                # pays a recovery pass. New victims only appear via
+                # node/slice failures, which enqueue unconditionally.
+                self._enqueue(("recovery",))
             for pod_name in self._dependent_pods(ns, name, obj):
                 self._enqueue(("pod", ns, pod_name))
         elif resource == "resourceslices":
             self._enqueue(("inventory",))
+            if self.recovery is not None:
+                # Fatal device taints arrive as slice writes.
+                self._enqueue(("recovery",))
         elif resource == "deviceclasses":
             self._enqueue(("pending",))
         elif resource == "computedomains":
             self._enqueue(("pending",))
         elif resource in ("daemonsets", "nodes"):
             self._enqueue(("daemonsets",))
+            if resource == "nodes" and self.recovery is not None:
+                # NotReady transitions / node deletion feed escalation.
+                self._enqueue(("recovery",))
         elif resource == "jobs":
             self._enqueue(("jobs",))
         elif resource == "resourceclaimtemplates":
@@ -1357,6 +1407,12 @@ class DraScheduler:
                 self._sync_daemonsets()
             elif kind == "jobs":
                 self._sync_jobs()
+            elif kind == "recovery":
+                self._sync_recovery()
+                # A recovery pass may have deallocated claims; give
+                # them their re-placement attempt without waiting for
+                # the safety resync.
+                self._retry_pending_claims()
             elif kind == "pods-rescan":
                 for pod in self._pods():
                     refs = pod.get("spec", {}).get("resourceClaims") or []
@@ -1499,6 +1555,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="serve /metrics (placement frag/compactness + "
                         "scheduler sync/dirty-queue) on this port; "
                         "0 = disabled [METRICS_PORT]")
+    p.add_argument("--recovery-root",
+                   default=os.environ.get("TPU_DRA_RECOVERY_ROOT", ""),
+                   help="state root for the permanent-failure "
+                        "eviction controller's durable eviction "
+                        "records; empty = recovery disabled "
+                        "[TPU_DRA_RECOVERY_ROOT]")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -1529,6 +1591,14 @@ def main(argv: list[str] | None = None) -> int:
                                             metrics=resilience),
                          default_node=args.default_node,
                          metrics=metrics, sched_metrics=sched_metrics)
+    if args.recovery_root:
+        from .metrics import RecoveryMetrics  # noqa: PLC0415
+        from .recovery import EvictionController  # noqa: PLC0415
+
+        recovery_metrics = (RecoveryMetrics(registry=metrics.registry)
+                            if metrics is not None else None)
+        sched.attach_recovery(EvictionController(
+            sched.kube, args.recovery_root, metrics=recovery_metrics))
     print("scheduler running", flush=True)
     try:
         if args.sched_mode == "events":

@@ -13,10 +13,16 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# Keep in sync with the Makefile bench-chaos-smoke target.
+# Keep in sync with the Makefile bench-chaos-smoke target. The
+# recovery scenarios ride --chaos too: shrunk scale, and the
+# trajectory write redirected off the committed BENCH_recovery.json.
 SMOKE_ENV = {
     "BENCH_CHAOS_ITERS": "3",
     "BENCH_CHAOS_ROUNDS": "8",
+    "BENCH_RECOVERY_NODES": "3",
+    "BENCH_RECOVERY_CLAIMS": "8",
+    "BENCH_RECOVERY_DEADLINE_S": "1.0",
+    "BENCH_RECOVERY_OUT": "/tmp/BENCH_recovery_chaos_smoke.json",
 }
 
 

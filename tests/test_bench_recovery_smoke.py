@@ -1,0 +1,63 @@
+"""Tier-1 recovery smoke: the `make bench-recovery-smoke` contract as
+a non-slow test. Runs bench.py --recovery at reduced scale and asserts
+the permanent-failure acceptance bar: every claim on the killed node
+converges (re-allocated on surviving capacity or cleanly Failed), zero
+leaked carve-outs/CDI specs/leases on the surviving plugin, the
+hand-planted orphan repaired in ONE sweep, plugin wipe+restart
+consistent, and a controller crash mid-eviction resumed idempotently
+-- plus the BENCH_recovery.json trajectory file actually written."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-recovery-smoke target.
+SMOKE_ENV = {
+    "BENCH_RECOVERY_NODES": "3",
+    "BENCH_RECOVERY_CLAIMS": "10",
+    "BENCH_RECOVERY_DEADLINE_S": "1.0",
+}
+
+
+def test_bench_recovery_smoke_converges_every_claim(tmp_path):
+    out_json = tmp_path / "BENCH_recovery.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--recovery"],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV,
+             "BENCH_RECOVERY_OUT": str(out_json)},
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "recovery_violations"
+    # THE acceptance bar: zero violations of any kind.
+    assert doc["value"] == 0
+    assert doc["vs_baseline"] == 1.0
+    extras = doc["extras"]
+
+    # The scenario actually exercised the machinery.
+    assert extras["recovery_victims"] > 0
+    assert extras["recovery_prepared_on_plugin"] > 0
+    assert extras["recovery_replaced"] + \
+        extras["recovery_cleanly_failed"] == extras["recovery_victims"]
+    assert extras["recovery_unconverged"] == 0
+    assert extras["recovery_in_flight_after"] == 0
+
+    # Zero leaks on the surviving plugin; orphan repaired in one sweep.
+    assert extras["recovery_leaked_carveouts"] == 0
+    assert extras["recovery_leaked_leases"] == 0
+    assert extras["recovery_leaked_cdi_specs"] == 0
+    assert extras["recovery_stale_plugin_records"] == 0
+    assert extras["recovery_orphan_repaired_one_sweep"] == 1
+
+    # The other two chaos scenarios.
+    assert extras["recovery_wipe_restart_consistent"] == 1
+    assert extras["recovery_controller_crash_resumed"] == 1
+
+    # The trajectory file landed.
+    recorded = json.loads(out_json.read_text())
+    assert recorded["metric"] == "recovery_violations"
+    assert recorded["value"] == 0

@@ -53,6 +53,46 @@ class TestFlashAttention:
                                    atol=2e-5, rtol=2e-5)
 
 
+class TestFlashForwardOnly:
+    """The primal (never-differentiated) path runs the forward-only
+    pallas_call variant: no lse output declared, so pure-inference
+    callers skip the [B*H, S_qpad, 1] fp32 HBM write. Numerics must be
+    IDENTICAL to the vjp forward (same kernel body)."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_fwd_only_matches_vjp_forward(self, causal):
+        from k8s_dra_driver_gpu_tpu.ops.flash_attention import (
+            _flash_attention_fwd_impl,
+        )
+
+        q, k, v = rand_qkv(jax.random.PRNGKey(7), S=200)
+        out_lean, lse = _flash_attention_fwd_impl(
+            q, k, v, causal=causal, block_q=64, block_k=64,
+            interpret=True, with_lse=False)
+        assert lse is None
+        out_full, lse_full = _flash_attention_fwd_impl(
+            q, k, v, causal=causal, block_q=64, block_k=64,
+            interpret=True, with_lse=True)
+        assert lse_full is not None
+        np.testing.assert_array_equal(np.asarray(out_lean),
+                                      np.asarray(out_full))
+
+    def test_primal_call_unchanged_and_still_differentiable(self):
+        # flash_attention() without a grad wrapper rides the forward-
+        # only variant; its values must match the reference, and the
+        # SAME entry point must still differentiate (the vjp pair keeps
+        # the lse-carrying forward).
+        q, k, v = rand_qkv(jax.random.PRNGKey(8), S=64)
+        ref = dot_product_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True,
+                              block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        g = jax.grad(lambda q_: jnp.sum(flash_attention(
+            q_, k, v, causal=True, block_q=32, block_k=32)))(q)
+        assert np.isfinite(np.asarray(g)).all()
+
+
 class TestFlashAttentionGrad:
     def test_gradients_match_einsum(self):
         # Training through the kernel: custom VJP must match the einsum
