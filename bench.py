@@ -61,6 +61,13 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return max(0.0, float(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
 ITERS = _env_int("BENCH_ITERS", 50)
 # One worker per chip: the DRA scheduler never double-allocates a
 # device, so workers churn DISJOINT claims; contention is on the node
@@ -927,6 +934,266 @@ def bench_sched_churn() -> dict:
     }
 
 
+class _LatencyKube:
+    """Simulated apiserver RTT for the scheduler's client: real control
+    planes pay a network round trip per verb, which is exactly the
+    latency N sync workers overlap. Reads (get) and writes (create/
+    update/patch/delete) sleep their configured RTT; list/watch pass
+    through untouched so informers stay cheap."""
+
+    def __init__(self, inner, read_s: float, write_s: float):
+        self._inner = inner
+        self._read_s = read_s
+        self._write_s = write_s
+
+    def get(self, *a, **kw):
+        if self._read_s:
+            time.sleep(self._read_s)
+        return self._inner.get(*a, **kw)
+
+    def _write(self, verb, *a, **kw):
+        if self._write_s:
+            time.sleep(self._write_s)
+        return getattr(self._inner, verb)(*a, **kw)
+
+    def create(self, *a, **kw):
+        return self._write("create", *a, **kw)
+
+    def update(self, *a, **kw):
+        return self._write("update", *a, **kw)
+
+    def patch(self, *a, **kw):
+        return self._write("patch", *a, **kw)
+
+    def delete(self, *a, **kw):
+        return self._write("delete", *a, **kw)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+def bench_sched_scale() -> dict:
+    """Scheduler scale-out mode (`bench.py --sched-scale`): a
+    1000-node x 5000-claim batch-heavy arrival trace (claims+pods land
+    in bursts) against the event-driven scheduler, run once with
+    ``workers=1`` (the serialized PR 5 drain) and once with
+    ``workers=N`` (sharded multi-worker draining + batched multi-claim
+    allocation), under a simulated apiserver RTT. Reports wall clock,
+    writes per converged claim, p50/p99 claim->allocation latency,
+    syncs/sec, and the multi-worker speedup; validates every claim
+    converged, every pod bound, and NO device double-allocated.
+
+    Knobs: BENCH_SCALE_NODES (1000), BENCH_SCALE_CLAIMS (5000),
+    BENCH_SCALE_CHIPS (8/node), BENCH_SCALE_BURST (250 claims/burst),
+    BENCH_SCALE_WORKERS (4), BENCH_SCALE_BATCH (16 = TPU_DRA_SCHED_BATCH),
+    BENCH_SCALE_RTT_READ_MS (1.0) / BENCH_SCALE_RTT_WRITE_MS (2.0),
+    BENCH_SCALE_PIN (0; 1 = deterministic node+chip pinning so the
+    workers=1 and workers=N runs must produce IDENTICAL allocations --
+    the smoke-gate equivalence mode).
+
+    Gates (exit nonzero when set): BENCH_SCALE_MAX_WRITES_PER_CLAIM,
+    BENCH_SCALE_MIN_SPEEDUP, BENCH_SCALE_MAX_P99_MS,
+    BENCH_SCALE_REQUIRE_IDENTICAL=1."""
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.metrics import SchedulerMetrics
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+    from k8s_dra_driver_gpu_tpu.pkg.sliceutil import (
+        publish_resource_slices,
+    )
+
+    nodes_n = _env_int("BENCH_SCALE_NODES", 1000)
+    claims_total = _env_int("BENCH_SCALE_CLAIMS", 5000)
+    chips = _env_int("BENCH_SCALE_CHIPS", 8)
+    burst = max(1, _env_int("BENCH_SCALE_BURST", 250))
+    workers_n = _env_int("BENCH_SCALE_WORKERS", 4)
+    batch = _env_int("BENCH_SCALE_BATCH", 16)
+    read_s = _env_float("BENCH_SCALE_RTT_READ_MS", 1.0) / 1000.0
+    write_s = _env_float("BENCH_SCALE_RTT_WRITE_MS", 2.0) / 1000.0
+    pin = os.environ.get("BENCH_SCALE_PIN", "0") == "1"
+    RES = ("resource.k8s.io", "v1")
+
+    def node_slices(i: int) -> list:
+        devices = [{
+            "name": f"chip-{j}",
+            "attributes": {"type": {"string": "tpu-chip"},
+                           "index": {"int": j}},
+        } for j in range(chips)]
+        return [{
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": f"node-{i}-tpu.dra.dev"},
+            "spec": {
+                "driver": "tpu.dra.dev", "nodeName": f"node-{i}",
+                "pool": {"name": f"node-{i}", "generation": 1,
+                         "resourceSliceCount": 1},
+                "devices": devices,
+            },
+        }]
+
+    def _sync_count(sm) -> int:
+        total = 0
+        for metric in sm.sync_seconds.collect():
+            for s in metric.samples:
+                if s.name.endswith("_count"):
+                    total += int(s.value)
+        return total
+
+    def run_scale(workers: int) -> dict:
+        fake = FakeKubeClient()
+        alloc_times: dict = {}
+        counted = _CountingKube(_LatencyKube(fake, read_s, write_s),
+                                alloc_times)
+        fake.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "tpu.dra.dev"},
+            "spec": {"selectors": [{"cel": {
+                "expression": 'device.driver == "tpu.dra.dev"'}}]},
+        })
+        for i in range(nodes_n):
+            publish_resource_slices(fake, node_slices(i))
+        sm = SchedulerMetrics()
+        sched = DraScheduler(counted, sched_metrics=sm,
+                             workers=workers, batch_max=batch)
+        sched.start_event_driven()
+        sched.drain(60)
+        create_times: dict = {}
+        t0 = time.perf_counter()
+        n_bursts = (claims_total + burst - 1) // burst
+        made = 0
+        for b in range(n_bursts):
+            want = min(burst, claims_total - made)
+            names = []
+            for k in range(want):
+                idx = made + k
+                name = f"s-{idx}"
+                names.append(name)
+                exactly: dict = {"deviceClassName": "tpu.dra.dev"}
+                pod: dict = {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"{name}-pod",
+                                 "namespace": "default"},
+                    "spec": {
+                        "containers": [{"name": "c"}],
+                        "resourceClaims": [{
+                            "name": "tpu", "resourceClaimName": name}],
+                    },
+                }
+                if pin:
+                    # Deterministic equivalence mode: the pod is born
+                    # bound and the selector pins the exact chip, so
+                    # every run (any worker count) must land the same
+                    # (node, chip) per claim.
+                    pod["spec"]["nodeName"] = f"node-{idx % nodes_n}"
+                    exactly["selectors"] = [{"cel": {"expression": (
+                        'device.attributes["tpu.dra.dev"].index == '
+                        f'{(idx // nodes_n) % chips}')}}]
+                fake.create("", "v1", "pods", pod, namespace="default")
+                fake.create(*RES, "resourceclaims", {
+                    "apiVersion": "resource.k8s.io/v1",
+                    "kind": "ResourceClaim",
+                    "metadata": {"name": name, "namespace": "default"},
+                    "spec": {"devices": {"requests": [{
+                        "name": "tpu", "exactly": exactly}]}},
+                }, namespace="default")
+                create_times[("default", name)] = time.perf_counter()
+            made += want
+            deadline = time.perf_counter() + 300.0
+            pending = set(("default", n) for n in names)
+            while pending and time.perf_counter() < deadline:
+                pending -= set(alloc_times)
+                if pending:
+                    time.sleep(0.005)
+        # Let binding settle too (pinned pods are born bound).
+        sched.drain(120)
+        elapsed = time.perf_counter() - t0
+        unbound = 0
+        if not pin:
+            deadline = time.perf_counter() + 120.0
+            while time.perf_counter() < deadline:
+                unbound = sum(
+                    1 for p in fake.objects("", "pods")
+                    if not p.get("spec", {}).get("nodeName"))
+                if unbound == 0:
+                    break
+                time.sleep(0.05)
+        sched.stop()
+        # Correctness audit: convergence + no device double-allocated.
+        allocations: dict = {}
+        double_allocated = 0
+        seen_devices: set = set()
+        converged = 0
+        for claim in fake.objects("resource.k8s.io", "resourceclaims"):
+            alloc = claim.get("status", {}).get("allocation")
+            name = claim["metadata"]["name"]
+            if not alloc:
+                allocations[name] = None
+                continue
+            converged += 1
+            keys = sorted(
+                (r["driver"], r["pool"], r["device"])
+                for r in alloc["devices"]["results"])
+            allocations[name] = keys
+            for key in keys:
+                if key in seen_devices:
+                    double_allocated += 1
+                seen_devices.add(key)
+        lats = sorted(
+            alloc_times[k] - create_times[k]
+            for k in alloc_times if k in create_times
+        )
+        syncs = _sync_count(sm)
+        return {
+            "workers": workers,
+            "writes": counted.writes,
+            "converged": converged,
+            "unconverged": claims_total - converged,
+            "unbound_pods": unbound,
+            "double_allocated": double_allocated,
+            "writes_per_claim": round(
+                counted.writes / max(converged, 1), 2),
+            "elapsed_s": round(elapsed, 3),
+            "syncs": syncs,
+            "syncs_per_sec": round(syncs / max(elapsed, 1e-9), 1),
+            "p50_ms": round(lats[len(lats) // 2] * 1000, 2)
+            if lats else None,
+            "p99_ms": round(lats[max(0, int(len(lats) * 0.99) - 1)]
+                            * 1000, 2) if lats else None,
+            "allocations": allocations,
+        }
+
+    single = run_scale(1)
+    multi = run_scale(workers_n)
+    speedup = single["elapsed_s"] / max(multi["elapsed_s"], 1e-9)
+    identical = single["allocations"] == multi["allocations"]
+    extras = {
+        "scale_nodes": nodes_n,
+        "scale_claims": claims_total,
+        "scale_chips_per_node": chips,
+        "scale_burst": burst,
+        "scale_batch": batch,
+        "scale_workers": workers_n,
+        "scale_rtt_read_ms": read_s * 1000,
+        "scale_rtt_write_ms": write_s * 1000,
+        "scale_pinned": pin,
+        "scale_speedup": round(speedup, 2),
+        "scale_identical_allocations": identical,
+    }
+    for r in (single, multi):
+        prefix = f"scale_w{r['workers']}"
+        for key, val in r.items():
+            if key in ("allocations", "workers"):
+                continue
+            extras[f"{prefix}_{key}"] = val
+    return {
+        "metric": "sched_scale_multiworker_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        # >1 = sharded multi-worker beats the serialized drain while
+        # staying write-frugal and correct.
+        "vs_baseline": round(speedup, 2),
+        "extras": extras,
+    }
+
+
 def bench_chaos() -> dict:
     """Chaos mode (`bench.py --chaos`): the claim-churn stress under a
     SEEDED fault schedule, plus the two gang-scale failure scenarios the
@@ -1659,20 +1926,122 @@ def _write_recovery_json(result: dict) -> None:
         f.write("\n")
 
 
+def _sched_json_path() -> str:
+    return os.environ.get(
+        "BENCH_SCHED_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_scheduler.json"))
+
+
+def _load_sched_json(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
 def main() -> None:
+    if "--profile" in sys.argv[1:]:
+        # Satellite: wrap ANY bench scenario in cProfile and emit the
+        # top-25 cumulative hotspots, so perf PRs start from data.
+        import cProfile  # noqa: PLC0415
+        import io  # noqa: PLC0415
+        import pstats  # noqa: PLC0415
+
+        sys.argv.remove("--profile")
+        out_path = os.environ.get(
+            "BENCH_PROFILE_OUT",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_profile.txt"))
+        prof = cProfile.Profile()
+        try:
+            prof.runcall(_dispatch)
+        finally:
+            buf = io.StringIO()
+            stats = pstats.Stats(prof, stream=buf)
+            stats.sort_stats("cumulative").print_stats(25)
+            with open(out_path, "w", encoding="utf-8") as f:
+                f.write(f"# bench.py {' '.join(sys.argv[1:])} -- top-25 "
+                        "cumulative hotspots (cProfile)\n")
+                f.write(buf.getvalue())
+            print(f"profile written: {out_path}", file=sys.stderr)
+        return
+    _dispatch()
+
+
+def _dispatch() -> None:
     if "--placement-sim" in sys.argv[1:]:
         print(json.dumps(bench_placement_sim()))
         return
+    if "--sched-scale" in sys.argv[1:]:
+        result = bench_sched_scale()
+        out_path = _sched_json_path()
+        doc = _load_sched_json(out_path)
+        if not doc:
+            doc = {"metric": "sched_kube_writes_per_converged_claim"}
+        # The scale run is a trajectory ENTRY in BENCH_scheduler.json,
+        # alongside (never clobbering) the churn result.
+        doc["scale"] = result
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(result))
+        ex = result["extras"]
+        wkey = "scale_w%d" % ex["scale_workers"]
+        ok = True
+        if ex["scale_w1_double_allocated"] or \
+                ex[wkey + "_double_allocated"]:
+            print("sched-scale gate failed: device double-allocated",
+                  file=sys.stderr)
+            ok = False
+        if ex["scale_w1_unconverged"] or ex[wkey + "_unconverged"]:
+            print("sched-scale gate failed: unconverged claims",
+                  file=sys.stderr)
+            ok = False
+
+        def _ceiling(env: str, key: str) -> bool:
+            try:
+                cap = float(os.environ.get(env, "0"))
+            except ValueError:
+                cap = 0.0
+            actual = ex[key]
+            if cap and actual is not None and actual > cap:
+                print(f"sched-scale gate failed: {key}={actual} > "
+                      f"{env}={cap}", file=sys.stderr)
+                return False
+            return True
+
+        ok = _ceiling("BENCH_SCALE_MAX_WRITES_PER_CLAIM",
+                      wkey + "_writes_per_claim") and ok
+        ok = _ceiling("BENCH_SCALE_MAX_P99_MS", wkey + "_p99_ms") and ok
+        try:
+            floor = float(os.environ.get("BENCH_SCALE_MIN_SPEEDUP", "0"))
+        except ValueError:
+            floor = 0.0
+        if floor and ex["scale_speedup"] < floor:
+            print(f"sched-scale gate failed: speedup="
+                  f"{ex['scale_speedup']} < {floor}", file=sys.stderr)
+            ok = False
+        if os.environ.get("BENCH_SCALE_REQUIRE_IDENTICAL") == "1" and \
+                not ex["scale_identical_allocations"]:
+            print("sched-scale gate failed: multi-worker allocations "
+                  "differ from workers=1", file=sys.stderr)
+            ok = False
+        if not ok:
+            sys.exit(1)
+        return
     if "--sched-churn" in sys.argv[1:]:
         result = bench_sched_churn()
-        out_path = os.environ.get(
-            "BENCH_SCHED_OUT",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "BENCH_scheduler.json"))
+        out_path = _sched_json_path()
+        prior = _load_sched_json(out_path)
+        if prior.get("scale"):
+            result = {**result, "scale": prior["scale"]}
         with open(out_path, "w", encoding="utf-8") as f:
             json.dump(result, f, indent=1, sort_keys=True)
             f.write("\n")
-        print(json.dumps(result))
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "scale"}))
         # CI gate (`make bench-sched-smoke`): the write-amp ratio is
         # deterministic (counted writes), the convergence ratio is a
         # timing measurement -- both gates opt-in via env.

@@ -78,6 +78,10 @@ RULES: dict[str, str] = {
     "TPUDRA009": "scheduler sync path lists a watched resource via the "
                  "raw kube client instead of the informer-backed "
                  "ClusterView/snapshot (pkg/schedcache)",
+    "TPUDRA010": "blocking kube I/O while holding the scheduler "
+                 "registry lock (_state_lock) or the allocation-state "
+                 "lock; commit I/O is sanctioned under per-node locks "
+                 "only (sharded-allocation hierarchy)",
 }
 
 # Lock model (docs/architecture.md "Locking hierarchy"). Matched on the
@@ -85,6 +89,15 @@ RULES: dict[str, str] = {
 _LEVEL_RESERVATION = 1
 _LEVEL_SHARD = 2
 _LEVEL_CHECKPOINT = 3
+# Scheduler sharded-allocation hierarchy (docs/architecture.md
+# "Sharded allocation locking"): per-node locks (outermost, commit I/O
+# sanctioned) -> registry _state_lock (brief bookkeeping) ->
+# AllocationState._alloc_lock (innermost, pure state). Distinct level
+# band so the prepare-pipeline model never cross-talks.
+_LEVEL_SCHED_NODE = 11
+_LEVEL_SCHED_STATE = 12
+_LEVEL_SCHED_ALLOC = 13
+_SCHED_LOCK_FAMILIES = ("sched_state", "sched_alloc")
 
 _KUBE_VERBS = {"get", "list", "patch", "create", "delete", "update",
                "watch"}
@@ -102,6 +115,9 @@ _RAW_KUBECLIENT_FILES = {"kubeclient.py", "retry.py"}
 # TPUDRA009 scope: the scheduler's sync paths (the ClusterView in
 # schedcache.py is the sanctioned listing layer and is out of scope).
 _SCHED_SYNC_FILES = {"scheduler.py"}
+# TPUDRA010 / sched-lock-hierarchy scope: the modules that define and
+# use the sharded-allocation locks.
+_SCHED_LOCK_FILES = {"scheduler.py", "schedcache.py"}
 # Resources the scheduler watches (mirror of
 # pkg/schedcache.WATCHED_RESOURCES, kept literal so the linter has no
 # runtime import of the code under analysis).
@@ -502,14 +518,30 @@ class _ModuleLinter(ast.NodeVisitor):
 
     def _classify_acquisition(self, expr: ast.AST):
         """(family, level, key) when ``expr`` acquires a lock:
-        ``X.acquire(...)`` (flock-like: guard-returning) or
-        ``X.hold(...)`` (sharded locks)."""
+        ``X.acquire(...)`` (flock-like: guard-returning), ``X.hold(...)``
+        (sharded chip locks / scheduler node locks), or -- inside the
+        scheduler modules -- a bare ``with self._state_lock`` /
+        ``with self._alloc_lock`` mutex context."""
+        if isinstance(expr, (ast.Attribute, ast.Name)):
+            # Plain `with <lock>:` contexts only participate in the
+            # scheduler lock model (the prepare pipeline's locks are
+            # all acquire()/hold() shaped).
+            if self.basename in _SCHED_LOCK_FILES:
+                src = _unparse(expr)
+                if src.endswith("_state_lock"):
+                    return ("sched_state", _LEVEL_SCHED_STATE, src)
+                if src.endswith("_alloc_lock"):
+                    return ("sched_alloc", _LEVEL_SCHED_ALLOC, src)
+            return None
         if not (isinstance(expr, ast.Call)
                 and isinstance(expr.func, ast.Attribute)):
             return None
         attr = expr.func.attr
         base = expr.func.value
         base_src = _unparse(base)
+        if attr == "hold" and "node_locks" in base_src and \
+                self.basename in _SCHED_LOCK_FILES:
+            return ("sched_node", _LEVEL_SCHED_NODE, base_src)
         if attr == "hold" and "shard" in base_src:
             return ("shard", _LEVEL_SHARD, base_src)
         if attr == "acquire":
@@ -523,12 +555,14 @@ class _ModuleLinter(ast.NodeVisitor):
         held_levels = [h.level for h in self.held if h.level is not None]
         if level is not None and held_levels and level < max(held_levels):
             inner = max(self.held, key=lambda h: h.level or 0)
+            order_doc = ("node locks -> _state_lock -> _alloc_lock"
+                         if level >= _LEVEL_SCHED_NODE
+                         else "reservation -> shard -> checkpoint")
             self._emit(
                 "TPUDRA001", node,
                 f"acquires level-{level} lock {key!r} while holding "
                 f"level-{inner.level} lock {inner.key!r} (line "
-                f"{inner.line}); documented order is reservation -> "
-                "shard -> checkpoint",
+                f"{inner.line}); documented order is {order_doc}",
                 key=f"{inner.key}>{key}",
             )
         if family == "flock":
@@ -672,6 +706,30 @@ class _ModuleLinter(ast.NodeVisitor):
                         f"{holder.family} lock {holder.key!r} (held "
                         f"since line {holder.line})",
                         key=f"{holder.key}:{blocking}",
+                    )
+
+            # TPUDRA010: kube I/O under the scheduler registry /
+            # allocation-state locks. These must stay brief bookkeeping
+            # sections so disjoint allocations commit in parallel --
+            # commit I/O belongs under the per-node locks (which are
+            # deliberately NOT in this check's scope).
+            if any(h.family in _SCHED_LOCK_FAMILIES for h in self.held):
+                chain = _attr_chain(func)
+                is_kube = (attr in _KUBE_VERBS and chain[:-1]
+                           and chain[-2] == "kube")
+                is_sleep = chain == ["time", "sleep"]
+                if is_kube or is_sleep:
+                    holder = next(h for h in self.held
+                                  if h.family in _SCHED_LOCK_FAMILIES)
+                    what = f"{base_src}.{attr}" if is_kube else \
+                        "time.sleep"
+                    self._emit(
+                        "TPUDRA010", node,
+                        f"blocking call {what}(...) while holding "
+                        f"scheduler lock {holder.key!r} (held since "
+                        f"line {holder.line}); move the I/O outside or "
+                        "under the per-node locks",
+                        key=f"{holder.key}:{what}",
                     )
 
             # TPUDRA008 (second half): a kube verb on a raw (unwrapped)

@@ -269,6 +269,70 @@ class PlacementMetrics:
         )
 
 
+class WorkQueueMetrics:
+    """Keyed-workqueue observability (pkg/workqueue), the metrics sink
+    the queue calls through its duck-typed ``metrics`` hook.
+
+    ``shard`` labels carry the worker index that owns the shard (the
+    queue routes every key's shard to exactly one worker), so a single
+    hot shard shows up as one deep gauge while its siblings sit at
+    zero. ``wait_seconds`` measures enqueue-to-run latency INCLUDING
+    any retry or hot-key backoff the item waited out -- a healthy
+    scheduler queue stays in the low-millisecond buckets.
+    ``hot_backoff_total`` counts fairness escalations: a key re-dirtied
+    in a tight loop being throttled so cold keys on its worker keep
+    draining."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.depth = Gauge(
+            "tpu_dra_workqueue_depth",
+            "Keys queued per workqueue shard (worker index).",
+            ["shard"],
+            registry=self.registry,
+        )
+        self.wait = Histogram(
+            "tpu_dra_workqueue_wait_seconds",
+            "Queue latency from enqueue to callback start, including "
+            "retry/hot-key backoff.",
+            buckets=_BUCKETS,
+            registry=self.registry,
+        )
+        self.retries = Counter(
+            "tpu_dra_workqueue_retries_total",
+            "Callbacks re-enqueued with backoff after an error.",
+            registry=self.registry,
+        )
+        self.drops = Counter(
+            "tpu_dra_workqueue_drops_total",
+            "Keys dropped (PermanentError or retry budget exhausted).",
+            registry=self.registry,
+        )
+        self.hot_backoffs = Counter(
+            "tpu_dra_workqueue_hot_backoff_total",
+            "Fairness escalations applied to keys re-dirtied in a "
+            "tight loop (pkg/workqueue hot-key damping).",
+            registry=self.registry,
+        )
+
+    # -- the duck-typed sink pkg/workqueue calls ------------------------------
+
+    def set_depth(self, shard: str, n: int) -> None:
+        self.depth.labels(shard).set(n)
+
+    def observe_wait(self, seconds: float) -> None:
+        self.wait.observe(max(seconds, 0.0))
+
+    def inc_retry(self) -> None:
+        self.retries.inc()
+
+    def inc_drop(self) -> None:
+        self.drops.inc()
+
+    def inc_hot_backoff(self) -> None:
+        self.hot_backoffs.inc()
+
+
 class SchedulerMetrics:
     """Event-driven scheduler observability (pkg/scheduler +
     pkg/schedcache + pkg/informer).
@@ -314,6 +378,24 @@ class SchedulerMetrics:
             ["resource"],
             registry=self.registry,
         )
+        self.snapshot_build = Histogram(
+            "tpu_dra_sched_snapshot_build_seconds",
+            "Wall time to (re)build the indexed inventory snapshot "
+            "from the published ResourceSlices (pkg/schedcache); one "
+            "sample per actual rebuild, cache hits cost nothing.",
+            buckets=_BUCKETS,
+            registry=self.registry,
+        )
+        self.commit_conflicts = Counter(
+            "tpu_dra_sched_commit_conflicts_total",
+            "Optimistic allocation commits rejected at reserve time "
+            "(another worker took a device/counter between fit and "
+            "commit); each conflict re-fits against fresh state.",
+            registry=self.registry,
+        )
+        # Per-shard queue depth / wait / retry observability for the
+        # scheduler's sharded sync queue (pkg/workqueue).
+        self.workqueue = WorkQueueMetrics(registry=self.registry)
 
 
 class ComputeDomainMetrics:

@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
+from contextlib import contextmanager
 from typing import Callable
 
 from .cel import CelProgram, Quantity, compile_expression
@@ -246,6 +248,45 @@ class InventorySnapshot:
         return hit
 
 
+class NodeLockManager:
+    """Per-node allocation locks for the sharded scheduler: disjoint
+    nodes commit in parallel, same-node contenders serialize, and a
+    gang claim spanning several hosts takes its whole lock set in one
+    ordered acquisition (sorted node names) so two gangs overlapping on
+    any node can never deadlock. Sits ABOVE the scheduler registry lock
+    and the allocation-state lock in the documented hierarchy
+    (docs/architecture.md "Sharded allocation locking"); commit kube
+    I/O is sanctioned under node locks only."""
+
+    def __init__(self):
+        self._locks: dict[str, threading.Lock] = {}
+        self._mu = threading.Lock()
+
+    def _lock_for(self, node: str) -> threading.Lock:
+        with self._mu:
+            lock = self._locks.get(node)
+            if lock is None:
+                lock = self._locks[node] = threading.Lock()
+            return lock
+
+    @contextmanager
+    def hold(self, nodes):
+        """Acquire the locks for ``nodes`` in sorted order (the
+        deadlock-freedom invariant the interleaving explorer and lint
+        rule TPUDRA001 check)."""
+        ordered = sorted(set(nodes))
+        held = []
+        try:
+            for node in ordered:
+                lock = self._lock_for(node)
+                lock.acquire()
+                held.append(lock)
+            yield
+        finally:
+            for lock in reversed(held):
+                lock.release()
+
+
 class AllocationState:
     """Allocated-device keys + debited counter budgets, incrementally
     maintained from ResourceClaim allocations.
@@ -254,13 +295,25 @@ class AllocationState:
     namespace/name): replaying the same allocation -- e.g. the watch
     event for a patch the scheduler itself just wrote -- is a no-op,
     and a changed allocation releases the previous devices first.
+
+    Thread safety (scheduler scale-out): every mutation happens under
+    the internal ``_alloc_lock`` so informer event threads and N sync
+    workers can share one state. ``try_commit`` is the atomic
+    check-and-reserve the optimistic commit-then-observe protocol pins
+    on: a fit computed against (possibly stale) reads either reserves
+    its devices atomically or reports a conflict for a re-fit, so two
+    workers can never double-allocate a device or over-spend a counter
+    budget. ``node_load`` is maintained incrementally so the per-claim
+    node ordering no longer scans the whole allocated set.
     """
 
     def __init__(self, snapshot: InventorySnapshot):
         self.snapshot = snapshot
         self.ledger = snapshot.make_ledger()
         self.allocated: set[tuple] = set()
+        self.node_load: dict[str, int] = {}
         self._claims: dict[str, frozenset] = {}
+        self._alloc_lock = threading.Lock()
 
     @staticmethod
     def claim_id(claim: dict) -> str:
@@ -277,49 +330,194 @@ class AllocationState:
         )
 
     def rebuild(self, claims: list[dict]) -> None:
-        self.ledger = self.snapshot.make_ledger()
-        self.allocated = set()
-        self._claims = {}
-        for claim in claims:
-            self.observe(claim)
+        with self._alloc_lock:
+            self.ledger = self.snapshot.make_ledger()
+            self.allocated = set()
+            self.node_load = {}
+            self._claims = {}
+            for claim in claims:
+                self._observe_locked(claim)
 
     def observe(self, claim: dict) -> bool:
         """Fold one claim's current allocation in. Returns True when
         the state changed."""
+        with self._alloc_lock:
+            return self._observe_locked(claim)
+
+    def _observe_locked(self, claim: dict) -> bool:
         cid = self.claim_id(claim)
         keys = self._alloc_keys(claim)
         old = self._claims.get(cid, frozenset())
         if keys == old:
             return False
-        self._release(old)
+        self._release_locked(old)
+        self._apply_locked(cid, keys)
+        return True
+
+    def _apply_locked(self, cid: str, keys: frozenset) -> None:
         for key in keys:
             self.allocated.add(key)
             cand = self.snapshot.by_key.get(key)
             if cand is not None:
                 self.ledger.debit(cand.driver, cand.pool,
                                   cand.device.get("consumesCounters"))
+                self.node_load[cand.node] = \
+                    self.node_load.get(cand.node, 0) + 1
         if keys:
             self._claims[cid] = keys
         else:
             self._claims.pop(cid, None)
-        return True
 
     def forget(self, claim: dict) -> bool:
         """Drop a deleted claim; its devices return to the free pool."""
-        cid = self.claim_id(claim)
-        old = self._claims.pop(cid, None)
-        if not old:
-            return False
-        self._release(old)
-        return True
+        with self._alloc_lock:
+            cid = self.claim_id(claim)
+            old = self._claims.pop(cid, None)
+            if not old:
+                return False
+            self._release_locked(old)
+            return True
 
-    def _release(self, keys: frozenset) -> None:
+    def try_commit(self, claim: dict) -> bool:
+        """Atomically reserve one claim's planned allocation: every
+        device key must still be free and every counter budget must
+        still fit, judged and applied under one lock. Returns False on
+        conflict (the caller re-fits against fresh state); replaying a
+        claim's own reservation returns True (idempotent). A reserve
+        whose kube patch subsequently fails is undone via ``forget``,
+        so a failed write never leaks a debit (commit-then-observe)."""
+        cid = self.claim_id(claim)
+        keys = self._alloc_keys(claim)
+        with self._alloc_lock:
+            prior = self._claims.get(cid)
+            if prior == keys:
+                return True  # idempotent replay of our own reservation
+            if prior is not None:
+                # The claim was freshly read as unallocated, so a prior
+                # entry is stale (a deallocated claim's ghost from the
+                # commit-log replay): release it and re-judge. Callers
+                # serialize per claim (shard affinity), so this can
+                # never steal another worker's in-flight reservation.
+                self._release_locked(prior)
+                self._claims.pop(cid, None)
+            debited: list[Candidate] = []
+            ok = True
+            for key in keys:
+                if key in self.allocated:
+                    ok = False
+                    break
+                cand = self.snapshot.by_key.get(key)
+                if cand is None:
+                    continue
+                consumes = cand.device.get("consumesCounters")
+                if consumes and not self.ledger.fits(
+                        cand.driver, cand.pool, consumes):
+                    ok = False
+                    break
+                # Debit as we go so multi-device claims can't pass N
+                # individual fits that overspend one shared counter.
+                self.ledger.debit(cand.driver, cand.pool, consumes)
+                debited.append(cand)
+            if not ok:
+                for cand in debited:
+                    self.ledger.credit(cand.driver, cand.pool,
+                                       cand.device.get("consumesCounters"))
+                return False
+            for cand in debited:
+                # _apply_locked re-debits; restore balance first.
+                self.ledger.credit(cand.driver, cand.pool,
+                                   cand.device.get("consumesCounters"))
+            self._apply_locked(cid, keys)
+            return True
+
+    def ledger_snapshot(self) -> "CounterLedger":
+        """Consistent copy of the counter ledger for a lock-free fit."""
+        with self._alloc_lock:
+            copy = CounterLedger()
+            copy._avail = {k: dict(v) for k, v in self.ledger._avail.items()}
+            return copy
+
+    def load_view(self) -> dict[str, int]:
+        """Consistent copy of the per-node allocated-device counts."""
+        with self._alloc_lock:
+            return dict(self.node_load)
+
+    def _release_locked(self, keys: frozenset) -> None:
         for key in keys:
             self.allocated.discard(key)
             cand = self.snapshot.by_key.get(key)
             if cand is not None:
                 self.ledger.credit(cand.driver, cand.pool,
                                    cand.device.get("consumesCounters"))
+                left = self.node_load.get(cand.node, 0) - 1
+                if left > 0:
+                    self.node_load[cand.node] = left
+                else:
+                    self.node_load.pop(cand.node, None)
+
+
+# Objects (claims / pods) opt into a scheduling domain with this
+# annotation; unannotated objects belong to the default domain.
+DOMAIN_ANNOTATION = "resource.tpu.dra/domain"
+
+
+class SchedulingDomain:
+    """A partitioned scheduling domain (scheduler-per-pool sharding).
+
+    Operators scale the control plane horizontally by running one
+    scheduler instance per domain: each instance leader-elects on its
+    own per-domain Lease (``lease_name``), restricts its inventory
+    snapshot to the pools matching ``pools`` (exact names or
+    ``fnmatch`` globs), and consumes only the dirty keys of claims /
+    pods annotated ``resource.tpu.dra/domain: <name>``. Exactly one
+    domain should be ``default=True`` (or one scheduler run with no
+    domain at all): it owns unannotated objects plus the cluster-wide
+    controllers (DaemonSet/Job sync, recovery), which must not run in
+    every domain."""
+
+    def __init__(self, name: str, pools=(), default: bool = False):
+        self.name = name
+        self.pools = [p for p in pools if p]
+        self.default = default
+
+    @property
+    def lease_name(self) -> str:
+        return f"tpu-dra-scheduler-{self.name}"
+
+    def owns_pool(self, pool: str, node: str) -> bool:
+        """POOL names only (node-local pools are named after their
+        node, so that already covers the common case); matching node
+        names too would let one slice silently satisfy two domains'
+        globs and overlap their snapshots -- nothing validates domain
+        disjointness, so the contract stays narrow."""
+        if not self.pools:
+            return True
+        from fnmatch import fnmatch  # noqa: PLC0415
+
+        return any(fnmatch(pool, pat) for pat in self.pools)
+
+    def owns_object(self, obj: dict) -> bool:
+        """Claim/pod routing: the domain annotation wins; unannotated
+        objects belong to the default domain."""
+        ann = (obj.get("metadata", {}).get("annotations") or {}).get(
+            DOMAIN_ANNOTATION, "")
+        if ann:
+            return ann == self.name
+        return self.default
+
+    @classmethod
+    def from_env(cls, env=None) -> "SchedulingDomain | None":
+        import os  # noqa: PLC0415
+
+        env = env if env is not None else os.environ
+        name = env.get("TPU_DRA_SCHED_DOMAIN", "")
+        if not name:
+            return None
+        pools = [p.strip() for p in env.get(
+            "TPU_DRA_SCHED_DOMAIN_POOLS", "").split(",") if p.strip()]
+        default = env.get("TPU_DRA_SCHED_DOMAIN_DEFAULT", "") in (
+            "1", "true", "True")
+        return cls(name, pools=pools, default=default)
 
 
 # (group, version, resource, kind) for every resource the scheduler's
@@ -357,15 +555,31 @@ class ClusterView:
     def __init__(self, kube, on_event: Callable | None = None,
                  on_relist: Callable[[str], None] | None = None,
                  resync_period: float = 300.0,
-                 default_node: str | None = None):
+                 default_node: str | None = None,
+                 pool_filter: Callable[[str, str], bool] | None = None,
+                 on_snapshot_build: Callable[[float], None] | None = None):
         self.kube = kube
         self._on_event = on_event
         self._on_relist = on_relist
         self._resync_period = resync_period
         self._default_node = default_node
+        # Scheduling-domain partitioning: pool_filter(pool, node) False
+        # makes a slice invisible to this scheduler's snapshot (the
+        # per-pool domain sharding surface).
+        self._pool_filter = pool_filter
+        self._on_snapshot_build = on_snapshot_build
         self._informers: dict[str, Informer] = {}
         self._snapshot: InventorySnapshot | None = None
         self._snapshot_lock = threading.Lock()
+        # Bumped on EVERY slice event/invalidation; snapshot() rereads
+        # until its listing is provably not older than the latest bump,
+        # so a rebuild racing an event-thread generation bump can never
+        # install (and serve to a commit) a stale-generation snapshot.
+        # In event mode it also powers the O(1) snapshot fast path: a
+        # cached snapshot built at the current generation is returned
+        # without relisting or recomputing the signature.
+        self._slice_gen = 0
+        self._snapshot_gen = -1
         self._cd_windows: dict[str, list[str]] | None = None
         self._started = False
 
@@ -415,6 +629,12 @@ class ClusterView:
                         obj: dict) -> None:
         if resource == "computedomains":
             self._cd_windows = None
+        elif resource == "resourceslices":
+            # The informer applied the change to its cache BEFORE
+            # firing this hook, so any slice listing taken after this
+            # bump observes it.
+            with self._snapshot_lock:
+                self._slice_gen += 1
 
     # -- per-pass bookkeeping -------------------------------------------------
 
@@ -453,6 +673,15 @@ class ClusterView:
     def device_classes(self) -> list[dict]:
         return self._list(*RESOURCE, "deviceclasses")
 
+    def get_pod(self, name: str, namespace: str = "default") -> dict:
+        inf = self._informers.get("pods")
+        if inf is not None:
+            obj = inf.get(name, namespace)
+            if obj is None:
+                raise NotFoundError(f"pods/{name}")
+            return obj
+        return self.kube.get("", "v1", "pods", name, namespace=namespace)
+
     def get_claim(self, name: str, namespace: str = "default") -> dict:
         inf = self._informers.get("resourceclaims")
         if inf is not None:
@@ -475,20 +704,79 @@ class ClusterView:
 
     # -- indexed snapshot -----------------------------------------------------
 
+    def _filtered_slices(self) -> list[dict]:
+        slices = self.slices()
+        if self._pool_filter is None:
+            return slices
+        return [
+            s for s in slices
+            if self._pool_filter(
+                s.get("spec", {}).get("pool", {}).get("name", ""),
+                s.get("spec", {}).get("nodeName", ""))
+        ]
+
+    # Bounded retries for the list-vs-event race below: a cluster
+    # churning slices faster than we can list is pathological; after
+    # this many laps the freshest listing we have wins (still at least
+    # as new as every bump observed before the first lap).
+    _SNAPSHOT_RACE_RETRIES = 10
+
     def snapshot(self) -> InventorySnapshot:
         """The current inventory snapshot, rebuilt only when any slice
-        changed (tracked via (name, resourceVersion, generation))."""
-        slices = self.slices()
+        changed (tracked via (name, resourceVersion, generation)).
+
+        Rebuilds are race-checked against ``_slice_gen``: a worker
+        whose listing predates a concurrent slice event (generation
+        bump) re-lists instead of installing -- and handing a commit --
+        a stale-generation snapshot that could clobber a newer one.
+
+        Event mode gets an O(1) fast path off the same counter: slice
+        events are the only thing that can change the listing, so a
+        snapshot built at the current generation is returned without
+        relisting or recomputing the O(slices) signature -- at 1000
+        nodes that check used to dominate every allocation batch."""
+        if self._started:
+            with self._snapshot_lock:
+                if self._snapshot is not None and \
+                        self._snapshot_gen == self._slice_gen:
+                    return self._snapshot
+        for _ in range(self._SNAPSHOT_RACE_RETRIES):
+            with self._snapshot_lock:
+                gen0 = self._slice_gen
+            slices = self._filtered_slices()
+            sig = InventorySnapshot.signature_of(slices)
+            with self._snapshot_lock:
+                if self._snapshot is not None and \
+                        self._snapshot.signature == sig:
+                    # The listing provably covers every event up to
+                    # gen0 (read before the list); never stamp newer.
+                    self._snapshot_gen = max(self._snapshot_gen, gen0)
+                    return self._snapshot
+                if self._slice_gen != gen0:
+                    continue  # raced a slice event: our listing may be stale
+                t0 = time.monotonic()
+                self._snapshot = InventorySnapshot(
+                    slices, signature=sig,
+                    default_node=self._default_node)
+                self._snapshot_gen = gen0
+                snap = self._snapshot
+            if self._on_snapshot_build is not None:
+                self._on_snapshot_build(time.monotonic() - t0)
+            return snap
+        # Persistent churn: accept the freshest listing we can get
+        # (and force the next call to re-verify).
+        slices = self._filtered_slices()
         sig = InventorySnapshot.signature_of(slices)
         with self._snapshot_lock:
             if self._snapshot is None or self._snapshot.signature != sig:
                 self._snapshot = InventorySnapshot(
-                    slices, signature=sig,
-                    default_node=self._default_node)
+                    slices, signature=sig, default_node=self._default_node)
+            self._snapshot_gen = -1
             return self._snapshot
 
     def invalidate_snapshot(self) -> None:
         with self._snapshot_lock:
+            self._slice_gen += 1
             self._snapshot = None
 
     # -- ComputeDomain windows ------------------------------------------------

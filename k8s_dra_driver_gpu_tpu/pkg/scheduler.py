@@ -58,12 +58,15 @@ from .featuregates import (
 )
 from .kubeclient import ConflictError, KubeError, NotFoundError
 from .schedcache import (
+    DOMAIN_ANNOTATION,
     AllocationState,
     Candidate as _Candidate,
     ClusterView,
     CompiledSelectors as _CompiledSelectors,
     CounterLedger as _CounterLedger,
     InventorySnapshot,
+    NodeLockManager,
+    SchedulingDomain,
     tolerates as _tolerates,
 )
 from .topology import TorusGrid, largest_free_shape
@@ -79,6 +82,31 @@ RESOURCE = ("resource.k8s.io", "v1")
 # carry the steady state, this only catches watch gaps and software
 # bugs. Override with TPU_DRA_SCHED_RESYNC (seconds).
 DEFAULT_RESYNC_S = 30.0
+
+# Sync-queue worker count (event mode). 1 = the historical serialized
+# drain; N > 1 shards claim/pod keys over N-1 data workers plus one
+# dedicated control-key worker (full resync, inventory, recovery --
+# which therefore can never starve behind a claim flood). Override
+# with --sched-workers / TPU_DRA_SCHED_WORKERS.
+DEFAULT_SCHED_WORKERS = 1
+
+# Max dirty claim keys drained against ONE inventory snapshot /
+# device-class read (amortizes snapshot signature checks and static-CEL
+# memo warmup across a burst). Override with TPU_DRA_SCHED_BATCH.
+DEFAULT_SCHED_BATCH = 8
+
+# Dirty-key kinds handled by the dedicated control worker (shard 0).
+_CTL_KINDS = frozenset((
+    "full", "pending", "inventory", "daemonsets", "jobs", "recovery",
+    "pods-rescan",
+))
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 def _meta(obj):
@@ -100,12 +128,31 @@ class DraScheduler:
 
     def __init__(self, kube, default_node: str | None = None,
                  gates: FeatureGates | None = None, metrics=None,
-                 sched_metrics=None, resync_period: float | None = None):
+                 sched_metrics=None, resync_period: float | None = None,
+                 workers: int | None = None, batch_max: int | None = None,
+                 domain: SchedulingDomain | None = None):
         self.kube = kube
         self.default_node = default_node
         self._selectors = _CompiledSelectors()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        if workers is None:
+            workers = _env_int("TPU_DRA_SCHED_WORKERS",
+                               DEFAULT_SCHED_WORKERS)
+        self.sched_workers = max(1, workers)
+        if batch_max is None:
+            batch_max = _env_int("TPU_DRA_SCHED_BATCH",
+                                 DEFAULT_SCHED_BATCH)
+        self.batch_max = max(1, batch_max)
+        # Partitioned scheduling domain (scheduler-per-pool sharding):
+        # None = this instance owns everything (the historical shape).
+        self.domain = domain if domain is not None \
+            else SchedulingDomain.from_env()
+        # Cluster-wide controllers (DaemonSet/Job sync, recovery) run
+        # in exactly one domain; non-default domain instances only
+        # allocate/bind their own claims and pods.
+        self._cluster_controllers = (self.domain is None
+                                     or self.domain.default)
         if gates is None:
             try:
                 gates = FeatureGates.from_env()
@@ -130,15 +177,31 @@ class DraScheduler:
         self.resync_period = resync_period
         # All reads in sync paths go through the view (lint TPUDRA009):
         # informer caches in event mode, list-through in direct mode.
-        self.view = ClusterView(kube, on_event=self._on_informer_event,
-                                on_relist=self._on_informer_relist,
-                                default_node=default_node)
+        self.view = ClusterView(
+            kube, on_event=self._on_informer_event,
+            on_relist=self._on_informer_relist,
+            default_node=default_node,
+            pool_filter=(self.domain.owns_pool
+                         if self.domain is not None and self.domain.pools
+                         else None),
+            on_snapshot_build=self._on_snapshot_build)
         # Inventory snapshot + incrementally-maintained allocation
         # state; rebuilt whenever the snapshot changes and on every
         # full pass (the safety property of the resync).
         self._snap: InventorySnapshot | None = None
         self._alloc: AllocationState | None = None
+        # Registry lock: guards the snapshot/alloc-state IDENTITY, the
+        # commit log, and the pod<->claim indexes. Held briefly only --
+        # never across kube I/O or a fit (lint TPUDRA010). Fine-grained
+        # allocation safety lives in the per-node locks + the
+        # AllocationState's atomic try_commit instead, so disjoint
+        # allocations commit in parallel. Documented hierarchy:
+        # node locks -> _state_lock -> AllocationState._alloc_lock.
         self._state_lock = threading.RLock()
+        # Per-node allocation locks: same-node contenders serialize,
+        # gang/CD-window claims take their window as one sorted lock
+        # set, commit kube I/O is sanctioned under these only.
+        self._node_locks = NodeLockManager()
         # Allocations THIS scheduler committed recently, replayed into
         # every rebuilt AllocationState: with a real apiserver the
         # informer cache can lag our own allocation patch, and a
@@ -165,11 +228,43 @@ class DraScheduler:
         scheduler's loop: its sync runs inside every full pass and on
         node / slice / eviction-relevant claim dirty keys, its reads
         come from this scheduler's informer-backed view (zero kube
-        lists per pass in event mode), and ``_try_allocate`` excludes
-        the nodes it has declared permanently failed."""
+        lists per pass in event mode), and allocation
+        (``_candidate_nodes``) excludes the nodes it has declared
+        permanently failed."""
         controller.view = self.view
         self.recovery = controller
         return self
+
+    # -- sharding plumbing ----------------------------------------------------
+
+    @property
+    def _sharded(self) -> bool:
+        """Multi-worker event mode: per-object work (claim allocation,
+        pod generation/binding) must run on its key's shard, so full
+        passes fan out dirty keys instead of doing that work inline."""
+        return self._queue is not None and self.sched_workers > 1
+
+    def _shard_of(self, key: tuple):
+        """Control keys pin to worker 0 (the recovery/resync lane,
+        immune to claim floods); claim/pod keys hash namespace/name
+        over the remaining workers."""
+        kind = key[0]
+        if kind in _CTL_KINDS or self.sched_workers == 1:
+            return 0
+        from .workqueue import stable_shard_hash  # noqa: PLC0415
+
+        h = stable_shard_hash(f"{key[1]}/{key[2]}" if len(key) >= 3
+                              else kind)
+        return 1 + h % (self.sched_workers - 1)
+
+    def _on_snapshot_build(self, seconds: float) -> None:
+        if self.sched_metrics is not None:
+            self.sched_metrics.snapshot_build.observe(seconds)
+
+    def _owns(self, obj: dict) -> bool:
+        """Domain routing for claims and pods; domainless schedulers
+        own everything."""
+        return self.domain is None or self.domain.owns_object(obj)
 
     # -- claim generation (kcm resourceclaim controller) ----------------------
 
@@ -181,11 +276,21 @@ class DraScheduler:
 
     def _generate_claims(self):
         for pod in self._pods():
+            if not self._owns(pod):
+                continue
             refs = pod.get("spec", {}).get("resourceClaims") or []
             have = {s["name"] for s in pod.get("status", {}).get(
                 "resourceClaimStatuses") or []}
             if not any(r.get("resourceClaimTemplateName")
                        and r["name"] not in have for r in refs):
+                continue
+            if self._sharded:
+                # Per-pod work belongs to the pod's shard: two workers
+                # generating for one pod would double-create the
+                # uuid-suffixed claims.
+                self._enqueue(("pod", _meta(pod).get("namespace",
+                                                     "default"),
+                               _meta(pod)["name"]))
                 continue
             if self.view.event_driven:
                 # Generated claim names carry a uuid suffix, so a
@@ -220,6 +325,15 @@ class DraScheduler:
                 continue  # template not applied yet; retry next pass
             claim_name = (f"{_meta(pod)['name']}-{ref['name']}-"
                           f"{uuid.uuid4().hex[:5]}")
+            annotations = {
+                "resource.kubernetes.io/pod-claim-name": ref["name"],
+            }
+            # Generated claims inherit the pod's scheduling domain so
+            # the owning domain scheduler allocates them.
+            pod_domain = (_meta(pod).get("annotations") or {}).get(
+                DOMAIN_ANNOTATION)
+            if pod_domain:
+                annotations[DOMAIN_ANNOTATION] = pod_domain
             claim = {
                 "apiVersion": "resource.k8s.io/v1",
                 "kind": "ResourceClaim",
@@ -227,10 +341,7 @@ class DraScheduler:
                     "name": claim_name,
                     "namespace": ns,
                     "uid": f"claim-{uuid.uuid4().hex[:12]}",
-                    "annotations": {
-                        "resource.kubernetes.io/pod-claim-name":
-                            ref["name"],
-                    },
+                    "annotations": annotations,
                     "ownerReferences": [{
                         "apiVersion": "v1", "kind": "Pod",
                         "name": _meta(pod)["name"],
@@ -273,7 +384,31 @@ class DraScheduler:
         if not by_resource:
             return
         for pod in self._pods():
+            if not self._owns(pod):
+                continue
+            if self._sharded:
+                if self._pod_wants_extended_claim(pod, by_resource):
+                    self._enqueue(("pod",
+                                   _meta(pod).get("namespace", "default"),
+                                   _meta(pod)["name"]))
+                continue
             self._generate_extended_resource_claims_for(pod, by_resource)
+
+    @staticmethod
+    def _pod_wants_extended_claim(pod, by_resource) -> bool:
+        """Cheap pre-filter for the sharded fan-out: would
+        _generate_extended_resource_claims_for even consider this pod?"""
+        if pod.get("status", {}).get("extendedResourceClaimStatus"):
+            return False
+        if pod.get("spec", {}).get("nodeName"):
+            return False
+        if pod.get("status", {}).get("phase") not in (None, "", "Pending"):
+            return False
+        return any(
+            rname in by_resource
+            for c in pod.get("spec", {}).get("containers", [])
+            for rname in ((c.get("resources") or {}).get("limits") or {})
+        )
 
     def _generate_extended_resource_claims_for(self, pod,
                                                by_resource) -> bool:
@@ -346,6 +481,13 @@ class DraScheduler:
         pod_uid = _meta(pod).get("uid", "") or _meta(pod)["name"]
         claim_name = (f"{_meta(pod)['name']}-extended-resources-"
                       f"{pod_uid[-5:]}")
+        annotations = {}
+        # Like template-generated claims: inherit the pod's scheduling
+        # domain so the owning domain scheduler allocates it.
+        pod_domain = (_meta(pod).get("annotations") or {}).get(
+            DOMAIN_ANNOTATION)
+        if pod_domain:
+            annotations[DOMAIN_ANNOTATION] = pod_domain
         claim = {
             "apiVersion": "resource.k8s.io/v1",
             "kind": "ResourceClaim",
@@ -353,6 +495,7 @@ class DraScheduler:
                 "name": claim_name,
                 "namespace": ns,
                 "uid": f"claim-{uuid.uuid4().hex[:12]}",
+                "annotations": annotations,
                 "ownerReferences": [{
                     "apiVersion": "v1", "kind": "Pod",
                     "name": _meta(pod)["name"],
@@ -441,19 +584,24 @@ class DraScheduler:
         is the list the rebuild used.
 
         In direct mode that list is a FRESH kube list, so an entry for
-        an absent claim means the claim was deleted -- drop it (its
-        devices are free again). In event mode the cache may lag our
-        own claim's create, so absent entries survive until the
-        DELETED event (which retires them) or the TTL."""
+        an absent claim means the claim was deleted -- and an entry
+        for a PRESENT claim with no allocation means it was
+        deallocated (e.g. the recovery controller's drain): drop both
+        (their devices are free again). In event mode the cache may
+        lag our own claim's create, so entries survive until the
+        claim's allocation-bearing event retires them or the TTL."""
         now = time.monotonic()
         present = {(c.get("metadata", {}).get("namespace", "default"),
-                    c.get("metadata", {}).get("name", ""))
+                    c.get("metadata", {}).get("name", "")): c
                    for c in claims}
         authoritative = not self.view.event_driven
         for key in list(self._commit_log):
             t, claim_like = self._commit_log[key]
-            if now - t > self.COMMIT_LOG_TTL_S or (
-                    authoritative and key not in present):
+            live = present.get(key)
+            stale = authoritative and (
+                live is None
+                or not live.get("status", {}).get("allocation"))
+            if now - t > self.COMMIT_LOG_TTL_S or stale:
                 del self._commit_log[key]
             else:
                 self._alloc.observe(claim_like)
@@ -462,9 +610,11 @@ class DraScheduler:
                                            AllocationState]:
         """Current snapshot + allocation state; a snapshot rebuild
         (any slice write / pool-generation bump) rebuilds the
-        allocation state from the claim set."""
+        allocation state from the claim set. The snapshot read happens
+        OUTSIDE _state_lock (it has its own lock + event-mode fast
+        path), so the hot path costs one brief identity check."""
+        snap = self.view.snapshot()
         with self._state_lock:
-            snap = self.view.snapshot()
             if snap is not self._snap or self._alloc is None:
                 self._snap = snap
                 self._alloc = AllocationState(snap)
@@ -477,8 +627,8 @@ class DraScheduler:
                                             AllocationState]:
         """Full defensive rebuild (every full pass does this, which is
         what makes the safety resync actually safe)."""
+        snap = self.view.snapshot()
         with self._state_lock:
-            snap = self.view.snapshot()
             self._snap = snap
             self._alloc = AllocationState(snap)
             claims = self.view.claims()
@@ -505,34 +655,19 @@ class DraScheduler:
             for c in self.view.device_classes()
         }
 
-    def _try_allocate(self, claim, snap: InventorySnapshot,
-                      alloc: AllocationState, classes,
-                      pinned_node: str | None = None) -> dict | None:
-        """One claim against the snapshot. Returns the allocation or
-        None; the caller commits it (patch + ``alloc.observe``) so the
-        incremental state only ever reflects allocations that landed.
-        ``pinned_node`` restricts placement to the node a consumer pod
-        is already bound to (real DRA allocates during that pod's
-        scheduling, so the choice is inherently per-node)."""
-        requests = claim.get("spec", {}).get("devices", {}).get(
-            "requests", [])
-        if not requests:
-            return None
-        # Node-local pools pin the whole claim to one node: try each
-        # candidate node until every request fits (kube-scheduler does
-        # this per-node in Filter). Least-allocated node first -- the
-        # spreading a real scheduler gets from per-pod Filter/Score;
-        # without it a multi-node gang would pile onto one node.
-        load: dict[str, int] = {}
-        for key in alloc.allocated:
-            cand = snap.by_key.get(key)
-            if cand is not None:
-                load[cand.node] = load.get(cand.node, 0) + 1
-        # ComputeDomain gangs first try the ICI-adjacent host window
-        # the CD controller picked; load still spreads the gang's
-        # members WITHIN the window, and non-window nodes remain as
-        # overflow so a full window degrades instead of wedging.
-        window = set(self._preferred_gang_nodes(claim) or ())
+    # Optimistic-commit retry budget: a conflict means another worker
+    # reserved a device/counter between our fit and our try_commit;
+    # each retry re-fits against fresh state. Same-node contenders are
+    # already serialized by the node lock, so conflicts only come from
+    # cross-node counter races and are rare.
+    COMMIT_RETRIES = 4
+
+    def _candidate_nodes(self, claim, snap: InventorySnapshot,
+                         load: dict[str, int], window: set,
+                         pinned_node: str | None) -> list[str]:
+        """Node probe order for one claim: CD window first, then
+        least-allocated (the spreading a real scheduler gets from
+        per-pod Filter/Score), with permanently failed nodes vetoed."""
         nodes = sorted(snap.by_node,
                        key=lambda n: (0 if not window or n in window
                                       else 1, load.get(n, 0), n))
@@ -545,52 +680,145 @@ class DraScheduler:
                 nodes = [n for n in nodes if n not in excluded]
         if pinned_node is not None:
             nodes = [n for n in nodes if n == pinned_node]
+        return nodes
+
+    def _allocate_one(self, claim, snap: InventorySnapshot,
+                      alloc: AllocationState, classes,
+                      pinned_node: str | None = None) -> bool:
+        """One claim through the sharded allocation protocol:
+
+        1. **Fit** per candidate node under that node's lock (gang /
+           CD-window claims take the whole window as one sorted
+           multi-node lock set), reading allocation state optimistically.
+        2. **Reserve** atomically (``AllocationState.try_commit``): the
+           planned devices must still be free and the counter budgets
+           must still fit; a conflict re-fits against fresh state.
+        3. **Commit** the kube patch while still holding the node lock
+           (same-node contenders serialize; disjoint nodes proceed in
+           parallel); a failed patch releases the reservation so a
+           write that never landed never leaks a debit
+           (commit-then-observe).
+
+        Returns True when an allocation landed. ``pinned_node``
+        restricts placement to the node a consumer pod is already
+        bound to (real DRA allocates during that pod's scheduling, so
+        the choice is inherently per-node)."""
+        requests = claim.get("spec", {}).get("devices", {}).get(
+            "requests", [])
+        if not requests:
+            return False
+        # ComputeDomain gangs first try the ICI-adjacent host window
+        # the CD controller picked; load still spreads the gang's
+        # members WITHIN the window, and non-window nodes remain as
+        # overflow so a full window degrades instead of wedging.
+        window = set(self._preferred_gang_nodes(claim) or ())
+        for _attempt in range(self.COMMIT_RETRIES):
+            nodes = self._candidate_nodes(claim, snap, alloc.load_view(),
+                                          window, pinned_node)
+            # One ledger copy per attempt, shared across every probed
+            # node: the fit is optimistic anyway (try_commit re-judges
+            # budgets at reserve time), so a pending claim walking all
+            # 1000 nodes doesn't pay 1000 locked copies.
+            ledger = alloc.ledger_snapshot()
+            outcome = self._try_nodes(claim, nodes, window, snap, alloc,
+                                      ledger, classes)
+            if outcome == "committed":
+                return True
+            if outcome != "conflict":
+                return False
+            if self.sched_metrics is not None:
+                self.sched_metrics.commit_conflicts.inc()
+        logger.warning(
+            "claim %s/%s: %d consecutive commit conflicts; leaving "
+            "pending for the next sync",
+            _meta(claim).get("namespace", "default"),
+            _meta(claim).get("name", "?"), self.COMMIT_RETRIES)
+        return False
+
+    def _try_nodes(self, claim, nodes: list[str], window: set,
+                   snap: InventorySnapshot, alloc: AllocationState,
+                   ledger: _CounterLedger, classes) -> str:
+        """Walk the candidate nodes under per-node locks; window gangs
+        take their whole (sorted) window lock set in ONE acquisition so
+        two gangs overlapping on any node cannot deadlock. Returns
+        "committed" | "conflict" | "failed" | "unfit"."""
+        if window:
+            win_nodes = [n for n in nodes if n in window]
+            if win_nodes:
+                with self._node_locks.hold(win_nodes):
+                    out = self._fit_and_commit(claim, win_nodes, snap,
+                                               alloc, ledger, classes)
+                if out != "unfit":
+                    return out
+            rest = [n for n in nodes if n not in window]
+        else:
+            rest = nodes
+        for node in rest:
+            with self._node_locks.hold((node,)):
+                out = self._fit_and_commit(claim, (node,), snap, alloc,
+                                           ledger, classes)
+            if out != "unfit":
+                return out
+        return "unfit"
+
+    def _fit_and_commit(self, claim, nodes, snap: InventorySnapshot,
+                        alloc: AllocationState, ledger: _CounterLedger,
+                        classes) -> str:
+        """Fit + commit on the first of ``nodes`` that satisfies the
+        claim. Caller holds the node locks for every entry, so the
+        allocation state for these nodes is quiescent apart from
+        cross-node counter races (which try_commit catches)."""
         for node in nodes:
-            picks = self._fit_on_node(claim, node, snap, alloc, classes)
+            picks = self._fit_on_node(claim, node, snap, alloc.allocated,
+                                      ledger, classes)
             if picks is None:
                 continue
-            results, configs = [], []
-            seen_classes = []
-            for req_name, cand, class_name in picks:
-                results.append({
-                    "request": req_name,
-                    "driver": cand.driver,
-                    "pool": cand.pool,
-                    "device": cand.name,
-                })
-                if class_name not in seen_classes:
-                    seen_classes.append(class_name)
-            for class_name in seen_classes:
-                for cfg in classes.get(class_name, {}).get(
-                        "spec", {}).get("config", []) or []:
-                    if "opaque" in cfg:
-                        configs.append({
-                            "opaque": cfg["opaque"],
-                            "requests": [],
-                            "source": "FromClass",
-                        })
-            for cfg in claim.get("spec", {}).get("devices", {}).get(
-                    "config", []) or []:
+            alloc_obj = self._build_alloc_obj(claim, node, picks, classes)
+            return self._commit_allocation(claim, alloc_obj, snap, alloc)
+        return "unfit"
+
+    def _build_alloc_obj(self, claim, node, picks, classes) -> dict:
+        results, configs = [], []
+        seen_classes = []
+        for req_name, cand, class_name in picks:
+            results.append({
+                "request": req_name,
+                "driver": cand.driver,
+                "pool": cand.pool,
+                "device": cand.name,
+            })
+            if class_name not in seen_classes:
+                seen_classes.append(class_name)
+        for class_name in seen_classes:
+            for cfg in classes.get(class_name, {}).get(
+                    "spec", {}).get("config", []) or []:
                 if "opaque" in cfg:
                     configs.append({
                         "opaque": cfg["opaque"],
-                        "requests": cfg.get("requests", []),
-                        "source": "FromClaim",
+                        "requests": [],
+                        "source": "FromClass",
                     })
-            bind_node = node or self.default_node
-            alloc_obj = {
-                "devices": {"results": results, "config": configs},
-            }
-            if bind_node:
-                alloc_obj["nodeSelector"] = {"nodeSelectorTerms": [{
-                    "matchFields": [{
-                        "key": "metadata.name",
-                        "operator": "In",
-                        "values": [bind_node],
-                    }],
-                }]}
-            return alloc_obj
-        return None
+        for cfg in claim.get("spec", {}).get("devices", {}).get(
+                "config", []) or []:
+            if "opaque" in cfg:
+                configs.append({
+                    "opaque": cfg["opaque"],
+                    "requests": cfg.get("requests", []),
+                    "source": "FromClaim",
+                })
+        bind_node = node or self.default_node
+        alloc_obj = {
+            "devices": {"results": results, "config": configs},
+        }
+        if bind_node:
+            alloc_obj["nodeSelector"] = {"nodeSelectorTerms": [{
+                "matchFields": [{
+                    "key": "metadata.name",
+                    "operator": "In",
+                    "values": [bind_node],
+                }],
+            }]}
+        return alloc_obj
 
     # DFS budget for the constraint-aware fit: a claim that cannot be
     # decided within this many visited states is treated as unsatisfiable
@@ -720,9 +948,13 @@ class DraScheduler:
             self.metrics.largest_shape.labels(label).set(chips)
 
     def _fit_on_node(self, claim, node, snap: InventorySnapshot,
-                     alloc: AllocationState, classes):
+                     allocated: set, ledger: _CounterLedger, classes):
         """All requests of one claim against one node; returns
-        [(request, candidate, class_name)] or None. Counter fits are
+        [(request, candidate, class_name)] or None. ``allocated`` is
+        only ever probed for membership (safe against concurrent
+        commits on other nodes) and ``ledger`` is a private copy, so
+        the fit itself runs lock-free; the atomic try_commit re-judges
+        both before anything becomes visible. Counter fits are
         checked against a tentative ledger so multi-device claims can't
         double-spend.
 
@@ -738,7 +970,6 @@ class DraScheduler:
         """
         spec = claim.get("spec", {}).get("devices", {})
         node_cands = snap.by_node.get(node, ())
-        allocated = alloc.allocated
         reqs = []
         for req in spec.get("requests", []):
             exactly = req.get("exactly") or req  # v1 nests under exactly
@@ -779,9 +1010,10 @@ class DraScheduler:
                 "attr": attr,
             })
 
+        # Private working copy: _FitBudgetExceeded can abandon the DFS
+        # mid-undo, so the caller's ledger copy must stay pristine.
         spent = _CounterLedger()
-        spent._avail = {k: dict(v)
-                        for k, v in alloc.ledger._avail.items()}
+        spent._avail = {k: dict(v) for k, v in ledger._avail.items()}
         cvals: list = [None] * len(constraints)
         state = {"steps": 0}
 
@@ -904,52 +1136,84 @@ class DraScheduler:
 
     def _commit_allocation(self, claim, alloc_obj,
                            snap: InventorySnapshot,
-                           alloc: AllocationState) -> bool:
-        """Patch the allocation; fold it into the incremental state
-        only when the write landed."""
+                           alloc: AllocationState) -> str:
+        """Reserve atomically, then patch. The reservation makes the
+        devices visible to every other worker BEFORE the kube write, so
+        nobody can plan against them in the patch window; a failed
+        patch releases it (commit-then-observe: the incremental state
+        only ever keeps allocations that landed). Returns
+        "committed" | "conflict" | "failed"."""
         ns = _meta(claim).get("namespace", "default")
+        claim_like = {
+            "metadata": _meta(claim),
+            "status": {"allocation": alloc_obj},
+        }
+        # Reserve against the LIVE state, atomically with the
+        # commit-log insert, under _state_lock: state installs
+        # (_ensure/_rebuild) take the same lock, so a rebuild that ran
+        # after the caller captured ``alloc`` is the state we reserve
+        # on, and any LATER rebuild replays the log entry -- either
+        # way the reservation is visible before the patch is in
+        # flight, so no worker can fit against a state that never saw
+        # it (the double-allocation window). The fit itself stays
+        # optimistic (it may have read a superseded state); try_commit
+        # re-judges everything here.
+        log_key = (ns, _meta(claim)["name"])
+        with self._state_lock:
+            live = self._alloc if self._alloc is not None else alloc
+            if not live.try_commit(claim_like):
+                return "conflict"
+            self._commit_log[log_key] = (time.monotonic(), claim_like)
         try:
             self.kube.patch(
                 *RESOURCE, "resourceclaims", _meta(claim)["name"],
                 {"status": {"allocation": alloc_obj}}, namespace=ns)
         except (NotFoundError, ConflictError):
-            return False
-        claim_like = {
-            "metadata": _meta(claim),
-            "status": {"allocation": alloc_obj},
-        }
-        with self._state_lock:
-            alloc.observe(claim_like)
-            self._commit_log[(ns, _meta(claim)["name"])] = (
-                time.monotonic(), claim_like)
+            with self._state_lock:
+                self._commit_log.pop(log_key, None)
+                current = self._alloc
+            live.forget(claim_like)
+            if current is not None and current is not live:
+                # A rebuild swapped states mid-patch and replayed the
+                # now-dead reservation; release it there too.
+                current.forget(claim_like)
+            return "failed"
         self._observe_placement(alloc_obj, snap, alloc)
         logger.info(
             "allocated claim %s/%s -> %s", ns, _meta(claim)["name"],
             [r["device"] for r in alloc_obj["devices"]["results"]])
-        return True
+        return "committed"
 
     def _allocate_claims(self):
-        # The whole pass holds _state_lock: informer threads mutate the
-        # allocation state under it, and an unguarded reader iterating
-        # alloc.allocated mid-event would die on set-changed-during-
-        # iteration (event hooks from our OWN patches re-enter on this
-        # thread -- RLock).
-        with self._state_lock:
-            snap, alloc = self._rebuild_alloc_state()
-            classes = self._device_classes()
-            pins = self._claim_pins()
+        snap, alloc = self._rebuild_alloc_state()
+        if self._sharded:
+            # Claim work belongs to its shard: fan the pending claims
+            # out as dirty keys so allocation for one claim always runs
+            # serialized on one worker (the full pass stays O(pending)).
             for claim in self.view.claims():
                 if claim.get("status", {}).get("allocation"):
                     continue
                 if _meta(claim).get("deletionTimestamp"):
                     continue
-                pin = pins.get((_meta(claim).get("namespace", "default"),
-                                _meta(claim)["name"]))
-                alloc_obj = self._try_allocate(claim, snap, alloc,
-                                               classes, pinned_node=pin)
-                if alloc_obj is None:
+                if not self._owns(claim):
                     continue
-                self._commit_allocation(claim, alloc_obj, snap, alloc)
+                self._enqueue(("claim",
+                               _meta(claim).get("namespace", "default"),
+                               _meta(claim)["name"]))
+            return
+        classes = self._device_classes()
+        pins = self._claim_pins()
+        for claim in self.view.claims():
+            if claim.get("status", {}).get("allocation"):
+                continue
+            if _meta(claim).get("deletionTimestamp"):
+                continue
+            if not self._owns(claim):
+                continue
+            pin = pins.get((_meta(claim).get("namespace", "default"),
+                            _meta(claim)["name"]))
+            self._allocate_one(claim, snap, alloc, classes,
+                               pinned_node=pin)
 
     # -- binding --------------------------------------------------------------
 
@@ -1028,6 +1292,19 @@ class DraScheduler:
         except KubeError:
             ext_names = None  # fail closed per-pod, retry next pass
         for pod in self._pods():
+            if not self._owns(pod):
+                continue
+            if self._sharded:
+                # Reservation + bind for one pod must run serialized on
+                # the pod's shard (a racing duplicate would double-add
+                # reservedFor entries).
+                if not pod.get("spec", {}).get("nodeName") and \
+                        pod.get("status", {}).get("phase") in (
+                            None, "", "Pending"):
+                    self._enqueue(("pod",
+                                   _meta(pod).get("namespace", "default"),
+                                   _meta(pod)["name"]))
+                continue
             self._bind_pod(pod, ext_names)
 
     def _bind_pod(self, pod, ext_names: set[str] | None) -> bool:
@@ -1219,9 +1496,13 @@ class DraScheduler:
     def sync_once(self):
         t0 = time.monotonic()
         self.view.begin_pass()
-        self._sync_recovery()
-        self._sync_daemonsets()
-        self._sync_jobs()
+        if self._cluster_controllers:
+            # Non-default domain instances only allocate/bind their
+            # own objects; exactly one instance runs the cluster-wide
+            # controllers.
+            self._sync_recovery()
+            self._sync_daemonsets()
+            self._sync_jobs()
         self._generate_claims()
         self._generate_extended_resource_claims()
         self._allocate_claims()
@@ -1248,14 +1529,20 @@ class DraScheduler:
     def start_event_driven(self) -> "DraScheduler":
         """Informer-fed dirty-set mode: per-object events enqueue keyed
         work; the periodic FULL resync survives only as the safety net
-        (``resync_period``, default 30s / TPU_DRA_SCHED_RESYNC)."""
+        (``resync_period``, default 30s / TPU_DRA_SCHED_RESYNC).
+        ``sched_workers`` > 1 shards claim/pod keys over N-1 data
+        workers (disjoint-node allocations commit in parallel) with
+        control keys pinned to a dedicated worker."""
         from .workqueue import RateLimiter, WorkQueue  # noqa: PLC0415
 
         if self._queue is not None:
             return self
         self._queue = WorkQueue(
             limiter=RateLimiter(base_delay=0.05, max_delay=2.0),
-            workers=1, name="sched-sync",
+            workers=self.sched_workers, name="sched-sync",
+            shard_of=self._shard_of,
+            metrics=(self.sched_metrics.workqueue
+                     if self.sched_metrics is not None else None),
         )
         self.view.start()
         self._enqueue(("full",))
@@ -1295,13 +1582,15 @@ class DraScheduler:
         name = md.get("name", "")
         if resource == "pods":
             self._index_pod(ev_type, ns, name, obj)
-            self._enqueue(("pod", ns, name))
+            if self._owns(obj):
+                self._enqueue(("pod", ns, name))
             owners = md.get("ownerReferences") or []
-            if any(o.get("kind") == "Job" for o in owners):
-                self._enqueue(("jobs",))
-            if ev_type == "DELETED" and any(
-                    o.get("kind") == "DaemonSet" for o in owners):
-                self._enqueue(("daemonsets",))
+            if self._cluster_controllers:
+                if any(o.get("kind") == "Job" for o in owners):
+                    self._enqueue(("jobs",))
+                if ev_type == "DELETED" and any(
+                        o.get("kind") == "DaemonSet" for o in owners):
+                    self._enqueue(("daemonsets",))
         elif resource == "resourceclaims":
             with self._state_lock:
                 if self._alloc is not None:
@@ -1318,7 +1607,7 @@ class DraScheduler:
                 # Freed devices may unblock any pending claim.
                 self._pods_of_claim.pop((ns, name), None)
                 self._enqueue(("pending",))
-            else:
+            elif self._owns(obj):
                 self._enqueue(("claim", ns, name))
             if self.recovery is not None and self.recovery.busy():
                 # Allocation changes advance IN-FLIGHT evictions
@@ -1391,13 +1680,16 @@ class DraScheduler:
         t0 = time.monotonic()
         kind = key[0]
         try:
+            if kind in ("daemonsets", "jobs", "recovery") and \
+                    not self._cluster_controllers:
+                return  # another domain owns the cluster controllers
             if kind == "full":
                 self.sync_once()
                 return  # sync_once observed itself as a full pass
             if kind == "pod":
                 self._sync_pod_key(key[1], key[2])
             elif kind == "claim":
-                self._sync_claim_key(key[1], key[2])
+                self._sync_claim_keys_batched(key)
             elif kind == "pending":
                 self._retry_pending_claims()
             elif kind == "inventory":
@@ -1440,6 +1732,8 @@ class DraScheduler:
             pod = self.kube.get("", "v1", "pods", name, namespace=ns)
         except NotFoundError:
             return
+        if not self._owns(pod):
+            return
         try:
             by_resource = self._extended_resource_classes()
             ext_names: set[str] | None = set(by_resource)
@@ -1456,9 +1750,58 @@ class DraScheduler:
                 return
         self._bind_pod(pod, ext_names)
 
+    def _sync_claim_keys_batched(self, key: tuple) -> None:
+        """Batched multi-claim allocation: drain up to ``batch_max``
+        due claim keys from this worker's own shard against ONE
+        inventory snapshot + device-class read, amortizing the
+        signature check and the static-CEL memo warmup over the whole
+        burst. Extra keys report their outcomes back to the queue via
+        ``finish`` (per-key retry discipline preserved)."""
+        extras: list[tuple] = []
+        if self._queue is not None and self.batch_max > 1:
+            extras = self._queue.take_ready(
+                lambda k: isinstance(k, tuple) and k and k[0] == "claim",
+                self.batch_max - 1)
+        if not extras:
+            self._sync_claim_key(key[1], key[2])
+            return
+        try:
+            snap, alloc = self._ensure_alloc_state()
+            classes = self._device_classes()
+        except BaseException as e:
+            # The taken extras are marked running in the queue; if the
+            # shared setup dies they MUST still be reported or they
+            # stay wedged (enqueues for a running key only set the
+            # dirty flag). Hand each its own retry.
+            for extra in extras:
+                self._queue.finish(extra, e)
+            raise
+        primary_err: BaseException | None = None
+        try:
+            self._sync_claim_one(key[1], key[2], snap, alloc, classes)
+        except Exception as e:  # noqa: BLE001 - re-raised after finishes
+            primary_err = e
+        for extra in extras:
+            err: BaseException | None = None
+            try:
+                self._sync_claim_one(extra[1], extra[2], snap, alloc,
+                                     classes)
+            except Exception as e:  # noqa: BLE001 - per-key retry
+                err = e
+            self._queue.finish(extra, err)
+        if primary_err is not None:
+            raise primary_err
+
     def _sync_claim_key(self, ns: str, name: str) -> None:
         """Allocation attempt for ONE claim, re-read fresh so a stale
         cache can never double-allocate."""
+        snap, alloc = self._ensure_alloc_state()
+        self._sync_claim_one(ns, name, snap, alloc,
+                             self._device_classes())
+
+    def _sync_claim_one(self, ns: str, name: str,
+                        snap: InventorySnapshot, alloc: AllocationState,
+                        classes) -> None:
         try:
             claim = self.kube.get(*RESOURCE, "resourceclaims", name,
                                   namespace=ns)
@@ -1466,30 +1809,25 @@ class DraScheduler:
             return
         if _meta(claim).get("deletionTimestamp"):
             return
-        # _state_lock spans the read-allocate-commit sequence: the
-        # allocation state is mutated under this lock by informer
-        # threads, so the _try_allocate reader must hold it too.
-        with self._state_lock:
-            snap, alloc = self._ensure_alloc_state()
-            if claim.get("status", {}).get("allocation"):
-                alloc.observe(claim)
-                return
-            classes = self._device_classes()
-            pin = self._pin_for_claim(ns, name)
-            alloc_obj = self._try_allocate(claim, snap, alloc, classes,
-                                           pinned_node=pin)
-            if alloc_obj is not None:
-                self._commit_allocation(claim, alloc_obj, snap, alloc)
+        if claim.get("status", {}).get("allocation"):
+            alloc.observe(claim)
+            return
+        if not self._owns(claim):
+            return
+        pin = self._pin_for_claim(ns, name)
+        self._allocate_one(claim, snap, alloc, classes, pinned_node=pin)
 
     def _pin_for_claim(self, ns: str, claim_name: str) -> str | None:
         """Bound-consumer pin for one claim via the reverse index (no
-        full pod scan)."""
+        full pod scan). Cache read: a lagging bind event only means an
+        unpinned placement preference for one attempt, never a
+        double-allocation, so the fresh-GET discipline of the claim
+        itself does not apply here."""
         with self._state_lock:
             pod_names = set(self._pods_of_claim.get((ns, claim_name), ()))
         for pod_name in pod_names:
             try:
-                pod = self.kube.get("", "v1", "pods", pod_name,
-                                    namespace=ns)
+                pod = self.view.get_pod(pod_name, namespace=ns)
             except NotFoundError:
                 continue
             node = pod.get("spec", {}).get("nodeName")
@@ -1500,15 +1838,22 @@ class DraScheduler:
     def _retry_pending_claims(self) -> None:
         """Re-try every still-pending claim (cache scan, then a fresh
         GET per pending claim inside _sync_claim_key). O(pending), and
-        pending claims are exactly the ones worth O(1 GET) each."""
+        pending claims are exactly the ones worth O(1 GET) each. In
+        sharded mode the retries fan out to their shards so claim work
+        stays serialized per key."""
         for claim in self.view.claims():
             if claim.get("status", {}).get("allocation"):
                 continue
             if _meta(claim).get("deletionTimestamp"):
                 continue
-            self._sync_claim_key(
-                _meta(claim).get("namespace", "default"),
-                _meta(claim)["name"])
+            if not self._owns(claim):
+                continue
+            ns = _meta(claim).get("namespace", "default")
+            name = _meta(claim)["name"]
+            if self._sharded:
+                self._enqueue(("claim", ns, name))
+            else:
+                self._sync_claim_key(ns, name)
 
     # -- loop -----------------------------------------------------------------
 
@@ -1536,6 +1881,38 @@ class DraScheduler:
         self.view.stop()
 
 
+def run_leader_elected(sched: DraScheduler, namespace: str = "kube-system",
+                       identity: str | None = None,
+                       stop: threading.Event | None = None,
+                       lease_name: str | None = None,
+                       **lease_kwargs) -> None:
+    """Gate a (typically per-domain) scheduler instance behind a Lease:
+    the instance idles as a hot standby until it wins
+    ``tpu-dra-scheduler-<domain>``, runs event-driven while holding it,
+    and stops cleanly when the lease is lost or ``stop`` is set. This
+    is the horizontal-scale surface: one leader-elected scheduler pair
+    per scheduling domain, each consuming only its own pools' dirty
+    keys."""
+    from .leaderelection import LeaderElector  # noqa: PLC0415
+
+    stop = stop if stop is not None else threading.Event()
+    if lease_name is None:
+        lease_name = (sched.domain.lease_name if sched.domain is not None
+                      else "tpu-dra-scheduler")
+    if identity is None:
+        identity = f"sched-{uuid.uuid4().hex[:8]}"
+    elector = LeaderElector(sched.kube, lease_name, namespace, identity,
+                            **lease_kwargs)
+
+    def lead():
+        sched.start_event_driven()
+        while not stop.is_set():
+            stop.wait(0.2)
+
+    elector.run(lead, stop, on_stopped_leading=sched.stop)
+    sched.stop()
+
+
 def main(argv: list[str] | None = None) -> int:
     from .kubeclient import KubeClient
 
@@ -1550,6 +1927,46 @@ def main(argv: list[str] | None = None) -> int:
                         "sync with a low-frequency safety resync; "
                         "'poll': the legacy full-resync loop at "
                         "--interval [TPU_DRA_SCHED_MODE]")
+    p.add_argument("--sched-workers", type=int,
+                   default=_env_int("TPU_DRA_SCHED_WORKERS",
+                                    DEFAULT_SCHED_WORKERS),
+                   help="sync-queue workers in events mode: 1 = "
+                        "serialized drain; N>1 shards claim/pod keys "
+                        "over N-1 data workers plus a dedicated "
+                        "control-key worker [TPU_DRA_SCHED_WORKERS]")
+    p.add_argument("--sched-batch", type=int,
+                   default=_env_int("TPU_DRA_SCHED_BATCH",
+                                    DEFAULT_SCHED_BATCH),
+                   help="max dirty claim keys drained against one "
+                        "inventory snapshot [TPU_DRA_SCHED_BATCH]")
+    p.add_argument("--sched-domain",
+                   default=os.environ.get("TPU_DRA_SCHED_DOMAIN", ""),
+                   help="scheduling-domain name for scheduler-per-pool "
+                        "sharding; empty = this instance owns "
+                        "everything [TPU_DRA_SCHED_DOMAIN]")
+    p.add_argument("--sched-domain-pools",
+                   default=os.environ.get("TPU_DRA_SCHED_DOMAIN_POOLS",
+                                          ""),
+                   help="comma-separated pool names / fnmatch globs "
+                        "this domain's snapshot is restricted to "
+                        "[TPU_DRA_SCHED_DOMAIN_POOLS]")
+    p.add_argument("--sched-domain-default", action="store_true",
+                   default=os.environ.get("TPU_DRA_SCHED_DOMAIN_DEFAULT",
+                                          "") in ("1", "true", "True"),
+                   help="this domain owns unannotated objects and the "
+                        "cluster-wide controllers "
+                        "[TPU_DRA_SCHED_DOMAIN_DEFAULT]")
+    p.add_argument("--leader-elect", action="store_true",
+                   default=os.environ.get("TPU_DRA_SCHED_LEADER_ELECT",
+                                          "") in ("1", "true", "True"),
+                   help="gate this instance behind the per-domain "
+                        "Lease (hot-standby HA) "
+                        "[TPU_DRA_SCHED_LEADER_ELECT]")
+    p.add_argument("--leader-elect-namespace",
+                   default=os.environ.get(
+                       "TPU_DRA_SCHED_LEASE_NAMESPACE", "kube-system"),
+                   help="namespace of the leader-election Lease "
+                        "[TPU_DRA_SCHED_LEASE_NAMESPACE]")
     p.add_argument("--metrics-port", type=int,
                    default=int(os.environ.get("METRICS_PORT", "0")),
                    help="serve /metrics (placement frag/compactness + "
@@ -1587,10 +2004,19 @@ def main(argv: list[str] | None = None) -> int:
         from .metrics import ResilienceMetrics  # noqa: PLC0415
 
         resilience = ResilienceMetrics(registry=metrics.registry)
+    domain = None
+    if args.sched_domain:
+        domain = SchedulingDomain(
+            args.sched_domain,
+            pools=[p.strip() for p in args.sched_domain_pools.split(",")
+                   if p.strip()],
+            default=args.sched_domain_default)
     sched = DraScheduler(RetryingKubeClient(KubeClient(host=args.kube_api),
                                             metrics=resilience),
                          default_node=args.default_node,
-                         metrics=metrics, sched_metrics=sched_metrics)
+                         metrics=metrics, sched_metrics=sched_metrics,
+                         workers=args.sched_workers,
+                         batch_max=args.sched_batch, domain=domain)
     if args.recovery_root:
         from .metrics import RecoveryMetrics  # noqa: PLC0415
         from .recovery import EvictionController  # noqa: PLC0415
@@ -1601,7 +2027,10 @@ def main(argv: list[str] | None = None) -> int:
             sched.kube, args.recovery_root, metrics=recovery_metrics))
     print("scheduler running", flush=True)
     try:
-        if args.sched_mode == "events":
+        if args.sched_mode == "events" and args.leader_elect:
+            run_leader_elected(sched,
+                               namespace=args.leader_elect_namespace)
+        elif args.sched_mode == "events":
             sched.start_event_driven()
             while True:
                 time.sleep(60)

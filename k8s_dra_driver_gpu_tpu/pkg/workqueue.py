@@ -11,6 +11,29 @@ ErrorRetryMaxTimeout, permanentError short-circuit).
 Design notes (TPU build): a small threaded queue. Items are hashable keys
 with an attached callback; failures re-enqueue with exponential backoff
 until the limiter's max delay; ``PermanentError`` short-circuits retries.
+
+Scale-out additions (scheduler scale-out PR):
+
+- **Keyed shard affinity** (``shard_of``): every key maps to a shard and
+  every shard maps to exactly one worker, so keys sharing a shard are
+  processed serially while disjoint shards drain in parallel. The
+  scheduler hashes claim/pod namespace+name into data shards and pins
+  control keys (full resync, recovery, inventory) to a dedicated shard,
+  which is what keeps the eviction controller from queueing behind a
+  claim flood.
+- **Batch draining** (``take_ready`` / ``finish``): a running callback
+  may claim additional due same-shard keys and process them in one
+  amortized pass (one inventory snapshot per batch instead of one per
+  claim), then report each extra key's outcome via ``finish``.
+- **Hot-key fairness**: a key re-dirtied in a tight loop (an object
+  whose every reconcile triggers another event for itself) is re-run
+  immediately only ``hot_threshold`` consecutive times; past that its
+  requeue delay escalates exponentially (capped at the limiter's max
+  delay), so one hot key cannot monopolize a worker while cold keys
+  wait. The streak resets the first time the key retires clean.
+- **Observability** (``metrics``): per-shard depth, queue-wait
+  histogram, retry/drop/hot-backoff counters via a duck-typed sink
+  (pkg/metrics.WorkQueueMetrics).
 """
 
 from __future__ import annotations
@@ -20,6 +43,7 @@ import logging
 import random
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -69,21 +93,42 @@ DOMAIN_DAEMON_LIMITER = RateLimiter(base_delay=0.005, max_delay=6.0, jitter=0.5)
 CONTROLLER_DEFAULT_LIMITER = RateLimiter(base_delay=0.005, max_delay=1.0)
 
 
+def stable_shard_hash(value: Any) -> int:
+    """Deterministic (cross-process) non-negative hash for shard
+    routing; python's builtin hash() is salted per process."""
+    if isinstance(value, int):
+        return abs(value)
+    return zlib.crc32(repr(value).encode("utf-8", "replace"))
+
+
 @dataclass(order=True)
 class _Scheduled:
     when: float
     seq: int
     key: Any = field(compare=False)
+    # Enqueue timestamp for the queue-wait histogram (includes any
+    # retry/hot backoff the item waited out).
+    born: float = field(compare=False, default=0.0)
 
 
 class WorkQueue:
     """A retrying queue. ``enqueue(key, fn)`` runs ``fn(key)`` on a worker;
     exceptions re-enqueue with backoff; PermanentError drops the item.
 
+    ``shard_of(key)`` (optional) routes every key to a stable shard;
+    a shard is owned by exactly one worker (``stable_shard_hash(shard)
+    % workers``; an int shard is taken modulo directly so callers can
+    pin shards to workers). Without it, keys hash over all workers.
+
     ``serialize=False`` allows multiple workers (reference CD plugin uses
     Serialize(false) because channel-Prepares are codependent with the
     daemon's Prepare, driver.go:89-96).
     """
+
+    # Consecutive dirty-requeues a key may burn at zero delay before the
+    # fairness escalation kicks in.
+    HOT_THRESHOLD = 3
+    HOT_BASE_DELAY = 0.02
 
     def __init__(
         self,
@@ -91,11 +136,17 @@ class WorkQueue:
         workers: int = 1,
         name: str = "workqueue",
         on_drop: Callable[[Any, BaseException], None] | None = None,
+        shard_of: Callable[[Any], Any] | None = None,
+        metrics=None,
     ):
         self._limiter = limiter
         self._name = name
         self._on_drop = on_drop
-        self._heap: list[_Scheduled] = []
+        self._shard_of = shard_of
+        self._metrics = metrics
+        self.workers = max(workers, 1)
+        self._heaps: list[list[_Scheduled]] = [
+            [] for _ in range(self.workers)]
         self._failures: dict[Any, int] = {}
         self._first_failure: dict[Any, float] = {}
         self._pending: set[Any] = set()  # keys queued or running (dedupe)
@@ -108,19 +159,37 @@ class WorkQueue:
         # in-flight callback returns (k8s workqueue "dirty" semantics),
         # so a watch event racing a reconcile is never silently dropped.
         self._dirty: set[Any] = set()
-        self._cv = threading.Condition()
+        # Consecutive dirty-requeue streak per key (fairness escalation).
+        self._hot: dict[Any, int] = {}
+        # One base lock; per-worker conditions on it so a push wakes
+        # ONLY the owning worker instead of thundering the whole pool.
+        base = threading.RLock()
+        self._cv = threading.Condition(base)
+        self._worker_cv = [threading.Condition(base)
+                           for _ in range(max(workers, 1))]
+        # Lock-free approximate queued-size (hot-path metrics read).
+        self._size = 0
         self._seq = 0
         self._shutdown = False
         self._tokens = float(limiter.global_burst)
         self._last_refill = time.monotonic()
+        self._tls = threading.local()
         self._threads = [
-            threading.Thread(target=self._run, name=f"{name}-{i}", daemon=True)
-            for i in range(max(workers, 1))
+            threading.Thread(target=self._run, args=(i,),
+                             name=f"{name}-{i}", daemon=True)
+            for i in range(self.workers)
         ]
         for t in self._threads:
             t.start()
 
     # -- public API -----------------------------------------------------------
+
+    def worker_of(self, key: Any) -> int:
+        """The worker index that owns ``key``'s shard."""
+        if self.workers == 1:
+            return 0
+        shard = self._shard_of(key) if self._shard_of is not None else key
+        return stable_shard_hash(shard) % self.workers
 
     def enqueue(self, key: Any, fn: Callable[[Any], None]) -> None:
         """Schedule fn(key) to run now. Deduplicates by key while queued
@@ -146,13 +215,59 @@ class WorkQueue:
             self._first_failure.pop(key, None)
 
     def len(self) -> int:
+        """Approximate queued size, read without the lock -- this sits
+        on the enqueue hot path (dirty-queue depth gauge)."""
+        return self._size
+
+    def depth(self, worker: int) -> int:
         with self._cv:
-            return len(self._heap)
+            return len(self._heaps[worker])
+
+    def take_ready(self, pred: Callable[[Any], bool],
+                   limit: int) -> list[Any]:
+        """Claim up to ``limit`` additional DUE keys from the calling
+        worker's own heap (same-shard by construction) matching
+        ``pred``, marking them running. Only callable from inside a
+        queue callback; the caller must report each taken key's outcome
+        via :meth:`finish`. Batch takes bypass the global token bucket
+        (the batch exists to amortize work, not to multiply it)."""
+        idx = getattr(self._tls, "worker", None)
+        if idx is None or limit <= 0:
+            return []
+        taken: list[Any] = []
+        now = time.monotonic()
+        with self._cv:
+            heap = self._heaps[idx]
+            keep: list[_Scheduled] = []
+            for item in heap:
+                if (len(taken) < limit and item.when <= now
+                        and item.key not in self._running
+                        and pred(item.key)):
+                    taken.append(item.key)
+                    self._running.add(item.key)
+                    if self._metrics is not None:
+                        self._metrics.observe_wait(now - item.born)
+                else:
+                    keep.append(item)
+            if taken:
+                # In place: the worker loop holds an alias to this list.
+                heap[:] = keep
+                heapq.heapify(heap)
+                self._size -= len(taken)
+                self._observe_depth_locked(idx)
+        return taken
+
+    def finish(self, key: Any, error: BaseException | None = None) -> None:
+        """Report the outcome of a key claimed via :meth:`take_ready`
+        (success retires or re-runs a dirty key; an error re-enqueues
+        with the same backoff discipline as a worker-loop failure)."""
+        self._after_run(key, error)
 
     def shutdown(self, wait: bool = True) -> None:
         with self._cv:
             self._shutdown = True
-            self._cv.notify_all()
+            for cv in self._worker_cv:
+                cv.notify_all()
         if wait:
             for t in self._threads:
                 t.join(timeout=5.0)
@@ -162,7 +277,7 @@ class WorkQueue:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._cv:
-                if not self._pending and not self._heap:
+                if not self._pending and not any(self._heaps):
                     return True
             time.sleep(0.005)
         return False
@@ -171,10 +286,18 @@ class WorkQueue:
 
     def _push(self, key: Any, delay: float) -> None:
         self._seq += 1
+        idx = self.worker_of(key)
+        now = time.monotonic()
         heapq.heappush(
-            self._heap, _Scheduled(time.monotonic() + delay, self._seq, key)
-        )
-        self._cv.notify()
+            self._heaps[idx],
+            _Scheduled(now + delay, self._seq, key, born=now))
+        self._size += 1
+        self._observe_depth_locked(idx)
+        self._worker_cv[idx].notify()
+
+    def _observe_depth_locked(self, idx: int) -> None:
+        if self._metrics is not None:
+            self._metrics.set_depth(str(idx), len(self._heaps[idx]))
 
     def _take_token(self) -> float:
         """Global token bucket (reference: 5 rps / burst 10 on prep queues).
@@ -194,75 +317,113 @@ class WorkQueue:
             return 0.0
         return (1.0 - self._tokens) / self._limiter.global_rps
 
-    def _run(self) -> None:
+    def _hot_delay_locked(self, key: Any) -> float:
+        """Fairness escalation for a dirty-requeued key: free re-runs up
+        to HOT_THRESHOLD consecutive times, then exponential backoff so
+        a tight re-dirty loop cannot starve cold keys on its worker."""
+        streak = self._hot.get(key, 0) + 1
+        self._hot[key] = streak
+        if streak <= self.HOT_THRESHOLD:
+            return 0.0
+        delay = min(
+            self.HOT_BASE_DELAY * (2 ** min(streak - self.HOT_THRESHOLD - 1,
+                                            30)),
+            self._limiter.max_delay,
+        )
+        if self._metrics is not None:
+            self._metrics.inc_hot_backoff()
+        return delay
+
+    def _run(self, idx: int) -> None:
+        self._tls.worker = idx
+        heap = self._heaps[idx]
+        wcv = self._worker_cv[idx]
         while True:
             with self._cv:
                 while not self._shutdown and (
-                    not self._heap or self._heap[0].when > time.monotonic()
+                    not heap or heap[0].when > time.monotonic()
                 ):
                     timeout = None
-                    if self._heap:
-                        timeout = max(self._heap[0].when - time.monotonic(), 0)
-                    self._cv.wait(timeout=timeout)
+                    if heap:
+                        timeout = max(heap[0].when - time.monotonic(), 0)
+                    wcv.wait(timeout=timeout)
                 if self._shutdown:
                     return
                 wait = self._take_token()
                 if wait > 0:
-                    item = heapq.heappop(self._heap)
+                    item = heapq.heappop(heap)
                     item.when = time.monotonic() + wait
-                    heapq.heappush(self._heap, item)
+                    heapq.heappush(heap, item)
                     continue
-                item = heapq.heappop(self._heap)
+                item = heapq.heappop(heap)
+                self._size -= 1
                 self._running.add(item.key)
                 fn = self._fn.get(item.key)
+                self._observe_depth_locked(idx)
+                if self._metrics is not None:
+                    self._metrics.observe_wait(
+                        time.monotonic() - item.born)
+            err: BaseException | None = None
             try:
                 if fn is not None:
                     fn(item.key)
-            except PermanentError as e:
-                self._drop(item.key, e)
             except BaseException as e:  # noqa: BLE001 - retry loop boundary
-                now = time.monotonic()
-                with self._cv:
-                    first = self._first_failure.setdefault(item.key, now)
-                    exhausted = (
-                        self._limiter.retry_timeout is not None
-                        and now - first >= self._limiter.retry_timeout
-                    )
-                    if not exhausted:
-                        n = self._failures.get(item.key, 0) + 1
-                        self._failures[item.key] = n
-                        self._running.discard(item.key)
-                        # A retry is scheduled; it looks the callback up
-                        # at run time, so a fresh fn enqueued mid-flight
-                        # (or mid-backoff) is picked up automatically.
-                        self._dirty.discard(item.key)
-                        self._push(item.key, self._limiter.delay_for(n))
-                if exhausted:
-                    logger.warning(
-                        "%s: retry budget (%.1fs) exhausted for %r",
-                        self._name, self._limiter.retry_timeout, item.key,
-                    )
-                    self._drop(item.key, e)
-                else:
-                    logger.warning(
-                        "%s: %r failed (attempt %d), retrying: %s",
-                        self._name, item.key, n, e,
-                    )
-            else:
-                with self._cv:
-                    self._failures.pop(item.key, None)
-                    self._first_failure.pop(item.key, None)
-                    self._running.discard(item.key)
-                    self._retire_or_requeue_locked(item.key)
+                err = e
+            self._after_run(item.key, err)
+
+    def _after_run(self, key: Any, err: BaseException | None) -> None:
+        """Post-callback bookkeeping, shared by the worker loop and
+        ``finish`` (batch-taken keys)."""
+        if err is None:
+            with self._cv:
+                self._failures.pop(key, None)
+                self._first_failure.pop(key, None)
+                self._running.discard(key)
+                self._retire_or_requeue_locked(key)
+            return
+        if isinstance(err, PermanentError):
+            self._drop(key, err)
+            return
+        now = time.monotonic()
+        with self._cv:
+            first = self._first_failure.setdefault(key, now)
+            exhausted = (
+                self._limiter.retry_timeout is not None
+                and now - first >= self._limiter.retry_timeout
+            )
+            if not exhausted:
+                n = self._failures.get(key, 0) + 1
+                self._failures[key] = n
+                self._running.discard(key)
+                # A retry is scheduled; it looks the callback up
+                # at run time, so a fresh fn enqueued mid-flight
+                # (or mid-backoff) is picked up automatically.
+                self._dirty.discard(key)
+                self._push(key, self._limiter.delay_for(n))
+                if self._metrics is not None:
+                    self._metrics.inc_retry()
+        if exhausted:
+            logger.warning(
+                "%s: retry budget (%.1fs) exhausted for %r",
+                self._name, self._limiter.retry_timeout, key,
+            )
+            self._drop(key, err)
+        else:
+            logger.warning(
+                "%s: %r failed (attempt %d), retrying: %s",
+                self._name, key, n, err,
+            )
 
     def _retire_or_requeue_locked(self, key: Any) -> None:
-        """Re-push a dirty key, else retire it from pending. Caller holds
-        the lock."""
+        """Re-push a dirty key (with the fairness escalation delay),
+        else retire it from pending. Caller holds the lock."""
         if key in self._dirty and not self._shutdown:
             self._dirty.discard(key)
-            self._push(key, delay=0.0)  # key stays in _pending
+            # key stays in _pending
+            self._push(key, delay=self._hot_delay_locked(key))
         else:
             self._dirty.discard(key)
+            self._hot.pop(key, None)
             self._pending.discard(key)
             self._fn.pop(key, None)
 
@@ -272,6 +433,8 @@ class WorkQueue:
             self._first_failure.pop(key, None)
             self._running.discard(key)
             self._retire_or_requeue_locked(key)
+            if self._metrics is not None:
+                self._metrics.inc_drop()
         if self._on_drop:
             self._on_drop(key, err)
         else:

@@ -29,6 +29,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: minutes-long proofs excluded from tier-1 "
+        "(-m 'not slow'); run explicitly or via their make targets",
+    )
+
+
 @pytest.fixture()
 def tmp_root(tmp_path):
     """A scratch dir standing in for the plugin's state root."""

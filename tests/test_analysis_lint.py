@@ -530,6 +530,85 @@ class TestSchedulerSyncListRule:
             lint_source(src, rel="pkg/schedcache.py"))
 
 
+class TestSchedulerLockDisciplineRule:
+    """TPUDRA010 + the sharded-allocation lock hierarchy: kube I/O is
+    forbidden under the scheduler registry (_state_lock) and
+    allocation-state (_alloc_lock) locks, sanctioned under the
+    per-node locks, and the node locks sit OUTSIDE both in the
+    documented order."""
+
+    def test_kube_patch_under_state_lock_flagged(self):
+        src = ("class DraScheduler:\n"
+               "    def bad(self):\n"
+               "        with self._state_lock:\n"
+               "            self.kube.patch('', 'v1', 'pods', 'p', {})\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA010" in rules_of(findings)
+
+    def test_kube_get_under_alloc_lock_flagged(self):
+        src = ("class AllocationState:\n"
+               "    def bad(self):\n"
+               "        with self._alloc_lock:\n"
+               "            self.kube.get('', 'v1', 'pods', 'p')\n")
+        findings = lint_source(src, rel="pkg/schedcache.py")
+        assert "TPUDRA010" in rules_of(findings)
+
+    def test_sleep_under_state_lock_flagged(self):
+        src = ("import time\n"
+               "class DraScheduler:\n"
+               "    def bad(self):\n"
+               "        with self._state_lock:\n"
+               "            time.sleep(1)\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA010" in rules_of(findings)
+
+    def test_commit_io_under_node_locks_sanctioned(self):
+        src = ("class DraScheduler:\n"
+               "    def good(self, node):\n"
+               "        with self._node_locks.hold((node,)):\n"
+               "            self.kube.patch('resource.k8s.io', 'v1',\n"
+               "                            'resourceclaims', 'c', {})\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA010" not in rules_of(findings)
+
+    def test_bookkeeping_under_state_lock_clean(self):
+        src = ("class DraScheduler:\n"
+               "    def good(self):\n"
+               "        with self._state_lock:\n"
+               "            self._commit_log.pop(('ns', 'n'), None)\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA010" not in rules_of(findings)
+
+    def test_node_lock_inside_state_lock_is_inversion(self):
+        # Documented order: node locks -> _state_lock -> _alloc_lock.
+        src = ("class DraScheduler:\n"
+               "    def bad(self, node):\n"
+               "        with self._state_lock:\n"
+               "            with self._node_locks.hold((node,)):\n"
+               "                pass\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA001" in rules_of(findings)
+
+    def test_documented_sched_order_clean(self):
+        src = ("class DraScheduler:\n"
+               "    def good(self, node):\n"
+               "        with self._node_locks.hold((node,)):\n"
+               "            with self._state_lock:\n"
+               "                with self._alloc_lock:\n"
+               "                    pass\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA001" not in rules_of(findings)
+
+    def test_out_of_scope_files_unaffected(self):
+        # A _state_lock-named mutex elsewhere is not the scheduler's.
+        src = ("class Other:\n"
+               "    def fine(self):\n"
+               "        with self._state_lock:\n"
+               "            self.kube.patch('', 'v1', 'pods', 'p', {})\n")
+        findings = lint_source(src, rel="kubeletplugin/other.py")
+        assert "TPUDRA010" not in rules_of(findings)
+
+
 class TestWholePackageGate:
     """The tier-1 CI gate from ISSUE 3: zero non-baselined findings
     over the shipped package, with the committed baseline EMPTY (every

@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Keep in sync with the Makefile bench-sched-smoke target.
@@ -51,3 +53,95 @@ def test_sched_churn_smoke(tmp_path):
         emitted = json.load(f)
     assert emitted["extras"]["sched_write_reduction"] == \
         extras["sched_write_reduction"]
+
+
+# Keep in sync with the Makefile bench-sched-smoke scale variant: the
+# multi-worker correctness gate. PIN=1 makes the trace fully
+# deterministic (pods born bound + chip-pinning selectors), so the
+# workers=1 and workers=4 runs must produce IDENTICAL allocations.
+SCALE_SMOKE_ENV = {
+    "BENCH_SCALE_NODES": "12",
+    "BENCH_SCALE_CLAIMS": "36",
+    "BENCH_SCALE_BURST": "12",
+    "BENCH_SCALE_WORKERS": "4",
+    "BENCH_SCALE_BATCH": "8",
+    "BENCH_SCALE_PIN": "1",
+    "BENCH_SCALE_REQUIRE_IDENTICAL": "1",
+    "BENCH_SCALE_MAX_WRITES_PER_CLAIM": "3.5",
+    "BENCH_SCALE_MAX_P99_MS": "2000",
+}
+
+
+def _run_scale(tmp_path, env):
+    out_file = str(tmp_path / "BENCH_scheduler.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--sched-scale"],
+        env={**os.environ, "PYTHONPATH": REPO, **env,
+             "BENCH_SCHED_OUT": out_file},
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    with open(out_file, encoding="utf-8") as f:
+        emitted = json.load(f)
+    return doc, emitted
+
+
+def test_sched_scale_multiworker_smoke(tmp_path):
+    """The multi-worker correctness gate: identical final allocations
+    vs workers=1 on the deterministic pinned trace, no double
+    allocation, full convergence, and the writes/claim + p99 bounds."""
+    doc, emitted = _run_scale(tmp_path, SCALE_SMOKE_ENV)
+    assert doc["metric"] == "sched_scale_multiworker_speedup"
+    ex = doc["extras"]
+    assert ex["scale_identical_allocations"] is True
+    for w in (1, 4):
+        assert ex[f"scale_w{w}_unconverged"] == 0
+        assert ex[f"scale_w{w}_double_allocated"] == 0
+        assert ex[f"scale_w{w}_writes_per_claim"] <= 3.5
+    # The scale entry joined the trajectory file alongside the churn
+    # result's shape (never clobbering it).
+    assert emitted["scale"]["extras"]["scale_workers"] == 4
+
+
+def test_profile_flag_wraps_any_scenario(tmp_path):
+    """Satellite: `bench.py --profile <scenario>` wraps the run in
+    cProfile and emits the top-25 cumulative hotspots to a report
+    file, so future perf PRs start from measured data."""
+    out_file = str(tmp_path / "BENCH_scheduler.json")
+    prof_file = str(tmp_path / "BENCH_profile.txt")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--profile",
+         "--sched-scale"],
+        env={**os.environ, "PYTHONPATH": REPO, **SCALE_SMOKE_ENV,
+             "BENCH_SCHED_OUT": out_file,
+             "BENCH_PROFILE_OUT": prof_file},
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    # The scenario itself still ran and emitted its result line.
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "sched_scale_multiworker_speedup"
+    with open(prof_file, encoding="utf-8") as f:
+        report = f.read()
+    assert "cumulative" in report and "ncalls" in report
+    # Top-25: pstats caps the list it prints.
+    assert "to 25 due to restriction" in report
+
+
+@pytest.mark.slow
+def test_sched_scale_full_1000_nodes(tmp_path):
+    """The full acceptance run (mirrors `make bench-sched-scale`):
+    1000 nodes x 5000 claims, workers=4 vs workers=1 speedup >= 2x on
+    the batch-heavy trace, writes/claim <= 3.5, everything converged.
+    Minutes-long -- excluded from tier-1 via the slow marker."""
+    doc, _ = _run_scale(tmp_path, {
+        "BENCH_SCALE_MIN_SPEEDUP": "2.0",
+        "BENCH_SCALE_MAX_WRITES_PER_CLAIM": "3.5",
+    })
+    ex = doc["extras"]
+    assert ex["scale_nodes"] == 1000 and ex["scale_claims"] == 5000
+    assert ex["scale_speedup"] >= 2.0
+    for w in (1, 4):
+        assert ex[f"scale_w{w}_unconverged"] == 0
+        assert ex[f"scale_w{w}_double_allocated"] == 0

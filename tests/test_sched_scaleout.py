@@ -1,0 +1,797 @@
+"""Scheduler scale-out tier (ISSUE 7): sharded multi-worker draining,
+the optimistic fit/reserve/commit allocation protocol, batched
+multi-claim allocation, snapshot signature caching under concurrent
+invalidation, per-pool scheduling domains with leader election, and
+deterministic interleaving coverage of two workers racing one node plus
+a gang claim spanning both shards."""
+
+import threading
+import time
+from contextlib import contextmanager
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg.analysis.interleave import explore
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.metrics import SchedulerMetrics
+from k8s_dra_driver_gpu_tpu.pkg.schedcache import (
+    AllocationState,
+    ClusterView,
+    DOMAIN_ANNOTATION,
+    InventorySnapshot,
+    NodeLockManager,
+    SchedulingDomain,
+)
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import (
+    DraScheduler,
+    run_leader_elected,
+)
+from k8s_dra_driver_gpu_tpu.pkg.sliceutil import publish_resource_slices
+
+RES = ("resource.k8s.io", "v1")
+
+
+def apply_class(kube, name="tpu.dra.dev"):
+    kube.create(*RES, "deviceclasses", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": name},
+        "spec": {"selectors": [{"cel": {
+            "expression": f'device.driver == "{name}"'}}]},
+    })
+
+
+def node_slices(node, chips=4, driver="tpu.dra.dev"):
+    return [{
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+        "metadata": {"name": f"{node}-{driver}"},
+        "spec": {"driver": driver, "nodeName": node,
+                 "pool": {"name": node, "generation": 1,
+                          "resourceSliceCount": 1},
+                 "devices": [
+                     {"name": f"chip-{j}", "attributes": {
+                         "type": {"string": "tpu-chip"},
+                         "index": {"int": j}}}
+                     for j in range(chips)]},
+    }]
+
+
+def make_claim(kube, name, count=1, ns="default", cel=None,
+               annotations=None):
+    exactly = {"deviceClassName": "tpu.dra.dev"}
+    if count != 1:
+        exactly["count"] = count
+    if cel:
+        exactly["selectors"] = [{"cel": {"expression": cel}}]
+    md = {"name": name, "namespace": ns, "uid": f"uid-{name}"}
+    if annotations:
+        md["annotations"] = dict(annotations)
+    kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": md,
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "exactly": exactly}]}},
+    }, namespace=ns)
+
+
+def allocation(kube, name, ns="default"):
+    return kube.get(*RES, "resourceclaims", name, ns).get(
+        "status", {}).get("allocation")
+
+
+def allocated_keys(kube):
+    """claim name -> sorted device keys, plus the double-alloc audit."""
+    out, seen, doubles = {}, set(), 0
+    for claim in kube.objects("resource.k8s.io", "resourceclaims"):
+        alloc = claim.get("status", {}).get("allocation")
+        name = claim["metadata"]["name"]
+        if not alloc:
+            out[name] = None
+            continue
+        keys = sorted((r["driver"], r["pool"], r["device"])
+                      for r in alloc["devices"]["results"])
+        out[name] = keys
+        for key in keys:
+            if key in seen:
+                doubles += 1
+            seen.add(key)
+    return out, doubles
+
+
+class TestShardRouting:
+    def test_control_keys_pin_to_worker_zero(self):
+        fake = FakeKubeClient()
+        sched = DraScheduler(fake, workers=4)
+        for kind in ("full", "pending", "inventory", "daemonsets",
+                     "jobs", "recovery", "pods-rescan"):
+            assert sched._shard_of((kind,)) == 0
+        # Claim/pod keys spread over the data workers (1..N-1), never
+        # onto the control worker -- a claim flood cannot starve the
+        # recovery/resync lane.
+        shards = {sched._shard_of(("claim", "default", f"c-{i}"))
+                  for i in range(64)}
+        assert shards <= {1, 2, 3}
+        assert len(shards) > 1
+        # Stable per key, and pod/claim keys for one object co-shard.
+        assert sched._shard_of(("claim", "ns", "x")) == \
+            sched._shard_of(("claim", "ns", "x"))
+
+    def test_single_worker_keeps_everything_on_worker_zero(self):
+        sched = DraScheduler(FakeKubeClient(), workers=1)
+        assert sched._shard_of(("claim", "default", "c")) == 0
+
+
+class TestMultiWorkerAllocation:
+    def test_racing_workers_never_double_allocate(self):
+        """12 fungible claims against 8 chips under 4 workers: every
+        chip allocated exactly once, exactly 8 claims converge."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        for node in ("node-a", "node-b"):
+            publish_resource_slices(fake, node_slices(node))
+        sched = DraScheduler(fake, workers=4, batch_max=4,
+                             sched_metrics=SchedulerMetrics())
+        sched.start_event_driven()
+        assert sched.drain(15.0)
+        try:
+            for i in range(12):
+                make_claim(fake, f"c-{i}")
+            assert sched.drain(30.0)
+            # Retries for the 4 overflow claims settle via pending.
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                allocs, _ = allocated_keys(fake)
+                if sum(1 for v in allocs.values() if v) == 8:
+                    break
+                time.sleep(0.02)
+        finally:
+            sched.stop()
+        allocs, doubles = allocated_keys(fake)
+        assert doubles == 0
+        assert sum(1 for v in allocs.values() if v) == 8
+        used = sorted(k for v in allocs.values() if v for k in v)
+        assert len(used) == len(set(used)) == 8
+
+    def test_multiworker_equivalent_to_single_worker_on_trace(self):
+        """Acceptance: a recorded deterministic trace (pods born bound
+        + chip-pinning selectors) produces IDENTICAL final allocations
+        under workers=1 and workers=4."""
+
+        def run(workers):
+            fake = FakeKubeClient()
+            apply_class(fake)
+            for i in range(4):
+                publish_resource_slices(fake, node_slices(f"node-{i}",
+                                                          chips=2))
+            sched = DraScheduler(fake, workers=workers, batch_max=4)
+            sched.start_event_driven()
+            assert sched.drain(15.0)
+            try:
+                for idx in range(8):
+                    name = f"c-{idx}"
+                    fake.create("", "v1", "pods", {
+                        "apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": f"{name}-pod",
+                                     "namespace": "default"},
+                        "spec": {"containers": [{"name": "c"}],
+                                 "nodeName": f"node-{idx % 4}",
+                                 "resourceClaims": [{
+                                     "name": "tpu",
+                                     "resourceClaimName": name}]},
+                    }, namespace="default")
+                    make_claim(fake, name, cel=(
+                        'device.attributes["tpu.dra.dev"].index == '
+                        f'{idx // 4}'))
+                assert sched.drain(30.0)
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    allocs, _ = allocated_keys(fake)
+                    if all(allocs.get(f"c-{i}") for i in range(8)):
+                        break
+                    time.sleep(0.02)
+            finally:
+                sched.stop()
+            return allocated_keys(fake)
+
+        single, d1 = run(1)
+        multi, d4 = run(4)
+        assert d1 == d4 == 0
+        assert single == multi
+        assert all(single[f"c-{i}"] for i in range(8))
+
+    def test_rebuild_during_patch_window_keeps_reservation(self):
+        """A state rebuild (safety resync) racing the patch window of
+        an in-flight commit must still see the reserved devices: the
+        commit-log entry lands BEFORE the patch, so the replay carries
+        the reservation into the fresh AllocationState instead of
+        resurrecting the device as free (double-allocation window)."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-a", chips=1))
+        sched = DraScheduler(fake)
+        sched.start_event_driven()
+        assert sched.drain(15.0)
+        real_patch = fake.patch
+        raced: dict = {}
+
+        def racing_patch(group, version, resource, name, patch,
+                         namespace=None, **kw):
+            if resource == "resourceclaims" and \
+                    (patch.get("status") or {}).get("allocation") and \
+                    "alloc2" not in raced:
+                # The resync fires exactly inside the patch window; the
+                # claim cache cannot contain this allocation yet.
+                _, raced["alloc2"] = sched._rebuild_alloc_state()
+            return real_patch(group, version, resource, name, patch,
+                              namespace=namespace, **kw)
+
+        fake.patch = racing_patch
+        try:
+            make_claim(fake, "c1")
+            assert sched.drain(15.0)
+            assert allocation(fake, "c1")
+        finally:
+            sched.stop()
+            fake.patch = real_patch
+        key = ("tpu.dra.dev", "node-a", "chip-0")
+        assert key in raced["alloc2"].allocated, \
+            "in-flight reservation lost across a state rebuild"
+
+    def test_commit_reserves_against_live_state_after_swap(self):
+        """A commit whose caller captured a since-superseded
+        AllocationState must reserve against the LIVE state: reserving
+        only into the dead capture would leave the live state showing
+        the devices free until the claim's watch event arrives."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-a", chips=1))
+        sched = DraScheduler(fake)  # direct mode: no events to mask it
+        make_claim(fake, "c1")
+        claim = fake.get(*RES, "resourceclaims", "c1", "default")
+        snap, alloc1 = sched._ensure_alloc_state()
+        classes = sched._device_classes()
+        _, alloc2 = sched._rebuild_alloc_state()  # the swap
+        assert alloc2 is not alloc1
+        assert sched._allocate_one(claim, snap, alloc1, classes)
+        key = ("tpu.dra.dev", "node-a", "chip-0")
+        assert key in alloc2.allocated, \
+            "reservation landed only in the superseded state"
+        assert allocation(fake, "c1")
+
+    def test_batch_setup_failure_releases_taken_keys(self):
+        """If the batched path's shared setup dies after take_ready,
+        every taken key must be finished (re-enqueued with its error)
+        -- otherwise those claims wedge as running forever."""
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import KubeError
+
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-a", chips=8))
+        sched = DraScheduler(fake, workers=1, batch_max=8)
+        sched.start_event_driven()
+        assert sched.drain(15.0)
+        orig = sched._device_classes
+        state = {"failed": False}
+
+        def flaky():
+            if not state["failed"]:
+                state["failed"] = True
+                raise KubeError(503, "transient")
+            return orig()
+
+        sched._device_classes = flaky
+        try:
+            block = threading.Event()
+            started = threading.Event()
+            sched._queue.enqueue(
+                ("block",), lambda k: (started.set(), block.wait(5.0)))
+            assert started.wait(5.0)
+            for i in range(5):
+                make_claim(fake, f"f-{i}")
+            time.sleep(0.1)
+            block.set()
+            assert sched.drain(30.0)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if all(allocation(fake, f"f-{i}") for i in range(5)):
+                    break
+                time.sleep(0.02)
+            assert all(allocation(fake, f"f-{i}") for i in range(5)), \
+                "batch-taken keys wedged after setup failure"
+        finally:
+            sched.stop()
+
+    def test_commit_conflict_metric_counts(self):
+        """A planned allocation whose devices vanish between fit and
+        reserve reports a conflict and re-fits."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-a", chips=2))
+        sm = SchedulerMetrics()
+        sched = DraScheduler(fake, sched_metrics=sm)
+        snap, alloc = sched._ensure_alloc_state()
+        classes = sched._device_classes()
+        make_claim(fake, "victim")
+        claim = fake.get(*RES, "resourceclaims", "victim", "default")
+
+        # Steal chip-0 between the fit and the reserve by wrapping
+        # try_commit's first invocation.
+        orig = alloc.try_commit
+        stolen = {"done": False}
+
+        def stealing(claim_like):
+            if not stolen["done"]:
+                stolen["done"] = True
+                orig({"metadata": {"uid": "thief", "name": "thief",
+                                   "namespace": "default"},
+                      "status": {"allocation": {"devices": {"results": [
+                          {"driver": "tpu.dra.dev", "pool": "node-a",
+                           "device": claim_like["status"]["allocation"][
+                               "devices"]["results"][0]["device"]},
+                      ]}}}})
+            return orig(claim_like)
+
+        alloc.try_commit = stealing
+        assert sched._allocate_one(claim, snap, alloc, classes)
+        got = allocation(fake, "victim")
+        assert got is not None
+        # The re-fit picked the surviving chip, not the stolen one.
+        thief_dev = next(iter(alloc._claims["thief"]))[2]
+        assert got["devices"]["results"][0]["device"] != thief_dev
+        text = sm.commit_conflicts.collect()[0].samples[0].value
+        assert text >= 1
+
+
+class TestBatchedAllocation:
+    def test_burst_drains_in_batches_and_all_allocate(self):
+        fake = FakeKubeClient()
+        apply_class(fake)
+        for node in ("node-a", "node-b", "node-c"):
+            publish_resource_slices(fake, node_slices(node))
+        sched = DraScheduler(fake, workers=1, batch_max=8,
+                             sched_metrics=SchedulerMetrics())
+        sched.start_event_driven()
+        assert sched.drain(15.0)
+        batches = []
+        orig = sched._queue.take_ready
+
+        def spy(pred, limit):
+            got = orig(pred, limit)
+            if got:
+                batches.append(len(got))
+            return got
+
+        sched._queue.take_ready = spy
+        try:
+            # Park the worker so the burst is all due at once.
+            block = threading.Event()
+            started = threading.Event()
+            sched._queue.enqueue(
+                ("block",), lambda k: (started.set(), block.wait(5.0)))
+            assert started.wait(5.0)
+            for i in range(10):
+                make_claim(fake, f"b-{i}")
+            time.sleep(0.1)  # let the claim events enqueue
+            block.set()
+            assert sched.drain(30.0)
+            assert all(allocation(fake, f"b-{i}") for i in range(10))
+        finally:
+            sched.stop()
+        # At least one multi-claim batch formed (amortized snapshot).
+        assert batches and max(batches) >= 2
+        _, doubles = allocated_keys(fake)
+        assert doubles == 0
+
+
+class TestSnapshotRace:
+    def test_stale_listing_never_installed_over_concurrent_bump(self):
+        """Satellite: an event-thread generation bump racing a
+        worker's snapshot() must never serve a stale-generation
+        snapshot to a commit. The stale listing (taken before the
+        bump) is detected via the slice generation and re-listed."""
+        fake = FakeKubeClient()
+        publish_resource_slices(fake, node_slices("node-a", chips=4))
+        view = ClusterView(fake)
+        stale = [dict(s) for s in fake.list(*RES, "resourceslices")]
+        # The inventory grows (generation bump) -- this is the state
+        # every commit from now on must see.
+        publish_resource_slices(fake, node_slices("node-a", chips=6))
+
+        orig_list = fake.list
+        raced = {"done": False}
+
+        def racy_list(group, version, resource, namespace=None, **kw):
+            if resource == "resourceslices" and not raced["done"]:
+                raced["done"] = True
+                # The "event" lands AFTER our listing was taken: bump
+                # the generation and hand back the stale world.
+                view.invalidate_snapshot()
+                return stale
+            return orig_list(group, version, resource,
+                             namespace=namespace, **kw)
+
+        fake.list = racy_list
+        snap = view.snapshot()
+        names = {c.name for c in snap.candidates}
+        assert "chip-5" in names, \
+            "stale-generation snapshot served to a commit"
+        assert raced["done"]
+
+    def test_snapshot_build_time_exported(self):
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-a"))
+        sm = SchedulerMetrics()
+        sched = DraScheduler(fake, sched_metrics=sm)
+        sched.sync_once()
+        from prometheus_client import generate_latest
+
+        text = generate_latest(sm.registry).decode()
+        assert "tpu_dra_sched_snapshot_build_seconds_count 1" in text
+
+    def test_concurrent_snapshot_readers_one_build(self):
+        fake = FakeKubeClient()
+        publish_resource_slices(fake, node_slices("node-a"))
+        builds = []
+        view = ClusterView(fake,
+                           on_snapshot_build=lambda dt: builds.append(dt))
+        snaps = []
+        threads = [threading.Thread(
+            target=lambda: snaps.append(view.snapshot()))
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5.0)
+        assert len({id(s) for s in snaps}) == 1
+        assert len(builds) == 1
+
+
+class TestSchedulingDomains:
+    def test_domains_partition_pools_and_claims(self):
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-a"))
+        publish_resource_slices(fake, node_slices("node-b"))
+        dom_a = SchedulingDomain("a", pools=["node-a"], default=True)
+        dom_b = SchedulingDomain("b", pools=["node-b"])
+        sched_a = DraScheduler(fake, domain=dom_a)
+        sched_b = DraScheduler(fake, domain=dom_b)
+        make_claim(fake, "c-plain")  # unannotated -> default domain a
+        make_claim(fake, "c-b", annotations={DOMAIN_ANNOTATION: "b"})
+        # b syncs first: it must not touch the default-domain claim.
+        sched_b.sync_once()
+        assert allocation(fake, "c-plain") is None
+        assert allocation(fake, "c-b")["devices"]["results"][0][
+            "pool"] == "node-b"
+        sched_a.sync_once()
+        got = allocation(fake, "c-plain")
+        assert got["devices"]["results"][0]["pool"] == "node-a"
+
+    def test_domain_snapshot_restricted_to_own_pools(self):
+        fake = FakeKubeClient()
+        publish_resource_slices(fake, node_slices("node-a"))
+        publish_resource_slices(fake, node_slices("node-b"))
+        sched = DraScheduler(
+            fake, domain=SchedulingDomain("b", pools=["node-b"]))
+        snap = sched.view.snapshot()
+        assert set(snap.by_node) == {"node-b"}
+
+    def test_domain_pool_globs(self):
+        dom = SchedulingDomain("edge", pools=["edge-*"])
+        assert dom.owns_pool("edge-7", "edge-7")
+        assert not dom.owns_pool("core-1", "core-1")
+
+    def test_generated_claim_inherits_pod_domain(self):
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-b"))
+        fake.create(*RES, "resourceclaimtemplates", {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": "tpl", "namespace": "default"},
+            "spec": {"spec": {"devices": {"requests": [
+                {"name": "tpu",
+                 "exactly": {"deviceClassName": "tpu.dra.dev"}}]}}},
+        }, namespace="default")
+        fake.create("", "v1", "pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "worker", "namespace": "default",
+                         "annotations": {DOMAIN_ANNOTATION: "b"}},
+            "spec": {"containers": [{"name": "c"}],
+                     "resourceClaims": [{
+                         "name": "tpu",
+                         "resourceClaimTemplateName": "tpl"}]},
+        }, namespace="default")
+        sched = DraScheduler(
+            fake, domain=SchedulingDomain("b", pools=["node-b"]))
+        sched.sync_once()
+        sched.sync_once()
+        pod = fake.get("", "v1", "pods", "worker", "default")
+        generated = pod["status"]["resourceClaimStatuses"][0][
+            "resourceClaimName"]
+        claim = fake.get(*RES, "resourceclaims", generated, "default")
+        assert claim["metadata"]["annotations"][DOMAIN_ANNOTATION] == "b"
+        assert claim["status"]["allocation"]
+
+    def test_leader_election_gates_domain_scheduler(self):
+        """Two instances of one domain: the standby idles (no queue,
+        no writes) until the leader steps down, then takes over."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-a"))
+        lease_kw = dict(lease_duration=1.0, renew_period=0.1,
+                        retry_period=0.05)
+        dom = SchedulingDomain("a", pools=["node-a"], default=True)
+        sched1 = DraScheduler(fake, domain=dom)
+        sched2 = DraScheduler(fake, domain=dom)
+        stop1, stop2 = threading.Event(), threading.Event()
+        t1 = threading.Thread(
+            target=run_leader_elected,
+            args=(sched1,), kwargs=dict(identity="i1", stop=stop1,
+                                        **lease_kw), daemon=True)
+        t1.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and sched1._queue is None:
+            time.sleep(0.01)
+        assert sched1._queue is not None, "leader never started"
+        t2 = threading.Thread(
+            target=run_leader_elected,
+            args=(sched2,), kwargs=dict(identity="i2", stop=stop2,
+                                        **lease_kw), daemon=True)
+        t2.start()
+        make_claim(fake, "c1")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not allocation(fake, "c1"):
+            time.sleep(0.02)
+        assert allocation(fake, "c1")
+        assert sched2._queue is None, "standby ran while leader held"
+        # Leader steps down; the standby must take over the domain.
+        stop1.set()
+        t1.join(10.0)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and sched2._queue is None:
+            time.sleep(0.02)
+        assert sched2._queue is not None, "standby never took over"
+        make_claim(fake, "c2")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not allocation(fake, "c2"):
+            time.sleep(0.02)
+        assert allocation(fake, "c2")
+        stop2.set()
+        t2.join(10.0)
+
+
+class TestInterleavedAllocation:
+    """Deterministic interleaving coverage (pkg/analysis/interleave)
+    of the sharded allocation protocol: two workers racing one node,
+    and a CD-window gang spanning both shards racing a single-node
+    claim. No deadlock, no double allocation, over DFS schedules."""
+
+    @pytest.fixture()
+    def instrumented(self):
+        current = {"sched": None}
+        orig_hold = NodeLockManager.hold
+        # The commit choice point sits at _commit_allocation entry
+        # (BEFORE the registry lock): yielding while holding a real
+        # lock would stall the cooperative explorer.
+        orig_commit = DraScheduler._commit_allocation
+        orig_patch = FakeKubeClient.patch
+
+        @contextmanager
+        def vhold(self, nodes):
+            vs = current["sched"]
+            if vs is None or vs._current() is None:
+                with orig_hold(self, nodes):
+                    yield
+                return
+            ids = sorted(set(nodes))
+            for n in ids:
+                vs.lock_acquire(("node", n), reentrant_error=False)
+            try:
+                yield
+            finally:
+                for n in reversed(ids):
+                    vs.lock_release(("node", n))
+
+        def vcommit(self, claim, alloc_obj, snap, alloc):
+            vs = current["sched"]
+            if vs is not None:
+                vs.yield_point("commit")
+            return orig_commit(self, claim, alloc_obj, snap, alloc)
+
+        def vpatch(self, *a, **kw):
+            vs = current["sched"]
+            if vs is not None:
+                vs.yield_point("kube.patch")
+            return orig_patch(self, *a, **kw)
+
+        NodeLockManager.hold = vhold
+        DraScheduler._commit_allocation = vcommit
+        FakeKubeClient.patch = vpatch
+        try:
+            yield current
+        finally:
+            NodeLockManager.hold = orig_hold
+            DraScheduler._commit_allocation = orig_commit
+            FakeKubeClient.patch = orig_patch
+
+    def test_two_workers_racing_one_node(self, instrumented):
+        """One free chip, two claims, two workers: exactly one claim
+        wins, the other pends; never a double allocation or deadlock."""
+
+        def build(vsched):
+            instrumented["sched"] = vsched
+            fake = FakeKubeClient.__new__(FakeKubeClient)
+            FakeKubeClient.__init__(fake)
+            apply_class(fake)
+            publish_resource_slices(fake, node_slices("node-a", chips=1))
+            make_claim(fake, "r1")
+            make_claim(fake, "r2")
+            dra = DraScheduler(fake)
+            dra._ensure_alloc_state()
+            vsched.fake = fake
+
+            def worker(name):
+                def run():
+                    dra._sync_claim_key("default", name)
+                return run
+
+            vsched.spawn(worker("r1"), name="w1")
+            vsched.spawn(worker("r2"), name="w2")
+
+        def invariant(vsched):
+            allocs, doubles = allocated_keys(vsched.fake)
+            assert doubles == 0
+            winners = [n for n, v in allocs.items() if v]
+            assert len(winners) == 1, f"expected one winner: {allocs}"
+
+        result = explore(build, invariant, max_schedules=300)
+        assert result.ok, "\n".join(str(f) for f in result.failures)
+        assert result.schedules_run > 1
+
+    def test_gang_window_spanning_shards_vs_single_node(self,
+                                                        instrumented):
+        """A CD-window gang claim whose multi-node lock set spans
+        node-a+node-b races a single-node claim on node-b: sorted
+        lock-set acquisition means no schedule deadlocks, and every
+        schedule converges with unique devices."""
+
+        def build(vsched):
+            instrumented["sched"] = vsched
+            fake = FakeKubeClient.__new__(FakeKubeClient)
+            FakeKubeClient.__init__(fake)
+            apply_class(fake)
+            publish_resource_slices(fake, node_slices("node-a", chips=1))
+            publish_resource_slices(fake, node_slices("node-b", chips=2))
+            make_claim(fake, "gang-1")
+            make_claim(fake, "solo")
+            dra = DraScheduler(fake)
+
+            orig_window = DraScheduler._preferred_gang_nodes
+
+            def windowed(self, claim):
+                if claim["metadata"]["name"].startswith("gang"):
+                    return ["node-a", "node-b"]
+                return orig_window(self, claim)
+
+            dra._preferred_gang_nodes = windowed.__get__(dra)
+            dra._ensure_alloc_state()
+            vsched.fake = fake
+
+            def worker(name):
+                def run():
+                    dra._sync_claim_key("default", name)
+                return run
+
+            vsched.spawn(worker("gang-1"), name="gang")
+            vsched.spawn(worker("solo"), name="solo")
+
+        def invariant(vsched):
+            allocs, doubles = allocated_keys(vsched.fake)
+            assert doubles == 0
+            # Capacity 3, demand 2: both always converge.
+            assert allocs["gang-1"] and allocs["solo"], allocs
+
+        result = explore(build, invariant, max_schedules=300)
+        assert result.ok, "\n".join(str(f) for f in result.failures)
+
+
+class TestWorkqueueMetricsExposition:
+    def test_queue_and_snapshot_metrics_on_scheduler_registry(self):
+        from prometheus_client import generate_latest
+
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-a"))
+        sm = SchedulerMetrics()
+        sched = DraScheduler(fake, workers=2, sched_metrics=sm)
+        sched.start_event_driven()
+        assert sched.drain(15.0)
+        make_claim(fake, "c1")
+        assert sched.drain(15.0)
+        sched.stop()
+        text = generate_latest(sm.registry).decode()
+        assert 'tpu_dra_workqueue_depth{shard=' in text
+        assert "tpu_dra_workqueue_wait_seconds_count" in text
+        assert "tpu_dra_workqueue_retries_total" in text
+        assert "tpu_dra_workqueue_hot_backoff_total" in text
+        assert "tpu_dra_sched_snapshot_build_seconds" in text
+        assert "tpu_dra_sched_commit_conflicts_total" in text
+
+
+class TestAllocationStateConcurrency:
+    def test_try_commit_rejects_taken_device(self):
+        snap = InventorySnapshot(node_slices("node-a", chips=2))
+        alloc = AllocationState(snap)
+        taken = {
+            "metadata": {"uid": "u1", "name": "c1",
+                         "namespace": "default"},
+            "status": {"allocation": {"devices": {"results": [
+                {"driver": "tpu.dra.dev", "pool": "node-a",
+                 "device": "chip-0"}]}}},
+        }
+        assert alloc.try_commit(taken)
+        rival = {
+            "metadata": {"uid": "u2", "name": "c2",
+                         "namespace": "default"},
+            "status": {"allocation": {"devices": {"results": [
+                {"driver": "tpu.dra.dev", "pool": "node-a",
+                 "device": "chip-0"}]}}},
+        }
+        assert not alloc.try_commit(rival)
+        # Idempotent replay of the winner's own reservation.
+        assert alloc.try_commit(taken)
+        assert alloc.node_load == {"node-a": 1}
+
+    def test_node_load_maintained_incrementally(self):
+        snap = InventorySnapshot(node_slices("node-a", chips=4))
+        alloc = AllocationState(snap)
+        claims = []
+        for i in range(3):
+            c = {
+                "metadata": {"uid": f"u{i}", "name": f"c{i}",
+                             "namespace": "default"},
+                "status": {"allocation": {"devices": {"results": [
+                    {"driver": "tpu.dra.dev", "pool": "node-a",
+                     "device": f"chip-{i}"}]}}},
+            }
+            claims.append(c)
+            alloc.observe(c)
+        assert alloc.load_view() == {"node-a": 3}
+        alloc.forget(claims[0])
+        assert alloc.load_view() == {"node-a": 2}
+
+    def test_concurrent_observe_forget_stress(self):
+        snap = InventorySnapshot(node_slices("node-a", chips=8))
+        alloc = AllocationState(snap)
+        errs = []
+
+        def churn(base):
+            try:
+                for i in range(200):
+                    c = {
+                        "metadata": {"uid": f"{base}-{i % 4}",
+                                     "name": f"{base}-{i % 4}",
+                                     "namespace": "default"},
+                        "status": {"allocation": {"devices": {
+                            "results": [{
+                                "driver": "tpu.dra.dev",
+                                "pool": "node-a",
+                                "device": f"chip-{i % 8}"}]}}},
+                    }
+                    alloc.observe(c)
+                    alloc.load_view()
+                    alloc.ledger_snapshot()
+                    alloc.forget(c)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=churn, args=(f"t{j}",))
+                   for j in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20.0)
+        assert not errs
+        assert alloc.load_view() == {}
+        assert not alloc.allocated
