@@ -4,8 +4,8 @@ PYTHON ?= python
 
 .PHONY: all native test test-fast bench bench-smoke \
 	bench-placement-smoke bench-chaos-smoke bench-sched-smoke \
-	bench-sched-scale bench-recovery-smoke lint lint-analysis clean \
-	stamp-version
+	bench-sched-scale bench-recovery-smoke bench-serving-smoke \
+	lint lint-analysis clean stamp-version
 
 VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
 
@@ -87,6 +87,21 @@ bench-recovery-smoke:
 	BENCH_RECOVERY_DEADLINE_S=1.0 \
 	BENCH_RECOVERY_OUT=$(or $(BENCH_RECOVERY_OUT),/tmp/BENCH_recovery_smoke.json) \
 	$(PYTHON) bench.py --recovery
+
+# Multi-tenant serving smoke: a shrunk `--serving` run (4 nodes x 96
+# tenants through the partition engine + slot-aware scheduler) with
+# the full gate set enforced deterministically: tenant density >= 4x
+# the whole-chip baseline, ZERO counter over-commit, every active
+# tenant converged, carve-out create p99 bounded, converged republish
+# = zero writes, and both partition crash points (mid-create /
+# mid-destroy) resuming idempotently under a fresh plugin. Mirrored as
+# a non-slow test in tests/test_bench_serving_smoke.py; the full-scale
+# trajectory file is BENCH_serving.json (plain `bench.py --serving`).
+bench-serving-smoke:
+	BENCH_SERVING_NODES=4 BENCH_SERVING_TENANTS=96 \
+	BENCH_SERVING_BURST=24 BENCH_SERVING_ROUNDS=3 \
+	BENCH_SERVING_OUT=$(or $(BENCH_SERVING_OUT),/tmp/BENCH_serving_smoke.json) \
+	$(PYTHON) bench.py --serving
 
 # Scheduler-churn smoke: a shrunk `--sched-churn` trace (8 nodes x 24
 # claims of paired pod+claim churn + unchanged health republishes)

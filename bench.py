@@ -42,6 +42,7 @@ extras carries the secondary metrics:
 
 import json
 import os
+import random
 import statistics
 import sys
 import tempfile
@@ -1892,6 +1893,386 @@ def bench_recovery() -> dict:
     }
 
 
+def bench_serving() -> dict:
+    """Multi-tenant inference-serving mode (`bench.py --serving`):
+    hundreds of small tenants across a v5e pool through the partition
+    engine + slot-aware scheduler, vs the whole-chip baseline.
+
+    Pipeline (the pkg/partition stack end to end):
+
+    1. **Profile** (MISO): seeded per-tenant HBM demands feed the
+       TenantProfileStore; the SizingPolicy picks the smallest
+       partition profile whose per-tenant budget covers the p95 demand
+       from a slot-count catalog (1/2/4/8 tenants per chip).
+    2. **Pack** (ParvaGPU): the planning view packs the tenant
+       population onto the pool's chips best-fit-decreasing.
+    3. **Serve**: every node publishes chips + the chosen partition
+       devices (KEP-4815 counters, oversubscribeSlots); tenant claims
+       arrive in bursts with churn (a seeded fraction of each burst
+       retires) against the event-driven scheduler; the whole-chip
+       baseline runs the same arrival trace against chips only.
+    4. **Node proof**: a REAL DeviceState + PartitionEngine node
+       prepares/unprepares tenant claims (carve-out create p99 from
+       the prep_attach_partition segment), and the partition
+       create/destroy crash points (fault seams partition.create /
+       partition.destroy) are proven to resume idempotently under a
+       fresh plugin.
+
+    Gates (`make bench-serving-smoke` / tier-1 mirror): tenant density
+    >= BENCH_SERVING_MIN_TENANT_RATIO x baseline (default 4.0), ZERO
+    counter over-commit (recomputed from the final allocations), all
+    active tenants converged, carve-out create p99 <=
+    BENCH_SERVING_MAX_CREATE_P99_MS (default 1000 -- the reference's
+    O(1 s) dynamic-partition envelope; measured ~14 ms p99 on an idle
+    box, the headroom absorbs CI-box fsync noise), converged republish
+    = zero writes, both crash points resumed. Emits
+    BENCH_serving.json (BENCH_SERVING_OUT).
+
+    Knobs: BENCH_SERVING_NODES (12), BENCH_SERVING_TENANTS (300),
+    BENCH_SERVING_BURST (40), BENCH_SERVING_CHURN (0.15),
+    BENCH_SERVING_SEED, BENCH_SERVING_ROUNDS (8, node-proof
+    prepare/unprepare rounds)."""
+    from k8s_dra_driver_gpu_tpu.kubeletplugin import DRIVER_NAME
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.claim import (
+        DeviceResult,
+        OpaqueConfig,
+        ResourceClaim,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+        Config,
+        DeviceState,
+        PrepareError,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.deviceinfo import (
+        AllocatableDevice,
+        ChipInfo,
+        DeviceKind,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.partitions import (
+        consumed_counters,
+        shared_counter_sets,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg import faults
+    from k8s_dra_driver_gpu_tpu.pkg.cel import Quantity
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.partition import (
+        PartitionDemand,
+        PartitionProfile,
+        PartitionSet,
+        SizingPolicy,
+        TenantProfileStore,
+        pack_tenants,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.partition.engine import (
+        catalog_for,
+        partition_devices,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+    from k8s_dra_driver_gpu_tpu.pkg.sliceutil import (
+        publish_resource_slices,
+    )
+    from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+        EnumerateOptions,
+        PyTpuLib,
+    )
+
+    nodes_n = _env_int("BENCH_SERVING_NODES", 12)
+    tenants_n = _env_int("BENCH_SERVING_TENANTS", 300)
+    burst = max(1, _env_int("BENCH_SERVING_BURST", 40))
+    rounds = max(1, _env_int("BENCH_SERVING_ROUNDS", 8))
+    seed = _env_int("BENCH_SERVING_SEED", 20260803)
+    try:
+        churn = float(os.environ.get("BENCH_SERVING_CHURN", "0.15"))
+    except ValueError:
+        churn = 0.15
+    rng = random.Random(seed)
+    RES = ("resource.k8s.io", "v1")
+    topology = "v5e-4"
+
+    lib = PyTpuLib()
+    opts = EnumerateOptions(mock_topology=topology)
+    host = lib.enumerate(opts)
+    tpu_profiles = lib.subslice_profiles(opts)
+    chip_hbm = host.hbm_bytes_per_chip
+    chips_per_node = len(host.chips)
+    total_chips = nodes_n * chips_per_node
+
+    # -- 1) MISO: profile-then-choose ----------------------------------------
+    store = TenantProfileStore(defaults={})
+    for _ in range(tenants_n):
+        # Small inference tenants: 1.0-1.9 GiB working sets.
+        store.observe("serving", int((1.0 + rng.random() * 0.9)
+                                     * (1 << 30)))
+    demand = store.demand("serving", percentile=0.95)
+    one_chip = next(p.name for p in tpu_profiles if p.chips == 1)
+    candidates = PartitionSet(profiles=tuple(
+        PartitionProfile(name=f"serv{s}", subslice=one_chip,
+                         max_tenants=s)
+        for s in (1, 2, 4, 8)
+    ))
+    choice = SizingPolicy(0.95).pick(
+        demand, catalog_for(host, tpu_profiles, candidates))
+    assert choice is not None, "no partition profile covers the demand"
+    chosen = PartitionSet(profiles=(choice.profile,))
+    slots = choice.profile.max_tenants
+
+    # -- 2) ParvaGPU packing plan (planning view) ----------------------------
+    plan = pack_tenants(
+        [PartitionDemand(hbm_bytes=demand.hbm_bytes, count=tenants_n,
+                         tenant="serving")],
+        chip_hbm, total_chips, max_tenants_per_chip=slots)
+
+    # -- 3) fleet trace: whole-chip baseline vs partition serving ------------
+    def node_slices(i: int, with_partitions: bool) -> list:
+        node = f"node-{i}"
+        devs = []
+        for chip in host.chips:
+            dev = AllocatableDevice(
+                kind=DeviceKind.CHIP, chip=ChipInfo(chip=chip, host=host))
+            entry = dev.to_dra_device()
+            entry["consumesCounters"] = consumed_counters(dev, host)
+            devs.append(entry)
+        if with_partitions:
+            for dev in partition_devices(host, tpu_profiles,
+                                         chosen).values():
+                entry = dev.to_dra_device()
+                entry["consumesCounters"] = consumed_counters(dev, host)
+                devs.append(entry)
+        return [{
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": f"{node}-{DRIVER_NAME}"},
+            "spec": {
+                "driver": DRIVER_NAME, "nodeName": node,
+                "pool": {"name": node, "generation": 1,
+                         "resourceSliceCount": 1},
+                "sharedCounters": shared_counter_sets(host),
+                "devices": devs,
+            },
+        }]
+
+    def run_trace(with_partitions: bool) -> dict:
+        fake = FakeKubeClient()
+        alloc_times: dict = {}
+        counted = _CountingKube(fake, alloc_times)
+        selector = f'device.driver == "{DRIVER_NAME}"'
+        if with_partitions:
+            selector += (f' && device.attributes["{DRIVER_NAME}"]'
+                         '.partition')
+        fake.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "tpu-serving-tenant"},
+            "spec": {"selectors": [{"cel": {"expression": selector}}]},
+        })
+        for i in range(nodes_n):
+            publish_resource_slices(fake, node_slices(i, with_partitions))
+        sched = DraScheduler(counted, workers=1)
+        sched.start_event_driven()
+        sched.drain(30)
+        trace_rng = random.Random(seed + 1)  # identical across modes
+        prev_burst: list[str] = []
+        arrived = 0
+        t0 = time.perf_counter()
+        while arrived < tenants_n:
+            want = min(burst, tenants_n - arrived)
+            names = [f"tenant-{arrived + k}" for k in range(want)]
+            arrived += want
+            # Churn: a seeded fraction of the PREVIOUS burst retires
+            # (request completed) before the next burst lands.
+            retire = [n for n in prev_burst
+                      if trace_rng.random() < churn]
+            for name in retire:
+                fake.delete(*RES, "resourceclaims", name,
+                            namespace="default")
+            for name in names:
+                fake.create(*RES, "resourceclaims", {
+                    "apiVersion": "resource.k8s.io/v1",
+                    "kind": "ResourceClaim",
+                    "metadata": {"name": name, "namespace": "default"},
+                    "spec": {"devices": {"requests": [{
+                        "name": "tenant",
+                        "exactly": {
+                            "deviceClassName": "tpu-serving-tenant"},
+                    }]}},
+                }, namespace="default")
+            prev_burst = names
+            sched.drain(30)
+        sched.drain(30)
+        elapsed = time.perf_counter() - t0
+        # Final state: who is allocated, and what do they consume?
+        claims = fake.list(*RES, "resourceclaims")
+        allocated = [c for c in claims
+                     if c.get("status", {}).get("allocation")]
+        pending = [c["metadata"]["name"] for c in claims
+                   if not c.get("status", {}).get("allocation")]
+        # Counter audit: recompute every pool's consumption from the
+        # final allocations; ANY counter above its shared capacity is
+        # an over-commit (the thing the virtual-capacity split must
+        # make impossible).
+        slices = fake.list(*RES, "resourceslices")
+        capacity: dict[tuple, int] = {}
+        consumes_of: dict[tuple, list] = {}
+        for s in slices:
+            spec = s["spec"]
+            pool = spec["pool"]["name"]
+            for cs in spec.get("sharedCounters") or []:
+                for cname, val in (cs.get("counters") or {}).items():
+                    capacity[(pool, cs["name"], cname)] = Quantity.parse(
+                        val["value"]).milli
+            for dev in spec.get("devices", []):
+                consumes_of[(pool, dev["name"])] = \
+                    dev.get("consumesCounters") or []
+        used: dict[tuple, int] = {}
+        for c in allocated:
+            for r in c["status"]["allocation"]["devices"]["results"]:
+                for block in consumes_of.get(
+                        (r["pool"], r["device"]), []):
+                    for cname, val in (block.get("counters")
+                                       or {}).items():
+                        key = (r["pool"], block.get("counterSet", ""),
+                               cname)
+                        used[key] = used.get(key, 0) + Quantity.parse(
+                            val["value"]).milli
+        over = sorted(
+            key for key, milli in used.items()
+            if milli > capacity.get(key, 0)
+        )
+        # Converged republish: every node re-publishes its UNCHANGED
+        # slice set through the diff -- must cost zero writes.
+        republish_writes = 0
+        for i in range(nodes_n):
+            stats = publish_resource_slices(
+                counted, node_slices(i, with_partitions), diff=True)
+            republish_writes += stats["writes"]
+        sched.stop()
+        return {
+            "arrived": arrived,
+            "active": len(allocated),
+            "pending": len(pending),
+            "ever_allocated": len(alloc_times),
+            "density": round(len(allocated) / max(total_chips, 1), 2),
+            "overcommitted_counters": len(over),
+            "republish_writes": republish_writes,
+            "elapsed_s": round(elapsed, 3),
+        }
+
+    baseline = run_trace(with_partitions=False)
+    serving = run_trace(with_partitions=True)
+    ratio = serving["density"] / max(baseline["density"], 1e-9)
+
+    # -- 4) node proof: real DeviceState + engine, churn + crash points ------
+    import shutil  # noqa: PLC0415
+
+    gates = ("DynamicSubSlice=true,TimeSlicingSettings=true,"
+             "MultiTenancySupport=true,TenantPartitioning=true")
+    node_root = tempfile.mkdtemp(prefix="bench-serving-")
+    oversub_cfg = OpaqueConfig(
+        parameters={"apiVersion": "resource.tpu.dra/v1beta1",
+                    "kind": "SubSliceConfig", "oversubscribe": True},
+        requests=(), source="FromClaim")
+
+    def tenant_claim(uid: str, device: str) -> ResourceClaim:
+        return ResourceClaim(
+            uid=uid, namespace="default", name=uid,
+            results=[DeviceResult(request="tenant", driver=DRIVER_NAME,
+                                  pool="bench", device=device)],
+            configs=[oversub_cfg] if slots > 1 else [])
+
+    create_p99_ms = None
+    crash_create_resumed = False
+    crash_destroy_resumed = False
+    try:
+        state = DeviceState(Config.mock(
+            root=node_root, topology=topology, gates=gates,
+            partition_set=chosen))
+        part_names = sorted(
+            n for n, d in state.allocatable.items()
+            if d.kind == DeviceKind.PARTITION)
+        # Churn rounds: each round creates every partition's carve-out
+        # fresh (prepare one tenant per partition, then unprepare), so
+        # the segment samples are genuine create paths.
+        for r in range(rounds):
+            uids = [f"serv-{r}-{k}" for k in range(len(part_names))]
+            for uid, name in zip(uids, part_names):
+                state.prepare(tenant_claim(uid, name))
+            for uid in uids:
+                state.unprepare(uid)
+        samples = state.segment_samples("prep_attach_partition")
+        create_p99_ms = _p99_ms(samples)
+        # Crash point 1: mid-create. The fault fires AFTER the durable
+        # PartitionCreating record; a fresh plugin must resolve it and
+        # a retried prepare must succeed.
+        faults.arm("partition.create", mode="error", count=1)
+        try:
+            state.prepare(tenant_claim("crash-c", part_names[0]))
+        except PrepareError:
+            pass
+        faults.reset()
+        state2 = DeviceState(Config.mock(
+            root=node_root, topology=topology, gates=gates,
+            partition_set=chosen))
+        state2.prepare(tenant_claim("crash-c", part_names[0]))
+        crash_create_resumed = (
+            "crash-c" in state2.prepared_claims()
+            and len(state2.subslice_registry.list()) == 1)
+        # Crash point 2: mid-destroy. The Destroying record survives
+        # the failed unprepare; the retry (same plugin) and a fresh
+        # plugin both converge to zero records, zero carve-outs.
+        faults.arm("partition.destroy", mode="error", count=1)
+        try:
+            state2.unprepare("crash-c")
+        except Exception:  # noqa: BLE001 - injected
+            pass
+        faults.reset()
+        state2.unprepare("crash-c")
+        state3 = DeviceState(Config.mock(
+            root=node_root, topology=topology, gates=gates,
+            partition_set=chosen))
+        crash_destroy_resumed = (
+            state3.subslice_registry.list() == {}
+            and state3.partition_engine.active_partitions() == 0)
+    finally:
+        faults.reset()
+        shutil.rmtree(node_root, ignore_errors=True)
+
+    extras = {
+        "serving_nodes": nodes_n,
+        "serving_total_chips": total_chips,
+        "serving_tenants": tenants_n,
+        "serving_churn": churn,
+        "serving_demand_p95_bytes": demand.hbm_bytes,
+        "serving_profile": choice.profile.name,
+        "serving_profile_slots": slots,
+        "serving_tenant_hbm_budget": choice.per_tenant_hbm,
+        "serving_pack_tenants_per_chip": round(
+            plan.tenants_per_chip, 2),
+        "serving_pack_waste_fraction": round(plan.waste_fraction, 4),
+        "serving_density_ratio": round(ratio, 2),
+        "serving_create_p99_ms": create_p99_ms,
+        "serving_crash_create_resumed": crash_create_resumed,
+        "serving_crash_destroy_resumed": crash_destroy_resumed,
+    }
+    for mode, r in (("baseline", baseline), ("serving", serving)):
+        for key, val in r.items():
+            extras[f"serving_{mode}_{key}"] = val
+    return {
+        "metric": "serving_tenants_per_chip",
+        "value": serving["density"],
+        "unit": "tenants/chip",
+        "vs_baseline": round(ratio, 2),
+        "extras": extras,
+    }
+
+
+def _write_serving_json(result: dict) -> None:
+    out_path = os.environ.get(
+        "BENCH_SERVING_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_serving.json"))
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
 def bench_lint_findings() -> dict:
     """Static-analysis finding counts (pkg/analysis linter) in the
     metrics-friendly shape BASELINE.md tracks across PRs: the bench/CI
@@ -2060,6 +2441,54 @@ def _dispatch() -> None:
                    "sched_write_reduction")
         ok = _gate("BENCH_SCHED_MIN_CONV_RATIO",
                    "sched_convergence_speedup_p50") and ok
+        if not ok:
+            sys.exit(1)
+        return
+    if "--serving" in sys.argv[1:]:
+        result = bench_serving()
+        _write_serving_json(result)
+        print(json.dumps(result))
+        ex = result["extras"]
+        ok = True
+        if ex["serving_serving_overcommitted_counters"] or \
+                ex["serving_baseline_overcommitted_counters"]:
+            print("serving gate failed: counter over-commit",
+                  file=sys.stderr)
+            ok = False
+        if ex["serving_serving_pending"]:
+            print("serving gate failed: "
+                  f"{ex['serving_serving_pending']} tenants never "
+                  "converged", file=sys.stderr)
+            ok = False
+        if ex["serving_serving_republish_writes"]:
+            print("serving gate failed: converged republish wrote "
+                  f"{ex['serving_serving_republish_writes']} slices",
+                  file=sys.stderr)
+            ok = False
+        if not (ex["serving_crash_create_resumed"]
+                and ex["serving_crash_destroy_resumed"]):
+            print("serving gate failed: partition crash point did not "
+                  "resume idempotently", file=sys.stderr)
+            ok = False
+        try:
+            floor = float(os.environ.get(
+                "BENCH_SERVING_MIN_TENANT_RATIO", "4.0"))
+        except ValueError:
+            floor = 4.0
+        if floor and result["vs_baseline"] < floor:
+            print("serving gate failed: density ratio "
+                  f"{result['vs_baseline']} < {floor}", file=sys.stderr)
+            ok = False
+        try:
+            cap_ms = float(os.environ.get(
+                "BENCH_SERVING_MAX_CREATE_P99_MS", "1000"))
+        except ValueError:
+            cap_ms = 1000.0
+        p99 = ex["serving_create_p99_ms"]
+        if cap_ms and (p99 is None or p99 > cap_ms):
+            print(f"serving gate failed: create p99 {p99}ms > "
+                  f"{cap_ms}ms", file=sys.stderr)
+            ok = False
         if not ok:
             sys.exit(1)
         return

@@ -178,11 +178,20 @@ class TpuConfig:
 @dataclass
 class SubSliceConfig:
     """Config for sub-slice carve-out claims (MigDeviceConfig analog,
-    migconfig.go:28)."""
+    migconfig.go:28). Also the config kind for partition devices
+    (pkg/partition): a tenant claim targeting an OVERSUBSCRIBED
+    partition (one whose device advertises ``oversubscribeSlots`` > 1)
+    must set ``oversubscribe: true`` -- the explicit opt-in to sharing
+    a carve-out cooperatively with up to N-1 other tenants."""
 
     KIND = "SubSliceConfig"
 
     sharing: Sharing | None = None
+    # Opt-in to time-slice oversubscription on a shared partition
+    # device. Preparing an oversubscribed partition WITHOUT this flag
+    # fails: a workload must never be co-scheduled onto shared cores it
+    # did not agree to share.
+    oversubscribe: bool = False
 
     def normalize(self) -> None:
         if self.sharing is None:
@@ -192,6 +201,13 @@ class SubSliceConfig:
     def validate(self) -> None:
         if self.sharing:
             self.sharing.validate()
+        if self.oversubscribe and self.sharing and \
+                self.sharing.is_multi_tenancy:
+            raise ValidationError(
+                "oversubscribe provisions its own per-tenant tenancy "
+                "enforcement; a MultiTenancy sharing config cannot be "
+                "combined with it"
+            )
 
 
 @dataclass

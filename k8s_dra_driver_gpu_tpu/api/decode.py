@@ -44,7 +44,8 @@ _KINDS: dict[str, Type] = {
 # JSON field name -> dataclass attribute per type.
 _FIELD_MAPS: dict[Type, dict[str, str]] = {
     TpuConfig: {"sharing": "sharing"},
-    SubSliceConfig: {"sharing": "sharing"},
+    SubSliceConfig: {"sharing": "sharing",
+                     "oversubscribe": "oversubscribe"},
     PassthroughConfig: {"iommuMode": "iommu_mode"},
     ComputeDomainChannelConfig: {
         "domainID": "domain_id",

@@ -33,10 +33,13 @@ from ..pkg.featuregates import (
     DYNAMIC_SUB_SLICE,
     MULTI_TENANCY_SUPPORT,
     PASSTHROUGH_SUPPORT,
+    TENANT_PARTITIONING,
     TIME_SLICING_SETTINGS,
     FeatureGates,
 )
 from ..pkg.analysis.statemachine import TWO_PHASE_POLICY
+from ..pkg.partition.engine import PartitionEngine, PartitionEngineError
+from ..pkg.partition.spec import PartitionSet
 from ..pkg.flock import Flock
 from ..pkg.fsutil import write_json_atomic
 from ..pkg.timing import SegmentTimer
@@ -89,6 +92,12 @@ class Config:
     # "chip-0-ss-1c-1". Published as-is; Prepare does not create (and
     # Unprepare does not destroy) a carve-out for them.
     static_subslices: tuple[str, ...] = ()
+    # Desired multi-tenant partition layout (pkg/partition). Requires
+    # the TenantPartitioning feature gate; None = no partition engine.
+    partition_set: PartitionSet | None = None
+    # Pool identity for PartitionSet pool globs (node-local pools are
+    # named after the node); None = every PartitionSet applies.
+    pool_name: str | None = None
 
     @classmethod
     def mock(
@@ -99,6 +108,7 @@ class Config:
         gates: str = "DynamicSubSlice=true,TimeSlicingSettings=true,"
         "MultiTenancySupport=true",
         tenancy_agents: bool = False,
+        partition_set: PartitionSet | None = None,
     ) -> "Config":
         return cls(
             root=root,
@@ -108,6 +118,7 @@ class Config:
             feature_gates=FeatureGates.parse(gates),
             cdi_root=os.path.join(root, "cdi"),
             tenancy_agents=tenancy_agents,
+            partition_set=partition_set,
         )
 
 
@@ -330,6 +341,18 @@ class DeviceState:
             # root (sharing policies, tenancy dirs, CDI specs, live
             # carve-outs) must go with them or holder entries leak.
             self._cleanup_all_side_state()
+        # Multi-tenant partition engine (pkg/partition): desired
+        # partition devices join the allocatable set, and crashed
+        # create/destroy records resolve BEFORE the unknown-state sweep
+        # (the sweep consults the engine's live uuids, so a mid-
+        # lifecycle carve-out is never read as an orphan).
+        self.partition_engine: PartitionEngine | None = None
+        if config.partition_set is not None and \
+                config.feature_gates.is_enabled(TENANT_PARTITIONING):
+            self.partition_engine = PartitionEngine(
+                self, config.partition_set, pool=config.pool_name)
+            self.allocatable.update(self.partition_engine.devices())
+            self.partition_engine.resume()
         self.destroy_unknown_subslices()
         # Re-own tenancy state for claims that survived the restart
         # (respawn their enforcement agents; drop orphan dirs). A live
@@ -356,6 +379,75 @@ class DeviceState:
 
     def tenancy_agent_count(self) -> int:
         return self._tenancy.agent_count()
+
+    # -- partition-engine collaborator surface --------------------------------
+    # (public accessors so pkg/partition/engine.py never reaches into
+    # underscore state; the registry alias keeps carve-out create/
+    # destroy textually recognizable to lint rule TPUDRA011.)
+
+    @property
+    def config_root(self) -> str:
+        return self._config.root
+
+    @property
+    def boot_id(self) -> str | None:
+        return self._config.boot_id
+
+    @property
+    def subslice_profiles(self):
+        return self._profiles
+
+    @property
+    def subslice_registry(self) -> SubSliceRegistry:
+        return self._registry
+
+    def apply_partition_set(self, partition_set: PartitionSet) -> None:
+        """Swap in a new desired partition layout (profile-guided
+        re-plan): the allocatable set is rebuilt atomically; callers
+        republish slices afterwards.
+
+        Partitions of RETIRED profiles that still have lifecycle
+        records (live tenants, or a mid-flight teardown) stay in the
+        allocatable set: overlap validation and the sharing-release
+        math read their cores from here, so dropping them early would
+        blind the node to cores a live workload occupies. New attaches
+        to them already fail (the engine's desired set no longer knows
+        the device); prune_retired_partitions() sweeps them out once
+        their records are gone."""
+        if self.partition_engine is None:
+            raise PrepareError("partition engine not enabled")
+        devices = self.partition_engine.apply(partition_set)
+        held = self.partition_engine.recorded_devices()
+        with self._lock:
+            merged = {
+                name: dev for name, dev in self.allocatable.items()
+                if dev.kind != DeviceKind.PARTITION
+                or (name in held and name not in devices)
+            }
+            merged.update(devices)
+            self.allocatable = merged
+
+    def prune_retired_partitions(self) -> int:
+        """Drop partition devices that are neither desired nor backed
+        by a lifecycle record anymore (a re-plan retired them and their
+        last tenant has since detached). Returns devices pruned; the
+        next publish drops them from the ResourceSlices."""
+        if self.partition_engine is None:
+            return 0
+        desired = set(self.partition_engine.devices())
+        held = self.partition_engine.recorded_devices()
+        with self._lock:
+            retired = [
+                name for name, dev in self.allocatable.items()
+                if dev.kind == DeviceKind.PARTITION
+                and name not in desired and name not in held
+            ]
+            if retired:
+                merged = dict(self.allocatable)
+                for name in retired:
+                    del merged[name]
+                self.allocatable = merged
+        return len(retired)
 
     # -- enumeration ----------------------------------------------------------
 
@@ -503,6 +595,11 @@ class DeviceState:
                 for dev in c.devices
                 if dev.live and "uuid" in dev.live  # vfio: no uuid
             }
+            if self.partition_engine is not None:
+                # Partition carve-outs mid-lifecycle (Creating/Ready/
+                # Destroying records) are owned by the engine, not by
+                # claim records alone.
+                referenced |= self.partition_engine.live_uuids()
             destroyed = 0
             for uid in list(self._registry.list()):
                 if uid not in referenced:
@@ -779,26 +876,63 @@ class DeviceState:
         with self._history_lock:
             return list(self._segment_history.get(name, ()))
 
+    def _slots_of(self, canonical_name: str) -> int:
+        """Tenant-slot count of a device: oversubscribed partition
+        devices admit up to maxTenants concurrent claims; everything
+        else is exclusive (1)."""
+        dev = self.allocatable.get(canonical_name)
+        if dev is not None and dev.kind == DeviceKind.PARTITION and \
+                dev.partition is not None:
+            return dev.partition.profile.max_tenants
+        return 1
+
     def _validate_no_overlap(self, cp, claim: ResourceClaim) -> None:
         """Reject preparing a device whose chips/cores another claim holds
         (guards scheduler races; device_state.go:1212-1249).
 
         PrepareStarted claims count too: their device list is the
         RESERVATION an in-flight prepare wrote before leaving the global
-        section (legacy records without devices can't conflict)."""
-        held: dict[int, str] = {}  # core index -> claim uid
+        section (legacy records without devices can't conflict).
+
+        Oversubscribed partition devices (pkg/partition) are the one
+        sanctioned overlap: up to ``maxTenants`` claims may hold the
+        SAME device (they cooperatively share its cores), but its cores
+        still exclude every OTHER device, and the slot budget is a hard
+        cap -- the node-side mirror of the scheduler's slot-aware
+        allocation."""
+        held: dict[int, tuple[str, str]] = {}  # core -> (device, uid)
+        holders: dict[str, set[str]] = {}  # device -> holder uids
         for other in cp.claims.values():
             if other.uid == claim.uid:
                 continue
             for dev in other.devices:
+                holders.setdefault(dev.canonical_name, set()).add(
+                    other.uid)
                 for core in self._cores_of(dev.canonical_name):
-                    held[core] = other.uid
+                    held[core] = (dev.canonical_name, other.uid)
         for result in claim.results:
+            slots = self._slots_of(result.device)
+            if slots > 1:
+                already = holders.get(result.device, set())
+                if len(already) >= slots:
+                    raise PrepareError(
+                        f"device {result.device} has no free tenant "
+                        f"slot ({len(already)}/{slots} held)"
+                    )
+                for core in self._cores_of(result.device):
+                    entry = held.get(core)
+                    if entry is not None and entry[0] != result.device:
+                        raise PrepareError(
+                            f"device {result.device} overlaps with "
+                            f"prepared claim {entry[1]} (device "
+                            f"{entry[0]})"
+                        )
+                continue
             for core in self._cores_of(result.device):
                 if core in held:
                     raise PrepareError(
                         f"device {result.device} overlaps with prepared "
-                        f"claim {held[core]}"
+                        f"claim {held[core][1]}"
                     )
 
     def _cores_of(self, canonical_name: str) -> tuple[int, ...]:
@@ -817,6 +951,8 @@ class DeviceState:
                 pos * self.host.cores_per_chip + k
                 for k in range(self.host.cores_per_chip)
             )
+        if dev.partition is not None:
+            return dev.partition.spec.core_indices(self.host)
         if dev.subslice is not None:
             return dev.subslice.spec.core_indices(self.host)
         return ()
@@ -832,6 +968,28 @@ class DeviceState:
                 )
             chips.append(self.host.chips[pos])
         return chips
+
+    def _subslice_contract(self, spec, edits) -> list:
+        """Device nodes + TPU bounds env for a sub-slice-backed device.
+        ONE contract shared by dynamic/static sub-slices and partition
+        carve-outs -- a bounds-format change edited here reaches every
+        tenant kind. Returns the backing physical chips."""
+        positions = (
+            spec.chip_positions(self.host)
+            if not spec.is_core_level
+            else (spec.parent_chip,)
+        )
+        physical = self._chips_at(positions)
+        for chip in physical:
+            edits.device_nodes.append(chip.devpath)
+        if spec.is_core_level:
+            edits.env.append(f"TPU_CORE_BOUNDS={spec.placement}")
+            edits.env.append("TPU_MEGACORE=disabled")
+        else:
+            edits.env.append(
+                f"TPU_CHIPS_PER_HOST_BOUNDS={spec.profile.replace('x', ',')}"
+            )
+        return physical
 
     def _resolve_configs(self, claim: ResourceClaim):
         """Per-request effective config: class-sourced first, claim-sourced
@@ -857,6 +1015,7 @@ class DeviceState:
                 if dev is not None and dev.kind in (
                     DeviceKind.SUBSLICE_DYNAMIC,
                     DeviceKind.SUBSLICE_STATIC,
+                    DeviceKind.PARTITION,
                 ):
                     cfg_obj = api_configs.SubSliceConfig()
                 elif dev is not None and dev.kind == DeviceKind.PASSTHROUGH:
@@ -877,15 +1036,30 @@ class DeviceState:
         device_state.go:536)."""
         created_live: list[str] = []
         configured_vfio: list[str] = []
+        attached_parts: list[str] = []
         touched_chips: set[int] = set()
         try:
             return self._prepare_devices_inner(
-                claim, created_live, configured_vfio, touched_chips, timer,
-                cfgs,
+                claim, created_live, configured_vfio, attached_parts,
+                touched_chips, timer, cfgs,
             )
         except BaseException:
             for live_uuid in created_live:
                 self._registry.destroy(live_uuid)
+            for name in attached_parts:
+                if self.partition_engine is not None:
+                    # Holder-counted: the backing carve-out survives if
+                    # a co-tenant claim still holds the partition. A
+                    # detach failure here must not mask the original
+                    # error -- the durable Destroying record makes the
+                    # next sweep/retry finish it.
+                    try:
+                        self.partition_engine.detach(claim.uid, name)
+                    except PartitionEngineError:
+                        logger.exception(
+                            "rollback: partition detach failed for %s "
+                            "(will resume from the durable record)",
+                            name)
             for bdf in configured_vfio:
                 self._vfio.unconfigure(bdf)
             self._timeslicing.release(claim.uid, sorted(touched_chips))
@@ -898,6 +1072,7 @@ class DeviceState:
         claim: ResourceClaim,
         created_live: list[str],
         configured_vfio: list[str],
+        attached_parts: list[str],
         touched_chips: set[int],
         timer: SegmentTimer,
         cfgs=None,
@@ -906,6 +1081,12 @@ class DeviceState:
             cfgs = self._resolve_configs(claim)
         prepared: list[CheckpointedDevice] = []
         device_edits: dict[str, ContainerEdits] = {}
+        # canonical device name -> CDI device name. Usually identity;
+        # oversubscribed partition devices get a claim-scoped CDI name,
+        # because N tenant claims hold the SAME canonical device and
+        # qualified CDI ids (vendor/class=name) must stay unique across
+        # their transient specs.
+        cdi_name_of: dict[str, str] = {}
         claim_chips: set[int] = set()
         # request -> (chips, device names) for one sharing application per
         # config group (the reference merges MPS edits per group,
@@ -935,25 +1116,37 @@ class DeviceState:
                     self._vfio.configure(chip.pci_bdf, cfg)
                 )
                 live = {"pciBdf": chip.pci_bdf, "vfio": True}
+            elif dev.kind == DeviceKind.PARTITION:
+                info = dev.partition
+                if self.partition_engine is None:
+                    raise PrepareError(
+                        "partition engine not enabled on this node"
+                    )
+                if info.oversubscribed and not getattr(
+                        cfg, "oversubscribe", False):
+                    raise PrepareError(
+                        f"device {result.device} is oversubscribed "
+                        f"({info.profile.max_tenants} tenant slots); "
+                        "the claim's SubSliceConfig must opt in with "
+                        "oversubscribe: true"
+                    )
+                physical = self._subslice_contract(info.spec, edits)
+                edits.env.append(f"TPU_PARTITION={info.profile.name}")
+                edits.env.append(
+                    f"TPU_PARTITION_HBM_BYTES={info.tenant_hbm_bytes}")
+                # Carve-out realized on demand (first tenant creates,
+                # co-tenants attach); crash-resumable via the engine's
+                # partition records.
+                try:
+                    with timer.segment("prep_attach_partition"):
+                        live = self.partition_engine.attach(
+                            claim.uid, result.device)
+                except PartitionEngineError as e:
+                    raise PrepareError(str(e)) from e
+                attached_parts.append(result.device)
             else:
                 ss = dev.subslice
-                positions = (
-                    ss.spec.chip_positions(self.host)
-                    if not ss.spec.is_core_level
-                    else (ss.spec.parent_chip,)
-                )
-                physical = self._chips_at(positions)
-                for chip in physical:
-                    edits.device_nodes.append(chip.devpath)
-                if ss.spec.is_core_level:
-                    edits.env.append(
-                        f"TPU_CORE_BOUNDS={ss.spec.placement}"
-                    )
-                    edits.env.append("TPU_MEGACORE=disabled")
-                else:
-                    edits.env.append(
-                        f"TPU_CHIPS_PER_HOST_BOUNDS={ss.spec.profile.replace('x', ',')}"
-                    )
+                physical = self._subslice_contract(ss.spec, edits)
                 if dev.kind == DeviceKind.SUBSLICE_DYNAMIC:
                     live_t = SubSliceLiveTuple(
                         spec=ss.spec, uuid=f"tpu-ss-{uuidlib.uuid4()}"
@@ -978,7 +1171,11 @@ class DeviceState:
             for i in physical_idxs:
                 edits.env.append(f"TPU_DEVICE_{i}=1")
 
-            device_edits[result.device] = edits
+            cdi_name = result.device
+            if self._slots_of(result.device) > 1:
+                cdi_name = f"{result.device}-t-{claim.uid}"
+            cdi_name_of[result.device] = cdi_name
+            device_edits[cdi_name] = edits
             prepared.append(
                 CheckpointedDevice(
                     canonical_name=result.device,
@@ -989,9 +1186,36 @@ class DeviceState:
             )
 
         # One sharing application per request group over its full chip and
-        # device set.
+        # device set. Groups holding oversubscribed partition devices
+        # get the partition-engine sharing contract instead (time-slice
+        # policy + per-tenant tenancy enforcement).
         sharing_edits = ContainerEdits()
         for request, (chips, names) in groups.items():
+            over = [n for n in names if self._slots_of(n) > 1]
+            if over:
+                if len(over) != len(names):
+                    # Fail closed: applying the partition sharing
+                    # contract (time-slice policy + per-slot HBM
+                    # ceiling) across the group would wrongly cap the
+                    # exclusive devices, and skipping it would leave
+                    # the shared ones unenforced. A class selector
+                    # matching both shapes must be split into separate
+                    # requests.
+                    raise PrepareError(
+                        f"request {request!r} mixes oversubscribed "
+                        f"partition devices ({sorted(over)}) with "
+                        "exclusive devices "
+                        f"({sorted(set(names) - set(over))}); split "
+                        "them into separate requests"
+                    )
+                touched_chips |= chips
+                sharing_edits = sharing_edits.merge(
+                    self._apply_oversubscription(
+                        claim, request, cfgs[request], sorted(chips),
+                        over,
+                    )
+                )
+                continue
             sharing = getattr(cfgs[request], "sharing", None)
             if sharing is None:
                 continue
@@ -1013,7 +1237,8 @@ class DeviceState:
             )
         by_name = dict(zip(sorted(device_edits), cdi_ids))
         for dev in prepared:
-            dev.cdi_device_ids = [by_name[dev.canonical_name]]
+            dev.cdi_device_ids = [
+                by_name[cdi_name_of[dev.canonical_name]]]
         return prepared
 
     def _check_config_kind(self, dev: AllocatableDevice, cfg) -> None:
@@ -1023,7 +1248,8 @@ class DeviceState:
             raise PrepareError(
                 f"config kind {type(cfg).__name__} cannot apply to a chip"
             )
-        if dev.kind in (DeviceKind.SUBSLICE_DYNAMIC, DeviceKind.SUBSLICE_STATIC) \
+        if dev.kind in (DeviceKind.SUBSLICE_DYNAMIC,
+                        DeviceKind.SUBSLICE_STATIC, DeviceKind.PARTITION) \
                 and not isinstance(cfg, api_configs.SubSliceConfig):
             raise PrepareError(
                 f"config kind {type(cfg).__name__} cannot apply to a sub-slice"
@@ -1035,6 +1261,44 @@ class DeviceState:
                 f"config kind {type(cfg).__name__} cannot apply to a "
                 "passthrough device"
             )
+
+    def _apply_oversubscription(
+        self,
+        claim: ResourceClaim,
+        request: str,
+        cfg,
+        chip_indices: list[int],
+        device_names: list[str],
+    ) -> ContainerEdits:
+        """Sharing contract for oversubscribed partition tenants: the
+        chips' cooperative time-slice policy (holder-counted across the
+        co-tenant claims) plus a per-tenant tenancy dir whose HBM
+        ceiling is the partition's per-slot budget -- "N tenant claims
+        share one carve-out under TimeSlicingManager /
+        MultiTenancyManager"."""
+        gates = self._config.feature_gates
+        if not gates.is_enabled(TIME_SLICING_SETTINGS) or \
+                not gates.is_enabled(MULTI_TENANCY_SUPPORT):
+            raise PrepareError(
+                "oversubscribed partitions need the TimeSlicingSettings "
+                "and MultiTenancySupport feature gates"
+            )
+        sharing = getattr(cfg, "sharing", None)
+        ts_cfg = api_configs.TimeSlicingConfig()
+        if sharing is not None and sharing.is_time_slicing and \
+                sharing.time_slicing is not None:
+            ts_cfg = sharing.time_slicing
+        edits = self._timeslicing.set_time_slice(
+            claim.uid, chip_indices, ts_cfg)
+        tenant_hbm = min(
+            self.allocatable[name].partition.tenant_hbm_bytes
+            for name in device_names
+        )
+        mt_cfg = api_configs.MultiTenancyConfig(
+            hbm_limit=str(tenant_hbm))
+        mt_cfg.normalize()
+        return edits.merge(self._tenancy.start(
+            claim.uid, request, chip_indices, mt_cfg, device_names))
 
     def _apply_sharing(
         self,
@@ -1134,6 +1398,26 @@ class DeviceState:
                 # Kernel boundary: return the function to the native
                 # driver (vfio-device.go:189).
                 self._vfio.unconfigure(dev.live["pciBdf"])
+            elif dev.live and dev.live.get("partition"):
+                # Holder-counted through the partition engine: the
+                # carve-out dies only with its LAST tenant. Engine gone
+                # (gate flipped off across a restart): derive the
+                # holder count the same way the engine does -- another
+                # claim record referencing the device means a co-tenant
+                # workload may still be running on the carve-out.
+                if self.partition_engine is not None:
+                    self.partition_engine.detach(
+                        checkpointed.uid, dev.canonical_name)
+                else:
+                    held_elsewhere = any(
+                        other.uid != checkpointed.uid
+                        and any(d.canonical_name == dev.canonical_name
+                                for d in other.devices)
+                        for other in self._checkpoint.get(
+                            ).claims.values()
+                    )
+                    if not held_elsewhere:
+                        self._registry.destroy(dev.live["uuid"])
             elif dev.live:
                 self._registry.destroy(dev.live["uuid"])
             for core in self._cores_of(dev.canonical_name):

@@ -16,6 +16,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from enum import Enum
 
+from ..pkg.partition.spec import PartitionProfile, partition_device_name
 from ..tpulib.binding import TpuChip, TpuHostInfo
 from .subslice import SubSliceSpecTuple, chip_name
 
@@ -25,6 +26,10 @@ class DeviceKind(str, Enum):
     SUBSLICE_STATIC = "subslice-static"
     SUBSLICE_DYNAMIC = "subslice-dynamic"
     PASSTHROUGH = "passthrough"
+    # PartitionSet-desired tenant partition (pkg/partition): a dynamic
+    # carve-out sold as one or more tenant slots with a budgeted HBM
+    # share. Realized/retired on demand by the partition engine.
+    PARTITION = "partition"
 
 
 @dataclass(frozen=True)
@@ -104,6 +109,81 @@ class SubSliceInfo:
 
 
 @dataclass(frozen=True)
+class PartitionInfo:
+    """A tenant partition: a PartitionSet profile applied to one
+    backing carve-out placement (pkg/partition/spec.py).
+
+    Published capacities are PER TENANT SLOT: ``hbmBytes`` is the
+    tenant's HBM budget (carve-out HBM x hbmFraction / maxTenants) and
+    ``tensorCores`` the tenant's core share as a milli quantity -- the
+    same virtual-capacity split the device's KEP-4815
+    ``consumesCounters`` encode, so N slot allocations together consume
+    exactly the backing carve-out's budget."""
+
+    profile: PartitionProfile
+    spec: SubSliceSpecTuple  # the backing carve-out
+    host: TpuHostInfo
+    placement: int  # index within the profile's placement list
+
+    @property
+    def canonical_name(self) -> str:
+        return partition_device_name(self.profile.name, self.placement)
+
+    @property
+    def cores(self) -> int:
+        return len(self.spec.core_indices(self.host))
+
+    @property
+    def carve_hbm_bytes(self) -> int:
+        per_core = (self.host.hbm_bytes_per_chip
+                    // self.host.cores_per_chip)
+        return per_core * self.cores
+
+    @property
+    def tenant_hbm_bytes(self) -> int:
+        """Per-tenant HBM budget/ceiling."""
+        return int(self.carve_hbm_bytes * self.profile.hbm_fraction
+                   ) // self.profile.max_tenants
+
+    @property
+    def tenant_core_milli(self) -> int:
+        """Per-tenant core share PER CORE of the backing carve-out, in
+        milli-cores (the virtual-capacity multiplier)."""
+        return 1000 // self.profile.max_tenants
+
+    @property
+    def oversubscribed(self) -> bool:
+        return self.profile.max_tenants > 1
+
+    def attributes(self) -> dict:
+        return {
+            "platform": self.host.platform,
+            "acceleratorType": self.host.accelerator_type,
+            "topology": self.host.topology,
+            "profile": self.profile.name,
+            "subslice": self.profile.subslice,
+            "placement": self.placement,
+            "parentChip": (
+                self.spec.parent_chip if self.spec.is_core_level else -1
+            ),
+            "workerId": self.host.worker_id,
+            "partition": True,
+            # > 1 marks a shared device the scheduler may allocate to
+            # several tenant claims (slot-aware AllocationState).
+            "oversubscribeSlots": self.profile.max_tenants,
+        }
+
+    def capacities(self) -> dict:
+        caps: dict = {"hbmBytes": self.tenant_hbm_bytes}
+        if self.profile.max_tenants > 1:
+            caps["tensorCores"] = (
+                f"{(self.cores * 1000) // self.profile.max_tenants}m")
+        else:
+            caps["tensorCores"] = self.cores
+        return caps
+
+
+@dataclass(frozen=True)
 class PassthroughInfo:
     """A chip surfaced for vfio passthrough (VfioDeviceInfo analog)."""
 
@@ -137,6 +217,7 @@ class AllocatableDevice:
     chip: ChipInfo | None = None
     subslice: SubSliceInfo | None = None
     passthrough: PassthroughInfo | None = None
+    partition: PartitionInfo | None = None
     # DRA device taints currently applied (health events -> taints).
     taints: list[dict] = field(default_factory=list)
 
@@ -146,7 +227,8 @@ class AllocatableDevice:
 
     @property
     def _info(self):
-        return self.chip or self.subslice or self.passthrough
+        return (self.chip or self.subslice or self.passthrough
+                or self.partition)
 
     def to_dra_device(self) -> dict:
         """-> a resource.k8s.io Device entry for a ResourceSlice."""

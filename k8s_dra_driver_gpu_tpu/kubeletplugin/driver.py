@@ -52,6 +52,13 @@ class Driver:
         self.kube = kube_client
         self.node_name = node_name
         self.metrics = metrics or DRARequestMetrics()
+        if self.state.partition_engine is not None:
+            from ..pkg.metrics import PartitionMetrics  # noqa: PLC0415
+
+            self.state.partition_engine.metrics = PartitionMetrics(
+                registry=self.metrics.registry)
+            self.state.partition_engine.metrics.set_active(
+                self.state.partition_engine.active_partitions())
         # Export the SegmentTimer breakdown (prep_lock_wait,
         # ckpt_fsync_wait, ...) through the request-metrics registry.
         self.state.segment_observer = self.metrics.observe_segments
@@ -367,6 +374,14 @@ class Driver:
         self._published_hashes = hashes
         self._published_verified_at = time.monotonic()
         return stats
+
+    def apply_partition_set(self, partition_set) -> dict:
+        """Profile-guided re-plan: swap the desired partition layout
+        and republish. Partition churn rides the content-hash diff --
+        only the slices whose device inventory actually changed are
+        rewritten (and a converged re-apply costs zero writes)."""
+        self.state.apply_partition_set(partition_set)
+        return self.publish_resources()
 
     # -- health ---------------------------------------------------------------
 

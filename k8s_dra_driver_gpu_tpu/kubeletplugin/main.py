@@ -86,6 +86,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated admin-pre-carved sub-slices "
                         "(static-MIG analog), e.g. "
                         "'ss-2x1x1-0,chip-0-ss-1c-1' [STATIC_SUBSLICES]")
+    p.add_argument("--partition-set",
+                   default=env("TPU_DRA_PARTITION_SET", ""),
+                   help="path to a PartitionSet JSON file (multi-tenant "
+                        "partition engine, pkg/partition; needs the "
+                        "TenantPartitioning feature gate) "
+                        "[TPU_DRA_PARTITION_SET]")
     p.add_argument("--additional-health-kinds-to-ignore",
                    default=env("ADDITIONAL_HEALTH_KINDS_TO_IGNORE", ""),
                    help="comma-separated health kinds never tainted "
@@ -131,6 +137,26 @@ def run(argv: list[str] | None = None) -> int:
         ),
     )
     node_name = args.node_name or os.uname().nodename
+    if args.partition_set:
+        from ..pkg.featuregates import TENANT_PARTITIONING  # noqa: PLC0415
+        from ..pkg.partition import PartitionSet  # noqa: PLC0415
+
+        # Bad layout files fail startup loudly (PartitionSpecError),
+        # like a bad --static-subslices name: never silently publish
+        # less than the operator declared. Pool globs match against
+        # this node's pool (node-local pools are named after the node).
+        # Same contract for the gate: DeviceState only builds the
+        # engine under TenantPartitioning, so a declared layout with
+        # the gate off would silently publish nothing. (To drain a
+        # node out of partitioning, drop the flag WITH the gate -- the
+        # engine-gone unprepare path retires leftover carve-outs.)
+        if not gates.is_enabled(TENANT_PARTITIONING):
+            raise SystemExit(
+                f"--partition-set {args.partition_set} requires the "
+                f"{TENANT_PARTITIONING} feature gate (--feature-gates "
+                f"{TENANT_PARTITIONING}=true)")
+        config.partition_set = PartitionSet.from_file(args.partition_set)
+        config.pool_name = node_name
 
     metrics = DRARequestMetrics()
     # Retry/breaker/quarantine + recovery-sweep counters share the

@@ -35,11 +35,29 @@ def shared_counter_sets(host: TpuHostInfo) -> list[dict]:
 def consumed_counters(
     dev: AllocatableDevice, host: TpuHostInfo
 ) -> list[dict]:
-    """The consumesCounters block for one device."""
+    """The consumesCounters block for one device.
+
+    Partition devices (pkg/partition) consume PER-TENANT-SLOT shares:
+    each core counter is debited ``1/maxTenants`` (a milli quantity --
+    the virtual-capacity multiplier) and HBM is debited the tenant's
+    budgeted share, so ``maxTenants`` slot allocations together consume
+    at most the backing carve-out's budget and a whole-chip claim can
+    never land on a chip with an active tenant."""
     per_core_hbm = host.hbm_bytes_per_chip // host.cores_per_chip
+    core_value = "1"
     if dev.kind == DeviceKind.CHIP:
         idx = dev.chip.chip.index
         cores = [(idx, k) for k in range(host.cores_per_chip)]
+    elif dev.kind == DeviceKind.PARTITION and dev.partition is not None:
+        part = dev.partition
+        cores = [
+            (c // host.cores_per_chip, c % host.cores_per_chip)
+            for c in part.spec.core_indices(host)
+        ]
+        if part.profile.max_tenants > 1:
+            core_value = f"{part.tenant_core_milli}m"
+        # Tenant HBM budget, spread over the carve-out's cores.
+        per_core_hbm = part.tenant_hbm_bytes // max(len(cores), 1)
     elif dev.subslice is not None:
         cores = [
             (c // host.cores_per_chip, c % host.cores_per_chip)
@@ -50,7 +68,7 @@ def consumed_counters(
     counters: dict[str, dict] = {}
     hbm_per_chip: dict[int, int] = {}
     for chip_idx, core_idx in cores:
-        counters[f"core-{chip_idx}-{core_idx}"] = {"value": "1"}
+        counters[f"core-{chip_idx}-{core_idx}"] = {"value": core_value}
         hbm_per_chip[chip_idx] = hbm_per_chip.get(chip_idx, 0) + per_core_hbm
     for chip_idx, hbm in hbm_per_chip.items():
         counters[f"hbm-{chip_idx}"] = {"value": str(hbm)}

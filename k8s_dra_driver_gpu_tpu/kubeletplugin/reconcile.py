@@ -122,6 +122,15 @@ class NodeStateReconciler:
             self._state.prepared_claims(), lookups)
         counts["cdi_spec"] = self._sweep_cdi_specs()
         counts["carveout"] = self._state.destroy_unknown_subslices()
+        if self._state.partition_engine is not None:
+            # Safety net for the holder-counted teardown: a partition
+            # whose last tenant record was GC'd above (instead of
+            # unprepared) is reaped here; devices a re-plan retired
+            # leave the allocatable set once their records are gone.
+            counts["idle_partition"] = \
+                self._state.partition_engine.reap_idle()
+            counts["idle_partition"] += \
+                self._state.prune_retired_partitions()
         counts["lease"] = self._sweep_leases()
         counts["devices_gone"] = self._declare_gone_devices(
             self._state.prepared_claims(), lookups)
@@ -269,8 +278,8 @@ class NodeStateReconciler:
         if self._metrics is None:
             return
         for kind in ("stale_claim", "moved_claim", "cdi_spec",
-                     "carveout", "lease"):
-            if counts[kind]:
+                     "carveout", "lease", "idle_partition"):
+            if counts.get(kind):
                 self._metrics.orphans_repaired.labels(kind).inc(
                     counts[kind])
         for kind, n in counts.items():

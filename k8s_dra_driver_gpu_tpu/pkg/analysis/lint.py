@@ -82,6 +82,12 @@ RULES: dict[str, str] = {
                  "registry lock (_state_lock) or the allocation-state "
                  "lock; commit I/O is sanctioned under per-node locks "
                  "only (sharded-allocation hierarchy)",
+    "TPUDRA011": "sub-slice carve-out create/destroy outside the "
+                 "partition engine / DeviceState lock discipline: "
+                 "registry mutations must go through "
+                 "pkg/partition/engine.py (holder-counted, durable "
+                 "partition records) or kubeletplugin/device_state.py "
+                 "(claim-checkpointed), never ad hoc",
 }
 
 # Lock model (docs/architecture.md "Locking hierarchy"). Matched on the
@@ -118,6 +124,13 @@ _SCHED_SYNC_FILES = {"scheduler.py"}
 # TPUDRA010 / sched-lock-hierarchy scope: the modules that define and
 # use the sharded-allocation locks.
 _SCHED_LOCK_FILES = {"scheduler.py", "schedcache.py"}
+# TPUDRA011 scope: the ONLY modules sanctioned to mutate the live
+# carve-out registry. device_state.py owns claim-driven creates/
+# destroys (under the claim's checkpoint + shard locks); the partition
+# engine owns partition-record-driven ones (rel-path matched so a
+# stray same-named engine.py elsewhere is not sanctioned).
+_CARVEOUT_FILES = {"device_state.py"}
+_CARVEOUT_REL_SUFFIXES = ("pkg/partition/engine.py",)
 # Resources the scheduler watches (mirror of
 # pkg/schedcache.WATCHED_RESOURCES, kept literal so the linter has no
 # runtime import of the code under analysis).
@@ -131,7 +144,11 @@ _STATE_LITERALS = {"PrepareStarted", "PrepareCompleted",
                    # outside the declarative model bypass the eviction
                    # TransitionPolicy exactly like raw claim states.
                    "EvictionPlanned", "EvictionDraining",
-                   "EvictionDeallocated"}
+                   "EvictionDeallocated",
+                   # Partition lifecycle (pkg/partition/engine.py):
+                   # same rule for the partition TransitionPolicy.
+                   "PartitionCreating", "PartitionReady",
+                   "PartitionDestroying"}
 # Copy constructors that launder taint (deep or top-level).
 _COPY_CALLS = {"json_copy", "deepcopy", "dict", "list", "sorted",
                "json_loads"}
@@ -626,6 +643,30 @@ class _ModuleLinter(ast.NodeVisitor):
         if isinstance(func, ast.Attribute):
             attr = func.attr
             base_src = _unparse(func.value)
+
+            # TPUDRA011: carve-out registry mutation outside the
+            # partition engine / DeviceState. The registry attribute is
+            # deliberately named *_registry in both sanctioned modules,
+            # so the textual match covers `self._registry`,
+            # `state.subslice_registry`, and module-level bindings.
+            if attr in ("create", "destroy") and \
+                    base_src.endswith("_registry"):
+                rel_posix = self.rel.replace(os.sep, "/")
+                sanctioned = (
+                    self.basename in _CARVEOUT_FILES
+                    or any(rel_posix.endswith(sfx)
+                           for sfx in _CARVEOUT_REL_SUFFIXES)
+                )
+                if not sanctioned:
+                    self._emit(
+                        "TPUDRA011", node,
+                        f"carve-out registry mutation {base_src}."
+                        f"{attr}(...) outside the partition engine / "
+                        "DeviceState: route through "
+                        "PartitionEngine.attach/detach or the claim "
+                        "prepare pipeline",
+                        key=f"{base_src}.{attr}",
+                    )
 
             # TPUDRA009: raw kube.list of a watched resource inside the
             # scheduler's sync paths -- these reads must come from the

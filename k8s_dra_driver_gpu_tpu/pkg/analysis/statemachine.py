@@ -150,6 +150,42 @@ EVICTION_POLICY = TransitionPolicy(
     }),
 )
 
+# -- partition lifecycle (pkg/partition/engine.py) ----------------------------
+#
+# The multi-tenant partition engine persists one record per dynamic
+# partition (a PartitionSet-desired carve-out) through the same
+# group-committed CheckpointManager, so a node-plugin crash mid-create
+# or mid-destroy resumes idempotently:
+#
+#   absent -> PartitionCreating      (durable intent, carve-out next)
+#   PartitionCreating -> PartitionReady      (carve-out realized)
+#   PartitionReady -> PartitionDestroying    (last tenant detached /
+#                                             profile removed)
+#   PartitionCreating -> PartitionDestroying (crash-resume rollback of
+#                                             a half-created partition)
+#   <Creating|Destroying> -> absent          (create rolled back /
+#                                             destroy finished)
+#
+# A PartitionReady record must never vanish without passing through
+# PartitionDestroying: the destroy intent is what makes a crashed
+# teardown resumable instead of leaking the carve-out.
+
+PARTITION_CREATING = "PartitionCreating"
+PARTITION_READY = "PartitionReady"
+PARTITION_DESTROYING = "PartitionDestroying"
+
+PARTITION_POLICY = TransitionPolicy(
+    "partition",
+    frozenset({
+        (ABSENT, PARTITION_CREATING),                  # durable intent
+        (PARTITION_CREATING, PARTITION_READY),         # carve-out live
+        (PARTITION_CREATING, PARTITION_DESTROYING),    # crash rollback
+        (PARTITION_CREATING, ABSENT),                  # create failed
+        (PARTITION_READY, PARTITION_DESTROYING),       # teardown intent
+        (PARTITION_DESTROYING, ABSENT),                # destroy done
+    }),
+)
+
 #: Registry for the AST pass (lint TPUDRA007): modules constructing a
 #: CheckpointManager must pass transition_policy= explicitly -- one of
 #: these, or None with an inline-allow comment stating why.
@@ -157,4 +193,5 @@ POLICIES = {
     "two-phase": TWO_PHASE_POLICY,
     "single-phase": SINGLE_PHASE_POLICY,
     "eviction": EVICTION_POLICY,
+    "partition": PARTITION_POLICY,
 }

@@ -52,6 +52,11 @@ DYNAMIC_SUB_SLICE = "DynamicSubSlice"
 COMPUTE_DOMAIN_CLIQUES = "ComputeDomainCliques"
 CRASH_ON_ICI_FABRIC_ERRORS = "CrashOnICIFabricErrors"
 DEVICE_METADATA = "DeviceMetadata"
+# Multi-tenant partition engine (pkg/partition): PartitionSet-driven
+# dynamic sub-slice lifecycle, profile-guided partition devices, and
+# time-slice oversubscription slots for inference serving. Builds on
+# the dynamic carve-out plumbing, hence the DynamicSubSlice dependency.
+TENANT_PARTITIONING = "TenantPartitioning"
 # ICI topology-aware placement (pkg/topology): the in-tree scheduler
 # ranks candidate device sets by compactness + fragmentation cost and
 # the CD controller prefers ICI-adjacent hosts for multi-host gangs.
@@ -82,6 +87,13 @@ KNOWN_FEATURES: dict[str, FeatureSpec] = {
         ),
         FeatureSpec(CHIP_HEALTH_CHECK, default=True, stage=Stage.BETA),
         FeatureSpec(DYNAMIC_SUB_SLICE, default=False, stage=Stage.ALPHA),
+        FeatureSpec(
+            TENANT_PARTITIONING,
+            default=False,
+            stage=Stage.ALPHA,
+            # The engine realizes partitions as dynamic carve-outs.
+            requires=(DYNAMIC_SUB_SLICE,),
+        ),
         FeatureSpec(COMPUTE_DOMAIN_CLIQUES, default=True, stage=Stage.BETA),
         FeatureSpec(CRASH_ON_ICI_FABRIC_ERRORS, default=True, stage=Stage.BETA),
         FeatureSpec(DEVICE_METADATA, default=False, stage=Stage.ALPHA),

@@ -314,6 +314,13 @@ class WorkQueueMetrics:
             "tight loop (pkg/workqueue hot-key damping).",
             registry=self.registry,
         )
+        self.steals = Counter(
+            "tpu_dra_workqueue_steals_total",
+            "Ready keys stolen by idle workers from a backlogged "
+            "sibling's heap (pkg/workqueue work stealing); a rising "
+            "rate means one shard is hot enough to flood its owner.",
+            registry=self.registry,
+        )
 
     # -- the duck-typed sink pkg/workqueue calls ------------------------------
 
@@ -331,6 +338,9 @@ class WorkQueueMetrics:
 
     def inc_hot_backoff(self) -> None:
         self.hot_backoffs.inc()
+
+    def inc_steal(self, n: int = 1) -> None:
+        self.steals.inc(n)
 
 
 class SchedulerMetrics:
@@ -386,6 +396,15 @@ class SchedulerMetrics:
             buckets=_BUCKETS,
             registry=self.registry,
         )
+        self.domain_exhausted = Counter(
+            "tpu_dra_sched_domain_exhausted_total",
+            "Allocation attempts for domain-pinned claims that found "
+            "no fit inside their scheduling domain's pools (the claim "
+            "gets a DomainExhausted condition + Warning Event instead "
+            "of waiting silently).",
+            ["domain"],
+            registry=self.registry,
+        )
         self.commit_conflicts = Counter(
             "tpu_dra_sched_commit_conflicts_total",
             "Optimistic allocation commits rejected at reserve time "
@@ -396,6 +415,47 @@ class SchedulerMetrics:
         # Per-shard queue depth / wait / retry observability for the
         # scheduler's sharded sync queue (pkg/workqueue).
         self.workqueue = WorkQueueMetrics(registry=self.registry)
+
+
+class PartitionMetrics:
+    """Partition-engine observability (pkg/partition/engine.py).
+
+    A healthy serving node shows ``partitions_active`` tracking tenant
+    load (carve-outs realized on demand, reaped when idle) and the
+    create/destroy counters moving together; ``creates`` racing ahead
+    of ``destroys`` without ``partitions_active`` rising means crashed
+    teardowns are being resumed (check the node plugin logs)."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.partitions_active = Gauge(
+            "tpu_dra_partitions_active",
+            "Partition carve-outs currently realized (PartitionReady "
+            "records) on this node.",
+            registry=self.registry,
+        )
+        self.creates = Counter(
+            "tpu_dra_partition_creates_total",
+            "Partition carve-outs created (first tenant attach).",
+            registry=self.registry,
+        )
+        self.destroys = Counter(
+            "tpu_dra_partition_destroys_total",
+            "Partition carve-outs destroyed (last tenant detach, idle "
+            "reap, or crash-resumed teardown).",
+            registry=self.registry,
+        )
+
+    # -- the duck-typed sink pkg/partition/engine.py calls --------------------
+
+    def inc_create(self) -> None:
+        self.creates.inc()
+
+    def inc_destroy(self) -> None:
+        self.destroys.inc()
+
+    def set_active(self, n: int) -> None:
+        self.partitions_active.set(n)
 
 
 class ComputeDomainMetrics:

@@ -1,0 +1,46 @@
+"""Multi-tenant partition engine (the dynamic MIG/MPS analog).
+
+Layers:
+
+- ``spec``: PartitionSet / PartitionProfile -- the declarative partition
+  layout (operator file or planner output).
+- ``profiles``: MISO-grounded tenant-profile store + sizing policy
+  (observed demand percentiles -> smallest satisfying profile).
+- ``packing``: ParvaGPU-style best-fit-decreasing tenant co-location.
+- ``engine``: node-side dynamic carve-out lifecycle (crash-safe via the
+  ``partition`` TransitionPolicy) + the publishable device projection.
+
+See docs/architecture.md "Partition engine" and docs/operations.md
+"Partitioning & serving runbook".
+"""
+
+from .packing import PackingPlan, pack_tenants
+from .profiles import (
+    DEFAULT_TENANT_DEMANDS,
+    TENANT_PROFILE_ANNOTATION,
+    SizingPolicy,
+    TenantProfileStore,
+)
+from .spec import (
+    PartitionDemand,
+    PartitionProfile,
+    PartitionSet,
+    PartitionSpecError,
+    parse_partition_device_name,
+    partition_device_name,
+)
+
+__all__ = [
+    "DEFAULT_TENANT_DEMANDS",
+    "TENANT_PROFILE_ANNOTATION",
+    "PackingPlan",
+    "PartitionDemand",
+    "PartitionProfile",
+    "PartitionSet",
+    "PartitionSpecError",
+    "SizingPolicy",
+    "TenantProfileStore",
+    "pack_tenants",
+    "parse_partition_device_name",
+    "partition_device_name",
+]

@@ -1,0 +1,497 @@
+"""The node-side partition engine: dynamic carve-out lifecycle.
+
+The reference driver's dynamically-creatable MIG path creates a GPU
+instance at Prepare and destroys it at Unprepare
+(device_state.go:229-334). This engine generalizes that for the
+multi-tenant serving workload:
+
+- A :class:`~.spec.PartitionSet` declares the desired partition
+  profiles; :func:`partition_devices` projects them onto this host's
+  sub-slice placements as first-class partition devices (published in
+  the node's partitions ResourceSlice with KEP-4815 counter budgets
+  against the parent chips -- see kubeletplugin/partitions.py).
+- The BACKING CARVE-OUT of a partition is realized lazily at
+  NodePrepare time (first tenant attach) and torn back down when the
+  last tenant detaches, so an idle pool returns to whole-chip
+  allocatability without operator action.
+- Every create/destroy is driven through a durable record in a
+  dedicated CheckpointManager under the ``partition`` TransitionPolicy
+  (pkg/analysis/statemachine.py): absent -> PartitionCreating ->
+  PartitionReady -> PartitionDestroying -> absent. A crash at ANY
+  point (fault seams ``partition.create`` / ``partition.destroy``)
+  resumes idempotently: a Creating record with live tenants completes
+  its create, an orphaned Creating/Destroying record finishes its
+  teardown, and the carve-out uuid is pinned in the record so a
+  half-created carve-out is found again instead of leaked.
+
+Holder counting is DERIVED, not stored: the tenants of a partition are
+exactly the node checkpoint's claims referencing the partition device,
+so the engine's records never duplicate (and can never disagree with)
+the claim state machine.
+
+Carve-out create/destroy lives ONLY here and in
+kubeletplugin/device_state.py -- lint rule TPUDRA011 enforces it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import uuid as uuidlib
+from dataclasses import dataclass
+
+from ...kubeletplugin.checkpoint import (
+    CheckpointedClaim,
+    CheckpointedDevice,
+    CheckpointManager,
+)
+from ...kubeletplugin.deviceinfo import (
+    AllocatableDevice,
+    DeviceKind,
+    PartitionInfo,
+)
+from ...kubeletplugin.subslice import (
+    SubSliceLiveTuple,
+    SubSliceSpecTuple,
+    enumerate_subslice_devices,
+)
+from ..analysis.statemachine import (
+    PARTITION_CREATING,
+    PARTITION_DESTROYING,
+    PARTITION_POLICY,
+    PARTITION_READY,
+)
+from ..faults import fault_point
+from .spec import PartitionProfile, PartitionSet, PartitionSpecError
+
+logger = logging.getLogger(__name__)
+
+
+class PartitionEngineError(RuntimeError):
+    """A partition attach/detach that cannot proceed (retriable at the
+    claim level: the kubelet re-drives Prepare)."""
+
+
+@dataclass(frozen=True)
+class ResolvedProfile:
+    """A PartitionProfile resolved against this host's carve-out
+    placements."""
+
+    profile: PartitionProfile
+    infos: tuple[PartitionInfo, ...]
+
+
+def resolve_partition_set(host, tpu_profiles, partition_set: PartitionSet,
+                          pool: str | None = None
+                          ) -> list[ResolvedProfile]:
+    """Project a PartitionSet onto one host. Raises PartitionSpecError
+    when a profile names a backing sub-slice this host cannot carve
+    (config error -- fail loudly, like a bad static_subslices name)."""
+    if pool is not None and not partition_set.applies_to_pool(pool):
+        return []
+    by_name = {p.name: p for p in tpu_profiles}
+    out: list[ResolvedProfile] = []
+    for prof in partition_set.profiles:
+        base = by_name.get(prof.subslice)
+        if base is None:
+            raise PartitionSpecError(
+                f"partition profile {prof.name!r}: backing sub-slice "
+                f"{prof.subslice!r} is not a valid carve-out for this "
+                f"host ({host.accelerator_type or 'unknown'})"
+            )
+        specs = enumerate_subslice_devices(host, (base,))
+        infos = tuple(
+            PartitionInfo(profile=prof, spec=spec, host=host, placement=k)
+            for k, spec in enumerate(specs)
+        )
+        out.append(ResolvedProfile(profile=prof, infos=infos))
+    return out
+
+
+def partition_devices(host, tpu_profiles, partition_set: PartitionSet,
+                      pool: str | None = None
+                      ) -> dict[str, AllocatableDevice]:
+    """name -> AllocatableDevice for every desired partition on this
+    host (the publishable projection; shared by the engine and the
+    serving bench's fleet simulation)."""
+    out: dict[str, AllocatableDevice] = {}
+    for rp in resolve_partition_set(host, tpu_profiles, partition_set,
+                                    pool=pool):
+        for info in rp.infos:
+            out[info.canonical_name] = AllocatableDevice(
+                kind=DeviceKind.PARTITION, partition=info
+            )
+    return out
+
+
+def catalog_for(host, tpu_profiles, partition_set: PartitionSet
+                ) -> list[tuple[PartitionProfile, object]]:
+    """(profile, resolved PartitionInfo) pairs -- the SizingPolicy
+    input (pkg/partition/profiles.py). Handing the policy the SAME
+    PartitionInfo the publisher budgets from keeps sizing and the
+    published per-slot capacity in lock-step (no re-derived formula
+    to drift)."""
+    out = []
+    for rp in resolve_partition_set(host, tpu_profiles, partition_set):
+        if not rp.infos:
+            continue
+        out.append((rp.profile, rp.infos[0]))
+    return out
+
+
+class PartitionEngine:
+    """Per-node dynamic partition lifecycle, attached to a DeviceState.
+
+    Thread model: attach/detach run under the owning claim's chip shard
+    locks (device_state.prepare/unprepare); the engine adds a per-
+    partition-device lock so resume()/apply()/reap_idle() -- which run
+    without shard locks -- serialize against them. Lock order is
+    shard locks -> partition device lock -> checkpoint/registry flocks;
+    nothing inside a device lock ever takes a shard lock back.
+    """
+
+    def __init__(self, state, partition_set: PartitionSet,
+                 pool: str | None = None, metrics=None):
+        self._state = state
+        self.metrics = metrics
+        self.partition_set = partition_set
+        self._pool = pool
+        root = os.path.join(state.config_root, "partition")
+        self._checkpoint = CheckpointManager(
+            root, boot_id=state.boot_id,
+            transition_policy=PARTITION_POLICY)
+        self._mutex = threading.Lock()
+        self._dev_locks: dict[str, threading.Lock] = {}
+        self._devices: dict[str, AllocatableDevice] = {}
+        self._rebuild_devices()
+
+    # -- desired devices ------------------------------------------------------
+
+    def _project_devices(self, partition_set: PartitionSet
+                         ) -> dict[str, AllocatableDevice]:
+        host = self._state.host
+        expected = min(host.num_slice_chips, host.chips_per_host)
+        if len(host.chips) < expected:
+            # Same rule as the raw sub-slice path: a degraded host's
+            # placement grid cannot be trusted against a hole.
+            logger.warning(
+                "degraded host (%d/%d chips): not publishing partition "
+                "devices", len(host.chips), expected,
+            )
+            return {}
+        return partition_devices(
+            host, self._state.subslice_profiles, partition_set,
+            pool=self._pool)
+
+    def _rebuild_devices(self) -> None:
+        self._devices = self._project_devices(self.partition_set)
+
+    def devices(self) -> dict[str, AllocatableDevice]:
+        """The desired (publishable) partition device set."""
+        with self._mutex:
+            return dict(self._devices)
+
+    def apply(self, partition_set: PartitionSet
+              ) -> dict[str, AllocatableDevice]:
+        """Swap in a new PartitionSet (profile-guided re-plan): the
+        desired device set is recomputed, partitions no longer desired
+        are reaped once idle, and the caller republishes. Returns the
+        new device set.
+
+        A re-plan that keeps a profile NAME but changes its backing
+        sub-slice would silently re-shape a device whose old carve-out
+        is still pinned by live tenants (overlap validation and the
+        container edits would read the new shape while the workload
+        runs on the old one) -- that is rejected loudly; drain the
+        tenants or retire the profile name instead. Held-with-old-shape
+        but idle records are settled by the reap below before any new
+        attach can reuse them."""
+        partition_set.validate()
+        new_devices = self._project_devices(partition_set)
+        # Validate-and-swap holds every affected device's lifecycle
+        # lock: a concurrent attach either pinned its record before we
+        # look (seen by the loop below -> rejected loudly) or blocks
+        # here and re-reads the swapped-in spec (attach reads _devices
+        # under the device lock). Sorted acquisition; every other
+        # taker holds at most one device lock, so this cannot deadlock.
+        with self._mutex:
+            current = set(self._devices)
+        names = sorted(current | set(new_devices)
+                       | set(self._checkpoint.get().claims))
+        with contextlib.ExitStack() as stack:
+            for name in names:
+                stack.enter_context(self._dev_lock(name))
+            for name, rec in self._checkpoint.get().claims.items():
+                dev = new_devices.get(name)
+                pinned = self._pinned_spec(rec)
+                if dev is None or dev.partition is None or pinned is None:
+                    continue
+                want = dev.partition.spec.canonical_name()
+                if pinned != want and self._holders(name) > 0:
+                    raise PartitionSpecError(
+                        f"re-plan changes the backing carve-out of "
+                        f"{name!r} ({pinned} -> {want}) while tenants "
+                        "still hold it; drain the tenants or retire the "
+                        "profile name instead"
+                    )
+            with self._mutex:
+                self.partition_set = partition_set
+                self._rebuild_devices()
+                devices = dict(self._devices)
+        self.reap_idle()
+        return devices
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _dev_lock(self, name: str) -> threading.Lock:
+        with self._mutex:
+            lock = self._dev_locks.get(name)
+            if lock is None:
+                lock = self._dev_locks[name] = threading.Lock()
+            return lock
+
+    def _record(self, name: str) -> CheckpointedClaim | None:
+        return self._checkpoint.get().claims.get(name)
+
+    @staticmethod
+    def _pinned_spec(rec: CheckpointedClaim) -> str | None:
+        """The backing sub-slice canonical name pinned in a lifecycle
+        record at create time (None on records from before the spec
+        was pinned)."""
+        if rec.devices and rec.devices[0].live:
+            return rec.devices[0].live.get("spec")
+        return None
+
+    def _holders(self, name: str, exclude: set[str] = frozenset()
+                 ) -> int:
+        """Tenant claims currently holding this partition device,
+        derived from the node checkpoint (reservations count: an
+        in-flight prepare's tenant must pin the carve-out)."""
+        count = 0
+        for uid, claim in self._state.prepared_claims().items():
+            if uid in exclude:
+                continue
+            if any(dev.canonical_name == name for dev in claim.devices):
+                count += 1
+        return count
+
+    def live_uuids(self) -> set[str]:
+        """Carve-out uuids owned by partition records in ANY state --
+        the unknown-state sweep must never eat a partition mid-
+        lifecycle."""
+        return {
+            dev.live["uuid"]
+            for rec in self._checkpoint.get().claims.values()
+            for dev in rec.devices
+            if dev.live and "uuid" in dev.live
+        }
+
+    def recorded_devices(self) -> set[str]:
+        """Partition device names with a lifecycle record in ANY state
+        -- the set whose backing carve-outs (and tenant claims) still
+        exist. A re-plan must keep these visible to overlap validation
+        and the counter model until their last tenant detaches."""
+        return set(self._checkpoint.get().claims)
+
+    def active_partitions(self) -> int:
+        return sum(
+            1 for rec in self._checkpoint.get().claims.values()
+            if rec.state == PARTITION_READY
+        )
+
+    def attach(self, claim_uid: str, device_name: str) -> dict:
+        """Ensure the backing carve-out of ``device_name`` exists and
+        return its live identity for the claim's checkpoint record.
+        Idempotent and crash-resumable: the carve-out uuid is pinned in
+        the PartitionCreating record BEFORE the carve-out is realized,
+        so a crash in between resumes onto the same identity."""
+        with self._dev_lock(device_name):
+            # Spec read under the device lock (dev-lock -> mutex, the
+            # resume() order): apply() holds this lock across a
+            # re-plan's validate+swap, so the spec pinned below can
+            # never be concurrently invalidated by a re-shape.
+            with self._mutex:
+                dev = self._devices.get(device_name)
+            if dev is None or dev.partition is None:
+                raise PartitionEngineError(
+                    f"unknown partition device {device_name!r}"
+                )
+            rec = self._record(device_name)
+            if rec is not None and rec.state == PARTITION_DESTROYING:
+                # A crashed teardown owns the old carve-out; finish it
+                # before creating fresh (never share a dying identity).
+                self._teardown_locked(device_name, rec)
+                rec = None
+            if rec is not None:
+                pinned = self._pinned_spec(rec)
+                want = dev.partition.spec.canonical_name()
+                if pinned is not None and pinned != want:
+                    # A re-plan re-shaped this device while the old
+                    # carve-out still exists: never hand a tenant the
+                    # old identity under the new contract. Retriable --
+                    # once the old record settles (last detach /
+                    # reap_idle) the next attach creates fresh.
+                    raise PartitionEngineError(
+                        f"partition {device_name!r} backing carve-out "
+                        f"changed ({pinned} -> {want}); old carve-out "
+                        "still settling"
+                    )
+            if rec is None:
+                live = {"uuid": f"tpu-pt-{uuidlib.uuid4()}",
+                        "partition": device_name,
+                        "spec": dev.partition.spec.canonical_name()}
+                rec = CheckpointedClaim(
+                    uid=device_name,
+                    state=PARTITION_CREATING,
+                    devices=[CheckpointedDevice(
+                        canonical_name=device_name,
+                        kind=DeviceKind.PARTITION.value,
+                        live=live,
+                    )],
+                )
+                self._checkpoint.update_claim(device_name, rec)
+            live = rec.devices[0].live
+            if rec.state == PARTITION_CREATING:
+                fault_point("partition.create",
+                            error=lambda m: PartitionEngineError(m))
+                if live["uuid"] not in self._state.subslice_registry.list():
+                    self._state.subslice_registry.create(SubSliceLiveTuple(
+                        spec=dev.partition.spec, uuid=live["uuid"]))
+                ready = CheckpointedClaim(
+                    uid=device_name, state=PARTITION_READY,
+                    devices=rec.devices)
+                self._checkpoint.update_claim(device_name, ready)
+                if self.metrics is not None:
+                    self.metrics.inc_create()
+                    self.metrics.set_active(self.active_partitions())
+                logger.info("partition %s: carve-out %s created",
+                            device_name, live["uuid"])
+            return dict(live)
+
+    def detach(self, claim_uid: str, device_name: str) -> None:
+        """Drop one tenant's hold; the backing carve-out is destroyed
+        when the LAST holder detaches (idle partitions return their
+        chips to whole-chip allocatability)."""
+        with self._dev_lock(device_name):
+            rec = self._record(device_name)
+            if rec is None:
+                return
+            if self._holders(device_name, exclude={claim_uid}) > 0:
+                return  # co-tenants still share the carve-out
+            self._teardown_locked(device_name, rec)
+
+    def _teardown_locked(self, name: str,
+                         rec: CheckpointedClaim) -> None:
+        """Durable-intent destroy: record PartitionDestroying first, so
+        a crash mid-destroy resumes instead of leaking the carve-out.
+        Caller holds the device lock."""
+        if rec.state != PARTITION_DESTROYING:
+            self._checkpoint.update_claim(name, CheckpointedClaim(
+                uid=name, state=PARTITION_DESTROYING,
+                devices=rec.devices))
+        fault_point("partition.destroy",
+                    error=lambda m: PartitionEngineError(m))
+        for dev in rec.devices:
+            if dev.live and "uuid" in dev.live:
+                self._state.subslice_registry.destroy(dev.live["uuid"])
+        self._checkpoint.update_claim(name, None)
+        if self.metrics is not None:
+            self.metrics.inc_destroy()
+            self.metrics.set_active(self.active_partitions())
+        logger.info("partition %s: carve-out destroyed", name)
+
+    # -- reconciliation -------------------------------------------------------
+
+    def resume(self) -> int:
+        """Crash recovery at plugin start: every record resolves to a
+        settled state. Returns the number of records repaired."""
+        repaired = 0
+        for name in sorted(self._checkpoint.get().claims):
+            with self._dev_lock(name):
+                rec = self._record(name)
+                if rec is None:
+                    continue
+                holders = self._holders(name)
+                with self._mutex:
+                    desired = name in self._devices
+                if rec.state == PARTITION_DESTROYING:
+                    # Destroy intent was durable: finish it.
+                    self._teardown_locked(name, rec)
+                    repaired += 1
+                elif rec.state == PARTITION_CREATING:
+                    if holders > 0 and desired:
+                        # Crash mid-create with a tenant reservation:
+                        # complete the create onto the pinned uuid --
+                        # and the pinned SPEC, which wins over the
+                        # current desired shape if a re-plan changed
+                        # the layout file across the restart (the
+                        # tenant attached under the old contract).
+                        live = rec.devices[0].live
+                        dev = self._devices.get(name)
+                        spec = None
+                        if live and live.get("spec"):
+                            spec = SubSliceSpecTuple.from_canonical_name(
+                                live["spec"])
+                        if spec is None and dev is not None:
+                            spec = dev.partition.spec
+                        if live and spec is not None and \
+                                live["uuid"] not in \
+                                self._state.subslice_registry.list():
+                            self._state.subslice_registry.create(
+                                SubSliceLiveTuple(
+                                    spec=spec, uuid=live["uuid"]))
+                        self._checkpoint.update_claim(
+                            name, CheckpointedClaim(
+                                uid=name, state=PARTITION_READY,
+                                devices=rec.devices))
+                    else:
+                        self._teardown_locked(name, rec)
+                    repaired += 1
+                elif rec.state == PARTITION_READY and (
+                        holders == 0 or not desired):
+                    if holders == 0:
+                        self._teardown_locked(name, rec)
+                        repaired += 1
+                    # not-desired with holders: reaped on last detach
+                elif rec.state == PARTITION_READY:
+                    pinned = self._pinned_spec(rec)
+                    with self._mutex:
+                        dev = self._devices.get(name)
+                    if pinned is not None and dev is not None and \
+                            dev.partition is not None and \
+                            pinned != dev.partition.spec.canonical_name():
+                        # Layout file re-shaped this device across the
+                        # restart while tenants hold the old carve-out.
+                        # The held identity stays authoritative; new
+                        # attaches fail until the tenants drain.
+                        logger.error(
+                            "partition %s: desired backing carve-out "
+                            "changed across restart (%s -> %s) with "
+                            "%d live tenant(s); keeping the held "
+                            "carve-out until they drain", name, pinned,
+                            dev.partition.spec.canonical_name(), holders)
+        if self.metrics is not None:
+            self.metrics.set_active(self.active_partitions())
+        return repaired
+
+    def reap_idle(self) -> int:
+        """Settle lifecycle records with ZERO tenant holders: Ready
+        partitions idle since their last detach (or no longer desired
+        after an apply()), plus orphaned Creating/Destroying records
+        whose tenant rolled back or was GC'd without an unprepare --
+        without this a half-created carve-out would occupy its chips
+        until the next plugin restart. Safe against in-flight
+        attaches: a live prepare's claim reservation exists before
+        attach runs, so a zero-holder record observed under the device
+        lock is genuinely orphaned. Returns partitions reaped."""
+        reaped = 0
+        for name in sorted(self._checkpoint.get().claims):
+            with self._dev_lock(name):
+                rec = self._record(name)
+                if rec is None or self._holders(name) > 0:
+                    continue
+                self._teardown_locked(name, rec)
+                reaped += 1
+        return reaped

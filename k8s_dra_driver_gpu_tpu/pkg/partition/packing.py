@@ -1,0 +1,116 @@
+"""ParvaGPU-style demand-matched spatial packing.
+
+ParvaGPU (2409.14447) meets large-scale DNN-inference SLOs by choosing
+per-tenant GPU "spatial shares" and then CO-LOCATING complementary
+tenants so chips run full instead of fragmenting. The TPU translation
+packs sized tenants (per-tenant HBM budgets from
+pkg/partition/profiles.SizingPolicy) onto chips with
+best-fit-decreasing: large tenants seed chips, small complementary
+tenants top them off, and the plan reports the waste the layout leaves
+so the planner can compare candidate partition sets.
+
+Deterministic on purpose: the same demands always produce the same
+plan (bench gates and tests replay it).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .spec import PartitionDemand
+
+
+@dataclass
+class ChipPlan:
+    """Tenants co-located on one chip."""
+
+    index: int
+    capacity_hbm: int
+    used_hbm: int = 0
+    tenants: list[PartitionDemand] = field(default_factory=list)
+
+    @property
+    def free_hbm(self) -> int:
+        return self.capacity_hbm - self.used_hbm
+
+
+@dataclass
+class PackingPlan:
+    chips: list[ChipPlan]
+    unplaced: list[PartitionDemand]
+
+    @property
+    def chips_used(self) -> int:
+        return sum(1 for c in self.chips if c.tenants)
+
+    @property
+    def tenants_placed(self) -> int:
+        return sum(len(c.tenants) for c in self.chips)
+
+    @property
+    def tenants_per_chip(self) -> float:
+        used = self.chips_used
+        return self.tenants_placed / used if used else 0.0
+
+    @property
+    def waste_fraction(self) -> float:
+        """Unused HBM across the chips the plan touched (the ParvaGPU
+        objective: lower = tighter co-location)."""
+        cap = sum(c.capacity_hbm for c in self.chips if c.tenants)
+        if not cap:
+            return 0.0
+        used = sum(c.used_hbm for c in self.chips if c.tenants)
+        return 1.0 - used / cap
+
+    def to_dict(self) -> dict:
+        return {
+            "chipsUsed": self.chips_used,
+            "tenantsPlaced": self.tenants_placed,
+            "tenantsPerChip": round(self.tenants_per_chip, 2),
+            "wasteFraction": round(self.waste_fraction, 4),
+            "unplaced": len(self.unplaced),
+        }
+
+
+def pack_tenants(demands: list[PartitionDemand], chip_hbm: int,
+                 chips: int, max_tenants_per_chip: int | None = None
+                 ) -> PackingPlan:
+    """Best-fit-decreasing co-location of tenants onto ``chips`` chips
+    of ``chip_hbm`` HBM each.
+
+    Tenants sort by HBM demand descending (ties broken by tenant key
+    for determinism); each picks the chip whose remaining HBM fits it
+    TIGHTEST -- which is exactly what pairs a large tenant with the
+    complementary small ones instead of spreading smalls across fresh
+    chips. ``max_tenants_per_chip`` caps co-tenancy (the cooperative
+    time-slice client bound); None = HBM-bound only."""
+    expanded: list[PartitionDemand] = []
+    for d in demands:
+        for _ in range(max(d.count, 0)):
+            expanded.append(PartitionDemand(
+                hbm_bytes=d.hbm_bytes, cores=d.cores, count=1,
+                tenant=d.tenant))
+    expanded.sort(key=lambda d: (-d.hbm_bytes, d.tenant))
+    plan = PackingPlan(
+        chips=[ChipPlan(index=i, capacity_hbm=chip_hbm)
+               for i in range(chips)],
+        unplaced=[],
+    )
+    for demand in expanded:
+        best: ChipPlan | None = None
+        for chip in plan.chips:
+            if chip.free_hbm < demand.hbm_bytes:
+                continue
+            if max_tenants_per_chip is not None and \
+                    len(chip.tenants) >= max_tenants_per_chip:
+                continue
+            if best is None or chip.free_hbm < best.free_hbm or (
+                    chip.free_hbm == best.free_hbm
+                    and chip.index < best.index):
+                best = chip
+        if best is None:
+            plan.unplaced.append(demand)
+            continue
+        best.tenants.append(demand)
+        best.used_hbm += demand.hbm_bytes
+    return plan

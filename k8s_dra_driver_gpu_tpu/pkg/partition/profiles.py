@@ -1,0 +1,193 @@
+"""Tenant-profile store + profile-guided partition sizing (MISO).
+
+MISO (2207.11428) sizes MIG partitions by PROFILING each tenant's
+resource demand and then choosing the smallest partition that satisfies
+it, instead of letting users guess. The TPU translation:
+
+- :class:`TenantProfileStore` records observed HBM/core demand per
+  TENANT KEY -- a DeviceClass name or the value of the claim annotation
+  ``resource.tpu.dra/tenant-profile`` -- and answers percentile
+  queries. It seeds from a static profile file (the operator's prior)
+  and from bench-measured defaults (:data:`DEFAULT_TENANT_DEMANDS`,
+  numbers measured by the in-repo model stack on v5e-class HBM
+  footprints), so sizing works before any live observation exists.
+- :class:`SizingPolicy` picks the SMALLEST profile in a
+  :class:`~.spec.PartitionSet` catalog whose per-tenant budget covers
+  the demand percentile (HBM first -- the binding constraint for
+  inference serving -- then cores).
+
+The store is node- and scheduler-side shareable: it is pure state with
+a JSON file form, no kube or device dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+
+from .spec import PartitionDemand, PartitionProfile, PartitionSpecError
+
+#: Claim annotation naming the tenant profile a claim belongs to.
+TENANT_PROFILE_ANNOTATION = "resource.tpu.dra/tenant-profile"
+
+#: Bench-measured per-tenant working sets (HBM bytes, cores) for the
+#: in-repo serving stack: decode-only llama-class serving at small
+#: batch fits comfortably in a fraction of a chip's HBM. These are the
+#: cold-start priors; live observations supersede them.
+DEFAULT_TENANT_DEMANDS: dict[str, PartitionDemand] = {
+    "serving-small": PartitionDemand(hbm_bytes=2 << 30, cores=1,
+                                     tenant="serving-small"),
+    "serving-medium": PartitionDemand(hbm_bytes=6 << 30, cores=1,
+                                      tenant="serving-medium"),
+    "serving-large": PartitionDemand(hbm_bytes=12 << 30, cores=1,
+                                     tenant="serving-large"),
+}
+
+_MAX_SAMPLES = 4096  # per tenant key; serving fleets churn constantly
+
+
+class TenantProfileStore:
+    """Observed demand samples per tenant key, with percentile reads.
+
+    Thread-safe: the node plugin's prepare path and the planner read/
+    write concurrently."""
+
+    def __init__(self, defaults: dict[str, PartitionDemand] | None = None):
+        self._lock = threading.Lock()
+        # tenant key -> HBM-demand samples (bytes) in ARRIVAL order
+        # (a bounded sliding window) + core demand.
+        self._hbm: dict[str, list[int]] = {}
+        self._cores: dict[str, int] = {}
+        defaults = (DEFAULT_TENANT_DEMANDS if defaults is None
+                    else defaults)
+        for key, demand in defaults.items():
+            self._hbm[key] = [demand.hbm_bytes]
+            self._cores[key] = demand.cores
+
+    def observe(self, tenant: str, hbm_bytes: int, cores: int = 1) -> None:
+        """Fold one observed demand sample into the tenant's bounded
+        sliding window. Eviction is by ARRIVAL, not by magnitude: a
+        tenant whose working set shrinks must see its percentiles come
+        down once the old large samples age out of the window."""
+        if not tenant or hbm_bytes < 0:
+            return
+        with self._lock:
+            samples = self._hbm.setdefault(tenant, [])
+            samples.append(hbm_bytes)
+            if len(samples) > _MAX_SAMPLES:
+                samples.pop(0)
+            self._cores[tenant] = max(self._cores.get(tenant, 1), cores)
+
+    def demand(self, tenant: str, percentile: float = 0.95
+               ) -> PartitionDemand | None:
+        """The demand percentile for one tenant key, or None when the
+        key has never been observed (and has no default)."""
+        with self._lock:
+            samples = self._hbm.get(tenant)
+            if not samples:
+                return None
+            ordered = sorted(samples)
+            idx = min(len(ordered) - 1,
+                      max(0, int(percentile * len(ordered) + 0.5) - 1))
+            # count stays 1 (one tenant's demand): pack_tenants reads
+            # it as tenant multiplicity, not as the sample size.
+            return PartitionDemand(
+                hbm_bytes=ordered[idx],
+                cores=self._cores.get(tenant, 1),
+                tenant=tenant,
+            )
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return sorted(self._hbm)
+
+    # -- static profile file --------------------------------------------------
+
+    def load_file(self, path: str) -> int:
+        """Merge a static profile file: ``{"tenants": {key:
+        {"hbmBytes": N, "cores": M}}}``. Returns entries loaded."""
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise PartitionSpecError(
+                f"unreadable tenant profile file {path!r}: {e}"
+            ) from e
+        tenants = doc.get("tenants") or {}
+        for key, entry in tenants.items():
+            self.observe(key, int(entry.get("hbmBytes", 0)),
+                         cores=int(entry.get("cores", 1)))
+        return len(tenants)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "tenants": {
+                    key: {"hbmBytes": max(samples),
+                          "cores": self._cores.get(key, 1),
+                          "samples": len(samples)}
+                    for key, samples in self._hbm.items()
+                }
+            }
+
+
+@dataclass(frozen=True)
+class SizedChoice:
+    """One sizing decision: the chosen profile + the budget it grants
+    (per-tenant HBM bytes, and the per-core TIME share in milli --
+    PartitionInfo.tenant_core_milli, the virtual-capacity
+    multiplier)."""
+
+    profile: PartitionProfile
+    per_tenant_hbm: int
+    per_tenant_core_milli: int
+
+
+class SizingPolicy:
+    """MISO's choose step: the smallest catalog profile whose
+    PER-TENANT budget satisfies the demand percentile.
+
+    "Smallest" orders by per-tenant HBM first (the serving-workload
+    binding constraint), then by per-tenant core share -- so a demand
+    of 1.8Gi on a 16Gi chip picks the 8-slot/2Gi profile, not the
+    4-slot/4Gi one, and the fleet packs 8 tenants per chip instead
+    of 4."""
+
+    def __init__(self, percentile: float = 0.95):
+        self.percentile = percentile
+
+    def pick(self, demand: PartitionDemand,
+             catalog: list
+             ) -> SizedChoice | None:
+        """``catalog``: (profile, resolved PartitionInfo) pairs -- the
+        caller resolves subslice shapes against the actual host
+        (pkg/partition/engine.catalog_for). Budgets are read off the
+        PartitionInfo the publisher budgets counters from
+        (tenant_hbm_bytes / tenant_core_milli), so the policy can
+        never admit a tenant past the published per-slot capacity.
+        Returns the smallest satisfying choice, or None when nothing in
+        the catalog covers the demand (the tenant needs a whole chip /
+        sub-slice claim instead).
+
+        Core coverage is PHYSICAL SPAN, not temporal share: a tenant
+        demanding N cores needs a backing carve-out spanning >= N
+        cores (its parallelism cannot fold onto fewer), while the
+        per-core milli share only divides TIME on those cores -- that
+        is what oversubscription means."""
+        best: SizedChoice | None = None
+        for profile, info in catalog:
+            per_hbm = info.tenant_hbm_bytes
+            per_core_milli = info.tenant_core_milli
+            if per_hbm < demand.hbm_bytes:
+                continue
+            if info.cores < max(demand.cores, 1):
+                continue
+            if per_core_milli < 1:
+                continue
+            choice = SizedChoice(profile, per_hbm, per_core_milli)
+            if best is None or (choice.per_tenant_hbm,
+                                choice.per_tenant_core_milli) < (
+                    best.per_tenant_hbm, best.per_tenant_core_milli):
+                best = choice
+        return best

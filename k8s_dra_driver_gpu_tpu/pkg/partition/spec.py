@@ -1,0 +1,191 @@
+"""PartitionSet: the declarative partition layout for a pool.
+
+The MIG analog of a mig-parted config, but DYNAMIC: instead of an admin
+pre-carving a static device list, a PartitionSet declares per-pool
+desired partition PROFILES ("split v5e chips into 1-core tenants with
+1/2 the HBM, 4 tenants per carve-out") and the node-side engine
+(pkg/partition/engine.py) realizes/retires the backing carve-outs on
+demand at NodePrepare time.
+
+Grounding (PAPERS.md): MISO (2207.11428) profiles tenant demand and
+picks the smallest satisfying partition; ParvaGPU (2409.14447)
+co-locates complementary DNN-inference tenants spatially. The profile
+catalog here is the vocabulary both policies choose from
+(pkg/partition/profiles.py, pkg/partition/packing.py).
+
+A profile names a backing sub-slice carve-out (tpulib SubSliceProfile:
+"1c" core-level, or a chip-grid shape like "1x1" / "2x1x1"), an HBM
+fraction of that carve-out budgeted to the partition's tenants, and a
+tenant-slot count. ``max_tenants`` > 1 makes the partition an
+OVERSUBSCRIPTION device: the published KEP-4815 counter consumption is
+divided by the slot count (the virtual-capacity multiplier), so N
+tenant allocations together consume exactly the carve-out's budget and
+the scheduler can never over-commit cores/HBM between tenants and
+whole-chip claims.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+
+_PROFILE_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]*[a-z0-9])?$")
+_SUBSLICE_RE = re.compile(r"^(1c|\d+x\d+(?:x\d+)?)$")
+
+
+class PartitionSpecError(ValueError):
+    """A PartitionSet that can never be realized (config error)."""
+
+
+@dataclass(frozen=True)
+class PartitionProfile:
+    """One desired partition shape.
+
+    ``hbm_fraction`` budgets a share of the backing carve-out's HBM to
+    the partition's tenants (ParvaGPU-style right-sizing: a 1-chip
+    carve-out sold at 1/2 HBM leaves headroom the packer can give a
+    complementary co-tenant). ``max_tenants`` is the oversubscription
+    slot count; per-tenant HBM ceiling = carve-out HBM * hbm_fraction /
+    max_tenants, enforced at allocation by the scaled counters and at
+    runtime by the tenancy env contract."""
+
+    name: str
+    subslice: str  # backing carve-out profile ("1c", "1x1", "2x1x1", ...)
+    max_tenants: int = 1
+    hbm_fraction: float = 1.0
+
+    def validate(self) -> None:
+        if not _PROFILE_NAME_RE.match(self.name):
+            raise PartitionSpecError(
+                f"invalid partition profile name {self.name!r} "
+                "(lowercase alphanumerics and dashes)"
+            )
+        if not _SUBSLICE_RE.match(self.subslice):
+            raise PartitionSpecError(
+                f"profile {self.name!r}: invalid backing sub-slice "
+                f"{self.subslice!r} (want '1c' or a grid like '2x1x1')"
+            )
+        if self.max_tenants < 1:
+            raise PartitionSpecError(
+                f"profile {self.name!r}: maxTenants must be >= 1"
+            )
+        if not 0.0 < self.hbm_fraction <= 1.0:
+            raise PartitionSpecError(
+                f"profile {self.name!r}: hbmFraction must be in (0, 1]"
+            )
+
+    @property
+    def oversubscribed(self) -> bool:
+        return self.max_tenants > 1
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "subslice": self.subslice,
+            "maxTenants": self.max_tenants,
+            "hbmFraction": self.hbm_fraction,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PartitionProfile":
+        prof = cls(
+            name=d.get("name", ""),
+            subslice=d.get("subslice", ""),
+            max_tenants=int(d.get("maxTenants", 1)),
+            hbm_fraction=float(d.get("hbmFraction", 1.0)),
+        )
+        prof.validate()
+        return prof
+
+
+@dataclass(frozen=True)
+class PartitionSet:
+    """Desired partition profiles for the pools matching ``pools``
+    (fnmatch globs over POOL names, same contract as SchedulingDomain;
+    empty = every pool)."""
+
+    profiles: tuple[PartitionProfile, ...] = ()
+    pools: tuple[str, ...] = ()
+
+    def validate(self) -> None:
+        seen: set[str] = set()
+        for prof in self.profiles:
+            prof.validate()
+            if prof.name in seen:
+                raise PartitionSpecError(
+                    f"duplicate partition profile name {prof.name!r}"
+                )
+            seen.add(prof.name)
+
+    def applies_to_pool(self, pool: str) -> bool:
+        if not self.pools:
+            return True
+        from fnmatch import fnmatch  # noqa: PLC0415
+
+        return any(fnmatch(pool, pat) for pat in self.pools)
+
+    def to_dict(self) -> dict:
+        return {
+            "profiles": [p.to_dict() for p in self.profiles],
+            "pools": list(self.pools),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PartitionSet":
+        ps = cls(
+            profiles=tuple(
+                PartitionProfile.from_dict(p)
+                for p in d.get("profiles", [])
+            ),
+            pools=tuple(d.get("pools", [])),
+        )
+        ps.validate()
+        return ps
+
+    @classmethod
+    def from_file(cls, path: str) -> "PartitionSet":
+        """Load the operator-authored partition layout (the mig-parted
+        config analog; see docs/operations.md for the format)."""
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise PartitionSpecError(
+                f"unreadable partition set {path!r}: {e}"
+            ) from e
+        if not isinstance(doc, dict):
+            raise PartitionSpecError(
+                f"partition set {path!r}: expected a JSON object"
+            )
+        return cls.from_dict(doc)
+
+
+@dataclass
+class PartitionDemand:
+    """Observed or declared per-tenant demand (the sizing input)."""
+
+    hbm_bytes: int = 0
+    cores: int = 1
+    count: int = 1  # tenants with this demand (packing weight)
+    tenant: str = ""  # tenant key (DeviceClass / annotation value)
+
+    def to_dict(self) -> dict:
+        return {"hbmBytes": self.hbm_bytes, "cores": self.cores,
+                "count": self.count, "tenant": self.tenant}
+
+
+def partition_device_name(profile: str, placement: int) -> str:
+    """Canonical partition device name (distinct from chip-/ss- names
+    so nothing can collide with the raw sub-slice devices)."""
+    return f"pt-{profile}-{placement}"
+
+
+_PT_RE = re.compile(r"^pt-([a-z0-9](?:[a-z0-9-]*[a-z0-9])?)-(\d+)$")
+
+
+def parse_partition_device_name(name: str) -> tuple[str, int] | None:
+    m = _PT_RE.match(name)
+    if not m:
+        return None
+    return m.group(1), int(m.group(2))

@@ -144,7 +144,8 @@ class CounterLedger:
 
 
 class Candidate:
-    __slots__ = ("driver", "pool", "node", "device", "blocking_taints")
+    __slots__ = ("driver", "pool", "node", "device", "blocking_taints",
+                 "slots")
 
     def __init__(self, driver, pool, node, device):
         self.driver = driver
@@ -158,6 +159,18 @@ class Candidate:
             t for t in device.get("taints") or []
             if t.get("effect") in ("NoSchedule", "NoExecute")
         ]
+        # Shared-device tenant slots (pkg/partition oversubscription):
+        # an ``oversubscribeSlots`` int attribute > 1 lets up to that
+        # many claims hold the device concurrently; everything else is
+        # exclusive (1). The device's consumesCounters are published
+        # PER SLOT, so the counter ledger stays exact.
+        entry = (device.get("attributes") or {}).get(
+            "oversubscribeSlots")
+        slots = entry.get("int", 1) if isinstance(entry, dict) else 1
+        try:
+            self.slots = max(int(slots), 1)
+        except (TypeError, ValueError):
+            self.slots = 1
 
     @property
     def name(self):
@@ -310,10 +323,19 @@ class AllocationState:
     def __init__(self, snapshot: InventorySnapshot):
         self.snapshot = snapshot
         self.ledger = snapshot.make_ledger()
+        # Keys at FULL capacity -- the set the fit probes. Exclusive
+        # devices fill at one allocation; shared (oversubscribed
+        # partition) devices fill at ``Candidate.slots`` concurrent
+        # holders, tracked in _counts.
         self.allocated: set[tuple] = set()
+        self._counts: dict[tuple, int] = {}
         self.node_load: dict[str, int] = {}
         self._claims: dict[str, frozenset] = {}
         self._alloc_lock = threading.Lock()
+
+    def _slots_of(self, key: tuple) -> int:
+        cand = self.snapshot.by_key.get(key)
+        return cand.slots if cand is not None else 1
 
     @staticmethod
     def claim_id(claim: dict) -> str:
@@ -333,6 +355,7 @@ class AllocationState:
         with self._alloc_lock:
             self.ledger = self.snapshot.make_ledger()
             self.allocated = set()
+            self._counts = {}
             self.node_load = {}
             self._claims = {}
             for claim in claims:
@@ -356,7 +379,10 @@ class AllocationState:
 
     def _apply_locked(self, cid: str, keys: frozenset) -> None:
         for key in keys:
-            self.allocated.add(key)
+            count = self._counts.get(key, 0) + 1
+            self._counts[key] = count
+            if count >= self._slots_of(key):
+                self.allocated.add(key)
             cand = self.snapshot.by_key.get(key)
             if cand is not None:
                 self.ledger.debit(cand.driver, cand.pool,
@@ -380,12 +406,14 @@ class AllocationState:
 
     def try_commit(self, claim: dict) -> bool:
         """Atomically reserve one claim's planned allocation: every
-        device key must still be free and every counter budget must
-        still fit, judged and applied under one lock. Returns False on
-        conflict (the caller re-fits against fresh state); replaying a
-        claim's own reservation returns True (idempotent). A reserve
-        whose kube patch subsequently fails is undone via ``forget``,
-        so a failed write never leaks a debit (commit-then-observe)."""
+        device key must still have a free slot (exclusive devices: not
+        allocated at all; shared partition devices: fewer than
+        ``slots`` holders) and every counter budget must still fit,
+        judged and applied under one lock. Returns False on conflict
+        (the caller re-fits against fresh state); replaying a claim's
+        own reservation returns True (idempotent). A reserve whose
+        kube patch subsequently fails is undone via ``forget``, so a
+        failed write never leaks a debit (commit-then-observe)."""
         cid = self.claim_id(claim)
         keys = self._alloc_keys(claim)
         with self._alloc_lock:
@@ -395,9 +423,11 @@ class AllocationState:
             if prior is not None:
                 # The claim was freshly read as unallocated, so a prior
                 # entry is stale (a deallocated claim's ghost from the
-                # commit-log replay): release it and re-judge. Callers
-                # serialize per claim (shard affinity), so this can
-                # never steal another worker's in-flight reservation.
+                # commit-log replay): release it and re-judge. The work
+                # queue runs each key on at most one worker at a time
+                # (its running-set -- true even with work stealing), so
+                # this can never drop another worker's in-flight
+                # reservation.
                 self._release_locked(prior)
                 self._claims.pop(cid, None)
             debited: list[Candidate] = []
@@ -444,7 +474,13 @@ class AllocationState:
 
     def _release_locked(self, keys: frozenset) -> None:
         for key in keys:
-            self.allocated.discard(key)
+            count = self._counts.get(key, 0) - 1
+            if count > 0:
+                self._counts[key] = count
+            else:
+                self._counts.pop(key, None)
+            if count < self._slots_of(key):
+                self.allocated.discard(key)
             cand = self.snapshot.by_key.get(key)
             if cand is not None:
                 self.ledger.credit(cand.driver, cand.pool,

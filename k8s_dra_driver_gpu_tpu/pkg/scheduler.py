@@ -257,6 +257,13 @@ class DraScheduler:
                               else kind)
         return 1 + h % (self.sched_workers - 1)
 
+    @staticmethod
+    def _stealable(key: tuple) -> bool:
+        """Only per-object data keys (claim/pod) may migrate to an idle
+        worker; control keys keep their dedicated worker-0 lane."""
+        return isinstance(key, tuple) and bool(key) and \
+            key[0] not in _CTL_KINDS
+
     def _on_snapshot_build(self, seconds: float) -> None:
         if self.sched_metrics is not None:
             self.sched_metrics.snapshot_build.observe(seconds)
@@ -684,7 +691,7 @@ class DraScheduler:
 
     def _allocate_one(self, claim, snap: InventorySnapshot,
                       alloc: AllocationState, classes,
-                      pinned_node: str | None = None) -> bool:
+                      pinned_node: str | None = None) -> str:
         """One claim through the sharded allocation protocol:
 
         1. **Fit** per candidate node under that node's lock (gang /
@@ -699,19 +706,21 @@ class DraScheduler:
            write that never landed never leaks a debit
            (commit-then-observe).
 
-        Returns True when an allocation landed. ``pinned_node``
-        restricts placement to the node a consumer pod is already
-        bound to (real DRA allocates during that pod's scheduling, so
-        the choice is inherently per-node)."""
+        Returns the final outcome ("committed" | "unfit" | "failed" |
+        "conflict" | "norequests"). ``pinned_node`` restricts placement
+        to the node a consumer pod is already bound to (real DRA
+        allocates during that pod's scheduling, so the choice is
+        inherently per-node)."""
         requests = claim.get("spec", {}).get("devices", {}).get(
             "requests", [])
         if not requests:
-            return False
+            return "norequests"
         # ComputeDomain gangs first try the ICI-adjacent host window
         # the CD controller picked; load still spreads the gang's
         # members WITHIN the window, and non-window nodes remain as
         # overflow so a full window degrades instead of wedging.
         window = set(self._preferred_gang_nodes(claim) or ())
+        outcome = "unfit"
         for _attempt in range(self.COMMIT_RETRIES):
             nodes = self._candidate_nodes(claim, snap, alloc.load_view(),
                                           window, pinned_node)
@@ -723,17 +732,21 @@ class DraScheduler:
             outcome = self._try_nodes(claim, nodes, window, snap, alloc,
                                       ledger, classes)
             if outcome == "committed":
-                return True
+                self._clear_domain_exhausted(claim)
+                return outcome
             if outcome != "conflict":
-                return False
+                break
             if self.sched_metrics is not None:
                 self.sched_metrics.commit_conflicts.inc()
-        logger.warning(
-            "claim %s/%s: %d consecutive commit conflicts; leaving "
-            "pending for the next sync",
-            _meta(claim).get("namespace", "default"),
-            _meta(claim).get("name", "?"), self.COMMIT_RETRIES)
-        return False
+        if outcome == "conflict":
+            logger.warning(
+                "claim %s/%s: %d consecutive commit conflicts; leaving "
+                "pending for the next sync",
+                _meta(claim).get("namespace", "default"),
+                _meta(claim).get("name", "?"), self.COMMIT_RETRIES)
+        elif outcome == "unfit" and pinned_node is None:
+            self._flag_domain_exhausted(claim)
+        return outcome
 
     def _try_nodes(self, claim, nodes: list[str], window: set,
                    snap: InventorySnapshot, alloc: AllocationState,
@@ -1104,6 +1117,103 @@ class DraScheduler:
                 _meta(claim).get("namespace", "default"),
                 _meta(claim).get("name", "?"), self.MAX_FIT_STEPS, node)
             return None
+
+    # -- domain-exhaustion surfacing (scheduler-per-pool sharding) ------------
+
+    DOMAIN_EXHAUSTED_CONDITION = "DomainExhausted"
+
+    def _flag_domain_exhausted(self, claim) -> None:
+        """A claim PINNED into this scheduling domain found no fit in
+        the domain's (pool-restricted) inventory. Without this it sits
+        silently Pending forever -- the domain annotation stops it from
+        spilling to other pools by design. Surface the wedge: a
+        ``DomainExhausted`` condition on the claim plus a deduped
+        Warning Event, and count it
+        (tpu_dra_sched_domain_exhausted_total) so operators can alert
+        on a full domain."""
+        if self.domain is None or not self.domain.pools:
+            return  # unrestricted inventory: not a domain wedge
+        ann = (_meta(claim).get("annotations") or {}).get(
+            DOMAIN_ANNOTATION, "")
+        if not ann:
+            return  # default-domain traffic is not pinned
+        if self.sched_metrics is not None:
+            self.sched_metrics.domain_exhausted.labels(ann).inc()
+        ns = _meta(claim).get("namespace", "default")
+        name = _meta(claim)["name"]
+        message = (
+            f"no device fit in scheduling domain {ann!r} (pools "
+            f"{sorted(self.domain.pools)}); the claim stays pending "
+            "until domain capacity frees or the annotation moves it"
+        )
+        conditions = claim.get("status", {}).get("conditions") or []
+        for c in conditions:
+            if c.get("type") == self.DOMAIN_EXHAUSTED_CONDITION and \
+                    c.get("status") == "True" and \
+                    c.get("message") == message:
+                return  # already surfaced: deduped, no churn
+        kept = [c for c in conditions
+                if c.get("type") != self.DOMAIN_EXHAUSTED_CONDITION]
+        kept.append({
+            "type": self.DOMAIN_EXHAUSTED_CONDITION,
+            "status": "True",
+            "reason": "DomainExhausted",
+            "message": message,
+        })
+        try:
+            self.kube.patch(*RESOURCE, "resourceclaims", name,
+                            {"status": {"conditions": kept}},
+                            namespace=ns)
+        except KubeError:
+            # Cosmetic surfacing write: a flaky apiserver here must
+            # never abort the sync pass that real allocations ride on.
+            return
+        event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                # Deterministic name = create-once dedupe: repeat
+                # passes hit ConflictError instead of spamming.
+                "name": f"{name}.domain-exhausted",
+                "namespace": ns,
+            },
+            "type": "Warning",
+            "reason": "DomainExhausted",
+            "message": message,
+            "involvedObject": {
+                "kind": "ResourceClaim", "name": name, "namespace": ns,
+                "uid": _meta(claim).get("uid", ""),
+            },
+            "source": {"component": "tpu-dra-scheduler"},
+        }
+        try:
+            self.kube.create("", "v1", "events", event, namespace=ns)
+        except KubeError:
+            pass  # events best-effort; the condition already landed
+
+    def _clear_domain_exhausted(self, claim) -> None:
+        """An allocation landed for a claim that carried the
+        exhaustion condition: retire it (status False) so observers
+        see the recovery."""
+        conditions = claim.get("status", {}).get("conditions") or []
+        if not any(c.get("type") == self.DOMAIN_EXHAUSTED_CONDITION
+                   and c.get("status") == "True" for c in conditions):
+            return
+        kept = [c for c in conditions
+                if c.get("type") != self.DOMAIN_EXHAUSTED_CONDITION]
+        kept.append({
+            "type": self.DOMAIN_EXHAUSTED_CONDITION,
+            "status": "False",
+            "reason": "Allocated",
+            "message": "domain capacity freed; claim allocated",
+        })
+        try:
+            self.kube.patch(
+                *RESOURCE, "resourceclaims", _meta(claim)["name"],
+                {"status": {"conditions": kept}},
+                namespace=_meta(claim).get("namespace", "default"))
+        except (NotFoundError, ConflictError, KubeError):
+            pass  # cosmetic: the allocation itself already landed
 
     def _claim_pins(self) -> dict[tuple[str, str], str]:
         """(namespace, claim name) -> node, for claims whose consumer
@@ -1543,6 +1653,13 @@ class DraScheduler:
             shard_of=self._shard_of,
             metrics=(self.sched_metrics.workqueue
                      if self.sched_metrics is not None else None),
+            # Work stealing between idle data workers: a pathological
+            # single-namespace claim flood (every key hashing to one
+            # shard) drains across the pool. Control keys stay pinned
+            # to worker 0 -- the recovery/resync lane must never
+            # migrate behind a claim flood.
+            steal=(self._stealable if self.sched_workers > 1 else None),
+            may_steal=lambda idx: idx != 0,
         )
         self.view.start()
         self._enqueue(("full",))
@@ -1752,7 +1869,9 @@ class DraScheduler:
 
     def _sync_claim_keys_batched(self, key: tuple) -> None:
         """Batched multi-claim allocation: drain up to ``batch_max``
-        due claim keys from this worker's own shard against ONE
+        due claim keys from this worker's heap (its home shard plus
+        any work-stolen keys; per-key exclusion is the queue's
+        running-set, not shard residency) against ONE
         inventory snapshot + device-class read, amortizing the
         signature check and the static-CEL memo warmup over the whole
         burst. Extra keys report their outcomes back to the queue via

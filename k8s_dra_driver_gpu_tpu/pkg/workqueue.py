@@ -31,9 +31,18 @@ Scale-out additions (scheduler scale-out PR):
   requeue delay escalates exponentially (capped at the limiter's max
   delay), so one hot key cannot monopolize a worker while cold keys
   wait. The streak resets the first time the key retires clean.
+- **Work stealing** (``steal``): an idle worker may claim ready keys
+  from the DEEPEST sibling heap (under the shared owner lock), so a
+  pathological flood hashing onto one shard -- e.g. a single-namespace
+  claim storm whose ns/name keys all land on one data worker -- drains
+  across the pool instead of serializing. Only keys the ``steal``
+  predicate admits are eligible (the scheduler excludes control keys),
+  and per-KEY exclusion is preserved: a key lives in exactly one heap
+  and ``_running`` blocks concurrent re-runs, so stealing changes
+  placement, never serialization semantics.
 - **Observability** (``metrics``): per-shard depth, queue-wait
-  histogram, retry/drop/hot-backoff counters via a duck-typed sink
-  (pkg/metrics.WorkQueueMetrics).
+  histogram, retry/drop/hot-backoff/steal counters via a duck-typed
+  sink (pkg/metrics.WorkQueueMetrics).
 """
 
 from __future__ import annotations
@@ -138,12 +147,24 @@ class WorkQueue:
         on_drop: Callable[[Any, BaseException], None] | None = None,
         shard_of: Callable[[Any], Any] | None = None,
         metrics=None,
+        steal: Callable[[Any], bool] | None = None,
+        may_steal: Callable[[int], bool] | None = None,
     ):
         self._limiter = limiter
         self._name = name
         self._on_drop = on_drop
         self._shard_of = shard_of
         self._metrics = metrics
+        # Work-stealing predicate: keys it admits may be migrated from
+        # a backlogged sibling's heap to an idle worker. None (the
+        # default) disables stealing entirely -- strict shard->worker
+        # placement, the historical behavior. ``may_steal(worker)``
+        # additionally gates WHICH workers act as thieves (the
+        # scheduler keeps its dedicated control worker out, so control
+        # keys never queue behind stolen claim work).
+        self._steal = steal
+        self._may_steal = may_steal
+        self._idle: set[int] = set()
         self.workers = max(workers, 1)
         self._heaps: list[list[_Scheduled]] = [
             [] for _ in range(self.workers)]
@@ -226,8 +247,10 @@ class WorkQueue:
     def take_ready(self, pred: Callable[[Any], bool],
                    limit: int) -> list[Any]:
         """Claim up to ``limit`` additional DUE keys from the calling
-        worker's own heap (same-shard by construction) matching
-        ``pred``, marking them running. Only callable from inside a
+        worker's own heap (its home shard, plus any keys work stealing
+        migrated in) matching ``pred``, marking them running. Per-key
+        exclusion rests on the ``_running`` set, not shard residency,
+        so stolen keys batch exactly like home keys. Only callable from inside a
         queue callback; the caller must report each taken key's outcome
         via :meth:`finish`. Batch takes bypass the global token bucket
         (the batch exists to amortize work, not to multiply it)."""
@@ -294,6 +317,14 @@ class WorkQueue:
         self._size += 1
         self._observe_depth_locked(idx)
         self._worker_cv[idx].notify()
+        if self._steal is not None and delay <= 0:
+            # Give one idle sibling a chance to steal if the owner is
+            # backlogged; a thief that finds nothing just re-sleeps.
+            for j in self._idle:
+                if j != idx and (self._may_steal is None
+                                 or self._may_steal(j)):
+                    self._worker_cv[j].notify()
+                    break
 
     def _observe_depth_locked(self, idx: int) -> None:
         if self._metrics is not None:
@@ -334,6 +365,51 @@ class WorkQueue:
             self._metrics.inc_hot_backoff()
         return delay
 
+    def _steal_into_locked(self, idx: int) -> bool:
+        """Idle worker ``idx`` claims ready keys from the DEEPEST
+        sibling heap (caller holds the shared base lock, i.e. the
+        owner's lock). Only due, not-running keys the ``steal``
+        predicate admits are eligible; about half of them migrate (the
+        owner keeps the rest), preserving per-key serialization --
+        a key is in exactly one heap and ``_running`` still excludes
+        concurrent re-runs. Returns True when anything was stolen."""
+        now = time.monotonic()
+        best_idx = -1
+        best_ready: list[_Scheduled] = []
+        for j, heap in enumerate(self._heaps):
+            if j == idx:
+                continue
+            ready = [
+                item for item in heap
+                if item.when <= now and item.key not in self._running
+                and self._steal(item.key)
+            ]
+            if len(ready) > len(best_ready):
+                best_idx, best_ready = j, ready
+        if best_idx < 0 or not best_ready:
+            return False
+        take = best_ready[-max(1, len(best_ready) // 2):]
+        taken = {item.seq for item in take}
+        src = self._heaps[best_idx]
+        src[:] = [item for item in src if item.seq not in taken]
+        heapq.heapify(src)
+        for item in take:
+            heapq.heappush(self._heaps[idx], item)
+        self._observe_depth_locked(best_idx)
+        self._observe_depth_locked(idx)
+        if self._metrics is not None and \
+                hasattr(self._metrics, "inc_steal"):
+            self._metrics.inc_steal(len(take))
+        if len(best_ready) - len(take) > 1:
+            # The victim is still backlogged: cascade the wake to
+            # another idle sibling so the whole pool joins the drain.
+            for j in self._idle:
+                if j != idx and (self._may_steal is None
+                                 or self._may_steal(j)):
+                    self._worker_cv[j].notify()
+                    break
+        return True
+
     def _run(self, idx: int) -> None:
         self._tls.worker = idx
         heap = self._heaps[idx]
@@ -343,10 +419,19 @@ class WorkQueue:
                 while not self._shutdown and (
                     not heap or heap[0].when > time.monotonic()
                 ):
+                    if self._steal is not None and (
+                            self._may_steal is None
+                            or self._may_steal(idx)) and \
+                            self._steal_into_locked(idx):
+                        continue
                     timeout = None
                     if heap:
                         timeout = max(heap[0].when - time.monotonic(), 0)
-                    wcv.wait(timeout=timeout)
+                    self._idle.add(idx)
+                    try:
+                        wcv.wait(timeout=timeout)
+                    finally:
+                        self._idle.discard(idx)
                 if self._shutdown:
                     return
                 wait = self._take_token()

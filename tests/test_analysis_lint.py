@@ -599,6 +599,58 @@ class TestSchedulerLockDisciplineRule:
         findings = lint_source(src, rel="pkg/scheduler.py")
         assert "TPUDRA001" not in rules_of(findings)
 
+
+class TestCarveOutRegistryRule:
+    """TPUDRA011: carve-out registry create/destroy is sanctioned only
+    in the partition engine and DeviceState -- everything else must go
+    through PartitionEngine.attach/detach or the prepare pipeline."""
+
+    def test_registry_create_elsewhere_flagged(self):
+        src = ("class Sweeper:\n"
+               "    def bad(self, live):\n"
+               "        self._registry.create(live)\n")
+        findings = lint_source(src, rel="kubeletplugin/reconcile.py")
+        assert "TPUDRA011" in rules_of(findings)
+
+    def test_registry_destroy_via_public_alias_flagged(self):
+        src = ("def reap(state, uuid):\n"
+               "    state.subslice_registry.destroy(uuid)\n")
+        findings = lint_source(src, rel="pkg/recovery.py")
+        assert "TPUDRA011" in rules_of(findings)
+
+    def test_device_state_sanctioned(self):
+        src = ("class DeviceState:\n"
+               "    def _rollback(self, uuid):\n"
+               "        self._registry.destroy(uuid)\n")
+        assert "TPUDRA011" not in rules_of(
+            lint_source(src, rel="kubeletplugin/device_state.py"))
+
+    def test_partition_engine_sanctioned_by_rel_path(self):
+        src = ("class PartitionEngine:\n"
+               "    def attach(self, live):\n"
+               "        self._state.subslice_registry.create(live)\n")
+        assert "TPUDRA011" not in rules_of(
+            lint_source(src, rel="pkg/partition/engine.py"))
+
+    def test_same_basename_elsewhere_not_sanctioned(self):
+        # A stray engine.py outside pkg/partition/ gets no free pass.
+        src = ("def hack(state, live):\n"
+               "    state.subslice_registry.create(live)\n")
+        findings = lint_source(src, rel="pkg/other/engine.py")
+        assert "TPUDRA011" in rules_of(findings)
+
+    def test_registry_reads_clean(self):
+        src = ("def audit(state):\n"
+               "    return state.subslice_registry.list()\n")
+        assert "TPUDRA011" not in rules_of(
+            lint_source(src, rel="pkg/recovery.py"))
+
+    def test_unrelated_create_clean(self):
+        src = ("def mk(kube, obj):\n"
+               "    kube.create('', 'v1', 'pods', obj)\n")
+        assert "TPUDRA011" not in rules_of(
+            lint_source(src, rel="pkg/recovery.py"))
+
     def test_out_of_scope_files_unaffected(self):
         # A _state_lock-named mutex elsewhere is not the scheduler's.
         src = ("class Other:\n"

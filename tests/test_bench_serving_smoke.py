@@ -1,0 +1,65 @@
+"""Tier-1 multi-tenant serving smoke: the `make bench-serving-smoke`
+contract as a non-slow test. Runs `bench.py --serving` at reduced scale
+and asserts the partition-engine gate set: tenant density >= 4x the
+whole-chip baseline, ZERO counter over-commit, every active tenant
+converged, bounded carve-out create p99, zero-write converged
+republishes, and idempotent resume of the partition create/destroy
+crash points -- so a regression anywhere in the pkg/partition stack
+(sizing, slot-aware allocation, engine lifecycle, counter scaling)
+fails fast here instead of surfacing as a BENCH trajectory dip."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-serving-smoke target.
+SMOKE_ENV = {
+    "BENCH_SERVING_NODES": "4",
+    "BENCH_SERVING_TENANTS": "96",
+    "BENCH_SERVING_BURST": "24",
+    "BENCH_SERVING_ROUNDS": "3",
+}
+
+
+def test_serving_smoke(tmp_path):
+    out_file = str(tmp_path / "BENCH_serving.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serving"],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV,
+             "BENCH_SERVING_OUT": out_file},
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "serving_tenants_per_chip"
+    ex = doc["extras"]
+    # The headline: >= 4x tenants per chip vs the whole-chip baseline
+    # (MISO sizing picked an 8-slot profile for the ~2Gi demand, so
+    # the fleet lands well above the floor even under churn).
+    assert doc["vs_baseline"] >= 4.0
+    assert ex["serving_profile_slots"] >= 4
+    # Zero counter over-commit, recomputed from the final allocations.
+    assert ex["serving_serving_overcommitted_counters"] == 0
+    assert ex["serving_baseline_overcommitted_counters"] == 0
+    # Every active tenant converged (capacity covers the active set).
+    assert ex["serving_serving_pending"] == 0
+    assert ex["serving_serving_active"] > ex["serving_baseline_active"]
+    # Converged republish through the content-hash diff: zero writes.
+    assert ex["serving_serving_republish_writes"] == 0
+    # Real-node carve-out creation stayed within the latency budget.
+    assert ex["serving_create_p99_ms"] is not None
+    assert ex["serving_create_p99_ms"] <= 1000.0
+    # Crash points (mid-create / mid-destroy) resumed idempotently
+    # under a fresh plugin on the same state root.
+    assert ex["serving_crash_create_resumed"] is True
+    assert ex["serving_crash_destroy_resumed"] is True
+    # The trajectory artifact landed and round-trips.
+    with open(out_file, encoding="utf-8") as f:
+        emitted = json.load(f)
+    assert emitted["vs_baseline"] == doc["vs_baseline"]
+    # The ParvaGPU packing plan agrees with the realized density to
+    # within churn (the plan has no churn, so it upper-bounds).
+    assert ex["serving_pack_tenants_per_chip"] >= doc["value"]

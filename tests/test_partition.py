@@ -1,0 +1,779 @@
+"""Multi-tenant partition engine tier (ISSUE 8): PartitionSet specs,
+MISO profile-guided sizing, ParvaGPU packing, the node-side dynamic
+carve-out lifecycle (crash-safe via the ``partition`` TransitionPolicy),
+oversubscription slots end to end through DeviceState and the
+slot-aware scheduler allocation state, and partition publishing through
+the content-hash diff."""
+
+import json
+import os
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+    Config,
+    DeviceState,
+    PrepareError,
+)
+from k8s_dra_driver_gpu_tpu.kubeletplugin.deviceinfo import DeviceKind
+from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+from k8s_dra_driver_gpu_tpu.kubeletplugin.partitions import (
+    consumed_counters,
+    shared_counter_sets,
+)
+from k8s_dra_driver_gpu_tpu.pkg import faults
+from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+    CheckpointTransitionError,
+    PARTITION_CREATING,
+    PARTITION_DESTROYING,
+    PARTITION_POLICY,
+    PARTITION_READY,
+)
+from k8s_dra_driver_gpu_tpu.pkg.cel import Quantity
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.partition import (
+    PartitionDemand,
+    PartitionProfile,
+    PartitionSet,
+    PartitionSpecError,
+    SizingPolicy,
+    TenantProfileStore,
+    pack_tenants,
+    parse_partition_device_name,
+    partition_device_name,
+)
+from k8s_dra_driver_gpu_tpu.pkg.partition.engine import (
+    catalog_for,
+    partition_devices,
+    resolve_partition_set,
+)
+from k8s_dra_driver_gpu_tpu.pkg.schedcache import (
+    AllocationState,
+    InventorySnapshot,
+)
+from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+    EnumerateOptions,
+    PyTpuLib,
+)
+from tests.fake_kube import make_claim, opaque
+
+GATES = ("DynamicSubSlice=true,TimeSlicingSettings=true,"
+         "MultiTenancySupport=true,TenantPartitioning=true")
+
+GIB = 1 << 30
+
+
+def serving_set(slots: int = 2, subslice: str = "1x1",
+                fraction: float = 1.0, name: str = "serv") -> PartitionSet:
+    return PartitionSet(profiles=(
+        PartitionProfile(name=name, subslice=subslice,
+                         max_tenants=slots, hbm_fraction=fraction),
+    ))
+
+
+def oversub_cfg():
+    return [{"parameters": opaque("SubSliceConfig", oversubscribe=True)}]
+
+
+@pytest.fixture()
+def v5e_state(tmp_root):
+    """v5e-4 host (4 chips, 1 core/chip, 16Gi HBM each) with a 2-slot
+    1-chip partition profile."""
+    return DeviceState(Config.mock(
+        root=tmp_root, topology="v5e-4", gates=GATES,
+        partition_set=serving_set(slots=2)))
+
+
+# -- spec ---------------------------------------------------------------------
+
+
+class TestPartitionSpec:
+    def test_profile_validation(self):
+        with pytest.raises(PartitionSpecError):
+            PartitionProfile(name="Bad Name", subslice="1x1").validate()
+        with pytest.raises(PartitionSpecError):
+            PartitionProfile(name="p", subslice="banana").validate()
+        with pytest.raises(PartitionSpecError):
+            PartitionProfile(name="p", subslice="1x1",
+                             max_tenants=0).validate()
+        with pytest.raises(PartitionSpecError):
+            PartitionProfile(name="p", subslice="1x1",
+                             hbm_fraction=1.5).validate()
+        PartitionProfile(name="serv-8", subslice="1c",
+                         max_tenants=8, hbm_fraction=0.5).validate()
+
+    def test_duplicate_profile_names_rejected(self):
+        ps = PartitionSet(profiles=(
+            PartitionProfile(name="a", subslice="1x1"),
+            PartitionProfile(name="a", subslice="2x1"),
+        ))
+        with pytest.raises(PartitionSpecError):
+            ps.validate()
+
+    def test_file_round_trip(self, tmp_path):
+        path = str(tmp_path / "partitions.json")
+        ps = PartitionSet(
+            profiles=(PartitionProfile(name="serv", subslice="1x1",
+                                       max_tenants=4,
+                                       hbm_fraction=0.5),),
+            pools=("pool-*",))
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(ps.to_dict(), f)
+        loaded = PartitionSet.from_file(path)
+        assert loaded == ps
+        assert loaded.applies_to_pool("pool-7")
+        assert not loaded.applies_to_pool("edge-1")
+
+    def test_unreadable_file_raises(self, tmp_path):
+        with pytest.raises(PartitionSpecError):
+            PartitionSet.from_file(str(tmp_path / "missing.json"))
+
+    def test_plugin_rejects_partition_set_without_gate(self, tmp_path):
+        """--partition-set with TenantPartitioning off must fail
+        startup loudly: DeviceState would otherwise skip the engine
+        and silently publish zero partition devices."""
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.main import run
+        path = str(tmp_path / "partitions.json")
+        ps = PartitionSet(
+            profiles=(PartitionProfile(name="serv", subslice="1x1",
+                                       max_tenants=4,
+                                       hbm_fraction=0.5),))
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(ps.to_dict(), f)
+        with pytest.raises(SystemExit, match="TenantPartitioning"):
+            run(["--partition-set", path,
+                 "--mock-topology", "v5e-4",
+                 "--state-root", str(tmp_path / "state")])
+
+    def test_device_name_round_trip(self):
+        name = partition_device_name("serv-small", 3)
+        assert name == "pt-serv-small-3"
+        assert parse_partition_device_name(name) == ("serv-small", 3)
+        assert parse_partition_device_name("chip-0") is None
+
+
+# -- MISO sizing --------------------------------------------------------------
+
+
+class TestProfileGuidedSizing:
+    def test_store_percentiles_and_defaults(self):
+        store = TenantProfileStore()
+        # Bench-measured defaults answer before any observation.
+        assert store.demand("serving-small").hbm_bytes == 2 * GIB
+        for mb in (100, 200, 300, 400, 1000):
+            store.observe("t", mb << 20)
+        assert store.demand("t", percentile=0.5).hbm_bytes == 300 << 20
+        assert store.demand("t", percentile=1.0).hbm_bytes == 1000 << 20
+        assert store.demand("unknown") is None
+
+    def test_window_evicts_by_arrival_so_demand_can_shrink(
+            self, monkeypatch):
+        """The sample window is FIFO by arrival: a tenant whose working
+        set shrinks sees its percentiles come down once the old large
+        samples age out (a sorted-trim would pin p95 at the historical
+        max forever)."""
+        from k8s_dra_driver_gpu_tpu.pkg.partition import profiles
+        monkeypatch.setattr(profiles, "_MAX_SAMPLES", 8)
+        store = TenantProfileStore(defaults={})
+        for _ in range(8):
+            store.observe("t", 12 * GIB)
+        assert store.demand("t", percentile=0.95).hbm_bytes == 12 * GIB
+        for _ in range(8):
+            store.observe("t", 2 * GIB)
+        assert store.demand("t", percentile=0.95).hbm_bytes == 2 * GIB
+
+    def test_demand_count_is_tenant_multiplicity_not_samples(self):
+        """demand().count feeds pack_tenants as tenant multiplicity;
+        the sample size must never leak into it (it would pack
+        thousands of phantom tenants)."""
+        store = TenantProfileStore(defaults={})
+        for mb in (100, 200, 300):
+            store.observe("t", mb << 20)
+        assert store.demand("t").count == 1
+
+    def test_static_profile_file(self, tmp_path):
+        path = str(tmp_path / "tenants.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"tenants": {"svc-a": {"hbmBytes": 3 * GIB,
+                                             "cores": 1}}}, f)
+        store = TenantProfileStore(defaults={})
+        assert store.load_file(path) == 1
+        assert store.demand("svc-a").hbm_bytes == 3 * GIB
+
+    def test_sizing_picks_smallest_satisfying(self):
+        lib = PyTpuLib()
+        opts = EnumerateOptions(mock_topology="v5e-4")
+        host = lib.enumerate(opts)
+        profiles = lib.subslice_profiles(opts)
+        candidates = PartitionSet(profiles=tuple(
+            PartitionProfile(name=f"s{n}", subslice="1x1", max_tenants=n)
+            for n in (1, 2, 4, 8)))
+        catalog = catalog_for(host, profiles, candidates)
+        choice = SizingPolicy().pick(
+            PartitionDemand(hbm_bytes=3 * GIB), catalog)
+        # 16Gi chip: the 4-slot profile (4Gi/tenant) is the smallest
+        # budget covering 3Gi -- not the 2-slot (8Gi) one.
+        assert choice.profile.name == "s4"
+        assert choice.per_tenant_hbm == 4 * GIB
+        none = SizingPolicy().pick(
+            PartitionDemand(hbm_bytes=64 * GIB), catalog)
+        assert none is None
+        # Core demand is PHYSICAL SPAN: a 2-core tenant cannot fold
+        # onto a 1-core (v5e single-chip) carve-out, no matter the
+        # HBM headroom or slot share.
+        assert SizingPolicy().pick(
+            PartitionDemand(hbm_bytes=1 * GIB, cores=2), catalog) is None
+        wide = catalog_for(host, profiles, PartitionSet(profiles=(
+            PartitionProfile(name="pair", subslice="2x1",
+                             max_tenants=4),)))
+        paired = SizingPolicy().pick(
+            PartitionDemand(hbm_bytes=1 * GIB, cores=2), wide)
+        assert paired is not None and paired.profile.name == "pair"
+
+
+# -- ParvaGPU packing ---------------------------------------------------------
+
+
+class TestPacking:
+    def test_complementary_tenants_co_locate(self):
+        plan = pack_tenants(
+            [PartitionDemand(hbm_bytes=12 * GIB, count=1, tenant="big"),
+             PartitionDemand(hbm_bytes=4 * GIB, count=1, tenant="small"),
+             PartitionDemand(hbm_bytes=8 * GIB, count=1, tenant="mid")],
+            chip_hbm=16 * GIB, chips=4)
+        # big(12)+small(4) share one chip; mid gets its own.
+        assert plan.chips_used == 2
+        assert plan.tenants_placed == 3
+        tenants_by_chip = sorted(
+            sorted(t.tenant for t in c.tenants)
+            for c in plan.chips if c.tenants)
+        assert ["big", "small"] in tenants_by_chip
+
+    def test_capacity_and_slot_caps_respected(self):
+        plan = pack_tenants(
+            [PartitionDemand(hbm_bytes=2 * GIB, count=20,
+                             tenant="small")],
+            chip_hbm=16 * GIB, chips=2, max_tenants_per_chip=4)
+        for chip in plan.chips:
+            assert chip.used_hbm <= chip.capacity_hbm
+            assert len(chip.tenants) <= 4
+        assert plan.tenants_placed == 8
+        assert len(plan.unplaced) == 12
+
+    def test_deterministic(self):
+        demands = [PartitionDemand(hbm_bytes=(i % 5 + 1) * GIB, count=2,
+                                   tenant=f"t{i}") for i in range(6)]
+        a = pack_tenants(demands, 16 * GIB, 4)
+        b = pack_tenants(demands, 16 * GIB, 4)
+        assert [[t.tenant for t in c.tenants] for c in a.chips] == \
+            [[t.tenant for t in c.tenants] for c in b.chips]
+
+
+# -- device projection --------------------------------------------------------
+
+
+class TestPartitionDevices:
+    def setup_method(self):
+        self.lib = PyTpuLib()
+        self.opts = EnumerateOptions(mock_topology="v5e-4")
+        self.host = self.lib.enumerate(self.opts)
+        self.profiles = self.lib.subslice_profiles(self.opts)
+
+    def test_projection_names_attrs_counters(self):
+        devs = partition_devices(self.host, self.profiles,
+                                 serving_set(slots=4, fraction=0.5))
+        assert sorted(devs) == [f"pt-serv-{k}" for k in range(4)]
+        dev = devs["pt-serv-0"]
+        entry = dev.to_dra_device()
+        assert entry["attributes"]["oversubscribeSlots"] == {"int": 4}
+        assert entry["attributes"]["partition"] == {"bool": True}
+        # Per-tenant budget: 16Gi * 0.5 / 4 = 2Gi.
+        assert entry["capacity"]["hbmBytes"] == {"value": str(2 * GIB)}
+        consumes = consumed_counters(dev, self.host)[0]["counters"]
+        assert consumes["core-0-0"] == {"value": "250m"}
+        assert consumes["hbm-0"] == {"value": str(2 * GIB)}
+
+    def test_slot_consumption_never_exceeds_carve_budget(self):
+        for slots in (1, 2, 3, 4, 8):
+            devs = partition_devices(self.host, self.profiles,
+                                     serving_set(slots=slots))
+            consumes = consumed_counters(devs["pt-serv-0"],
+                                         self.host)[0]["counters"]
+            core = Quantity.parse(consumes["core-0-0"]["value"]).milli
+            hbm = Quantity.parse(consumes["hbm-0"]["value"]).milli
+            assert core * slots <= 1000
+            assert hbm * slots <= (16 * GIB) * 1000
+
+    def test_pool_glob_filters(self):
+        ps = PartitionSet(
+            profiles=(PartitionProfile(name="serv", subslice="1x1"),),
+            pools=("serving-*",))
+        assert partition_devices(self.host, self.profiles, ps,
+                                 pool="batch-1") == {}
+        assert len(partition_devices(self.host, self.profiles, ps,
+                                     pool="serving-1")) == 4
+
+    def test_unknown_backing_subslice_fails_loudly(self):
+        ps = serving_set(subslice="9x9")
+        with pytest.raises(PartitionSpecError):
+            resolve_partition_set(self.host, self.profiles, ps)
+
+
+# -- partition TransitionPolicy ----------------------------------------------
+
+
+class TestPartitionPolicy:
+    def test_legal_lifecycle(self):
+        for old, new in ((None, PARTITION_CREATING),
+                         (PARTITION_CREATING, PARTITION_READY),
+                         (PARTITION_CREATING, PARTITION_DESTROYING),
+                         (PARTITION_READY, PARTITION_DESTROYING),
+                         (PARTITION_DESTROYING, None)):
+            PARTITION_POLICY.validate("p", old, new)
+
+    def test_ready_cannot_vanish_without_destroy_intent(self):
+        with pytest.raises(CheckpointTransitionError):
+            PARTITION_POLICY.validate("p", PARTITION_READY, None)
+        with pytest.raises(CheckpointTransitionError):
+            PARTITION_POLICY.validate("p", None, PARTITION_READY)
+
+
+# -- DeviceState lifecycle ----------------------------------------------------
+
+
+class TestDeviceStateLifecycle:
+    def test_partition_devices_enumerated_behind_gate(self, tmp_root):
+        st = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set()))
+        parts = [n for n, d in st.allocatable.items()
+                 if d.kind == DeviceKind.PARTITION]
+        assert len(parts) == 4
+        # Gate off: same config publishes no partitions.
+        st2 = DeviceState(Config.mock(
+            root=os.path.join(tmp_root, "off"), topology="v5e-4",
+            partition_set=serving_set()))
+        assert st2.partition_engine is None
+        assert not any(d.kind == DeviceKind.PARTITION
+                       for d in st2.allocatable.values())
+
+    def test_cotenants_share_one_carveout(self, v5e_state):
+        st = v5e_state
+        st.prepare(make_claim("t1", ["pt-serv-0"], configs=oversub_cfg()))
+        st.prepare(make_claim("t2", ["pt-serv-0"], configs=oversub_cfg()))
+        assert len(st.subslice_registry.list()) == 1
+        assert st.partition_engine.active_partitions() == 1
+        # Holder-counted teardown: the carve-out survives the first
+        # detach, dies with the last.
+        st.unprepare("t1")
+        assert len(st.subslice_registry.list()) == 1
+        st.unprepare("t2")
+        assert st.subslice_registry.list() == {}
+        assert st.partition_engine.active_partitions() == 0
+
+    def test_slot_cap_enforced(self, v5e_state):
+        st = v5e_state
+        st.prepare(make_claim("t1", ["pt-serv-0"], configs=oversub_cfg()))
+        st.prepare(make_claim("t2", ["pt-serv-0"], configs=oversub_cfg()))
+        with pytest.raises(PrepareError, match="no free tenant slot"):
+            st.prepare(make_claim("t3", ["pt-serv-0"],
+                                  configs=oversub_cfg()))
+
+    def test_partition_excludes_other_devices_on_its_cores(
+            self, v5e_state):
+        st = v5e_state
+        st.prepare(make_claim("t1", ["pt-serv-0"], configs=oversub_cfg()))
+        with pytest.raises(PrepareError, match="overlaps"):
+            st.prepare(make_claim("c0", ["chip-0"]))
+        # And the reverse: a held chip blocks its partition.
+        st.prepare(make_claim("c1", ["chip-1"]))
+        with pytest.raises(PrepareError, match="overlaps"):
+            st.prepare(make_claim("t2", ["pt-serv-1"],
+                                  configs=oversub_cfg()))
+
+    def test_oversubscribe_requires_opt_in(self, v5e_state):
+        with pytest.raises(PrepareError, match="oversubscribe"):
+            v5e_state.prepare(make_claim("t1", ["pt-serv-0"]))
+        assert "t1" not in v5e_state.prepared_claims()
+        assert v5e_state.subslice_registry.list() == {}
+
+    def test_exclusive_partition_needs_no_opt_in(self, tmp_root):
+        st = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=1, fraction=0.5)))
+        st.prepare(make_claim("t1", ["pt-serv-0"]))
+        with pytest.raises(PrepareError):
+            st.prepare(make_claim("t2", ["pt-serv-0"]))
+        # No tenancy dir: exclusive partitions don't co-share.
+        assert not st._tenancy.active("t1")
+
+    def test_env_and_sharing_contract(self, v5e_state):
+        st = v5e_state
+        st.prepare(make_claim("t1", ["pt-serv-0"], configs=oversub_cfg()))
+        spec = st._cdi.read_spec("t1")
+        dev_env = spec["devices"][0]["containerEdits"]["env"]
+        assert "TPU_PARTITION=serv" in dev_env
+        assert f"TPU_PARTITION_HBM_BYTES={8 * GIB}" in dev_env
+        common_env = spec["containerEdits"]["env"]
+        # Oversubscription sharing: cooperative time-slice policy +
+        # per-tenant tenancy ceiling at the slot budget.
+        assert "TPU_PROCESS_SHARING=cooperative" in common_env
+        assert "TPU_MULTI_TENANT=1" in common_env
+        assert f"TPU_HBM_LIMIT_BYTES={8 * GIB}" in common_env
+        assert st._timeslicing.current(0) is not None
+        # The policy file is holder-counted across co-tenants.
+        st.prepare(make_claim("t2", ["pt-serv-0"], configs=oversub_cfg()))
+        st.unprepare("t1")
+        assert st._timeslicing.current(0) is not None
+        st.unprepare("t2")
+        assert st._timeslicing.current(0) is None
+
+    def test_cdi_ids_are_claim_scoped_for_shared_devices(
+            self, v5e_state):
+        st = v5e_state
+        i1 = st.prepare(make_claim("t1", ["pt-serv-0"],
+                                   configs=oversub_cfg()))
+        i2 = st.prepare(make_claim("t2", ["pt-serv-0"],
+                                   configs=oversub_cfg()))
+        assert i1 != i2  # qualified CDI ids must never collide
+
+    def test_restart_resumes_holders_and_reaps_idle(self, tmp_root):
+        st = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        st.prepare(make_claim("t1", ["pt-serv-0"], configs=oversub_cfg()))
+        st2 = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        # Held partition survives the restart; its carve-out is intact.
+        assert len(st2.subslice_registry.list()) == 1
+        assert st2.partition_engine.active_partitions() == 1
+        st2.unprepare("t1")
+        assert st2.subslice_registry.list() == {}
+
+    def test_crash_mid_create_resumes_idempotently(self, tmp_root):
+        st = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        faults.arm("partition.create", mode="error", count=1)
+        try:
+            with pytest.raises(PrepareError):
+                st.prepare(make_claim("t1", ["pt-serv-0"],
+                                      configs=oversub_cfg()))
+        finally:
+            faults.reset()
+        # The failed prepare left no claim record and no carve-out...
+        assert "t1" not in st.prepared_claims()
+        # ...and a retry (same plugin) succeeds on the same device.
+        st.prepare(make_claim("t1", ["pt-serv-0"], configs=oversub_cfg()))
+        assert len(st.subslice_registry.list()) == 1
+        # A fresh plugin on the same root agrees with itself.
+        st2 = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        assert len(st2.subslice_registry.list()) == 1
+        assert st2.partition_engine.active_partitions() == 1
+
+    def test_crash_mid_destroy_resumes_idempotently(self, tmp_root):
+        st = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        st.prepare(make_claim("t1", ["pt-serv-0"], configs=oversub_cfg()))
+        faults.arm("partition.destroy", mode="error", count=1)
+        try:
+            with pytest.raises(Exception):
+                st.unprepare("t1")
+        finally:
+            faults.reset()
+        # Retry finishes the durable-intent destroy.
+        st.unprepare("t1")
+        assert st.subslice_registry.list() == {}
+        st2 = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        assert st2.partition_engine.active_partitions() == 0
+        assert st2.subslice_registry.list() == {}
+
+    def test_orphan_creating_record_reaped_at_restart(self, tmp_root):
+        """A crash BETWEEN the PartitionCreating record and the claim's
+        own reservation leaves a holderless Creating record: resume
+        rolls it back (record gone, no carve-out leak)."""
+        st = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        faults.arm("partition.create", mode="error", count=1)
+        try:
+            with pytest.raises(PrepareError):
+                st.prepare(make_claim("t1", ["pt-serv-0"],
+                                      configs=oversub_cfg()))
+        finally:
+            faults.reset()
+        st2 = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        assert st2.partition_engine._checkpoint.get().claims == {}
+        assert st2.subslice_registry.list() == {}
+
+    def test_apply_partition_set_replan(self, tmp_root):
+        st = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        assert "pt-serv-0" in st.allocatable
+        st.apply_partition_set(serving_set(slots=4, name="dense"))
+        names = [n for n, d in st.allocatable.items()
+                 if d.kind == DeviceKind.PARTITION]
+        assert sorted(names) == [f"pt-dense-{k}" for k in range(4)]
+        assert st._slots_of("pt-dense-0") == 4
+
+    def test_replan_keeps_held_partitions_visible(self, tmp_root):
+        """A re-plan retiring a profile with LIVE tenants must keep
+        the held device in the allocatable set: overlap validation and
+        the sharing-release math read its cores from there. It leaves
+        only after the last tenant detaches (prune sweep)."""
+        st = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        st.prepare(make_claim("t1", ["pt-serv-0"], configs=oversub_cfg()))
+        st.apply_partition_set(serving_set(slots=4, name="dense"))
+        # Retired-but-held device survives the re-plan...
+        assert "pt-serv-0" in st.allocatable
+        # ...so a whole-chip claim on its chip is still rejected.
+        with pytest.raises(PrepareError, match="overlaps"):
+            st.prepare(make_claim("c0", ["chip-0"]))
+        # New tenants cannot attach to a retired device.
+        with pytest.raises(PrepareError, match="unknown partition"):
+            st.prepare(make_claim("t2", ["pt-serv-0"],
+                                  configs=oversub_cfg()))
+        # Last tenant leaves: the carve-out dies, the prune sweep
+        # drops the device, and the chip is whole again.
+        st.unprepare("t1")
+        assert st.subslice_registry.list() == {}
+        assert st.prune_retired_partitions() == 1
+        assert "pt-serv-0" not in st.allocatable
+        st.prepare(make_claim("c0", ["chip-0"]))
+
+    def test_mixed_oversubscribed_request_rejected(self, v5e_state):
+        """One request resolving to BOTH an oversubscribed partition
+        and an exclusive sub-slice fails closed: neither silently
+        unenforced sharing nor a wrongly-capped exclusive device."""
+        with pytest.raises(PrepareError, match="mixes oversubscribed"):
+            v5e_state.prepare(make_claim(
+                "mix", ["pt-serv-0", "ss-1x1-1"],
+                configs=oversub_cfg()))
+        assert "mix" not in v5e_state.prepared_claims()
+        assert v5e_state.subslice_registry.list() == {}
+
+    def test_engine_gone_rollback_is_holder_counted(self, tmp_root):
+        """Gate flipped off across a restart: unprepare must still not
+        destroy a shared carve-out while a co-tenant claim record
+        references it."""
+        st = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4", gates=GATES,
+            partition_set=serving_set(slots=2)))
+        st.prepare(make_claim("t1", ["pt-serv-0"], configs=oversub_cfg()))
+        st.prepare(make_claim("t2", ["pt-serv-0"], configs=oversub_cfg()))
+        st2 = DeviceState(Config.mock(
+            root=tmp_root, topology="v5e-4",
+            partition_set=serving_set(slots=2)))  # gate off: no engine
+        assert st2.partition_engine is None
+        st2.unprepare("t1")
+        assert len(st2.subslice_registry.list()) == 1  # t2 still runs
+        st2.unprepare("t2")
+        assert st2.subslice_registry.list() == {}
+
+
+# -- slot-aware scheduler allocation -----------------------------------------
+
+
+def partition_slices(node: str, slots: int = 2) -> list[dict]:
+    lib = PyTpuLib()
+    opts = EnumerateOptions(mock_topology="v5e-4")
+    host = lib.enumerate(opts)
+    profiles = lib.subslice_profiles(opts)
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.deviceinfo import (
+        AllocatableDevice,
+        ChipInfo,
+    )
+
+    devs = []
+    for chip in host.chips:
+        dev = AllocatableDevice(kind=DeviceKind.CHIP,
+                                chip=ChipInfo(chip=chip, host=host))
+        entry = dev.to_dra_device()
+        entry["consumesCounters"] = consumed_counters(dev, host)
+        devs.append(entry)
+    for dev in partition_devices(host, profiles,
+                                 serving_set(slots=slots)).values():
+        entry = dev.to_dra_device()
+        entry["consumesCounters"] = consumed_counters(dev, host)
+        devs.append(entry)
+    return [{
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+        "metadata": {"name": f"{node}-tpu.dra.dev"},
+        "spec": {"driver": "tpu.dra.dev", "nodeName": node,
+                 "pool": {"name": node, "generation": 1,
+                          "resourceSliceCount": 1},
+                 "sharedCounters": shared_counter_sets(host),
+                 "devices": devs},
+    }]
+
+
+class TestSlotAwareAllocation:
+    def _snap(self, slots=2):
+        return InventorySnapshot(partition_slices("node-0", slots))
+
+    @staticmethod
+    def _claim_for(uid, device):
+        return {
+            "metadata": {"uid": uid, "namespace": "default",
+                         "name": uid},
+            "status": {"allocation": {"devices": {"results": [{
+                "driver": "tpu.dra.dev", "pool": "node-0",
+                "device": device,
+            }]}}},
+        }
+
+    def test_candidate_slots_extracted(self):
+        snap = self._snap(slots=4)
+        key = ("tpu.dra.dev", "node-0", "pt-serv-0")
+        assert snap.by_key[key].slots == 4
+        chip = ("tpu.dra.dev", "node-0", "chip-0")
+        assert snap.by_key[chip].slots == 1
+
+    def test_try_commit_fills_slots_then_conflicts(self):
+        snap = self._snap(slots=2)
+        alloc = AllocationState(snap)
+        assert alloc.try_commit(self._claim_for("t1", "pt-serv-0"))
+        key = ("tpu.dra.dev", "node-0", "pt-serv-0")
+        assert key not in alloc.allocated  # one free slot left
+        assert alloc.try_commit(self._claim_for("t2", "pt-serv-0"))
+        assert key in alloc.allocated  # at capacity
+        assert not alloc.try_commit(self._claim_for("t3", "pt-serv-0"))
+
+    def test_release_frees_a_slot(self):
+        snap = self._snap(slots=2)
+        alloc = AllocationState(snap)
+        alloc.try_commit(self._claim_for("t1", "pt-serv-0"))
+        alloc.try_commit(self._claim_for("t2", "pt-serv-0"))
+        alloc.forget(self._claim_for("t1", "pt-serv-0"))
+        assert alloc.try_commit(self._claim_for("t3", "pt-serv-0"))
+
+    def test_counters_exclude_whole_chip_vs_tenants(self):
+        """End to end through the scheduler: tenants on chip 0's
+        partition block a whole-chip claim there, and vice versa."""
+        from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+
+        fake = FakeKubeClient()
+        RES = ("resource.k8s.io", "v1")
+        fake.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "tenant"},
+            "spec": {"selectors": [{"cel": {"expression":
+                'device.attributes["tpu.dra.dev"].partition'}}]},
+        })
+        fake.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "whole-chip"},
+            "spec": {"selectors": [{"cel": {"expression":
+                'device.attributes["tpu.dra.dev"].coresPerChip >= 1'}}]},
+        })
+        from k8s_dra_driver_gpu_tpu.pkg.sliceutil import (
+            publish_resource_slices,
+        )
+
+        publish_resource_slices(fake, partition_slices("node-0",
+                                                       slots=4))
+
+        def claim(name, cls):
+            fake.create(*RES, "resourceclaims", {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": "default",
+                             "uid": f"uid-{name}"},
+                "spec": {"devices": {"requests": [{
+                    "name": "r",
+                    "exactly": {"deviceClassName": cls}}]}},
+            }, namespace="default")
+
+        sched = DraScheduler(fake)
+        # 3 whole-chip claims take chips 0-2 (first-fit within the
+        # node), then 4 tenants fill the LAST free chip's partition,
+        # then neither a whole-chip claim nor a 5th tenant fits.
+        for k in range(3):
+            claim(f"chip-{k}", "whole-chip")
+        sched.sync_once()
+        for k in range(4):
+            claim(f"tenant-{k}", "tenant")
+        sched.sync_once()
+        claim("chip-late", "whole-chip")
+        claim("tenant-late", "tenant")
+        sched.sync_once()
+        got = {c["metadata"]["name"]:
+               bool(c.get("status", {}).get("allocation"))
+               for c in fake.list(*RES, "resourceclaims")}
+        assert all(got[f"tenant-{k}"] for k in range(4))
+        assert all(got[f"chip-{k}"] for k in range(3))
+        assert not got["chip-late"]
+        assert not got["tenant-late"]
+        # No counter over-commit: the four tenants consumed exactly
+        # chip 0 (250m x 4 cores... 1 core on v5e), nothing doubled.
+        devices = [
+            r["device"]
+            for c in fake.list(*RES, "resourceclaims")
+            if c.get("status", {}).get("allocation")
+            for r in c["status"]["allocation"]["devices"]["results"]
+        ]
+        assert sorted(d for d in devices if d.startswith("chip")) == \
+            ["chip-0", "chip-1", "chip-2"]
+        tenants = [d for d in devices if d.startswith("pt-")]
+        # All four tenants share ONE partition device (the only chip
+        # whose counters were still whole), consuming it exactly.
+        assert len(tenants) == 4 and set(tenants) == {"pt-serv-3"}
+
+
+# -- publishing ---------------------------------------------------------------
+
+
+class TestPartitionPublishing:
+    @pytest.fixture()
+    def driver(self, tmp_root):
+        kube = FakeKubeClient()
+        d = Driver(
+            Config.mock(root=tmp_root, topology="v5e-4", gates=GATES,
+                        partition_set=serving_set(slots=2)),
+            kube, node_name="node-a", enable_health_monitor=False,
+            publication_mode="split",  # KEP-4815 two-slice layout
+        )
+        d.publish_resources()
+        return d
+
+    def test_partitions_published_in_partitions_slice(self, driver):
+        slices = driver.kube.list("resource.k8s.io", "v1",
+                                  "resourceslices")
+        by_name = {s["metadata"]["name"]: s for s in slices}
+        parts = by_name["node-a-tpu.dra.dev-partitions"]
+        names = [d["name"] for d in parts["spec"]["devices"]]
+        assert "pt-serv-0" in names
+        entry = next(d for d in parts["spec"]["devices"]
+                     if d["name"] == "pt-serv-0")
+        assert entry["consumesCounters"][0]["counters"][
+            "core-0-0"] == {"value": "500m"}
+
+    def test_converged_republish_zero_writes(self, driver):
+        stats = driver.publish_resources()
+        assert stats["writes"] == 0 and stats["skipped"] >= 1
+
+    def test_replan_republishes_only_changed_inventory(self, driver):
+        stats = driver.apply_partition_set(
+            serving_set(slots=4, name="dense"))
+        # Inventory changed (device names moved): the diff rewrites at
+        # a bumped generation -- and a converged re-apply is free.
+        assert stats["writes"] >= 1
+        stats2 = driver.apply_partition_set(
+            serving_set(slots=4, name="dense"))
+        assert stats2["writes"] == 0
+        slices = driver.kube.list("resource.k8s.io", "v1",
+                                  "resourceslices")
+        names = [d["name"] for s in slices
+                 for d in s["spec"]["devices"]]
+        assert "pt-dense-0" in names and "pt-serv-0" not in names

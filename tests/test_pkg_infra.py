@@ -523,6 +523,131 @@ class TestWorkQueueSharding:
         assert shards <= {"0", "1"} and shards
 
 
+class TestWorkQueueStealing:
+    """Work stealing between idle data shards: a pathological
+    single-shard flood (every key hashing onto one worker) drains
+    across the pool; control-lane keys and non-thief workers stay
+    pinned."""
+
+    def test_single_shard_flood_spreads_across_workers(self):
+        threads: set[str] = set()
+        lock = threading.Lock()
+
+        def slow(key):
+            with lock:
+                threads.add(threading.current_thread().name)
+            time.sleep(0.02)
+
+        q = WorkQueue(workers=4, shard_of=lambda k: 1,
+                      steal=lambda k: True, name="steal")
+        t0 = time.monotonic()
+        for i in range(20):
+            q.enqueue(("claim", "ns", str(i)), slow)
+        assert q.wait_idle(10.0)
+        wall = time.monotonic() - t0
+        q.shutdown()
+        # More than one worker executed keys, and the flood finished
+        # faster than the serialized 20 x 20ms drain.
+        assert len(threads) > 1
+        assert wall < 20 * 0.02
+
+    def test_no_steal_predicate_keeps_strict_affinity(self):
+        threads: set[str] = set()
+        lock = threading.Lock()
+
+        def slow(key):
+            with lock:
+                threads.add(threading.current_thread().name)
+            time.sleep(0.005)
+
+        q = WorkQueue(workers=4, shard_of=lambda k: 1, name="nosteal")
+        for i in range(10):
+            q.enqueue(("claim", "ns", str(i)), slow)
+        assert q.wait_idle(10.0)
+        q.shutdown()
+        assert len(threads) == 1
+
+    def test_excluded_keys_never_migrate(self):
+        workers_of: dict = {}
+        lock = threading.Lock()
+
+        def slow(key):
+            with lock:
+                workers_of.setdefault(
+                    key[0], set()).add(threading.current_thread().name)
+            time.sleep(0.01)
+
+        q = WorkQueue(workers=3, shard_of=lambda k: 0,
+                      steal=lambda k: k[0] != "full", name="ctl")
+        for i in range(8):
+            q.enqueue(("full", i), slow)
+        assert q.wait_idle(10.0)
+        q.shutdown()
+        # Control keys stayed on their owning worker.
+        assert len(workers_of["full"]) == 1
+
+    def test_may_steal_gates_thief_workers(self):
+        threads: set[str] = set()
+        lock = threading.Lock()
+
+        def slow(key):
+            with lock:
+                threads.add(threading.current_thread().name)
+            time.sleep(0.01)
+
+        # Only worker 2 may steal from the flood on worker 1.
+        q = WorkQueue(workers=4, shard_of=lambda k: 1,
+                      steal=lambda k: True,
+                      may_steal=lambda idx: idx == 2, name="gated")
+        for i in range(12):
+            q.enqueue(("claim", "ns", str(i)), slow)
+        assert q.wait_idle(10.0)
+        q.shutdown()
+        assert {t.rsplit("-", 1)[1] for t in threads} <= {"1", "2"}
+
+    def test_stolen_key_preserves_retry_discipline(self):
+        """A stolen key that fails re-enqueues with backoff and
+        eventually succeeds, exactly like an owner-run key."""
+        attempts = {"n": 0}
+        done = threading.Event()
+
+        def flaky(key):
+            if key == ("claim", "ns", "flaky"):
+                attempts["n"] += 1
+                if attempts["n"] < 2:
+                    raise RuntimeError("transient")
+                done.set()
+            else:
+                time.sleep(0.02)
+
+        q = WorkQueue(limiter=RateLimiter(base_delay=0.01),
+                      workers=3, shard_of=lambda k: 1,
+                      steal=lambda k: True, name="retry")
+        for i in range(6):
+            q.enqueue(("claim", "ns", str(i)), flaky)
+        q.enqueue(("claim", "ns", "flaky"), flaky)
+        assert done.wait(10.0)
+        assert q.wait_idle(10.0)
+        q.shutdown()
+        assert attempts["n"] == 2
+
+    def test_steal_metric_counts(self):
+        from k8s_dra_driver_gpu_tpu.pkg.metrics import WorkQueueMetrics
+
+        wm = WorkQueueMetrics()
+
+        def slow(key):
+            time.sleep(0.02)
+
+        q = WorkQueue(workers=4, shard_of=lambda k: 1,
+                      steal=lambda k: True, metrics=wm, name="metered")
+        for i in range(16):
+            q.enqueue(("claim", "ns", str(i)), slow)
+        assert q.wait_idle(10.0)
+        q.shutdown()
+        assert wm.steals._value.get() > 0
+
+
 class TestMetrics:
     def test_taint_gauge_reconciles(self):
         from k8s_dra_driver_gpu_tpu.kubeletplugin.health import DeviceTaint

@@ -251,7 +251,8 @@ class TestMultiWorkerAllocation:
         classes = sched._device_classes()
         _, alloc2 = sched._rebuild_alloc_state()  # the swap
         assert alloc2 is not alloc1
-        assert sched._allocate_one(claim, snap, alloc1, classes)
+        assert sched._allocate_one(claim, snap, alloc1,
+                                   classes) == "committed"
         key = ("tpu.dra.dev", "node-a", "chip-0")
         assert key in alloc2.allocated, \
             "reservation landed only in the superseded state"
@@ -331,7 +332,8 @@ class TestMultiWorkerAllocation:
             return orig(claim_like)
 
         alloc.try_commit = stealing
-        assert sched._allocate_one(claim, snap, alloc, classes)
+        assert sched._allocate_one(claim, snap, alloc,
+                                   classes) == "committed"
         got = allocation(fake, "victim")
         assert got is not None
         # The re-fit picked the surviving chip, not the stolen one.
@@ -559,6 +561,87 @@ class TestSchedulingDomains:
         assert allocation(fake, "c2")
         stop2.set()
         t2.join(10.0)
+
+
+class TestDomainExhausted:
+    """A domain-pinned claim that cannot fit inside its scheduling
+    domain must surface the wedge (condition + deduped Warning Event +
+    metric) instead of sitting silently Pending."""
+
+    def _exhausted_setup(self):
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-b", chips=1))
+        sm = SchedulerMetrics()
+        sched = DraScheduler(
+            fake, domain=SchedulingDomain("b", pools=["node-b"]),
+            sched_metrics=sm)
+        make_claim(fake, "fill", annotations={DOMAIN_ANNOTATION: "b"})
+        sched.sync_once()
+        assert allocation(fake, "fill") is not None
+        make_claim(fake, "wedged",
+                   annotations={DOMAIN_ANNOTATION: "b"})
+        sched.sync_once()
+        return fake, sched, sm
+
+    def test_condition_event_and_metric(self):
+        fake, sched, sm = self._exhausted_setup()
+        claim = fake.get(*RES, "resourceclaims", "wedged", "default")
+        conds = claim["status"]["conditions"]
+        assert any(c["type"] == "DomainExhausted"
+                   and c["status"] == "True" for c in conds)
+        events = [e for e in fake.objects("", "events")
+                  if e.get("reason") == "DomainExhausted"]
+        assert len(events) == 1
+        assert events[0]["type"] == "Warning"
+        assert events[0]["involvedObject"]["name"] == "wedged"
+        assert sm.domain_exhausted.labels("b")._value.get() >= 1
+
+    def test_condition_and_event_deduped_across_passes(self):
+        fake, sched, sm = self._exhausted_setup()
+        for _ in range(3):
+            sched.sync_once()
+        claim = fake.get(*RES, "resourceclaims", "wedged", "default")
+        conds = [c for c in claim["status"]["conditions"]
+                 if c["type"] == "DomainExhausted"]
+        assert len(conds) == 1
+        events = [e for e in fake.objects("", "events")
+                  if e.get("reason") == "DomainExhausted"]
+        assert len(events) == 1
+        # The metric keeps counting attempts even though the claim
+        # surface stays quiet.
+        assert sm.domain_exhausted.labels("b")._value.get() >= 4
+
+    def test_condition_clears_when_capacity_frees(self):
+        fake, sched, sm = self._exhausted_setup()
+        fake.delete(*RES, "resourceclaims", "fill",
+                    namespace="default")
+        sched.sync_once()
+        claim = fake.get(*RES, "resourceclaims", "wedged", "default")
+        assert claim["status"]["allocation"]
+        conds = [c for c in claim["status"]["conditions"]
+                 if c["type"] == "DomainExhausted"]
+        assert len(conds) == 1 and conds[0]["status"] == "False"
+        assert conds[0]["reason"] == "Allocated"
+
+    def test_unpinned_claim_not_flagged(self):
+        """Unfit claims in the default domain (no annotation) are NOT
+        a domain wedge -- no condition, no event."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        publish_resource_slices(fake, node_slices("node-a", chips=1))
+        sched = DraScheduler(
+            fake,
+            domain=SchedulingDomain("a", pools=["node-a"],
+                                    default=True))
+        make_claim(fake, "fill")
+        sched.sync_once()
+        make_claim(fake, "overflow")
+        sched.sync_once()
+        claim = fake.get(*RES, "resourceclaims", "overflow", "default")
+        assert not (claim.get("status") or {}).get("conditions")
+        assert not [e for e in fake.objects("", "events")
+                    if e.get("reason") == "DomainExhausted"]
 
 
 class TestInterleavedAllocation:

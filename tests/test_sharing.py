@@ -1,0 +1,175 @@
+"""Direct unit coverage for the seed sharing managers
+(kubeletplugin/sharing.py) -- TimeSlicingManager's holder-counted
+policy-file write/rollback and MultiTenancyManager's tenancy-dir
+provisioning, env/mount contract, and cleanup. These managers predate
+the test suite (they were only exercised indirectly through
+DeviceState) and are the foundation the partition engine's
+oversubscription contract stands on."""
+
+import json
+import os
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.api.configs import (
+    MultiTenancyConfig,
+    TimeSlicingConfig,
+)
+from k8s_dra_driver_gpu_tpu.kubeletplugin.sharing import (
+    MultiTenancyManager,
+    TimeSlicingManager,
+)
+
+GIB = 1 << 30
+
+
+@pytest.fixture()
+def ts(tmp_path):
+    return TimeSlicingManager(str(tmp_path))
+
+
+@pytest.fixture()
+def mt(tmp_path):
+    return MultiTenancyManager(str(tmp_path),
+                               hbm_capacity_bytes=16 * GIB,
+                               spawn_agents=False)
+
+
+class TestTimeSlicingManager:
+    def test_policy_file_written_with_env_contract(self, ts):
+        edits = ts.set_time_slice("c1", [0, 2],
+                                  TimeSlicingConfig(interval="Short"))
+        assert "TPU_TIMESLICE_INTERVAL_US=1000" in edits.env
+        assert "TPU_PROCESS_SHARING=cooperative" in edits.env
+        for idx in (0, 2):
+            doc = ts.current(idx)
+            assert doc["interval"] == "Short"
+            assert doc["intervalUs"] == 1000
+            assert doc["holders"] == {"c1": "Short"}
+        assert ts.current(1) is None
+
+    def test_interval_last_setter_wins_holders_accumulate(self, ts):
+        ts.set_time_slice("c1", [0], TimeSlicingConfig(interval="Short"))
+        ts.set_time_slice("c2", [0], TimeSlicingConfig(interval="Long"))
+        doc = ts.current(0)
+        assert doc["interval"] == "Long"
+        assert doc["intervalUs"] == 20000
+        assert set(doc["holders"]) == {"c1", "c2"}
+
+    def test_release_is_holder_counted(self, ts):
+        """The policy file is the admin surface a scheduler daemon
+        consumes: it must persist until the LAST sharing claim
+        releases the chip."""
+        ts.set_time_slice("c1", [0], TimeSlicingConfig())
+        ts.set_time_slice("c2", [0], TimeSlicingConfig())
+        ts.release("c1", [0])
+        doc = ts.current(0)
+        assert doc is not None and set(doc["holders"]) == {"c2"}
+        ts.release("c2", [0])
+        assert ts.current(0) is None
+
+    def test_rollback_after_failed_prepare_leaves_no_residue(self, ts):
+        """The prepare-failure rollback path: write then release for
+        the same claim, including chips the claim never wrote (the
+        rollback releases the claim's full chip set defensively)."""
+        ts.set_time_slice("c1", [0, 1], TimeSlicingConfig())
+        ts.release("c1", [0, 1, 2, 3])
+        for idx in range(4):
+            assert ts.current(idx) is None
+
+    def test_release_unknown_claim_is_noop(self, ts):
+        ts.set_time_slice("c1", [0], TimeSlicingConfig())
+        ts.release("ghost", [0])
+        assert set(ts.current(0)["holders"]) == {"c1"}
+
+    def test_default_interval_budget(self, ts):
+        edits = ts.set_time_slice("c1", [0], TimeSlicingConfig())
+        assert "TPU_TIMESLICE_INTERVAL_US=5000" in edits.env
+        assert ts.current(0)["interval"] == "Default"
+
+
+class TestMultiTenancyManager:
+    def _start(self, mt, claim="c1", request="r0", chips=(0, 1),
+               cfg=None, devices=("chip-0", "chip-1")):
+        cfg = cfg or MultiTenancyConfig(max_clients=3, hbm_limit="4Gi")
+        cfg.normalize()
+        return mt.start(claim, request, list(chips), cfg, list(devices))
+
+    def test_tenancy_dir_and_manifest_provisioned(self, mt, tmp_path):
+        self._start(mt)
+        d = str(tmp_path / "tenancy" / "c1" / "r0")
+        assert os.path.isdir(os.path.join(d, "shared"))
+        with open(os.path.join(d, "tenancy.json"),
+                  encoding="utf-8") as f:
+            manifest = json.load(f)
+        assert manifest["chips"] == [0, 1]
+        assert manifest["maxClients"] == 3
+        # PER-CHIP capacity: every tenant runs on every chip of the
+        # group, so admission fits tenants within ONE chip's HBM.
+        assert manifest["hbmCapacityBytes"] == 16 * GIB
+        assert manifest["hbmLimits"] == {"chip-0": 4 * GIB,
+                                         "chip-1": 4 * GIB}
+        # The informational copy tenants can read rides shared/.
+        assert os.path.isfile(
+            os.path.join(d, "shared", "tenancy.json"))
+
+    def test_env_and_mount_contract(self, mt):
+        edits = self._start(mt)
+        assert "TPU_MULTI_TENANT=1" in edits.env
+        assert "TPU_TENANCY_DIR=/var/run/tpu-tenancy/c1/r0" in edits.env
+        assert "TPU_MAX_TENANTS=3" in edits.env
+        assert f"TPU_HBM_LIMIT_BYTES={4 * GIB}" in edits.env
+        # Only shared/ is mounted, WRITABLE (rendezvous files), and
+        # the control plane (manifest, agent socket) stays outside.
+        assert len(edits.mounts) == 1
+        host, container, read_only = edits.mounts[0]
+        assert host.endswith(os.path.join("c1", "r0", "shared"))
+        assert container == "/var/run/tpu-tenancy/c1/r0"
+        assert read_only is False
+
+    def test_per_device_override_beats_wildcard(self, mt):
+        cfg = MultiTenancyConfig(
+            hbm_limit="8Gi",
+            per_device_hbm_limits={"chip-0": "2Gi"})
+        cfg.normalize()
+        edits = self._start(mt, cfg=cfg)
+        # Env carries the MIN across the group (uniform contract);
+        # per-device granularity rides the manifest.
+        assert f"TPU_HBM_LIMIT_BYTES={2 * GIB}" in edits.env
+
+    def test_no_limits_no_env(self, mt):
+        cfg = MultiTenancyConfig()
+        cfg.normalize()
+        edits = self._start(mt, cfg=cfg)
+        assert not any(e.startswith("TPU_MAX_TENANTS") for e in edits.env)
+        assert not any(e.startswith("TPU_HBM_LIMIT_BYTES")
+                       for e in edits.env)
+
+    def test_stop_cleans_up_claim_dir(self, mt, tmp_path):
+        self._start(mt)
+        assert mt.active("c1")
+        mt.stop("c1")
+        assert not mt.active("c1")
+        assert not os.path.isdir(str(tmp_path / "tenancy" / "c1"))
+
+    def test_stop_is_per_claim(self, mt):
+        self._start(mt, claim="c1")
+        self._start(mt, claim="c2")
+        mt.stop("c1")
+        assert not mt.active("c1")
+        assert mt.active("c2")
+
+    def test_reconcile_drops_orphans_keeps_active(self, mt, tmp_path):
+        self._start(mt, claim="live")
+        self._start(mt, claim="orphan")
+        mt.reconcile({"live"})
+        assert mt.active("live")
+        assert not mt.active("orphan")
+
+    def test_multiple_requests_one_claim(self, mt, tmp_path):
+        self._start(mt, request="r0", chips=(0,), devices=("chip-0",))
+        self._start(mt, request="r1", chips=(1,), devices=("chip-1",))
+        base = tmp_path / "tenancy" / "c1"
+        assert sorted(os.listdir(base)) == ["r0", "r1"]
+        mt.stop("c1")
+        assert not os.path.isdir(str(base))
