@@ -5,7 +5,7 @@ PYTHON ?= python
 .PHONY: all native test test-fast bench bench-smoke \
 	bench-placement-smoke bench-chaos-smoke bench-sched-smoke \
 	bench-sched-scale bench-recovery-smoke bench-serving-smoke \
-	lint lint-analysis clean stamp-version
+	bench-trace-smoke lint lint-analysis clean stamp-version
 
 VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
 
@@ -122,6 +122,23 @@ bench-sched-smoke:
 	BENCH_SCALE_MAX_WRITES_PER_CLAIM=3.5 BENCH_SCALE_MAX_P99_MS=2000 \
 	BENCH_SCHED_OUT=$(or $(BENCH_SCHED_OUT),/tmp/BENCH_scheduler_smoke.json) \
 	$(PYTHON) bench.py --sched-scale
+
+# Tracing-overhead smoke: a shrunk `bench.py --trace-overhead` run --
+# the deterministic single-threaded allocation pass timed fully-sampled
+# vs tracing-off (interleaved reps; gate = min-of-reps ratio, extended
+# adaptively under co-tenant load)
+# gated at <= 5% overhead, plus the wiring proof on the event-driven
+# control plane (sampling on exports spans + converges; sampling off
+# exports ZERO spans). Mirrored as a non-slow test in
+# tests/test_bench_trace_smoke.py; the committed trajectory file is
+# BENCH_observability.json (full-size plain `bench.py
+# --trace-overhead`).
+bench-trace-smoke:
+	BENCH_TRACE_NODES=8 BENCH_TRACE_CLAIMS=64 BENCH_TRACE_REPS=4 \
+	BENCH_TRACE_CHURN_CLAIMS=24 \
+	BENCH_TRACE_MAX_OVERHEAD_PCT=5 \
+	BENCH_OBS_OUT=$(or $(BENCH_OBS_OUT),/tmp/BENCH_observability_smoke.json) \
+	$(PYTHON) bench.py --trace-overhead
 
 # Full 1000-node x 5000-claim scale-out proof (the BENCH_scheduler.json
 # "scale" trajectory entry): sharded multi-worker draining + batched

@@ -15,7 +15,7 @@ import logging
 import threading
 
 from ...api.computedomain import ComputeDomainStatusValue
-from ...pkg import json_copy
+from ...pkg import flightrecorder, json_copy, tracing
 from ...pkg.featuregates import (
     TOPOLOGY_AWARE_PLACEMENT,
     FeatureGateError,
@@ -161,6 +161,25 @@ class ComputeDomainController:
     # -- reconcile ------------------------------------------------------------
 
     def reconcile(self, cd: dict) -> None:
+        # One root span + flight event per domain reconcile, keyed by
+        # the domain UID (queryable at /debug/claims/<domain-uid> like
+        # claim timelines) -- the controller's hop in the cross-binary
+        # trace surface (pkg/tracing.py).
+        meta = cd["metadata"]
+        with tracing.span("cd.reconcile", attrs={
+                "domain": (f"{meta.get('namespace', 'default')}/"
+                           f"{meta.get('name', '?')}"),
+                "claim_uid": meta.get("uid", "")}) as sp:
+            flightrecorder.default().record(
+                meta.get("uid", "") or meta.get("name", "?"),
+                "cd_reconcile",
+                alias=(f"{meta.get('namespace', 'default')}/"
+                       f"{meta.get('name', '?')}"),
+                trace_id=(sp.context.trace_id if sp.recording else ""),
+                deleting=bool(meta.get("deletionTimestamp")))
+            self._reconcile_inner(cd)
+
+    def _reconcile_inner(self, cd: dict) -> None:
         meta = cd["metadata"]
         if meta.get("deletionTimestamp"):
             self._teardown(cd)

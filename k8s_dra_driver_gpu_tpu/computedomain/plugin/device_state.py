@@ -34,6 +34,7 @@ from ...kubeletplugin.checkpoint import (
     ClaimState,
 )
 from ...kubeletplugin.claim import ResourceClaim
+from ...pkg import tracing
 from ...pkg.analysis.statemachine import SINGLE_PHASE_POLICY
 from ...pkg.kubeclient import KubeError, NotFoundError
 from ...pkg.timing import SegmentTimer
@@ -142,8 +143,22 @@ class CDDeviceState:
     def prepare(self, claim: ResourceClaim) -> list[str]:
         # Per-segment timings (the reference CD plugin logs the same
         # t_prep_* breakdown); the segments double as the fault-
-        # injection seams the robustness suite uses.
-        timer = SegmentTimer("cd_prepare", claim.uid)
+        # injection seams the robustness suite uses. The claim's
+        # traceparent annotation (stamped by the scheduler's commit)
+        # parents these segments into the cross-binary trace.
+        timer = SegmentTimer("cd_prepare", claim.uid,
+                             parent=tracing.extract(claim.annotations))
+        try:
+            return self._prepare_locked(claim, timer)
+        finally:
+            # Like the chip plugin's prepare: the error / idempotent
+            # paths finish the operation span too (a raised segment
+            # would otherwise export children whose cd_prepare parent
+            # never appears in /debug/traces).
+            timer.done()
+
+    def _prepare_locked(self, claim: ResourceClaim,
+                        timer: SegmentTimer) -> list[str]:
         with self._lock:
             with timer.segment("cd_get_checkpoint"):
                 cp = self._checkpoint.get()
@@ -187,7 +202,13 @@ class CDDeviceState:
 
             with timer.segment("cd_checkpoint_write"):
                 self._checkpoint.update(complete)
-            timer.done()
+            from ...pkg import flightrecorder  # noqa: PLC0415
+
+            flightrecorder.default().record(
+                claim.uid, "cd_prepare_segments",
+                trace_id=timer.trace_id,
+                **{f"{name}_ms": round(dt * 1e3, 2)
+                   for name, dt in sorted(timer.segments.items())})
             return cdi_ids
 
     def _decode_config(self, claim: ResourceClaim):

@@ -15,6 +15,8 @@ import logging
 import time
 
 from ...kubeletplugin.claim import ResourceClaim
+from ...pkg import flightrecorder, tracing
+from ...pkg.events import emit_warning_event
 from ...pkg.kubeclient import KubeError, NotFoundError
 from ...pkg.retry import RETRIABLE_STATUSES
 from ...pkg.metrics import DRARequestMetrics
@@ -127,11 +129,20 @@ class CDDriver:
         an intact gang then goes clean end to end."""
         uid = getattr(ref, "uid", None) or ref.get("uid")
         deadline = time.monotonic() + self.retry_timeout
+        t0 = time.monotonic()
         failures = 0
         while True:
             try:
                 claim = self._fetch_claim(ref)
                 cdi_ids = self.state.prepare(claim)
+                trace_id = tracing.trace_id_of(claim.annotations)
+                self.metrics.slo.observe(
+                    "prepare", time.monotonic() - t0, trace_id)
+                flightrecorder.default().record(
+                    uid, "cd_prepare_done",
+                    alias=f"{claim.namespace}/{claim.name}",
+                    trace_id=trace_id, retries=failures,
+                    ms=round((time.monotonic() - t0) * 1e3, 2))
                 return [
                     {
                         "request_names": [r.request],
@@ -159,7 +170,7 @@ class CDDriver:
                 failures += 1
                 delay = RETRY_LIMITER.delay_for(failures)
                 if time.monotonic() + delay >= deadline:
-                    self._abort_gang_prepare(uid, e)
+                    self._abort_gang_prepare(uid, e, ref=ref)
                     raise TimeoutError(
                         f"gang prepare deadline ({self.retry_timeout}s) "
                         f"exceeded; node state unwound, retriable: {e}"
@@ -168,22 +179,53 @@ class CDDriver:
                             failures, delay, e)
                 time.sleep(delay)
 
-    def _abort_gang_prepare(self, uid: str, cause: Exception) -> None:
+    def _abort_gang_prepare(self, uid: str, cause: Exception,
+                            ref=None) -> None:
         """Deadline blown: unwind this node's own half-prepared state so
         a kubelet retry starts clean (and a dissolved gang leaves no
-        daemon pods pinned by a stale node label)."""
+        daemon pods pinned by a stale node label). The operator gets
+        the claim's whole flight-recorder timeline in the log plus a
+        create-once Warning Event on the claim -- no archaeology across
+        four binaries' log streams."""
         self.gang_aborts += 1
         if self.resilience is not None:
             self.resilience.gang_aborts.inc()
+        flight = flightrecorder.default()
+        flight.record(uid, "gang_abort", error=str(cause)[:200],
+                      deadline_s=self.retry_timeout)
         logger.warning(
             "gang prepare abort for claim %s after %.0fs: %s "
-            "(unwinding node-local state)", uid, self.retry_timeout,
-            cause,
+            "(unwinding node-local state); flight record:\n%s",
+            uid, self.retry_timeout, cause, flight.dump(uid),
         )
+        self._gang_abort_event(uid, ref, cause)
         try:
             self.state.unwind_failed_prepare(uid)
         except Exception:  # noqa: BLE001 - best-effort unwind
             logger.exception("gang-abort unwind failed for %s", uid)
+
+    def _gang_abort_event(self, uid: str, ref, cause: Exception) -> None:
+        """Deduped Warning Event on the claim (deterministic name =
+        create-once: repeat aborts for the same claim hit 409 instead
+        of spamming). Best-effort -- the unwind must proceed even when
+        the apiserver is the thing that is down."""
+        name = getattr(ref, "name", None) or (
+            ref.get("name") if isinstance(ref, dict) else "")
+        namespace = getattr(ref, "namespace", None) or (
+            ref.get("namespace") if isinstance(ref, dict) else "") or \
+            "default"
+        if not name:
+            return
+        emit_warning_event(
+            self.kube, event_name=f"{name}.gang-abort",
+            namespace=namespace, reason="GangPrepareAborted",
+            message=(
+                f"gang prepare deadline ({self.retry_timeout:.0f}s) "
+                f"exceeded on node {self.node_name}: {str(cause)[:300]}; "
+                "node-local state unwound, kubelet will retry "
+                "(timeline at /debug/claims/<uid> on the node plugin)"),
+            involved_kind="ResourceClaim", involved_name=name,
+            involved_uid=uid, component="tpu-dra-cd-plugin")
 
     def unprepare_resource_claims(self, claim_refs: list) -> dict:
         out = {}

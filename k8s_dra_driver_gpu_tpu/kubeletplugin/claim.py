@@ -43,6 +43,10 @@ class ResourceClaim:
     name: str = ""
     results: list[DeviceResult] = field(default_factory=list)
     configs: list[OpaqueConfig] = field(default_factory=list)
+    # Object annotations: the cross-binary trace context rides here
+    # (resource.tpu.dra/traceparent, stamped by the scheduler's
+    # allocation patch -- pkg/tracing.py).
+    annotations: dict = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, obj: dict, driver: str = DRIVER_NAME) -> "ResourceClaim":
@@ -77,4 +81,5 @@ class ResourceClaim:
             name=meta.get("name", ""),
             results=results,
             configs=configs,
+            annotations=dict(meta.get("annotations") or {}),
         )

@@ -40,6 +40,7 @@ from ..pkg.featuregates import (
 from ..pkg.analysis.statemachine import TWO_PHASE_POLICY
 from ..pkg.partition.engine import PartitionEngine, PartitionEngineError
 from ..pkg.partition.spec import PartitionSet
+from ..pkg import flightrecorder, tracing
 from ..pkg.flock import Flock
 from ..pkg.fsutil import write_json_atomic
 from ..pkg.timing import SegmentTimer
@@ -652,7 +653,12 @@ class DeviceState:
         and ``ckpt_fsync_wait`` also feed the metrics histogram and
         bench.py's stress extras.
         """
-        timer = SegmentTimer("prepare", claim.uid)
+        # Cross-binary trace: the scheduler's commit span context rides
+        # the claim's traceparent annotation, so every segment below
+        # becomes a child span of that commit (pkg/tracing.py). A
+        # claim with no (or an unsampled) annotation traces locally.
+        timer = SegmentTimer("prepare", claim.uid,
+                             parent=tracing.extract(claim.annotations))
         try:
             return self._prepare_inner(claim, timer)
         finally:
@@ -864,6 +870,14 @@ class DeviceState:
             for name, dt in timer.segments.items():
                 self._segment_history.setdefault(
                     name, deque(maxlen=4096)).append(dt)
+        # The per-claim flight recorder gets the same breakdown the
+        # histogram sees, keyed by claim UID and tied to the trace.
+        if timer.key:
+            flightrecorder.default().record(
+                timer.key, f"{timer.operation}_segments",
+                trace_id=timer.trace_id,
+                **{f"{name}_ms": round(dt * 1e3, 2)
+                   for name, dt in sorted(timer.segments.items())})
         observer = self.segment_observer
         if observer is not None:
             try:

@@ -15,8 +15,10 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from . import DRIVER_NAME
+from ..pkg import flightrecorder, tracing
 from ..pkg.kubeclient import NotFoundError
 from ..pkg.metrics import DRARequestMetrics
+from ..pkg.partition.profiles import TenantProfileStore
 from ..pkg.sliceutil import publish_resource_slices, slice_content_hash
 from .claim import ResourceClaim
 from .cleanup import CheckpointCleanupManager
@@ -102,6 +104,11 @@ class Driver:
         self.reconciler = NodeStateReconciler(
             self.state, kube_client, cleanup=self.cleanup,
             metrics=recovery_metrics, node_name=node_name)
+        # Live tenant-demand store (MISO sizing input, pkg/partition/
+        # profiles.py): fed by the health-poll loop's tpulib telemetry
+        # samples below, so partition re-plans size against OBSERVED
+        # per-tenant HBM/core usage instead of static files only.
+        self.tenant_profiles = TenantProfileStore()
         self.health_monitor = None
         if enable_health_monitor:
             # The startup enumeration is the health baseline: a chip seen
@@ -141,6 +148,7 @@ class Driver:
                 additional_ignored=additional_ignored_health_kinds,
                 quarantine=QuarantineTracker(
                     on_quarantine=on_quarantine, on_failed=on_failed),
+                on_tenant_usage=self._on_tenant_usage,
             )
         else:
             # Health monitoring off: mark every chip observably
@@ -212,6 +220,8 @@ class Driver:
                     return uid, (self._prepare_one(ref), "")
             except Exception as e:  # noqa: BLE001 - wire boundary
                 logger.exception("prepare failed for claim %s", uid)
+                flightrecorder.default().record(
+                    uid, "prepare_failed", error=str(e)[:200])
                 return uid, ([], str(e))
 
         if len(claim_refs) <= 1:
@@ -240,7 +250,17 @@ class Driver:
         if obj.get("metadata", {}).get("uid") != uid:
             raise NotFoundError(f"claim {namespace}/{name} UID mismatch")
         claim = ResourceClaim.from_dict(obj)
+        # The scheduler's commit-span context rides the claim's
+        # traceparent annotation: the prepare below records under the
+        # SAME trace id, and the SLO prepare phase links to it.
+        trace_id = tracing.trace_id_of(claim.annotations)
         self.state.prepare(claim)
+        self.metrics.slo.observe("prepare", time.monotonic() - t0,
+                                 trace_id)
+        flightrecorder.default().record(
+            uid, "prepare_done", alias=f"{namespace}/{name}",
+            trace_id=trace_id,
+            ms=round((time.monotonic() - t0) * 1e3, 2))
         # Group CDI ids by request for the kubelet response.
         cp = self.state.prepared_claims()[uid]
         by_request: dict[str, list] = {}
@@ -384,6 +404,15 @@ class Driver:
         return self.publish_resources()
 
     # -- health ---------------------------------------------------------------
+
+    def _on_tenant_usage(self, usage) -> None:
+        """Health-poll telemetry -> the live tenant-demand store: each
+        tpulib per-tenant HBM/core sample lands in the
+        TenantProfileStore the MISO sizing policy reads, replacing
+        static-file-only demand (ROADMAP item 1 follow-up)."""
+        for u in usage:
+            self.tenant_profiles.record(u.tenant, u.hbm_bytes,
+                                        cores=u.cores)
 
     def _on_health_taints(self, taints: list[DeviceTaint]) -> None:
         """Reconcile device taints and republish (driver.go:496-566).

@@ -293,10 +293,17 @@ class ChipHealthMonitor:
         poll_interval: float = POLL_INTERVAL_S,
         quarantine: QuarantineTracker | None = None,
         on_quarantine: Callable[[str], None] | None = None,
+        on_tenant_usage: Callable[[tuple], None] | None = None,
     ):
         self._tpulib = tpulib
         self._opts = opts
         self._on_taints = on_taints
+        # Live per-tenant HBM/core telemetry (tpulib.tenant_usage):
+        # sampled on the SAME poll cadence as health and handed to the
+        # consumer (the driver feeds its TenantProfileStore, the MISO
+        # sizing input). None = telemetry off; a tpulib without the
+        # seam degrades to no samples.
+        self._on_tenant_usage = on_tenant_usage
         self._ignored = frozenset(ignored_kinds) | frozenset(additional_ignored)
         self._interval = poll_interval
         self._stop = threading.Event()
@@ -333,6 +340,21 @@ class ChipHealthMonitor:
         taints = self.poll_once()
         return taints + self.quarantine.observe(taints)
 
+    def sample_telemetry(self) -> tuple:
+        """One per-tenant usage sample through the tpulib seam,
+        delivered to ``on_tenant_usage``. Returns the samples (also
+        the direct-drive entry for tests). A tpulib predating the
+        seam, or no consumer, is a no-op."""
+        if self._on_tenant_usage is None:
+            return ()
+        fn = getattr(self._tpulib, "tenant_usage", None)
+        if fn is None:
+            return ()
+        usage = tuple(fn(self._opts) or ())
+        if usage:
+            self._on_tenant_usage(usage)
+        return usage
+
     def _backoff(self) -> float:
         """Current sleep: the base interval, doubled per consecutive
         failure (capped) so a dying tpulib isn't hammered at full poll
@@ -360,6 +382,12 @@ class ChipHealthMonitor:
                     self._backoff())
                 continue
             self.consecutive_failures = 0
+            try:
+                # Telemetry rides the health cadence but must never
+                # poison it: a broken usage seam only loses samples.
+                self.sample_telemetry()
+            except Exception:  # noqa: BLE001 - telemetry best-effort
+                logger.exception("tenant-usage sample failed")
             if taints != self._last:
                 self._last = taints
                 try:

@@ -88,6 +88,13 @@ RULES: dict[str, str] = {
                  "pkg/partition/engine.py (holder-counted, durable "
                  "partition records) or kubeletplugin/device_state.py "
                  "(claim-checkpointed), never ad hoc",
+    "TPUDRA012": "span / flight-recorder entry created outside the "
+                 "public with-guarded API: bare Span(...) or "
+                 "FlightEvent(...) construction, or start_span() "
+                 "outside a with statement, leaks an unfinished span "
+                 "(never exported, wrong parent for everything after "
+                 "it on the thread) -- use tracing.span(...) / "
+                 "FlightRecorder.record(...)",
 }
 
 # Lock model (docs/architecture.md "Locking hierarchy"). Matched on the
@@ -131,6 +138,14 @@ _SCHED_LOCK_FILES = {"scheduler.py", "schedcache.py"}
 # stray same-named engine.py elsewhere is not sanctioned).
 _CARVEOUT_FILES = {"device_state.py"}
 _CARVEOUT_REL_SUFFIXES = ("pkg/partition/engine.py",)
+# TPUDRA012 scope: the tracing layer itself constructs Spans and may
+# hold start_span() results across non-lexical lifetimes (SegmentTimer
+# owns its operation span from __init__ to done()); the flight
+# recorder constructs its own events. Everyone else goes through
+# tracing.span(...) / FlightRecorder.record(...).
+_SPAN_CTOR_FILES = {"tracing.py", "lint.py"}
+_START_SPAN_FILES = {"tracing.py", "timing.py", "lint.py"}
+_FLIGHT_EVENT_FILES = {"flightrecorder.py", "lint.py"}
 # Resources the scheduler watches (mirror of
 # pkg/schedcache.WATCHED_RESOURCES, kept literal so the linter has no
 # runtime import of the code under analysis).
@@ -598,6 +613,16 @@ class _ModuleLinter(ast.NodeVisitor):
     def visit_With(self, node: ast.With) -> None:
         entered: list[_Held] = []
         for item in node.items:
+            # TPUDRA012: a span opened as a with-item is the sanctioned
+            # form; mark it so visit_Call's bare-start_span check
+            # skips it.
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                fname = (expr.func.id if isinstance(expr.func, ast.Name)
+                         else expr.func.attr
+                         if isinstance(expr.func, ast.Attribute) else "")
+                if fname in ("span", "start_span"):
+                    expr._tpudra_with = True  # type: ignore[attr-defined]
             acq = self._classify_acquisition(item.context_expr)
             if acq is not None:
                 family, level, key = acq
@@ -627,6 +652,60 @@ class _ModuleLinter(ast.NodeVisitor):
             for sub in ast.walk(node):
                 if sub is not node and self._is_kubeclient_ctor(sub):
                     sub._tpudra_wrapped = True  # type: ignore[attr-defined]
+
+        # TPUDRA012: bare Span / FlightEvent construction outside the
+        # tracing layer, and start_span() outside a with statement.
+        # The public APIs (tracing.span context manager,
+        # FlightRecorder.record) are the only sanctioned producers --
+        # an unfinished span is never exported and mis-parents every
+        # later span on its thread; a hand-built FlightEvent bypasses
+        # the ring's locking and capacity.
+        if wrapper_name == "Span" and \
+                self.basename not in _SPAN_CTOR_FILES:
+            self._emit(
+                "TPUDRA012", node,
+                "bare Span(...) construction outside pkg/tracing.py; "
+                "use the with-guarded tracing.span(...) API",
+                key="Span",
+            )
+        if wrapper_name == "FlightEvent" and \
+                self.basename not in _FLIGHT_EVENT_FILES:
+            self._emit(
+                "TPUDRA012", node,
+                "bare FlightEvent(...) construction outside "
+                "pkg/flightrecorder.py; use FlightRecorder.record(...)",
+                key="FlightEvent",
+            )
+        if wrapper_name == "start_span" and \
+                not getattr(node, "_tpudra_with", False) and \
+                self.basename not in _START_SPAN_FILES:
+            self._emit(
+                "TPUDRA012", node,
+                "start_span(...) outside a with statement: the span is "
+                "never finished/exported on the error path; use "
+                "`with tracing.span(...)` (SegmentTimer is the "
+                "sanctioned non-lexical holder)",
+                key="start_span",
+            )
+        # The public span() helper held outside `with` is the identical
+        # leak under the other spelling (span() just returns
+        # start_span()'s result). Matched as bare `span(` or
+        # `tracing.span(` so a same-named helper on some OTHER object
+        # never trips it.
+        if wrapper_name == "span" and \
+                (isinstance(func, ast.Name)
+                 or (isinstance(func, ast.Attribute)
+                     and isinstance(func.value, ast.Name)
+                     and func.value.id == "tracing")) and \
+                not getattr(node, "_tpudra_with", False) and \
+                self.basename not in _START_SPAN_FILES:
+            self._emit(
+                "TPUDRA012", node,
+                "tracing.span(...) outside a with statement: the span "
+                "is never finished/exported on the error path; use it "
+                "as the context expression of `with`",
+                key="span",
+            )
 
         # TPUDRA008: raw KubeClient construction outside the wrapper.
         if self._is_kubeclient_ctor(node) and \

@@ -1,0 +1,47 @@
+"""Shared best-effort Warning-Event emission.
+
+Three producers (the scheduler's unschedulable-pod and
+domain-exhausted surfacing, the CD plugin's gang-abort) emit the same
+core/v1 Event shape; this is the one builder so the dedupe convention
+lives in one place. Two dedupe styles, chosen by the caller's
+``event_name``:
+
+- a DETERMINISTIC name (``<obj>.domain-exhausted``) makes the create
+  itself the dedupe -- repeats hit 409 and are swallowed (create-once);
+- a UNIQUE name (uuid suffix) emits every time; the caller dedupes at
+  a different layer (e.g. on the object's condition).
+
+Emission is always best-effort: events are cosmetic surfacing, and
+the state write they accompany (a condition patch, an unwind) must
+proceed even when the apiserver is the thing that is down.
+"""
+
+from __future__ import annotations
+
+from .kubeclient import KubeError
+
+
+def emit_warning_event(kube, *, event_name: str, namespace: str,
+                       reason: str, message: str, involved_kind: str,
+                       involved_name: str, involved_uid: str = "",
+                       component: str) -> None:
+    event = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "name": event_name,
+            "namespace": namespace,
+        },
+        "type": "Warning",
+        "reason": reason,
+        "message": message,
+        "involvedObject": {
+            "kind": involved_kind, "name": involved_name,
+            "namespace": namespace, "uid": involved_uid,
+        },
+        "source": {"component": component},
+    }
+    try:
+        kube.create("", "v1", "events", event, namespace=namespace)
+    except KubeError:
+        pass  # best-effort (409 = already surfaced, or API down)

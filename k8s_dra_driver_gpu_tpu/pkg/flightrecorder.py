@@ -1,0 +1,169 @@
+"""Per-claim flight recorder: a bounded ring of lifecycle events.
+
+Traces (pkg/tracing.py) answer "how long did each hop take"; the
+flight recorder answers "what happened to THIS claim, in order" --
+dirty-key enqueues, fit outcomes, try_commit conflicts, allocation
+patches, prepare segment breakdowns, partition attaches, eviction
+stages. It is always on (no sampling: the ring is fixed-size and an
+event is one small dict append under a lock), so when a gang-prepare
+aborts or an eviction blows its deadline the operator gets the whole
+timeline dumped into the log instead of doing archaeology across four
+binaries' log streams.
+
+Keys: producers record under the claim UID when they have it (node
+plugins, partition engine, recovery) and under ``namespace/name``
+before the UID is known (the scheduler's dirty-key enqueue); an
+``alias`` ties the two, and queries match either -- so
+``/debug/claims/<uid>`` and ``/debug/claims/<ns>/<name>`` both return
+the full story. Domain-shaped producers (the CD controller) use the
+domain UID the same way.
+
+Construct events only through :meth:`FlightRecorder.record` (lint rule
+TPUDRA012 fences bare ``FlightEvent(`` construction like bare spans).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+DEFAULT_CAPACITY = 4096
+
+
+@dataclass(frozen=True)
+class FlightEvent:
+    """One structured lifecycle event (create via
+    FlightRecorder.record; TPUDRA012 fences bare construction)."""
+
+    ts: float
+    key: str
+    event: str
+    alias: str = ""
+    trace_id: str = ""
+    fields: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        out = {"ts": self.ts, "key": self.key, "event": self.event}
+        if self.alias:
+            out["alias"] = self.alias
+        if self.trace_id:
+            out["trace_id"] = self.trace_id
+        if self.fields:
+            out.update(self.fields)
+        return out
+
+
+class FlightRecorder:
+    """Fixed-size ring of FlightEvents with a per-key view."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring: deque[FlightEvent] = deque(
+            maxlen=max(16, int(capacity)))
+        self.recorded_total = 0
+
+    def record(self, key: str, event: str, *, alias: str = "",
+               trace_id: str = "", **fields) -> None:
+        """Append one event. ``key`` is the claim UID (or ns/name when
+        the UID is not known yet); ``alias`` the other identity when
+        both are known; extra keyword fields become event payload."""
+        if not key:
+            return
+        ev = FlightEvent(ts=time.time(), key=str(key), event=str(event),
+                         alias=str(alias or ""),
+                         trace_id=str(trace_id or ""), fields=fields)
+        with self._lock:
+            self._ring.append(ev)
+            self.recorded_total += 1
+
+    def events(self, key: str = "") -> list[dict]:
+        """Events for one key, oldest first; everything when ``key`` is
+        empty. Matching is identity-closed over aliases: a UID query
+        also returns events recorded under the claim's ``ns/name``
+        BEFORE the UID was known (the scheduler's enqueue), because a
+        later event carrying both identities ties them together."""
+        with self._lock:
+            ring = list(self._ring)
+        if not key:
+            return [ev.to_dict() for ev in ring]
+        ids = {key}
+        # Two passes reach a fixpoint for the two-identity (uid <->
+        # ns/name) chains producers record; aliased events seen in
+        # pass one pull their other identity's alias-less events in
+        # pass two.
+        for _ in range(2):
+            for ev in ring:
+                if ev.key in ids or (ev.alias and ev.alias in ids):
+                    ids.add(ev.key)
+                    if ev.alias:
+                        ids.add(ev.alias)
+        return [ev.to_dict() for ev in ring
+                if ev.key in ids or (ev.alias and ev.alias in ids)]
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return sorted({ev.key for ev in self._ring})
+
+    def dump(self, key: str) -> str:
+        """Human-readable timeline for one claim -- what gang-abort /
+        eviction-failure handlers put in the log."""
+        events = self.events(key)
+        if not events:
+            return f"(no flight-recorder events for {key!r})"
+        lines = []
+        for ev in events:
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("ts", "key", "event")}
+            detail = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+            lines.append(f"  {ev['ts']:.3f} {ev['event']:<20} {detail}")
+        return "\n".join(lines)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # -- /debug/claims endpoints (pkg/httpserver handler signatures) ----------
+
+    def claims_endpoint(self, rest: str) -> tuple[int, str, bytes]:
+        """GET /debug/claims/<uid-or-ns/name>."""
+        key = rest.strip("/")
+        if not key:
+            body = json.dumps({"claims": self.keys()}).encode()
+            return 200, "application/json", body
+        events = self.events(key)
+        if not events:
+            return (404, "application/json",
+                    b'{"error": "no events for key"}')
+        body = json.dumps({"key": key, "events": events},
+                          sort_keys=True).encode()
+        return 200, "application/json", body
+
+    def index_endpoint(self) -> tuple[int, str, bytes]:
+        """GET /debug/claims -- the keys currently in the ring."""
+        body = json.dumps({"claims": self.keys()}).encode()
+        return 200, "application/json", body
+
+
+_default: FlightRecorder | None = None
+_default_lock = threading.Lock()
+
+
+def default() -> FlightRecorder:
+    """The process-wide recorder (served at /debug/claims)."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = FlightRecorder()
+    return _default
+
+
+def set_default(recorder: FlightRecorder) -> FlightRecorder:
+    """Swap the process recorder (tests)."""
+    global _default
+    with _default_lock:
+        _default = recorder
+    return recorder

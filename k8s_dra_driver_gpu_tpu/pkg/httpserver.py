@@ -13,13 +13,21 @@ EndpointFn = Callable[[], tuple[int, str, bytes]]
 class SimpleHTTPEndpoint:
     """Serves GET <path> from ``fn``; ``extra`` adds more path->fn
     routes on the same listener (e.g. /metrics + /debug/stacks).
-    Anything else 404s."""
+    A route key ending in ``/*`` is a PREFIX route: its handler takes
+    the rest of the path as one argument (e.g. ``/debug/claims/*`` ->
+    ``fn("<uid>")``). Anything else 404s."""
 
     def __init__(self, path: str, fn: EndpointFn, host: str = "127.0.0.1",
                  port: int = 0, thread_name: str = "http-endpoint",
                  extra: dict[str, EndpointFn] | None = None):
         routes = {path.rstrip("/"): fn}
-        routes.update({p.rstrip("/"): f for p, f in (extra or {}).items()})
+        prefix_routes: dict[str, Callable[[str],
+                                          tuple[int, str, bytes]]] = {}
+        for p, f in (extra or {}).items():
+            if p.endswith("/*"):
+                prefix_routes[p[:-2].rstrip("/")] = f
+            else:
+                routes[p.rstrip("/")] = f
         default = path.rstrip("/")
 
         class Handler(BaseHTTPRequestHandler):
@@ -29,6 +37,12 @@ class SimpleHTTPEndpoint:
                 # a bare "/" falls back to the primary endpoint.
                 handler = routes.get(got, routes.get(default)
                                      if got == "" else None)
+                if handler is None:
+                    for prefix, pfn in prefix_routes.items():
+                        if got.startswith(prefix + "/"):
+                            handler = (lambda pfn=pfn, rest=got[
+                                len(prefix) + 1:]: pfn(rest))
+                            break
                 if handler is None:
                     self.send_response(404)
                     self.end_headers()

@@ -9,13 +9,51 @@ bats suite asserts, tests/bats/test_cd_logging.bats):
 - 4 (default): claim/domain lifecycle (INFO);
 - 6: per-claim ``t_prep_*`` segment timings and other DEBUG detail;
 - 7: wire dumps.
+
+Trace correlation (pkg/tracing.py): every record carries the active
+span's ``trace_id`` and ``claim_uid`` (empty when no span is active),
+injected by :class:`TraceContextFilter` -- so grepping a trace id from
+``/debug/traces`` finds the matching log lines in every binary without
+changing a single call site.
 """
 
 from __future__ import annotations
 
 import logging
 
-FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+from . import tracing
+
+FORMAT = ("%(asctime)s %(name)s %(levelname)s "
+          "[trace=%(trace_id)s] %(message)s")
+
+
+class TraceContextFilter(logging.Filter):
+    """Stamps ``trace_id`` / ``claim_uid`` from the calling thread's
+    active span onto every record (empty strings when none), so FORMAT
+    can reference them and log lines correlate with traces for free.
+    Attached to handlers by :func:`setup`; always passes the record."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        sp = tracing.current_span()
+        if sp is not None and sp.recording:
+            record.trace_id = sp.context.trace_id
+            record.claim_uid = str(sp.attrs.get("claim_uid", ""))
+        else:
+            record.trace_id = ""
+            record.claim_uid = ""
+        return True
+
+
+def install_trace_filter() -> TraceContextFilter:
+    """Attach the trace filter to every root-logger handler (idempotent
+    per handler); returns the filter for callers wiring custom
+    handlers."""
+    filt = TraceContextFilter()
+    for handler in logging.getLogger().handlers:
+        if not any(isinstance(f, TraceContextFilter)
+                   for f in handler.filters):
+            handler.addFilter(filt)
+    return filt
 
 
 def level_for(verbosity: int) -> int:
@@ -27,6 +65,7 @@ def level_for(verbosity: int) -> int:
 
 def setup(verbosity: int) -> None:
     logging.basicConfig(level=level_for(verbosity), format=FORMAT)
+    install_trace_filter()
 
 
 def startup_logger(name: str) -> logging.Logger:

@@ -19,10 +19,55 @@ from prometheus_client import (
     generate_latest,
 )
 
+from . import flightrecorder, tracing
 from .debug import debug_stacks_endpoint
 from .httpserver import SimpleHTTPEndpoint
 
 _BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)
+
+
+class ClaimSLOMetrics:
+    """Claim-lifecycle SLO accounting (pkg/tracing.py's metric half).
+
+    One histogram, ``tpu_dra_claim_e2e_seconds``, labeled by lifecycle
+    phase so the end-to-end latency a user feels decomposes into WHO
+    owes it: ``queued`` (dirty-key enqueue -> sync start, the
+    scheduler's backlog), ``fit`` (candidate walk + constraint DFS),
+    ``commit`` (atomic reserve), ``patch`` (the allocation kube write),
+    ``prepare`` (node-side NodePrepareResources, reported by both
+    kubelet plugins), and ``evict`` (recovery-controller eviction to
+    re-placement). Observations carry the claim's trace id as an
+    OpenMetrics exemplar when one is active, so a histogram outlier
+    links straight to its span tree in ``/debug/traces``."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.e2e = Histogram(
+            "tpu_dra_claim_e2e_seconds",
+            "Per-phase claim-lifecycle latency (queued/fit/commit/"
+            "patch/prepare on the hot path; evict for recovery), with "
+            "trace-id exemplars linking outliers to /debug/traces.",
+            ["phase"],
+            buckets=_BUCKETS,
+            registry=self.registry,
+        )
+        # labels() is ~4us of dict/validation per call; the phase set
+        # is tiny and this sits on the per-allocation hot path.
+        self._children: dict = {}
+
+    def observe(self, phase: str, seconds: float,
+                trace_id: str = "") -> None:
+        h = self._children.get(phase)
+        if h is None:
+            h = self._children[phase] = self.e2e.labels(phase)
+        amount = max(float(seconds), 0.0)
+        if trace_id:
+            try:
+                h.observe(amount, {"trace_id": trace_id[:32]})
+                return
+            except (TypeError, ValueError):
+                pass  # old prometheus_client / oversized exemplar
+        h.observe(amount)
 
 
 class DRARequestMetrics:
@@ -87,6 +132,10 @@ class DRARequestMetrics:
             buckets=_BUCKETS,
             registry=self.registry,
         )
+        # The node plugin's slice of the claim-lifecycle SLO histogram
+        # (phase="prepare"); the scheduler exports the control-plane
+        # phases from its own registry (SchedulerMetrics.slo).
+        self.slo = ClaimSLOMetrics(registry=self.registry)
 
     def observe_segments(self, operation: str, segments: dict) -> None:
         """DeviceState.segment_observer hook: one histogram sample per
@@ -415,6 +464,10 @@ class SchedulerMetrics:
         # Per-shard queue depth / wait / retry observability for the
         # scheduler's sharded sync queue (pkg/workqueue).
         self.workqueue = WorkQueueMetrics(registry=self.registry)
+        # Claim-lifecycle SLO phases owned by the control plane
+        # (queued/fit/commit/patch; the recovery controller's evict
+        # phase shares this instance via attach_recovery).
+        self.slo = ClaimSLOMetrics(registry=self.registry)
 
 
 class PartitionMetrics:
@@ -479,14 +532,18 @@ class ComputeDomainMetrics:
 
 class MetricsServer(SimpleHTTPEndpoint):
     """Prometheus exposition server (reference prometheus_httpserver.go)
-    + the pprof-analog /debug/stacks route (the reference mounts pprof
-    on the same diagnostics mux, controller main.go:383-390).
+    + the pprof-analog diagnostics routes the reference mounts on the
+    same mux (controller main.go:383-390): /debug/stacks (all-thread
+    tracebacks), /debug/traces[/<trace-id>] (the in-process span ring,
+    pkg/tracing.py), and /debug/claims[/<uid-or-ns/name>] (the
+    per-claim flight recorder, pkg/flightrecorder.py) -- one listener
+    per binary carries metrics AND the introspection surface.
 
-    Stack traces disclose internal state, so like the reference's
-    opt-in --pprof-path the debug route is only served when the
-    listener is loopback-bound or explicitly enabled
+    Stack traces / span payloads disclose internal state, so like the
+    reference's opt-in --pprof-path the debug routes are only served
+    when the listener is loopback-bound or explicitly enabled
     (TPU_DRA_DEBUG_HTTP=1); production metrics bind 0.0.0.0 and keep
-    it off. SIGUSR1 remains the always-available dump path."""
+    them off. SIGUSR1 remains the always-available dump path."""
 
     def __init__(self, registry: CollectorRegistry, host: str = "127.0.0.1",
                  port: int = 0, debug_endpoints: bool | None = None):
@@ -497,8 +554,22 @@ class MetricsServer(SimpleHTTPEndpoint):
                 host in ("127.0.0.1", "localhost", "::1")
                 or os.environ.get("TPU_DRA_DEBUG_HTTP") == "1"
             )
-        extra = {"/debug/stacks": debug_stacks_endpoint} \
-            if debug_endpoints else None
+        extra = None
+        if debug_endpoints:
+            # Late-bound: the process exporter/recorder may be swapped
+            # after the server starts (tests, bench isolation).
+            extra = {
+                "/debug/stacks": debug_stacks_endpoint,
+                "/debug/traces":
+                    lambda: tracing.exporter().traces_endpoint(),
+                "/debug/traces/*":
+                    lambda rest: tracing.exporter().trace_endpoint(rest),
+                "/debug/claims":
+                    lambda: flightrecorder.default().index_endpoint(),
+                "/debug/claims/*":
+                    lambda rest: flightrecorder.default()
+                    .claims_endpoint(rest),
+            }
         super().__init__(
             "/metrics",
             lambda: (200, "text/plain; version=0.0.4",

@@ -63,6 +63,7 @@ from ..analysis.statemachine import (
     PARTITION_POLICY,
     PARTITION_READY,
 )
+from .. import flightrecorder, tracing
 from ..faults import fault_point
 from .spec import PartitionProfile, PartitionSet, PartitionSpecError
 
@@ -307,6 +308,19 @@ class PartitionEngine:
         Idempotent and crash-resumable: the carve-out uuid is pinned in
         the PartitionCreating record BEFORE the carve-out is realized,
         so a crash in between resumes onto the same identity."""
+        # Child of the prepare pipeline's prep_attach_partition segment
+        # span (same thread), which itself chains to the scheduler's
+        # commit span via the claim's traceparent annotation.
+        with tracing.span("partition.attach", attrs={
+                "device": device_name, "claim_uid": claim_uid}) as sp:
+            live = self._attach_inner(claim_uid, device_name)
+            flightrecorder.default().record(
+                claim_uid, "partition_attach",
+                trace_id=(sp.context.trace_id if sp.recording else ""),
+                device=device_name, uuid=live.get("uuid", ""))
+            return live
+
+    def _attach_inner(self, claim_uid: str, device_name: str) -> dict:
         with self._dev_lock(device_name):
             # Spec read under the device lock (dev-lock -> mutex, the
             # resume() order): apply() holds this lock across a
@@ -374,13 +388,20 @@ class PartitionEngine:
         """Drop one tenant's hold; the backing carve-out is destroyed
         when the LAST holder detaches (idle partitions return their
         chips to whole-chip allocatability)."""
-        with self._dev_lock(device_name):
-            rec = self._record(device_name)
-            if rec is None:
-                return
-            if self._holders(device_name, exclude={claim_uid}) > 0:
-                return  # co-tenants still share the carve-out
-            self._teardown_locked(device_name, rec)
+        with tracing.span("partition.detach", attrs={
+                "device": device_name, "claim_uid": claim_uid}) as sp:
+            with self._dev_lock(device_name):
+                rec = self._record(device_name)
+                if rec is None:
+                    return
+                last = self._holders(device_name,
+                                     exclude={claim_uid}) == 0
+                if last:
+                    self._teardown_locked(device_name, rec)
+            flightrecorder.default().record(
+                claim_uid, "partition_detach",
+                trace_id=(sp.context.trace_id if sp.recording else ""),
+                device=device_name, destroyed=last)
 
     def _teardown_locked(self, name: str,
                          rec: CheckpointedClaim) -> None:

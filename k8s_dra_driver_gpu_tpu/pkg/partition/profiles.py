@@ -79,6 +79,15 @@ class TenantProfileStore:
                 samples.pop(0)
             self._cores[tenant] = max(self._cores.get(tenant, 1), cores)
 
+    def record(self, tenant: str, hbm_bytes: int, cores: int = 1) -> None:
+        """Live-telemetry ingest (the kubelet plugin's health-poll
+        loop feeds tpulib per-tenant usage samples here -- see
+        kubeletplugin/health.ChipHealthMonitor.sample_telemetry). Same
+        sliding-window semantics as :meth:`observe`; the separate name
+        marks the producer: ``record`` is measured usage, ``observe``
+        is declared/derived demand."""
+        self.observe(tenant, hbm_bytes, cores=cores)
+
     def demand(self, tenant: str, percentile: float = 0.95
                ) -> PartitionDemand | None:
         """The demand percentile for one tenant key, or None when the

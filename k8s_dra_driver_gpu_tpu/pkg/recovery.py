@@ -51,7 +51,7 @@ import threading
 import time
 
 from . import json_copy, positive_float_env
-from . import faults
+from . import faults, flightrecorder, tracing
 from .analysis.statemachine import (
     EVICTION_DEALLOCATED,
     EVICTION_DRAINING,
@@ -282,6 +282,15 @@ class EvictionController:
         # scheduler's claim-event gating).
         self._active_count = len(self._checkpoint.get().claims)
         self.last_sync: dict = {}
+        # Claim-lifecycle SLO sink (pkg/metrics.ClaimSLOMetrics): set
+        # by DraScheduler.attach_recovery so eviction e2e latency
+        # (plan -> re-placement) reports as the "evict" phase on the
+        # scheduler's registry. None = standalone controller, no SLO.
+        self.slo = None
+        # Per-claim flight recorder: every eviction stage transition
+        # lands in the ring, and a deadline failure dumps the claim's
+        # whole timeline into the log.
+        self.flight = flightrecorder.default()
 
     # -- scheduler surface ----------------------------------------------------
 
@@ -553,6 +562,14 @@ class EvictionController:
                 canonical_name=self._META_DEVICE, kind=self._META_DEVICE,
                 live=live)],
         ))
+        # One flight-recorder event per durable stage transition: the
+        # eviction ladder shows up in /debug/claims/<uid> next to the
+        # claim's scheduling and prepare history.
+        self.flight.record(
+            uid, "eviction",
+            alias=(f"{_meta(claim).get('namespace', 'default')}/"
+                   f"{_meta(claim).get('name', '')}"),
+            state=state, source=live.get("source", ""))
 
     # -- staged advance -------------------------------------------------------
 
@@ -720,10 +737,21 @@ class EvictionController:
                 claim, "False", "Recovered",
                 "claim migrated to surviving capacity after a "
                 "permanent failure")
+            planned_at = float(self._record_meta(rec).get(
+                "plannedAt", 0.0))
             self._checkpoint.update_claim(uid, None)
             counts["replaced"] += 1
             if self.metrics is not None:
                 self.metrics.replaced.inc()
+            if self.slo is not None and planned_at:
+                # Eviction e2e: plan -> re-placement, the recovery
+                # controller's slice of the claim-SLO histogram.
+                self.slo.observe(
+                    "evict", max(time.time() - planned_at, 0.0),
+                    tracing.trace_id_of(
+                        _meta(claim).get("annotations") or {}))
+            self.flight.record(uid, "eviction", state="Recovered",
+                               nodes=sorted(allocation_nodes(claim)))
             logger.warning("claim %s recovered: re-placed on %s", uid,
                            sorted(allocation_nodes(claim)))
             return
@@ -738,5 +766,13 @@ class EvictionController:
             counts["failed"] += 1
             if self.metrics is not None:
                 self.metrics.failed.inc()
-            logger.error("claim %s failed recovery: deadline "
-                         "exceeded with no re-placement", uid)
+            self.flight.record(uid, "eviction",
+                               state="DeadlineExceeded")
+            # Eviction failure: dump the claim's whole flight-recorder
+            # timeline so the operator sees the ladder (plan -> drain
+            # -> deallocate -> the wait that never converged) in one
+            # log block instead of reconstructing it by hand.
+            logger.error(
+                "claim %s failed recovery: deadline exceeded with no "
+                "re-placement; flight record:\n%s", uid,
+                self.flight.dump(uid))

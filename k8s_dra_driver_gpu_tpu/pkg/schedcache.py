@@ -36,6 +36,7 @@ import time
 from contextlib import contextmanager
 from typing import Callable
 
+from . import tracing
 from .cel import CelProgram, Quantity, compile_expression
 from .informer import Informer
 from .kubeclient import KubeError, NotFoundError
@@ -791,9 +792,11 @@ class ClusterView:
                 if self._slice_gen != gen0:
                     continue  # raced a slice event: our listing may be stale
                 t0 = time.monotonic()
-                self._snapshot = InventorySnapshot(
-                    slices, signature=sig,
-                    default_node=self._default_node)
+                with tracing.span("sched.snapshot_build",
+                                  attrs={"slices": len(slices)}):
+                    self._snapshot = InventorySnapshot(
+                        slices, signature=sig,
+                        default_node=self._default_node)
                 self._snapshot_gen = gen0
                 snap = self._snapshot
             if self._on_snapshot_build is not None:

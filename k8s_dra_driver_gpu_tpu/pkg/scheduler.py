@@ -51,6 +51,8 @@ import threading
 import time
 import uuid
 
+from . import flightrecorder, tracing
+from .events import emit_warning_event
 from .featuregates import (
     TOPOLOGY_AWARE_PLACEMENT,
     FeatureGateError,
@@ -222,6 +224,19 @@ class DraScheduler:
         # slice / claim events + the safety resync) and veto allocation
         # onto permanently failed nodes.
         self.recovery = None
+        # Claim-lifecycle flight recorder (pkg/flightrecorder): every
+        # dirty-key enqueue / fit outcome / commit conflict / patch
+        # lands in the bounded ring served at /debug/claims.
+        self.flight = flightrecorder.default()
+        # Per-worker fit-phase start time (SLO phase accounting).
+        self._fit_tls = threading.local()
+
+    @property
+    def _slo(self):
+        """The claim-lifecycle SLO histogram (ClaimSLOMetrics), or
+        None when this scheduler runs metrics-less."""
+        return (self.sched_metrics.slo
+                if self.sched_metrics is not None else None)
 
     def attach_recovery(self, controller) -> "DraScheduler":
         """Drive a pkg/recovery.EvictionController from this
@@ -232,6 +247,10 @@ class DraScheduler:
         (``_candidate_nodes``) excludes the nodes it has declared
         permanently failed."""
         controller.view = self.view
+        # Eviction e2e latency reports into the shared claim-SLO
+        # histogram (phase="evict") on this scheduler's registry.
+        if self.sched_metrics is not None:
+            controller.slo = self.sched_metrics.slo
         self.recovery = controller
         return self
 
@@ -557,26 +576,12 @@ class DraScheduler:
                             namespace=ns)
         except (NotFoundError, ConflictError):
             return
-        event = {
-            "apiVersion": "v1",
-            "kind": "Event",
-            "metadata": {
-                "name": f"{name}.{uuid.uuid4().hex[:10]}",
-                "namespace": ns,
-            },
-            "type": "Warning",
-            "reason": reason,
-            "message": message,
-            "involvedObject": {
-                "kind": "Pod", "name": name, "namespace": ns,
-                "uid": _meta(pod).get("uid", ""),
-            },
-            "source": {"component": "tpu-dra-scheduler"},
-        }
-        try:
-            self.kube.create("", "v1", "events", event, namespace=ns)
-        except KubeError:
-            pass  # events are best-effort, the condition already landed
+        emit_warning_event(
+            self.kube, event_name=f"{name}.{uuid.uuid4().hex[:10]}",
+            namespace=ns, reason=reason, message=message,
+            involved_kind="Pod", involved_name=name,
+            involved_uid=_meta(pod).get("uid", ""),
+            component="tpu-dra-scheduler")
 
     # -- allocation (kube-scheduler DRA plugin) -------------------------------
 
@@ -720,24 +725,45 @@ class DraScheduler:
         # members WITHIN the window, and non-window nodes remain as
         # overflow so a full window degrades instead of wedging.
         window = set(self._preferred_gang_nodes(claim) or ())
-        outcome = "unfit"
-        for _attempt in range(self.COMMIT_RETRIES):
-            nodes = self._candidate_nodes(claim, snap, alloc.load_view(),
-                                          window, pinned_node)
-            # One ledger copy per attempt, shared across every probed
-            # node: the fit is optimistic anyway (try_commit re-judges
-            # budgets at reserve time), so a pending claim walking all
-            # 1000 nodes doesn't pay 1000 locked copies.
-            ledger = alloc.ledger_snapshot()
-            outcome = self._try_nodes(claim, nodes, window, snap, alloc,
-                                      ledger, classes)
-            if outcome == "committed":
-                self._clear_domain_exhausted(claim)
-                return outcome
-            if outcome != "conflict":
-                break
-            if self.sched_metrics is not None:
-                self.sched_metrics.commit_conflicts.inc()
+        ns = _meta(claim).get("namespace", "default")
+        uid = _meta(claim).get("uid", "")
+        with tracing.span("sched.claim", attrs={
+                "claim": f"{ns}/{_meta(claim).get('name', '?')}",
+                "claim_uid": uid}) as claim_span:
+            # Fit-phase clock for the SLO breakdown: everything from
+            # here until the winning try_commit is "fit" (candidate
+            # walk, constraint DFS, conflict re-fits). Thread-local:
+            # N workers allocate concurrently.
+            self._fit_tls.t0 = time.monotonic()
+            outcome = "unfit"
+            for _attempt in range(self.COMMIT_RETRIES):
+                nodes = self._candidate_nodes(claim, snap,
+                                              alloc.load_view(),
+                                              window, pinned_node)
+                # One ledger copy per attempt, shared across every
+                # probed node: the fit is optimistic anyway (try_commit
+                # re-judges budgets at reserve time), so a pending
+                # claim walking all 1000 nodes doesn't pay 1000 locked
+                # copies.
+                ledger = alloc.ledger_snapshot()
+                outcome = self._try_nodes(claim, nodes, window, snap,
+                                          alloc, ledger, classes)
+                if outcome == "committed":
+                    self._clear_domain_exhausted(claim)
+                    break
+                if outcome != "conflict":
+                    break
+                if self.sched_metrics is not None:
+                    self.sched_metrics.commit_conflicts.inc()
+            claim_span.set_attr("outcome", outcome)
+        self.flight.record(
+            uid or f"{ns}/{_meta(claim).get('name', '?')}", "fit",
+            alias=f"{ns}/{_meta(claim).get('name', '?')}",
+            trace_id=(claim_span.context.trace_id
+                      if claim_span.recording else ""),
+            outcome=outcome)
+        if outcome == "committed":
+            return outcome
         if outcome == "conflict":
             logger.warning(
                 "claim %s/%s: %d consecutive commit conflicts; leaving "
@@ -1168,28 +1194,14 @@ class DraScheduler:
             # Cosmetic surfacing write: a flaky apiserver here must
             # never abort the sync pass that real allocations ride on.
             return
-        event = {
-            "apiVersion": "v1",
-            "kind": "Event",
-            "metadata": {
-                # Deterministic name = create-once dedupe: repeat
-                # passes hit ConflictError instead of spamming.
-                "name": f"{name}.domain-exhausted",
-                "namespace": ns,
-            },
-            "type": "Warning",
-            "reason": "DomainExhausted",
-            "message": message,
-            "involvedObject": {
-                "kind": "ResourceClaim", "name": name, "namespace": ns,
-                "uid": _meta(claim).get("uid", ""),
-            },
-            "source": {"component": "tpu-dra-scheduler"},
-        }
-        try:
-            self.kube.create("", "v1", "events", event, namespace=ns)
-        except KubeError:
-            pass  # events best-effort; the condition already landed
+        # Deterministic name = create-once dedupe: repeat passes hit
+        # ConflictError instead of spamming.
+        emit_warning_event(
+            self.kube, event_name=f"{name}.domain-exhausted",
+            namespace=ns, reason="DomainExhausted", message=message,
+            involved_kind="ResourceClaim", involved_name=name,
+            involved_uid=_meta(claim).get("uid", ""),
+            component="tpu-dra-scheduler")
 
     def _clear_domain_exhausted(self, claim) -> None:
         """An allocation landed for a claim that carried the
@@ -1269,25 +1281,77 @@ class DraScheduler:
         # optimistic (it may have read a superseded state); try_commit
         # re-judges everything here.
         log_key = (ns, _meta(claim)["name"])
-        with self._state_lock:
-            live = self._alloc if self._alloc is not None else alloc
-            if not live.try_commit(claim_like):
-                return "conflict"
-            self._commit_log[log_key] = (time.monotonic(), claim_like)
-        try:
-            self.kube.patch(
-                *RESOURCE, "resourceclaims", _meta(claim)["name"],
-                {"status": {"allocation": alloc_obj}}, namespace=ns)
-        except (NotFoundError, ConflictError):
+        uid = _meta(claim).get("uid", "")
+        fit_t0 = getattr(self._fit_tls, "t0", None)
+        t_commit0 = time.monotonic()
+        with tracing.span("sched.commit", attrs={
+                "claim_uid": uid}) as commit_sp:
             with self._state_lock:
-                self._commit_log.pop(log_key, None)
-                current = self._alloc
-            live.forget(claim_like)
-            if current is not None and current is not live:
-                # A rebuild swapped states mid-patch and replayed the
-                # now-dead reservation; release it there too.
-                current.forget(claim_like)
-            return "failed"
+                live = self._alloc if self._alloc is not None else alloc
+                if not live.try_commit(claim_like):
+                    commit_sp.set_attr("conflict", True)
+                    self.flight.record(
+                        uid or log_key[1], "commit_conflict",
+                        alias=f"{ns}/{log_key[1]}",
+                        trace_id=(commit_sp.context.trace_id
+                                  if commit_sp.recording else ""))
+                    return "conflict"
+                self._commit_log[log_key] = (time.monotonic(), claim_like)
+            trace_id = (commit_sp.context.trace_id
+                        if commit_sp.recording else "")
+            self._fit_tls.trace_id = trace_id
+            # Cross-binary propagation: the traceparent annotation
+            # rides the SAME patch as the allocation, so the kubelet
+            # plugins' prepare spans become children of THIS commit
+            # span -- one trace id, pod admission to carve-out.
+            patch = {"status": {"allocation": alloc_obj}}
+            if commit_sp.recording:
+                patch["metadata"] = {"annotations": tracing.inject(
+                    commit_sp, {})}
+            elif tracing.TRACEPARENT_ANNOTATION in (
+                    _meta(claim).get("annotations") or {}):
+                # Unsampled re-allocation of a claim that still carries
+                # a PREVIOUS allocation's traceparent (eviction ->
+                # migration): clear it (merge-patch null), or the node
+                # plugin would parent this prepare under the dead
+                # first trace.
+                patch["metadata"] = {"annotations": {
+                    tracing.TRACEPARENT_ANNOTATION: None}}
+            t_patch0 = time.monotonic()
+            try:
+                # No dedicated patch span: the commit span carries
+                # patch_ms instead (one fewer span on the hot path;
+                # the SLO histogram still splits the phases).
+                self.kube.patch(
+                    *RESOURCE, "resourceclaims",
+                    _meta(claim)["name"], patch, namespace=ns)
+            except (NotFoundError, ConflictError):
+                with self._state_lock:
+                    self._commit_log.pop(log_key, None)
+                    current = self._alloc
+                live.forget(claim_like)
+                if current is not None and current is not live:
+                    # A rebuild swapped states mid-patch and replayed
+                    # the now-dead reservation; release it there too.
+                    current.forget(claim_like)
+                return "failed"
+            t_end = time.monotonic()
+            if commit_sp.recording:
+                # Set while the span is still open so the JSONL sink
+                # (which dict-ifies at export) sees it too, not just
+                # the read-time /debug/traces ring.
+                commit_sp.set_attr("patch_ms",
+                                   round((t_end - t_patch0) * 1e3, 3))
+        if self._slo is not None:
+            if fit_t0 is not None:
+                self._slo.observe("fit", t_commit0 - fit_t0, trace_id)
+            self._slo.observe("commit", t_patch0 - t_commit0, trace_id)
+            self._slo.observe("patch", t_end - t_patch0, trace_id)
+        self.flight.record(
+            uid or log_key[1], "alloc_patched",
+            alias=f"{ns}/{log_key[1]}", trace_id=trace_id,
+            devices=[r["device"]
+                     for r in alloc_obj["devices"]["results"]])
         self._observe_placement(alloc_obj, snap, alloc)
         logger.info(
             "allocated claim %s/%s -> %s", ns, _meta(claim)["name"],
@@ -1682,6 +1746,10 @@ class DraScheduler:
         if self._queue is None or self._stop.is_set():
             return
         self._queue.enqueue(key, self._sync_key)
+        if len(key) >= 3 and key[0] == "claim":
+            # Flight-record the dirty-key enqueue under ns/name (the
+            # UID is not known here; later events alias the two).
+            self.flight.record(f"{key[1]}/{key[2]}", "enqueue")
         if self.sched_metrics is not None:
             self.sched_metrics.dirty_depth.set(self._queue.len())
 
@@ -1934,7 +2002,18 @@ class DraScheduler:
         if not self._owns(claim):
             return
         pin = self._pin_for_claim(ns, name)
-        self._allocate_one(claim, snap, alloc, classes, pinned_node=pin)
+        qwait = (self._queue.current_wait()
+                 if self._queue is not None else None)
+        outcome = self._allocate_one(claim, snap, alloc, classes,
+                                     pinned_node=pin)
+        if outcome == "committed" and qwait is not None and \
+                self._slo is not None:
+            # The queued phase of THIS claim's winning attempt: dirty-
+            # key enqueue -> sync start, including retry/hot backoff.
+            # The trace id is the commit span's (stashed by
+            # _commit_allocation on this worker thread).
+            self._slo.observe("queued", qwait,
+                              getattr(self._fit_tls, "trace_id", ""))
 
     def _pin_for_claim(self, ns: str, claim_name: str) -> str | None:
         """Bound-consumer pin for one claim via the reverse index (no
@@ -2098,9 +2177,12 @@ def main(argv: list[str] | None = None) -> int:
                         "records; empty = recovery disabled "
                         "[TPU_DRA_RECOVERY_ROOT]")
     args = p.parse_args(argv)
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from . import logsetup  # noqa: PLC0415
+
+    # Shared logging contract incl. the trace-id correlation filter
+    # (pkg/logsetup): scheduler log lines carry the same trace ids the
+    # node plugins log, so one grep follows a claim across binaries.
+    logsetup.setup(_env_int("V", 4))
     metrics = None
     sched_metrics = None
     server = None

@@ -5,6 +5,15 @@ driver.go:394-404, device_state.go:229-334, nvlib.go:860-930,
 cdi.go:306) -- fine-grained timings of lock acquisition, checkpoint
 reads/writes, device creation, and CDI spec writes, logged per claim so
 field latency regressions are attributable to a segment.
+
+Tracing integration (pkg/tracing.py): a SegmentTimer optionally parents
+its operation under a remote span context -- the scheduler's commit
+span, carried by the claim's traceparent annotation -- and every
+``segment()`` becomes a child span, so the same instants that feed the
+klog breakdown and the prepare-segment histogram also appear in the
+cross-binary trace. Logging and the fault-injection seams are
+byte-for-byte the historical behavior: the seams fire BEFORE any span
+exists, so a crash-at-segment never exports a half-open span.
 """
 
 from __future__ import annotations
@@ -14,7 +23,7 @@ import os
 import time
 from contextlib import contextmanager
 
-from . import faults
+from . import faults, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -32,13 +41,34 @@ ENV_STALL_SECONDS = "TPU_DRA_STALL_SECONDS"
 
 
 class SegmentTimer:
-    """Collects named wall-time segments for one operation."""
+    """Collects named wall-time segments for one operation.
 
-    def __init__(self, operation: str, key: str = ""):
+    ``parent`` (a pkg/tracing Span or SpanContext, typically extracted
+    from the claim's traceparent annotation) makes the whole operation
+    a child span of a remote trace; with no parent the operation starts
+    its own trace (sampling-gated). The operation span is exported at
+    :meth:`done` -- tracing sanctions this module's ``start_span``
+    (lint TPUDRA012) because the timer's lifetime is not lexical."""
+
+    def __init__(self, operation: str, key: str = "", parent=None):
         self.operation = operation
         self.key = key
         self.segments: dict[str, float] = {}
         self._start = time.monotonic()
+        attrs = {"claim_uid": key} if key else None
+        self._span = tracing.start_span(operation, parent=parent,
+                                        attrs=attrs)
+
+    @property
+    def trace_id(self) -> str:
+        """The sampled trace id this operation records under, or ''."""
+        return (self._span.context.trace_id
+                if self._span.recording else "")
+
+    @property
+    def span(self):
+        """The operation span (child segment spans parent here)."""
+        return self._span
 
     @contextmanager
     def segment(self, name: str):
@@ -50,7 +80,10 @@ class SegmentTimer:
         faults.fault_point(f"segment:{name}")
         t0 = time.monotonic()
         try:
-            yield
+            with tracing.span(name, parent=self._span,
+                              attrs=({"claim_uid": self.key}
+                                     if self.key else None)):
+                yield
         finally:
             self.segments[name] = self.segments.get(name, 0.0) + (
                 time.monotonic() - t0
@@ -59,6 +92,11 @@ class SegmentTimer:
     def done(self) -> float:
         """Log the segment breakdown; returns total seconds."""
         total = time.monotonic() - self._start
+        if self._span.recording:
+            self._span.set_attr("total_ms", round(total * 1e3, 3))
+            for name, dt in self.segments.items():
+                self._span.set_attr(f"t_{name}_ms", round(dt * 1e3, 3))
+        self._span.finish()
         if logger.isEnabledFor(logging.DEBUG):
             parts = " ".join(
                 f"t_{name}={dt * 1e3:.2f}ms"

@@ -240,6 +240,16 @@ class WorkQueue:
         on the enqueue hot path (dirty-queue depth gauge)."""
         return self._size
 
+    def current_wait(self) -> float | None:
+        """Enqueue-to-run wait (seconds, including any retry / hot-key
+        backoff) of the item the CALLING worker is currently executing;
+        None outside a queue callback. Batch-taken keys (take_ready)
+        share the primary item's wait -- they drained in the same
+        amortized pass. This is the per-item twin of the aggregate
+        wait histogram: consumers (the scheduler's claim-SLO "queued"
+        phase) attribute one item's latency instead of a distribution."""
+        return getattr(self._tls, "wait", None)
+
     def depth(self, worker: int) -> int:
         with self._cv:
             return len(self._heaps[worker])
@@ -445,9 +455,9 @@ class WorkQueue:
                 self._running.add(item.key)
                 fn = self._fn.get(item.key)
                 self._observe_depth_locked(idx)
+                self._tls.wait = time.monotonic() - item.born
                 if self._metrics is not None:
-                    self._metrics.observe_wait(
-                        time.monotonic() - item.born)
+                    self._metrics.observe_wait(self._tls.wait)
             err: BaseException | None = None
             try:
                 if fn is not None:

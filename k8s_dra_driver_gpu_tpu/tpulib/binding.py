@@ -27,6 +27,10 @@ _SO_PATH = os.path.join(_NATIVE_DIR, "libtpuinfo.so")
 ENV_MOCK_TOPOLOGY = "TPULIB_MOCK_TOPOLOGY"
 ENV_MOCK_WORKER_ID = "TPULIB_MOCK_WORKER_ID"
 ENV_MOCK_HEALTH_EVENTS = "TPULIB_MOCK_HEALTH_EVENTS"
+# Per-tenant HBM/core usage injection for the telemetry seam
+# (tenant_usage): "tenant=<key>,hbm=<bytes>[,cores=N]|..." or
+# "@/path/to/control-file" re-read every poll, like health events.
+ENV_MOCK_TENANT_USAGE = "TPULIB_MOCK_TENANT_USAGE"
 
 
 class TpuLibError(RuntimeError):
@@ -83,6 +87,16 @@ class HealthEvent:
     chip: int
     kind: str
     fatal: bool
+
+
+@dataclass(frozen=True)
+class TenantUsage:
+    """One per-tenant resource-usage sample (the live-telemetry seam
+    the MISO sizing loop consumes, pkg/partition/profiles.py)."""
+
+    tenant: str
+    hbm_bytes: int
+    cores: int = 1
 
 
 @dataclass(frozen=True)
@@ -217,6 +231,46 @@ class NativeTpuLib:
             HealthEvent(chip=e["chip"], kind=e["kind"], fatal=e["fatal"])
             for e in doc["events"]
         )
+
+    def tenant_usage(
+        self, opts: EnumerateOptions | None = None
+    ) -> tuple[TenantUsage, ...]:
+        """Per-tenant HBM/core usage samples. The native library
+        exposes no per-tenant counters yet, so both backends share the
+        Python-side source (the mock injection env / control file) --
+        byte-identical parity by construction."""
+        return _tenant_usage_from_env()
+
+
+def _tenant_usage_from_env() -> tuple[TenantUsage, ...]:
+    """Parse TPULIB_MOCK_TENANT_USAGE:
+    ``tenant=<key>,hbm=<bytes>[,cores=N]|...`` with the same
+    ``@control-file`` re-read-every-poll form as health events."""
+    _fault_point("tpulib.tenant_usage", error=lambda m: TpuLibError(m))
+    spec = os.environ.get(ENV_MOCK_TENANT_USAGE, "")
+    if spec.startswith("@"):
+        try:
+            with open(spec[1:], encoding="latin-1") as f:
+                spec = f.read().strip(" \t\r\n\f\v")
+        except OSError:
+            spec = ""
+    samples = []
+    for item in filter(None, spec.split("|")):
+        tenant, hbm, cores = "", 0, 1
+        for part in item.split(","):
+            if "=" not in part:
+                continue
+            k, _, v = part.partition("=")
+            if k == "tenant":
+                tenant = v
+            elif k == "hbm":
+                hbm = _atoi(v)
+            elif k == "cores":
+                cores = max(1, _atoi(v))
+        if tenant:
+            samples.append(TenantUsage(tenant=tenant, hbm_bytes=hbm,
+                                       cores=cores))
+    return tuple(samples)
 
 
 # ---------------------------------------------------------------------------
@@ -549,6 +603,13 @@ class PyTpuLib:
                         events.append(
                             HealthEvent(chip=idx, kind=kind, fatal=fatal))
         return tuple(events)
+
+    def tenant_usage(
+        self, opts: EnumerateOptions | None = None
+    ) -> tuple[TenantUsage, ...]:
+        """Per-tenant HBM/core usage samples (mock injection env /
+        control file; same source as the native backend)."""
+        return _tenant_usage_from_env()
 
 
 def load(prefer_native: bool = True, build_if_missing: bool = True):

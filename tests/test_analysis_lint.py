@@ -661,6 +661,96 @@ class TestCarveOutRegistryRule:
         assert "TPUDRA010" not in rules_of(findings)
 
 
+class TestSpanDisciplineRule:
+    """TPUDRA012: spans and flight-recorder entries go through the
+    public with-guarded APIs. Bare Span / FlightEvent construction and
+    a start_span held outside `with` leak unfinished spans (never
+    exported, mis-parented children) or bypass the ring's locking."""
+
+    def test_bare_span_ctor_flagged(self):
+        src = ("from .tracing import Span, SpanContext\n"
+               "def bad(ctx):\n"
+               "    sp = Span('prep', ctx)\n"
+               "    return sp\n")
+        findings = lint_source(src, rel="pkg/recovery.py")
+        assert "TPUDRA012" in rules_of(findings)
+
+    def test_bare_flight_event_ctor_flagged(self):
+        src = ("from .flightrecorder import FlightEvent\n"
+               "def bad(uid):\n"
+               "    return FlightEvent(ts=0.0, key=uid, event='x')\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA012" in rules_of(findings)
+
+    def test_start_span_outside_with_flagged(self):
+        src = ("from . import tracing\n"
+               "def bad():\n"
+               "    sp = tracing.start_span('op')\n"
+               "    return sp\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA012" in rules_of(findings)
+
+    def test_public_span_outside_with_flagged(self):
+        # The public span() helper held outside `with` is the same
+        # unfinished-span leak under the other spelling.
+        src = ("from . import tracing\n"
+               "def bad():\n"
+               "    sp = tracing.span('op')\n"
+               "    return sp\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA012" in rules_of(findings)
+
+    def test_other_objects_span_method_clean(self):
+        # Only bare span( / tracing.span( are fenced; a same-named
+        # method on some other object never trips the rule.
+        src = ("def good(doc):\n"
+               "    return doc.span('header')\n")
+        assert "TPUDRA012" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+    def test_with_guarded_span_clean(self):
+        src = ("from . import tracing\n"
+               "def good(uid):\n"
+               "    with tracing.span('op', attrs={'claim_uid': uid}):\n"
+               "        pass\n")
+        assert "TPUDRA012" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+    def test_start_span_as_with_context_clean(self):
+        # `with start_span(...)` IS finished on every path -- the
+        # with-guard is the discipline, not the helper's name.
+        src = ("from . import tracing\n"
+               "def good():\n"
+               "    with tracing.start_span('op') as sp:\n"
+               "        return sp.context\n")
+        assert "TPUDRA012" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+    def test_timing_layer_sanctioned(self):
+        # SegmentTimer owns its operation span from __init__ to
+        # done() -- the sanctioned non-lexical holder.
+        src = ("from . import tracing\n"
+               "class SegmentTimer:\n"
+               "    def __init__(self, operation):\n"
+               "        self._span = tracing.start_span(operation)\n")
+        assert "TPUDRA012" not in rules_of(
+            lint_source(src, rel="pkg/timing.py"))
+
+    def test_tracing_layer_ctor_sanctioned(self):
+        src = ("def start_span(name, ctx):\n"
+               "    return Span(name, ctx)\n")
+        assert "TPUDRA012" not in rules_of(
+            lint_source(src, rel="pkg/tracing.py"))
+
+    def test_recorder_record_clean(self):
+        src = ("from . import flightrecorder\n"
+               "def good(uid):\n"
+               "    flightrecorder.default().record(uid, 'fit',\n"
+               "                                    outcome='ok')\n")
+        assert "TPUDRA012" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+
 class TestWholePackageGate:
     """The tier-1 CI gate from ISSUE 3: zero non-baselined findings
     over the shipped package, with the committed baseline EMPTY (every

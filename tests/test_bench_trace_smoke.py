@@ -1,0 +1,58 @@
+"""Tier-1 tracing-overhead smoke: the `make bench-trace-smoke`
+contract as a non-slow test. Runs `bench.py --trace-overhead` on a
+shrunk trace and asserts (a) fully-sampled claim-lifecycle tracing
+stays inside the 5% overhead envelope of the tracing-off wall clock
+(min-of-interleaved-reps ratio, adaptively extended with more reps
+under load, so a loaded CI box doesn't decide the gate), (b) the
+sampling knob actually gates the hot path -- sampling
+on exports spans, sampling off exports ZERO, (c) the traced
+event-driven churn converges every claim, and (d) the
+BENCH_observability.json artifact is emitted -- so a tracing hot-path
+regression fails fast here instead of surfacing as a BENCH trajectory
+dip."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-trace-smoke target.
+SMOKE_ENV = {
+    "BENCH_TRACE_NODES": "8",
+    "BENCH_TRACE_CLAIMS": "64",
+    "BENCH_TRACE_REPS": "4",
+    "BENCH_TRACE_CHURN_CLAIMS": "24",
+    "BENCH_TRACE_MAX_OVERHEAD_PCT": "5",
+}
+
+
+def test_trace_overhead_smoke(tmp_path):
+    out_file = str(tmp_path / "BENCH_observability.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--trace-overhead"],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV,
+             "BENCH_OBS_OUT": out_file},
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "trace_overhead_pct"
+    ex = doc["extras"]
+    # The overhead gate itself (bench exits nonzero past the cap; the
+    # assert keeps the number visible in the pytest failure too).
+    assert doc["value"] <= 5.0
+    # The sampling knob gates span export BOTH ways: on must trace the
+    # real control plane, off must export nothing at all.
+    assert ex["trace_spans_exported_on"] > 0
+    assert ex["trace_churn_spans_on"] > 0
+    assert ex["trace_spans_exported_off"] == 0
+    # The traced event-driven churn still converged every claim.
+    assert ex["trace_unconverged"] == 0
+    # The trajectory artifact landed and round-trips.
+    with open(out_file, encoding="utf-8") as f:
+        emitted = json.load(f)
+    assert emitted["metric"] == "trace_overhead_pct"
+    assert emitted["extras"]["trace_spans_exported_off"] == 0

@@ -1,0 +1,155 @@
+"""Live tenant-demand telemetry: tpulib per-tenant HBM/core usage ->
+the health-poll loop -> TenantProfileStore (the MISO sizing input),
+replacing static-file-only demand (ROADMAP item 1 follow-up).
+"""
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.health import (
+    ChipHealthMonitor,
+)
+from k8s_dra_driver_gpu_tpu.pkg.partition.profiles import (
+    TenantProfileStore,
+)
+from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+    ENV_MOCK_TENANT_USAGE,
+    EnumerateOptions,
+    TenantUsage,
+    load,
+)
+
+
+class _FakeTpuLib:
+    """A tpulib double with a scripted telemetry feed."""
+
+    def __init__(self, feed):
+        self.feed = list(feed)
+
+    def health(self, opts):
+        return ()
+
+    def tenant_usage(self, opts):
+        return tuple(self.feed.pop(0)) if self.feed else ()
+
+
+class _LegacyTpuLib:
+    """A tpulib predating the telemetry seam (no tenant_usage)."""
+
+    def health(self, opts):
+        return ()
+
+
+def _monitor(tpulib, on_usage):
+    return ChipHealthMonitor(tpulib, EnumerateOptions(
+        mock_topology="v5e-4"), lambda taints: None,
+        on_tenant_usage=on_usage)
+
+
+class TestMonitorSampling:
+    def test_samples_flow_to_consumer(self):
+        got = []
+        fake = _FakeTpuLib([
+            [TenantUsage(tenant="svc-a", hbm_bytes=2 << 30, cores=1)],
+            [TenantUsage(tenant="svc-a", hbm_bytes=3 << 30, cores=2),
+             TenantUsage(tenant="svc-b", hbm_bytes=1 << 30)],
+        ])
+        mon = _monitor(fake, got.extend)
+        assert len(mon.sample_telemetry()) == 1
+        assert len(mon.sample_telemetry()) == 2
+        assert [u.tenant for u in got] == ["svc-a", "svc-a", "svc-b"]
+
+    def test_legacy_tpulib_degrades_to_no_samples(self):
+        got = []
+        mon = _monitor(_LegacyTpuLib(), got.append)
+        assert mon.sample_telemetry() == ()
+        assert got == []
+
+    def test_no_consumer_is_noop(self):
+        fake = _FakeTpuLib([[TenantUsage("svc-a", 1)]])
+        mon = _monitor(fake, None)
+        assert mon.sample_telemetry() == ()
+        # The feed was not consumed: telemetry is pull-on-demand.
+        assert fake.feed
+
+
+class TestStoreFeed:
+    def test_record_moves_percentiles(self):
+        """The regression the satellite asks for: live samples through
+        ``record`` supersede the static prior for sizing reads."""
+        store = TenantProfileStore(defaults={})
+        store.record("svc-a", 2 << 30, cores=1)
+        assert store.demand("svc-a").hbm_bytes == 2 << 30
+        # A fake live feed showing sustained higher demand.
+        feed = _FakeTpuLib([
+            [TenantUsage("svc-a", 6 << 30, cores=2)]] * 20)
+        mon = _monitor(
+            feed,
+            lambda usage: [store.record(u.tenant, u.hbm_bytes,
+                                        cores=u.cores)
+                           for u in usage])
+        for _ in range(20):
+            mon.sample_telemetry()
+        demand = store.demand("svc-a", percentile=0.95)
+        assert demand.hbm_bytes == 6 << 30
+        assert demand.cores == 2
+
+    def test_driver_wires_health_poll_to_store(self, tmp_root,
+                                               monkeypatch):
+        """End to end through the real Driver: the mock tpulib env
+        feed lands in Driver.tenant_profiles via the health monitor's
+        telemetry sampling."""
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+            Config,
+        )
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+
+        monkeypatch.setenv(
+            ENV_MOCK_TENANT_USAGE,
+            "tenant=svc-live,hbm=4294967296,cores=2|"
+            "tenant=svc-small,hbm=1073741824")
+        driver = Driver(Config.mock(root=tmp_root), FakeKubeClient(),
+                        node_name="n0", enable_health_monitor=True)
+        try:
+            usage = driver.health_monitor.sample_telemetry()
+            assert {u.tenant for u in usage} == {"svc-live",
+                                                 "svc-small"}
+            demand = driver.tenant_profiles.demand("svc-live")
+            assert demand.hbm_bytes == 4 << 30
+            assert demand.cores == 2
+            assert driver.tenant_profiles.demand(
+                "svc-small").hbm_bytes == 1 << 30
+        finally:
+            driver.stop()
+
+
+class TestMockSeamParity:
+    def test_env_spec_and_control_file(self, tmp_path, monkeypatch):
+        lib = load(prefer_native=False)
+        monkeypatch.setenv(ENV_MOCK_TENANT_USAGE,
+                           "tenant=a,hbm=100,cores=3|tenant=b,hbm=7")
+        usage = lib.tenant_usage(EnumerateOptions())
+        assert usage == (TenantUsage("a", 100, 3),
+                         TenantUsage("b", 7, 1))
+        ctl = tmp_path / "usage.ctl"
+        ctl.write_text("tenant=c,hbm=9\n")
+        monkeypatch.setenv(ENV_MOCK_TENANT_USAGE, f"@{ctl}")
+        assert lib.tenant_usage(EnumerateOptions()) == (
+            TenantUsage("c", 9, 1),)
+        # Control file re-read per poll: clearing it clears the feed.
+        ctl.write_text("")
+        assert lib.tenant_usage(EnumerateOptions()) == ()
+        monkeypatch.delenv(ENV_MOCK_TENANT_USAGE)
+        assert lib.tenant_usage(EnumerateOptions()) == ()
+
+    def test_native_backend_shares_the_env_source(self, monkeypatch):
+        pytest.importorskip("ctypes")
+        try:
+            native = load(prefer_native=True, build_if_missing=False)
+        except Exception:
+            pytest.skip("native backend unavailable")
+        if native.name != "native":
+            pytest.skip("native backend unavailable")
+        monkeypatch.setenv(ENV_MOCK_TENANT_USAGE, "tenant=x,hbm=5")
+        assert native.tenant_usage(EnumerateOptions()) == (
+            TenantUsage("x", 5, 1),)

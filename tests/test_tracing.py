@@ -1,0 +1,449 @@
+"""Claim-lifecycle tracing, flight recorder, and log correlation.
+
+Covers pkg/tracing.py (W3C traceparent contexts, with-guarded spans,
+sampling, the bounded exporter + debug endpoints), pkg/flightrecorder,
+the SegmentTimer span integration (pkg/timing.py), the logsetup trace
+filter -- and the acceptance end-to-end: a claim allocated by the REAL
+scheduler and prepared by a REAL DeviceState yields ONE trace id whose
+span tree contains the scheduler's commit span and the plugin's
+prepare-segment child spans, retrievable over HTTP from
+/debug/traces on the metrics listener.
+"""
+
+import json
+import logging
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.claim import ResourceClaim
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+    Config,
+    DeviceState,
+)
+from k8s_dra_driver_gpu_tpu.pkg import flightrecorder, logsetup, tracing
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.metrics import (
+    MetricsServer,
+    SchedulerMetrics,
+)
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+from k8s_dra_driver_gpu_tpu.pkg.sliceutil import publish_resource_slices
+from k8s_dra_driver_gpu_tpu.pkg.timing import SegmentTimer
+
+RES = ("resource.k8s.io", "v1")
+
+
+@pytest.fixture(autouse=True)
+def fresh_tracing(monkeypatch):
+    """Full sampling + a private exporter/recorder per test."""
+    monkeypatch.setenv(tracing.ENV_SAMPLE, "1")
+    exporter = tracing.set_exporter(tracing.TraceExporter())
+    recorder = flightrecorder.set_default(flightrecorder.FlightRecorder())
+    yield exporter, recorder
+    tracing.set_exporter(tracing.TraceExporter())
+    flightrecorder.set_default(flightrecorder.FlightRecorder())
+
+
+class TestSpanContext:
+    def test_traceparent_roundtrip(self):
+        ctx = tracing.SpanContext(trace_id="ab" * 16, span_id="cd" * 8)
+        parsed = tracing.SpanContext.from_traceparent(
+            ctx.to_traceparent())
+        assert parsed == ctx
+        assert parsed.sampled
+
+    def test_unsampled_flag_roundtrip(self):
+        ctx = tracing.SpanContext(trace_id="ab" * 16, span_id="cd" * 8,
+                                  sampled=False)
+        header = ctx.to_traceparent()
+        assert header.endswith("-00")
+        assert not tracing.SpanContext.from_traceparent(header).sampled
+
+    @pytest.mark.parametrize("bad", [
+        "", "garbage", "00-short-cdcdcdcdcdcdcdcd-01",
+        "00-" + "g" * 32 + "-" + "cd" * 8 + "-01",  # non-hex
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",  # zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # zero span id
+        None, 7,
+    ])
+    def test_malformed_rejected(self, bad):
+        assert tracing.SpanContext.from_traceparent(bad) is None
+
+    def test_extract_from_annotations(self):
+        ctx = tracing.SpanContext(trace_id="ab" * 16, span_id="cd" * 8)
+        ann = tracing.inject(ctx, {})
+        assert tracing.extract(ann) == ctx
+        assert tracing.extract({}) is None
+        assert tracing.extract(None) is None
+        assert tracing.trace_id_of(ann) == "ab" * 16
+
+
+class TestSpans:
+    def test_nesting_and_parenting(self, fresh_tracing):
+        exporter, _ = fresh_tracing
+        with tracing.span("outer") as outer:
+            assert tracing.current_span() is outer
+            with tracing.span("inner") as inner:
+                assert inner.context.trace_id == outer.context.trace_id
+                assert inner.parent_id == outer.context.span_id
+        assert tracing.current_span() is None
+        names = {d["name"] for d in exporter.spans()}
+        assert names == {"outer", "inner"}
+
+    def test_error_recorded_and_stack_unwound(self, fresh_tracing):
+        exporter, _ = fresh_tracing
+        with pytest.raises(ValueError):
+            with tracing.span("boom"):
+                raise ValueError("nope")
+        assert tracing.current_span() is None
+        [doc] = exporter.spans()
+        assert "ValueError" in doc["error"]
+
+    def test_remote_parent(self, fresh_tracing):
+        exporter, _ = fresh_tracing
+        remote = tracing.SpanContext(trace_id="ab" * 16,
+                                     span_id="cd" * 8)
+        with tracing.span("child", parent=remote) as sp:
+            assert sp.context.trace_id == remote.trace_id
+            assert sp.parent_id == remote.span_id
+
+    def test_sampling_off_is_noop(self, fresh_tracing, monkeypatch):
+        exporter, _ = fresh_tracing
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "0")
+        with tracing.span("root") as sp:
+            assert not sp.recording
+            with tracing.span("child") as child:
+                assert not child.recording
+        assert exporter.spans() == []
+
+    def test_unsampled_root_decision_inherited(self, fresh_tracing,
+                                               monkeypatch):
+        """At fractional rates the root's NO must be inherited: the
+        unsampled root still occupies the thread stack, so a nested
+        span sees it as parent instead of re-rolling an independent
+        root decision (which would export orphan parentless traces)."""
+        exporter, _ = fresh_tracing
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "0.5")
+        # First roll (the root) lands unsampled; any illegitimate
+        # re-roll by a nested span WOULD land sampled.
+        rolls = iter([0.9, 0.0, 0.0, 0.0])
+        monkeypatch.setattr(tracing.random, "random",
+                            lambda: next(rolls))
+        with tracing.span("root") as root:
+            assert not root.recording
+            assert tracing.current_span() is root
+            with tracing.span("child") as child:
+                assert not child.recording
+        assert tracing.current_span() is None
+        assert exporter.spans() == []
+
+    def test_unsampled_remote_parent_is_noop(self, fresh_tracing):
+        exporter, _ = fresh_tracing
+        remote = tracing.SpanContext(trace_id="ab" * 16,
+                                     span_id="cd" * 8, sampled=False)
+        with tracing.span("child", parent=remote) as sp:
+            assert not sp.recording
+        assert exporter.spans() == []
+
+    def test_threads_have_independent_stacks(self, fresh_tracing):
+        seen = {}
+
+        def worker():
+            seen["in_thread"] = tracing.current_span()
+
+        with tracing.span("main-only"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["in_thread"] is None
+
+
+class TestExporter:
+    def test_ring_is_bounded(self):
+        exp = tracing.TraceExporter(max_spans=16)
+        for i in range(100):
+            with tracing.span(f"s{i}"):
+                pass
+            exp.export(tracing.start_span("x"))
+        assert len(exp.spans()) <= 16
+
+    def test_traces_grouped_and_sorted(self, fresh_tracing):
+        exporter, _ = fresh_tracing
+        with tracing.span("a") as a:
+            with tracing.span("b"):
+                pass
+        traces = exporter.traces()
+        spans = traces[a.context.trace_id]
+        assert [s["name"] for s in spans] == ["a", "b"] or \
+            [s["name"] for s in spans] == ["b", "a"]
+
+    def test_jsonl_file_sink(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        exp = tracing.set_exporter(tracing.TraceExporter(path=path))
+        with tracing.span("filed"):
+            pass
+        lines = [json.loads(line) for line in
+                 open(path, encoding="utf-8")]
+        assert lines and lines[0]["name"] == "filed"
+        assert exp.exported_total == 1
+
+
+class TestSegmentTimerTracing:
+    def test_segments_are_child_spans_of_remote_parent(
+            self, fresh_tracing):
+        exporter, _ = fresh_tracing
+        remote = tracing.SpanContext(trace_id="ab" * 16,
+                                     span_id="cd" * 8)
+        timer = SegmentTimer("prepare", "uid-1", parent=remote)
+        with timer.segment("step_one"):
+            pass
+        timer.done()
+        by_name = {d["name"]: d for d in exporter.spans()}
+        assert by_name["prepare"]["parent_id"] == remote.span_id
+        assert by_name["step_one"]["parent_id"] == \
+            by_name["prepare"]["span_id"]
+        assert by_name["step_one"]["trace_id"] == remote.trace_id
+        assert timer.trace_id == remote.trace_id
+        # Segment wall-times still collected exactly as before.
+        assert "step_one" in timer.segments
+        assert "t_step_one_ms" in by_name["prepare"]["attrs"]
+
+    def test_fault_seam_behavior_preserved(self, fresh_tracing):
+        """The pkg/faults segment seam still fires at segment START --
+        before the segment's span is entered, so a crash-at-segment
+        never exports a half-open segment span."""
+        from k8s_dra_driver_gpu_tpu.pkg import faults
+
+        exporter, _ = fresh_tracing
+        faults.arm("segment:seamcheck", mode="error")
+        try:
+            timer = SegmentTimer("prepare", "uid-2")
+            with pytest.raises(faults.InjectedFault):
+                with timer.segment("seamcheck"):
+                    raise AssertionError("segment body must not run")
+        finally:
+            faults.reset()
+        assert "seamcheck" not in {d["name"] for d in exporter.spans()}
+
+
+class TestFlightRecorder:
+    def test_record_and_query_by_key_or_alias(self, fresh_tracing):
+        _, rec = fresh_tracing
+        rec.record("uid-1", "fit", alias="default/c1", outcome="unfit")
+        rec.record("default/c1", "enqueue")
+        by_uid = rec.events("uid-1")
+        by_name = rec.events("default/c1")
+        # Identity closure over the alias: BOTH spellings return the
+        # full story -- the uid query also pulls the alias-less
+        # enqueue recorded under ns/name before the uid existed,
+        # because the aliased fit event ties the two identities.
+        assert {e["event"] for e in by_uid} == {"fit", "enqueue"}
+        assert {e["event"] for e in by_name} == {"fit", "enqueue"}
+        # An unrelated claim's events stay out of both views.
+        rec.record("uid-2", "fit", alias="default/other")
+        assert {e["event"] for e in rec.events("uid-1")} == \
+            {"fit", "enqueue"}
+
+    def test_ring_bounded(self):
+        rec = flightrecorder.FlightRecorder(capacity=32)
+        for i in range(500):
+            rec.record("k", f"e{i}")
+        assert len(rec.events("k")) <= 32
+        assert rec.recorded_total == 500
+
+    def test_dump_readable(self, fresh_tracing):
+        _, rec = fresh_tracing
+        rec.record("uid-9", "eviction", state="EvictionPlanned")
+        dump = rec.dump("uid-9")
+        assert "eviction" in dump and "EvictionPlanned" in dump
+        assert "no flight-recorder events" in rec.dump("unknown")
+
+
+class TestLogCorrelation:
+    def test_filter_injects_trace_id(self, fresh_tracing):
+        filt = logsetup.TraceContextFilter()
+        record = logging.LogRecord("t", logging.INFO, __file__, 1,
+                                   "msg", (), None)
+        with tracing.span("op", attrs={"claim_uid": "uid-7"}):
+            assert filt.filter(record)
+            assert record.trace_id
+            assert record.claim_uid == "uid-7"
+        record2 = logging.LogRecord("t", logging.INFO, __file__, 1,
+                                    "msg", (), None)
+        filt.filter(record2)
+        assert record2.trace_id == ""
+        # FORMAT renders with the injected fields.
+        out = logging.Formatter(logsetup.FORMAT).format(record)
+        assert record.trace_id in out
+
+
+def _http_json(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+class TestEndToEndTrace:
+    """The acceptance criterion: one trace, scheduler commit span ->
+    plugin prepare-segment child spans, via the traceparent annotation
+    stamped on the claim -- served at /debug/traces."""
+
+    def _cluster(self, node: str = "node-0"):
+        fake = FakeKubeClient()
+        fake.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": "tpu.dra.dev"},
+            "spec": {"selectors": [{"cel": {
+                "expression": 'device.driver == "tpu.dra.dev"'}}]},
+        })
+        publish_resource_slices(fake, [{
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": f"{node}-tpu.dra.dev"},
+            "spec": {
+                "driver": "tpu.dra.dev", "nodeName": node,
+                "pool": {"name": node, "generation": 1,
+                         "resourceSliceCount": 1},
+                "devices": [{"name": f"chip-{j}"} for j in range(4)],
+            },
+        }])
+        fake.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "c-e2e", "namespace": "default",
+                         "uid": "uid-e2e"},
+            "spec": {"devices": {"requests": [{
+                "name": "tpu",
+                "exactly": {"deviceClassName": "tpu.dra.dev"},
+            }]}},
+        }, namespace="default")
+        return fake
+
+    def test_single_trace_spans_scheduler_and_plugin(
+            self, fresh_tracing, tmp_path):
+        exporter, recorder = fresh_tracing
+        fake = self._cluster()
+        sm = SchedulerMetrics()
+        sched = DraScheduler(fake, sched_metrics=sm)
+        sched.sync_once()
+
+        claim = fake.get(*RES, "resourceclaims", "c-e2e",
+                         namespace="default")
+        assert claim["status"]["allocation"]
+        header = claim["metadata"]["annotations"][
+            tracing.TRACEPARENT_ANNOTATION]
+        ctx = tracing.SpanContext.from_traceparent(header)
+        assert ctx is not None and ctx.sampled
+
+        # REAL node-side prepare off the allocated claim object.
+        state = DeviceState(Config.mock(root=str(tmp_path)))
+        rc = ResourceClaim.from_dict(claim)
+        assert rc.annotations[tracing.TRACEPARENT_ANNOTATION] == header
+        ids = state.prepare(rc)
+        assert ids
+
+        trace = exporter.traces()[ctx.trace_id]
+        by_name = {}
+        for doc in trace:
+            by_name.setdefault(doc["name"], doc)
+        # One trace id covers the scheduler AND the plugin.
+        assert "sched.commit" in by_name
+        assert "prepare" in by_name
+        assert "prep_devices" in by_name
+        # The plugin's operation span is a CHILD of the commit span
+        # (the annotation carried the commit span id).
+        assert by_name["sched.commit"]["span_id"] == ctx.span_id
+        assert by_name["prepare"]["parent_id"] == ctx.span_id
+        assert by_name["prep_devices"]["parent_id"] == \
+            by_name["prepare"]["span_id"]
+
+        # SLO histogram: control-plane phases landed with samples.
+        phases = set()
+        for metric in sm.slo.e2e.collect():
+            for s in metric.samples:
+                if s.name.endswith("_count") and s.value > 0:
+                    phases.add(s.labels["phase"])
+        assert {"fit", "commit", "patch"} <= phases
+
+        # Flight recorder has the claim's cross-binary timeline.
+        events = {e["event"] for e in recorder.events("uid-e2e")}
+        assert {"fit", "alloc_patched", "prepare_segments"} <= events
+
+        state.unprepare("uid-e2e")
+
+    def test_trace_served_over_http(self, fresh_tracing, tmp_path):
+        exporter, recorder = fresh_tracing
+        fake = self._cluster()
+        sched = DraScheduler(fake)
+        sched.sync_once()
+        claim = fake.get(*RES, "resourceclaims", "c-e2e",
+                         namespace="default")
+        ctx = tracing.SpanContext.from_traceparent(
+            claim["metadata"]["annotations"][
+                tracing.TRACEPARENT_ANNOTATION])
+        state = DeviceState(Config.mock(root=str(tmp_path)))
+        state.prepare(ResourceClaim.from_dict(claim))
+
+        from prometheus_client import CollectorRegistry
+
+        server = MetricsServer(CollectorRegistry(), host="127.0.0.1",
+                               port=0)
+        server.start()
+        try:
+            port = server.port
+            doc = _http_json(port, "/debug/traces")
+            assert ctx.trace_id in doc["traces"]
+            names = {s["name"] for s in doc["traces"][ctx.trace_id]}
+            assert {"sched.commit", "prepare"} <= names
+            one = _http_json(port, f"/debug/traces/{ctx.trace_id}")
+            assert {s["name"] for s in one["spans"]} == names
+            claims = _http_json(port, "/debug/claims/uid-e2e")
+            assert any(e["event"] == "prepare_segments"
+                       for e in claims["events"])
+            index = _http_json(port, "/debug/claims")
+            assert "uid-e2e" in index["claims"]
+            with pytest.raises(urllib.error.HTTPError):
+                _http_json(port, "/debug/traces/feedfacefeedface"
+                                 "feedfacefeedface")
+        finally:
+            server.stop()
+
+    def test_sampling_off_stamps_nothing(self, fresh_tracing,
+                                         monkeypatch):
+        exporter, _ = fresh_tracing
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "0")
+        fake = self._cluster()
+        sched = DraScheduler(fake)
+        sched.sync_once()
+        claim = fake.get(*RES, "resourceclaims", "c-e2e",
+                         namespace="default")
+        assert claim["status"]["allocation"]
+        assert tracing.TRACEPARENT_ANNOTATION not in (
+            claim["metadata"].get("annotations") or {})
+        assert exporter.spans() == []
+
+    def test_stale_traceparent_cleared_on_unsampled_realloc(
+            self, fresh_tracing, monkeypatch):
+        """A claim re-allocated with an UNSAMPLED commit must not keep
+        a previous allocation's traceparent (eviction -> migration):
+        the commit patch clears it, or the node plugin would parent
+        the new prepare under the dead first trace."""
+        exporter, _ = fresh_tracing
+        monkeypatch.setenv(tracing.ENV_SAMPLE, "0")
+        fake = self._cluster()
+        stale = tracing.SpanContext(trace_id="ab" * 16,
+                                    span_id="cd" * 8)
+        fake.patch(*RES, "resourceclaims", "c-e2e",
+                   {"metadata": {"annotations": {
+                       tracing.TRACEPARENT_ANNOTATION:
+                           stale.to_traceparent()}}},
+                   namespace="default")
+        sched = DraScheduler(fake)
+        sched.sync_once()
+        claim = fake.get(*RES, "resourceclaims", "c-e2e",
+                         namespace="default")
+        assert claim["status"]["allocation"]
+        assert tracing.TRACEPARENT_ANNOTATION not in (
+            claim["metadata"].get("annotations") or {})
+        assert exporter.spans() == []
