@@ -5,7 +5,8 @@ PYTHON ?= python
 .PHONY: all native test test-fast bench bench-smoke \
 	bench-placement-smoke bench-chaos-smoke bench-sched-smoke \
 	bench-sched-scale bench-recovery-smoke bench-serving-smoke \
-	bench-trace-smoke lint lint-analysis clean stamp-version
+	bench-trace-smoke bench-telemetry-smoke validate-dashboard \
+	lint lint-analysis clean stamp-version
 
 VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
 
@@ -139,6 +140,31 @@ bench-trace-smoke:
 	BENCH_TRACE_MAX_OVERHEAD_PCT=5 \
 	BENCH_OBS_OUT=$(or $(BENCH_OBS_OUT),/tmp/BENCH_observability_smoke.json) \
 	$(PYTHON) bench.py --trace-overhead
+
+# Fleet-telemetry overhead smoke: a shrunk `bench.py
+# --telemetry-overhead` run -- the real Driver claim churn interleaved
+# with health+telemetry polls, telemetry station fully on vs fully off
+# (interleaved reps; gate = min-of-reps ratio, adaptively extended
+# under co-tenant load), gated at <= 5% overhead. Also proves the
+# wiring both ways (on records ring samples, TPU_DRA_TELEMETRY=0
+# records ZERO) and that the converged quantized-attribute republish
+# costs zero kube writes. Mirrored as a non-slow test in
+# tests/test_bench_telemetry_smoke.py; the committed trajectory entry
+# is BENCH_observability.json "telemetry" (full-size plain
+# `bench.py --telemetry-overhead`).
+bench-telemetry-smoke:
+	BENCH_TELEMETRY_ITERS=8 BENCH_TELEMETRY_REPS=2 \
+	BENCH_TELEMETRY_MAX_OVERHEAD_PCT=5 \
+	BENCH_OBS_OUT=$(or $(BENCH_OBS_OUT),/tmp/BENCH_observability_smoke.json) \
+	$(PYTHON) bench.py --telemetry-overhead
+
+# Grafana fleet dashboard validation: every metric name referenced by
+# deployments/grafana/fleet-dashboard.json must actually be exposed by
+# some binary's registry (the check reuses the metrics-hygiene
+# registry compositions). Mirrored tier-1 as
+# tests/test_grafana_dashboard.py.
+validate-dashboard:
+	$(PYTHON) -m pytest tests/test_grafana_dashboard.py -q
 
 # Full 1000-node x 5000-claim scale-out proof (the BENCH_scheduler.json
 # "scale" trajectory entry): sharded multi-worker draining + batched

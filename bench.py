@@ -1178,6 +1178,162 @@ def bench_trace_overhead() -> dict:
     }
 
 
+def _telemetry_churn_wall(telemetry_on: bool, iters: int,
+                          polls_per_round: int) -> dict:
+    """One telemetry-overhead rep: the REAL Driver claim churn
+    (prepare -> unprepare per chip slot) interleaved with health+
+    telemetry polls, with the fleet telemetry station fully on or
+    fully off (TPU_DRA_TELEMETRY). Returns wall + the wiring stats the
+    gate checks (ring samples recorded, steady-state kube writes)."""
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import Config
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+    from k8s_dra_driver_gpu_tpu.pkg import fleetstate
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from tests.fake_kube import CountingKube, make_claim_dict
+
+    prev = {k: os.environ.get(k) for k in
+            ("TPU_DRA_TELEMETRY", "TPULIB_MOCK_TELEMETRY")}
+    os.environ["TPU_DRA_TELEMETRY"] = "1" if telemetry_on else "0"
+    # A realistic 4-chip feed: busy chips, stable thermals -- the
+    # steady state a production poll sees (the quantized attributes
+    # must converge to zero-write republishes).
+    os.environ["TPULIB_MOCK_TELEMETRY"] = "|".join(
+        f"chip={i},power=117,temp=48,hbm=2147483648,duty=0.93,"
+        f"ici_err=0" for i in range(4))
+    ring = fleetstate.set_default_ring(fleetstate.TelemetryRing())
+    # State root on tmpfs when available: the churn's checkpoint
+    # fsyncs on a network-backed /tmp (9p CI boxes) add multiplicative
+    # seconds-scale noise that swamps the millisecond-scale quantity
+    # under test; the overhead gate measures telemetry CPU, not the
+    # host's filesystem latency lottery.
+    shm = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    try:
+        with tempfile.TemporaryDirectory(dir=shm) as root:
+            kube = CountingKube(FakeKubeClient())
+            driver = Driver(Config.mock(root=root, topology="v5e-4"),
+                            kube, "bench-node",
+                            enable_health_monitor=True)
+            mon = driver.health_monitor
+            driver.publish_resources()
+            # Warm: first poll publishes the telemetry attributes
+            # (one content change), everything after must converge.
+            driver._on_health_taints(mon.poll_and_reconcile())
+            steady_writes = 0  # kube writes during STEADY polls only
+            t0 = time.perf_counter()
+            for i in range(iters):
+                batch = []
+                for chip in range(4):
+                    uid = f"tele-{chip}-{i}"
+                    obj = make_claim_dict(uid, [f"chip-{chip}"])
+                    obj["metadata"]["name"] = uid
+                    kube.create("resource.k8s.io", "v1",
+                                "resourceclaims", obj,
+                                namespace="default")
+                    batch.append({"uid": uid, "namespace": "default",
+                                  "name": uid})
+                driver.prepare_resource_claims(batch)
+                for _ in range(polls_per_round):
+                    w0 = kube.writes
+                    driver._on_health_taints(mon.poll_and_reconcile())
+                    steady_writes += kube.writes - w0
+                driver.unprepare_resource_claims(batch)
+            wall = time.perf_counter() - t0
+            driver.stop()
+            return {
+                "wall_s": wall,
+                "ring_samples": ring.recorded_total,
+                "steady_writes": steady_writes,
+            }
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        fleetstate.set_default_ring(fleetstate.TelemetryRing())
+
+
+def bench_telemetry_overhead() -> dict:
+    """Telemetry-overhead mode (`bench.py --telemetry-overhead`):
+    proves the fleet-telemetry cost contract and emits the
+    ``telemetry`` entry of ``BENCH_observability.json``.
+
+    **Gate half** -- the real Driver claim churn (prepare/unprepare
+    against the mock v5e-4 DeviceState) interleaved with health+
+    telemetry polls, timed with the telemetry station fully ON
+    (sampling + ring + anomaly detectors + quantized slice attributes)
+    vs fully OFF (TPU_DRA_TELEMETRY=0). Interleaved alternating reps,
+    min-of-reps ratio (same estimator rationale as --trace-overhead):
+    must stay within BENCH_TELEMETRY_MAX_OVERHEAD_PCT (default 5%).
+
+    **Wiring half** -- telemetry ON must record ring samples and keep
+    the converged steady-state republish at ZERO kube writes (the
+    quantized attributes hash identically poll over poll); telemetry
+    OFF must record NOTHING (the knob actually gates the station).
+
+    Knobs: BENCH_TELEMETRY_ITERS (claim rounds, default 30),
+    BENCH_TELEMETRY_POLLS (polls per round, 2),
+    BENCH_TELEMETRY_REPS (4)."""
+    iters = _env_int("BENCH_TELEMETRY_ITERS", 30)
+    polls = _env_int("BENCH_TELEMETRY_POLLS", 2)
+    reps = max(1, _env_int("BENCH_TELEMETRY_REPS", 4))
+    cap = _env_float("BENCH_TELEMETRY_MAX_OVERHEAD_PCT", 5.0)
+
+    offs, ons = [], []
+    on_samples = 0
+    off_samples = 0
+    on_steady_writes = 0
+
+    def measure_pairs(n: int) -> None:
+        nonlocal on_samples, off_samples, on_steady_writes
+        for _ in range(n):
+            sides = (False, True) if len(offs) % 2 == 0 \
+                else (True, False)
+            for on in sides:
+                r = _telemetry_churn_wall(on, iters, polls)
+                if on:
+                    ons.append(r["wall_s"])
+                    on_samples = max(on_samples, r["ring_samples"])
+                    on_steady_writes += r["steady_writes"]
+                else:
+                    offs.append(r["wall_s"])
+                    off_samples = max(off_samples, r["ring_samples"])
+
+    def min_overhead_pct() -> float:
+        return max(0.0, (min(ons) / max(min(offs), 1e-9) - 1.0) * 100)
+
+    # Unmeasured warmup (code paths, checkpoint plumbing, CDI dirs).
+    _telemetry_churn_wall(False, max(2, iters // 10), 1)
+    measure_pairs(reps)
+    # Adaptive extension under co-tenant load: min-of-reps only
+    # improves with samples; a real regression survives any number.
+    for _ in range(2):
+        if not cap or min_overhead_pct() <= cap:
+            break
+        measure_pairs(reps)
+    overhead_pct = min_overhead_pct()
+    return {
+        "metric": "telemetry_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "%",
+        # >1 = always-on fleet telemetry stays inside the 5% envelope
+        # the issue demands.
+        "vs_baseline": round(5.0 / max(overhead_pct, 0.1), 2),
+        "extras": {
+            "telemetry_iters": iters,
+            "telemetry_polls_per_round": polls,
+            "telemetry_reps": len(offs),
+            "telemetry_off_wall_s": round(min(offs), 4),
+            "telemetry_on_wall_s": round(min(ons), 4),
+            "telemetry_off_walls_s": [round(v, 4) for v in offs],
+            "telemetry_on_walls_s": [round(v, 4) for v in ons],
+            "telemetry_ring_samples_on": on_samples,
+            "telemetry_ring_samples_off": off_samples,
+            "telemetry_steady_writes_on": on_steady_writes,
+        },
+    }
+
+
 class _LatencyKube:
     """Simulated apiserver RTT for the scheduler's client: real control
     planes pay a network round trip per verb, which is exactly the
@@ -1736,6 +1892,88 @@ def bench_chaos() -> dict:
         extras["chaos_rendezvous_timed_out"] = int(
             not ready and waited < 5.0)
 
+    # -- scenario 6: seeded thermal drift + gang straggler telemetry ----
+    # The fleet-telemetry acceptance path end to end: a control-file
+    # telemetry feed ramps one chip's temperature (flapping, so the
+    # QuarantineTracker's transition counting engages) while another
+    # chip idles under busy peers (the gang-straggler profile). Both
+    # must be DETECTED (tpu_dra_anomaly_total moves, Warning Event
+    # lands), the flapper must ESCALATE through quarantine, and the
+    # converged steady-state telemetry republish must stay at ZERO
+    # kube writes.
+    from k8s_dra_driver_gpu_tpu.pkg import fleetstate
+    from tests.fake_kube import CountingKube
+
+    prev_tele = {k: os.environ.get(k) for k in
+                 ("TPU_DRA_TELEMETRY", "TPULIB_MOCK_TELEMETRY")}
+    ring = fleetstate.set_default_ring(fleetstate.TelemetryRing())
+    with tempfile.TemporaryDirectory() as root:
+        ctl = os.path.join(root, "telemetry.ctl")
+
+        def write_feed(hot_temp: float, straggler_duty: float) -> None:
+            with open(ctl, "w", encoding="utf-8") as f:
+                f.write("|".join([
+                    "chip=0,power=117,temp=45,duty=0.92",
+                    f"chip=1,power=117,temp={hot_temp},duty=0.92",
+                    "chip=2,power=117,temp=45,duty=0.92",
+                    f"chip=3,power=117,temp=45,duty={straggler_duty}",
+                ]))
+
+        write_feed(45, 0.92)
+        os.environ["TPU_DRA_TELEMETRY"] = "1"
+        os.environ["TPULIB_MOCK_TELEMETRY"] = "@" + ctl
+        fake = FakeKubeClient()
+        ckube = CountingKube(fake)
+        driver = Driver(Config.mock(root=root, topology="v5e-4"),
+                        ckube, "chaos-node",
+                        metrics=DRARequestMetrics())
+        mon = driver.health_monitor
+        try:
+            driver.publish_resources()
+            # Baseline warmup + the converged zero-write proof.
+            for _ in range(10):
+                driver._on_health_taints(mon.poll_and_reconcile())
+            w0 = ckube.writes
+            for _ in range(3):
+                driver._on_health_taints(mon.poll_and_reconcile())
+            converged_writes = ckube.writes - w0
+            # Flap the drift + straggler through enough cycles that
+            # the quarantine transition threshold trips for both.
+            for _ in range(4):
+                write_feed(95, 0.1)
+                driver._on_health_taints(mon.poll_and_reconcile())
+                write_feed(45, 0.92)
+                driver._on_health_taints(mon.poll_and_reconcile())
+            quarantined_now = set(mon.quarantine.quarantined)
+            tele_text = generate_latest(
+                driver.metrics.registry).decode()
+            events = fake.list("", "v1", "events", namespace="default")
+            anomaly_events = [e for e in events
+                              if e.get("reason") == "TelemetryAnomaly"]
+            extras.update({
+                "chaos_telemetry_converged_writes": converged_writes,
+                "chaos_anomaly_thermal_detected": int(
+                    'tpu_dra_anomaly_total{kind="thermal_drift"}'
+                    in tele_text),
+                "chaos_anomaly_straggler_detected": int(
+                    'tpu_dra_anomaly_total{kind="duty_cycle_'
+                    'straggler"}' in tele_text),
+                "chaos_anomaly_events": len(anomaly_events),
+                # BOTH seeded escalation paths must trip: the thermal
+                # flapper (chip-1) AND the straggler (chip-3).
+                "chaos_anomaly_quarantined": int(
+                    {"chip-1", "chip-3"} <= quarantined_now),
+                "chaos_telemetry_ring_samples": ring.recorded_total,
+            })
+        finally:
+            driver.stop()
+            for k, v in prev_tele.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            fleetstate.set_default_ring(fleetstate.TelemetryRing())
+
     exposition = generate_latest(resilience.registry).decode()
     extras["chaos_metrics_exported"] = int(
         'tpu_dra_retry_total{verb="get"}' in exposition
@@ -1743,7 +1981,16 @@ def bench_chaos() -> dict:
         and "tpu_dra_quarantine_total" in exposition)
 
     stuck = (stuck_claims + leaked_leases + leaked_subslices
-             + (0 if extras["chaos_rendezvous_timed_out"] else 1))
+             + (0 if extras["chaos_rendezvous_timed_out"] else 1)
+             # Telemetry acceptance (scenario 6): an undetected
+             # seeded anomaly, a missed quarantine escalation, a
+             # missing Warning Event, or a non-converged telemetry
+             # republish all count as stuck.
+             + (0 if extras["chaos_anomaly_thermal_detected"] else 1)
+             + (0 if extras["chaos_anomaly_straggler_detected"] else 1)
+             + (0 if extras["chaos_anomaly_quarantined"] else 1)
+             + (0 if extras["chaos_anomaly_events"] else 1)
+             + extras["chaos_telemetry_converged_writes"])
     total = extras["chaos_claims_total"]
     prepared_or_clean = total - stuck_claims
     return {
@@ -2557,6 +2804,13 @@ def _sched_json_path() -> str:
                      "BENCH_scheduler.json"))
 
 
+def _obs_json_path() -> str:
+    return os.environ.get(
+        "BENCH_OBS_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_observability.json"))
+
+
 def _load_sched_json(path: str) -> dict:
     try:
         with open(path, encoding="utf-8") as f:
@@ -2598,14 +2852,58 @@ def _dispatch() -> None:
     if "--placement-sim" in sys.argv[1:]:
         print(json.dumps(bench_placement_sim()))
         return
+    if "--telemetry-overhead" in sys.argv[1:]:
+        result = bench_telemetry_overhead()
+        out_path = _obs_json_path()
+        doc = _load_sched_json(out_path)  # same tolerant loader
+        doc["telemetry"] = result
+        if "metric" not in doc:
+            doc["metric"] = result["metric"]
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(json.dumps(result))
+        # CI gate (`make bench-telemetry-smoke`): the always-on
+        # telemetry station must stay inside the overhead envelope,
+        # the master knob must gate sampling both ways, and the
+        # converged steady-state republish must cost zero kube writes.
+        ex = result["extras"]
+        ok = True
+        cap = _env_float("BENCH_TELEMETRY_MAX_OVERHEAD_PCT", 5.0)
+        if cap and result["value"] > cap:
+            print(f"telemetry-overhead gate failed: {result['value']}% "
+                  f"> {cap}%", file=sys.stderr)
+            ok = False
+        if ex["telemetry_ring_samples_on"] <= 0:
+            print("telemetry-overhead gate failed: telemetry on "
+                  "recorded zero ring samples (the station is not "
+                  "actually wired)", file=sys.stderr)
+            ok = False
+        if ex["telemetry_ring_samples_off"] > 0:
+            print("telemetry-overhead gate failed: TPU_DRA_TELEMETRY=0 "
+                  f"still recorded {ex['telemetry_ring_samples_off']} "
+                  "samples", file=sys.stderr)
+            ok = False
+        if ex["telemetry_steady_writes_on"] > 0:
+            print("telemetry-overhead gate failed: converged telemetry "
+                  f"republish cost {ex['telemetry_steady_writes_on']} "
+                  "kube writes (must be zero)", file=sys.stderr)
+            ok = False
+        if not ok:
+            sys.exit(1)
+        return
     if "--trace-overhead" in sys.argv[1:]:
         result = bench_trace_overhead()
-        out_path = os.environ.get(
-            "BENCH_OBS_OUT",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "BENCH_observability.json"))
+        out_path = _obs_json_path()
+        # The trace result is the document root; a previously-written
+        # "telemetry" trajectory entry survives the rewrite.
+        doc = _load_sched_json(out_path)
+        telemetry_entry = doc.get("telemetry")
+        doc = dict(result)
+        if telemetry_entry is not None:
+            doc["telemetry"] = telemetry_entry
         with open(out_path, "w", encoding="utf-8") as f:
-            json.dump(result, f, indent=1, sort_keys=True)
+            json.dump(doc, f, indent=1, sort_keys=True)
             f.write("\n")
         print(json.dumps(result))
         # CI gate (`make bench-trace-smoke`): sampled tracing must stay

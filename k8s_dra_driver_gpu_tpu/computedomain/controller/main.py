@@ -60,9 +60,13 @@ def run(argv: list[str] | None = None) -> int:
     os.environ["V"] = str(args.verbosity)
 
     metrics = ComputeDomainMetrics()
-    from ...pkg.metrics import ResilienceMetrics  # noqa: PLC0415
+    from ...pkg.metrics import (  # noqa: PLC0415
+        ResilienceMetrics,
+        register_build_info,
+    )
     from ...pkg.retry import RetryingKubeClient  # noqa: PLC0415
 
+    register_build_info(metrics.registry)
     resilience = ResilienceMetrics(registry=metrics.registry)
     kube = RetryingKubeClient(
         FakeKubeClient() if args.standalone else KubeClient(

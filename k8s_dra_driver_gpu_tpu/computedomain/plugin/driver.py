@@ -199,6 +199,13 @@ class CDDriver:
             uid, self.retry_timeout, cause, flight.dump(uid),
         )
         self._gang_abort_event(uid, ref, cause)
+        # Incident bundle (pkg/doctor, TPU_DRA_DOCTOR_DIR-gated,
+        # rate-limited): a gang abort is exactly the moment the
+        # bounded rings hold the evidence -- snapshot them before the
+        # retry churn ages them out. Never blocks or fails the unwind.
+        from ...pkg import doctor  # noqa: PLC0415
+
+        doctor.auto_bundle("gang-abort", claim=uid)
         try:
             self.state.unwind_failed_prepare(uid)
         except Exception:  # noqa: BLE001 - best-effort unwind

@@ -15,7 +15,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from . import DRIVER_NAME
-from ..pkg import flightrecorder, tracing
+from ..pkg import fleetstate, flightrecorder, tracing
+from ..pkg.events import emit_warning_event
 from ..pkg.kubeclient import NotFoundError
 from ..pkg.metrics import DRARequestMetrics
 from ..pkg.partition.profiles import TenantProfileStore
@@ -27,11 +28,30 @@ from .deviceinfo import DeviceKind
 from .health import ChipHealthMonitor, DeviceTaint
 from .partitions import consumed_counters, shared_counter_sets
 from .reconcile import NodeStateReconciler
+from .subslice import chip_name
 
 logger = logging.getLogger(__name__)
 
 RESOURCE_GROUP = "resource.k8s.io"
 RESOURCE_VERSION = "v1"
+
+# Telemetry slice-attribute quantization: raw power/thermal wiggles
+# every poll, so publishing raw values would turn the zero-write
+# converged republish into a per-poll slice rewrite. Quantized to
+# these steps, steady-state telemetry hashes identically and the
+# content-hash diff short-circuits to zero kube calls; a real shift
+# (a chip heating 5C, a node picking up 10W) still lands within one
+# poll. TPU_DRA_TELEMETRY_ATTRS=0 disables attribute publication
+# entirely (the ring/metrics/anomaly stations keep running).
+TELEMETRY_POWER_STEP_W = 10
+TELEMETRY_TEMP_STEP_C = 5
+TELEMETRY_DUTY_STEP_PCT = 10
+TELEMETRY_HBM_STEP_PCT = 10
+# The cumulative ICI error counter is quantized too: a chronic 1-per-
+# poll trickle (below the anomaly burst threshold) must not turn into
+# one slice write per poll. Error-rate detail lives in the metrics
+# counter + anomaly taints; the attribute is the coarse fleet signal.
+TELEMETRY_ICI_STEP = 100
 
 
 class Driver:
@@ -65,6 +85,14 @@ class Driver:
         # ckpt_fsync_wait, ...) through the request-metrics registry.
         self.state.segment_observer = self.metrics.observe_segments
         self._taints: dict[str, list[dict]] = {}
+        # Quantized per-device telemetry attributes merged into the
+        # published slices (the scheduler's FleetAggregator folds
+        # them); see the TELEMETRY_*_STEP constants above.
+        self._telemetry_attrs: dict[str, dict] = {}
+        self._telemetry_attrs_enabled = (
+            fleetstate.telemetry_enabled()
+            and os.environ.get("TPU_DRA_TELEMETRY_ATTRS", "1")
+            not in ("0", "false", "False"))
         # Publication modes mirror the reference's three
         # (driver.go:190,574): "legacy" (pre-partitionable-devices
         # servers: one slice, whole chips only), "combined" (one slice,
@@ -149,6 +177,14 @@ class Driver:
                 quarantine=QuarantineTracker(
                     on_quarantine=on_quarantine, on_failed=on_failed),
                 on_tenant_usage=self._on_tenant_usage,
+                # Fleet telemetry station: samples land in the
+                # process ring (/debug/telemetry), anomaly episodes
+                # come back through _on_anomaly, and per-poll samples
+                # through _on_chip_telemetry (gauges + quantized slice
+                # attributes).
+                telemetry_ring=fleetstate.default_ring(),
+                on_chip_telemetry=self._on_chip_telemetry,
+                on_anomaly=self._on_anomaly,
             )
         else:
             # Health monitoring off: mark every chip observably
@@ -330,6 +366,9 @@ class Driver:
             taints = self._taints.get(name)
             if taints:
                 entry["taints"] = taints
+            tele = self._telemetry_attrs.get(name)
+            if tele:
+                entry.setdefault("attributes", {}).update(tele)
             if not legacy:
                 entry["consumesCounters"] = consumed_counters(dev, host)
             if dev.kind == DeviceKind.CHIP:
@@ -426,6 +465,14 @@ class Driver:
             new.setdefault(t.device, []).append(t.to_dict())
         self._taints = new
         self.metrics.set_taints(taints)
+        self._republish_reconciled()
+
+    def _republish_reconciled(self) -> None:
+        """Republish through the content-hash short-circuit: ZERO kube
+        calls (no list, no writes) when the generated slices hash to
+        what was last published and the memo is fresh. Shared by the
+        health-taint and telemetry-attribute reconcile paths -- both
+        arrive once per poll with, in steady state, nothing changed."""
         slices = self.generate_resource_slices()
         hashes = self._slice_hashes(slices)
         fresh = (time.monotonic() - self._published_verified_at
@@ -446,3 +493,84 @@ class Driver:
             self._published_verified_at = time.monotonic()
         except Exception:  # noqa: BLE001 - known reference gap: no retry
             logger.exception("republish after health event failed")
+
+    # -- fleet telemetry ------------------------------------------------------
+
+    def _on_chip_telemetry(self, samples) -> None:
+        """Health-poll telemetry -> per-chip gauges + quantized slice
+        attributes. Quantization (TELEMETRY_*_STEP) keeps steady-state
+        samples hashing identically, so the republish below
+        short-circuits to zero kube calls until a signal actually
+        moves a step."""
+        hbm_cap = max(self.state.host.hbm_bytes_per_chip, 1)
+        attrs: dict[str, dict] = {}
+        self.metrics.telemetry.prune_absent(s.chip for s in samples)
+        for s in samples:
+            self.metrics.telemetry.observe_sample(s)
+            name = chip_name(s.chip)
+            if name not in self.state.allocatable:
+                continue
+
+            def q(val: float, step: int) -> int:
+                return int(round(float(val) / step) * step)
+
+            attrs[name] = {
+                fleetstate.ATTR_POWER: {
+                    "int": q(s.power_watts, TELEMETRY_POWER_STEP_W)},
+                fleetstate.ATTR_TEMP: {
+                    "int": q(s.temp_celsius, TELEMETRY_TEMP_STEP_C)},
+                fleetstate.ATTR_DUTY: {
+                    "int": q(s.duty_cycle * 100,
+                             TELEMETRY_DUTY_STEP_PCT)},
+                fleetstate.ATTR_HBM: {
+                    "int": q(s.hbm_used_bytes * 100 / hbm_cap,
+                             TELEMETRY_HBM_STEP_PCT)},
+                fleetstate.ATTR_ICI_ERR: {
+                    "int": q(s.ici_link_errors, TELEMETRY_ICI_STEP)},
+            }
+        if not self._telemetry_attrs_enabled:
+            return
+        # REPLACE semantics: a chip absent from this sample set (its
+        # sensor path died) drops its attributes instead of publishing
+        # a frozen-but-plausible last reading forever.
+        if attrs == self._telemetry_attrs:
+            # Quantization held every value in place: the slice spec
+            # cannot have changed, so skip even the generate+hash.
+            # (This dict compare IS the telemetry steady state -- the
+            # <=5% overhead gate depends on it. Externally mutated
+            # slices still self-heal via the health path's periodic
+            # TPU_DRA_PUBLISH_RECHECK_S live recheck.)
+            return
+        self._telemetry_attrs = attrs
+        self._republish_reconciled()
+
+    def _on_anomaly(self, detections) -> None:
+        """Anomaly episode rising edges -> counter + flight record +
+        deduped Warning Event on the Node. The quarantine escalation
+        needs no wiring here: the detector's taints ride the health
+        poll's taint list straight into the QuarantineTracker."""
+        for det in detections:
+            self.metrics.telemetry.inc_anomaly(det.kind)
+            flightrecorder.default().record(
+                det.device, "anomaly", kind=det.kind,
+                node=self.node_name, **det.detail)
+            logger.warning(
+                "telemetry anomaly on %s/%s: %s %s", self.node_name,
+                det.device, det.kind, det.detail)
+            emit_warning_event(
+                self.kube,
+                # Deterministic name = create-once per (node, device,
+                # kind): a repeat episode of the same anomaly hits 409
+                # and is swallowed.
+                event_name=(f"{self.node_name}.{det.device}."
+                            f"{det.kind.replace('_', '-')}"),
+                namespace="default",
+                reason="TelemetryAnomaly",
+                message=(
+                    f"{det.kind} detected on {det.device} "
+                    f"(node {self.node_name}): {det.detail}; "
+                    "time-series at /debug/telemetry on the node "
+                    "plugin, bundle via python -m "
+                    "k8s_dra_driver_gpu_tpu.pkg.doctor"),
+                involved_kind="Node", involved_name=self.node_name,
+                component="tpu-dra-kubelet-plugin")

@@ -30,7 +30,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable
 
-from ..pkg import faults
+from ..pkg import anomaly as anomaly_mod
+from ..pkg import faults, fleetstate
 from ..tpulib.binding import EnumerateOptions, HealthEvent
 from .subslice import chip_name
 
@@ -294,6 +295,10 @@ class ChipHealthMonitor:
         quarantine: QuarantineTracker | None = None,
         on_quarantine: Callable[[str], None] | None = None,
         on_tenant_usage: Callable[[tuple], None] | None = None,
+        telemetry_ring=None,  # pkg.fleetstate.TelemetryRing | None
+        anomaly_detector=None,  # pkg.anomaly.AnomalyDetector | None
+        on_chip_telemetry: Callable[[tuple], None] | None = None,
+        on_anomaly: Callable[[list], None] | None = None,
     ):
         self._tpulib = tpulib
         self._opts = opts
@@ -304,6 +309,24 @@ class ChipHealthMonitor:
         # sizing input). None = telemetry off; a tpulib without the
         # seam degrades to no samples.
         self._on_tenant_usage = on_tenant_usage
+        # Fleet telemetry (tpulib.chip_telemetry, the node-collector
+        # half of the telemetry plane): per-chip power/thermal/HBM/
+        # duty samples ride the SAME poll cadence, land in the bounded
+        # ring served at /debug/telemetry, run through the anomaly
+        # detectors, and reach the driver via on_chip_telemetry
+        # (metric gauges + quantized slice attributes) / on_anomaly
+        # (Warning Events + counters + flight records). Anomaly taints
+        # feed the quarantine tracker exactly like raw health events.
+        # TPU_DRA_TELEMETRY=0 turns the whole station off.
+        self._telemetry_enabled = fleetstate.telemetry_enabled()
+        self.telemetry_ring = telemetry_ring
+        self.anomaly = anomaly_detector
+        if self._telemetry_enabled and self.anomaly is None and \
+                (on_anomaly is not None or telemetry_ring is not None):
+            self.anomaly = anomaly_mod.AnomalyDetector(
+                chip_name=chip_name)
+        self._on_chip_telemetry = on_chip_telemetry
+        self._on_anomaly = on_anomaly
         self._ignored = frozenset(ignored_kinds) | frozenset(additional_ignored)
         self._interval = poll_interval
         self._stop = threading.Event()
@@ -335,10 +358,54 @@ class ChipHealthMonitor:
         return taints
 
     def poll_and_reconcile(self) -> list[DeviceTaint]:
-        """One poll + quarantine pass: the merged taint list the
-        callback sees (also the direct-drive entry for tests/bench)."""
+        """One poll + telemetry sample + quarantine pass: the merged
+        taint list the callback sees (also the direct-drive entry for
+        tests/bench). Anomaly taints (non-fatal, observe-only) merge
+        BEFORE the quarantine pass, so a flapping anomaly escalates
+        through the same transition counting as a flapping health
+        event."""
         taints = self.poll_once()
+        try:
+            # Telemetry must never poison the health poll: a broken
+            # seam only loses samples (and their anomaly taints).
+            self.sample_chip_telemetry()
+        except Exception:  # noqa: BLE001 - telemetry best-effort
+            logger.exception("chip-telemetry sample failed")
+        if self.anomaly is not None:
+            taints = taints + self.anomaly.taints(
+                DeviceTaint, TAINT_KEY_PREFIX)
         return taints + self.quarantine.observe(taints)
+
+    def sample_chip_telemetry(self) -> tuple:
+        """One per-chip telemetry sample through the tpulib seam:
+        ring append, anomaly fold, consumer callbacks. Returns the
+        samples (also the direct-drive entry for tests/bench). A
+        tpulib predating the seam, TPU_DRA_TELEMETRY=0, or no wiring
+        at all is a no-op."""
+        if not self._telemetry_enabled:
+            return ()
+        fn = getattr(self._tpulib, "chip_telemetry", None)
+        if fn is None:
+            return ()
+        if self.telemetry_ring is None and self.anomaly is None and \
+                self._on_chip_telemetry is None:
+            return ()
+        samples = tuple(fn(self._opts) or ())
+        if self.telemetry_ring is not None:
+            for s in samples:
+                self.telemetry_ring.record_sample(s)
+        if self.anomaly is not None:
+            detections = self.anomaly.observe(samples)
+            if detections and self._on_anomaly is not None:
+                try:
+                    self._on_anomaly(detections)
+                except Exception:  # noqa: BLE001 - consumer hook
+                    logger.exception("anomaly hook failed")
+        if self._on_chip_telemetry is not None:
+            # Delivered even when EMPTY: the consumer drops stale
+            # slice attributes for chips that stopped reporting.
+            self._on_chip_telemetry(samples)
+        return samples
 
     def sample_telemetry(self) -> tuple:
         """One per-tenant usage sample through the tpulib seam,

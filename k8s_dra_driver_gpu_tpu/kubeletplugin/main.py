@@ -165,7 +165,10 @@ def run(argv: list[str] | None = None) -> int:
     from ..pkg.metrics import (  # noqa: PLC0415
         RecoveryMetrics,
         ResilienceMetrics,
+        register_build_info,
     )
+
+    register_build_info(metrics.registry, gates)
     from ..pkg.retry import RetryingKubeClient  # noqa: PLC0415
 
     resilience = ResilienceMetrics(registry=metrics.registry)

@@ -95,6 +95,14 @@ RULES: dict[str, str] = {
                  "(never exported, wrong parent for everything after "
                  "it on the thread) -- use tracing.span(...) / "
                  "FlightRecorder.record(...)",
+    "TPUDRA013": "telemetry ring / fleet-aggregator mutation outside "
+                 "the telemetry layer: record_sample(...) / fold_*(...) "
+                 "calls are fenced to pkg/fleetstate.py, pkg/anomaly.py "
+                 "and kubeletplugin/health.py -- every other producer "
+                 "goes through the health-poll sampling seam or the "
+                 "public FleetAggregator.observe_pass entry, so the "
+                 "bounded time-series can't be corrupted (or "
+                 "double-fed) from a random call site",
 }
 
 # Lock model (docs/architecture.md "Locking hierarchy"). Matched on the
@@ -146,6 +154,16 @@ _CARVEOUT_REL_SUFFIXES = ("pkg/partition/engine.py",)
 _SPAN_CTOR_FILES = {"tracing.py", "lint.py"}
 _START_SPAN_FILES = {"tracing.py", "timing.py", "lint.py"}
 _FLIGHT_EVENT_FILES = {"flightrecorder.py", "lint.py"}
+# TPUDRA013 scope: the telemetry layer's definition sites. The ring /
+# aggregator mutation methods are deliberately named record_sample /
+# fold_* in pkg/fleetstate.py so the textual match is unambiguous;
+# kubeletplugin/health.py is the ONE sanctioned producer (the
+# health-poll sampling seam) and pkg/anomaly.py folds its own detector
+# state. Rel-path suffixes, not basenames (the TPUDRA011 lesson): a
+# stray future health.py elsewhere gets no pass.
+_TELEMETRY_MUT_SUFFIXES = ("pkg/fleetstate.py", "pkg/anomaly.py",
+                           "kubeletplugin/health.py",
+                           "analysis/lint.py")
 # Resources the scheduler watches (mirror of
 # pkg/schedcache.WATCHED_RESOURCES, kept literal so the linter has no
 # runtime import of the code under analysis).
@@ -722,6 +740,25 @@ class _ModuleLinter(ast.NodeVisitor):
         if isinstance(func, ast.Attribute):
             attr = func.attr
             base_src = _unparse(func.value)
+
+            # TPUDRA013: telemetry ring / fleet-aggregator mutation
+            # outside the telemetry layer. The mutating surface is the
+            # distinctively-named record_sample / fold_* methods
+            # (pkg/fleetstate.py); everyone else uses the read surface
+            # or FleetAggregator.observe_pass.
+            if (attr == "record_sample" or attr.startswith("fold_")) \
+                    and not any(
+                        self.rel.replace(os.sep, "/").endswith(sfx)
+                        for sfx in _TELEMETRY_MUT_SUFFIXES):
+                self._emit(
+                    "TPUDRA013", node,
+                    f"telemetry state mutation {base_src}.{attr}(...) "
+                    "outside pkg/fleetstate.py / pkg/anomaly.py / "
+                    "kubeletplugin/health.py: feed samples through the "
+                    "health-poll seam (ChipHealthMonitor) or fold "
+                    "through FleetAggregator.observe_pass",
+                    key=f"{base_src}.{attr}",
+                )
 
             # TPUDRA011: carve-out registry mutation outside the
             # partition engine / DeviceState. The registry attribute is

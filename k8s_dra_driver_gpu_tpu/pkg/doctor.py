@@ -1,0 +1,359 @@
+"""One-command diagnostics bundles: ``python -m ...pkg.doctor``.
+
+Debugging the driver used to mean hand-scraping four binaries'
+``/metrics`` and ``/debug/*`` endpoints before the evidence aged out
+of the bounded rings. The doctor crawls every binary's full
+introspection surface -- ``/metrics``, ``/debug/traces``,
+``/debug/claims`` (plus each claim's timeline), ``/debug/stacks``,
+``/debug/telemetry``, ``/debug/fleet`` -- into ONE timestamped
+``.tar.gz`` incident bundle, together with a correlated per-claim
+report that merges the flight-recorder timelines of all binaries into
+one ordered story per claim.
+
+CLI::
+
+    python -m k8s_dra_driver_gpu_tpu.pkg.doctor \\
+        scheduler=http://127.0.0.1:9090 \\
+        plugin=http://127.0.0.1:9091 \\
+        cd-plugin=http://127.0.0.1:9092 \\
+        --out-dir /tmp --claim default/my-claim
+
+Automatic bundles: the gang-abort (computedomain/plugin/driver.py) and
+eviction-deadline (pkg/recovery.py) failure paths call
+:func:`auto_bundle` -- when ``TPU_DRA_DOCTOR_DIR`` is set, the
+triggering binary drops a bundle of its OWN in-process surfaces (no
+HTTP round trip; the rings live in this process) plus any peers listed
+in ``TPU_DRA_DOCTOR_ENDPOINTS`` (``name=url,name=url``). Rate-limited
+to one bundle per ``TPU_DRA_DOCTOR_MIN_INTERVAL_S`` (default 300s) so
+a failure storm can't fill the disk, and ALWAYS best-effort: a doctor
+failure never fails the operation that triggered it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import logging
+import os
+import tarfile
+import threading
+import time
+import urllib.request
+
+logger = logging.getLogger(__name__)
+
+ENV_DOCTOR_DIR = "TPU_DRA_DOCTOR_DIR"
+ENV_DOCTOR_ENDPOINTS = "TPU_DRA_DOCTOR_ENDPOINTS"
+ENV_DOCTOR_MIN_INTERVAL = "TPU_DRA_DOCTOR_MIN_INTERVAL_S"
+
+#: The introspection surface crawled per target, in crawl order.
+SURFACE_PATHS = (
+    "metrics",
+    "debug/traces",
+    "debug/claims",
+    "debug/stacks",
+    "debug/telemetry",
+    "debug/fleet",
+)
+
+#: Per-claim timelines fetched at most for this many claim keys (a
+#: huge ring should fatten the bundle, not hang the crawl).
+MAX_CLAIM_FETCH = 200
+
+_FETCH_TIMEOUT_S = 3.0
+
+
+def _fetch(url: str) -> tuple[bytes, str]:
+    """GET one URL; returns (body, error) with exactly one non-empty."""
+    try:
+        with urllib.request.urlopen(url, timeout=_FETCH_TIMEOUT_S) as r:
+            return r.read(), ""
+    except Exception as e:  # noqa: BLE001 - crawl must finish
+        return b"", f"{type(e).__name__}: {e}"
+
+
+def _member(tar: tarfile.TarFile, name: str, body: bytes,
+            mtime: float) -> None:
+    info = tarfile.TarInfo(name=name)
+    info.size = len(body)
+    info.mtime = int(mtime)
+    tar.addfile(info, io.BytesIO(body))
+
+
+def _suffix(path: str) -> str:
+    return ".txt" if path in ("metrics", "debug/stacks") else ".json"
+
+
+def crawl_target(name: str, base_url: str) -> dict:
+    """Crawl one binary's surface; returns
+    ``{path: {"body": bytes} | {"error": str}}``."""
+    base = base_url.rstrip("/")
+    out: dict[str, dict] = {}
+    for path in SURFACE_PATHS:
+        body, err = _fetch(f"{base}/{path}")
+        out[path] = {"error": err} if err else {"body": body}
+    # Per-claim timelines: expand the /debug/claims index.
+    claims_doc = out.get("debug/claims", {})
+    keys: list[str] = []
+    if "body" in claims_doc:
+        try:
+            keys = list(json.loads(claims_doc["body"]).get(
+                "claims", []))[:MAX_CLAIM_FETCH]
+        except (ValueError, AttributeError):
+            keys = []
+    for key in keys:
+        body, err = _fetch(f"{base}/debug/claims/{key}")
+        out[f"debug/claims/{key}"] = (
+            {"error": err} if err else {"body": body})
+    return out
+
+
+def _correlate(crawls: dict[str, dict]) -> dict:
+    """Merge every target's per-claim flight timelines into one
+    ordered, source-tagged story per claim -- the report half the
+    operator reads first."""
+    claims: dict[str, list[dict]] = {}
+    traces: dict[str, int] = {}
+    anomalies: dict[str, float] = {}
+    for target, surface in crawls.items():
+        for path, doc in surface.items():
+            if "body" not in doc:
+                continue
+            if path.startswith("debug/claims/"):
+                try:
+                    payload = json.loads(doc["body"])
+                except ValueError:
+                    continue
+                key = payload.get("key", path.rsplit("/", 1)[-1])
+                for ev in payload.get("events", []):
+                    claims.setdefault(key, []).append(
+                        {**ev, "source": target})
+            elif path == "debug/traces":
+                try:
+                    payload = json.loads(doc["body"])
+                except ValueError:
+                    continue
+                for tid, spans in (payload.get("traces") or {}).items():
+                    traces[tid] = traces.get(tid, 0) + len(spans)
+            elif path == "metrics":
+                for line in doc["body"].decode(
+                        "utf-8", "replace").splitlines():
+                    if line.startswith("tpu_dra_anomaly_total{"):
+                        try:
+                            label, val = line.rsplit(" ", 1)
+                            anomalies[label] = (anomalies.get(label, 0)
+                                                + float(val))
+                        except ValueError:
+                            pass
+    for events in claims.values():
+        events.sort(key=lambda ev: ev.get("ts", 0.0))
+    return {
+        "claims": claims,
+        "trace_span_counts": traces,
+        "anomaly_counters": anomalies,
+    }
+
+
+def bundle_path(out_dir: str, trigger: str,
+                now: float | None = None) -> str:
+    stamp = time.strftime("%Y%m%d-%H%M%S",
+                          time.gmtime(now if now is not None
+                                      else time.time()))
+    return os.path.join(
+        out_dir, f"tpu-dra-doctor-{stamp}-{trigger}.tar.gz")
+
+
+def collect_bundle(targets: dict[str, str], out_dir: str = ".",
+                   claim: str = "", trigger: str = "manual",
+                   extra_members: dict[str, bytes] | None = None,
+                   out_path: str | None = None) -> str:
+    """Crawl ``targets`` (name -> base URL) and write the bundle;
+    returns its path. ``claim`` focuses the report on one claim key
+    (everything is still collected). ``extra_members`` lets the
+    in-process auto-bundle path add local dumps without a listener;
+    ``out_path`` pins the destination (the async auto-bundle computes
+    it up front so it can be reported before the crawl finishes)."""
+    now = time.time()
+    if out_path is None:
+        out_path = bundle_path(out_dir, trigger, now)
+    crawls = {name: crawl_target(name, url)
+              for name, url in targets.items()}
+    report = _correlate(crawls)
+    if claim:
+        focused = {k: v for k, v in report["claims"].items()
+                   if claim in (k,) or claim in k}
+        report["focus_claim"] = claim
+        report["focus_events"] = focused
+    manifest = {
+        "created": now,
+        "trigger": trigger,
+        "targets": dict(targets),
+        "surface_paths": list(SURFACE_PATHS),
+        "errors": {
+            f"{t}/{p}": doc["error"]
+            for t, surface in crawls.items()
+            for p, doc in surface.items() if doc.get("error")
+        },
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    with tarfile.open(out_path, "w:gz") as tar:
+        for target, surface in crawls.items():
+            for path, doc in surface.items():
+                if "body" not in doc:
+                    continue
+                member = f"{target}/{path}{_suffix(path)}" \
+                    if not path.startswith("debug/claims/") \
+                    else f"{target}/{path}.json"
+                _member(tar, member, doc["body"], now)
+        for name, body in (extra_members or {}).items():
+            _member(tar, name, body, now)
+        _member(tar, "report.json",
+                json.dumps(report, sort_keys=True, indent=1).encode(),
+                now)
+        _member(tar, "manifest.json",
+                json.dumps(manifest, sort_keys=True, indent=1).encode(),
+                now)
+    logger.warning("doctor bundle written: %s (%d target(s), %d "
+                   "fetch error(s))", out_path, len(targets),
+                   len(manifest["errors"]))
+    return out_path
+
+
+# -- automatic incident bundles -----------------------------------------------
+
+_auto_lock = threading.Lock()
+_auto_last = 0.0
+
+
+def _local_surface() -> dict[str, bytes]:
+    """This process's own introspection surfaces, dumped without HTTP
+    (the triggering binary IS one of the targets, and its listener may
+    be disabled)."""
+    from . import fleetstate, flightrecorder, tracing  # noqa: PLC0415
+    from .debug import debug_stacks_endpoint  # noqa: PLC0415
+
+    out: dict[str, bytes] = {}
+    try:
+        out["local/debug/traces.json"] = json.dumps(
+            {"traces": tracing.exporter().traces()},
+            sort_keys=True).encode()
+    except Exception:  # noqa: BLE001 - every dump is best-effort
+        pass
+    try:
+        out["local/debug/claims.json"] = json.dumps(
+            {"events": flightrecorder.default().events()},
+            sort_keys=True).encode()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        out["local/debug/stacks.txt"] = debug_stacks_endpoint()[2]
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        out["local/debug/telemetry.json"] = json.dumps(
+            fleetstate.default_ring().snapshot(),
+            sort_keys=True).encode()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        out["local/debug/fleet.json"] = json.dumps(
+            fleetstate.default_fleet().snapshot(),
+            sort_keys=True).encode()
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+def _parse_endpoints(raw: str) -> dict[str, str]:
+    out = {}
+    for item in filter(None, (t.strip() for t in raw.split(","))):
+        name, _, url = item.partition("=")
+        if name and url:
+            out[name.strip()] = url.strip()
+    return out
+
+
+def auto_bundle(trigger: str, claim: str = "",
+                env=os.environ) -> str | None:
+    """Drop an incident bundle for a failure path (gang abort,
+    eviction deadline). No-op unless ``TPU_DRA_DOCTOR_DIR`` is set;
+    rate-limited; NEVER raises or blocks -- the local in-process
+    surfaces are snapshotted synchronously (the evidence that ages
+    out of the rings), but the remote-peer crawl + tar write run on a
+    daemon thread: during exactly the incident the bundle is for, the
+    peers are the slow thing, and the triggering unwind must not wait
+    out their fetch timeouts. Returns the bundle's (eventual) path."""
+    global _auto_last
+    out_dir = env.get(ENV_DOCTOR_DIR, "")
+    if not out_dir:
+        return None
+    try:
+        min_interval = float(env.get(ENV_DOCTOR_MIN_INTERVAL, "300"))
+    except ValueError:
+        min_interval = 300.0
+    with _auto_lock:
+        now = time.monotonic()
+        if _auto_last and now - _auto_last < min_interval:
+            return None
+        _auto_last = now
+    try:
+        os.makedirs(out_dir, exist_ok=True)  # fail HERE, not async
+        targets = _parse_endpoints(env.get(ENV_DOCTOR_ENDPOINTS, ""))
+        # Snapshot the bounded rings NOW, before the triggering
+        # operation's own retry churn ages the evidence out.
+        extra = _local_surface()
+        out_path = bundle_path(out_dir, trigger)
+
+        def write() -> None:
+            try:
+                collect_bundle(targets, out_dir=out_dir, claim=claim,
+                               trigger=trigger, extra_members=extra,
+                               out_path=out_path)
+            except Exception:  # noqa: BLE001 - diagnostics
+                logger.exception("auto doctor bundle failed "
+                                 "(trigger=%s)", trigger)
+
+        threading.Thread(target=write, name="doctor-bundle",
+                         daemon=True).start()
+        return out_path
+    except Exception:  # noqa: BLE001 - diagnostics must never hurt
+        logger.exception("auto doctor bundle failed (trigger=%s)",
+                         trigger)
+        return None
+
+
+def reset_rate_limit() -> None:
+    """Tests: allow the next auto_bundle immediately."""
+    global _auto_last
+    with _auto_lock:
+        _auto_last = 0.0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m k8s_dra_driver_gpu_tpu.pkg.doctor",
+        description="Collect a tpu-dra diagnostics bundle from the "
+                    "binaries' metrics/debug endpoints.")
+    p.add_argument("targets", nargs="+",
+                   help="name=base-url pairs, e.g. "
+                        "scheduler=http://127.0.0.1:9090")
+    p.add_argument("--out-dir", default=".",
+                   help="directory for the bundle (default: .)")
+    p.add_argument("--claim", default="",
+                   help="claim key (uid or ns/name) to focus the "
+                        "correlated report on")
+    args = p.parse_args(argv)
+    targets = _parse_endpoints(",".join(args.targets))
+    if not targets:
+        p.error("no valid name=url targets")
+    path = collect_bundle(targets, out_dir=args.out_dir,
+                          claim=args.claim)
+    print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,353 @@
+"""Fleet telemetry state: the per-chip telemetry ring (node side) and
+the fleet aggregator (scheduler side).
+
+The telemetry plane has three stations; this module owns the state at
+both ends:
+
+- **TelemetryRing** (every node plugin): a compact rolling in-memory
+  ring of per-chip power/thermal/HBM/duty-cycle samples fed by the
+  health-poll loop (kubeletplugin/health.py sampling the
+  ``tpulib.chip_telemetry`` seam) and served at ``/debug/telemetry``
+  on the plugin's metrics listener. Bounded (``TPU_DRA_TELEMETRY_RING``
+  samples per chip), no external store to deploy.
+- **FleetAggregator** (the scheduler): folds per-node telemetry --
+  published as quantized ResourceSlice device attributes riding the
+  existing content-hash-diffed publish path, so a converged republish
+  stays ZERO kube writes -- together with the scheduler's own
+  ``AllocationState`` and ``pkg/topology`` into fleet time-series:
+  per-pool utilization, ``fragmentation_score`` /
+  ``largest_free_shape`` history, and pending-claim demand vs. free
+  capacity. Exported as ``tpu_dra_fleet_*`` gauges and served as a
+  JSON snapshot at ``/debug/fleet``.
+
+Mutation discipline (lint rule TPUDRA013): ring / aggregator state
+mutations (``record_sample``, ``fold_*``) happen ONLY inside this
+module, pkg/anomaly.py, and kubeletplugin/health.py -- every other
+caller goes through the read surface (``latest``/``series``/
+``snapshot``) or the public fold entry (``observe_pass``), so the
+time-series can never be corrupted from a random call site.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from . import positive_float_env
+from .topology import TorusGrid
+from .topology.score import frag_from_largest, largest_free_shape
+
+#: Samples kept per chip in the node ring (at the default 5s health
+#: poll cadence, 360 samples = 30 minutes of history).
+DEFAULT_RING_SAMPLES = int(positive_float_env(
+    "TPU_DRA_TELEMETRY_RING", default=360, floor=16))
+#: Fleet time-series points kept per pool by the scheduler aggregator.
+DEFAULT_FLEET_HISTORY = int(positive_float_env(
+    "TPU_DRA_FLEET_HISTORY", default=512, floor=16))
+
+#: ResourceSlice attribute names the node plugin publishes (quantized;
+#: see kubeletplugin/driver.py) and the aggregator folds.
+ATTR_POWER = "telemetryPowerWatts"
+ATTR_TEMP = "telemetryTempCelsius"
+ATTR_DUTY = "telemetryDutyPct"
+ATTR_HBM = "telemetryHbmUsedPct"
+ATTR_ICI_ERR = "telemetryIciErrors"
+TELEMETRY_ATTRS = (ATTR_POWER, ATTR_TEMP, ATTR_DUTY, ATTR_HBM,
+                   ATTR_ICI_ERR)
+
+
+class TelemetryRing:
+    """Bounded per-chip ring of telemetry samples (the
+    ``/debug/telemetry`` source on every node plugin)."""
+
+    def __init__(self, samples_per_chip: int = 0):
+        self._lock = threading.Lock()
+        self._maxlen = max(16, int(samples_per_chip
+                                   or DEFAULT_RING_SAMPLES))
+        self._series: dict[int, deque] = {}
+        self.recorded_total = 0
+
+    def record_sample(self, sample) -> None:
+        """Append one ChipTelemetry sample (mutation fenced to the
+        telemetry layer by lint rule TPUDRA013)."""
+        doc = sample.to_dict() if hasattr(sample, "to_dict") else dict(
+            sample)
+        doc["ts"] = time.time()
+        chip = int(doc.get("chip", -1))
+        with self._lock:
+            ring = self._series.get(chip)
+            if ring is None:
+                ring = self._series[chip] = deque(maxlen=self._maxlen)
+            ring.append(doc)
+            self.recorded_total += 1
+
+    def latest(self) -> dict[int, dict]:
+        """Most recent sample per chip."""
+        with self._lock:
+            return {chip: ring[-1] for chip, ring in
+                    self._series.items() if ring}
+
+    def series(self, chip: int) -> list[dict]:
+        """Full retained history for one chip, oldest first."""
+        with self._lock:
+            ring = self._series.get(int(chip))
+            return list(ring) if ring else []
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "samples_per_chip": self._maxlen,
+                "recorded_total": self.recorded_total,
+                "chips": {str(chip): list(ring)
+                          for chip, ring in self._series.items()},
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+    # -- /debug/telemetry endpoint (pkg/httpserver handler signature) ---------
+
+    def telemetry_endpoint(self) -> tuple[int, str, bytes]:
+        body = json.dumps(self.snapshot(), sort_keys=True).encode()
+        return 200, "application/json", body
+
+
+class FleetAggregator:
+    """Scheduler-side fleet state: per-pool utilization / fragmentation
+    time-series plus per-node telemetry folded from published slice
+    attributes.
+
+    ``observe_pass`` is the one public entry (called from the
+    scheduler's full sync pass); everything it learns lands in bounded
+    history rings and the optional duck-typed ``metrics`` sink
+    (pkg.metrics.FleetMetrics). Reads never block a sync: the JSON
+    snapshot is rebuilt from the rings under a short lock.
+    """
+
+    def __init__(self, metrics=None, history: int = 0):
+        self._lock = threading.Lock()
+        self._history = max(16, int(history or DEFAULT_FLEET_HISTORY))
+        self.metrics = metrics
+        # (driver, pool) -> deque of per-pass points
+        self._pools: dict[tuple[str, str], deque] = {}
+        # node -> latest folded telemetry aggregate
+        self._nodes: dict[str, dict] = {}
+        self._pending = 0
+        self._last_pass_ts = 0.0
+        self.passes_total = 0
+        # Labels currently exported through the metrics sink (pruned
+        # when a pool/node leaves the snapshot).
+        self._metric_pools: set[str] = set()
+        self._metric_nodes: set[str] = set()
+
+    # -- the fold (mutations; TPUDRA013 fences callers) -----------------------
+
+    def observe_pass(self, snapshot, alloc, pending_claims: int,
+                     grid_fn=None) -> dict:
+        """Fold one scheduler pass: ``snapshot`` is the
+        InventorySnapshot, ``alloc`` the AllocationState, and
+        ``pending_claims`` the claims still waiting for capacity.
+        ``grid_fn(candidates) -> TorusGrid`` injects the scheduler's
+        grid builder (defaults to TorusGrid.from_devices). Returns the
+        per-pool points folded (tests / the debug endpoint)."""
+        now = time.time()
+        by_pool: dict[tuple[str, str], list] = {}
+        for cand in snapshot.candidates:
+            by_pool.setdefault((cand.driver, cand.pool), []).append(cand)
+        allocated = alloc.allocated if alloc is not None else frozenset()
+        points = {}
+        nodes: dict[str, dict] = {}
+        for key, cands in by_pool.items():
+            total = len(cands)
+            used = sum(1 for c in cands if c.key in allocated)
+            free = [c for c in cands if c.key not in allocated]
+            frag, largest = self._fold_frag(cands, free, grid_fn)
+            points[key] = {
+                "ts": round(now, 3),
+                "total_devices": total,
+                "allocated_devices": used,
+                "free_devices": total - used,
+                "utilization": round(used / total, 4) if total else 0.0,
+                "fragmentation_score": frag,
+                "largest_free_shape": largest,
+            }
+            self._fold_node_telemetry(cands, nodes)
+        self._finalize_nodes(nodes)
+        with self._lock:
+            for key, point in points.items():
+                ring = self._pools.get(key)
+                if ring is None:
+                    ring = self._pools[key] = deque(maxlen=self._history)
+                ring.append(point)
+            # Pools that vanished from the snapshot keep their history
+            # (the ring is the record of what happened); nodes reflect
+            # the CURRENT inventory only.
+            self._nodes = nodes
+            self._pending = int(pending_claims)
+            self._last_pass_ts = now
+            self.passes_total += 1
+        if self.metrics is not None:
+            try:
+                self.metrics.set_pending(int(pending_claims))
+                pool_labels = {f"{driver}/{pool}"
+                               for driver, pool in points}
+                for (driver, pool), point in points.items():
+                    self.metrics.set_pool(
+                        f"{driver}/{pool}", point["utilization"],
+                        point["free_devices"])
+                for node, agg in nodes.items():
+                    self.metrics.set_node(
+                        node, agg.get("power_watts", 0.0),
+                        agg.get("temp_celsius", 0.0))
+                # Pools/nodes gone from THIS pass stop exporting: a
+                # retired pool or dead node must not freeze its last
+                # reading into fleet sums.
+                for label in self._metric_pools - pool_labels:
+                    self.metrics.remove_pool(label)
+                for node in self._metric_nodes - set(nodes):
+                    self.metrics.remove_node(node)
+                self._metric_pools = pool_labels
+                self._metric_nodes = set(nodes)
+            except Exception:  # noqa: BLE001 - metrics sink best-effort
+                pass
+        return points
+
+    @staticmethod
+    def _fold_frag(cands, free, grid_fn) -> tuple[float | None,
+                                                  int | None]:
+        """Fragmentation of a pool's free chips via pkg/topology; None
+        when the pool publishes no usable ICI coordinates."""
+        try:
+            grid = (grid_fn or
+                    (lambda cs: TorusGrid.from_devices(
+                        [c.device for c in cs])))(cands)
+            free_cells = {grid.coords[c.name] for c in free
+                          if c.name in grid.coords}
+            if not grid.coords:
+                return None, None
+            _, chips = largest_free_shape(grid, free_cells)
+            return (round(frag_from_largest(chips, len(free_cells)), 4),
+                    chips)
+        except Exception:  # noqa: BLE001 - uncoordinated pools
+            return None, None
+
+    @staticmethod
+    def _fold_node_telemetry(cands, nodes: dict[str, dict]) -> None:
+        """Aggregate the quantized per-device telemetry attributes the
+        node plugins publish into one per-node view (sum of power,
+        max temp, mean duty, max HBM-used fraction, sum of ICI error
+        counters)."""
+        for cand in cands:
+            attrs = cand.device.get("attributes") or {}
+            vals = {}
+            for name in TELEMETRY_ATTRS:
+                entry = attrs.get(name)
+                if isinstance(entry, dict) and "int" in entry:
+                    try:
+                        vals[name] = int(entry["int"])
+                    except (TypeError, ValueError):
+                        pass
+            if not vals:
+                continue
+            agg = nodes.setdefault(cand.node, {
+                "chips": 0, "power_watts": 0, "temp_celsius": 0,
+                "duty_pct_sum": 0, "hbm_used_pct": 0,
+                "ici_link_errors": 0,
+            })
+            agg["chips"] += 1
+            agg["power_watts"] += vals.get(ATTR_POWER, 0)
+            agg["temp_celsius"] = max(agg["temp_celsius"],
+                                      vals.get(ATTR_TEMP, 0))
+            agg["duty_pct_sum"] += vals.get(ATTR_DUTY, 0)
+            agg["hbm_used_pct"] = max(agg["hbm_used_pct"],
+                                      vals.get(ATTR_HBM, 0))
+            agg["ici_link_errors"] += vals.get(ATTR_ICI_ERR, 0)
+
+    @staticmethod
+    def _finalize_nodes(nodes: dict[str, dict]) -> None:
+        """One-shot finalize AFTER every pool folded: a node's devices
+        may span several (driver, pool) groups, so the running sum
+        must survive across _fold_node_telemetry calls."""
+        for agg in nodes.values():
+            if agg["chips"]:
+                agg["duty_pct_mean"] = round(
+                    agg.pop("duty_pct_sum") / agg["chips"], 1)
+
+    # -- read surface ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ts": self._last_pass_ts,
+                "passes_total": self.passes_total,
+                "pending_claims": self._pending,
+                "pools": {
+                    f"{driver}/{pool}": {
+                        "current": ring[-1] if ring else None,
+                        "history": list(ring),
+                    }
+                    for (driver, pool), ring in self._pools.items()
+                },
+                "nodes": dict(self._nodes),
+            }
+
+    # -- /debug/fleet endpoint (pkg/httpserver handler signature) -------------
+
+    def fleet_endpoint(self) -> tuple[int, str, bytes]:
+        body = json.dumps(self.snapshot(), sort_keys=True).encode()
+        return 200, "application/json", body
+
+
+# -- process-wide defaults (what the MetricsServer debug routes serve) --------
+
+_default_ring: TelemetryRing | None = None
+_default_fleet: FleetAggregator | None = None
+_default_lock = threading.Lock()
+
+
+def default_ring() -> TelemetryRing:
+    """The process-wide telemetry ring (served at /debug/telemetry)."""
+    global _default_ring
+    if _default_ring is None:
+        with _default_lock:
+            if _default_ring is None:
+                _default_ring = TelemetryRing()
+    return _default_ring
+
+
+def set_default_ring(ring: TelemetryRing) -> TelemetryRing:
+    """Swap the process ring (tests / bench isolation)."""
+    global _default_ring
+    with _default_lock:
+        _default_ring = ring
+    return ring
+
+
+def default_fleet() -> FleetAggregator:
+    """The process-wide fleet aggregator (served at /debug/fleet)."""
+    global _default_fleet
+    if _default_fleet is None:
+        with _default_lock:
+            if _default_fleet is None:
+                _default_fleet = FleetAggregator()
+    return _default_fleet
+
+
+def set_default_fleet(fleet: FleetAggregator) -> FleetAggregator:
+    """Swap the process aggregator (the scheduler installs its own)."""
+    global _default_fleet
+    with _default_lock:
+        _default_fleet = fleet
+    return fleet
+
+
+def telemetry_enabled(env=os.environ) -> bool:
+    """The master telemetry switch (``TPU_DRA_TELEMETRY``, default on):
+    off disables sampling, ring, anomaly detection, and slice-attribute
+    publication in one place (the bench overhead gate's off side)."""
+    return env.get("TPU_DRA_TELEMETRY", "1") not in ("0", "false",
+                                                     "False")

@@ -19,11 +19,47 @@ from prometheus_client import (
     generate_latest,
 )
 
-from . import flightrecorder, tracing
+from . import fleetstate, flightrecorder, tracing
 from .debug import debug_stacks_endpoint
 from .httpserver import SimpleHTTPEndpoint
 
 _BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60)
+
+
+def register_build_info(registry: CollectorRegistry,
+                        gates=None) -> Gauge:
+    """The ``tpu_dra_build_info`` info-gauge every binary exposes:
+    value 1, labels carrying the VERSION-file version and the active
+    (enabled) feature gates -- so a fleet dashboard can pivot any
+    metric by code version / gate set during a rollout. Call once per
+    registry (each binary's main; the metrics-hygiene test asserts
+    presence and label contract)."""
+    from .. import __version__  # noqa: PLC0415
+    from .featuregates import (  # noqa: PLC0415
+        KNOWN_FEATURES,
+        FeatureGateError,
+        FeatureGates,
+    )
+
+    if gates is None:
+        # Default to the SAME source the binary resolves its gates
+        # from (FEATURE_GATES env): callers without an explicit gate
+        # object must still advertise what is actually active.
+        try:
+            gates = FeatureGates.from_env()
+        except FeatureGateError:
+            gates = FeatureGates()
+    active = ",".join(sorted(
+        name for name in KNOWN_FEATURES if gates.is_enabled(name)))
+    g = Gauge(
+        "tpu_dra_build_info",
+        "Build/version identity (value is always 1; the labels carry "
+        "the information).",
+        ["version", "feature_gates"],
+        registry=registry,
+    )
+    g.labels(version=__version__, feature_gates=active).set(1)
+    return g
 
 
 class ClaimSLOMetrics:
@@ -136,6 +172,13 @@ class DRARequestMetrics:
         # (phase="prepare"); the scheduler exports the control-plane
         # phases from its own registry (SchedulerMetrics.slo).
         self.slo = ClaimSLOMetrics(registry=self.registry)
+        # Per-chip power/thermal/utilization telemetry + anomaly
+        # episode counts (the fleet telemetry plane's node half; fed
+        # by the health-poll loop through kubeletplugin/driver.py).
+        # Labeled families export nothing until a chip reports, so a
+        # telemetry-less binary sharing this class pays zero scrape
+        # noise.
+        self.telemetry = TelemetryMetrics(registry=self.registry)
 
     def observe_segments(self, operation: str, segments: dict) -> None:
         """DeviceState.segment_observer hook: one histogram sample per
@@ -511,6 +554,179 @@ class PartitionMetrics:
         self.partitions_active.set(n)
 
 
+class TelemetryMetrics:
+    """Per-chip telemetry exposition (the node collector's metric
+    half; kubeletplugin/health.py feeds it on the health-poll cadence
+    from the ``tpulib.chip_telemetry`` seam, kubeletplugin/driver.py
+    wires it onto the plugin registry).
+
+    The gauges are instantaneous per-chip signals; ``ici_link_errors``
+    re-exports tpulib's CUMULATIVE counter as deltas so Prometheus
+    ``rate()`` works across plugin restarts. ``anomaly_total`` counts
+    detection EPISODES (pkg/anomaly.py rising edges), not per-poll
+    presence -- a sustained thermal drift is one anomaly, not one per
+    5s."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.power = Gauge(
+            "tpu_dra_chip_power_watts",
+            "Instantaneous per-chip power draw (tpulib telemetry).",
+            ["chip"],
+            registry=self.registry,
+        )
+        self.temp = Gauge(
+            "tpu_dra_chip_temp_celsius",
+            "Per-chip die temperature (tpulib telemetry).",
+            ["chip"],
+            registry=self.registry,
+        )
+        self.hbm_used = Gauge(
+            "tpu_dra_chip_hbm_used_bytes",
+            "Per-chip HBM bytes in use (tpulib telemetry).",
+            ["chip"],
+            registry=self.registry,
+        )
+        self.duty = Gauge(
+            "tpu_dra_chip_duty_cycle",
+            "Per-chip TensorCore duty cycle, 0.0-1.0 (tpulib "
+            "telemetry).",
+            ["chip"],
+            registry=self.registry,
+        )
+        self.ici_errors = Counter(
+            "tpu_dra_chip_ici_link_errors_total",
+            "ICI link errors observed per chip (delta of tpulib's "
+            "cumulative counter).",
+            ["chip"],
+            registry=self.registry,
+        )
+        self.anomalies = Counter(
+            "tpu_dra_anomaly_total",
+            "Telemetry anomaly episodes detected, by kind "
+            "(thermal_drift, power_cap_throttle, duty_cycle_straggler, "
+            "ici_link_error_burst; pkg/anomaly.py).",
+            ["kind"],
+            registry=self.registry,
+        )
+        self._ici_last: dict[str, int] = {}
+
+    # -- the sinks kubeletplugin/{health,driver}.py call ----------------------
+
+    def observe_sample(self, sample) -> None:
+        """One ChipTelemetry sample -> gauge updates + the ICI error
+        delta."""
+        chip = str(sample.chip)
+        self.power.labels(chip).set(float(sample.power_watts))
+        self.temp.labels(chip).set(float(sample.temp_celsius))
+        self.hbm_used.labels(chip).set(int(sample.hbm_used_bytes))
+        self.duty.labels(chip).set(float(sample.duty_cycle))
+        cum = int(sample.ici_link_errors)
+        last = self._ici_last.get(chip)
+        self._ici_last[chip] = cum
+        if last is not None and cum > last:
+            self.ici_errors.labels(chip).inc(cum - last)
+
+    def prune_absent(self, present_chips) -> None:
+        """Remove gauge children for chips absent from the current
+        sample set: a dead sensor must read as NO data, not a
+        frozen-but-plausible last value summed into dashboards
+        (mirrors the slice-attribute replace semantics)."""
+        present = {str(c) for c in present_chips}
+        for chip in set(self._ici_last) - present:
+            for gauge in (self.power, self.temp, self.hbm_used,
+                          self.duty):
+                try:
+                    gauge.remove(chip)
+                except KeyError:
+                    pass
+            # The error counter keeps its history (it is a counter),
+            # but the delta baseline resets so a returning chip
+            # re-baselines instead of double-counting.
+            self._ici_last.pop(chip, None)
+
+    def inc_anomaly(self, kind: str) -> None:
+        self.anomalies.labels(kind).inc()
+
+
+class FleetMetrics:
+    """Fleet-aggregator exposition (pkg/fleetstate.FleetAggregator's
+    duck-typed sink, on the scheduler registry).
+
+    ``pool_utilization`` near 1.0 with ``pending_claims`` above zero is
+    the capacity-starvation signal; ``node_power_watts`` /
+    ``node_temp_celsius`` are the scheduler-visible per-node power and
+    thermal envelope folded from the slice attributes the node plugins
+    publish (the 2501.17752 power-as-scheduler-signal input). Frag
+    history lives in PlacementMetrics + /debug/fleet."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.pool_utilization = Gauge(
+            "tpu_dra_fleet_pool_utilization",
+            "Allocated fraction of a pool's devices (0.0-1.0), from "
+            "the scheduler's AllocationState.",
+            ["pool"],
+            registry=self.registry,
+        )
+        self.pool_free = Gauge(
+            "tpu_dra_fleet_pool_free_devices",
+            "Devices currently unallocated in a pool.",
+            ["pool"],
+            registry=self.registry,
+        )
+        self.pending = Gauge(
+            "tpu_dra_fleet_pending_claims",
+            "Claims waiting for capacity (demand the free pools are "
+            "not absorbing).",
+            registry=self.registry,
+        )
+        self.node_power = Gauge(
+            "tpu_dra_fleet_node_power_watts",
+            "Per-node power draw summed from the telemetry slice "
+            "attributes the node plugins publish (quantized).",
+            ["node"],
+            registry=self.registry,
+        )
+        self.node_temp = Gauge(
+            "tpu_dra_fleet_node_temp_celsius",
+            "Per-node hottest-chip temperature from the telemetry "
+            "slice attributes (quantized).",
+            ["node"],
+            registry=self.registry,
+        )
+
+    # -- the duck-typed sink pkg/fleetstate.py calls --------------------------
+
+    def set_pool(self, pool: str, utilization: float,
+                 free: int) -> None:
+        self.pool_utilization.labels(pool).set(utilization)
+        self.pool_free.labels(pool).set(free)
+
+    def set_pending(self, n: int) -> None:
+        self.pending.set(n)
+
+    def set_node(self, node: str, power_w: float, temp_c: float) -> None:
+        self.node_power.labels(node).set(power_w)
+        self.node_temp.labels(node).set(temp_c)
+
+    def remove_pool(self, pool: str) -> None:
+        """A pool left the snapshot: its gauges must disappear rather
+        than freeze at the last value."""
+        for gauge in (self.pool_utilization, self.pool_free):
+            try:
+                gauge.remove(pool)
+            except KeyError:
+                pass
+
+    def remove_node(self, node: str) -> None:
+        for gauge in (self.node_power, self.node_temp):
+            try:
+                gauge.remove(node)
+            except KeyError:
+                pass
+
+
 class ComputeDomainMetrics:
     """Cluster-level ComputeDomain status gauge (computedomain_cluster.go)."""
 
@@ -535,9 +751,13 @@ class MetricsServer(SimpleHTTPEndpoint):
     + the pprof-analog diagnostics routes the reference mounts on the
     same mux (controller main.go:383-390): /debug/stacks (all-thread
     tracebacks), /debug/traces[/<trace-id>] (the in-process span ring,
-    pkg/tracing.py), and /debug/claims[/<uid-or-ns/name>] (the
-    per-claim flight recorder, pkg/flightrecorder.py) -- one listener
-    per binary carries metrics AND the introspection surface.
+    pkg/tracing.py), /debug/claims[/<uid-or-ns/name>] (the per-claim
+    flight recorder, pkg/flightrecorder.py), /debug/telemetry (the
+    per-chip telemetry ring) and /debug/fleet (the scheduler's fleet
+    snapshot, both pkg/fleetstate.py) -- one listener per binary
+    carries metrics AND the introspection surface, and
+    ``python -m ...pkg.doctor`` crawls exactly this set into an
+    incident bundle.
 
     Stack traces / span payloads disclose internal state, so like the
     reference's opt-in --pprof-path the debug routes are only served
@@ -569,6 +789,16 @@ class MetricsServer(SimpleHTTPEndpoint):
                 "/debug/claims/*":
                     lambda rest: flightrecorder.default()
                     .claims_endpoint(rest),
+                # Fleet telemetry plane (pkg/fleetstate): the node
+                # plugins' per-chip sample ring and the scheduler's
+                # fleet snapshot. Served on EVERY binary (an unused
+                # surface returns an empty document, which is what the
+                # doctor bundle expects rather than a 404).
+                "/debug/telemetry":
+                    lambda: fleetstate.default_ring()
+                    .telemetry_endpoint(),
+                "/debug/fleet":
+                    lambda: fleetstate.default_fleet().fleet_endpoint(),
             }
         super().__init__(
             "/metrics",

@@ -776,3 +776,9 @@ class EvictionController:
                 "claim %s failed recovery: deadline exceeded with no "
                 "re-placement; flight record:\n%s", uid,
                 self.flight.dump(uid))
+            # Incident bundle (pkg/doctor, TPU_DRA_DOCTOR_DIR-gated,
+            # rate-limited): a blown recovery deadline means capacity
+            # or control-plane trouble -- snapshot the rings now.
+            from . import doctor  # noqa: PLC0415
+
+            doctor.auto_bundle("eviction-deadline", claim=uid)

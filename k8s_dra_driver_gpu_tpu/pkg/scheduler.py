@@ -51,7 +51,7 @@ import threading
 import time
 import uuid
 
-from . import flightrecorder, tracing
+from . import fleetstate, flightrecorder, tracing
 from .events import emit_warning_event
 from .featuregates import (
     TOPOLOGY_AWARE_PLACEMENT,
@@ -132,7 +132,8 @@ class DraScheduler:
                  gates: FeatureGates | None = None, metrics=None,
                  sched_metrics=None, resync_period: float | None = None,
                  workers: int | None = None, batch_max: int | None = None,
-                 domain: SchedulingDomain | None = None):
+                 domain: SchedulingDomain | None = None,
+                 fleet_metrics=None):
         self.kube = kube
         self.default_node = default_node
         self._selectors = _CompiledSelectors()
@@ -228,6 +229,17 @@ class DraScheduler:
         # dirty-key enqueue / fit outcome / commit conflict / patch
         # lands in the bounded ring served at /debug/claims.
         self.flight = flightrecorder.default()
+        # Fleet telemetry aggregator (pkg/fleetstate): every full pass
+        # folds the inventory snapshot + allocation state + published
+        # node-telemetry attributes into per-pool utilization /
+        # fragmentation time-series (served at /debug/fleet, exported
+        # through FleetMetrics when the registry is wired). The
+        # process default (/debug/fleet, doctor bundles) is claimed
+        # lazily on the FIRST fold -- a merely-constructed scheduler
+        # (tests build several per process) never repoints the live
+        # one's endpoint at an empty aggregator.
+        self.fleet = fleetstate.FleetAggregator(metrics=fleet_metrics)
+        self._fleet_installed = False
         # Per-worker fit-phase start time (SLO phase accounting).
         self._fit_tls = threading.local()
 
@@ -1681,9 +1693,33 @@ class DraScheduler:
         self._generate_extended_resource_claims()
         self._allocate_claims()
         self._bind_pods()
+        self._observe_fleet()
         if self.sched_metrics is not None:
             self.sched_metrics.sync_seconds.labels("full").observe(
                 time.monotonic() - t0)
+
+    def _observe_fleet(self) -> None:
+        """Fold one pass's inventory + allocation state + pending
+        demand into the fleet aggregator (pkg/fleetstate). Full-pass
+        cadence only (the safety resync in event mode): fleet
+        time-series want seconds-to-minutes resolution, not per-claim.
+        Never lets a telemetry failure fail a sync."""
+        if self.fleet is None:
+            return
+        try:
+            if not self._fleet_installed:
+                fleetstate.set_default_fleet(self.fleet)
+                self._fleet_installed = True
+            snap, alloc = self._ensure_alloc_state()
+            pending = sum(
+                1 for c in self.view.claims()
+                if self._owns(c)
+                and not c.get("status", {}).get("allocation")
+                and not _meta(c).get("deletionTimestamp"))
+            self.fleet.observe_pass(snap, alloc, pending,
+                                    grid_fn=self._grid_for)
+        except Exception:  # noqa: BLE001 - observability must not
+            logger.exception("fleet telemetry fold failed")  # fail sync
 
     def _sync_recovery(self) -> None:
         """One recovery-controller pass, ahead of allocation so the
@@ -2186,8 +2222,10 @@ def main(argv: list[str] | None = None) -> int:
     metrics = None
     sched_metrics = None
     server = None
+    fleet_metrics = None
     if args.metrics_port:
         from .metrics import (  # noqa: PLC0415
+            FleetMetrics,
             MetricsServer,
             PlacementMetrics,
             SchedulerMetrics,
@@ -2195,6 +2233,7 @@ def main(argv: list[str] | None = None) -> int:
 
         metrics = PlacementMetrics()
         sched_metrics = SchedulerMetrics(registry=metrics.registry)
+        fleet_metrics = FleetMetrics(registry=metrics.registry)
         server = MetricsServer(metrics.registry, host="0.0.0.0",
                                port=args.metrics_port)
         server.start()
@@ -2217,7 +2256,12 @@ def main(argv: list[str] | None = None) -> int:
                          default_node=args.default_node,
                          metrics=metrics, sched_metrics=sched_metrics,
                          workers=args.sched_workers,
-                         batch_max=args.sched_batch, domain=domain)
+                         batch_max=args.sched_batch, domain=domain,
+                         fleet_metrics=fleet_metrics)
+    if metrics is not None:
+        from .metrics import register_build_info  # noqa: PLC0415
+
+        register_build_info(metrics.registry, sched.gates)
     if args.recovery_root:
         from .metrics import RecoveryMetrics  # noqa: PLC0415
         from .recovery import EvictionController  # noqa: PLC0415

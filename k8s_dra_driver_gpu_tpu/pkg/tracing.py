@@ -61,6 +61,15 @@ TRACEPARENT_ANNOTATION = "resource.tpu.dra/traceparent"
 ENV_SAMPLE = "TPU_DRA_TRACE_SAMPLE"
 ENV_TRACE_FILE = "TPU_DRA_TRACE_FILE"
 ENV_TRACE_RING = "TPU_DRA_TRACE_RING"
+# JSONL sink rotation: at max-MB the file rotates to <path>.1 (shifting
+# .1 -> .2 ... up to keep-N, oldest dropped), so a long-lived sampled
+# binary can never fill the disk. 0 MB = unbounded (the historical
+# behavior); rotation errors disable the sink like write errors --
+# never fail a traced op.
+ENV_TRACE_FILE_MAX_MB = "TPU_DRA_TRACE_FILE_MAX_MB"
+ENV_TRACE_FILE_KEEP = "TPU_DRA_TRACE_FILE_KEEP"
+DEFAULT_TRACE_FILE_MAX_MB = 64.0
+DEFAULT_TRACE_FILE_KEEP = 3
 
 _VERSION = "00"
 DEFAULT_RING_SPANS = 4096
@@ -352,12 +361,43 @@ class TraceExporter:
     failing a traced operation."""
 
     def __init__(self, max_spans: int = DEFAULT_RING_SPANS,
-                 path: str | None = None):
+                 path: str | None = None,
+                 max_file_bytes: int | None = None,
+                 keep_files: int | None = None):
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(16, int(max_spans)))
         self._path = path or None
         self._file_broken = False
+        if max_file_bytes is None:
+            max_file_bytes = int(_env_float(
+                ENV_TRACE_FILE_MAX_MB, DEFAULT_TRACE_FILE_MAX_MB)
+                * 1024 * 1024)
+        self._max_file_bytes = max(0, int(max_file_bytes))
+        if keep_files is None:
+            keep_files = int(_env_float(ENV_TRACE_FILE_KEEP,
+                                        DEFAULT_TRACE_FILE_KEEP))
+        self._keep_files = max(1, int(keep_files))
+        # Size tracked incrementally (stat once at startup for an
+        # existing file): the sink must not pay a per-span stat.
+        self._file_size = 0
+        if self._path:
+            try:
+                self._file_size = os.path.getsize(self._path)
+            except OSError:
+                self._file_size = 0
         self.exported_total = 0
+
+    def _rotate_locked(self) -> None:
+        """Size cap hit: shift <path>.N-1 -> <path>.N (oldest dropped)
+        and move the live file to <path>.1. Any error disables the
+        sink -- identical policy to write errors, a traced op never
+        fails."""
+        for i in range(self._keep_files - 1, 0, -1):
+            src = f"{self._path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self._path}.{i + 1}")
+        os.replace(self._path, f"{self._path}.1")
+        self._file_size = 0
 
     def export(self, sp: Span) -> None:
         # The ring stores the (terminal, finished) Span object and
@@ -368,10 +408,15 @@ class TraceExporter:
             self._ring.append(sp)
             self.exported_total += 1
         if self._path and not self._file_broken:
+            line = json.dumps(sp.to_dict(), sort_keys=True) + "\n"
             try:
-                with open(self._path, "a", encoding="utf-8") as f:
-                    f.write(json.dumps(sp.to_dict(),
-                                       sort_keys=True) + "\n")
+                with self._lock:
+                    if self._max_file_bytes and \
+                            self._file_size >= self._max_file_bytes:
+                        self._rotate_locked()
+                    with open(self._path, "a", encoding="utf-8") as f:
+                        f.write(line)
+                    self._file_size += len(line)
             except OSError:
                 self._file_broken = True
                 logger.exception(
@@ -412,6 +457,13 @@ class TraceExporter:
         body = json.dumps({"trace_id": trace_id, "spans": spans_},
                           sort_keys=True).encode()
         return 200, "application/json", body
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 def _ring_size() -> int:

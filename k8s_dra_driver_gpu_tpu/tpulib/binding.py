@@ -31,6 +31,11 @@ ENV_MOCK_HEALTH_EVENTS = "TPULIB_MOCK_HEALTH_EVENTS"
 # (tenant_usage): "tenant=<key>,hbm=<bytes>[,cores=N]|..." or
 # "@/path/to/control-file" re-read every poll, like health events.
 ENV_MOCK_TENANT_USAGE = "TPULIB_MOCK_TENANT_USAGE"
+# Per-chip power/thermal/utilization injection for the fleet-telemetry
+# seam (chip_telemetry): "chip=0,power=120.5,temp=55,hbm=1073741824,
+# duty=0.85,ici_err=3|chip=1,..." with the same "@control-file"
+# re-read-every-poll form as health events.
+ENV_MOCK_TELEMETRY = "TPULIB_MOCK_TELEMETRY"
 
 
 class TpuLibError(RuntimeError):
@@ -97,6 +102,31 @@ class TenantUsage:
     tenant: str
     hbm_bytes: int
     cores: int = 1
+
+
+@dataclass(frozen=True)
+class ChipTelemetry:
+    """One per-chip power/thermal/utilization sample (the node half of
+    the fleet telemetry plane, kubeletplugin/health.py ->
+    pkg/fleetstate.py). ``ici_link_errors`` is CUMULATIVE (a counter
+    the consumer differentiates); everything else is instantaneous."""
+
+    chip: int
+    power_watts: float = 0.0
+    temp_celsius: float = 0.0
+    hbm_used_bytes: int = 0
+    duty_cycle: float = 0.0  # 0.0-1.0
+    ici_link_errors: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "chip": self.chip,
+            "power_watts": self.power_watts,
+            "temp_celsius": self.temp_celsius,
+            "hbm_used_bytes": self.hbm_used_bytes,
+            "duty_cycle": self.duty_cycle,
+            "ici_link_errors": self.ici_link_errors,
+        }
 
 
 @dataclass(frozen=True)
@@ -241,6 +271,59 @@ class NativeTpuLib:
         byte-identical parity by construction."""
         return _tenant_usage_from_env()
 
+    def chip_telemetry(
+        self, opts: EnumerateOptions | None = None
+    ) -> tuple[ChipTelemetry, ...]:
+        """Per-chip power/thermal/utilization samples. Like
+        tenant_usage, the native library exposes no power rails yet,
+        so both backends share the Python-side mock source --
+        byte-identical parity by construction."""
+        return _chip_telemetry_from_env()
+
+
+def _chip_telemetry_from_env() -> tuple[ChipTelemetry, ...]:
+    """Parse TPULIB_MOCK_TELEMETRY:
+    ``chip=<i>[,power=<W>][,temp=<C>][,hbm=<bytes>][,duty=<0..1>]
+    [,ici_err=<n>]|...`` with the same ``@control-file``
+    re-read-every-poll form as health events. Empty / unset = no
+    samples (a host without power rails degrades to no telemetry,
+    never fake numbers)."""
+    _fault_point("tpulib.telemetry", error=lambda m: TpuLibError(m))
+    spec = os.environ.get(ENV_MOCK_TELEMETRY, "")
+    if spec.startswith("@"):
+        try:
+            with open(spec[1:], encoding="latin-1") as f:
+                spec = f.read().strip(" \t\r\n\f\v")
+        except OSError:
+            spec = ""
+    samples = []
+    for item in filter(None, spec.split("|")):
+        chip = -1
+        power = temp = duty = 0.0
+        hbm = ici = 0
+        for part in item.split(","):
+            if "=" not in part:
+                continue
+            k, _, v = part.partition("=")
+            if k == "chip":
+                chip = _atoi(v)
+            elif k == "power":
+                power = _atof(v)
+            elif k == "temp":
+                temp = _atof(v)
+            elif k == "hbm":
+                hbm = _atoi(v)
+            elif k == "duty":
+                duty = _atof(v)
+            elif k == "ici_err":
+                ici = _atoi(v)
+        if chip >= 0:
+            samples.append(ChipTelemetry(
+                chip=chip, power_watts=power, temp_celsius=temp,
+                hbm_used_bytes=hbm, duty_cycle=duty,
+                ici_link_errors=ici))
+    return tuple(samples)
+
 
 def _tenant_usage_from_env() -> tuple[TenantUsage, ...]:
     """Parse TPULIB_MOCK_TENANT_USAGE:
@@ -337,6 +420,13 @@ def _atoi(s: str) -> int:
     integer prefix, 0 when there is none."""
     m = re.match(r"\s*[+-]?\d+", s)
     return int(m.group()) if m else 0
+
+
+def _atof(s: str) -> float:
+    """C atof semantics to match _atoi: leading float prefix, 0.0 when
+    there is none (telemetry grammar values are never exponents)."""
+    m = re.match(r"\s*[+-]?\d*\.?\d+", s)
+    return float(m.group()) if m else 0.0
 
 
 def _parse_type(t: str) -> tuple[_Gen, int] | None:
@@ -610,6 +700,13 @@ class PyTpuLib:
         """Per-tenant HBM/core usage samples (mock injection env /
         control file; same source as the native backend)."""
         return _tenant_usage_from_env()
+
+    def chip_telemetry(
+        self, opts: EnumerateOptions | None = None
+    ) -> tuple[ChipTelemetry, ...]:
+        """Per-chip power/thermal/utilization samples (mock injection
+        env / control file; same source as the native backend)."""
+        return _chip_telemetry_from_env()
 
 
 def load(prefer_native: bool = True, build_if_missing: bool = True):

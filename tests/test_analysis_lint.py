@@ -751,6 +751,58 @@ class TestSpanDisciplineRule:
             lint_source(src, rel="pkg/scheduler.py"))
 
 
+class TestTelemetryMutationRule:
+    """TPUDRA013: telemetry ring / fleet-aggregator mutations
+    (record_sample / fold_*) are fenced to pkg/fleetstate.py,
+    pkg/anomaly.py and kubeletplugin/health.py -- everyone else feeds
+    through the health-poll sampling seam or
+    FleetAggregator.observe_pass."""
+
+    def test_ring_mutation_outside_layer_flagged(self):
+        src = ("from .fleetstate import default_ring\n"
+               "def bad(sample):\n"
+               "    default_ring().record_sample(sample)\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA013" in rules_of(findings)
+
+    def test_fold_outside_layer_flagged(self):
+        src = ("def bad(fleet, cands, nodes):\n"
+               "    fleet.fold_node_telemetry(cands, nodes)\n")
+        findings = lint_source(src, rel="kubeletplugin/driver.py")
+        assert "TPUDRA013" in rules_of(findings)
+
+    def test_health_poll_producer_sanctioned(self):
+        src = ("def sample(self, samples):\n"
+               "    for s in samples:\n"
+               "        self.telemetry_ring.record_sample(s)\n")
+        assert "TPUDRA013" not in rules_of(
+            lint_source(src, rel="kubeletplugin/health.py"))
+
+    def test_stray_same_named_file_not_sanctioned(self):
+        # Rel-path suffix sanctioning (the TPUDRA011 lesson): a future
+        # computedomain/daemon/health.py gets NO mutation rights just
+        # for its basename.
+        src = ("def bad(ring, s):\n"
+               "    ring.record_sample(s)\n")
+        findings = lint_source(src, rel="computedomain/daemon/health.py")
+        assert "TPUDRA013" in rules_of(findings)
+
+    def test_fleetstate_internal_fold_sanctioned(self):
+        src = ("class FleetAggregator:\n"
+               "    def observe_pass(self, snap):\n"
+               "        self.fold_pass(snap)\n")
+        assert "TPUDRA013" not in rules_of(
+            lint_source(src, rel="pkg/fleetstate.py"))
+
+    def test_observe_pass_entry_clean_everywhere(self):
+        # The public fold entry is NOT a fenced mutation: the
+        # scheduler calls it every full pass.
+        src = ("def sync(self, snap, alloc):\n"
+               "    self.fleet.observe_pass(snap, alloc, 0)\n")
+        assert "TPUDRA013" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+
 class TestWholePackageGate:
     """The tier-1 CI gate from ISSUE 3: zero non-baselined findings
     over the shipped package, with the committed baseline EMPTY (every

@@ -1,0 +1,56 @@
+"""Tier-1 fleet-telemetry overhead smoke: the `make
+bench-telemetry-smoke` contract as a non-slow test. Runs `bench.py
+--telemetry-overhead` on a shrunk churn and asserts (a) the always-on
+telemetry station (sampling + ring + anomaly detectors + quantized
+slice attributes) stays inside the 5% overhead envelope of the
+telemetry-off wall clock (min-of-interleaved-reps ratio, adaptively
+extended under load), (b) TPU_DRA_TELEMETRY gates the station both
+ways -- on records ring samples, off records ZERO, (c) the converged
+quantized-attribute republish costs zero kube writes, and (d) the
+BENCH_observability.json "telemetry" trajectory entry is emitted --
+so a telemetry hot-path regression fails fast here instead of
+surfacing as a BENCH trajectory dip."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-telemetry-smoke target.
+SMOKE_ENV = {
+    "BENCH_TELEMETRY_ITERS": "8",
+    "BENCH_TELEMETRY_REPS": "2",
+    "BENCH_TELEMETRY_MAX_OVERHEAD_PCT": "5",
+}
+
+
+def test_telemetry_overhead_smoke(tmp_path):
+    out_file = str(tmp_path / "BENCH_observability.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--telemetry-overhead"],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV,
+             "BENCH_OBS_OUT": out_file},
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "telemetry_overhead_pct"
+    ex = doc["extras"]
+    # The overhead gate itself (bench exits nonzero past the cap; the
+    # assert keeps the number visible in the pytest failure too).
+    assert doc["value"] <= 5.0
+    # The master knob gates the station BOTH ways.
+    assert ex["telemetry_ring_samples_on"] > 0
+    assert ex["telemetry_ring_samples_off"] == 0
+    # Converged telemetry republish = zero kube writes.
+    assert ex["telemetry_steady_writes_on"] == 0
+    # The trajectory entry landed under the "telemetry" key and
+    # round-trips (the trace-overhead entry owns the document root).
+    with open(out_file, encoding="utf-8") as f:
+        emitted = json.load(f)
+    assert emitted["telemetry"]["metric"] == "telemetry_overhead_pct"
+    assert emitted["telemetry"]["extras"][
+        "telemetry_steady_writes_on"] == 0

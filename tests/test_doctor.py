@@ -1,0 +1,249 @@
+"""One-command diagnostics bundles (pkg/doctor): the CLI crawl over a
+LIVE stack (scheduler + chip plugin + CD plugin, each with its real
+MetricsServer serving /metrics and the /debug surfaces), the
+correlated per-claim report, and the rate-limited automatic incident
+bundles the gang-abort / eviction-deadline paths drop."""
+
+import json
+import os
+import tarfile
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import Config
+from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+from k8s_dra_driver_gpu_tpu.pkg import (
+    doctor,
+    fleetstate,
+    flightrecorder,
+    tracing,
+)
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.metrics import (
+    DRARequestMetrics,
+    MetricsServer,
+    PlacementMetrics,
+    SchedulerMetrics,
+)
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+from tests.test_scheduler import RES, apply_device_classes
+
+SURFACES = ("metrics", "debug/traces", "debug/claims", "debug/stacks",
+            "debug/telemetry", "debug/fleet")
+
+
+@pytest.fixture()
+def live_stack(tmp_path, monkeypatch):
+    """The bench-style live stack: scheduler + chip plugin + CD plugin
+    with one claim allocated AND prepared, each binary's registry
+    served by a real MetricsServer with debug endpoints on."""
+    from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state import (
+        CDDeviceState,
+    )
+    from k8s_dra_driver_gpu_tpu.computedomain.plugin.driver import (
+        CDDriver,
+    )
+
+    monkeypatch.setenv(
+        "TPULIB_MOCK_TELEMETRY",
+        "|".join(f"chip={i},power=117,temp=48,duty=0.9"
+                 for i in range(4)))
+    flightrecorder.set_default(flightrecorder.FlightRecorder())
+    tracing.set_exporter(tracing.TraceExporter())
+    fleetstate.set_default_ring(fleetstate.TelemetryRing())
+
+    kube = FakeKubeClient()
+    apply_device_classes(kube)
+    plugin_metrics = DRARequestMetrics()
+    plugin = Driver(Config.mock(root=str(tmp_path / "plugin")), kube,
+                    node_name="node-a", metrics=plugin_metrics,
+                    publication_mode="combined")
+    plugin.publish_resources()
+    plugin._on_health_taints(
+        plugin.health_monitor.poll_and_reconcile())
+
+    sched_metrics = PlacementMetrics()
+    SchedulerMetrics(registry=sched_metrics.registry)
+    sched = DraScheduler(kube, metrics=sched_metrics)
+
+    cd_metrics = DRARequestMetrics()
+    cd_state = CDDeviceState(root=str(tmp_path / "cd"), kube=kube,
+                             node_name="node-a", use_informer=False)
+    CDDriver(cd_state, kube, "node-a", retry_timeout=0.2)
+
+    # One claim through the real pipeline: allocate + node prepare.
+    kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": "probe", "namespace": "default"},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu",
+             "exactly": {"deviceClassName": "tpu.dra.dev"}}]}},
+    }, namespace="default")
+    sched.sync_once()
+    obj = kube.get(*RES, "resourceclaims", "probe", "default")
+    assert obj["status"]["allocation"]
+    uid = obj["metadata"]["uid"]
+    plugin.prepare_resource_claims(
+        [{"uid": uid, "namespace": "default", "name": "probe"}])
+
+    servers = {
+        "scheduler": MetricsServer(sched_metrics.registry),
+        "plugin": MetricsServer(plugin_metrics.registry),
+        "cd-plugin": MetricsServer(cd_metrics.registry),
+    }
+    for s in servers.values():
+        s.start()
+    try:
+        yield servers, uid
+    finally:
+        for s in servers.values():
+            s.stop()
+        plugin.stop()
+        flightrecorder.set_default(flightrecorder.FlightRecorder())
+        tracing.set_exporter(tracing.TraceExporter())
+        fleetstate.set_default_ring(fleetstate.TelemetryRing())
+
+
+def test_cli_bundle_covers_all_surfaces(live_stack, tmp_path,
+                                        capsys):
+    servers, uid = live_stack
+    rc = doctor.main(
+        [f"{name}=http://127.0.0.1:{srv.port}"
+         for name, srv in servers.items()]
+        + ["--out-dir", str(tmp_path), "--claim", uid])
+    assert rc == 0
+    bundle = capsys.readouterr().out.strip()
+    assert bundle.endswith(".tar.gz") and os.path.exists(bundle)
+    with tarfile.open(bundle) as tar:
+        names = set(tar.getnames())
+        report = json.load(tar.extractfile("report.json"))
+        manifest = json.load(tar.extractfile("manifest.json"))
+    # Every binary's full surface is in the bundle.
+    for target in servers:
+        for path in SURFACES:
+            suffix = ".txt" if path in ("metrics",
+                                        "debug/stacks") else ".json"
+            assert f"{target}/{path}{suffix}" in names, (
+                f"missing {target}/{path}")
+    assert not manifest["errors"]
+    # The correlated report merges the claim's whole story (scheduler
+    # enqueue under ns/name + plugin prepare under uid, tied by the
+    # alias) and focuses on the requested claim.
+    assert report["focus_claim"] == uid
+    events = report["claims"][uid]
+    assert any(ev["event"] == "prepare_done" for ev in events)
+    assert report["trace_span_counts"], "no traces correlated"
+    # Telemetry surface carried real samples.
+    with tarfile.open(bundle) as tar:
+        tele = json.load(tar.extractfile("plugin/debug/telemetry.json"))
+    assert tele["chips"], "telemetry ring empty in bundle"
+
+
+def test_cli_records_unreachable_target(tmp_path, capsys):
+    rc = doctor.main(["gone=http://127.0.0.1:9",
+                      "--out-dir", str(tmp_path)])
+    assert rc == 0  # a dead binary must not kill the crawl
+    bundle = capsys.readouterr().out.strip()
+    with tarfile.open(bundle) as tar:
+        manifest = json.load(tar.extractfile("manifest.json"))
+    assert any(k.startswith("gone/") for k in manifest["errors"])
+
+
+class TestAutoBundle:
+    def test_disabled_without_dir(self, monkeypatch):
+        monkeypatch.delenv(doctor.ENV_DOCTOR_DIR, raising=False)
+        doctor.reset_rate_limit()
+        assert doctor.auto_bundle("gang-abort", claim="u1") is None
+
+    @staticmethod
+    def _wait_for_file(path, timeout=15.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def test_bundle_and_rate_limit(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(doctor.ENV_DOCTOR_DIR, str(tmp_path))
+        monkeypatch.setenv(doctor.ENV_DOCTOR_MIN_INTERVAL, "3600")
+        doctor.reset_rate_limit()
+        flightrecorder.default().record("u-gang", "gang_abort",
+                                        error="deadline")
+        path = doctor.auto_bundle("gang-abort", claim="u-gang")
+        # The crawl/tar runs on a daemon thread (the triggering unwind
+        # must never wait out peer fetch timeouts); the path is
+        # reported up front.
+        assert path and self._wait_for_file(path)
+        assert "gang-abort" in os.path.basename(path)
+        with tarfile.open(path) as tar:
+            names = set(tar.getnames())
+            local = json.load(
+                tar.extractfile("local/debug/claims.json"))
+        # The triggering binary's own in-process surfaces are dumped
+        # without needing a listener.
+        assert {"local/debug/traces.json", "local/debug/stacks.txt",
+                "local/debug/telemetry.json",
+                "local/debug/fleet.json"} <= names
+        assert any(ev["key"] == "u-gang" for ev in local["events"])
+        # Rate limited: an immediate second trigger is swallowed.
+        assert doctor.auto_bundle("gang-abort") is None
+
+    def test_never_raises(self, monkeypatch):
+        monkeypatch.setenv(doctor.ENV_DOCTOR_DIR,
+                           "/proc/no-such-dir/x")
+        doctor.reset_rate_limit()
+        assert doctor.auto_bundle("eviction-deadline") is None
+
+    def test_gang_abort_path_drops_bundle(self, tmp_path,
+                                          monkeypatch):
+        """The CD driver's gang-abort unwind drops a bundle
+        end to end (TPU_DRA_DOCTOR_DIR set, deadline forced)."""
+        from k8s_dra_driver_gpu_tpu.computedomain.plugin.device_state import (  # noqa: E501
+            CDDeviceState,
+        )
+        from k8s_dra_driver_gpu_tpu.computedomain.plugin.driver import (
+            CDDriver,
+        )
+        from tests.fake_kube import make_claim_dict
+
+        monkeypatch.setenv(doctor.ENV_DOCTOR_DIR, str(tmp_path))
+        doctor.reset_rate_limit()
+        kube = FakeKubeClient()
+        kube.create("", "v1", "nodes",
+                    {"metadata": {"name": "n0", "labels": {}}})
+        kube.create("resource.tpu.dra", "v1beta1", "computedomains", {
+            "metadata": {"name": "cd", "uid": "cd-uid",
+                         "namespace": "default"},
+            "spec": {"numNodes": 2},
+            "status": {"status": "NotReady", "nodes": []},
+        }, namespace="default")
+        state = CDDeviceState(root=str(tmp_path / "cd"), kube=kube,
+                              node_name="n0", use_informer=False)
+        drv = CDDriver(state, kube, "n0", retry_timeout=0.2)
+        uid = "gang-claim"
+        obj = make_claim_dict(
+            uid, ["channel-0"],
+            driver="compute-domain.tpu.dra.dev",
+            configs=[{"parameters": {
+                "apiVersion": "resource.tpu.dra/v1beta1",
+                "kind": "ComputeDomainChannelConfig",
+                "domainID": "cd-uid"}}])
+        obj["metadata"]["name"] = uid
+        kube.create(*RES, "resourceclaims", obj, namespace="default")
+        out = drv.prepare_resource_claims(
+            [{"uid": uid, "namespace": "default", "name": uid}])
+        assert out[uid][1]  # the gang prepare aborted
+        deadline = 15.0
+        import time as _t
+
+        t0 = _t.monotonic()
+        bundles = []
+        while _t.monotonic() - t0 < deadline and not bundles:
+            bundles = [f for f in os.listdir(tmp_path)
+                       if f.endswith(".tar.gz")]
+            _t.sleep(0.05)
+        assert len(bundles) == 1
+        assert "gang-abort" in bundles[0]

@@ -1,0 +1,200 @@
+"""Fleet telemetry plane, scheduler half: the FleetAggregator fold
+(per-pool utilization / fragmentation time-series, node telemetry
+from published slice attributes, pending-demand tracking), the
+FleetMetrics sink, the /debug/fleet endpoint, and the DraScheduler
+full-pass wiring."""
+
+import json
+
+from k8s_dra_driver_gpu_tpu.pkg import fleetstate
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.metrics import FleetMetrics
+from k8s_dra_driver_gpu_tpu.pkg.schedcache import (
+    AllocationState,
+    InventorySnapshot,
+)
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+
+RES = ("resource.k8s.io", "v1")
+
+
+def make_slice(node="n0", chips=4, telemetry=True, gen=1,
+               grid=(2, 2)):
+    devices = []
+    for i in range(chips):
+        attrs = {
+            "iciX": {"int": i % grid[0]},
+            "iciY": {"int": i // grid[0]},
+            "iciZ": {"int": 0},
+            "topology": {"string": f"{grid[0]}x{grid[1]}"},
+        }
+        if telemetry:
+            attrs.update({
+                fleetstate.ATTR_POWER: {"int": 120},
+                fleetstate.ATTR_TEMP: {"int": 55},
+                fleetstate.ATTR_DUTY: {"int": 80},
+                fleetstate.ATTR_HBM: {"int": 10},
+                fleetstate.ATTR_ICI_ERR: {"int": 3},
+            })
+        devices.append({"name": f"chip-{i}", "attributes": attrs,
+                        "capacity": {}})
+    return {
+        "metadata": {"name": f"{node}-slice"},
+        "spec": {
+            "driver": "tpu.dra.dev", "nodeName": node,
+            "pool": {"name": node, "generation": gen,
+                     "resourceSliceCount": 1},
+            "devices": devices,
+        },
+    }
+
+
+def allocated_claim(uid, devices, node="n0"):
+    return {
+        "metadata": {"uid": uid, "namespace": "default", "name": uid},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": "tpu.dra.dev", "pool": node,
+             "device": d} for d in devices]}}},
+    }
+
+
+class TestFleetAggregator:
+    def test_pool_utilization_and_frag(self):
+        snap = InventorySnapshot([make_slice()])
+        alloc = AllocationState(snap)
+        alloc.rebuild([allocated_claim("u1", ["chip-0", "chip-1"])])
+        fleet = fleetstate.FleetAggregator()
+        points = fleet.observe_pass(snap, alloc, pending_claims=3)
+        point = points[("tpu.dra.dev", "n0")]
+        assert point["total_devices"] == 4
+        assert point["allocated_devices"] == 2
+        assert point["utilization"] == 0.5
+        # chips 0,1 allocated on a 2x2 grid: the two free chips form a
+        # contiguous 2x1 -> largest_free_shape 2, frag 0.
+        assert point["largest_free_shape"] == 2
+        assert point["fragmentation_score"] == 0.0
+        snapshot = fleet.snapshot()
+        assert snapshot["pending_claims"] == 3
+        assert snapshot["pools"]["tpu.dra.dev/n0"]["current"] == point
+
+    def test_node_telemetry_folded_from_attrs(self):
+        snap = InventorySnapshot([make_slice()])
+        fleet = fleetstate.FleetAggregator()
+        fleet.observe_pass(snap, AllocationState(snap), 0)
+        nodes = fleet.snapshot()["nodes"]
+        assert nodes["n0"]["power_watts"] == 480   # 4 x 120
+        assert nodes["n0"]["temp_celsius"] == 55   # max
+        assert nodes["n0"]["duty_pct_mean"] == 80.0
+        assert nodes["n0"]["ici_link_errors"] == 12
+
+    def test_node_spanning_two_pools_folds_once(self):
+        """Regression: a node whose telemetry-attributed devices show
+        up under TWO (driver, pool) groups (e.g. two driver names
+        during an upgrade) must fold into one aggregate instead of
+        KeyError-ing the whole pass on the finalized running sum."""
+        s1 = make_slice()
+        s2 = make_slice()
+        s2["metadata"]["name"] = "n0-slice-alt"
+        s2["spec"]["driver"] = "alt.tpu.dra.dev"
+        snap = InventorySnapshot([s1, s2])
+        fleet = fleetstate.FleetAggregator()
+        fleet.observe_pass(snap, AllocationState(snap), 0)
+        nodes = fleet.snapshot()["nodes"]
+        assert nodes["n0"]["chips"] == 8
+        assert nodes["n0"]["power_watts"] == 960
+        assert nodes["n0"]["duty_pct_mean"] == 80.0
+
+    def test_telemetry_less_pool_has_no_node_entry(self):
+        snap = InventorySnapshot([make_slice(telemetry=False)])
+        fleet = fleetstate.FleetAggregator()
+        fleet.observe_pass(snap, AllocationState(snap), 0)
+        assert fleet.snapshot()["nodes"] == {}
+
+    def test_history_ring_bounded(self):
+        snap = InventorySnapshot([make_slice()])
+        alloc = AllocationState(snap)
+        fleet = fleetstate.FleetAggregator(history=16)
+        for _ in range(40):
+            fleet.observe_pass(snap, alloc, 0)
+        hist = fleet.snapshot()["pools"]["tpu.dra.dev/n0"]["history"]
+        assert len(hist) == 16
+        assert fleet.passes_total == 40
+
+    def test_metrics_sink(self):
+        from prometheus_client import generate_latest
+
+        metrics = FleetMetrics()
+        snap = InventorySnapshot([make_slice()])
+        alloc = AllocationState(snap)
+        alloc.rebuild([allocated_claim("u1", ["chip-0"])])
+        fleet = fleetstate.FleetAggregator(metrics=metrics)
+        fleet.observe_pass(snap, alloc, pending_claims=2)
+        text = generate_latest(metrics.registry).decode()
+        assert ('tpu_dra_fleet_pool_utilization'
+                '{pool="tpu.dra.dev/n0"} 0.25') in text
+        assert "tpu_dra_fleet_pending_claims 2.0" in text
+        assert ('tpu_dra_fleet_node_power_watts{node="n0"} 480.0'
+                in text)
+
+    def test_metrics_pruned_when_pool_and_node_vanish(self):
+        from prometheus_client import generate_latest
+
+        metrics = FleetMetrics()
+        fleet = fleetstate.FleetAggregator(metrics=metrics)
+        snap = InventorySnapshot([make_slice()])
+        fleet.observe_pass(snap, AllocationState(snap), 0)
+        text = generate_latest(metrics.registry).decode()
+        assert 'pool="tpu.dra.dev/n0"' in text
+        assert 'node="n0"' in text
+        empty = InventorySnapshot([])
+        fleet.observe_pass(empty, AllocationState(empty), 0)
+        text = generate_latest(metrics.registry).decode()
+        # Gone from the snapshot = gone from the exposition (history
+        # survives in the /debug/fleet rings only).
+        assert 'pool="tpu.dra.dev/n0"' not in text
+        assert 'node="n0"' not in text
+
+    def test_fleet_endpoint(self):
+        fleet = fleetstate.FleetAggregator()
+        status, ctype, body = fleet.fleet_endpoint()
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["pools"] == {} and doc["passes_total"] == 0
+
+
+class TestSchedulerWiring:
+    def test_full_pass_folds_fleet_state(self):
+        kube = FakeKubeClient()
+        kube.create(*RES, "resourceslices", make_slice())
+        # One pending claim the pass cannot place (unknown class) and
+        # one pre-allocated claim.
+        kube.create(*RES, "resourceclaims", {
+            "metadata": {"uid": "u-pending", "namespace": "default",
+                         "name": "pending"},
+            "spec": {"devices": {"requests": [
+                {"name": "tpu", "exactly": {
+                    "deviceClassName": "missing.class",
+                    "count": 1}}]}},
+        }, namespace="default")
+        kube.create(*RES, "resourceclaims",
+                    allocated_claim("u-alloc", ["chip-0"]),
+                    namespace="default")
+        sched = DraScheduler(kube, default_node="n0")
+        sched.sync_once()
+        snap = fleetstate.default_fleet().snapshot()
+        point = snap["pools"]["tpu.dra.dev/n0"]["current"]
+        assert point["allocated_devices"] == 1
+        assert snap["pending_claims"] == 1
+        assert snap["nodes"]["n0"]["power_watts"] == 480
+        # The scheduler's aggregator IS the process default served at
+        # /debug/fleet.
+        assert fleetstate.default_fleet() is sched.fleet
+
+    def test_fold_failure_never_fails_sync(self, monkeypatch):
+        kube = FakeKubeClient()
+        kube.create(*RES, "resourceslices", make_slice())
+        sched = DraScheduler(kube, default_node="n0")
+        monkeypatch.setattr(
+            sched.fleet, "observe_pass",
+            lambda *a, **kw: (_ for _ in ()).throw(RuntimeError("x")))
+        sched.sync_once()  # must not raise

@@ -1,0 +1,80 @@
+"""Grafana fleet-dashboard validation (`make validate-dashboard`,
+CI-gated): every ``tpu_dra_*`` metric name referenced by a panel expr
+in deployments/grafana/fleet-dashboard.json must actually be exposed
+by some binary's registry. The exposed-name set comes from the SAME
+registry compositions the metrics-hygiene suite scrapes, so the
+dashboard can never reference a metric that was renamed or dropped --
+and the check is pure Python (no Grafana needed)."""
+
+import json
+import os
+import re
+
+from test_metrics_hygiene import COMPOSITIONS, _compose
+
+from k8s_dra_driver_gpu_tpu.pkg.metrics import register_build_info
+
+DASHBOARD = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "deployments", "grafana", "fleet-dashboard.json")
+
+_METRIC_RE = re.compile(r"\btpu_dra_[a-z0-9_]+\b")
+
+
+def _exposed_names() -> set[str]:
+    """Every sample name any composed binary registry can expose,
+    plus the histogram series suffixes PromQL addresses directly."""
+    names: set[str] = set()
+    for builders in COMPOSITIONS.values():
+        registry = _compose(builders)
+        register_build_info(registry)
+        for fam in registry.collect():
+            base = fam.name
+            names.add(base)
+            for sample in fam.samples:
+                names.add(sample.name)
+            if fam.type == "counter":
+                names.add(base + "_total")
+            if fam.type == "histogram":
+                names.update({base + "_bucket", base + "_count",
+                              base + "_sum"})
+    return names
+
+
+def _dashboard_exprs() -> list[str]:
+    with open(DASHBOARD, encoding="utf-8") as f:
+        doc = json.load(f)
+    exprs = []
+    for panel in doc.get("panels", []):
+        for target in panel.get("targets", []):
+            if target.get("expr"):
+                exprs.append(target["expr"])
+    for var in doc.get("templating", {}).get("list", []):
+        if isinstance(var.get("query"), str):
+            exprs.append(var["query"])
+    return exprs
+
+
+def test_dashboard_parses_and_has_required_panels():
+    with open(DASHBOARD, encoding="utf-8") as f:
+        doc = json.load(f)
+    titles = " ".join(p.get("title", "").lower()
+                      for p in doc.get("panels", []))
+    # The ISSUE's panel contract: utilization, frag score,
+    # power/thermal, anomaly rate.
+    for needle in ("utilization", "fragmentation", "power", "thermal",
+                   "anomaly"):
+        assert needle in titles, f"dashboard lost its {needle} panel"
+
+
+def test_dashboard_references_only_exposed_metrics():
+    exprs = _dashboard_exprs()
+    assert exprs, "dashboard has no panel exprs"
+    exposed = _exposed_names()
+    referenced = {name for expr in exprs
+                  for name in _METRIC_RE.findall(expr)}
+    assert referenced, "dashboard references no tpu_dra_ metrics"
+    unknown = sorted(referenced - exposed)
+    assert not unknown, (
+        f"dashboard references metric name(s) not exposed by any "
+        f"binary registry: {unknown}")

@@ -17,11 +17,13 @@ from k8s_dra_driver_gpu_tpu.pkg.metrics import (
     ClaimSLOMetrics,
     ComputeDomainMetrics,
     DRARequestMetrics,
+    FleetMetrics,
     PartitionMetrics,
     PlacementMetrics,
     RecoveryMetrics,
     ResilienceMetrics,
     SchedulerMetrics,
+    register_build_info,
 )
 
 PKG_DIR = os.path.join(
@@ -46,7 +48,7 @@ def _compose(builders) -> CollectorRegistry:
 COMPOSITIONS = {
     "kubelet-plugin": (DRARequestMetrics, ResilienceMetrics,
                        RecoveryMetrics, PartitionMetrics),
-    "scheduler": (PlacementMetrics, SchedulerMetrics,
+    "scheduler": (PlacementMetrics, SchedulerMetrics, FleetMetrics,
                   ResilienceMetrics, RecoveryMetrics),
     "cd-plugin": (DRARequestMetrics, ResilienceMetrics,
                   RecoveryMetrics),
@@ -57,12 +59,96 @@ COMPOSITIONS = {
 @pytest.mark.parametrize("name", sorted(COMPOSITIONS))
 def test_registry_scrapes_clean(name):
     registry = _compose(COMPOSITIONS[name])
+    # Every binary's main also stamps the build-info gauge; it must
+    # compose (and scrape) cleanly alongside every metric class.
+    register_build_info(registry)
     text = generate_latest(registry).decode()
     families = list(text_string_to_metric_families(text))
     assert families, f"{name}: empty scrape"
     seen = [f.name for f in families]
     dupes = {n for n in seen if seen.count(n) > 1}
     assert not dupes, f"{name}: duplicate metric families {dupes}"
+
+
+@pytest.mark.parametrize("name", sorted(COMPOSITIONS))
+def test_build_info_gauge(name):
+    """Every binary's registry exposes tpu_dra_build_info with the
+    VERSION-file version and the active feature-gate set (the
+    rollout-pivot labels)."""
+    from k8s_dra_driver_gpu_tpu import __version__
+    from k8s_dra_driver_gpu_tpu.pkg.featuregates import FeatureGates
+
+    registry = _compose(COMPOSITIONS[name])
+    register_build_info(registry, FeatureGates.parse(
+        "DynamicSubSlice=true"))
+    text = generate_latest(registry).decode()
+    [fam] = [f for f in text_string_to_metric_families(text)
+             if f.name == "tpu_dra_build_info"]
+    [sample] = fam.samples
+    assert sample.value == 1
+    assert sample.labels["version"] == __version__
+    # VERSION file is the single source of truth the gauge re-exports.
+    with open(os.path.join(os.path.dirname(PKG_DIR), "VERSION"),
+              encoding="utf-8") as f:
+        assert sample.labels["version"] == f.read().strip().lstrip("v")
+    gates = sample.labels["feature_gates"].split(",")
+    assert "DynamicSubSlice" in gates
+    assert "ChipHealthCheck" in gates  # default-on gate is "active"
+
+
+# Dimensionless-by-design exceptions to the unit-suffix rule: ratios
+# and pure counts whose unit IS the quantity. Add here consciously.
+_UNITLESS_OK = {
+    "tpu_dra_placement_compactness",  # max ICI hops (a hop count)
+    "tpu_dra_chip_duty_cycle",        # 0.0-1.0 ratio
+    "tpu_dra_fleet_pool_utilization",  # 0.0-1.0 ratio
+    "tpu_dra_placement_frag_score",   # 0.0-1.0 score
+}
+
+
+def test_metric_naming_conventions():
+    """Prometheus naming-convention gate over EVERY composed registry:
+    lowercase names only, counters end `_total`, nothing else does,
+    and time/size metrics carry their `_seconds`/`_bytes` unit suffix
+    -- so new telemetry metrics can't drift from the convention the
+    dashboards (deployments/grafana) key on."""
+    lower = re.compile(r"^[a-z][a-z0-9_]*$")
+    for comp_name, builders in COMPOSITIONS.items():
+        registry = _compose(builders)
+        register_build_info(registry)
+        for fam in registry.collect():
+            for sample in fam.samples:
+                n = sample.name
+                assert lower.match(n), (
+                    f"{comp_name}: metric name {n!r} violates "
+                    "lowercase_with_underscores")
+            base = fam.name
+            if fam.type == "counter":
+                for sample in fam.samples:
+                    if sample.name.endswith("_created"):
+                        continue  # prometheus_client bookkeeping
+                    assert sample.name.endswith("_total"), (
+                        f"{comp_name}: counter sample {sample.name!r} "
+                        "must end _total")
+            else:
+                assert not base.endswith("_total"), (
+                    f"{comp_name}: non-counter {base!r} must not "
+                    "claim the _total suffix")
+            # Unit suffixes: a name that mentions a unit must END with
+            # it (tpu_dra_seconds_to_x-style misorderings drift
+            # dashboards).
+            for unit in ("seconds", "bytes"):
+                if unit in base:
+                    assert base.endswith(f"_{unit}") or \
+                        base.endswith("_total"), (
+                            f"{comp_name}: {base!r} mentions "
+                            f"{unit!r} but does not end _{unit}")
+            if fam.type == "histogram":
+                assert base.endswith(("_seconds", "_bytes")) or \
+                    base in _UNITLESS_OK, (
+                        f"{comp_name}: histogram {base!r} has no unit "
+                        "suffix; add one or register it in "
+                        "_UNITLESS_OK consciously")
 
 
 def test_exemplar_observation_scrapes_clean():

@@ -1,0 +1,457 @@
+"""Fleet telemetry plane, node half: the tpulib ``chip_telemetry``
+seam, the bounded per-chip ring (pkg/fleetstate.TelemetryRing), the
+EWMA/z-score anomaly detectors (pkg/anomaly), the health-poll
+sampling station (kubeletplugin/health.py), and the Driver wiring
+(gauges, quantized slice attributes riding the zero-write converged
+republish, deduped Warning Events, quarantine escalation)."""
+
+import json
+import logging
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.health import (
+    TAINT_KEY_PREFIX,
+    ChipHealthMonitor,
+    DeviceTaint,
+)
+from k8s_dra_driver_gpu_tpu.pkg import anomaly, fleetstate
+from k8s_dra_driver_gpu_tpu.pkg.faults import inject
+from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+    ENV_MOCK_TELEMETRY,
+    ChipTelemetry,
+    EnumerateOptions,
+    TpuLibError,
+    load,
+)
+
+logging.getLogger(
+    "k8s_dra_driver_gpu_tpu.kubeletplugin.driver").setLevel(
+    logging.ERROR)
+
+
+def sample(chip, power=100.0, temp=45.0, hbm=1 << 30, duty=0.9,
+           ici=0):
+    return ChipTelemetry(chip=chip, power_watts=power,
+                         temp_celsius=temp, hbm_used_bytes=hbm,
+                         duty_cycle=duty, ici_link_errors=ici)
+
+
+class TestBindingSeam:
+    def test_env_grammar_and_control_file(self, tmp_path, monkeypatch):
+        lib = load(prefer_native=False)
+        monkeypatch.setenv(
+            ENV_MOCK_TELEMETRY,
+            "chip=0,power=120.5,temp=55,hbm=1073741824,duty=0.85,"
+            "ici_err=3|chip=1,power=118,temp=52")
+        got = lib.chip_telemetry(EnumerateOptions())
+        assert got == (
+            ChipTelemetry(0, 120.5, 55.0, 1 << 30, 0.85, 3),
+            ChipTelemetry(1, 118.0, 52.0, 0, 0.0, 0),
+        )
+        ctl = tmp_path / "tele.ctl"
+        ctl.write_text("chip=2,power=99.5,temp=40\n")
+        monkeypatch.setenv(ENV_MOCK_TELEMETRY, f"@{ctl}")
+        assert lib.chip_telemetry(EnumerateOptions()) == (
+            ChipTelemetry(2, 99.5, 40.0, 0, 0.0, 0),)
+        # Control file re-read per poll; unset env = no samples (a
+        # host without power rails degrades, never fakes numbers).
+        ctl.write_text("")
+        assert lib.chip_telemetry(EnumerateOptions()) == ()
+        monkeypatch.delenv(ENV_MOCK_TELEMETRY)
+        assert lib.chip_telemetry(EnumerateOptions()) == ()
+
+    def test_malformed_entries_dropped(self, monkeypatch):
+        lib = load(prefer_native=False)
+        monkeypatch.setenv(ENV_MOCK_TELEMETRY,
+                           "power=9|chip=1,power=x,temp=50.x|garbage")
+        got = lib.chip_telemetry(EnumerateOptions())
+        # chip-less entries drop; atoi/atof prefix semantics keep the
+        # parsable parts.
+        assert got == (ChipTelemetry(1, 0.0, 50.0, 0, 0.0, 0),)
+
+    def test_fault_point(self, monkeypatch):
+        lib = load(prefer_native=False)
+        monkeypatch.setenv(ENV_MOCK_TELEMETRY, "chip=0,power=1")
+        with inject("tpulib.telemetry", mode="error"), \
+                pytest.raises(TpuLibError):
+            lib.chip_telemetry(EnumerateOptions())
+
+    def test_native_backend_shares_the_env_source(self, monkeypatch):
+        try:
+            native = load(prefer_native=True, build_if_missing=False)
+        except Exception:
+            pytest.skip("native backend unavailable")
+        if native.name != "native":
+            pytest.skip("native backend unavailable")
+        monkeypatch.setenv(ENV_MOCK_TELEMETRY, "chip=0,power=7,temp=3")
+        assert native.chip_telemetry(EnumerateOptions()) == (
+            ChipTelemetry(0, 7.0, 3.0, 0, 0.0, 0),)
+
+
+class TestTelemetryRing:
+    def test_bounded_per_chip(self):
+        ring = fleetstate.TelemetryRing(samples_per_chip=16)
+        for i in range(50):
+            ring.record_sample(sample(0, power=float(i)))
+        series = ring.series(0)
+        assert len(series) == 16
+        assert series[-1]["power_watts"] == 49.0
+        assert ring.recorded_total == 50
+
+    def test_latest_and_endpoint(self):
+        ring = fleetstate.TelemetryRing()
+        ring.record_sample(sample(0, temp=41.0))
+        ring.record_sample(sample(1, temp=42.0))
+        assert ring.latest()[1]["temp_celsius"] == 42.0
+        status, ctype, body = ring.telemetry_endpoint()
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert set(doc["chips"]) == {"0", "1"}
+        assert all("ts" in s for s in doc["chips"]["0"])
+
+
+class TestAnomalyDetector:
+    def det(self, **kw):
+        kw.setdefault("min_samples", 3)
+        return anomaly.AnomalyDetector(**kw)
+
+    def test_thermal_drift_fires_after_warmup_only(self):
+        # An excursion while the baseline is still warming (n <
+        # min_samples) must NOT fire -- it becomes baseline instead.
+        det = self.det()
+        assert det.observe([sample(0, temp=50.0)]) == []
+        assert det.observe([sample(0, temp=90.0)]) == []
+        # A warmed, stable baseline turns the same excursion into a
+        # detection.
+        det2 = self.det()
+        for _ in range(4):
+            assert det2.observe([sample(0, temp=45.0)]) == []
+        out = det2.observe([sample(0, temp=90.0)])
+        assert [a.kind for a in out] == [anomaly.KIND_THERMAL]
+        assert out[0].device == "chip-0"
+
+    def test_drift_is_one_episode_and_reedges_after_clear(self):
+        det = self.det()
+        for _ in range(5):
+            det.observe([sample(0, temp=45.0)])
+        assert det.observe([sample(0, temp=90.0)])
+        # Sustained condition: same episode, no new edge, but the
+        # taint level stays up (the quarantine feed sees it).
+        assert det.observe([sample(0, temp=90.0)]) == []
+        assert ("chip-0", anomaly.KIND_THERMAL) in det.active()
+        # Clears, then drifts again: a FRESH episode (the flapping the
+        # QuarantineTracker counts as transitions).
+        assert det.observe([sample(0, temp=45.0)]) == []
+        assert det.active() == frozenset()
+        assert det.observe([sample(0, temp=90.0)])
+
+    def test_steady_hot_chip_is_baseline_not_anomaly(self):
+        det = self.det()
+        for _ in range(10):
+            out = det.observe([sample(0, temp=85.0)])
+            assert out == []
+
+    def test_power_cap_throttle(self):
+        det = self.det(power_cap_w=200.0)
+        out = det.observe([sample(0, power=199.0, duty=0.95)])
+        assert [a.kind for a in out] == [anomaly.KIND_POWER]
+        # Idle at the cap is not throttling.
+        det2 = self.det(power_cap_w=200.0)
+        assert det2.observe([sample(0, power=199.0, duty=0.1)]) == []
+
+    def test_power_cap_default_disabled(self):
+        det = self.det()
+        assert det.observe([sample(0, power=9999.0, duty=1.0)]) == []
+
+    def test_ici_burst_on_delta_not_level(self):
+        det = self.det(ici_burst=5)
+        assert det.observe([sample(0, ici=100)]) == []  # first = baseline
+        assert det.observe([sample(0, ici=102)]) == []  # small delta
+        out = det.observe([sample(0, ici=110)])
+        assert [a.kind for a in out] == [anomaly.KIND_ICI]
+        assert out[0].detail["delta"] == 8
+
+    def test_duty_cycle_straggler_needs_busy_peers(self):
+        det = self.det()
+        busy = [sample(i, duty=0.9) for i in range(3)]
+        out = det.observe(busy + [sample(3, duty=0.1)])
+        assert [a.kind for a in out] == [anomaly.KIND_STRAGGLER]
+        assert out[0].device == "chip-3"
+        # Everyone idle: no straggler (the gang is not running).
+        det2 = self.det()
+        idle = [sample(i, duty=0.05) for i in range(4)]
+        assert det2.observe(idle) == []
+
+    def test_taints_reflect_level(self):
+        det = self.det(power_cap_w=100.0)
+        det.observe([sample(0, power=100.0, duty=1.0)])
+        taints = det.taints(DeviceTaint, TAINT_KEY_PREFIX)
+        assert taints == [DeviceTaint(
+            device="chip-0",
+            key=f"{TAINT_KEY_PREFIX}/{anomaly.KIND_POWER}",
+            value="true", effect="")]
+        det.observe([sample(0, power=10.0, duty=1.0)])
+        assert det.taints(DeviceTaint, TAINT_KEY_PREFIX) == []
+
+
+class _FakeTpuLib:
+    """tpulib double with a scripted per-poll telemetry feed."""
+
+    def __init__(self, feed):
+        self.feed = list(feed)
+
+    def health(self, opts):
+        return ()
+
+    def chip_telemetry(self, opts):
+        return tuple(self.feed.pop(0)) if self.feed else ()
+
+
+class _LegacyTpuLib:
+    def health(self, opts):
+        return ()
+
+
+class TestMonitorSampling:
+    def monitor(self, tpulib, **kw):
+        kw.setdefault("telemetry_ring", fleetstate.TelemetryRing())
+        return ChipHealthMonitor(
+            tpulib, EnumerateOptions(mock_topology="v5e-4"),
+            lambda taints: None, **kw)
+
+    def test_samples_land_in_ring_and_callback(self):
+        got = []
+        mon = self.monitor(
+            _FakeTpuLib([[sample(0)], [sample(0), sample(1)]]),
+            on_chip_telemetry=got.extend)
+        assert len(mon.sample_chip_telemetry()) == 1
+        assert len(mon.sample_chip_telemetry()) == 2
+        assert mon.telemetry_ring.recorded_total == 3
+        assert [s.chip for s in got] == [0, 0, 1]
+
+    def test_legacy_tpulib_degrades(self):
+        mon = self.monitor(_LegacyTpuLib())
+        assert mon.sample_chip_telemetry() == ()
+
+    def test_master_switch_disables(self, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_TELEMETRY", "0")
+        fake = _FakeTpuLib([[sample(0)]])
+        mon = self.monitor(fake)
+        assert mon.sample_chip_telemetry() == ()
+        assert fake.feed  # not even pulled
+        assert mon.telemetry_ring.recorded_total == 0
+
+    def test_anomaly_taints_merge_into_poll(self):
+        feed = [[sample(0, temp=45.0)]] * 5 + \
+            [[sample(0, temp=95.0)]] * 2
+        mon = self.monitor(
+            _FakeTpuLib(feed),
+            anomaly_detector=anomaly.AnomalyDetector(min_samples=3))
+        for _ in range(5):
+            assert mon.poll_and_reconcile() == []
+        taints = mon.poll_and_reconcile()
+        assert DeviceTaint(
+            device="chip-0",
+            key=f"{TAINT_KEY_PREFIX}/{anomaly.KIND_THERMAL}",
+            value="true", effect="") in taints
+
+    def test_broken_telemetry_never_poisons_health_poll(self):
+        class Sick(_LegacyTpuLib):
+            def chip_telemetry(self, opts):
+                raise RuntimeError("boom")
+
+        mon = self.monitor(Sick())
+        assert mon.poll_and_reconcile() == []  # health result survives
+
+
+class TestDriverWiring:
+    @pytest.fixture
+    def driver(self, tmp_root, monkeypatch):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+            Config,
+        )
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+        from tests.fake_kube import CountingKube
+
+        monkeypatch.setenv(
+            ENV_MOCK_TELEMETRY,
+            "|".join(f"chip={i},power=117,temp=48,hbm=2147483648,"
+                     f"duty=0.93" for i in range(4)))
+        fleetstate.set_default_ring(fleetstate.TelemetryRing())
+        kube = CountingKube(FakeKubeClient())
+        d = Driver(Config.mock(root=tmp_root), kube, node_name="n0")
+        d.publish_resources()
+        yield d, kube
+        d.stop()
+        fleetstate.set_default_ring(fleetstate.TelemetryRing())
+
+    def test_quantized_attrs_published(self, driver):
+        d, kube = driver
+        d._on_health_taints(d.health_monitor.poll_and_reconcile())
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        chip0 = [dev for s in slices
+                 for dev in s["spec"]["devices"]
+                 if dev["name"] == "chip-0"][0]
+        attrs = chip0["attributes"]
+        assert attrs[fleetstate.ATTR_POWER] == {"int": 120}  # 117 -> 120
+        assert attrs[fleetstate.ATTR_TEMP] == {"int": 50}    # 48 -> 50
+        assert attrs[fleetstate.ATTR_DUTY] == {"int": 90}    # 93 -> 90
+        # 2 GiB / 16 GiB = 12.5% -> 10 (v5e chips have 16 GiB HBM)
+        assert attrs[fleetstate.ATTR_HBM] == {"int": 10}
+
+    def test_converged_republish_is_zero_writes(self, driver):
+        d, kube = driver
+        d._on_health_taints(d.health_monitor.poll_and_reconcile())
+        writes = kube.writes
+        reads = kube.reads
+        for _ in range(5):
+            d._on_health_taints(d.health_monitor.poll_and_reconcile())
+        assert kube.writes == writes
+        assert kube.reads == reads  # hash memo: no list either
+
+    def test_metrics_gauges_exported(self, driver):
+        from prometheus_client import generate_latest
+
+        d, _ = driver
+        d.health_monitor.poll_and_reconcile()
+        text = generate_latest(d.metrics.registry).decode()
+        assert 'tpu_dra_chip_power_watts{chip="0"} 117.0' in text
+        assert 'tpu_dra_chip_temp_celsius{chip="3"} 48.0' in text
+
+    def test_anomaly_event_flight_and_quarantine(self, driver,
+                                                 monkeypatch):
+        d, kube = driver
+        mon = d.health_monitor
+        from k8s_dra_driver_gpu_tpu.pkg import flightrecorder
+
+        rec = flightrecorder.set_default(flightrecorder.FlightRecorder())
+        base = "|".join(f"chip={i},power=117,temp=48,duty=0.93"
+                        for i in range(4))
+        hot = base.replace("chip=1,power=117,temp=48",
+                           "chip=1,power=117,temp=95")
+        for _ in range(10):
+            monkeypatch.setenv(ENV_MOCK_TELEMETRY, base)
+            d._on_health_taints(mon.poll_and_reconcile())
+        for _ in range(4):  # thermal FLAPPING -> quarantine
+            monkeypatch.setenv(ENV_MOCK_TELEMETRY, hot)
+            d._on_health_taints(mon.poll_and_reconcile())
+            monkeypatch.setenv(ENV_MOCK_TELEMETRY, base)
+            d._on_health_taints(mon.poll_and_reconcile())
+        assert "chip-1" in mon.quarantine.quarantined
+        events = kube.list("", "v1", "events", namespace="default")
+        anomalies = [e for e in events
+                     if e["reason"] == "TelemetryAnomaly"]
+        # Deduped: 4 episodes, ONE event (deterministic name -> 409).
+        assert len(anomalies) == 1
+        assert "thermal_drift" in anomalies[0]["message"]
+        assert anomalies[0]["involvedObject"]["name"] == "n0"
+        # Flight recorder carries the per-device episode timeline.
+        kinds = [ev["kind"] for ev in rec.events("chip-1")
+                 if ev["event"] == "anomaly"]
+        assert kinds.count("thermal_drift") >= 4
+        # The published slice carries the quarantine taint.
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        chip1 = [dev for s in slices for dev in s["spec"]["devices"]
+                 if dev["name"] == "chip-1"][0]
+        assert any(t["key"] == f"{TAINT_KEY_PREFIX}/degraded"
+                   for t in chip1.get("taints", []))
+        flightrecorder.set_default(flightrecorder.FlightRecorder())
+
+    def test_ici_error_trickle_stays_zero_write(self, driver,
+                                                monkeypatch):
+        """Regression: a chronic sub-burst error trickle (cumulative
+        counter creeping +1/poll) must NOT defeat the zero-write
+        converged republish -- the attribute is quantized like every
+        other signal."""
+        d, kube = driver
+        mon = d.health_monitor
+
+        def feed(ici):
+            monkeypatch.setenv(
+                ENV_MOCK_TELEMETRY,
+                "|".join(f"chip={i},power=117,temp=48,duty=0.93,"
+                         f"ici_err={ici + i}" for i in range(4)))
+
+        feed(0)
+        d._on_health_taints(mon.poll_and_reconcile())
+        writes = kube.writes
+        for step in range(1, 6):
+            feed(step)  # +1 error per poll, below the burst threshold
+            d._on_health_taints(mon.poll_and_reconcile())
+        assert kube.writes == writes
+        # The un-quantized truth still flows through the counter.
+        from prometheus_client import generate_latest
+
+        text = generate_latest(d.metrics.registry).decode()
+        assert ('tpu_dra_chip_ici_link_errors_total{chip="0"} 5.0'
+                in text)
+
+    def test_vanished_chip_gauges_pruned(self, driver, monkeypatch):
+        """A dead sensor exports NO gauge value (not a frozen one);
+        the delta baseline resets so a returning chip re-baselines."""
+        from prometheus_client import generate_latest
+
+        d, _ = driver
+        mon = d.health_monitor
+        mon.poll_and_reconcile()
+        text = generate_latest(d.metrics.registry).decode()
+        assert 'tpu_dra_chip_power_watts{chip="3"}' in text
+        monkeypatch.setenv(
+            ENV_MOCK_TELEMETRY,
+            "|".join(f"chip={i},power=117,temp=48,duty=0.93"
+                     for i in range(3)))
+        mon.poll_and_reconcile()
+        text = generate_latest(d.metrics.registry).decode()
+        assert 'tpu_dra_chip_power_watts{chip="3"}' not in text
+        assert 'tpu_dra_chip_power_watts{chip="0"}' in text
+
+    def test_vanished_chip_drops_its_attrs(self, driver, monkeypatch):
+        """Regression: a chip whose sensor path dies must DROP its
+        slice attributes instead of publishing a frozen last reading
+        forever (replace semantics, including the all-chips-gone
+        case)."""
+        d, kube = driver
+        mon = d.health_monitor
+        d._on_health_taints(mon.poll_and_reconcile())
+        # chip-3 stops reporting.
+        monkeypatch.setenv(
+            ENV_MOCK_TELEMETRY,
+            "|".join(f"chip={i},power=117,temp=48,hbm=2147483648,"
+                     f"duty=0.93" for i in range(3)))
+        d._on_health_taints(mon.poll_and_reconcile())
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        by_name = {dev["name"]: dev for s in slices
+                   for dev in s["spec"]["devices"]}
+        assert fleetstate.ATTR_POWER in by_name["chip-0"]["attributes"]
+        assert fleetstate.ATTR_POWER not in \
+            by_name["chip-3"]["attributes"]
+        # The whole feed dying clears everything.
+        monkeypatch.setenv(ENV_MOCK_TELEMETRY, "")
+        d._on_health_taints(mon.poll_and_reconcile())
+        slices = kube.list("resource.k8s.io", "v1", "resourceslices")
+        assert not any(
+            fleetstate.ATTR_POWER in dev.get("attributes", {})
+            for s in slices for dev in s["spec"]["devices"])
+
+    def test_attrs_disabled_knob(self, tmp_root, monkeypatch):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+            Config,
+        )
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+        from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+
+        monkeypatch.setenv(ENV_MOCK_TELEMETRY, "chip=0,power=117")
+        monkeypatch.setenv("TPU_DRA_TELEMETRY_ATTRS", "0")
+        d = Driver(Config.mock(root=tmp_root), FakeKubeClient(),
+                   node_name="n0")
+        try:
+            d.publish_resources()
+            d._on_health_taints(d.health_monitor.poll_and_reconcile())
+            slices = d.generate_resource_slices()
+            attrs = [dev["attributes"] for s in slices
+                     for dev in s["spec"]["devices"]]
+            assert not any(fleetstate.ATTR_POWER in a for a in attrs)
+        finally:
+            d.stop()

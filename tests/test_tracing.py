@@ -190,6 +190,55 @@ class TestExporter:
         assert lines and lines[0]["name"] == "filed"
         assert exp.exported_total == 1
 
+    def test_jsonl_sink_rotates_at_size_cap(self, tmp_path):
+        """TPU_DRA_TRACE_FILE_MAX_MB rotation: at the size cap the
+        live file shifts to .1 (then .2 ... up to keep-N, oldest
+        dropped), bounding total disk for a long-lived sampled
+        binary."""
+        path = str(tmp_path / "trace.jsonl")
+        tracing.set_exporter(tracing.TraceExporter(
+            path=path, max_file_bytes=2000, keep_files=3))
+        for i in range(200):
+            with tracing.span(f"rot-{i}"):
+                pass
+        files = sorted(os.listdir(tmp_path))
+        assert files == ["trace.jsonl", "trace.jsonl.1",
+                         "trace.jsonl.2", "trace.jsonl.3"]
+        # keep-N bound: nothing past .3, rotated files near the cap.
+        assert os.path.getsize(tmp_path / "trace.jsonl.1") >= 2000
+        # Every rotated file still holds valid JSONL.
+        for name in files:
+            for line in open(tmp_path / name, encoding="utf-8"):
+                json.loads(line)
+
+    def test_rotation_picks_up_existing_file_size(self, tmp_path):
+        """A restart resumes the size accounting from the on-disk
+        file instead of starting at zero (the cap holds across
+        restarts)."""
+        path = str(tmp_path / "trace.jsonl")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("x" * 3000 + "\n")
+        tracing.set_exporter(tracing.TraceExporter(
+            path=path, max_file_bytes=2000, keep_files=2))
+        with tracing.span("after-restart"):
+            pass
+        assert os.path.exists(path + ".1")  # rotated immediately
+
+    def test_rotation_env_knobs(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(tracing.ENV_TRACE_FILE_MAX_MB, "0.001")
+        monkeypatch.setenv(tracing.ENV_TRACE_FILE_KEEP, "2")
+        exp = tracing.TraceExporter(path=str(tmp_path / "t.jsonl"))
+        assert exp._max_file_bytes == int(0.001 * 1024 * 1024)
+        assert exp._keep_files == 2
+
+    def test_unwritable_sink_disables_never_raises(self, tmp_path):
+        exp = tracing.set_exporter(tracing.TraceExporter(
+            path=str(tmp_path / "no-such-dir" / "t.jsonl")))
+        with tracing.span("survives"):
+            pass  # write error logged, op unaffected
+        assert exp._file_broken
+        assert len(exp.spans()) == 1  # ring still records
+
 
 class TestSegmentTimerTracing:
     def test_segments_are_child_spans_of_remote_parent(
