@@ -176,6 +176,39 @@ bench-sched-scale:
 	BENCH_SCALE_MIN_SPEEDUP=2.0 BENCH_SCALE_MAX_WRITES_PER_CLAIM=3.5 \
 	$(PYTHON) bench.py --sched-scale
 
+# 10k-node scale smoke: a shrunk deterministic `--sched-scale` run
+# exercising the PR 11 contracts -- identical allocations vs workers=1
+# on the pinned trace, per-pool snapshot DELTA rebuild >= 1.5x faster
+# than a cold rebuild (>= 5x gated at the full 10k run below) with
+# byte-identical candidate sets, and a pinned-to-exhausted-domain
+# claim spilling to its sibling domain (opt-out respected). Mirrored
+# as a non-slow test in tests/test_bench_sched_scale10k_smoke.py.
+bench-sched-scale10k-smoke:
+	BENCH_SCALE_ENTRY=scale10k BENCH_SCALE_NODES=60 \
+	BENCH_SCALE_CLAIMS=180 BENCH_SCALE_BURST=60 \
+	BENCH_SCALE_WORKERS=4 BENCH_SCALE_BATCH=16 BENCH_SCALE_PIN=1 \
+	BENCH_SCALE_REQUIRE_IDENTICAL=1 \
+	BENCH_SCALE_MAX_WRITES_PER_CLAIM=3.5 BENCH_SCALE_MAX_P99_MS=5000 \
+	BENCH_SCALE_DELTA_NODES=300 BENCH_SCALE_MIN_DELTA_SPEEDUP=1.5 \
+	BENCH_SCALE_REQUIRE_SPILLOVER=1 \
+	BENCH_SCHED_OUT=$(or $(BENCH_SCHED_OUT),/tmp/BENCH_scheduler_scale10k_smoke.json) \
+	$(PYTHON) bench.py --sched-scale
+
+# Full 10k-node x 50k-claim proof (the BENCH_scheduler.json "scale10k"
+# trajectory entry): the serialized workers=1 baseline is skipped
+# (tens of minutes of pure RTT), the headline gate is the per-pool
+# snapshot-maintenance speedup (>= 5x vs a cold full rebuild at 10k
+# nodes, byte-identical candidate sets), plus full convergence, no
+# double allocation, writes/claim <= 3.5, and the spillover proof.
+bench-sched-scale10k:
+	BENCH_SCALE_ENTRY=scale10k BENCH_SCALE_NODES=10000 \
+	BENCH_SCALE_CLAIMS=50000 BENCH_SCALE_BURST=1000 \
+	BENCH_SCALE_WORKERS=4 BENCH_SCALE_BATCH=32 BENCH_SCALE_BASELINE=0 \
+	BENCH_SCALE_MAX_WRITES_PER_CLAIM=3.5 \
+	BENCH_SCALE_MIN_DELTA_SPEEDUP=5.0 BENCH_SCALE_REQUIRE_SPILLOVER=1 \
+	TPU_DRA_SCHED_RESYNC=900 \
+	$(PYTHON) bench.py --sched-scale
+
 lint:
 	ruff check --select E9,F k8s_dra_driver_gpu_tpu/ tests/ bench.py __graft_entry__.py
 

@@ -1372,6 +1372,204 @@ class _LatencyKube:
         return getattr(self._inner, item)
 
 
+def _sched_scale_node_slices(i: int, chips: int) -> list:
+    devices = [{
+        "name": f"chip-{j}",
+        "attributes": {"type": {"string": "tpu-chip"},
+                       "index": {"int": j}},
+    } for j in range(chips)]
+    return [{
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+        "metadata": {"name": f"node-{i}-tpu.dra.dev"},
+        "spec": {
+            "driver": "tpu.dra.dev", "nodeName": f"node-{i}",
+            "pool": {"name": f"node-{i}", "generation": 1,
+                     "resourceSliceCount": 1},
+            "devices": devices,
+        },
+    }]
+
+
+def _measure_delta_maintenance(nodes_n: int, chips: int,
+                               events: int) -> dict:
+    """Steady-state snapshot-maintenance microbench: per-pool delta
+    rebuild (what the scheduler now pays per slice event) vs the cold
+    full rebuild the pre-delta scheduler paid for the SAME state.
+    Also verifies byte-identical candidate sets delta-vs-cold at every
+    event (the equivalence contract at bench scale)."""
+    import random as _random
+
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.schedcache import (
+        ClusterView,
+        InventorySnapshot,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.sliceutil import (
+        publish_resource_slices,
+    )
+
+    RES = ("resource.k8s.io", "v1")
+    fake = FakeKubeClient()
+    for i in range(nodes_n):
+        publish_resource_slices(fake, _sched_scale_node_slices(i, chips))
+    delta_pools_built = []
+    view = ClusterView(
+        fake, on_snapshot_delta=lambda pool, s: delta_pools_built.append(
+            (pool, s)))
+    view.start()
+    view.wait_for_sync(60)
+    view.snapshot()  # prime: full build
+    rng = _random.Random(_env_int("BENCH_CHAOS_SEED", 7))
+    delta_s, full_s = [], []
+    mismatches = 0
+    for k in range(events):
+        i = rng.randrange(nodes_n)
+        devs = [{
+            "name": f"chip-{j}",
+            "attributes": {"type": {"string": "tpu-chip"},
+                           "index": {"int": j}},
+        } for j in range(max(1, chips - (k % 2)))]
+        fake.patch(*RES, "resourceslices", f"node-{i}-tpu.dra.dev", {
+            "spec": {"pool": {"generation": 2 + k}, "devices": devs},
+        })
+        t0 = time.perf_counter()
+        snap = view.snapshot()  # the delta path
+        delta_s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        cold = InventorySnapshot(view.slices())  # the pre-delta cost
+        full_s.append(time.perf_counter() - t0)
+        if sorted(snap.by_key) != sorted(cold.by_key):
+            mismatches += 1
+        else:
+            key = ("tpu.dra.dev", f"node-{i}", devs[0]["name"])
+            a, b = snap.by_key.get(key), cold.by_key.get(key)
+            if (a is None) != (b is None) or \
+                    (a is not None and a.device != b.device):
+                mismatches += 1
+    view.stop()
+    delta_s.sort()
+    full_s.sort()
+    d_med = delta_s[len(delta_s) // 2]
+    f_med = full_s[len(full_s) // 2]
+    return {
+        "delta_nodes": nodes_n,
+        "delta_events": events,
+        "delta_pool_builds": len(delta_pools_built),
+        "delta_median_ms": round(d_med * 1000, 3),
+        "full_median_ms": round(f_med * 1000, 3),
+        "delta_speedup": round(f_med / max(d_med, 1e-9), 2),
+        "delta_equiv_mismatches": mismatches,
+    }
+
+
+def _prove_spillover() -> dict:
+    """Cross-domain spillover proof at fixed small scale: domain "a"
+    (1 chip) with sibling "b" (4 chips); a third "a" claim must SPILL
+    to b and allocate there (annotating intent, deduped DomainSpilled
+    event), while an opted-out claim stays pending with the
+    DomainExhausted condition."""
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.schedcache import (
+        DOMAIN_ANNOTATION,
+        SPILLED_FROM_ANNOTATION,
+        SPILLOVER_ANNOTATION,
+        SchedulingDomain,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+    from k8s_dra_driver_gpu_tpu.pkg.sliceutil import (
+        publish_resource_slices,
+    )
+
+    RES = ("resource.k8s.io", "v1")
+    fake = FakeKubeClient()
+    fake.create(*RES, "deviceclasses", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": "tpu.dra.dev"},
+        "spec": {"selectors": [{"cel": {
+            "expression": 'device.driver == "tpu.dra.dev"'}}]},
+    })
+
+    def slices(node, chips):
+        return [{
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": f"{node}-tpu.dra.dev"},
+            "spec": {"driver": "tpu.dra.dev", "nodeName": node,
+                     "pool": {"name": node, "generation": 1,
+                              "resourceSliceCount": 1},
+                     "devices": [{"name": f"chip-{j}"}
+                                 for j in range(chips)]}}]
+
+    publish_resource_slices(fake, slices("spill-a-0", 1))
+    publish_resource_slices(fake, slices("spill-b-0", 4))
+    dom_a = SchedulingDomain(
+        "a", pools=["spill-a*"],
+        siblings=[SchedulingDomain("b", pools=["spill-b*"])])
+    dom_b = SchedulingDomain("b", pools=["spill-b*"], default=True)
+    sched_a = DraScheduler(fake, domain=dom_a).start_event_driven()
+    sched_b = DraScheduler(fake, domain=dom_b).start_event_driven()
+    out = {"spillover_proven": False, "spillover_optout_respected": False,
+           "spillover_events": 0}
+    try:
+        sched_a.drain(15)
+        sched_b.drain(15)
+
+        def claim(name, optout=False):
+            ann = {DOMAIN_ANNOTATION: "a"}
+            if optout:
+                ann[SPILLOVER_ANNOTATION] = "false"
+            fake.create(*RES, "resourceclaims", {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": "default",
+                             "annotations": ann},
+                "spec": {"devices": {"requests": [{
+                    "name": "tpu", "exactly": {
+                        "deviceClassName": "tpu.dra.dev"}}]}},
+            }, namespace="default")
+
+        claim("spill-c1")
+        claim("spill-c2")
+        claim("spill-c3", optout=True)
+        deadline = time.perf_counter() + 30
+        objs = {}
+        while time.perf_counter() < deadline:
+            sched_a.drain(5)
+            sched_b.drain(5)
+            objs = {c["metadata"]["name"]: c for c in fake.objects(
+                "resource.k8s.io", "resourceclaims")
+                if c["metadata"]["name"].startswith("spill-c")}
+            if all(o.get("status", {}).get("allocation")
+                   for n, o in objs.items() if n != "spill-c3"):
+                break
+            time.sleep(0.05)
+    finally:
+        sched_a.stop()
+        sched_b.stop()
+    spilled = [o for o in objs.values()
+               if (o["metadata"].get("annotations") or {}).get(
+                   SPILLED_FROM_ANNOTATION) == "a"]
+    if len(spilled) == 1 and spilled[0].get("status", {}).get(
+            "allocation"):
+        alloc = spilled[0]["status"]["allocation"]
+        pools = {r["pool"] for r in alloc["devices"]["results"]}
+        ann = spilled[0]["metadata"]["annotations"]
+        out["spillover_proven"] = (
+            pools == {"spill-b-0"}
+            and ann.get(DOMAIN_ANNOTATION) == "b")
+    c3 = objs.get("spill-c3", {})
+    conds = [c.get("type") for c in c3.get("status", {}).get(
+        "conditions") or []]
+    out["spillover_optout_respected"] = (
+        not c3.get("status", {}).get("allocation")
+        and "DomainExhausted" in conds
+        and (c3["metadata"].get("annotations") or {}).get(
+            DOMAIN_ANNOTATION) == "a")
+    out["spillover_events"] = sum(
+        1 for e in fake.objects("", "events")
+        if e.get("reason") == "DomainSpilled")
+    return out
+
+
 def bench_sched_scale() -> dict:
     """Scheduler scale-out mode (`bench.py --sched-scale`): a
     1000-node x 5000-claim batch-heavy arrival trace (claims+pods land
@@ -1381,7 +1579,10 @@ def bench_sched_scale() -> dict:
     allocation), under a simulated apiserver RTT. Reports wall clock,
     writes per converged claim, p50/p99 claim->allocation latency,
     syncs/sec, and the multi-worker speedup; validates every claim
-    converged, every pod bound, and NO device double-allocated.
+    converged, every pod bound, and NO device double-allocated. Two
+    companion stages ride along: the snapshot-maintenance microbench
+    (per-pool delta rebuild vs cold full rebuild -- the 10k-node
+    O(changes) contract) and the cross-domain spillover proof.
 
     Knobs: BENCH_SCALE_NODES (1000), BENCH_SCALE_CLAIMS (5000),
     BENCH_SCALE_CHIPS (8/node), BENCH_SCALE_BURST (250 claims/burst),
@@ -1389,11 +1590,18 @@ def bench_sched_scale() -> dict:
     BENCH_SCALE_RTT_READ_MS (1.0) / BENCH_SCALE_RTT_WRITE_MS (2.0),
     BENCH_SCALE_PIN (0; 1 = deterministic node+chip pinning so the
     workers=1 and workers=N runs must produce IDENTICAL allocations --
-    the smoke-gate equivalence mode).
+    the smoke-gate equivalence mode), BENCH_SCALE_BASELINE (1; 0 skips
+    the workers=1 run -- the 10k-scale mode, where the serialized
+    baseline alone would take tens of minutes), BENCH_SCALE_DELTA (1;
+    0 skips the delta microbench) + BENCH_SCALE_DELTA_NODES /
+    BENCH_SCALE_DELTA_EVENTS, BENCH_SCALE_SPILLOVER (1; 0 skips the
+    spillover proof), BENCH_SCALE_ENTRY (trajectory key, "scale";
+    the 10k run writes "scale10k").
 
     Gates (exit nonzero when set): BENCH_SCALE_MAX_WRITES_PER_CLAIM,
     BENCH_SCALE_MIN_SPEEDUP, BENCH_SCALE_MAX_P99_MS,
-    BENCH_SCALE_REQUIRE_IDENTICAL=1."""
+    BENCH_SCALE_REQUIRE_IDENTICAL=1, BENCH_SCALE_MIN_DELTA_SPEEDUP,
+    BENCH_SCALE_REQUIRE_SPILLOVER=1."""
     from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
     from k8s_dra_driver_gpu_tpu.pkg.metrics import SchedulerMetrics
     from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
@@ -1413,21 +1621,7 @@ def bench_sched_scale() -> dict:
     RES = ("resource.k8s.io", "v1")
 
     def node_slices(i: int) -> list:
-        devices = [{
-            "name": f"chip-{j}",
-            "attributes": {"type": {"string": "tpu-chip"},
-                           "index": {"int": j}},
-        } for j in range(chips)]
-        return [{
-            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
-            "metadata": {"name": f"node-{i}-tpu.dra.dev"},
-            "spec": {
-                "driver": "tpu.dra.dev", "nodeName": f"node-{i}",
-                "pool": {"name": f"node-{i}", "generation": 1,
-                         "resourceSliceCount": 1},
-                "devices": devices,
-            },
-        }]
+        return _sched_scale_node_slices(i, chips)
 
     def _sync_count(sm) -> int:
         total = 0
@@ -1560,10 +1754,9 @@ def bench_sched_scale() -> dict:
             "allocations": allocations,
         }
 
-    single = run_scale(1)
+    baseline = os.environ.get("BENCH_SCALE_BASELINE", "1") == "1"
+    single = run_scale(1) if baseline else None
     multi = run_scale(workers_n)
-    speedup = single["elapsed_s"] / max(multi["elapsed_s"], 1e-9)
-    identical = single["allocations"] == multi["allocations"]
     extras = {
         "scale_nodes": nodes_n,
         "scale_claims": claims_total,
@@ -1574,22 +1767,46 @@ def bench_sched_scale() -> dict:
         "scale_rtt_read_ms": read_s * 1000,
         "scale_rtt_write_ms": write_s * 1000,
         "scale_pinned": pin,
-        "scale_speedup": round(speedup, 2),
-        "scale_identical_allocations": identical,
+        "scale_baseline_run": baseline,
     }
-    for r in (single, multi):
+    speedup = None
+    if single is not None:
+        speedup = single["elapsed_s"] / max(multi["elapsed_s"], 1e-9)
+        extras["scale_speedup"] = round(speedup, 2)
+        extras["scale_identical_allocations"] = (
+            single["allocations"] == multi["allocations"])
+    runs = [multi] if single is None else [single, multi]
+    for r in runs:
         prefix = f"scale_w{r['workers']}"
         for key, val in r.items():
             if key in ("allocations", "workers"):
                 continue
             extras[f"{prefix}_{key}"] = val
+    if os.environ.get("BENCH_SCALE_DELTA", "1") == "1":
+        delta = _measure_delta_maintenance(
+            _env_int("BENCH_SCALE_DELTA_NODES", nodes_n), chips,
+            _env_int("BENCH_SCALE_DELTA_EVENTS", 30))
+        for key, val in delta.items():
+            extras[f"scale_{key}"] = val
+    if os.environ.get("BENCH_SCALE_SPILLOVER", "1") == "1":
+        for key, val in _prove_spillover().items():
+            extras[f"scale_{key}"] = val
+    if speedup is not None:
+        value = round(speedup, 2)
+        metric = "sched_scale_multiworker_speedup"
+    else:
+        # 10k mode: no serialized baseline; the headline number is the
+        # snapshot-maintenance win instead.
+        value = extras.get("scale_delta_speedup", 0.0)
+        metric = "sched_scale_delta_speedup"
     return {
-        "metric": "sched_scale_multiworker_speedup",
-        "value": round(speedup, 2),
+        "metric": metric,
+        "value": value,
         "unit": "x",
-        # >1 = sharded multi-worker beats the serialized drain while
-        # staying write-frugal and correct.
-        "vs_baseline": round(speedup, 2),
+        # >1 = the measured configuration beats its pre-PR baseline
+        # (serialized drain, or the cold full rebuild in 10k mode)
+        # while staying write-frugal and correct.
+        "vs_baseline": value,
         "extras": extras,
     }
 
@@ -2945,21 +3162,25 @@ def _dispatch() -> None:
         if not doc:
             doc = {"metric": "sched_kube_writes_per_converged_claim"}
         # The scale run is a trajectory ENTRY in BENCH_scheduler.json,
-        # alongside (never clobbering) the churn result.
-        doc["scale"] = result
+        # alongside (never clobbering) the churn result. The 10k run
+        # writes its own entry key (BENCH_SCALE_ENTRY=scale10k).
+        entry = os.environ.get("BENCH_SCALE_ENTRY", "scale")
+        doc[entry] = result
         with open(out_path, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
             f.write("\n")
         print(json.dumps(result))
         ex = result["extras"]
         wkey = "scale_w%d" % ex["scale_workers"]
+        has_baseline = ex.get("scale_baseline_run", True)
         ok = True
-        if ex["scale_w1_double_allocated"] or \
-                ex[wkey + "_double_allocated"]:
+        if ex[wkey + "_double_allocated"] or \
+                (has_baseline and ex["scale_w1_double_allocated"]):
             print("sched-scale gate failed: device double-allocated",
                   file=sys.stderr)
             ok = False
-        if ex["scale_w1_unconverged"] or ex[wkey + "_unconverged"]:
+        if ex[wkey + "_unconverged"] or \
+                (has_baseline and ex["scale_w1_unconverged"]):
             print("sched-scale gate failed: unconverged claims",
                   file=sys.stderr)
             ok = False
@@ -2979,19 +3200,46 @@ def _dispatch() -> None:
         ok = _ceiling("BENCH_SCALE_MAX_WRITES_PER_CLAIM",
                       wkey + "_writes_per_claim") and ok
         ok = _ceiling("BENCH_SCALE_MAX_P99_MS", wkey + "_p99_ms") and ok
-        try:
-            floor = float(os.environ.get("BENCH_SCALE_MIN_SPEEDUP", "0"))
-        except ValueError:
-            floor = 0.0
-        if floor and ex["scale_speedup"] < floor:
+
+        def _floor_env(env: str) -> float:
+            try:
+                return float(os.environ.get(env, "0"))
+            except ValueError:
+                return 0.0
+
+        floor = _floor_env("BENCH_SCALE_MIN_SPEEDUP")
+        if floor and ex.get("scale_speedup", 0.0) < floor:
             print(f"sched-scale gate failed: speedup="
-                  f"{ex['scale_speedup']} < {floor}", file=sys.stderr)
+                  f"{ex.get('scale_speedup')} < {floor}",
+                  file=sys.stderr)
             ok = False
         if os.environ.get("BENCH_SCALE_REQUIRE_IDENTICAL") == "1" and \
-                not ex["scale_identical_allocations"]:
+                not ex.get("scale_identical_allocations"):
             print("sched-scale gate failed: multi-worker allocations "
                   "differ from workers=1", file=sys.stderr)
             ok = False
+        floor = _floor_env("BENCH_SCALE_MIN_DELTA_SPEEDUP")
+        if floor and ex.get("scale_delta_speedup", 0.0) < floor:
+            print(f"sched-scale gate failed: delta_speedup="
+                  f"{ex.get('scale_delta_speedup')} < {floor}",
+                  file=sys.stderr)
+            ok = False
+        if "scale_delta_equiv_mismatches" in ex and \
+                ex["scale_delta_equiv_mismatches"]:
+            print("sched-scale gate failed: delta snapshot diverged "
+                  f"from cold rebuild at "
+                  f"{ex['scale_delta_equiv_mismatches']} events",
+                  file=sys.stderr)
+            ok = False
+        if os.environ.get("BENCH_SCALE_REQUIRE_SPILLOVER") == "1":
+            if not ex.get("scale_spillover_proven"):
+                print("sched-scale gate failed: pinned claim did not "
+                      "spill to the sibling domain", file=sys.stderr)
+                ok = False
+            if not ex.get("scale_spillover_optout_respected"):
+                print("sched-scale gate failed: spillover opt-out "
+                      "annotation was not respected", file=sys.stderr)
+                ok = False
         if not ok:
             sys.exit(1)
         return

@@ -77,7 +77,9 @@ RULES: dict[str, str] = {
                  "wrapper (or kube call without an explicit timeout)",
     "TPUDRA009": "scheduler sync path lists a watched resource via the "
                  "raw kube client instead of the informer-backed "
-                 "ClusterView/snapshot (pkg/schedcache)",
+                 "ClusterView/snapshot (pkg/schedcache), or mutates "
+                 "per-pool sub-snapshot internals outside "
+                 "pkg/schedcache.py's delta paths",
     "TPUDRA010": "blocking kube I/O while holding the scheduler "
                  "registry lock (_state_lock) or the allocation-state "
                  "lock; commit I/O is sanctioned under per-node locks "
@@ -136,6 +138,20 @@ _RAW_KUBECLIENT_FILES = {"kubeclient.py", "retry.py"}
 # TPUDRA009 scope: the scheduler's sync paths (the ClusterView in
 # schedcache.py is the sanctioned listing layer and is out of scope).
 _SCHED_SYNC_FILES = {"scheduler.py"}
+# TPUDRA009 sub-snapshot fence: the per-pool incremental snapshot's
+# internals (pkg/schedcache PoolSnapshot / InventorySnapshot merged
+# indexes + memos) are shared BY IDENTITY across snapshot generations
+# -- an external mutation corrupts every generation holding the
+# object, silently, for untouched pools. Only schedcache.py's delta
+# paths may mutate them; consumers go through the read surface and
+# the order_memo_get/put accessors. Rel-path sanctioned (the TPUDRA011
+# lesson): a stray schedcache.py elsewhere gets no pass.
+_SNAPSHOT_INTERNAL_ATTRS = {
+    "by_key", "by_node", "pool_generations", "counter_seeds",
+    "sel_cache", "_sel_cache", "order_cache", "slice_sigs",
+    "delta_pools", "_pools_of_node", "candidates",
+}
+_SNAPSHOT_MUT_SUFFIXES = ("pkg/schedcache.py", "analysis/lint.py")
 # TPUDRA010 / sched-lock-hierarchy scope: the modules that define and
 # use the sharded-allocation locks.
 _SCHED_LOCK_FILES = {"scheduler.py", "schedcache.py"}
@@ -784,6 +800,14 @@ class _ModuleLinter(ast.NodeVisitor):
                         key=f"{base_src}.{attr}",
                     )
 
+            # TPUDRA009 (sub-snapshot fence): mutator method on a
+            # protected schedcache internal (snap.candidates.append,
+            # pool.sel_cache.update, snap.pools.pop, ...) outside the
+            # sanctioned delta paths.
+            if attr in _MUTATORS and isinstance(func, ast.Attribute):
+                self._check_snapshot_internal_write(
+                    func.value, node, f"{attr}()")
+
             # TPUDRA009: raw kube.list of a watched resource inside the
             # scheduler's sync paths -- these reads must come from the
             # informer-backed ClusterView / inventory snapshot.
@@ -942,7 +966,48 @@ class _ModuleLinter(ast.NodeVisitor):
 
         self.generic_visit(node)
 
+    def _snapshot_mut_sanctioned(self) -> bool:
+        rel_posix = self.rel.replace(os.sep, "/")
+        return any(rel_posix.endswith(sfx)
+                   for sfx in _SNAPSHOT_MUT_SUFFIXES)
+
+    def _check_snapshot_internal_write(self, container,
+                                       node, how: str) -> None:
+        """TPUDRA009 (sub-snapshot fence): ``container`` is the
+        expression whose contents are being mutated (e.g. the
+        ``snap.order_cache`` in ``snap.order_cache[k] = v``); flag it
+        when it is a protected schedcache internal and this module is
+        not sanctioned."""
+        if not isinstance(container, ast.Attribute):
+            return
+        if container.attr not in _SNAPSHOT_INTERNAL_ATTRS:
+            return
+        # A class initializing ITS OWN attribute of the same name is
+        # someone else's business (self.X = ... / self.X.append(...)).
+        root = container.value
+        if isinstance(root, ast.Name) and root.id == "self":
+            return
+        if self._snapshot_mut_sanctioned():
+            return
+        src = _unparse(container)
+        self._emit(
+            "TPUDRA009", node,
+            f"{how} of per-pool sub-snapshot internal {src!r} outside "
+            "pkg/schedcache.py: these structures are shared by "
+            "identity across snapshot generations -- mutate only "
+            "through schedcache delta paths (topology order memos: "
+            "order_memo_get/put)",
+            key=f"snapmut:{src}:{how}",
+        )
+
     def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                self._check_snapshot_internal_write(
+                    target.value, node, "subscript write")
+            elif isinstance(target, ast.Attribute):
+                self._check_snapshot_internal_write(
+                    target, node, "attribute rebind")
         fs = self._fs()
         if fs is not None:
             # TPUDRA008 bookkeeping: locals bound to a raw KubeClient.
@@ -983,6 +1048,15 @@ class _ModuleLinter(ast.NodeVisitor):
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
         target = node.target
+        if isinstance(target, ast.Subscript):
+            self._check_snapshot_internal_write(
+                target.value, node, "augmented subscript write")
+        elif isinstance(target, ast.Attribute):
+            # snap.order_cache |= {...} / pool.candidates += [...]
+            # mutate the shared internal just as surely as a
+            # subscript write.
+            self._check_snapshot_internal_write(
+                target, node, "augmented attribute write")
         if isinstance(target, (ast.Subscript, ast.Attribute)) and \
                 self._is_tainted(target.value):
             self._emit(
@@ -994,6 +1068,10 @@ class _ModuleLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                self._check_snapshot_internal_write(
+                    target.value, node, "del")
         for target in node.targets:
             if isinstance(target, (ast.Subscript, ast.Attribute)) and \
                     self._is_tainted(target.value):

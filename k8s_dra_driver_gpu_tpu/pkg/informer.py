@@ -25,12 +25,146 @@ Works against both clients:
 
 from __future__ import annotations
 
+import heapq
 import json
 import logging
+import os
+import random
 import threading
+import time
 from typing import Callable
 
 logger = logging.getLogger(__name__)
+
+# Relist priority (lower = first): the allocation-critical state
+# (slices = the inventory, claims = the held allocations) must be
+# fresh before anything else is worth scheduling against, so a restart
+# storm drains those first; pods/daemonsets/jobs are derived work that
+# tolerates a stale cache longest. Unlisted resources drain last.
+RELIST_PRIORITY: dict[str, int] = {
+    "resourceslices": 0,
+    "resourceclaims": 1,
+    "deviceclasses": 2,
+    "resourceclaimtemplates": 2,
+    "computedomains": 2,
+    "nodes": 3,
+    "pods": 4,
+    "daemonsets": 5,
+    "jobs": 5,
+}
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+class RelistCoordinator:
+    """Shards full relists across a set of informers.
+
+    A restart storm (apiserver bounce, watch-gap burst) used to fire
+    all nine informers' relists at once -- nine concurrent full LISTs
+    against an apiserver that just came back. Routed through this
+    coordinator they instead drain as a bounded trickle:
+
+    - **Concurrency cap** (``TPU_DRA_SCHED_RELIST_CONCURRENCY``,
+      default 2): at most N relists in flight at once.
+    - **Priority ordering** (:data:`RELIST_PRIORITY`): queued waiters
+      are admitted slices/claims before pods/daemonsets, so the
+      allocation-critical caches recover first.
+    - **Per-resource jittered backoff**
+      (``TPU_DRA_SCHED_RELIST_BASE_S`` doubling per consecutive
+      relist up to ``TPU_DRA_SCHED_RELIST_MAX_S``, 50-100% decorrelated
+      jitter, streak reset after ``TPU_DRA_SCHED_RELIST_QUIET_S`` of
+      quiet): a resource whose watch keeps gapping backs off
+      exponentially instead of hammering LIST in a tight loop. The
+      applied delay is reported through ``on_backoff(resource,
+      seconds)`` (exported as
+      ``tpu_dra_informer_relist_backoff_seconds``).
+
+    The first relist of a quiet resource (startup, an isolated gap)
+    pays zero delay -- only *repeat* relists inside the quiet window
+    back off."""
+
+    def __init__(self, concurrency: int | None = None,
+                 base_delay: float | None = None,
+                 max_delay: float | None = None,
+                 quiet_period: float | None = None,
+                 on_backoff: Callable[[str, float], None] | None = None,
+                 rng: random.Random | None = None,
+                 time_fn: Callable[[], float] = time.monotonic,
+                 sleep_fn: Callable[[float], None] = time.sleep):
+        if concurrency is None:
+            concurrency = int(_env_float(
+                "TPU_DRA_SCHED_RELIST_CONCURRENCY", 2))
+        self.concurrency = max(1, concurrency)
+        self.base_delay = (base_delay if base_delay is not None else
+                           _env_float("TPU_DRA_SCHED_RELIST_BASE_S", 0.5))
+        self.max_delay = (max_delay if max_delay is not None else
+                          _env_float("TPU_DRA_SCHED_RELIST_MAX_S", 30.0))
+        self.quiet_period = (quiet_period if quiet_period is not None else
+                             _env_float("TPU_DRA_SCHED_RELIST_QUIET_S",
+                                        60.0))
+        self._on_backoff = on_backoff
+        self._rng = rng if rng is not None else random.Random()
+        self._time = time_fn
+        self._sleep = sleep_fn
+        self._cv = threading.Condition()
+        self._active = 0
+        self._seq = 0
+        self._waiting: list[tuple[int, int, object]] = []
+        self._streak: dict[str, int] = {}
+        self._last: dict[str, float] = {}
+
+    def backoff_for(self, resource: str) -> float:
+        """Advance the resource's streak and return the jittered delay
+        to apply before its next relist (0 for a quiet resource)."""
+        with self._cv:
+            now = self._time()
+            last = self._last.get(resource)
+            if last is not None and now - last < self.quiet_period:
+                self._streak[resource] = self._streak.get(resource, 0) + 1
+            else:
+                self._streak[resource] = 0
+            n = self._streak[resource]
+            if n <= 0:
+                return 0.0
+            delay = min(self.base_delay * (2 ** (n - 1)), self.max_delay)
+            return delay * (0.5 + self._rng.random() * 0.5)
+
+    def run(self, resource: str, fn: Callable[[], None]) -> None:
+        """Apply the resource's backoff, then run ``fn`` inside the
+        priority-ordered concurrency gate."""
+        delay = self.backoff_for(resource)
+        if delay > 0:
+            if self._on_backoff is not None:
+                try:
+                    self._on_backoff(resource, delay)
+                except Exception:  # noqa: BLE001 - metrics hook
+                    logger.exception("relist backoff hook failed")
+            self._sleep(delay)
+        pri = RELIST_PRIORITY.get(resource, 9)
+        token = object()
+        with self._cv:
+            self._seq += 1
+            heapq.heappush(self._waiting, (pri, self._seq, token))
+            while self._active >= self.concurrency or \
+                    self._waiting[0][2] is not token:
+                self._cv.wait(timeout=5.0)
+            heapq.heappop(self._waiting)
+            self._active += 1
+            # Wake the next head: with free slots it may run NOW,
+            # concurrently with us.
+            self._cv.notify_all()
+        try:
+            fn()
+        finally:
+            with self._cv:
+                self._active -= 1
+                self._last[resource] = self._time()
+                self._cv.notify_all()
 
 
 class Informer:
@@ -44,6 +178,7 @@ class Informer:
         namespace: str | None = None,
         resync_period: float = 30.0,
         on_relist: Callable[[], None] | None = None,
+        coordinator: RelistCoordinator | None = None,
     ):
         self.kube = kube
         self.group = group
@@ -65,6 +200,10 @@ class Informer:
         # covers the whole burst.
         self.relist_total = 0
         self._on_relist = on_relist
+        # Optional RelistCoordinator: full relists then queue through
+        # the shared priority/concurrency/backoff gate instead of
+        # hitting the apiserver immediately (restart-storm discipline).
+        self._coordinator = coordinator
         self._relist_lock = threading.Lock()
         self._relist_active = False
         self._relist_pending = False
@@ -223,7 +362,11 @@ class Informer:
             self._relist_active = True
         try:
             while True:
-                self._relist_once()
+                if self._coordinator is not None:
+                    self._coordinator.run(self.resource,
+                                          self._relist_once)
+                else:
+                    self._relist_once()
                 with self._relist_lock:
                     if not self._relist_pending:
                         return

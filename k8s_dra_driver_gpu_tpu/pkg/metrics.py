@@ -488,6 +488,40 @@ class SchedulerMetrics:
             buckets=_BUCKETS,
             registry=self.registry,
         )
+        self.snapshot_delta = Histogram(
+            "tpu_dra_sched_snapshot_delta_seconds",
+            "Per-pool incremental sub-snapshot rebuild time on the "
+            "delta path (pkg/schedcache PoolSnapshot): one sample per "
+            "pool actually re-projected by a slice event; untouched "
+            "pools merge by identity and cost nothing. A healthy "
+            "10k-node fleet shows this replacing snapshot_build "
+            "entirely outside full resyncs.",
+            ["pool"],
+            buckets=_BUCKETS,
+            registry=self.registry,
+        )
+        self.relist_backoff = Histogram(
+            "tpu_dra_informer_relist_backoff_seconds",
+            "Jittered backoff the relist coordinator applied before "
+            "an informer's full relist (pkg/informer "
+            "RelistCoordinator): repeated relists of one resource "
+            "inside the quiet window back off exponentially so a "
+            "restart storm drains without thundering-herding the "
+            "apiserver. Quiet resources relist with zero delay and "
+            "record nothing here.",
+            ["resource"],
+            buckets=_BUCKETS,
+            registry=self.registry,
+        )
+        self.domain_spilled = Counter(
+            "tpu_dra_sched_domain_spilled_total",
+            "Claims re-homed by cross-domain spillover: a claim "
+            "pinned to an exhausted scheduling domain was annotated "
+            "over to a sibling domain (migration-cost ranked) instead "
+            "of pending forever.",
+            ["from_domain", "to_domain"],
+            registry=self.registry,
+        )
         self.domain_exhausted = Counter(
             "tpu_dra_sched_domain_exhausted_total",
             "Allocation attempts for domain-pinned claims that found "

@@ -33,12 +33,13 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from typing import Callable
 
 from . import tracing
 from .cel import CelProgram, Quantity, compile_expression
-from .informer import Informer
+from .informer import RELIST_PRIORITY, Informer, RelistCoordinator
 from .kubeclient import KubeError, NotFoundError
 
 logger = logging.getLogger(__name__)
@@ -182,21 +183,92 @@ class Candidate:
         return (self.driver, self.pool, self.name)
 
 
+def pool_key_of(slice_obj: dict) -> tuple[str, str]:
+    """(driver, pool name) for one ResourceSlice."""
+    spec = slice_obj.get("spec", {})
+    return (spec.get("driver", ""),
+            spec.get("pool", {}).get("name", ""))
+
+
+class PoolSnapshot:
+    """The allocation-relevant projection of ONE (driver, pool)'s
+    ResourceSlices: newest-generation candidates with a per-node
+    split, KEP-4815 counter seeds, the pool-scoped static-CEL memo,
+    and the slice signature triples the incremental rebuild diffs on.
+
+    Immutable after construction and shared BY IDENTITY across
+    snapshot generations: a slice event rebuilds only the affected
+    pool's PoolSnapshot, every untouched pool -- candidates, CEL
+    memos, everything -- rides into the next merged view untouched
+    (the mutation-isolation property tests/test_sched_delta.py pins).
+    Mutating these internals outside pkg/schedcache.py is lint-fenced
+    (TPUDRA009, pkg/analysis)."""
+
+    __slots__ = ("driver", "pool", "generation", "slice_sigs",
+                 "candidates", "by_node", "nodes", "counter_seeds",
+                 "sel_cache")
+
+    def __init__(self, driver: str, pool: str, slices: list[dict],
+                 default_node: str | None = None):
+        self.driver = driver
+        self.pool = pool
+        # Name-sorted so the build is a pure function of the slice SET
+        # -- event-ordered delta rebuilds and listing-ordered cold
+        # rebuilds must produce byte-identical candidate sequences.
+        ordered = sorted(slices, key=lambda s: s.get(
+            "metadata", {}).get("name", ""))
+        self.slice_sigs = tuple(
+            (s.get("metadata", {}).get("name", ""),
+             s.get("metadata", {}).get("resourceVersion", ""),
+             s.get("spec", {}).get("pool", {}).get("generation", 0))
+            for s in ordered)
+        gen = 0
+        for s in ordered:
+            gen = max(gen, s.get("spec", {}).get("pool", {}).get(
+                "generation", 0))
+        self.generation = gen
+        self.candidates: list[Candidate] = []
+        self.counter_seeds: list[list[dict]] = []
+        for s in ordered:
+            spec = s.get("spec", {})
+            if spec.get("pool", {}).get("generation", 0) != gen:
+                continue  # stale generation: invisible to allocation
+            node = spec.get("nodeName") or default_node or ""
+            if spec.get("sharedCounters"):
+                self.counter_seeds.append(spec["sharedCounters"])
+            for dev in spec.get("devices", []):
+                self.candidates.append(
+                    Candidate(driver, pool, node, dev))
+        self.by_node: dict[str, list[Candidate]] = {}
+        for c in self.candidates:
+            self.by_node.setdefault(c.node, []).append(c)
+        self.nodes = frozenset(self.by_node)
+        # (expression, device name) -> bool; pool-scoped so it shares
+        # the PoolSnapshot's lifetime exactly.
+        self.sel_cache: dict[tuple[str, str], bool] = {}
+
+
 class InventorySnapshot:
-    """The allocation-relevant projection of the published
-    ResourceSlices, built once per slice change:
+    """The merged allocation view over per-pool sub-snapshots
+    (:class:`PoolSnapshot`), built once per slice change:
 
     - ``candidates`` / ``by_key`` / ``by_node``: newest-generation
       devices, indexed for the per-node fit.
     - counter seeds for a fresh :class:`CounterLedger`.
     - ``cel_match``: memoized static-selector evaluation -- one CEL
-      run per (expression, device) for the snapshot's LIFETIME, not
-      per claim per pass.
-    - ``order_cache``: the topology scorer's candidate-ordering memos
-      (moved here from the scheduler's per-pass cache; they are pure
-      functions of the inventory, so they live exactly as long as it
-      does and invalidate on any slice write / generation bump).
-    """
+      run per (expression, device) for the owning POOL sub-snapshot's
+      lifetime (which spans merged-view generations for untouched
+      pools), not per claim per pass.
+    - ``order_cache``: the topology scorer's candidate-ordering memos,
+      keyed ``(driver, pool, names, want)`` -- pure functions of one
+      pool's inventory, so delta rebuilds carry untouched pools'
+      entries forward and drop exactly the changed pools'.
+
+    Two build paths share the result shape: the cold ``__init__``
+    (O(slices), direct mode / first build) and :meth:`delta`
+    (O(changes): only the dirtied pools re-project; untouched
+    :class:`PoolSnapshot` objects merge by IDENTITY and the top-level
+    indexes are pointer-copied, never content-copied)."""
 
     @staticmethod
     def signature_of(slices: list[dict]) -> tuple:
@@ -209,57 +281,195 @@ class InventorySnapshot:
 
     def __init__(self, slices: list[dict], signature: tuple | None = None,
                  default_node: str | None = None):
-        self.signature = (self.signature_of(slices)
-                          if signature is None else signature)
-        newest: dict[tuple, int] = {}
+        self._signature = (self.signature_of(slices)
+                           if signature is None else signature)
+        self.default_node = default_node
+        # Build seq / delta lineage: stamped by the owning ClusterView
+        # so consumers (AllocationState.retarget) can learn WHICH pools
+        # changed between two snapshots they hold.
+        self.build_seq: int | None = None
+        self.delta_pools: frozenset = frozenset()
+        buckets: dict[tuple[str, str], list[dict]] = {}
         for s in slices:
-            spec = s.get("spec", {})
-            pool = spec.get("pool", {})
-            key = (spec.get("driver", ""), pool.get("name", ""))
-            newest[key] = max(newest.get(key, 0),
-                              pool.get("generation", 0))
-        self.pool_generations = newest
-        self.candidates: list[Candidate] = []
-        self._counter_seeds: list[tuple[str, str, list[dict]]] = []
-        for s in slices:
-            spec = s.get("spec", {})
-            pool = spec.get("pool", {})
-            driver = spec.get("driver", "")
-            pool_name = pool.get("name", "")
-            if pool.get("generation", 0) != newest[(driver, pool_name)]:
-                continue  # stale generation: invisible to allocation
-            node = spec.get("nodeName") or default_node or ""
-            if spec.get("sharedCounters"):
-                self._counter_seeds.append(
-                    (driver, pool_name, spec["sharedCounters"]))
-            for dev in spec.get("devices", []):
-                self.candidates.append(
-                    Candidate(driver, pool_name, node, dev))
-        self.by_key: dict[tuple, Candidate] = {
-            c.key: c for c in self.candidates}
-        self.by_node: dict[str, list[Candidate]] = {}
-        for c in self.candidates:
-            self.by_node.setdefault(c.node, []).append(c)
+            buckets.setdefault(pool_key_of(s), []).append(s)
+        self.pools: dict[tuple[str, str], PoolSnapshot] = {
+            pk: PoolSnapshot(pk[0], pk[1], group, default_node)
+            for pk, group in buckets.items()
+        }
         self.order_cache: dict[tuple, list[str] | None] = {}
-        self._sel_cache: dict[tuple[str, tuple], bool] = {}
+        self._sel_cache: dict[tuple, bool] = {}
+        self._candidates: list[Candidate] | None = None
+        self._index_pools()
+
+    def _index_pools(self) -> None:
+        """(Re)build the merged indexes from scratch for a cold build:
+        deterministic pool-key order so cold and delta builds agree."""
+        self.pool_generations = {
+            pk: p.generation for pk, p in self.pools.items()}
+        self.by_key: dict[tuple, Candidate] = {}
+        self.by_node: dict[str, list[Candidate]] = {}
+        self._pools_of_node: dict[str, frozenset] = {}
+        pools_of_node: dict[str, set] = {}
+        for pk in sorted(self.pools):
+            for c in self.pools[pk].candidates:
+                self.by_key[c.key] = c
+            for node in self.pools[pk].nodes:
+                pools_of_node.setdefault(node, set()).add(pk)
+        for node, pks in pools_of_node.items():
+            self._pools_of_node[node] = frozenset(pks)
+            self.by_node[node] = self._merge_node(node, pks)
+
+    def _merge_node(self, node: str, pks) -> list[Candidate]:
+        """One node's merged candidate list. A single-pool node (the
+        common node-local-pool case) SHARES the pool's list by
+        identity -- delta rebuilds then copy only pointers."""
+        if len(pks) == 1:
+            (only,) = pks
+            return self.pools[only].by_node[node]
+        return [c for pk in sorted(pks)
+                for c in self.pools[pk].by_node.get(node, ())]
+
+    @property
+    def signature(self) -> tuple:
+        """Sorted per-slice (name, resourceVersion, generation)
+        triples. Delta builds compute it LAZILY from the per-pool
+        signature shards -- the event-mode fast path never needs it."""
+        if self._signature is None:
+            self._signature = tuple(sorted(
+                t for p in self.pools.values() for t in p.slice_sigs))
+        return self._signature
+
+    @property
+    def candidates(self) -> list[Candidate]:
+        if self._candidates is None:
+            self._candidates = [
+                c for pk in sorted(self.pools)
+                for c in self.pools[pk].candidates]
+        return self._candidates
+
+    @classmethod
+    def delta(cls, prev: "InventorySnapshot",
+              dirty_buckets: dict[tuple[str, str], list[dict]],
+              default_node: str | None = None,
+              on_pool_build: Callable | None = None
+              ) -> "InventorySnapshot":
+        """O(changes) rebuild: re-project ONLY the pools named in
+        ``dirty_buckets`` (pool key -> that pool's current slices;
+        empty list = pool gone) and merge with every other pool of
+        ``prev`` by identity. Pools whose slice signature turns out
+        unchanged are dropped from the delta (spurious dirtying);
+        if nothing really changed, ``prev`` itself is returned.
+
+        The merged indexes are pointer-copies of ``prev``'s with only
+        the changed pools' entries spliced -- untouched pools' sub-
+        snapshots (candidates, CEL memos, order memos) are NEVER
+        copied, which is what keeps maintenance sublinear in fleet
+        size (bench.py --sched-scale delta gate)."""
+        pools = dict(prev.pools)
+        rebuilt: dict[tuple[str, str], PoolSnapshot | None] = {}
+        for pk, slices in dirty_buckets.items():
+            old = pools.get(pk)
+            if not slices:
+                if old is None:
+                    continue  # never existed: nothing to drop
+                pools.pop(pk)
+                rebuilt[pk] = None
+                continue
+            t0 = time.monotonic()
+            new = PoolSnapshot(pk[0], pk[1], slices, default_node)
+            built_s = time.monotonic() - t0
+            if old is not None and old.slice_sigs == new.slice_sigs:
+                continue  # spuriously dirtied: content unchanged
+            if on_pool_build is not None:
+                on_pool_build(pk, built_s)
+            pools[pk] = new
+            rebuilt[pk] = new
+        if not rebuilt:
+            return prev
+        changed = frozenset(rebuilt)
+        snap = cls.__new__(cls)
+        snap.default_node = default_node
+        snap.pools = pools
+        snap.build_seq = None
+        snap.delta_pools = changed
+        snap._signature = None  # lazy: merged from per-pool shards
+        snap._candidates = None
+        snap._sel_cache = {}
+        # Untouched pools keep their topology-order memos; changed
+        # pools' (and legacy-shaped keys') entries drop.
+        snap.order_cache = {
+            k: v for k, v in prev.order_cache.items()
+            if isinstance(k, tuple) and len(k) >= 2
+            and (k[0], k[1]) in pools and (k[0], k[1]) not in changed}
+        snap.pool_generations = dict(prev.pool_generations)
+        snap.by_key = dict(prev.by_key)
+        snap.by_node = dict(prev.by_node)
+        snap._pools_of_node = dict(prev._pools_of_node)
+        affected_nodes: set[str] = set()
+        for pk, new in rebuilt.items():
+            old = prev.pools.get(pk)
+            if old is not None:
+                for c in old.candidates:
+                    snap.by_key.pop(c.key, None)
+                affected_nodes |= old.nodes
+            if new is not None:
+                for c in new.candidates:
+                    snap.by_key[c.key] = c
+                affected_nodes |= new.nodes
+                snap.pool_generations[pk] = new.generation
+            else:
+                snap.pool_generations.pop(pk, None)
+        for node in affected_nodes:
+            pks = {pk for pk in prev._pools_of_node.get(node, ())
+                   if pk not in changed}
+            pks |= {pk for pk in changed
+                    if pk in pools and node in pools[pk].nodes}
+            if not pks:
+                snap.by_node.pop(node, None)
+                snap._pools_of_node.pop(node, None)
+            else:
+                snap._pools_of_node[node] = frozenset(pks)
+                snap.by_node[node] = snap._merge_node(node, pks)
+        return snap
 
     def make_ledger(self) -> CounterLedger:
         ledger = CounterLedger()
-        for driver, pool, sets in self._counter_seeds:
-            ledger.seed(driver, pool, sets)
+        for pk in sorted(self.pools):
+            for sets in self.pools[pk].counter_seeds:
+                ledger.seed(pk[0], pk[1], sets)
         return ledger
 
     def cel_match(self, expression: str, prog: CelProgram,
                   cand: Candidate) -> bool:
-        key = (expression, cand.key)
-        hit = self._sel_cache.get(key)
+        pool = self.pools.get((cand.driver, cand.pool))
+        cache = pool.sel_cache if pool is not None else self._sel_cache
+        key = (expression, cand.name)
+        hit = cache.get(key)
         if hit is None:
             try:
                 hit = bool(prog.matches_device(cand.device, cand.driver))
             except Exception:  # noqa: BLE001 - CEL eval boundary
                 hit = False
-            self._sel_cache[key] = hit
+            cache[key] = hit
         return hit
+
+    # -- topology order memo (the mutation-fenced accessor pair) --------------
+
+    def order_memo_get(self, key: tuple):
+        """Cached topology candidate ordering, or the ``_MISS``
+        sentinel (a cached None is a real answer: 'no usable
+        coordinates')."""
+        return self.order_cache.get(key, _ORDER_MISS)
+
+    def order_memo_put(self, key: tuple,
+                       ordered: list[str] | None) -> None:
+        """The ONLY sanctioned external mutation path into the order
+        memo (TPUDRA009 fences direct subscript writes to schedcache
+        internals outside this module)."""
+        self.order_cache[key] = ordered
+
+
+_ORDER_MISS = object()
 
 
 class NodeLockManager:
@@ -321,6 +531,16 @@ class AllocationState:
     node ordering no longer scans the whole allocated set.
     """
 
+    # Node-ordering memo staleness bound: the least-loaded-first node
+    # order re-sorts after ceil(nodes / REORDER_NODES_PER_STEP) load
+    # mutations (or any snapshot change) -- EXACT per-commit spreading
+    # on small fleets (threshold 1 below 256 nodes, the historical
+    # behavior), amortized at scale where the per-claim O(n log n)
+    # sort was the top 10k-node allocation hotspot. Pure placement
+    # PREFERENCE: a stale order can only pick a slightly-more-loaded
+    # node first, never misallocate.
+    REORDER_NODES_PER_STEP = 256
+
     def __init__(self, snapshot: InventorySnapshot):
         self.snapshot = snapshot
         self.ledger = snapshot.make_ledger()
@@ -333,6 +553,8 @@ class AllocationState:
         self.node_load: dict[str, int] = {}
         self._claims: dict[str, frozenset] = {}
         self._alloc_lock = threading.Lock()
+        self._node_order: list[str] | None = None
+        self._node_order_drift = 0
 
     def _slots_of(self, key: tuple) -> int:
         cand = self.snapshot.by_key.get(key)
@@ -359,8 +581,81 @@ class AllocationState:
             self._counts = {}
             self.node_load = {}
             self._claims = {}
+            self._node_order = None
             for claim in claims:
                 self._observe_locked(claim)
+
+    def retarget(self, snapshot: InventorySnapshot,
+                 changed_pools) -> None:
+        """Re-point this state at a DELTA-built snapshot: only the
+        ``changed_pools`` (driver, pool) keys differ from the current
+        snapshot, so the O(claims) rebuild collapses to re-deriving
+        exactly those pools' ledger seeds, node-load contributions and
+        at-capacity memberships from the held allocations. Untouched
+        pools' Candidate objects are IDENTICAL between the two
+        snapshots, so every other piece of state is already right.
+        Equivalent to ``rebuild`` over the same claim set (pinned by
+        tests/test_sched_delta.py)."""
+        changed = set(changed_pools)
+        with self._alloc_lock:
+            old_snap = self.snapshot
+            self.snapshot = snapshot
+            self._node_order = None
+            if not changed:
+                return
+            # Reseed the changed pools' counter budgets...
+            for lkey in [k for k in self.ledger._avail
+                         if (k[0], k[1]) in changed]:
+                del self.ledger._avail[lkey]
+            for pk in changed:
+                pool = snapshot.pools.get(pk)
+                if pool is not None:
+                    for sets in pool.counter_seeds:
+                        self.ledger.seed(pk[0], pk[1], sets)
+            # ...then re-apply the held allocations that touch them.
+            for key, count in self._counts.items():
+                pk = (key[0], key[1])
+                if pk not in changed:
+                    continue
+                old_cand = old_snap.by_key.get(key)
+                new_cand = snapshot.by_key.get(key)
+                if old_cand is not None:
+                    left = self.node_load.get(old_cand.node, 0) - count
+                    if left > 0:
+                        self.node_load[old_cand.node] = left
+                    else:
+                        self.node_load.pop(old_cand.node, None)
+                if new_cand is not None:
+                    consumes = new_cand.device.get("consumesCounters")
+                    for _ in range(count):
+                        self.ledger.debit(new_cand.driver, new_cand.pool,
+                                          consumes)
+                    self.node_load[new_cand.node] = \
+                        self.node_load.get(new_cand.node, 0) + count
+                slots = new_cand.slots if new_cand is not None else 1
+                if count >= slots:
+                    self.allocated.add(key)
+                else:
+                    self.allocated.discard(key)
+
+    def ordered_nodes(self) -> list[str]:
+        """Every node with published candidates, least-loaded first
+        (name tiebreak), memoized until ``max(1, nodes //
+        REORDER_NODES_PER_STEP)`` load mutations accumulate or the
+        snapshot changes. Callers must treat the returned list as
+        read-only (it is shared across workers)."""
+        with self._alloc_lock:
+            order = self._node_order
+            threshold = max(
+                1, len(self.snapshot.by_node) //
+                self.REORDER_NODES_PER_STEP)
+            if order is None or self._node_order_drift >= threshold:
+                load = self.node_load
+                order = sorted(self.snapshot.by_node,
+                               key=lambda n: (load.get(n, 0), n))
+                self._node_order = order
+                self._node_order_drift = 0
+            return order
 
     def observe(self, claim: dict) -> bool:
         """Fold one claim's current allocation in. Returns True when
@@ -390,6 +685,7 @@ class AllocationState:
                                   cand.device.get("consumesCounters"))
                 self.node_load[cand.node] = \
                     self.node_load.get(cand.node, 0) + 1
+                self._node_order_drift += 1
         if keys:
             self._claims[cid] = keys
         else:
@@ -486,6 +782,7 @@ class AllocationState:
             if cand is not None:
                 self.ledger.credit(cand.driver, cand.pool,
                                    cand.device.get("consumesCounters"))
+                self._node_order_drift += 1
                 left = self.node_load.get(cand.node, 0) - 1
                 if left > 0:
                     self.node_load[cand.node] = left
@@ -496,6 +793,17 @@ class AllocationState:
 # Objects (claims / pods) opt into a scheduling domain with this
 # annotation; unannotated objects belong to the default domain.
 DOMAIN_ANNOTATION = "resource.tpu.dra/domain"
+# Cross-domain claim spillover (pkg/scheduler._maybe_spill): a claim
+# pinned into an exhausted domain re-homes to a sibling domain instead
+# of pending forever. The move annotates INTENT so operators (and the
+# claim's eventual return path) can see it was displaced:
+#   spilled-from: the ORIGINAL domain (first hop wins; stable across
+#                 multi-hop spills),
+#   spillover-hops: hop count, capped by TPU_DRA_SPILLOVER_MAX_HOPS,
+#   spillover: "false" on a claim opts it out entirely.
+SPILLOVER_ANNOTATION = "resource.tpu.dra/spillover"
+SPILLED_FROM_ANNOTATION = "resource.tpu.dra/spilled-from"
+SPILLOVER_HOPS_ANNOTATION = "resource.tpu.dra/spillover-hops"
 
 
 class SchedulingDomain:
@@ -512,10 +820,16 @@ class SchedulingDomain:
     controllers (DaemonSet/Job sync, recovery), which must not run in
     every domain."""
 
-    def __init__(self, name: str, pools=(), default: bool = False):
+    def __init__(self, name: str, pools=(), default: bool = False,
+                 siblings: "list[SchedulingDomain] | None" = None):
         self.name = name
         self.pools = [p for p in pools if p]
         self.default = default
+        # Spillover targets, in operator preference order: sibling
+        # domains a pinned claim may re-home to when THIS domain's
+        # pools are exhausted (pkg/scheduler._maybe_spill ranks them
+        # by migration-cost score; order is the tiebreak prior).
+        self.siblings: list[SchedulingDomain] = list(siblings or ())
 
     @property
     def lease_name(self) -> str:
@@ -543,6 +857,31 @@ class SchedulingDomain:
         return self.default
 
     @classmethod
+    def parse_siblings(cls, spec: str) -> "list[SchedulingDomain]":
+        """``name=glob|glob;name2=glob`` -> sibling domains, in the
+        operator's preference order. Malformed entries are skipped
+        (a bad sibling must not take the scheduler down) -- including
+        entries WITHOUT pool globs: an empty pool list means
+        match-everything in owns_pool, which would count the whole
+        cluster (the exhausted origin included) as the sibling's spill
+        capacity."""
+        siblings = []
+        for entry in (spec or "").split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            name, _, globs = entry.partition("=")
+            name = name.strip()
+            pools = [g.strip() for g in globs.split("|") if g.strip()]
+            if not name or not pools:
+                logger.warning(
+                    "skipping malformed spillover sibling entry %r "
+                    "(want name=poolglob[|glob...])", entry)
+                continue
+            siblings.append(cls(name, pools=pools))
+        return siblings
+
+    @classmethod
     def from_env(cls, env=None) -> "SchedulingDomain | None":
         import os  # noqa: PLC0415
 
@@ -554,7 +893,10 @@ class SchedulingDomain:
             "TPU_DRA_SCHED_DOMAIN_POOLS", "").split(",") if p.strip()]
         default = env.get("TPU_DRA_SCHED_DOMAIN_DEFAULT", "") in (
             "1", "true", "True")
-        return cls(name, pools=pools, default=default)
+        siblings = cls.parse_siblings(env.get(
+            "TPU_DRA_SCHED_DOMAIN_SIBLINGS", ""))
+        return cls(name, pools=pools, default=default,
+                   siblings=siblings)
 
 
 # (group, version, resource, kind) for every resource the scheduler's
@@ -594,7 +936,9 @@ class ClusterView:
                  resync_period: float = 300.0,
                  default_node: str | None = None,
                  pool_filter: Callable[[str, str], bool] | None = None,
-                 on_snapshot_build: Callable[[float], None] | None = None):
+                 on_snapshot_build: Callable[[float], None] | None = None,
+                 on_snapshot_delta: Callable | None = None,
+                 on_relist_backoff: Callable | None = None):
         self.kube = kube
         self._on_event = on_event
         self._on_relist = on_relist
@@ -605,7 +949,13 @@ class ClusterView:
         # per-pool domain sharding surface).
         self._pool_filter = pool_filter
         self._on_snapshot_build = on_snapshot_build
+        # on_snapshot_delta(pool_label, seconds): one observation per
+        # per-pool sub-snapshot rebuilt by the delta path
+        # (tpu_dra_sched_snapshot_delta_seconds{pool}).
+        self._on_snapshot_delta = on_snapshot_delta
+        self._on_relist_backoff = on_relist_backoff
         self._informers: dict[str, Informer] = {}
+        self._relist_coord = None
         self._snapshot: InventorySnapshot | None = None
         self._snapshot_lock = threading.Lock()
         # Bumped on EVERY slice event/invalidation; snapshot() rereads
@@ -617,7 +967,26 @@ class ClusterView:
         # without relisting or recomputing the signature.
         self._slice_gen = 0
         self._snapshot_gen = -1
+        # Per-pool slice buckets, maintained INCREMENTALLY from slice
+        # events (and re-anchored at every full build): the delta
+        # rebuild reads exactly the dirty pools' slices from here --
+        # zero listing, zero grouping of the other 9,999 pools.
+        # _dirty_pools None = tracking lost (unusable event payload);
+        # the next snapshot() falls back to a full build.
+        self._slices_by_pool: dict[tuple[str, str], dict[str, dict]] = {}
+        self._pool_of_slice: dict[str, tuple[str, str]] = {}
+        self._dirty_pools: set[tuple[str, str]] | None = set()
+        # Delta lineage: every installed snapshot gets a build seq and
+        # the log records which pools each build changed (None = full
+        # rebuild, unknown delta), so AllocationState holders can
+        # retarget in O(changed pools) instead of rebuilding O(claims).
+        self._build_seq = 0
+        self._delta_log: deque = deque(maxlen=512)
         self._cd_windows: dict[str, list[str]] | None = None
+        # Bumped on every ComputeDomain event (single informer watch
+        # thread writes it): cd_windows() builds that raced an event
+        # serve their listing but never install it.
+        self._cd_gen = 0
         self._started = False
 
     # -- lifecycle ------------------------------------------------------------
@@ -630,12 +999,24 @@ class ClusterView:
         if self._started:
             return self
         self._started = True
-        for group, version, resource, kind in WATCHED_RESOURCES:
+        # One relist coordinator for all nine informers: a restart
+        # storm's simultaneous relists drain priority-ordered
+        # (slices/claims first -- the allocation-critical state) under
+        # a concurrency cap with per-resource jittered backoff, instead
+        # of thundering-herding the apiserver. Startup itself lists in
+        # the same priority order.
+        self._relist_coord = RelistCoordinator(
+            on_backoff=self._on_relist_backoff)
+        ordered = sorted(WATCHED_RESOURCES,
+                         key=lambda e: RELIST_PRIORITY.get(e[2], 9))
+        for group, version, resource, kind in ordered:
             inf = Informer(self.kube, group, version, resource, kind=kind,
                            resync_period=self._resync_period,
-                           on_relist=self._relist_hook(resource))
-            if self._on_event is not None:
-                inf.add_event_hook(self._event_hook(resource))
+                           on_relist=self._relist_hook(resource),
+                           coordinator=self._relist_coord)
+            # The LOCAL hook (slice buckets, CD windows) always runs;
+            # the external on_event feed is optional.
+            inf.add_event_hook(self._event_hook(resource))
             self._informers[resource] = inf
             inf.start()
         return self
@@ -665,13 +1046,81 @@ class ClusterView:
     def _on_local_event(self, resource: str, ev_type: str,
                         obj: dict) -> None:
         if resource == "computedomains":
-            self._cd_windows = None
+            self._on_cd_event(ev_type, obj)
         elif resource == "resourceslices":
             # The informer applied the change to its cache BEFORE
             # firing this hook, so any slice listing taken after this
             # bump observes it.
-            with self._snapshot_lock:
+            self._on_slice_event(ev_type, obj)
+
+    def _on_cd_event(self, ev_type: str, obj: dict) -> None:
+        """SCOPED CD-window maintenance: one ComputeDomain's event
+        updates exactly its own uid's entry, so the N-1 unrelated
+        domains' window memos (and the pools their gangs target)
+        survive -- the cache used to be nuked wholesale on any CD
+        event, costing a relist per pending channel claim across every
+        pool. ``_cd_gen`` always advances so a cd_windows() build
+        racing this event discards its (possibly pre-event) result
+        instead of caching it -- with per-uid maintenance there is no
+        later global invalidation to heal a stale install."""
+        self._cd_gen += 1
+        cached = self._cd_windows
+        if cached is None:
+            return  # never built: the next cd_windows() builds fresh
+        md = obj.get("metadata", {})
+        uid = md.get("uid")
+        if not uid:
+            self._cd_windows = None  # unusable payload: full refresh
+            return
+        if ev_type == "DELETED":
+            cached.pop(uid, None)
+        else:
+            ann = (md.get("annotations") or {}).get(
+                PREFERRED_NODES_ANNOTATION, "")
+            cached[uid] = [n for n in ann.split(",") if n]
+
+    def _on_slice_event(self, ev_type: str, obj: dict) -> None:
+        """Incremental per-pool slice bucket + dirty-pool upkeep (the
+        delta rebuild's feed). Slices a domain's pool_filter excludes
+        never dirty this view at all -- other domains' slice churn no
+        longer costs this scheduler a rebuild."""
+        md = obj.get("metadata", {})
+        name = md.get("name", "")
+        pk = pool_key_of(obj)
+        visible = self._pool_filter is None or self._pool_filter(
+            pk[1], obj.get("spec", {}).get("nodeName", ""))
+        with self._snapshot_lock:
+            if not name:
+                # No identity to track: fall back to a full rebuild.
                 self._slice_gen += 1
+                self._dirty_pools = None
+                return
+            prev_pk = self._pool_of_slice.get(name)
+            if prev_pk is None and not visible:
+                return  # filtered and never seen: invisible churn
+            self._slice_gen += 1
+            if prev_pk is not None and prev_pk != pk:
+                # Pool (or driver) rename: retire the old residency.
+                bucket = self._slices_by_pool.get(prev_pk)
+                if bucket is not None:
+                    bucket.pop(name, None)
+                    if not bucket:
+                        self._slices_by_pool.pop(prev_pk, None)
+                if self._dirty_pools is not None:
+                    self._dirty_pools.add(prev_pk)
+            if ev_type == "DELETED" or not visible:
+                self._pool_of_slice.pop(name, None)
+                bucket = self._slices_by_pool.get(pk)
+                if bucket is not None:
+                    bucket.pop(name, None)
+                    if not bucket:
+                        self._slices_by_pool.pop(pk, None)
+            else:
+                self._pool_of_slice[name] = pk
+                self._slices_by_pool.setdefault(pk, {})[name] = obj
+            if self._dirty_pools is not None and (
+                    visible or prev_pk is not None):
+                self._dirty_pools.add(pk)
 
     # -- per-pass bookkeeping -------------------------------------------------
 
@@ -771,12 +1220,21 @@ class ClusterView:
         events are the only thing that can change the listing, so a
         snapshot built at the current generation is returned without
         relisting or recomputing the O(slices) signature -- at 1000
-        nodes that check used to dominate every allocation batch."""
+        nodes that check used to dominate every allocation batch.
+
+        Between the fast path and the full rebuild sits the DELTA
+        path: with per-pool dirty tracking intact, only the dirtied
+        pools' sub-snapshots rebuild and merge into the served view
+        (O(changes), the 10k-node maintenance contract)."""
         if self._started:
             with self._snapshot_lock:
                 if self._snapshot is not None and \
                         self._snapshot_gen == self._slice_gen:
                     return self._snapshot
+                if self._snapshot is not None and \
+                        self._snapshot_gen >= 0 and \
+                        self._dirty_pools is not None:
+                    return self._snapshot_delta_locked()
         for _ in range(self._SNAPSHOT_RACE_RETRIES):
             with self._snapshot_lock:
                 gen0 = self._slice_gen
@@ -797,6 +1255,7 @@ class ClusterView:
                     self._snapshot = InventorySnapshot(
                         slices, signature=sig,
                         default_node=self._default_node)
+                self._install_full_locked(self._snapshot, slices)
                 self._snapshot_gen = gen0
                 snap = self._snapshot
             if self._on_snapshot_build is not None:
@@ -810,8 +1269,90 @@ class ClusterView:
             if self._snapshot is None or self._snapshot.signature != sig:
                 self._snapshot = InventorySnapshot(
                     slices, signature=sig, default_node=self._default_node)
+                self._install_full_locked(self._snapshot, slices)
             self._snapshot_gen = -1
             return self._snapshot
+
+    def _install_full_locked(self, snap: InventorySnapshot,
+                             slices: list[dict]) -> None:
+        """Bookkeeping for a freshly built FULL snapshot (caller holds
+        the lock and has verified the listing's generation): stamp the
+        build seq, log it as an everything-may-have-changed build, and
+        re-anchor the per-pool buckets + dirty tracking off the
+        authoritative listing."""
+        self._build_seq += 1
+        snap.build_seq = self._build_seq
+        self._delta_log.append((self._build_seq, None))
+        if not self._started:
+            return
+        self._slices_by_pool = {}
+        self._pool_of_slice = {}
+        for s in slices:
+            name = s.get("metadata", {}).get("name", "")
+            if not name:
+                continue
+            pk = pool_key_of(s)
+            self._pool_of_slice[name] = pk
+            self._slices_by_pool.setdefault(pk, {})[name] = s
+        self._dirty_pools = set()
+
+    def _snapshot_delta_locked(self) -> InventorySnapshot:
+        """Delta rebuild under the snapshot lock: O(dirty pools), the
+        event threads that would mutate the buckets are excluded by
+        the same lock. Spuriously dirtied pools (content unchanged)
+        fall out inside InventorySnapshot.delta; a no-op delta keeps
+        the previous snapshot object (and its identity-based
+        consumers) entirely."""
+        gen0 = self._slice_gen
+        dirty = self._dirty_pools
+        self._dirty_pools = set()
+        buckets = {
+            pk: list(self._slices_by_pool.get(pk, {}).values())
+            for pk in dirty
+        }
+        new = InventorySnapshot.delta(
+            self._snapshot, buckets, default_node=self._default_node,
+            on_pool_build=self._pool_build_hook)
+        if new is not self._snapshot:
+            self._build_seq += 1
+            new.build_seq = self._build_seq
+            self._delta_log.append((self._build_seq, new.delta_pools))
+            self._snapshot = new
+        self._snapshot_gen = gen0
+        return self._snapshot
+
+    def _pool_build_hook(self, pk: tuple[str, str],
+                         seconds: float) -> None:
+        if self._on_snapshot_delta is not None:
+            try:
+                self._on_snapshot_delta(f"{pk[0]}/{pk[1]}", seconds)
+            except Exception:  # noqa: BLE001 - metrics hook
+                logger.exception("snapshot delta hook failed")
+
+    def changed_pools_between(self, old: InventorySnapshot | None,
+                              new: InventorySnapshot | None
+                              ) -> set | None:
+        """The pool keys that changed between two snapshots this view
+        installed, or None when that cannot be answered from the delta
+        log (either snapshot unstamped, a full rebuild in the window,
+        or the log aged past ``old``) -- the caller then falls back to
+        a full state rebuild."""
+        if old is new:
+            return set()
+        old_seq = getattr(old, "build_seq", None) if old else None
+        new_seq = getattr(new, "build_seq", None) if new else None
+        if old_seq is None or new_seq is None or new_seq < old_seq:
+            return None
+        out: set = set()
+        with self._snapshot_lock:
+            if self._delta_log and self._delta_log[0][0] > old_seq + 1:
+                return None  # log no longer covers the window
+            for seq, pools in self._delta_log:
+                if old_seq < seq <= new_seq:
+                    if pools is None:
+                        return None  # a full rebuild: unknown delta
+                    out |= pools
+        return out
 
     def invalidate_snapshot(self) -> None:
         with self._snapshot_lock:
@@ -828,11 +1369,13 @@ class ClusterView:
         cached = self._cd_windows
         if cached is not None:
             return cached
+        gen0 = self._cd_gen
         try:
             cds = self._list(CD_GROUP, CD_VERSION, "computedomains")
         except KubeError:
-            self._cd_windows = {}
-            return self._cd_windows
+            if self._cd_gen == gen0:
+                self._cd_windows = {}
+            return {}
         windows: dict[str, list[str]] = {}
         for cd in cds:
             md = cd.get("metadata", {})
@@ -841,5 +1384,11 @@ class ClusterView:
                 PREFERRED_NODES_ANNOTATION, "")
             if uid:
                 windows[uid] = [n for n in ann.split(",") if n]
-        self._cd_windows = windows
+        if self._cd_gen == gen0:
+            # No event raced the build: safe to install. A raced
+            # build serves its listing uncached; the next call
+            # re-lists and sees the event's effect (per-uid
+            # maintenance has no later global heal, so a stale
+            # install would live forever).
+            self._cd_windows = windows
         return windows

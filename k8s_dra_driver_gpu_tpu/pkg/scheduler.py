@@ -61,6 +61,9 @@ from .featuregates import (
 from .kubeclient import ConflictError, KubeError, NotFoundError
 from .schedcache import (
     DOMAIN_ANNOTATION,
+    SPILLED_FROM_ANNOTATION,
+    SPILLOVER_ANNOTATION,
+    SPILLOVER_HOPS_ANNOTATION,
     AllocationState,
     Candidate as _Candidate,
     ClusterView,
@@ -69,6 +72,8 @@ from .schedcache import (
     InventorySnapshot,
     NodeLockManager,
     SchedulingDomain,
+    _ORDER_MISS,
+    pool_key_of,
     tolerates as _tolerates,
 )
 from .topology import TorusGrid, largest_free_shape
@@ -178,6 +183,38 @@ class DraScheduler:
             except ValueError:
                 resync_period = DEFAULT_RESYNC_S
         self.resync_period = resync_period
+        # Cross-domain claim spillover (pkg/schedcache annotations):
+        # enabled for pool-restricted domains with configured siblings
+        # unless the master switch turns it off. Knobs:
+        # TPU_DRA_SPILLOVER (default on), TPU_DRA_SPILLOVER_MAX_HOPS,
+        # TPU_DRA_SPILLOVER_ORDER_WEIGHT / _UTIL_WEIGHT.
+        self._spillover_enabled = os.environ.get(
+            "TPU_DRA_SPILLOVER", "1") not in ("0", "false", "False")
+        self._spillover_max_hops = _env_int(
+            "TPU_DRA_SPILLOVER_MAX_HOPS", 1)
+        # Migration-cost weights (2502.01909's multi-objective
+        # placement framing, collapsed to the spill decision's two
+        # live terms): the operator's sibling ORDER is the stated
+        # preference, the sibling's current utilization is the
+        # congestion cost of moving there.
+        try:
+            self._spill_order_weight = float(os.environ.get(
+                "TPU_DRA_SPILLOVER_ORDER_WEIGHT", "1.0"))
+        except ValueError:
+            self._spill_order_weight = 1.0
+        try:
+            self._spill_util_weight = float(os.environ.get(
+                "TPU_DRA_SPILLOVER_UTIL_WEIGHT", "10.0"))
+        except ValueError:
+            self._spill_util_weight = 10.0
+        # Sibling-capacity memo: (expires, {sibling -> (free, total)}).
+        # Spill decisions are rare (exhaustion events), but an
+        # exhausted-domain claim FLOOD must not scan claims per claim.
+        # The lock makes rank+debit atomic across sharded workers --
+        # two workers spilling concurrently must not both judge the
+        # same pre-debit free count and overshoot the sibling.
+        self._spill_capacity_memo: tuple[float, dict] | None = None
+        self._spill_lock = threading.Lock()
         # All reads in sync paths go through the view (lint TPUDRA009):
         # informer caches in event mode, list-through in direct mode.
         self.view = ClusterView(
@@ -187,7 +224,9 @@ class DraScheduler:
             pool_filter=(self.domain.owns_pool
                          if self.domain is not None and self.domain.pools
                          else None),
-            on_snapshot_build=self._on_snapshot_build)
+            on_snapshot_build=self._on_snapshot_build,
+            on_snapshot_delta=self._on_snapshot_delta,
+            on_relist_backoff=self._on_relist_backoff)
         # Inventory snapshot + incrementally-maintained allocation
         # state; rebuilt whenever the snapshot changes and on every
         # full pass (the safety property of the resync).
@@ -298,6 +337,17 @@ class DraScheduler:
     def _on_snapshot_build(self, seconds: float) -> None:
         if self.sched_metrics is not None:
             self.sched_metrics.snapshot_build.observe(seconds)
+
+    def _on_snapshot_delta(self, pool_label: str,
+                           seconds: float) -> None:
+        if self.sched_metrics is not None:
+            self.sched_metrics.snapshot_delta.labels(
+                pool_label).observe(seconds)
+
+    def _on_relist_backoff(self, resource: str, seconds: float) -> None:
+        if self.sched_metrics is not None:
+            self.sched_metrics.relist_backoff.labels(
+                resource).observe(seconds)
 
     def _owns(self, obj: dict) -> bool:
         """Domain routing for claims and pods; domainless schedulers
@@ -632,14 +682,28 @@ class DraScheduler:
 
     def _ensure_alloc_state(self) -> tuple[InventorySnapshot,
                                            AllocationState]:
-        """Current snapshot + allocation state; a snapshot rebuild
-        (any slice write / pool-generation bump) rebuilds the
-        allocation state from the claim set. The snapshot read happens
-        OUTSIDE _state_lock (it has its own lock + event-mode fast
-        path), so the hot path costs one brief identity check."""
+        """Current snapshot + allocation state. The snapshot read
+        happens OUTSIDE _state_lock (it has its own lock + event-mode
+        fast path), so the hot path costs one brief identity check.
+
+        When the view can answer WHICH pools changed between the state
+        we hold and the new snapshot (the per-pool delta log), the
+        allocation state RETARGETS in O(changed pools) -- a slice
+        event no longer costs an O(claims) rebuild. A full rebuild
+        survives as the fallback for unstamped snapshots, full
+        resyncs, and log gaps."""
         snap = self.view.snapshot()
         with self._state_lock:
-            if snap is not self._snap or self._alloc is None:
+            if snap is self._snap and self._alloc is not None:
+                return self._snap, self._alloc
+            changed = None
+            if self._alloc is not None and self._snap is not None:
+                changed = self.view.changed_pools_between(
+                    self._snap, snap)
+            if changed is not None:
+                self._alloc.retarget(snap, changed)
+                self._snap = snap
+            else:
                 self._snap = snap
                 self._alloc = AllocationState(snap)
                 claims = self.view.claims()
@@ -687,14 +751,28 @@ class DraScheduler:
     COMMIT_RETRIES = 4
 
     def _candidate_nodes(self, claim, snap: InventorySnapshot,
-                         load: dict[str, int], window: set,
+                         alloc: AllocationState, window: set,
                          pinned_node: str | None) -> list[str]:
         """Node probe order for one claim: CD window first, then
         least-allocated (the spreading a real scheduler gets from
-        per-pod Filter/Score), with permanently failed nodes vetoed."""
-        nodes = sorted(snap.by_node,
-                       key=lambda n: (0 if not window or n in window
-                                      else 1, load.get(n, 0), n))
+        per-pod Filter/Score), with permanently failed nodes vetoed.
+
+        The load ordering comes from the AllocationState's memoized
+        ``ordered_nodes`` (re-sorted only every nodes/
+        REORDER_NODES_PER_STEP load
+        mutations) -- at 10k nodes the per-claim O(n log n) sort was
+        the allocation hotspot, and the order is pure preference so a
+        bounded staleness cannot misallocate. Pinned claims skip the
+        walk entirely: real DRA allocates during the consumer pod's
+        scheduling, so the node choice is already made."""
+        if pinned_node is not None:
+            nodes = ([pinned_node] if pinned_node in snap.by_node
+                     else [])
+        else:
+            nodes = alloc.ordered_nodes()
+            if window:
+                nodes = ([n for n in nodes if n in window]
+                         + [n for n in nodes if n not in window])
         if self.recovery is not None:
             # Permanently failed nodes may still have slices published
             # (a dead kubelet can't retract them): allocation must
@@ -702,8 +780,6 @@ class DraScheduler:
             excluded = self.recovery.excluded_nodes()
             if excluded:
                 nodes = [n for n in nodes if n not in excluded]
-        if pinned_node is not None:
-            nodes = [n for n in nodes if n == pinned_node]
         return nodes
 
     def _allocate_one(self, claim, snap: InventorySnapshot,
@@ -749,8 +825,19 @@ class DraScheduler:
             self._fit_tls.t0 = time.monotonic()
             outcome = "unfit"
             for _attempt in range(self.COMMIT_RETRIES):
-                nodes = self._candidate_nodes(claim, snap,
-                                              alloc.load_view(),
+                if _attempt:
+                    # A conflict means our captured state is stale --
+                    # typically a safety-resync rebuild swapped in a
+                    # fresh AllocationState mid-batch and the old
+                    # object stopped receiving observes. Re-fit
+                    # against the LIVE state or every retry keeps
+                    # picking the same stolen devices.
+                    with self._state_lock:
+                        if self._alloc is not None:
+                            alloc = self._alloc
+                        if self._snap is not None:
+                            snap = self._snap
+                nodes = self._candidate_nodes(claim, snap, alloc,
                                               window, pinned_node)
                 # One ledger copy per attempt, shared across every
                 # probed node: the fit is optimistic anyway (try_commit
@@ -783,7 +870,12 @@ class DraScheduler:
                 _meta(claim).get("namespace", "default"),
                 _meta(claim).get("name", "?"), self.COMMIT_RETRIES)
         elif outcome == "unfit" and pinned_node is None:
-            self._flag_domain_exhausted(claim)
+            # A domain-pinned claim that found no fit spills to a
+            # sibling domain (annotating intent) instead of pending
+            # forever; only when it cannot spill does it surface the
+            # exhaustion condition.
+            if not self._maybe_spill(claim):
+                self._flag_domain_exhausted(claim)
         return outcome
 
     def _try_nodes(self, claim, nodes: list[str], window: set,
@@ -929,13 +1021,17 @@ class DraScheduler:
             if len(group) >= want:
                 names = tuple(c.name for c in group)
                 key = (driver, pool, names, want)
-                if key in snap.order_cache:
-                    ordered = snap.order_cache[key]
+                # Memo access through the schedcache accessors only:
+                # TPUDRA009 fences direct mutation of sub-snapshot
+                # internals to pkg/schedcache.py delta paths.
+                hit = snap.order_memo_get(key)
+                if hit is not _ORDER_MISS:
+                    ordered = hit
                 else:
                     grid = self._grid_for(group)
                     ordered = topo_order_candidates(grid, list(names),
                                                     want)
-                    snap.order_cache[key] = ordered
+                    snap.order_memo_put(key, ordered)
             if ordered is None:
                 out.extend(group)
             else:
@@ -1159,6 +1255,7 @@ class DraScheduler:
     # -- domain-exhaustion surfacing (scheduler-per-pool sharding) ------------
 
     DOMAIN_EXHAUSTED_CONDITION = "DomainExhausted"
+    DOMAIN_SPILLED_CONDITION = "DomainSpilled"
 
     def _flag_domain_exhausted(self, claim) -> None:
         """A claim PINNED into this scheduling domain found no fit in
@@ -1217,20 +1314,25 @@ class DraScheduler:
 
     def _clear_domain_exhausted(self, claim) -> None:
         """An allocation landed for a claim that carried the
-        exhaustion condition: retire it (status False) so observers
-        see the recovery."""
+        exhaustion (or in-flight spill) condition: retire it (status
+        False) so observers see the recovery."""
         conditions = claim.get("status", {}).get("conditions") or []
-        if not any(c.get("type") == self.DOMAIN_EXHAUSTED_CONDITION
-                   and c.get("status") == "True" for c in conditions):
+        retire = {self.DOMAIN_EXHAUSTED_CONDITION:
+                  "domain capacity freed; claim allocated",
+                  self.DOMAIN_SPILLED_CONDITION:
+                  "claim allocated in the spill target domain"}
+        live = {c.get("type") for c in conditions
+                if c.get("type") in retire and c.get("status") == "True"}
+        if not live:
             return
-        kept = [c for c in conditions
-                if c.get("type") != self.DOMAIN_EXHAUSTED_CONDITION]
-        kept.append({
-            "type": self.DOMAIN_EXHAUSTED_CONDITION,
-            "status": "False",
-            "reason": "Allocated",
-            "message": "domain capacity freed; claim allocated",
-        })
+        kept = [c for c in conditions if c.get("type") not in live]
+        for cond_type in sorted(live):
+            kept.append({
+                "type": cond_type,
+                "status": "False",
+                "reason": "Allocated",
+                "message": retire[cond_type],
+            })
         try:
             self.kube.patch(
                 *RESOURCE, "resourceclaims", _meta(claim)["name"],
@@ -1238,6 +1340,189 @@ class DraScheduler:
                 namespace=_meta(claim).get("namespace", "default"))
         except (NotFoundError, ConflictError, KubeError):
             pass  # cosmetic: the allocation itself already landed
+
+    # -- cross-domain claim spillover -----------------------------------------
+
+    # Sibling-capacity memo TTL: bounds the claims+slices scan rate
+    # under an exhausted-domain claim flood.
+    SPILL_MEMO_TTL_S = 2.0
+
+    @staticmethod
+    def _claim_device_demand(claim) -> int:
+        """Rough device count one claim needs (All-mode counts 1):
+        a sibling with less free capacity than this can be skipped
+        without a fit."""
+        total = 0
+        for req in claim.get("spec", {}).get("devices", {}).get(
+                "requests", []):
+            exactly = req.get("exactly") or req
+            if exactly.get("allocationMode", "ExactCount") == "All":
+                total += 1
+            else:
+                try:
+                    total += max(int(exactly.get("count", 1)), 1)
+                except (TypeError, ValueError):
+                    total += 1
+        return max(total, 1)
+
+    def _sibling_capacity(self) -> dict[str, tuple[int, int]]:
+        """sibling name -> (free devices, total devices) across the
+        sibling's pools, computed from the UNfiltered informer caches
+        (this domain's snapshot is pool-restricted by design, so the
+        spill decision is the one read that must see past the fence).
+        Memoized briefly: spills are rare but arrive in floods when a
+        domain fills."""
+        memo = self._spill_capacity_memo
+        now = time.monotonic()
+        if memo is not None and memo[0] > now:
+            return memo[1]
+        try:
+            slices = self.view.slices()
+            claims = self.view.claims()
+        except KubeError:
+            return {}
+        siblings = self.domain.siblings if self.domain else []
+        # Newest-generation device keys per sibling.
+        newest: dict[tuple, int] = {}
+        for s in slices:
+            pk = pool_key_of(s)
+            gen = s.get("spec", {}).get("pool", {}).get("generation", 0)
+            newest[pk] = max(newest.get(pk, 0), gen)
+        totals: dict[str, set] = {sib.name: set() for sib in siblings}
+        for s in slices:
+            spec = s.get("spec", {})
+            pk = pool_key_of(s)
+            if spec.get("pool", {}).get("generation", 0) != newest[pk]:
+                continue
+            node = spec.get("nodeName", "")
+            for sib in siblings:
+                if sib.owns_pool(pk[1], node):
+                    for dev in spec.get("devices", []):
+                        totals[sib.name].add(
+                            (pk[0], pk[1], dev.get("name", "")))
+        allocated: set = set()
+        for claim in claims:
+            alloc = claim.get("status", {}).get("allocation") or {}
+            for r in alloc.get("devices", {}).get("results", []):
+                allocated.add((r.get("driver", ""), r.get("pool", ""),
+                               r.get("device", "")))
+        out = {
+            name: (len(keys - allocated), len(keys))
+            for name, keys in totals.items()
+        }
+        self._spill_capacity_memo = (now + self.SPILL_MEMO_TTL_S, out)
+        return out
+
+    def _rank_spill_target(self, claim) -> "SchedulingDomain | None":
+        """Cheapest sibling by migration-cost score: configured order
+        (weighted) + current utilization (weighted), siblings without
+        enough free devices for the claim's rough demand skipped."""
+        demand = self._claim_device_demand(claim)
+        capacity = self._sibling_capacity()
+        best, best_cost = None, None
+        for idx, sib in enumerate(self.domain.siblings):
+            free, total = capacity.get(sib.name, (0, 0))
+            if total <= 0 or free < demand:
+                continue
+            util = 1.0 - free / total
+            cost = (self._spill_order_weight * idx
+                    + self._spill_util_weight * util)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = sib, cost
+        return best
+
+    def _maybe_spill(self, claim) -> bool:
+        """Re-home a domain-pinned, domain-exhausted claim to the
+        cheapest sibling domain: ONE annotation patch moves the
+        domain pin, records the original domain
+        (``spilled-from``) and the hop count, and the sibling's
+        scheduler picks the claim up off the resulting watch event.
+        Deduped ``DomainSpilled`` Warning Event; claims annotated
+        ``resource.tpu.dra/spillover: "false"`` never move. Returns
+        True when the claim was spilled."""
+        domain = self.domain
+        if (not self._spillover_enabled or domain is None
+                or not domain.pools or not domain.siblings):
+            return False
+        ann = _meta(claim).get("annotations") or {}
+        if ann.get(DOMAIN_ANNOTATION, "") != domain.name:
+            return False  # not pinned here: not ours to move
+        if ann.get(SPILLOVER_ANNOTATION, "").lower() in (
+                "false", "0", "off", "disabled"):
+            return False  # operator opt-out
+        try:
+            hops = int(ann.get(SPILLOVER_HOPS_ANNOTATION, "0") or 0)
+        except ValueError:
+            hops = self._spillover_max_hops  # malformed: stop moving
+        if hops >= self._spillover_max_hops:
+            return False
+        with self._spill_lock:
+            # Rank + capacity debit are ATOMIC: concurrent workers
+            # spilling a flood each consume their demand from the
+            # memoized free count before the next one judges it. The
+            # debit is conservative (a failed patch below leaves it
+            # spent until the memo's 2s TTL) -- under-spilling briefly
+            # beats overshooting the sibling.
+            target = self._rank_spill_target(claim)
+            if target is None:
+                return False  # every sibling full too: stay + surface
+            memo = self._spill_capacity_memo
+            if memo is not None and target.name in memo[1]:
+                free, total = memo[1][target.name]
+                memo[1][target.name] = (
+                    free - self._claim_device_demand(claim), total)
+        ns = _meta(claim).get("namespace", "default")
+        name = _meta(claim)["name"]
+        origin = ann.get(SPILLED_FROM_ANNOTATION) or domain.name
+        # The condition rides the SAME patch as the re-home: if the
+        # target domain name is misconfigured (no scheduler owns it),
+        # the claim still SHOWS what happened to it -- pre-spillover
+        # it at least pended with a visible DomainExhausted.
+        conditions = [c for c in claim.get("status", {}).get(
+            "conditions") or []
+            if c.get("type") != self.DOMAIN_SPILLED_CONDITION]
+        conditions.append({
+            "type": self.DOMAIN_SPILLED_CONDITION,
+            "status": "True",
+            "reason": "DomainSpilled",
+            "message": (f"spilled from domain {origin!r} to sibling "
+                        f"{target.name!r} (hop {hops + 1}); pending "
+                        "here means no scheduler owns that domain"),
+        })
+        patch = {
+            "metadata": {"annotations": {
+                DOMAIN_ANNOTATION: target.name,
+                SPILLED_FROM_ANNOTATION: origin,
+                SPILLOVER_HOPS_ANNOTATION: str(hops + 1),
+            }},
+            "status": {"conditions": conditions},
+        }
+        try:
+            self.kube.patch(*RESOURCE, "resourceclaims", name, patch,
+                            namespace=ns)
+        except KubeError:
+            return False  # claim gone / conflicted: retry next pass
+        if self.sched_metrics is not None:
+            self.sched_metrics.domain_spilled.labels(
+                domain.name, target.name).inc()
+        self.flight.record(
+            _meta(claim).get("uid", "") or f"{ns}/{name}", "spilled",
+            alias=f"{ns}/{name}", src=domain.name, dst=target.name)
+        message = (
+            f"domain {domain.name!r} exhausted; claim spilled to "
+            f"sibling domain {target.name!r} (hop {hops + 1}, origin "
+            f"{origin!r}); annotate "
+            f"{SPILLOVER_ANNOTATION}=false to opt out")
+        # Deterministic name = create-once dedupe, like DomainExhausted.
+        emit_warning_event(
+            self.kube, event_name=f"{name}.domain-spilled",
+            namespace=ns, reason="DomainSpilled", message=message,
+            involved_kind="ResourceClaim", involved_name=name,
+            involved_uid=_meta(claim).get("uid", ""),
+            component="tpu-dra-scheduler")
+        logger.info("claim %s/%s spilled: domain %s -> %s", ns, name,
+                    domain.name, target.name)
+        return True
 
     def _claim_pins(self) -> dict[tuple[str, str], str]:
         """(namespace, claim name) -> node, for claims whose consumer
@@ -1914,7 +2199,13 @@ class DraScheduler:
             elif kind == "pending":
                 self._retry_pending_claims()
             elif kind == "inventory":
-                self.view.invalidate_snapshot()
+                # Slice events already marked their pools dirty in the
+                # view (per-pool delta tracking): the next snapshot()
+                # read rebuilds exactly those pools and the allocation
+                # state retargets in O(changed pools). The old global
+                # invalidate here forced an O(slices) full rebuild +
+                # O(claims) state rebuild per slice event -- the
+                # 10k-node hotspot this PR removes.
                 self._retry_pending_claims()
             elif kind == "daemonsets":
                 self._sync_daemonsets()
@@ -2042,6 +2333,14 @@ class DraScheduler:
                  if self._queue is not None else None)
         outcome = self._allocate_one(claim, snap, alloc, classes,
                                      pinned_node=pin)
+        if outcome == "conflict":
+            # Retries exhausted against contended/stale state: hand
+            # the claim back to the queue (dirty-flag requeue with the
+            # normal backoff) so it re-fits against a FRESH
+            # _ensure_alloc_state instead of pending until the next
+            # full resync -- at a 10k-node resync cadence that wait
+            # would be minutes.
+            self._enqueue(("claim", ns, name))
         if outcome == "committed" and qwait is not None and \
                 self._slo is not None:
             # The queued phase of THIS claim's winning attempt: dirty-
@@ -2190,6 +2489,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="this domain owns unannotated objects and the "
                         "cluster-wide controllers "
                         "[TPU_DRA_SCHED_DOMAIN_DEFAULT]")
+    p.add_argument("--sched-domain-siblings",
+                   default=os.environ.get(
+                       "TPU_DRA_SCHED_DOMAIN_SIBLINGS", ""),
+                   help="spillover siblings for this domain, "
+                        "'name=poolglob|poolglob;name2=glob' in "
+                        "preference order: a claim pinned here that "
+                        "cannot fit re-homes to the cheapest sibling "
+                        "(migration-cost ranked) instead of pending "
+                        "forever [TPU_DRA_SCHED_DOMAIN_SIBLINGS]")
     p.add_argument("--leader-elect", action="store_true",
                    default=os.environ.get("TPU_DRA_SCHED_LEADER_ELECT",
                                           "") in ("1", "true", "True"),
@@ -2250,7 +2558,9 @@ def main(argv: list[str] | None = None) -> int:
             args.sched_domain,
             pools=[p.strip() for p in args.sched_domain_pools.split(",")
                    if p.strip()],
-            default=args.sched_domain_default)
+            default=args.sched_domain_default,
+            siblings=SchedulingDomain.parse_siblings(
+                args.sched_domain_siblings))
     sched = DraScheduler(RetryingKubeClient(KubeClient(host=args.kube_api),
                                             metrics=resilience),
                          default_node=args.default_node,

@@ -530,6 +530,76 @@ class TestSchedulerSyncListRule:
             lint_source(src, rel="pkg/schedcache.py"))
 
 
+class TestSnapshotInternalMutationFence:
+    """TPUDRA009 extension (PR 11): per-pool sub-snapshot internals
+    (pkg/schedcache PoolSnapshot / merged-view indexes + memos) are
+    shared BY IDENTITY across snapshot generations, so they may only
+    be mutated from schedcache.py's delta paths."""
+
+    def test_subscript_write_flagged(self):
+        src = ("def bad(snap, key, val):\n"
+               "    snap.order_cache[key] = val\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA009" in rules_of(findings)
+
+    def test_mutator_call_flagged(self):
+        src = ("def bad(snap, cand):\n"
+               "    snap.candidates.append(cand)\n")
+        findings = lint_source(src, rel="pkg/recovery.py")
+        assert "TPUDRA009" in rules_of(findings)
+
+    def test_attribute_rebind_flagged(self):
+        src = ("def bad(snap):\n"
+               "    snap.by_key = {}\n")
+        findings = lint_source(src, rel="pkg/fleetstate.py")
+        assert "TPUDRA009" in rules_of(findings)
+
+    def test_del_flagged(self):
+        src = ("def bad(snap, key):\n"
+               "    del snap.by_node[key]\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA009" in rules_of(findings)
+
+    def test_augmented_attribute_write_flagged(self):
+        src = ("def bad(snap, more):\n"
+               "    snap.order_cache |= more\n"
+               "    snap.candidates += [1]\n")
+        findings = [f for f in lint_source(src, rel="pkg/scheduler.py")
+                    if f.rule == "TPUDRA009"]
+        assert len(findings) == 2
+
+    def test_schedcache_delta_paths_sanctioned(self):
+        src = ("def delta(snap, key, val):\n"
+               "    snap.by_key[key] = val\n"
+               "    snap.order_cache.pop(key, None)\n")
+        assert "TPUDRA009" not in rules_of(
+            lint_source(src, rel="pkg/schedcache.py"))
+
+    def test_stray_schedcache_basename_not_sanctioned(self):
+        # Rel-path suffix matched, not basename (the TPUDRA011
+        # lesson): a stray schedcache.py elsewhere gets no pass.
+        src = ("def bad(snap, key, val):\n"
+               "    snap.by_key[key] = val\n")
+        findings = lint_source(src, rel="other/dir/my_schedcache.py")
+        assert "TPUDRA009" in rules_of(findings)
+
+    def test_reads_and_own_attrs_clean(self):
+        src = ("class Other:\n"
+               "    def __init__(self):\n"
+               "        self.by_node = {}\n"  # its OWN attribute
+               "    def read(self, snap, node):\n"
+               "        return snap.by_node.get(node, ())\n")
+        assert "TPUDRA009" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+    def test_order_memo_accessors_clean(self):
+        src = ("def topo(snap, key, val):\n"
+               "    hit = snap.order_memo_get(key)\n"
+               "    snap.order_memo_put(key, val)\n")
+        assert "TPUDRA009" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+
 class TestSchedulerLockDisciplineRule:
     """TPUDRA010 + the sharded-allocation lock hierarchy: kube I/O is
     forbidden under the scheduler registry (_state_lock) and

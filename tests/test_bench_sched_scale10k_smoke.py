@@ -1,0 +1,75 @@
+"""Tier-1 10k-scale smoke: the `make bench-sched-scale10k-smoke`
+contract as a non-slow test. Runs `bench.py --sched-scale` on the
+shrunk deterministic trace and asserts the PR 11 gates:
+
+- per-pool snapshot DELTA rebuild beats the cold full rebuild (>=1.5x
+  at smoke scale; >=5x gated at the full 10k run) with byte-identical
+  candidate sets at every churn event,
+- identical final allocations vs workers=1 on the pinned trace (the
+  delta path must not change WHAT gets allocated),
+- a claim pinned to an exhausted scheduling domain SPILLS to its
+  sibling domain (annotated intent + deduped DomainSpilled event)
+  while the opt-out annotation is respected,
+- writes/claim and convergence stay within the scale envelope,
+- the result lands as the `scale10k` trajectory entry.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-sched-scale10k-smoke target.
+SMOKE_ENV = {
+    "BENCH_SCALE_ENTRY": "scale10k",
+    "BENCH_SCALE_NODES": "60",
+    "BENCH_SCALE_CLAIMS": "180",
+    "BENCH_SCALE_BURST": "60",
+    "BENCH_SCALE_WORKERS": "4",
+    "BENCH_SCALE_BATCH": "16",
+    "BENCH_SCALE_PIN": "1",
+    "BENCH_SCALE_REQUIRE_IDENTICAL": "1",
+    "BENCH_SCALE_MAX_WRITES_PER_CLAIM": "3.5",
+    "BENCH_SCALE_MAX_P99_MS": "5000",
+    "BENCH_SCALE_DELTA_NODES": "300",
+    "BENCH_SCALE_MIN_DELTA_SPEEDUP": "1.5",
+    "BENCH_SCALE_REQUIRE_SPILLOVER": "1",
+}
+
+
+def test_sched_scale10k_smoke(tmp_path):
+    out_file = str(tmp_path / "BENCH_scheduler.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--sched-scale"],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV,
+             "BENCH_SCHED_OUT": out_file},
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    ex = doc["extras"]
+    # Correctness: deterministic equivalence + the scale envelope.
+    assert ex["scale_identical_allocations"] is True
+    for w in (1, 4):
+        assert ex[f"scale_w{w}_unconverged"] == 0
+        assert ex[f"scale_w{w}_double_allocated"] == 0
+        assert ex[f"scale_w{w}_writes_per_claim"] <= 3.5
+    # The per-pool delta maintenance contract: faster than a cold
+    # rebuild AND byte-identical to it at every churn event.
+    assert ex["scale_delta_speedup"] >= 1.5
+    assert ex["scale_delta_equiv_mismatches"] == 0
+    assert ex["scale_delta_pool_builds"] > 0
+    # The spillover contract: the pinned claim escaped its exhausted
+    # domain; the opted-out claim stayed put with the condition.
+    assert ex["scale_spillover_proven"] is True
+    assert ex["scale_spillover_optout_respected"] is True
+    assert ex["scale_spillover_events"] == 1
+    # The trajectory artifact landed under its own entry key,
+    # alongside (never clobbering) the churn/scale entries.
+    with open(out_file, encoding="utf-8") as f:
+        emitted = json.load(f)
+    assert emitted["scale10k"]["extras"]["scale_delta_speedup"] == \
+        ex["scale_delta_speedup"]

@@ -204,3 +204,146 @@ class TestCDPluginInformerPath:
                     namespace="default")
         with pytest.raises(RetryableError):
             state._get_cd("u-cd1")
+
+
+class TestRelistCoordinator:
+    """PR 11: sharded relists -- priority ordering, concurrency cap,
+    and per-resource jittered exponential backoff (the restart-storm
+    discipline)."""
+
+    def _coord(self, **kw):
+        import random
+
+        from k8s_dra_driver_gpu_tpu.pkg.informer import (
+            RelistCoordinator,
+        )
+
+        kw.setdefault("rng", random.Random(7))
+        return RelistCoordinator(**kw)
+
+    def test_first_relist_of_quiet_resource_is_free(self):
+        clock = [0.0]
+        coord = self._coord(time_fn=lambda: clock[0])
+        assert coord.backoff_for("resourceslices") == 0.0
+
+    def test_repeat_relists_back_off_exponentially_with_jitter(self):
+        clock = [0.0]
+        coord = self._coord(base_delay=1.0, max_delay=8.0,
+                            quiet_period=60.0,
+                            time_fn=lambda: clock[0])
+        coord._last["pods"] = 0.0
+        delays = []
+        for _ in range(5):
+            clock[0] += 1.0
+            d = coord.backoff_for("pods")
+            coord._last["pods"] = clock[0]
+            delays.append(d)
+        # Jittered to 50-100% of 1, 2, 4, 8, 8 (capped).
+        for d, base in zip(delays, (1.0, 2.0, 4.0, 8.0, 8.0)):
+            assert base * 0.5 <= d <= base, (d, base)
+
+    def test_quiet_period_resets_the_streak(self):
+        clock = [0.0]
+        coord = self._coord(base_delay=1.0, quiet_period=10.0,
+                            time_fn=lambda: clock[0])
+        coord._last["pods"] = 0.0
+        clock[0] = 1.0
+        assert coord.backoff_for("pods") > 0
+        coord._last["pods"] = 1.0
+        clock[0] = 100.0  # long quiet: streak resets
+        assert coord.backoff_for("pods") == 0.0
+
+    def test_priority_order_and_concurrency_cap(self):
+        import threading
+        import time as _time
+
+        coord = self._coord(concurrency=1, base_delay=0.0,
+                            quiet_period=0.0)
+        order = []
+        running = []
+        max_conc = [0]
+        gate = threading.Event()
+
+        def job(resource):
+            def fn():
+                running.append(resource)
+                max_conc[0] = max(max_conc[0], len(running))
+                if resource == "warmup":
+                    gate.wait(5)  # hold the slot while others queue
+                else:
+                    _time.sleep(0.01)
+                order.append(resource)
+                running.remove(resource)
+            coord.run(resource, fn)
+
+        warm = threading.Thread(target=job, args=("warmup",))
+        warm.start()
+        _time.sleep(0.05)  # warmup holds the only slot
+        threads = []
+        # Submit LOW-priority first, then high: admission must be by
+        # priority, not arrival.
+        for resource in ("daemonsets", "pods", "resourceclaims",
+                         "resourceslices"):
+            t = threading.Thread(target=job, args=(resource,))
+            t.start()
+            _time.sleep(0.05)  # deterministic queue contents
+            threads.append(t)
+        gate.set()
+        warm.join(5)
+        for t in threads:
+            t.join(5)
+        assert order[0] == "warmup"
+        assert order[1:] == ["resourceslices", "resourceclaims",
+                             "pods", "daemonsets"]
+        assert max_conc[0] == 1
+
+    def test_backoff_hook_feeds_metric(self):
+        observed = []
+        clock = [0.0]
+        sleeps = []
+        coord = self._coord(
+            base_delay=1.0, quiet_period=60.0,
+            on_backoff=lambda r, s: observed.append((r, s)),
+            time_fn=lambda: clock[0], sleep_fn=sleeps.append)
+        coord.run("pods", lambda: None)   # streak 0: free
+        coord.run("pods", lambda: None)   # repeat: backs off
+        assert len(observed) == 1 and observed[0][0] == "pods"
+        assert sleeps and sleeps[0] == observed[0][1]
+
+    def test_informer_routes_relists_through_coordinator(self):
+        ran = []
+
+        class Spy:
+            def run(self, resource, fn):
+                ran.append(resource)
+                fn()
+
+        kube = FakeKubeClient()
+        make_cd(kube, "cd1")
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain", coordinator=Spy())
+        inf.start()
+        inf.relist()
+        assert ran == ["computedomains", "computedomains"]
+        assert inf.get("cd1", "default") is not None
+
+    def test_cluster_view_starts_informers_in_priority_order(self):
+        from k8s_dra_driver_gpu_tpu.pkg.informer import RELIST_PRIORITY
+        from k8s_dra_driver_gpu_tpu.pkg.schedcache import ClusterView
+
+        listed = []
+        kube = FakeKubeClient()
+        orig = kube.list
+
+        def spy_list(group, version, resource, **kw):
+            listed.append(resource)
+            return orig(group, version, resource, **kw)
+
+        kube.list = spy_list
+        view = ClusterView(kube)
+        view.start()
+        assert view.wait_for_sync(10)
+        view.stop()
+        prios = [RELIST_PRIORITY.get(r, 9) for r in listed]
+        assert prios == sorted(prios), listed
+        assert listed[0] == "resourceslices"

@@ -196,6 +196,8 @@ PRODUCERS = {
     "wait": r"observe_wait\(",
     "sync_seconds": r"\.sync_seconds\.labels\(",
     "snapshot_build": r"\.snapshot_build\.observe\(",
+    "snapshot_delta": r"\.snapshot_delta\.labels\(",
+    "relist_backoff": r"\.relist_backoff\.labels\(",
 }
 
 

@@ -1,0 +1,339 @@
+"""Cross-domain claim spillover (PR 11, pkg/scheduler._maybe_spill).
+
+A claim PINNED into a scheduling domain whose pools are exhausted
+re-homes to a sibling domain (annotating intent) instead of pending
+forever: one patch rewrites the domain pin + records spilled-from /
+hop count, the sibling's scheduler allocates it off the watch event,
+a deduped DomainSpilled Warning Event fires, and
+tpu_dra_sched_domain_spilled_total counts the move. Opt-out via
+resource.tpu.dra/spillover: "false"; hop cap via
+TPU_DRA_SPILLOVER_MAX_HOPS.
+"""
+
+import time
+
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.metrics import SchedulerMetrics
+from k8s_dra_driver_gpu_tpu.pkg.schedcache import (
+    DOMAIN_ANNOTATION,
+    SPILLED_FROM_ANNOTATION,
+    SPILLOVER_ANNOTATION,
+    SPILLOVER_HOPS_ANNOTATION,
+    SchedulingDomain,
+)
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+from k8s_dra_driver_gpu_tpu.pkg.sliceutil import publish_resource_slices
+
+RES = ("resource.k8s.io", "v1")
+
+
+def setup_class_and_slices(fake, pools):
+    fake.create(*RES, "deviceclasses", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": "tpu.dra.dev"},
+        "spec": {"selectors": [{"cel": {
+            "expression": 'device.driver == "tpu.dra.dev"'}}]},
+    })
+    for pool, chips in pools.items():
+        publish_resource_slices(fake, [{
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": f"{pool}-tpu.dra.dev"},
+            "spec": {"driver": "tpu.dra.dev", "nodeName": pool,
+                     "pool": {"name": pool, "generation": 1,
+                              "resourceSliceCount": 1},
+                     "devices": [{"name": f"chip-{j}"}
+                                 for j in range(chips)]},
+        }])
+
+
+def make_claim(fake, name, domain="a", extra_ann=None, count=1):
+    ann = {DOMAIN_ANNOTATION: domain}
+    ann.update(extra_ann or {})
+    exactly = {"deviceClassName": "tpu.dra.dev"}
+    if count != 1:
+        exactly["count"] = count
+    fake.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": ann},
+        "spec": {"devices": {"requests": [{
+            "name": "tpu", "exactly": exactly}]}},
+    }, namespace="default")
+
+
+def get_claim(fake, name):
+    return fake.get(*RES, "resourceclaims", name, "default")
+
+
+def wait_for(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.03)
+    return pred()
+
+
+class TestSpilloverEndToEnd:
+    def _run_pair(self, fake, sched_a, sched_b, body):
+        sched_a.start_event_driven()
+        sched_b.start_event_driven()
+        try:
+            assert sched_a.drain(10) and sched_b.drain(10)
+            body()
+        finally:
+            sched_a.stop()
+            sched_b.stop()
+
+    def test_exhausted_claim_spills_annotates_and_allocates(self):
+        fake = FakeKubeClient()
+        setup_class_and_slices(fake, {"pool-a-0": 1, "pool-b-0": 4})
+        sm = SchedulerMetrics()
+        sched_a = DraScheduler(fake, sched_metrics=sm,
+                               domain=SchedulingDomain(
+                                   "a", pools=["pool-a*"],
+                                   siblings=[SchedulingDomain(
+                                       "b", pools=["pool-b*"])]))
+        sched_b = DraScheduler(fake, domain=SchedulingDomain(
+            "b", pools=["pool-b*"], default=True))
+
+        def body():
+            make_claim(fake, "c1")
+            make_claim(fake, "c2")
+            assert wait_for(lambda: (
+                sched_a.drain(5), sched_b.drain(5),
+                get_claim(fake, "c1").get("status", {}).get(
+                    "allocation")
+                and get_claim(fake, "c2").get("status", {}).get(
+                    "allocation"))[-1])
+            c1, c2 = get_claim(fake, "c1"), get_claim(fake, "c2")
+            spilled = c2 if (c2["metadata"].get("annotations") or {}
+                             ).get(SPILLED_FROM_ANNOTATION) else c1
+            stayed = c1 if spilled is c2 else c2
+            ann = spilled["metadata"]["annotations"]
+            # Intent annotated: pin moved, origin + hops recorded.
+            assert ann[DOMAIN_ANNOTATION] == "b"
+            assert ann[SPILLED_FROM_ANNOTATION] == "a"
+            assert ann[SPILLOVER_HOPS_ANNOTATION] == "1"
+            pools = {r["pool"] for r in spilled["status"]["allocation"][
+                "devices"]["results"]}
+            assert pools == {"pool-b-0"}
+            stayed_pools = {r["pool"] for r in stayed["status"][
+                "allocation"]["devices"]["results"]}
+            assert stayed_pools == {"pool-a-0"}
+            # Deduped DomainSpilled event (create-once name).
+            events = [e for e in fake.objects("", "events")
+                      if e.get("reason") == "DomainSpilled"]
+            assert len(events) == 1
+            # Metric counted the move.
+            val = 0.0
+            for fam in sm.domain_spilled.collect():
+                for s in fam.samples:
+                    if s.name.endswith("_total") and s.labels == {
+                            "from_domain": "a", "to_domain": "b"}:
+                        val = s.value
+            assert val == 1.0
+            # The spilled claim carries NO DomainExhausted condition
+            # (it escaped instead); its in-flight DomainSpilled
+            # breadcrumb retired to False when the sibling allocated.
+            conds = {c.get("type"): c for c in spilled.get(
+                "status", {}).get("conditions") or []}
+            assert "DomainExhausted" not in conds
+            assert conds["DomainSpilled"]["status"] == "False"
+            assert conds["DomainSpilled"]["reason"] == "Allocated"
+
+        self._run_pair(fake, sched_a, sched_b, body)
+
+    def test_optout_annotation_pends_with_condition(self):
+        fake = FakeKubeClient()
+        setup_class_and_slices(fake, {"pool-a-0": 1, "pool-b-0": 4})
+        sched_a = DraScheduler(fake, domain=SchedulingDomain(
+            "a", pools=["pool-a*"],
+            siblings=[SchedulingDomain("b", pools=["pool-b*"])]))
+        sched_b = DraScheduler(fake, domain=SchedulingDomain(
+            "b", pools=["pool-b*"], default=True))
+
+        def body():
+            make_claim(fake, "c1")
+            make_claim(fake, "c-optout",
+                       extra_ann={SPILLOVER_ANNOTATION: "false"})
+            assert wait_for(lambda: (
+                sched_a.drain(5), sched_b.drain(5),
+                get_claim(fake, "c1").get("status", {}).get(
+                    "allocation") is not None)[-1])
+            sched_a.drain(5)
+            c = get_claim(fake, "c-optout")
+            assert not c.get("status", {}).get("allocation")
+            ann = c["metadata"]["annotations"]
+            assert ann[DOMAIN_ANNOTATION] == "a"  # never moved
+            assert SPILLED_FROM_ANNOTATION not in ann
+            conds = [x.get("type") for x in c.get("status", {}).get(
+                "conditions") or []]
+            assert "DomainExhausted" in conds
+
+        self._run_pair(fake, sched_a, sched_b, body)
+
+    def test_hop_cap_stops_chained_spills(self):
+        fake = FakeKubeClient()
+        setup_class_and_slices(fake, {"pool-a-0": 1, "pool-b-0": 1})
+        # Domain b is ALSO full and also has a sibling (back to a):
+        # with the default max-hops=1 a spilled claim must not
+        # ping-pong.
+        sched_a = DraScheduler(fake, domain=SchedulingDomain(
+            "a", pools=["pool-a*"],
+            siblings=[SchedulingDomain("b", pools=["pool-b*"])]))
+        sched_b = DraScheduler(fake, domain=SchedulingDomain(
+            "b", pools=["pool-b*"], default=True,
+            siblings=[SchedulingDomain("a", pools=["pool-a*"])]))
+
+        def body():
+            make_claim(fake, "c1")  # fills pool-a
+            make_claim(fake, "cb1", domain="b")  # fills pool-b
+            wait_for(lambda: (
+                sched_a.drain(5), sched_b.drain(5),
+                get_claim(fake, "c1").get("status", {}).get(
+                    "allocation") is not None
+                and get_claim(fake, "cb1").get("status", {}).get(
+                    "allocation") is not None)[-1])
+            # A third a-pinned claim: both domains full. It may spill
+            # ONCE (a->b, if b briefly looked free) but must then sit
+            # still at the hop cap -- never bounce back to a.
+            make_claim(fake, "c2")
+            time.sleep(0.5)
+            sched_a.drain(5)
+            sched_b.drain(5)
+            c2 = get_claim(fake, "c2")
+            ann = c2["metadata"]["annotations"]
+            hops = int(ann.get(SPILLOVER_HOPS_ANNOTATION, "0") or 0)
+            assert hops <= 1
+            if hops == 1:
+                assert ann[SPILLED_FROM_ANNOTATION] == "a"
+            assert not c2.get("status", {}).get("allocation")
+
+        self._run_pair(fake, sched_a, sched_b, body)
+
+
+class TestSpilloverRanking:
+    def test_cheapest_sibling_by_migration_cost(self):
+        """Two siblings: order prefers b, but b is nearly full while c
+        is empty -- the utilization term must win and pick c."""
+        fake = FakeKubeClient()
+        setup_class_and_slices(fake, {
+            "pool-a-0": 0, "pool-b-0": 4, "pool-c-0": 4})
+        dom = SchedulingDomain(
+            "a", pools=["pool-a*"],
+            siblings=[SchedulingDomain("b", pools=["pool-b*"]),
+                      SchedulingDomain("c", pools=["pool-c*"])])
+        sched = DraScheduler(fake, domain=dom)
+        # Pre-allocate 3 of b's 4 chips (utilization 0.75 -> cost
+        # 0*1 + 0.75*10 = 7.5 beats 1*1 + 0*10 = 1 for c? No: lower
+        # cost wins, c costs 1.0 < b's 7.5).
+        for j in range(3):
+            fake.create(*RES, "resourceclaims", {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": f"pre-{j}", "namespace": "default"},
+                "spec": {"devices": {"requests": []}},
+                "status": {"allocation": {"devices": {"results": [{
+                    "driver": "tpu.dra.dev", "pool": "pool-b-0",
+                    "device": f"chip-{j}"}]}}},
+            }, namespace="default")
+        claim = {"metadata": {"name": "x", "namespace": "default",
+                              "annotations": {DOMAIN_ANNOTATION: "a"}},
+                 "spec": {"devices": {"requests": [{
+                     "name": "r", "exactly": {
+                         "deviceClassName": "tpu.dra.dev"}}]}}}
+        target = sched._rank_spill_target(claim)
+        assert target is not None and target.name == "c"
+
+    def test_successful_spill_debits_the_capacity_memo(self):
+        """A flood of exhausted-domain claims inside the memo TTL must
+        not all spill against the same pre-spill free count: each
+        successful spill debits the memoized sibling capacity, so the
+        sibling can't be overshot."""
+        fake = FakeKubeClient()
+        setup_class_and_slices(fake, {"pool-a-0": 0, "pool-b-0": 2})
+        dom = SchedulingDomain(
+            "a", pools=["pool-a*"],
+            siblings=[SchedulingDomain("b", pools=["pool-b*"])])
+        sched = DraScheduler(fake, domain=dom)
+        for i in range(4):
+            make_claim(fake, f"flood-{i}")
+        spilled = 0
+        for i in range(4):
+            claim = get_claim(fake, f"flood-{i}")
+            if sched._maybe_spill(claim):
+                spilled += 1
+        # Only as many spills as the sibling has free devices (2);
+        # the rest stay home (and would surface DomainExhausted).
+        assert spilled == 2
+
+    def test_sibling_without_capacity_skipped(self):
+        fake = FakeKubeClient()
+        setup_class_and_slices(fake, {"pool-a-0": 0, "pool-b-0": 2})
+        dom = SchedulingDomain(
+            "a", pools=["pool-a*"],
+            siblings=[SchedulingDomain("b", pools=["pool-b*"])])
+        sched = DraScheduler(fake, domain=dom)
+        claim = {"metadata": {"name": "x", "namespace": "default",
+                              "annotations": {DOMAIN_ANNOTATION: "a"}},
+                 "spec": {"devices": {"requests": [{
+                     "name": "r", "exactly": {
+                         "deviceClassName": "tpu.dra.dev",
+                         "count": 3}}]}}}
+        # Demand 3 > b's 2 free devices: nowhere to go.
+        assert sched._rank_spill_target(claim) is None
+
+    def test_unpinned_or_domainless_never_spills(self):
+        fake = FakeKubeClient()
+        setup_class_and_slices(fake, {"pool-a-0": 0, "pool-b-0": 2})
+        dom = SchedulingDomain(
+            "a", pools=["pool-a*"],
+            siblings=[SchedulingDomain("b", pools=["pool-b*"])])
+        sched = DraScheduler(fake, domain=dom)
+        unpinned = {"metadata": {"name": "x", "namespace": "default"},
+                    "spec": {}}
+        assert sched._maybe_spill(unpinned) is False
+        domainless = DraScheduler(fake)
+        pinned = {"metadata": {"name": "y", "namespace": "default",
+                               "annotations": {DOMAIN_ANNOTATION: "a"}},
+                  "spec": {}}
+        assert domainless._maybe_spill(pinned) is False
+
+    def test_master_switch_disables(self, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_SPILLOVER", "0")
+        fake = FakeKubeClient()
+        setup_class_and_slices(fake, {"pool-a-0": 0, "pool-b-0": 2})
+        dom = SchedulingDomain(
+            "a", pools=["pool-a*"],
+            siblings=[SchedulingDomain("b", pools=["pool-b*"])])
+        sched = DraScheduler(fake, domain=dom)
+        pinned = {"metadata": {"name": "x", "namespace": "default",
+                               "annotations": {DOMAIN_ANNOTATION: "a"}},
+                  "spec": {"devices": {"requests": [{
+                      "name": "r", "exactly": {
+                          "deviceClassName": "tpu.dra.dev"}}]}}}
+        assert sched._maybe_spill(pinned) is False
+
+
+class TestSiblingParsing:
+    def test_parse_siblings_grammar(self):
+        # Glob-less entries ("d") are skipped as malformed: an empty
+        # pool list would match EVERY pool and count the whole
+        # cluster as that sibling's spill capacity.
+        sibs = SchedulingDomain.parse_siblings(
+            "b=pool-b*|pool-b2*; c=pool-c* ;; =bad; d")
+        assert [(s.name, s.pools) for s in sibs] == [
+            ("b", ["pool-b*", "pool-b2*"]),
+            ("c", ["pool-c*"]),
+        ]
+
+    def test_from_env_parses_siblings(self, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_SCHED_DOMAIN", "a")
+        monkeypatch.setenv("TPU_DRA_SCHED_DOMAIN_POOLS", "pool-a*")
+        monkeypatch.setenv("TPU_DRA_SCHED_DOMAIN_SIBLINGS",
+                           "b=pool-b*")
+        dom = SchedulingDomain.from_env()
+        assert dom is not None
+        assert [s.name for s in dom.siblings] == ["b"]
+        assert dom.siblings[0].pools == ["pool-b*"]
