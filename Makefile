@@ -4,7 +4,8 @@ PYTHON ?= python
 
 .PHONY: all native test test-fast bench bench-smoke \
 	bench-placement-smoke bench-chaos-smoke bench-sched-smoke \
-	bench-sched-scale bench-recovery-smoke bench-serving-smoke \
+	bench-sched-scale bench-recovery-smoke bench-defrag-smoke \
+	bench-serving-smoke \
 	bench-trace-smoke bench-telemetry-smoke validate-dashboard \
 	lint lint-analysis clean stamp-version
 
@@ -88,6 +89,23 @@ bench-recovery-smoke:
 	BENCH_RECOVERY_DEADLINE_S=1.0 \
 	BENCH_RECOVERY_OUT=$(or $(BENCH_RECOVERY_OUT),/tmp/BENCH_recovery_smoke.json) \
 	$(PYTHON) bench.py --recovery
+
+# Active-defragmentation smoke: a shrunk `--defrag` run (6x6 pool,
+# 120 seeded churn steps under first-fit) with the full gate set
+# enforced deterministically: churn decays fragmentation past the
+# trigger, the DefragController converges it back to <= the release
+# target with the largest catalog gang shape allocatable again, moves
+# stay inside the 15%-of-live-claims budget, nothing is left stuck
+# (no records, reservations, hints, pending claims, or double
+# allocations), and the compact no-churn control run executes ZERO
+# moves (the hysteresis proof). Mirrored as a non-slow test in
+# tests/test_bench_defrag_smoke.py; the full-scale trajectory file is
+# BENCH_defrag.json (plain `bench.py --defrag`: 8x8, 400 steps).
+bench-defrag-smoke:
+	BENCH_DEFRAG_DIMS=6x6 BENCH_DEFRAG_STEPS=120 \
+	BENCH_DEFRAG_ARRIVAL=0.45 \
+	BENCH_DEFRAG_OUT=$(or $(BENCH_DEFRAG_OUT),/tmp/BENCH_defrag_smoke.json) \
+	$(PYTHON) bench.py --defrag
 
 # Multi-tenant serving smoke: a shrunk `--serving` run (4 nodes x 96
 # tenants through the partition engine + slot-aware scheduler) with

@@ -2600,6 +2600,330 @@ def bench_recovery() -> dict:
     }
 
 
+def bench_defrag() -> dict:
+    """Active-defragmentation mode (`bench.py --defrag`): seeded claim
+    churn under first-fit placement decays one coordinated pool's
+    fragmentation past the trigger; the DefragController must converge
+    it back to a large free sub-torus within a bounded move budget.
+
+    Pipeline (the pkg/defrag stack end to end, against the REAL
+    scheduler + fleet aggregator):
+
+    1. **Decay**: BENCH_DEFRAG_STEPS of seeded arrival/departure churn
+       (sizes up to the largest catalog gang) with topology-aware
+       placement OFF -- the historical first-fit policy, which shreds
+       the free space. Churn continues until fragmentation_score >=
+       the trigger (capped), pending stragglers are dropped, and the
+       decayed frag is recorded.
+    2. **Converge**: a DefragController (trigger/release/budget from
+       the env knobs) attached to the same scheduler plans carve
+       windows and migrates claims through drain -> deallocate ->
+       hinted re-placement until frag <= the release target.
+    3. **Control**: a fresh compact (topology-ON, churn-less) cluster
+       runs the same controller for the same number of passes -- the
+       hysteresis proof: it must execute ZERO moves.
+
+    Emits BENCH_defrag.json (per-pass frag/largest/moves trajectory);
+    `main` exits nonzero when the pool fails to decay, fails to
+    converge, exceeds the migration budget, leaves anything stuck, or
+    the control run moves anything. Knobs: BENCH_DEFRAG_DIMS (8x8),
+    BENCH_DEFRAG_STEPS (400), BENCH_DEFRAG_SEED, BENCH_DEFRAG_TRIGGER
+    (0.25), BENCH_DEFRAG_TARGET (0.15), BENCH_DEFRAG_BUDGET_PCT (15),
+    BENCH_DEFRAG_OUT."""
+    import random as _random
+
+    from k8s_dra_driver_gpu_tpu.pkg.defrag import (
+        DEFRAG_TARGET_ANNOTATION,
+        DefragController,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.featuregates import FeatureGates
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.metrics import DefragMetrics
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+    from k8s_dra_driver_gpu_tpu.pkg.sliceutil import (
+        publish_resource_slices,
+    )
+
+    RES = ("resource.k8s.io", "v1")
+    DRIVER = "tpu.dra.dev"
+    dims_raw = os.environ.get("BENCH_DEFRAG_DIMS", "8x8")
+    try:
+        w, h = (int(p) for p in dims_raw.split("x"))
+    except ValueError:
+        w, h = 8, 8
+    steps = _env_int("BENCH_DEFRAG_STEPS", 400)
+    seed = _env_int("BENCH_DEFRAG_SEED", 20260804)
+    trigger = float(os.environ.get("BENCH_DEFRAG_TRIGGER", "0.25"))
+    target = float(os.environ.get("BENCH_DEFRAG_TARGET", "0.15"))
+    budget_pct = float(os.environ.get("BENCH_DEFRAG_BUDGET_PCT", "15"))
+    # Claim arrival probability per churn step: the knob that sets the
+    # steady-state utilization (smaller pools saturate at 0.7).
+    arrival = float(os.environ.get("BENCH_DEFRAG_ARRIVAL", "0.7"))
+    # The claim-size catalog: the largest entry is the gang shape the
+    # pool must be able to host again after defrag.
+    sizes = (1, 1, 2, 2, 4, 8)
+    gang_chips = max(sizes)
+    extras: dict = {"defrag_dims": f"{w}x{h}",
+                    "defrag_steps": steps, "defrag_seed": seed}
+    violations = 0
+
+    def node_slices(node):
+        devices = []
+        i = 0
+        for y in range(h):
+            for x in range(w):
+                devices.append({
+                    "name": f"chip-{i}",
+                    "attributes": {
+                        "type": {"string": "tpu-chip"},
+                        "platform": {"string": "v5e"},
+                        "topology": {"string": f"{w}x{h}"},
+                        "iciX": {"int": x}, "iciY": {"int": y},
+                    }})
+                i += 1
+        return [{
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceSlice",
+            "metadata": {"name": f"{node}-{DRIVER}"},
+            "spec": {"driver": DRIVER, "nodeName": node,
+                     "pool": {"name": node, "generation": 1,
+                              "resourceSliceCount": 1},
+                     "devices": devices},
+        }]
+
+    def build_cluster(gates):
+        fake = FakeKubeClient()
+        fake.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": DRIVER},
+            "spec": {"selectors": [{"cel": {
+                "expression": f'device.driver == "{DRIVER}"'}}]},
+        })
+        fake.create("", "v1", "nodes", {
+            "metadata": {"name": "node-a"},
+            "status": {"conditions": [
+                {"type": "Ready", "status": "True"}]}})
+        publish_resource_slices(fake, node_slices("node-a"))
+        return fake, DraScheduler(fake, gates=FeatureGates.parse(gates))
+
+    def frag_point(sched):
+        entry = sched.fleet.snapshot()["pools"].get(
+            f"{DRIVER}/node-a") or {}
+        return entry.get("current") or {}
+
+    def live_claims(fake):
+        return [c for c in fake.list(*RES, "resourceclaims")
+                if c.get("status", {}).get("allocation")]
+
+    def pending_claims(fake):
+        return [c for c in fake.list(*RES, "resourceclaims")
+                if not c.get("status", {}).get("allocation")]
+
+    # -- phase 1: churn decay under first-fit --------------------------
+    fake, sched = build_cluster("TopologyAwarePlacement=false")
+    rng = _random.Random(seed)
+    next_id = 0
+    expiry: dict[str, int] = {}
+    trajectory: list[dict] = []
+
+    def churn_step(step):
+        nonlocal next_id
+        for name in [n for n, e in expiry.items() if e <= step]:
+            del expiry[name]
+            try:
+                fake.delete(*RES, "resourceclaims", name,
+                            namespace="default")
+            except Exception:  # noqa: BLE001 - already gone
+                pass
+        if rng.random() < arrival:
+            size = rng.choice(sizes)
+            name = f"b{next_id}"
+            next_id += 1
+            exactly = {"deviceClassName": DRIVER}
+            if size != 1:
+                exactly["count"] = size
+            fake.create(*RES, "resourceclaims", {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"devices": {"requests": [{
+                    "name": "tpu", "exactly": exactly}]}},
+            }, namespace="default")
+            expiry[name] = step + rng.randint(5, 60)
+        sched.sync_once()
+
+    step = 0
+    decayed = 0.0
+    # Cap: the churn must cross the trigger eventually; the bound only
+    # guards a pathological seed. The stop ALSO requires enough free
+    # chips for the catalog gang to be recoverable at all -- a decayed
+    # state with < gang_chips free is starvation, not fragmentation.
+    max_steps = steps + 600
+    while step < max_steps:
+        churn_step(step)
+        step += 1
+        point = frag_point(sched)
+        frag = point.get("fragmentation_score")
+        if frag is not None:
+            trajectory.append({"phase": "decay", "step": step,
+                               "frag": frag,
+                               "largest": point.get(
+                                   "largest_free_shape")})
+        if step >= steps and frag is not None and \
+                frag >= trigger and \
+                point.get("free_devices", 0) >= gang_chips + 2:
+            decayed = frag
+            break
+    extras["defrag_decay_steps"] = step
+    extras["defrag_decayed_frag"] = decayed
+    if decayed < trigger:
+        print(f"defrag decay failed: frag {decayed} < {trigger} "
+              f"after {step} steps", file=sys.stderr)
+        violations += 1
+    # Freeze the churn: drop pending stragglers so the live-claim set
+    # (the budget denominator) is well-defined.
+    for claim in pending_claims(fake):
+        fake.delete(*RES, "resourceclaims",
+                    claim["metadata"]["name"], namespace="default")
+    sched.sync_once()
+    live = live_claims(fake)
+    extras["defrag_live_claims"] = len(live)
+    extras["defrag_utilization"] = frag_point(sched).get("utilization")
+    move_cap = max(1, int(len(live) * budget_pct / 100))
+    extras["defrag_move_budget"] = move_cap
+
+    # -- phase 2: the controller converges it back ---------------------
+    with tempfile.TemporaryDirectory() as root:
+        metrics = DefragMetrics()
+        ctrl = DefragController(
+            fake, os.path.join(root, "defrag"), metrics=metrics,
+            trigger=trigger, release=target, sustain_s=0.0,
+            max_concurrent=8, deadline_s=60.0, budget_pct=budget_pct,
+            cooldown_s=0.0)
+        sched.attach_defrag(ctrl)
+        converge_passes = 0
+        for _ in range(120):
+            # The acceptance budget is TOTAL moves <= budget_pct of
+            # the live claims: shrink the controller's per-window
+            # budget to whatever remains, so a multi-window
+            # convergence can never overshoot the cap.
+            remaining = move_cap - int(metrics.moves._value.get())
+            ctrl.budget_pct = max(
+                0.0, remaining * 100.0 / max(len(live), 1))
+            sched.sync_once()
+            converge_passes += 1
+            point = frag_point(sched)
+            trajectory.append({
+                "phase": "converge", "step": step + converge_passes,
+                "frag": point.get("fragmentation_score"),
+                "largest": point.get("largest_free_shape"),
+                "moves": int(metrics.moves._value.get()),
+            })
+            if point.get("fragmentation_score") is not None and \
+                    point["fragmentation_score"] <= target and \
+                    (point.get("largest_free_shape") or 0) >= \
+                    gang_chips and not ctrl.active_moves():
+                break
+        point = frag_point(sched)
+        moves = int(metrics.moves._value.get())
+        extras["defrag_converge_passes"] = converge_passes
+        extras["defrag_final_frag"] = point.get("fragmentation_score")
+        extras["defrag_final_largest"] = point.get(
+            "largest_free_shape")
+        extras["defrag_moves"] = moves
+        extras["defrag_plans"] = int(metrics.plans._value.get())
+        extras["defrag_aborted"] = int(metrics.aborted._value.get())
+        extras["defrag_frag_recovered_chips"] = int(
+            metrics.frag_recovered._value.get())
+        if point.get("fragmentation_score") is None or \
+                point["fragmentation_score"] > target:
+            print(f"defrag convergence failed: frag "
+                  f"{point.get('fragmentation_score')} > {target}",
+                  file=sys.stderr)
+            violations += 1
+        if (point.get("largest_free_shape") or 0) < gang_chips:
+            print(f"defrag convergence failed: largest free shape "
+                  f"{point.get('largest_free_shape')} < the "
+                  f"{gang_chips}-chip catalog gang", file=sys.stderr)
+            violations += 1
+        if moves > move_cap:
+            print(f"defrag budget blown: {moves} moves > cap "
+                  f"{move_cap} ({budget_pct}% of {len(live)} live "
+                  "claims)", file=sys.stderr)
+            violations += 1
+        # Zero stuck state of any kind.
+        stuck = len(ctrl.active_moves()) + len(ctrl.reservations())
+        stuck += len(pending_claims(fake))
+        leftover_hints = sum(
+            1 for c in fake.list(*RES, "resourceclaims")
+            if DEFRAG_TARGET_ANNOTATION in (
+                c.get("metadata", {}).get("annotations") or {}))
+        stuck += leftover_hints
+        # Every device held by at most one claim (zero
+        # double-allocations, recomputed from the final allocations).
+        held: dict[str, str] = {}
+        double = 0
+        for claim in live_claims(fake):
+            alloc = claim["status"]["allocation"]
+            for r in alloc["devices"]["results"]:
+                if r["device"] in held:
+                    double += 1
+                held[r["device"]] = claim["metadata"]["name"]
+        extras["defrag_stuck"] = stuck
+        extras["defrag_double_allocated"] = double
+        if stuck or double:
+            print(f"defrag left {stuck} stuck item(s), {double} "
+                  "double-allocation(s)", file=sys.stderr)
+            violations += stuck + double
+
+    # -- phase 3: no-churn control (the hysteresis proof) --------------
+    ctl_fake, ctl_sched = build_cluster("TopologyAwarePlacement=true")
+    for k in range(max(4, (w * h) // (2 * gang_chips))):
+        ctl_fake.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": f"ctl{k}", "namespace": "default"},
+            "spec": {"devices": {"requests": [{
+                "name": "tpu", "exactly": {
+                    "deviceClassName": DRIVER,
+                    "count": gang_chips}}]}},
+        }, namespace="default")
+    with tempfile.TemporaryDirectory() as root:
+        ctl_metrics = DefragMetrics()
+        ctl = DefragController(
+            ctl_fake, os.path.join(root, "defrag"),
+            metrics=ctl_metrics, trigger=trigger, release=target,
+            sustain_s=0.0, max_concurrent=8, budget_pct=budget_pct,
+            cooldown_s=0.0)
+        ctl_sched.attach_defrag(ctl)
+        for _ in range(20):
+            ctl_sched.sync_once()
+        ctl_moves = int(ctl_metrics.moves._value.get())
+        ctl_plans = int(ctl_metrics.plans._value.get())
+        extras["defrag_control_frag"] = frag_point(ctl_sched).get(
+            "fragmentation_score")
+        extras["defrag_control_moves"] = ctl_moves
+        extras["defrag_control_plans"] = ctl_plans
+        if ctl_moves or ctl_plans:
+            print(f"defrag hysteresis failed: control run planned "
+                  f"{ctl_plans} window(s) / {ctl_moves} move(s)",
+                  file=sys.stderr)
+            violations += 1
+
+    return {
+        "metric": "defrag_violations",
+        "value": violations,
+        "unit": "violations",
+        # Frag recovered relative to the decayed level (>= 1.0 means
+        # the controller gave back everything churn destroyed).
+        "vs_baseline": round(
+            (decayed - (extras.get("defrag_final_frag") or 0.0))
+            / max(decayed - target, 1e-9), 3) if decayed else 0.0,
+        "extras": extras,
+        "trajectory": trajectory[-200:],
+    }
+
+
 def bench_serving() -> dict:
     """Multi-tenant inference-serving mode (`bench.py --serving`):
     hundreds of small tenants across a v5e pool through the partition
@@ -3014,6 +3338,16 @@ def _write_recovery_json(result: dict) -> None:
         f.write("\n")
 
 
+def _write_defrag_json(result: dict) -> None:
+    out_path = os.environ.get(
+        "BENCH_DEFRAG_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_defrag.json"))
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
 def _sched_json_path() -> str:
     return os.environ.get(
         "BENCH_SCHED_OUT",
@@ -3329,6 +3663,17 @@ def _dispatch() -> None:
         print(json.dumps(result))
         # The CI gate (`make bench-recovery-smoke`): an unconverged
         # claim or ANY leaked layer is a hard failure.
+        if result["value"] > 0:
+            sys.exit(1)
+        return
+    if "--defrag" in sys.argv[1:]:
+        result = bench_defrag()
+        _write_defrag_json(result)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "trajectory"}))
+        # The CI gate (`make bench-defrag-smoke`): failed decay,
+        # failed convergence, a blown move budget, anything stuck, or
+        # a control-run move is a hard failure.
         if result["value"] > 0:
             sys.exit(1)
         return

@@ -194,6 +194,12 @@ _STATE_LITERALS = {"PrepareStarted", "PrepareCompleted",
                    # TransitionPolicy exactly like raw claim states.
                    "EvictionPlanned", "EvictionDraining",
                    "EvictionDeallocated",
+                   # Defrag-move lifecycle (pkg/defrag.py): the active
+                   # defragmentation controller's records live under
+                   # the defrag TransitionPolicy; raw literals bypass
+                   # it the same way.
+                   "DefragPlanned", "DefragDraining",
+                   "DefragDeallocated",
                    # Partition lifecycle (pkg/partition/engine.py):
                    # same rule for the partition TransitionPolicy.
                    "PartitionCreating", "PartitionReady",

@@ -150,6 +150,44 @@ EVICTION_POLICY = TransitionPolicy(
     }),
 )
 
+# -- defrag moves (active defragmentation, pkg/defrag.py) ---------------------
+#
+# The defrag controller migrates LIVE claims off shredded free space so
+# large sub-tori re-form (the capacity-recovery half of the eviction
+# machinery). Each planned move is one record in the controller's
+# CheckpointManager, mirroring the eviction ladder so a controller
+# crash mid-move resumes from the durable stage:
+#
+#   absent -> DefragPlanned          (move planned: target devices
+#                                     chosen, placement hint stamped)
+#   DefragPlanned -> DefragDraining  (consumer pods evicted,
+#                                     reservations dropped)
+#   DefragDraining -> DefragDeallocated (allocation cleared; the
+#                                     scheduler re-places onto the
+#                                     hinted target)
+#   <any> -> absent                  (re-placed, claim gone, or the
+#                                     move aborted at its deadline)
+#
+# The same stage-skip rule applies: a drain or deallocation without
+# its durable intent record is exactly what the runtime validator
+# refuses.
+
+DEFRAG_PLANNED = "DefragPlanned"
+DEFRAG_DRAINING = "DefragDraining"
+DEFRAG_DEALLOCATED = "DefragDeallocated"
+
+DEFRAG_POLICY = TransitionPolicy(
+    "defrag",
+    frozenset({
+        (ABSENT, DEFRAG_PLANNED),                 # move planned
+        (DEFRAG_PLANNED, DEFRAG_DRAINING),        # pods evicted
+        (DEFRAG_DRAINING, DEFRAG_DEALLOCATED),    # allocation cleared
+        (DEFRAG_PLANNED, ABSENT),                 # canceled / aborted
+        (DEFRAG_DRAINING, ABSENT),                # canceled / aborted
+        (DEFRAG_DEALLOCATED, ABSENT),             # re-placed / aborted
+    }),
+)
+
 # -- partition lifecycle (pkg/partition/engine.py) ----------------------------
 #
 # The multi-tenant partition engine persists one record per dynamic
@@ -193,5 +231,6 @@ POLICIES = {
     "two-phase": TWO_PHASE_POLICY,
     "single-phase": SINGLE_PHASE_POLICY,
     "eviction": EVICTION_POLICY,
+    "defrag": DEFRAG_POLICY,
     "partition": PARTITION_POLICY,
 }

@@ -729,6 +729,18 @@ class FleetMetrics:
             ["node"],
             registry=self.registry,
         )
+        self.fold_seconds = Histogram(
+            "tpu_dra_fleet_fold_seconds",
+            "Wall time of one FleetAggregator fold (per-pool "
+            "utilization + fragmentation over the whole inventory). "
+            "Kept flat by the largest_free_shape memo in "
+            "pkg/topology/score.py -- a rising p99 here means the "
+            "memo stopped hitting (pool geometry churning every "
+            "pass).",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5),
+            registry=self.registry,
+        )
 
     # -- the duck-typed sink pkg/fleetstate.py calls --------------------------
 
@@ -759,6 +771,62 @@ class FleetMetrics:
                 gauge.remove(node)
             except KeyError:
                 pass
+
+
+class DefragMetrics:
+    """Active-defragmentation observability (pkg/defrag.py, on the
+    scheduler registry).
+
+    A healthy controller shows ``plans_total`` rising only when churn
+    has genuinely shredded a pool (the hysteresis proof: a quiet fleet
+    shows zero), every planned move retiring through ``moves_total``
+    (``aborted_total`` staying flat), ``frag_recovered_chips_total``
+    tracking the largest-free-shape growth each completed plan bought,
+    and move latency (plan -> re-placement) bounded by the scheduler's
+    re-placement path. ``active_moves`` returning to zero after every
+    window is the no-stuck-claims invariant."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.plans = Counter(
+            "tpu_dra_defrag_plans_total",
+            "Defrag plan windows started (a triggered pool with a "
+            "feasible re-pack admitted for execution).",
+            registry=self.registry,
+        )
+        self.moves = Counter(
+            "tpu_dra_defrag_moves_total",
+            "Claim migrations completed by the defrag controller "
+            "(drain -> deallocate -> re-placement retired).",
+            registry=self.registry,
+        )
+        self.frag_recovered = Counter(
+            "tpu_dra_defrag_frag_recovered_chips_total",
+            "Chips of largest-free-sub-torus growth recovered by "
+            "completed defrag plans (chips_after - chips_before, "
+            "summed per plan window).",
+            registry=self.registry,
+        )
+        self.aborted = Counter(
+            "tpu_dra_defrag_aborted_total",
+            "Defrag moves abandoned (move deadline exceeded, claim "
+            "deleted mid-move, or pool healed mid-plan).",
+            registry=self.registry,
+        )
+        self.active_moves = Gauge(
+            "tpu_dra_defrag_active_moves",
+            "Defrag move records currently in flight (bounded by "
+            "TPU_DRA_DEFRAG_MAX_CONCURRENT).",
+            registry=self.registry,
+        )
+        self.move_seconds = Histogram(
+            "tpu_dra_defrag_move_seconds",
+            "End-to-end latency of one completed defrag move: plan "
+            "record written -> claim re-placed on surviving capacity.",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 120.0, 300.0),
+            registry=self.registry,
+        )
 
 
 class ComputeDomainMetrics:

@@ -91,6 +91,18 @@ MAX_CONCURRENT_EVICTIONS = int(positive_float_env(
 #: is traded against disruption, not taken for free).
 DISRUPTION_WEIGHT = positive_float_env(
     "TPU_DRA_RECOVERY_DISRUPTION_WEIGHT", default=4.0, floor=0.0)
+#: Weight of one fully-aged claim (uptime >= AGE_SCALE_S) in the move
+#: score: migrating a claim that has been running for hours throws
+#: away hours of work (checkpoint distance, warmed caches), so the
+#: planner prefers moving young claims over long-running training
+#: gangs when either recovers the same capacity.
+AGE_WEIGHT = positive_float_env(
+    "TPU_DRA_RECOVERY_AGE_WEIGHT", default=2.0, floor=0.0)
+#: Uptime at which a claim counts as fully aged (the age term
+#: saturates there -- a week-old gang is not 50x costlier than a
+#: 3-hour one, it is simply "old").
+AGE_SCALE_S = positive_float_env(
+    "TPU_DRA_RECOVERY_AGE_SCALE_S", default=3600.0, floor=1.0)
 
 
 def _meta(obj: dict) -> dict:
@@ -138,6 +150,114 @@ def allocation_device_keys(claim: dict) -> set[tuple[str, str, str]]:
         (r.get("driver", ""), r.get("pool", ""), r.get("device", ""))
         for r in alloc.get("devices", {}).get("results", [])
     }
+
+
+def claim_age_s(claim: dict, now: float | None = None) -> float:
+    """Claim uptime in seconds from ``metadata.creationTimestamp``
+    (RFC3339); 0.0 when absent or unparseable -- an ageless claim is
+    scored as brand new, i.e. cheap to move, which fails safe (the
+    planner can only UNDER-protect a claim it cannot date)."""
+    ts = _meta(claim).get("creationTimestamp")
+    if not ts or not isinstance(ts, str):
+        return 0.0
+    import datetime  # noqa: PLC0415 - leaf helper, cold path
+
+    try:
+        created = datetime.datetime.fromisoformat(
+            ts.replace("Z", "+00:00"))
+    except ValueError:
+        return 0.0
+    if created.tzinfo is None:
+        created = created.replace(tzinfo=datetime.timezone.utc)
+    now = time.time() if now is None else now
+    return max(now - created.timestamp(), 0.0)
+
+
+def age_cost(claims: list[dict], age_weight: float = AGE_WEIGHT,
+             age_scale_s: float = AGE_SCALE_S,
+             now: float | None = None) -> float:
+    """The uptime term of a migration-cost score, summed over a move
+    group: each claim contributes ``age_weight x min(uptime /
+    age_scale, 1)``. Shared by the eviction planner and the defrag
+    planner (pkg/defrag) so 'prefer young victims' means the same
+    thing in both."""
+    now = time.time() if now is None else now
+    return age_weight * sum(
+        min(claim_age_s(c, now) / age_scale_s, 1.0) for c in claims)
+
+
+def consumer_pods_of(claim: dict, pods: list[dict]) -> list[dict]:
+    """Pods consuming a claim: reservedFor entries, resourceClaims
+    refs/statuses, and the extended-resource claim status."""
+    ns = _meta(claim).get("namespace", "default")
+    name = _meta(claim).get("name", "")
+    reserved = {
+        (ns, r.get("name", ""))
+        for r in claim.get("status", {}).get("reservedFor") or []
+        if r.get("resource") == "pods"
+    }
+    out = []
+    for pod in pods:
+        pns = _meta(pod).get("namespace", "default")
+        if pns != ns:
+            continue
+        if (pns, _meta(pod).get("name", "")) in reserved:
+            out.append(pod)
+            continue
+        statuses = {s.get("resourceClaimName")
+                    for s in pod.get("status", {}).get(
+                        "resourceClaimStatuses") or []}
+        refs = {r.get("resourceClaimName")
+                for r in pod.get("spec", {}).get(
+                    "resourceClaims") or []}
+        ext = (pod.get("status", {}).get(
+            "extendedResourceClaimStatus") or {}).get(
+            "resourceClaimName")
+        if name in statuses or name in refs or name == ext:
+            out.append(pod)
+    return out
+
+
+def drain_claim(kube, claim: dict, pods: list[dict]) -> None:
+    """The drain stage both migration controllers share (eviction +
+    defrag): evict BOUND consumer pods and drop the reservations.
+    Unbound pods survive -- they simply wait for the re-placement;
+    deleted pods come back through their controllers (Jobs,
+    DaemonSets) exactly like a real eviction."""
+    ns = _meta(claim).get("namespace", "default")
+    for pod in consumer_pods_of(claim, pods):
+        if not pod.get("spec", {}).get("nodeName"):
+            continue
+        try:
+            kube.delete("", "v1", "pods", _meta(pod)["name"],
+                        namespace=ns)
+            logger.warning("evicted pod %s/%s (consumer of migrating "
+                           "claim %s)", ns, _meta(pod)["name"],
+                           _meta(claim).get("uid", ""))
+        except NotFoundError:
+            pass
+    if claim.get("status", {}).get("reservedFor"):
+        try:
+            kube.patch(*RESOURCE, "resourceclaims",
+                       _meta(claim)["name"],
+                       {"status": {"reservedFor": None}},
+                       namespace=ns)
+        except (NotFoundError, ConflictError):
+            pass
+
+
+def clear_allocation(kube, claim: dict) -> bool:
+    """The deallocate stage both migration controllers share: clear
+    the claim's allocation so the incremental scheduler owns
+    re-placement. Returns False when the write was refused (NotFound /
+    Conflict) -- the caller re-examines next pass."""
+    try:
+        kube.patch(*RESOURCE, "resourceclaims", _meta(claim)["name"],
+                   {"status": {"allocation": None}},
+                   namespace=_meta(claim).get("namespace", "default"))
+    except (NotFoundError, ConflictError):
+        return False
+    return True
 
 
 def set_permanent_failure_condition(kube, claim: dict, status: str,
@@ -252,6 +372,7 @@ class EvictionController:
                  deadline_s: float = RECOVERY_DEADLINE_S,
                  max_concurrent: int = MAX_CONCURRENT_EVICTIONS,
                  disruption_weight: float = DISRUPTION_WEIGHT,
+                 age_weight: float = AGE_WEIGHT,
                  clock=time.monotonic):
         # Imported here, not at module top: pkg -> kubeletplugin is a
         # one-way street everywhere else; keeping it function-local
@@ -265,6 +386,7 @@ class EvictionController:
         self.deadline_s = deadline_s
         self.max_concurrent = max(1, int(max_concurrent))
         self.disruption_weight = disruption_weight
+        self.age_weight = age_weight
         self.detector = FailureDetector(
             notready_grace_s=notready_grace_s, clock=clock)
         # Eviction lifecycle records, durable + transition-validated:
@@ -485,11 +607,17 @@ class EvictionController:
             gang = claim_gang_id(claim) if claim else None
             groups.setdefault(gang or f"solo-{uid}", []).append(uid)
         scored = []
+        now = time.time()
         for gid, uids in groups.items():
             cost = sum(len(allocation_device_keys(by_uid[u]))
                        for u in uids if u in by_uid)
             disruption = sum(1 for u in uids if new.get(u) == "gang")
-            score = cost + self.disruption_weight * disruption
+            # Uptime term: admission order prefers young claims, so a
+            # long-running training gang waits behind a fresh
+            # singleton when the concurrency cap forces a choice.
+            aged = age_cost([by_uid[u] for u in uids if u in by_uid],
+                            self.age_weight, now=now)
+            score = cost + self.disruption_weight * disruption + aged
             scored.append((score, gid, uids, cost, disruption))
         scored.sort(key=lambda t: (t[0], t[1]))
         faults.fault_point("recovery.plan")
@@ -613,61 +741,15 @@ class EvictionController:
             return []
 
     def _consumer_pods(self, claim: dict, pods: list[dict]) -> list[dict]:
-        ns = _meta(claim).get("namespace", "default")
-        name = _meta(claim).get("name", "")
-        reserved = {
-            (ns, r.get("name", ""))
-            for r in claim.get("status", {}).get("reservedFor") or []
-            if r.get("resource") == "pods"
-        }
-        out = []
-        for pod in pods:
-            pns = _meta(pod).get("namespace", "default")
-            if pns != ns:
-                continue
-            if (pns, _meta(pod).get("name", "")) in reserved:
-                out.append(pod)
-                continue
-            statuses = {s.get("resourceClaimName")
-                        for s in pod.get("status", {}).get(
-                            "resourceClaimStatuses") or []}
-            refs = {r.get("resourceClaimName")
-                    for r in pod.get("spec", {}).get(
-                        "resourceClaims") or []}
-            ext = (pod.get("status", {}).get(
-                "extendedResourceClaimStatus") or {}).get(
-                "resourceClaimName")
-            if name in statuses or name in refs or name == ext:
-                out.append(pod)
-        return out
+        return consumer_pods_of(claim, pods)
 
     def _drain(self, uid: str, rec, claim: dict,
                pods: list[dict]) -> None:
         """Evict BOUND consumer pods (their node is dead, or their gang
-        claim is being moved under them) and drop the reservations;
-        unbound pods survive -- they simply wait for the re-placement.
-        Deleted pods come back through their controllers (Jobs,
-        DaemonSets) exactly like a real eviction."""
+        claim is being moved under them) and drop the reservations
+        (the shared ``drain_claim`` stage)."""
         faults.fault_point("recovery.drain")
-        ns = _meta(claim).get("namespace", "default")
-        for pod in self._consumer_pods(claim, pods):
-            if not pod.get("spec", {}).get("nodeName"):
-                continue
-            try:
-                self.kube.delete("", "v1", "pods", _meta(pod)["name"],
-                                 namespace=ns)
-                logger.warning("evicted pod %s/%s (consumer of failed "
-                               "claim %s)", ns, _meta(pod)["name"], uid)
-            except NotFoundError:
-                pass
-        if claim.get("status", {}).get("reservedFor"):
-            try:
-                self.kube.patch(*RESOURCE, "resourceclaims",
-                                _meta(claim)["name"],
-                                {"status": {"reservedFor": None}},
-                                namespace=ns)
-            except (NotFoundError, ConflictError):
-                pass
+        drain_claim(self.kube, claim, pods)
         self._write_record(claim, EVICTION_DRAINING, prev=rec)
 
     def _deallocate(self, uid: str, rec, claim: dict) -> bool:
@@ -693,12 +775,7 @@ class EvictionController:
                 "recreated consumer pod generates a fresh claim",
                 ns, _meta(claim).get("name"), uid)
             return False
-        try:
-            self.kube.patch(*RESOURCE, "resourceclaims",
-                            _meta(claim)["name"],
-                            {"status": {"allocation": None}},
-                            namespace=ns)
-        except (NotFoundError, ConflictError):
+        if not clear_allocation(self.kube, claim):
             return True  # re-examined (and retired) next pass
         self._write_record(claim, EVICTION_DEALLOCATED, prev=rec)
         logger.warning("deallocated failed claim %s/%s (uid %s); "

@@ -52,6 +52,11 @@ import time
 import uuid
 
 from . import fleetstate, flightrecorder, tracing
+from .defrag import (
+    DEFRAG_TARGET_ANNOTATION,
+    claim_device_demand as _defrag_claim_demand,
+    parse_target_hint as _parse_defrag_hint,
+)
 from .events import emit_warning_event
 from .featuregates import (
     TOPOLOGY_AWARE_PLACEMENT,
@@ -105,7 +110,7 @@ DEFAULT_SCHED_BATCH = 8
 # Dirty-key kinds handled by the dedicated control worker (shard 0).
 _CTL_KINDS = frozenset((
     "full", "pending", "inventory", "daemonsets", "jobs", "recovery",
-    "pods-rescan",
+    "defrag", "pods-rescan",
 ))
 
 
@@ -264,6 +269,12 @@ class DraScheduler:
         # slice / claim events + the safety resync) and veto allocation
         # onto permanently failed nodes.
         self.recovery = None
+        # Active defragmentation (pkg/defrag.DefragController): rides
+        # the same loop (full passes + claim events while moves are in
+        # flight); its device reservations veto allocation off carve
+        # cells and move targets, and its placement hints steer the
+        # re-placement of moving claims.
+        self.defrag = None
         # Claim-lifecycle flight recorder (pkg/flightrecorder): every
         # dirty-key enqueue / fit outcome / commit conflict / patch
         # lands in the bounded ring served at /debug/claims.
@@ -303,6 +314,20 @@ class DraScheduler:
         if self.sched_metrics is not None:
             controller.slo = self.sched_metrics.slo
         self.recovery = controller
+        return self
+
+    def attach_defrag(self, controller) -> "DraScheduler":
+        """Drive a pkg/defrag.DefragController from this scheduler's
+        loop: its sync runs inside every full pass (after the fleet
+        fold, so the frag rings it triggers on are fresh) and on claim
+        dirty keys while moves are in flight; its reads come from this
+        scheduler's informer-backed view; allocation honors its
+        placement hints and vetoes its device reservations."""
+        controller.view = self.view
+        if controller.fleet is None:
+            # The trigger signal reads THIS scheduler's fleet rings.
+            controller.fleet = self.fleet
+        self.defrag = controller
         return self
 
     # -- sharding plumbing ----------------------------------------------------
@@ -773,6 +798,14 @@ class DraScheduler:
             if window:
                 nodes = ([n for n in nodes if n in window]
                          + [n for n in nodes if n not in window])
+            hint = self._defrag_hint(claim)
+            if hint is not None and hint[0] in snap.by_node:
+                # A claim mid-defrag-move probes its planned target
+                # node first (pure preference: every other node stays
+                # in the walk, so a stale hint degrades instead of
+                # wedging).
+                nodes = ([hint[0]]
+                         + [n for n in nodes if n != hint[0]])
         if self.recovery is not None:
             # Permanently failed nodes may still have slices published
             # (a dead kubelet can't retract them): allocation must
@@ -1043,6 +1076,18 @@ class DraScheduler:
         # is the pre-topology first-fit order, verbatim.
         return out if any_signal else cands
 
+    @staticmethod
+    def _defrag_hint(claim) -> tuple[str, list[str]] | None:
+        """The defrag controller's placement hint for a moving claim:
+        (target node, target device names), or None. Parsed from the
+        ``resource.tpu.dra/defrag-target`` annotation the controller
+        stamps before deallocating (pkg/defrag)."""
+        raw = (_meta(claim).get("annotations") or {}).get(
+            DEFRAG_TARGET_ANNOTATION)
+        if not raw:
+            return None
+        return _parse_defrag_hint(raw)
+
     def _preferred_gang_nodes(self, claim) -> list[str] | None:
         """ComputeDomain channel claims prefer the ICI-adjacent host
         window the CD controller picked (its preferred-nodes
@@ -1140,10 +1185,36 @@ class DraScheduler:
                         list(exactly.get("tolerations") or []))
                 ],
             })
+        if self.defrag is not None:
+            # Defrag device veto: carve cells and in-flight move
+            # targets are reserved -- only the claim a device is
+            # reserved FOR may allocate it while the move is in
+            # flight (everyone else fits around the forming shape).
+            reserved = self.defrag.reservations()
+            if reserved:
+                uid = _meta(claim).get("uid", "")
+                for r in reqs:
+                    r["cands"] = [
+                        c for c in r["cands"]
+                        if c.key not in reserved
+                        or (uid and reserved[c.key] == uid)]
         if self._topology:
             for r in reqs:
                 r["cands"] = self._topology_order(snap, r["cands"],
                                                  r["want"])
+        hint = self._defrag_hint(claim)
+        if hint is not None and hint[0] == node:
+            # Defrag placement hint: the controller's planned target
+            # devices lead each request's candidate order. Applied
+            # AFTER the topology reorder (the hint is the stronger,
+            # claim-specific signal) and independent of the topology
+            # gate; ordering only -- the backtracking fit still
+            # decides.
+            hinted = set(hint[1])
+            for r in reqs:
+                r["cands"] = (
+                    [c for c in r["cands"] if c.name in hinted]
+                    + [c for c in r["cands"] if c.name not in hinted])
         constraints = []
         for c in spec.get("constraints") or []:
             attr = c.get("matchAttribute")
@@ -1351,19 +1422,10 @@ class DraScheduler:
     def _claim_device_demand(claim) -> int:
         """Rough device count one claim needs (All-mode counts 1):
         a sibling with less free capacity than this can be skipped
-        without a fit."""
-        total = 0
-        for req in claim.get("spec", {}).get("devices", {}).get(
-                "requests", []):
-            exactly = req.get("exactly") or req
-            if exactly.get("allocationMode", "ExactCount") == "All":
-                total += 1
-            else:
-                try:
-                    total += max(int(exactly.get("count", 1)), 1)
-                except (TypeError, ValueError):
-                    total += 1
-        return max(total, 1)
+        without a fit. ONE rule, shared with the defrag demand
+        signal (pkg/defrag.claim_device_demand) so the two readers
+        of 'how many chips does this claim want' can never drift."""
+        return _defrag_claim_demand(claim)
 
     def _sibling_capacity(self) -> dict[str, tuple[int, int]]:
         """sibling name -> (free devices, total devices) across the
@@ -1979,6 +2041,10 @@ class DraScheduler:
         self._allocate_claims()
         self._bind_pods()
         self._observe_fleet()
+        if self._cluster_controllers:
+            # After the fleet fold: the defrag trigger reads the frag
+            # rings THIS pass just refreshed.
+            self._sync_defrag()
         if self.sched_metrics is not None:
             self.sched_metrics.sync_seconds.labels("full").observe(
                 time.monotonic() - t0)
@@ -2018,6 +2084,17 @@ class DraScheduler:
             self.recovery.sync_once()
         except Exception:  # noqa: BLE001 - control loop
             logger.exception("recovery sync failed")
+
+    def _sync_defrag(self) -> None:
+        """One defrag-controller pass. InjectedCrash (a BaseException)
+        sails through on purpose -- the crash-resume suite's
+        controller-death scenarios depend on it."""
+        if self.defrag is None:
+            return
+        try:
+            self.defrag.sync_once()
+        except Exception:  # noqa: BLE001 - control loop
+            logger.exception("defrag sync failed")
 
     # -- event-driven incremental sync ----------------------------------------
 
@@ -2122,6 +2199,12 @@ class DraScheduler:
                 # pays a recovery pass. New victims only appear via
                 # node/slice failures, which enqueue unconditionally.
                 self._enqueue(("recovery",))
+            if self.defrag is not None and self.defrag.busy():
+                # Same gating for in-flight defrag moves: a moving
+                # claim's re-allocation (or deletion) advances its
+                # record without waiting for the safety resync; quiet
+                # fleets never pay a defrag pass per claim event.
+                self._enqueue(("defrag",))
             for pod_name in self._dependent_pods(ns, name, obj):
                 self._enqueue(("pod", ns, pod_name))
         elif resource == "resourceslices":
@@ -2186,7 +2269,7 @@ class DraScheduler:
         t0 = time.monotonic()
         kind = key[0]
         try:
-            if kind in ("daemonsets", "jobs", "recovery") and \
+            if kind in ("daemonsets", "jobs", "recovery", "defrag") and \
                     not self._cluster_controllers:
                 return  # another domain owns the cluster controllers
             if kind == "full":
@@ -2216,6 +2299,11 @@ class DraScheduler:
                 # A recovery pass may have deallocated claims; give
                 # them their re-placement attempt without waiting for
                 # the safety resync.
+                self._retry_pending_claims()
+            elif kind == "defrag":
+                self._sync_defrag()
+                # A defrag pass deallocates moving claims; re-place
+                # them (onto their hinted targets) immediately.
                 self._retry_pending_claims()
             elif kind == "pods-rescan":
                 for pod in self._pods():
@@ -2520,6 +2608,11 @@ def main(argv: list[str] | None = None) -> int:
                         "eviction controller's durable eviction "
                         "records; empty = recovery disabled "
                         "[TPU_DRA_RECOVERY_ROOT]")
+    p.add_argument("--defrag-root",
+                   default=os.environ.get("TPU_DRA_DEFRAG_ROOT", ""),
+                   help="state root for the active-defragmentation "
+                        "controller's durable move records; empty = "
+                        "defrag disabled [TPU_DRA_DEFRAG_ROOT]")
     args = p.parse_args(argv)
     from . import logsetup  # noqa: PLC0415
 
@@ -2580,6 +2673,14 @@ def main(argv: list[str] | None = None) -> int:
                             if metrics is not None else None)
         sched.attach_recovery(EvictionController(
             sched.kube, args.recovery_root, metrics=recovery_metrics))
+    if args.defrag_root:
+        from .defrag import DefragController  # noqa: PLC0415
+        from .metrics import DefragMetrics  # noqa: PLC0415
+
+        defrag_metrics = (DefragMetrics(registry=metrics.registry)
+                          if metrics is not None else None)
+        sched.attach_defrag(DefragController(
+            sched.kube, args.defrag_root, metrics=defrag_metrics))
     print("scheduler running", flush=True)
     try:
         if args.sched_mode == "events" and args.leader_elect:

@@ -24,6 +24,8 @@ fallback still produces compact -- just not box-shaped -- sets.
 
 from __future__ import annotations
 
+import threading
+
 from .grid import Coord, TorusGrid
 from .shapes import enumerate_shapes, placements, shapes_for_count
 
@@ -67,15 +69,75 @@ def frag_cost(pick: set[Coord],
                if not cells.isdisjoint(pick))
 
 
+def grid_signature(grid: TorusGrid) -> tuple:
+    """A hashable identity for a grid's geometry: dims, wraparound,
+    and the coordinate map. A pure function of the published devices,
+    so two grids built from the same slices share one memo row.
+
+    Cached on the grid instance: TorusGrid is immutable after
+    ``from_devices``, and the fleet fold + the defrag what-if loop
+    query the same grid object many times per pass -- without the
+    cache the O(n log n) coord sort would dominate every memo hit.
+    (``object.__setattr__``: the dataclass is frozen, which blocks
+    the normal spelling but not this deliberate one-shot memo.)"""
+    sig = getattr(grid, "_signature_memo", None)
+    if sig is None:
+        sig = (grid.dims, grid.wrap,
+               tuple(sorted(grid.coords.items())))
+        try:
+            object.__setattr__(grid, "_signature_memo", sig)
+        except (AttributeError, TypeError):
+            pass  # slotted/odd grid subclass: recompute per call
+    return sig
+
+
+# largest_free_shape memo: (grid signature, frozenset(free)) ->
+# (shape, chips). The FleetAggregator fold recomputes every pool's
+# frag each pass and the defrag what-if loop probes dozens of
+# hypothetical free sets against ONE grid -- without the memo each
+# probe pays the full O(shapes x placements) sweep. Bounded FIFO
+# (oldest third dropped at the cap) so a long-lived scheduler can't
+# grow it without bound.
+_SHAPE_MEMO: dict[tuple, tuple[tuple[int, int, int], int]] = {}
+_SHAPE_MEMO_MAX = 4096
+_shape_memo_lock = threading.Lock()
+
+
+def clear_shape_memo() -> None:
+    """Drop the largest_free_shape memo (tests / bench isolation)."""
+    with _shape_memo_lock:
+        _SHAPE_MEMO.clear()
+
+
 def largest_free_shape(grid: TorusGrid, free: set[Coord]
                        ) -> tuple[tuple[int, int, int], int]:
     """The biggest sub-torus shape still fully placeable in ``free``
-    -> (shape, chips); ((0, 0, 0), 0) when nothing is free."""
+    -> (shape, chips); ((0, 0, 0), 0) when nothing is free.
+
+    Memoized on (grid signature, free set): the sweep is the most
+    expensive topology operation, and both the fleet fold and the
+    defrag planner call it repeatedly with recurring inputs."""
+    key = (grid_signature(grid), frozenset(free))
+    with _shape_memo_lock:
+        hit = _SHAPE_MEMO.get(key)
+    if hit is not None:
+        return hit
+    result: tuple[tuple[int, int, int], int] = ((0, 0, 0), 0)
     for shape in enumerate_shapes(grid, max_chips=len(free)):
+        placed = False
         for cells in placements(grid, shape):
             if all(c in free for c in cells):
-                return shape, shape[0] * shape[1] * shape[2]
-    return (0, 0, 0), 0
+                result = (shape, shape[0] * shape[1] * shape[2])
+                placed = True
+                break
+        if placed:
+            break
+    with _shape_memo_lock:
+        if len(_SHAPE_MEMO) >= _SHAPE_MEMO_MAX:
+            for old in list(_SHAPE_MEMO)[:_SHAPE_MEMO_MAX // 3]:
+                del _SHAPE_MEMO[old]
+        _SHAPE_MEMO[key] = result
+    return result
 
 
 def frag_from_largest(largest_chips: int, free_count: int) -> float:

@@ -18,13 +18,15 @@ import random
 import statistics
 from dataclasses import dataclass
 
-from .grid import TorusGrid, default_wrap
+from .grid import Coord, TorusGrid, default_wrap
 from .score import (
+    _greedy_sets,
     frag_from_largest,
     largest_free_shape,
     rank_placements,
     set_compactness,
 )
+from .shapes import enumerate_shapes, placements, shapes_for_count
 
 # Typical TPU claim sizes: single chips up to half-slice blocks.
 DEFAULT_SIZES = (1, 1, 2, 4, 4, 8)
@@ -155,6 +157,149 @@ def simulate_churn(grid: TorusGrid, trace: list[TraceEvent],
         "allocs": len(hops),
         "alloc_failures": failed,
     }
+
+
+# -- simulated re-pack (the defrag controller's what-if engine) ---------------
+
+
+@dataclass(frozen=True)
+class RepackMove:
+    """One planned relocation: ``claim`` vacates ``cells`` and re-lands
+    on ``target`` (both in grid coordinates)."""
+
+    claim: str
+    cells: tuple[Coord, ...]
+    target: tuple[Coord, ...]
+
+
+@dataclass(frozen=True)
+class RepackPlan:
+    """A feasible carve: relocate ``moves`` and the ``goal_shape``
+    sub-torus at ``goal_cells`` becomes fully free. ``chips_before`` /
+    ``chips_after`` are the largest-free-shape sizes the plan trades
+    between (the frag-recovered signal)."""
+
+    moves: tuple[RepackMove, ...]
+    goal_shape: tuple[int, int, int]
+    goal_cells: frozenset[Coord]
+    chips_before: int
+    chips_after: int
+
+
+def _same_node(cells, node_of) -> bool:
+    """A relocated claim must land on ONE node: allocation fits per
+    node (pkg/scheduler._fit_on_node), so a cross-node destination
+    could never actually be committed."""
+    if node_of is None:
+        return True
+    nodes = {node_of.get(c) for c in cells}
+    return len(nodes) == 1 and None not in nodes
+
+
+def _place_displaced(grid: TorusGrid, avail: set[Coord], size: int,
+                     node_of=None) -> tuple[Coord, ...] | None:
+    """Destination cells for one displaced claim: the most compact
+    exact sub-torus placement fully inside ``avail``, falling back to
+    a greedy nearest-neighbor set when no box fits."""
+    for shape in shapes_for_count(grid, size):
+        for cells in placements(grid, shape):
+            if all(c in avail for c in cells) and \
+                    _same_node(cells, node_of):
+                return cells
+    for cells in _greedy_sets(grid, avail, size):
+        if _same_node(cells, node_of):
+            return cells
+    return None
+
+
+def plan_repack(grid: TorusGrid, free: set[Coord],
+                allocations: dict[str, set[Coord]],
+                movable=None, cost_fn=None, max_moves: int | None = None,
+                node_of: dict[Coord, str] | None = None
+                ) -> RepackPlan | None:
+    """Simulated re-pack: the largest sub-torus shape that can be made
+    fully free by relocating at most ``max_moves`` movable claims into
+    the remaining free space, and the cheapest way to do it.
+
+    The search walks the protected-shape catalog largest volume first;
+    for each placement of a shape it collects the claims squatting on
+    it, verifies every one is ``movable`` and re-placeable in the
+    space left over, and scores the displacement with ``cost_fn``
+    (claim ids -> float; defaults to the claim count). Among feasible
+    carves of the winning volume the cheapest (then fewest chips
+    moved, then deterministic anchor order) wins -- the 2502.01909
+    multi-objective trade: frag recovered vs. migration cost, with
+    gang disruption and claim age folded in by the caller's cost_fn.
+
+    Returns None when no shape larger than the current largest free
+    shape can be carved within the move budget.
+    """
+    free = set(free)
+    movable = movable if movable is not None else (lambda cid: True)
+    cost_fn = cost_fn if cost_fn is not None else \
+        (lambda cids: float(len(cids)))
+    _, chips_before = largest_free_shape(grid, free)
+    cell_owner: dict[Coord, str] = {}
+    for cid, cells in allocations.items():
+        for c in cells:
+            cell_owner[c] = cid
+    movable_chips = sum(len(cells) for cid, cells in allocations.items()
+                        if movable(cid))
+    best: tuple | None = None  # (volume, (cost, moved, cells), shape,
+    #                             cells, targets)
+    for shape in enumerate_shapes(
+            grid, max_chips=len(free) + movable_chips):
+        vol = shape[0] * shape[1] * shape[2]
+        if vol <= chips_before:
+            break  # volume-descending: no gain left below this
+        if best is not None and vol < best[0]:
+            break  # every shape of the winning volume already judged
+        for cells in placements(grid, shape):
+            cellset = set(cells)
+            if not all(c in free or c in cell_owner for c in cellset):
+                continue  # overlaps a device the planner can't model
+            owners = sorted({cell_owner[c] for c in cellset
+                             if c in cell_owner})
+            if not owners or any(not movable(o) for o in owners):
+                continue
+            if max_moves is not None and len(owners) > max_moves:
+                continue
+            displaced = set().union(*(allocations[o] for o in owners))
+            avail = (free | displaced) - cellset
+            targets: dict[str, tuple[Coord, ...]] = {}
+            ok = True
+            # Relocate biggest claims first: they need the contiguous
+            # space the smaller ones would otherwise shred.
+            for o in sorted(owners,
+                            key=lambda o: (-len(allocations[o]), o)):
+                dest = _place_displaced(grid, avail,
+                                        len(allocations[o]), node_of)
+                if dest is None:
+                    ok = False
+                    break
+                targets[o] = dest
+                avail -= set(dest)
+            if not ok:
+                continue
+            key = (cost_fn(tuple(owners)),
+                   sum(len(allocations[o]) for o in owners), cells)
+            if best is None or vol > best[0] or \
+                    (vol == best[0] and key < best[1]):
+                best = (vol, key, shape, cells, targets)
+    if best is None:
+        return None
+    _vol, _key, shape, cells, targets = best
+    moves = tuple(
+        RepackMove(claim=o, cells=tuple(sorted(allocations[o])),
+                   target=targets[o])
+        for o in sorted(targets))
+    projected = (free | set().union(*(allocations[o] for o in targets))
+                 ) - set().union(*(set(t) for t in targets.values()))
+    _, chips_after = largest_free_shape(grid, projected)
+    return RepackPlan(moves=moves, goal_shape=shape,
+                      goal_cells=frozenset(cells),
+                      chips_before=chips_before,
+                      chips_after=chips_after)
 
 
 def run_placement_bench(topologies=("v5e-16", "v5p-32"), steps: int = 400,

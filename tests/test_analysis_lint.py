@@ -174,6 +174,19 @@ class TestStateLiteralRule:
         src = "PREPARE_STARTED = 'PrepareStarted'\n"
         assert lint_source(src, rel="kubeletplugin/checkpoint.py") == []
 
+    def test_raw_defrag_state_literal_flagged(self):
+        """The defrag-move lifecycle literals (pkg/defrag.py) are
+        fenced exactly like the prepare/eviction/partition states."""
+        src = ("def f(rec):\n"
+               "    return rec.state in ('DefragPlanned',"
+               " 'DefragDraining', 'DefragDeallocated')\n")
+        findings = lint_source(src, rel="pkg/defrag.py")
+        assert sum(1 for f in findings if f.rule == "TPUDRA005") == 3
+
+    def test_defrag_statemachine_definition_site_exempt(self):
+        src = "DEFRAG_PLANNED = 'DefragPlanned'\n"
+        assert lint_source(src, rel="pkg/analysis/statemachine.py") == []
+
 
 class TestCachedObjectMutationRule:
     def test_mutating_kube_get_result_flagged(self):

@@ -13,6 +13,11 @@ from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
     DeviceState,
 )
 from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+    DEFRAG_DEALLOCATED,
+    DEFRAG_DRAINING,
+    DEFRAG_PLANNED,
+    DEFRAG_POLICY,
+    POLICIES,
     PREPARE_COMPLETED,
     PREPARE_STARTED,
     SINGLE_PHASE_POLICY,
@@ -78,6 +83,33 @@ class TestTransitionPolicy:
         with pytest.raises(CheckpointTransitionError,
                            match="claim u-1.*two-phase"):
             TWO_PHASE_POLICY.validate("u-1", None, PREPARE_COMPLETED)
+
+    @pytest.mark.parametrize("old,new", [
+        (None, DEFRAG_PLANNED),
+        (DEFRAG_PLANNED, DEFRAG_DRAINING),
+        (DEFRAG_DRAINING, DEFRAG_DEALLOCATED),
+        (DEFRAG_PLANNED, None),       # canceled / aborted
+        (DEFRAG_DRAINING, None),
+        (DEFRAG_DEALLOCATED, None),   # re-placed / aborted
+    ])
+    def test_defrag_ladder_legal(self, old, new):
+        DEFRAG_POLICY.validate("u", old, new)  # no raise
+
+    @pytest.mark.parametrize("old,new", [
+        (None, DEFRAG_DRAINING),       # drain without a durable plan
+        (None, DEFRAG_DEALLOCATED),    # dealloc without a plan
+        (DEFRAG_PLANNED, DEFRAG_DEALLOCATED),   # skipped the drain
+        (DEFRAG_DEALLOCATED, DEFRAG_PLANNED),   # backwards
+    ])
+    def test_defrag_stage_skips_illegal(self, old, new):
+        with pytest.raises(CheckpointTransitionError):
+            DEFRAG_POLICY.validate("u", old, new)
+
+    def test_defrag_policy_registered(self):
+        """The AST pass (TPUDRA007) resolves policies through this
+        registry: pkg/defrag.py's CheckpointManager must find its
+        declared policy there."""
+        assert POLICIES["defrag"] is DEFRAG_POLICY
 
 
 class TestRuntimeValidatorInCheckpointManager:

@@ -1,0 +1,64 @@
+"""Tier-1 defrag smoke: the `make bench-defrag-smoke` contract as a
+non-slow test. Runs bench.py --defrag at reduced scale and asserts the
+active-defragmentation acceptance bar: seeded churn decays the pool's
+fragmentation past the trigger, the controller converges it back to
+<= the release target with the largest catalog gang shape allocatable
+again, migrations stay inside the budget, nothing is left stuck (no
+records / reservations / hints / pending claims / double
+allocations), and the compact no-churn control run executes ZERO
+moves (the hysteresis proof) -- plus the BENCH_defrag.json trajectory
+file actually written."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-defrag-smoke target.
+SMOKE_ENV = {
+    "BENCH_DEFRAG_DIMS": "6x6",
+    "BENCH_DEFRAG_STEPS": "120",
+    "BENCH_DEFRAG_ARRIVAL": "0.45",
+}
+
+
+def test_bench_defrag_smoke_converges_the_pool(tmp_path):
+    out_json = tmp_path / "BENCH_defrag.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--defrag"],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV,
+             "BENCH_DEFRAG_OUT": str(out_json)},
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "defrag_violations"
+    # THE acceptance bar: zero violations of any kind.
+    assert doc["value"] == 0
+    extras = doc["extras"]
+
+    # Churn genuinely decayed the pool past the trigger...
+    assert extras["defrag_decayed_frag"] >= 0.25
+    # ...and the controller converged it back below the target with
+    # the catalog gang shape allocatable again.
+    assert extras["defrag_final_frag"] <= 0.15
+    assert extras["defrag_final_largest"] >= 8
+    # Bounded budget: moves within 15% of the live claims.
+    assert 0 < extras["defrag_moves"] <= extras["defrag_move_budget"]
+    # Nothing stuck, nothing double-allocated, nothing aborted.
+    assert extras["defrag_stuck"] == 0
+    assert extras["defrag_double_allocated"] == 0
+    assert extras["defrag_aborted"] == 0
+    assert extras["defrag_frag_recovered_chips"] > 0
+
+    # The hysteresis proof: the compact control run planned nothing.
+    assert extras["defrag_control_moves"] == 0
+    assert extras["defrag_control_plans"] == 0
+
+    # The trajectory file landed with both phases recorded.
+    recorded = json.loads(out_json.read_text())
+    assert recorded["metric"] == "defrag_violations"
+    phases = {p["phase"] for p in recorded["trajectory"]}
+    assert phases == {"decay", "converge"}

@@ -1,0 +1,1044 @@
+"""Active defragmentation tier (ISSUE 12): the frag-drift trigger
+(pkg/fleetstate.frag_signal drives pkg/defrag.DefragController), the
+multi-objective re-pack planner (pkg/topology/sim.plan_repack), the
+durable move pipeline riding the eviction stages, and the scheduler's
+hint/veto integration.
+
+The acceptance bar under test: a shredded pool converges back to a
+large free sub-torus by migrating a bounded set of claims -- protected
+(opt-out) claims never move, priority-annotated claims only move for
+strictly-higher-priority demand, young claims move before old gangs,
+a controller crash at ANY fault point resumes idempotently, and no
+schedule of a move racing a user claim-delete ever double-allocates
+or leaves a stuck record."""
+
+import os
+import time
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg import faults
+from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+    CheckpointTransitionError,
+    DEFRAG_DEALLOCATED,
+    DEFRAG_DRAINING,
+    DEFRAG_PLANNED,
+)
+from k8s_dra_driver_gpu_tpu.pkg.defrag import (
+    DEFRAG_TARGET_ANNOTATION,
+    DefragController,
+    OPT_OUT_ANNOTATION,
+    PRIORITY_ANNOTATION,
+    parse_target_hint,
+)
+from k8s_dra_driver_gpu_tpu.pkg.faults import InjectedCrash
+from k8s_dra_driver_gpu_tpu.pkg.featuregates import FeatureGates
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.metrics import DefragMetrics
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+from k8s_dra_driver_gpu_tpu.pkg.sliceutil import publish_resource_slices
+from k8s_dra_driver_gpu_tpu.pkg.topology.grid import TorusGrid
+from k8s_dra_driver_gpu_tpu.pkg.topology.sim import plan_repack
+
+RES = ("resource.k8s.io", "v1")
+DRIVER = "tpu.dra.dev"
+
+OLD_TS = "2020-01-01T00:00:00Z"
+
+
+# -- cluster scaffolding ------------------------------------------------------
+
+
+def apply_class(kube, name=DRIVER):
+    kube.create(*RES, "deviceclasses", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": name},
+        "spec": {"selectors": [{"cel": {
+            "expression": f'device.driver == "{name}"'}}]},
+    })
+
+
+def node_slices(node, dims=(4, 4)):
+    """One coordinated pool: chips named chip-<i> at (i%w, i//w)."""
+    devices = []
+    i = 0
+    for y in range(dims[1]):
+        for x in range(dims[0]):
+            devices.append({
+                "name": f"chip-{i}",
+                "attributes": {
+                    "type": {"string": "tpu-chip"},
+                    "platform": {"string": "v5e"},
+                    "topology": {"string": f"{dims[0]}x{dims[1]}"},
+                    "iciX": {"int": x}, "iciY": {"int": y},
+                }})
+            i += 1
+    return [{
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+        "metadata": {"name": f"{node}-{DRIVER}"},
+        "spec": {"driver": DRIVER, "nodeName": node,
+                 "pool": {"name": node, "generation": 1,
+                          "resourceSliceCount": 1},
+                 "devices": devices},
+    }]
+
+
+def add_node(kube, name):
+    kube.create("", "v1", "nodes", {
+        "metadata": {"name": name, "labels": {}},
+        "status": {"conditions": [
+            {"type": "Ready", "status": "True"}]},
+    })
+
+
+def make_claim(kube, name, count=1, annotations=None, gang=None,
+               created=None, same_row=False):
+    exactly = {"deviceClassName": DRIVER}
+    if count != 1:
+        exactly["count"] = count
+    spec = {"devices": {"requests": [{"name": "tpu",
+                                      "exactly": exactly}]}}
+    if same_row:
+        # The contiguity constraint that makes a multi-chip claim
+        # genuinely pend on a shredded pool: all chips on one ICI row.
+        spec["devices"]["constraints"] = [
+            {"matchAttribute": f"{DRIVER}/iciY"}]
+    if gang:
+        spec["devices"]["config"] = [{"opaque": {
+            "driver": DRIVER,
+            "parameters": {"kind": "ComputeDomainChannelConfig",
+                           "domainID": gang},
+        }}]
+    meta = {"name": name, "namespace": "default"}
+    if annotations:
+        meta["annotations"] = dict(annotations)
+    if created:
+        meta["creationTimestamp"] = created
+    kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": meta, "spec": spec}, namespace="default")
+
+
+def claim_of(kube, name):
+    return kube.get(*RES, "resourceclaims", name, namespace="default")
+
+
+def alloc_devs(kube, name):
+    alloc = claim_of(kube, name).get("status", {}).get("allocation")
+    if not alloc:
+        return None
+    return sorted(r["device"] for r in alloc["devices"]["results"])
+
+
+def occupy(kube, sched, layout, node="node-a"):
+    """Allocate claims onto EXACT chips by stamping the allocation
+    status directly (deterministic layouts regardless of placement
+    policy); one scheduler pass then observes everything.
+    ``layout``: name -> dict(make_claim kwargs, chips=[indices])."""
+    for name, opts in layout.items():
+        opts = dict(opts)
+        chips = opts.pop("chips")
+        make_claim(kube, name, count=len(chips), **opts)
+        alloc = {
+            "devices": {"results": [
+                {"request": "tpu", "driver": DRIVER, "pool": node,
+                 "device": f"chip-{i}"} for i in chips]},
+            "nodeSelector": {"nodeSelectorTerms": [{"matchFields": [{
+                "key": "metadata.name", "operator": "In",
+                "values": [node]}]}]},
+        }
+        kube.patch(*RES, "resourceclaims", name,
+                   {"status": {"allocation": alloc}},
+                   namespace="default")
+    sched.sync_once()
+    for name, opts in layout.items():
+        want = sorted(f"chip-{i}" for i in opts["chips"])
+        got = alloc_devs(kube, name)
+        assert got == want, f"setup: {name} landed {got}, want {want}"
+
+
+def frag_point(sched, pool="node-a"):
+    snap = sched.fleet.snapshot()
+    entry = snap["pools"].get(f"{DRIVER}/{pool}") or {}
+    return entry.get("current") or {}
+
+
+def settle(sched, passes=8):
+    for _ in range(passes):
+        sched.sync_once()
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """(kube, scheduler, controller): one 4x4 coordinated pool,
+    first-fit placement (topology gate off so tests control the
+    layout), instant-fire defrag controller driven by sync_once."""
+    fake = FakeKubeClient()
+    apply_class(fake)
+    add_node(fake, "node-a")
+    publish_resource_slices(fake, node_slices("node-a"))
+    sched = DraScheduler(fake, gates=FeatureGates.parse(
+        "TopologyAwarePlacement=false"))
+    ctrl = DefragController(
+        fake, str(tmp_path / "defrag"), trigger=0.25, release=0.15,
+        sustain_s=0.0, max_concurrent=4, deadline_s=60.0,
+        budget_pct=100.0, cooldown_s=0.0)
+    sched.attach_defrag(ctrl)
+    return fake, sched, ctrl
+
+
+def checkerboard(fake, sched):
+    """Fill the 4x4 pool with 16 singles, delete the (x+y)-odd half:
+    free space becomes a perfect checkerboard (largest free shape 1,
+    frag 0.875)."""
+    layout = {f"c{i}": {"chips": [i]} for i in range(16)}
+    occupy(fake, sched, layout)
+    survivors = []
+    for i in range(16):
+        x, y = i % 4, i // 4
+        if (x + y) % 2 == 1:
+            fake.delete(*RES, "resourceclaims", f"c{i}",
+                        namespace="default")
+        else:
+            survivors.append(f"c{i}")
+    return survivors
+
+
+# -- the re-pack planner (pkg/topology/sim.plan_repack) -----------------------
+
+
+class TestPlanRepack:
+    def _grid(self, dims=(4, 4)):
+        coords = {}
+        i = 0
+        for y in range(dims[1]):
+            for x in range(dims[0]):
+                coords[f"chip-{i}"] = (x, y, 0)
+                i += 1
+        return TorusGrid(dims=(dims[0], dims[1], 1),
+                         wrap=(False, False, False), coords=coords)
+
+    def test_checkerboard_carve(self):
+        grid = self._grid()
+        allocs = {}
+        free = set()
+        for name, c in grid.coords.items():
+            if (c[0] + c[1]) % 2 == 0:
+                allocs[f"u-{name}"] = {c}
+            else:
+                free.add(c)
+        plan = plan_repack(grid, free, allocs)
+        assert plan is not None
+        assert plan.chips_before == 1
+        assert plan.chips_after >= 8
+        # Targets are disjoint from the carve and from each other.
+        used = set()
+        for move in plan.moves:
+            cells = set(move.target)
+            assert not cells & plan.goal_cells
+            assert not cells & used
+            used |= cells
+
+    def test_budget_shrinks_the_carve(self):
+        grid = self._grid()
+        allocs = {}
+        free = set()
+        for name, c in grid.coords.items():
+            if (c[0] + c[1]) % 2 == 0:
+                allocs[f"u-{name}"] = {c}
+            else:
+                free.add(c)
+        plan = plan_repack(grid, free, allocs, max_moves=2)
+        assert plan is not None
+        assert len(plan.moves) <= 2
+        # 2 moves can clear a 2x2 window of a checkerboard, not a 2x4.
+        assert plan.chips_after >= 4
+
+    def test_unmovable_claims_block_their_placements(self):
+        grid = self._grid()
+        # Row 1 and row 3 fully held by protected claims; row 0
+        # blocked by m-old, row 2 by m-young. Only rows 0/2 are
+        # feasible 4x1 carves.
+        allocs, protected = {}, set()
+        for y in (1, 3):
+            for x in range(4):
+                uid = f"p-{x}-{y}"
+                allocs[uid] = {(x, y, 0)}
+                protected.add(uid)
+        allocs["m-a"] = {(0, 0, 0)}
+        allocs["m-b"] = {(0, 2, 0)}
+        free = {c for c in grid.coords.values()
+                if not any(c in cells for cells in allocs.values())}
+        plan = plan_repack(grid, free, allocs,
+                           movable=lambda u: u not in protected)
+        assert plan is not None
+        moved = {m.claim for m in plan.moves}
+        assert moved in ({"m-a"}, {"m-b"})
+        assert not moved & protected
+
+    def test_cost_fn_picks_the_cheaper_victim(self):
+        grid = self._grid()
+        allocs = {}
+        for y in (1, 3):
+            for x in range(4):
+                allocs[f"p-{x}-{y}"] = {(x, y, 0)}
+        allocs["cheap"] = {(0, 0, 0)}
+        allocs["dear"] = {(0, 2, 0)}
+        free = {c for c in grid.coords.values()
+                if not any(c in cells for cells in allocs.values())}
+        plan = plan_repack(
+            grid, free, allocs,
+            movable=lambda u: u in ("cheap", "dear"),
+            cost_fn=lambda uids: sum(
+                100.0 if u == "dear" else 1.0 for u in uids))
+        assert {m.claim for m in plan.moves} == {"cheap"}
+
+    def test_node_of_restricts_targets_to_one_node(self):
+        grid = self._grid((4, 2))
+        node_of = {c: ("n0" if c[1] == 0 else "n1")
+                   for c in grid.coords.values()}
+        # A 2-chip claim squats on row 0; every 3x2 carve leaves only
+        # a CROSS-NODE pair as its destination. Without node_of the
+        # planner would take it (and the scheduler could never commit
+        # it); with node_of the carve is correctly infeasible.
+        allocs = {"m": {(0, 0, 0), (1, 0, 0)}}
+        free = {(2, 0, 0), (3, 0, 0), (0, 1, 0), (1, 1, 0),
+                (2, 1, 0), (3, 1, 0)}
+        unconstrained = plan_repack(grid, free, allocs)
+        assert unconstrained is not None
+        assert any(len({node_of[c] for c in m.target}) > 1
+                   for m in unconstrained.moves)
+        assert plan_repack(grid, free, allocs, node_of=node_of) is None
+
+    def test_no_gain_returns_none(self):
+        grid = self._grid()
+        # Compact half-full pool: the free half IS the largest shape.
+        allocs = {f"u{y}{x}": {(x, y, 0)}
+                  for y in (0, 1) for x in range(4)}
+        free = {(x, y, 0) for y in (2, 3) for x in range(4)}
+        assert plan_repack(grid, free, allocs) is None
+
+
+# -- trigger + convergence ----------------------------------------------------
+
+
+class TestDefragConverges:
+    def test_checkerboard_converges_to_large_free_shape(self, cluster):
+        fake, sched, ctrl = cluster
+        survivors = checkerboard(fake, sched)
+        sched.sync_once()
+        assert frag_point(sched)["fragmentation_score"] >= 0.25
+        settle(sched, 10)
+        point = frag_point(sched)
+        assert point["fragmentation_score"] <= 0.15
+        assert point["largest_free_shape"] >= 8
+        assert ctrl.active_moves() == {}
+        assert ctrl.reservations() == {}
+        # Every surviving claim still allocated, exactly one device
+        # per claim, no duplicates (zero double-allocations) and no
+        # leftover placement hints.
+        seen = []
+        for name in survivors:
+            devs = alloc_devs(fake, name)
+            assert devs and len(devs) == 1
+            seen += devs
+            ann = claim_of(fake, name).get(
+                "metadata", {}).get("annotations") or {}
+            assert DEFRAG_TARGET_ANNOTATION not in ann
+        assert len(seen) == len(set(seen))
+
+    def test_moved_claims_land_on_planned_targets(self, cluster):
+        fake, sched, ctrl = cluster
+        checkerboard(fake, sched)
+        sched.sync_once()  # plan window
+        records = ctrl._checkpoint.get().claims
+        assert records
+        targets = {rec.name: (rec.devices[0].live or {}).get("target")
+                   for rec in records.values()}
+        settle(sched, 10)
+        for name, target in targets.items():
+            assert alloc_devs(fake, name) == sorted(target)
+
+    def test_quiet_pool_executes_zero_moves(self, cluster):
+        """The hysteresis proof: a compact pool below the trigger
+        never plans a window."""
+        fake, sched, ctrl = cluster
+        occupy(fake, sched, {f"c{i}": {"chips": [i]}
+                             for i in range(8)})
+        metrics = DefragMetrics()
+        ctrl.metrics = metrics
+        settle(sched, 6)
+        assert frag_point(sched)["fragmentation_score"] == 0.0
+        assert ctrl.active_moves() == {}
+        assert metrics.plans._value.get() == 0
+        assert metrics.moves._value.get() == 0
+
+    def test_sustain_defers_until_window_elapses(self, tmp_path):
+        fake = FakeKubeClient()
+        apply_class(fake)
+        add_node(fake, "node-a")
+        publish_resource_slices(fake, node_slices("node-a"))
+        sched = DraScheduler(fake, gates=FeatureGates.parse(
+            "TopologyAwarePlacement=false"))
+        ctrl = DefragController(
+            fake, str(tmp_path / "defrag"), trigger=0.25,
+            release=0.15, sustain_s=0.4, max_concurrent=4,
+            deadline_s=60.0, budget_pct=100.0, cooldown_s=0.0)
+        sched.attach_defrag(ctrl)
+        checkerboard(fake, sched)
+        sched.sync_once()
+        # Armed but not sustained: no window yet.
+        assert ctrl.active_moves() == {}
+        time.sleep(0.45)
+        sched.sync_once()
+        assert ctrl.active_moves() != {}
+
+    def test_pause_stops_new_windows(self, cluster, monkeypatch):
+        fake, sched, ctrl = cluster
+        checkerboard(fake, sched)
+        monkeypatch.setenv("TPU_DRA_DEFRAG_PAUSE", "1")
+        settle(sched, 4)
+        assert ctrl.active_moves() == {}
+        monkeypatch.delenv("TPU_DRA_DEFRAG_PAUSE")
+        settle(sched, 10)
+        assert frag_point(sched)["fragmentation_score"] <= 0.15
+
+    def test_budget_caps_moves_per_window(self, tmp_path):
+        fake = FakeKubeClient()
+        apply_class(fake)
+        add_node(fake, "node-a")
+        publish_resource_slices(fake, node_slices("node-a"))
+        sched = DraScheduler(fake, gates=FeatureGates.parse(
+            "TopologyAwarePlacement=false"))
+        # 8 live claims x 30% budget -> at most 2 moves per window.
+        ctrl = DefragController(
+            fake, str(tmp_path / "defrag"), trigger=0.25,
+            release=0.15, sustain_s=0.0, max_concurrent=8,
+            deadline_s=60.0, budget_pct=30.0, cooldown_s=0.0)
+        sched.attach_defrag(ctrl)
+        checkerboard(fake, sched)
+        sched.sync_once()
+        assert 0 < len(ctrl.active_moves()) <= 2
+        # Successive (cooldown-less) windows each respect the budget;
+        # the pool still converges, just in smaller bites.
+        for _ in range(20):
+            assert len(ctrl.active_moves()) <= 2
+            sched.sync_once()
+        assert ctrl.active_moves() == {}
+        assert frag_point(sched)["largest_free_shape"] >= 4
+
+
+# -- protection: opt-out + priority classes -----------------------------------
+
+
+def protected_rows_layout(extra_a=None, extra_b=None,
+                          created_a=None, created_b=None,
+                          gang_a=None):
+    """Rows 1 and 3 held by opt-out claims; row 0 blocked only by
+    ``vic-a`` (chip-0), row 2 only by ``vic-b`` (chip-8). The only
+    feasible 4x1 carves are rows 0 and 2, so the planner's choice
+    between the two victims is exactly the property under test."""
+    layout = {}
+    for y in (1, 3):
+        for x in range(4):
+            i = y * 4 + x
+            layout[f"p{i}"] = {
+                "chips": [i],
+                "annotations": {OPT_OUT_ANNOTATION: "true"}}
+    layout["vic-a"] = {"chips": [0],
+                       "annotations": dict(extra_a or {}),
+                       "created": created_a}
+    if gang_a:
+        layout["vic-a"]["gang"] = gang_a
+    layout["vic-b"] = {"chips": [8],
+                       "annotations": dict(extra_b or {}),
+                       "created": created_b}
+    return layout
+
+
+class TestProtectionAndPriority:
+    def _mk(self, tmp_path, fake):
+        sched = DraScheduler(fake, gates=FeatureGates.parse(
+            "TopologyAwarePlacement=false"))
+        ctrl = DefragController(
+            fake, str(tmp_path / "defrag"), trigger=0.2,
+            release=0.1, sustain_s=0.0, max_concurrent=4,
+            deadline_s=60.0, budget_pct=100.0, cooldown_s=0.0)
+        sched.attach_defrag(ctrl)
+        return sched, ctrl
+
+    def _cluster(self, tmp_path):
+        fake = FakeKubeClient()
+        apply_class(fake)
+        add_node(fake, "node-a")
+        publish_resource_slices(fake, node_slices("node-a"))
+        return fake
+
+    def test_opt_out_claims_are_never_moved(self, tmp_path):
+        fake = self._cluster(tmp_path)
+        sched, ctrl = self._mk(tmp_path, fake)
+        layout = protected_rows_layout(
+            extra_a={OPT_OUT_ANNOTATION: "true"},
+            extra_b={OPT_OUT_ANNOTATION: "true"})
+        occupy(fake, sched, layout)
+        settle(sched, 4)
+        # Every claim protected: frag stays, nothing moves.
+        assert ctrl.active_moves() == {}
+        for name in layout:
+            assert alloc_devs(fake, name) is not None
+
+    def test_infeasible_pool_cools_down_instead_of_resweeping(
+            self, tmp_path):
+        """A pool that fires but has NO feasible carve (everything
+        protected) enters cooldown: the expensive what-if sweep must
+        not re-run on every single pass."""
+        fake = self._cluster(tmp_path)
+        sched, ctrl = self._mk(tmp_path, fake)
+        ctrl.cooldown_s = 300.0
+        layout = protected_rows_layout(
+            extra_a={OPT_OUT_ANNOTATION: "true"},
+            extra_b={OPT_OUT_ANNOTATION: "true"})
+        occupy(fake, sched, layout)
+        sched.sync_once()
+        assert ctrl.active_moves() == {}
+        key = (DRIVER, "node-a")
+        assert ctrl._cooldown_until.get(key, 0) > time.time()
+        # While cooled down, further passes skip planning entirely.
+        calls = []
+        real = ctrl._plan_pool
+        ctrl._plan_pool = lambda *a, **kw: calls.append(1) or real(
+            *a, **kw)
+        settle(sched, 3)
+        assert calls == []
+
+    def test_young_singleton_moves_before_old_claim(self, tmp_path):
+        """The age-cost regression: when either victim frees the same
+        shape, the long-running claim survives and the young one
+        migrates."""
+        fake = self._cluster(tmp_path)
+        sched, ctrl = self._mk(tmp_path, fake)
+        layout = protected_rows_layout(created_a=OLD_TS,
+                                       created_b=None)
+        occupy(fake, sched, layout)
+        old_devs = alloc_devs(fake, "vic-a")
+        sched.sync_once()
+        assert set(ctrl.active_moves()) != set()
+        settle(sched, 8)
+        assert ctrl.active_moves() == {}
+        # The old claim never moved; the young one did.
+        assert alloc_devs(fake, "vic-a") == old_devs
+        assert alloc_devs(fake, "vic-b") != ["chip-8"]
+
+    def test_old_gang_survives_young_singleton(self, tmp_path):
+        """The ISSUE's regression verbatim: an old GANG member is
+        costlier still (age + disruption), so the young singleton
+        frees the shape."""
+        fake = self._cluster(tmp_path)
+        sched, ctrl = self._mk(tmp_path, fake)
+        layout = protected_rows_layout(created_a=OLD_TS,
+                                       gang_a="gang-1")
+        # A second gang member elsewhere makes vic-a's disruption > 0.
+        layout["vic-a2"] = {"chips": [2], "gang": "gang-1",
+                            "created": OLD_TS}
+        occupy(fake, sched, layout)
+        old_devs = alloc_devs(fake, "vic-a")
+        settle(sched, 8)
+        assert ctrl.active_moves() == {}
+        assert alloc_devs(fake, "vic-a") == old_devs
+        assert alloc_devs(fake, "vic-b") != ["chip-8"]
+
+    def test_priority_claims_immune_without_demand(self, tmp_path):
+        """Sustained-frag windows act for fleet health, on nobody's
+        behalf: priority-annotated claims never move."""
+        fake = self._cluster(tmp_path)
+        sched, ctrl = self._mk(tmp_path, fake)
+        layout = protected_rows_layout(
+            extra_a={PRIORITY_ANNOTATION: "5"},
+            extra_b={PRIORITY_ANNOTATION: "5"})
+        occupy(fake, sched, layout)
+        settle(sched, 4)
+        assert ctrl.active_moves() == {}
+        assert alloc_devs(fake, "vic-a") == ["chip-0"]
+        assert alloc_devs(fake, "vic-b") == ["chip-8"]
+
+    def test_higher_priority_demand_preempts_lower(self, tmp_path):
+        fake = self._cluster(tmp_path)
+        sched, ctrl = self._mk(tmp_path, fake)
+        layout = protected_rows_layout(
+            extra_a={PRIORITY_ANNOTATION: "5"},
+            extra_b={PRIORITY_ANNOTATION: "5"})
+        occupy(fake, sched, layout)
+        # A pending whole-row claim (4 chips, one ICI row) with
+        # priority 10: no free row exists, so it pends -- the demand
+        # signal that licenses preempting priority-5 victims.
+        make_claim(fake, "demand", count=4, same_row=True,
+                   annotations={PRIORITY_ANNOTATION: "10"})
+        sched.sync_once()
+        assert alloc_devs(fake, "demand") is None
+        settle(sched, 10)
+        assert ctrl.active_moves() == {}
+        # A victim moved, the row formed, the demand claim landed on
+        # one ICI row.
+        devs = alloc_devs(fake, "demand")
+        assert devs and len(devs) == 4
+        rows = {(int(d.split("-")[1]) // 4) for d in devs}
+        assert len(rows) == 1
+
+    def test_malformed_priority_fails_closed(self, tmp_path):
+        """A priority annotation that does not parse protects the
+        claim (the user clearly meant to shield it) instead of
+        silently demoting it to the movable tier."""
+        fake = self._cluster(tmp_path)
+        sched, ctrl = self._mk(tmp_path, fake)
+        layout = protected_rows_layout(
+            extra_a={PRIORITY_ANNOTATION: "high"},
+            extra_b={PRIORITY_ANNOTATION: "not-a-number"})
+        occupy(fake, sched, layout)
+        settle(sched, 4)
+        assert ctrl.active_moves() == {}
+        assert alloc_devs(fake, "vic-a") == ["chip-0"]
+        assert alloc_devs(fake, "vic-b") == ["chip-8"]
+
+    def test_malformed_demand_priority_has_no_preemption_power(
+            self, tmp_path):
+        """The demand-side twin: a typo'd priority annotation on a
+        PENDING claim must not grant it unbounded preemption power
+        over protected victims."""
+        fake = self._cluster(tmp_path)
+        sched, ctrl = self._mk(tmp_path, fake)
+        layout = protected_rows_layout(
+            extra_a={PRIORITY_ANNOTATION: "5"},
+            extra_b={PRIORITY_ANNOTATION: "5"})
+        occupy(fake, sched, layout)
+        make_claim(fake, "demand", count=4, same_row=True,
+                   annotations={PRIORITY_ANNOTATION: "very-high"})
+        settle(sched, 4)
+        assert ctrl.active_moves() == {}
+        assert alloc_devs(fake, "demand") is None
+        assert alloc_devs(fake, "vic-a") == ["chip-0"]
+        assert alloc_devs(fake, "vic-b") == ["chip-8"]
+
+    def test_equal_priority_demand_does_not_preempt(self, tmp_path):
+        fake = self._cluster(tmp_path)
+        sched, ctrl = self._mk(tmp_path, fake)
+        layout = protected_rows_layout(
+            extra_a={PRIORITY_ANNOTATION: "5"},
+            extra_b={PRIORITY_ANNOTATION: "5"})
+        occupy(fake, sched, layout)
+        make_claim(fake, "demand", count=4, same_row=True,
+                   annotations={PRIORITY_ANNOTATION: "5"})
+        settle(sched, 4)
+        assert ctrl.active_moves() == {}
+        assert alloc_devs(fake, "demand") is None
+        assert alloc_devs(fake, "vic-a") == ["chip-0"]
+        assert alloc_devs(fake, "vic-b") == ["chip-8"]
+
+
+# -- scheduler integration: reservations + hints ------------------------------
+
+
+class TestSchedulerIntegration:
+    def test_parse_target_hint(self):
+        assert parse_target_hint("n1|chip-1,chip-2") == \
+            ("n1", ["chip-1", "chip-2"])
+        assert parse_target_hint("") is None
+        assert parse_target_hint("n1|") is None
+        assert parse_target_hint("chip-1,chip-2") is None
+
+    def test_reserved_devices_vetoed_for_other_claims(self, cluster):
+        """While a window is in flight every free cell is either carve
+        or a move target: a NEW claim must pend rather than squat on
+        the forming shape, then allocate once the window closes."""
+        fake, sched, ctrl = cluster
+        checkerboard(fake, sched)
+        sched.sync_once()  # plan: reservations live
+        assert ctrl.reservations()
+        make_claim(fake, "intruder")
+        sched.sync_once()
+        assert alloc_devs(fake, "intruder") is None
+        settle(sched, 10)
+        assert ctrl.active_moves() == {}
+        assert alloc_devs(fake, "intruder") is not None
+
+    def test_abort_clears_hint_and_claim_reschedules(self, cluster):
+        """A move whose re-placement never lands aborts cleanly at the
+        deadline: record retired, hint cleared, claim schedulable."""
+        fake, sched, ctrl = cluster
+        checkerboard(fake, sched)
+        ctrl.deadline_s = 0.05
+        ctrl.cooldown_s = 30.0  # no instant re-plan after the aborts
+        # Drive the CONTROLLER only (no scheduler passes), so the
+        # deallocated claims cannot re-place before the deadline.
+        sched.sync_once()  # plan
+        ctrl.sync_once()   # drain
+        ctrl.sync_once()   # dealloc
+        moving = set(ctrl.active_moves())
+        assert moving
+        time.sleep(0.06)
+        ctrl.sync_once()   # deadline -> abort
+        assert ctrl.active_moves() == {}
+        assert ctrl.reservations() == {}
+        for claim in fake.list(*RES, "resourceclaims"):
+            ann = claim.get("metadata", {}).get("annotations") or {}
+            assert DEFRAG_TARGET_ANNOTATION not in ann
+        # The aborted claims are pending and schedulable: the next
+        # scheduler pass re-places them (anywhere).
+        settle(sched, 2)
+        for claim in fake.list(*RES, "resourceclaims"):
+            assert claim.get("status", {}).get("allocation")
+        # The aborted-window marker is cleaned up when the window's
+        # last record retires through the abort path too.
+        assert ctrl._aborted_windows == set()
+
+    def test_stuck_draining_move_aborts_at_deadline(self, cluster):
+        """The no-wedge guarantee: a record stuck mid-ladder (not just
+        Deallocated) still times out -- otherwise a perpetually
+        refused patch would pin the reservations and block every new
+        window forever."""
+        fake, sched, ctrl = cluster
+        checkerboard(fake, sched)
+        ctrl.cooldown_s = 30.0
+        sched.sync_once()  # plan
+        ctrl.sync_once()   # drain: records now Draining
+        moving = dict(ctrl.active_moves())
+        assert moving and set(moving.values()) == {"DefragDraining"}
+        # Backdate the admission clocks past the deadline.
+        ctrl.deadline_s = 5.0
+        for uid, rec in list(ctrl._checkpoint.get().claims.items()):
+            meta = dict(rec.devices[0].live or {})
+            meta["startedAt"] = time.time() - 60.0
+            ctrl._write_record(
+                {"metadata": {"uid": uid, "namespace": rec.namespace,
+                              "name": rec.name}},
+                rec.state, live=meta)
+        ctrl.sync_once()
+        assert ctrl.active_moves() == {}
+        assert ctrl.reservations() == {}
+        for claim in fake.list(*RES, "resourceclaims"):
+            ann = claim.get("metadata", {}).get("annotations") or {}
+            assert DEFRAG_TARGET_ANNOTATION not in ann
+
+    def test_deadline_runs_from_admission_not_plan_time(self, cluster):
+        """An ADMITTED move gets its full re-placement budget from the
+        moment it was drained: backdating the window's plan clock past
+        the deadline must not abort moves that were admitted late (a
+        slow window's tail would otherwise be disrupted only to abort
+        instantly)."""
+        fake, sched, ctrl = cluster
+        ctrl.deadline_s = 5.0
+        metrics = DefragMetrics()
+        ctrl.metrics = metrics
+        checkerboard(fake, sched)
+        sched.sync_once()  # plan
+        ctrl.sync_once()   # admit: all Draining, startedAt = now
+        records = ctrl._checkpoint.get().claims
+        assert len(records) == 4
+        for uid, rec in list(records.items()):
+            meta = dict(rec.devices[0].live or {})
+            assert meta["startedAt"] > 0
+            meta["plannedAt"] = meta["plannedAt"] - 60.0
+            ctrl._write_record(
+                {"metadata": {"uid": uid, "namespace": rec.namespace,
+                              "name": rec.name}},
+                rec.state, live=meta)
+        settle(sched, 10)
+        assert ctrl.active_moves() == {}
+        assert metrics.aborted._value.get() == 0
+        assert metrics.moves._value.get() == 4
+        assert frag_point(sched)["fragmentation_score"] <= 0.15
+
+    def test_event_driven_convergence(self, tmp_path):
+        """The production wiring: event-driven scheduler, defrag riding
+        dirty keys + the safety resync."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        add_node(fake, "node-a")
+        publish_resource_slices(fake, node_slices("node-a"))
+        sched = DraScheduler(fake, resync_period=0.1,
+                             gates=FeatureGates.parse(
+                                 "TopologyAwarePlacement=false"))
+        ctrl = DefragController(
+            fake, str(tmp_path / "defrag"), trigger=0.25,
+            release=0.15, sustain_s=0.0, max_concurrent=4,
+            deadline_s=60.0, budget_pct=100.0, cooldown_s=0.0)
+        sched.attach_defrag(ctrl)
+        sched.start_event_driven()
+        try:
+            sched.drain(10)
+            layout = {f"c{i}": {"chips": [i]} for i in range(16)}
+            for name in layout:
+                make_claim(fake, name)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if all(alloc_devs(fake, n) for n in layout):
+                    break
+                time.sleep(0.05)
+            # Shred: delete whichever claims hold the odd cells.
+            for name in list(layout):
+                devs = alloc_devs(fake, name)
+                assert devs
+                idx = int(devs[0].split("-")[1])
+                if (idx % 4 + idx // 4) % 2 == 1:
+                    fake.delete(*RES, "resourceclaims", name,
+                                namespace="default")
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                point = frag_point(sched)
+                if point.get("fragmentation_score") is not None and \
+                        point["fragmentation_score"] <= 0.15 and \
+                        not ctrl.active_moves():
+                    break
+                time.sleep(0.1)
+            point = frag_point(sched)
+            assert point["fragmentation_score"] <= 0.15
+            assert point["largest_free_shape"] >= 8
+            assert ctrl.active_moves() == {}
+        finally:
+            sched.stop()
+
+
+# -- durability: crash-at-every-fault-point + resume --------------------------
+
+
+class TestDefragDurability:
+    @pytest.fixture()
+    def shredded(self, tmp_path):
+        fake = FakeKubeClient()
+        apply_class(fake)
+        add_node(fake, "node-a")
+        publish_resource_slices(fake, node_slices("node-a"))
+        sched = DraScheduler(fake, gates=FeatureGates.parse(
+            "TopologyAwarePlacement=false"))
+        root = str(tmp_path / "defrag")
+        ctrl = DefragController(
+            fake, root, trigger=0.25, release=0.15, sustain_s=0.0,
+            max_concurrent=4, deadline_s=60.0, budget_pct=100.0,
+            cooldown_s=0.0)
+        sched.attach_defrag(ctrl)
+        survivors = checkerboard(fake, sched)
+        return fake, sched, ctrl, root, survivors
+
+    @pytest.mark.parametrize("point", [
+        "defrag.sync", "defrag.plan", "defrag.drain",
+        "defrag.dealloc",
+    ])
+    def test_controller_crash_resumes_idempotently(
+            self, shredded, point, tmp_path):
+        """InjectedCrash at every controller fault point, then a FRESH
+        controller on the same state root: the window resumes from the
+        durable records and converges -- reservations and hints
+        re-derived, no stuck claims, no double allocations."""
+        fake, sched, ctrl, root, survivors = shredded
+        with faults.inject(point, mode="crash", count=1):
+            crashed = False
+            for _ in range(6):
+                try:
+                    sched.sync_once()
+                except InjectedCrash:
+                    crashed = True
+                    break
+            assert crashed, f"{point} never fired"
+        resumed = DefragController(
+            fake, root, trigger=0.25, release=0.15, sustain_s=0.0,
+            max_concurrent=4, deadline_s=60.0, budget_pct=100.0,
+            cooldown_s=0.0)
+        # The replacement re-derives its veto set from the durable
+        # records before its first sync.
+        if resumed.active_moves():
+            assert resumed.reservations()
+        sched.attach_defrag(resumed)
+        settle(sched, 12)
+        point_now = frag_point(sched)
+        assert point_now["fragmentation_score"] <= 0.15
+        assert resumed.active_moves() == {}
+        seen = []
+        for name in survivors:
+            devs = alloc_devs(fake, name)
+            assert devs and len(devs) == 1
+            seen += devs
+        assert len(seen) == len(set(seen))
+
+    def test_claim_deleted_mid_move_cancels(self, shredded):
+        fake, sched, ctrl, root, survivors = shredded
+        sched.sync_once()  # plan
+        moving = sorted(ctrl.active_moves())
+        assert moving
+        rec = ctrl._checkpoint.get().claims[moving[0]]
+        fake.delete(*RES, "resourceclaims", rec.name,
+                    namespace="default")
+        settle(sched, 8)
+        assert ctrl.active_moves() == {}
+
+    def test_illegal_stage_skip_fails_the_commit(self, tmp_path):
+        """absent -> Draining (a drain without its durable plan) is
+        exactly what the defrag TransitionPolicy must refuse."""
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.checkpoint import (
+            CheckpointedClaim,
+            CheckpointedDevice,
+        )
+
+        fake = FakeKubeClient()
+        ctrl = DefragController(fake, str(tmp_path / "d"))
+        rec = CheckpointedClaim(
+            uid="u1", namespace="default", name="c",
+            state=DEFRAG_DRAINING,
+            devices=[CheckpointedDevice(canonical_name="defrag",
+                                        kind="defrag", live={})])
+        with pytest.raises(RuntimeError) as err:
+            ctrl._checkpoint.update_claim("u1", rec)
+        assert isinstance(err.value.__cause__,
+                          CheckpointTransitionError)
+        for state in (DEFRAG_PLANNED, DEFRAG_DRAINING,
+                      DEFRAG_DEALLOCATED):
+            rec = CheckpointedClaim(
+                uid="u1", namespace="default", name="c", state=state,
+                devices=rec.devices)
+            ctrl._checkpoint.update_claim("u1", rec)
+        ctrl._checkpoint.update_claim("u1", None)
+
+
+# -- interleaving coverage: a move racing a user claim delete -----------------
+
+
+class _YieldingKube:
+    """Kube wrapper turning every API verb into an explorer choice
+    point (no-op passthrough from uninstrumented threads)."""
+
+    def __init__(self, sched, inner):
+        self._sched = sched
+        self._inner = inner
+
+    def _verb(self, name):
+        inner = getattr(self._inner, name)
+
+        def call(*a, **kw):
+            self._sched.yield_point(f"kube.{name}")
+            return inner(*a, **kw)
+        return call
+
+    def __getattr__(self, item):
+        if item in ("get", "list", "create", "update", "patch",
+                    "delete"):
+            return self._verb(item)
+        return getattr(self._inner, item)
+
+
+class TestDefragInterleaveDFS:
+    def test_claim_delete_races_every_move_stage(
+            self, tmp_path, monkeypatch):
+        """DFS coverage of the move state machine: a user deleting the
+        moving claim is interleaved at EVERY kube-verb boundary of the
+        plan -> drain -> deallocate -> retire ladder. All schedules
+        must end converged: no stuck record, no illegal transition,
+        and never a device held by two claims."""
+        from k8s_dra_driver_gpu_tpu.pkg.analysis import interleave
+
+        monkeypatch.setattr(os, "fsync", lambda fd: None)
+        monkeypatch.setattr(os, "fdatasync", lambda fd: None)
+        runs = [0]
+
+        def build(sched):
+            runs[0] += 1
+            fake = FakeKubeClient()
+            apply_class(fake)
+            add_node(fake, "node-a")
+            publish_resource_slices(fake, node_slices("node-a",
+                                                      dims=(2, 2)))
+            setup = DraScheduler(fake, gates=FeatureGates.parse(
+                "TopologyAwarePlacement=false"))
+            # 2x2 pool, diagonal occupancy: frag 0.5, one move fixes.
+            occupy(fake, setup, {"c0": {"chips": [0]},
+                                 "c1": {"chips": [1]},
+                                 "c2": {"chips": [2]},
+                                 "c3": {"chips": [3]}})
+            for name in ("c1", "c2"):
+                fake.delete(*RES, "resourceclaims", name,
+                            namespace="default")
+            ctrl = DefragController(
+                _YieldingKube(sched, fake),
+                str(tmp_path / f"dfs-{runs[0]}"),
+                trigger=0.25, release=0.15, sustain_s=0.0,
+                max_concurrent=2, deadline_s=60.0, budget_pct=100.0,
+                cooldown_s=0.0)
+            driver = DraScheduler(fake, gates=FeatureGates.parse(
+                "TopologyAwarePlacement=false"))
+            driver.attach_defrag(ctrl)
+            sched.ctrl = ctrl
+            sched.fake = fake
+            sched.driver = driver
+
+            def controller():
+                for _ in range(4):
+                    driver.sync_once()
+
+            def user():
+                sched.yield_point("user.delete")
+                moving = sorted(ctrl.active_moves())
+                victim = None
+                if moving:
+                    rec = ctrl._checkpoint.get().claims.get(moving[0])
+                    victim = rec.name if rec else None
+                try:
+                    fake.delete(*RES, "resourceclaims",
+                                victim or "c0", namespace="default")
+                except Exception:  # noqa: BLE001 - already gone
+                    pass
+
+            sched.spawn(controller, "ctrl")
+            sched.spawn(user, "user")
+
+        def invariant(sched):
+            # Quiesce from the (uninstrumented) main thread.
+            for _ in range(3):
+                sched.driver.sync_once()
+            leftover = sched.ctrl.active_moves()
+            assert leftover == {}, f"stuck move records: {leftover}"
+            held: dict[str, str] = {}
+            for claim in sched.fake.list(*RES, "resourceclaims"):
+                alloc = claim.get("status", {}).get("allocation")
+                name = claim["metadata"]["name"]
+                if not alloc:
+                    continue
+                for r in alloc["devices"]["results"]:
+                    dev = r["device"]
+                    assert dev not in held, (
+                        f"device {dev} double-allocated to "
+                        f"{held[dev]} and {name}")
+                    held[dev] = name
+
+        result = interleave.explore(build, invariant,
+                                    max_schedules=120)
+        assert result.schedules_run >= 10
+        assert result.ok, f"{len(result.failures)} failing schedule(s);"\
+            f" first: {result.failures[0] if result.failures else None}"
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+class TestDefragMetrics:
+    def test_exposition(self, tmp_path):
+        from prometheus_client import generate_latest
+
+        fake = FakeKubeClient()
+        apply_class(fake)
+        add_node(fake, "node-a")
+        publish_resource_slices(fake, node_slices("node-a"))
+        sched = DraScheduler(fake, gates=FeatureGates.parse(
+            "TopologyAwarePlacement=false"))
+        metrics = DefragMetrics()
+        ctrl = DefragController(
+            fake, str(tmp_path / "defrag"), metrics=metrics,
+            trigger=0.25, release=0.15, sustain_s=0.0,
+            max_concurrent=4, deadline_s=60.0, budget_pct=100.0,
+            cooldown_s=0.0)
+        sched.attach_defrag(ctrl)
+        checkerboard(fake, sched)
+        settle(sched, 10)
+        text = generate_latest(metrics.registry).decode()
+        assert "tpu_dra_defrag_plans_total 1.0" in text
+        assert "tpu_dra_defrag_moves_total 4.0" in text
+        assert "tpu_dra_defrag_frag_recovered_chips_total 7.0" in text
+        assert "tpu_dra_defrag_aborted_total 0.0" in text
+        assert "tpu_dra_defrag_active_moves 0.0" in text
+        assert "tpu_dra_defrag_move_seconds_count 4.0" in text

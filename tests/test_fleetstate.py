@@ -161,6 +161,110 @@ class TestFleetAggregator:
         doc = json.loads(body)
         assert doc["pools"] == {} and doc["passes_total"] == 0
 
+    def test_fold_seconds_histogram_observed(self):
+        from prometheus_client import generate_latest
+
+        metrics = FleetMetrics()
+        snap = InventorySnapshot([make_slice()])
+        fleet = fleetstate.FleetAggregator(metrics=metrics)
+        fleet.observe_pass(snap, AllocationState(snap), 0)
+        text = generate_latest(metrics.registry).decode()
+        assert "tpu_dra_fleet_fold_seconds_count 1.0" in text
+
+
+class TestFragSignal:
+    """The defrag trigger signal (pkg/defrag rides this): arm at the
+    trigger, fire on demand or sustain, hysteresis band, release."""
+
+    KEY = ("tpu.dra.dev", "n0")
+
+    def _fleet(self, allocated):
+        """2x2 pool with ``allocated`` chips taken. Diagonal
+        {chip-0, chip-3} -> frag 0.5; {chip-0} -> 0.333 (largest 2 of
+        3 free); [] -> 0.0."""
+        snap = InventorySnapshot([make_slice()])
+        alloc = AllocationState(snap)
+        alloc.rebuild([allocated_claim(f"u{i}", [c])
+                       for i, c in enumerate(allocated)])
+        fleet = fleetstate.FleetAggregator()
+        fleet.observe_pass(snap, alloc, 0)
+        return fleet, snap
+
+    def test_arms_then_fires_after_sustain(self):
+        fleet, _ = self._fleet(["chip-0", "chip-3"])
+        sig = fleet.frag_signal(0.4, 0.1, sustain_s=60.0, now=1000.0)
+        assert self.KEY in sig
+        assert sig[self.KEY]["fragmentation_score"] == 0.5
+        assert not sig[self.KEY]["fire"]  # armed, not sustained yet
+        sig = fleet.frag_signal(0.4, 0.1, sustain_s=60.0, now=1059.0)
+        assert not sig[self.KEY]["fire"]
+        sig = fleet.frag_signal(0.4, 0.1, sustain_s=60.0, now=1061.0)
+        assert sig[self.KEY]["fire"]
+        assert sig[self.KEY]["armed_since"] == 1000.0
+
+    def test_demand_fires_immediately(self):
+        fleet, _ = self._fleet(["chip-0", "chip-3"])
+        sig = fleet.frag_signal(0.4, 0.1, sustain_s=3600.0,
+                                demand={self.KEY}, now=1000.0)
+        assert sig[self.KEY]["fire"]
+
+    def test_below_trigger_never_arms(self):
+        fleet, _ = self._fleet([])
+        assert fleet.frag_signal(0.4, 0.1, sustain_s=0.0,
+                                 now=1000.0) == {}
+
+    def test_hysteresis_band_keeps_armed_release_disarms(self):
+        snap = InventorySnapshot([make_slice()])
+        fleet = fleetstate.FleetAggregator()
+        diag = AllocationState(snap)
+        diag.rebuild([allocated_claim("u1", ["chip-0"]),
+                      allocated_claim("u2", ["chip-3"])])
+        fleet.observe_pass(snap, diag, 0)  # frag 0.5: arms
+        fleet.frag_signal(0.4, 0.1, sustain_s=0.0, now=1000.0)
+        # Frag falls into the band (0.333: under the 0.4 trigger,
+        # above the 0.1 release): still armed, still firing.
+        one = AllocationState(snap)
+        one.rebuild([allocated_claim("u1", ["chip-0"])])
+        fleet.observe_pass(snap, one, 0)
+        sig = fleet.frag_signal(0.4, 0.1, sustain_s=0.0, now=1001.0)
+        assert sig[self.KEY]["fire"]
+        assert sig[self.KEY]["armed_since"] == 1000.0
+        # Fully healed (frag 0.0 <= release): disarmed...
+        fleet.observe_pass(snap, AllocationState(snap), 0)
+        assert fleet.frag_signal(0.4, 0.1, sustain_s=0.0,
+                                 now=1002.0) == {}
+        # ...and the band alone can never RE-arm it.
+        fleet.observe_pass(snap, one, 0)
+        assert fleet.frag_signal(0.4, 0.1, sustain_s=0.0,
+                                 now=1003.0) == {}
+
+    def test_vanished_pool_neither_fires_nor_holds_its_arm_clock(self):
+        """A pool that leaves the inventory keeps its ring history
+        (/debug/fleet) but must stop firing the controller, and its
+        armed clock must not survive to skip the sustain window when
+        the pool returns."""
+        snap = InventorySnapshot([make_slice()])
+        fleet = fleetstate.FleetAggregator()
+        diag = AllocationState(snap)
+        diag.rebuild([allocated_claim("u1", ["chip-0"]),
+                      allocated_claim("u2", ["chip-3"])])
+        fleet.observe_pass(snap, diag, 0)  # frag 0.5: arms
+        assert fleet.frag_signal(0.4, 0.1, sustain_s=60.0,
+                                 now=1000.0)
+        # The pool's node dies: empty snapshot, ring history kept.
+        empty = InventorySnapshot([])
+        fleet.observe_pass(empty, AllocationState(empty), 0)
+        assert "tpu.dra.dev/n0" in fleet.snapshot()["pools"]
+        assert fleet.frag_signal(0.4, 0.1, sustain_s=60.0,
+                                 now=2000.0) == {}
+        # The pool returns, still fragmented: it must re-arm FRESH
+        # (armed_since = now, not the stale pre-death clock) so the
+        # sustain window is actually observed again.
+        fleet.observe_pass(snap, diag, 0)
+        sig = fleet.frag_signal(0.4, 0.1, sustain_s=60.0, now=3000.0)
+        assert sig[self.KEY]["armed_since"] == 3000.0
+        assert not sig[self.KEY]["fire"]
+
 
 class TestSchedulerWiring:
     def test_full_pass_folds_fleet_state(self):

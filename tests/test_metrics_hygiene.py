@@ -16,6 +16,7 @@ from prometheus_client.parser import text_string_to_metric_families
 from k8s_dra_driver_gpu_tpu.pkg.metrics import (
     ClaimSLOMetrics,
     ComputeDomainMetrics,
+    DefragMetrics,
     DRARequestMetrics,
     FleetMetrics,
     PartitionMetrics,
@@ -49,7 +50,7 @@ COMPOSITIONS = {
     "kubelet-plugin": (DRARequestMetrics, ResilienceMetrics,
                        RecoveryMetrics, PartitionMetrics),
     "scheduler": (PlacementMetrics, SchedulerMetrics, FleetMetrics,
-                  ResilienceMetrics, RecoveryMetrics),
+                  ResilienceMetrics, RecoveryMetrics, DefragMetrics),
     "cd-plugin": (DRARequestMetrics, ResilienceMetrics,
                   RecoveryMetrics),
     "cd-controller": (ComputeDomainMetrics, ResilienceMetrics),
@@ -198,6 +199,8 @@ PRODUCERS = {
     "snapshot_build": r"\.snapshot_build\.observe\(",
     "snapshot_delta": r"\.snapshot_delta\.labels\(",
     "relist_backoff": r"\.relist_backoff\.labels\(",
+    "fold_seconds": r"fold_hist\.observe\(",
+    "move_seconds": r"\.move_seconds\.observe\(",
 }
 
 

@@ -405,6 +405,51 @@ class TestEvictionPlanning:
             assert alloc_node(fake, name) == "node-a"
         assert ctrl.active_evictions() == {}
 
+    def test_young_claim_admitted_before_old_gang(self, tmp_path):
+        """The age-cost satellite: under the concurrency cap the
+        planner admits the YOUNG singleton's migration first --
+        moving a long-running claim throws away hours of work, so
+        uptime now weighs into the 2502.01909 score alongside device
+        count and gang disruption."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        add_node(fake, "node-b")
+        publish_resource_slices(fake, node_slices("node-b"))
+        sched = DraScheduler(fake)
+        ctrl = EvictionController(fake, str(tmp_path / "r"),
+                                  notready_grace_s=0.0,
+                                  max_concurrent=1, deadline_s=60.0)
+        sched.attach_recovery(ctrl)
+        # An OLD claim (years of uptime) and a YOUNG one (no
+        # creationTimestamp = brand new), both landing on node-b.
+        for name, created in (("old", "2020-01-01T00:00:00Z"),
+                              ("young", None)):
+            meta = {"name": name, "namespace": "default"}
+            if created:
+                meta["creationTimestamp"] = created
+            fake.create(*RES, "resourceclaims", {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim", "metadata": meta,
+                "spec": {"devices": {"requests": [{
+                    "name": "tpu",
+                    "exactly": {"deviceClassName": DRIVER}}]}},
+            }, namespace="default")
+        settle(sched, 2)
+        assert alloc_node(fake, "old") == "node-b"
+        assert alloc_node(fake, "young") == "node-b"
+        add_node(fake, "node-a")
+        publish_resource_slices(fake, node_slices("node-a"))
+        set_ready(fake, "node-b", False)
+        sched.sync_once()
+        young_uid = fake.get(*RES, "resourceclaims", "young",
+                             namespace="default")["metadata"]["uid"]
+        # The cap admits exactly ONE eviction: the young claim's.
+        assert list(ctrl.active_evictions()) == [young_uid]
+        settle(sched, passes=14)
+        assert ctrl.active_evictions() == {}
+        for name in ("old", "young"):
+            assert alloc_node(fake, name) == "node-a"
+
 
 # -- durability: crash-at-every-fault-point + resume --------------------------
 

@@ -228,6 +228,50 @@ class TestScorer:
         assert largest_free_shape(g, diag)[1] == 1
         assert fragmentation_score(g, diag) == pytest.approx(0.75)
 
+    def test_largest_free_shape_memoized(self, monkeypatch):
+        """The (grid signature, free set) memo: the second identical
+        query must not re-enumerate shapes -- the FleetAggregator fold
+        and the defrag what-if loop both lean on this."""
+        from k8s_dra_driver_gpu_tpu.pkg.topology import score
+
+        score.clear_shape_memo()
+        g = grid_4x4()
+        free = {(i, i, 0) for i in range(4)}
+        cold = largest_free_shape(g, free)
+        calls = []
+        real = score.enumerate_shapes
+        monkeypatch.setattr(
+            score, "enumerate_shapes",
+            lambda *a, **kw: calls.append(1) or real(*a, **kw))
+        assert largest_free_shape(g, free) == cold
+        assert calls == [], "memo miss on an identical query"
+        # An EQUIVALENT grid built separately shares the row (the
+        # signature is geometry, not object identity)...
+        g2 = grid_4x4()
+        assert largest_free_shape(g2, free) == cold
+        assert calls == []
+        # ...and a different free set is a genuine miss.
+        largest_free_shape(g, set(g.coords.values()))
+        assert calls
+        score.clear_shape_memo()
+
+    def test_memo_never_changes_results(self):
+        """Property check: memoized answers byte-match a cold sweep
+        across a seeded set of free subsets."""
+        from k8s_dra_driver_gpu_tpu.pkg.topology import score
+
+        g = grid_4x4()
+        cells = sorted(g.coords.values())
+        rng = random.Random(20260804)
+        subsets = [set(rng.sample(cells, rng.randint(0, len(cells))))
+                   for _ in range(12)]
+        score.clear_shape_memo()
+        cold = [largest_free_shape(g, s) for s in subsets]
+        warm = [largest_free_shape(g, s) for s in subsets]
+        assert warm == cold
+        score.clear_shape_memo()
+        assert [largest_free_shape(g, s) for s in subsets] == cold
+
 
 class TestHostRanking:
     def test_best_window_of_consecutive_workers_first(self):
