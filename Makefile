@@ -5,7 +5,7 @@ PYTHON ?= python
 .PHONY: all native test test-fast bench bench-smoke \
 	bench-placement-smoke bench-chaos-smoke bench-sched-smoke \
 	bench-sched-scale bench-recovery-smoke bench-defrag-smoke \
-	bench-serving-smoke \
+	bench-serving-smoke bench-autoscale-smoke \
 	bench-trace-smoke bench-telemetry-smoke validate-dashboard \
 	lint lint-analysis clean stamp-version
 
@@ -121,6 +121,25 @@ bench-serving-smoke:
 	BENCH_SERVING_BURST=24 BENCH_SERVING_ROUNDS=3 \
 	BENCH_SERVING_OUT=$(or $(BENCH_SERVING_OUT),/tmp/BENCH_serving_smoke.json) \
 	$(PYTHON) bench.py --serving
+
+# Serving-autoscaler smoke: a shrunk `--autoscale` run (3 nodes, 8
+# base tenants, 10x diurnal burst -> decay -> burst) with the full
+# gate set enforced deterministically: every phase's achieved
+# tenants/chip within 15% of the trace-aware offline ORACLE plan,
+# ZERO counter over-commit recomputed from final allocations, zero
+# pending tenants at every phase end, converged steady-state
+# controller+node passes = ZERO kube writes, carve-out create p99
+# bounded by the 1s envelope on a REAL DeviceState, and a controller
+# crash at EVERY fault point (autoscale.sync/plan/apply/confirm)
+# resuming to the reference plan. Mirrored as a non-slow test in
+# tests/test_bench_autoscale_smoke.py; the full-scale trajectory file
+# is BENCH_autoscale.json (plain `bench.py --autoscale`: 6 nodes, 16
+# base tenants).
+bench-autoscale-smoke:
+	BENCH_AUTOSCALE_NODES=3 BENCH_AUTOSCALE_TENANTS=8 \
+	BENCH_AUTOSCALE_ROUNDS=2 \
+	BENCH_AUTOSCALE_OUT=$(or $(BENCH_AUTOSCALE_OUT),/tmp/BENCH_autoscale_smoke.json) \
+	$(PYTHON) bench.py --autoscale
 
 # Scheduler-churn smoke: a shrunk `--sched-churn` trace (8 nodes x 24
 # claims of paired pod+claim churn + unchanged health republishes)

@@ -3294,6 +3294,441 @@ def bench_serving() -> dict:
     }
 
 
+def bench_autoscale() -> dict:
+    """Serving-autoscaler mode (`bench.py --autoscale`): a diurnal
+    demand trace (burst 10x -> decay -> burst) against the
+    demand-driven PartitionSet controller (pkg/autoscale) riding the
+    real scheduler, with emulated node agents converging published
+    partition devices onto every controller re-plan.
+
+    Pipeline (the pkg/autoscale stack end to end):
+
+    1. **Burst**: 10x the base tenant population arrives as annotated
+       claims (tenant-profile + declared demand); the controller
+       ingests the demand, sizes the smallest satisfying profile
+       (MISO), rolls the PartitionSet CRD, the node agents republish
+       partition devices, and the scheduler packs the tenants.
+    2. **Decay**: the burst retires; the survivors' working sets grow.
+       The sliding demand window (TPU_DRA_PROFILE_WINDOW_S) ages the
+       burst out and the controller re-plans DOWN (fewer, larger
+       slots) -- profile names are shape-versioned so the swap is
+       live-tenant safe.
+    3. **Burst again**: the morning rush returns; the controller
+       re-plans back UP.
+
+    Each phase's achieved tenants/chip is compared against the ORACLE
+    (trace-aware offline) plan: the best slot count knowing the
+    phase's true demand, packed perfectly. Gates (`make
+    bench-autoscale-smoke` / tier-1 mirror): tracked ratio >=
+    BENCH_AUTOSCALE_MIN_TRACKED (0.85 = within 15% of oracle) in
+    EVERY phase, ZERO counter over-commit recomputed from the final
+    allocations, zero pending tenants at every phase end, converged
+    steady-state passes = ZERO kube writes (controller AND node
+    agents), carve-out create p99 <= BENCH_AUTOSCALE_MAX_CREATE_P99_MS
+    (1000 -- the existing 1 s envelope) on a REAL DeviceState, and a
+    controller crash at EVERY fault point resuming to the reference
+    plan. Emits BENCH_autoscale.json (BENCH_AUTOSCALE_OUT).
+
+    Knobs: BENCH_AUTOSCALE_NODES (6), BENCH_AUTOSCALE_TENANTS (16 --
+    the decayed base; the burst is 10x), BENCH_AUTOSCALE_SEED,
+    BENCH_AUTOSCALE_ROUNDS (3, node-proof prepare rounds),
+    BENCH_AUTOSCALE_WINDOW_S (1.0, the demand window)."""
+    from k8s_dra_driver_gpu_tpu.kubeletplugin import DRIVER_NAME
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.claim import (
+        DeviceResult,
+        OpaqueConfig,
+        ResourceClaim,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+        Config,
+        DeviceState,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.deviceinfo import (
+        AllocatableDevice,
+        ChipInfo,
+        DeviceKind,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.partitions import (
+        consumed_counters,
+        shared_counter_sets,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg import faults
+    from k8s_dra_driver_gpu_tpu.pkg.autoscale import (
+        AutoscaleController,
+        crd as crdmod,
+        fingerprint,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.autoscale.planner import (
+        TENANT_DEMAND_HBM_ANNOTATION,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.cel import Quantity
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.partition import (
+        TENANT_PROFILE_ANNOTATION,
+        TenantProfileStore,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.partition.engine import (
+        partition_devices,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+    from k8s_dra_driver_gpu_tpu.pkg.sliceutil import (
+        publish_resource_slices,
+    )
+    from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+        EnumerateOptions,
+        PyTpuLib,
+    )
+
+    nodes_n = _env_int("BENCH_AUTOSCALE_NODES", 6)
+    base_n = max(2, _env_int("BENCH_AUTOSCALE_TENANTS", 16))
+    rounds = max(1, _env_int("BENCH_AUTOSCALE_ROUNDS", 3))
+    seed = _env_int("BENCH_AUTOSCALE_SEED", 20260804)
+    window_s = _env_float("BENCH_AUTOSCALE_WINDOW_S", 1.0)
+    rng = random.Random(seed)
+    RES = ("resource.k8s.io", "v1")
+    CRD = ("resource.tpu.dra", "v1beta1", "partitionsets")
+    GIB = 1 << 30
+    topology = "v5e-4"
+
+    lib = PyTpuLib()
+    opts = EnumerateOptions(mock_topology=topology)
+    host = lib.enumerate(opts)
+    tpu_profiles = lib.subslice_profiles(opts)
+    chip_hbm = host.hbm_bytes_per_chip
+    chips_per_node = len(host.chips)
+    total_chips = nodes_n * chips_per_node
+    slot_counts = (1, 2, 4, 8)
+
+    # The diurnal trace: (phase, tenant count, per-tenant demand fn).
+    burst_n = base_n * 10
+    small = lambda: int((1.2 + rng.random() * 0.6) * GIB)  # noqa: E731
+    large = lambda: int((5.5 + rng.random() * 0.5) * GIB)  # noqa: E731
+    phases = [("burst1", burst_n, small), ("decay", base_n, large),
+              ("burst2", burst_n, small)]
+
+    def oracle_plan(count: int, demand_bytes: int) -> dict:
+        """Trace-aware offline plan: the largest slot count whose
+        per-tenant budget covers the TRUE phase demand, packed
+        perfectly across the fleet."""
+        best = max((s for s in slot_counts
+                    if chip_hbm // s >= demand_bytes), default=1)
+        capacity = best * total_chips
+        return {"slots": best,
+                "tenants_per_chip": min(count, capacity) / total_chips}
+
+    def node_slices(i: int, pset) -> list:
+        node = f"node-{i}"
+        devs = []
+        for chip in host.chips:
+            dev = AllocatableDevice(
+                kind=DeviceKind.CHIP, chip=ChipInfo(chip=chip,
+                                                    host=host))
+            entry = dev.to_dra_device()
+            entry["consumesCounters"] = consumed_counters(dev, host)
+            devs.append(entry)
+        if pset is not None:
+            for dev in partition_devices(host, tpu_profiles,
+                                         pset).values():
+                entry = dev.to_dra_device()
+                entry["consumesCounters"] = consumed_counters(dev, host)
+                devs.append(entry)
+        return [{
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": f"{node}-{DRIVER_NAME}"},
+            "spec": {
+                "driver": DRIVER_NAME, "nodeName": node,
+                "pool": {"name": node, "generation": 1,
+                         "resourceSliceCount": 1},
+                "sharedCounters": shared_counter_sets(host),
+                "devices": devs,
+            },
+        }]
+
+    fake = FakeKubeClient()
+    alloc_times: dict = {}
+    counted = _CountingKube(fake, alloc_times)
+    fake.create(*RES, "deviceclasses", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": "tpu-serving-tenant"},
+        "spec": {"selectors": [{"cel": {"expression":
+            f'device.driver == "{DRIVER_NAME}" && '
+            f'device.attributes["{DRIVER_NAME}"].partition'}}]},
+    })
+    for i in range(nodes_n):
+        publish_resource_slices(fake, node_slices(i, None))
+
+    as_root = tempfile.mkdtemp(prefix="bench-autoscale-")
+    store = TenantProfileStore(defaults={}, window_s=window_s)
+    ctrl = AutoscaleController(counted, as_root, store=store,
+                               sustain_s=0.0, cooldown_s=0.0,
+                               slot_counts=slot_counts)
+    sched = DraScheduler(counted, workers=1)
+    sched.attach_autoscaler(ctrl)
+
+    def node_republish() -> int:
+        """The emulated node agents: converge every node's published
+        devices onto the winning CRD (the PartitionSetWatcher
+        selection rule) through the content-hash diff; returns kube
+        writes spent."""
+        outcome, payload, _obj = crdmod.select_for_pool(
+            fake.list(*CRD), "node-0")
+        pset = payload[0] if outcome == "ok" else None
+        writes = 0
+        for i in range(nodes_n):
+            stats = publish_resource_slices(
+                counted, node_slices(i, pset), diff=True)
+            writes += stats["writes"]
+        return writes
+
+    def converge(max_rounds: int = 12) -> None:
+        for _ in range(max_rounds):
+            sched.sync_once()
+            node_republish()
+            sched.sync_once()
+            claims = fake.list(*RES, "resourceclaims")
+            pending = [c for c in claims
+                       if not c.get("status", {}).get("allocation")]
+            if not pending and not ctrl.busy():
+                return
+
+    def audit_overcommit() -> int:
+        """Recompute every pool's counter consumption from the FINAL
+        allocations; any counter above its shared capacity is an
+        over-commit."""
+        slices = fake.list(*RES, "resourceslices")
+        capacity: dict[tuple, int] = {}
+        consumes_of: dict[tuple, list] = {}
+        for s in slices:
+            spec = s["spec"]
+            pool = spec["pool"]["name"]
+            for cs in spec.get("sharedCounters") or []:
+                for cname, val in (cs.get("counters") or {}).items():
+                    capacity[(pool, cs["name"], cname)] = \
+                        Quantity.parse(val["value"]).milli
+            for dev in spec.get("devices", []):
+                consumes_of[(pool, dev["name"])] = \
+                    dev.get("consumesCounters") or []
+        used: dict[tuple, int] = {}
+        for c in fake.list(*RES, "resourceclaims"):
+            alloc = c.get("status", {}).get("allocation")
+            if not alloc:
+                continue
+            for r in alloc["devices"]["results"]:
+                for block in consumes_of.get(
+                        (r["pool"], r["device"]), []):
+                    for cname, val in (block.get("counters")
+                                       or {}).items():
+                        key = (r["pool"], block.get("counterSet", ""),
+                               cname)
+                        used[key] = used.get(key, 0) + Quantity.parse(
+                            val["value"]).milli
+        return sum(1 for key, milli in used.items()
+                   if milli > capacity.get(key, 0))
+
+    trajectory = []
+    extras: dict = {
+        "autoscale_nodes": nodes_n,
+        "autoscale_total_chips": total_chips,
+        "autoscale_base_tenants": base_n,
+        "autoscale_burst_tenants": burst_n,
+        "autoscale_window_s": window_s,
+    }
+    tracked_min = None
+    overcommit_total = 0
+    steady_writes_total = 0
+    live: dict[str, int] = {}  # claim name -> demand
+
+    for phase, count, demand_fn in phases:
+        # Window roll-over: the previous phase's samples age out so
+        # the percentiles reflect THIS phase's demand (the diurnal
+        # point of the sliding window).
+        time.sleep(window_s + 0.2)
+        demand = demand_fn()
+        # Retire everything, then admit this phase's population (a
+        # serving fleet redeploys between day/night shapes).
+        for name in list(live):
+            fake.delete(*RES, "resourceclaims", name,
+                        namespace="default")
+            del live[name]
+        for k in range(count):
+            name = f"{phase}-t{k}"
+            fake.create(*RES, "resourceclaims", {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceClaim",
+                "metadata": {"name": name, "namespace": "default",
+                             "annotations": {
+                                 TENANT_PROFILE_ANNOTATION: "web",
+                                 TENANT_DEMAND_HBM_ANNOTATION:
+                                     str(demand),
+                             }},
+                "spec": {"devices": {"requests": [{
+                    "name": "tenant",
+                    "exactly": {
+                        "deviceClassName": "tpu-serving-tenant"},
+                }]}},
+            }, namespace="default")
+            live[name] = demand
+        t0 = time.perf_counter()
+        converge()
+        elapsed = time.perf_counter() - t0
+        claims = fake.list(*RES, "resourceclaims")
+        allocated = sum(1 for c in claims
+                        if c.get("status", {}).get("allocation"))
+        pending = len(claims) - allocated
+        oracle = oracle_plan(count, demand)
+        achieved = allocated / total_chips
+        ratio = achieved / max(oracle["tenants_per_chip"], 1e-9)
+        tracked_min = ratio if tracked_min is None else min(
+            tracked_min, ratio)
+        over = audit_overcommit()
+        overcommit_total += over
+        # Steady state: two more controller+node rounds must cost
+        # ZERO kube writes (the converged-republish contract).
+        w0 = counted.writes
+        for _ in range(2):
+            sched.sync_once()
+            node_republish()
+        steady_writes = counted.writes - w0
+        steady_writes_total += steady_writes
+        crds = fake.list(*CRD)
+        profile_names = sorted(
+            p["name"] for p in (crds[0]["spec"].get("profiles", [])
+                                if crds else []))
+        point = {
+            "phase": phase,
+            "tenants": count,
+            "demand_bytes": demand,
+            "allocated": allocated,
+            "pending": pending,
+            "tenants_per_chip": round(achieved, 3),
+            "oracle_slots": oracle["slots"],
+            "oracle_tenants_per_chip": round(
+                oracle["tenants_per_chip"], 3),
+            "tracked_ratio": round(ratio, 3),
+            "profiles": profile_names,
+            "overcommitted_counters": over,
+            "steady_writes": steady_writes,
+            "elapsed_s": round(elapsed, 3),
+        }
+        trajectory.append(point)
+        extras[f"autoscale_{phase}_tracked_ratio"] = round(ratio, 3)
+        extras[f"autoscale_{phase}_pending"] = pending
+        extras[f"autoscale_{phase}_profiles"] = ",".join(profile_names)
+    sched.stop()
+
+    extras["autoscale_tracked_ratio_min"] = round(tracked_min, 3)
+    extras["autoscale_overcommitted_counters"] = overcommit_total
+    extras["autoscale_steady_writes"] = steady_writes_total
+
+    # -- crash-at-every-fault-point resume proof ------------------------------
+    fault_points = ("autoscale.sync", "autoscale.plan",
+                    "autoscale.apply", "autoscale.confirm")
+
+    def crash_run(fault: str | None) -> str:
+        """One small controller run; with a fault armed the first sync
+        that hits it dies and a FRESH controller on the same root
+        finishes. Returns the final CRD spec fingerprint."""
+        f = FakeKubeClient()
+        publish_resource_slices(f, node_slices(0, None))
+        root = tempfile.mkdtemp(prefix="bench-autoscale-crash-")
+        s = TenantProfileStore(defaults={}, window_s=0.0)
+        for _ in range(24):
+            s.observe("web", int(1.5 * GIB))
+        c = AutoscaleController(f, root, store=s, sustain_s=0.0,
+                                cooldown_s=0.0,
+                                slot_counts=slot_counts)
+        if fault is not None:
+            faults.arm(fault, mode="error", count=1)
+        try:
+            for _ in range(6):
+                try:
+                    c.sync_once()
+                except Exception:  # noqa: BLE001 - injected
+                    break
+        finally:
+            faults.reset()
+        resumed = AutoscaleController(f, root, store=s, sustain_s=0.0,
+                                      cooldown_s=0.0,
+                                      slot_counts=slot_counts)
+        for _ in range(6):
+            resumed.sync_once()
+            if not resumed.busy():
+                break
+        crds = f.list(*CRD)
+        return fingerprint(crds[0]["spec"]) if crds else ""
+
+    reference_fp = crash_run(None)
+    crash_resumed = True
+    for fault in fault_points:
+        fp = crash_run(fault)
+        ok = bool(fp) and fp == reference_fp
+        extras[f"autoscale_crash_{fault.split('.')[1]}_resumed"] = \
+            int(ok)
+        crash_resumed = crash_resumed and ok
+    extras["autoscale_crash_resumed"] = int(crash_resumed)
+
+    # -- node proof: carve-out create p99 on a REAL DeviceState ---------------
+    import shutil  # noqa: PLC0415
+
+    gates = ("DynamicSubSlice=true,TimeSlicingSettings=true,"
+             "MultiTenancySupport=true,TenantPartitioning=true")
+    outcome, payload, _obj = crdmod.select_for_pool(
+        fake.list(*CRD), "node-0")
+    final_pset = payload[0] if outcome == "ok" else None
+    create_p99_ms = None
+    if final_pset is not None and final_pset.profiles:
+        node_root = tempfile.mkdtemp(prefix="bench-autoscale-node-")
+        slots = max(p.max_tenants for p in final_pset.profiles)
+        oversub_cfg = OpaqueConfig(
+            parameters={"apiVersion": "resource.tpu.dra/v1beta1",
+                        "kind": "SubSliceConfig",
+                        "oversubscribe": True},
+            requests=(), source="FromClaim")
+        try:
+            state = DeviceState(Config.mock(
+                root=node_root, topology=topology, gates=gates,
+                partition_set=final_pset))
+            part_names = sorted(
+                n for n, d in state.allocatable.items()
+                if d.kind == DeviceKind.PARTITION)
+            for r in range(rounds):
+                uids = [f"as-{r}-{k}" for k in range(len(part_names))]
+                for uid, name in zip(uids, part_names):
+                    state.prepare(ResourceClaim(
+                        uid=uid, namespace="default", name=uid,
+                        results=[DeviceResult(
+                            request="tenant", driver=DRIVER_NAME,
+                            pool="bench", device=name)],
+                        configs=[oversub_cfg] if slots > 1 else []))
+                for uid in uids:
+                    state.unprepare(uid)
+            create_p99_ms = _p99_ms(
+                state.segment_samples("prep_attach_partition"))
+        finally:
+            shutil.rmtree(node_root, ignore_errors=True)
+    shutil.rmtree(as_root, ignore_errors=True)
+    extras["autoscale_create_p99_ms"] = create_p99_ms
+
+    return {
+        "metric": "autoscale_tracked_ratio_min",
+        "value": extras["autoscale_tracked_ratio_min"],
+        "unit": "achieved/oracle tenants-per-chip",
+        "vs_baseline": extras["autoscale_tracked_ratio_min"],
+        "trajectory": trajectory,
+        "extras": extras,
+    }
+
+
+def _write_autoscale_json(result: dict) -> None:
+    out_path = os.environ.get(
+        "BENCH_AUTOSCALE_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_autoscale.json"))
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
 def _write_serving_json(result: dict) -> None:
     out_path = os.environ.get(
         "BENCH_SERVING_OUT",
@@ -3652,6 +4087,53 @@ def _dispatch() -> None:
         p99 = ex["serving_create_p99_ms"]
         if cap_ms and (p99 is None or p99 > cap_ms):
             print(f"serving gate failed: create p99 {p99}ms > "
+                  f"{cap_ms}ms", file=sys.stderr)
+            ok = False
+        if not ok:
+            sys.exit(1)
+        return
+    if "--autoscale" in sys.argv[1:]:
+        result = bench_autoscale()
+        _write_autoscale_json(result)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "trajectory"}))
+        # CI gate (`make bench-autoscale-smoke`): the diurnal trace
+        # must track the oracle within 15% in EVERY phase with zero
+        # over-commit, zero pending tenants, zero steady-state kube
+        # writes, bounded create p99, and every controller crash point
+        # resuming to the reference plan.
+        ex = result["extras"]
+        ok = True
+        floor = _env_float("BENCH_AUTOSCALE_MIN_TRACKED", 0.85)
+        if floor and result["value"] < floor:
+            print(f"autoscale gate failed: tracked ratio "
+                  f"{result['value']} < {floor} (worst phase vs the "
+                  "trace-aware oracle)", file=sys.stderr)
+            ok = False
+        if ex["autoscale_overcommitted_counters"]:
+            print("autoscale gate failed: counter over-commit",
+                  file=sys.stderr)
+            ok = False
+        for point in result["trajectory"]:
+            if point["pending"]:
+                print(f"autoscale gate failed: {point['pending']} "
+                      f"tenants pending at the end of phase "
+                      f"{point['phase']}", file=sys.stderr)
+                ok = False
+        if ex["autoscale_steady_writes"]:
+            print("autoscale gate failed: converged steady-state "
+                  f"passes cost {ex['autoscale_steady_writes']} kube "
+                  "writes (must be zero)", file=sys.stderr)
+            ok = False
+        if not ex["autoscale_crash_resumed"]:
+            print("autoscale gate failed: a controller crash point "
+                  "did not resume to the reference plan",
+                  file=sys.stderr)
+            ok = False
+        cap_ms = _env_float("BENCH_AUTOSCALE_MAX_CREATE_P99_MS", 1000.0)
+        p99 = ex["autoscale_create_p99_ms"]
+        if cap_ms and (p99 is None or p99 > cap_ms):
+            print(f"autoscale gate failed: create p99 {p99}ms > "
                   f"{cap_ms}ms", file=sys.stderr)
             ok = False
         if not ok:

@@ -137,6 +137,27 @@ class Driver:
         # samples below, so partition re-plans size against OBSERVED
         # per-tenant HBM/core usage instead of static files only.
         self.tenant_profiles = TenantProfileStore()
+        # Serving-autoscaler seam (pkg/autoscale): when the partition
+        # engine is enabled, a PartitionSet CRD watcher makes the
+        # cluster-scoped layout the source of truth -- every matching
+        # CRD update converges through apply_partition_set, the
+        # startup layout (file or empty) survives as the bootstrap
+        # fallback, and a malformed CRD keeps the last good plan
+        # active. TPU_DRA_PARTITION_WATCH=0 restores the
+        # startup-only-file behavior.
+        self.partition_watcher = None
+        if self.state.partition_engine is not None and os.environ.get(
+                "TPU_DRA_PARTITION_WATCH", "1") not in ("0", "false",
+                                                        "False"):
+            from ..pkg.autoscale import (  # noqa: PLC0415
+                PartitionSetWatcher,
+            )
+
+            self.partition_watcher = PartitionSetWatcher(
+                kube_client,
+                pool=config.pool_name or node_name,
+                apply_fn=self.apply_partition_set,
+                bootstrap=self.state.partition_engine.partition_set)
         self.health_monitor = None
         if enable_health_monitor:
             # The startup enumeration is the health baseline: a chip seen
@@ -217,8 +238,16 @@ class Driver:
         self.metrics.prepared_devices.set(self.state.prepared_device_count())
         self.metrics.tenancy_agents.set(self.state.tenancy_agent_count())
         self.publish_resources()
+        # AFTER the bootstrap publish: the watcher's initial reconcile
+        # converges onto any governing PartitionSet CRD (a restarted
+        # plugin reaches the same carve-out set a live one holds), and
+        # its apply republishes through the content-hash diff.
+        if self.partition_watcher is not None:
+            self.partition_watcher.start()
 
     def stop(self) -> None:
+        if self.partition_watcher is not None:
+            self.partition_watcher.stop()
         self.reconciler.stop()
         self.cleanup.stop()
         if self.health_monitor:

@@ -157,6 +157,20 @@ def run(argv: list[str] | None = None) -> int:
                 f"{TENANT_PARTITIONING}=true)")
         config.partition_set = PartitionSet.from_file(args.partition_set)
         config.pool_name = node_name
+    else:
+        from ..pkg.featuregates import TENANT_PARTITIONING  # noqa: PLC0415
+
+        if gates.is_enabled(TENANT_PARTITIONING):
+            # No bootstrap file: the engine starts with an EMPTY
+            # layout and the PartitionSet CRD watcher (pkg/autoscale,
+            # wired in Driver) populates it from the cluster-scoped
+            # object -- the serving autoscaler's managed path, where
+            # the CRD is the source of truth and no node-local file
+            # exists at all.
+            from ..pkg.partition import PartitionSet  # noqa: PLC0415
+
+            config.partition_set = PartitionSet.from_dict({})
+            config.pool_name = node_name
 
     metrics = DRARequestMetrics()
     # Retry/breaker/quarantine + recovery-sweep counters share the

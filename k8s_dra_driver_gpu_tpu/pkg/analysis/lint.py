@@ -105,6 +105,16 @@ RULES: dict[str, str] = {
                  "public FleetAggregator.observe_pass entry, so the "
                  "bounded time-series can't be corrupted (or "
                  "double-fed) from a random call site",
+    "TPUDRA014": "PartitionSet spec/profile mutation outside the "
+                 "autoscale control plane: PartitionSet(...) / "
+                 "PartitionProfile(...) construction and apiserver "
+                 "writes to the partitionsets CRD are fenced to "
+                 "pkg/autoscale/ and the pkg/partition/spec.py "
+                 "definition site -- every other producer consumes "
+                 "plans through the CRD watch / engine apply path, so "
+                 "a random call site can never fork the fleet's "
+                 "desired layout from the controller's durable "
+                 "rollout records",
 }
 
 # Lock model (docs/architecture.md "Locking hierarchy"). Matched on the
@@ -180,6 +190,16 @@ _FLIGHT_EVENT_FILES = {"flightrecorder.py", "lint.py"}
 _TELEMETRY_MUT_SUFFIXES = ("pkg/fleetstate.py", "pkg/anomaly.py",
                            "kubeletplugin/health.py",
                            "analysis/lint.py")
+# TPUDRA014 scope: PartitionSet/PartitionProfile specs are BUILT only
+# by the definition site (pkg/partition/spec.py: from_dict/from_file)
+# and the autoscale control plane (pkg/autoscale/: the planner emits
+# desired sets, the controller writes them to the partitionsets CRD).
+# Rel-path sanctioned like TPUDRA011/013 -- a stray spec.py elsewhere
+# gets no pass; the pkg/autoscale/ entry is a directory prefix.
+_PARTITION_SPEC_SUFFIXES = ("pkg/partition/spec.py",
+                            "analysis/lint.py")
+_PARTITION_SPEC_DIRS = ("pkg/autoscale/",)
+_PARTITION_CRD_WRITE_VERBS = {"create", "update", "patch", "delete"}
 # Resources the scheduler watches (mirror of
 # pkg/schedcache.WATCHED_RESOURCES, kept literal so the linter has no
 # runtime import of the code under analysis).
@@ -203,7 +223,11 @@ _STATE_LITERALS = {"PrepareStarted", "PrepareCompleted",
                    # Partition lifecycle (pkg/partition/engine.py):
                    # same rule for the partition TransitionPolicy.
                    "PartitionCreating", "PartitionReady",
-                   "PartitionDestroying"}
+                   "PartitionDestroying",
+                   # Autoscale rollout lifecycle (pkg/autoscale/
+                   # controller.py): the serving autoscaler's re-plan
+                   # records live under the autoscale TransitionPolicy.
+                   "AutoscalePlanned", "AutoscaleApplying"}
 # Copy constructors that launder taint (deep or top-level).
 _COPY_CALLS = {"json_copy", "deepcopy", "dict", "list", "sorted",
                "json_loads"}
@@ -680,6 +704,14 @@ class _ModuleLinter(ast.NodeVisitor):
 
     visit_AsyncWith = visit_With
 
+    def _partition_spec_sanctioned(self) -> bool:
+        """TPUDRA014 scope check: inside pkg/autoscale/ or one of the
+        sanctioned rel-path suffixes."""
+        rel_posix = self.rel.replace(os.sep, "/")
+        return (any(rel_posix.endswith(sfx)
+                    for sfx in _PARTITION_SPEC_SUFFIXES)
+                or any(d in rel_posix for d in _PARTITION_SPEC_DIRS))
+
     def visit_Call(self, node: ast.Call) -> None:
         func = node.func
 
@@ -747,6 +779,23 @@ class _ModuleLinter(ast.NodeVisitor):
                 key="span",
             )
 
+        # TPUDRA014: PartitionSet spec/profile construction outside
+        # the autoscale control plane / spec definition site. The
+        # classmethod readers (from_dict/from_file) stay open -- they
+        # PARSE an authored layout; only direct construction AUTHORS
+        # one.
+        if wrapper_name in ("PartitionSet", "PartitionProfile") and \
+                not self._partition_spec_sanctioned():
+            self._emit(
+                "TPUDRA014", node,
+                f"{wrapper_name}(...) constructed outside "
+                "pkg/autoscale/ / pkg/partition/spec.py: desired "
+                "partition layouts are authored by the autoscale "
+                "planner (or parsed via PartitionSet.from_dict/"
+                "from_file), never built ad hoc",
+                key=wrapper_name,
+            )
+
         # TPUDRA008: raw KubeClient construction outside the wrapper.
         if self._is_kubeclient_ctor(node) and \
                 not getattr(node, "_tpudra_wrapped", False) and \
@@ -780,6 +829,24 @@ class _ModuleLinter(ast.NodeVisitor):
                     "health-poll seam (ChipHealthMonitor) or fold "
                     "through FleetAggregator.observe_pass",
                     key=f"{base_src}.{attr}",
+                )
+
+            # TPUDRA014 (write half): apiserver writes to the
+            # partitionsets CRD outside the autoscale control plane.
+            # Any kube write verb with a "partitionsets" literal
+            # resource argument is an authoring site.
+            if attr in _PARTITION_CRD_WRITE_VERBS and any(
+                    isinstance(a, ast.Constant)
+                    and a.value == "partitionsets"
+                    for a in node.args) and \
+                    not self._partition_spec_sanctioned():
+                self._emit(
+                    "TPUDRA014", node,
+                    f"partitionsets CRD write {base_src}.{attr}(...) "
+                    "outside pkg/autoscale/: re-plans roll out "
+                    "through the AutoscaleController's durable "
+                    "records, never ad hoc",
+                    key=f"{base_src}.{attr}:partitionsets",
                 )
 
             # TPUDRA011: carve-out registry mutation outside the

@@ -212,6 +212,41 @@ PARTITION_CREATING = "PartitionCreating"
 PARTITION_READY = "PartitionReady"
 PARTITION_DESTROYING = "PartitionDestroying"
 
+# -- autoscale re-plans (serving autoscaler, pkg/autoscale/) ------------------
+#
+# The demand-driven PartitionSet controller rolls profile re-plans
+# through the apiserver as one durable record per re-plan, so a
+# controller crash mid-rollout resumes idempotently onto the SAME plan
+# (the desired spec is pinned in the Planned record):
+#
+#   absent -> AutoscalePlanned       (drift past the hysteresis band:
+#                                     desired PartitionSet computed and
+#                                     pinned durably)
+#   AutoscalePlanned -> AutoscaleApplying  (CRD write issued to the
+#                                     apiserver)
+#   AutoscalePlanned -> absent       (superseded before the write: an
+#                                     operator override or fresher plan
+#                                     won)
+#   AutoscaleApplying -> absent      (CRD content confirmed == plan, or
+#                                     an operator override won the race)
+#
+# A rollout may never skip Planned (an apiserver write without its
+# durable intent is unresumable) -- the stage-skip rule the runtime
+# validator enforces for every other ladder applies here too.
+
+AUTOSCALE_PLANNED = "AutoscalePlanned"
+AUTOSCALE_APPLYING = "AutoscaleApplying"
+
+AUTOSCALE_POLICY = TransitionPolicy(
+    "autoscale",
+    frozenset({
+        (ABSENT, AUTOSCALE_PLANNED),            # durable re-plan intent
+        (AUTOSCALE_PLANNED, AUTOSCALE_APPLYING),  # CRD write issued
+        (AUTOSCALE_PLANNED, ABSENT),            # superseded pre-write
+        (AUTOSCALE_APPLYING, ABSENT),           # confirmed / superseded
+    }),
+)
+
 PARTITION_POLICY = TransitionPolicy(
     "partition",
     frozenset({
@@ -233,4 +268,5 @@ POLICIES = {
     "eviction": EVICTION_POLICY,
     "defrag": DEFRAG_POLICY,
     "partition": PARTITION_POLICY,
+    "autoscale": AUTOSCALE_POLICY,
 }

@@ -1,0 +1,68 @@
+"""Serving autoscaler: the demand-driven PartitionSet control plane.
+
+ROADMAP open item 4 (the millions-of-users path). PR 8's partition
+engine sized tenants ONCE from a static ``--partition-set`` file and
+re-planned only through a manual ``Driver.apply_partition_set``; PR 9
+already streams live per-tenant HBM/core demand into the
+``TenantProfileStore``. This package closes the loop:
+
+- **crd.py** -- the cluster-scoped ``PartitionSet`` CRD
+  (``partitionsets.resource.tpu.dra/v1beta1``): the fleet-wide desired
+  partition layout, watched through the existing informer machinery.
+  It replaces the node-local layout file as the source of truth; the
+  file survives as the bootstrap fallback.
+- **planner.py** -- MISO (2207.11428) profile-guided sizing + ParvaGPU
+  (2409.14447) demand-aware packing over the observed demand
+  percentiles, with a hysteresis band so the fleet tracks diurnal load
+  without flapping, and per-profile CEL-selectable priority so
+  latency-critical tenants are packed away from oversubscribed
+  devices.
+- **controller.py** -- the re-planning controller riding the scheduler
+  loop (``DraScheduler.attach_autoscaler``, leader-elected like
+  recovery/defrag): durable re-plan records under the ``autoscale``
+  TransitionPolicy make a crash mid-rollout resume idempotently.
+- **nodewatch.py** -- the node plugin's CRD watcher: every matching
+  PartitionSet update converges the node's published partition devices
+  through ``Driver.apply_partition_set`` (live-tenant-safe: the engine
+  refuses to re-shape held carve-outs, and retired profiles drain
+  through ``prune_retired_partitions``); a malformed CRD fails CLOSED,
+  keeping the last good plan active.
+
+Lint rule TPUDRA014 fences PartitionSet spec/profile construction and
+``partitionsets`` apiserver writes to this package plus the
+``pkg/partition/spec.py`` definition site.
+"""
+
+from .controller import AutoscaleController
+from .crd import (
+    AUTOSCALE_CRD_GROUP,
+    AUTOSCALE_CRD_KIND,
+    AUTOSCALE_CRD_RESOURCE,
+    AUTOSCALE_CRD_VERSION,
+    MANAGED_ANNOTATION,
+    PriorityRule,
+    crd_object,
+    fingerprint,
+    partition_set_from_crd,
+    select_for_pool,
+)
+from .nodewatch import PartitionSetWatcher
+from .planner import AutoscalePlanner, PlanResult, pool_chip_caps
+
+__all__ = [
+    "AUTOSCALE_CRD_GROUP",
+    "AUTOSCALE_CRD_KIND",
+    "AUTOSCALE_CRD_RESOURCE",
+    "AUTOSCALE_CRD_VERSION",
+    "MANAGED_ANNOTATION",
+    "AutoscaleController",
+    "AutoscalePlanner",
+    "PartitionSetWatcher",
+    "PlanResult",
+    "PriorityRule",
+    "crd_object",
+    "fingerprint",
+    "partition_set_from_crd",
+    "pool_chip_caps",
+    "select_for_pool",
+]

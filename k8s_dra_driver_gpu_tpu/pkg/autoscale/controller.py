@@ -1,0 +1,455 @@
+"""The autoscale controller: continuous demand-driven re-planning.
+
+Rides the scheduler loop (``DraScheduler.attach_autoscaler``, so it is
+leader-elected and informer-fed exactly like recovery/defrag) or runs
+directly (``sync_once``) in tests and the autoscale bench. Each pass:
+
+1. **Ingest** -- fold apiserver-declared tenant demand (claim
+   annotations ``resource.tpu.dra/tenant-demand-hbm`` / ``-cores``)
+   into the sliding-window TenantProfileStore. Node-side tpulib
+   telemetry reaches the same store when the deployment co-locates the
+   feed; either way the window (``TPU_DRA_PROFILE_WINDOW_S``) makes
+   retired demand age out.
+2. **Advance** -- drive any in-flight re-plan record through its
+   ladder (Planned -> Applying -> confirmed/superseded). Records are
+   durable (CheckpointManager under the ``autoscale``
+   TransitionPolicy), so a controller crash at ANY fault point
+   (``autoscale.sync`` / ``plan`` / ``apply`` / ``confirm``) resumes
+   idempotently onto the SAME plan -- the desired spec is pinned in
+   the Planned record.
+3. **Plan** -- run the MISO/ParvaGPU planner over the demand
+   percentiles + fleet pending demand; on drift past the hysteresis
+   band (urgent upsizes immediately, repacks after
+   ``TPU_DRA_AUTOSCALE_SUSTAIN_S``) write a durable Planned record and
+   start the rollout. A converged pass (desired == active) writes
+   NOTHING to the apiserver -- the steady-state-zero-writes contract
+   the bench gates.
+
+The controller owns exactly one CRD (``crd_name``, default
+``tpu-dra-autoscale``) and never touches objects it does not manage:
+an operator flipping ``resource.tpu.dra/autoscale-managed`` to
+``"false"`` freezes re-planning (manual override), and a spec that
+changed under an in-flight rollout supersedes the rollout (the
+operator's content wins).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from .. import faults, flightrecorder, positive_float_env
+from ..analysis.statemachine import (
+    AUTOSCALE_APPLYING,
+    AUTOSCALE_PLANNED,
+    AUTOSCALE_POLICY,
+)
+from ..kubeclient import ConflictError, KubeError, NotFoundError
+from ..partition.profiles import (
+    TENANT_PROFILE_ANNOTATION,
+    TenantProfileStore,
+)
+from ..partition.spec import PartitionSet, PartitionSpecError
+from . import crd
+from .planner import (
+    TENANT_DEMAND_CORES_ANNOTATION,
+    TENANT_DEMAND_HBM_ANNOTATION,
+    AutoscalePlanner,
+    pool_chip_caps,
+)
+
+logger = logging.getLogger(__name__)
+
+RESOURCE = ("resource.k8s.io", "v1")
+CRD = (crd.AUTOSCALE_CRD_GROUP, crd.AUTOSCALE_CRD_VERSION,
+       crd.AUTOSCALE_CRD_RESOURCE)
+
+
+#: Repack (non-urgent) drift must persist this long before a rollout
+#: fires; urgent drift (upsizes, latency-critical isolation, pending
+#: demand with no profile) fires immediately.
+AUTOSCALE_SUSTAIN_S = positive_float_env(
+    "TPU_DRA_AUTOSCALE_SUSTAIN_S", default=120.0, floor=0.0)
+#: Hysteresis band: a repack-down needs this much headroom below the
+#: finer budget before it is proposed.
+AUTOSCALE_BAND = positive_float_env(
+    "TPU_DRA_AUTOSCALE_BAND", default=0.1, floor=0.0)
+#: Quiet period after a completed rollout before the next plan.
+AUTOSCALE_COOLDOWN_S = positive_float_env(
+    "TPU_DRA_AUTOSCALE_COOLDOWN_S", default=60.0, floor=0.0)
+#: Pause switch: "1"/"true" stops NEW plans; in-flight rollouts still
+#: advance to completion (never park a half-applied CRD).
+PAUSE_ENV = "TPU_DRA_AUTOSCALE_PAUSE"
+
+
+def _meta(obj: dict) -> dict:
+    return obj.get("metadata", {})
+
+
+class AutoscaleController:
+    """Plans and rolls out PartitionSet re-plans; designed to ride the
+    event-driven scheduler loop (``attach_autoscaler``) or be driven
+    directly (``sync_once``) by tests and ``bench.py --autoscale``."""
+
+    _META_DEVICE = "autoscale"
+
+    def __init__(self, kube, root: str, store=None, fleet=None,
+                 metrics=None, crd_name: str = "tpu-dra-autoscale",
+                 percentile: float = 0.95,
+                 band: float = AUTOSCALE_BAND,
+                 sustain_s: float = AUTOSCALE_SUSTAIN_S,
+                 cooldown_s: float = AUTOSCALE_COOLDOWN_S,
+                 slot_counts: tuple[int, ...] = (1, 2, 4, 8),
+                 subslice: str = "1x1",
+                 pools: tuple[str, ...] = ()):
+        # Function-local import like pkg/recovery and pkg/defrag: pkg
+        # -> kubeletplugin stays a one-way street for non-driver users.
+        from ...kubeletplugin.checkpoint import (  # noqa: PLC0415
+            CheckpointManager,
+        )
+
+        self.kube = kube
+        self.store = store if store is not None else TenantProfileStore(
+            defaults={})
+        self.fleet = fleet  # pkg/fleetstate.FleetAggregator | None
+        self.metrics = metrics  # pkg.metrics.AutoscaleMetrics | None
+        self.crd_name = crd_name
+        self.planner = AutoscalePlanner(
+            percentile=percentile, band=band, slot_counts=slot_counts,
+            subslice=subslice)
+        self.sustain_s = sustain_s
+        self.cooldown_s = cooldown_s
+        self.pools = tuple(pools)
+        self._checkpoint = CheckpointManager(
+            root, transition_policy=AUTOSCALE_POLICY)
+        self._lock = threading.Lock()
+        self._active_count = len(self._checkpoint.get().claims)
+        # Non-urgent drift sustain clock: fingerprint of the drifted
+        # desired spec -> wall clock first observed. A DIFFERENT drift
+        # restarts the clock (the fleet is still moving).
+        self._drift_since: tuple[str, float] | None = None
+        self._cooldown_until = 0.0
+        # Optional informer-backed read surface
+        # (pkg/schedcache.ClusterView), set by attach_autoscaler.
+        self.view = None
+        self.flight = flightrecorder.default()
+        self.last_sync: dict = {}
+
+    # -- scheduler surface ----------------------------------------------------
+
+    def busy(self) -> bool:
+        """True while a rollout record is in flight. Read by tests and
+        the bench converge loops (the scheduler enqueues autoscale
+        keys on EVERY partitionsets event, busy or not -- an operator
+        edit must reach the defer/supersede logic promptly, unlike the
+        recovery/defrag controllers whose per-claim event floods are
+        gated on their busy())."""
+        with self._lock:
+            return self._active_count > 0
+
+    @staticmethod
+    def paused() -> bool:
+        return os.environ.get(PAUSE_ENV, "") in ("1", "true", "True")
+
+    # -- reads ----------------------------------------------------------------
+
+    def _list_claims(self) -> list[dict]:
+        if self.view is not None:
+            return self.view.claims()
+        return self.kube.list(*RESOURCE, "resourceclaims")
+
+    def _list_slices(self) -> list[dict]:
+        if self.view is not None:
+            return self.view.slices()
+        return self.kube.list(*RESOURCE, "resourceslices")
+
+    def _list_partition_sets(self) -> list[dict]:
+        if self.view is not None:
+            return self.view.partition_sets()
+        return self.kube.list(*CRD)
+
+    # -- sync -----------------------------------------------------------------
+
+    def sync_once(self) -> dict:
+        """One ingest -> advance -> plan pass. Every stage is
+        idempotent; a crash anywhere resumes from the durable
+        record."""
+        faults.fault_point("autoscale.sync")
+        counts = {"advanced": 0, "applied": 0, "completed": 0,
+                  "superseded": 0, "planned": 0, "converged": 0,
+                  "deferred": 0}
+        try:
+            claims = self._list_claims()
+            crds = self._list_partition_sets()
+        except KubeError:
+            logger.warning("autoscale sync: list failed; retrying "
+                           "next pass")
+            return counts
+        live, pending = self._ingest_claim_demand(claims)
+        self._advance(counts)
+        if not self.paused():
+            self._detect_and_plan(crds, live, pending, counts)
+        if counts["planned"]:
+            # Issue the freshly planned rollout's CRD write in the
+            # SAME pass (the record is already durable): the write's
+            # own partitionsets informer event then drives the confirm
+            # stage, so a rollout never waits out the safety resync.
+            self._advance(counts, apply_only=True)
+        active = len(self._checkpoint.get().claims)
+        with self._lock:
+            self._active_count = active
+        if self.metrics is not None:
+            self.metrics.active_rollouts.set(active)
+        self.last_sync = counts
+        return counts
+
+    # -- demand ingest --------------------------------------------------------
+
+    def _ingest_claim_demand(self, claims: list[dict]
+                             ) -> tuple[set[str], set[str]]:
+        """Fold annotation-declared demand into the store; returns
+        (live tenant keys, pending tenant keys). Re-observed every
+        pass on purpose: live claims keep their demand fresh inside
+        the sliding window, and a retired claim's samples age out --
+        the decay half of the diurnal loop."""
+        live: set[str] = set()
+        pending: set[str] = set()
+        for claim in claims:
+            md = _meta(claim)
+            if md.get("deletionTimestamp"):
+                continue
+            ann = md.get("annotations") or {}
+            tenant = ann.get(TENANT_PROFILE_ANNOTATION)
+            if not tenant:
+                continue
+            live.add(tenant)
+            if not claim.get("status", {}).get("allocation"):
+                pending.add(tenant)
+            raw = ann.get(TENANT_DEMAND_HBM_ANNOTATION)
+            if raw is None:
+                continue
+            try:
+                hbm = int(raw)
+                cores = int(ann.get(TENANT_DEMAND_CORES_ANNOTATION, 1))
+            except (TypeError, ValueError):
+                continue  # malformed demand: observe nothing
+            self.store.observe(tenant, hbm, cores=cores)
+        return live, pending
+
+    # -- planning -------------------------------------------------------------
+
+    def _our_crd(self, crds: list[dict]) -> dict | None:
+        for obj in crds:
+            if _meta(obj).get("name") == self.crd_name:
+                return obj
+        return None
+
+    def _detect_and_plan(self, crds: list[dict], live: set[str],
+                         pending: set[str], counts: dict) -> None:
+        if self._checkpoint.get().claims:
+            return  # one rollout at a time: finish it first
+        now = time.time()
+        if now < self._cooldown_until:
+            return
+        our = self._our_crd(crds)
+        rules: tuple = ()
+        active = PartitionSet(pools=self.pools)
+        if our is not None:
+            if not crd.is_managed(our):
+                # Operator took manual control: plan nothing until the
+                # managed annotation returns.
+                counts["deferred"] += 1
+                return
+            try:
+                active, rules = crd.partition_set_from_crd(our)
+            except PartitionSpecError as e:
+                # Our own CRD hand-edited into garbage: fail closed --
+                # replanning against an unknowable baseline could
+                # stampede the fleet. The operator surface is the log
+                # + the lint-clean CRD they are editing.
+                logger.error("autoscale: managed PartitionSet %s is "
+                             "malformed (%s); deferring re-plans",
+                             self.crd_name, e)
+                counts["deferred"] += 1
+                return
+        try:
+            slices = self._list_slices()
+        except KubeError:
+            return
+        chip_hbm, cores_per_chip = pool_chip_caps(slices)
+        plan = self.planner.plan(
+            self.store, active, rules=rules, chip_hbm=chip_hbm,
+            cores_per_chip=cores_per_chip, live_tenants=live,
+            pending_tenants=pending,
+            pools=self.pools)
+        if not plan.changed:
+            counts["converged"] += 1
+            self._drift_since = None
+            if self.metrics is not None:
+                self.metrics.converged.inc()
+            return
+        desired_spec = crd.spec_dict(plan.desired, rules)
+        fp = crd.fingerprint(desired_spec)
+        # The fleet pending-demand ring (pkg/fleetstate): sustained
+        # pending claims while tenants wait means the current layout
+        # is slot-starved -- a repack to finer profiles ADDS capacity,
+        # so it must not idle out the sustain window.
+        starving = bool(pending) and self.fleet is not None and \
+            self.fleet.pending_recent() > 0
+        if not plan.urgent and not starving:
+            # Repack drift waits out the sustain window; the clock
+            # restarts when the drift CONTENT moves (fleet still
+            # settling).
+            if self._drift_since is None or self._drift_since[0] != fp:
+                self._drift_since = (fp, now)
+            if now - self._drift_since[1] < self.sustain_s:
+                counts["deferred"] += 1
+                return
+        self._drift_since = None
+        faults.fault_point("autoscale.plan")
+        self._write_record(fp, AUTOSCALE_PLANNED, live={
+            "spec": desired_spec,
+            "fingerprint": fp,
+            "crd": self.crd_name,
+            "plannedAt": now,
+            "urgent": plan.urgent,
+            "decisions": {t: d.get("action", "")
+                          for t, d in plan.decisions.items()},
+            "baseRevision": crd.revision_of(our) if our else 0,
+        })
+        counts["planned"] += 1
+        with self._lock:
+            self._active_count = max(self._active_count, 1)
+        if self.metrics is not None:
+            self.metrics.plans.inc()
+        logger.warning(
+            "autoscale re-plan %s: %d profile(s) [%s]%s", fp,
+            len(plan.desired.profiles),
+            ", ".join(f"{t}:{d.get('action')}"
+                      for t, d in sorted(plan.decisions.items())),
+            " (urgent)" if plan.urgent else "")
+
+    # -- durable records ------------------------------------------------------
+
+    def _write_record(self, uid_fp: str, state: str,
+                      live: dict | None = None, prev=None) -> None:
+        from ...kubeletplugin.checkpoint import (  # noqa: PLC0415
+            CheckpointedClaim,
+            CheckpointedDevice,
+        )
+
+        uid = f"replan-{uid_fp}"
+        if prev is not None:
+            live = dict(prev.devices[0].live or {}) \
+                if prev.devices else {}
+        self._checkpoint.update_claim(uid, CheckpointedClaim(
+            uid=uid, state=state,
+            devices=[CheckpointedDevice(
+                canonical_name=self._META_DEVICE,
+                kind=self._META_DEVICE, live=live or {})],
+        ))
+        self.flight.record(uid, "autoscale", state=state,
+                           fingerprint=uid_fp)
+
+    @staticmethod
+    def _record_meta(rec) -> dict:
+        return (rec.devices[0].live or {}) if rec.devices else {}
+
+    # -- rollout ladder -------------------------------------------------------
+
+    def _advance(self, counts: dict, apply_only: bool = False) -> None:
+        records = self._checkpoint.get().claims
+        for uid in sorted(records):
+            rec = records[uid]
+            meta = self._record_meta(rec)
+            fp = meta.get("fingerprint", "")
+            if rec.state == AUTOSCALE_PLANNED:
+                if self._apply(uid, fp, meta, counts):
+                    counts["advanced"] += 1
+                    counts["applied"] += 1
+            elif rec.state == AUTOSCALE_APPLYING and not apply_only:
+                self._confirm(uid, fp, meta, counts)
+
+    def _supersede(self, uid: str, counts: dict, why: str) -> None:
+        self._checkpoint.update_claim(uid, None)
+        counts["superseded"] += 1
+        if self.metrics is not None:
+            self.metrics.superseded.inc()
+        self.flight.record(uid, "autoscale", state="Superseded")
+        logger.warning("autoscale rollout %s superseded: %s; operator "
+                       "content wins", uid, why)
+
+    def _apply(self, uid: str, fp: str, meta: dict,
+               counts: dict) -> bool:
+        """Write the pinned spec to the apiserver (create or
+        merge-patch), then durably mark Applying. Idempotent: a resume
+        after a crash mid-write re-issues the same content. An
+        operator who flipped the managed annotation off while the
+        record was in flight wins: the rollout retires untouched --
+        the write below must never stomp a manual-override flip (only
+        the CREATE path may stamp the annotation)."""
+        faults.fault_point("autoscale.apply")
+        spec = meta.get("spec") or {}
+        revision = int(meta.get("baseRevision", 0)) + 1
+        try:
+            live = self.kube.get(*CRD, self.crd_name)
+        except NotFoundError:
+            live = None
+        except KubeError:
+            return False  # retry next pass
+        if live is not None and not crd.is_managed(live):
+            self._supersede(uid, counts,
+                            "managed annotation flipped off mid-plan")
+            return False
+        try:
+            if live is None:
+                self.kube.create(*CRD, crd.crd_object_from_spec(
+                    self.crd_name, spec, revision=revision))
+            else:
+                self.kube.patch(*CRD, self.crd_name, {
+                    "metadata": {"annotations": {
+                        crd.REVISION_ANNOTATION: str(revision),
+                    }},
+                    "spec": spec,
+                })
+        except ConflictError:
+            return False  # re-examined next pass
+        except KubeError:
+            logger.warning("autoscale: CRD apply failed; retrying")
+            return False
+        rec = self._checkpoint.get().claims.get(uid)
+        self._write_record(fp, AUTOSCALE_APPLYING, prev=rec)
+        return True
+
+    def _confirm(self, uid: str, fp: str, meta: dict,
+                 counts: dict) -> None:
+        """Fresh-read the CRD; our content standing = rollout
+        complete, anything else = superseded (the operator's content
+        wins -- we never fight a manual edit)."""
+        faults.fault_point("autoscale.confirm")
+        try:
+            live = self.kube.get(*CRD, self.crd_name)
+        except NotFoundError:
+            live = None
+        except KubeError:
+            return  # retry next pass
+        counts["advanced"] += 1
+        if live is not None and \
+                crd.fingerprint(live.get("spec", {})) == fp:
+            self._checkpoint.update_claim(f"replan-{fp}", None)
+            counts["completed"] += 1
+            self._cooldown_until = time.time() + self.cooldown_s
+            planned_at = float(meta.get("plannedAt", 0.0))
+            if self.metrics is not None:
+                self.metrics.applies.inc()
+                if planned_at:
+                    self.metrics.rollout_seconds.observe(
+                        max(time.time() - planned_at, 0.0))
+            self.flight.record(f"replan-{fp}", "autoscale",
+                               state="Completed")
+            logger.warning("autoscale rollout %s complete", fp)
+        else:
+            self._supersede(f"replan-{fp}", counts,
+                            "concurrent PartitionSet edit")

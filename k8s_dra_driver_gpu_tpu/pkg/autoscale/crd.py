@@ -1,0 +1,226 @@
+"""The PartitionSet CRD: the fleet-wide desired partition layout.
+
+Cluster-scoped ``partitionsets.resource.tpu.dra/v1beta1`` objects carry
+the SAME spec the node-local layout file did (``profiles`` +
+``pools``), plus the autoscaler's operator inputs:
+
+- ``spec.priorityRules``: per-profile CEL-selectable priority. Each
+  rule is ``{"selector": <CEL over the tenant>, "priority": <int>}``;
+  the expression sees a ``tenant`` variable
+  (``{"key": str, "hbmBytes": int, "cores": int}``). A tenant matching
+  any rule with priority > 0 is latency-critical: the planner sizes it
+  against NON-oversubscribed profiles only (maxTenants == 1), packing
+  it away from shared devices.
+- ``metadata.annotations["resource.tpu.dra/autoscale-managed"]``:
+  ``"true"`` on CRDs the controller owns and may rewrite. An operator
+  flips it to ``"false"`` to take manual control -- the controller
+  stops planning against that object (the manual-override procedure,
+  docs/operations.md). CRDs the controller did not create are never
+  rewritten.
+
+Node-side selection is deterministic: among the cluster's
+PartitionSets whose ``spec.pools`` globs match this node's pool, the
+LEXICOGRAPHICALLY FIRST by name wins -- so an operator-authored
+``00-manual-override`` object out-ranks the controller's
+``tpu-dra-autoscale`` without any coordination. A malformed winning
+object fails CLOSED: the watcher keeps the last good plan active and
+surfaces the parse error.
+
+Construction of PartitionSet/PartitionProfile specs (and
+``partitionsets`` apiserver writes) is fenced to pkg/autoscale/ +
+pkg/partition/spec.py by lint rule TPUDRA014.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from fnmatch import fnmatch
+from functools import lru_cache
+
+from ..cel import CelEvalError, CelParseError, compile_expression
+from ..partition.spec import PartitionSet, PartitionSpecError
+
+
+@lru_cache(maxsize=256)
+def _compiled_selector(selector: str):
+    """One CelProgram per distinct rule source: matches() runs per
+    tenant per rule per planning pass, for expressions that never
+    change (the AST underneath is process-memoized too; this also
+    skips re-wrapping it)."""
+    return compile_expression(selector)
+
+AUTOSCALE_CRD_GROUP = "resource.tpu.dra"
+AUTOSCALE_CRD_VERSION = "v1beta1"
+AUTOSCALE_CRD_RESOURCE = "partitionsets"
+AUTOSCALE_CRD_KIND = "PartitionSet"
+
+#: "true" on controller-managed CRDs; an operator flips it to "false"
+#: to freeze the object against re-plans (manual override).
+MANAGED_ANNOTATION = "resource.tpu.dra/autoscale-managed"
+#: Revision counter the controller bumps per applied re-plan
+#: (observability only -- the content fingerprint is the identity).
+REVISION_ANNOTATION = "resource.tpu.dra/autoscale-revision"
+
+
+@dataclass(frozen=True)
+class PriorityRule:
+    """One CEL-selected tenant priority class."""
+
+    selector: str
+    priority: int
+
+    def to_dict(self) -> dict:
+        return {"selector": self.selector, "priority": self.priority}
+
+    def matches(self, tenant: str, hbm_bytes: int, cores: int) -> bool:
+        """Evaluate the selector against one tenant. Errors mean "does
+        not match" (the claim-selector CEL contract): a broken rule
+        must never grant or deny priority by crashing the planner."""
+        try:
+            prog = _compiled_selector(self.selector)
+            result = prog.evaluate({"tenant": {
+                "key": tenant, "hbmBytes": hbm_bytes, "cores": cores,
+            }})
+        except (CelParseError, CelEvalError):
+            return False
+        return result is True
+
+
+def parse_priority_rules(raw: list | None) -> tuple[PriorityRule, ...]:
+    """Strict-parse ``spec.priorityRules``; malformed rules raise
+    PartitionSpecError (the whole CRD then fails closed)."""
+    rules = []
+    for i, entry in enumerate(raw or []):
+        if not isinstance(entry, dict) or not entry.get("selector"):
+            raise PartitionSpecError(
+                f"priorityRules[{i}]: want {{selector, priority}}")
+        selector = str(entry["selector"])
+        try:
+            compile_expression(selector)
+        except CelParseError as e:
+            raise PartitionSpecError(
+                f"priorityRules[{i}]: bad CEL selector "
+                f"{selector!r}: {e}") from e
+        try:
+            priority = int(entry.get("priority", 0))
+        except (TypeError, ValueError) as e:
+            raise PartitionSpecError(
+                f"priorityRules[{i}]: priority must be an int") from e
+        rules.append(PriorityRule(selector=selector, priority=priority))
+    return tuple(rules)
+
+
+def partition_set_from_crd(obj: dict) -> tuple[PartitionSet,
+                                               tuple[PriorityRule, ...]]:
+    """Strict-parse one PartitionSet CRD object. Raises
+    PartitionSpecError on anything malformed (callers fail closed)."""
+    spec = obj.get("spec")
+    if not isinstance(spec, dict):
+        raise PartitionSpecError(
+            f"PartitionSet {obj.get('metadata', {}).get('name')!r}: "
+            "missing spec")
+    ps = PartitionSet.from_dict(spec)
+    return ps, parse_priority_rules(spec.get("priorityRules"))
+
+
+def fingerprint(spec: dict) -> str:
+    """Content identity of one CRD spec (order-insensitive): the
+    rollout-confirmation and steady-state-no-write comparisons both
+    key on this, so a semantically identical spec never re-applies."""
+    return hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def spec_dict(partition_set: PartitionSet,
+              priority_rules: tuple[PriorityRule, ...] = ()) -> dict:
+    out = partition_set.to_dict()
+    if priority_rules:
+        out["priorityRules"] = [r.to_dict() for r in priority_rules]
+    return out
+
+
+def crd_object_from_spec(name: str, spec: dict, revision: int = 1,
+                         managed: bool = True) -> dict:
+    """The canonical managed-CRD object shape -- the ONE authoring
+    site for apiVersion/kind/metadata, shared by crd_object() and the
+    controller's create path."""
+    return {
+        "apiVersion": f"{AUTOSCALE_CRD_GROUP}/{AUTOSCALE_CRD_VERSION}",
+        "kind": AUTOSCALE_CRD_KIND,
+        "metadata": {
+            "name": name,
+            "annotations": {
+                MANAGED_ANNOTATION: "true" if managed else "false",
+                REVISION_ANNOTATION: str(revision),
+            },
+        },
+        "spec": spec,
+    }
+
+
+def crd_object(name: str, partition_set: PartitionSet,
+               priority_rules: tuple[PriorityRule, ...] = (),
+               revision: int = 1, managed: bool = True) -> dict:
+    return crd_object_from_spec(
+        name, spec_dict(partition_set, priority_rules),
+        revision=revision, managed=managed)
+
+
+def is_managed(obj: dict) -> bool:
+    ann = (obj.get("metadata", {}).get("annotations") or {})
+    return ann.get(MANAGED_ANNOTATION) == "true"
+
+
+def revision_of(obj: dict) -> int:
+    ann = (obj.get("metadata", {}).get("annotations") or {})
+    try:
+        return int(ann.get(REVISION_ANNOTATION, 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def _pools_of(obj: dict) -> list[str]:
+    """Lenient read of spec.pools (selection must work even when the
+    rest of the spec is malformed, so a broken winning CRD is
+    DETECTED rather than silently skipped in favor of a lower-ranked
+    one the operator did not intend to win)."""
+    spec = obj.get("spec") or {}
+    pools = spec.get("pools") or []
+    if not isinstance(pools, list):
+        return []
+    return [str(p) for p in pools]
+
+
+def applies_to_pool(obj: dict, pool: str) -> bool:
+    pools = _pools_of(obj)
+    if not pools:
+        return True
+    return any(fnmatch(pool, pat) for pat in pools)
+
+
+def select_for_pool(objs: list[dict], pool: str
+                    ) -> tuple[str, object, dict | None]:
+    """Pick the PartitionSet governing ``pool``: lexicographically
+    first by name among the objects whose pool globs match.
+
+    Returns one of:
+    - ``("ok", (partition_set, rules, fingerprint), obj)``
+    - ``("malformed", error_message, obj)`` -- the winning object
+      cannot be parsed; the caller keeps its last good plan (fail
+      closed)
+    - ``("none", None, None)`` -- nothing governs this pool; the
+      caller falls back to its bootstrap plan.
+    """
+    matching = sorted(
+        (o for o in objs if applies_to_pool(o, pool)),
+        key=lambda o: o.get("metadata", {}).get("name", ""))
+    if not matching:
+        return "none", None, None
+    winner = matching[0]
+    try:
+        ps, rules = partition_set_from_crd(winner)
+    except PartitionSpecError as e:
+        return "malformed", str(e), winner
+    return "ok", (ps, rules, fingerprint(winner.get("spec", {}))), winner

@@ -1,0 +1,150 @@
+"""Node-side PartitionSet CRD watch: CRD updates -> engine re-plan.
+
+The kubelet plugin used to load its partition layout ONCE from the
+``--partition-set`` file at startup; re-plans needed a manual
+``Driver.apply_partition_set`` call. This watcher makes the
+cluster-scoped PartitionSet CRD the source of truth: an informer over
+``partitionsets.resource.tpu.dra`` converges every matching update
+into ``Driver.apply_partition_set`` (which republishes through the
+content-hash diff -- a converged re-apply costs zero kube writes). The
+file survives as the BOOTSTRAP fallback: it is the plan while no CRD
+governs this pool, and the plan the node reverts to when the governing
+CRD is deleted.
+
+Fail-closed contract (the satellite the CRD->node seam tests pin):
+
+- a MALFORMED winning CRD keeps the last good plan active
+  (``last_error`` surfaces the parse failure, ``failed_total``
+  counts it);
+- an UNREALIZABLE plan (a profile naming a carve-out this host cannot
+  cut, or a re-shape of a live-tenant profile -- both
+  ``PartitionSpecError`` from the engine) is rejected the same way;
+- a restarted plugin converges to the same carve-out set as a live
+  one: the informer's initial list drives the same ``_reconcile``
+  path an event does.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..informer import Informer
+from ..partition.spec import PartitionSet, PartitionSpecError
+from . import crd
+
+logger = logging.getLogger(__name__)
+
+
+class PartitionSetWatcher:
+    """Watches PartitionSet CRDs and applies the winning plan for one
+    pool through ``apply_fn`` (``Driver.apply_partition_set``)."""
+
+    def __init__(self, kube, pool: str, apply_fn,
+                 bootstrap: PartitionSet | None = None,
+                 resync_period: float = 300.0):
+        self.pool = pool
+        self._apply_fn = apply_fn
+        self._bootstrap = bootstrap
+        self._bootstrap_fp = (
+            crd.fingerprint(bootstrap.to_dict())
+            if bootstrap is not None else None)
+        # The fingerprint of the currently APPLIED plan: None until
+        # the first reconcile; the bootstrap plan (already applied by
+        # DeviceState construction) is the implicit initial state.
+        self._applied_fp: str | None = self._bootstrap_fp
+        self._lock = threading.Lock()
+        self.last_error: str | None = None
+        self.applied_total = 0
+        self.failed_total = 0
+        self._informer = Informer(
+            kube, crd.AUTOSCALE_CRD_GROUP, crd.AUTOSCALE_CRD_VERSION,
+            crd.AUTOSCALE_CRD_RESOURCE, kind=crd.AUTOSCALE_CRD_KIND,
+            resync_period=resync_period)
+        self._informer.add_event_hook(self._on_event)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "PartitionSetWatcher":
+        self._informer.start()
+        # The initial list IS the first reconcile: a freshly restarted
+        # plugin converges to the cluster's current plan before any
+        # event arrives.
+        self.reconcile()
+        return self
+
+    def stop(self) -> None:
+        self._informer.stop()
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._informer.wait_for_sync(timeout)
+
+    @property
+    def applied_fingerprint(self) -> str | None:
+        with self._lock:
+            return self._applied_fp
+
+    # -- reconcile ------------------------------------------------------------
+
+    def _fail(self, msg: str) -> None:
+        """Fail-closed bookkeeping (caller holds the lock): the log
+        AND the counter dedupe on the error text, so one persistent
+        malformed CRD counts ONCE instead of once per event/resync --
+        the counter distinguishes a stuck plan from a flapping
+        fleet."""
+        if msg != self.last_error:
+            logger.error("autoscale watch: %s; keeping the last good "
+                         "plan active (fail closed)", msg)
+            self.failed_total += 1
+        self.last_error = msg
+
+    def _on_event(self, _ev_type: str, _obj: dict) -> None:
+        # Cheap full reconcile per event: selection is global (the
+        # winning CRD may CHANGE when any object appears/vanishes), so
+        # per-object incremental upkeep would re-derive the same
+        # ordering anyway. Runs on the informer's notify thread.
+        self.reconcile()
+
+    def reconcile(self) -> bool:
+        """Converge the node onto the winning plan. Returns True when
+        a plan was (re-)applied."""
+        outcome, payload, obj = crd.select_for_pool(
+            self._informer.list(), self.pool)
+        with self._lock:
+            if outcome == "malformed":
+                name = (obj or {}).get("metadata", {}).get("name", "?")
+                self._fail(f"PartitionSet {name}: {payload}")
+                return False
+            if outcome == "none":
+                if self._bootstrap is None or \
+                        self._applied_fp == self._bootstrap_fp:
+                    self.last_error = None  # converged: error resolved
+                    return False
+                plan, fp = self._bootstrap, self._bootstrap_fp
+                source = "bootstrap file"
+            else:
+                plan, _rules, fp = payload
+                if fp == self._applied_fp:
+                    self.last_error = None  # converged: error resolved
+                    return False
+                source = (obj or {}).get("metadata", {}).get(
+                    "name", "?")
+            try:
+                self._apply_fn(plan)
+            except PartitionSpecError as e:
+                self._fail(f"plan from {source} rejected: {e}")
+                return False
+            except Exception as e:  # noqa: BLE001 - node must survive
+                # Republish hiccups (transient kube errors) are not a
+                # plan failure; the next event / publish recheck
+                # heals. The plan itself applied.
+                logger.warning("autoscale watch: republish after "
+                               "apply failed (%s); will self-heal", e)
+            self._applied_fp = fp
+            self.last_error = None
+            self.applied_total += 1
+            logger.info(
+                "autoscale watch: applied partition plan from %s "
+                "(%d profile(s)) on pool %s", source,
+                len(plan.profiles), self.pool)
+            return True

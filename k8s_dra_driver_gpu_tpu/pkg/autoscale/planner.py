@@ -1,0 +1,303 @@
+"""Demand -> desired-PartitionSet planning (MISO sizing + ParvaGPU
+packing) with a hysteresis band.
+
+The planner is pure: observed demand percentiles in
+(:class:`~..partition.profiles.TenantProfileStore`), desired
+:class:`~..partition.spec.PartitionSet` out. The controller owns
+everything stateful (sustain clocks, durable rollout records, the
+apiserver).
+
+Sizing (MISO 2207.11428): per tenant key, the smallest slot count
+whose per-tenant budget covers the demand percentile -- evaluated
+against a catalog of one-chip-backed profiles at the configured slot
+counts, with per-slot budgets derived from the SAME chip capacities
+the nodes publish as KEP-4815 shared counters
+(:func:`pool_chip_caps`), so the plan can never promise a budget the
+counter model will refuse.
+
+Hysteresis: a tenant whose active profile still covers its demand is
+only REPACKED to a finer profile when the demand sits clearly below
+the finer budget (``band`` fraction of headroom) -- demand oscillating
+around a slot boundary must not flap the fleet between layouts.
+Upsizes (demand above the active budget) always fire: an
+under-provisioned serving tenant is an SLO breach, not a style
+preference.
+
+Priority (per-profile CEL, :class:`~.crd.PriorityRule`): a tenant
+matching a rule with priority > 0 is latency-critical and is sized
+against maxTenants == 1 profiles only -- packed away from
+oversubscribed devices (the ParvaGPU interference-avoidance move).
+
+Profile names are VERSIONED by shape (``<tenant>-s<slots>``): a
+re-size retires the old NAME and introduces a new one instead of
+re-shaping a live profile, which is what makes rollouts live-tenant
+safe -- the node engine refuses to re-shape held carve-outs, new
+tenants land on the new profile, and the retired name drains through
+``prune_retired_partitions`` once its last tenant detaches.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from dataclasses import dataclass, field
+
+from ..cel import Quantity
+from ..partition.profiles import SizingPolicy
+from ..partition.spec import PartitionProfile, PartitionSet
+from .crd import PriorityRule
+
+logger = logging.getLogger(__name__)
+
+#: Claim annotations declaring a tenant's demand to the scheduler-side
+#: store (the apiserver-visible twin of the node-local tpulib
+#: telemetry feed): the controller folds these every pass, so live
+#: claims keep their demand fresh in the sliding window and retired
+#: claims age out.
+TENANT_DEMAND_HBM_ANNOTATION = "resource.tpu.dra/tenant-demand-hbm"
+TENANT_DEMAND_CORES_ANNOTATION = "resource.tpu.dra/tenant-demand-cores"
+
+_NAME_SANITIZE_RE = re.compile(r"[^a-z0-9-]+")
+
+
+def profile_name_for(tenant: str, slots: int) -> str:
+    """Shape-versioned profile name (see module docstring)."""
+    san = _NAME_SANITIZE_RE.sub("-", tenant.lower()).strip("-") or "t"
+    return f"{san}-s{slots}"
+
+
+_PROFILE_NAME_RE = re.compile(r"^(.*)-s(\d+)$")
+
+
+def tenant_of_profile(name: str) -> tuple[str, int] | None:
+    m = _PROFILE_NAME_RE.match(name)
+    if not m:
+        return None
+    return m.group(1), int(m.group(2))
+
+
+def pool_chip_caps(slices: list[dict]) -> tuple[int, int]:
+    """(hbm_bytes_per_chip, cores_per_chip) from published
+    ResourceSlice shared counters -- the fleet's largest chip class
+    (uniform-fleet assumption; heterogeneous pools get the
+    conservative treatment of being sized against the largest chip
+    and validated per-node by the engine's counter model)."""
+    hbm = 0
+    cores_by_chip: dict[str, set[str]] = {}
+    cores = 0
+    for s in slices:
+        for cs in s.get("spec", {}).get("sharedCounters") or []:
+            cores_by_chip.clear()
+            for cname, val in (cs.get("counters") or {}).items():
+                if cname.startswith("hbm-"):
+                    try:
+                        hbm = max(hbm, Quantity.parse(
+                            str(val.get("value", "0"))).milli // 1000)
+                    except ValueError:
+                        continue
+                elif cname.startswith("core-"):
+                    parts = cname.split("-")
+                    if len(parts) >= 3:
+                        cores_by_chip.setdefault(
+                            parts[1], set()).add(parts[2])
+            if cores_by_chip:
+                cores = max(cores, max(
+                    len(v) for v in cores_by_chip.values()))
+    return hbm, max(cores, 1)
+
+
+@dataclass(frozen=True)
+class CatalogEntry:
+    """A sizing-catalog entry: duck-typed for SizingPolicy.pick (the
+    same ``tenant_hbm_bytes`` / ``tenant_core_milli`` / ``cores``
+    surface PartitionInfo publishes), with budgets derived from the
+    published chip counters instead of a node-local host handle."""
+
+    profile: PartitionProfile
+    cores: int
+    tenant_hbm_bytes: int
+    tenant_core_milli: int
+
+
+@dataclass
+class PlanResult:
+    """One planning pass: the desired PartitionSet, whether it differs
+    from the active one, and whether the difference is urgent (an
+    upsize / new tenant -- fire now) or cosmetic repacking (wait out
+    the sustain window)."""
+
+    desired: PartitionSet
+    changed: bool = False
+    urgent: bool = False
+    #: tenant -> {"slots", "budget", "demand", "action", "priority"}
+    decisions: dict = field(default_factory=dict)
+
+
+class AutoscalePlanner:
+    def __init__(self, percentile: float = 0.95, band: float = 0.1,
+                 slot_counts: tuple[int, ...] = (1, 2, 4, 8),
+                 subslice: str = "1x1"):
+        self.percentile = percentile
+        self.band = max(0.0, min(float(band), 0.9))
+        self.slot_counts = tuple(sorted(set(
+            int(s) for s in slot_counts if int(s) >= 1)))
+        self.subslice = subslice
+        self._policy = SizingPolicy(percentile)
+
+    # -- catalog --------------------------------------------------------------
+
+    def _catalog(self, tenant: str, chip_hbm: int, cores_per_chip: int,
+                 slot_counts: tuple[int, ...]
+                 ) -> list[tuple[PartitionProfile, CatalogEntry]]:
+        out = []
+        for slots in slot_counts:
+            prof = PartitionProfile(
+                name=profile_name_for(tenant, slots),
+                subslice=self.subslice, max_tenants=slots)
+            entry = CatalogEntry(
+                profile=prof, cores=cores_per_chip,
+                tenant_hbm_bytes=chip_hbm // slots,
+                tenant_core_milli=1000 * cores_per_chip // slots)
+            out.append((prof, entry))
+        return out
+
+    @staticmethod
+    def _priority_of(tenant: str, hbm: int, cores: int,
+                     rules: tuple[PriorityRule, ...]) -> int:
+        return max((r.priority for r in rules
+                    if r.matches(tenant, hbm, cores)), default=0)
+
+    # -- the plan -------------------------------------------------------------
+
+    def plan(self, store, active: PartitionSet,
+             rules: tuple[PriorityRule, ...] = (),
+             chip_hbm: int = 0, cores_per_chip: int = 1,
+             live_tenants: set[str] | None = None,
+             pending_tenants: set[str] | None = None,
+             pools: tuple[str, ...] = (),
+             now: float | None = None) -> PlanResult:
+        """Size every fresh tenant key against the catalog and diff
+        the result against ``active``.
+
+        ``live_tenants``: tenant keys with live claims -- their
+        profiles are retained even when every sample aged out of the
+        window (never yank a serving tenant's profile under it).
+        ``pending_tenants``: tenant keys with PENDING claims -- a
+        missing/undersized profile for one of these is urgent."""
+        live_tenants = live_tenants or set()
+        pending_tenants = pending_tenants or set()
+        active_by_name = {p.name: p for p in active.profiles}
+        fresh = set(store.fresh_tenants(now=now)) | set(live_tenants)
+        decisions: dict = {}
+        profiles: dict[str, PartitionProfile] = {}
+        urgent = False
+
+        if chip_hbm <= 0:
+            # No published counters to budget against (empty fleet):
+            # nothing can be sized -- keep the active layout verbatim.
+            return PlanResult(desired=active)
+
+        for tenant in sorted(fresh):
+            demand = store.demand(tenant, self.percentile, now=now)
+            if demand is None:
+                # Live claims but zero observed samples ever: keep any
+                # active profiles for this tenant untouched (below).
+                self._retain_active(tenant, active_by_name, profiles)
+                continue
+            prio = self._priority_of(tenant, demand.hbm_bytes,
+                                     demand.cores, rules)
+            slot_counts = (1,) if prio > 0 else self.slot_counts
+            catalog = self._catalog(tenant, chip_hbm, cores_per_chip,
+                                    slot_counts)
+            choice = self._policy.pick(demand, catalog)
+            if choice is None:
+                # Whole-chip-class demand: no partition profile; any
+                # active one for this tenant retires (urgent only if
+                # the tenant is pending -- it needs whole chips now).
+                decisions[tenant] = {"action": "no-fit",
+                                     "demand": demand.hbm_bytes,
+                                     "priority": prio}
+                urgent = urgent or tenant in pending_tenants
+                continue
+            s_new = choice.profile.max_tenants
+            cur = self._active_profile(tenant, active_by_name)
+            action = "new"
+            if cur is not None:
+                s_old = cur.max_tenants
+                budget_old = chip_hbm // max(s_old, 1)
+                if prio > 0 and s_old > 1:
+                    action = "isolate"  # latency-critical: off shared
+                    urgent = True
+                elif demand.hbm_bytes > budget_old:
+                    action = "upsize"  # active budget blown: SLO risk
+                    urgent = True
+                elif s_new > s_old:
+                    # Could pack finer -- but only when demand sits
+                    # clearly below the finer budget (hysteresis).
+                    budget_new = chip_hbm // s_new
+                    if demand.hbm_bytes > budget_new * (1 - self.band):
+                        choice = self._keep(cur, chip_hbm,
+                                            cores_per_chip)
+                        action = "keep"
+                    else:
+                        action = "repack"
+                else:
+                    choice = self._keep(cur, chip_hbm, cores_per_chip)
+                    action = "keep"
+            else:
+                urgent = urgent or tenant in pending_tenants
+            profiles[choice.profile.name] = choice.profile
+            decisions[tenant] = {
+                "action": action,
+                "slots": choice.profile.max_tenants,
+                "budget": choice.per_tenant_hbm,
+                "demand": demand.hbm_bytes,
+                "priority": prio,
+            }
+
+        desired = PartitionSet(
+            profiles=tuple(profiles[name] for name in sorted(profiles)),
+            pools=tuple(pools) or active.pools)
+        changed = ({p.name for p in desired.profiles}
+                   != set(active_by_name)
+                   or desired.pools != active.pools)
+        # A retired profile (tenant aged out entirely) is never urgent.
+        return PlanResult(desired=desired, changed=changed,
+                          urgent=urgent and changed,
+                          decisions=decisions)
+
+    @staticmethod
+    def _active_profile(tenant: str, active_by_name: dict
+                        ) -> PartitionProfile | None:
+        """The tenant's current profile in the active set (by the
+        shape-versioned naming contract)."""
+        best = None
+        for name, prof in active_by_name.items():
+            parsed = tenant_of_profile(name)
+            if parsed and parsed[0] == tenant:
+                # Multiple shapes mid-drain: the finest (newest
+                # sizing) is the planning anchor.
+                if best is None or prof.max_tenants > best.max_tenants:
+                    best = prof
+        return best
+
+    @staticmethod
+    def _retain_active(tenant: str, active_by_name: dict,
+                       profiles: dict) -> None:
+        for name, prof in active_by_name.items():
+            parsed = tenant_of_profile(name)
+            if parsed and parsed[0] == tenant:
+                profiles[name] = prof
+
+    def _keep(self, cur: PartitionProfile, chip_hbm: int,
+              cores_per_chip: int):
+        """Wrap the kept active profile in the choice shape (budgets
+        computed exactly like the catalog path's CatalogEntry, so a
+        kept and a freshly-sized choice never disagree)."""
+        from ..partition.profiles import SizedChoice  # noqa: PLC0415
+
+        slots = max(cur.max_tenants, 1)
+        return SizedChoice(
+            profile=cur,
+            per_tenant_hbm=chip_hbm // slots,
+            per_tenant_core_milli=1000 * cores_per_chip // slots)

@@ -37,6 +37,7 @@ import time
 from collections import deque
 
 from . import positive_float_env
+from .partition.spec import parse_partition_device_name
 from .topology import TorusGrid
 from .topology.score import frag_from_largest, largest_free_shape
 
@@ -155,6 +156,21 @@ class FleetAggregator:
         # keep firing the controller nor hold a stale armed clock
         # that would skip the sustain window on return.
         self._live_pools: set[tuple[str, str]] = set()
+        # Pending-demand ring: (ts, pending claims) per pass. The
+        # autoscaler's starvation signal and the /debug/fleet history
+        # next to the per-pool frag/utilization rings.
+        self._pending_ring: deque = deque(maxlen=self._history)
+        # Optional TenantProfileStore (pkg/partition/profiles): when
+        # attached, /debug/fleet surfaces the per-tenant demand
+        # percentiles the autoscale planner sizes against -- operators
+        # see what the controller sees.
+        self._profile_store = None
+
+    def attach_profile_store(self, store) -> None:
+        """Surface a TenantProfileStore's windowed percentiles in the
+        fleet snapshot (read-only: the aggregator never mutates the
+        store)."""
+        self._profile_store = store
 
     # -- the fold (mutations; TPUDRA013 fences callers) -----------------------
 
@@ -172,6 +188,9 @@ class FleetAggregator:
         for cand in snapshot.candidates:
             by_pool.setdefault((cand.driver, cand.pool), []).append(cand)
         allocated = alloc.allocated if alloc is not None else frozenset()
+        holder_counts = (alloc.slot_counts()
+                         if alloc is not None
+                         and hasattr(alloc, "slot_counts") else {})
         points = {}
         nodes: dict[str, dict] = {}
         for key, cands in by_pool.items():
@@ -179,6 +198,13 @@ class FleetAggregator:
             used = sum(1 for c in cands if c.key in allocated)
             free = [c for c in cands if c.key not in allocated]
             frag, largest = self._fold_frag(cands, free, grid_fn)
+            # Partition-slot occupancy (the autoscaler's input next to
+            # frag/utilization): pt- devices' tenant slots vs holders.
+            pt = [c for c in cands
+                  if parse_partition_device_name(c.name) is not None]
+            slots_total = sum(c.slots for c in pt)
+            slots_used = sum(min(holder_counts.get(c.key, 0), c.slots)
+                             for c in pt)
             points[key] = {
                 "ts": round(now, 3),
                 "total_devices": total,
@@ -187,6 +213,11 @@ class FleetAggregator:
                 "utilization": round(used / total, 4) if total else 0.0,
                 "fragmentation_score": frag,
                 "largest_free_shape": largest,
+                "partition_slots_total": slots_total,
+                "partition_slots_used": slots_used,
+                "partition_slot_occupancy": (
+                    round(slots_used / slots_total, 4)
+                    if slots_total else None),
             }
             self._fold_node_telemetry(cands, nodes)
         self._finalize_nodes(nodes)
@@ -201,6 +232,8 @@ class FleetAggregator:
             # the CURRENT inventory only.
             self._nodes = nodes
             self._pending = int(pending_claims)
+            self._pending_ring.append(
+                {"ts": round(now, 3), "pending": int(pending_claims)})
             self._last_pass_ts = now
             self.passes_total += 1
             self._live_pools = set(points)
@@ -350,12 +383,23 @@ class FleetAggregator:
 
     # -- read surface ---------------------------------------------------------
 
-    def snapshot(self) -> dict:
+    def pending_recent(self, points: int = 5) -> int:
+        """Max pending-claim count over the last ``points`` passes:
+        the autoscaler's sustained-starvation signal (one noisy pass
+        neither fires nor masks it)."""
         with self._lock:
-            return {
+            tail = list(self._pending_ring)[-max(points, 1):]
+            return max((p["pending"] for p in tail), default=0)
+
+    def snapshot(self) -> dict:
+        tenants = (self._profile_store.percentiles()
+                   if self._profile_store is not None else None)
+        with self._lock:
+            out = {
                 "ts": self._last_pass_ts,
                 "passes_total": self.passes_total,
                 "pending_claims": self._pending,
+                "pending_history": list(self._pending_ring),
                 "pools": {
                     f"{driver}/{pool}": {
                         "current": ring[-1] if ring else None,
@@ -365,6 +409,12 @@ class FleetAggregator:
                 },
                 "nodes": dict(self._nodes),
             }
+            if tenants is not None:
+                # What the autoscale planner sees: windowed per-tenant
+                # demand percentiles (pkg/autoscale reads the same
+                # store).
+                out["tenant_demand"] = tenants
+            return out
 
     # -- /debug/fleet endpoint (pkg/httpserver handler signature) -------------
 

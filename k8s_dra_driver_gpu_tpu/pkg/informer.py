@@ -47,6 +47,7 @@ RELIST_PRIORITY: dict[str, int] = {
     "deviceclasses": 2,
     "resourceclaimtemplates": 2,
     "computedomains": 2,
+    "partitionsets": 2,
     "nodes": 3,
     "pods": 4,
     "daemonsets": 5,

@@ -24,9 +24,21 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from dataclasses import dataclass
 
+from .. import positive_float_env
 from .spec import PartitionDemand, PartitionProfile, PartitionSpecError
+
+
+def _default_window_s() -> float:
+    """The demand sliding window (``TPU_DRA_PROFILE_WINDOW_S``, default
+    3600s): percentile reads consider only samples this recent, so a
+    traffic burst that has since decayed stops inflating the sized
+    profile once its samples age out. 0 disables aging (all-history,
+    the pre-window behavior)."""
+    return positive_float_env("TPU_DRA_PROFILE_WINDOW_S",
+                              default=3600.0, floor=0.0)
 
 #: Claim annotation naming the tenant profile a claim belongs to.
 TENANT_PROFILE_ANNOTATION = "resource.tpu.dra/tenant-profile"
@@ -53,31 +65,58 @@ class TenantProfileStore:
     Thread-safe: the node plugin's prepare path and the planner read/
     write concurrently."""
 
-    def __init__(self, defaults: dict[str, PartitionDemand] | None = None):
+    def __init__(self, defaults: dict[str, PartitionDemand] | None = None,
+                 window_s: float | None = None):
         self._lock = threading.Lock()
-        # tenant key -> HBM-demand samples (bytes) in ARRIVAL order
-        # (a bounded sliding window) + core demand.
-        self._hbm: dict[str, list[int]] = {}
+        # tenant key -> (ts, HBM bytes) samples in ARRIVAL order (a
+        # bounded count-limited buffer ALSO aged by the time window
+        # below) + core demand.
+        self._hbm: dict[str, list[tuple[float, int]]] = {}
         self._cores: dict[str, int] = {}
+        # Sliding TIME window for percentile reads: samples older than
+        # this never count (but the single freshest sample survives as
+        # the last-known-demand fallback -- see demand()). None = env
+        # default; 0 = all-history.
+        self.window_s = (_default_window_s() if window_s is None
+                         else max(float(window_s), 0.0))
         defaults = (DEFAULT_TENANT_DEMANDS if defaults is None
                     else defaults)
+        now = time.time()
         for key, demand in defaults.items():
-            self._hbm[key] = [demand.hbm_bytes]
+            self._hbm[key] = [(now, demand.hbm_bytes)]
             self._cores[key] = demand.cores
 
-    def observe(self, tenant: str, hbm_bytes: int, cores: int = 1) -> None:
+    def observe(self, tenant: str, hbm_bytes: int, cores: int = 1,
+                now: float | None = None) -> None:
         """Fold one observed demand sample into the tenant's bounded
-        sliding window. Eviction is by ARRIVAL, not by magnitude: a
-        tenant whose working set shrinks must see its percentiles come
-        down once the old large samples age out of the window."""
+        sliding window. Eviction is by ARRIVAL (count bound) and by AGE
+        (``window_s``), not by magnitude: a tenant whose working set
+        shrinks must see its percentiles come down once the old large
+        samples age out of the window. ``now`` is a test seam."""
         if not tenant or hbm_bytes < 0:
             return
+        ts = time.time() if now is None else float(now)
         with self._lock:
             samples = self._hbm.setdefault(tenant, [])
-            samples.append(hbm_bytes)
+            samples.append((ts, hbm_bytes))
             if len(samples) > _MAX_SAMPLES:
                 samples.pop(0)
             self._cores[tenant] = max(self._cores.get(tenant, 1), cores)
+
+    def _windowed(self, samples: list[tuple[float, int]],
+                  now: float) -> list[int]:
+        """Samples inside the time window, falling back to the single
+        freshest sample when everything aged out: a tenant that WAS
+        observed keeps its last known demand (better than falling back
+        to a whole-chip claim), it just stops compounding stale
+        history into the percentile."""
+        if not samples:
+            return []
+        if self.window_s <= 0:
+            return [v for _, v in samples]
+        cutoff = now - self.window_s
+        live = [v for ts, v in samples if ts >= cutoff]
+        return live if live else [samples[-1][1]]
 
     def record(self, tenant: str, hbm_bytes: int, cores: int = 1) -> None:
         """Live-telemetry ingest (the kubelet plugin's health-poll
@@ -88,15 +127,17 @@ class TenantProfileStore:
         is declared/derived demand."""
         self.observe(tenant, hbm_bytes, cores=cores)
 
-    def demand(self, tenant: str, percentile: float = 0.95
-               ) -> PartitionDemand | None:
-        """The demand percentile for one tenant key, or None when the
-        key has never been observed (and has no default)."""
+    def demand(self, tenant: str, percentile: float = 0.95,
+               now: float | None = None) -> PartitionDemand | None:
+        """The demand percentile for one tenant key over the sliding
+        time window, or None when the key has never been observed (and
+        has no default)."""
+        ts = time.time() if now is None else float(now)
         with self._lock:
-            samples = self._hbm.get(tenant)
-            if not samples:
+            windowed = self._windowed(self._hbm.get(tenant, []), ts)
+            if not windowed:
                 return None
-            ordered = sorted(samples)
+            ordered = sorted(windowed)
             idx = min(len(ordered) - 1,
                       max(0, int(percentile * len(ordered) + 0.5) - 1))
             # count stays 1 (one tenant's demand): pack_tenants reads
@@ -110,6 +151,20 @@ class TenantProfileStore:
     def tenants(self) -> list[str]:
         with self._lock:
             return sorted(self._hbm)
+
+    def fresh_tenants(self, now: float | None = None) -> list[str]:
+        """Tenant keys with at least one sample STRICTLY inside the
+        time window (no last-sample fallback): the autoscale planner's
+        retention signal -- a tenant with neither fresh samples nor
+        live claims has genuinely left and its profiles may retire."""
+        ts = time.time() if now is None else float(now)
+        with self._lock:
+            if self.window_s <= 0:
+                return sorted(k for k, s in self._hbm.items() if s)
+            cutoff = ts - self.window_s
+            return sorted(
+                key for key, samples in self._hbm.items()
+                if samples and samples[-1][0] >= cutoff)
 
     # -- static profile file --------------------------------------------------
 
@@ -133,12 +188,35 @@ class TenantProfileStore:
         with self._lock:
             return {
                 "tenants": {
-                    key: {"hbmBytes": max(samples),
+                    key: {"hbmBytes": max(v for _, v in samples),
                           "cores": self._cores.get(key, 1),
                           "samples": len(samples)}
                     for key, samples in self._hbm.items()
+                    if samples
                 }
             }
+
+    def percentiles(self, percentiles: tuple[float, ...] = (0.5, 0.95),
+                    now: float | None = None) -> dict[str, dict]:
+        """Per-tenant demand percentiles over the sliding window (the
+        ``/debug/fleet`` operator surface: what the autoscale planner
+        sees). ``{tenant: {"p50_hbm_bytes": N, "p95_hbm_bytes": N,
+        "cores": M, "samples": K}}``."""
+        ts = time.time() if now is None else float(now)
+        out: dict[str, dict] = {}
+        with self._lock:
+            for key, samples in self._hbm.items():
+                windowed = sorted(self._windowed(samples, ts))
+                if not windowed:
+                    continue
+                entry: dict = {"samples": len(windowed),
+                               "cores": self._cores.get(key, 1)}
+                for pct in percentiles:
+                    idx = min(len(windowed) - 1,
+                              max(0, int(pct * len(windowed) + 0.5) - 1))
+                    entry[f"p{int(pct * 100)}_hbm_bytes"] = windowed[idx]
+                out[key] = entry
+        return out
 
 
 @dataclass(frozen=True)

@@ -769,6 +769,13 @@ class AllocationState:
         with self._alloc_lock:
             return dict(self.node_load)
 
+    def slot_counts(self) -> dict[tuple, int]:
+        """Consistent copy of per-device-key holder counts (shared
+        partition devices count every co-tenant): the fleet
+        aggregator's partition-slot-occupancy read."""
+        with self._alloc_lock:
+            return dict(self._counts)
+
     def _release_locked(self, keys: frozenset) -> None:
         for key in keys:
             count = self._counts.get(key, 0) - 1
@@ -913,6 +920,10 @@ WATCHED_RESOURCES: tuple[tuple[str, str, str, str], ...] = (
     ("resource.k8s.io", "v1", "resourceclaimtemplates",
      "ResourceClaimTemplate"),
     (CD_GROUP, CD_VERSION, "computedomains", "ComputeDomain"),
+    # The serving autoscaler's desired-layout CRD (pkg/autoscale):
+    # cluster-scoped, watched so re-plans reach the controller's
+    # confirm stage (and pending tenants their retry) without polling.
+    (CD_GROUP, CD_VERSION, "partitionsets", "PartitionSet"),
 )
 
 
@@ -1158,6 +1169,9 @@ class ClusterView:
 
     def device_classes(self) -> list[dict]:
         return self._list(*RESOURCE, "deviceclasses")
+
+    def partition_sets(self) -> list[dict]:
+        return self._list(CD_GROUP, CD_VERSION, "partitionsets")
 
     def get_pod(self, name: str, namespace: str = "default") -> dict:
         inf = self._informers.get("pods")

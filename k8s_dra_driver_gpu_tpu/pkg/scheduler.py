@@ -110,7 +110,7 @@ DEFAULT_SCHED_BATCH = 8
 # Dirty-key kinds handled by the dedicated control worker (shard 0).
 _CTL_KINDS = frozenset((
     "full", "pending", "inventory", "daemonsets", "jobs", "recovery",
-    "defrag", "pods-rescan",
+    "defrag", "autoscale", "pods-rescan",
 ))
 
 
@@ -275,6 +275,12 @@ class DraScheduler:
         # cells and move targets, and its placement hints steer the
         # re-placement of moving claims.
         self.defrag = None
+        # Serving autoscaler (pkg/autoscale.AutoscaleController):
+        # rides the same loop (full passes + PartitionSet CRD events)
+        # and re-plans the fleet's partition layout from live tenant
+        # demand; its rollouts land as CRD writes the node plugins'
+        # watchers converge on.
+        self.autoscaler = None
         # Claim-lifecycle flight recorder (pkg/flightrecorder): every
         # dirty-key enqueue / fit outcome / commit conflict / patch
         # lands in the bounded ring served at /debug/claims.
@@ -328,6 +334,21 @@ class DraScheduler:
             # The trigger signal reads THIS scheduler's fleet rings.
             controller.fleet = self.fleet
         self.defrag = controller
+        return self
+
+    def attach_autoscaler(self, controller) -> "DraScheduler":
+        """Drive a pkg/autoscale.AutoscaleController from this
+        scheduler's loop: its sync runs inside every full pass (after
+        the fleet fold, so the pending-demand ring it consults is
+        fresh) and on PartitionSet CRD dirty keys; its reads come from
+        this scheduler's informer-backed view; its TenantProfileStore
+        percentiles surface at /debug/fleet next to the rings."""
+        controller.view = self.view
+        if controller.fleet is None:
+            controller.fleet = self.fleet
+        if self.fleet is not None:
+            self.fleet.attach_profile_store(controller.store)
+        self.autoscaler = controller
         return self
 
     # -- sharding plumbing ----------------------------------------------------
@@ -2043,8 +2064,10 @@ class DraScheduler:
         self._observe_fleet()
         if self._cluster_controllers:
             # After the fleet fold: the defrag trigger reads the frag
-            # rings THIS pass just refreshed.
+            # rings THIS pass just refreshed, and the autoscaler the
+            # pending-demand ring.
             self._sync_defrag()
+            self._sync_autoscale()
         if self.sched_metrics is not None:
             self.sched_metrics.sync_seconds.labels("full").observe(
                 time.monotonic() - t0)
@@ -2095,6 +2118,17 @@ class DraScheduler:
             self.defrag.sync_once()
         except Exception:  # noqa: BLE001 - control loop
             logger.exception("defrag sync failed")
+
+    def _sync_autoscale(self) -> None:
+        """One autoscale-controller pass. InjectedCrash (a
+        BaseException) sails through on purpose -- the crash-resume
+        suite's controller-death scenarios depend on it."""
+        if self.autoscaler is None:
+            return
+        try:
+            self.autoscaler.sync_once()
+        except Exception:  # noqa: BLE001 - control loop
+            logger.exception("autoscale sync failed")
 
     # -- event-driven incremental sync ----------------------------------------
 
@@ -2216,6 +2250,13 @@ class DraScheduler:
             self._enqueue(("pending",))
         elif resource == "computedomains":
             self._enqueue(("pending",))
+        elif resource == "partitionsets":
+            # A layout CRD moved: the autoscaler may have a rollout to
+            # confirm (or an operator edit to defer to), and pending
+            # tenants get their retry once the nodes republish.
+            if self.autoscaler is not None:
+                self._enqueue(("autoscale",))
+            self._enqueue(("pending",))
         elif resource in ("daemonsets", "nodes"):
             self._enqueue(("daemonsets",))
             if resource == "nodes" and self.recovery is not None:
@@ -2269,7 +2310,8 @@ class DraScheduler:
         t0 = time.monotonic()
         kind = key[0]
         try:
-            if kind in ("daemonsets", "jobs", "recovery", "defrag") and \
+            if kind in ("daemonsets", "jobs", "recovery", "defrag",
+                        "autoscale") and \
                     not self._cluster_controllers:
                 return  # another domain owns the cluster controllers
             if kind == "full":
@@ -2305,6 +2347,8 @@ class DraScheduler:
                 # A defrag pass deallocates moving claims; re-place
                 # them (onto their hinted targets) immediately.
                 self._retry_pending_claims()
+            elif kind == "autoscale":
+                self._sync_autoscale()
             elif kind == "pods-rescan":
                 for pod in self._pods():
                     refs = pod.get("spec", {}).get("resourceClaims") or []
@@ -2613,6 +2657,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="state root for the active-defragmentation "
                         "controller's durable move records; empty = "
                         "defrag disabled [TPU_DRA_DEFRAG_ROOT]")
+    p.add_argument("--autoscale-root",
+                   default=os.environ.get("TPU_DRA_AUTOSCALE_ROOT", ""),
+                   help="state root for the serving autoscaler's "
+                        "durable re-plan records (the demand-driven "
+                        "PartitionSet controller, pkg/autoscale); "
+                        "empty = autoscaler disabled "
+                        "[TPU_DRA_AUTOSCALE_ROOT]")
     args = p.parse_args(argv)
     from . import logsetup  # noqa: PLC0415
 
@@ -2681,6 +2732,15 @@ def main(argv: list[str] | None = None) -> int:
                           if metrics is not None else None)
         sched.attach_defrag(DefragController(
             sched.kube, args.defrag_root, metrics=defrag_metrics))
+    if args.autoscale_root:
+        from .autoscale import AutoscaleController  # noqa: PLC0415
+        from .metrics import AutoscaleMetrics  # noqa: PLC0415
+
+        autoscale_metrics = (AutoscaleMetrics(registry=metrics.registry)
+                             if metrics is not None else None)
+        sched.attach_autoscaler(AutoscaleController(
+            sched.kube, args.autoscale_root,
+            metrics=autoscale_metrics))
     print("scheduler running", flush=True)
     try:
         if args.sched_mode == "events" and args.leader_elect:

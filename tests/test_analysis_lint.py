@@ -886,6 +886,93 @@ class TestTelemetryMutationRule:
             lint_source(src, rel="pkg/scheduler.py"))
 
 
+class TestPartitionSpecRule:
+    """TPUDRA014: PartitionSet/PartitionProfile construction and
+    partitionsets CRD writes are fenced to pkg/autoscale/ +
+    pkg/partition/spec.py (rel-path sanctioned like TPUDRA011/013)."""
+
+    def test_spec_construction_outside_flagged(self):
+        src = ("from ..pkg.partition import PartitionSet\n"
+               "def bad():\n"
+               "    return PartitionSet(profiles=())\n")
+        findings = lint_source(src, rel="kubeletplugin/driver.py")
+        assert "TPUDRA014" in rules_of(findings)
+
+    def test_profile_construction_outside_flagged(self):
+        src = ("from ..partition.spec import PartitionProfile\n"
+               "def bad():\n"
+               "    return PartitionProfile(name='x', subslice='1x1')\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA014" in rules_of(findings)
+
+    def test_attribute_form_flagged(self):
+        src = ("from ..pkg.partition import spec\n"
+               "def bad():\n"
+               "    return spec.PartitionSet(profiles=())\n")
+        findings = lint_source(src, rel="kubeletplugin/main.py")
+        assert "TPUDRA014" in rules_of(findings)
+
+    def test_parse_classmethods_stay_open(self):
+        src = ("from ..pkg.partition import PartitionSet\n"
+               "def good(path):\n"
+               "    a = PartitionSet.from_file(path)\n"
+               "    b = PartitionSet.from_dict({})\n"
+               "    return a, b\n")
+        assert "TPUDRA014" not in rules_of(
+            lint_source(src, rel="kubeletplugin/main.py"))
+
+    def test_autoscale_package_sanctioned(self):
+        src = ("from ..partition.spec import PartitionProfile,"
+               " PartitionSet\n"
+               "def plan():\n"
+               "    p = PartitionProfile(name='t-s8', subslice='1x1',\n"
+               "                         max_tenants=8)\n"
+               "    return PartitionSet(profiles=(p,))\n")
+        assert "TPUDRA014" not in rules_of(
+            lint_source(src, rel="pkg/autoscale/planner.py"))
+
+    def test_spec_definition_site_sanctioned(self):
+        src = ("def from_dict(cls, d):\n"
+               "    return PartitionSet(profiles=())\n")
+        assert "TPUDRA014" not in rules_of(
+            lint_source(src, rel="pkg/partition/spec.py"))
+
+    def test_stray_same_named_file_not_sanctioned(self):
+        src = ("def bad():\n"
+               "    return PartitionSet(profiles=())\n")
+        findings = lint_source(src, rel="computedomain/plugin/spec.py")
+        assert "TPUDRA014" in rules_of(findings)
+
+    def test_crd_write_outside_flagged(self):
+        src = ("def bad(kube, obj):\n"
+               "    kube.create('resource.tpu.dra', 'v1beta1',\n"
+               "                'partitionsets', obj)\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA014" in rules_of(findings)
+
+    def test_crd_patch_outside_flagged(self):
+        src = ("def bad(kube, name, patch):\n"
+               "    kube.patch('resource.tpu.dra', 'v1beta1',\n"
+               "               'partitionsets', name, patch)\n")
+        findings = lint_source(src, rel="kubeletplugin/driver.py")
+        assert "TPUDRA014" in rules_of(findings)
+
+    def test_crd_write_in_controller_sanctioned(self):
+        src = ("def apply(self, spec):\n"
+               "    self.kube.patch('resource.tpu.dra', 'v1beta1',\n"
+               "                    'partitionsets', self.crd_name,\n"
+               "                    {'spec': spec})\n")
+        assert "TPUDRA014" not in rules_of(
+            lint_source(src, rel="pkg/autoscale/controller.py"))
+
+    def test_crd_reads_stay_open(self):
+        src = ("def watch(kube):\n"
+               "    return kube.list('resource.tpu.dra', 'v1beta1',\n"
+               "                     'partitionsets')\n")
+        assert "TPUDRA014" not in rules_of(
+            lint_source(src, rel="kubeletplugin/driver.py"))
+
+
 class TestWholePackageGate:
     """The tier-1 CI gate from ISSUE 3: zero non-baselined findings
     over the shipped package, with the committed baseline EMPTY (every

@@ -111,6 +111,38 @@ class TestTransitionPolicy:
         declared policy there."""
         assert POLICIES["defrag"] is DEFRAG_POLICY
 
+    @pytest.mark.parametrize("old,new", [
+        (None, "AutoscalePlanned"),
+        ("AutoscalePlanned", "AutoscaleApplying"),
+        ("AutoscalePlanned", None),     # superseded pre-write
+        ("AutoscaleApplying", None),    # confirmed / superseded
+    ])
+    def test_autoscale_ladder_legal(self, old, new):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+            AUTOSCALE_POLICY,
+        )
+
+        AUTOSCALE_POLICY.validate("u", old, new)  # no raise
+
+    @pytest.mark.parametrize("old,new", [
+        (None, "AutoscaleApplying"),    # CRD write without intent
+        ("AutoscaleApplying", "AutoscalePlanned"),  # backwards
+    ])
+    def test_autoscale_stage_skips_illegal(self, old, new):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+            AUTOSCALE_POLICY,
+        )
+
+        with pytest.raises(CheckpointTransitionError):
+            AUTOSCALE_POLICY.validate("u", old, new)
+
+    def test_autoscale_policy_registered(self):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+            AUTOSCALE_POLICY,
+        )
+
+        assert POLICIES["autoscale"] is AUTOSCALE_POLICY
+
 
 class TestRuntimeValidatorInCheckpointManager:
     def test_legal_lifecycle_commits(self, tmp_root):

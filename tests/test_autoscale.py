@@ -1,0 +1,821 @@
+"""Serving autoscaler (pkg/autoscale): CRD helpers, the MISO/ParvaGPU
+planner with its hysteresis band and CEL priority rules, the
+leader-elected re-planning controller (durable ``autoscale``
+TransitionPolicy records, crash-at-every-fault-point resume, zero
+steady-state kube writes), the TenantProfileStore sliding time window,
+and the CRD -> node propagation seam (live Driver + restarted Driver
+converge to the same carve-out set; a malformed CRD fails closed)."""
+
+from __future__ import annotations
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin import DRIVER_NAME
+from k8s_dra_driver_gpu_tpu.kubeletplugin.deviceinfo import (
+    AllocatableDevice,
+    ChipInfo,
+    DeviceKind,
+)
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import Config
+from k8s_dra_driver_gpu_tpu.kubeletplugin.driver import Driver
+from k8s_dra_driver_gpu_tpu.kubeletplugin.partitions import (
+    consumed_counters,
+    shared_counter_sets,
+)
+from k8s_dra_driver_gpu_tpu.pkg import faults
+from k8s_dra_driver_gpu_tpu.pkg.autoscale import (
+    AutoscaleController,
+    AutoscalePlanner,
+    PriorityRule,
+    crd_object,
+    fingerprint,
+    partition_set_from_crd,
+    pool_chip_caps,
+    select_for_pool,
+)
+from k8s_dra_driver_gpu_tpu.pkg.autoscale import crd as crdmod
+from k8s_dra_driver_gpu_tpu.pkg.autoscale.planner import (
+    TENANT_DEMAND_HBM_ANNOTATION,
+)
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.metrics import AutoscaleMetrics
+from k8s_dra_driver_gpu_tpu.pkg.partition import (
+    TENANT_PROFILE_ANNOTATION,
+    PartitionSet,
+    PartitionSpecError,
+    SizingPolicy,
+    TenantProfileStore,
+)
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+from k8s_dra_driver_gpu_tpu.tpulib.binding import (
+    EnumerateOptions,
+    PyTpuLib,
+)
+from tests.fake_kube import CountingKube
+
+RES = ("resource.k8s.io", "v1")
+CRD = ("resource.tpu.dra", "v1beta1", "partitionsets")
+GIB = 1 << 30
+GATES = ("DynamicSubSlice=true,TimeSlicingSettings=true,"
+         "MultiTenancySupport=true,TenantPartitioning=true")
+
+_LIB = PyTpuLib()
+_OPTS = EnumerateOptions(mock_topology="v5e-4")
+HOST = _LIB.enumerate(_OPTS)
+CHIP_HBM = HOST.hbm_bytes_per_chip
+
+
+def publish_chip_fleet(fake, nodes: int = 1) -> None:
+    """Publish plain whole-chip slices (the counter source the planner
+    budgets against)."""
+    for i in range(nodes):
+        devs = []
+        for chip in HOST.chips:
+            dev = AllocatableDevice(
+                kind=DeviceKind.CHIP, chip=ChipInfo(chip=chip, host=HOST))
+            entry = dev.to_dra_device()
+            entry["consumesCounters"] = consumed_counters(dev, HOST)
+            devs.append(entry)
+        fake.create(*RES, "resourceslices", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+            "metadata": {"name": f"node-{i}-{DRIVER_NAME}"},
+            "spec": {
+                "driver": DRIVER_NAME, "nodeName": f"node-{i}",
+                "pool": {"name": f"node-{i}", "generation": 1,
+                         "resourceSliceCount": 1},
+                "sharedCounters": shared_counter_sets(HOST),
+                "devices": devs,
+            },
+        })
+
+
+def make_controller(kube, root, **kw) -> AutoscaleController:
+    kw.setdefault("sustain_s", 0.0)
+    kw.setdefault("cooldown_s", 0.0)
+    return AutoscaleController(kube, root, **kw)
+
+
+def run_to_convergence(ctrl, passes: int = 6) -> dict:
+    last = {}
+    for _ in range(passes):
+        last = ctrl.sync_once()
+        if not ctrl.busy() and (last["converged"] or last["deferred"]):
+            break
+    return last
+
+
+def tenant_claim(fake, name: str, tenant: str, hbm: int,
+                 allocated: bool = False) -> None:
+    obj = {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default",
+                     "annotations": {
+                         TENANT_PROFILE_ANNOTATION: tenant,
+                         TENANT_DEMAND_HBM_ANNOTATION: str(hbm),
+                     }},
+        "spec": {"devices": {"requests": [{"name": "t"}]}},
+    }
+    if allocated:
+        obj["status"] = {"allocation": {"devices": {"results": []}}}
+    fake.create(*RES, "resourceclaims", obj, namespace="default")
+
+
+# -- CRD helpers --------------------------------------------------------------
+
+
+class TestCrd:
+    def test_round_trip(self):
+        ps = PartitionSet.from_dict({"profiles": [
+            {"name": "web-s8", "subslice": "1x1", "maxTenants": 8}],
+            "pools": ["node-*"]})
+        obj = crd_object("tpu-dra-autoscale", ps,
+                         priority_rules=(PriorityRule(
+                             "tenant.key == 'interactive'", 100),))
+        parsed, rules = partition_set_from_crd(obj)
+        assert parsed == ps
+        assert rules[0].priority == 100
+        assert crdmod.is_managed(obj)
+        assert crdmod.revision_of(obj) == 1
+
+    def test_malformed_spec_raises(self):
+        with pytest.raises(PartitionSpecError):
+            partition_set_from_crd({"metadata": {"name": "x"}})
+        with pytest.raises(PartitionSpecError):
+            partition_set_from_crd({"spec": {"profiles": [
+                {"name": "BAD NAME", "subslice": "1x1"}]}})
+
+    def test_malformed_priority_rule_raises(self):
+        with pytest.raises(PartitionSpecError):
+            partition_set_from_crd({"spec": {
+                "profiles": [],
+                "priorityRules": [{"selector": "tenant.key =="}]}})
+        with pytest.raises(PartitionSpecError):
+            partition_set_from_crd({"spec": {
+                "profiles": [], "priorityRules": [{"priority": 3}]}})
+
+    def test_priority_rule_matching(self):
+        rule = PriorityRule("tenant.hbmBytes > 4000000000", 10)
+        assert rule.matches("big", 6 * GIB, 1)
+        assert not rule.matches("small", 1 * GIB, 1)
+        # Eval errors mean "no match", never a crash.
+        assert not PriorityRule("tenant.nope.deeper == 1", 10).matches(
+            "x", 1, 1)
+
+    def test_select_for_pool_orders_by_name(self):
+        ours = crd_object("tpu-dra-autoscale", PartitionSet.from_dict(
+            {"profiles": [{"name": "a-s8", "subslice": "1x1",
+                           "maxTenants": 8}]}))
+        manual = crd_object("00-manual", PartitionSet.from_dict(
+            {"profiles": [{"name": "b-s1", "subslice": "1x1"}]}),
+            managed=False)
+        outcome, payload, obj = select_for_pool([ours, manual], "node-0")
+        assert outcome == "ok"
+        ps, _rules, fp = payload
+        assert ps.profiles[0].name == "b-s1"
+        assert obj["metadata"]["name"] == "00-manual"
+        assert fp == fingerprint(manual["spec"])
+
+    def test_select_respects_pool_globs(self):
+        scoped = crd_object("scoped", PartitionSet.from_dict(
+            {"profiles": [], "pools": ["pool-a*"]}))
+        outcome, _, _ = select_for_pool([scoped], "pool-b7")
+        assert outcome == "none"
+        outcome, _, _ = select_for_pool([scoped], "pool-a3")
+        assert outcome == "ok"
+
+    def test_select_malformed_winner_fails_closed(self):
+        # The WINNING object being malformed is reported -- never
+        # silently skipped in favor of a lower-ranked one.
+        bad = {"apiVersion": "resource.tpu.dra/v1beta1",
+               "kind": "PartitionSet",
+               "metadata": {"name": "00-bad"},
+               "spec": {"profiles": [{"name": "BAD NAME",
+                                      "subslice": "1x1"}]}}
+        good = crd_object("zz-good", PartitionSet.from_dict(
+            {"profiles": []}))
+        outcome, err, obj = select_for_pool([good, bad], "node-0")
+        assert outcome == "malformed"
+        assert "BAD NAME" in err
+        assert obj["metadata"]["name"] == "00-bad"
+
+
+# -- TenantProfileStore sliding window (satellite) ----------------------------
+
+
+class TestProfileWindow:
+    def test_burst_then_decay_shrinks_sized_profile(self):
+        """The regression the satellite names: a demand burst followed
+        by decay must shrink the sized profile once the burst's
+        samples age out of the TPU_DRA_PROFILE_WINDOW_S window."""
+        store = TenantProfileStore(defaults={}, window_s=60.0)
+        for _ in range(50):  # the burst: 12 GiB working sets at t=0
+            store.observe("web", 12 * GIB, now=1000.0)
+        planner = AutoscalePlanner()
+        cat = planner._catalog("web", CHIP_HBM, HOST.cores_per_chip,
+                               (1, 2, 4, 8))
+        big = SizingPolicy().pick(
+            store.demand("web", now=1010.0), cat)
+        assert big.profile.max_tenants == 1  # 12Gi of a 16Gi chip
+        for _ in range(20):  # decay: small working sets at t+100
+            store.observe("web", int(1.5 * GIB), now=1100.0)
+        small = SizingPolicy().pick(
+            store.demand("web", now=1105.0), cat)
+        assert small.profile.max_tenants == 8  # 2Gi budget covers 1.5Gi
+
+    def test_all_aged_out_falls_back_to_last_sample(self):
+        store = TenantProfileStore(defaults={}, window_s=10.0)
+        store.observe("web", 3 * GIB, now=0.0)
+        d = store.demand("web", now=1000.0)
+        assert d is not None and d.hbm_bytes == 3 * GIB
+
+    def test_window_zero_is_all_history(self):
+        store = TenantProfileStore(defaults={}, window_s=0.0)
+        store.observe("web", 8 * GIB, now=0.0)
+        store.observe("web", 1 * GIB, now=1e9)
+        d = store.demand("web", percentile=0.99, now=2e9)
+        assert d.hbm_bytes == 8 * GIB
+
+    def test_fresh_tenants_excludes_aged_keys(self):
+        store = TenantProfileStore(defaults={}, window_s=60.0)
+        store.observe("old", GIB, now=0.0)
+        store.observe("new", GIB, now=1000.0)
+        assert store.fresh_tenants(now=1010.0) == ["new"]
+
+    def test_percentiles_surface(self):
+        store = TenantProfileStore(defaults={}, window_s=0.0)
+        for i in range(100):
+            store.observe("web", i * GIB)
+        pct = store.percentiles()
+        assert pct["web"]["p50_hbm_bytes"] == 49 * GIB
+        assert pct["web"]["p95_hbm_bytes"] == 94 * GIB
+
+
+# -- planner ------------------------------------------------------------------
+
+
+class TestPlanner:
+    def _store(self, tenant="web", hbm=int(1.5 * GIB), n=40):
+        store = TenantProfileStore(defaults={})
+        for _ in range(n):
+            store.observe(tenant, hbm)
+        return store
+
+    def test_sizes_smallest_satisfying(self):
+        plan = AutoscalePlanner().plan(
+            self._store(), PartitionSet.from_dict({}),
+            chip_hbm=CHIP_HBM, cores_per_chip=HOST.cores_per_chip)
+        assert plan.changed
+        names = [p.name for p in plan.desired.profiles]
+        assert names == ["web-s8"]
+
+    def test_no_counters_keeps_active_verbatim(self):
+        active = PartitionSet.from_dict({"profiles": [
+            {"name": "web-s8", "subslice": "1x1", "maxTenants": 8}]})
+        plan = AutoscalePlanner().plan(self._store(), active,
+                                       chip_hbm=0)
+        assert not plan.changed and plan.desired == active
+
+    def test_upsize_is_urgent(self):
+        active = PartitionSet.from_dict({"profiles": [
+            {"name": "web-s8", "subslice": "1x1", "maxTenants": 8}]})
+        plan = AutoscalePlanner().plan(
+            self._store(hbm=3 * GIB), active,
+            chip_hbm=CHIP_HBM, cores_per_chip=HOST.cores_per_chip)
+        assert plan.changed and plan.urgent
+        assert [p.name for p in plan.desired.profiles] == ["web-s4"]
+        assert plan.decisions["web"]["action"] == "upsize"
+
+    def test_hysteresis_band_blocks_boundary_repack(self):
+        # Active s4 (4Gi budget); demand 1.9Gi. s8's 2Gi budget would
+        # fit, but only with 5% headroom -- inside the 10% band, so
+        # the layout must NOT flap.
+        active = PartitionSet.from_dict({"profiles": [
+            {"name": "web-s4", "subslice": "1x1", "maxTenants": 4}]})
+        plan = AutoscalePlanner(band=0.1).plan(
+            self._store(hbm=int(1.9 * GIB)), active,
+            chip_hbm=CHIP_HBM, cores_per_chip=HOST.cores_per_chip)
+        assert not plan.changed
+        assert plan.decisions["web"]["action"] == "keep"
+
+    def test_clear_headroom_repacks_non_urgent(self):
+        active = PartitionSet.from_dict({"profiles": [
+            {"name": "web-s4", "subslice": "1x1", "maxTenants": 4}]})
+        plan = AutoscalePlanner(band=0.1).plan(
+            self._store(hbm=int(1.2 * GIB)), active,
+            chip_hbm=CHIP_HBM, cores_per_chip=HOST.cores_per_chip)
+        assert plan.changed and not plan.urgent
+        assert [p.name for p in plan.desired.profiles] == ["web-s8"]
+        assert plan.decisions["web"]["action"] == "repack"
+
+    def test_cel_priority_packs_away_from_oversubscription(self):
+        rules = (PriorityRule("tenant.key == 'interactive'", 100),)
+        store = self._store(tenant="interactive")
+        plan = AutoscalePlanner().plan(
+            store, PartitionSet.from_dict({}), rules=rules,
+            chip_hbm=CHIP_HBM, cores_per_chip=HOST.cores_per_chip)
+        # 1.5Gi demand would pack 8/chip -- but the priority rule
+        # forces a dedicated (maxTenants == 1) profile.
+        assert [p.name for p in plan.desired.profiles] == \
+            ["interactive-s1"]
+        assert plan.decisions["interactive"]["priority"] == 100
+
+    def test_priority_isolation_off_shared_is_urgent(self):
+        rules = (PriorityRule("tenant.key == 'interactive'", 100),)
+        active = PartitionSet.from_dict({"profiles": [
+            {"name": "interactive-s8", "subslice": "1x1",
+             "maxTenants": 8}]})
+        plan = AutoscalePlanner().plan(
+            self._store(tenant="interactive"), active, rules=rules,
+            chip_hbm=CHIP_HBM, cores_per_chip=HOST.cores_per_chip)
+        assert plan.changed and plan.urgent
+        assert plan.decisions["interactive"]["action"] == "isolate"
+
+    def test_aged_out_tenant_profile_retires(self):
+        store = TenantProfileStore(defaults={}, window_s=60.0)
+        store.observe("gone", GIB, now=0.0)
+        active = PartitionSet.from_dict({"profiles": [
+            {"name": "gone-s8", "subslice": "1x1", "maxTenants": 8}]})
+        plan = AutoscalePlanner().plan(
+            store, active, chip_hbm=CHIP_HBM,
+            cores_per_chip=HOST.cores_per_chip, now=1000.0)
+        assert plan.changed and not plan.urgent
+        assert plan.desired.profiles == ()
+
+    def test_live_tenant_profile_retained_despite_aged_samples(self):
+        store = TenantProfileStore(defaults={}, window_s=60.0)
+        store.observe("web", GIB, now=0.0)
+        active = PartitionSet.from_dict({"profiles": [
+            {"name": "web-s8", "subslice": "1x1", "maxTenants": 8}]})
+        plan = AutoscalePlanner().plan(
+            store, active, chip_hbm=CHIP_HBM,
+            cores_per_chip=HOST.cores_per_chip,
+            live_tenants={"web"}, now=1000.0)
+        # The last-sample fallback keeps the demand alive, sizing
+        # still lands on s8 -> no change.
+        assert not plan.changed
+
+    def test_pool_chip_caps_reads_published_counters(self):
+        fake = FakeKubeClient()
+        publish_chip_fleet(fake)
+        hbm, cores = pool_chip_caps(fake.list(*RES, "resourceslices"))
+        assert hbm == CHIP_HBM
+        assert cores == HOST.cores_per_chip
+
+
+# -- controller ---------------------------------------------------------------
+
+
+class TestController:
+    def _fixture(self, tmp_path, tenants=40, hbm=int(1.5 * GIB)):
+        fake = FakeKubeClient()
+        publish_chip_fleet(fake)
+        counted = CountingKube(fake)
+        ctrl = make_controller(counted, str(tmp_path / "as"))
+        for _ in range(tenants):
+            ctrl.store.observe("web", hbm)
+        return fake, counted, ctrl
+
+    def test_rollout_and_steady_state_zero_writes(self, tmp_path):
+        fake, counted, ctrl = self._fixture(tmp_path)
+        run_to_convergence(ctrl)
+        crds = fake.list(*CRD)
+        assert len(crds) == 1
+        ps, _rules = partition_set_from_crd(crds[0])
+        assert [p.name for p in ps.profiles] == ["web-s8"]
+        assert not ctrl.busy()
+        # Converged passes: ZERO kube writes.
+        w0 = counted.writes
+        for _ in range(3):
+            out = ctrl.sync_once()
+            assert out["converged"] == 1
+        assert counted.writes == w0
+
+    def test_replan_on_demand_shift(self, tmp_path):
+        fake, _counted, ctrl = self._fixture(tmp_path)
+        run_to_convergence(ctrl)
+        for _ in range(200):  # demand grows past the 2Gi s8 budget
+            ctrl.store.observe("web", 6 * GIB)
+        run_to_convergence(ctrl)
+        ps, _ = partition_set_from_crd(fake.list(*CRD)[0])
+        assert [p.name for p in ps.profiles] == ["web-s2"]
+        assert crdmod.revision_of(fake.list(*CRD)[0]) == 2
+
+    def test_sustain_defers_non_urgent_repack(self, tmp_path):
+        fake = FakeKubeClient()
+        publish_chip_fleet(fake)
+        ctrl = make_controller(fake, str(tmp_path / "as"),
+                               sustain_s=3600.0)
+        # Seed an active layout at s4, then demand that would repack
+        # to s8 (non-urgent): the sustain window must defer it.
+        fake.create(*CRD, crd_object(
+            "tpu-dra-autoscale", PartitionSet.from_dict({"profiles": [
+                {"name": "web-s4", "subslice": "1x1",
+                 "maxTenants": 4}]})))
+        for _ in range(40):
+            ctrl.store.observe("web", int(1.2 * GIB))
+        out = ctrl.sync_once()
+        assert out["deferred"] == 1 and out["planned"] == 0
+        assert fake.list(*CRD)[0]["spec"]["profiles"][0]["name"] == \
+            "web-s4"
+
+    def test_urgent_upsize_skips_sustain(self, tmp_path):
+        fake = FakeKubeClient()
+        publish_chip_fleet(fake)
+        ctrl = make_controller(fake, str(tmp_path / "as"),
+                               sustain_s=3600.0)
+        fake.create(*CRD, crd_object(
+            "tpu-dra-autoscale", PartitionSet.from_dict({"profiles": [
+                {"name": "web-s8", "subslice": "1x1",
+                 "maxTenants": 8}]})))
+        for _ in range(40):
+            ctrl.store.observe("web", 3 * GIB)
+        out = ctrl.sync_once()
+        assert out["planned"] == 1
+
+    def test_fleet_pending_ring_skips_sustain(self, tmp_path):
+        """The fleet pending-demand ring input: sustained pending
+        claims while a repack would add slot capacity must fire NOW
+        instead of idling out the sustain window."""
+        from k8s_dra_driver_gpu_tpu.pkg.fleetstate import (
+            FleetAggregator,
+        )
+
+        fake = FakeKubeClient()
+        publish_chip_fleet(fake)
+        fleet = FleetAggregator()
+        empty_snap = type("S", (), {"candidates": []})()
+        for _ in range(3):
+            fleet.observe_pass(empty_snap, None, pending_claims=5)
+        ctrl = make_controller(fake, str(tmp_path / "as"),
+                               sustain_s=3600.0, fleet=fleet)
+        fake.create(*CRD, crd_object(
+            "tpu-dra-autoscale", PartitionSet.from_dict({"profiles": [
+                {"name": "web-s4", "subslice": "1x1",
+                 "maxTenants": 4}]})))
+        # Repack-level demand (non-urgent on its own) + a PENDING
+        # tenant + the fleet ring showing sustained pending.
+        tenant_claim(fake, "c1", "web", int(1.2 * GIB),
+                     allocated=False)
+        for _ in range(40):
+            ctrl.store.observe("web", int(1.2 * GIB))
+        out = ctrl.sync_once()
+        assert out["planned"] == 1
+
+    def test_manual_override_freezes_planning(self, tmp_path):
+        fake, counted, ctrl = self._fixture(tmp_path)
+        run_to_convergence(ctrl)
+        obj = fake.list(*CRD)[0]
+        fake.patch(*CRD, obj["metadata"]["name"], {
+            "metadata": {"annotations": {
+                crdmod.MANAGED_ANNOTATION: "false"}}})
+        for _ in range(200):
+            ctrl.store.observe("web", 6 * GIB)  # would normally replan
+        w0 = counted.writes
+        out = ctrl.sync_once()
+        assert out["deferred"] == 1 and out["planned"] == 0
+        assert counted.writes == w0
+
+    def test_concurrent_operator_edit_supersedes(self, tmp_path):
+        fake, _counted, ctrl = self._fixture(tmp_path)
+        metrics = AutoscaleMetrics()
+        ctrl.metrics = metrics
+        ctrl.sync_once()  # planned + applied (record now Applying)
+        assert ctrl.busy()
+        # Operator takes over mid-rollout: rewrites the spec AND flips
+        # the managed annotation (the manual-override workflow).
+        fake.patch(*CRD, "tpu-dra-autoscale", {
+            "metadata": {"annotations": {
+                crdmod.MANAGED_ANNOTATION: "false"}},
+            "spec": {"profiles": [
+                {"name": "manual-s2", "subslice": "1x1",
+                 "maxTenants": 2}], "pools": []}})
+        out = ctrl.sync_once()
+        assert out["superseded"] == 1
+        assert not ctrl.busy()
+        # Operator content stands and planning is frozen.
+        assert fake.list(*CRD)[0]["spec"]["profiles"][0]["name"] == \
+            "manual-s2"
+        out = ctrl.sync_once()
+        assert out["deferred"] == 1 and out["planned"] == 0
+        assert metrics.superseded._value.get() == 1
+
+    def test_managed_flip_mid_plan_never_stomped(self, tmp_path):
+        """An operator flipping the managed annotation off while a
+        Planned record is in flight wins: the apply stage retires the
+        rollout as superseded instead of merge-patching the
+        annotation back to \"true\" (which would silently erase the
+        override)."""
+        fake, counted, ctrl = self._fixture(tmp_path)
+        run_to_convergence(ctrl)
+        # Arm a second rollout but stop it at Planned: fail the apply
+        # stage's fresh read once so the record stays Planned.
+        for _ in range(200):
+            ctrl.store.observe("web", 6 * GIB)
+        faults.arm("autoscale.apply", mode="error", count=1)
+        try:
+            try:
+                ctrl.sync_once()
+            except Exception:  # noqa: BLE001 - injected
+                pass
+        finally:
+            faults.reset()
+        assert ctrl.busy()  # Planned record in flight
+        # Operator takes manual control BEFORE the write lands.
+        fake.patch(*CRD, "tpu-dra-autoscale", {
+            "metadata": {"annotations": {
+                crdmod.MANAGED_ANNOTATION: "false"}}})
+        spec_before = fake.list(*CRD)[0]["spec"]
+        out = ctrl.sync_once()
+        assert out["superseded"] == 1 and out["applied"] == 0
+        assert not ctrl.busy()
+        live = fake.list(*CRD)[0]
+        # Neither the annotation nor the spec was stomped.
+        assert not crdmod.is_managed(live)
+        assert live["spec"] == spec_before
+
+    def test_malformed_managed_crd_defers(self, tmp_path):
+        fake, counted, ctrl = self._fixture(tmp_path)
+        run_to_convergence(ctrl)
+        fake.patch(*CRD, "tpu-dra-autoscale", {"spec": {"profiles": [
+            {"name": "BAD NAME", "subslice": "1x1"}]}})
+        w0 = counted.writes
+        out = ctrl.sync_once()
+        assert out["deferred"] == 1
+        assert counted.writes == w0
+
+    def test_claim_annotations_feed_store_and_age_out(self, tmp_path):
+        fake = FakeKubeClient()
+        publish_chip_fleet(fake)
+        ctrl = make_controller(fake, str(tmp_path / "as"))
+        ctrl.store.window_s = 60.0
+        tenant_claim(fake, "c1", "api", 5 * GIB, allocated=True)
+        ctrl.sync_once()
+        d = ctrl.store.demand("api")
+        assert d is not None and d.hbm_bytes == 5 * GIB
+
+    def test_pending_tenant_is_urgent(self, tmp_path):
+        fake = FakeKubeClient()
+        publish_chip_fleet(fake)
+        ctrl = make_controller(fake, str(tmp_path / "as"),
+                               sustain_s=3600.0)
+        tenant_claim(fake, "c1", "api", 2 * GIB, allocated=False)
+        out = ctrl.sync_once()
+        assert out["planned"] == 1  # new pending tenant fires NOW
+
+    @pytest.mark.parametrize("fault", [
+        "autoscale.sync", "autoscale.plan", "autoscale.apply",
+        "autoscale.confirm"])
+    def test_crash_at_every_fault_point_resumes_to_same_plan(
+            self, tmp_path, fault):
+        """A controller crash at ANY fault point resumes idempotently:
+        a fresh controller on the same root converges the CRD to the
+        same content an uncrashed run produces."""
+        # Reference run (no faults).
+        ref_fake = FakeKubeClient()
+        publish_chip_fleet(ref_fake)
+        ref = make_controller(ref_fake, str(tmp_path / "ref"))
+        for _ in range(40):
+            ref.store.observe("web", int(1.5 * GIB))
+        run_to_convergence(ref)
+        ref_fp = fingerprint(ref_fake.list(*CRD)[0]["spec"])
+
+        fake = FakeKubeClient()
+        publish_chip_fleet(fake)
+        root = str(tmp_path / "crash")
+        ctrl = make_controller(fake, root)
+        for _ in range(40):
+            ctrl.store.observe("web", int(1.5 * GIB))
+        faults.arm(fault, mode="error", count=1)
+        try:
+            crashed = False
+            for _ in range(6):
+                try:
+                    ctrl.sync_once()
+                except Exception:  # noqa: BLE001 - injected
+                    crashed = True
+                    break
+            assert crashed, f"{fault} never fired"
+        finally:
+            faults.reset()
+        # The controller "process" died; a fresh one on the same root
+        # resumes from the durable records.
+        resumed = make_controller(fake, root)
+        for _ in range(40):
+            resumed.store.observe("web", int(1.5 * GIB))
+        run_to_convergence(resumed)
+        assert not resumed.busy()
+        crds = fake.list(*CRD)
+        assert len(crds) == 1
+        assert fingerprint(crds[0]["spec"]) == ref_fp
+
+    def test_event_mode_rollout_needs_no_resync(self, tmp_path):
+        """The liveness chain: plan+apply land in one pass, and the
+        CRD write's own partitionsets informer event drives the
+        confirm stage -- a rollout completes without waiting out the
+        safety resync (set to an hour here on purpose)."""
+        fake = FakeKubeClient()
+        publish_chip_fleet(fake)
+        sched = DraScheduler(fake, workers=1, resync_period=3600.0)
+        ctrl = make_controller(fake, str(tmp_path / "as"))
+        sched.attach_autoscaler(ctrl)
+        for _ in range(40):
+            ctrl.store.observe("web", int(1.5 * GIB))
+        sched.start_event_driven()
+        try:
+            import time as _time
+
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline:
+                assert sched.drain(10)
+                if fake.list(*CRD) and not ctrl.busy():
+                    break
+                _time.sleep(0.02)
+            assert not ctrl.busy(), "rollout stalled waiting on resync"
+            ps, _ = partition_set_from_crd(fake.list(*CRD)[0])
+            assert [p.name for p in ps.profiles] == ["web-s8"]
+        finally:
+            sched.stop()
+
+    def test_rides_scheduler_loop(self, tmp_path):
+        fake = FakeKubeClient()
+        publish_chip_fleet(fake)
+        sched = DraScheduler(fake)
+        ctrl = make_controller(fake, str(tmp_path / "as"))
+        sched.attach_autoscaler(ctrl)
+        for _ in range(40):
+            ctrl.store.observe("web", int(1.5 * GIB))
+        for _ in range(3):
+            sched.sync_once()
+        ps, _ = partition_set_from_crd(fake.list(*CRD)[0])
+        assert [p.name for p in ps.profiles] == ["web-s8"]
+        # The fleet snapshot surfaces what the planner saw.
+        snap = sched.fleet.snapshot()
+        assert "web" in snap["tenant_demand"]
+        assert snap["pending_history"], "pending ring must be fed"
+
+
+# -- CRD -> node propagation seam (satellite) ---------------------------------
+
+
+def _node_config(root: str) -> Config:
+    cfg = Config.mock(root=root, gates=GATES,
+                      partition_set=PartitionSet.from_dict({}))
+    cfg.pool_name = "node-0"
+    return cfg
+
+
+def _pt_devices(driver: Driver) -> list[str]:
+    return sorted(n for n, d in driver.state.allocatable.items()
+                  if d.kind == DeviceKind.PARTITION)
+
+
+class TestNodeSeam:
+    def _crd(self, slots=8, name="tpu-dra-autoscale", revision=1):
+        return crd_object(name, PartitionSet.from_dict({"profiles": [
+            {"name": f"web-s{slots}", "subslice": "1x1",
+             "maxTenants": slots}]}), revision=revision)
+
+    def test_live_driver_converges_on_crd_update(self, tmp_path):
+        fake = FakeKubeClient()
+        drv = Driver(_node_config(str(tmp_path / "n0")), fake, "node-0",
+                     enable_health_monitor=False)
+        drv.start()
+        try:
+            assert _pt_devices(drv) == []
+            fake.create(*CRD, self._crd(slots=8))
+            assert _pt_devices(drv) == [
+                f"pt-web-s8-{k}" for k in range(len(HOST.chips))]
+            # Published through the diff: the partition devices are on
+            # the apiserver too.
+            slices = fake.list(*RES, "resourceslices")
+            names = {d["name"] for s in slices
+                     for d in s["spec"]["devices"]}
+            assert "pt-web-s8-0" in names
+            # Re-plan via CRD update converges live.
+            fake.update(*CRD, "tpu-dra-autoscale",
+                        self._crd(slots=4, revision=2))
+            assert _pt_devices(drv) == [
+                f"pt-web-s4-{k}" for k in range(len(HOST.chips))]
+        finally:
+            drv.stop()
+
+    def test_restarted_driver_converges_to_same_set(self, tmp_path):
+        fake = FakeKubeClient()
+        fake.create(*CRD, self._crd(slots=8))
+        root = str(tmp_path / "n0")
+        drv = Driver(_node_config(root), fake, "node-0",
+                     enable_health_monitor=False)
+        drv.start()
+        live_set = _pt_devices(drv)
+        live_slices = {s["metadata"]["name"]:
+                       sorted(d["name"] for d in s["spec"]["devices"])
+                       for s in fake.list(*RES, "resourceslices")}
+        drv.stop()
+        assert live_set, "live driver saw no partition devices"
+        # Fresh process, same root: the watcher's initial reconcile
+        # must converge to the SAME carve-out set.
+        drv2 = Driver(_node_config(root), fake, "node-0",
+                      enable_health_monitor=False)
+        drv2.start()
+        try:
+            assert _pt_devices(drv2) == live_set
+            slices2 = {s["metadata"]["name"]:
+                       sorted(d["name"] for d in s["spec"]["devices"])
+                       for s in fake.list(*RES, "resourceslices")}
+            assert slices2 == live_slices
+        finally:
+            drv2.stop()
+
+    def test_malformed_crd_keeps_last_good_plan(self, tmp_path):
+        fake = FakeKubeClient()
+        fake.create(*CRD, self._crd(slots=8))
+        drv = Driver(_node_config(str(tmp_path / "n0")), fake, "node-0",
+                     enable_health_monitor=False)
+        drv.start()
+        try:
+            good = _pt_devices(drv)
+            assert good
+            fake.update(*CRD, "tpu-dra-autoscale", {
+                "apiVersion": "resource.tpu.dra/v1beta1",
+                "kind": "PartitionSet",
+                "metadata": {"name": "tpu-dra-autoscale"},
+                "spec": {"profiles": [{"name": "BAD NAME",
+                                       "subslice": "nope"}]}})
+            assert _pt_devices(drv) == good  # fail closed
+            assert drv.partition_watcher.last_error
+            assert drv.partition_watcher.failed_total >= 1
+            # A later good update recovers.
+            fake.update(*CRD, "tpu-dra-autoscale",
+                        self._crd(slots=4, revision=3))
+            assert _pt_devices(drv) == [
+                f"pt-web-s4-{k}" for k in range(len(HOST.chips))]
+            assert drv.partition_watcher.last_error is None
+        finally:
+            drv.stop()
+
+    def test_malformed_counter_dedupes_and_revert_clears_error(
+            self, tmp_path):
+        """One persistent malformed CRD counts ONCE (not once per
+        event/resync), and reverting it to the already-applied content
+        clears last_error on the converged no-op path."""
+        fake = FakeKubeClient()
+        good = self._crd(slots=8)
+        fake.create(*CRD, good)
+        drv = Driver(_node_config(str(tmp_path / "n0")), fake, "node-0",
+                     enable_health_monitor=False)
+        drv.start()
+        try:
+            watcher = drv.partition_watcher
+            fake.update(*CRD, "tpu-dra-autoscale", {
+                "apiVersion": "resource.tpu.dra/v1beta1",
+                "kind": "PartitionSet",
+                "metadata": {"name": "tpu-dra-autoscale"},
+                "spec": {"profiles": [{"name": "BAD NAME",
+                                       "subslice": "nope"}]}})
+            assert watcher.failed_total == 1
+            for _ in range(3):  # resync-like re-reconciles
+                watcher.reconcile()
+            assert watcher.failed_total == 1  # deduped on error text
+            # Operator reverts to the content already applied: the
+            # converged no-op must clear the stale error.
+            fake.update(*CRD, "tpu-dra-autoscale", good)
+            assert watcher.last_error is None
+            assert _pt_devices(drv) == [
+                f"pt-web-s8-{k}" for k in range(len(HOST.chips))]
+        finally:
+            drv.stop()
+
+    def test_crd_delete_reverts_to_bootstrap(self, tmp_path):
+        fake = FakeKubeClient()
+        bootstrap = PartitionSet.from_dict({"profiles": [
+            {"name": "boot-s2", "subslice": "1x1", "maxTenants": 2}]})
+        cfg = Config.mock(root=str(tmp_path / "n0"), gates=GATES,
+                          partition_set=bootstrap)
+        cfg.pool_name = "node-0"
+        drv = Driver(cfg, fake, "node-0", enable_health_monitor=False)
+        drv.start()
+        try:
+            assert _pt_devices(drv) == [
+                f"pt-boot-s2-{k}" for k in range(len(HOST.chips))]
+            fake.create(*CRD, self._crd(slots=8))
+            assert _pt_devices(drv) == [
+                f"pt-web-s8-{k}" for k in range(len(HOST.chips))]
+            fake.delete(*CRD, "tpu-dra-autoscale")
+            assert _pt_devices(drv) == [
+                f"pt-boot-s2-{k}" for k in range(len(HOST.chips))]
+        finally:
+            drv.stop()
+
+    def test_watch_opt_out_restores_file_only_behavior(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_PARTITION_WATCH", "0")
+        fake = FakeKubeClient()
+        fake.create(*CRD, self._crd(slots=8))
+        drv = Driver(_node_config(str(tmp_path / "n0")), fake, "node-0",
+                     enable_health_monitor=False)
+        drv.start()
+        try:
+            assert drv.partition_watcher is None
+            assert _pt_devices(drv) == []
+        finally:
+            drv.stop()

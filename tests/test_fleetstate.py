@@ -172,6 +172,69 @@ class TestFleetAggregator:
         assert "tpu_dra_fleet_fold_seconds_count 1.0" in text
 
 
+class TestAutoscalerInputs:
+    """The /debug/fleet satellite: per-pool partition-slot occupancy
+    and tenant-demand percentiles next to the existing rings, so
+    operators see what the autoscale controller sees."""
+
+    def _partition_slice(self):
+        s = make_slice(telemetry=False)
+        for k in range(2):
+            s["spec"]["devices"].append({
+                "name": f"pt-web-s4-{k}",
+                "attributes": {"oversubscribeSlots": {"int": 4}},
+                "capacity": {},
+            })
+        return s
+
+    def test_partition_slot_occupancy_folded(self):
+        snap = InventorySnapshot([self._partition_slice()])
+        alloc = AllocationState(snap)
+        # 3 co-tenants on one 4-slot partition device, 1 on the other.
+        alloc.rebuild(
+            [allocated_claim(f"t{i}", ["pt-web-s4-0"])
+             for i in range(3)]
+            + [allocated_claim("t9", ["pt-web-s4-1"])])
+        fleet = fleetstate.FleetAggregator()
+        points = fleet.observe_pass(snap, alloc, pending_claims=0)
+        point = points[("tpu.dra.dev", "n0")]
+        assert point["partition_slots_total"] == 8
+        assert point["partition_slots_used"] == 4
+        assert point["partition_slot_occupancy"] == 0.5
+
+    def test_chip_only_pool_has_no_occupancy(self):
+        snap = InventorySnapshot([make_slice(telemetry=False)])
+        fleet = fleetstate.FleetAggregator()
+        points = fleet.observe_pass(snap, AllocationState(snap), 0)
+        point = points[("tpu.dra.dev", "n0")]
+        assert point["partition_slots_total"] == 0
+        assert point["partition_slot_occupancy"] is None
+
+    def test_pending_ring_and_recent(self):
+        snap = InventorySnapshot([make_slice(telemetry=False)])
+        fleet = fleetstate.FleetAggregator()
+        for pending in (0, 7, 2):
+            fleet.observe_pass(snap, AllocationState(snap), pending)
+        hist = fleet.snapshot()["pending_history"]
+        assert [p["pending"] for p in hist] == [0, 7, 2]
+        assert fleet.pending_recent() == 7
+        assert fleet.pending_recent(points=1) == 2
+
+    def test_tenant_demand_surfaces_when_store_attached(self):
+        from k8s_dra_driver_gpu_tpu.pkg.partition import (
+            TenantProfileStore,
+        )
+
+        fleet = fleetstate.FleetAggregator()
+        assert "tenant_demand" not in fleet.snapshot()
+        store = TenantProfileStore(defaults={}, window_s=0.0)
+        for i in range(10):
+            store.observe("web", (i + 1) << 30)
+        fleet.attach_profile_store(store)
+        snap = fleet.snapshot()
+        assert snap["tenant_demand"]["web"]["p95_hbm_bytes"] == 10 << 30
+
+
 class TestFragSignal:
     """The defrag trigger signal (pkg/defrag rides this): arm at the
     trigger, fire on demand or sustain, hysteresis band, release."""

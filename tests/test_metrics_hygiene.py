@@ -14,6 +14,7 @@ from prometheus_client import CollectorRegistry, generate_latest
 from prometheus_client.parser import text_string_to_metric_families
 
 from k8s_dra_driver_gpu_tpu.pkg.metrics import (
+    AutoscaleMetrics,
     ClaimSLOMetrics,
     ComputeDomainMetrics,
     DefragMetrics,
@@ -50,7 +51,8 @@ COMPOSITIONS = {
     "kubelet-plugin": (DRARequestMetrics, ResilienceMetrics,
                        RecoveryMetrics, PartitionMetrics),
     "scheduler": (PlacementMetrics, SchedulerMetrics, FleetMetrics,
-                  ResilienceMetrics, RecoveryMetrics, DefragMetrics),
+                  ResilienceMetrics, RecoveryMetrics, DefragMetrics,
+                  AutoscaleMetrics),
     "cd-plugin": (DRARequestMetrics, ResilienceMetrics,
                   RecoveryMetrics),
     "cd-controller": (ComputeDomainMetrics, ResilienceMetrics),
@@ -201,6 +203,7 @@ PRODUCERS = {
     "relist_backoff": r"\.relist_backoff\.labels\(",
     "fold_seconds": r"fold_hist\.observe\(",
     "move_seconds": r"\.move_seconds\.observe\(",
+    "rollout_seconds": r"\.rollout_seconds\.observe\(",
 }
 
 
