@@ -6,6 +6,7 @@ PYTHON ?= python
 	bench-placement-smoke bench-chaos-smoke bench-sched-smoke \
 	bench-sched-scale bench-recovery-smoke bench-defrag-smoke \
 	bench-serving-smoke bench-autoscale-smoke \
+	bench-powersched-smoke \
 	bench-trace-smoke bench-telemetry-smoke validate-dashboard \
 	lint lint-analysis clean stamp-version
 
@@ -140,6 +141,23 @@ bench-autoscale-smoke:
 	BENCH_AUTOSCALE_ROUNDS=2 \
 	BENCH_AUTOSCALE_OUT=$(or $(BENCH_AUTOSCALE_OUT),/tmp/BENCH_autoscale_smoke.json) \
 	$(PYTHON) bench.py --autoscale
+
+# Power-aware scheduling + pre-warming smoke: the telemetry->placement
+# loop gate (`bench.py --powersched`). Half 1 proves pre-warming cuts
+# burst attach p99 >= 3x vs the cold lazy-create path on a REAL
+# DeviceState (every warm attach a counted hit); half 2 runs a burst
+# against a power-capped rack + an anomaly-tainted chip: zero
+# tpu_dra_claim_e2e_seconds SLO breaches, zero pending, zero per-node
+# power over-commit recomputed from the final allocations, the tainted
+# chip used only as last resort, and converged steady-state passes at
+# ZERO kube writes. Mirrored as a non-slow test in
+# tests/test_bench_powersched_smoke.py; the committed trajectory file
+# is BENCH_powersched.json (plain `bench.py --powersched`).
+bench-powersched-smoke:
+	BENCH_POWERSCHED_NODES=4 BENCH_POWERSCHED_ROUNDS=2 \
+	BENCH_POWERSCHED_MIN_PREWARM_RATIO=3.0 \
+	BENCH_POWERSCHED_OUT=$(or $(BENCH_POWERSCHED_OUT),/tmp/BENCH_powersched_smoke.json) \
+	$(PYTHON) bench.py --powersched
 
 # Scheduler-churn smoke: a shrunk `--sched-churn` trace (8 nodes x 24
 # claims of paired pod+claim churn + unchanged health republishes)

@@ -3719,6 +3719,251 @@ def bench_autoscale() -> dict:
     }
 
 
+def bench_powersched() -> dict:
+    """Power/thermal-aware scheduling + predictive pre-warming mode
+    (`bench.py --powersched`), the telemetry->placement loop gate
+    (ISSUE 15). Two halves:
+
+    1. **Pre-warm attach latency** on a REAL DeviceState: every tenant
+       attach in the COLD run pays the lazy carve-out create
+       (durable PartitionCreating/Ready records + registry fsyncs) on
+       the claim path; the WARM run pre-realizes the carve-outs via
+       ``PartitionEngine.set_prewarm`` first, so attaches hit warm
+       records. Gate: cold attach p99 >= BENCH_POWERSCHED_MIN_
+       PREWARM_RATIO (3) x warm attach p99, and every warm attach is
+       a counted pre-warm HIT.
+    2. **Power-capped rack chaos** against the real scheduler: a rack
+       (2 of N nodes) publishes ``powerCapWatts`` at HALF its chips'
+       summed rated draw, one chip carries an active anomaly taint,
+       and a burst sized to the fleet's power-feasible capacity minus
+       one arrives at once. Gates: zero claims breach the
+       ``tpu_dra_claim_e2e_seconds`` SLO envelope
+       (BENCH_POWERSCHED_SLO_S, 2s), zero pending, zero per-node
+       power over-commit recomputed from the final allocations, the
+       tainted chip is picked only after every clean same-node chip
+       (pure-preference avoidance), and two post-convergence passes
+       cost ZERO kube writes.
+
+    Emits BENCH_powersched.json (BENCH_POWERSCHED_OUT). Knobs:
+    BENCH_POWERSCHED_NODES (6), BENCH_POWERSCHED_ROUNDS (3),
+    BENCH_POWERSCHED_SLO_S (2.0)."""
+    from k8s_dra_driver_gpu_tpu.kubeletplugin import DRIVER_NAME
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.claim import (
+        DeviceResult,
+        OpaqueConfig,
+        ResourceClaim,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+        Config,
+        DeviceState,
+    )
+    from k8s_dra_driver_gpu_tpu.kubeletplugin.deviceinfo import (
+        DeviceKind,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.metrics import PartitionMetrics
+    from k8s_dra_driver_gpu_tpu.pkg.partition.spec import (
+        PartitionSet,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+
+    nodes_n = max(3, _env_int("BENCH_POWERSCHED_NODES", 6))
+    rounds = max(1, _env_int("BENCH_POWERSCHED_ROUNDS", 3))
+    slo_s = _env_float("BENCH_POWERSCHED_SLO_S", 2.0)
+    RES = ("resource.k8s.io", "v1")
+    RATED_W = 100
+    extras: dict = {"powersched_nodes": nodes_n,
+                    "powersched_rounds": rounds,
+                    "powersched_slo_s": slo_s}
+
+    # -- half 1: warm vs cold attach p99 on a real DeviceState ----------------
+    import shutil  # noqa: PLC0415
+
+    gates = ("DynamicSubSlice=true,TimeSlicingSettings=true,"
+             "MultiTenancySupport=true,TenantPartitioning=true")
+    pset = PartitionSet.from_dict({"profiles": [
+        {"name": "serv", "subslice": "1x1", "maxTenants": 2}]})
+    oversub = OpaqueConfig(
+        parameters={"apiVersion": "resource.tpu.dra/v1beta1",
+                    "kind": "SubSliceConfig", "oversubscribe": True},
+        requests=(), source="FromClaim")
+
+    def attach_run(prewarm: bool) -> tuple[list, int, int]:
+        """Rounds of one-tenant-per-partition prepare/unprepare;
+        returns (attach segment samples, prewarm hits, creates)."""
+        root = tempfile.mkdtemp(prefix="bench-powersched-node-")
+        try:
+            state = DeviceState(Config.mock(
+                root=root, topology="v5e-4", gates=gates,
+                partition_set=pset))
+            engine = state.partition_engine
+            engine.metrics = PartitionMetrics()
+            names = sorted(
+                n for n, d in state.allocatable.items()
+                if d.kind == DeviceKind.PARTITION)
+            for r in range(rounds):
+                if prewarm:
+                    engine.set_prewarm({"serv": len(names)},
+                                       max_total=len(names))
+                uids = [f"ps-{r}-{k}" for k in range(len(names))]
+                for uid, name in zip(uids, names):
+                    state.prepare(ResourceClaim(
+                        uid=uid, namespace="default", name=uid,
+                        results=[DeviceResult(
+                            request="tenant", driver=DRIVER_NAME,
+                            pool="bench", device=name)],
+                        configs=[oversub]))
+                for uid in uids:
+                    state.unprepare(uid)
+            hits = int(engine.metrics.prewarm_hits._value.get())
+            creates = int(engine.metrics.creates._value.get())
+            return (state.segment_samples("prep_attach_partition"),
+                    hits, creates)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    cold_samples, cold_hits, _ = attach_run(prewarm=False)
+    warm_samples, warm_hits, _ = attach_run(prewarm=True)
+    cold_p99 = _p99_ms(cold_samples)
+    warm_p99 = _p99_ms(warm_samples)
+    ratio = (cold_p99 / max(warm_p99, 1e-6)
+             if cold_p99 is not None and warm_p99 is not None else 0.0)
+    extras.update({
+        "powersched_cold_attach_p99_ms": cold_p99,
+        "powersched_warm_attach_p99_ms": warm_p99,
+        "powersched_prewarm_speedup": round(ratio, 2),
+        "powersched_prewarm_hits": warm_hits,
+        "powersched_prewarm_expected_hits": len(warm_samples),
+        "powersched_cold_hits": cold_hits,
+    })
+
+    # -- half 2: power-capped rack chaos --------------------------------------
+    chips = 4
+    capped_nodes = {f"node-{i}" for i in range(2)}
+    cap_w = (chips // 2) * RATED_W  # the rack fits HALF its chips
+    tainted_node, tainted_chip = f"node-{nodes_n - 1}", "chip-0"
+
+    def node_slice(i: int) -> dict:
+        node = f"node-{i}"
+        devices = []
+        for j in range(chips):
+            attrs = {
+                "iciX": {"int": j % 2}, "iciY": {"int": j // 2},
+                "iciZ": {"int": 0}, "topology": {"string": "2x2"},
+                "powerRatedWatts": {"int": RATED_W},
+            }
+            if node in capped_nodes:
+                attrs["powerCapWatts"] = {"int": cap_w}
+            dev = {"name": f"chip-{j}", "attributes": attrs}
+            if node == tainted_node and f"chip-{j}" == tainted_chip:
+                dev["taints"] = [{
+                    "key": "tpu.dra.dev/power_cap_throttle",
+                    "value": "true", "effect": ""}]
+            devices.append(dev)
+        return {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceSlice",
+            "metadata": {"name": f"{node}-{DRIVER_NAME}"},
+            "spec": {
+                "driver": DRIVER_NAME, "nodeName": node,
+                "pool": {"name": node, "generation": 1,
+                         "resourceSliceCount": 1},
+                "devices": devices,
+            },
+        }
+
+    fake = FakeKubeClient()
+    alloc_times: dict = {}
+    counted = _CountingKube(fake, alloc_times)
+    fake.create(*RES, "deviceclasses", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": "tpu"}, "spec": {},
+    })
+    for i in range(nodes_n):
+        fake.create(*RES, "resourceslices", node_slice(i))
+    usable = (nodes_n - len(capped_nodes)) * chips \
+        + len(capped_nodes) * (chips // 2)
+    burst = usable - 1
+    create_ts: dict = {}
+    for k in range(burst):
+        name = f"pc-{k}"
+        fake.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"devices": {"requests": [{
+                "name": "tpu",
+                "exactly": {"deviceClassName": "tpu"}}]}},
+        }, namespace="default")
+        create_ts[("default", name)] = time.perf_counter()
+    sched = DraScheduler(counted)
+    for _ in range(6):
+        sched.sync_once()
+        claims = fake.list(*RES, "resourceclaims")
+        if all(c.get("status", {}).get("allocation") for c in claims):
+            break
+    claims = fake.list(*RES, "resourceclaims")
+    pending = sum(1 for c in claims
+                  if not c.get("status", {}).get("allocation"))
+    e2e = [alloc_times[key] - t0 for key, t0 in create_ts.items()
+           if key in alloc_times]
+    breaches = sum(1 for s in e2e if s > slo_s)
+
+    # Per-node power audit recomputed from the FINAL allocations.
+    used_w: dict[str, int] = {}
+    used_chips: dict[str, set] = {}
+    for c in claims:
+        alloc = c.get("status", {}).get("allocation")
+        if not alloc:
+            continue
+        for r in alloc["devices"]["results"]:
+            used_w[r["pool"]] = used_w.get(r["pool"], 0) + RATED_W
+            used_chips.setdefault(r["pool"], set()).add(r["device"])
+    overcommit = sum(
+        1 for node in capped_nodes if used_w.get(node, 0) > cap_w)
+    # Pure-preference avoidance: the tainted chip may carry load ONLY
+    # once every clean chip on its node is taken.
+    tainted_used = tainted_chip in used_chips.get(tainted_node, set())
+    clean_free = chips - len(used_chips.get(tainted_node, set()))
+    avoided_ok = (not tainted_used) or clean_free == 0
+    w0 = counted.writes
+    for _ in range(2):
+        sched.sync_once()
+    steady_writes = counted.writes - w0
+    sched.stop()
+    extras.update({
+        "powersched_burst_claims": burst,
+        "powersched_capacity": usable,
+        "powersched_pending": pending,
+        "powersched_e2e_p99_ms": _p99_ms(e2e),
+        "powersched_slo_breaches": breaches,
+        "powersched_power_overcommit": overcommit,
+        "powersched_capped_rack_used_w": {
+            n: used_w.get(n, 0) for n in sorted(capped_nodes)},
+        "powersched_rack_cap_w": cap_w,
+        "powersched_tainted_chip_avoid_ok": int(avoided_ok),
+        "powersched_steady_writes": steady_writes,
+    })
+
+    return {
+        "metric": "powersched_prewarm_speedup",
+        "value": round(ratio, 2),
+        "unit": "x cold/warm attach p99",
+        "vs_baseline": round(ratio, 2),
+        "extras": extras,
+    }
+
+
+def _write_powersched_json(result: dict) -> None:
+    out_path = os.environ.get(
+        "BENCH_POWERSCHED_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_powersched.json"))
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
 def _write_autoscale_json(result: dict) -> None:
     out_path = os.environ.get(
         "BENCH_AUTOSCALE_OUT",
@@ -4135,6 +4380,53 @@ def _dispatch() -> None:
         if cap_ms and (p99 is None or p99 > cap_ms):
             print(f"autoscale gate failed: create p99 {p99}ms > "
                   f"{cap_ms}ms", file=sys.stderr)
+            ok = False
+        if not ok:
+            sys.exit(1)
+        return
+    if "--powersched" in sys.argv[1:]:
+        result = bench_powersched()
+        _write_powersched_json(result)
+        print(json.dumps(result))
+        # CI gate (`make bench-powersched-smoke`): pre-warming must
+        # cut burst attach p99 by the configured factor with every
+        # warm attach a counted hit, and the power-capped-rack chaos
+        # run must shed load with zero SLO breach, zero pending, zero
+        # recomputed power over-commit, honest anomaly avoidance, and
+        # zero steady-state kube writes.
+        ex = result["extras"]
+        ok = True
+        floor = _env_float("BENCH_POWERSCHED_MIN_PREWARM_RATIO", 3.0)
+        if floor and result["value"] < floor:
+            print(f"powersched gate failed: prewarm speedup "
+                  f"{result['value']}x < {floor}x (cold p99 "
+                  f"{ex['powersched_cold_attach_p99_ms']}ms vs warm "
+                  f"{ex['powersched_warm_attach_p99_ms']}ms)",
+                  file=sys.stderr)
+            ok = False
+        if ex["powersched_prewarm_hits"] < \
+                ex["powersched_prewarm_expected_hits"]:
+            print("powersched gate failed: only "
+                  f"{ex['powersched_prewarm_hits']}/"
+                  f"{ex['powersched_prewarm_expected_hits']} warm "
+                  "attaches hit a pre-warmed carve-out",
+                  file=sys.stderr)
+            ok = False
+        for key, label in (
+                ("powersched_slo_breaches", "claims breached the SLO"),
+                ("powersched_pending", "claims left pending"),
+                ("powersched_power_overcommit",
+                 "power-capped nodes over-committed"),
+                ("powersched_steady_writes",
+                 "kube writes in converged steady state")):
+            if ex[key]:
+                print(f"powersched gate failed: {ex[key]} {label}",
+                      file=sys.stderr)
+                ok = False
+        if not ex["powersched_tainted_chip_avoid_ok"]:
+            print("powersched gate failed: the anomaly-tainted chip "
+                  "carried load while a clean same-node peer was free",
+                  file=sys.stderr)
             ok = False
         if not ok:
             sys.exit(1)

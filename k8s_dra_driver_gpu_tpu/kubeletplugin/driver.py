@@ -20,6 +20,7 @@ from ..pkg.events import emit_warning_event
 from ..pkg.kubeclient import NotFoundError
 from ..pkg.metrics import DRARequestMetrics
 from ..pkg.partition.profiles import TenantProfileStore
+from ..pkg.schedcache import ATTR_POWER_CAP, power_cap_env
 from ..pkg.sliceutil import publish_resource_slices, slice_content_hash
 from .claim import ResourceClaim
 from .cleanup import CheckpointCleanupManager
@@ -93,6 +94,11 @@ class Driver:
             fleetstate.telemetry_enabled()
             and os.environ.get("TPU_DRA_TELEMETRY_ATTRS", "1")
             not in ("0", "false", "False"))
+        # This node's power cap in watts (TPU_DRA_POWER_CAP_W, 0 =
+        # uncapped): published as a powerCapWatts attribute on every
+        # chip device so the scheduler's power-budget counter model
+        # (pkg/schedcache) and the fleet headroom gauge see it.
+        self._power_cap_w = power_cap_env()
         # Publication modes mirror the reference's three
         # (driver.go:190,574): "legacy" (pre-partitionable-devices
         # servers: one slice, whole chips only), "combined" (one slice,
@@ -157,7 +163,8 @@ class Driver:
                 kube_client,
                 pool=config.pool_name or node_name,
                 apply_fn=self.apply_partition_set,
-                bootstrap=self.state.partition_engine.partition_set)
+                bootstrap=self.state.partition_engine.partition_set,
+                prewarm_fn=self.apply_prewarm)
         self.health_monitor = None
         if enable_health_monitor:
             # The startup enumeration is the health baseline: a chip seen
@@ -398,6 +405,9 @@ class Driver:
             tele = self._telemetry_attrs.get(name)
             if tele:
                 entry.setdefault("attributes", {}).update(tele)
+            if self._power_cap_w > 0 and dev.kind == DeviceKind.CHIP:
+                entry.setdefault("attributes", {})[ATTR_POWER_CAP] = {
+                    "int": self._power_cap_w}
             if not legacy:
                 entry["consumesCounters"] = consumed_counters(dev, host)
             if dev.kind == DeviceKind.CHIP:
@@ -470,6 +480,17 @@ class Driver:
         rewritten (and a converged re-apply costs zero writes)."""
         self.state.apply_partition_set(partition_set)
         return self.publish_resources()
+
+    def apply_prewarm(self, hints: dict) -> int:
+        """Predictive pre-warming: converge the partition engine's
+        warm carve-out set onto the forecaster's hint (the winning
+        PartitionSet CRD's prewarm annotation -- pkg/autoscale). No
+        republish: carve-out realization changes no published device,
+        so a hint application costs ZERO kube calls. Returns
+        carve-outs created."""
+        if self.state.partition_engine is None:
+            return 0
+        return self.state.partition_engine.set_prewarm(hints or {})
 
     # -- health ---------------------------------------------------------------
 

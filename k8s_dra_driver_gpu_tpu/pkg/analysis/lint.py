@@ -115,6 +115,15 @@ RULES: dict[str, str] = {
                  "a random call site can never fork the fleet's "
                  "desired layout from the controller's durable "
                  "rollout records",
+    "TPUDRA015": "power-budget / pre-warm state mutation outside its "
+                 "definition site: AllocationState.power_debit/"
+                 "power_credit are fenced to pkg/schedcache.py (the "
+                 "per-node power ledger must stay balanced against "
+                 "try_commit's atomic judgment) and "
+                 "PartitionEngine.set_prewarm to the engine + the "
+                 "node driver's CRD-watch path (the warm carve-out "
+                 "set must track the forecaster's hint, never a "
+                 "random call site)",
 }
 
 # Lock model (docs/architecture.md "Locking hierarchy"). Matched on the
@@ -200,6 +209,17 @@ _PARTITION_SPEC_SUFFIXES = ("pkg/partition/spec.py",
                             "analysis/lint.py")
 _PARTITION_SPEC_DIRS = ("pkg/autoscale/",)
 _PARTITION_CRD_WRITE_VERBS = {"create", "update", "patch", "delete"}
+# TPUDRA015 scope (rel-path sanctioned like TPUDRA011/013/014): the
+# power ledger's debit/credit pair lives on AllocationState and is
+# called only from its own apply/release/retarget paths; the pre-warm
+# warm-set mutation (set_prewarm) is called only by the engine's
+# definition site and the node driver's CRD-watch path
+# (Driver.apply_prewarm). A stray same-named file elsewhere gets no
+# pass.
+_POWER_MUT_SUFFIXES = ("pkg/schedcache.py", "analysis/lint.py")
+_PREWARM_MUT_SUFFIXES = ("pkg/partition/engine.py",
+                         "kubeletplugin/driver.py",
+                         "analysis/lint.py")
 # Resources the scheduler watches (mirror of
 # pkg/schedcache.WATCHED_RESOURCES, kept literal so the linter has no
 # runtime import of the code under analysis).
@@ -828,6 +848,37 @@ class _ModuleLinter(ast.NodeVisitor):
                     "kubeletplugin/health.py: feed samples through the "
                     "health-poll seam (ChipHealthMonitor) or fold "
                     "through FleetAggregator.observe_pass",
+                    key=f"{base_src}.{attr}",
+                )
+
+            # TPUDRA015: power-ledger / pre-warm warm-set mutation
+            # outside the definition sites. The mutating surface is
+            # the distinctively-named power_debit/power_credit
+            # (pkg/schedcache.AllocationState) and set_prewarm
+            # (pkg/partition/engine.PartitionEngine).
+            rel_posix = self.rel.replace(os.sep, "/")
+            if attr in ("power_debit", "power_credit") and not any(
+                    rel_posix.endswith(sfx)
+                    for sfx in _POWER_MUT_SUFFIXES):
+                self._emit(
+                    "TPUDRA015", node,
+                    f"power-ledger mutation {base_src}.{attr}(...) "
+                    "outside pkg/schedcache.py: the per-node power "
+                    "budget is balanced only by AllocationState's own "
+                    "apply/release/retarget paths (try_commit judges "
+                    "it atomically); read power_snapshot() instead",
+                    key=f"{base_src}.{attr}",
+                )
+            if attr == "set_prewarm" and not any(
+                    rel_posix.endswith(sfx)
+                    for sfx in _PREWARM_MUT_SUFFIXES):
+                self._emit(
+                    "TPUDRA015", node,
+                    f"pre-warm mutation {base_src}.{attr}(...) outside "
+                    "pkg/partition/engine.py / kubeletplugin/"
+                    "driver.py: the warm carve-out set converges from "
+                    "the PartitionSet CRD's prewarm annotation "
+                    "(Driver.apply_prewarm), never ad hoc",
                     key=f"{base_src}.{attr}",
                 )
 

@@ -35,7 +35,9 @@ operator's content wins).
 
 from __future__ import annotations
 
+import json
 import logging
+import math
 import os
 import threading
 import time
@@ -53,6 +55,7 @@ from ..partition.profiles import (
 )
 from ..partition.spec import PartitionSet, PartitionSpecError
 from . import crd
+from .forecast import DemandForecaster
 from .planner import (
     TENANT_DEMAND_CORES_ANNOTATION,
     TENANT_DEMAND_HBM_ANNOTATION,
@@ -122,6 +125,21 @@ class AutoscaleController:
         self.sustain_s = sustain_s
         self.cooldown_s = cooldown_s
         self.pools = tuple(pools)
+        # Predictive pre-warming: the forecaster projects near-term
+        # per-pool partition demand from the fleet rings; the result
+        # lands as the prewarm ANNOTATION on our CRD (advisory -- no
+        # spec change, no rollout) and the node watchers drive
+        # PartitionEngine.set_prewarm from it. None = disabled.
+        self.forecaster = (DemandForecaster()
+                           if os.environ.get("TPU_DRA_PREWARM", "1")
+                           not in ("0", "false", "False") else None)
+        # Prewarm-hint hysteresis, PER POOL: wall clock since a pool's
+        # forecast first read zero while its hint stands. A pool's
+        # entry clears only after the forecaster's stale window -- a
+        # hint wobbling down must not write per pass, a plateau keeps
+        # its warmth until demand has plausibly gone for good, and one
+        # pool's ramp must never clobber another pool's held hint.
+        self._prewarm_zero_since: dict[str, float] = {}
         self._checkpoint = CheckpointManager(
             root, transition_policy=AUTOSCALE_POLICY)
         self._lock = threading.Lock()
@@ -191,6 +209,7 @@ class AutoscaleController:
         self._advance(counts)
         if not self.paused():
             self._detect_and_plan(crds, live, pending, counts)
+            self._plan_prewarm(crds, counts)
         if counts["planned"]:
             # Issue the freshly planned rollout's CRD write in the
             # SAME pass (the record is already durable): the write's
@@ -330,6 +349,131 @@ class AutoscaleController:
             ", ".join(f"{t}:{d.get('action')}"
                       for t, d in sorted(plan.decisions.items())),
             " (urgent)" if plan.urgent else "")
+
+    # -- predictive pre-warming (forecast -> CRD hint) ------------------------
+
+    @staticmethod
+    def _parse_prewarm(raw: str) -> tuple[dict, bool]:
+        """Tolerant parse of the standing prewarm annotation into
+        ``{pool: {profile: int}}`` plus a garbage flag. EVERY
+        malformed fragment (bad JSON, non-dict pools, non-int counts)
+        reads as absent-and-garbage -- a hand-edited annotation must
+        degrade to a rewrite, never crash the sync pass that carries
+        real rollouts."""
+        if not raw:
+            return {}, False
+        try:
+            parsed = json.loads(raw)
+        except (TypeError, ValueError):
+            return {}, True
+        if not isinstance(parsed, dict):
+            return {}, True
+        out: dict[str, dict[str, int]] = {}
+        garbage = False
+        for pool, profs in parsed.items():
+            if not isinstance(profs, dict):
+                garbage = True
+                continue
+            entry: dict[str, int] = {}
+            for prof, n in profs.items():
+                try:
+                    n = int(n)
+                except (TypeError, ValueError):
+                    garbage = True
+                    continue
+                if n > 0:
+                    entry[str(prof)] = n
+            if entry:
+                out[str(pool)] = entry
+        return out, garbage
+
+    def _plan_prewarm(self, crds: list[dict], counts: dict) -> None:
+        """Project near-term partition demand per pool from the fleet
+        rings and converge the prewarm annotation on our CRD. A
+        converged forecast (or none) writes NOTHING -- the
+        steady-state-zero-writes contract covers this stage too.
+        Reads ride the pass's informer-backed CRD listing (an
+        advisory hint needs no fresh-GET discipline; a stale view at
+        worst re-issues an idempotent patch)."""
+        if self.forecaster is None or self.fleet is None:
+            return
+        live = self._our_crd(crds)
+        if live is None:
+            return  # no governing CRD: nothing to hint
+        if not crd.is_managed(live):
+            return  # manual override freezes pre-warming too
+        try:
+            ps, _rules = crd.partition_set_from_crd(live)
+        except PartitionSpecError:
+            return  # malformed spec: the plan stage already defers
+        per_pool = self.forecaster.forecast(self.fleet.snapshot())
+        cap = int(positive_float_env("TPU_DRA_PREWARM_MAX",
+                                     default=8, floor=0))
+        hints: dict[str, dict[str, int]] = {}
+        if ps.profiles and cap > 0:
+            # New tenants land on the finest (highest-slot) profile;
+            # that is the shape worth warming.
+            best = max(ps.profiles, key=lambda p: p.max_tenants)
+            for label, slots in sorted(per_pool.items()):
+                pool = label.split("/", 1)[-1]
+                devices = min(
+                    math.ceil(slots / max(best.max_tenants, 1)), cap)
+                if devices > 0:
+                    hints[pool] = {best.name: devices}
+        raw = (live.get("metadata", {}).get("annotations")
+               or {}).get(crd.PREWARM_ANNOTATION, "")
+        cur, garbage = self._parse_prewarm(raw)
+        # Write-stability hysteresis (the zero-write steady-state
+        # contract), judged PER POOL: GROWTH writes immediately (a
+        # burst must warm now) and carries every other pool's held
+        # hint along (one ramp must not clobber a plateau's warmth);
+        # a shrinking/wobbling forecast holds the standing hint (no
+        # per-pass rewrites while a trend decays); a pool whose
+        # forecast stays ZERO for the forecaster's stale window drops
+        # out once -- the idle sweep then returns its chips.
+        now = time.time()
+        for pool in list(self._prewarm_zero_since):
+            if pool in hints or pool not in cur:
+                del self._prewarm_zero_since[pool]
+        for pool in cur:
+            if pool not in hints:
+                self._prewarm_zero_since.setdefault(pool, now)
+        expired = {pool for pool, ts in
+                   self._prewarm_zero_since.items()
+                   if now - ts >= self.forecaster.stale_s}
+        held = {pool: profs for pool, profs in cur.items()
+                if pool not in hints and pool not in expired}
+        merged = {**held, **hints}
+        grown = any(
+            n > (cur.get(pool) or {}).get(prof, 0)
+            for pool, profs in hints.items()
+            for prof, n in profs.items())
+        if not garbage and not grown and set(merged) == set(cur):
+            return  # converged or wobbling: zero writes
+        if grown:
+            value = crd.prewarm_value(merged)
+        else:
+            # Expiry / garbage repair without growth: hold every
+            # still-live pool's STANDING counts (a not-grown forecast
+            # never lowers a held hint -- that is the hold), drop only
+            # the expired pools.
+            value = crd.prewarm_value(
+                {pool: profs for pool, profs in cur.items()
+                 if pool not in expired})
+        try:
+            self.kube.patch(*CRD, self.crd_name, {
+                "metadata": {"annotations": {
+                    crd.PREWARM_ANNOTATION: value or None,
+                }},
+            })
+        except (ConflictError, NotFoundError, KubeError):
+            return  # advisory hint: retried next pass
+        for pool in expired:
+            self._prewarm_zero_since.pop(pool, None)
+        counts["prewarmed"] = counts.get("prewarmed", 0) + 1
+        self.flight.record(self.crd_name, "autoscale",
+                           state="Prewarm", hint=value or "(cleared)")
+        logger.info("autoscale prewarm hint: %s", value or "cleared")
 
     # -- durable records ------------------------------------------------------
 

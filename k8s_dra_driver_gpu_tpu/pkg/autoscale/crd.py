@@ -62,6 +62,14 @@ MANAGED_ANNOTATION = "resource.tpu.dra/autoscale-managed"
 #: Revision counter the controller bumps per applied re-plan
 #: (observability only -- the content fingerprint is the identity).
 REVISION_ANNOTATION = "resource.tpu.dra/autoscale-revision"
+#: Predictive pre-warm hint (the forecaster's output, pkg/autoscale/
+#: forecast.py): JSON ``{"<pool glob>": {"<profile>": count}}``. An
+#: ANNOTATION, not spec -- the hint is advisory and must neither move
+#: the spec fingerprint (no rollout/supersede churn) nor survive as
+#: layout. Node watchers read their pool's entry and drive
+#: ``PartitionEngine.set_prewarm``; a malformed value reads as no hint
+#: (fail closed to the lazy-create behavior).
+PREWARM_ANNOTATION = "resource.tpu.dra/prewarm"
 
 
 @dataclass(frozen=True)
@@ -171,6 +179,49 @@ def crd_object(name: str, partition_set: PartitionSet,
 def is_managed(obj: dict) -> bool:
     ann = (obj.get("metadata", {}).get("annotations") or {})
     return ann.get(MANAGED_ANNOTATION) == "true"
+
+
+def prewarm_value(hints: dict[str, dict[str, int]]) -> str:
+    """Canonical (sorted) annotation encoding of pool -> profile ->
+    count hints; "" means the annotation should be absent."""
+    cleaned = {
+        pool: {prof: int(n) for prof, n in profs.items() if int(n) > 0}
+        for pool, profs in (hints or {}).items()
+    }
+    cleaned = {pool: profs for pool, profs in cleaned.items() if profs}
+    return json.dumps(cleaned, sort_keys=True) if cleaned else ""
+
+
+def prewarm_hints_of(obj: dict | None, pool: str) -> dict[str, int]:
+    """``{profile: count}`` this pool should keep warm, parsed from
+    the winning CRD's prewarm annotation (pool keys are fnmatch globs,
+    like ``spec.pools``). Malformed annotations read as {} -- the
+    fail-closed direction for an advisory latency hint is OFF."""
+    if obj is None:
+        return {}
+    raw = (obj.get("metadata", {}).get("annotations")
+           or {}).get(PREWARM_ANNOTATION)
+    if not raw:
+        return {}
+    try:
+        parsed = json.loads(raw)
+    except (TypeError, ValueError):
+        return {}
+    if not isinstance(parsed, dict):
+        return {}
+    out: dict[str, int] = {}
+    for pat, profs in parsed.items():
+        if not isinstance(profs, dict) or \
+                not fnmatch(pool, str(pat)):
+            continue
+        for prof, count in profs.items():
+            try:
+                n = int(count)
+            except (TypeError, ValueError):
+                continue
+            if n > 0:
+                out[str(prof)] = max(out.get(str(prof), 0), n)
+    return out
 
 
 def revision_of(obj: dict) -> int:

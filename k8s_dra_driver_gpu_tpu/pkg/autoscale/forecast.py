@@ -1,0 +1,140 @@
+"""Demand forecasting over the fleet time-series rings.
+
+The telemetry plane (pkg/fleetstate) already records per-pool
+partition-slot occupancy and pending-claim history every scheduler
+pass; this module turns those rings into a *latency* optimization:
+project near-term partition demand per pool so the autoscale
+controller can pre-realize carve-outs BEFORE the burst's first
+attaches arrive -- a warm partition's attach skips the
+``partition.create`` fsyncs on the claim-e2e critical path
+(pkg/partition/engine.set_prewarm is the node-side consumer).
+
+Deliberately boring math, matched to what the rings can support:
+
+- **Trend**: a least-squares slope over the recent
+  ``partition_slots_used`` points, projected ``horizon_s`` ahead. Only
+  a RISING trend forecasts anything -- flat or decaying pools predict
+  zero (pre-warming is purely additive; the idle sweep owns decay).
+- **Freshness**: points older than ``window_s`` are ignored and a ring
+  whose newest point is older than ``stale_s`` forecasts zero -- a
+  burst that came and went ages out instead of warming a dead pool
+  forever.
+- **Starvation boost**: claims pending RIGHT NOW (the
+  ``pending_history`` ring, same sustained-max read the autoscaler's
+  urgency check uses) are immediate demand on top of the trend.
+
+The forecaster is pure and stateless: rings in, ``{pool: additional
+slots}`` out. The controller owns everything stateful (the CRD hint
+annotation, convergence, bounds).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+from .. import positive_float_env
+
+#: How far ahead the trend is projected (seconds).
+FORECAST_HORIZON_S = positive_float_env(
+    "TPU_DRA_FORECAST_HORIZON_S", default=120.0, floor=1.0)
+#: Ring points older than this never enter the regression.
+FORECAST_WINDOW_S = positive_float_env(
+    "TPU_DRA_FORECAST_WINDOW_S", default=600.0, floor=5.0)
+#: A pool whose newest point is older than this forecasts zero.
+FORECAST_STALE_S = positive_float_env(
+    "TPU_DRA_FORECAST_STALE_S", default=180.0, floor=1.0)
+#: Minimum ring points before the trend is trusted.
+FORECAST_MIN_POINTS = int(positive_float_env(
+    "TPU_DRA_FORECAST_MIN_POINTS", default=4, floor=2))
+
+
+class DemandForecaster:
+    """Projects per-pool partition-slot demand from the
+    FleetAggregator's rings (see module docstring)."""
+
+    def __init__(self, horizon_s: float = 0.0, window_s: float = 0.0,
+                 stale_s: float = 0.0, min_points: int = 0):
+        self.horizon_s = horizon_s or FORECAST_HORIZON_S
+        self.window_s = window_s or FORECAST_WINDOW_S
+        self.stale_s = stale_s or FORECAST_STALE_S
+        self.min_points = min_points or FORECAST_MIN_POINTS
+
+    # -- one pool -------------------------------------------------------------
+
+    def forecast_slots(self, history: list[dict],
+                       now: float | None = None) -> int:
+        """Projected ADDITIONAL slot demand for one pool ring at
+        ``now + horizon_s`` (0 unless a fresh, sustained ramp is in
+        flight)."""
+        now = time.time() if now is None else now
+        pts = [(float(p["ts"]), int(p["partition_slots_used"]))
+               for p in history or []
+               if p.get("partition_slots_used") is not None
+               and p.get("ts") is not None]
+        recent = [(t, v) for t, v in pts if now - t <= self.window_s]
+        if len(recent) < self.min_points:
+            return 0
+        last_t, last_v = recent[-1]
+        if now - last_t > self.stale_s:
+            # The ring stopped moving: whatever ramp was in flight has
+            # decayed out of relevance (the aged-out-burst contract).
+            return 0
+        if last_v <= recent[-2][1]:
+            # The ramp must still be RISING at the newest sample: a
+            # step that already landed and plateaued is served
+            # capacity, not in-flight demand -- without this, the
+            # regression keeps projecting a just-finished burst's
+            # slope forward and the hint churns writes in steady
+            # state.
+            return 0
+        slope = self._slope(recent)
+        if slope <= 0:
+            return 0
+        # The projection minus the current level IS the trend term.
+        return max(int(math.ceil(slope * self.horizon_s)), 0)
+
+    @staticmethod
+    def _slope(points: list[tuple[float, int]]) -> float:
+        """Least-squares slope (slots per second) of (ts, used)."""
+        n = len(points)
+        mean_t = sum(t for t, _ in points) / n
+        mean_v = sum(v for _, v in points) / n
+        denom = sum((t - mean_t) ** 2 for t, _ in points)
+        if denom <= 0:
+            return 0.0
+        return sum((t - mean_t) * (v - mean_v)
+                   for t, v in points) / denom
+
+    # -- the whole fleet ------------------------------------------------------
+
+    def forecast(self, fleet_snapshot: dict,
+                 now: float | None = None) -> dict[str, int]:
+        """``{pool label: additional slots}`` over every pool in a
+        FleetAggregator snapshot; pools forecasting zero are omitted.
+        The sustained pending-claim count (fleet-GLOBAL -- the ring
+        cannot attribute a pending claim to a pool) boosts only pools
+        whose OWN ring already shows a rising trend: demand at the
+        door amplifies an in-flight ramp, but must not fan out across
+        every flat pool in the fleet (N pools x pending carve-outs of
+        phantom warm capacity). Starvation with no ramp anywhere is
+        the autoscale planner's urgent-re-plan territory, not a
+        pre-warm signal."""
+        now = time.time() if now is None else now
+        pending = 0
+        tail = (fleet_snapshot.get("pending_history") or [])[-5:]
+        if tail:
+            pending = max(int(p.get("pending", 0)) for p in tail)
+            if now - float(tail[-1].get("ts", 0)) > self.stale_s:
+                pending = 0
+        out: dict[str, int] = {}
+        for label, entry in (fleet_snapshot.get("pools")
+                             or {}).items():
+            history = entry.get("history") or []
+            current = entry.get("current") or {}
+            if current.get("partition_slots_total") in (None, 0):
+                continue  # pool publishes no partition slots
+            add = self.forecast_slots(history, now=now)
+            if add > 0:
+                out[label] = add + pending
+        return out

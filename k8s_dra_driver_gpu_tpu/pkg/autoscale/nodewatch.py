@@ -42,9 +42,18 @@ class PartitionSetWatcher:
 
     def __init__(self, kube, pool: str, apply_fn,
                  bootstrap: PartitionSet | None = None,
-                 resync_period: float = 300.0):
+                 resync_period: float = 300.0,
+                 prewarm_fn=None):
         self.pool = pool
         self._apply_fn = apply_fn
+        # Predictive pre-warming (``Driver.apply_prewarm``): the
+        # winning CRD's prewarm ANNOTATION (the scheduler-side
+        # forecaster's hint) converges through this on every
+        # reconcile, independent of the spec fingerprint -- an
+        # annotation-only patch must reach the engine without a
+        # layout re-apply.
+        self._prewarm_fn = prewarm_fn
+        self._applied_prewarm: dict[str, int] | None = None
         self._bootstrap = bootstrap
         self._bootstrap_fp = (
             crd.fingerprint(bootstrap.to_dict())
@@ -98,6 +107,38 @@ class PartitionSetWatcher:
             self.failed_total += 1
         self.last_error = msg
 
+    def _converge_prewarm(self, hints: dict[str, int],
+                          force: bool = False) -> None:
+        """Apply a changed pre-warm hint through ``prewarm_fn``
+        (Driver.apply_prewarm -> engine.set_prewarm). Best-effort: a
+        failing engine must never block plan convergence. ``force``
+        re-applies even an unchanged hint (a plan was just applied;
+        the warm set must re-converge onto the new layout)."""
+        if self._prewarm_fn is None:
+            return
+        with self._lock:
+            if not force and hints == self._applied_prewarm:
+                return
+        try:
+            self._prewarm_fn(hints)
+        except Exception as e:  # noqa: BLE001 - advisory latency hint
+            # NOT memoized either way: the next reconcile retries the
+            # shortfall. A PartitionEngineError is the engine's
+            # expected partial-application signal (name-matched: the
+            # engine class is not importable here without pulling the
+            # kubeletplugin stack into pkg/autoscale); anything else
+            # is a bug worth a traceback.
+            if type(e).__name__ == "PartitionEngineError":
+                logger.warning(
+                    "autoscale watch: prewarm hint partially applied "
+                    "(%s); retrying next reconcile", e)
+            else:
+                logger.exception("autoscale watch: prewarm hint "
+                                 "failed; lazy creates still serve")
+            return
+        with self._lock:
+            self._applied_prewarm = dict(hints)
+
     def _on_event(self, _ev_type: str, _obj: dict) -> None:
         # Cheap full reconcile per event: selection is global (the
         # winning CRD may CHANGE when any object appears/vanishes), so
@@ -106,10 +147,26 @@ class PartitionSetWatcher:
         self.reconcile()
 
     def reconcile(self) -> bool:
-        """Converge the node onto the winning plan. Returns True when
-        a plan was (re-)applied."""
+        """Converge the node onto the winning plan, then the plan's
+        pre-warm hint. Returns True when a plan was (re-)applied."""
         outcome, payload, obj = crd.select_for_pool(
             self._informer.list(), self.pool)
+        applied = self._reconcile_plan(outcome, payload, obj)
+        if outcome != "malformed":
+            # The advisory pre-warm hint converges on EVERY reconcile,
+            # AFTER the plan apply above -- set_prewarm can only
+            # realize carve-outs for profiles the engine already
+            # projects, so a hint arriving with its plan must see the
+            # new layout (and a re-applied plan re-converges even an
+            # unchanged hint: the apply may have reaped/retired warm
+            # records). A malformed winning spec keeps the last good
+            # hint, like the plan; no governing CRD = no hint = the
+            # engine releases its warm set to the idle sweep.
+            self._converge_prewarm(
+                crd.prewarm_hints_of(obj, self.pool), force=applied)
+        return applied
+
+    def _reconcile_plan(self, outcome: str, payload, obj) -> bool:
         with self._lock:
             if outcome == "malformed":
                 name = (obj or {}).get("metadata", {}).get("name", "?")

@@ -38,8 +38,13 @@ from collections import deque
 
 from . import positive_float_env
 from .partition.spec import parse_partition_device_name
+from .schedcache import ATTR_POWER_CAP, power_cap_env
 from .topology import TorusGrid
-from .topology.score import frag_from_largest, largest_free_shape
+from .topology.score import (
+    attr_int,
+    frag_from_largest,
+    largest_free_shape,
+)
 
 #: Samples kept per chip in the node ring (at the default 5s health
 #: poll cadence, 360 samples = 30 minutes of history).
@@ -48,6 +53,13 @@ DEFAULT_RING_SAMPLES = int(positive_float_env(
 #: Fleet time-series points kept per pool by the scheduler aggregator.
 DEFAULT_FLEET_HISTORY = int(positive_float_env(
     "TPU_DRA_FLEET_HISTORY", default=512, floor=16))
+#: How long a chip's last known power reading is carried when a fold
+#: sees no (or a zero) power attribute for it -- the last-known-demand
+#: fallback of the tenant store, applied to power: a single dropped
+#: poll must not fake instant power headroom and let the scorer pile
+#: claims onto a hot host. Past the TTL the chip reads as no data.
+POWER_SAMPLE_TTL_S = positive_float_env(
+    "TPU_DRA_POWER_SAMPLE_TTL_S", default=60.0, floor=1.0)
 
 #: ResourceSlice attribute names the node plugin publishes (quantized;
 #: see kubeletplugin/driver.py) and the aggregator folds.
@@ -141,9 +153,13 @@ class FleetAggregator:
         self._last_pass_ts = 0.0
         self.passes_total = 0
         # Labels currently exported through the metrics sink (pruned
-        # when a pool/node leaves the snapshot).
+        # when a pool/node leaves the snapshot; the power-headroom set
+        # additionally prunes when a still-present pool's caps vanish
+        # -- a gauge must never freeze at a stale headroom for a pool
+        # whose power model turned off).
         self._metric_pools: set[str] = set()
         self._metric_nodes: set[str] = set()
+        self._metric_power_pools: set[str] = set()
         # Defrag trigger hysteresis (pkg/defrag): pool key -> wall
         # clock its fragmentation first crossed the trigger threshold.
         # Armed pools stay armed until frag falls to the RELEASE
@@ -165,6 +181,10 @@ class FleetAggregator:
         # percentiles the autoscale planner sizes against -- operators
         # see what the controller sees.
         self._profile_store = None
+        # Last known per-device power reading, (ts, watts) keyed by
+        # candidate key: the carry source when a fold sees a device
+        # with a missing/zero power attribute (POWER_SAMPLE_TTL_S).
+        self._last_dev_power: dict[tuple, tuple[float, int]] = {}
 
     def attach_profile_store(self, store) -> None:
         """Surface a TenantProfileStore's windowed percentiles in the
@@ -193,6 +213,7 @@ class FleetAggregator:
                          and hasattr(alloc, "slot_counts") else {})
         points = {}
         nodes: dict[str, dict] = {}
+        env_cap = power_cap_env()
         for key, cands in by_pool.items():
             total = len(cands)
             used = sum(1 for c in cands if c.key in allocated)
@@ -205,6 +226,11 @@ class FleetAggregator:
             slots_total = sum(c.slots for c in pt)
             slots_used = sum(min(holder_counts.get(c.key, 0), c.slots)
                              for c in pt)
+            pool_power, pool_caps = self._fold_node_telemetry(
+                cands, nodes, now)
+            cap_total = sum(
+                (cap if cap > 0 else env_cap)
+                for cap in pool_caps.values()) if pool_caps else 0
             points[key] = {
                 "ts": round(now, 3),
                 "total_devices": total,
@@ -218,9 +244,21 @@ class FleetAggregator:
                 "partition_slot_occupancy": (
                     round(slots_used / slots_total, 4)
                     if slots_total else None),
+                # Power envelope (2501.17752 scheduling input): summed
+                # device draw vs the summed node caps of this pool.
+                # None when no cap is known (model off).
+                "power_watts": pool_power,
+                "power_cap_watts": cap_total or None,
+                "power_headroom_watts": (
+                    max(cap_total - pool_power, 0)
+                    if cap_total else None),
             }
-            self._fold_node_telemetry(cands, nodes)
         self._finalize_nodes(nodes)
+        # Age the carry map: a device gone past the TTL reads as no
+        # data everywhere instead of a frozen plausible wattage.
+        for dkey in [k for k, (ts, _w) in self._last_dev_power.items()
+                     if now - ts > POWER_SAMPLE_TTL_S]:
+            del self._last_dev_power[dkey]
         with self._lock:
             for key, point in points.items():
                 ring = self._pools.get(key)
@@ -253,10 +291,29 @@ class FleetAggregator:
                 self.metrics.set_pending(int(pending_claims))
                 pool_labels = {f"{driver}/{pool}"
                                for driver, pool in points}
+                # getattr: the sink is duck-typed and older test
+                # doubles may not carry the power gauge.
+                pool_power_fn = getattr(self.metrics, "set_pool_power",
+                                        None)
+                power_pools: set[str] = set()
                 for (driver, pool), point in points.items():
                     self.metrics.set_pool(
                         f"{driver}/{pool}", point["utilization"],
                         point["free_devices"])
+                    if pool_power_fn is not None and \
+                            point.get("power_headroom_watts") \
+                            is not None:
+                        pool_power_fn(f"{driver}/{pool}",
+                                      point["power_headroom_watts"])
+                        power_pools.add(f"{driver}/{pool}")
+                # A pool whose caps vanished this pass (model turned
+                # off) drops its headroom gauge instead of freezing.
+                power_prune_fn = getattr(self.metrics,
+                                         "remove_pool_power", None)
+                if power_prune_fn is not None:
+                    for label in self._metric_power_pools - power_pools:
+                        power_prune_fn(label)
+                self._metric_power_pools = power_pools
                 for node, agg in nodes.items():
                     self.metrics.set_node(
                         node, agg.get("power_watts", 0.0),
@@ -293,12 +350,21 @@ class FleetAggregator:
         except Exception:  # noqa: BLE001 - uncoordinated pools
             return None, None
 
-    @staticmethod
-    def _fold_node_telemetry(cands, nodes: dict[str, dict]) -> None:
+    def _fold_node_telemetry(self, cands, nodes: dict[str, dict],
+                             now: float) -> tuple[int, dict[str, int]]:
         """Aggregate the quantized per-device telemetry attributes the
         node plugins publish into one per-node view (sum of power,
         max temp, mean duty, max HBM-used fraction, sum of ICI error
-        counters)."""
+        counters). Returns ``(pool power watts, {node: published power
+        cap})`` for this candidate group's pool point.
+
+        A device with a MISSING or ZERO power attribute carries its
+        last windowed reading (``POWER_SAMPLE_TTL_S``) instead of
+        folding as 0 W -- one dropped poll must not fake instant power
+        headroom under a pile of claims; past the TTL it genuinely
+        reads as no data (the replace-semantics contract)."""
+        pool_power = 0
+        pool_caps: dict[str, int] = {}
         for cand in cands:
             attrs = cand.device.get("attributes") or {}
             vals = {}
@@ -309,8 +375,22 @@ class FleetAggregator:
                         vals[name] = int(entry["int"])
                     except (TypeError, ValueError):
                         pass
+            cap = max(attr_int(attrs, ATTR_POWER_CAP), 0)
+            if cap > 0 or vals:
+                pool_caps[cand.node] = max(
+                    pool_caps.get(cand.node, 0), cap)
+            power = vals.get(ATTR_POWER, 0)
+            if power > 0:
+                self._last_dev_power[cand.key] = (now, power)
+            else:
+                carried = self._last_dev_power.get(cand.key)
+                if carried is not None and \
+                        now - carried[0] <= POWER_SAMPLE_TTL_S:
+                    power = carried[1]
+                    vals[ATTR_POWER] = power
             if not vals:
                 continue
+            pool_power += power
             agg = nodes.setdefault(cand.node, {
                 "chips": 0, "power_watts": 0, "temp_celsius": 0,
                 "duty_pct_sum": 0, "hbm_used_pct": 0,
@@ -324,6 +404,7 @@ class FleetAggregator:
             agg["hbm_used_pct"] = max(agg["hbm_used_pct"],
                                       vals.get(ATTR_HBM, 0))
             agg["ici_link_errors"] += vals.get(ATTR_ICI_ERR, 0)
+        return pool_power, pool_caps
 
     @staticmethod
     def _finalize_nodes(nodes: dict[str, dict]) -> None:

@@ -575,6 +575,32 @@ class PartitionMetrics:
             "reap, or crash-resumed teardown).",
             registry=self.registry,
         )
+        # Predictive pre-warming (pkg/partition/engine.set_prewarm,
+        # fed by the autoscale forecaster's CRD hint): created counts
+        # carve-outs realized AHEAD of demand, hit counts first
+        # attaches that found a warm carve-out (skipping the
+        # partition.create fsyncs on the claim path), reaped counts
+        # warm-but-never-attached carve-outs returned by the idle
+        # sweep after the forecast decayed. hit/created is the
+        # forecaster's precision.
+        self.prewarm_created = Counter(
+            "tpu_dra_prewarm_created_total",
+            "Partition carve-outs pre-realized ahead of forecast "
+            "demand.",
+            registry=self.registry,
+        )
+        self.prewarm_hits = Counter(
+            "tpu_dra_prewarm_hit_total",
+            "Tenant attaches that landed on a pre-warmed carve-out "
+            "(no partition.create on the claim path).",
+            registry=self.registry,
+        )
+        self.prewarm_reaped = Counter(
+            "tpu_dra_prewarm_reaped_total",
+            "Pre-warmed carve-outs reaped un-attached after the "
+            "forecast decayed.",
+            registry=self.registry,
+        )
 
     # -- the duck-typed sink pkg/partition/engine.py calls --------------------
 
@@ -586,6 +612,15 @@ class PartitionMetrics:
 
     def set_active(self, n: int) -> None:
         self.partitions_active.set(n)
+
+    def inc_prewarm_created(self) -> None:
+        self.prewarm_created.inc()
+
+    def inc_prewarm_hit(self) -> None:
+        self.prewarm_hits.inc()
+
+    def inc_prewarm_reaped(self) -> None:
+        self.prewarm_reaped.inc()
 
 
 class TelemetryMetrics:
@@ -729,6 +764,16 @@ class FleetMetrics:
             ["node"],
             registry=self.registry,
         )
+        self.power_headroom = Gauge(
+            "tpu_dra_fleet_power_headroom_watts",
+            "Per-pool power headroom: summed node power caps "
+            "(powerCapWatts attributes / TPU_DRA_POWER_CAP_W) minus "
+            "the summed telemetry draw, with dropped power samples "
+            "carried for TPU_DRA_POWER_SAMPLE_TTL_S. Absent when no "
+            "cap is configured (power model off).",
+            ["pool"],
+            registry=self.registry,
+        )
         self.fold_seconds = Histogram(
             "tpu_dra_fleet_fold_seconds",
             "Wall time of one FleetAggregator fold (per-pool "
@@ -756,10 +801,23 @@ class FleetMetrics:
         self.node_power.labels(node).set(power_w)
         self.node_temp.labels(node).set(temp_c)
 
+    def set_pool_power(self, pool: str, headroom_w: float) -> None:
+        self.power_headroom.labels(pool).set(headroom_w)
+
+    def remove_pool_power(self, pool: str) -> None:
+        """A still-present pool stopped publishing power caps: its
+        headroom gauge disappears rather than freezing (the power
+        model is off, not at its last value)."""
+        try:
+            self.power_headroom.remove(pool)
+        except KeyError:
+            pass
+
     def remove_pool(self, pool: str) -> None:
         """A pool left the snapshot: its gauges must disappear rather
         than freeze at the last value."""
-        for gauge in (self.pool_utilization, self.pool_free):
+        for gauge in (self.pool_utilization, self.pool_free,
+                      self.power_headroom):
             try:
                 gauge.remove(pool)
             except KeyError:

@@ -63,11 +63,26 @@ from ..analysis.statemachine import (
     PARTITION_POLICY,
     PARTITION_READY,
 )
-from .. import flightrecorder, tracing
+from .. import flightrecorder, positive_float_env, tracing
 from ..faults import fault_point
-from .spec import PartitionProfile, PartitionSet, PartitionSpecError
+from .spec import (
+    PartitionProfile,
+    PartitionSet,
+    PartitionSpecError,
+    parse_partition_device_name,
+)
 
 logger = logging.getLogger(__name__)
+
+def prewarm_max() -> int:
+    """Upper bound on carve-outs kept warm ahead of demand per node
+    (``TPU_DRA_PREWARM_MAX``; pkg/autoscale's forecaster hint drives
+    set_prewarm); 0 disables pre-warming entirely -- every attach
+    pays the lazy create. Read live per application (the controller
+    reads the same env live per pass -- the two halves of the feature
+    must never disagree across an operator flip)."""
+    return int(positive_float_env(
+        "TPU_DRA_PREWARM_MAX", default=8, floor=0))
 
 
 class PartitionEngineError(RuntimeError):
@@ -166,6 +181,15 @@ class PartitionEngine:
         self._mutex = threading.Lock()
         self._dev_locks: dict[str, threading.Lock] = {}
         self._devices: dict[str, AllocatableDevice] = {}
+        # Predictive pre-warming (set_prewarm): names that SHOULD stay
+        # warm per the current forecast hint (reap_idle leaves their
+        # zero-holder records alone), and the subset this engine
+        # created ahead of demand that no tenant has attached yet (the
+        # hit/reaped metric bookkeeping). In-memory on purpose: a
+        # restart settles records via resume() and the CRD watcher
+        # re-applies the hint right after.
+        self._prewarm_desired: set[str] = set()
+        self._prewarm_idle: set[str] = set()
         self._rebuild_devices()
 
     # -- desired devices ------------------------------------------------------
@@ -352,44 +376,70 @@ class PartitionEngine:
                         f"changed ({pinned} -> {want}); old carve-out "
                         "still settling"
                     )
-            if rec is None:
-                live = {"uuid": f"tpu-pt-{uuidlib.uuid4()}",
-                        "partition": device_name,
-                        "spec": dev.partition.spec.canonical_name()}
-                rec = CheckpointedClaim(
-                    uid=device_name,
-                    state=PARTITION_CREATING,
-                    devices=[CheckpointedDevice(
-                        canonical_name=device_name,
-                        kind=DeviceKind.PARTITION.value,
-                        live=live,
-                    )],
-                )
-                self._checkpoint.update_claim(device_name, rec)
-            live = rec.devices[0].live
-            if rec.state == PARTITION_CREATING:
-                fault_point("partition.create",
-                            error=lambda m: PartitionEngineError(m))
-                if live["uuid"] not in self._state.subslice_registry.list():
-                    self._state.subslice_registry.create(SubSliceLiveTuple(
-                        spec=dev.partition.spec, uuid=live["uuid"]))
-                ready = CheckpointedClaim(
-                    uid=device_name, state=PARTITION_READY,
-                    devices=rec.devices)
-                self._checkpoint.update_claim(device_name, ready)
-                if self.metrics is not None:
-                    self.metrics.inc_create()
-                    self.metrics.set_active(self.active_partitions())
-                logger.info("partition %s: carve-out %s created",
-                            device_name, live["uuid"])
-            return dict(live)
+            # Pre-warm hit accounting: an attach that finds a READY
+            # record this engine realized ahead of demand just skipped
+            # the partition.create fsyncs on its claim path.
+            warm_hit = (rec is not None
+                        and rec.state == PARTITION_READY)
+            live = self._realize_locked(device_name, dev, rec)
+            if warm_hit:
+                with self._mutex:
+                    warm_hit = device_name in self._prewarm_idle
+                    self._prewarm_idle.discard(device_name)
+                if warm_hit and self.metrics is not None:
+                    self.metrics.inc_prewarm_hit()
+            return live
+
+    def _realize_locked(self, device_name: str, dev,
+                        rec: CheckpointedClaim | None) -> dict:
+        """Create-or-complete the backing carve-out (caller holds the
+        device lock and has settled any Destroying/re-shaped record).
+        Shared by the attach path and set_prewarm, so a pre-warmed and
+        a lazily-created carve-out are byte-identical in lifecycle."""
+        if rec is None:
+            live = {"uuid": f"tpu-pt-{uuidlib.uuid4()}",
+                    "partition": device_name,
+                    "spec": dev.partition.spec.canonical_name()}
+            rec = CheckpointedClaim(
+                uid=device_name,
+                state=PARTITION_CREATING,
+                devices=[CheckpointedDevice(
+                    canonical_name=device_name,
+                    kind=DeviceKind.PARTITION.value,
+                    live=live,
+                )],
+            )
+            self._checkpoint.update_claim(device_name, rec)
+        live = rec.devices[0].live
+        if rec.state == PARTITION_CREATING:
+            fault_point("partition.create",
+                        error=lambda m: PartitionEngineError(m))
+            if live["uuid"] not in self._state.subslice_registry.list():
+                self._state.subslice_registry.create(SubSliceLiveTuple(
+                    spec=dev.partition.spec, uuid=live["uuid"]))
+            ready = CheckpointedClaim(
+                uid=device_name, state=PARTITION_READY,
+                devices=rec.devices)
+            self._checkpoint.update_claim(device_name, ready)
+            if self.metrics is not None:
+                self.metrics.inc_create()
+                self.metrics.set_active(self.active_partitions())
+            logger.info("partition %s: carve-out %s created",
+                        device_name, live["uuid"])
+        return dict(live)
 
     def detach(self, claim_uid: str, device_name: str) -> None:
         """Drop one tenant's hold; the backing carve-out is destroyed
         when the LAST holder detaches (idle partitions return their
-        chips to whole-chip allocatability)."""
+        chips to whole-chip allocatability) -- UNLESS the current
+        pre-warm hint wants this device warm: then the Ready record
+        simply returns to the warm-unattached set, so a standing
+        forecast survives attach/detach churn instead of depleting
+        (the next burst's first attach is a hit again, no re-create
+        needed)."""
         with tracing.span("partition.detach", attrs={
                 "device": device_name, "claim_uid": claim_uid}) as sp:
+            kept_warm = False
             with self._dev_lock(device_name):
                 rec = self._record(device_name)
                 if rec is None:
@@ -397,11 +447,19 @@ class PartitionEngine:
                 last = self._holders(device_name,
                                      exclude={claim_uid}) == 0
                 if last:
-                    self._teardown_locked(device_name, rec)
+                    with self._mutex:
+                        kept_warm = (device_name in
+                                     self._prewarm_desired
+                                     and rec.state == PARTITION_READY)
+                        if kept_warm:
+                            self._prewarm_idle.add(device_name)
+                    if not kept_warm:
+                        self._teardown_locked(device_name, rec)
             flightrecorder.default().record(
                 claim_uid, "partition_detach",
                 trace_id=(sp.context.trace_id if sp.recording else ""),
-                device=device_name, destroyed=last)
+                device=device_name, destroyed=last and not kept_warm,
+                kept_warm=kept_warm)
 
     def _teardown_locked(self, name: str,
                          rec: CheckpointedClaim) -> None:
@@ -422,6 +480,144 @@ class PartitionEngine:
             self.metrics.inc_destroy()
             self.metrics.set_active(self.active_partitions())
         logger.info("partition %s: carve-out destroyed", name)
+
+    # -- predictive pre-warming (pkg/autoscale forecaster hint) ---------------
+
+    def set_prewarm(self, counts: dict[str, int],
+                    max_total: int | None = None) -> int:
+        """Converge the warm set onto a forecast hint
+        (``{profile name: devices to keep warm}``): realize carve-outs
+        for up to that many record-less devices per profile, bounded
+        by ``max_total`` (``TPU_DRA_PREWARM_MAX``), and release names
+        the hint no longer wants so the EXISTING idle sweep
+        (reap_idle) returns their chips. Devices already holding a
+        record in any state count toward their profile's quota -- a
+        held or already-warm partition is warm capacity, not a reason
+        to carve more. Returns the number of carve-outs created;
+        raises PartitionEngineError when a desired carve-out could
+        not be realized (the partial warm set stays applied -- the
+        raise tells the CRD watcher not to memoize the hint as
+        converged, so the next reconcile retries the shortfall).
+
+        Mutation fencing (lint rule TPUDRA015): only the node driver's
+        CRD-watch path may call this -- a random call site would fork
+        the warm set from the forecast hint."""
+        cap = prewarm_max() if max_total is None \
+            else max(int(max_total), 0)
+        want: dict[str, int] = {
+            str(p): int(n) for p, n in (counts or {}).items()
+            if int(n) > 0}
+        recorded = self._checkpoint.get().claims
+        desired: set[str] = set()
+        to_create: list[tuple[str, AllocatableDevice]] = []
+        budget = cap
+        with self._mutex:
+            devices = dict(self._devices)
+        by_profile: dict[str, list[str]] = {}
+        for name in sorted(devices):
+            parsed = parse_partition_device_name(name)
+            if parsed is not None:
+                by_profile.setdefault(parsed[0], []).append(name)
+        for profile, quota in sorted(want.items()):
+            names = by_profile.get(profile, ())
+            kept = 0
+            for name in names:
+                if kept >= quota or budget <= 0:
+                    break
+                kept += 1
+                budget -= 1
+                desired.add(name)
+                rec = recorded.get(name)
+                if rec is not None and rec.state == PARTITION_READY:
+                    continue  # held or already warm: quota satisfied
+                # No record, or a non-Ready record (a crashed create/
+                # teardown): the realize loop below settles and
+                # completes it -- a wedged Creating record is NOT warm
+                # capacity and must not satisfy the quota forever.
+                to_create.append((name, devices[name]))
+        # Publish the intended warm set BEFORE realizing: a concurrent
+        # reap_idle (the reconcile sweep thread) snapshots keep_warm
+        # up front, and a freshly created zero-holder Ready record
+        # must already be covered or the sweep tears it straight back
+        # down (and the watcher's hint memo would never re-create it).
+        with self._mutex:
+            self._prewarm_desired = set(desired)
+        created = 0
+        failed = 0
+        for name, snap_dev in to_create:
+            with self._dev_lock(name):
+                rec = self._record(name)
+                if rec is not None and rec.state == PARTITION_READY:
+                    continue  # an attach beat us to it: already warm
+                if rec is not None and self._holders(name) > 0:
+                    continue  # an in-flight attach owns the record
+                # Re-read the spec under the device lock (the attach
+                # path's discipline, dev-lock -> mutex): a re-plan
+                # racing this hint may have re-shaped or retired the
+                # device since the pre-lock snapshot -- realizing the
+                # STALE spec would pin a carve-out every attach then
+                # refuses and the reap (keep-warm) never settles.
+                with self._mutex:
+                    dev = self._devices.get(name)
+                if dev is None or dev.partition is None or \
+                        dev.partition.spec.canonical_name() != \
+                        snap_dev.partition.spec.canonical_name():
+                    desired.discard(name)
+                    continue
+                try:
+                    if rec is not None and (
+                            rec.state == PARTITION_DESTROYING
+                            or (self._pinned_spec(rec) or "") not in
+                            ("", dev.partition.spec.canonical_name())):
+                        # A crashed teardown owns the old identity --
+                        # or a crashed create pinned a PRE-re-plan
+                        # spec: finish/settle it, then warm fresh
+                        # (never share a dying or stale-shape
+                        # carve-out; the attach path's rule).
+                        self._teardown_locked(name, rec)
+                        rec = None
+                    # rec None -> fresh warm create; rec CREATING ->
+                    # complete the crashed create onto its pinned uuid
+                    # (resume()'s semantic).
+                    self._realize_locked(name, dev, rec)
+                except PartitionEngineError:
+                    # A refused create (fault injection, registry
+                    # pressure) downgrades to the lazy path for this
+                    # device; surfaced below so the CRD watcher does
+                    # NOT memoize the hint as applied and retries it.
+                    desired.discard(name)
+                    failed += 1
+                    continue
+                created += 1
+                with self._mutex:
+                    self._prewarm_idle.add(name)
+                if self.metrics is not None:
+                    self.metrics.inc_prewarm_created()
+        with self._mutex:
+            # Re-publish the PRUNED set (failed/re-shaped names drop
+            # out). The idle set is NOT intersected with it: a
+            # warm-but-no-longer-wanted carve-out stays tracked until
+            # the idle sweep reaps it (the reaped-counter accounting)
+            # or a late tenant attaches (a hit anyway).
+            self._prewarm_desired = desired
+        if created or want:
+            logger.info(
+                "prewarm: %d carve-out(s) created, %d desired warm "
+                "(cap %d)", created, len(desired), cap)
+        if failed:
+            # Partial application: everything realizable IS warm, but
+            # the caller must not record the hint as converged.
+            raise PartitionEngineError(
+                f"prewarm: {failed} carve-out(s) failed to realize "
+                f"({created} created); retry on the next hint "
+                "application")
+        return created
+
+    def prewarm_state(self) -> tuple[set[str], set[str]]:
+        """(desired-warm names, created-but-unattached names) -- test
+        and /debug surface; copies."""
+        with self._mutex:
+            return set(self._prewarm_desired), set(self._prewarm_idle)
 
     # -- reconciliation -------------------------------------------------------
 
@@ -506,13 +702,33 @@ class PartitionEngine:
         until the next plugin restart. Safe against in-flight
         attaches: a live prepare's claim reservation exists before
         attach runs, so a zero-holder record observed under the device
-        lock is genuinely orphaned. Returns partitions reaped."""
+        lock is genuinely orphaned. Records the current pre-warm hint
+        wants kept warm (set_prewarm) are deliberately zero-holder and
+        are skipped; once the forecast decays out of the hint, this
+        same sweep returns their chips. Returns partitions reaped."""
         reaped = 0
+        with self._mutex:
+            keep_warm = set(self._prewarm_desired)
         for name in sorted(self._checkpoint.get().claims):
             with self._dev_lock(name):
                 rec = self._record(name)
                 if rec is None or self._holders(name) > 0:
                     continue
+                if name in keep_warm and \
+                        rec.state == PARTITION_READY:
+                    # Intentionally warm: the forecast holds it. ONLY
+                    # Ready records qualify -- a zero-holder Creating/
+                    # Destroying record on a hint-desired name is a
+                    # crashed lifecycle this sweep must still settle,
+                    # never warm capacity.
+                    continue
                 self._teardown_locked(name, rec)
                 reaped += 1
+                with self._mutex:
+                    was_idle_warm = name in self._prewarm_idle
+                    self._prewarm_idle.discard(name)
+                if was_idle_warm and self.metrics is not None:
+                    # A forecasted-but-never-needed carve-out going
+                    # back: the forecaster's false-positive counter.
+                    self.metrics.inc_prewarm_reaped()
         return reaped

@@ -73,8 +73,8 @@ class PackingPlan:
 
 
 def pack_tenants(demands: list[PartitionDemand], chip_hbm: int,
-                 chips: int, max_tenants_per_chip: int | None = None
-                 ) -> PackingPlan:
+                 chips: int, max_tenants_per_chip: int | None = None,
+                 avoid: set[int] | None = None) -> PackingPlan:
     """Best-fit-decreasing co-location of tenants onto ``chips`` chips
     of ``chip_hbm`` HBM each.
 
@@ -83,7 +83,13 @@ def pack_tenants(demands: list[PartitionDemand], chip_hbm: int,
     TIGHTEST -- which is exactly what pairs a large tenant with the
     complementary small ones instead of spreading smalls across fresh
     chips. ``max_tenants_per_chip`` caps co-tenancy (the cooperative
-    time-slice client bound); None = HBM-bound only."""
+    time-slice client bound); None = HBM-bound only.
+
+    ``avoid`` names chip indices in an active telemetry anomaly
+    episode (power-cap throttling, duty-cycle straggling, thermal
+    drift -- pkg/anomaly.py): a tenant packs onto one ONLY when no
+    clean chip fits it. Pure preference -- a degraded chip still
+    carries load before a tenant goes unplaced."""
     expanded: list[PartitionDemand] = []
     for d in demands:
         for _ in range(max(d.count, 0)):
@@ -96,18 +102,26 @@ def pack_tenants(demands: list[PartitionDemand], chip_hbm: int,
                for i in range(chips)],
         unplaced=[],
     )
+    avoid = avoid or set()
     for demand in expanded:
         best: ChipPlan | None = None
+        best_avoided = True
         for chip in plan.chips:
             if chip.free_hbm < demand.hbm_bytes:
                 continue
             if max_tenants_per_chip is not None and \
                     len(chip.tenants) >= max_tenants_per_chip:
                 continue
-            if best is None or chip.free_hbm < best.free_hbm or (
-                    chip.free_hbm == best.free_hbm
-                    and chip.index < best.index):
+            avoided = chip.index in avoid
+            # A clean chip always out-ranks an avoided one; within a
+            # tier the historical tightest-fit rule decides.
+            if best is None or (best_avoided and not avoided) or (
+                    best_avoided == avoided
+                    and (chip.free_hbm < best.free_hbm
+                         or (chip.free_hbm == best.free_hbm
+                             and chip.index < best.index))):
                 best = chip
+                best_avoided = avoided
         if best is None:
             plan.unplaced.append(demand)
             continue

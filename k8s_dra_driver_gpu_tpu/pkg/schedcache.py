@@ -31,20 +31,70 @@ the reference driver consumes CRs exclusively through informer caches.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
+from functools import lru_cache
 from typing import Callable
 
 from . import tracing
 from .cel import CelProgram, Quantity, compile_expression
 from .informer import RELIST_PRIORITY, Informer, RelistCoordinator
 from .kubeclient import KubeError, NotFoundError
+from .topology.score import attr_int as _attr_int, \
+    device_headroom_penalty
 
 logger = logging.getLogger(__name__)
 
 RESOURCE = ("resource.k8s.io", "v1")
+
+# -- power as a budgeted resource (2501.17752) --------------------------------
+#
+# Per-host power is modeled like a KEP-4815 counter: every node has a
+# power cap (the slice attribute below, stamped by the node plugin from
+# its TPU_DRA_POWER_CAP_W, or the scheduler-side env default) and every
+# allocated device debits its expected draw -- the published rated
+# draw, falling back to the live telemetry attribute, falling back to
+# the TPU_DRA_CHIP_POWER_W default. ``AllocationState.try_commit``
+# judges the node budget atomically alongside the chip counters, so a
+# power-capped rack structurally cannot over-commit even under racing
+# workers. Caps/draws of 0 (the default) disable the model entirely --
+# the historical behavior.
+ATTR_POWER_CAP = "powerCapWatts"
+ATTR_POWER_RATED = "powerRatedWatts"
+# Mirror of pkg/fleetstate.ATTR_POWER (kept literal like CD_GROUP: the
+# attribute contract, not an import edge).
+ATTR_POWER_TELEMETRY = "telemetryPowerWatts"
+
+
+@lru_cache(maxsize=8)
+def _parse_watts(raw: str) -> int:
+    try:
+        return max(int(float(raw)), 0)
+    except ValueError:
+        return 0
+
+
+def power_cap_env(env=None) -> int:
+    """Scheduler-side default per-node power cap in watts
+    (``TPU_DRA_POWER_CAP_W``); 0 = no cap (model off) for nodes that
+    publish no ``powerCapWatts`` attribute. Called per node on the
+    fit/commit paths: the env read stays live (tests flip it), the
+    parse is memoized on the raw string."""
+    return _parse_watts((env or os.environ).get(
+        "TPU_DRA_POWER_CAP_W", "0"))
+
+
+def chip_power_default_env(env=None) -> int:
+    """Default expected draw in watts for a non-partition device that
+    publishes neither ``powerRatedWatts`` nor live telemetry
+    (``TPU_DRA_CHIP_POWER_W``); 0 = such devices debit nothing.
+    Same live-read/memoized-parse discipline as ``power_cap_env``
+    (called per device at snapshot build)."""
+    return _parse_watts((env or os.environ).get(
+        "TPU_DRA_CHIP_POWER_W", "0"))
 
 # ComputeDomain CRD coordinates (kept literal: importing the
 # computedomain package here would cycle through the plugin stack).
@@ -147,7 +197,8 @@ class CounterLedger:
 
 class Candidate:
     __slots__ = ("driver", "pool", "node", "device", "blocking_taints",
-                 "slots")
+                 "slots", "is_partition", "power_watts",
+                 "headroom_penalty")
 
     def __init__(self, driver, pool, node, device):
         self.driver = driver
@@ -161,18 +212,38 @@ class Candidate:
             t for t in device.get("taints") or []
             if t.get("effect") in ("NoSchedule", "NoExecute")
         ]
+        attrs = device.get("attributes") or {}
         # Shared-device tenant slots (pkg/partition oversubscription):
         # an ``oversubscribeSlots`` int attribute > 1 lets up to that
         # many claims hold the device concurrently; everything else is
         # exclusive (1). The device's consumesCounters are published
         # PER SLOT, so the counter ledger stays exact.
-        entry = (device.get("attributes") or {}).get(
-            "oversubscribeSlots")
+        entry = attrs.get("oversubscribeSlots")
         slots = entry.get("int", 1) if isinstance(entry, dict) else 1
         try:
             self.slots = max(int(slots), 1)
         except (TypeError, ValueError):
             self.slots = 1
+        part = attrs.get("partition")
+        self.is_partition = bool(
+            isinstance(part, dict) and part.get("bool"))
+        # Expected power draw (watts) this device debits from its
+        # node's power budget when allocated: the published rating,
+        # else the live telemetry attribute, else (for whole devices
+        # only -- a partition shares its parent chip's power, which
+        # the chip-level attributes already account for) the
+        # TPU_DRA_CHIP_POWER_W default. 0 = debits nothing.
+        self.power_watts = _attr_int(attrs, ATTR_POWER_RATED)
+        if self.power_watts <= 0:
+            self.power_watts = _attr_int(attrs, ATTR_POWER_TELEMETRY)
+        if self.power_watts <= 0 and not self.is_partition:
+            self.power_watts = chip_power_default_env()
+        # Telemetry-derived placement penalty (pkg/topology/score):
+        # >0 on chips in an active anomaly episode or out of power/
+        # thermal headroom -- the scheduler's candidate orderings sort
+        # these last (pure preference, never exclusion). Precomputed
+        # here so the per-claim fit touches an int, not taint lists.
+        self.headroom_penalty = device_headroom_penalty(device)
 
     @property
     def name(self):
@@ -206,7 +277,7 @@ class PoolSnapshot:
 
     __slots__ = ("driver", "pool", "generation", "slice_sigs",
                  "candidates", "by_node", "nodes", "counter_seeds",
-                 "sel_cache")
+                 "sel_cache", "node_power_caps")
 
     def __init__(self, driver: str, pool: str, slices: list[dict],
                  default_node: str | None = None):
@@ -243,6 +314,17 @@ class PoolSnapshot:
         for c in self.candidates:
             self.by_node.setdefault(c.node, []).append(c)
         self.nodes = frozenset(self.by_node)
+        # Per-node power cap (watts) from the ``powerCapWatts``
+        # attribute the node plugin stamps on its devices (the NODE
+        # cap, stamped identically on each -- max() tolerates a
+        # mid-upgrade mix): the seed of the per-host power budget.
+        self.node_power_caps: dict[str, int] = {}
+        for c in self.candidates:
+            cap = _attr_int(c.device.get("attributes") or {},
+                            ATTR_POWER_CAP)
+            if cap > 0:
+                self.node_power_caps[c.node] = max(
+                    self.node_power_caps.get(c.node, 0), cap)
         # (expression, device name) -> bool; pool-scoped so it shares
         # the PoolSnapshot's lifetime exactly.
         self.sel_cache: dict[tuple[str, str], bool] = {}
@@ -439,6 +521,19 @@ class InventorySnapshot:
                 ledger.seed(pk[0], pk[1], sets)
         return ledger
 
+    def power_cap_of(self, node: str) -> int:
+        """The node's power budget in watts (the published
+        ``powerCapWatts`` attribute, else the scheduler-side
+        TPU_DRA_POWER_CAP_W default); 0 = uncapped. Computed from the
+        per-pool shards on demand so the delta path maintains no extra
+        merged index."""
+        cap = 0
+        for pk in self._pools_of_node.get(node, ()):
+            pool = self.pools.get(pk)
+            if pool is not None:
+                cap = max(cap, pool.node_power_caps.get(node, 0))
+        return cap if cap > 0 else power_cap_env()
+
     def cel_match(self, expression: str, prog: CelProgram,
                   cand: Candidate) -> bool:
         pool = self.pools.get((cand.driver, cand.pool))
@@ -551,6 +646,11 @@ class AllocationState:
         self.allocated: set[tuple] = set()
         self._counts: dict[tuple, int] = {}
         self.node_load: dict[str, int] = {}
+        # Per-node power debits (watts) from held allocations: the
+        # spent half of the power budget try_commit judges against
+        # InventorySnapshot.power_cap_of. Mutated ONLY through
+        # power_debit/power_credit (lint rule TPUDRA015).
+        self.power_used: dict[str, int] = {}
         self._claims: dict[str, frozenset] = {}
         self._alloc_lock = threading.Lock()
         self._node_order: list[str] | None = None
@@ -580,10 +680,38 @@ class AllocationState:
             self.allocated = set()
             self._counts = {}
             self.node_load = {}
+            self.power_used = {}
             self._claims = {}
             self._node_order = None
             for claim in claims:
                 self._observe_locked(claim)
+
+    # -- power budget (mutations fenced by lint rule TPUDRA015) ---------------
+
+    def power_debit(self, node: str, watts: int) -> None:
+        """Debit one device's expected draw from its node's budget.
+        Caller holds ``_alloc_lock`` (called from the apply/retarget
+        paths only -- the TPUDRA015 fence keeps random call sites from
+        un-balancing the budget)."""
+        if watts > 0 and node:
+            self.power_used[node] = self.power_used.get(node, 0) + watts
+
+    def power_credit(self, node: str, watts: int) -> None:
+        """Undo a debit (release half; same discipline as
+        ``power_debit``)."""
+        if watts > 0 and node:
+            left = self.power_used.get(node, 0) - watts
+            if left > 0:
+                self.power_used[node] = left
+            else:
+                self.power_used.pop(node, None)
+
+    def power_snapshot(self) -> dict[str, int]:
+        """Consistent copy of per-node power debits (watts) for a
+        lock-free fit; try_commit re-judges before anything becomes
+        visible."""
+        with self._alloc_lock:
+            return dict(self.power_used)
 
     def retarget(self, snapshot: InventorySnapshot,
                  changed_pools) -> None:
@@ -625,6 +753,12 @@ class AllocationState:
                         self.node_load[old_cand.node] = left
                     else:
                         self.node_load.pop(old_cand.node, None)
+                    # Power draw re-derives from the NEW candidate's
+                    # attributes below: a telemetry/rating attribute
+                    # change is exactly the event that dirtied this
+                    # pool, so any debit/credit drift heals here.
+                    self.power_credit(old_cand.node,
+                                      old_cand.power_watts * count)
                 if new_cand is not None:
                     consumes = new_cand.device.get("consumesCounters")
                     for _ in range(count):
@@ -632,6 +766,8 @@ class AllocationState:
                                           consumes)
                     self.node_load[new_cand.node] = \
                         self.node_load.get(new_cand.node, 0) + count
+                    self.power_debit(new_cand.node,
+                                     new_cand.power_watts * count)
                 slots = new_cand.slots if new_cand is not None else 1
                 if count >= slots:
                     self.allocated.add(key)
@@ -685,6 +821,7 @@ class AllocationState:
                                   cand.device.get("consumesCounters"))
                 self.node_load[cand.node] = \
                     self.node_load.get(cand.node, 0) + 1
+                self.power_debit(cand.node, cand.power_watts)
                 self._node_order_drift += 1
         if keys:
             self._claims[cid] = keys
@@ -728,6 +865,7 @@ class AllocationState:
                 self._release_locked(prior)
                 self._claims.pop(cid, None)
             debited: list[Candidate] = []
+            power_want: dict[str, int] = {}
             ok = True
             for key in keys:
                 if key in self.allocated:
@@ -741,6 +879,21 @@ class AllocationState:
                         cand.driver, cand.pool, consumes):
                     ok = False
                     break
+                # Power budget (2501.17752): the claim's summed draw
+                # per node must fit under the node cap on top of what
+                # is already debited -- judged cumulatively so a
+                # multi-device claim can't pass N individual checks
+                # that together blow the rack budget.
+                if cand.power_watts > 0:
+                    want = power_want.get(cand.node, 0) + \
+                        cand.power_watts
+                    cap = self.snapshot.power_cap_of(cand.node)
+                    if cap > 0 and \
+                            self.power_used.get(cand.node, 0) + want \
+                            > cap:
+                        ok = False
+                        break
+                    power_want[cand.node] = want
                 # Debit as we go so multi-device claims can't pass N
                 # individual fits that overspend one shared counter.
                 self.ledger.debit(cand.driver, cand.pool, consumes)
@@ -789,6 +942,7 @@ class AllocationState:
             if cand is not None:
                 self.ledger.credit(cand.driver, cand.pool,
                                    cand.device.get("consumesCounters"))
+                self.power_credit(cand.node, cand.power_watts)
                 self._node_order_drift += 1
                 left = self.node_load.get(cand.node, 0) - 1
                 if left > 0:

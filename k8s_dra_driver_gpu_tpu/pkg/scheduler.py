@@ -897,10 +897,13 @@ class DraScheduler:
                 # probed node: the fit is optimistic anyway (try_commit
                 # re-judges budgets at reserve time), so a pending
                 # claim walking all 1000 nodes doesn't pay 1000 locked
-                # copies.
+                # copies. The power-debit view rides along the same
+                # way (one copy per attempt, re-judged at reserve).
                 ledger = alloc.ledger_snapshot()
+                power = alloc.power_snapshot()
                 outcome = self._try_nodes(claim, nodes, window, snap,
-                                          alloc, ledger, classes)
+                                          alloc, ledger, classes,
+                                          power)
                 if outcome == "committed":
                     self._clear_domain_exhausted(claim)
                     break
@@ -934,7 +937,8 @@ class DraScheduler:
 
     def _try_nodes(self, claim, nodes: list[str], window: set,
                    snap: InventorySnapshot, alloc: AllocationState,
-                   ledger: _CounterLedger, classes) -> str:
+                   ledger: _CounterLedger, classes,
+                   power: dict | None = None) -> str:
         """Walk the candidate nodes under per-node locks; window gangs
         take their whole (sorted) window lock set in ONE acquisition so
         two gangs overlapping on any node cannot deadlock. Returns
@@ -944,7 +948,8 @@ class DraScheduler:
             if win_nodes:
                 with self._node_locks.hold(win_nodes):
                     out = self._fit_and_commit(claim, win_nodes, snap,
-                                               alloc, ledger, classes)
+                                               alloc, ledger, classes,
+                                               power)
                 if out != "unfit":
                     return out
             rest = [n for n in nodes if n not in window]
@@ -953,21 +958,21 @@ class DraScheduler:
         for node in rest:
             with self._node_locks.hold((node,)):
                 out = self._fit_and_commit(claim, (node,), snap, alloc,
-                                           ledger, classes)
+                                           ledger, classes, power)
             if out != "unfit":
                 return out
         return "unfit"
 
     def _fit_and_commit(self, claim, nodes, snap: InventorySnapshot,
                         alloc: AllocationState, ledger: _CounterLedger,
-                        classes) -> str:
+                        classes, power: dict | None = None) -> str:
         """Fit + commit on the first of ``nodes`` that satisfies the
         claim. Caller holds the node locks for every entry, so the
         allocation state for these nodes is quiescent apart from
         cross-node counter races (which try_commit catches)."""
         for node in nodes:
             picks = self._fit_on_node(claim, node, snap, alloc.allocated,
-                                      ledger, classes)
+                                      ledger, classes, power=power)
             if picks is None:
                 continue
             alloc_obj = self._build_alloc_obj(claim, node, picks, classes)
@@ -1083,8 +1088,15 @@ class DraScheduler:
                     ordered = hit
                 else:
                     grid = self._grid_for(group)
-                    ordered = topo_order_candidates(grid, list(names),
-                                                    want)
+                    # Power/thermal headroom term: placements touching
+                    # degraded chips rank last (pure preference; the
+                    # penalties derive from the same pool content the
+                    # memo is invalidated on, so the memo stays safe).
+                    penalties = {c.name: c.headroom_penalty
+                                 for c in group if c.headroom_penalty}
+                    ordered = topo_order_candidates(
+                        grid, list(names), want,
+                        penalties=penalties or None)
                     snap.order_memo_put(key, ordered)
             if ordered is None:
                 out.extend(group)
@@ -1161,7 +1173,8 @@ class DraScheduler:
             self.metrics.largest_shape.labels(label).set(chips)
 
     def _fit_on_node(self, claim, node, snap: InventorySnapshot,
-                     allocated: set, ledger: _CounterLedger, classes):
+                     allocated: set, ledger: _CounterLedger, classes,
+                     power: dict | None = None):
         """All requests of one claim against one node; returns
         [(request, candidate, class_name)] or None. ``allocated`` is
         only ever probed for membership (safe against concurrent
@@ -1169,7 +1182,10 @@ class DraScheduler:
         the fit itself runs lock-free; the atomic try_commit re-judges
         both before anything becomes visible. Counter fits are
         checked against a tentative ledger so multi-device claims can't
-        double-spend.
+        double-spend. ``power`` is the per-node power-debit view: on a
+        power-capped node the picks' summed expected draw must fit
+        under the remaining budget (2501.17752's power-as-a-counter
+        model; try_commit re-judges atomically).
 
         ``spec.devices.constraints[].matchAttribute`` (KEP-4381): every
         device allocated for the constraint's requests (all requests
@@ -1223,6 +1239,16 @@ class DraScheduler:
             for r in reqs:
                 r["cands"] = self._topology_order(snap, r["cands"],
                                                  r["want"])
+        # Thermal/straggler-aware bias: candidates in an active
+        # anomaly episode (or out of power/thermal headroom) sort LAST
+        # -- a stable partition, so within each health tier the
+        # topology (or first-fit) order above survives verbatim. Pure
+        # preference: a degraded chip is still picked when nothing
+        # clean satisfies the request (the last-resort contract).
+        for r in reqs:
+            if any(c.headroom_penalty for c in r["cands"]):
+                r["cands"] = sorted(r["cands"],
+                                    key=lambda c: c.headroom_penalty)
         hint = self._defrag_hint(claim)
         if hint is not None and hint[0] == node:
             # Defrag placement hint: the controller's planned target
@@ -1255,6 +1281,12 @@ class DraScheduler:
         spent._avail = {k: dict(v) for k, v in ledger._avail.items()}
         cvals: list = [None] * len(constraints)
         state = {"steps": 0}
+        # Remaining node power budget for this fit (None = uncapped).
+        # A one-cell list so the DFS's try_pick/undo closures can
+        # debit/credit it like the tentative counter ledger.
+        power_cap = snap.power_cap_of(node)
+        power_left = ([power_cap - (power or {}).get(node, 0)]
+                      if power_cap > 0 else None)
 
         def applies(ci, req_name):
             want = constraints[ci]["requests"]
@@ -1266,6 +1298,9 @@ class DraScheduler:
             consumes = cand.device.get("consumesCounters")
             if not spent.fits(cand.driver, cand.pool, consumes):
                 return None
+            if power_left is not None and cand.power_watts > 0 and \
+                    cand.power_watts > power_left[0]:
+                return None  # node power budget exhausted
             set_cis = []
             for ci, c in enumerate(constraints):
                 if not applies(ci, req["name"]):
@@ -1281,11 +1316,15 @@ class DraScheduler:
                 if ci in set_cis:
                     cvals[ci] = self._attr_value(cand, c["attr"])
             spent.debit(cand.driver, cand.pool, consumes)
+            if power_left is not None:
+                power_left[0] -= cand.power_watts
             taken.add(cand.key)
 
             def undo():
                 taken.discard(cand.key)
                 spent.credit(cand.driver, cand.pool, consumes)
+                if power_left is not None:
+                    power_left[0] += cand.power_watts
                 for ci in set_cis:
                     cvals[ci] = None
             return undo

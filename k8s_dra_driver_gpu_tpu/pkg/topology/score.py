@@ -24,16 +24,96 @@ fallback still produces compact -- just not box-shaped -- sets.
 
 from __future__ import annotations
 
+import os
 import threading
+from functools import lru_cache
 
 from .grid import Coord, TorusGrid
 from .shapes import enumerate_shapes, placements, shapes_for_count
+
+
+def attr_int(attrs: dict, name: str) -> int:
+    """Quantized int device attribute (`{"int": N}` entries), 0 when
+    absent/malformed — THE parse for the telemetry/power attribute
+    contract, shared by the scorer, pkg/schedcache and
+    pkg/fleetstate so the three readers can never drift."""
+    entry = attrs.get(name)
+    if isinstance(entry, dict) and "int" in entry:
+        try:
+            return int(entry["int"])
+        except (TypeError, ValueError):
+            return 0
+    return 0
 
 
 def set_compactness(grid: TorusGrid, cells: set[Coord]
                     ) -> tuple[int, int]:
     """(max ICI hops, exposed surface area) -- lower is tighter."""
     return (grid.max_hops(cells), grid.surface_area(cells))
+
+
+# -- power / thermal headroom (2501.17752: telemetry as a placement
+# signal). Chips in an active anomaly episode of these kinds carry a
+# non-fatal ``tpu.dra.dev/<kind>`` device taint (pkg/anomaly.py via the
+# health poll); the scorer treats them as last-resort picks. Pure
+# PREFERENCE below the quarantine threshold: the fit semantics
+# (selectors, counters, matchAttributes) never change -- a degraded
+# chip is still used when no clean peer satisfies the claim.
+AVOID_TAINT_KINDS = ("power_cap_throttle", "duty_cycle_straggler",
+                     "thermal_drift")
+#: Penalty weight of an active avoid-kind anomaly taint.
+PENALTY_ANOMALY = 4
+#: ...of low power headroom (telemetry draw near the node cap share).
+PENALTY_POWER = 2
+#: ...of low thermal headroom (die temp at/above the soft limit).
+PENALTY_THERMAL = 1
+#: Power-headroom threshold: telemetry draw >= this fraction of the
+#: device's rated/cap share counts as "no headroom".
+POWER_HEADROOM_FRACTION = 0.9
+
+
+@lru_cache(maxsize=8)
+def _parse_limit(raw: str) -> float:
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        return 0.0
+
+
+def _soft_temp_limit_c() -> float:
+    """``TPU_DRA_TEMP_SOFT_LIMIT_C``: die temperature above which a
+    chip loses thermal-headroom preference (0 disables). Called per
+    device per snapshot build: the env read stays live (tests flip
+    it) but the parse is memoized on the raw string."""
+    return _parse_limit(os.environ.get(
+        "TPU_DRA_TEMP_SOFT_LIMIT_C", "0"))
+
+
+def device_headroom_penalty(device: dict,
+                            temp_limit_c: float | None = None) -> int:
+    """Telemetry-derived placement penalty for one published device
+    (0 = healthy). Summed per candidate placement by
+    :func:`rank_placements` and used as a stable-sort key by the
+    scheduler's fallback ordering -- higher sorts later, never out."""
+    penalty = 0
+    for taint in device.get("taints") or []:
+        key = taint.get("key", "")
+        if any(key.endswith("/" + kind) or key == kind
+               for kind in AVOID_TAINT_KINDS):
+            penalty += PENALTY_ANOMALY
+            break  # one anomaly penalty per device, not per kind
+    attrs = device.get("attributes") or {}
+    power = attr_int(attrs, "telemetryPowerWatts")
+    rated = attr_int(attrs, "powerRatedWatts")
+    if power > 0 and rated > 0 and \
+            power >= rated * POWER_HEADROOM_FRACTION:
+        penalty += PENALTY_POWER
+    temp = attr_int(attrs, "telemetryTempCelsius")
+    limit = _soft_temp_limit_c() if temp_limit_c is None \
+        else temp_limit_c
+    if temp > 0 and limit > 0 and temp >= limit:
+        penalty += PENALTY_THERMAL
+    return penalty
 
 
 def _protected_shapes(grid: TorusGrid) -> list[tuple[int, int, int]]:
@@ -184,13 +264,20 @@ def _greedy_sets(grid: TorusGrid, free: set[Coord], count: int
     return out
 
 
-def rank_placements(grid: TorusGrid, free_names: list[str], count: int
+def rank_placements(grid: TorusGrid, free_names: list[str], count: int,
+                    penalties: dict[str, int] | None = None
                     ) -> list[list[str]]:
     """Candidate device sets for a ``count``-chip claim, best first.
 
     Only names with coordinates participate; an empty result means the
     caller should keep its first-fit order (no grid information, or
     count exceeds the coordinated free chips).
+
+    ``penalties`` (device name -> headroom penalty,
+    :func:`device_headroom_penalty`) is the power/thermal term: a
+    placement touching a throttling / thermally-drifting / straggling
+    chip ranks below every clean placement, but stays in the list --
+    last resort, never excluded.
     """
     if count < 1:
         return []
@@ -208,6 +295,7 @@ def rank_placements(grid: TorusGrid, free_names: list[str], count: int
     # One coord->name inversion for every candidate (cell_names would
     # rebuild it per placement).
     by_coord = {c: n for n, c in grid.coords.items()}
+    penalties = penalties or {}
     scored = []
     for cells in candidates:
         cellset = set(cells)
@@ -216,24 +304,28 @@ def rank_placements(grid: TorusGrid, free_names: list[str], count: int
             continue  # a cell with no published device: not realizable
         max_hops, surface = set_compactness(grid, cellset)
         scored.append((
+            sum(penalties.get(n, 0) for n in names),
             max_hops,
             frag_cost(cellset, inventory),
             surface,
             sorted(names),
             names,
         ))
-    scored.sort(key=lambda t: t[:4])
-    return [t[4] for t in scored]
+    scored.sort(key=lambda t: t[:5])
+    return [t[5] for t in scored]
 
 
-def order_candidates(grid: TorusGrid, free_names: list[str], count: int
+def order_candidates(grid: TorusGrid, free_names: list[str], count: int,
+                     penalties: dict[str, int] | None = None
                      ) -> list[str] | None:
     """A full preference ordering of ``free_names`` for a backtracking
     allocator: the best-ranked placement's devices first, then each
     next placement's unseen devices, then any remaining names in their
     original (first-fit) order. None = no topology signal; keep the
-    caller's order."""
-    ranked = rank_placements(grid, free_names, count)
+    caller's order. ``penalties`` biases the ranking away from
+    degraded chips (see :func:`rank_placements`)."""
+    ranked = rank_placements(grid, free_names, count,
+                             penalties=penalties)
     if not ranked:
         return None
     ordered: list[str] = []

@@ -973,6 +973,71 @@ class TestPartitionSpecRule:
             lint_source(src, rel="kubeletplugin/driver.py"))
 
 
+class TestPowerPrewarmMutationRule:
+    """TPUDRA015: AllocationState.power_debit/power_credit are fenced
+    to pkg/schedcache.py and PartitionEngine.set_prewarm to the engine
+    + the node driver's CRD-watch path (rel-path sanctioned like
+    TPUDRA011/013/014)."""
+
+    def test_power_debit_outside_flagged(self):
+        src = ("def bad(alloc, node):\n"
+               "    alloc.power_debit(node, 250)\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        assert "TPUDRA015" in rules_of(findings)
+
+    def test_power_credit_outside_flagged(self):
+        src = ("def bad(self, node):\n"
+               "    self._alloc.power_credit(node, 250)\n")
+        findings = lint_source(src, rel="pkg/recovery.py")
+        assert "TPUDRA015" in rules_of(findings)
+
+    def test_power_mutation_definition_site_sanctioned(self):
+        src = ("class AllocationState:\n"
+               "    def _apply_locked(self, cand):\n"
+               "        self.power_debit(cand.node, cand.power_watts)\n")
+        assert "TPUDRA015" not in rules_of(
+            lint_source(src, rel="pkg/schedcache.py"))
+
+    def test_stray_schedcache_not_sanctioned(self):
+        src = ("def bad(alloc):\n"
+               "    alloc.power_debit('n', 1)\n")
+        findings = lint_source(src,
+                               rel="computedomain/schedcache.py")
+        assert "TPUDRA015" in rules_of(findings)
+
+    def test_power_snapshot_read_stays_open(self):
+        src = ("def good(alloc):\n"
+               "    return alloc.power_snapshot()\n")
+        assert "TPUDRA015" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+    def test_set_prewarm_outside_flagged(self):
+        src = ("def bad(engine):\n"
+               "    engine.set_prewarm({'web-s8': 4})\n")
+        findings = lint_source(src, rel="pkg/autoscale/controller.py")
+        assert "TPUDRA015" in rules_of(findings)
+
+    def test_set_prewarm_driver_path_sanctioned(self):
+        src = ("def apply_prewarm(self, hints):\n"
+               "    return self.state.partition_engine.set_prewarm(\n"
+               "        hints or {})\n")
+        assert "TPUDRA015" not in rules_of(
+            lint_source(src, rel="kubeletplugin/driver.py"))
+
+    def test_set_prewarm_engine_sanctioned(self):
+        src = ("class PartitionEngine:\n"
+               "    def apply(self, ps):\n"
+               "        self.set_prewarm({})\n")
+        assert "TPUDRA015" not in rules_of(
+            lint_source(src, rel="pkg/partition/engine.py"))
+
+    def test_stray_engine_not_sanctioned(self):
+        src = ("def bad(engine):\n"
+               "    engine.set_prewarm({})\n")
+        findings = lint_source(src, rel="computedomain/engine.py")
+        assert "TPUDRA015" in rules_of(findings)
+
+
 class TestWholePackageGate:
     """The tier-1 CI gate from ISSUE 3: zero non-baselined findings
     over the shipped package, with the committed baseline EMPTY (every

@@ -1,0 +1,64 @@
+"""Tier-1 power-sched smoke: the `make bench-powersched-smoke`
+contract as a non-slow test. Runs bench.py --powersched at reduced
+scale and asserts the telemetry->placement acceptance bar: pre-warming
+cuts burst attach p99 >= 3x vs the cold lazy-create path with every
+warm attach a counted pre-warm hit, and the power-capped-rack chaos
+run sheds load with zero claim-e2e SLO breaches, zero pending, zero
+per-node power over-commit recomputed from the final allocations,
+last-resort-only use of the anomaly-tainted chip, and converged
+steady-state passes at zero kube writes -- plus the
+BENCH_powersched.json trajectory file actually written."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-powersched-smoke target.
+SMOKE_ENV = {
+    "BENCH_POWERSCHED_NODES": "4",
+    "BENCH_POWERSCHED_ROUNDS": "2",
+    "BENCH_POWERSCHED_MIN_PREWARM_RATIO": "3.0",
+}
+
+
+def test_bench_powersched_smoke_closes_the_loop(tmp_path):
+    out_json = tmp_path / "BENCH_powersched.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--powersched"],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV,
+             "BENCH_POWERSCHED_OUT": str(out_json)},
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "powersched_prewarm_speedup"
+    extras = doc["extras"]
+
+    # THE latency bar: warm attaches >= 3x faster at p99 than cold
+    # lazy creates, and every one of them hit a pre-warmed carve-out.
+    assert doc["value"] >= 3.0
+    assert extras["powersched_warm_attach_p99_ms"] is not None
+    assert extras["powersched_prewarm_hits"] == \
+        extras["powersched_prewarm_expected_hits"] > 0
+    assert extras["powersched_cold_hits"] == 0
+
+    # The power-capped rack sheds load instead of breaching:
+    # everything allocated, inside the SLO, and the recomputed
+    # per-node power audit stays under every cap.
+    assert extras["powersched_pending"] == 0
+    assert extras["powersched_slo_breaches"] == 0
+    assert extras["powersched_power_overcommit"] == 0
+    for node, used in extras["powersched_capped_rack_used_w"].items():
+        assert used <= extras["powersched_rack_cap_w"], node
+
+    # Anomaly avoidance is preference, not exclusion; steady state
+    # stays write-free.
+    assert extras["powersched_tainted_chip_avoid_ok"] == 1
+    assert extras["powersched_steady_writes"] == 0
+
+    recorded = json.loads(out_json.read_text())
+    assert recorded["metric"] == "powersched_prewarm_speedup"

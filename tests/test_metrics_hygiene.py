@@ -207,6 +207,31 @@ PRODUCERS = {
 }
 
 
+# Same producer-proof contract for the ISSUE-15 power/pre-warm
+# counters and gauge (they are not histograms, so the AST scan above
+# does not see them): metric name -> call-site regex that must match
+# in the package tree outside pkg/metrics.py.
+COUNTER_PRODUCERS = {
+    "tpu_dra_fleet_power_headroom_watts": r"set_pool_power",
+    "tpu_dra_prewarm_created_total": r"inc_prewarm_created\(",
+    "tpu_dra_prewarm_hit_total": r"inc_prewarm_hit\(",
+    "tpu_dra_prewarm_reaped_total": r"inc_prewarm_reaped\(",
+}
+
+
+def test_power_prewarm_metrics_have_producers():
+    sources = list(_package_sources())
+    for metric, pattern in COUNTER_PRODUCERS.items():
+        rx = re.compile(pattern)
+        hits = [path for path, text in sources
+                if rx.search(text)
+                and not path.endswith(os.path.join("pkg",
+                                                   "metrics.py"))]
+        assert hits, (
+            f"{metric!r} has no producer call site matching "
+            f"{pattern!r} outside pkg/metrics.py -- dead metric")
+
+
 def _package_sources():
     for root, _dirs, files in os.walk(PKG_DIR):
         if "__pycache__" in root:

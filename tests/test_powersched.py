@@ -1,0 +1,771 @@
+"""Telemetry->placement loop (ISSUE 15): power as a budgeted resource
+(per-node caps seeded from slice attributes, debited atomically in
+AllocationState.try_commit), thermal/straggler-aware candidate
+ordering (anomaly-episode avoidance as pure preference), the
+FleetAggregator power-carry fix + per-pool power headroom, the demand
+forecaster over the fleet rings, and the predictive pre-warm
+lifecycle (engine set_prewarm -> warm attach -> idle reap)."""
+
+import json
+import time
+
+from prometheus_client import generate_latest
+
+from k8s_dra_driver_gpu_tpu.kubeletplugin.device_state import (
+    Config,
+    DeviceState,
+)
+from k8s_dra_driver_gpu_tpu.pkg import fleetstate
+from k8s_dra_driver_gpu_tpu.pkg.autoscale import crd as crdmod
+from k8s_dra_driver_gpu_tpu.pkg.autoscale.controller import (
+    AutoscaleController,
+)
+from k8s_dra_driver_gpu_tpu.pkg.autoscale.forecast import (
+    DemandForecaster,
+)
+from k8s_dra_driver_gpu_tpu.pkg.autoscale.nodewatch import (
+    PartitionSetWatcher,
+)
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+from k8s_dra_driver_gpu_tpu.pkg.metrics import (
+    FleetMetrics,
+    PartitionMetrics,
+)
+from k8s_dra_driver_gpu_tpu.pkg.partition import (
+    PartitionDemand,
+    pack_tenants,
+)
+from k8s_dra_driver_gpu_tpu.pkg.partition.profiles import (
+    TenantProfileStore,
+)
+from k8s_dra_driver_gpu_tpu.pkg.schedcache import (
+    AllocationState,
+    InventorySnapshot,
+)
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+from k8s_dra_driver_gpu_tpu.pkg.topology.score import (
+    device_headroom_penalty,
+    rank_placements,
+)
+from k8s_dra_driver_gpu_tpu.pkg.topology import TorusGrid
+
+RES = ("resource.k8s.io", "v1")
+CRD = ("resource.tpu.dra", "v1beta1", "partitionsets")
+DRIVER = "tpu.dra.dev"
+GATES = ("DynamicSubSlice=true,TimeSlicingSettings=true,"
+         "MultiTenancySupport=true,TenantPartitioning=true")
+
+
+def make_slice(node="n0", chips=4, cap_w=0, rated_w=0, power_w=0,
+               taints=None, gen=1, grid=(2, 2)):
+    """One node-local pool slice; per-chip power attributes and
+    optional anomaly taints on named chips."""
+    devices = []
+    for i in range(chips):
+        attrs = {
+            "iciX": {"int": i % grid[0]},
+            "iciY": {"int": i // grid[0]},
+            "iciZ": {"int": 0},
+            "topology": {"string": f"{grid[0]}x{grid[1]}"},
+        }
+        if cap_w:
+            attrs["powerCapWatts"] = {"int": cap_w}
+        if rated_w:
+            attrs["powerRatedWatts"] = {"int": rated_w}
+        if power_w:
+            attrs[fleetstate.ATTR_POWER] = {"int": power_w}
+        dev = {"name": f"chip-{i}", "attributes": attrs,
+               "capacity": {}}
+        per_chip = (taints or {}).get(f"chip-{i}")
+        if per_chip:
+            dev["taints"] = per_chip
+        devices.append(dev)
+    return {
+        "metadata": {"name": f"{node}-slice"},
+        "spec": {
+            "driver": DRIVER, "nodeName": node,
+            "pool": {"name": node, "generation": gen,
+                     "resourceSliceCount": 1},
+            "devices": devices,
+        },
+    }
+
+
+def anomaly_taint(kind="power_cap_throttle"):
+    """The non-fatal observe-only taint pkg/anomaly.py publishes."""
+    return [{"key": f"tpu.dra.dev/{kind}", "value": "true",
+             "effect": ""}]
+
+
+def allocated_claim(uid, devices, node="n0"):
+    return {
+        "metadata": {"uid": uid, "namespace": "default", "name": uid},
+        "status": {"allocation": {"devices": {"results": [
+            {"request": "tpu", "driver": DRIVER, "pool": node,
+             "device": d} for d in devices]}}},
+    }
+
+
+def make_kube(slices):
+    kube = FakeKubeClient()
+    kube.create(*RES, "deviceclasses", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": "tpu"}, "spec": {},
+    })
+    for s in slices:
+        kube.create(*RES, "resourceslices", s)
+    return kube
+
+
+def make_claim(kube, name, count=1):
+    exactly = {"deviceClassName": "tpu"}
+    if count != 1:
+        exactly["count"] = count
+    return kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"devices": {"requests": [
+            {"name": "tpu", "exactly": exactly}]}},
+    }, namespace="default")
+
+
+def allocation(kube, name):
+    return kube.get(*RES, "resourceclaims", name, "default").get(
+        "status", {}).get("allocation")
+
+
+# -- power as a budgeted resource ---------------------------------------------
+
+
+class TestPowerBudget:
+    def test_try_commit_rejects_power_overcommit(self):
+        # cap 250 W, 100 W/chip: two chips fit, the third must not.
+        snap = InventorySnapshot([make_slice(cap_w=250, rated_w=100)])
+        alloc = AllocationState(snap)
+        assert alloc.try_commit(allocated_claim("u1", ["chip-0"]))
+        assert alloc.try_commit(allocated_claim("u2", ["chip-1"]))
+        assert not alloc.try_commit(allocated_claim("u3", ["chip-2"]))
+        assert alloc.power_snapshot() == {"n0": 200}
+
+    def test_multi_device_claim_judged_cumulatively(self):
+        # 250 W cap: a 3-chip claim (300 W) must fail as a WHOLE even
+        # though each chip individually fits.
+        snap = InventorySnapshot([make_slice(cap_w=250, rated_w=100)])
+        alloc = AllocationState(snap)
+        assert not alloc.try_commit(allocated_claim(
+            "u1", ["chip-0", "chip-1", "chip-2"]))
+        assert alloc.power_snapshot() == {}  # failed reserve leaks nothing
+        assert alloc.try_commit(allocated_claim(
+            "u2", ["chip-0", "chip-1"]))
+
+    def test_release_restores_budget(self):
+        snap = InventorySnapshot([make_slice(cap_w=100, rated_w=100)])
+        alloc = AllocationState(snap)
+        claim = allocated_claim("u1", ["chip-0"])
+        assert alloc.try_commit(claim)
+        assert not alloc.try_commit(allocated_claim("u2", ["chip-1"]))
+        alloc.forget(claim)
+        assert alloc.power_snapshot() == {}
+        assert alloc.try_commit(allocated_claim("u2", ["chip-1"]))
+
+    def test_telemetry_attr_is_the_draw_fallback(self):
+        # No rating published: the live telemetry attribute debits.
+        snap = InventorySnapshot([make_slice(cap_w=150, power_w=120)])
+        alloc = AllocationState(snap)
+        assert alloc.try_commit(allocated_claim("u1", ["chip-0"]))
+        assert not alloc.try_commit(allocated_claim("u2", ["chip-1"]))
+
+    def test_uncapped_node_never_rejects_on_power(self):
+        snap = InventorySnapshot([make_slice(rated_w=100)])
+        alloc = AllocationState(snap)
+        for i in range(4):
+            assert alloc.try_commit(
+                allocated_claim(f"u{i}", [f"chip-{i}"]))
+
+    def test_env_cap_applies_to_attributeless_nodes(self, monkeypatch):
+        monkeypatch.setenv("TPU_DRA_POWER_CAP_W", "150")
+        monkeypatch.setenv("TPU_DRA_CHIP_POWER_W", "100")
+        snap = InventorySnapshot([make_slice()])
+        alloc = AllocationState(snap)
+        assert alloc.try_commit(allocated_claim("u1", ["chip-0"]))
+        assert not alloc.try_commit(allocated_claim("u2", ["chip-1"]))
+
+    def test_rebuild_recomputes_power(self):
+        snap = InventorySnapshot([make_slice(cap_w=400, rated_w=100)])
+        alloc = AllocationState(snap)
+        alloc.rebuild([allocated_claim("u1", ["chip-0", "chip-1"])])
+        assert alloc.power_snapshot() == {"n0": 200}
+        alloc.rebuild([])
+        assert alloc.power_snapshot() == {}
+
+    def test_scheduler_sheds_load_to_uncapped_node(self):
+        # n0 is power-capped to one chip's draw; n1 is uncapped. Four
+        # single-chip claims: exactly one lands on n0, the rest shed
+        # to n1, nothing pends (the zero-SLO-breach shape the chaos
+        # bench gates at scale).
+        kube = make_kube([
+            make_slice(node="n0", cap_w=100, rated_w=100),
+            make_slice(node="n1", rated_w=100),
+        ])
+        for i in range(4):
+            make_claim(kube, f"c{i}")
+        sched = DraScheduler(kube)
+        sched.sync_once()
+        by_node = {"n0": 0, "n1": 0}
+        for i in range(4):
+            alloc = allocation(kube, f"c{i}")
+            assert alloc, f"claim c{i} pending"
+            sel = alloc["nodeSelector"]["nodeSelectorTerms"][0][
+                "matchFields"][0]["values"][0]
+            by_node[sel] += 1
+        assert by_node["n0"] == 1  # cap structurally enforced
+        assert by_node["n1"] == 3
+
+    def test_power_capped_pool_pends_overflow(self):
+        kube = make_kube([make_slice(cap_w=200, rated_w=100)])
+        for i in range(3):
+            make_claim(kube, f"c{i}")
+        sched = DraScheduler(kube)
+        sched.sync_once()
+        allocated = sum(1 for i in range(3)
+                        if allocation(kube, f"c{i}"))
+        assert allocated == 2  # 200 W budget = two 100 W chips
+
+
+# -- anomaly-episode placement avoidance --------------------------------------
+
+
+class TestAnomalyAvoidance:
+    def test_throttling_chip_skipped_while_clean_peer_exists(self):
+        kube = make_kube([make_slice(
+            taints={"chip-0": anomaly_taint("power_cap_throttle")})])
+        make_claim(kube, "c1")
+        sched = DraScheduler(kube)
+        sched.sync_once()
+        alloc = allocation(kube, "c1")
+        assert alloc
+        assert alloc["devices"]["results"][0]["device"] != "chip-0"
+
+    def test_throttling_chip_still_used_as_last_resort(self):
+        kube = make_kube([make_slice(
+            taints={"chip-0": anomaly_taint("duty_cycle_straggler")})])
+        for i in range(4):
+            make_claim(kube, f"c{i}")
+        sched = DraScheduler(kube)
+        sched.sync_once()
+        devices = set()
+        for i in range(4):
+            alloc = allocation(kube, f"c{i}")
+            assert alloc, "anomaly avoidance must never EXCLUDE"
+            devices.add(alloc["devices"]["results"][0]["device"])
+        assert devices == {"chip-0", "chip-1", "chip-2", "chip-3"}
+
+    def test_thermal_drift_taint_biases_too(self):
+        kube = make_kube([make_slice(
+            taints={"chip-1": anomaly_taint("thermal_drift")})])
+        for i in range(3):
+            make_claim(kube, f"c{i}")
+        sched = DraScheduler(kube)
+        sched.sync_once()
+        devices = {allocation(kube, f"c{i}")["devices"]["results"][0]
+                   ["device"] for i in range(3)}
+        assert "chip-1" not in devices
+
+    def test_headroom_penalty_terms(self, monkeypatch):
+        assert device_headroom_penalty({"taints": anomaly_taint()}) > 0
+        assert device_headroom_penalty({}) == 0
+        # power near the rated cap = lost headroom
+        assert device_headroom_penalty({"attributes": {
+            "telemetryPowerWatts": {"int": 95},
+            "powerRatedWatts": {"int": 100},
+        }}) > 0
+        assert device_headroom_penalty({"attributes": {
+            "telemetryPowerWatts": {"int": 50},
+            "powerRatedWatts": {"int": 100},
+        }}) == 0
+        monkeypatch.setenv("TPU_DRA_TEMP_SOFT_LIMIT_C", "90")
+        assert device_headroom_penalty({"attributes": {
+            "telemetryTempCelsius": {"int": 95},
+        }}) > 0
+
+    def test_rank_placements_penalty_outranks_compactness(self):
+        # 2x2 grid, 2-chip claim: the compact pair containing the
+        # penalized chip must rank below a clean pair.
+        slice_obj = make_slice()
+        grid = TorusGrid.from_devices(slice_obj["spec"]["devices"])
+        names = [f"chip-{i}" for i in range(4)]
+        clean = rank_placements(grid, names, 2)
+        biased = rank_placements(grid, names, 2,
+                                 penalties={"chip-0": 4})
+        assert clean  # sanity
+        assert "chip-0" in clean[0] or "chip-0" in clean[1]
+        assert "chip-0" not in biased[0]
+
+    def test_pack_tenants_avoid_bias(self):
+        demands = [PartitionDemand(hbm_bytes=4, cores=1, count=2,
+                                   tenant="t")]
+        plan = pack_tenants(demands, chip_hbm=8, chips=3,
+                            avoid={0})
+        used = {c.index for c in plan.chips if c.tenants}
+        assert 0 not in used
+        # last resort: only avoided chips left -> still placed
+        plan2 = pack_tenants(
+            [PartitionDemand(hbm_bytes=4, cores=1, count=3,
+                             tenant="t")],
+            chip_hbm=4, chips=3, avoid={0, 1, 2})
+        assert not plan2.unplaced
+
+
+# -- fleet power carry + headroom ---------------------------------------------
+
+
+class TestFleetPower:
+    def test_pool_power_and_headroom(self):
+        snap = InventorySnapshot([make_slice(cap_w=500, power_w=120)])
+        fleet = fleetstate.FleetAggregator()
+        points = fleet.observe_pass(snap, AllocationState(snap), 0)
+        point = points[(DRIVER, "n0")]
+        assert point["power_watts"] == 480
+        assert point["power_cap_watts"] == 500
+        assert point["power_headroom_watts"] == 20
+
+    def test_dropped_power_sample_carries_last_reading(self):
+        """Satellite bug fix: a missing/zero power attribute must NOT
+        fold as 0 W (fake headroom) while the carry TTL holds."""
+        fleet = fleetstate.FleetAggregator()
+        hot = InventorySnapshot([make_slice(cap_w=500, power_w=120)])
+        fleet.observe_pass(hot, AllocationState(hot), 0)
+        # the power attribute vanishes (dropped poll), cap stays
+        dropped = InventorySnapshot([make_slice(cap_w=500)])
+        points = fleet.observe_pass(dropped, AllocationState(dropped),
+                                    0)
+        point = points[(DRIVER, "n0")]
+        assert point["power_watts"] == 480  # carried, not 0
+        assert point["power_headroom_watts"] == 20
+        assert fleet.snapshot()["nodes"]["n0"]["power_watts"] == 480
+
+    def test_carry_expires_after_ttl(self, monkeypatch):
+        monkeypatch.setattr(fleetstate, "POWER_SAMPLE_TTL_S", 0.05)
+        fleet = fleetstate.FleetAggregator()
+        hot = InventorySnapshot([make_slice(cap_w=500, power_w=120)])
+        fleet.observe_pass(hot, AllocationState(hot), 0)
+        time.sleep(0.1)
+        dropped = InventorySnapshot([make_slice(cap_w=500)])
+        points = fleet.observe_pass(dropped, AllocationState(dropped),
+                                    0)
+        assert points[(DRIVER, "n0")]["power_watts"] == 0
+
+    def test_headroom_gauge_exported_and_pruned(self):
+        metrics = FleetMetrics()
+        fleet = fleetstate.FleetAggregator(metrics=metrics)
+        snap = InventorySnapshot([make_slice(cap_w=500, power_w=120)])
+        fleet.observe_pass(snap, AllocationState(snap), 0)
+        text = generate_latest(metrics.registry).decode()
+        assert ("tpu_dra_fleet_power_headroom_watts"
+                '{pool="tpu.dra.dev/n0"} 20.0') in text
+        gone = InventorySnapshot([make_slice(node="n9", cap_w=500,
+                                             power_w=100)])
+        fleet.observe_pass(gone, AllocationState(gone), 0)
+        text = generate_latest(metrics.registry).decode()
+        assert 'pool="tpu.dra.dev/n0"' not in text
+
+    def test_headroom_gauge_dropped_when_caps_vanish(self):
+        """A pool that STAYS in the snapshot but stops publishing
+        power caps must drop its headroom gauge, not freeze it."""
+        metrics = FleetMetrics()
+        fleet = fleetstate.FleetAggregator(metrics=metrics)
+        capped = InventorySnapshot([make_slice(cap_w=500,
+                                               power_w=120)])
+        fleet.observe_pass(capped, AllocationState(capped), 0)
+        assert "power_headroom_watts" in generate_latest(
+            metrics.registry).decode()
+        uncapped = InventorySnapshot([make_slice(power_w=120)])
+        fleet.observe_pass(uncapped, AllocationState(uncapped), 0)
+        text = generate_latest(metrics.registry).decode()
+        assert 'tpu_dra_fleet_power_headroom_watts{' not in text
+
+    def test_capless_pool_exposes_no_headroom(self):
+        snap = InventorySnapshot([make_slice(power_w=120)])
+        fleet = fleetstate.FleetAggregator()
+        points = fleet.observe_pass(snap, AllocationState(snap), 0)
+        point = points[(DRIVER, "n0")]
+        assert point["power_watts"] == 480
+        assert point["power_cap_watts"] is None
+        assert point["power_headroom_watts"] is None
+
+
+# -- demand forecaster --------------------------------------------------------
+
+
+def ring(values, now, step=10.0):
+    """Synthetic pool history: oldest first, ending at ``now``."""
+    n = len(values)
+    return [{"ts": now - (n - 1 - i) * step,
+             "partition_slots_used": v,
+             "partition_slots_total": 64}
+            for i, v in enumerate(values)]
+
+
+class TestForecaster:
+    def test_ramp_predicts(self):
+        now = 1000.0
+        fc = DemandForecaster(horizon_s=120, window_s=600,
+                              stale_s=180, min_points=4)
+        add = fc.forecast_slots(ring([0, 4, 8, 12, 16], now), now=now)
+        # ~0.4 slots/s * 120s horizon = ~48 more slots
+        assert 40 <= add <= 56
+
+    def test_flat_predicts_nothing(self):
+        now = 1000.0
+        fc = DemandForecaster(min_points=4)
+        assert fc.forecast_slots(ring([8, 8, 8, 8, 8], now),
+                                 now=now) == 0
+
+    def test_decay_predicts_nothing(self):
+        now = 1000.0
+        fc = DemandForecaster(min_points=4)
+        assert fc.forecast_slots(ring([16, 12, 8, 4, 2], now),
+                                 now=now) == 0
+
+    def test_decayed_burst_ages_out(self):
+        # A ramp that happened long ago: newest point is stale.
+        now = 1000.0
+        fc = DemandForecaster(horizon_s=120, window_s=10_000,
+                              stale_s=180, min_points=4)
+        old = ring([0, 4, 8, 12, 16], now - 500)
+        assert fc.forecast_slots(old, now=now) == 0
+
+    def test_too_few_points_predicts_nothing(self):
+        now = 1000.0
+        fc = DemandForecaster(min_points=4)
+        assert fc.forecast_slots(ring([0, 8], now), now=now) == 0
+
+    def test_fleet_forecast_with_pending_boost(self):
+        now = 1000.0
+        fc = DemandForecaster(horizon_s=120, min_points=4)
+        snapshot = {
+            "pending_history": [{"ts": now - 1, "pending": 3}],
+            "pools": {
+                "d/ramp": {"history": ring([0, 4, 8, 12, 16], now),
+                           "current": ring([16], now)[-1]},
+                "d/flat": {"history": ring([8, 8, 8, 8, 8], now),
+                           "current": ring([8], now)[-1]},
+                # no partition slots -> never forecast
+                "d/chips": {"history": [
+                    {"ts": now, "partition_slots_used": None}],
+                    "current": {"partition_slots_total": None}},
+            },
+        }
+        out = fc.forecast(snapshot, now=now)
+        # Pending boost amplifies the RAMPING pool only: fanning the
+        # fleet-global pending count across every flat pool would
+        # pre-warm N pools' worth of phantom capacity.
+        assert out["d/ramp"] > 43  # trend + 3 pending
+        assert "d/flat" not in out
+        assert "d/chips" not in out
+
+
+# -- predictive pre-warming ---------------------------------------------------
+
+
+def serving_state(tmp_root, slots=2):
+    from k8s_dra_driver_gpu_tpu.pkg.partition import (
+        PartitionProfile,
+        PartitionSet,
+    )
+
+    pset = PartitionSet(profiles=(
+        PartitionProfile(name="serv", subslice="1x1",
+                         max_tenants=slots),
+    ))
+    return DeviceState(Config.mock(
+        root=tmp_root, topology="v5e-4", gates=GATES,
+        partition_set=pset))
+
+
+class TestPrewarmEngine:
+    def test_set_prewarm_realizes_and_reap_skips(self, tmp_root):
+        state = serving_state(tmp_root)
+        engine = state.partition_engine
+        engine.metrics = PartitionMetrics()
+        created = engine.set_prewarm({"serv": 2})
+        assert created == 2
+        desired, idle = engine.prewarm_state()
+        assert len(desired) == 2 and idle == desired
+        assert engine.active_partitions() == 2
+        # the existing idle sweep must leave the warm set alone
+        assert engine.reap_idle() == 0
+        assert engine.active_partitions() == 2
+        text = generate_latest(engine.metrics.registry).decode()
+        assert "tpu_dra_prewarm_created_total 2.0" in text
+
+    def test_set_prewarm_idempotent_and_bounded(self, tmp_root):
+        state = serving_state(tmp_root)
+        engine = state.partition_engine
+        assert engine.set_prewarm({"serv": 3}, max_total=2) == 2
+        assert engine.set_prewarm({"serv": 3}, max_total=2) == 0
+        assert engine.active_partitions() == 2
+
+    def test_warm_attach_hits_and_skips_create(self, tmp_root):
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.claim import (
+            DeviceResult,
+            OpaqueConfig,
+            ResourceClaim,
+        )
+
+        state = serving_state(tmp_root)
+        engine = state.partition_engine
+        engine.metrics = PartitionMetrics()
+        engine.set_prewarm({"serv": 1})
+        (name,) = engine.prewarm_state()[0]
+        uuid_before = {
+            rec.devices[0].live["uuid"]
+            for rec in engine._checkpoint.get().claims.values()}
+        cfg = OpaqueConfig(
+            parameters={"apiVersion": "resource.tpu.dra/v1beta1",
+                        "kind": "SubSliceConfig",
+                        "oversubscribe": True},
+            requests=(), source="FromClaim")
+        state.prepare(ResourceClaim(
+            uid="t1", namespace="default", name="t1",
+            results=[DeviceResult(request="tenant", driver=DRIVER,
+                                  pool="bench", device=name)],
+            configs=[cfg]))
+        # same carve-out identity: the attach reused the warm one
+        uuid_after = {
+            rec.devices[0].live["uuid"]
+            for rec in engine._checkpoint.get().claims.values()}
+        assert uuid_before == uuid_after
+        text = generate_latest(engine.metrics.registry).decode()
+        assert "tpu_dra_prewarm_hit_total 1.0" in text
+        assert "tpu_dra_partition_creates_total 1.0" in text
+        _desired, idle = engine.prewarm_state()
+        assert name not in idle
+
+    def test_decayed_hint_reaps_through_idle_sweep(self, tmp_root):
+        state = serving_state(tmp_root)
+        engine = state.partition_engine
+        engine.metrics = PartitionMetrics()
+        engine.set_prewarm({"serv": 2})
+        engine.set_prewarm({})  # forecast decayed
+        assert engine.reap_idle() == 2
+        assert engine.active_partitions() == 0
+        text = generate_latest(engine.metrics.registry).decode()
+        assert "tpu_dra_prewarm_reaped_total 2.0" in text
+
+    def test_detach_keeps_hint_desired_carveout_warm(self, tmp_root):
+        """A standing hint must survive attach/detach churn: the last
+        detach of a hint-desired partition returns it to the warm set
+        instead of destroying it, so the next burst hits warm again
+        without any watcher re-application."""
+        from k8s_dra_driver_gpu_tpu.kubeletplugin.claim import (
+            DeviceResult,
+            OpaqueConfig,
+            ResourceClaim,
+        )
+
+        state = serving_state(tmp_root)
+        engine = state.partition_engine
+        engine.metrics = PartitionMetrics()
+        engine.set_prewarm({"serv": 1})
+        (name,) = engine.prewarm_state()[0]
+        cfg = OpaqueConfig(
+            parameters={"apiVersion": "resource.tpu.dra/v1beta1",
+                        "kind": "SubSliceConfig",
+                        "oversubscribe": True},
+            requests=(), source="FromClaim")
+        for i in range(2):  # two churn cycles on the SAME hint
+            state.prepare(ResourceClaim(
+                uid=f"t{i}", namespace="default", name=f"t{i}",
+                results=[DeviceResult(request="tenant", driver=DRIVER,
+                                      pool="bench", device=name)],
+                configs=[cfg]))
+            state.unprepare(f"t{i}")
+            assert engine.active_partitions() == 1  # still warm
+            assert name in engine.prewarm_state()[1]
+        text = generate_latest(engine.metrics.registry).decode()
+        # one physical create, two warm hits across the churn
+        assert "tpu_dra_partition_creates_total 1.0" in text
+        assert "tpu_dra_prewarm_hit_total 2.0" in text
+        # hint decays -> the idle sweep finally returns the chips
+        engine.set_prewarm({})
+        assert engine.reap_idle() == 1
+
+    def test_unknown_profile_warms_nothing(self, tmp_root):
+        state = serving_state(tmp_root)
+        engine = state.partition_engine
+        assert engine.set_prewarm({"nope": 4}) == 0
+
+    def test_partial_realize_failure_raises_but_keeps_warm(
+            self, tmp_root):
+        """A transient create failure applies the partial warm set
+        AND raises, so the CRD watcher does not memoize the hint and
+        the next reconcile retries the shortfall."""
+        from k8s_dra_driver_gpu_tpu.pkg import faults
+        from k8s_dra_driver_gpu_tpu.pkg.partition.engine import (
+            PartitionEngineError,
+        )
+
+        state = serving_state(tmp_root)
+        engine = state.partition_engine
+        faults.arm("partition.create", mode="error", count=1)
+        try:
+            try:
+                engine.set_prewarm({"serv": 2})
+                raise AssertionError("expected PartitionEngineError")
+            except PartitionEngineError:
+                pass
+        finally:
+            faults.reset()
+        assert engine.active_partitions() == 1  # the partial half
+        # retry (the watcher's un-memoized path) completes the set
+        assert engine.set_prewarm({"serv": 2}) == 1
+        assert engine.active_partitions() == 2
+
+    def test_watcher_does_not_memoize_partial_failure(self):
+        from k8s_dra_driver_gpu_tpu.pkg.partition.engine import (
+            PartitionEngineError,
+        )
+
+        calls = []
+
+        def flaky(hints):
+            calls.append(dict(hints))
+            if len(calls) == 1:
+                raise PartitionEngineError("1 carve-out failed")
+
+        kube = FakeKubeClient()
+        watcher = PartitionSetWatcher(
+            kube, pool="node-0", apply_fn=lambda ps: None,
+            prewarm_fn=flaky)
+        watcher._converge_prewarm({"serv": 2})
+        watcher._converge_prewarm({"serv": 2})  # UNCHANGED hint
+        assert len(calls) == 2  # retried, not memoized
+        watcher._converge_prewarm({"serv": 2})
+        assert len(calls) == 2  # success memoized
+
+
+class TestPrewarmPropagation:
+    """Forecast hint -> CRD annotation -> node watcher -> engine."""
+
+    def _crd(self, hints=None, managed=True):
+        obj = crdmod.crd_object_from_spec(
+            "tpu-dra-autoscale",
+            {"profiles": [{"name": "web-s8", "subslice": "1x1",
+                           "maxTenants": 8}], "pools": []},
+            managed=managed)
+        if hints is not None:
+            obj["metadata"]["annotations"][
+                crdmod.PREWARM_ANNOTATION] = json.dumps(hints)
+        return obj
+
+    def test_prewarm_hints_of_pool_globs(self):
+        obj = self._crd({"node-*": {"web-s8": 3},
+                         "other": {"web-s8": 9}})
+        assert crdmod.prewarm_hints_of(obj, "node-7") == {"web-s8": 3}
+        assert crdmod.prewarm_hints_of(obj, "other") == {"web-s8": 9}
+        assert crdmod.prewarm_hints_of(obj, "x") == {}
+
+    def test_malformed_hint_reads_as_none(self):
+        obj = self._crd()
+        obj["metadata"]["annotations"][
+            crdmod.PREWARM_ANNOTATION] = "{not json"
+        assert crdmod.prewarm_hints_of(obj, "node-0") == {}
+
+    def test_watcher_drives_prewarm_fn(self):
+        kube = FakeKubeClient()
+        kube.create(*CRD, self._crd({"node-0": {"web-s8": 2}}))
+        applied = []
+        watcher = PartitionSetWatcher(
+            kube, pool="node-0", apply_fn=lambda ps: None,
+            prewarm_fn=lambda hints: applied.append(dict(hints)))
+        watcher.start()
+        try:
+            assert watcher.wait_for_sync(5.0)
+            assert applied[-1] == {"web-s8": 2}
+            # unchanged hint: no re-apply
+            seen = len(applied)
+            watcher.reconcile()
+            assert len(applied) == seen
+            # hint decays: the empty hint propagates (engine releases)
+            kube.patch(*CRD, "tpu-dra-autoscale", {
+                "metadata": {"annotations": {
+                    crdmod.PREWARM_ANNOTATION: None}}})
+            deadline = time.time() + 5.0
+            while time.time() < deadline and applied[-1] != {}:
+                time.sleep(0.02)
+            assert applied[-1] == {}
+        finally:
+            watcher.stop()
+
+    def test_controller_stamps_hint_from_ramp(self, tmp_path):
+        kube = FakeKubeClient()
+        kube.create(*CRD, self._crd())
+        now = time.time()
+        fleet = fleetstate.FleetAggregator()
+        # hand-plant a ramping slot ring (white-box like the fleet
+        # tests: observe_pass needs a full snapshot stack)
+        import collections
+
+        fleet._pools[(DRIVER, "node-0")] = collections.deque(
+            ring([0, 8, 16, 24, 32], now), maxlen=64)
+        ctrl = AutoscaleController(
+            kube, str(tmp_path / "as"), store=TenantProfileStore(
+                defaults={}),
+            fleet=fleet, sustain_s=0.0, cooldown_s=0.0)
+        ctrl.forecaster = DemandForecaster(
+            horizon_s=120, window_s=10_000, stale_s=10_000,
+            min_points=4)
+        ctrl.sync_once()
+        live = kube.get(*CRD, "tpu-dra-autoscale")
+        hints = crdmod.prewarm_hints_of(live, "node-0")
+        assert hints.get("web-s8", 0) >= 1
+        # converged forecast: the second pass patches nothing
+        rv_before = live["metadata"].get("resourceVersion")
+        ctrl.sync_once()
+        live2 = kube.get(*CRD, "tpu-dra-autoscale")
+        assert live2["metadata"].get("resourceVersion") == rv_before
+
+    def test_growth_write_holds_other_pools_hints(self, tmp_path):
+        """One pool's ramp must not clobber another pool's standing
+        (held) hint, and a malformed count in the annotation must not
+        crash the pass (garbage repairs, never raises)."""
+        import collections
+
+        kube = FakeKubeClient()
+        obj = self._crd()
+        obj["metadata"]["annotations"][crdmod.PREWARM_ANNOTATION] = \
+            json.dumps({"node-held": {"web-s8": 4},
+                        "node-bad": {"web-s8": "four"}})
+        kube.create(*CRD, obj)
+        now = time.time()
+        fleet = fleetstate.FleetAggregator()
+        fleet._pools[(DRIVER, "node-ramp")] = collections.deque(
+            ring([0, 8, 16, 24, 32], now), maxlen=64)
+        ctrl = AutoscaleController(
+            kube, str(tmp_path / "as"), store=TenantProfileStore(
+                defaults={}),
+            fleet=fleet, sustain_s=0.0, cooldown_s=0.0)
+        ctrl.forecaster = DemandForecaster(
+            horizon_s=120, window_s=10_000, stale_s=10_000,
+            min_points=4)
+        ctrl.sync_once()  # must not crash on the "four" count
+        live = kube.get(*CRD, "tpu-dra-autoscale")
+        assert crdmod.prewarm_hints_of(live, "node-ramp").get(
+            "web-s8", 0) >= 1
+        # the held pool's standing hint survives the growth write
+        assert crdmod.prewarm_hints_of(live, "node-held") == {
+            "web-s8": 4}
+
+    def test_unmanaged_crd_never_stamped(self, tmp_path):
+        kube = FakeKubeClient()
+        kube.create(*CRD, self._crd(managed=False))
+        fleet = fleetstate.FleetAggregator()
+        ctrl = AutoscaleController(
+            kube, str(tmp_path / "as"),
+            store=TenantProfileStore(defaults={}), fleet=fleet,
+            sustain_s=0.0, cooldown_s=0.0)
+        ctrl.sync_once()
+        live = kube.get(*CRD, "tpu-dra-autoscale")
+        assert crdmod.PREWARM_ANNOTATION not in (
+            live["metadata"].get("annotations") or {})
