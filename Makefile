@@ -8,7 +8,7 @@ PYTHON ?= python
 	bench-serving-smoke bench-autoscale-smoke \
 	bench-powersched-smoke \
 	bench-trace-smoke bench-telemetry-smoke validate-dashboard \
-	lint lint-analysis clean stamp-version
+	lint lint-analysis modelcheck-smoke modelcheck clean stamp-version
 
 VERSION := $(shell cat VERSION 2>/dev/null || echo v0.0.0-dev)
 
@@ -278,6 +278,23 @@ lint-analysis:
 	    k8s_dra_driver_gpu_tpu \
 	    --baseline analysis-baseline.json \
 	    --metrics-out analysis-metrics.prom
+
+# Multi-actor protocol model checker (pkg/analysis/modelcheck.py):
+# two active-active schedulers + node plugin + recovery controller
+# against a modeled apiserver with real resourceVersion semantics.
+# The smoke (seconds) proves the checker still CATCHES the seeded
+# blind-write double-allocation, minimizes + deterministically replays
+# it, and that the correct protocol survives a bounded DFS+random
+# sweep; mirrored as a non-slow test in tests/test_analysis_modelcheck.py.
+modelcheck-smoke:
+	$(PYTHON) -m k8s_dra_driver_gpu_tpu.pkg.analysis.modelcheck --smoke
+
+# Pre-release gate (slow, ~10s+): >= 10k correct-protocol schedules
+# (DFS + seeded random) across the commit/prepare/recovery scenarios
+# with crash budgets, plus the static crash-closure pass. See
+# docs/analysis.md "Model checking the commit protocol".
+modelcheck:
+	$(PYTHON) -m k8s_dra_driver_gpu_tpu.pkg.analysis.modelcheck --full
 
 clean:
 	$(MAKE) -C k8s_dra_driver_gpu_tpu/tpulib/native clean

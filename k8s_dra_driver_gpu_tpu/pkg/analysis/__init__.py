@@ -14,16 +14,25 @@ introduced (docs/architecture.md "Locking hierarchy") live here as
   ``-race`` analog).
 - ``statemachine``: the declarative model of legal checkpoint claim
   transitions plus the runtime validator CheckpointManager enforces on
-  every group-committed mutation.
+  every group-committed mutation -- and the static crash-closure pass
+  (``crash_closure_all``) proving every on-disk state reachable across
+  a fault seam has a resume path.
+- ``callgraph``: the project-wide call graph the interprocedural lint
+  rules (TPUDRA016-018) resolve cross-module edges against.
+- ``modelcheck``: the multi-actor protocol model checker -- a modeled
+  apiserver with real resourceVersion semantics under the controlled
+  scheduler, exploring {2 schedulers, node plugin, recovery controller}
+  interleavings (``python -m ...pkg.analysis.modelcheck --smoke``).
 
 Run the linter: ``python -m k8s_dra_driver_gpu_tpu.pkg.analysis`` (or
 ``make lint-analysis``). See docs/analysis.md.
 
 Only the (dependency-free) state-machine model is re-exported here:
 ``kubeletplugin/checkpoint.py`` imports through this package on the
-PRODUCTION path, so the dev-tooling modules (``lint``, ``interleave``)
-must be imported explicitly by their consumers -- an import-time bug in
-the linter must never be able to take down a node plugin.
+PRODUCTION path, so the dev-tooling modules (``lint``, ``interleave``,
+``callgraph``, ``modelcheck``) must be imported explicitly by their
+consumers -- an import-time bug in the linter must never be able to
+take down a node plugin.
 """
 
 from __future__ import annotations

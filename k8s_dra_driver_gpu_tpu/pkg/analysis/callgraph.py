@@ -1,0 +1,349 @@
+"""Project-wide call graph for the interprocedural lint passes.
+
+The intra-module AST passes in ``lint.py`` stop at a function boundary:
+a kube RPC two calls deep under ``_state_lock`` or an API-object
+mutation hidden behind a cross-module helper is invisible to them. This
+module builds one conservative, import-resolution-based call graph over
+every linted source and distills each function to the summaries the
+interprocedural rules (TPUDRA016-018) need:
+
+- ``blocking``: the function performs kube I/O (``*.kube.<verb>``) or
+  sleeps (``time.sleep``) -- directly, or transitively through resolved
+  callees (``blocking_closure``). Each closure entry carries the
+  WITNESS PATH of call edges down to the sink, so a finding can say
+  exactly which chain smuggled the RPC under the lock.
+- ``mutates_params``: parameter names the function mutates in place
+  (mutator-method calls, subscript/attribute stores, ``del``) -- the
+  laundering half of the informer-object rule: ``helper(cached_obj)``
+  is as much a mutation as ``cached_obj["spec"] = ...`` when helper
+  writes through its parameter.
+
+Resolution is deliberately conservative (no type inference): bare names
+resolve to same-module functions then from-imports; ``self.m(...)`` to
+methods of classes in the same module; ``mod.f(...)`` through module
+imports. Unresolvable calls contribute nothing -- the rules under-report
+rather than guess (the lint suite pins both directions).
+
+Dev tooling: imported by ``lint.py`` only -- never from production
+modules (same isolation rule as ``interleave``/``modelcheck``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+_KUBE_VERBS = {"get", "list", "patch", "create", "delete", "update",
+               "watch"}
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+@dataclass
+class CallSite:
+    """One syntactic call inside a function body, pre-resolution."""
+    spelling: str          # "helper" | "self.m" | "mod.f" (<=2 segments)
+    line: int
+
+
+@dataclass
+class FunctionNode:
+    qualname: str          # "pkg/scheduler.py::Scheduler._commit_allocation"
+    rel: str               # module path, '/'-separated, fingerprint-stable
+    name: str
+    cls: str | None
+    lineno: int
+    params: list[str] = field(default_factory=list)
+    calls: list[CallSite] = field(default_factory=list)
+    #: direct blocking sink, e.g. ("kube", "self.kube.patch", 123) or
+    #: ("sleep", "time.sleep", 45); None when the body has none.
+    sink: tuple[str, str, int] | None = None
+    #: parameter names written through in place (excl. ``self``).
+    mutates_params: set[str] = field(default_factory=set)
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Collect per-function call sites + summaries for one module."""
+
+    def __init__(self, rel: str, graph: "CallGraph"):
+        self.rel = rel
+        self.graph = graph
+        self._cls: list[str] = []
+        self._fn: list[FunctionNode] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._cls.append(node.name)
+        self.generic_visit(node)
+        self._cls.pop()
+
+    def _visit_func(self, node) -> None:
+        cls = self._cls[-1] if self._cls else None
+        qual = f"{self.rel}::" + (f"{cls}.{node.name}" if cls
+                                  else node.name)
+        params = [a.arg for a in node.args.args + node.args.kwonlyargs
+                  if a.arg != "self"]
+        fn = FunctionNode(qualname=qual, rel=self.rel, name=node.name,
+                          cls=cls, lineno=node.lineno, params=params)
+        # Nested defs attribute their calls to the ENCLOSING function:
+        # the closure runs (at the latest) while the outer frame's
+        # locks may be held, and the laundering rules care about the
+        # outer call site anyway.
+        if self._fn:
+            fn = self._fn[-1]
+            self._fn.append(fn)
+            self.generic_visit(node)
+            self._fn.pop()
+            return
+        self.graph.add(fn)
+        self._fn.append(fn)
+        self.generic_visit(node)
+        self._fn.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- summaries ------------------------------------------------------------
+
+    def _param_root(self, node: ast.AST) -> str | None:
+        fn = self._fn[-1] if self._fn else None
+        if fn is None:
+            return None
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        if isinstance(node, ast.Name) and node.id in fn.params:
+            return node.id
+        return None
+
+    _MUTATORS = {"append", "extend", "insert", "remove", "pop",
+                 "popitem", "clear", "update", "setdefault", "sort",
+                 "reverse", "add", "discard"}
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = self._fn[-1] if self._fn else None
+        func = node.func
+        if fn is not None:
+            chain = _attr_chain(func)
+            # Blocking sinks.
+            if chain == ["time", "sleep"] and fn.sink is None:
+                fn.sink = ("sleep", "time.sleep", node.lineno)
+            elif isinstance(func, ast.Attribute) and \
+                    func.attr in _KUBE_VERBS and len(chain) >= 2 and \
+                    chain[-2] == "kube" and fn.sink is None:
+                fn.sink = ("kube", ".".join(chain), node.lineno)
+            # Mutator method through a parameter.
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in self._MUTATORS:
+                root = self._param_root(func.value)
+                if root is not None:
+                    fn.mutates_params.add(root)
+            # Call-site spellings the resolver understands.
+            if isinstance(func, ast.Name):
+                fn.calls.append(CallSite(func.id, node.lineno))
+            elif isinstance(func, ast.Attribute) and \
+                    isinstance(func.value, ast.Name):
+                fn.calls.append(CallSite(
+                    f"{func.value.id}.{func.attr}", node.lineno))
+        self.generic_visit(node)
+
+    def _mut_store(self, target: ast.AST) -> None:
+        fn = self._fn[-1] if self._fn else None
+        if fn is None:
+            return
+        if isinstance(target, (ast.Subscript, ast.Attribute)):
+            root = self._param_root(target.value)
+            if root is not None:
+                fn.mutates_params.add(root)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._mut_store(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._mut_store(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._mut_store(t)
+        self.generic_visit(node)
+
+
+class CallGraph:
+    """Resolved project call graph + transitive blocking closure."""
+
+    def __init__(self):
+        self.nodes: dict[str, FunctionNode] = {}
+        # rel -> {func name -> qualname} (module-level functions)
+        self.module_funcs: dict[str, dict[str, str]] = {}
+        # rel -> {class -> {method -> qualname}}
+        self.module_classes: dict[str, dict[str, dict[str, str]]] = {}
+        # rel -> {local alias -> ("func", module, name) | ("mod", module)}
+        self.imports: dict[str, dict[str, tuple]] = {}
+        # module dotted-tail -> rel (resolution of `from .x import y`)
+        self._mod_rels: dict[str, str] = {}
+        self._closure: dict[str, tuple | None] | None = None
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def build(cls, sources: dict[str, str]) -> "CallGraph":
+        """``sources``: rel path ('/'-separated) -> source text. Files
+        that fail to parse are skipped (TPUDRA000 reports them)."""
+        graph = cls()
+        for rel, source in sorted(sources.items()):
+            try:
+                tree = ast.parse(source, filename=rel)
+            except SyntaxError:
+                continue
+            graph._index_module(rel, tree)
+        for rel, source in sorted(sources.items()):
+            try:
+                tree = ast.parse(source, filename=rel)
+            except SyntaxError:
+                continue
+            _FunctionScanner(rel, graph).visit(tree)
+        return graph
+
+    def _index_module(self, rel: str, tree: ast.Module) -> None:
+        mod_name = os.path.splitext(rel.split("/")[-1])[0]
+        self._mod_rels.setdefault(mod_name, rel)
+        imports = self.imports.setdefault(rel, {})
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = (node.module or "").split(".")[-1]
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if mod:
+                        imports[local] = ("func", mod, alias.name)
+                    else:  # `from . import sibling`
+                        imports[local] = ("mod", alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    imports.setdefault(
+                        local, ("mod", alias.name.split(".")[-1]))
+
+    def add(self, fn: FunctionNode) -> None:
+        self.nodes[fn.qualname] = fn
+        self._closure = None
+        if fn.cls is None:
+            self.module_funcs.setdefault(fn.rel, {})[fn.name] = \
+                fn.qualname
+        else:
+            self.module_classes.setdefault(fn.rel, {}).setdefault(
+                fn.cls, {})[fn.name] = fn.qualname
+
+    # -- resolution -----------------------------------------------------------
+
+    def resolve(self, caller: FunctionNode,
+                spelling: str) -> list[str]:
+        """Qualnames a call spelling may reach from ``caller``. Empty
+        when unresolvable (the rules then stay silent)."""
+        rel = caller.rel
+        if "." not in spelling:
+            # Bare name: same-module function wins; else a from-import.
+            local = self.module_funcs.get(rel, {}).get(spelling)
+            if local is not None:
+                return [local]
+            imp = self.imports.get(rel, {}).get(spelling)
+            if imp is not None and imp[0] == "func":
+                target_rel = self._mod_rels.get(imp[1])
+                if target_rel is not None:
+                    qn = self.module_funcs.get(
+                        target_rel, {}).get(imp[2])
+                    return [qn] if qn is not None else []
+            return []
+        base, _, meth = spelling.partition(".")
+        if base == "self":
+            # Method on the caller's own class (same module); falls
+            # back to every same-module class -- helpers often live on
+            # a sibling mixin.
+            classes = self.module_classes.get(rel, {})
+            if caller.cls is not None:
+                qn = classes.get(caller.cls, {}).get(meth)
+                if qn is not None:
+                    return [qn]
+            return sorted(
+                m[meth] for m in classes.values() if meth in m)
+        imp = self.imports.get(rel, {}).get(base)
+        if imp is not None and imp[0] == "mod":
+            target_rel = self._mod_rels.get(imp[1])
+            if target_rel is not None:
+                qn = self.module_funcs.get(target_rel, {}).get(meth)
+                return [qn] if qn is not None else []
+        return []
+
+    # -- transitive blocking closure ------------------------------------------
+
+    def blocking_closure(self) -> dict[str, tuple]:
+        """qualname -> (kind, sink_label, sink_line, path) for every
+        function that blocks directly or transitively. ``path`` is the
+        qualname chain from the function down to (and including) the
+        one holding the sink -- the witness edge list TPUDRA017 prints.
+        """
+        if self._closure is not None:
+            return {q: e for q, e in self._closure.items()
+                    if e is not None}
+        memo: dict[str, tuple | None] = {}
+
+        def visit(qual: str, stack: set[str]) -> tuple | None:
+            if qual in memo:
+                return memo[qual]
+            if qual in stack:
+                return None  # recursion: judged by the outer frame
+            fn = self.nodes.get(qual)
+            if fn is None:
+                return None
+            if fn.sink is not None:
+                kind, label, line = fn.sink
+                memo[qual] = (kind, label, line, [qual])
+                return memo[qual]
+            stack.add(qual)
+            found: tuple | None = None
+            for site in fn.calls:
+                for callee in self.resolve(fn, site.spelling):
+                    sub = visit(callee, stack)
+                    if sub is not None:
+                        kind, label, line, path = sub
+                        found = (kind, label, line, [qual] + path)
+                        break
+                if found is not None:
+                    break
+            stack.discard(qual)
+            memo[qual] = found
+            return found
+
+        for qual in sorted(self.nodes):
+            visit(qual, set())
+        self._closure = memo
+        return {q: e for q, e in memo.items() if e is not None}
+
+    def mutating_callees(self, caller: FunctionNode,
+                         spelling: str) -> list[FunctionNode]:
+        """Resolved callees of ``spelling`` that mutate at least one
+        parameter in place (TPUDRA016 raw material)."""
+        out = []
+        for qual in self.resolve(caller, spelling):
+            fn = self.nodes.get(qual)
+            if fn is not None and fn.mutates_params:
+                out.append(fn)
+        return out
+
+
+def render_edge(path: list[str], sink_label: str,
+                sink_line: int | None = None) -> str:
+    """Human/CI-readable witness: ``a -> b -> c [kube.patch@L12]``."""
+    chain = " -> ".join(path)
+    at = f"@L{sink_line}" if sink_line else ""
+    return f"{chain} [{sink_label}{at}]"

@@ -63,7 +63,7 @@ class _ScheduleAborted(BaseException):
 
 class _Worker:
     __slots__ = ("name", "fn", "thread", "event", "state", "waiting_on",
-                 "exc", "started", "aborted")
+                 "exc", "started", "aborted", "parked_label")
 
     def __init__(self, name: str, fn):
         self.name = name
@@ -75,6 +75,10 @@ class _Worker:
         self.exc: BaseException | None = None
         self.started = False
         self.aborted = False
+        # Label of the operation this worker will perform when next
+        # scheduled (set at every pause) -- what the partial-order
+        # reduction in explore() judges independence on.
+        self.parked_label = f"start {name}"
 
 
 class _VLock:
@@ -150,6 +154,11 @@ class ControlledScheduler:
         self._started = False
         #: [(n_options, chosen_index)] -- the schedule's identity.
         self.choice_log: list[tuple[int, int]] = []
+        #: Per choice-log entry: the label of the operation each option
+        #: stands for (a runnable worker's parked op for scheduling
+        #: choices, ``label[i]`` for value choices). explore()'s
+        #: partial-order reduction consumes this.
+        self.option_log: list[list[str]] = []
         #: [(worker name, label)] -- human-readable decision trace.
         self.trace: list[tuple[str, str]] = []
 
@@ -200,6 +209,7 @@ class ControlledScheduler:
             idx = self._chooser.choose(len(runnable))
             idx = max(0, min(idx, len(runnable) - 1))
             self.choice_log.append((len(runnable), idx))
+            self.option_log.append([w.parked_label for w in runnable])
             worker = runnable[idx]
             self._wake.clear()
             worker.event.set()
@@ -262,6 +272,7 @@ class ControlledScheduler:
 
     def _pause(self, worker: _Worker, label: str) -> None:
         self.trace.append((worker.name, label))
+        worker.parked_label = label
         self._wake.set()
         worker.event.wait()
         worker.event.clear()
@@ -275,6 +286,35 @@ class ControlledScheduler:
         worker = self._current()
         if worker is not None:
             self._pause(worker, label or "yield")
+
+    def choice(self, n: int, label: str = "choice") -> int:
+        """A VALUE choice point: the worker asks the schedule to pick
+        one of ``n`` modeled outcomes (deliver vs. delay a watch event,
+        crash vs. survive a fault seam, ...). The pick lands in the
+        same ``choice_log`` as scheduling decisions, so DFS sibling
+        enumeration, replay, and minimization all treat modeled
+        nondeterminism and thread interleaving uniformly.
+
+        Runs inline in the worker (no scheduler handoff): exactly one
+        worker executes at a time, so appending to the logs here is
+        race-free. From an uninstrumented thread the first option is
+        taken, keeping instrumented code usable outside the explorer.
+        """
+        if n <= 1:
+            return 0
+        worker = self._current()
+        if worker is None:
+            return 0
+        idx = self._chooser.choose(n)
+        idx = max(0, min(idx, n - 1))
+        self.choice_log.append((n, idx))
+        # Every option of a value choice belongs to THIS worker: tag
+        # them with the worker name so independence judgments never
+        # commute two options of one program order.
+        self.option_log.append(
+            [f"{worker.name}:{label}[{i}]" for i in range(n)])
+        self.trace.append((worker.name, f"{label}={idx}"))
+        return idx
 
     def lock_acquire(self, lock_id, reentrant_error: bool = True) -> None:
         worker = self._current()
@@ -372,7 +412,7 @@ def _run_one(build, invariant, chooser, cleanup=None) -> tuple[
 
 def explore(build, invariant=None, max_schedules: int = 1000,
             stop_at_first_failure: bool = False,
-            cleanup=None) -> ExplorationResult:
+            cleanup=None, independent=None) -> ExplorationResult:
     """Depth-first systematic exploration.
 
     ``build(sched)`` spawns the worker threads (fresh state per
@@ -381,6 +421,16 @@ def explore(build, invariant=None, max_schedules: int = 1000,
     schedule (unpatch instrumentation there). Worker exceptions and
     deadlocks count as failures too (workers that EXPECT errors must
     catch them and fold the outcome into state the invariant judges).
+
+    ``independent(op_a, op_b)`` enables a sleep-set-style partial-order
+    reduction: at each decision point the sibling branch that would run
+    ``op_a`` instead of the chosen ``op_b`` is pruned when the callback
+    judges the two operation labels independent (commuting: disjoint
+    state, neither enables/disables the other). The labels are the
+    ``option_log`` strings (a worker's parked-op label, or
+    ``worker:label[i]`` for value choices). The reduction is only sound
+    for genuinely commuting operations -- when unsure return False; see
+    docs/analysis.md "POR caveats".
     """
     result = ExplorationResult()
     pending: list[list[int]] = [[]]
@@ -398,10 +448,19 @@ def explore(build, invariant=None, max_schedules: int = 1000,
         # Enqueue every unexplored sibling at/beyond the replayed
         # prefix (standard stateless-model-checking DFS frontier).
         log = sched.choice_log
+        ops = sched.option_log
         for pos in range(len(prefix), len(log)):
             n_options, chosen = log[pos]
+            step_ops = ops[pos] if pos < len(ops) else None
             for alt in range(n_options):
                 if alt == chosen:
+                    continue
+                if independent is not None and step_ops is not None \
+                        and len(step_ops) == n_options and independent(
+                            step_ops[alt], step_ops[chosen]):
+                    # Commuting ops: running alt first reaches the same
+                    # state this branch reaches one step later -- the
+                    # sibling adds schedules, not coverage.
                     continue
                 branch = [c for _, c in log[:pos]] + [alt]
                 key = tuple(branch)
@@ -412,12 +471,33 @@ def explore(build, invariant=None, max_schedules: int = 1000,
     return result
 
 
+# Frontier-tracking bookkeeping cap for explore_random: past this many
+# discovered branches the space is plainly not small enough to prove
+# exhausted, so the accounting (the only thing the cap bounds) stops.
+_RANDOM_FRONTIER_CAP = 100_000
+
+
 def explore_random(build, invariant=None, schedules: int = 100,
                    seed: int = 0, cleanup=None) -> ExplorationResult:
     """Seeded-random schedule sampling -- the cheap wide net for state
-    spaces too big to exhaust."""
+    spaces too big to exhaust.
+
+    Keeps the same branch-frontier accounting as ``explore()``: every
+    executed schedule covers the discovered branch prefixes it extends,
+    and when the frontier provably drains (every discovered branch is
+    covered -- small state spaces) the run reports ``exhausted=True``
+    and short-circuits instead of burning the remaining samples on
+    schedules it has already seen.
+    """
     result = ExplorationResult()
     rng = random.Random(seed)
+    # Branch prefixes discovered but not yet extended by any executed
+    # schedule -- explore()'s `pending`, fed by random runs instead of
+    # a DFS pop. `seen` mirrors explore()'s dedup (and includes the
+    # root, covered by the very first run).
+    pending: set[tuple[int, ...]] = {()}
+    seen: set[tuple[int, ...]] = {()}
+    tracking = True
     for _ in range(schedules):
         sched, err = _run_one(build, invariant, RandomChooser(rng),
                               cleanup)
@@ -425,6 +505,26 @@ def explore_random(build, invariant=None, schedules: int = 100,
         if err is not None:
             result.failures.append(ScheduleFailure(
                 choices=sched.choices, error=err, trace=sched.trace))
+        if not tracking:
+            continue
+        log = sched.choice_log
+        run = tuple(c for _, c in log)
+        for pos, (n_options, chosen) in enumerate(log):
+            for alt in range(n_options):
+                if alt == chosen:
+                    continue
+                branch = run[:pos] + (alt,)
+                if branch not in seen:
+                    seen.add(branch)
+                    pending.add(branch)
+        for i in range(len(run) + 1):
+            pending.discard(run[:i])
+        if len(seen) > _RANDOM_FRONTIER_CAP:
+            tracking = False  # too big to prove exhausted; keep sampling
+            pending.clear()
+        elif not pending:
+            result.exhausted = True
+            break
     return result
 
 

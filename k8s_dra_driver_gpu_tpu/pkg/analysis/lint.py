@@ -124,7 +124,35 @@ RULES: dict[str, str] = {
                  "node driver's CRD-watch path (the warm carve-out "
                  "set must track the forecaster's hint, never a "
                  "random call site)",
+    "TPUDRA016": "cached API object mutated through a cross-module "
+                 "helper (call-graph resolved): the callee writes "
+                 "through its parameter, so the call site mutates an "
+                 "informer-cached object exactly like an in-place "
+                 "store -- deep-copy before the call, or move the "
+                 "mutation into the object's owning module",
+    "TPUDRA017": "kube I/O or sleep reached TRANSITIVELY while "
+                 "holding _state_lock/_alloc_lock/shard locks/a "
+                 "flock (call-graph closure): the witness edge chain "
+                 "shows which helper smuggled the blocking call under "
+                 "the lock (the direct case is TPUDRA003/010)",
+    "TPUDRA018": "kube write to resourceclaims inside a "
+                 "commit-protocol scope (a function that couples "
+                 "AllocationState.try_commit with apiserver writes) "
+                 "whose payload never rides a resourceVersion "
+                 "precondition: without the 409 arbiter, two "
+                 "schedulers' commit-then-observe writes can "
+                 "double-allocate across processes",
 }
+
+#: Doc anchors for CI annotations: rule -> URL. The base is overridable
+#: (TPU_DRA_ANALYSIS_DOC_BASE) so hosted CI can point at a rendered
+#: docs site; default is the repo-relative markdown anchor.
+
+
+def rule_doc_url(rule: str) -> str:
+    base = os.environ.get("TPU_DRA_ANALYSIS_DOC_BASE",
+                          "docs/analysis.md")
+    return f"{base}#{rule.lower()}"
 
 # Lock model (docs/architecture.md "Locking hierarchy"). Matched on the
 # unparsed base expression of an acquisition.
@@ -255,9 +283,13 @@ _COPY_CALLS = {"json_copy", "deepcopy", "dict", "list", "sorted",
 _ALLOW_RE = re.compile(r"#.*?tpudra:\s*allow=([A-Z0-9,\*]+)")
 # Module-wide allow (for server-side fakes that legitimately own and
 # mutate the stored API objects): a comment `tpudra: allow-file=<RULE>`
-# anywhere in the module. (Spelled with <RULE> here so this very
-# comment cannot allow-file the linter itself.)
+# in the module's HEADER -- the first _FILE_ALLOW_LINES lines only, so
+# a stray string literal (or pasted example) deep in a module can
+# never silently disable a rule for the whole file. (Spelled with
+# <RULE> here so this very comment cannot allow-file the linter
+# itself.)
 _FILE_ALLOW_RE = re.compile(r"#.*?tpudra:\s*allow-file=([A-Z0-9,\*]+)")
+_FILE_ALLOW_LINES = 10
 
 
 @dataclass
@@ -270,12 +302,20 @@ class Finding:
     message: str
     key: str
     baselined: bool = False
+    #: For interprocedural findings (TPUDRA016/017): the rendered
+    #: call-graph witness chain that triggered the rule, e.g.
+    #: ``a -> b -> c [self.kube.patch@L12]``. None for local rules.
+    edge: str | None = None
 
     @property
     def fingerprint(self) -> str:
         """Line-number-free identity: survives reformatting, moves with
         the enclosing function."""
         return f"{self.rule}:{self.path}:{self.qualname}:{self.key}"
+
+    @property
+    def doc_url(self) -> str:
+        return rule_doc_url(self.rule)
 
     def to_dict(self) -> dict:
         return {
@@ -287,12 +327,15 @@ class Finding:
             "message": self.message,
             "fingerprint": self.fingerprint,
             "baselined": self.baselined,
+            "doc_url": self.doc_url,
+            "edge": self.edge,
         }
 
     def __str__(self) -> str:
         tag = " [baselined]" if self.baselined else ""
+        via = f"\n    via {self.edge}" if self.edge else ""
         return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
-                f"{self.message}{tag}")
+                f"{self.message}{tag}{via}")
 
 
 @dataclass
@@ -318,6 +361,7 @@ class LintReport:
         return {
             "files_scanned": self.files_scanned,
             "rules": RULES,
+            "rule_docs": {rule: rule_doc_url(rule) for rule in RULES},
             "counts": self.counts(),
             "baselined_counts": {
                 rule: n for rule, n in (
@@ -391,11 +435,20 @@ class _FuncState:
         # Locals bound to a RAW (unwrapped) KubeClient(...): verb calls
         # on them without an explicit timeout are TPUDRA008 findings.
         self.raw_kube: set[str] = set()
+        # TPUDRA018 (commit-protocol scope): the function couples an
+        # AllocationState.try_commit reservation with apiserver writes.
+        self.commit_scope = False
+        # ... and whether any payload construction in it touches a
+        # "resourceVersion" key (the precondition riding the write).
+        self.rv_literal = False
+        # Deferred kube writes to resourceclaims: judged when the
+        # function closes (the rv literal may appear after the call).
+        self.claim_writes: list[tuple] = []
 
 
 class _ModuleLinter(ast.NodeVisitor):
     def __init__(self, path: str, rel: str, source: str,
-                 api_helpers: set[str]):
+                 api_helpers: set[str], graph=None):
         self.path = path
         self.rel = rel
         self.basename = os.path.basename(rel)
@@ -407,8 +460,16 @@ class _ModuleLinter(ast.NodeVisitor):
         # Same-module helper functions returning kube/informer objects
         # (pass 1 of the two-pass taint analysis).
         self.api_helpers = api_helpers
+        # Project call graph (callgraph.CallGraph) for the
+        # interprocedural rules; None degrades them to silent.
+        self.graph = graph
+        self._blocking = graph.blocking_closure() if graph is not None \
+            else {}
         self.file_allowed: set[str] = set()
-        for m in _FILE_ALLOW_RE.finditer(source):
+        # Header pragma only: scanning the whole source would let a
+        # string literal anywhere disable a rule file-wide.
+        header = "\n".join(self.lines[:_FILE_ALLOW_LINES])
+        for m in _FILE_ALLOW_RE.finditer(header):
             self.file_allowed.update(m.group(1).split(","))
         # Local names bound to the DRIVER's CheckpointManager class,
         # and to its defining MODULE (`from ..kubeletplugin import
@@ -431,21 +492,22 @@ class _ModuleLinter(ast.NodeVisitor):
             return True
         # The allow comment may sit on the finding's line or -- for
         # lines with no room -- on the (comment-only) line above it.
+        # finditer, not search: a line carrying several `allow=` rules
+        # (e.g. two suppressions with separate reasons) honors each.
         for lineno in (line, line - 1):
             if not 1 <= lineno <= len(self.lines):
                 continue
             text = self.lines[lineno - 1]
             if lineno != line and not text.lstrip().startswith("#"):
                 continue
-            m = _ALLOW_RE.search(text)
-            if m:
+            for m in _ALLOW_RE.finditer(text):
                 rules = m.group(1).split(",")
                 if "*" in rules or rule in rules:
                     return True
         return False
 
     def _emit(self, rule: str, node: ast.AST, message: str,
-              key: str) -> None:
+              key: str, edge: str | None = None) -> None:
         line = getattr(node, "lineno", 1)
         if self._allowed(line, rule):
             return
@@ -461,6 +523,7 @@ class _ModuleLinter(ast.NodeVisitor):
             rule=rule, path=self.rel, line=line,
             col=getattr(node, "col_offset", 0),
             qualname=self.qualname, message=message, key=key,
+            edge=edge,
         ))
 
     # -- scope handling -------------------------------------------------------
@@ -506,6 +569,23 @@ class _ModuleLinter(ast.NodeVisitor):
         outer_held = self.held
         self.held = []  # lock regions don't cross function boundaries
         self.generic_visit(node)
+        # TPUDRA018, judged at function close (the rv precondition may
+        # be built after the write call in source order): a function
+        # that couples try_commit with resourceclaims writes must ride
+        # a resourceVersion precondition on those writes.
+        if fs.commit_scope and not fs.rv_literal:
+            for write_node, what in fs.claim_writes:
+                self._emit(
+                    "TPUDRA018", write_node,
+                    f"commit-protocol write {what}(...) to "
+                    "resourceclaims without a resourceVersion "
+                    "precondition anywhere in "
+                    f"{self.qualname}: the 409 arbiter is what stops "
+                    "two active-active schedulers from "
+                    "double-allocating (see docs/analysis.md "
+                    "'Model checking the commit protocol')",
+                    key=f"{what}:resourceclaims",
+                )
         self.held = outer_held
         self.funcs.pop()
         self.scope.pop()
@@ -1037,6 +1117,21 @@ class _ModuleLinter(ast.NodeVisitor):
                         key=f"{holder.key}:{what}",
                     )
 
+            # TPUDRA018 raw material: does this function couple a
+            # try_commit reservation with resourceclaims writes?
+            fs = self._fs()
+            if fs is not None:
+                if attr == "try_commit":
+                    fs.commit_scope = True
+                chain = _attr_chain(func)
+                if attr in ("patch", "update") and chain[:-1] and \
+                        chain[-2] == "kube" and any(
+                            isinstance(a, ast.Constant)
+                            and a.value == "resourceclaims"
+                            for a in node.args):
+                    fs.claim_writes.append(
+                        (node, f"{base_src}.{attr}"))
+
             # TPUDRA008 (second half): a kube verb on a raw (unwrapped)
             # KubeClient local without an explicit timeout parks the
             # calling thread on the urllib default when the apiserver
@@ -1062,6 +1157,51 @@ class _ModuleLinter(ast.NodeVisitor):
                     "mutating (client-go informer rule)",
                     key=f"{_root_name(func.value)}.{attr}",
                 )
+
+        # -- interprocedural rules (call-graph resolved) ----------------------
+        spelling = None
+        if isinstance(func, ast.Name):
+            spelling = func.id
+        elif isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name):
+            spelling = f"{func.value.id}.{func.attr}"
+        caller = self._graph_caller() if spelling is not None else None
+        if caller is not None:
+            # TPUDRA017: a call that is not ITSELF a blocking sink
+            # (those are TPUDRA003/010) but transitively reaches kube
+            # I/O or sleep through the call graph, made while a
+            # hierarchy lock is held. Per-node commit locks are
+            # sanctioned for commit I/O (same carve-out as TPUDRA010).
+            holder = next(
+                (h for h in self.held
+                 if h.family in ("flock", "shard") + _SCHED_LOCK_FAMILIES),
+                None)
+            if holder is not None and not self._is_direct_sink(func):
+                for callee_qn in self.graph.resolve(caller, spelling):
+                    hit = self._blocking.get(callee_qn)
+                    if hit is None:
+                        continue
+                    kind, label, line, path = hit
+                    from .callgraph import render_edge
+                    edge = render_edge(
+                        [caller.qualname] + path, label, line)
+                    self._emit(
+                        "TPUDRA017", node,
+                        f"{spelling}(...) transitively performs "
+                        f"{'kube I/O' if kind == 'kube' else label}"
+                        f" while holding {holder.family} lock "
+                        f"{holder.key!r} (held since line "
+                        f"{holder.line}); witness: {edge}",
+                        key=f"{holder.key}:{spelling}",
+                        edge=edge,
+                    )
+                    break
+            # TPUDRA016: a tainted (informer-cached / API) object
+            # handed to a CROSS-MODULE helper that writes through the
+            # parameter -- mutation laundered past the intra-module
+            # taint pass.
+            if self.graph is not None:
+                self._check_laundered_mutation(node, caller, spelling)
 
         # TPUDRA007: CheckpointManager(...) without transition_policy.
         # In scope: the class imported from the driver's checkpoint
@@ -1089,6 +1229,70 @@ class _ModuleLinter(ast.NodeVisitor):
                 )
 
         self.generic_visit(node)
+
+    # -- interprocedural helpers ----------------------------------------------
+
+    def _graph_caller(self):
+        """The call-graph FunctionNode for the CURRENT lexical scope
+        (graph nodes exist for top-level functions and Class.method;
+        nested defs resolve to their enclosing function)."""
+        if self.graph is None or not self.scope:
+            return None
+        if len(self.scope) >= 2:
+            qn = self.graph.module_classes.get(self.rel, {}).get(
+                self.scope[0], {}).get(self.scope[1])
+            if qn is not None:
+                return self.graph.nodes.get(qn)
+        qn = self.graph.module_funcs.get(self.rel, {}).get(
+            self.scope[0])
+        return self.graph.nodes.get(qn) if qn is not None else None
+
+    @staticmethod
+    def _is_direct_sink(func: ast.AST) -> bool:
+        """Is this call itself the blocking sink TPUDRA003/010 already
+        police (kube verb / time.sleep)?"""
+        if not isinstance(func, ast.Attribute):
+            return False
+        chain = _attr_chain(func)
+        if chain == ["time", "sleep"]:
+            return True
+        return func.attr in _KUBE_VERBS and len(chain) >= 2 and \
+            chain[-2] == "kube"
+
+    def _check_laundered_mutation(self, node: ast.Call, caller,
+                                  spelling: str) -> None:
+        """TPUDRA016: tainted API object passed to a cross-module
+        helper that mutates the matching parameter in place."""
+        from .callgraph import render_edge
+        callees = self.graph.mutating_callees(caller, spelling)
+        if not callees:
+            return
+        args = [(i, a) for i, a in enumerate(node.args)]
+        for callee in callees:
+            if callee.rel == self.rel:
+                continue  # same module: the local taint pass's beat
+            for i, arg in args:
+                if i >= len(callee.params):
+                    break
+                param = callee.params[i]
+                if param not in callee.mutates_params:
+                    continue
+                if not self._is_tainted(arg) or self._is_copy_call(arg):
+                    continue
+                edge = render_edge(
+                    [caller.qualname, callee.qualname],
+                    f"mutates {param!r}", callee.lineno)
+                self._emit(
+                    "TPUDRA016", node,
+                    f"cached API object {_unparse(arg)!r} passed to "
+                    f"cross-module helper {spelling}(...) which "
+                    f"mutates its {param!r} parameter in place "
+                    f"({callee.rel}:{callee.lineno}); deep-copy "
+                    f"before the call; witness: {edge}",
+                    key=f"{spelling}:{param}",
+                    edge=edge,
+                )
+                return
 
     def _snapshot_mut_sanctioned(self) -> bool:
         rel_posix = self.rel.replace(os.sep, "/")
@@ -1217,6 +1421,10 @@ class _ModuleLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Constant(self, node: ast.Constant) -> None:
+        if node.value == "resourceVersion":
+            fs = self._fs()
+            if fs is not None:
+                fs.rv_literal = True
         if isinstance(node.value, str) and node.value in _STATE_LITERALS \
                 and self.basename not in _STATE_LITERAL_FILES:
             self._emit(
@@ -1274,11 +1482,20 @@ def _collect_api_helpers(tree: ast.Module) -> set[str]:
 
 
 def lint_source(source: str, rel: str = "<string>",
-                path: str = "<string>") -> list[Finding]:
-    """Lint one module's source; returns its findings (unbaselined)."""
+                path: str = "<string>", graph=None) -> list[Finding]:
+    """Lint one module's source; returns its findings (unbaselined).
+
+    ``graph`` is the project CallGraph for the interprocedural rules;
+    when omitted a single-module graph is built from this source, so
+    TPUDRA017 still sees same-module helper chains (TPUDRA016 is
+    cross-module by definition and stays silent)."""
     tree = ast.parse(source, filename=rel)
+    if graph is None:
+        from .callgraph import CallGraph
+        graph = CallGraph.build({rel: source})
     linter = _ModuleLinter(path, rel, source,
-                           api_helpers=_collect_api_helpers(tree))
+                           api_helpers=_collect_api_helpers(tree),
+                           graph=graph)
     linter.visit(tree)
     return linter.findings
 
@@ -1343,14 +1560,25 @@ def run_lint(paths: list[str], baseline: Baseline | str | None = None,
         if os.path.isfile(root):
             root = os.path.dirname(root)
     report = LintReport()
+    sources: dict[str, tuple[str, str]] = {}  # rel -> (path, source)
     for path in files:
         rel = os.path.relpath(os.path.abspath(path), root)
         # Fingerprints must be stable across checkouts.
         rel = rel.replace(os.sep, "/")
         try:
             with open(path, encoding="utf-8") as f:
-                source = f.read()
-            report.findings.extend(lint_source(source, rel=rel, path=path))
+                sources[rel] = (path, f.read())
+        except OSError:
+            continue
+    # One project-wide call graph so the interprocedural rules
+    # (TPUDRA016/017) resolve edges across every linted module.
+    from .callgraph import CallGraph
+    graph = CallGraph.build({rel: src for rel, (_, src)
+                             in sources.items()})
+    for rel, (path, source) in sources.items():
+        try:
+            report.findings.extend(
+                lint_source(source, rel=rel, path=path, graph=graph))
         except SyntaxError as e:
             report.findings.append(Finding(
                 rule="TPUDRA000", path=rel, line=e.lineno or 1, col=0,

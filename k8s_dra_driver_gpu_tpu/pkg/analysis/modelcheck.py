@@ -1,0 +1,1063 @@
+"""Multi-actor protocol model checker for the commit/prepare/recovery
+protocols -- the whole-driver companion to ``interleave``'s per-node
+pipeline explorer.
+
+Where ``interleave`` permutes thread schedules inside ONE process,
+this module models the DISTRIBUTED protocol: several actors (two
+active-active schedulers sharing a placement domain, a node plugin, a
+recovery controller) run as workers under the same
+``ControlledScheduler``, but every Kubernetes verb goes through one
+modeled apiserver (:class:`ModelApiServer`) with real resourceVersion
+semantics -- stale informer reads, 409s on preconditioned writes,
+watch-event delay, and crash-restart of any actor all become explicit
+``choice()`` points the DFS enumerates.
+
+The protocol under test is the driver's own commit-then-observe shape
+(``scheduler._commit_allocation`` + lint rule TPUDRA018): a fit is
+planned against a possibly-stale informer cache, the reservation write
+rides the resourceVersion that plan READ, and the apiserver's 409 is
+the only cross-process arbiter. ``--seeded-bug`` (and the first leg of
+``--smoke``) removes exactly that precondition -- the write becomes a
+blind merge-patch -- and the checker must find, minimize, and
+deterministically replay a double-allocation; with the precondition
+intact, the same scenario must survive every explored schedule.
+
+Machine-checked invariants (evaluated on the quiesced end state, plus
+inline during execution where noted):
+
+- **No double-allocation**: no device key appears in two claims'
+  ``status.allocation`` (extracted with the real
+  ``AllocationState._alloc_keys``), and the domain ledger maps each
+  device to at most one claim.
+- **Ledger/status convergence**: every stamped claim is backed by the
+  matching ledger entry and vice versa -- the two views of truth agree
+  once all actors quiesce.
+- **Power ledger never over-commits**: per-node sum of the rated watts
+  of status-referenced devices stays within the node cap (double
+  allocation of a chip is also a double power debit).
+- **Every claim converges**: all claims end allocated and stamped
+  (liveness via each actor's deterministic drain phase).
+- **TransitionPolicies hold across crashes**: every durable checkpoint
+  write is validated inline against its ``TransitionPolicy``
+  (TWO_PHASE for the node plugin, EVICTION for the recovery
+  controller), including writes on the post-crash resume path.
+
+Exploration is DFS (``interleave.explore``) plus seeded-random
+sampling, with a conservative partial-order reduction
+(:func:`independent_ops`) and failure-schedule minimization
+(:func:`minimize_failure`) producing deterministic replay artifacts
+(``--json-out`` / ``--replay``).
+
+Run: ``python -m k8s_dra_driver_gpu_tpu.pkg.analysis.modelcheck
+--smoke`` (CI, seconds) or ``--full`` (pre-release, >= 10k schedules).
+Dev tooling: imported explicitly, never via the package ``__init__``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import sys
+
+from ..kubeclient import ConflictError, NotFoundError
+from ..schedcache import AllocationState, claim_like
+from .interleave import (
+    ControlledScheduler,
+    ExplorationResult,
+    ReplayChooser,
+    _run_one,
+    explore,
+    explore_random,
+)
+from .statemachine import (
+    EVICTION_DEALLOCATED,
+    EVICTION_DRAINING,
+    EVICTION_PLANNED,
+    EVICTION_POLICY,
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    TWO_PHASE_POLICY,
+    crash_closure_all,
+)
+
+DRIVER = "tpu.example.com"
+POOL = "pool-a"
+
+
+class _ActorCrash(BaseException):
+    """Unwinds an actor at a modeled fault seam. BaseException so actor
+    code's ``except Exception`` retry handling cannot swallow a modeled
+    crash -- only the actor wrapper's restart loop catches it."""
+
+
+class ModelApiServer:
+    """One modeled apiserver: named objects, a global resourceVersion
+    counter, and REAL optimistic-concurrency semantics.
+
+    - ``update`` replaces an object; a resourceVersion in the incoming
+      metadata is a precondition (mismatch raises ConflictError -- the
+      same class the real and fake clients raise).
+    - ``patch`` is JSON merge-patch; a resourceVersion in the patch
+      body is likewise a precondition (matching FakeKubeClient.patch
+      and the real apiserver), and a PATCH WITHOUT one is the
+      last-write-wins blind merge the seeded bug exploits.
+    - Every successful write appends a full deep copy to each
+      subscriber queue (the modeled watch stream) and to ``history``
+      (for invariants over intermediate states).
+
+    Not thread-safe on purpose: exactly one worker runs at a time under
+    the ControlledScheduler, so locks here would only hide missing
+    yield points.
+    """
+
+    def __init__(self, objects: dict[str, dict] | None = None):
+        self._rv = 0
+        self._store: dict[str, dict] = {}
+        self._queues: dict[str, list[tuple[str, dict]]] = {}
+        self.history: list[tuple[str, dict]] = []
+        for name, obj in (objects or {}).items():
+            self._install(name, copy.deepcopy(obj))
+
+    def _install(self, name: str, obj: dict) -> None:
+        self._rv += 1
+        md = dict(obj.get("metadata") or {})
+        md["resourceVersion"] = str(self._rv)
+        md.setdefault("name", name)
+        self._store[name] = {**obj, "metadata": md}
+
+    def _broadcast(self, name: str) -> None:
+        snap = copy.deepcopy(self._store[name])
+        self.history.append((name, snap))
+        for q in self._queues.values():
+            q.append((name, copy.deepcopy(snap)))
+
+    def subscribe(self, actor: str) -> list[tuple[str, dict]]:
+        """Register an actor's watch queue (primed with the current
+        state, like an informer's initial list) and return it."""
+        q = [(n, copy.deepcopy(o)) for n, o in self._store.items()]
+        self._queues[actor] = q
+        return q
+
+    def unsubscribe(self, actor: str) -> None:
+        self._queues.pop(actor, None)
+
+    def get(self, name: str) -> dict:
+        if name not in self._store:
+            raise NotFoundError(name)
+        return copy.deepcopy(self._store[name])
+
+    def names(self) -> list[str]:
+        return sorted(self._store)
+
+    def update(self, name: str, obj: dict) -> dict:
+        if name not in self._store:
+            raise NotFoundError(name)
+        cur_rv = self._store[name]["metadata"]["resourceVersion"]
+        rv_in = obj.get("metadata", {}).get("resourceVersion")
+        if rv_in is not None and str(rv_in) != cur_rv:
+            raise ConflictError(
+                f"{name}: resourceVersion {rv_in} is stale "
+                f"(current {cur_rv})")
+        new = copy.deepcopy(obj)
+        self._rv += 1
+        new.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        new["metadata"].setdefault("name", name)
+        self._store[name] = new
+        self._broadcast(name)
+        return copy.deepcopy(new)
+
+    def patch(self, name: str, patch: dict) -> dict:
+        if name not in self._store:
+            raise NotFoundError(name)
+        cur = self._store[name]
+        cur_rv = cur["metadata"]["resourceVersion"]
+        patch = copy.deepcopy(patch)
+        rv_in = patch.get("metadata", {}).pop("resourceVersion", None)
+        if rv_in is not None and str(rv_in) != cur_rv:
+            raise ConflictError(
+                f"{name}: resourceVersion {rv_in} is stale "
+                f"(current {cur_rv})")
+
+        def merge(dst, src):
+            for k, v in src.items():
+                if v is None:
+                    dst.pop(k, None)
+                elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    merge(dst[k], v)
+                else:
+                    dst[k] = copy.deepcopy(v)
+
+        merge(cur, patch)
+        self._rv += 1
+        cur["metadata"]["resourceVersion"] = str(self._rv)
+        self._broadcast(name)
+        return copy.deepcopy(cur)
+
+
+class ModelInformer:
+    """An actor's local cache over its ModelApiServer watch queue.
+
+    Nothing applies until the actor chooses to drain the queue -- which
+    the scenarios surface as a scheduler ``choice()``: deliver all,
+    deliver none (lag), or deliver all but the newest (a delayed tail,
+    the coarse reorder model). Stale reads are therefore an explored
+    branch, not a timing accident.
+    """
+
+    def __init__(self, api: ModelApiServer, actor: str):
+        self.api = api
+        self.actor = actor
+        self.queue = api.subscribe(actor)
+        self.cache: dict[str, dict] = {}
+
+    def deliver(self, upto: int | None = None) -> int:
+        """Apply the first ``upto`` queued events (all when None)."""
+        n = len(self.queue) if upto is None else min(upto, len(self.queue))
+        for name, obj in self.queue[:n]:
+            self.cache[name] = obj
+        del self.queue[:n]
+        return n
+
+    def get(self, name: str) -> dict | None:
+        return self.cache.get(name)
+
+
+class DurableCheckpoint:
+    """A crash-surviving per-claim state dict whose every write is
+    validated against a TransitionPolicy -- the model of the node
+    plugins' group-committed CheckpointManager file. In-memory actor
+    state dies with a modeled crash; this object is handed to the
+    restarted incarnation, exactly like the on-disk checkpoint."""
+
+    def __init__(self, policy):
+        self.policy = policy
+        self.states: dict[str, str] = {}
+
+    def transition(self, uid: str, new: str | None) -> None:
+        old = self.states.get(uid)
+        self.policy.validate(uid, old, new)
+        if new is None:
+            self.states.pop(uid, None)
+        else:
+            self.states[uid] = new
+
+
+# -- scenario: active-active commit protocol ----------------------------------
+
+
+def _ledger_devices(ledger: dict) -> dict[str, str | None]:
+    return ledger.get("spec", {}).get("devices", {})
+
+
+def _status_keys(claim: dict) -> frozenset:
+    # The REAL extractor the incremental scheduler state uses -- so the
+    # invariant judges the exact claim shape production code consumes.
+    return AllocationState._alloc_keys(claim)
+
+
+def _stamp_patch(device: str) -> dict:
+    return {"status": {"allocation": {"devices": {"results": [
+        {"driver": DRIVER, "pool": POOL, "device": device},
+    ]}}}}
+
+
+class CommitScenario:
+    """Two active-active schedulers share a placement domain: one
+    ledger object (device -> claim, per-device node + watts, per-node
+    power caps) arbitrates, each scheduler owns one pending claim, and
+    both prefer the same device order -- so every schedule in which a
+    stale read survives to the write is a potential double-allocation.
+
+    ``precondition=False`` is the seeded bug: the ledger reservation
+    becomes a blind merge-patch (no resourceVersion riding the write),
+    i.e. exactly the defect lint rule TPUDRA018 pins in real code.
+
+    Actor shape (per scheduler): up to ``rounds`` main rounds -- each a
+    {deliver-choice, plan from cache, yield, reserve, stamp} sequence
+    with optional crash seams -- then a deterministic, choice-free
+    drain: resync from the apiserver, stamp orphan reservations, place
+    own still-unplaced claims. The drain is what makes EVERY schedule
+    converge under the correct protocol (the liveness half of the
+    invariant set); it deliberately never second-guesses an
+    already-stamped claim, so it cannot mask a double-stamp.
+    """
+
+    name = "commit"
+
+    def __init__(self, precondition: bool = True, crashes: int = 0,
+                 rounds: int = 2):
+        self.precondition = precondition
+        self.crash_budget = crashes
+        self.rounds = rounds
+        self.devices = {"d0": "n0", "d1": "n1"}  # device -> node
+        self.watts = 100
+        self.node_cap = 150  # one 100 W chip per node: overlap = over-commit
+        self.claims = {"c0": "s0", "c1": "s1"}  # claim -> owning scheduler
+        self.api: ModelApiServer | None = None
+        self._crashes_left = 0
+
+    # -- modeled objects ------------------------------------------------------
+
+    def _initial_objects(self) -> dict[str, dict]:
+        objs = {"ledger": {"spec": {
+            "devices": {d: None for d in self.devices},
+            "nodes": {d: n for d, n in self.devices.items()},
+            "watts": {d: self.watts for d in self.devices},
+            "caps": {n: self.node_cap for n in set(self.devices.values())},
+        }}}
+        for c in self.claims:
+            objs[c] = {"metadata": {"name": c, "namespace": "default",
+                                    "uid": f"uid-{c}"}, "status": {}}
+        return objs
+
+    # -- actor ---------------------------------------------------------------
+
+    def _maybe_crash(self, sched: ControlledScheduler, actor: str,
+                     seam: str) -> None:
+        if self._crashes_left <= 0:
+            return
+        if sched.choice(2, f"{actor}:crash@{seam}") == 1:
+            self._crashes_left -= 1
+            raise _ActorCrash(f"{actor} @ {seam}")
+
+    def _reserve(self, api: ModelApiServer, ledger: dict, device: str,
+                 claim: str) -> bool:
+        """One reservation write. Correct mode: full-object update
+        riding the rv the plan read (409 = lost the race). Bug mode:
+        blind merge-patch -- last writer silently wins the device."""
+        if self.precondition:
+            new = copy.deepcopy(ledger)
+            _ledger_devices(new)[device] = claim
+            try:
+                api.update("ledger", new)
+            except ConflictError:
+                return False
+            return True
+        api.patch("ledger", {"spec": {"devices": {device: claim}}})
+        return True
+
+    def _stamp(self, api: ModelApiServer, claim: str, device: str) -> None:
+        # Single writer per claim value-wise: every stamp derives from
+        # the same immutable ledger entry, so the rv-less merge is
+        # idempotent across the owner and any drain's orphan pass.
+        try:
+            api.patch(claim, _stamp_patch(device))
+        except NotFoundError:
+            pass
+
+    def _drain(self, api: ModelApiServer, owned: list[str]) -> None:
+        """Choice-free convergence pass (runs without yield points, so
+        it executes atomically under the controlled scheduler): stamp
+        any orphan reservation from ledger truth, then reserve+stamp
+        own claims that have neither a stamp nor a ledger entry."""
+        for _ in range(2 * len(self.claims) + 2):
+            ledger = api.get("ledger")
+            devs = _ledger_devices(ledger)
+            placed = {c: d for d, c in devs.items() if c is not None}
+            done = True
+            for c in self.claims:
+                claim = api.get(c)
+                stamped = bool(_status_keys(claim))
+                if not stamped and c in placed:
+                    self._stamp(api, c, placed[c])  # orphan: crash seam hit
+                    done = False
+                elif not stamped and c in owned:
+                    free = [d for d in sorted(devs) if devs[d] is None]
+                    if not free:
+                        continue
+                    if self._reserve(api, ledger, free[0], c):
+                        self._stamp(api, c, free[0])
+                    done = False
+            if done:
+                return
+
+    def _scheduler_body(self, sched: ControlledScheduler, api: ModelApiServer,
+                        actor: str, owned: list[str]) -> None:
+        inf = ModelInformer(api, actor)
+        try:
+            for _ in range(self.rounds):
+                if inf.queue:
+                    pick = sched.choice(3, f"{actor}:deliver")
+                    if pick == 0:
+                        inf.deliver()
+                    elif pick == 2:
+                        inf.deliver(len(inf.queue) - 1)  # delayed tail
+                ledger = inf.get("ledger")
+                if ledger is None:
+                    continue
+                devs = _ledger_devices(ledger)
+                target = None
+                for c in owned:
+                    cached = inf.get(c)
+                    if cached is not None and _status_keys(cached):
+                        continue
+                    if c in devs.values():
+                        continue
+                    free = [d for d in sorted(devs) if devs[d] is None]
+                    if free:
+                        target = (c, free[0])
+                    break
+                if target is None:
+                    continue
+                c, device = target
+                self._maybe_crash(sched, actor, "pre-reserve")
+                sched.yield_point(f"{actor}:write ledger")
+                if self._reserve(api, ledger, device, c):
+                    self._maybe_crash(sched, actor, "post-reserve")
+                    sched.yield_point(f"{actor}:write {c}")
+                    self._stamp(api, c, device)
+            self._drain(api, owned)
+        finally:
+            api.unsubscribe(actor)
+
+    def _actor(self, sched: ControlledScheduler, api: ModelApiServer,
+               actor: str, owned: list[str]):
+        def run() -> None:
+            # Crash-restart loop: a modeled crash throws away ALL
+            # in-memory state (informer cache included) and re-enters
+            # the body, exactly like a process restart against the
+            # durable apiserver. Bounded by the crash budget.
+            for _ in range(self.crash_budget + 1):
+                try:
+                    self._scheduler_body(sched, api, actor, owned)
+                    return
+                except _ActorCrash:
+                    sched.yield_point(f"{actor}:restart")
+            self._drain(api, owned)
+        return run
+
+    # -- explore() adapter ----------------------------------------------------
+
+    def build(self, sched: ControlledScheduler) -> None:
+        self.api = ModelApiServer(self._initial_objects())
+        self._crashes_left = self.crash_budget
+        by_owner: dict[str, list[str]] = {}
+        for c, s in self.claims.items():
+            by_owner.setdefault(s, []).append(c)
+        for actor in sorted(by_owner):
+            sched.spawn(self._actor(sched, self.api, actor,
+                                    sorted(by_owner[actor])), name=actor)
+
+    def invariant(self, sched: ControlledScheduler) -> None:
+        api = self.api
+        assert api is not None
+        ledger = api.get("ledger")
+        devs = _ledger_devices(ledger)
+        statuses = {c: api.get(c) for c in self.claims}
+        keys = {c: _status_keys(obj) for c, obj in statuses.items()}
+
+        # No double-allocation: pairwise-disjoint status device keys.
+        names = sorted(keys)
+        for i, a in enumerate(names):
+            for b in names[i + 1:]:
+                overlap = keys[a] & keys[b]
+                assert not overlap, (
+                    f"double-allocation: {sorted(k[2] for k in overlap)} "
+                    f"held by both {a} and {b}")
+
+        # Convergence: every claim stamped and ledger-backed, both ways.
+        placed = {c: d for d, c in devs.items() if c is not None}
+        for c in self.claims:
+            assert keys[c], f"claim {c} never converged (no allocation)"
+            stamped = {k[2] for k in keys[c]}
+            assert c in placed and placed[c] in stamped, (
+                f"ledger/status divergence: {c} stamped {sorted(stamped)} "
+                f"but ledger places it on {placed.get(c)!r}")
+
+        # Power ledger: per-node debit of status-referenced chips must
+        # fit the node cap (a double-allocated chip debits twice).
+        caps = ledger["spec"]["caps"]
+        nodes = ledger["spec"]["nodes"]
+        watts = ledger["spec"]["watts"]
+        used: dict[str, int] = {}
+        for c in names:
+            for k in keys[c]:
+                node = nodes.get(k[2], "?")
+                used[node] = used.get(node, 0) + watts.get(k[2], 0)
+        for node, total in used.items():
+            assert total <= caps.get(node, 0), (
+                f"power over-commit on {node}: {total} W debited, "
+                f"cap {caps.get(node, 0)} W")
+
+
+# -- scenario: two-phase prepare under crashes --------------------------------
+
+
+class PrepareScenario:
+    """A node plugin on node n0 runs the two-phase prepare
+    (PrepareStarted durable -> work -> PrepareCompleted) for the claims
+    allocated on ITS node, against a DurableCheckpoint that survives
+    modeled crashes, resuming half-done prepares on restart; a
+    scheduler concurrently places a second claim (which lands on the
+    OTHER node -- a plugin only ever prepares node-local claims, so its
+    convergence obligation is exactly the set allocated on n0). Every
+    checkpoint write is policy-validated inline, so an illegal
+    transition anywhere on the crash/resume lattice is a failure with a
+    replayable schedule -- the dynamic twin of the static
+    ``crash_closure`` pass."""
+
+    name = "prepare"
+    node = "n0"
+
+    def __init__(self, crashes: int = 1):
+        self.crash_budget = crashes
+        self.commit = CommitScenario(precondition=True, crashes=0, rounds=1)
+        self.commit.claims = {"c0": "s0", "c1": "s0"}
+        self.checkpoint: DurableCheckpoint | None = None
+        self._crashes_left = 0
+
+    def _initial_objects(self) -> dict[str, dict]:
+        # c0 starts placed+stamped on the plugin's node: a node plugin
+        # has work exactly when its node has allocations, so the
+        # prepare lattice is explored without coupling the plugin's
+        # liveness to the placement race (CommitScenario owns that).
+        objs = self.commit._initial_objects()
+        _ledger_devices(objs["ledger"])["d0"] = "c0"
+        stamped = claim_like("c0", [(DRIVER, POOL, "d0")], uid="uid-c0")
+        objs["c0"]["status"] = stamped["status"]
+        return objs
+
+    def _local_uids(self, read) -> list[tuple[str, str]]:
+        """(claim, uid) pairs whose allocation references a device on
+        the plugin's node, per ``read(name) -> obj | None``."""
+        out = []
+        for c in sorted(self.commit.claims):
+            obj = read(c)
+            if obj is None:
+                continue
+            for k in _status_keys(obj):
+                if self.commit.devices.get(k[2]) == self.node:
+                    out.append((c, obj["metadata"].get("uid", c)))
+                    break
+        return out
+
+    def _prepare_one(self, sched: ControlledScheduler,
+                     cp: DurableCheckpoint, uid: str) -> None:
+        if cp.states.get(uid) == PREPARE_COMPLETED:
+            return
+        if cp.states.get(uid) != PREPARE_STARTED:
+            cp.transition(uid, PREPARE_STARTED)  # durable reservation
+        if self._crashes_left > 0 and sched.choice(
+                2, "plugin:crash@mid-prepare") == 1:
+            self._crashes_left -= 1
+            raise _ActorCrash("plugin mid-prepare")
+        sched.yield_point(f"plugin:complete {uid}")
+        cp.transition(uid, PREPARE_COMPLETED)
+
+    def _plugin_body(self, sched: ControlledScheduler, api: ModelApiServer,
+                     cp: DurableCheckpoint) -> None:
+        inf = ModelInformer(api, "plugin")
+        try:
+            # Resume path first, like a restarted kubelet plugin: any
+            # durable PrepareStarted must be driven to completion
+            # before new work (the crash-closure contract, dynamic).
+            for uid, state in sorted(cp.states.items()):
+                if state == PREPARE_STARTED:
+                    sched.yield_point(f"plugin:resume {uid}")
+                    cp.transition(uid, PREPARE_COMPLETED)
+            for _ in range(2):
+                if inf.queue:
+                    if sched.choice(2, "plugin:deliver") == 0:
+                        inf.deliver()
+                for _, uid in self._local_uids(inf.get):
+                    self._prepare_one(sched, cp, uid)
+            # Drain: finish every node-local claim from apiserver truth.
+            for _, uid in self._local_uids(
+                    lambda c: api.get(c)):
+                self._prepare_one(sched, cp, uid)
+        finally:
+            api.unsubscribe("plugin")
+
+    def build(self, sched: ControlledScheduler) -> None:
+        self.commit.api = ModelApiServer(self._initial_objects())
+        self.commit._crashes_left = 0
+        api = self.commit.api
+        sched.spawn(self.commit._actor(sched, api, "s0", ["c0", "c1"]),
+                    name="s0")
+        self.checkpoint = DurableCheckpoint(TWO_PHASE_POLICY)
+        self._crashes_left = self.crash_budget
+
+        def plugin() -> None:
+            cp = self.checkpoint  # durable: same object across restarts
+            for _ in range(self.crash_budget + 1):
+                try:
+                    self._plugin_body(sched, api, cp)
+                    return
+                except _ActorCrash:
+                    sched.yield_point("plugin:restart")
+            # Out of restart budget: still owe the drain (the modeled
+            # "eventually the plugin stays up" assumption).
+            for _, uid in self._local_uids(lambda c: api.get(c)):
+                if cp.states.get(uid) != PREPARE_COMPLETED:
+                    if cp.states.get(uid) != PREPARE_STARTED:
+                        cp.transition(uid, PREPARE_STARTED)
+                    cp.transition(uid, PREPARE_COMPLETED)
+
+        sched.spawn(plugin, name="plugin")
+
+    def invariant(self, sched: ControlledScheduler) -> None:
+        self.commit.invariant(sched)
+        cp = self.checkpoint
+        api = self.commit.api
+        assert cp is not None and api is not None
+        local = self._local_uids(lambda c: api.get(c))
+        assert local, "model bug: no claim ended on the plugin's node"
+        for c, uid in local:
+            assert cp.states.get(uid) == PREPARE_COMPLETED, (
+                f"allocated claim {c} ended {cp.states.get(uid) or 'absent'}"
+                " in the node checkpoint (prepare never completed)")
+        for uid, state in cp.states.items():
+            assert state in (PREPARE_STARTED, PREPARE_COMPLETED), (
+                f"checkpoint holds unknown state {state!r} for {uid}")
+
+
+# -- scenario: recovery/eviction ladder under crashes -------------------------
+
+
+class RecoveryScenario:
+    """A claim sits allocated on a device that then fails. The recovery
+    controller walks the EVICTION_POLICY ladder (Planned -> Draining:
+    clear the claim status -> Deallocated: free the ledger slot with an
+    rv precondition -> absent), persisting each rung in a
+    DurableCheckpoint so a crash at any seam resumes idempotently from
+    the durable rung; its final drain re-places the claim on a healthy
+    device. A contending scheduler runs benign rounds alongside (its
+    drain must neither resurrect the failed device nor stamp the
+    half-evicted orphan)."""
+
+    name = "recovery"
+
+    def __init__(self, crashes: int = 1):
+        self.crash_budget = crashes
+        self.commit = CommitScenario(precondition=True, crashes=0, rounds=1)
+        self.commit.claims = {"c0": "recovery"}
+        self.failed_device = "d0"
+        self.checkpoint: DurableCheckpoint | None = None
+        self._crashes_left = 0
+
+    def _initial_objects(self) -> dict[str, dict]:
+        objs = self.commit._initial_objects()
+        # c0 starts placed+stamped on the device that is about to fail.
+        _ledger_devices(objs["ledger"])[self.failed_device] = "c0"
+        stamped = claim_like(
+            "c0", [(DRIVER, POOL, self.failed_device)], uid="uid-c0")
+        objs["c0"]["status"] = stamped["status"]
+        objs["ledger"]["spec"]["failed"] = [self.failed_device]
+        return objs
+
+    def _maybe_crash(self, sched: ControlledScheduler, seam: str) -> None:
+        if self._crashes_left <= 0:
+            return
+        if sched.choice(2, f"recovery:crash@{seam}") == 1:
+            self._crashes_left -= 1
+            raise _ActorCrash(f"recovery @ {seam}")
+
+    def _controller_body(self, sched: ControlledScheduler,
+                         api: ModelApiServer, cp: DurableCheckpoint) -> None:
+        uid = "uid-c0"
+        # Resume from whatever rung the durable record holds -- each
+        # arm is idempotent, so a crash-restart redoes at most one.
+        if cp.states.get(uid) is None:
+            sched.yield_point("recovery:plan")
+            cp.transition(uid, EVICTION_PLANNED)
+            self._maybe_crash(sched, "planned")
+        if cp.states.get(uid) == EVICTION_PLANNED:
+            sched.yield_point("recovery:write c0")
+            api.patch("c0", {"status": {"allocation": None}})
+            cp.transition(uid, EVICTION_DRAINING)
+            self._maybe_crash(sched, "draining")
+        if cp.states.get(uid) == EVICTION_DRAINING:
+            for _ in range(4):
+                ledger = api.get("ledger")
+                devs = _ledger_devices(ledger)
+                if devs.get(self.failed_device) != "c0":
+                    break
+                new = copy.deepcopy(ledger)
+                _ledger_devices(new)[self.failed_device] = None
+                sched.yield_point("recovery:write ledger")
+                try:
+                    api.update("ledger", new)
+                    break
+                except ConflictError:
+                    continue
+            cp.transition(uid, EVICTION_DEALLOCATED)
+            self._maybe_crash(sched, "deallocated")
+        if cp.states.get(uid) == EVICTION_DEALLOCATED:
+            cp.transition(uid, None)
+        # Re-placement drain: the controller owns convergence here.
+        self._healthy_drain(api, ["c0"])
+
+    def _healthy_drain(self, api: ModelApiServer, owned: list[str]) -> None:
+        """CommitScenario._drain with the failed-device guard: never
+        reserve a failed device, never stamp an orphan ledger entry
+        that still points at one (it is mid-eviction, not recoverable
+        truth)."""
+        for _ in range(6):
+            ledger = api.get("ledger")
+            devs = _ledger_devices(ledger)
+            failed = set(ledger["spec"].get("failed", []))
+            placed = {c: d for d, c in devs.items()
+                      if c is not None and d not in failed}
+            done = True
+            for c in self.commit.claims:
+                claim = api.get(c)
+                if _status_keys(claim):
+                    continue
+                if c in placed:
+                    self.commit._stamp(api, c, placed[c])
+                    done = False
+                elif c in owned and c not in {
+                        v for d, v in devs.items() if v is not None}:
+                    free = [d for d in sorted(devs)
+                            if devs[d] is None and d not in failed]
+                    if not free:
+                        continue
+                    if self.commit._reserve(api, ledger, free[0], c):
+                        self.commit._stamp(api, c, free[0])
+                    done = False
+            if done:
+                return
+
+    def build(self, sched: ControlledScheduler) -> None:
+        self.commit.api = ModelApiServer(self._initial_objects())
+        api = self.commit.api
+        self.checkpoint = DurableCheckpoint(EVICTION_POLICY)
+        self._crashes_left = self.crash_budget
+
+        def controller() -> None:
+            cp = self.checkpoint
+            for _ in range(self.crash_budget + 1):
+                try:
+                    self._controller_body(sched, api, cp)
+                    return
+                except _ActorCrash:
+                    sched.yield_point("recovery:restart")
+            self._healthy_drain(api, ["c0"])
+
+        def bystander() -> None:
+            # A contending scheduler: resyncs and runs the guarded
+            # drain for claims it does NOT own -- it may stamp a
+            # healthy orphan but must never touch the failed device.
+            for _ in range(2):
+                sched.yield_point("s1:read ledger")
+            self._healthy_drain(api, [])
+
+        sched.spawn(controller, name="recovery")
+        sched.spawn(bystander, name="s1")
+
+    def invariant(self, sched: ControlledScheduler) -> None:
+        api = self.commit.api
+        cp = self.checkpoint
+        assert api is not None and cp is not None
+        ledger = api.get("ledger")
+        failed = set(ledger["spec"].get("failed", []))
+        claim = api.get("c0")
+        keys = _status_keys(claim)
+        assert keys, "c0 never re-placed after eviction"
+        stamped = {k[2] for k in keys}
+        assert not (stamped & failed), (
+            f"c0 re-placed onto failed device(s) {sorted(stamped & failed)}")
+        devs = _ledger_devices(ledger)
+        placed = {c: d for d, c in devs.items() if c is not None}
+        assert placed.get("c0") in stamped, (
+            f"ledger/status divergence after recovery: ledger "
+            f"{placed.get('c0')!r} vs status {sorted(stamped)}")
+        assert not cp.states, (
+            f"eviction checkpoint not drained: {cp.states}")
+
+
+SCENARIOS = {
+    "commit": CommitScenario,
+    "prepare": PrepareScenario,
+    "recovery": RecoveryScenario,
+}
+
+
+# -- partial-order reduction --------------------------------------------------
+
+
+def _op_parts(label: str) -> tuple[str, str]:
+    """Split an option label into (actor, operation). Labels this
+    module emits are ``actor:op ...``; anything else (lock labels from
+    interleave instrumentation, bare yields) degrades to ('', label)
+    and is judged dependent -- conservative by construction."""
+    if ":" in label:
+        actor, _, op = label.partition(":")
+        if " " not in actor and actor:
+            return actor, op
+    return "", label
+
+
+def independent_ops(a: str, b: str) -> bool:
+    """Conservative commutation judgment for explore()'s sleep-set
+    pruning. Two parked operations commute only when they belong to
+    DIFFERENT actors and neither can observe the other:
+
+    - both are apiserver writes to DIFFERENT objects, or
+    - one is a pure-local start/read and the other actor's op touches
+      no shared object it reads.
+
+    Everything involving watch delivery, crashes, restarts, or the same
+    apiserver object is dependent (deliveries observe every prior
+    write; crash options change enabled-ness). When unsure: False --
+    see docs/analysis.md "POR caveats"."""
+    actor_a, op_a = _op_parts(a)
+    actor_b, op_b = _op_parts(b)
+    if not actor_a or not actor_b or actor_a == actor_b:
+        return False
+    for op in (op_a, op_b):
+        if not (op.startswith("write ") or op.startswith("read ")):
+            return False
+    obj_a = op_a.split(" ", 1)[1]
+    obj_b = op_b.split(" ", 1)[1]
+    if op_a.startswith("read ") and op_b.startswith("read "):
+        return True
+    return obj_a != obj_b
+
+
+# -- failure minimization + replay --------------------------------------------
+
+
+def minimize_failure(scenario, choices: list[int], error_type: str,
+                     max_probes: int = 400) -> tuple[list[int], int]:
+    """Shrink a failing choice list while the SAME failure class
+    reproduces: drop the tail, then zero individual choices (0 is every
+    chooser's default), to fixpoint or probe budget. Returns (minimized
+    choices, probes spent). Deterministic: every probe is a
+    ReplayChooser run of the scenario."""
+    probes = 0
+
+    def fails(cand: list[int]) -> bool:
+        nonlocal probes
+        probes += 1
+        _, err = _run_one(scenario.build, scenario.invariant,
+                          ReplayChooser(cand))
+        return err is not None and type(err).__name__ == error_type
+
+    best = list(choices)
+    changed = True
+    while changed and probes < max_probes:
+        changed = False
+        while best and probes < max_probes and fails(best[:-1]):
+            best = best[:-1]
+            changed = True
+        for i in range(len(best)):
+            if probes >= max_probes:
+                break
+            if best[i] == 0:
+                continue
+            cand = best[:i] + [0] + best[i + 1:]
+            if fails(cand):
+                best = cand
+                changed = True
+    return best, probes
+
+
+def make_artifact(scenario, failure) -> dict:
+    return {
+        "scenario": scenario.name,
+        "params": {
+            "precondition": getattr(scenario, "precondition",
+                                    getattr(getattr(scenario, "commit", None),
+                                            "precondition", True)),
+            "crashes": getattr(scenario, "crash_budget", 0),
+        },
+        "choices": list(failure.choices),
+        "error_type": type(failure.error).__name__,
+        "error": str(failure.error),
+        "trace": [list(t) for t in failure.trace],
+    }
+
+
+def replay_artifact(artifact: dict):
+    """Re-run a recorded failing schedule deterministically. Returns
+    (scheduler, error) -- error is None when the schedule no longer
+    fails (i.e. the bug is fixed)."""
+    cls = SCENARIOS[artifact["scenario"]]
+    params = artifact.get("params", {})
+    if cls is CommitScenario:
+        scenario = cls(precondition=params.get("precondition", True),
+                       crashes=params.get("crashes", 0))
+    else:
+        scenario = cls(crashes=params.get("crashes", 0))
+    return _run_one(scenario.build, scenario.invariant,
+                    ReplayChooser(list(artifact["choices"])))
+
+
+# -- gates --------------------------------------------------------------------
+
+
+def check_seeded_bug(max_schedules: int = 400) -> dict:
+    """The self-test: with the resourceVersion precondition REMOVED
+    from the ledger reservation, bounded DFS must find a
+    double-allocation, minimize it, and the minimized schedule must
+    replay to the same failure."""
+    scenario = CommitScenario(precondition=False)
+    res = explore(scenario.build, scenario.invariant,
+                  max_schedules=max_schedules, stop_at_first_failure=True,
+                  independent=independent_ops)
+    out = {"gate": "seeded-bug", "schedules_run": res.schedules_run,
+           "caught": bool(res.failures), "ok": bool(res.failures)}
+    if not res.failures:
+        return out
+    failure = res.failures[0]
+    error_type = type(failure.error).__name__
+    minimized, probes = minimize_failure(scenario, failure.choices,
+                                         error_type)
+    _, err = _run_one(scenario.build, scenario.invariant,
+                      ReplayChooser(minimized))
+    replay_ok = err is not None and type(err).__name__ == error_type
+    failure.choices = minimized
+    artifact = make_artifact(scenario, failure)
+    artifact["error"] = str(err) if replay_ok else artifact["error"]
+    out.update({
+        "minimized_choices": minimized,
+        "minimize_probes": probes,
+        "replay_deterministic": replay_ok,
+        "artifact": artifact,
+        "error": artifact["error"],
+        "ok": replay_ok,
+    })
+    return out
+
+
+def _result_dict(gate: str, res: ExplorationResult) -> dict:
+    return {
+        "gate": gate,
+        "schedules_run": res.schedules_run,
+        "exhausted": res.exhausted,
+        "failures": [
+            {"choices": f.choices,
+             "error_type": type(f.error).__name__,
+             "error": str(f.error)}
+            for f in res.failures[:5]
+        ],
+        "ok": res.ok,
+    }
+
+
+def check_scenario(name: str, dfs: int, rand: int, seed: int = 0,
+                   crashes: int = 0) -> dict:
+    """Correct-protocol gate: DFS + seeded-random exploration of one
+    scenario must report ZERO violations."""
+    def fresh():
+        cls = SCENARIOS[name]
+        if cls is CommitScenario:
+            return cls(precondition=True, crashes=crashes)
+        return cls(crashes=crashes)
+
+    scenario = fresh()
+    res = explore(scenario.build, scenario.invariant, max_schedules=dfs,
+                  independent=independent_ops)
+    total = _result_dict(f"{name}(crashes={crashes})", res)
+    if rand > 0:
+        scenario = fresh()
+        rres = explore_random(scenario.build, scenario.invariant,
+                              schedules=rand, seed=seed)
+        total["schedules_run"] += rres.schedules_run
+        total["random_schedules"] = rres.schedules_run
+        total["failures"] += [
+            {"choices": f.choices, "error_type": type(f.error).__name__,
+             "error": str(f.error)} for f in rres.failures[:5]]
+        total["ok"] = total["ok"] and rres.ok
+    return total
+
+
+def run_gates(full: bool = False, seed: int = 0,
+              schedules: int | None = None) -> dict:
+    """The composite gate ``make modelcheck-smoke`` / ``modelcheck``
+    run. Smoke: seconds. Full: >= 10k correct-protocol schedules."""
+    if schedules is None:
+        schedules = 12_000 if full else 1_200
+    half = schedules // 2
+    gates = [check_seeded_bug(max_schedules=600 if full else 400)]
+    gates.append(check_scenario("commit", dfs=half, rand=schedules - half,
+                                seed=seed))
+    crash_budget = schedules // 6 if full else 300
+    gates.append(check_scenario("commit", dfs=crash_budget,
+                                rand=crash_budget // 2, seed=seed + 1,
+                                crashes=1))
+    gates.append(check_scenario("prepare", dfs=crash_budget,
+                                rand=crash_budget // 2, seed=seed + 2,
+                                crashes=1))
+    gates.append(check_scenario("recovery", dfs=crash_budget,
+                                rand=crash_budget // 2, seed=seed + 3,
+                                crashes=1))
+    closure = crash_closure_all()
+    gates.append({"gate": "crash-closure", "ok": closure["ok"],
+                  "policies": {n: {"unreachable": p["unreachable"],
+                                   "unresumable": p["unresumable"]}
+                               for n, p in closure["policies"].items()}})
+    return {"mode": "full" if full else "smoke",
+            "ok": all(g["ok"] for g in gates),
+            "schedules_total": sum(g.get("schedules_run", 0) for g in gates),
+            "gates": gates}
+
+
+def _print_report(report: dict) -> None:
+    for g in report["gates"]:
+        status = "ok" if g["ok"] else "FAIL"
+        extra = ""
+        if g["gate"] == "seeded-bug":
+            extra = (f" caught={g['caught']}"
+                     f" minimized={len(g.get('minimized_choices', []))}"
+                     f" choices replay={g.get('replay_deterministic')}")
+        elif "schedules_run" in g:
+            extra = (f" schedules={g['schedules_run']}"
+                     f" exhausted={g.get('exhausted')}")
+        print(f"  [{status}] {g['gate']}{extra}")
+        for f in g.get("failures", []):
+            print(f"         {f['error_type']}: {f['error']}")
+            print(f"         replay choices: {f['choices']}")
+    total = report.get("schedules_total", 0)
+    print(f"modelcheck {report['mode']}: "
+          f"{'PASS' if report['ok'] else 'FAIL'} "
+          f"({total} schedules explored)")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m k8s_dra_driver_gpu_tpu.pkg.analysis.modelcheck",
+        description="Multi-actor protocol model checker "
+                    "(docs/analysis.md, 'Model checking the commit "
+                    "protocol').")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--smoke", action="store_true",
+                      help="CI gate: bounded DFS+random, seconds")
+    mode.add_argument("--full", action="store_true",
+                      help="pre-release gate: >= 10k schedules")
+    mode.add_argument("--replay", metavar="ARTIFACT",
+                      help="re-run a recorded failing schedule")
+    ap.add_argument("--schedules", type=int, default=None,
+                    help="override the correct-protocol schedule budget")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json-out", metavar="PATH",
+                    help="write the machine-readable report/artifact here")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        with open(args.replay, encoding="utf-8") as f:
+            artifact = json.load(f)
+        sched, err = replay_artifact(artifact)
+        if err is None:
+            print(f"replay of {artifact['scenario']} schedule "
+                  f"{artifact['choices']}: no longer fails")
+            return 0
+        print(f"replay reproduces {type(err).__name__}: {err}")
+        for name, label in sched.trace:
+            print(f"  {name}: {label}")
+        return 1
+
+    report = run_gates(full=args.full, seed=args.seed,
+                       schedules=args.schedules)
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+    _print_report(report)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -270,3 +270,74 @@ POLICIES = {
     "partition": PARTITION_POLICY,
     "autoscale": AUTOSCALE_POLICY,
 }
+
+
+# -- crash-closure pass -------------------------------------------------------
+#
+# Every transition commits durably (group-committed checkpoint / CRD
+# record), so a crash can land between ANY two writes: each state a
+# policy can reach from absent is a state recovery may find on disk.
+# The closure proof is therefore pure graph reachability over the
+# declared transitions:
+#
+#   * every state REACHABLE from absent must also REACH absent again
+#     (a resume path: the record can always be driven back out of the
+#     checkpoint -- completed, rolled back, or canceled). A reachable
+#     state with no path back is a wedge: one crash there leaves a
+#     record no controller can ever legally retire.
+#   * every state a policy NAMES must be reachable from absent --
+#     an unreachable state is dead weight in the model (and a tell
+#     that a transition row was dropped in an edit).
+
+
+def crash_closure(policy: TransitionPolicy) -> dict:
+    """Prove (or refute) that every on-disk state reachable across a
+    crash seam has a legal resume path. Returns a machine-readable
+    report: ``{"policy", "states", "unreachable", "unresumable",
+    "ok"}`` with states spelled as strings ("absent" for ``None``)."""
+    succ: dict[str | None, set[str | None]] = {}
+    pred: dict[str | None, set[str | None]] = {}
+    states: set[str | None] = {ABSENT}
+    for old, new in policy.allowed:
+        states.add(old)
+        states.add(new)
+        succ.setdefault(old, set()).add(new)
+        pred.setdefault(new, set()).add(old)
+
+    def closure(start, edges) -> set:
+        out = {start}
+        stack = [start]
+        while stack:
+            for nxt in edges.get(stack.pop(), ()):
+                if nxt not in out:
+                    out.add(nxt)
+                    stack.append(nxt)
+        return out
+
+    reachable = closure(ABSENT, succ)   # durable-on-disk candidates
+    resumable = closure(ABSENT, pred)   # states with a path back out
+
+    def spell(s: str | None) -> str:
+        return s if s is not None else "absent"
+
+    unreachable = sorted(
+        spell(s) for s in states - reachable if s is not ABSENT)
+    unresumable = sorted(
+        spell(s) for s in reachable - resumable if s is not ABSENT)
+    return {
+        "policy": policy.name,
+        "states": sorted(spell(s) for s in states),
+        "unreachable": unreachable,
+        "unresumable": unresumable,
+        "ok": not unreachable and not unresumable,
+    }
+
+
+def crash_closure_all(
+        policies: dict[str, TransitionPolicy] | None = None) -> dict:
+    """Run the closure proof over every registered policy (or a given
+    registry). ``{"ok": bool, "policies": {name: report}}``."""
+    reports = {name: crash_closure(pol)
+               for name, pol in sorted((policies or POLICIES).items())}
+    return {"ok": all(r["ok"] for r in reports.values()),
+            "policies": reports}

@@ -209,6 +209,16 @@ class Informer:
         self._relist_active = False
         self._relist_pending = False
         self._fake_hook = None
+        # Modelable delivery seam (pkg/analysis/modelcheck.py): when
+        # set, ``event_gate(ev_type, obj) -> bool`` is consulted before
+        # each watch event is applied to the cache. True applies now;
+        # False parks the event on an internal queue until
+        # ``flush_deferred()`` -- which is how the model checker turns
+        # "informer lag" into an explicit interleaving choice instead
+        # of a wall-clock accident. None (production) applies
+        # immediately, zero overhead.
+        self.event_gate: Callable[[str, dict], bool] | None = None
+        self._deferred: list[tuple[str, dict]] = []
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -288,6 +298,29 @@ class Informer:
         return (md.get("namespace", ""), md.get("name", ""))
 
     def _on_watch_event(self, ev_type: str, obj: dict) -> None:
+        gate = self.event_gate
+        if gate is not None:
+            try:
+                deliver = gate(ev_type, obj)
+            except Exception:  # noqa: BLE001 - gate bug must not lose events
+                logger.exception("informer event gate failed; delivering")
+                deliver = True
+            if not deliver:
+                with self._lock:
+                    self._deferred.append((ev_type, obj))
+                return
+        self._apply_event(ev_type, obj)
+
+    def flush_deferred(self) -> int:
+        """Apply every event the gate parked, in arrival order; returns
+        how many were applied. No-op (0) without a gate."""
+        with self._lock:
+            pending, self._deferred = self._deferred, []
+        for ev_type, obj in pending:
+            self._apply_event(ev_type, obj)
+        return len(pending)
+
+    def _apply_event(self, ev_type: str, obj: dict) -> None:
         changed = False
         with self._lock:
             key = self._key(obj)

@@ -546,11 +546,17 @@ class FakeKubeClient:
             obj = self._store[key]
             stored_rv = obj.get("metadata", {}).get("resourceVersion", "1")
             patch = json.loads(json.dumps(patch))
-            # Merge-patch ignores optimistic concurrency (matching the
-            # apiserver for rv-less patches); a stale rv inside the
-            # patch body must not rewind the stored counter update()
-            # now enforces against.
-            patch.get("metadata", {}).pop("resourceVersion", None)
+            # The apiserver treats a resourceVersion INSIDE a
+            # merge-patch body as an optimistic-concurrency
+            # precondition: mismatch is a 409, match applies. Rv-less
+            # patches keep last-write-wins semantics. Either way the
+            # body rv is consumed here -- it must never rewind the
+            # stored counter update() enforces against.
+            rv_in = patch.get("metadata", {}).pop("resourceVersion", None)
+            if rv_in is not None and str(rv_in) != stored_rv:
+                raise ConflictError(
+                    f"{resource}/{name}: resourceVersion {rv_in} is "
+                    f"stale (current {stored_rv})")
             merge(obj, patch)
             obj.setdefault("metadata", {})["resourceVersion"] = stored_rv
             rv = int(obj.get("metadata", {}).get("resourceVersion", "1"))

@@ -606,6 +606,23 @@ class NodeLockManager:
                 lock.release()
 
 
+def claim_like(name: str, devices: list[tuple[str, str, str]],
+               namespace: str = "default", uid: str = "") -> dict:
+    """Build the minimal ResourceClaim-shaped dict AllocationState
+    consumes: ``devices`` is a list of (driver, pool, device) keys --
+    the same tuples ``_alloc_keys`` extracts. The canonical seam for
+    model checkers and tests that drive observe/try_commit/forget
+    without a full apiserver object."""
+    return {
+        "metadata": {"name": name, "namespace": namespace,
+                     **({"uid": uid} if uid else {})},
+        "status": {"allocation": {"devices": {"results": [
+            {"driver": d, "pool": p, "device": dev}
+            for d, p, dev in devices
+        ]}}},
+    }
+
+
 class AllocationState:
     """Allocated-device keys + debited counter budgets, incrementally
     maintained from ResourceClaim allocations.

@@ -1724,9 +1724,19 @@ class DraScheduler:
             # plugins' prepare spans become children of THIS commit
             # span -- one trace id, pod admission to carve-out.
             patch = {"status": {"allocation": alloc_obj}}
+            # The patch rides the resourceVersion the fit READ: the
+            # apiserver 409s if anything touched the claim since, which
+            # is the only arbiter that stops a second active-active
+            # scheduler (own informer, own ledger) from stamping a
+            # conflicting allocation over this one. The ConflictError
+            # path below releases the reservation and the claim comes
+            # back through resync against the post-write state.
+            rv = _meta(claim).get("resourceVersion")
+            if rv is not None:
+                patch["metadata"] = {"resourceVersion": rv}
             if commit_sp.recording:
-                patch["metadata"] = {"annotations": tracing.inject(
-                    commit_sp, {})}
+                patch.setdefault("metadata", {})["annotations"] = (
+                    tracing.inject(commit_sp, {}))
             elif tracing.TRACEPARENT_ANNOTATION in (
                     _meta(claim).get("annotations") or {}):
                 # Unsampled re-allocation of a claim that still carries
@@ -1734,8 +1744,8 @@ class DraScheduler:
                 # migration): clear it (merge-patch null), or the node
                 # plugin would parent this prepare under the dead
                 # first trace.
-                patch["metadata"] = {"annotations": {
-                    tracing.TRACEPARENT_ANNOTATION: None}}
+                patch.setdefault("metadata", {})["annotations"] = {
+                    tracing.TRACEPARENT_ANNOTATION: None}
             t_patch0 = time.monotonic()
             try:
                 # No dedicated patch span: the commit span carries

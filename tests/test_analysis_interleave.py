@@ -129,6 +129,142 @@ class TestToyRaceDetection:
         assert r1.failures
 
 
+class TestValueChoicePoints:
+    """choice(): modeled nondeterminism (deliver/delay, crash/survive)
+    lands in the same choice_log as scheduling decisions, so DFS,
+    replay and minimization treat it uniformly."""
+
+    def _build_factory(self, picks):
+        def build(sched):
+            def worker():
+                picks.append(sched.choice(3, "mode"))
+
+            sched.spawn(worker, "w")
+        return build
+
+    def test_dfs_enumerates_every_value(self):
+        picks = []
+        result = explore(self._build_factory(picks), max_schedules=16)
+        assert result.exhausted and result.ok
+        # One worker, one 3-way value choice: exactly 3 schedules.
+        assert result.schedules_run == 3
+        assert sorted(picks) == [0, 1, 2]
+
+    def test_replay_pins_the_value(self):
+        for want in (0, 1, 2):
+            picks = []
+            sched = ControlledScheduler(ReplayChooser([0, want]))
+            self._build_factory(picks)(sched)
+            sched.run()
+            assert picks == [want]
+
+    def test_choice_logged_with_labeled_options(self):
+        sched = ControlledScheduler(ReplayChooser([0, 2]))
+        self._build_factory([])(sched)
+        sched.run()
+        assert (3, 2) in sched.choice_log
+        assert ["w:mode[0]", "w:mode[1]", "w:mode[2]"] in sched.option_log
+        assert ("w", "mode=2") in sched.trace
+
+    def test_uninstrumented_thread_takes_first_option(self):
+        sched = ControlledScheduler()
+        assert sched.choice(4, "outside") == 0  # and no log entry
+        assert sched.choice_log == []
+
+    def test_degenerate_choice_is_free(self):
+        picks = []
+
+        def build(sched):
+            sched.spawn(lambda: picks.append(sched.choice(1, "only")),
+                        "w")
+
+        result = explore(build, max_schedules=8)
+        # n<=1 adds no choice point: a single schedule covers it.
+        assert result.schedules_run == 1 and picks == [0]
+
+
+class TestRandomFrontierExhaustion:
+    """ISSUE 18 satellite: explore_random tracks the branch frontier
+    and reports exhausted=True on small state spaces instead of
+    burning the remaining budget on schedules it has already seen."""
+
+    def test_small_buggy_space_exhausts_and_catches(self):
+        result = explore_random(_build_buggy, _both_incremented,
+                                schedules=500, seed=3)
+        # The toy unlocked-RMW race: caught, AND the run short-circuits
+        # far below the budget once every discovered branch is covered.
+        assert result.failures
+        assert "lost update" in str(result.failures[0].error)
+        assert result.exhausted
+        assert result.schedules_run < 500
+
+    def test_small_clean_space_exhausts_ok(self):
+        result = explore_random(_build_locked, _both_incremented,
+                                schedules=500, seed=3)
+        assert result.exhausted and result.ok
+        assert result.schedules_run < 500
+
+    def test_insufficient_budget_is_not_exhausted(self):
+        # One run cannot cover the siblings it just discovered: the
+        # flag must stay False (the pre-fix bug was the inverse -- it
+        # could never become True).
+        result = explore_random(_build_buggy, _both_incremented,
+                                schedules=1, seed=0)
+        assert not result.exhausted
+        assert result.schedules_run == 1
+
+
+class TestPartialOrderReduction:
+    """explore(independent=...): sibling branches whose parked ops
+    commute are pruned -- fewer schedules, same verdicts."""
+
+    @staticmethod
+    def _build(sched):
+        state = {}
+        sched.state = state
+
+        def writer(name, obj):
+            def body():
+                sched.yield_point(f"{name}:write {obj}")
+                state[obj] = name
+            return body
+
+        sched.spawn(writer("a", "x"), "a")
+        sched.spawn(writer("b", "y"), "b")
+
+    @staticmethod
+    def _invariant(sched):
+        assert sched.state == {"x": "a", "y": "b"}
+
+    @staticmethod
+    def _commuting(op_a, op_b):
+        # Labels are "actor:write obj" once parked at the yield; the
+        # "start <name>" spawn labels stay dependent (no colon).
+        pa, pb = op_a.partition(":"), op_b.partition(":")
+        if not pa[1] or not pb[1] or pa[0] == pb[0]:
+            return False
+        return pa[2] != pb[2]  # different objects commute
+
+    def test_por_prunes_commuting_siblings(self):
+        full = explore(self._build, self._invariant, max_schedules=256)
+        reduced = explore(self._build, self._invariant,
+                          max_schedules=256,
+                          independent=self._commuting)
+        assert full.exhausted and full.ok
+        assert reduced.exhausted and reduced.ok
+        assert reduced.schedules_run < full.schedules_run
+
+    def test_por_never_masks_a_real_race(self):
+        # The canonical misuse guard: judging everything independent
+        # over a genuinely racy workload WOULD hide schedules -- but
+        # the conservative callback (same actor / unparsable labels
+        # dependent) must keep the lost update reachable.
+        result = explore(_build_buggy, _both_incremented,
+                         max_schedules=64,
+                         independent=self._commuting)
+        assert result.failures
+
+
 class TestVirtualLocks:
     def test_deadlock_detected_not_hung(self):
         def build(sched):

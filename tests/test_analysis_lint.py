@@ -382,6 +382,78 @@ class TestSuppression:
                + self.SRC_BAD)
         assert lint_source(src) == []
 
+    def test_file_allow_honored_anywhere_in_header(self):
+        # allow-file= may sit on any of the first 10 lines (module
+        # docstrings and imports routinely precede it).
+        src = ("# line 1\n" * 8
+               + "# server-side fake; tpudra: allow-file=TPUDRA002\n"
+               + self.SRC_BAD)
+        assert lint_source(src) == []
+
+    def test_file_allow_beyond_header_ignored(self):
+        """The ISSUE 18 satellite: a file-wide pragma buried past the
+        first 10 lines (where nobody reviewing the module header would
+        see it) must NOT disable the rule."""
+        src = ("# line 1\n" * 10
+               + "# sneaky; tpudra: allow-file=TPUDRA002\n"
+               + self.SRC_BAD)
+        assert rules_of(lint_source(src)) == ["TPUDRA002"]
+
+    def test_file_allow_in_trailing_string_literal_ignored(self):
+        # The header restriction also means a string LITERAL deep in
+        # the module carrying the pragma text can't disable a rule
+        # (pre-restriction, scanning the whole source let it).
+        src = (self.SRC_BAD
+               + "    pass\n" * 9
+               + "    x = '# tpudra: allow-file=TPUDRA002'\n")
+        assert "TPUDRA002" in rules_of(lint_source(src))
+
+    def test_multiple_allow_groups_on_one_line(self):
+        # Stacked suppressions, each with its own reason comment: every
+        # `tpudra: allow=` group on the line is honored (finditer, not
+        # a first-match search).
+        src = ("import time\n"
+               "class S:\n"
+               "    def bad(self):\n"
+               "        with self.pu_lock.acquire(timeout=1.0):\n"
+               "            time.sleep(1)"
+               "  # fake clock: tpudra: allow=TPUDRA003"
+               "  # bounded: tpudra: allow=TPUDRA999\n")
+        assert lint_source(src) == []
+        # ... and order doesn't matter: the matching rule may be the
+        # first group just as well.
+        src2 = src.replace("allow=TPUDRA003", "allow=TPUDRA998").replace(
+            "allow=TPUDRA999", "allow=TPUDRA003")
+        assert lint_source(src2) == []
+
+    def test_comma_list_allow(self):
+        src = ("def bad(lock):\n"
+               "    lock.acquire(timeout=1.0)"
+               "  # tpudra: allow=TPUDRA001,TPUDRA002\n")
+        assert lint_source(src) == []
+
+    def test_crlf_source_findings_and_suppressions(self):
+        """CRLF line endings must not break line-table indexing: the
+        finding still fires on the right line, and the suppression
+        comment (whose line now ends in \\r) still matches."""
+        bad = self.SRC_BAD.replace("\n", "\r\n")
+        assert rules_of(lint_source(bad)) == ["TPUDRA002"]
+        allowed = ("def bad(lock):\r\n"
+                   "    lock.acquire(timeout=1.0)"
+                   "  # tpudra: allow=TPUDRA002\r\n")
+        assert lint_source(allowed) == []
+        header = ("# fake; tpudra: allow-file=TPUDRA002\r\n"
+                  + bad)
+        assert lint_source(header) == []
+
+    def test_crlf_file_through_run_lint(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_bytes(
+            b"def bad(lock):\r\n    lock.acquire(timeout=1.0)\r\n")
+        report = run_lint([str(mod)], root=str(tmp_path))
+        assert [f.rule for f in report.findings] == ["TPUDRA002"]
+        assert report.findings[0].line == 2
+
     def test_baseline_fingerprint_is_line_number_free(self, tmp_path):
         mod = tmp_path / "m.py"
         mod.write_text(self.SRC_BAD)
@@ -499,6 +571,346 @@ class TestRunnerAndOutput:
         active = [f.fingerprint for f in report2.active
                   if f.rule == "TPUDRA006"]
         assert active == [fps[1]]
+
+
+class TestFingerprintSuffixCollisions:
+    """ISSUE 18 satellite: edge cases of the #N fingerprint-suffix
+    disambiguator around the baseline grammar."""
+
+    def test_three_same_shaped_findings_all_distinct(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "def bad(self):\n"
+            "    obj = self.kube.get('g', 'v1', 'r', 'n')\n"
+            "    obj['metadata']['labels'] = {}\n"
+            "    obj['metadata']['annotations'] = {}\n"
+            "    obj['metadata']['finalizers'] = []\n"
+        )
+        report = run_lint([str(mod)], root=str(tmp_path))
+        fps = [f.fingerprint for f in report.findings
+               if f.rule == "TPUDRA006"]
+        assert len(fps) == 3 and len(set(fps)) == 3
+        # Baselining #1 and #3 leaves exactly #2 active.
+        baseline = Baseline({fps[0]: "known", fps[2]: "known"})
+        report2 = run_lint([str(mod)], baseline=baseline,
+                           root=str(tmp_path))
+        assert [f.fingerprint for f in report2.active
+                if f.rule == "TPUDRA006"] == [fps[1]]
+
+    def test_suffix_counter_scoped_per_function(self, tmp_path):
+        # The SAME shape in two different functions needs no #N suffix
+        # (the qualname already splits them) -- and the fingerprints
+        # must still be distinct.
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "def bad_a(self):\n"
+            "    obj = self.kube.get('g', 'v1', 'r', 'n')\n"
+            "    obj['metadata']['labels'] = {}\n"
+            "def bad_b(self):\n"
+            "    obj = self.kube.get('g', 'v1', 'r', 'n')\n"
+            "    obj['metadata']['labels'] = {}\n"
+        )
+        report = run_lint([str(mod)], root=str(tmp_path))
+        fps = [f.fingerprint for f in report.findings
+               if f.rule == "TPUDRA006"]
+        assert len(fps) == 2 and len(set(fps)) == 2
+        assert not any("#" in fp.rsplit(":", 1)[-1] for fp in fps)
+
+    def test_suffixed_fingerprints_survive_line_shifts(self, tmp_path):
+        # The whole point of key-based fingerprints, extended to the
+        # suffixed ones: moving the function must not re-key #2.
+        mod = tmp_path / "m.py"
+        body = ("def bad(self):\n"
+                "    obj = self.kube.get('g', 'v1', 'r', 'n')\n"
+                "    obj['metadata']['labels'] = {}\n"
+                "    obj['metadata']['annotations'] = {}\n")
+        mod.write_text(body)
+        fps1 = [f.fingerprint for f in
+                run_lint([str(mod)], root=str(tmp_path)).findings]
+        mod.write_text("# pad\n" * 7 + body)
+        fps2 = [f.fingerprint for f in
+                run_lint([str(mod)], root=str(tmp_path)).findings]
+        assert fps1 == fps2
+
+
+class TestInterproceduralLockRule:
+    """TPUDRA017: kube I/O / sleep reached TRANSITIVELY through the
+    project call graph while a hierarchy lock is held. Direct sinks
+    stay TPUDRA003/010's beat."""
+
+    def test_helper_method_kube_io_under_state_lock_flagged(self):
+        src = ("class DraScheduler:\n"
+               "    def _publish(self, name):\n"
+               "        self.kube.patch('', 'v1', 'pods', name, {})\n"
+               "    def bad(self, name):\n"
+               "        with self._state_lock:\n"
+               "            self._publish(name)\n")
+        findings = lint_source(src, rel="pkg/scheduler.py")
+        hits = [f for f in findings if f.rule == "TPUDRA017"]
+        assert len(hits) == 1
+        # The finding carries the witness edge chain down to the sink.
+        assert hits[0].edge is not None
+        assert "_publish" in hits[0].edge
+        assert "kube.patch" in hits[0].edge
+
+    def test_two_hop_sleep_under_flock_flagged(self):
+        src = ("import time\n"
+               "def deep():\n"
+               "    time.sleep(1)\n"
+               "def mid():\n"
+               "    deep()\n"
+               "class S:\n"
+               "    def bad(self):\n"
+               "        with self.pu_lock.acquire(timeout=1.0):\n"
+               "            mid()\n")
+        findings = lint_source(src, rel="kubeletplugin/x.py")
+        hits = [f for f in findings if f.rule == "TPUDRA017"]
+        assert len(hits) == 1
+        assert "mid" in hits[0].edge and "deep" in hits[0].edge
+        assert "time.sleep" in hits[0].edge
+
+    def test_direct_sink_stays_tpudra010_not_017(self):
+        src = ("class DraScheduler:\n"
+               "    def bad(self):\n"
+               "        with self._state_lock:\n"
+               "            self.kube.patch('', 'v1', 'pods', 'p', {})\n")
+        rules = rules_of(lint_source(src, rel="pkg/scheduler.py"))
+        assert "TPUDRA010" in rules and "TPUDRA017" not in rules
+
+    def test_helper_call_outside_lock_clean(self):
+        src = ("class DraScheduler:\n"
+               "    def _publish(self, name):\n"
+               "        self.kube.patch('', 'v1', 'pods', name, {})\n"
+               "    def good(self, name):\n"
+               "        with self._state_lock:\n"
+               "            x = 1\n"
+               "        self._publish(name)\n")
+        assert "TPUDRA017" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+    def test_nonblocking_helper_under_lock_clean(self):
+        src = ("class DraScheduler:\n"
+               "    def _bump(self, d):\n"
+               "        d['n'] = d.get('n', 0) + 1\n"
+               "    def good(self):\n"
+               "        with self._state_lock:\n"
+               "            self._bump(self._counters)\n")
+        assert "TPUDRA017" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+    def test_commit_io_helper_under_node_locks_sanctioned(self):
+        # Same carve-out as TPUDRA010: per-node commit locks sanction
+        # commit I/O, including transitively.
+        src = ("class DraScheduler:\n"
+               "    def _commit(self, name):\n"
+               "        self.kube.patch('resource.k8s.io', 'v1',\n"
+               "                        'resourceclaims', name, {})\n"
+               "    def good(self, node, name):\n"
+               "        with self._node_locks.hold((node,)):\n"
+               "            self._commit(name)\n")
+        assert "TPUDRA017" not in rules_of(
+            lint_source(src, rel="pkg/scheduler.py"))
+
+
+class TestLaunderedMutationRule:
+    """TPUDRA016: an informer-cached / API object handed to a
+    CROSS-MODULE helper that writes through the parameter -- the
+    mutation TPUDRA006's intra-module taint pass can't see."""
+
+    HELPER = ("def set_label(obj, v):\n"
+              "    obj['metadata']['labels'] = v\n")
+
+    def _lint_pair(self, tmp_path, caller_src):
+        (tmp_path / "helpers.py").write_text(self.HELPER)
+        (tmp_path / "caller.py").write_text(caller_src)
+        report = run_lint([str(tmp_path)], root=str(tmp_path))
+        return report.findings
+
+    def test_tainted_object_to_mutating_helper_flagged(self, tmp_path):
+        findings = self._lint_pair(
+            tmp_path,
+            "from helpers import set_label\n"
+            "class S:\n"
+            "    def bad(self):\n"
+            "        pod = self.kube.get('', 'v1', 'pods', 'p')\n"
+            "        set_label(pod, {})\n")
+        hits = [f for f in findings if f.rule == "TPUDRA016"]
+        assert len(hits) == 1
+        assert hits[0].path == "caller.py"
+        assert "set_label" in hits[0].edge
+        assert "'obj'" in hits[0].edge  # the mutated parameter
+
+    def test_copy_at_call_site_clean(self, tmp_path):
+        findings = self._lint_pair(
+            tmp_path,
+            "import copy\n"
+            "from helpers import set_label\n"
+            "class S:\n"
+            "    def good(self):\n"
+            "        pod = self.kube.get('', 'v1', 'pods', 'p')\n"
+            "        set_label(copy.deepcopy(pod), {})\n")
+        assert "TPUDRA016" not in {f.rule for f in findings}
+
+    def test_untainted_object_clean(self, tmp_path):
+        findings = self._lint_pair(
+            tmp_path,
+            "from helpers import set_label\n"
+            "class S:\n"
+            "    def good(self):\n"
+            "        fresh = {'metadata': {}}\n"
+            "        set_label(fresh, {})\n")
+        assert "TPUDRA016" not in {f.rule for f in findings}
+
+    def test_same_module_helper_not_016(self):
+        # Same-module laundering is the intra-module taint pass's job
+        # (and a single-module graph never crosses rel boundaries).
+        src = ("def set_label(obj, v):\n"
+               "    obj['metadata']['labels'] = v\n"
+               "class S:\n"
+               "    def f(self):\n"
+               "        pod = self.kube.get('', 'v1', 'pods', 'p')\n"
+               "        set_label(pod, {})\n")
+        assert "TPUDRA016" not in rules_of(
+            lint_source(src, rel="pkg/x.py"))
+
+    def test_non_mutating_helper_clean(self, tmp_path):
+        (tmp_path / "helpers.py").write_text(
+            "def label_of(obj):\n"
+            "    return obj.get('metadata', {}).get('labels')\n")
+        (tmp_path / "caller.py").write_text(
+            "from helpers import label_of\n"
+            "class S:\n"
+            "    def good(self):\n"
+            "        pod = self.kube.get('', 'v1', 'pods', 'p')\n"
+            "        return label_of(pod)\n")
+        report = run_lint([str(tmp_path)], root=str(tmp_path))
+        assert "TPUDRA016" not in {f.rule for f in report.findings}
+
+
+class TestCommitProtocolWriteRule:
+    """TPUDRA018: a function coupling AllocationState.try_commit with
+    a kube write to resourceclaims must ride a resourceVersion
+    precondition on the write -- the 409 arbiter is what stops two
+    active-active schedulers from double-allocating (the model
+    checker's seeded bug, pinned statically)."""
+
+    def test_commit_scope_write_without_rv_flagged(self):
+        src = ("class S:\n"
+               "    def commit(self, claim, cand):\n"
+               "        if not self.alloc.try_commit(claim, cand):\n"
+               "            return\n"
+               "        self.kube.patch('resource.k8s.io', 'v1',\n"
+               "                        'resourceclaims', 'c',\n"
+               "                        {'status': {}})\n")
+        findings = lint_source(src)
+        hits = [f for f in findings if f.rule == "TPUDRA018"]
+        assert len(hits) == 1
+        assert "resourceVersion" in hits[0].message
+
+    def test_rv_literal_anywhere_in_function_clean(self):
+        # The precondition may be assembled AFTER the call in source
+        # order (judged at function close, not at the call site).
+        src = ("class S:\n"
+               "    def commit(self, claim, cand):\n"
+               "        if not self.alloc.try_commit(claim, cand):\n"
+               "            return\n"
+               "        body = {'metadata': {'resourceVersion':\n"
+               "                claim['metadata']['resourceVersion']}}\n"
+               "        self.kube.patch('resource.k8s.io', 'v1',\n"
+               "                        'resourceclaims', 'c', body)\n")
+        assert "TPUDRA018" not in rules_of(lint_source(src))
+
+    def test_update_verb_also_fenced(self):
+        src = ("class S:\n"
+               "    def commit(self, claim, cand, obj):\n"
+               "        if not self.alloc.try_commit(claim, cand):\n"
+               "            return\n"
+               "        self.kube.update('resource.k8s.io', 'v1',\n"
+               "                         'resourceclaims', 'c', obj)\n")
+        assert "TPUDRA018" in rules_of(lint_source(src))
+
+    def test_claim_write_without_commit_scope_clean(self):
+        # Status publishes outside the reservation protocol (e.g. the
+        # drain's idempotent stamps) are not in scope.
+        src = ("class S:\n"
+               "    def publish(self, body):\n"
+               "        self.kube.patch('resource.k8s.io', 'v1',\n"
+               "                        'resourceclaims', 'c', body)\n")
+        assert "TPUDRA018" not in rules_of(lint_source(src))
+
+    def test_commit_scope_other_resource_clean(self):
+        src = ("class S:\n"
+               "    def commit(self, claim, cand):\n"
+               "        if not self.alloc.try_commit(claim, cand):\n"
+               "            return\n"
+               "        self.kube.patch('', 'v1', 'nodes', 'n', {})\n")
+        assert "TPUDRA018" not in rules_of(lint_source(src))
+
+
+class TestDocUrlsAndEdges:
+    """ISSUE 18 satellite: --json emits per-rule doc URLs and, for
+    interprocedural findings, the resolved call-graph edge."""
+
+    SRC_017 = ("import time\n"
+               "def deep():\n"
+               "    time.sleep(1)\n"
+               "class S:\n"
+               "    def bad(self):\n"
+               "        with self.pu_lock.acquire(timeout=1.0):\n"
+               "            deep()\n")
+
+    def test_finding_dict_carries_doc_url_and_edge(self):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.lint import rule_doc_url
+
+        (hit,) = [f for f in lint_source(self.SRC_017)
+                  if f.rule == "TPUDRA017"]
+        d = hit.to_dict()
+        assert d["doc_url"] == "docs/analysis.md#tpudra017"
+        assert d["doc_url"] == rule_doc_url("TPUDRA017")
+        assert "time.sleep" in d["edge"]
+        # Non-interprocedural findings carry edge=None, not a miss.
+        (two,) = lint_source(TestSuppression.SRC_BAD)
+        assert two.to_dict()["edge"] is None
+        assert two.to_dict()["doc_url"].endswith("#tpudra002")
+
+    def test_doc_base_env_override(self, monkeypatch):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.lint import rule_doc_url
+
+        monkeypatch.setenv("TPU_DRA_ANALYSIS_DOC_BASE",
+                           "https://ci.example.com/analysis")
+        assert rule_doc_url("TPUDRA018") == \
+            "https://ci.example.com/analysis#tpudra018"
+
+    def test_json_cli_emits_rule_docs_and_edges(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(self.SRC_017)
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s_dra_driver_gpu_tpu.pkg.analysis",
+             str(mod), "--root", str(tmp_path), "--no-baseline", "--json"],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert set(doc["rule_docs"]) == set(RULES)
+        assert doc["rule_docs"]["TPUDRA017"] == \
+            "docs/analysis.md#tpudra017"
+        (f017,) = [f for f in doc["findings"]
+                   if f["rule"] == "TPUDRA017"]
+        assert "time.sleep" in f017["edge"]
+        assert f017["doc_url"] == "docs/analysis.md#tpudra017"
+
+    def test_text_mode_prints_witness_edge(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(self.SRC_017)
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s_dra_driver_gpu_tpu.pkg.analysis",
+             str(mod), "--root", str(tmp_path), "--no-baseline"],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 1
+        assert "via " in proc.stdout and "time.sleep" in proc.stdout
 
 
 class TestSchedulerSyncListRule:

@@ -1,0 +1,294 @@
+"""Multi-actor protocol model checker tests (pkg/analysis/modelcheck).
+
+Three layers:
+- unit tests over the modeled apiserver / informer / durable
+  checkpoint (the semantics every scenario leans on);
+- the seeded-bug self-test: with the resourceVersion precondition
+  removed, bounded DFS must catch the double-allocation, minimize it,
+  and replay it deterministically -- mirroring `make modelcheck-smoke`;
+- bounded correct-protocol sweeps over the commit / prepare / recovery
+  scenarios (the full >= 10k-schedule run is `make modelcheck`).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg.analysis.interleave import (
+    ReplayChooser,
+    _run_one,
+    explore,
+    explore_random,
+)
+from k8s_dra_driver_gpu_tpu.pkg.analysis.modelcheck import (
+    CommitScenario,
+    DurableCheckpoint,
+    ModelApiServer,
+    ModelInformer,
+    check_scenario,
+    check_seeded_bug,
+    independent_ops,
+    make_artifact,
+    minimize_failure,
+    replay_artifact,
+    run_gates,
+)
+from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+    EVICTION_POLICY,
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    TWO_PHASE_POLICY,
+    CheckpointTransitionError,
+)
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import (
+    ConflictError,
+    NotFoundError,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestModelApiServer:
+    def mk(self):
+        return ModelApiServer({
+            "ledger": {"spec": {"devices": {"d0": None}}},
+            "c0": {"metadata": {"uid": "u0"}, "status": {}},
+        })
+
+    def test_objects_get_monotonic_resource_versions(self):
+        api = self.mk()
+        rvs = [int(api.get(n)["metadata"]["resourceVersion"])
+               for n in api.names()]
+        assert len(set(rvs)) == len(rvs)
+        before = int(api.get("c0")["metadata"]["resourceVersion"])
+        api.patch("c0", {"status": {"x": 1}})
+        assert int(api.get("c0")["metadata"]["resourceVersion"]) > before
+
+    def test_update_rv_precondition_conflicts(self):
+        api = self.mk()
+        stale = api.get("ledger")
+        api.patch("ledger", {"spec": {"devices": {"d0": "c0"}}})
+        with pytest.raises(ConflictError):
+            api.update("ledger", stale)
+        # The losing write changed nothing.
+        assert api.get("ledger")["spec"]["devices"]["d0"] == "c0"
+        # A fresh read's rv wins.
+        fresh = api.get("ledger")
+        fresh["spec"]["devices"]["d0"] = "c1"
+        api.update("ledger", fresh)
+        assert api.get("ledger")["spec"]["devices"]["d0"] == "c1"
+
+    def test_patch_rv_in_body_is_a_precondition(self):
+        api = self.mk()
+        stale_rv = api.get("c0")["metadata"]["resourceVersion"]
+        api.patch("c0", {"status": {"x": 1}})
+        with pytest.raises(ConflictError):
+            api.patch("c0", {"metadata": {"resourceVersion": stale_rv},
+                             "status": {"x": 2}})
+        assert api.get("c0")["status"]["x"] == 1
+
+    def test_rv_less_patch_is_the_blind_merge(self):
+        # Exactly the seeded bug's weapon: last writer silently wins.
+        api = self.mk()
+        api.patch("ledger", {"spec": {"devices": {"d0": "c0"}}})
+        api.patch("ledger", {"spec": {"devices": {"d0": "c1"}}})
+        assert api.get("ledger")["spec"]["devices"]["d0"] == "c1"
+
+    def test_merge_none_deletes_and_get_is_a_copy(self):
+        api = self.mk()
+        api.patch("c0", {"status": {"x": 1}})
+        api.patch("c0", {"status": {"x": None}})
+        assert "x" not in api.get("c0")["status"]
+        api.get("c0")["status"]["evil"] = True
+        assert "evil" not in api.get("c0")["status"]
+        with pytest.raises(NotFoundError):
+            api.get("nope")
+        with pytest.raises(NotFoundError):
+            api.patch("nope", {})
+
+    def test_subscribers_see_every_committed_write(self):
+        api = self.mk()
+        inf = ModelInformer(api, "s0")
+        assert inf.deliver() == 2  # primed with the initial list
+        api.patch("ledger", {"spec": {"devices": {"d0": "c0"}}})
+        api.patch("c0", {"status": {"x": 1}})
+        assert len(inf.queue) == 2
+        # Partial delivery models informer lag: the tail stays queued.
+        assert inf.deliver(upto=1) == 1
+        assert inf.get("ledger")["spec"]["devices"]["d0"] == "c0"
+        assert inf.get("c0")["status"] == {}
+        inf.deliver()
+        assert inf.get("c0")["status"]["x"] == 1
+
+
+class TestDurableCheckpoint:
+    def test_transitions_validated_by_policy(self):
+        cp = DurableCheckpoint(TWO_PHASE_POLICY)
+        cp.transition("u", PREPARE_STARTED)
+        cp.transition("u", PREPARE_COMPLETED)
+        cp.transition("u", None)
+        assert cp.states == {}
+
+    def test_illegal_transition_rejected(self):
+        cp = DurableCheckpoint(TWO_PHASE_POLICY)
+        with pytest.raises(CheckpointTransitionError):
+            cp.transition("u", PREPARE_COMPLETED)  # skipped reservation
+        assert cp.states == {}
+
+    def test_eviction_policy_wired(self):
+        cp = DurableCheckpoint(EVICTION_POLICY)
+        with pytest.raises(CheckpointTransitionError):
+            cp.transition("u", "EvictionDeallocated")
+
+
+class TestIndependenceJudgment:
+    def test_cross_actor_writes_to_distinct_objects_commute(self):
+        assert independent_ops("s0:write ledger", "s1:write c0")
+
+    def test_same_object_writes_dependent(self):
+        assert not independent_ops("s0:write ledger", "s1:write ledger")
+
+    def test_same_actor_never_commutes(self):
+        assert not independent_ops("s0:write ledger", "s0:write c0")
+
+    def test_reads_always_commute_cross_actor(self):
+        assert independent_ops("s0:read ledger", "s1:read ledger")
+        assert not independent_ops("s0:read ledger", "s1:write ledger")
+
+    def test_deliveries_crashes_and_unparsable_dependent(self):
+        assert not independent_ops("s0:deliver[1]", "s1:write c0")
+        assert not independent_ops("s0:crash@pre-reserve[0]",
+                                   "s1:write c0")
+        assert not independent_ops("start s0", "s1:write c0")
+
+
+class TestSeededBugGate:
+    """The CI-mirror: the deliberately re-seeded blind-write bug
+    (precondition=False, i.e. TPUDRA018's defect) must be caught,
+    minimized, and deterministically replayable within the smoke
+    budget."""
+
+    def test_seeded_double_allocation_caught_and_replayable(self):
+        out = check_seeded_bug(max_schedules=400)
+        assert out["caught"], "seeded bug escaped the bounded DFS"
+        assert out["replay_deterministic"]
+        assert out["ok"]
+        assert out["schedules_run"] <= 400
+        # Minimization reached a small reproducer.
+        assert 0 < len(out["minimized_choices"]) <= 12
+        # The artifact round-trips through the replay entrypoint.
+        sched, err = replay_artifact(out["artifact"])
+        assert err is not None
+        assert type(err).__name__ == out["artifact"]["error_type"]
+
+    def test_minimized_schedule_is_no_longer_failing_when_fixed(self):
+        # Replaying the buggy schedule against the CORRECT protocol
+        # must pass: the failure is the protocol's, not the harness's.
+        out = check_seeded_bug(max_schedules=400)
+        artifact = dict(out["artifact"],
+                        params={"precondition": True, "crashes": 0})
+        sched, err = replay_artifact(artifact)
+        assert err is None
+
+    def test_minimize_only_shrinks_and_stays_failing(self):
+        scenario = CommitScenario(precondition=False)
+        res = explore(scenario.build, scenario.invariant,
+                      max_schedules=400, stop_at_first_failure=True,
+                      independent=independent_ops)
+        failure = res.failures[0]
+        error_type = type(failure.error).__name__
+        minimized, probes = minimize_failure(
+            scenario, failure.choices, error_type)
+        assert len(minimized) <= len(failure.choices)
+        assert probes > 0
+        _, err = _run_one(scenario.build, scenario.invariant,
+                          ReplayChooser(minimized))
+        assert err is not None and type(err).__name__ == error_type
+
+
+class TestCorrectProtocolScenarios:
+    """Bounded clean sweeps (the full budget lives in
+    `make modelcheck`): the rv-preconditioned protocol survives DFS +
+    seeded-random exploration, including crash schedules."""
+
+    def test_commit_no_crashes_clean(self):
+        scenario = CommitScenario(precondition=True)
+        res = explore(scenario.build, scenario.invariant,
+                      max_schedules=250, independent=independent_ops)
+        assert res.ok, "\n".join(str(f) for f in res.failures[:3])
+        scenario = CommitScenario(precondition=True)
+        rres = explore_random(scenario.build, scenario.invariant,
+                              schedules=150, seed=11)
+        assert rres.ok, "\n".join(str(f) for f in rres.failures[:3])
+
+    @pytest.mark.parametrize("name", ["commit", "prepare", "recovery"])
+    def test_scenario_with_crash_budget_clean(self, name):
+        out = check_scenario(name, dfs=120, rand=60, seed=5, crashes=1)
+        assert out["ok"], out["failures"]
+        assert out["schedules_run"] > 0
+
+
+class TestGateRunner:
+    def test_run_gates_smoke_mirror(self):
+        """Tier-1 mirror of the `make modelcheck-smoke` CI step, at a
+        reduced budget: every gate (seeded bug, three scenarios, crash
+        closure) must pass."""
+        report = run_gates(full=False, schedules=240)
+        assert report["ok"], report
+        assert report["mode"] == "smoke"
+        gates = {g["gate"]: g for g in report["gates"]}
+        assert gates["seeded-bug"]["caught"]
+        assert gates["crash-closure"]["ok"]
+        assert {"commit(crashes=0)", "commit(crashes=1)",
+                "prepare(crashes=1)",
+                "recovery(crashes=1)"} <= set(gates)
+        assert report["schedules_total"] > 0
+
+    @pytest.mark.slow
+    def test_cli_smoke_passes(self, tmp_path):
+        out_path = tmp_path / "report.json"
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "k8s_dra_driver_gpu_tpu.pkg.analysis.modelcheck",
+             "--smoke", "--json-out", str(out_path)],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO,
+                 "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "PASS" in proc.stdout
+        report = json.loads(out_path.read_text())
+        assert report["ok"] and report["mode"] == "smoke"
+
+    def test_replay_cli_reproduces_artifact(self, tmp_path):
+        out = check_seeded_bug(max_schedules=400)
+        artifact_path = tmp_path / "artifact.json"
+        artifact_path.write_text(json.dumps(out["artifact"]))
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "k8s_dra_driver_gpu_tpu.pkg.analysis.modelcheck",
+             "--replay", str(artifact_path)],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "PYTHONPATH": REPO,
+                 "JAX_PLATFORMS": "cpu"},
+        )
+        # Exit 1 = the recorded schedule still reproduces the failure.
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "replay reproduces" in proc.stdout
+
+
+class TestArtifactShape:
+    def test_make_artifact_records_scenario_and_params(self):
+        scenario = CommitScenario(precondition=False, crashes=0)
+        res = explore(scenario.build, scenario.invariant,
+                      max_schedules=400, stop_at_first_failure=True,
+                      independent=independent_ops)
+        artifact = make_artifact(scenario, res.failures[0])
+        assert artifact["scenario"] == "commit"
+        assert artifact["params"] == {"precondition": False, "crashes": 0}
+        assert artifact["choices"] == res.failures[0].choices
+        assert artifact["error_type"]
+        assert json.loads(json.dumps(artifact)) == artifact

@@ -230,3 +230,64 @@ class TestDeviceStateWiring:
         # ...and the claim can still prepare normally afterwards.
         ids = state.prepare(make_claim("skip", ["chip-0"]))
         assert len(ids) == 1
+
+
+class TestCrashClosure:
+    """ISSUE 18: the static crash-closure pass -- every durable state
+    a crash can strand on disk must have a resume path back to absent,
+    for EVERY registered TransitionPolicy."""
+
+    def test_all_registered_policies_closed(self):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+            crash_closure_all,
+        )
+
+        report = crash_closure_all()
+        assert report["ok"], report
+        assert set(report["policies"]) == set(POLICIES)
+        assert len(report["policies"]) >= 6
+        for rep in report["policies"].values():
+            assert rep["unreachable"] == []
+            assert rep["unresumable"] == []
+            assert "absent" in rep["states"]
+
+    def test_trap_state_reported_unresumable(self):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+            crash_closure,
+        )
+
+        trap = TransitionPolicy("trap", frozenset([
+            (None, "A"), ("A", None),
+            ("A", "B"),  # B: no way back to absent
+        ]))
+        rep = crash_closure(trap)
+        assert not rep["ok"]
+        assert rep["unresumable"] == ["B"]
+        assert rep["unreachable"] == []
+
+    def test_orphan_state_reported_unreachable(self):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+            crash_closure,
+        )
+
+        orphan = TransitionPolicy("orphan", frozenset([
+            (None, "A"), ("A", None),
+            ("X", "A"),  # X appears in a rule but nothing reaches it
+        ]))
+        rep = crash_closure(orphan)
+        assert not rep["ok"]
+        assert rep["unreachable"] == ["X"]
+
+    def test_closure_over_given_registry(self):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+            crash_closure_all,
+        )
+
+        broken = TransitionPolicy("broken", frozenset([
+            (None, "A"), ("A", "B"),
+        ]))
+        report = crash_closure_all(
+            {"good": TWO_PHASE_POLICY, "broken": broken})
+        assert not report["ok"]
+        assert report["policies"]["good"]["ok"]
+        assert not report["policies"]["broken"]["ok"]

@@ -347,3 +347,64 @@ class TestRelistCoordinator:
         prios = [RELIST_PRIORITY.get(r, 9) for r in listed]
         assert prios == sorted(prios), listed
         assert listed[0] == "resourceslices"
+
+
+class TestEventGate:
+    """The model-checking seam (PR 18): with ``event_gate`` set, watch
+    events the gate declines are parked -- stale-cache windows become
+    an explicit, schedulable choice -- and ``flush_deferred()`` applies
+    them later in arrival order. Gate bugs must never lose events."""
+
+    def _started(self):
+        kube = FakeKubeClient()
+        inf = Informer(kube, API_GROUP, API_VERSION, "computedomains",
+                       kind="ComputeDomain").start()
+        assert inf.wait_for_sync(5.0)
+        return kube, inf
+
+    def test_gate_defers_and_flush_applies_in_order(self):
+        kube, inf = self._started()
+        inf.event_gate = lambda ev_type, obj: False
+        make_cd(kube, "cd1", uid="u1")
+        kube.patch(API_GROUP, API_VERSION, "computedomains", "cd1",
+                   {"status": {"status": "Ready"}}, namespace="default")
+        # Nothing landed in the cache: the window is held open.
+        assert inf.get_by_uid("u1") is None
+        inf.event_gate = None
+        assert inf.flush_deferred() == 2
+        cd = inf.get_by_uid("u1")
+        assert cd is not None
+        # Arrival order preserved: the patch applied after the add.
+        assert cd["status"]["status"] == "Ready"
+
+    def test_gate_can_pass_events_through(self):
+        kube, inf = self._started()
+        inf.event_gate = lambda ev_type, obj: True
+        make_cd(kube, "cd1", uid="u1")
+        assert inf.get_by_uid("u1") is not None
+        assert inf.flush_deferred() == 0
+
+    def test_deferred_delete_applies_on_flush(self):
+        kube, inf = self._started()
+        make_cd(kube, "cd1", uid="u1")
+        inf.event_gate = lambda ev_type, obj: False
+        kube.delete(API_GROUP, API_VERSION, "computedomains", "cd1",
+                    namespace="default")
+        assert inf.get_by_uid("u1") is not None  # still stale
+        assert inf.flush_deferred() == 1
+        assert inf.get_by_uid("u1") is None
+
+    def test_gate_exception_delivers_not_loses(self):
+        kube, inf = self._started()
+
+        def broken_gate(ev_type, obj):
+            raise RuntimeError("gate bug")
+
+        inf.event_gate = broken_gate
+        make_cd(kube, "cd1", uid="u1")
+        assert inf.get_by_uid("u1") is not None  # delivered anyway
+        assert inf.flush_deferred() == 0
+
+    def test_flush_without_gate_is_noop(self):
+        _, inf = self._started()
+        assert inf.flush_deferred() == 0

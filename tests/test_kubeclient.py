@@ -274,7 +274,7 @@ class TestOptimisticConcurrency:
         assert kube.get("", "v1", "configmaps", "cm",
                         namespace="ns")["data"]["k"] == "3"
 
-    def test_patch_never_rewinds_resource_version(self):
+    def test_patch_rv_in_body_is_a_precondition(self):
         kube = FakeKubeClient()
         kube.create("", "v1", "configmaps", {
             "apiVersion": "v1", "kind": "ConfigMap",
@@ -284,12 +284,19 @@ class TestOptimisticConcurrency:
         for i in range(3):  # advance the stored rv well past the copy
             kube.patch("", "v1", "configmaps", "cm",
                        {"data": {"k": str(i)}}, namespace="ns")
-        # Patching with a FULL stale object (rv inside the body) must
-        # not rewind the counter...
+        # A resourceVersion inside a merge-patch body is an optimistic
+        # concurrency precondition (real apiserver semantics): stale rv
+        # is a 409, never a silent rewind of the counter.
         stale["data"]["k"] = "stale"
-        kube.patch("", "v1", "configmaps", "cm", stale, namespace="ns")
+        with pytest.raises(ConflictError):
+            kube.patch("", "v1", "configmaps", "cm", stale, namespace="ns")
         fresh = kube.get("", "v1", "configmaps", "cm", namespace="ns")
-        assert int(fresh["metadata"]["resourceVersion"]) >= 5
-        # ...so a holder of the genuinely-latest rv still updates fine.
+        assert fresh["data"]["k"] == "2"
+        assert int(fresh["metadata"]["resourceVersion"]) >= 4
+        # A MATCHING rv in the body applies, bumps, and never rewinds.
         fresh["data"]["k"] = "after"
-        kube.update("", "v1", "configmaps", "cm", fresh, namespace="ns")
+        out = kube.patch("", "v1", "configmaps", "cm", fresh,
+                         namespace="ns")
+        assert out["data"]["k"] == "after"
+        assert (int(out["metadata"]["resourceVersion"])
+                > int(fresh["metadata"]["resourceVersion"]))
