@@ -5,6 +5,7 @@ PYTHON ?= python
 .PHONY: all native test test-fast bench bench-smoke \
 	bench-placement-smoke bench-chaos-smoke bench-sched-smoke \
 	bench-sched-scale bench-recovery-smoke bench-defrag-smoke \
+	bench-migration-smoke \
 	bench-serving-smoke bench-autoscale-smoke \
 	bench-powersched-smoke \
 	bench-trace-smoke bench-telemetry-smoke validate-dashboard \
@@ -108,6 +109,21 @@ bench-defrag-smoke:
 	BENCH_DEFRAG_OUT=$(or $(BENCH_DEFRAG_OUT),/tmp/BENCH_defrag_smoke.json) \
 	$(PYTHON) bench.py --defrag
 
+# Cooperative-migration smoke: a shrunk `--migration` run with every
+# gate enforced deterministically: the training gang migrates off the
+# evacuating host with bounded step-loss and a REAL orbax warm
+# restore, the serving tenant resizes s8->s2 with zero dropped
+# requests, every fault case (4 crash seams, ack-timeout,
+# checkpoint-failed, destination-lost, racing-delete) lands on the
+# cold fallback or resumes with zero residue, and the cooperative
+# cost tier visibly discounts defrag victim costs on identical pools.
+# Mirrored as a non-slow test in tests/test_bench_migration_smoke.py;
+# the full-scale trajectory file is BENCH_migration.json.
+bench-migration-smoke:
+	BENCH_MIGRATION_PASSES=24 BENCH_MIGRATION_REQUESTS_PER_PASS=3 \
+	BENCH_MIGRATION_OUT=$(or $(BENCH_MIGRATION_OUT),/tmp/BENCH_migration_smoke.json) \
+	$(PYTHON) bench.py --migration
+
 # Multi-tenant serving smoke: a shrunk `--serving` run (4 nodes x 96
 # tenants through the partition engine + slot-aware scheduler) with
 # the full gate set enforced deterministically: tenant density >= 4x
@@ -208,7 +224,7 @@ bench-trace-smoke:
 # is BENCH_observability.json "telemetry" (full-size plain
 # `bench.py --telemetry-overhead`).
 bench-telemetry-smoke:
-	BENCH_TELEMETRY_ITERS=8 BENCH_TELEMETRY_REPS=2 \
+	BENCH_TELEMETRY_ITERS=12 BENCH_TELEMETRY_REPS=3 \
 	BENCH_TELEMETRY_MAX_OVERHEAD_PCT=5 \
 	BENCH_OBS_OUT=$(or $(BENCH_OBS_OUT),/tmp/BENCH_observability_smoke.json) \
 	$(PYTHON) bench.py --telemetry-overhead

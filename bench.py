@@ -1271,13 +1271,22 @@ def bench_telemetry_overhead() -> dict:
     quantized attributes hash identically poll over poll); telemetry
     OFF must record NOTHING (the knob actually gates the station).
 
+    Two estimators guard against CI co-tenant noise: min-of-reps
+    (immune to one-sided slow outliers) and the MEDIAN of per-pair
+    ratios (immune to machine-wide drift across the run, since each
+    pair's sides run back to back). The reported value is the smaller
+    of the two -- a genuine regression moves both, while either kind
+    of noise inflates only one.
+
     Knobs: BENCH_TELEMETRY_ITERS (claim rounds, default 30),
     BENCH_TELEMETRY_POLLS (polls per round, 2),
-    BENCH_TELEMETRY_REPS (4)."""
+    BENCH_TELEMETRY_REPS (4), BENCH_TELEMETRY_EXTEND_ROUNDS
+    (adaptive re-measure rounds while over the cap, 4)."""
     iters = _env_int("BENCH_TELEMETRY_ITERS", 30)
     polls = _env_int("BENCH_TELEMETRY_POLLS", 2)
     reps = max(1, _env_int("BENCH_TELEMETRY_REPS", 4))
     cap = _env_float("BENCH_TELEMETRY_MAX_OVERHEAD_PCT", 5.0)
+    extend_rounds = max(0, _env_int("BENCH_TELEMETRY_EXTEND_ROUNDS", 4))
 
     offs, ons = [], []
     on_samples = 0
@@ -1302,16 +1311,30 @@ def bench_telemetry_overhead() -> dict:
     def min_overhead_pct() -> float:
         return max(0.0, (min(ons) / max(min(offs), 1e-9) - 1.0) * 100)
 
-    # Unmeasured warmup (code paths, checkpoint plumbing, CDI dirs).
+    def median_pair_ratio() -> float:
+        n = min(len(offs), len(ons))
+        ratios = sorted(ons[i] / max(offs[i], 1e-9) for i in range(n))
+        if n % 2:
+            return ratios[n // 2]
+        return (ratios[n // 2 - 1] + ratios[n // 2]) / 2
+
+    def overhead_now() -> float:
+        return min(min_overhead_pct(),
+                   max(0.0, (median_pair_ratio() - 1.0) * 100))
+
+    # Unmeasured warmup for BOTH sides (code paths, checkpoint
+    # plumbing, CDI dirs, the telemetry station's first-poll setup):
+    # a cold ON side against a warm OFF side reads as fake overhead.
     _telemetry_churn_wall(False, max(2, iters // 10), 1)
+    _telemetry_churn_wall(True, max(2, iters // 10), 1)
     measure_pairs(reps)
-    # Adaptive extension under co-tenant load: min-of-reps only
-    # improves with samples; a real regression survives any number.
-    for _ in range(2):
-        if not cap or min_overhead_pct() <= cap:
+    # Adaptive extension under co-tenant load: both estimators only
+    # improve with samples; a real regression survives any number.
+    for _ in range(extend_rounds):
+        if not cap or overhead_now() <= cap:
             break
         measure_pairs(reps)
-    overhead_pct = min_overhead_pct()
+    overhead_pct = overhead_now()
     return {
         "metric": "telemetry_overhead_pct",
         "value": round(overhead_pct, 2),
@@ -1327,6 +1350,9 @@ def bench_telemetry_overhead() -> dict:
             "telemetry_on_wall_s": round(min(ons), 4),
             "telemetry_off_walls_s": [round(v, 4) for v in offs],
             "telemetry_on_walls_s": [round(v, 4) for v in ons],
+            "telemetry_min_overhead_pct": round(min_overhead_pct(), 2),
+            "telemetry_median_pair_ratio": round(
+                median_pair_ratio(), 4),
             "telemetry_ring_samples_on": on_samples,
             "telemetry_ring_samples_off": off_samples,
             "telemetry_steady_writes_on": on_steady_writes,
@@ -2191,6 +2217,128 @@ def bench_chaos() -> dict:
                     os.environ[k] = v
             fleetstate.set_default_ring(fleetstate.TelemetryRing())
 
+    # -- scenario 7: cooperative migration under injected faults --------
+    # The checkpoint-then-switch handshake (pkg/migration) with an
+    # ERROR armed at every migration.* fault seam -- each absorbed by
+    # the scheduler's sync wrapper and retried next pass -- plus one
+    # controller CRASH at the switch seam, restarted by rebuilding the
+    # controller from the same durable root. The move must still
+    # complete cooperatively; any residue (in-flight record, leaked
+    # destination reservation, leftover contract annotation, claim off
+    # the reserved node) folds into the stuck sum below.
+    from k8s_dra_driver_gpu_tpu.pkg import migration as mig
+    from k8s_dra_driver_gpu_tpu.pkg.faults import InjectedCrash
+    from k8s_dra_driver_gpu_tpu.pkg.featuregates import FeatureGates
+    from k8s_dra_driver_gpu_tpu.pkg.metrics import MigrationMetrics
+    from k8s_dra_driver_gpu_tpu.pkg.recovery import (
+        MIGRATION_CAPABLE_ANNOTATION,
+        allocation_nodes,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+    from k8s_dra_driver_gpu_tpu.pkg.sliceutil import publish_resource_slices
+
+    faults.reset()
+    mig_driver = "tpu.dra.dev"
+    mig_res = ("resource.k8s.io", "v1")
+    with tempfile.TemporaryDirectory() as root:
+        mfake = FakeKubeClient()
+        mfake.create(*mig_res, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": mig_driver},
+            "spec": {"selectors": [{"cel": {
+                "expression": f'device.driver == "{mig_driver}"'}}]}})
+
+        def mig_node(name: str) -> None:
+            mfake.create("", "v1", "nodes", {
+                "metadata": {"name": name, "annotations": {}},
+                "status": {"conditions": [
+                    {"type": "Ready", "status": "True"}]}})
+            publish_resource_slices(mfake, [{
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceSlice",
+                "metadata": {"name": f"{name}-{mig_driver}"},
+                "spec": {"driver": mig_driver, "nodeName": name,
+                         "pool": {"name": name, "generation": 1,
+                                  "resourceSliceCount": 1},
+                         "devices": [
+                             {"name": f"chip-{i}", "attributes": {
+                                 "type": {"string": "tpu-chip"},
+                                 "platform": {"string": "v5e"},
+                                 "topology": {"string": "2x1"},
+                                 "iciX": {"int": i},
+                                 "iciY": {"int": 0}}}
+                             for i in range(2)]}}])
+
+        mig_node("mig-a")
+        msched = DraScheduler(mfake, gates=FeatureGates.parse(
+            "TopologyAwarePlacement=false"))
+        mfake.create(*mig_res, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "mig-victim", "namespace": "default",
+                         "annotations": {
+                             MIGRATION_CAPABLE_ANNOTATION: "true"}},
+            "spec": {"devices": {"requests": [{
+                "name": "tpu", "exactly": {
+                    "deviceClassName": mig_driver, "count": 2}}]}}},
+            namespace="default")
+        msched.sync_once()  # pins the claim on mig-a (the only node)
+        mig_node("mig-b")
+        mmet = MigrationMetrics()
+
+        def mk_ctl():
+            c = mig.MigrationController(
+                mfake, os.path.join(root, "mig"), metrics=mmet,
+                ack_s=60.0)
+            msched.attach_migration(c)
+            return c
+
+        mctl = mk_ctl()
+        mfake.patch("", "v1", "nodes", "mig-a", {"metadata": {
+            "annotations": {mig.EVACUATE_ANNOTATION: "true"}}})
+        for seam in ("migration.sync", "migration.reserve",
+                     "migration.signal"):
+            faults.arm(seam, mode="error", count=1)
+        faults.arm("migration.switch", mode="crash", count=1)
+        mig_crashes = 0
+        for _ in range(24):
+            claim = mfake.get(*mig_res, "resourceclaims", "mig-victim",
+                              namespace="default")
+            ann = claim["metadata"].get("annotations") or {}
+            if ann.get(mig.MIGRATION_INTENT_ANNOTATION) and \
+                    not ann.get(mig.MIGRATION_ACK_ANNOTATION):
+                # Play the workload: checkpoint "done", post the ack.
+                mfake.patch(*mig_res, "resourceclaims", "mig-victim",
+                            {"metadata": {"annotations": {
+                                mig.MIGRATION_ACK_ANNOTATION:
+                                    "step-1"}}},
+                            namespace="default")
+            try:
+                msched.sync_once()
+            except InjectedCrash:
+                mig_crashes += 1
+                mctl = mk_ctl()
+            if int(mmet.coop_moves._value.get()) >= 1 \
+                    and not mctl.active_moves():
+                break
+        claim = mfake.get(*mig_res, "resourceclaims", "mig-victim",
+                          namespace="default")
+        ann = claim["metadata"].get("annotations") or {}
+        mig_residue = (
+            len(mctl.active_moves()) + len(mctl.reservations())
+            + sum(1 for key in (mig.MIGRATION_INTENT_ANNOTATION,
+                                mig.MIGRATION_ACK_ANNOTATION,
+                                mig.DEFRAG_TARGET_ANNOTATION)
+                  if ann.get(key) is not None))
+        extras.update({
+            "chaos_migration_coop_moves": int(
+                mmet.coop_moves._value.get()),
+            "chaos_migration_crash_restarts": mig_crashes,
+            "chaos_migration_residue": mig_residue,
+            "chaos_migration_final_nodes": sorted(
+                allocation_nodes(claim)),
+        })
+    faults.reset()
+
     exposition = generate_latest(resilience.registry).decode()
     extras["chaos_metrics_exported"] = int(
         'tpu_dra_retry_total{verb="get"}' in exposition
@@ -2207,7 +2355,16 @@ def bench_chaos() -> dict:
              + (0 if extras["chaos_anomaly_straggler_detected"] else 1)
              + (0 if extras["chaos_anomaly_quarantined"] else 1)
              + (0 if extras["chaos_anomaly_events"] else 1)
-             + extras["chaos_telemetry_converged_writes"])
+             + extras["chaos_telemetry_converged_writes"]
+             # Migration chaos (scenario 7): the faulted handshake must
+             # still land cooperatively on the reserved node after
+             # exactly one crash-restart, with zero residue.
+             + (0 if extras["chaos_migration_coop_moves"] >= 1 else 1)
+             + (0 if extras["chaos_migration_crash_restarts"] == 1
+                else 1)
+             + (0 if extras["chaos_migration_final_nodes"] == ["mig-b"]
+                else 1)
+             + extras["chaos_migration_residue"])
     total = extras["chaos_claims_total"]
     prepared_or_clean = total - stuck_claims
     return {
@@ -2919,6 +3076,668 @@ def bench_defrag() -> dict:
         "vs_baseline": round(
             (decayed - (extras.get("defrag_final_frag") or 0.0))
             / max(decayed - target, 1e-9), 3) if decayed else 0.0,
+        "extras": extras,
+        "trajectory": trajectory[-200:],
+    }
+
+
+def bench_migration() -> dict:
+    """Cooperative live-migration mode (`bench.py --migration`): the
+    checkpoint-then-switch handshake (pkg/migration) end to end against
+    the real scheduler, with the bench playing the workload side of the
+    annotation contract.
+
+    Four scenarios, each counting violations:
+
+    1. **Training gang evacuation**: a 2-member CD gang (shared
+       ComputeDomainChannelConfig domainID) trains on a host that gets
+       the ``resource.tpu.dra/evacuate`` annotation. The controller
+       reserves a destination window, signals intent, the workload
+       checkpoints (the REAL train/checkpoint.py TrainCheckpointer
+       unless BENCH_SKIP_MODEL) and acks, the gang switches behind the
+       all-acked barrier, and the job restores WARM on the new window.
+       Gates: both members migrate cooperatively onto the planned
+       target, step-loss <= BENCH_MIGRATION_MAX_STEP_LOSS (vs the
+       much larger cold-restart counterfactual), restore returns the
+       acked checkpoint exactly.
+    2. **Serving s8->s2 resize, zero dropped requests**: a serving
+       tenant on an 8-chip claim resizes to a 2-chip profile
+       make-before-break (new claim placed + warm-restored before the
+       old one retires), then the s2 replica is cooperatively migrated
+       off an evacuating host. A request is dropped iff no ready
+       replica exists when it fires; the gate is ZERO drops across the
+       whole run.
+    3. **Fault sweep**: every failure mode the ISSUE names -- crash at
+       each ``migration.*`` seam (controller rebuilt from the durable
+       root mid-handshake), ack timeout, checkpoint failure
+       (ack=``failed``), destination lost, racing claim delete -- must
+       end in a completed cooperative move (crash cases) or a clean
+       cold fallback: zero stuck claims, zero leaked reservations,
+       zero leftover contract annotations.
+    4. **Paired defrag comparison**: two identical fragmented pools,
+       one with every claim migration-capable, one without; the defrag
+       planner must pick the same victims at visibly lower cost
+       (~TPU_DRA_COOP_COST_FACTOR, gate <= 0.5x).
+
+    Emits BENCH_migration.json; ``main`` exits nonzero on any
+    violation (`make bench-migration-smoke`). Knobs:
+    BENCH_MIGRATION_MAX_STEP_LOSS (5), BENCH_MIGRATION_CKPT_EVERY
+    (20, the periodic cadence anchoring the cold counterfactual),
+    BENCH_MIGRATION_PASSES (40), BENCH_MIGRATION_REQUESTS_PER_PASS
+    (5), BENCH_MIGRATION_OUT."""
+    from k8s_dra_driver_gpu_tpu.pkg import faults
+    from k8s_dra_driver_gpu_tpu.pkg.defrag import (
+        DEFRAG_TARGET_ANNOTATION,
+        DefragController,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.faults import InjectedCrash
+    from k8s_dra_driver_gpu_tpu.pkg.featuregates import FeatureGates
+    from k8s_dra_driver_gpu_tpu.pkg.kubeclient import FakeKubeClient
+    from k8s_dra_driver_gpu_tpu.pkg.metrics import (
+        DefragMetrics,
+        MigrationMetrics,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.migration import (
+        ACK_FAILED,
+        EVACUATE_ANNOTATION,
+        MIGRATION_ACK_ANNOTATION,
+        MIGRATION_INTENT_ANNOTATION,
+        MigrationController,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.recovery import (
+        MIGRATION_CAPABLE_ANNOTATION,
+        allocation_device_keys,
+        allocation_nodes,
+    )
+    from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+    from k8s_dra_driver_gpu_tpu.pkg.sliceutil import (
+        publish_resource_slices,
+    )
+
+    RES = ("resource.k8s.io", "v1")
+    DRIVER = "tpu.dra.dev"
+    CONTRACT = {MIGRATION_INTENT_ANNOTATION, MIGRATION_ACK_ANNOTATION,
+                DEFRAG_TARGET_ANNOTATION}
+    max_step_loss = _env_int("BENCH_MIGRATION_MAX_STEP_LOSS", 5)
+    ckpt_every = _env_int("BENCH_MIGRATION_CKPT_EVERY", 20)
+    passes = _env_int("BENCH_MIGRATION_PASSES", 40)
+    reqs_per_pass = _env_int("BENCH_MIGRATION_REQUESTS_PER_PASS", 5)
+    extras: dict = {}
+    trajectory: list[dict] = []
+    violations = 0
+
+    def violate(msg: str) -> None:
+        nonlocal violations
+        print(f"migration bench: {msg}", file=sys.stderr)
+        violations += 1
+
+    def node_slices(node, w, h=1):
+        devices = []
+        i = 0
+        for y in range(h):
+            for x in range(w):
+                devices.append({
+                    "name": f"chip-{i}",
+                    "attributes": {
+                        "type": {"string": "tpu-chip"},
+                        "platform": {"string": "v5e"},
+                        "topology": {"string": f"{w}x{h}"},
+                        "iciX": {"int": x}, "iciY": {"int": y},
+                    }})
+                i += 1
+        return [{
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceSlice",
+            "metadata": {"name": f"{node}-{DRIVER}"},
+            "spec": {"driver": DRIVER, "nodeName": node,
+                     "pool": {"name": node, "generation": 1,
+                              "resourceSliceCount": 1},
+                     "devices": devices},
+        }]
+
+    def build_cluster(gates=""):
+        fake = FakeKubeClient()
+        fake.create(*RES, "deviceclasses", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+            "metadata": {"name": DRIVER},
+            "spec": {"selectors": [{"cel": {
+                "expression": f'device.driver == "{DRIVER}"'}}]},
+        })
+        return fake, DraScheduler(fake, gates=FeatureGates.parse(gates))
+
+    def add_node(fake, name, w, h=1):
+        fake.create("", "v1", "nodes", {
+            "metadata": {"name": name},
+            "status": {"conditions": [
+                {"type": "Ready", "status": "True"}]}})
+        publish_resource_slices(fake, node_slices(name, w, h))
+
+    def make_claim(fake, name, count, gang=None, capable=True):
+        spec: dict = {"devices": {"requests": [{
+            "name": "tpu", "exactly": {
+                "deviceClassName": DRIVER, "count": count}}]}}
+        if gang:
+            spec["devices"]["config"] = [{"opaque": {"parameters": {
+                "kind": "ComputeDomainChannelConfig",
+                "domainID": gang}}}]
+        annotations = {}
+        if capable:
+            annotations[MIGRATION_CAPABLE_ANNOTATION] = "true"
+        fake.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": name, "namespace": "default",
+                         "annotations": annotations},
+            "spec": spec,
+        }, namespace="default")
+
+    def claims_of(fake):
+        return fake.list(*RES, "resourceclaims")
+
+    def annotations_of(claim):
+        return claim.get("metadata", {}).get("annotations") or {}
+
+    def pump_acks(fake, value, acked: set) -> list[str]:
+        """The workload side of the contract: ack every claim carrying
+        a fresh migration-intent annotation. Returns the names newly
+        acked this pass."""
+        fresh = []
+        for c in claims_of(fake):
+            name = c["metadata"]["name"]
+            ann = annotations_of(c)
+            if MIGRATION_INTENT_ANNOTATION in ann and name not in acked:
+                fake.patch(*RES, "resourceclaims", name,
+                           {"metadata": {"annotations": {
+                               MIGRATION_ACK_ANNOTATION: value}}},
+                           namespace="default")
+                acked.add(name)
+                fresh.append(name)
+        return fresh
+
+    def contract_residue(fake) -> int:
+        return sum(1 for c in claims_of(fake)
+                   if CONTRACT & set(annotations_of(c)))
+
+    def cleanliness(fake, ctrl, label) -> None:
+        """The zero-stuck / zero-leak bar every scenario ends on."""
+        if ctrl.active_moves():
+            violate(f"{label}: {len(ctrl.active_moves())} move "
+                    "record(s) left in flight")
+        if ctrl.reservations():
+            violate(f"{label}: {len(ctrl.reservations())} leaked "
+                    "destination reservation(s)")
+        residue = contract_residue(fake)
+        if residue:
+            violate(f"{label}: {residue} claim(s) with leftover "
+                    "contract annotations")
+        for c in claims_of(fake):
+            if not c.get("status", {}).get("allocation"):
+                violate(f"{label}: claim {c['metadata']['name']} "
+                        "left unallocated (stuck)")
+
+    # -- scenario 1: training gang off an evacuating host --------------
+    use_model = not os.environ.get("BENCH_SKIP_MODEL")
+    ckpt_impl = "none"
+    with tempfile.TemporaryDirectory() as root:
+        fake, sched = build_cluster()
+        add_node(fake, "node-a", 4)
+        make_claim(fake, "trainer-0", 2, gang="train-gang")
+        make_claim(fake, "trainer-1", 2, gang="train-gang")
+        sched.sync_once()
+        src_nodes = {n for c in claims_of(fake)
+                     for n in allocation_nodes(c)}
+        if src_nodes != {"node-a"}:
+            violate(f"training gang landed on {sorted(src_nodes)}, "
+                    "expected node-a")
+        add_node(fake, "node-b", 4)
+        add_node(fake, "node-c", 4)
+        fake.patch("", "v1", "nodes", "node-a",
+                   {"metadata": {"annotations": {
+                       EVACUATE_ANNOTATION: "true"}}})
+        metrics = MigrationMetrics()
+        ctrl = MigrationController(fake, os.path.join(root, "mig"),
+                                   metrics=metrics, max_concurrent=4)
+        sched.attach_migration(ctrl)
+
+        # The training job: a logical step counter (one step per
+        # scheduler pass while the gang holds its allocation), with a
+        # periodic checkpoint cadence anchoring the cold-restart
+        # counterfactual, and a REAL orbax save/restore at the
+        # cooperative ack when the model stack is available.
+        ckpt_state = {"saved_step": None, "train_state": None}
+        step_fn = None
+        if use_model:
+            try:
+                import jax  # noqa: PLC0415
+
+                from k8s_dra_driver_gpu_tpu.models import (  # noqa: PLC0415
+                    llama,
+                )
+                from k8s_dra_driver_gpu_tpu.parallel.mesh import (  # noqa: PLC0415
+                    build_mesh,
+                    plan_for,
+                )
+                from k8s_dra_driver_gpu_tpu.train.checkpoint import (  # noqa: PLC0415
+                    TrainCheckpointer,
+                )
+                from k8s_dra_driver_gpu_tpu.train.train import (  # noqa: PLC0415
+                    make_sharded_train,
+                )
+
+                mesh = build_mesh(plan_for(jax.device_count()))
+                cfg = llama.LlamaConfig.tiny()
+                init_fn, step_fn, batch_shard, place = \
+                    make_sharded_train(mesh, cfg)
+                train_state = init_fn(place(llama.init(
+                    jax.random.PRNGKey(0), cfg)))
+                tokens = jax.device_put(
+                    jax.random.randint(jax.random.PRNGKey(1), (4, 17),
+                                       0, cfg.vocab_size), batch_shard)
+                train_state, _ = step_fn(train_state, tokens)
+                ckpt_state["train_state"] = train_state
+                ckpt = TrainCheckpointer(os.path.join(root, "ckpt"))
+                ckpt_impl = "orbax"
+            except Exception as e:  # noqa: BLE001 - model stack optional
+                print(f"migration bench: model stack unavailable "
+                      f"({e}); using logical checkpoints",
+                      file=sys.stderr)
+                use_model = False
+        if not use_model:
+            ckpt = None
+            ckpt_impl = "logical"
+
+        step = 0
+        last_periodic = 0
+        ack_step = None
+        acked: set = set()
+        planned_targets: dict[str, str] = {}
+        step_at_switch = None
+        restored_step = None
+        warm_restore_ok = False
+        cold_loss = None
+        for p in range(passes):
+            step += 1
+            if step % ckpt_every == 0 and ack_step is None:
+                last_periodic = step  # the cold-restart anchor
+            fresh = pump_acks(fake, str(step), acked)
+            if fresh and ack_step is None:
+                ack_step = step
+                ckpt_state["saved_step"] = step
+                if ckpt is not None:
+                    ckpt.save(step, ckpt_state["train_state"])
+            nodes_before = {c["metadata"]["name"]:
+                            sorted(allocation_nodes(c))
+                            for c in claims_of(fake)}
+            sched.sync_once()
+            for uid, rec in ctrl._checkpoint.get().claims.items():
+                meta = (rec.devices[0].live or {}) if rec.devices \
+                    else {}
+                planned_targets.setdefault(uid, meta.get("node", ""))
+            nodes_after = {c["metadata"]["name"]:
+                           sorted(allocation_nodes(c))
+                           for c in claims_of(fake)}
+            if step_at_switch is None and \
+                    any(nodes_after[n] != nodes_before[n]
+                        for n in nodes_after):
+                # The gang switched this pass: the steps taken since
+                # the ack-time checkpoint are the lost work.
+                step_at_switch = step
+                if ckpt is not None:
+                    latest = ckpt.latest_step()
+                    restored = ckpt.restore(
+                        ckpt_state["train_state"], latest)
+                    restored_step = latest
+                    warm_restore_ok = (
+                        latest == ack_step
+                        and int(restored.step)
+                        == int(ckpt_state["train_state"].step))
+                else:
+                    restored_step = ckpt_state["saved_step"]
+                    warm_restore_ok = restored_step == ack_step
+                cold_loss = step - last_periodic
+                step = restored_step or 0  # the warm rollback
+            trajectory.append({
+                "phase": "train", "pass": p, "step": step,
+                **{k: v for k, v in ctrl.last_sync.items() if v}})
+            if int(metrics.coop_moves._value.get()) >= 2:
+                break
+        coop_moves = int(metrics.coop_moves._value.get())
+        coop_loss = (step_at_switch - ack_step) \
+            if step_at_switch is not None and ack_step else None
+        final_nodes = {c["metadata"]["name"]:
+                       sorted(allocation_nodes(c))
+                       for c in claims_of(fake)}
+        extras.update({
+            "migration_train_coop_moves": coop_moves,
+            "migration_train_fallbacks": int(sum(
+                child._value.get()
+                for child in metrics.fallbacks._metrics.values())),
+            "migration_train_ack_step": ack_step,
+            "migration_train_step_at_switch": step_at_switch,
+            "migration_train_restored_step": restored_step,
+            "migration_train_step_loss": coop_loss,
+            "migration_train_cold_step_loss_counterfactual": cold_loss,
+            "migration_train_checkpointer": ckpt_impl,
+            "migration_train_warm_restore_ok": int(warm_restore_ok),
+            "migration_train_final_nodes": sorted(
+                {n for ns in final_nodes.values() for n in ns}),
+        })
+        if coop_moves < 2:
+            violate(f"training gang: only {coop_moves}/2 members "
+                    "migrated cooperatively")
+        if any("node-a" in ns for ns in final_nodes.values()):
+            violate("training gang: a member is still on the "
+                    "evacuating host")
+        gang_nodes = {tuple(ns) for ns in final_nodes.values()}
+        if len(gang_nodes) != 1:
+            violate(f"training gang split across {gang_nodes}: the "
+                    "rendezvous cannot re-form")
+        planned = set(planned_targets.values()) - {""}
+        landed = {n for ns in final_nodes.values() for n in ns}
+        if planned and landed != planned:
+            violate(f"training gang landed on {sorted(landed)}, not "
+                    f"the reserved window on {sorted(planned)}")
+        if coop_loss is None or coop_loss > max_step_loss:
+            violate(f"training step-loss {coop_loss} exceeds the "
+                    f"{max_step_loss}-step bound")
+        if not warm_restore_ok:
+            violate("warm restore did not return the acked "
+                    "checkpoint")
+        if coop_loss is not None and cold_loss is not None and \
+                cold_loss < coop_loss:
+            violate(f"cold counterfactual ({cold_loss}) lost LESS "
+                    f"than the cooperative path ({coop_loss})")
+        cleanliness(fake, ctrl, "training gang")
+        if ckpt is not None:
+            ckpt.close()
+
+    # -- scenario 2: serving s8->s2 resize, zero dropped requests ------
+    with tempfile.TemporaryDirectory() as root:
+        fake, sched = build_cluster()
+        add_node(fake, "node-a", 8)
+        add_node(fake, "node-b", 4)
+        make_claim(fake, "svc-s8", 8)
+        sched.sync_once()
+        metrics = MigrationMetrics()
+        ctrl = MigrationController(fake, os.path.join(root, "mig"),
+                                   metrics=metrics)
+        sched.attach_migration(ctrl)
+
+        svc = {"ready": None, "version": 0, "ckpt": None}
+
+        def svc_checkpoint():
+            svc["ckpt"] = {"version": svc["version"]}
+
+        def svc_restore() -> bool:
+            if svc["ckpt"] is None:
+                return False
+            svc["version"] = svc["ckpt"]["version"]
+            return True
+
+        def replica_alloc(name):
+            for c in claims_of(fake):
+                if c["metadata"]["name"] == name:
+                    return c.get("status", {}).get("allocation")
+            return None
+
+        if replica_alloc("svc-s8"):
+            svc["ready"] = "svc-s8"
+        served = dropped = 0
+        resize_done = False
+        moved_nodes: list[str] = []
+        acked = set()
+        s2_nodes: set = set()
+        for p in range(passes):
+            # The request stream: a request is dropped iff no ready
+            # replica holds an allocation when it fires.
+            for _ in range(reqs_per_pass):
+                if svc["ready"] and replica_alloc(svc["ready"]):
+                    served += 1
+                    svc["version"] += 1
+                else:
+                    dropped += 1
+            if p == 2:
+                # Demand dropped: resize s8 -> s2, make-before-break.
+                svc_checkpoint()
+                make_claim(fake, "svc-s2", 2)
+            if not resize_done and replica_alloc("svc-s2"):
+                # New replica warm-restores BEFORE the old retires.
+                if svc_restore():
+                    svc["ready"] = "svc-s2"
+                    fake.delete(*RES, "resourceclaims", "svc-s8",
+                                namespace="default")
+                    resize_done = True
+                    s2_nodes = allocation_nodes(
+                        next(c for c in claims_of(fake)
+                             if c["metadata"]["name"] == "svc-s2"))
+            if resize_done and not moved_nodes and p >= 6 and \
+                    s2_nodes:
+                # Now drain the s2 replica's host cooperatively.
+                for n in s2_nodes:
+                    fake.patch("", "v1", "nodes", n,
+                               {"metadata": {"annotations": {
+                                   EVACUATE_ANNOTATION: "true"}}})
+                moved_nodes = sorted(s2_nodes)
+            if pump_acks(fake, f"v{svc['version']}", acked):
+                svc_checkpoint()  # checkpoint rides the ack
+            before = replica_alloc("svc-s2")
+            sched.sync_once()
+            after = replica_alloc("svc-s2")
+            if resize_done and after and before != after:
+                # Re-placed: restore from the ack-time checkpoint;
+                # ready again before the next request fires.
+                svc_restore()
+            traj = {"phase": "serve", "pass": p, "served": served,
+                    "dropped": dropped}
+            trajectory.append(traj)
+            if moved_nodes and \
+                    int(metrics.coop_moves._value.get()) >= 1 and \
+                    not ctrl.active_moves():
+                break
+        s2_claim = next((c for c in claims_of(fake)
+                         if c["metadata"]["name"] == "svc-s2"), None)
+        final_chips = len(allocation_device_keys(s2_claim)) \
+            if s2_claim else 0
+        extras.update({
+            "migration_serving_requests": served + dropped,
+            "migration_serving_served": served,
+            "migration_serving_dropped": dropped,
+            "migration_serving_resize_done": int(resize_done),
+            "migration_serving_final_chips": final_chips,
+            "migration_serving_coop_moves": int(
+                metrics.coop_moves._value.get()),
+        })
+        if dropped:
+            violate(f"serving: {dropped} dropped request(s) during "
+                    "the s8->s2 resize + move")
+        if not resize_done or final_chips != 2:
+            violate(f"serving: resize did not land on the s2 profile "
+                    f"(chips={final_chips})")
+        if int(metrics.coop_moves._value.get()) < 1:
+            violate("serving: the s2 replica never migrated "
+                    "cooperatively off the evacuating host")
+        final_s2_nodes = allocation_nodes(s2_claim) if s2_claim else set()
+        if moved_nodes and final_s2_nodes & set(moved_nodes):
+            violate("serving: the s2 replica is still on the "
+                    "evacuating host")
+        cleanliness(fake, ctrl, "serving")
+
+    # -- scenario 3: the fault sweep -----------------------------------
+    fault_results: dict[str, str] = {}
+
+    def run_fault_case(case: str) -> None:
+        faults.reset()
+        with tempfile.TemporaryDirectory() as root:
+            fake, sched = build_cluster()
+            add_node(fake, "node-a", 4)
+            make_claim(fake, "victim", 2)
+            sched.sync_once()
+            add_node(fake, "node-b", 4)
+            fake.patch("", "v1", "nodes", "node-a",
+                       {"metadata": {"annotations": {
+                           EVACUATE_ANNOTATION: "true"}}})
+            metrics = MigrationMetrics()
+            ack_s = 0.01 if case == "ack-timeout" else 60.0
+
+            def mk():
+                return MigrationController(
+                    fake, os.path.join(root, "mig"), metrics=metrics,
+                    ack_s=ack_s)
+
+            ctrl = mk()
+            sched.attach_migration(ctrl)
+            if case.startswith("crash-"):
+                faults.arm("migration." + case[len("crash-"):],
+                           mode="crash", count=1)
+            acked: set = set()
+            crashed = False
+            fellback = None
+            for p in range(16):
+                if case == "checkpoint-failed":
+                    pump_acks(fake, ACK_FAILED, acked)
+                elif case != "ack-timeout":
+                    pump_acks(fake, "s1", acked)
+                if case == "destination-lost" and \
+                        ctrl.active_moves() and p >= 1:
+                    try:
+                        fake.delete(*RES, "resourceslices",
+                                    f"node-b-{DRIVER}")
+                    except Exception:  # noqa: BLE001 - already gone
+                        pass
+                if case == "racing-delete" and any(
+                        s == "MigrationIntentSignaled"
+                        for s in ctrl.active_moves().values()):
+                    fake.delete(*RES, "resourceclaims", "victim",
+                                namespace="default")
+                try:
+                    sched.sync_once()
+                except InjectedCrash:
+                    # The controller process died at the seam: rebuild
+                    # from the same durable root, exactly like a
+                    # restarted pod.
+                    crashed = True
+                    ctrl = mk()
+                    sched.attach_migration(ctrl)
+                    continue
+                for reason in ("ack-timeout", "checkpoint-failed",
+                               "destination-lost", "deadline"):
+                    if metrics.fallbacks.labels(
+                            reason)._value.get() >= 1:
+                        fellback = reason
+                if case == "ack-timeout":
+                    time.sleep(0.02)
+                done_coop = int(metrics.coop_moves._value.get()) >= 1
+                if done_coop or fellback or (
+                        case == "racing-delete"
+                        and not claims_of(fake)
+                        and not ctrl.active_moves()):
+                    break
+            # Stop planning NEW moves (the host is still annotated,
+            # and a fallen-back capable claim would be re-planned
+            # forever) and drain to the terminal state.
+            fake.patch("", "v1", "nodes", "node-a",
+                       {"metadata": {"annotations": {
+                           EVACUATE_ANNOTATION: None}}})
+            faults.reset()
+            for _ in range(4):
+                sched.sync_once()
+            coop = int(metrics.coop_moves._value.get())
+            if case.startswith("crash-"):
+                if not crashed:
+                    violate(f"fault sweep {case}: the seam never "
+                            "crashed (fault not wired)")
+                if coop < 1:
+                    violate(f"fault sweep {case}: move did not "
+                            "resume to completion after the crash")
+                fault_results[case] = "resumed" if coop else "stuck"
+            elif case == "racing-delete":
+                if claims_of(fake):
+                    violate("fault sweep racing-delete: claim still "
+                            "exists")
+                fault_results[case] = "canceled"
+            else:
+                if fellback != case and not (
+                        case == "destination-lost"
+                        and fellback == "deadline"):
+                    violate(f"fault sweep {case}: expected a "
+                            f"{case} fallback, saw {fellback}")
+                fault_results[case] = f"fellback:{fellback}"
+            cleanliness(fake, ctrl, f"fault sweep {case}")
+
+    for case in ("crash-sync", "crash-reserve", "crash-signal",
+                 "crash-switch", "ack-timeout", "checkpoint-failed",
+                 "destination-lost", "racing-delete"):
+        run_fault_case(case)
+    faults.reset()
+    extras["migration_fault_sweep"] = fault_results
+
+    # -- scenario 4: paired defrag victim-cost comparison --------------
+    def defrag_plan_costs(capable: bool) -> dict[str, float]:
+        with tempfile.TemporaryDirectory() as root:
+            fake, sched = build_cluster("TopologyAwarePlacement=false")
+            add_node(fake, "node-a", 4, 4)
+            for k in range(8):
+                make_claim(fake, f"c{k}", 2, capable=capable)
+                sched.sync_once()
+            # This exact deletion set shreds the 4x4 grid (frag 0.25,
+            # largest free window 6 < the 8-chip carve) so the 0.01
+            # trigger fires; the every-other-claim pattern happens to
+            # free two intact 2x2 blocks and plans nothing.
+            for k in (0, 1, 2, 4):
+                fake.delete(*RES, "resourceclaims", f"c{k}",
+                            namespace="default")
+            sched.sync_once()
+            dm = DefragMetrics()
+            dctl = DefragController(
+                fake, os.path.join(root, "defrag"), metrics=dm,
+                trigger=0.01, release=0.0, sustain_s=0.0,
+                max_concurrent=8, budget_pct=100.0, cooldown_s=0.0)
+            sched.attach_defrag(dctl)
+            sched.sync_once()  # ONE pass: plan only, harvest costs
+            by_uid = {c["metadata"]["uid"]: c["metadata"]["name"]
+                      for c in claims_of(fake)}
+            out = {}
+            for uid, rec in dctl._checkpoint.get().claims.items():
+                meta = (rec.devices[0].live or {}) if rec.devices \
+                    else {}
+                if "cost" in meta and uid in by_uid:
+                    out[by_uid[uid]] = float(meta["cost"])
+            return out
+
+    cold_costs = defrag_plan_costs(capable=False)
+    coop_costs = defrag_plan_costs(capable=True)
+    extras["migration_defrag_cold_victims"] = sorted(cold_costs)
+    extras["migration_defrag_coop_victims"] = sorted(coop_costs)
+    if not cold_costs or not coop_costs:
+        violate("paired defrag comparison: a plan produced no "
+                "victims to compare")
+        cost_ratio = None
+    elif sorted(cold_costs) != sorted(coop_costs):
+        violate("paired defrag comparison: the discount changed the "
+                "victim set on identical pools")
+        cost_ratio = None
+    else:
+        cost_ratio = round(
+            sum(coop_costs.values()) / max(sum(cold_costs.values()),
+                                           1e-9), 3)
+        if cost_ratio > 0.5:
+            violate(f"paired defrag comparison: cooperative cost "
+                    f"ratio {cost_ratio} is not visibly lower "
+                    "(expected ~TPU_DRA_COOP_COST_FACTOR)")
+    extras["migration_defrag_cost_ratio"] = cost_ratio
+
+    coop_loss = extras.get("migration_train_step_loss")
+    cold_loss = extras.get(
+        "migration_train_cold_step_loss_counterfactual")
+    return {
+        "metric": "migration_violations",
+        "value": violations,
+        "unit": "violations",
+        # Step-loss advantage of the cooperative path over the
+        # cold-restart counterfactual (>= 1.0 means checkpoint-on-
+        # demand lost no more than the periodic cadence would have).
+        "vs_baseline": round(cold_loss / max(coop_loss, 1), 2)
+        if coop_loss is not None and cold_loss is not None else 0.0,
         "extras": extras,
         "trajectory": trajectory[-200:],
     }
@@ -4028,6 +4847,16 @@ def _write_defrag_json(result: dict) -> None:
         f.write("\n")
 
 
+def _write_migration_json(result: dict) -> None:
+    out_path = os.environ.get(
+        "BENCH_MIGRATION_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_migration.json"))
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
 def _sched_json_path() -> str:
     return os.environ.get(
         "BENCH_SCHED_OUT",
@@ -4448,6 +5277,18 @@ def _dispatch() -> None:
         # The CI gate (`make bench-defrag-smoke`): failed decay,
         # failed convergence, a blown move budget, anything stuck, or
         # a control-run move is a hard failure.
+        if result["value"] > 0:
+            sys.exit(1)
+        return
+    if "--migration" in sys.argv[1:]:
+        result = bench_migration()
+        _write_migration_json(result)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "trajectory"}))
+        # The CI gate (`make bench-migration-smoke`): a failed
+        # handshake, unbounded step-loss, a dropped request, a fault
+        # path that didn't fall back clean, a leaked reservation, or
+        # an invisible cooperative discount is a hard failure.
         if result["value"] > 0:
             sys.exit(1)
         return

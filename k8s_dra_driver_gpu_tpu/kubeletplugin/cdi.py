@@ -115,12 +115,22 @@ class CDIHandler:
 
     def common_edits(self, host) -> ContainerEdits:
         """Edits shared by every claim on this host (GetCommonEditsCached
-        analog, cdi.go:112): libtpu mount + host-level env."""
+        analog, cdi.go:112): libtpu mount + host-level env.
+
+        The two TPU_DRA_MIGRATION_* vars are the cooperative-migration
+        env contract (pkg/migration): they name the claim annotations a
+        migration-capable workload watches for the checkpoint signal
+        and writes its ack to, so the container needs no hardcoded
+        knowledge of the driver's annotation namespace."""
         edits = ContainerEdits(
             env=[
                 "TPU_SKIP_MDS_QUERY=1",
                 f"TPU_ACCELERATOR_TYPE={host.accelerator_type}",
                 f"TPU_WORKER_ID={host.worker_id}",
+                ("TPU_DRA_MIGRATION_INTENT_ANNOTATION="
+                 "resource.tpu.dra/migration-intent"),
+                ("TPU_DRA_MIGRATION_ACK_ANNOTATION="
+                 "resource.tpu.dra/migration-ack"),
             ],
         )
         if os.path.exists(self._libtpu):

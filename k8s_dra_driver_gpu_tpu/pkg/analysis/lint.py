@@ -275,7 +275,13 @@ _STATE_LITERALS = {"PrepareStarted", "PrepareCompleted",
                    # Autoscale rollout lifecycle (pkg/autoscale/
                    # controller.py): the serving autoscaler's re-plan
                    # records live under the autoscale TransitionPolicy.
-                   "AutoscalePlanned", "AutoscaleApplying"}
+                   "AutoscalePlanned", "AutoscaleApplying",
+                   # Cooperative-migration lifecycle (pkg/migration.py):
+                   # checkpoint-then-switch records live under the
+                   # migration TransitionPolicy; raw literals bypass
+                   # the model identically.
+                   "MigrationDestReserved", "MigrationIntentSignaled",
+                   "MigrationWorkloadAcked", "MigrationSwitching"}
 # Copy constructors that launder taint (deep or top-level).
 _COPY_CALLS = {"json_copy", "deepcopy", "dict", "list", "sorted",
                "json_loads"}

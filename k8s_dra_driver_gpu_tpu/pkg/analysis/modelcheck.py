@@ -40,7 +40,14 @@ inline during execution where noted):
 - **TransitionPolicies hold across crashes**: every durable checkpoint
   write is validated inline against its ``TransitionPolicy``
   (TWO_PHASE for the node plugin, EVICTION for the recovery
-  controller), including writes on the post-crash resume path.
+  controller, MIGRATION for the cooperative-move controller),
+  including writes on the post-crash resume path.
+- **Migration never leaks**: the checkpoint-then-switch handshake
+  (:class:`MigrationScenario`) ends every explored schedule -- stale
+  plan reads, delayed/never acks, crash-restart at each seam, a racing
+  claim delete -- with no reservation marker left in the ledger, no
+  undrained move record, and the undeleted claim allocated on source
+  or destination (the cold fallback never strands it).
 
 Exploration is DFS (``interleave.explore``) plus seeded-random
 sampling, with a conservative partial-order reduction
@@ -75,6 +82,11 @@ from .statemachine import (
     EVICTION_DRAINING,
     EVICTION_PLANNED,
     EVICTION_POLICY,
+    MIGRATION_DEST_RESERVED,
+    MIGRATION_INTENT_SIGNALED,
+    MIGRATION_POLICY,
+    MIGRATION_SWITCHING,
+    MIGRATION_WORKLOAD_ACKED,
     PREPARE_COMPLETED,
     PREPARE_STARTED,
     TWO_PHASE_POLICY,
@@ -767,10 +779,354 @@ class RecoveryScenario:
             f"eviction checkpoint not drained: {cp.states}")
 
 
+# -- scenario: cooperative migration handshake --------------------------------
+
+
+class MigrationScenario:
+    """A claim sits allocated on a source device and the migration
+    controller walks the cooperative checkpoint-then-switch handshake
+    (pkg/migration) against it: reserve a destination FIRST (a ledger
+    marker written with an rv precondition -- the modeled
+    reservation-veto), signal the workload via a claim annotation, wait
+    for the checkpoint ack, then switch (free the source + convert the
+    reservation into the allocation in one preconditioned write) and
+    re-stamp. Every rung persists in a DurableCheckpoint under
+    MIGRATION_POLICY, so a crash at any seam resumes idempotently.
+
+    The explored adversaries: a STALE plan read (informer delivery
+    choice), an arbitrarily DELAYED (or never-arriving) workload ack, a
+    controller CRASH-RESTART at every post-transition seam, a RACING
+    CLAIM DELETE (deletionTimestamp tombstone), and a contending
+    scheduler placing its own claim into the same pool. The invariant
+    set is the robustness contract: no leaked reservation marker, no
+    drained-but-present record, no double allocation, the undeleted
+    claim always ends allocated (source OR destination -- a fallback
+    never strands it), and the bystander claim always converges."""
+
+    name = "migration"
+
+    RESERVED = "!c0"  # ledger marker: destination held for the move
+
+    def __init__(self, crashes: int = 1):
+        self.crash_budget = crashes
+        self.commit = CommitScenario(precondition=True, crashes=0,
+                                     rounds=1)
+        self.commit.devices = {"d0": "n0", "d1": "n1", "d2": "n2"}
+        self.commit.claims = {"c1": "s1"}
+        self.source = "d0"
+        self.checkpoint: DurableCheckpoint | None = None
+        # The durable record's live payload (the planned target): hands
+        # over to a restarted incarnation exactly like the on-disk
+        # record, while all other controller state dies with the crash.
+        self.durable: dict[str, str] = {}
+        self._crashes_left = 0
+
+    def _initial_objects(self) -> dict[str, dict]:
+        objs = self.commit._initial_objects()
+        objs["c0"] = {"metadata": {"name": "c0", "namespace": "default",
+                                   "uid": "uid-c0"}, "status": {}}
+        _ledger_devices(objs["ledger"])[self.source] = "c0"
+        stamped = claim_like(
+            "c0", [(DRIVER, POOL, self.source)], uid="uid-c0")
+        objs["c0"]["status"] = stamped["status"]
+        return objs
+
+    def _maybe_crash(self, sched: ControlledScheduler, seam: str) -> None:
+        if self._crashes_left <= 0:
+            return
+        if sched.choice(2, f"migration:crash@{seam}") == 1:
+            self._crashes_left -= 1
+            raise _ActorCrash(f"migration @ {seam}")
+
+    def _claim_deleted(self, api: ModelApiServer) -> bool:
+        try:
+            return bool(api.get("c0")["metadata"].get(
+                "deletionTimestamp"))
+        except NotFoundError:
+            return True
+
+    def _cancel(self, sched: ControlledScheduler, api: ModelApiServer,
+                cp: DurableCheckpoint) -> None:
+        """The guaranteed cold path, legal from every rung
+        (MIGRATION_POLICY allows state -> absent everywhere): release
+        the reservation marker, drop any ledger slot a DELETED claim
+        still holds, clear the contract annotations, retire the
+        record. An undeleted claim keeps its source allocation -- the
+        workload was never stopped, so fallback must not disturb it."""
+        for attempt in range(8):
+            ledger = api.get("ledger")
+            devs = _ledger_devices(ledger)
+            gone = self._claim_deleted(api)
+            dirty = [d for d, v in devs.items()
+                     if v == self.RESERVED or (gone and v == "c0")]
+            if not dirty:
+                break
+            new = copy.deepcopy(ledger)
+            for d in dirty:
+                _ledger_devices(new)[d] = None
+            if attempt == 0:
+                sched.yield_point("migration:write ledger")
+            try:
+                api.update("ledger", new)
+                break
+            except ConflictError:
+                continue
+        try:
+            api.patch("c0", {"metadata": {"annotations": {
+                "intent": None, "ack": None}}})
+        except NotFoundError:
+            pass
+        if cp.states.get("uid-c0") is not None:
+            cp.transition("uid-c0", None)
+        self.durable.pop("target", None)
+
+    def _controller_body(self, sched: ControlledScheduler,
+                         api: ModelApiServer,
+                         cp: DurableCheckpoint) -> None:
+        uid = "uid-c0"
+        if cp.states.get(uid) is None:
+            # Plan against a possibly-STALE informer read: the delivery
+            # choice decides how much of the watch stream the plan saw.
+            inf = ModelInformer(api, "migration-inf")
+            pick = sched.choice(3, "migration:deliver")
+            if pick == 0:
+                inf.deliver()
+            elif pick == 2:
+                inf.deliver(max(len(inf.queue) - 1, 0))
+            api.unsubscribe("migration-inf")
+            ledger = inf.get("ledger") or api.get("ledger")
+            devs = _ledger_devices(ledger)
+            free = [d for d in sorted(devs)
+                    if devs[d] is None and d != self.source]
+            if not free:
+                return  # nothing reservable: defer, claim undisturbed
+            # Reserve-first: the durable record (with its target) IS
+            # the reservation; the ledger marker is re-derived from it
+            # on every resume, so a crash here cannot leak anything.
+            self.durable["target"] = free[0]
+            cp.transition(uid, MIGRATION_DEST_RESERVED)
+            self._maybe_crash(sched, "reserve")
+        target = self.durable.get("target", "")
+        if cp.states.get(uid) == MIGRATION_DEST_RESERVED:
+            # Pin the marker with an rv precondition. A stale plan
+            # loses the race here and cancels: reserve-first means
+            # nothing was disrupted yet, so deferral is free.
+            pinned = False
+            for _ in range(8):
+                ledger = api.get("ledger")
+                devs = _ledger_devices(ledger)
+                if devs.get(target) == self.RESERVED:
+                    pinned = True
+                    break
+                if devs.get(target) is not None:
+                    break  # destination raced away
+                new = copy.deepcopy(ledger)
+                _ledger_devices(new)[target] = self.RESERVED
+                sched.yield_point("migration:write ledger")
+                try:
+                    api.update("ledger", new)
+                    pinned = True
+                    break
+                except ConflictError:
+                    continue
+            if not pinned or self._claim_deleted(api):
+                self._cancel(sched, api, cp)
+                return
+            sched.yield_point("migration:write c0")
+            try:
+                api.patch("c0", {"metadata": {"annotations": {
+                    "intent": target}}})
+            except NotFoundError:
+                self._cancel(sched, api, cp)
+                return
+            cp.transition(uid, MIGRATION_INTENT_SIGNALED)
+            self._maybe_crash(sched, "signal")
+        if cp.states.get(uid) == MIGRATION_INTENT_SIGNALED:
+            acked = False
+            for _ in range(6):
+                if self._claim_deleted(api):
+                    self._cancel(sched, api, cp)  # racing delete: cancel
+                    return
+                claim = api.get("c0")
+                if ((claim["metadata"].get("annotations") or {})
+                        .get("ack")):
+                    acked = True
+                    break
+                sched.yield_point("migration:read c0")
+            if not acked:
+                self._cancel(sched, api, cp)  # ack timeout: cold fallback
+                return
+            cp.transition(uid, MIGRATION_WORKLOAD_ACKED)
+            self._maybe_crash(sched, "ack")
+        if cp.states.get(uid) == MIGRATION_WORKLOAD_ACKED:
+            if self._claim_deleted(api):
+                self._cancel(sched, api, cp)
+                return
+            cp.transition(uid, MIGRATION_SWITCHING)
+            self._maybe_crash(sched, "switch")
+        if cp.states.get(uid) == MIGRATION_SWITCHING:
+            # The switch: ONE preconditioned ledger write frees the
+            # source and converts the reservation into the allocation;
+            # then the claim re-stamps onto the destination. Each arm
+            # is idempotent for the crash-resume path.
+            for _ in range(8):
+                ledger = api.get("ledger")
+                devs = _ledger_devices(ledger)
+                if devs.get(self.source) != "c0" and \
+                        devs.get(target) == "c0":
+                    break  # a previous incarnation already switched
+                new = copy.deepcopy(ledger)
+                nd = _ledger_devices(new)
+                if nd.get(self.source) == "c0":
+                    nd[self.source] = None
+                nd[target] = "c0"
+                sched.yield_point("migration:write ledger")
+                try:
+                    api.update("ledger", new)
+                    break
+                except ConflictError:
+                    continue
+            sched.yield_point("migration:write c0")
+            try:
+                api.patch("c0", {"metadata": {"annotations": {
+                    "intent": None, "ack": None}}, "status": None})
+                api.patch("c0", _stamp_patch(target))
+            except NotFoundError:
+                pass
+            cp.transition(uid, None)
+            self.durable.pop("target", None)
+            if self._claim_deleted(api):
+                self._cancel(sched, api, cp)  # deleted mid-switch: scrub
+
+    def build(self, sched: ControlledScheduler) -> None:
+        self.commit.api = ModelApiServer(self._initial_objects())
+        api = self.commit.api
+        self.checkpoint = DurableCheckpoint(MIGRATION_POLICY)
+        self.durable = {}
+        self._crashes_left = self.crash_budget
+
+        def controller() -> None:
+            cp = self.checkpoint
+            for _ in range(self.crash_budget + 1):
+                try:
+                    self._controller_body(sched, api, cp)
+                    return
+                except _ActorCrash:
+                    sched.yield_point("migration:restart")
+            self._cancel(sched, api, cp)  # budget exhausted: cold path
+
+        def workload() -> None:
+            # The migration-capable workload: watches for the intent
+            # annotation through its OWN (choice-delayed) informer,
+            # checkpoints, acks. May never see the intent within its
+            # run -- that schedule exercises the ack-timeout fallback.
+            inf = ModelInformer(api, "workload")
+            try:
+                for _ in range(5):
+                    if inf.queue:
+                        pick = sched.choice(3, "workload:deliver")
+                        if pick == 0:
+                            inf.deliver()
+                        elif pick == 2:
+                            inf.deliver(len(inf.queue) - 1)
+                    cached = inf.get("c0")
+                    ann = ((cached or {}).get("metadata") or {}).get(
+                        "annotations") or {}
+                    if ann.get("intent"):
+                        if sched.choice(2, "workload:ack-delay") == 1:
+                            sched.yield_point("workload:checkpointing")
+                        sched.yield_point("workload:write c0")
+                        try:
+                            api.patch("c0", {"metadata": {
+                                "annotations": {"ack": "ok"}}})
+                        except NotFoundError:
+                            pass
+                        return
+                    sched.yield_point("workload:idle")
+            finally:
+                api.unsubscribe("workload")
+
+        def deleter() -> None:
+            # The racing claim delete, as an explored branch: a
+            # tombstone patch (the model's deletionTimestamp) at
+            # whatever point the schedule lands it, followed by the
+            # SCHEDULER'S deleted-claim sweep (folded into this actor:
+            # a deleted claim's ledger slots are reclaimed by the
+            # allocation owner, while the reservation marker stays the
+            # migration controller's to release).
+            if sched.choice(2, "deleter:delete") != 1:
+                return
+            sched.yield_point("deleter:write c0")
+            api.patch("c0", {"metadata": {
+                "deletionTimestamp": "T0"}})
+            for attempt in range(8):
+                ledger = api.get("ledger")
+                devs = _ledger_devices(ledger)
+                dirty = [d for d, v in devs.items() if v == "c0"]
+                if not dirty:
+                    return
+                new = copy.deepcopy(ledger)
+                for d in dirty:
+                    _ledger_devices(new)[d] = None
+                if attempt == 0:
+                    sched.yield_point("deleter:write ledger")
+                try:
+                    api.update("ledger", new)
+                    return
+                except ConflictError:
+                    continue
+
+        def bystander() -> None:
+            # A contending scheduler placing c1 into the same pool:
+            # the reservation marker must veto it off the destination.
+            self.commit._scheduler_body(sched, api, "s1", ["c1"])
+
+        sched.spawn(controller, name="migration")
+        sched.spawn(workload, name="workload")
+        sched.spawn(deleter, name="deleter")
+        sched.spawn(bystander, name="s1")
+
+    def invariant(self, sched: ControlledScheduler) -> None:
+        api = self.commit.api
+        cp = self.checkpoint
+        assert api is not None and cp is not None
+        ledger = api.get("ledger")
+        devs = _ledger_devices(ledger)
+        # No leaked destination reservation, no undrained record.
+        leaked = [d for d, v in devs.items() if v == self.RESERVED]
+        assert not leaked, f"leaked destination reservation on {leaked}"
+        assert not cp.states, (
+            f"migration record not drained: {cp.states}")
+        # The bystander claim converged, ledger-consistently.
+        self.commit.invariant(sched)
+        c0 = api.get("c0")
+        placed = {c: d for d, c in devs.items() if c is not None}
+        if c0["metadata"].get("deletionTimestamp"):
+            assert "c0" not in placed, (
+                f"deleted claim c0 still holds ledger slot "
+                f"{placed.get('c0')!r}")
+            return
+        # The undeleted claim is never stranded: it ends allocated on
+        # source OR destination, status and ledger agreeing, disjoint
+        # from the bystander.
+        keys = _status_keys(c0)
+        assert keys, "c0 lost its allocation without being deleted"
+        stamped = {k[2] for k in keys}
+        assert placed.get("c0") in stamped, (
+            f"ledger/status divergence: c0 stamped {sorted(stamped)} "
+            f"but ledger places it on {placed.get('c0')!r}")
+        c1_keys = _status_keys(api.get("c1"))
+        overlap = keys & c1_keys
+        assert not overlap, (
+            f"double-allocation: {sorted(k[2] for k in overlap)} held "
+            f"by both c0 and c1")
+
+
 SCENARIOS = {
     "commit": CommitScenario,
     "prepare": PrepareScenario,
     "recovery": RecoveryScenario,
+    "migration": MigrationScenario,
 }
 
 
@@ -983,6 +1339,9 @@ def run_gates(full: bool = False, seed: int = 0,
                                 crashes=1))
     gates.append(check_scenario("recovery", dfs=crash_budget,
                                 rand=crash_budget // 2, seed=seed + 3,
+                                crashes=1))
+    gates.append(check_scenario("migration", dfs=crash_budget,
+                                rand=crash_budget // 2, seed=seed + 4,
                                 crashes=1))
     closure = crash_closure_all()
     gates.append({"gate": "crash-closure", "ok": closure["ok"],

@@ -247,6 +247,62 @@ AUTOSCALE_POLICY = TransitionPolicy(
     }),
 )
 
+# -- cooperative migration (checkpoint-then-switch, pkg/migration.py) --------
+#
+# The migration controller moves a LIVE claim with workload
+# cooperation: the destination window is reserved FIRST, the workload
+# is signaled (annotation + CDI env contract) and given a bounded
+# window to checkpoint and ack, and only then does the gang drain and
+# re-place onto the reserved window. One durable record per in-flight
+# move, same group-committed CheckpointManager as every other ladder:
+#
+#   absent -> MigrationDestReserved    (destination devices chosen and
+#                                       reserved; hint stamped)
+#   MigrationDestReserved -> MigrationIntentSignaled
+#                                      (migration-intent annotation
+#                                       stamped; workload now sees the
+#                                       signal via its env contract)
+#   MigrationIntentSignaled -> MigrationWorkloadAcked
+#                                      (workload checkpointed and
+#                                       acked within TPU_DRA_MIGRATION_ACK_S)
+#   MigrationWorkloadAcked -> MigrationSwitching
+#                                      (gang drained, allocation
+#                                       cleared; scheduler re-places
+#                                       onto the reserved window)
+#   <any> -> absent                    (completed -- or ANY failure:
+#                                       ack timeout, checkpoint
+#                                       failure, destination lost,
+#                                       claim gone. Fallback retires
+#                                       the record and hands the claim
+#                                       to the cold eviction path.)
+#
+# The per-state escape to absent is load-bearing: EVERY failure mode
+# must degrade to the cold path with the reservation released, so no
+# reachable state may lack a legal retirement edge (crash_closure_all
+# proves exactly that).
+
+MIGRATION_DEST_RESERVED = "MigrationDestReserved"
+MIGRATION_INTENT_SIGNALED = "MigrationIntentSignaled"
+MIGRATION_WORKLOAD_ACKED = "MigrationWorkloadAcked"
+MIGRATION_SWITCHING = "MigrationSwitching"
+
+MIGRATION_POLICY = TransitionPolicy(
+    "migration",
+    frozenset({
+        (ABSENT, MIGRATION_DEST_RESERVED),        # window reserved
+        (MIGRATION_DEST_RESERVED,
+         MIGRATION_INTENT_SIGNALED),              # workload signaled
+        (MIGRATION_INTENT_SIGNALED,
+         MIGRATION_WORKLOAD_ACKED),               # checkpoint acked
+        (MIGRATION_WORKLOAD_ACKED,
+         MIGRATION_SWITCHING),                    # gang drained
+        (MIGRATION_DEST_RESERVED, ABSENT),        # fallback / canceled
+        (MIGRATION_INTENT_SIGNALED, ABSENT),      # ack timeout fallback
+        (MIGRATION_WORKLOAD_ACKED, ABSENT),       # dest lost fallback
+        (MIGRATION_SWITCHING, ABSENT),            # re-placed / fallback
+    }),
+)
+
 PARTITION_POLICY = TransitionPolicy(
     "partition",
     frozenset({
@@ -269,6 +325,7 @@ POLICIES = {
     "defrag": DEFRAG_POLICY,
     "partition": PARTITION_POLICY,
     "autoscale": AUTOSCALE_POLICY,
+    "migration": MIGRATION_POLICY,
 }
 
 

@@ -205,10 +205,11 @@ class AutoscaleController:
             logger.warning("autoscale sync: list failed; retrying "
                            "next pass")
             return counts
-        live, pending = self._ingest_claim_demand(claims)
+        live, pending, coop = self._ingest_claim_demand(claims)
         self._advance(counts)
         if not self.paused():
-            self._detect_and_plan(crds, live, pending, counts)
+            self._detect_and_plan(crds, live, pending, counts,
+                                  coop=coop)
             self._plan_prewarm(crds, counts)
         if counts["planned"]:
             # Issue the freshly planned rollout's CRD write in the
@@ -227,14 +228,22 @@ class AutoscaleController:
     # -- demand ingest --------------------------------------------------------
 
     def _ingest_claim_demand(self, claims: list[dict]
-                             ) -> tuple[set[str], set[str]]:
+                             ) -> tuple[set[str], set[str], set[str]]:
         """Fold annotation-declared demand into the store; returns
-        (live tenant keys, pending tenant keys). Re-observed every
-        pass on purpose: live claims keep their demand fresh inside
-        the sliding window, and a retired claim's samples age out --
-        the decay half of the diurnal loop."""
+        (live tenant keys, pending tenant keys, cooperative tenant
+        keys). Re-observed every pass on purpose: live claims keep
+        their demand fresh inside the sliding window, and a retired
+        claim's samples age out -- the decay half of the diurnal loop.
+
+        A tenant is COOPERATIVE when every one of its live claims
+        declares the checkpoint-then-switch contract
+        (``resource.tpu.dra/migration-capable``): resizing it is a
+        cheap cooperative move, so its repack hysteresis relaxes."""
+        from ..recovery import claim_migration_capable  # noqa: PLC0415
+
         live: set[str] = set()
         pending: set[str] = set()
+        cold: set[str] = set()
         for claim in claims:
             md = _meta(claim)
             if md.get("deletionTimestamp"):
@@ -244,6 +253,8 @@ class AutoscaleController:
             if not tenant:
                 continue
             live.add(tenant)
+            if not claim_migration_capable(claim):
+                cold.add(tenant)
             if not claim.get("status", {}).get("allocation"):
                 pending.add(tenant)
             raw = ann.get(TENANT_DEMAND_HBM_ANNOTATION)
@@ -255,7 +266,7 @@ class AutoscaleController:
             except (TypeError, ValueError):
                 continue  # malformed demand: observe nothing
             self.store.observe(tenant, hbm, cores=cores)
-        return live, pending
+        return live, pending, live - cold
 
     # -- planning -------------------------------------------------------------
 
@@ -266,7 +277,8 @@ class AutoscaleController:
         return None
 
     def _detect_and_plan(self, crds: list[dict], live: set[str],
-                         pending: set[str], counts: dict) -> None:
+                         pending: set[str], counts: dict,
+                         coop: set[str] | None = None) -> None:
         if self._checkpoint.get().claims:
             return  # one rollout at a time: finish it first
         now = time.time()
@@ -302,7 +314,7 @@ class AutoscaleController:
             self.store, active, rules=rules, chip_hbm=chip_hbm,
             cores_per_chip=cores_per_chip, live_tenants=live,
             pending_tenants=pending,
-            pools=self.pools)
+            pools=self.pools, coop_tenants=coop)
         if not plan.changed:
             counts["converged"] += 1
             self._drift_since = None

@@ -175,7 +175,8 @@ class AutoscalePlanner:
              live_tenants: set[str] | None = None,
              pending_tenants: set[str] | None = None,
              pools: tuple[str, ...] = (),
-             now: float | None = None) -> PlanResult:
+             now: float | None = None,
+             coop_tenants: set[str] | None = None) -> PlanResult:
         """Size every fresh tenant key against the catalog and diff
         the result against ``active``.
 
@@ -183,9 +184,15 @@ class AutoscalePlanner:
         profiles are retained even when every sample aged out of the
         window (never yank a serving tenant's profile under it).
         ``pending_tenants``: tenant keys with PENDING claims -- a
-        missing/undersized profile for one of these is urgent."""
+        missing/undersized profile for one of these is urgent.
+        ``coop_tenants``: tenant keys whose every live claim declares
+        the cooperative migration contract (pkg/migration) -- their
+        repack-down hysteresis band shrinks by the cooperative cost
+        factor, because resizing them costs a bounded
+        checkpoint-restore instead of a cold restart."""
         live_tenants = live_tenants or set()
         pending_tenants = pending_tenants or set()
+        coop_tenants = coop_tenants or set()
         active_by_name = {p.name: p for p in active.profiles}
         fresh = set(store.fresh_tenants(now=now)) | set(live_tenants)
         decisions: dict = {}
@@ -233,9 +240,21 @@ class AutoscalePlanner:
                     urgent = True
                 elif s_new > s_old:
                     # Could pack finer -- but only when demand sits
-                    # clearly below the finer budget (hysteresis).
+                    # clearly below the finer budget (hysteresis). A
+                    # cooperative tenant's band shrinks: its resize is
+                    # a cheap checkpoint-then-switch, so the planner
+                    # converges on it aggressively instead of
+                    # rationing the disruption.
+                    band = self.band
+                    if tenant in coop_tenants:
+                        from ..recovery import (  # noqa: PLC0415
+                            COOP_COST_FACTOR,
+                        )
+
+                        band = self.band * min(max(
+                            COOP_COST_FACTOR, 0.0), 1.0)
                     budget_new = chip_hbm // s_new
-                    if demand.hbm_bytes > budget_new * (1 - self.band):
+                    if demand.hbm_bytes > budget_new * (1 - band):
                         choice = self._keep(cur, chip_hbm,
                                             cores_per_chip)
                         action = "keep"

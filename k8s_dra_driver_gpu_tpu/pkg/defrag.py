@@ -63,6 +63,7 @@ from .recovery import (
     allocation_nodes,
     claim_gang_id,
     clear_allocation,
+    coop_cost_multiplier,
     drain_claim,
 )
 from .topology import TorusGrid
@@ -496,7 +497,13 @@ class DefragController:
             disruption = sum(companions(u) for u in uids)
             aged = age_cost([by_uid[u] for u in uids],
                             self.age_weight, now=now)
-            return chips + self.disruption_weight * disruption + aged
+            # Cooperative tier (pkg/migration contract): victims that
+            # checkpoint on demand are far cheaper to displace, so the
+            # repack prefers them over cold-restart claims of equal
+            # size and age.
+            coop = coop_cost_multiplier([by_uid[u] for u in uids])
+            return (chips + self.disruption_weight * disruption
+                    + aged) * coop
 
         budget = max(1, int(len(allocations) * self.budget_pct / 100))
         plan = plan_repack(grid, free, allocations, movable=movable,

@@ -887,6 +887,81 @@ class DefragMetrics:
         )
 
 
+class MigrationMetrics:
+    """Cooperative-migration observability (pkg/migration.py, on the
+    scheduler registry).
+
+    A healthy controller shows every ``plans_total`` retiring through
+    ``coop_moves_total`` with ``fallbacks_total`` flat -- a rising
+    fallback rate means workloads stopped honoring the ack contract
+    (read the ``reason`` label: ack-timeout means the ack window is
+    undersized for real checkpoint time, checkpoint-failed means the
+    workload's own save path is broken, destination-lost means the
+    fleet is losing capacity mid-handshake). ``ack_seconds`` is the
+    workload's checkpoint time (size TPU_DRA_MIGRATION_ACK_S from its
+    p99); ``switch_seconds`` is the actual downtime (drain ->
+    re-placed); ``move_seconds`` the whole handshake. ``active_moves``
+    returning to zero after every handshake is the no-stuck-claims
+    invariant the chaos suite pins."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.plans = Counter(
+            "tpu_dra_migration_plans_total",
+            "Cooperative move groups planned (destination reserved, "
+            "durable records written).",
+            registry=self.registry,
+        )
+        self.coop_moves = Counter(
+            "tpu_dra_migration_coop_moves_total",
+            "Cooperative migrations completed warm (workload acked "
+            "its checkpoint, claim re-placed on the reserved window).",
+            registry=self.registry,
+        )
+        self.fallbacks = Counter(
+            "tpu_dra_migration_fallbacks_total",
+            "Cooperative moves degraded to the cold eviction path, by "
+            "reason (ack-timeout, checkpoint-failed, "
+            "destination-lost, deadline). The claim is never stuck: "
+            "fallback releases the reservation and drains cold.",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.active_moves = Gauge(
+            "tpu_dra_migration_active_moves",
+            "Migration handshake records currently in flight (bounded "
+            "by TPU_DRA_MIGRATION_MAX_CONCURRENT).",
+            registry=self.registry,
+        )
+        self.ack_seconds = Histogram(
+            "tpu_dra_migration_ack_seconds",
+            "Workload checkpoint time: intent signaled -> ack "
+            "annotation observed. Size TPU_DRA_MIGRATION_ACK_S from "
+            "this histogram's p99.",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 120.0, 300.0),
+            registry=self.registry,
+        )
+        self.switch_seconds = Histogram(
+            "tpu_dra_migration_switch_seconds",
+            "The actual workload downtime of a cooperative move: "
+            "drain/deallocate -> claim re-placed on the reserved "
+            "window (the workload restores warm from its own "
+            "checkpoint from there).",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 120.0, 300.0),
+            registry=self.registry,
+        )
+        self.move_seconds = Histogram(
+            "tpu_dra_migration_move_seconds",
+            "End-to-end latency of one completed cooperative move: "
+            "plan record written -> claim re-placed.",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 120.0, 300.0),
+            registry=self.registry,
+        )
+
+
 class AutoscaleMetrics:
     """Serving-autoscaler observability (pkg/autoscale, on the
     scheduler registry).

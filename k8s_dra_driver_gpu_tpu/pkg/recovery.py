@@ -104,6 +104,42 @@ AGE_WEIGHT = positive_float_env(
 AGE_SCALE_S = positive_float_env(
     "TPU_DRA_RECOVERY_AGE_SCALE_S", default=3600.0, floor=1.0)
 
+#: Claims carrying this annotation (any value but "false") declare the
+#: cooperative checkpoint-then-switch contract (pkg/migration): the
+#: workload checkpoints on demand when signaled, so moving it costs a
+#: bounded checkpoint-restore instead of a cold restart.
+MIGRATION_CAPABLE_ANNOTATION = "resource.tpu.dra/migration-capable"
+
+#: The second price tier of the 2502.01909 migration-cost model: a
+#: move group whose every member is migration-capable scores at this
+#: fraction of its cold cost. 0.25 means a cooperative gang is four
+#: times cheaper to displace -- recovery admission, defrag victim
+#: selection, and the autoscaler's repack hysteresis all converge more
+#: aggressively on workloads that promised to cooperate.
+COOP_COST_FACTOR = positive_float_env(
+    "TPU_DRA_COOP_COST_FACTOR", default=0.25, floor=0.0)
+
+
+def claim_migration_capable(claim: dict) -> bool:
+    raw = (_meta(claim).get("annotations") or {}).get(
+        MIGRATION_CAPABLE_ANNOTATION)
+    return raw is not None and raw not in ("false", "False", "0")
+
+
+def coop_cost_multiplier(claims: list[dict],
+                         factor: float | None = None) -> float:
+    """Cooperative discount for one move group: ``factor`` when EVERY
+    member declares the checkpoint-then-switch contract, 1.0
+    otherwise. All-or-nothing on purpose: a gang with one cold-only
+    member still pays a full cold rendezvous, so discounting it would
+    misprice the move."""
+    if not claims:
+        return 1.0
+    factor = COOP_COST_FACTOR if factor is None else factor
+    if all(claim_migration_capable(c) for c in claims):
+        return min(max(factor, 0.0), 1.0)
+    return 1.0
+
 
 def _meta(obj: dict) -> dict:
     return obj.get("metadata", {})
@@ -617,7 +653,13 @@ class EvictionController:
             # singleton when the concurrency cap forces a choice.
             aged = age_cost([by_uid[u] for u in uids if u in by_uid],
                             self.age_weight, now=now)
-            score = cost + self.disruption_weight * disruption + aged
+            # Cooperative tier: a group that checkpoints on demand
+            # loses a bounded restore, not its uptime -- its recovery
+            # is admitted ahead of equally-sized cold groups.
+            coop = coop_cost_multiplier(
+                [by_uid[u] for u in uids if u in by_uid])
+            score = (cost + self.disruption_weight * disruption
+                     + aged) * coop
             scored.append((score, gid, uids, cost, disruption))
         scored.sort(key=lambda t: (t[0], t[1]))
         faults.fault_point("recovery.plan")

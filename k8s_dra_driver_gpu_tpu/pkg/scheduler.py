@@ -110,7 +110,7 @@ DEFAULT_SCHED_BATCH = 8
 # Dirty-key kinds handled by the dedicated control worker (shard 0).
 _CTL_KINDS = frozenset((
     "full", "pending", "inventory", "daemonsets", "jobs", "recovery",
-    "defrag", "autoscale", "pods-rescan",
+    "defrag", "autoscale", "migration", "pods-rescan",
 ))
 
 
@@ -281,6 +281,12 @@ class DraScheduler:
         # demand; its rollouts land as CRD writes the node plugins'
         # watchers converge on.
         self.autoscaler = None
+        # Cooperative migration (pkg/migration.MigrationController):
+        # rides the same loop (full passes + claim events while
+        # handshakes are in flight); its destination reservations veto
+        # allocation exactly like defrag's, and its switch stage
+        # stamps the defrag placement hint to steer the re-placement.
+        self.migration = None
         # Claim-lifecycle flight recorder (pkg/flightrecorder): every
         # dirty-key enqueue / fit outcome / commit conflict / patch
         # lands in the bounded ring served at /debug/claims.
@@ -334,6 +340,18 @@ class DraScheduler:
             # The trigger signal reads THIS scheduler's fleet rings.
             controller.fleet = self.fleet
         self.defrag = controller
+        return self
+
+    def attach_migration(self, controller) -> "DraScheduler":
+        """Drive a pkg/migration.MigrationController from this
+        scheduler's loop: its sync runs inside every full pass (right
+        after recovery, so a freshly switched claim re-places in the
+        SAME pass) and on claim dirty keys while handshakes are in
+        flight; its reads come from this scheduler's informer-backed
+        view; allocation vetoes its destination reservations alongside
+        the defrag controller's."""
+        controller.view = self.view
+        self.migration = controller
         return self
 
     def attach_autoscaler(self, controller) -> "DraScheduler":
@@ -1222,12 +1240,17 @@ class DraScheduler:
                         list(exactly.get("tolerations") or []))
                 ],
             })
-        if self.defrag is not None:
-            # Defrag device veto: carve cells and in-flight move
-            # targets are reserved -- only the claim a device is
-            # reserved FOR may allocate it while the move is in
-            # flight (everyone else fits around the forming shape).
-            reserved = self.defrag.reservations()
+        if self.defrag is not None or self.migration is not None:
+            # Device veto: defrag carve cells / move targets and
+            # cooperative-migration destination windows are reserved
+            # -- only the claim a device is reserved FOR may allocate
+            # it while the move is in flight (everyone else fits
+            # around the forming shape / the reserved window).
+            reserved = {}
+            if self.defrag is not None:
+                reserved.update(self.defrag.reservations())
+            if self.migration is not None:
+                reserved.update(self.migration.reservations())
             if reserved:
                 uid = _meta(claim).get("uid", "")
                 for r in reqs:
@@ -2104,6 +2127,10 @@ class DraScheduler:
             # own objects; exactly one instance runs the cluster-wide
             # controllers.
             self._sync_recovery()
+            # After recovery, before allocation: a claim the migration
+            # controller switches this pass re-places (onto its
+            # reserved window) in the SAME pass.
+            self._sync_migration()
             self._sync_daemonsets()
             self._sync_jobs()
         self._generate_claims()
@@ -2178,6 +2205,17 @@ class DraScheduler:
             self.autoscaler.sync_once()
         except Exception:  # noqa: BLE001 - control loop
             logger.exception("autoscale sync failed")
+
+    def _sync_migration(self) -> None:
+        """One migration-controller pass. InjectedCrash (a
+        BaseException) sails through on purpose -- the chaos suite's
+        controller-death scenarios depend on it."""
+        if self.migration is None:
+            return
+        try:
+            self.migration.sync_once()
+        except Exception:  # noqa: BLE001 - control loop
+            logger.exception("migration sync failed")
 
     # -- event-driven incremental sync ----------------------------------------
 
@@ -2288,6 +2326,11 @@ class DraScheduler:
                 # record without waiting for the safety resync; quiet
                 # fleets never pay a defrag pass per claim event.
                 self._enqueue(("defrag",))
+            if self.migration is not None and self.migration.busy():
+                # And for in-flight cooperative handshakes: the
+                # workload's ack lands as a claim annotation patch, so
+                # the claim event IS the handshake's forward edge.
+                self._enqueue(("migration",))
             for pod_name in self._dependent_pods(ns, name, obj):
                 self._enqueue(("pod", ns, pod_name))
         elif resource == "resourceslices":
@@ -2295,6 +2338,10 @@ class DraScheduler:
             if self.recovery is not None:
                 # Fatal device taints arrive as slice writes.
                 self._enqueue(("recovery",))
+            if self.migration is not None and self.migration.busy():
+                # A retired slice may take an in-flight handshake's
+                # reserved destination with it (destination lost).
+                self._enqueue(("migration",))
         elif resource == "deviceclasses":
             self._enqueue(("pending",))
         elif resource == "computedomains":
@@ -2311,6 +2358,10 @@ class DraScheduler:
             if resource == "nodes" and self.recovery is not None:
                 # NotReady transitions / node deletion feed escalation.
                 self._enqueue(("recovery",))
+            if resource == "nodes" and self.migration is not None:
+                # The cooperative-evacuation annotation arrives as a
+                # node write.
+                self._enqueue(("migration",))
         elif resource == "jobs":
             self._enqueue(("jobs",))
         elif resource == "resourceclaimtemplates":
@@ -2360,7 +2411,7 @@ class DraScheduler:
         kind = key[0]
         try:
             if kind in ("daemonsets", "jobs", "recovery", "defrag",
-                        "autoscale") and \
+                        "autoscale", "migration") and \
                     not self._cluster_controllers:
                 return  # another domain owns the cluster controllers
             if kind == "full":
@@ -2395,6 +2446,11 @@ class DraScheduler:
                 self._sync_defrag()
                 # A defrag pass deallocates moving claims; re-place
                 # them (onto their hinted targets) immediately.
+                self._retry_pending_claims()
+            elif kind == "migration":
+                self._sync_migration()
+                # A switch deallocates the moving claim; re-place it
+                # (onto its reserved window) immediately.
                 self._retry_pending_claims()
             elif kind == "autoscale":
                 self._sync_autoscale()
@@ -2706,6 +2762,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="state root for the active-defragmentation "
                         "controller's durable move records; empty = "
                         "defrag disabled [TPU_DRA_DEFRAG_ROOT]")
+    p.add_argument("--migration-root",
+                   default=os.environ.get("TPU_DRA_MIGRATION_ROOT", ""),
+                   help="state root for the cooperative live-migration "
+                        "controller's durable move records "
+                        "(checkpoint-then-switch handshakes, "
+                        "pkg/migration); empty = cooperative "
+                        "migration disabled [TPU_DRA_MIGRATION_ROOT]")
     p.add_argument("--autoscale-root",
                    default=os.environ.get("TPU_DRA_AUTOSCALE_ROOT", ""),
                    help="state root for the serving autoscaler's "
@@ -2781,6 +2844,15 @@ def main(argv: list[str] | None = None) -> int:
                           if metrics is not None else None)
         sched.attach_defrag(DefragController(
             sched.kube, args.defrag_root, metrics=defrag_metrics))
+    if args.migration_root:
+        from .metrics import MigrationMetrics  # noqa: PLC0415
+        from .migration import MigrationController  # noqa: PLC0415
+
+        migration_metrics = (MigrationMetrics(registry=metrics.registry)
+                             if metrics is not None else None)
+        sched.attach_migration(MigrationController(
+            sched.kube, args.migration_root,
+            metrics=migration_metrics))
     if args.autoscale_root:
         from .autoscale import AutoscaleController  # noqa: PLC0415
         from .metrics import AutoscaleMetrics  # noqa: PLC0415

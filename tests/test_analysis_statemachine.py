@@ -143,6 +143,50 @@ class TestTransitionPolicy:
 
         assert POLICIES["autoscale"] is AUTOSCALE_POLICY
 
+    @pytest.mark.parametrize("old,new", [
+        (None, "MigrationDestReserved"),
+        ("MigrationDestReserved", "MigrationIntentSignaled"),
+        ("MigrationIntentSignaled", "MigrationWorkloadAcked"),
+        ("MigrationWorkloadAcked", "MigrationSwitching"),
+        # EVERY rung must retire to absent: that edge IS the
+        # guaranteed cold fallback (and the racing-delete cancel).
+        ("MigrationDestReserved", None),
+        ("MigrationIntentSignaled", None),
+        ("MigrationWorkloadAcked", None),
+        ("MigrationSwitching", None),
+    ])
+    def test_migration_ladder_legal(self, old, new):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+            MIGRATION_POLICY,
+        )
+
+        MIGRATION_POLICY.validate("u", old, new)  # no raise
+
+    @pytest.mark.parametrize("old,new", [
+        (None, "MigrationIntentSignaled"),   # signal without reserve
+        (None, "MigrationSwitching"),        # switch without handshake
+        ("MigrationDestReserved",
+         "MigrationWorkloadAcked"),          # skipped the signal
+        ("MigrationIntentSignaled",
+         "MigrationSwitching"),              # switch before the ack
+        ("MigrationSwitching",
+         "MigrationDestReserved"),           # backwards
+    ])
+    def test_migration_stage_skips_illegal(self, old, new):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+            MIGRATION_POLICY,
+        )
+
+        with pytest.raises(CheckpointTransitionError):
+            MIGRATION_POLICY.validate("u", old, new)
+
+    def test_migration_policy_registered(self):
+        from k8s_dra_driver_gpu_tpu.pkg.analysis.statemachine import (
+            MIGRATION_POLICY,
+        )
+
+        assert POLICIES["migration"] is MIGRATION_POLICY
+
 
 class TestRuntimeValidatorInCheckpointManager:
     def test_legal_lifecycle_commits(self, tmp_root):

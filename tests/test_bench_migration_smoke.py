@@ -1,0 +1,79 @@
+"""Tier-1 migration smoke: the `make bench-migration-smoke` contract
+as a non-slow test. Runs bench.py --migration at reduced scale and
+asserts the cooperative live-migration acceptance bar: the training
+gang migrates off the evacuating host with bounded step-loss and a
+warm checkpoint restore, the serving tenant resizes s8->s2 with zero
+dropped requests, every fault case (4 crash seams, ack-timeout,
+checkpoint-failed, destination-lost, racing-delete) resumes or falls
+back cold with zero stuck claims / leaked reservations / leftover
+contract annotations, and the cooperative cost tier visibly discounts
+defrag victim costs on identical pools -- plus the
+BENCH_migration.json trajectory file actually written."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Keep in sync with the Makefile bench-migration-smoke target.
+SMOKE_ENV = {
+    "BENCH_MIGRATION_PASSES": "24",
+    "BENCH_MIGRATION_REQUESTS_PER_PASS": "3",
+}
+
+
+def test_bench_migration_smoke_moves_warm_and_falls_back_cold(tmp_path):
+    out_json = tmp_path / "BENCH_migration.json"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--migration"],
+        env={**os.environ, "PYTHONPATH": REPO, **SMOKE_ENV,
+             "BENCH_MIGRATION_OUT": str(out_json)},
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "migration_violations"
+    # THE acceptance bar: zero violations of any kind.
+    assert doc["value"] == 0
+    extras = doc["extras"]
+
+    # Training gang: both members moved cooperatively (zero cold
+    # fallbacks) with bounded step-loss and an intact warm restore.
+    assert extras["migration_train_coop_moves"] == 2
+    assert extras["migration_train_fallbacks"] == 0
+    assert extras["migration_train_warm_restore_ok"] == 1
+    assert extras["migration_train_step_loss"] <= 5
+    # The cooperative checkpoint-on-demand must beat (or match) the
+    # periodic-checkpoint cold counterfactual.
+    assert extras["migration_train_step_loss"] <= \
+        extras["migration_train_cold_step_loss_counterfactual"]
+
+    # Serving resize s8 -> s2: make-before-break, zero drops.
+    assert extras["migration_serving_dropped"] == 0
+    assert extras["migration_serving_resize_done"] == 1
+    assert extras["migration_serving_final_chips"] == 2
+    assert extras["migration_serving_coop_moves"] >= 1
+
+    # Every fault case landed on its contract: crash seams resume,
+    # non-crash faults fall back cold, a racing delete cancels.
+    sweep = extras["migration_fault_sweep"]
+    for case in ("crash-sync", "crash-reserve", "crash-signal",
+                 "crash-switch"):
+        assert sweep[case] == "resumed", (case, sweep)
+    assert sweep["ack-timeout"] == "fellback:ack-timeout"
+    assert sweep["checkpoint-failed"] == "fellback:checkpoint-failed"
+    assert sweep["racing-delete"] == "canceled"
+    assert sweep["destination-lost"].startswith("fellback:")
+
+    # The cooperative tier visibly discounts the SAME defrag victims.
+    assert extras["migration_defrag_cold_victims"] == \
+        extras["migration_defrag_coop_victims"]
+    assert extras["migration_defrag_cost_ratio"] is not None
+    assert extras["migration_defrag_cost_ratio"] <= 0.5
+
+    # The trajectory file landed.
+    recorded = json.loads(out_json.read_text())
+    assert recorded["metric"] == "migration_violations"
+    assert recorded["trajectory"]

@@ -20,8 +20,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Keep in sync with the Makefile bench-telemetry-smoke target.
 SMOKE_ENV = {
-    "BENCH_TELEMETRY_ITERS": "8",
-    "BENCH_TELEMETRY_REPS": "2",
+    "BENCH_TELEMETRY_ITERS": "12",
+    "BENCH_TELEMETRY_REPS": "3",
     "BENCH_TELEMETRY_MAX_OVERHEAD_PCT": "5",
 }
 

@@ -204,6 +204,10 @@ PRODUCERS = {
     "fold_seconds": r"fold_hist\.observe\(",
     "move_seconds": r"\.move_seconds\.observe\(",
     "rollout_seconds": r"\.rollout_seconds\.observe\(",
+    # MigrationMetrics (pkg/migration.py). move_seconds shares its attr
+    # name with DefragMetrics, so the row above already covers it.
+    "ack_seconds": r"\.ack_seconds\.observe\(",
+    "switch_seconds": r"\.switch_seconds\.observe\(",
 }
 
 

@@ -1,0 +1,546 @@
+"""Cooperative live migration (ISSUE 19): the shared drain/deallocate
+helpers every migration controller rides (pkg/recovery.drain_claim /
+clear_allocation) and the checkpoint-then-switch MigrationController
+(pkg/migration) -- happy path, every fallback reason, the post-fallback
+cooldown, crash-resume from the durable records, and the gang ack
+barrier.
+
+The acceptance bar under test: a migration-capable claim on an
+evacuating node moves warm through reserve -> signal -> ack -> switch,
+EVERY failure mode (ack timeout, checkpoint failure, destination lost,
+whole-move deadline, racing delete, controller crash) degrades to the
+PR 6 cold eviction semantics with the reservation released and zero
+leftover contract annotations, and the shared drain/clear stages stay
+idempotent under partial failure and crash re-entry."""
+
+import time
+
+import pytest
+
+from k8s_dra_driver_gpu_tpu.pkg import faults
+from k8s_dra_driver_gpu_tpu.pkg.defrag import DEFRAG_TARGET_ANNOTATION
+from k8s_dra_driver_gpu_tpu.pkg.faults import InjectedCrash
+from k8s_dra_driver_gpu_tpu.pkg.kubeclient import (
+    ConflictError,
+    FakeKubeClient,
+)
+from k8s_dra_driver_gpu_tpu.pkg.metrics import MigrationMetrics
+from k8s_dra_driver_gpu_tpu.pkg.migration import (
+    ACK_FAILED,
+    EVACUATE_ANNOTATION,
+    MIGRATION_ACK_ANNOTATION,
+    MIGRATION_INTENT_ANNOTATION,
+    MigrationController,
+)
+from k8s_dra_driver_gpu_tpu.pkg.recovery import (
+    MIGRATION_CAPABLE_ANNOTATION,
+    clear_allocation,
+    drain_claim,
+)
+from k8s_dra_driver_gpu_tpu.pkg.scheduler import DraScheduler
+from k8s_dra_driver_gpu_tpu.pkg.sliceutil import publish_resource_slices
+
+RES = ("resource.k8s.io", "v1")
+DRIVER = "tpu.dra.dev"
+
+
+# -- cluster scaffolding ------------------------------------------------------
+
+
+def apply_class(kube, name=DRIVER):
+    kube.create(*RES, "deviceclasses", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "DeviceClass",
+        "metadata": {"name": name},
+        "spec": {"selectors": [{"cel": {
+            "expression": f'device.driver == "{name}"'}}]},
+    })
+
+
+def node_slices(node, chips=4):
+    return [{
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceSlice",
+        "metadata": {"name": f"{node}-{DRIVER}"},
+        "spec": {"driver": DRIVER, "nodeName": node,
+                 "pool": {"name": node, "generation": 1,
+                          "resourceSliceCount": 1},
+                 "devices": [{"name": f"chip-{j}", "attributes": {
+                     "type": {"string": "tpu-chip"},
+                     "index": {"int": j}}} for j in range(chips)]},
+    }]
+
+
+def add_node(kube, name):
+    kube.create("", "v1", "nodes", {
+        "metadata": {"name": name, "labels": {}},
+        "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+
+def make_capable_claim(kube, name, count=1, ns="default", gang=None,
+                       capable=True):
+    spec = {"devices": {"requests": [{
+        "name": "tpu",
+        "exactly": {"deviceClassName": DRIVER, **(
+            {"count": count} if count != 1 else {})},
+    }]}}
+    if gang:
+        spec["devices"]["config"] = [{"opaque": {
+            "driver": DRIVER,
+            "parameters": {"kind": "ComputeDomainChannelConfig",
+                           "domainID": gang},
+        }}]
+    annotations = {MIGRATION_CAPABLE_ANNOTATION: "true"} if capable \
+        else {}
+    kube.create(*RES, "resourceclaims", {
+        "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": ns,
+                     "annotations": annotations},
+        "spec": spec,
+    }, namespace=ns)
+
+
+def make_bound_pod(kube, name, claim_name, node, ns="default"):
+    kube.create("", "v1", "pods", {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"nodeName": node, "containers": [{"name": "c"}],
+                 "resourceClaims": [{"name": "tpu",
+                                     "resourceClaimName": claim_name}]},
+    }, namespace=ns)
+
+
+def get_claim(kube, name, ns="default"):
+    return kube.get(*RES, "resourceclaims", name, namespace=ns)
+
+
+def alloc_nodes(kube, name, ns="default"):
+    from k8s_dra_driver_gpu_tpu.pkg.recovery import allocation_nodes
+    return sorted(allocation_nodes(get_claim(kube, name, ns)))
+
+
+def annotations_of(kube, name, ns="default"):
+    return get_claim(kube, name, ns).get(
+        "metadata", {}).get("annotations") or {}
+
+
+def ack(kube, name, value="step-1", ns="default"):
+    kube.patch(*RES, "resourceclaims", name, {"metadata": {
+        "annotations": {MIGRATION_ACK_ANNOTATION: value}}},
+        namespace=ns)
+
+
+def evacuate(kube, node):
+    kube.patch("", "v1", "nodes", node, {"metadata": {
+        "annotations": {EVACUATE_ANNOTATION: "true"}}})
+
+
+def settle(sched, passes=6):
+    for _ in range(passes):
+        sched.sync_once()
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """(kube, scheduler, migration controller): claim 'w' (1 chip) +
+    bound consumer pod pinned on node-a (its slices published first),
+    node-b the only possible destination, controller riding the
+    scheduler loop. Cooldown 0 so fallback tests can re-plan."""
+    fake = FakeKubeClient()
+    apply_class(fake)
+    for node in ("node-a", "node-b"):
+        add_node(fake, node)
+    publish_resource_slices(fake, node_slices("node-a"))
+    sched = DraScheduler(fake)
+    make_capable_claim(fake, "w")
+    settle(sched)
+    assert alloc_nodes(fake, "w") == ["node-a"]
+    make_bound_pod(fake, "w-pod", "w", "node-a")
+    publish_resource_slices(fake, node_slices("node-b"))
+    mig = MigrationController(fake, str(tmp_path / "migration"),
+                              metrics=MigrationMetrics(),
+                              ack_s=60.0, deadline_s=60.0,
+                              cooldown_s=0.0)
+    sched.attach_migration(mig)
+    faults.reset()
+    yield fake, sched, mig
+    faults.reset()
+
+
+# -- the shared drain / deallocate stages -------------------------------------
+
+
+class _FlakyPatchKube:
+    """Raises ConflictError on the next ``fail`` patches, then passes
+    through -- the partial-patch seam both drain stages must survive."""
+
+    def __init__(self, inner, fail=1):
+        self._inner = inner
+        self.fail = fail
+
+    def patch(self, *a, **kw):
+        if self.fail > 0:
+            self.fail -= 1
+            raise ConflictError("injected patch conflict")
+        return self._inner.patch(*a, **kw)
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+
+class TestSharedDrainClear:
+    """pkg/recovery.drain_claim / clear_allocation: the one drain +
+    deallocate implementation recovery, defrag, AND migration share."""
+
+    def seed(self, reserve_pod="w-0"):
+        fake = FakeKubeClient()
+        fake.create(*RES, "resourceclaims", {
+            "apiVersion": "resource.k8s.io/v1", "kind": "ResourceClaim",
+            "metadata": {"name": "w", "namespace": "default",
+                         "uid": "uid-w"},
+            "spec": {},
+            "status": {
+                "allocation": {"devices": {"results": [{
+                    "request": "tpu", "driver": DRIVER,
+                    "pool": "node-a", "device": "chip-0"}]}},
+                "reservedFor": [{"resource": "pods",
+                                 "name": reserve_pod}],
+            },
+        }, namespace="default")
+        # Bound via the reservation, bound via the claim ref, and an
+        # UNBOUND consumer that must survive the drain.
+        make_bound_pod(fake, "w-0", "other-claim", "node-a")
+        make_bound_pod(fake, "w-1", "w", "node-a")
+        fake.create("", "v1", "pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "w-2", "namespace": "default"},
+            "spec": {"containers": [{"name": "c"}],
+                     "resourceClaims": [{"name": "tpu",
+                                         "resourceClaimName": "w"}]},
+        }, namespace="default")
+        claim = fake.get(*RES, "resourceclaims", "w",
+                         namespace="default")
+        pods = fake.list("", "v1", "pods")
+        return fake, claim, pods
+
+    def pod_names(self, fake):
+        return sorted(p["metadata"]["name"]
+                      for p in fake.list("", "v1", "pods"))
+
+    def test_drain_evicts_bound_consumers_and_drops_reservation(self):
+        fake, claim, pods = self.seed()
+        drain_claim(fake, claim, pods)
+        # Reserved pod + claim-ref pod evicted; unbound pod survives
+        # (it just waits for the re-placement).
+        assert self.pod_names(fake) == ["w-2"]
+        refreshed = fake.get(*RES, "resourceclaims", "w",
+                             namespace="default")
+        assert not refreshed.get("status", {}).get("reservedFor")
+        # ...and the allocation is untouched until clear_allocation.
+        assert refreshed["status"]["allocation"]
+        assert clear_allocation(fake, claim) is True
+        refreshed = fake.get(*RES, "resourceclaims", "w",
+                             namespace="default")
+        assert not refreshed.get("status", {}).get("allocation")
+
+    def test_claim_deleted_mid_drain_is_swallowed(self):
+        """The racing-delete seam: the controller drains from a STALE
+        claim snapshot after the claim (and a consumer pod) vanished.
+        Both helpers must treat NotFound as 'nothing left to do'."""
+        fake, claim, pods = self.seed()
+        fake.delete("", "v1", "pods", "w-1", namespace="default")
+        fake.delete(*RES, "resourceclaims", "w", namespace="default")
+        drain_claim(fake, claim, pods)  # no raise
+        assert self.pod_names(fake) == ["w-2"]
+        # The deallocate write is refused -> the caller re-examines
+        # next pass (and finds the claim gone).
+        assert clear_allocation(fake, claim) is False
+
+    def test_partial_patch_failure_leaves_retryable_state(self):
+        """A conflicted status patch mid-drain must not raise OR leave
+        a half-written claim: pods are already evicted, the reservation
+        survives, and a clean re-run finishes the job."""
+        fake, claim, pods = self.seed()
+        flaky = _FlakyPatchKube(fake, fail=2)
+        drain_claim(flaky, claim, pods)  # reservedFor patch conflicted
+        assert self.pod_names(fake) == ["w-2"]
+        refreshed = fake.get(*RES, "resourceclaims", "w",
+                             namespace="default")
+        assert refreshed["status"]["reservedFor"]  # patch was refused
+        assert clear_allocation(flaky, claim) is False  # ditto
+        assert fake.get(*RES, "resourceclaims", "w",
+                        namespace="default")["status"]["allocation"]
+        # The retry (no injected fault left) converges.
+        drain_claim(flaky, refreshed, fake.list("", "v1", "pods"))
+        assert clear_allocation(flaky, claim) is True
+        refreshed = fake.get(*RES, "resourceclaims", "w",
+                             namespace="default")
+        assert not refreshed.get("status", {}).get("reservedFor")
+        assert not refreshed.get("status", {}).get("allocation")
+
+    def test_idempotent_reentry_after_crash(self):
+        """A restarted controller replays its durable record and runs
+        BOTH stages again from the original (now stale) snapshot: the
+        re-entry must be a no-op, not an error."""
+        fake, claim, pods = self.seed()
+        drain_claim(fake, claim, pods)
+        assert clear_allocation(fake, claim) is True
+        before = fake.get(*RES, "resourceclaims", "w",
+                          namespace="default")
+        drain_claim(fake, claim, pods)  # stale pods list: all 404s
+        assert clear_allocation(fake, claim) is True  # merge no-op
+        after = fake.get(*RES, "resourceclaims", "w",
+                         namespace="default")
+        assert self.pod_names(fake) == ["w-2"]
+        assert after.get("status") == before.get("status")
+
+    def test_deadline_expiry_mid_stage_drains_cold(self, tmp_path):
+        """The whole-move deadline expiring mid-handshake (here: at
+        IntentSignaled, workload never acked) runs the shared drain +
+        clear stages cold: pod evicted, allocation gone, contract
+        annotations gone, reservation released -- never a stuck
+        claim."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        for node in ("node-a", "node-b"):
+            add_node(fake, node)
+        publish_resource_slices(fake, node_slices("node-a"))
+        sched = DraScheduler(fake)
+        make_capable_claim(fake, "w")
+        settle(sched)
+        make_bound_pod(fake, "w-pod", "w", "node-a")
+        publish_resource_slices(fake, node_slices("node-b"))
+        mig = MigrationController(fake, str(tmp_path / "migration"),
+                                  ack_s=60.0, deadline_s=0.05,
+                                  cooldown_s=3600.0)
+        sched.attach_migration(mig)
+        evacuate(fake, "node-a")
+        settle(sched, passes=2)  # plan + signal
+        assert mig.active_moves()
+        assert MIGRATION_INTENT_ANNOTATION in annotations_of(fake, "w")
+        pre_drain_pods = {p["metadata"]["name"]
+                          for p in fake.list("", "v1", "pods")}
+        assert "w-pod" in pre_drain_pods
+        time.sleep(0.06)
+        settle(sched)
+        assert mig.active_moves() == {}
+        assert mig.reservations() == {}
+        anns = annotations_of(fake, "w")
+        assert MIGRATION_INTENT_ANNOTATION not in anns
+        assert DEFRAG_TARGET_ANNOTATION not in anns
+        assert "w-pod" not in {p["metadata"]["name"]
+                               for p in fake.list("", "v1", "pods")}
+        # Cold semantics = drained, deallocated, then re-placed by the
+        # ordinary scheduler pass (the cooldown blocks a re-plan spin).
+        assert alloc_nodes(fake, "w")
+
+
+# -- the migration controller -------------------------------------------------
+
+
+class TestMigrationController:
+    def test_happy_path_checkpoint_then_switch(self, cluster):
+        fake, sched, mig = cluster
+        evacuate(fake, "node-a")
+        settle(sched, passes=2)  # plan (reserve) + signal
+        anns = annotations_of(fake, "w")
+        assert MIGRATION_INTENT_ANNOTATION in anns
+        assert anns[MIGRATION_INTENT_ANNOTATION].startswith("node-b|")
+        assert ";ack-by=" in anns[MIGRATION_INTENT_ANNOTATION]
+        # The destination window is vetoed while the workload saves.
+        assert set(mig.reservations().values()) == {
+            get_claim(fake, "w")["metadata"]["uid"]}
+        ack(fake, "w", "step-7")
+        settle(sched)
+        # Acked -> switched -> re-placed on the reserved window ->
+        # record retired, contract annotations cleared.
+        assert alloc_nodes(fake, "w") == ["node-b"]
+        assert mig.active_moves() == {}
+        assert mig.reservations() == {}
+        anns = annotations_of(fake, "w")
+        assert MIGRATION_INTENT_ANNOTATION not in anns
+        assert MIGRATION_ACK_ANNOTATION not in anns
+        assert DEFRAG_TARGET_ANNOTATION not in anns
+        # The bound consumer was evicted exactly once, at the switch.
+        assert "w-pod" not in {p["metadata"]["name"]
+                               for p in fake.list("", "v1", "pods")}
+        assert mig.metrics.coop_moves._value.get() == 1
+
+    def test_ack_timeout_falls_back_without_touching_allocation(
+            self, tmp_path):
+        """Pre-switch fallback: the workload never stopped, so an ack
+        timeout releases the reservation and clears the contract but
+        leaves the claim running on its OLD allocation -- the cold
+        controllers own it from here."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        for node in ("node-a", "node-b"):
+            add_node(fake, node)
+        publish_resource_slices(fake, node_slices("node-a"))
+        sched = DraScheduler(fake)
+        make_capable_claim(fake, "w")
+        settle(sched)
+        make_bound_pod(fake, "w-pod", "w", "node-a")
+        publish_resource_slices(fake, node_slices("node-b"))
+        metrics = MigrationMetrics()
+        mig = MigrationController(fake, str(tmp_path / "migration"),
+                                  metrics=metrics, ack_s=0.02,
+                                  deadline_s=60.0, cooldown_s=3600.0)
+        sched.attach_migration(mig)
+        evacuate(fake, "node-a")
+        settle(sched, passes=2)
+        assert MIGRATION_INTENT_ANNOTATION in annotations_of(fake, "w")
+        time.sleep(0.03)
+        settle(sched)
+        assert mig.active_moves() == {}
+        assert mig.reservations() == {}
+        assert MIGRATION_INTENT_ANNOTATION not in annotations_of(
+            fake, "w")
+        assert alloc_nodes(fake, "w") == ["node-a"]  # still running
+        assert "w-pod" in {p["metadata"]["name"]
+                           for p in fake.list("", "v1", "pods")}
+        assert metrics.fallbacks.labels(
+            "ack-timeout")._value.get() == 1
+        # The cooldown quarantines the claim: no immediate re-plan
+        # spin against the still-evacuating node.
+        settle(sched)
+        assert mig.active_moves() == {}
+        assert metrics.plans._value.get() == 1
+
+    def test_checkpoint_failed_ack_falls_back(self, cluster):
+        fake, sched, mig = cluster
+        evacuate(fake, "node-a")
+        settle(sched, passes=2)
+        # Lift the evacuation so the zero-cooldown fixture does not
+        # immediately re-plan the claim after the fallback.
+        fake.patch("", "v1", "nodes", "node-a", {"metadata": {
+            "annotations": {EVACUATE_ANNOTATION: None}}})
+        ack(fake, "w", ACK_FAILED)
+        sched.sync_once()
+        assert mig.active_moves() == {}
+        assert mig.reservations() == {}
+        assert mig.metrics.fallbacks.labels(
+            "checkpoint-failed")._value.get() == 1
+        assert alloc_nodes(fake, "w") == ["node-a"]
+        anns = annotations_of(fake, "w")
+        assert MIGRATION_INTENT_ANNOTATION not in anns
+        assert MIGRATION_ACK_ANNOTATION not in anns
+
+    def test_destination_lost_falls_back(self, cluster):
+        fake, sched, mig = cluster
+        evacuate(fake, "node-a")
+        settle(sched, passes=2)
+        assert mig.reservations()
+        # The reserved window evaporates: node-b's slices retire.
+        fake.delete(*RES, "resourceslices", f"node-b-{DRIVER}")
+        settle(sched, passes=2)
+        assert mig.active_moves() == {}
+        assert mig.reservations() == {}
+        assert mig.metrics.fallbacks.labels(
+            "destination-lost")._value.get() == 1
+        assert alloc_nodes(fake, "w") == ["node-a"]
+
+    def test_racing_claim_delete_cancels(self, cluster):
+        fake, sched, mig = cluster
+        evacuate(fake, "node-a")
+        settle(sched, passes=2)
+        assert mig.active_moves()
+        fake.delete("", "v1", "pods", "w-pod", namespace="default")
+        fake.delete(*RES, "resourceclaims", "w", namespace="default")
+        sched.sync_once()
+        assert mig.active_moves() == {}
+        assert mig.reservations() == {}
+        assert mig.metrics.fallbacks._metrics == {}  # canceled, not
+        assert mig.metrics.coop_moves._value.get() == 0  # fallen back
+
+    def test_crash_resume_rebuilds_reservations_and_completes(
+            self, cluster, tmp_path):
+        """A controller crash at the switch seam resumes from the
+        durable records: the rebuilt controller re-derives EXACTLY the
+        predecessor's reservation veto and finishes the move warm."""
+        fake, sched, mig = cluster
+        evacuate(fake, "node-a")
+        settle(sched, passes=2)
+        ack(fake, "w", "step-3")
+        sched.sync_once()  # -> WorkloadAcked
+        before = dict(mig.reservations())
+        assert before
+        faults.arm("migration.switch", mode="crash", count=1)
+        with pytest.raises(InjectedCrash):
+            sched.sync_once()
+        # Process death: rebuild from the same durable root.
+        reborn = MigrationController(
+            fake, str(tmp_path / "migration"),
+            metrics=MigrationMetrics(), ack_s=60.0, deadline_s=60.0,
+            cooldown_s=0.0)
+        assert dict(reborn.reservations()) == before
+        sched.attach_migration(reborn)
+        settle(sched)
+        assert alloc_nodes(fake, "w") == ["node-b"]
+        assert reborn.active_moves() == {}
+        assert reborn.reservations() == {}
+        assert reborn.metrics.coop_moves._value.get() == 1
+
+    def test_gang_switches_behind_all_acked_barrier(self, tmp_path):
+        """Two CD channel claims in one gang: neither drains until
+        BOTH acked -- one member switching alone would strand the
+        rendezvous it is part of."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        for node in ("node-a", "node-b"):
+            add_node(fake, node)
+        publish_resource_slices(fake, node_slices("node-a"))
+        sched = DraScheduler(fake)
+        make_capable_claim(fake, "g0", count=2, gang="cd-1")
+        make_capable_claim(fake, "g1", count=2, gang="cd-1")
+        settle(sched)
+        assert alloc_nodes(fake, "g0") == ["node-a"]
+        assert alloc_nodes(fake, "g1") == ["node-a"]
+        publish_resource_slices(fake, node_slices("node-b"))
+        mig = MigrationController(fake, str(tmp_path / "migration"),
+                                  metrics=MigrationMetrics(),
+                                  ack_s=60.0, deadline_s=60.0,
+                                  max_concurrent=2, cooldown_s=0.0)
+        sched.attach_migration(mig)
+        evacuate(fake, "node-a")
+        settle(sched, passes=2)  # reserve the WHOLE gang + signal
+        assert len(mig.active_moves()) == 2
+        assert len(mig.reservations()) == 4  # 2 chips x 2 members
+        ack(fake, "g0", "step-5")
+        settle(sched, passes=2)
+        # g0 acked but g1 has not: the barrier holds both allocations.
+        assert alloc_nodes(fake, "g0") == ["node-a"]
+        assert alloc_nodes(fake, "g1") == ["node-a"]
+        assert "MigrationWorkloadAcked" in mig.active_moves().values()
+        ack(fake, "g1", "step-5")
+        settle(sched)
+        assert alloc_nodes(fake, "g0") == ["node-b"]
+        assert alloc_nodes(fake, "g1") == ["node-b"]
+        assert mig.active_moves() == {}
+        assert mig.reservations() == {}
+        assert mig.metrics.coop_moves._value.get() == 2
+
+    def test_gang_with_cold_only_member_is_refused(self, tmp_path):
+        """All-or-nothing capability: a gang with ONE member that
+        never declared the contract is left to the cold controllers
+        entirely -- no record, no reservation, no annotations."""
+        fake = FakeKubeClient()
+        apply_class(fake)
+        for node in ("node-a", "node-b"):
+            add_node(fake, node)
+        publish_resource_slices(fake, node_slices("node-a"))
+        sched = DraScheduler(fake)
+        make_capable_claim(fake, "g0", count=2, gang="cd-1")
+        make_capable_claim(fake, "g1", count=2, gang="cd-1",
+                           capable=False)
+        settle(sched)
+        publish_resource_slices(fake, node_slices("node-b"))
+        mig = MigrationController(fake, str(tmp_path / "migration"),
+                                  ack_s=60.0, deadline_s=60.0,
+                                  cooldown_s=0.0)
+        sched.attach_migration(mig)
+        evacuate(fake, "node-a")
+        settle(sched, passes=3)
+        assert mig.active_moves() == {}
+        assert mig.reservations() == {}
+        assert MIGRATION_INTENT_ANNOTATION not in annotations_of(
+            fake, "g0")
